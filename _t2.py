@@ -1,0 +1,40 @@
+import logging, time, glob
+logging.basicConfig(level=logging.INFO)
+import ray_tpu
+ray_tpu.init(num_cpus=4)
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+
+c = Counter.remote(10)
+import ray_tpu._private.worker as wm
+import ray_tpu._private.api as api
+w = wm.global_worker
+gcs = api._head_node.gcs_server
+raylet = api._head_node.raylet
+r = c.incr.remote()
+for tick in range(20):
+    time.sleep(1)
+    actors = [(a.state, a.addr, a.death_cause) for a in gcs.actors.values()]
+    e = w.owned.get(r.id)
+    print(f"t={tick} actors={actors} obj={e.state if e else 'GONE'} "
+          f"workers={len(raylet.workers)}", flush=True)
+    if e and e.state != "PENDING":
+        print("result:", ray_tpu.get(r), flush=True)
+        break
+
+for f in glob.glob(api._head_node.session_dir + "/logs/*"):
+    txt = open(f).read()
+    if txt.strip():
+        print("===", f, flush=True)
+        print(txt[-2000:], flush=True)
+import os
+os._exit(0)

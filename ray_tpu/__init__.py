@@ -1,0 +1,49 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Tasks, actors, and a shared-memory object store coordinated by a global
+control service (GCS) and per-node raylets, with ML libraries on top —
+distributed training driving jax pjit/shard_map SPMD over TPU meshes,
+hyperparameter tuning, serving, datasets, and RL.  Capabilities mirror the
+reference (justinvyu/ray, surveyed in SURVEY.md); the accelerator substrate
+is TPU-first throughout: TPU chips/slices/ICI topology are first-class
+scheduler resources and collectives are XLA over ICI/DCN rather than NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu._private.api import (  # noqa: F401
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+    wait_placement_group_ready,
+)
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.actor import method  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+from ray_tpu.exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "ObjectRef", "method",
+    "exceptions", "__version__",
+]

@@ -1,0 +1,263 @@
+"""Public API: init/shutdown/remote/get/put/wait and cluster introspection.
+
+Reference: python/ray/_private/worker.py — init :1024, connect :1846,
+get :2188, remote decorator overloads :122-366.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import inspect
+import threading
+
+from ray_tpu import exceptions as rexc
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import apply_system_config
+from ray_tpu._private.node import InProcessNode, new_session_dir
+from ray_tpu._private.worker import CoreWorker, MODE_DRIVER
+
+_state_lock = threading.RLock()
+_head_node: InProcessNode | None = None
+_loop = None
+_loop_thread = None
+
+
+def _ensure_loop():
+    global _loop, _loop_thread
+    if _loop is not None and _loop_thread.is_alive():
+        return _loop
+    ready = threading.Event()
+
+    def _main():
+        global _loop
+        _loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(_loop)
+        ready.set()
+        _loop.run_forever()
+
+    _loop_thread = threading.Thread(target=_main, name="ray_tpu-io",
+                                    daemon=True)
+    _loop_thread.start()
+    ready.wait(30)
+    return _loop
+
+
+def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
+         num_gpus=None, resources=None, object_store_memory=None,
+         namespace: str = "default", ignore_reinit_error: bool = False,
+         _system_config: dict | None = None, log_to_driver: bool = True,
+         runtime_env=None, **kwargs):
+    """Start a cluster on this machine (address=None) or connect to one
+    ("host:gcs_port")."""
+    global _head_node
+    with _state_lock:
+        if worker_mod.global_worker is not None and \
+                worker_mod.global_worker.connected:
+            if ignore_reinit_error:
+                return worker_mod.global_worker
+            raise RuntimeError("ray_tpu.init() called twice "
+                               "(use ignore_reinit_error=True)")
+        if _system_config:
+            apply_system_config(_system_config)
+        if num_tpus is None:
+            num_tpus = num_gpus
+        loop = _ensure_loop()
+        if address is None:
+            _head_node = InProcessNode(
+                loop, head=True, num_cpus=num_cpus, num_tpus=num_tpus,
+                resources=resources, object_store_memory=object_store_memory,
+                session_dir=new_session_dir()).start()
+            gcs_addr = _head_node.gcs_addr
+            raylet_addr = _head_node.raylet_addr
+            store_path = _head_node.raylet.store_path
+            store_cap = _head_node.raylet.store_capacity
+        else:
+            host, port = address.split(":")
+            gcs_addr = (host, int(port))
+            raylet_addr, store_path, store_cap = _discover_local_raylet(
+                loop, gcs_addr)
+        cw = CoreWorker(MODE_DRIVER, gcs_addr, raylet_addr=raylet_addr,
+                        store_path=store_path, store_cap=store_cap)
+        cw.loop = loop
+        fut = asyncio.run_coroutine_threadsafe(cw._connect(), loop)
+        fut.result(60)
+        cw.connected = True
+        worker_mod.global_worker = cw
+        atexit.register(shutdown)
+        return cw
+
+
+def _discover_local_raylet(loop, gcs_addr):
+    """Connecting to an existing cluster: find this machine's raylet."""
+    from ray_tpu._private import protocol
+
+    async def _find():
+        conn = await protocol.Connection.connect(gcs_addr[0], gcs_addr[1],
+                                                 name="probe")
+        nodes = await conn.request("get_nodes", {})
+        await conn.close()
+        return nodes
+
+    nodes = asyncio.run_coroutine_threadsafe(_find(), loop).result(30)
+    import socket
+    local_hosts = {"127.0.0.1", "localhost", socket.gethostname()}
+    for n in nodes:
+        if n["alive"] and n["addr"][0] in local_hosts:
+            # store path/capacity arrive in the raylet's register_worker
+            # reply (see CoreWorker._connect)
+            return tuple(n["addr"]), None, None
+    raise RuntimeError("no alive raylet found on this machine")
+
+
+def shutdown():
+    global _head_node
+    with _state_lock:
+        cw = worker_mod.global_worker
+        if cw is not None:
+            cw.shutdown()
+            worker_mod.global_worker = None
+        if _head_node is not None:
+            _head_node.kill()
+            _head_node = None
+
+
+def is_initialized() -> bool:
+    return (worker_mod.global_worker is not None
+            and worker_mod.global_worker.connected)
+
+
+def remote(*args, **kwargs):
+    """@ray_tpu.remote decorator for functions and classes (reference:
+    python/ray/_private/worker.py:122-366)."""
+    from ray_tpu.actor import ActorClass
+    from ray_tpu.remote_function import RemoteFunction
+
+    def _make(target, opts):
+        if inspect.isclass(target):
+            return ActorClass(target, **opts)
+        return RemoteFunction(target, **opts)
+
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0])
+                                          or inspect.isclass(args[0])):
+        return _make(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+
+    def decorator(target):
+        return _make(target, kwargs)
+    return decorator
+
+
+def _worker() -> CoreWorker:
+    cw = worker_mod.global_worker
+    if cw is None or not cw.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return cw
+
+
+def get(refs, *, timeout=None):
+    return _worker().get(refs, timeout=timeout)
+
+
+def put(value) -> "ObjectRef":
+    return _worker().put(value)
+
+
+def wait(refs, *, num_returns=1, timeout=None, fetch_local=True):
+    if not isinstance(refs, list):
+        raise TypeError("ray_tpu.wait() expects a list of ObjectRefs")
+    return _worker().wait(refs, num_returns=num_returns, timeout=timeout,
+                          fetch_local=fetch_local)
+
+
+def kill(actor, *, no_restart=True):
+    from ray_tpu.actor import ActorHandle
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("ray_tpu.kill() expects an actor handle")
+    w = _worker()
+    w._run(w.gcs.request("kill_actor", {"actor_id": actor._ray_actor_id,
+                                        "no_restart": no_restart}))
+
+
+def get_actor(name: str, namespace: str = "default"):
+    from ray_tpu.actor import ActorHandle
+    w = _worker()
+    view = w._run(w.gcs.request("get_named_actor",
+                                {"name": name, "namespace": namespace}))
+    if view is None:
+        raise ValueError(f"no actor named '{name}'")
+    return ActorHandle(view["actor_id"], view.get("class_name", ""),
+                       addr=tuple(view["addr"]) if view.get("addr") else None)
+
+
+def nodes():
+    w = _worker()
+    out = []
+    for v in w._run(w.gcs.request("get_nodes", {})):
+        out.append({
+            "NodeID": v["node_id"].hex(),
+            "Alive": v["alive"],
+            "NodeManagerAddress": v["addr"][0],
+            "NodeManagerPort": v["addr"][1],
+            "Resources": v["resources"],
+            "Available": v.get("available", {}),
+            "Labels": v.get("labels", {}),
+        })
+    return out
+
+
+def cluster_resources():
+    w = _worker()
+    return w._run(w.gcs.request("cluster_resources", {}))["total"]
+
+
+def available_resources():
+    w = _worker()
+    return w._run(w.gcs.request("cluster_resources", {}))["available"]
+
+
+def wait_placement_group_ready(pg, timeout: float = 60.0) -> bool:
+    w = _worker()
+    view = w._run(w.gcs.request("wait_placement_group",
+                                {"pg_id": pg.id, "timeout": timeout}))
+    return view is not None and view["state"] == "CREATED"
+
+
+class RuntimeContext:
+    def __init__(self, worker: CoreWorker):
+        self._worker = worker
+
+    @property
+    def job_id(self):
+        return self._worker.job_id
+
+    @property
+    def node_id(self):
+        return self._worker.node_id
+
+    @property
+    def actor_id(self):
+        return self._worker.actor_id
+
+    @property
+    def task_id(self):
+        return self._worker.exec_ctx.task_id
+
+    def get_job_id(self):
+        return self.job_id.hex()
+
+    def get_node_id(self):
+        return self.node_id.hex() if self.node_id else None
+
+    def get_actor_id(self):
+        return self.actor_id.hex() if self.actor_id else None
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_worker())
+
+
+def timeline():
+    return []

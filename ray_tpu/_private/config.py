@@ -1,0 +1,89 @@
+"""Central runtime-tunable table, overridable by environment variables.
+
+TPU-native equivalent of the reference's single macro table of flags
+(reference: src/ray/common/ray_config_def.h:18-22 — RAY_CONFIG(type, name,
+default), env-overridable per process, distributed cluster-wide).  Here the
+table is a plain dataclass-like registry; every entry can be overridden with
+``RT_<NAME>`` in the environment, and ``ray_tpu.init(_system_config=...)``
+overrides are forwarded to spawned processes through the environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_DEFS: dict[str, tuple[type, object]] = {}
+
+
+def _def(name: str, typ: type, default):
+    _DEFS[name] = (typ, default)
+    return default
+
+
+class _Config:
+    # --- timing / liveness ---
+    heartbeat_period_ms = _def("heartbeat_period_ms", int, 1000)
+    heartbeat_timeout_ms = _def("heartbeat_timeout_ms", int, 30000)
+    resource_report_period_ms = _def("resource_report_period_ms", int, 100)
+    worker_register_timeout_s = _def("worker_register_timeout_s", float, 60.0)
+    connect_timeout_s = _def("connect_timeout_s", float, 30.0)
+
+    # --- object store ---
+    object_store_memory_bytes = _def("object_store_memory_bytes", int, 2 * 1024**3)
+    # Below this size objects are inlined in the owner's memory store and on
+    # the wire instead of going through shared memory (reference:
+    # ray_config_def.h max_direct_call_object_size = 100KiB).
+    max_direct_call_object_size = _def("max_direct_call_object_size", int, 100 * 1024)
+    fetch_chunk_bytes = _def("fetch_chunk_bytes", int, 8 * 1024**2)
+
+    # --- scheduling ---
+    max_workers_per_node = _def("max_workers_per_node", int, 64)
+    idle_worker_keep_s = _def("idle_worker_keep_s", float, 300.0)
+    lease_spillback_threshold = _def("lease_spillback_threshold", float, 1.0)
+
+    # --- tasks / actors ---
+    max_task_retries_default = _def("max_task_retries_default", int, 3)
+    actor_max_restarts_default = _def("actor_max_restarts_default", int, 0)
+    task_queue_warn_len = _def("task_queue_warn_len", int, 100000)
+
+    # --- logging ---
+    log_to_driver = _def("log_to_driver", bool, True)
+
+    def __init__(self, overrides: dict | None = None):
+        for name, (typ, default) in _DEFS.items():
+            env = os.environ.get(f"RT_{name.upper()}")
+            if env is not None:
+                if typ is bool:
+                    val = env.lower() in ("1", "true", "yes")
+                elif typ is int:
+                    val = int(env)
+                elif typ is float:
+                    val = float(env)
+                else:
+                    val = env
+                setattr(self, name, val)
+            else:
+                setattr(self, name, default)
+        if overrides:
+            for k, v in overrides.items():
+                if k not in _DEFS:
+                    raise ValueError(f"Unknown system config: {k}")
+                setattr(self, k, v)
+
+    def to_env(self) -> dict[str, str]:
+        """Serialize current values as env vars for child processes."""
+        out = {}
+        for name in _DEFS:
+            v = getattr(self, name)
+            out[f"RT_{name.upper()}"] = json.dumps(v) if not isinstance(v, str) else v
+        return out
+
+
+GLOBAL_CONFIG = _Config()
+
+
+def apply_system_config(overrides: dict):
+    global GLOBAL_CONFIG
+    GLOBAL_CONFIG = _Config(overrides)
+    return GLOBAL_CONFIG

@@ -1,0 +1,653 @@
+"""GCS — Global Control Service: the cluster's control plane.
+
+TPU-native re-design of the reference GCS server (reference:
+src/ray/gcs/gcs_server/gcs_server.h:70 and its managers —
+GcsNodeManager gcs_node_manager.h:36, GcsActorManager gcs_actor_manager.h:213
+with the actor state machine documented at :181-232, GcsPlacementGroupManager
+gcs_placement_group_manager.h:173 with 2-phase Prepare/Commit reservation,
+GcsJobManager, InternalKV gcs_kv_manager.h:31, pubsub hub src/ray/pubsub/).
+
+One asyncio process on the head node holding:
+  * node table + heartbeat liveness + load aggregation
+  * actor table + scheduling + restart state machine
+  * placement groups with 2-phase bundle reservation (PACK/SPREAD/STRICT_*),
+    including an ICI-topology-aware STRICT_PACK for TPU sub-meshes
+  * internal KV (function/class exports, named actors, collective rendezvous)
+  * long-poll-free pubsub: subscribers hold a persistent connection and
+    receive pushes (the reference batches over long-polls; a persistent
+    duplex conn gives the same O(#subscribers) property more simply)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu._private.placement import (choose_nodes_for_bundles,
+                                        PlacementError)
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: gcs_actor_manager.h:181-232).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class NodeInfo:
+    def __init__(self, node_id, addr, resources, labels, conn):
+        self.node_id: NodeID = node_id
+        self.addr: tuple[str, int] = tuple(addr)
+        self.total_resources: dict = dict(resources)
+        self.available_resources: dict = dict(resources)
+        self.labels: dict = dict(labels or {})
+        self.conn: protocol.Connection = conn
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.load = 0  # queued lease count reported by the raylet
+
+    def view(self):
+        return {
+            "node_id": self.node_id,
+            "addr": self.addr,
+            "resources": self.total_resources,
+            "available": self.available_resources,
+            "labels": self.labels,
+            "alive": self.alive,
+            "load": self.load,
+        }
+
+
+class ActorInfo:
+    def __init__(self, actor_id, spec, owner_conn_id, job_id):
+        self.actor_id: ActorID = actor_id
+        self.spec = spec  # dict: class_key, init payload, resources, opts
+        self.state = PENDING_CREATION
+        self.node_id: NodeID | None = None
+        self.addr: tuple[str, int] | None = None
+        self.worker_id = None
+        self.num_restarts = 0
+        self.max_restarts = spec.get("max_restarts", 0)
+        self.name = spec.get("name")
+        self.namespace = spec.get("namespace", "default")
+        self.detached = spec.get("detached", False)
+        self.owner_conn_id = owner_conn_id
+        self.job_id = job_id
+        self.death_cause: str | None = None
+        self.pg_id = spec.get("placement_group_id")
+
+    def view(self):
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "addr": self.addr,
+            "node_id": self.node_id,
+            "name": self.name,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "death_cause": self.death_cause,
+            "class_name": self.spec.get("class_name"),
+            "pid": self.spec.get("pid"),
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id, bundles, strategy, name, job_id):
+        self.pg_id: PlacementGroupID = pg_id
+        self.bundles: list[dict] = bundles
+        self.strategy = strategy
+        self.name = name
+        self.job_id = job_id
+        self.state = "PENDING"
+        self.bundle_nodes: list[NodeID] = []
+
+    def view(self):
+        return {
+            "pg_id": self.pg_id,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "state": self.state,
+            "bundle_nodes": self.bundle_nodes,
+            "name": self.name,
+        }
+
+
+class GcsServer:
+    def __init__(self, host="127.0.0.1"):
+        self.host = host
+        self.server = protocol.RpcServer(self._handle, host=host, name="gcs",
+                                         on_disconnect=self._on_disconnect)
+        self.nodes: dict[NodeID, NodeInfo] = {}
+        self.actors: dict[ActorID, ActorInfo] = {}
+        self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self.placement_groups: dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.kv: dict[str, dict[bytes, bytes]] = {}
+        self.subscribers: dict[str, set[protocol.Connection]] = {}
+        self.jobs: dict = {}
+        self._pending_actor_creations: dict[ActorID, asyncio.Task] = {}
+        self._actor_waiters: dict[ActorID, list[asyncio.Future]] = {}
+        self._node_waiters: list[asyncio.Future] = []
+        self._drivers: dict[int, dict] = {}  # conn-id -> {job_id}
+        self._start_time = time.time()
+
+    async def start(self, port=0):
+        port = await self.server.start(port)
+        asyncio.get_running_loop().create_task(self._liveness_loop())
+        logger.info("GCS listening on %s:%s", self.host, port)
+        return port
+
+    # ------------------------------------------------------------------ rpc
+    async def _handle(self, conn, method, body):
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise protocol.RpcError(f"GCS: no method {method}")
+        return await fn(conn, body)
+
+    async def _on_disconnect(self, conn):
+        # A raylet died, or a driver exited.
+        for node in list(self.nodes.values()):
+            if node.conn is conn and node.alive:
+                await self._mark_node_dead(node, "raylet connection lost")
+        drv = self._drivers.pop(id(conn), None)
+        if drv is not None:
+            await self._cleanup_job(drv["job_id"])
+
+    # ---------------------------------------------------------------- nodes
+    async def rpc_register_node(self, conn, body):
+        node_id = body["node_id"]
+        info = NodeInfo(node_id, body["addr"], body["resources"],
+                        body.get("labels"), conn)
+        self.nodes[node_id] = info
+        await self._publish("nodes", {"event": "added", "node": info.view()})
+        for fut in self._node_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._node_waiters.clear()
+        return {"ok": True, "cluster_nodes": [n.view() for n in self.nodes.values()]}
+
+    async def rpc_heartbeat(self, conn, body):
+        node = self.nodes.get(body["node_id"])
+        if node is None:
+            return {"ok": False, "reason": "unknown node (gcs restarted?)"}
+        node.last_heartbeat = time.monotonic()
+        if "available" in body:
+            node.available_resources = body["available"]
+        if "load" in body:
+            node.load = body["load"]
+        return {"ok": True}
+
+    async def rpc_get_nodes(self, conn, body):
+        return [n.view() for n in self.nodes.values()]
+
+    async def rpc_wait_for_nodes(self, conn, body):
+        count = body["count"]
+        timeout = body.get("timeout", 60.0)
+        deadline = time.monotonic() + timeout
+        while len([n for n in self.nodes.values() if n.alive]) < count:
+            fut = asyncio.get_running_loop().create_future()
+            self._node_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, max(0.01, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                return {"ok": False}
+        return {"ok": True}
+
+    async def rpc_drain_node(self, conn, body):
+        node = self.nodes.get(body["node_id"])
+        if node is None or not node.alive:
+            return {"ok": False}
+        try:
+            await node.conn.request("shutdown", {})
+        except Exception:
+            pass
+        await self._mark_node_dead(node, "drained")
+        return {"ok": True}
+
+    async def _liveness_loop(self):
+        period = cfg.heartbeat_period_ms / 1000.0
+        timeout = cfg.heartbeat_timeout_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > timeout:
+                    await self._mark_node_dead(node, "heartbeat timeout")
+
+    async def _mark_node_dead(self, node: NodeInfo, reason: str):
+        if not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
+        await self._publish("nodes", {"event": "removed",
+                                      "node_id": node.node_id,
+                                      "reason": reason})
+        # Restart or fail actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in (ALIVE,
+                                                                 PENDING_CREATION,
+                                                                 RESTARTING):
+                await self._on_actor_interrupted(actor,
+                                                 f"node died: {reason}")
+        # Invalidate placement groups with bundles there (reschedule).
+        for pg in self.placement_groups.values():
+            if node.node_id in pg.bundle_nodes and pg.state == "CREATED":
+                pg.state = "RESCHEDULING"
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+
+    # ------------------------------------------------------------------- kv
+    async def rpc_kv_put(self, conn, body):
+        ns = self.kv.setdefault(body.get("ns", ""), {})
+        overwrite = body.get("overwrite", True)
+        if not overwrite and body["key"] in ns:
+            return {"ok": False, "exists": True}
+        ns[body["key"]] = body["value"]
+        return {"ok": True}
+
+    async def rpc_kv_get(self, conn, body):
+        ns = self.kv.get(body.get("ns", ""), {})
+        return {"value": ns.get(body["key"])}
+
+    async def rpc_kv_del(self, conn, body):
+        ns = self.kv.get(body.get("ns", ""), {})
+        existed = ns.pop(body["key"], None) is not None
+        return {"ok": existed}
+
+    async def rpc_kv_keys(self, conn, body):
+        ns = self.kv.get(body.get("ns", ""), {})
+        prefix = body.get("prefix", b"")
+        return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+    # --------------------------------------------------------------- pubsub
+    async def rpc_subscribe(self, conn, body):
+        for channel in body["channels"]:
+            self.subscribers.setdefault(channel, set()).add(conn)
+        return {"ok": True}
+
+    async def rpc_publish(self, conn, body):
+        await self._publish(body["channel"], body["message"])
+        return {"ok": True}
+
+    async def _publish(self, channel: str, message):
+        subs = self.subscribers.get(channel)
+        if not subs:
+            return
+        dead = []
+        for conn in subs:
+            if conn.closed:
+                dead.append(conn)
+                continue
+            try:
+                await conn.push("pubsub", {"channel": channel, "message": message})
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            subs.discard(conn)
+
+    # ----------------------------------------------------------------- jobs
+    async def rpc_register_driver(self, conn, body):
+        job_id = body["job_id"]
+        self._drivers[id(conn)] = {"job_id": job_id}
+        self.jobs[job_id] = {"job_id": job_id, "start_time": time.time(),
+                             "driver_pid": body.get("pid"), "state": "RUNNING",
+                             "entrypoint": body.get("entrypoint", "")}
+        return {"ok": True, "nodes": [n.view() for n in self.nodes.values()]}
+
+    async def _cleanup_job(self, job_id):
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+        for actor in list(self.actors.values()):
+            if actor.job_id == job_id and not actor.detached and actor.state != DEAD:
+                await self._kill_actor(actor, "job finished", no_restart=True)
+        for pg in list(self.placement_groups.values()):
+            if pg.job_id == job_id:
+                await self._remove_pg(pg)
+
+    async def rpc_list_jobs(self, conn, body):
+        return list(self.jobs.values())
+
+    # --------------------------------------------------------------- actors
+    async def rpc_create_actor(self, conn, body):
+        """Register + schedule an actor (reference: GcsActorManager::
+        RegisterActor + GcsActorScheduler::Schedule, gcs_actor_scheduler.cc:49)."""
+        actor_id = body["actor_id"]
+        spec = body["spec"]
+        actor = ActorInfo(actor_id, spec, id(conn), body.get("job_id"))
+        if actor.name:
+            key = (actor.namespace, actor.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != DEAD:
+                    return {"ok": False,
+                            "reason": f"actor name '{actor.name}' already taken"}
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = actor
+        task = asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        self._pending_actor_creations[actor_id] = task
+        return {"ok": True}
+
+    async def _schedule_actor(self, actor: ActorInfo):
+        resources = dict(actor.spec.get("resources") or {})
+        strategy = actor.spec.get("scheduling_strategy")
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            node = self._pick_node(resources, strategy, actor.pg_id,
+                                   actor.spec.get("bundle_index"))
+            if node is None:
+                await asyncio.sleep(0.05)
+                continue
+            try:
+                reply = await node.conn.request("lease_worker_for_actor", {
+                    "actor_id": actor.actor_id,
+                    "resources": resources,
+                    "pg_id": actor.pg_id,
+                    "bundle_index": actor.spec.get("bundle_index"),
+                    "spec": actor.spec,
+                }, timeout=max(cfg.worker_register_timeout_s, 60.0))
+            except Exception as e:
+                logger.warning("actor lease on node %s failed: %s",
+                               node.node_id.hex()[:8], e)
+                await asyncio.sleep(0.05)
+                continue
+            if not reply.get("ok"):
+                await asyncio.sleep(0.02)
+                continue
+            actor.node_id = node.node_id
+            actor.addr = tuple(reply["worker_addr"])
+            actor.worker_id = reply.get("worker_id")
+            actor.spec["pid"] = reply.get("pid")
+            actor.state = ALIVE
+            await self._publish("actors", {"event": "alive",
+                                           "actor": actor.view()})
+            self._wake_actor_waiters(actor)
+            return
+        actor.state = DEAD
+        actor.death_cause = "scheduling timed out (infeasible resources?)"
+        await self._publish("actors", {"event": "dead", "actor": actor.view()})
+        self._wake_actor_waiters(actor)
+
+    def _pick_node(self, resources, strategy, pg_id=None, bundle_index=None):
+        """Hybrid pack policy with PG/node-affinity support (reference:
+        hybrid_scheduling_policy.h:48, node_affinity; bundle policies)."""
+        if pg_id is not None:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return None
+            if bundle_index is not None and bundle_index >= 0:
+                nid = pg.bundle_nodes[bundle_index]
+                node = self.nodes.get(nid)
+                return node if node and node.alive else None
+            candidates = [self.nodes[n] for n in pg.bundle_nodes
+                          if n in self.nodes and self.nodes[n].alive]
+        else:
+            candidates = [n for n in self.nodes.values() if n.alive]
+        if strategy and strategy.get("type") == "node_affinity":
+            node = self.nodes.get(strategy["node_id"])
+            if node and node.alive and self._fits(node, resources):
+                return node
+            if not strategy.get("soft", False):
+                return None
+        feasible = [n for n in candidates if self._fits_total(n, resources)]
+        if not feasible:
+            return None
+        avail = [n for n in feasible if self._fits(n, resources)]
+        pool = avail or feasible
+        if strategy and strategy.get("type") == "spread":
+            return min(pool, key=lambda n: n.load)
+        # pack: prefer most-utilized node that still fits (hybrid policy).
+        return max(pool, key=lambda n: n.load if avail else -n.load)
+
+    @staticmethod
+    def _fits(node: NodeInfo, resources: dict) -> bool:
+        return all(node.available_resources.get(k, 0) >= v
+                   for k, v in resources.items())
+
+    @staticmethod
+    def _fits_total(node: NodeInfo, resources: dict) -> bool:
+        return all(node.total_resources.get(k, 0) >= v
+                   for k, v in resources.items())
+
+    async def rpc_get_actor(self, conn, body):
+        actor = self.actors.get(body["actor_id"])
+        if actor is None:
+            return None
+        return actor.view()
+
+    async def rpc_wait_actor_alive(self, conn, body):
+        actor = self.actors.get(body["actor_id"])
+        if actor is None:
+            return None
+        if actor.state in (ALIVE, DEAD):
+            return actor.view()
+        fut = asyncio.get_running_loop().create_future()
+        self._actor_waiters.setdefault(actor.actor_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, body.get("timeout", 120.0))
+        except asyncio.TimeoutError:
+            pass
+        return actor.view()
+
+    def _wake_actor_waiters(self, actor: ActorInfo):
+        for fut in self._actor_waiters.pop(actor.actor_id, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def rpc_get_named_actor(self, conn, body):
+        key = (body.get("namespace", "default"), body["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return None
+        actor = self.actors.get(actor_id)
+        return actor.view() if actor and actor.state != DEAD else None
+
+    async def rpc_list_named_actors(self, conn, body):
+        out = []
+        for (ns, name), aid in self.named_actors.items():
+            a = self.actors.get(aid)
+            if a is not None and a.state != DEAD:
+                out.append({"name": name, "namespace": ns})
+        return out
+
+    async def rpc_report_actor_death(self, conn, body):
+        """A raylet reports that an actor's worker process died."""
+        actor = self.actors.get(body["actor_id"])
+        if actor is None or actor.state == DEAD:
+            return {"ok": True}
+        await self._on_actor_interrupted(actor, body.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _on_actor_interrupted(self, actor: ActorInfo, reason: str):
+        """Actor restart state machine (reference: gcs_actor_manager.h:181-232:
+        ALIVE -> RESTARTING while restarts remain, else -> DEAD)."""
+        if actor.max_restarts != 0 and (
+                actor.max_restarts < 0 or actor.num_restarts < actor.max_restarts):
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            actor.addr = None
+            await self._publish("actors", {"event": "restarting",
+                                           "actor": actor.view()})
+            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        else:
+            actor.state = DEAD
+            actor.death_cause = reason
+            await self._publish("actors", {"event": "dead",
+                                           "actor": actor.view()})
+            self._wake_actor_waiters(actor)
+
+    async def rpc_kill_actor(self, conn, body):
+        actor = self.actors.get(body["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        await self._kill_actor(actor, "ray_tpu.kill",
+                               no_restart=body.get("no_restart", True))
+        return {"ok": True}
+
+    async def _kill_actor(self, actor: ActorInfo, reason, no_restart=True):
+        if no_restart:
+            actor.max_restarts = 0
+        if actor.node_id is not None:
+            node = self.nodes.get(actor.node_id)
+            if node is not None and node.alive:
+                try:
+                    await node.conn.request("kill_worker",
+                                            {"worker_id": actor.worker_id})
+                except Exception:
+                    pass
+        if no_restart:
+            actor.state = DEAD
+            actor.death_cause = str(reason)
+            await self._publish("actors", {"event": "dead", "actor": actor.view()})
+            self._wake_actor_waiters(actor)
+
+    async def rpc_list_actors(self, conn, body):
+        return [a.view() for a in self.actors.values()]
+
+    # ----------------------------------------------------- placement groups
+    async def rpc_create_placement_group(self, conn, body):
+        pg = PlacementGroupInfo(body["pg_id"], body["bundles"],
+                                body.get("strategy", "PACK"),
+                                body.get("name"), body.get("job_id"))
+        self.placement_groups[pg.pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return {"ok": True}
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo):
+        """Two-phase bundle reservation (reference:
+        gcs_placement_group_scheduler.h:264 — Prepare on all nodes, then
+        Commit; bundle policies PACK/SPREAD/STRICT_* in
+        raylet/scheduling/policy/bundle_scheduling_policy.h)."""
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            alive = [n for n in self.nodes.values() if n.alive]
+            try:
+                assignment = choose_nodes_for_bundles(
+                    pg.bundles, pg.strategy, alive)
+            except PlacementError:
+                assignment = None
+            if assignment is None:
+                await asyncio.sleep(0.05)
+                continue
+            # Phase 1: prepare (reserve) on each node.
+            prepared = []
+            ok = True
+            for bundle_index, (node, bundle) in enumerate(
+                    zip(assignment, pg.bundles)):
+                try:
+                    r = await node.conn.request("prepare_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": bundle_index,
+                        "resources": bundle})
+                except Exception:
+                    r = {"ok": False}
+                if r.get("ok"):
+                    prepared.append((node, bundle_index))
+                else:
+                    ok = False
+                    break
+            if not ok:
+                for node, bundle_index in prepared:
+                    try:
+                        await node.conn.request("return_bundle", {
+                            "pg_id": pg.pg_id, "bundle_index": bundle_index})
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.05)
+                continue
+            # Phase 2: commit.
+            for node, bundle_index in prepared:
+                try:
+                    await node.conn.request("commit_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": bundle_index})
+                except Exception:
+                    pass
+            pg.bundle_nodes = [n.node_id for n in assignment]
+            pg.state = "CREATED"
+            await self._publish("placement_groups",
+                                {"event": "created", "pg": pg.view()})
+            return
+        pg.state = "INFEASIBLE"
+        await self._publish("placement_groups",
+                            {"event": "infeasible", "pg": pg.view()})
+
+    async def rpc_get_placement_group(self, conn, body):
+        pg = self.placement_groups.get(body["pg_id"])
+        return pg.view() if pg else None
+
+    async def rpc_wait_placement_group(self, conn, body):
+        deadline = time.monotonic() + body.get("timeout", 60.0)
+        while time.monotonic() < deadline:
+            pg = self.placement_groups.get(body["pg_id"])
+            if pg is None:
+                return None
+            if pg.state in ("CREATED", "INFEASIBLE"):
+                return pg.view()
+            await asyncio.sleep(0.01)
+        return pg.view() if pg else None
+
+    async def rpc_remove_placement_group(self, conn, body):
+        pg = self.placement_groups.get(body["pg_id"])
+        if pg is None:
+            return {"ok": False}
+        await self._remove_pg(pg)
+        return {"ok": True}
+
+    async def _remove_pg(self, pg: PlacementGroupInfo):
+        for bundle_index, node_id in enumerate(pg.bundle_nodes):
+            node = self.nodes.get(node_id)
+            if node is not None and node.alive:
+                try:
+                    await node.conn.request("return_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": bundle_index})
+                except Exception:
+                    pass
+        pg.state = "REMOVED"
+        self.placement_groups.pop(pg.pg_id, None)
+        await self._publish("placement_groups",
+                            {"event": "removed", "pg": pg.view()})
+
+    async def rpc_list_placement_groups(self, conn, body):
+        return [pg.view() for pg in self.placement_groups.values()]
+
+    # ------------------------------------------------------------ stats/etc
+    async def rpc_cluster_resources(self, conn, body):
+        total: dict = {}
+        avail: dict = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.total_resources.items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n.available_resources.items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def rpc_ping(self, conn, body):
+        return {"ok": True, "uptime": time.time() - self._start_time}
+
+
+def main():
+    import argparse
+    import sys
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[gcs] %(levelname)s %(message)s")
+
+    async def run():
+        gcs = GcsServer(host=args.host)
+        port = await gcs.start(args.port)
+        print(f"GCS_PORT={port}", flush=True)
+        sys.stdout.flush()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

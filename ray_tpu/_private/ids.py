@@ -1,0 +1,108 @@
+"""Binary unique identifiers for all runtime entities.
+
+TPU-native re-design of the reference's ID layer (reference:
+src/ray/common/id.h — JobID/TaskID/ObjectID/ActorID/NodeID as fixed-width
+binary ids with embedded structure).  We keep the same entity set but use
+flat 16-byte random ids; object ids embed the owner task id + return index
+so lineage can be recovered from the id alone.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ID_SIZE = 16
+
+
+class BaseID:
+    __slots__ = ("_bin",)
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, bytes) or len(binary) != _ID_SIZE:
+            raise ValueError(f"{type(self).__name__} requires {_ID_SIZE} bytes, got {binary!r}")
+        self._bin = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(_ID_SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * _ID_SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bin == b"\x00" * _ID_SIZE
+
+    def binary(self) -> bytes:
+        return self._bin
+
+    def hex(self) -> str:
+        return self._bin.hex()
+
+    def __hash__(self):
+        return hash(self._bin)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bin == self._bin
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bin.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bin,))
+
+
+class JobID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class FunctionID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    _counter_lock = threading.Lock()
+    _counter = 0
+
+    @classmethod
+    def for_fake_task(cls):
+        return cls.from_random()
+
+
+class ObjectID(BaseID):
+    """Object id = 12 random bytes (task id prefix) + 4-byte return index."""
+
+    @classmethod
+    def for_task_return(cls, task_id: "TaskID", index: int):
+        return cls(task_id.binary()[:12] + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls):
+        return cls.from_random()
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bin[12:], "little")
+
+
+ObjectRefID = ObjectID

@@ -1,0 +1,61 @@
+"""jax platform management helpers.
+
+The execution environment may pre-register an experimental TPU backend in
+every python process (a sitecustomize hook that also forces
+``jax_platforms="axon,cpu"`` via jax.config, overriding the JAX_PLATFORMS
+env var).  Backend initialization then dials the TPU device tunnel — which
+must only ever happen in the one process that owns the chip.  These helpers
+pin a process to the intended platform *before* first jax compute.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FORCED = {"value": None}
+
+
+def ensure_cpu(n_devices: int | None = None) -> None:
+    """Pin this process's jax to the host CPU platform.  Call before any
+    jax compute.  ``n_devices`` forces a virtual multi-device host platform
+    (for testing shardings without real chips)."""
+    if _FORCED["value"] == ("cpu", n_devices):
+        return
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = f"{flags} {want}".strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    _FORCED["value"] = ("cpu", n_devices)
+
+
+def ensure_accelerator() -> bool:
+    """Allow this process to use the real accelerator backend.  Returns True
+    if a non-CPU device is visible."""
+    try:
+        import jax
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            os.environ.pop("JAX_PLATFORMS", None)
+        devs = jax.devices()
+        return any(d.platform != "cpu" for d in devs)
+    except Exception:
+        return False
+
+
+def cpu_mesh_devices(n: int):
+    """Return n virtual CPU devices (forcing the host platform count)."""
+    ensure_cpu(n)
+    import jax
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        raise RuntimeError(
+            f"asked for {n} virtual cpu devices but jax already initialized "
+            f"with {len(devs)}; set XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={n} before the first jax use in this process")
+    return devs[:n]

@@ -1,0 +1,167 @@
+"""Node: spawns and supervises the cluster processes on one machine.
+
+Reference: python/ray/_private/node.py:1084 start_ray_processes /
+:896 start_gcs_server / :928 start_raylet, with command assembly in
+_private/services.py:1381,1440.  Head nodes run the GCS; every node runs a
+raylet (which embeds the shared-memory store).  In-process variants
+(`start_in_process`) run GCS + raylet coroutines inside the driver's event
+loop — that is what the multi-node-in-one-process test Cluster uses
+(reference analogue: python/ray/cluster_utils.py Cluster.add_node spawning
+real raylets locally).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.resources import detect_node_resources
+
+
+def new_session_dir():
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    session = os.path.join(base,
+                           f"session_{time.strftime('%Y%m%d-%H%M%S')}"
+                           f"_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def _read_port(proc, tag, timeout=30.0):
+    pattern = re.compile(rf"{tag}=(\d+)")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(f"{tag} process exited "
+                                   f"with {proc.returncode}")
+            time.sleep(0.01)
+            continue
+        m = pattern.search(line.decode(errors="replace"))
+        if m:
+            return int(m.group(1))
+    raise RuntimeError(f"timed out waiting for {tag}")
+
+
+class NodeProcesses:
+    """Out-of-process GCS + raylet for a real (head) node."""
+
+    def __init__(self, session_dir=None, num_cpus=None, num_tpus=None,
+                 resources=None, object_store_memory=None, head=True,
+                 gcs_addr=None):
+        self.session_dir = session_dir or new_session_dir()
+        self.procs: list[subprocess.Popen] = []
+        self.gcs_addr = gcs_addr
+        self.raylet_addr = None
+        self.head = head
+        self._resources, self._labels = detect_node_resources(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+        self._object_store_memory = (object_store_memory
+                                     or cfg.object_store_memory_bytes)
+
+    def start(self):
+        env = dict(os.environ)
+        env.update(cfg.to_env())
+        if self.head:
+            gcs = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.gcs"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+                start_new_session=True)
+            self.procs.append(gcs)
+            port = _read_port(gcs, "GCS_PORT")
+            self.gcs_addr = ("127.0.0.1", port)
+        import json
+        raylet = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.raylet",
+             "--gcs-host", self.gcs_addr[0],
+             "--gcs-port", str(self.gcs_addr[1]),
+             "--resources", json.dumps(self._resources),
+             "--labels", json.dumps(self._labels),
+             "--session-dir", self.session_dir,
+             "--store-capacity", str(self._object_store_memory)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+        self.procs.append(raylet)
+        rport = _read_port(raylet, "RAYLET_PORT")
+        self.raylet_addr = ("127.0.0.1", rport)
+        atexit.register(self.kill)
+        return self
+
+    def kill(self):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+        self.procs = []
+
+
+class InProcessNode:
+    """GCS and/or raylet running as coroutines inside the current process's
+    background event loop — used by the test Cluster fixture and by
+    ray_tpu.init() for fast single-machine bring-up."""
+
+    def __init__(self, loop, head=True, gcs_addr=None, num_cpus=None,
+                 num_tpus=None, resources=None, labels=None,
+                 object_store_memory=None, session_dir=None, node_name=None):
+        self.loop = loop
+        self.head = head
+        self.gcs_addr = gcs_addr
+        self.session_dir = session_dir or new_session_dir()
+        self.gcs_server = None
+        self.raylet = None
+        self.raylet_addr = None
+        self._resources, self._labels = detect_node_resources(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+        if labels:
+            self._labels.update(labels)
+        self._object_store_memory = (object_store_memory
+                                     or cfg.object_store_memory_bytes)
+        self.node_name = node_name
+
+    def start(self):
+        fut = asyncio.run_coroutine_threadsafe(self._start_async(), self.loop)
+        fut.result(60)
+        return self
+
+    async def _start_async(self):
+        if self.head:
+            from ray_tpu._private.gcs import GcsServer
+            self.gcs_server = GcsServer()
+            port = await self.gcs_server.start(0)
+            self.gcs_addr = ("127.0.0.1", port)
+        from ray_tpu._private.raylet import Raylet
+        self.raylet = Raylet(self.gcs_addr, self._resources,
+                             labels=self._labels,
+                             session_dir=self.session_dir,
+                             store_capacity=self._object_store_memory,
+                             node_name=self.node_name)
+        rport = await self.raylet.start(0)
+        self.raylet_addr = ("127.0.0.1", rport)
+        n_warm = min(2, max(1, int(self._resources.get("CPU", 1))))
+        self.raylet.prestart_workers(n_warm)
+
+    @property
+    def node_id(self):
+        return self.raylet.node_id if self.raylet else None
+
+    def kill(self, stop_gcs=True):
+        async def _kill():
+            if self.raylet is not None:
+                await self.raylet.shutdown()
+            if stop_gcs and self.gcs_server is not None:
+                await self.gcs_server.server.stop()
+        try:
+            asyncio.run_coroutine_threadsafe(_kill(), self.loop).result(10)
+        except Exception:
+            pass

@@ -1,0 +1,86 @@
+"""ObjectRef: a first-class future/handle to an object in the cluster.
+
+Mirrors the reference's ObjectRef semantics (reference:
+python/ray/includes/object_ref.pxi; ownership described in
+src/ray/core_worker/reference_count.h:61): every object has an *owner* (the
+process that created it); the ref carries the object id plus the owner's
+address so any holder can locate and fetch the value.
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "_track", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_addr: tuple[str, int] | None = None,
+                 _track: bool = False):
+        self.id = object_id
+        self.owner_addr = owner_addr
+        # Only the instance handed to the user at creation time carries a
+        # local-refcount stake; pickled/copied views don't double count.
+        self._track = _track
+
+    def __del__(self):
+        if getattr(self, "_track", False):
+            try:
+                from ray_tpu._private import worker as _w
+                if _w.global_worker is not None:
+                    _w.global_worker.remove_local_ref(self)
+            except Exception:
+                pass
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Serialization context (if any) tracks nested refs for borrowing.
+        ctx = _SER_CTX.get()
+        if ctx is not None:
+            ctx.append(self)
+        return (ObjectRef, (self.id, self.owner_addr))
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        from ray_tpu._private import worker as _w
+        return _w.global_worker.get_async(self).__await__()
+
+    def future(self):
+        from ray_tpu._private import worker as _w
+        return _w.global_worker.get_future(self)
+
+
+import contextvars
+
+_SER_CTX: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "ray_tpu_ser_ctx", default=None)
+
+
+class track_nested_refs:
+    """Context manager collecting ObjectRefs pickled within its scope."""
+
+    def __init__(self):
+        self.refs: list[ObjectRef] = []
+        self._token = None
+
+    def __enter__(self):
+        self._token = _SER_CTX.set(self.refs)
+        return self.refs
+
+    def __exit__(self, *exc):
+        _SER_CTX.reset(self._token)
+        return False

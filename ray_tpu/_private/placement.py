@@ -1,0 +1,136 @@
+"""Bundle placement policies, including TPU ICI-topology-aware packing.
+
+Reference: src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h
+implements PACK / SPREAD / STRICT_PACK / STRICT_SPREAD over GPU-era nodes.
+The TPU-era addition here: nodes carry ICI mesh coordinates as labels
+("tpu_coords": (x, y, z), "tpu_slice": name), and STRICT_SPREAD /
+SPREAD placements for TPU bundles prefer *contiguous sub-meshes* so the
+collective traffic of a gang-scheduled SPMD job rides ICI instead of DCN.
+This is a capability the reference never needed (NCCL rings are
+topology-agnostic at scheduling time); on TPU, adjacency is the whole game.
+"""
+
+from __future__ import annotations
+
+
+class PlacementError(Exception):
+    pass
+
+
+def _fits(avail: dict, req: dict) -> bool:
+    return all(avail.get(k, 0) >= v for k, v in req.items())
+
+
+def _sub(avail: dict, req: dict):
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0) - v
+
+
+def _is_tpu_bundle(bundle: dict) -> bool:
+    return any(k == "TPU" or k.startswith("TPU-") for k in bundle)
+
+
+def _sort_by_ici(nodes):
+    """Order nodes so that consecutive picks are ICI neighbours: group by
+    slice, then lexicographic mesh coordinates within a slice."""
+    def key(n):
+        labels = n.labels or {}
+        return (labels.get("tpu_slice", "~"),
+                tuple(labels.get("tpu_coords", ())) or (1 << 30,))
+    return sorted(nodes, key=key)
+
+
+def choose_nodes_for_bundles(bundles, strategy, nodes):
+    """Pick one node per bundle. Returns list[NodeInfo] aligned to bundles,
+    or None if currently infeasible. Raises PlacementError if *never*
+    feasible with the given alive nodes."""
+    if not nodes:
+        return None
+    for b in bundles:
+        if not any(_fits(n.total_resources, b) for n in nodes):
+            raise PlacementError(f"bundle {b} fits no node")
+
+    tpu_gang = any(_is_tpu_bundle(b) for b in bundles)
+
+    if strategy == "STRICT_PACK":
+        # Every bundle on ONE node.
+        combined: dict = {}
+        for b in bundles:
+            for k, v in b.items():
+                combined[k] = combined.get(k, 0) + v
+        for n in sorted(nodes, key=lambda n: -n.load):
+            if _fits(n.available_resources, combined):
+                return [n] * len(bundles)
+        if not any(_fits(n.total_resources, combined) for n in nodes):
+            raise PlacementError("STRICT_PACK bundles fit no single node")
+        return None
+
+    if strategy == "STRICT_SPREAD":
+        # Distinct node per bundle; for TPU gangs pick a contiguous sub-mesh.
+        ordered = _sort_by_ici(nodes) if tpu_gang else sorted(
+            nodes, key=lambda n: n.load)
+        if tpu_gang:
+            # Slide a window over the ICI ordering to find a contiguous run
+            # of len(bundles) nodes that each fit their bundle.
+            k = len(bundles)
+            for start in range(len(ordered) - k + 1):
+                window = ordered[start:start + k]
+                scratch = [dict(n.available_resources) for n in window]
+                ok = True
+                for b, av in zip(bundles, scratch):
+                    if not _fits(av, b):
+                        ok = False
+                        break
+                    _sub(av, b)
+                if ok:
+                    return window
+            return None
+        assignment = []
+        used = set()
+        for b in bundles:
+            pick = None
+            for n in ordered:
+                if id(n) in used:
+                    continue
+                if _fits(n.available_resources, b):
+                    pick = n
+                    break
+            if pick is None:
+                if len(nodes) < len(bundles):
+                    raise PlacementError(
+                        f"STRICT_SPREAD needs {len(bundles)} nodes, "
+                        f"cluster has {len(nodes)}")
+                return None
+            used.add(id(pick))
+            assignment.append(pick)
+        return assignment
+
+    # PACK / SPREAD: best-effort. Simulate availability while assigning.
+    scratch = {id(n): dict(n.available_resources) for n in nodes}
+    if strategy == "SPREAD":
+        ordered = _sort_by_ici(nodes) if tpu_gang else sorted(
+            nodes, key=lambda n: n.load)
+    else:  # PACK: most-loaded first so bundles co-locate
+        ordered = sorted(nodes, key=lambda n: -n.load)
+    assignment = []
+    spread_i = 0
+    for b in bundles:
+        pick = None
+        if strategy == "SPREAD":
+            # round-robin over the ordering
+            for j in range(len(ordered)):
+                n = ordered[(spread_i + j) % len(ordered)]
+                if _fits(scratch[id(n)], b):
+                    pick = n
+                    spread_i = (spread_i + j + 1) % len(ordered)
+                    break
+        else:
+            for n in ordered:
+                if _fits(scratch[id(n)], b):
+                    pick = n
+                    break
+        if pick is None:
+            return None
+        _sub(scratch[id(pick)], b)
+        assignment.append(pick)
+    return assignment

@@ -1,0 +1,264 @@
+"""Asyncio RPC plane used by every runtime process.
+
+The reference's control plane is gRPC with typed async client/server wrappers
+(reference: src/ray/rpc/grpc_server.h, client_call.h, 21 .proto services under
+src/ray/protobuf/).  The TPU-native build replaces that with a single lean
+length-prefixed pickle protocol over TCP — one connection class serves the
+GCS, raylet, and worker-to-worker planes.  Rationale: the control plane rides
+DCN either way; what matters on TPU is that the per-message Python overhead is
+tiny (the reference pays gRPC+protobuf serialization per task push; we pay one
+pickle).  Messages:
+
+  REQ(id, method, body) -> REP(id, result) | ERR(id, exception)
+  PUSH(method, body)                       (one-way notification)
+
+All payloads are pickled with protocol 5; large buffers never travel this
+plane (they go through the shared-memory object store, see shm_store.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+import pickle
+import struct
+import traceback
+
+logger = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<IBQ")  # payload_len, kind, msg_id
+KIND_REQ = 0
+KIND_REP = 1
+KIND_ERR = 2
+KIND_PUSH = 3
+
+_PICKLE_PROTO = 5
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteError(RpcError):
+    """Raised on the caller when the handler raised; carries remote traceback."""
+
+    def __init__(self, cause_repr: str, tb: str = ""):
+        super().__init__(f"{cause_repr}\nRemote traceback:\n{tb}")
+        self.cause_repr = cause_repr
+        self.remote_traceback = tb
+
+    def __reduce__(self):
+        return (RemoteError, (self.cause_repr, self.remote_traceback))
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=_PICKLE_PROTO)
+
+
+def loads(data):
+    return pickle.loads(data)
+
+
+class Connection:
+    """One bidirectional RPC connection.
+
+    Both sides can issue requests and serve them; ``handler(method, body)``
+    is an async callable returning the reply value.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handler=None, name: str = "?", on_close=None):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self.on_close = on_close
+        self._next_id = 1
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._write_lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, host: str, port: int, handler=None, name: str = "?",
+                      on_close=None, timeout: float = 30.0):
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout)
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _s
+            sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+        return cls(reader, writer, handler=handler, name=name, on_close=on_close)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(_HDR.size)
+                plen, kind, msg_id = _HDR.unpack(hdr)
+                payload = await self.reader.readexactly(plen) if plen else b""
+                if kind == KIND_REQ:
+                    asyncio.get_running_loop().create_task(
+                        self._serve(msg_id, payload))
+                elif kind == KIND_REP:
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(loads(payload))
+                elif kind == KIND_ERR:
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        cause_repr, tb = loads(payload)
+                        fut.set_exception(RemoteError(cause_repr, tb))
+                elif kind == KIND_PUSH:
+                    asyncio.get_running_loop().create_task(
+                        self._serve(0, payload, push=True))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        except asyncio.CancelledError:
+            return
+        except Exception:
+            logger.exception("rpc read loop error on %s", self.name)
+        finally:
+            await self._do_close()
+
+    async def _serve(self, msg_id: int, payload: bytes, push: bool = False):
+        try:
+            method, body = loads(payload)
+        except Exception:
+            logger.exception("bad rpc payload on %s", self.name)
+            return
+        try:
+            if self.handler is None:
+                raise RpcError(f"connection {self.name} has no handler")
+            result = await self.handler(self, method, body)
+            if not push:
+                await self._send(KIND_REP, msg_id, dumps(result))
+        except Exception as e:
+            if push:
+                logger.exception("push handler %s failed on %s", method, self.name)
+            else:
+                try:
+                    await self._send(KIND_ERR, msg_id,
+                                     dumps((repr(e), traceback.format_exc())))
+                except Exception:
+                    pass
+
+    async def _send(self, kind: int, msg_id: int, payload: bytes):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        async with self._write_lock:
+            self.writer.write(_HDR.pack(len(payload), kind, msg_id))
+            self.writer.write(payload)
+            try:
+                await self.writer.drain()
+            except (ConnectionResetError, OSError) as e:
+                raise ConnectionLost(str(e)) from e
+
+    async def request_send(self, method: str, body=None):
+        """Send a request and return the reply future WITHOUT awaiting it.
+        Used where wire-order must be controlled by the caller (e.g. actor
+        task sequence numbers) while replies are awaited concurrently."""
+        msg_id = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        await self._send(KIND_REQ, msg_id, dumps((method, body)))
+        return fut
+
+    async def request(self, method: str, body=None, timeout: float | None = None):
+        msg_id = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        await self._send(KIND_REQ, msg_id, dumps((method, body)))
+        if timeout is not None:
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            finally:
+                self._pending.pop(msg_id, None)
+        return await fut
+
+    async def push(self, method: str, body=None):
+        await self._send(KIND_PUSH, 0, dumps((method, body)))
+
+    async def _do_close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            try:
+                res = self.on_close(self)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                logger.exception("on_close for %s failed", self.name)
+
+    async def close(self):
+        self._reader_task.cancel()
+        await self._do_close()
+
+
+class RpcServer:
+    """Listens for connections; each served by ``handler(conn, method, body)``."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", name: str = "server",
+                 on_connect=None, on_disconnect=None):
+        self.handler = handler
+        self.host = host
+        self.name = name
+        self.on_connect = on_connect
+        self.on_disconnect = on_disconnect
+        self.port: int | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self.connections: set[Connection] = set()
+
+    async def start(self, port: int = 0):
+        self._server = await asyncio.start_server(self._on_client, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _on_client(self, reader, writer):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _s
+            sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+        conn = Connection(reader, writer, handler=self.handler,
+                          name=f"{self.name}-peer", on_close=self._on_conn_close)
+        self.connections.add(conn)
+        if self.on_connect is not None:
+            res = self.on_connect(conn)
+            if asyncio.iscoroutine(res):
+                await res
+
+    async def _on_conn_close(self, conn):
+        self.connections.discard(conn)
+        if self.on_disconnect is not None:
+            res = self.on_disconnect(conn)
+            if asyncio.iscoroutine(res):
+                await res
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self.connections):
+            await conn.close()

@@ -1,0 +1,905 @@
+"""Raylet: the per-node daemon — scheduler, worker pool, object-store authority.
+
+TPU-native re-design of the reference raylet (reference:
+src/ray/raylet/node_manager.h:143 — HandleRequestWorkerLease
+node_manager.cc:1822, HandleReturnWorker :1965; WorkerPool worker_pool.h:153
+PopWorker :337; LocalTaskManager local_task_manager.h:58;
+PlacementGroupResourceManager placement_group_resource_manager.h; the plasma
+store runs in-process, object_manager/plasma/store_runner.cc).
+
+Responsibilities:
+  * grants worker *leases* to core workers (lease = a worker process +
+    reserved resources; the submitter then pushes tasks directly to the
+    worker, amortizing scheduling — same protocol shape as the reference)
+  * worker pool: spawn/reuse/kill python worker processes
+  * local resource accounting incl. placement-group bundle accounts with
+    2-phase prepare/commit (reference: node_manager.proto:365-372)
+  * shared-memory object store authority (metadata RPC; data plane is the
+    clients' own mmap — see shm_store.py) + inter-node object pulls
+    (reference: object_manager/pull_manager.h:47 chunked pulls)
+  * blocked-worker CPU release so nested ray.get can't deadlock the pool
+    (reference: worker blocked/unblocked resource release in node_manager)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.shm_store import StoreServer, StoreMapping, default_store_path
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, worker_id, proc, conn=None, kind="cpu"):
+        self.kind = kind
+        self.worker_id: WorkerID = worker_id
+        self.proc: subprocess.Popen | None = proc
+        self.conn: protocol.Connection | None = conn
+        self.addr: tuple[str, int] | None = None
+        self.pid: int | None = proc.pid if proc else None
+        self.lease_id = None
+        self.actor_id = None
+        self.registered = asyncio.Event()
+        self.last_idle = time.monotonic()
+
+
+class Lease:
+    def __init__(self, lease_id, worker, resources, pg_key):
+        self.lease_id = lease_id
+        self.worker: WorkerHandle = worker
+        self.resources: dict = resources
+        self.pg_key = pg_key  # (pg_id, bundle_index) or None
+        self.blocked = False
+
+
+class Raylet:
+    def __init__(self, gcs_addr, resources, labels=None, host="127.0.0.1",
+                 session_dir="/tmp/ray_tpu", store_capacity=None,
+                 node_name=None):
+        self.node_id = NodeID.from_random()
+        self.gcs_addr = gcs_addr
+        self.host = host
+        self.session_dir = session_dir
+        self.node_name = node_name
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        self.server = protocol.RpcServer(self._handle, host=host, name="raylet",
+                                         on_disconnect=self._on_conn_lost)
+        self.gcs: protocol.Connection | None = None
+        self.port = None
+        store_capacity = store_capacity or cfg.object_store_memory_bytes
+        self.store_path = default_store_path(session_dir, self.node_id.hex())
+        self.store = StoreServer(self.store_path, store_capacity)
+        self.store_capacity = store_capacity
+        self.mapping = StoreMapping(self.store_path, store_capacity)
+        # workers
+        self.workers: dict[WorkerID, WorkerHandle] = {}
+        self.idle_workers: dict[str, list[WorkerHandle]] = {"cpu": [], "tpu": []}
+        self._spawn_sem = None  # created lazily on the loop
+        self.leases: dict[bytes, Lease] = {}
+        self.pending_leases: list[dict] = []  # queued lease requests
+        self._lease_waiters: list = []
+        # placement group bundle accounts: (pg_id, idx) -> {"reserved", "avail"}
+        self.bundles: dict[tuple, dict] = {}
+        # object store waiters: oid -> [futures] waiting for seal
+        self.seal_waiters: dict[bytes, list[asyncio.Future]] = {}
+        # cached cluster node table (from GCS pubsub)
+        self.cluster_nodes: dict[NodeID, dict] = {}
+        self.peer_conns: dict[NodeID, protocol.Connection] = {}
+        self._next_lease = 0
+        self._shutdown = False
+        self._subproc_env = None
+        # per-instance pull dedup (a class attribute would be shared across
+        # the in-process multi-raylet test Cluster)
+        self._pulls_inflight: dict = {}
+        # pins held on behalf of each client conn: id(conn) -> {oid: count}
+        self._client_pins: dict[int, dict[bytes, int]] = {}
+
+    # -------------------------------------------------------------- startup
+    async def start(self, port=0):
+        self.port = await self.server.start(port)
+        self.gcs = await protocol.Connection.connect(
+            self.gcs_addr[0], self.gcs_addr[1], handler=self._handle_gcs_push,
+            name="raylet->gcs", timeout=cfg.connect_timeout_s)
+        reply = await self.gcs.request("register_node", {
+            "node_id": self.node_id,
+            "addr": (self.host, self.port),
+            "resources": self.total_resources,
+            "labels": self.labels,
+        })
+        for view in reply.get("cluster_nodes", []):
+            self.cluster_nodes[view["node_id"]] = view
+        await self.gcs.request("subscribe", {"channels": ["nodes"]})
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._heartbeat_loop())
+        loop.create_task(self._reap_loop())
+        logger.info("raylet %s on %s:%s resources=%s", self.node_id.hex()[:8],
+                    self.host, self.port, self.total_resources)
+        return self.port
+
+    def _worker_env(self):
+        if self._subproc_env is None:
+            env = dict(os.environ)
+            env.update(cfg.to_env())
+            env.update({
+                "RT_RAYLET_HOST": self.host,
+                "RT_RAYLET_PORT": str(self.port),
+                "RT_GCS_HOST": self.gcs_addr[0],
+                "RT_GCS_PORT": str(self.gcs_addr[1]),
+                "RT_NODE_ID": self.node_id.hex(),
+                "RT_STORE_PATH": self.store_path,
+                "RT_STORE_CAP": str(self.store_capacity),
+                "RT_SESSION_DIR": self.session_dir,
+                # Workers must not grab the TPU chip by default; tasks that
+                # need it are leased TPU resources and may init jax then.
+                "JAX_PLATFORMS": os.environ.get("RT_WORKER_JAX_PLATFORMS", "cpu"),
+            })
+            self._subproc_env = env
+        return self._subproc_env
+
+    # ------------------------------------------------------------ rpc entry
+    async def _handle(self, conn, method, body):
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise protocol.RpcError(f"raylet: no method {method}")
+        return await fn(conn, body)
+
+    async def _handle_gcs_push(self, conn, method, body):
+        """The GCS talks back over the raylet's own registration connection
+        (duplex): pubsub pushes AND control RPCs (actor leases, bundle
+        prepare/commit) arrive here."""
+        if method == "pubsub":
+            if body["channel"] == "nodes":
+                msg = body["message"]
+                if msg["event"] == "added":
+                    view = msg["node"]
+                    self.cluster_nodes[view["node_id"]] = view
+                elif msg["event"] == "removed":
+                    self.cluster_nodes.pop(msg["node_id"], None)
+                    conn2 = self.peer_conns.pop(msg["node_id"], None)
+                    if conn2 is not None:
+                        await conn2.close()
+            return None
+        return await self._handle(conn, method, body)
+
+    async def _on_conn_lost(self, conn):
+        self._release_client_pins(conn)
+        for w in list(self.workers.values()):
+            if w.conn is conn:
+                await self._on_worker_dead(w, "worker connection lost")
+
+    # ------------------------------------------------------- worker lifecycle
+    def prestart_workers(self, n: int, kind: str = "cpu"):
+        """Spawn warm workers ahead of demand (reference: WorkerPool
+        PrestartWorkers — python startup is expensive, ~2s with jax in the
+        interpreter, so cold-start per lease would dominate small tasks)."""
+        for _ in range(n):
+            w = self._spawn_worker(kind)
+            asyncio.get_running_loop().create_task(self._await_prestart(w))
+
+    async def _await_prestart(self, w: WorkerHandle):
+        try:
+            await asyncio.wait_for(w.registered.wait(),
+                                   cfg.worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            await self._on_worker_dead(w, "prestarted worker never registered")
+            return
+        if w.lease_id is None and w not in self.idle_workers[w.kind]:
+            w.last_idle = time.monotonic()
+            self.idle_workers[w.kind].append(w)
+            self._kick_scheduler()
+
+    def _spawn_worker(self, kind: str = "cpu") -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(self._worker_env())
+        env["RT_WORKER_ID"] = worker_id.hex()
+        if kind == "tpu":
+            # TPU workers get the real backend (axon/tpu); cpu workers are
+            # pinned to the host platform so they never grab the chip.
+            env.pop("JAX_PLATFORMS", None)
+            if "RT_WORKER_JAX_PLATFORMS_TPU" in os.environ:
+                env["JAX_PLATFORMS"] = os.environ["RT_WORKER_JAX_PLATFORMS_TPU"]
+        logfile = os.path.join(self.session_dir, "logs",
+                               f"worker-{worker_id.hex()[:8]}.log")
+        os.makedirs(os.path.dirname(logfile), exist_ok=True)
+        out = open(logfile, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        out.close()
+        w = WorkerHandle(worker_id, proc, kind=kind)
+        self.workers[worker_id] = w
+        return w
+
+    async def rpc_register_worker(self, conn, body):
+        worker_id = WorkerID.from_hex(body["worker_id"])
+        w = self.workers.get(worker_id)
+        if w is None:  # e.g. driver-managed process; adopt it
+            w = WorkerHandle(worker_id, None)
+            self.workers[worker_id] = w
+        w.conn = conn
+        w.addr = tuple(body["addr"])
+        w.pid = body["pid"]
+        w.registered.set()
+        self._kick_scheduler()
+        return {"ok": True, "node_id": self.node_id,
+                "store_path": self.store_path,
+                "store_capacity": self.store_capacity}
+
+    async def _get_ready_worker(self, kind: str = "cpu") -> WorkerHandle | None:
+        idle = self.idle_workers[kind]
+        while idle:
+            w = idle.pop()
+            if w.conn is not None and not w.conn.closed:
+                return w
+        if len(self.workers) >= cfg.max_workers_per_node:
+            return None
+        if self._spawn_sem is None:
+            # Bound concurrent cold starts: on a small host an unbounded
+            # spawn storm (each ~2s of CPU) starves the running tasks.
+            self._spawn_sem = asyncio.Semaphore(
+                max(2, int(self.total_resources.get("CPU", 2))))
+        async with self._spawn_sem:
+            idle = self.idle_workers[kind]
+            if idle:
+                w = idle.pop()
+                if w.conn is not None and not w.conn.closed:
+                    return w
+            w = self._spawn_worker(kind)
+            try:
+                await asyncio.wait_for(w.registered.wait(),
+                                       cfg.worker_register_timeout_s)
+            except asyncio.TimeoutError:
+                await self._on_worker_dead(w, "worker failed to register")
+                return None
+            return w
+
+    async def _on_worker_dead(self, w: WorkerHandle, reason: str):
+        self.workers.pop(w.worker_id, None)
+        if w in self.idle_workers[w.kind]:
+            self.idle_workers[w.kind].remove(w)
+        if w.lease_id is not None:
+            lease = self.leases.pop(w.lease_id, None)
+            if lease is not None:
+                self._release_resources(lease)
+        if w.actor_id is not None and self.gcs is not None:
+            try:
+                await self.gcs.request("report_actor_death", {
+                    "actor_id": w.actor_id, "reason": reason})
+            except Exception:
+                pass
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+        self._kick_scheduler()
+
+    async def rpc_kill_worker(self, conn, body):
+        w = self.workers.get(body["worker_id"])
+        if w is None:
+            return {"ok": False}
+        w.actor_id = None  # killed deliberately; no death report
+        if w.proc is not None:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+        await self._on_worker_dead(w, "killed")
+        return {"ok": True}
+
+    async def _reap_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(0.2)
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None:
+                    await self._on_worker_dead(
+                        w, f"worker exited with code {w.proc.returncode}")
+            # trim long-idle workers
+            now = time.monotonic()
+            for kind, idle in self.idle_workers.items():
+                keep = []
+                for w in idle:
+                    if now - w.last_idle > cfg.idle_worker_keep_s:
+                        if w.proc is not None:
+                            try:
+                                w.proc.terminate()
+                            except Exception:
+                                pass
+                    else:
+                        keep.append(w)
+                self.idle_workers[kind] = keep
+
+    # ------------------------------------------------------------ resources
+    def _fits(self, resources: dict, pg_key=None) -> bool:
+        pool = self.bundles[pg_key]["avail"] if pg_key else self.available
+        return all(pool.get(k, 0) >= v - 1e-9 for k, v in resources.items())
+
+    def _fits_total(self, resources: dict) -> bool:
+        return all(self.total_resources.get(k, 0) >= v - 1e-9
+                   for k, v in resources.items())
+
+    def _acquire(self, resources: dict, pg_key=None):
+        pool = self.bundles[pg_key]["avail"] if pg_key else self.available
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0) - v
+
+    def _release(self, resources: dict, pg_key=None):
+        pool = self.available if pg_key is None else None
+        if pg_key is not None:
+            bundle = self.bundles.get(pg_key)
+            if bundle is None:
+                return
+            pool = bundle["avail"]
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0) + v
+
+    def _release_resources(self, lease: Lease):
+        if not lease.blocked:
+            self._release(lease.resources, lease.pg_key)
+        else:
+            non_cpu = {k: v for k, v in lease.resources.items() if k != "CPU"}
+            self._release(non_cpu, lease.pg_key)
+
+    # --------------------------------------------------------------- leases
+    async def rpc_request_worker_lease(self, conn, body):
+        """Lease protocol (reference: NodeManager::HandleRequestWorkerLease
+        node_manager.cc:1822 — grant locally, queue, or reply with a
+        spillback node for the submitter to retry on)."""
+        resources = body.get("resources") or {}
+        pg_id = body.get("pg_id")
+        bundle_index = body.get("bundle_index")
+        pg_key = None
+        if pg_id is not None:
+            pg_key = self._bundle_key_for(pg_id, bundle_index, resources)
+            if pg_key is None:
+                return {"error": f"placement group {pg_id} bundle "
+                                 f"{bundle_index} not on this node"}
+        elif not self._fits_total(resources):
+            # Infeasible here — spill to a node where it can ever fit.
+            target = self._pick_spillback(resources)
+            if target is not None:
+                return {"spillback": target}
+            return {"error": f"resources {resources} infeasible cluster-wide"}
+        elif (body.get("strategy") or {}).get("type") == "spread":
+            target = self._pick_spread_target(resources)
+            if target is not None:
+                return {"spillback": target}
+        fut = asyncio.get_running_loop().create_future()
+        self.pending_leases.append({"resources": resources, "pg_key": pg_key,
+                                    "future": fut,
+                                    "request_id": body.get("request_id")})
+        self._kick_scheduler()
+        granted = await fut
+        return granted
+
+    async def rpc_cancel_lease_requests(self, conn, body):
+        """Cancel queued (not yet granted) lease requests (reference:
+        node_manager.proto CancelWorkerLease — submitters cancel speculative
+        leases when their task queue drains)."""
+        ids = set(body["request_ids"])
+        cancelled = 0
+        for req in list(self.pending_leases):
+            if req.get("request_id") in ids and not req["future"].done():
+                req["future"].set_result({"cancelled": True})
+                self.pending_leases.remove(req)
+                cancelled += 1
+        return {"cancelled": cancelled}
+
+    def _bundle_key_for(self, pg_id, bundle_index, resources):
+        if bundle_index is not None and bundle_index >= 0:
+            key = (pg_id, bundle_index)
+            return key if key in self.bundles else None
+        for key, acct in self.bundles.items():
+            if key[0] == pg_id and all(acct["avail"].get(k, 0) >= v
+                                       for k, v in resources.items()):
+                return key
+        for key in self.bundles:
+            if key[0] == pg_id:
+                return key
+        return None
+
+    def _pick_spillback(self, resources):
+        for view in self.cluster_nodes.values():
+            if view["node_id"] == self.node_id:
+                continue
+            total = view.get("resources", {})
+            if all(total.get(k, 0) >= v for k, v in resources.items()):
+                return tuple(view["addr"])
+        return None
+
+    def _pick_spread_target(self, resources):
+        """SPREAD strategy: redirect to the least-loaded feasible node
+        (reference: scheduling/policy/spread_scheduling_policy)."""
+        best = None
+        best_load = self._load()
+        for view in self.cluster_nodes.values():
+            if view["node_id"] == self.node_id:
+                continue
+            avail = view.get("available", {})
+            if not all(avail.get(k, 0) >= v for k, v in resources.items()):
+                continue
+            load = view.get("load", 0)
+            if load < best_load:
+                best, best_load = tuple(view["addr"]), load
+        return best
+
+    def _load(self):
+        return len(self.pending_leases)
+
+    def _kick_scheduler(self):
+        self._kick_pending = True
+        asyncio.get_running_loop().call_soon(
+            lambda: asyncio.get_running_loop().create_task(
+                self._schedule_leases()))
+
+    _scheduling = False
+    _kick_pending = False
+
+    async def _schedule_leases(self):
+        """Grant pending lease requests from the idle pool; never block on a
+        worker cold-start (spawns run as background tasks and re-kick)."""
+        if self._scheduling:
+            self._kick_pending = True
+            return
+        self._scheduling = True
+        try:
+            need_spawn = {"cpu": 0, "tpu": 0}
+            for req in list(self.pending_leases):
+                if req["future"].done():
+                    self.pending_leases.remove(req)
+                    continue
+                if not self._fits(req["resources"], req["pg_key"]):
+                    continue
+                kind = "tpu" if req["resources"].get("TPU") else "cpu"
+                w = None
+                idle = self.idle_workers[kind]
+                while idle:
+                    cand = idle.pop()
+                    if cand.conn is not None and not cand.conn.closed:
+                        w = cand
+                        break
+                if w is None:
+                    need_spawn[kind] += 1
+                    continue
+                self._acquire(req["resources"], req["pg_key"])
+                self.pending_leases.remove(req)
+                lease_id = os.urandom(8)
+                lease = Lease(lease_id, w, req["resources"], req["pg_key"])
+                self.leases[lease_id] = lease
+                w.lease_id = lease_id
+                req["future"].set_result({
+                    "lease_id": lease_id,
+                    "worker_addr": w.addr,
+                    "worker_id": w.worker_id,
+                    "node_id": self.node_id,
+                })
+            for kind, n in need_spawn.items():
+                self._ensure_spawning(kind, n)
+        finally:
+            self._scheduling = False
+            if self._kick_pending and self.pending_leases:
+                self._kick_pending = False
+                asyncio.get_running_loop().create_task(
+                    self._schedule_leases())
+
+    _spawns_outstanding = 0
+
+    def _ensure_spawning(self, kind: str, demand: int):
+        """Keep at most `demand` additional cold starts in flight, bounded by
+        the node CPU count and the pool cap (reference: WorkerPool
+        maximum_startup_concurrency)."""
+        cap = max(2, int(self.total_resources.get("CPU", 2)))
+        can_spawn = min(
+            demand - self._spawns_outstanding,
+            cap - self._spawns_outstanding,
+            cfg.max_workers_per_node - len(self.workers),
+        )
+        for _ in range(max(0, can_spawn)):
+            self._spawns_outstanding += 1
+            w = self._spawn_worker(kind)
+            asyncio.get_running_loop().create_task(self._finish_spawn(w))
+
+    async def _finish_spawn(self, w: WorkerHandle):
+        try:
+            await asyncio.wait_for(w.registered.wait(),
+                                   cfg.worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            await self._on_worker_dead(w, "worker failed to register")
+            return
+        finally:
+            self._spawns_outstanding -= 1
+        if w.lease_id is None and w not in self.idle_workers[w.kind]:
+            w.last_idle = time.monotonic()
+            self.idle_workers[w.kind].append(w)
+        self._kick_scheduler()
+
+    async def rpc_return_worker(self, conn, body):
+        lease = self.leases.pop(body["lease_id"], None)
+        if lease is None:
+            return {"ok": False}
+        self._release_resources(lease)
+        w = lease.worker
+        w.lease_id = None
+        if body.get("kill"):
+            await self._on_worker_dead(w, "lease returned with kill")
+        elif w.conn is not None and not w.conn.closed:
+            w.last_idle = time.monotonic()
+            self.idle_workers[w.kind].append(w)
+        self._kick_scheduler()
+        return {"ok": True}
+
+    async def rpc_worker_blocked(self, conn, body):
+        """Worker is blocked in get(); temporarily release its CPUs so the
+        pool can make progress (reference: node_manager blocked-worker
+        resource release — prevents nested-get deadlock)."""
+        lease = self.leases.get(body["lease_id"])
+        if lease is None or lease.blocked:
+            return {"ok": False}
+        lease.blocked = True
+        cpus = {k: v for k, v in lease.resources.items() if k == "CPU"}
+        if cpus:
+            self._release(cpus, lease.pg_key)
+            self._kick_scheduler()
+        return {"ok": True}
+
+    async def rpc_worker_unblocked(self, conn, body):
+        lease = self.leases.get(body["lease_id"])
+        if lease is None or not lease.blocked:
+            return {"ok": False}
+        lease.blocked = False
+        cpus = {k: v for k, v in lease.resources.items() if k == "CPU"}
+        if cpus:
+            self._acquire(cpus, lease.pg_key)  # may overcommit briefly
+        return {"ok": True}
+
+    # -------------------------------------------------------- actor leasing
+    async def rpc_lease_worker_for_actor(self, conn, body):
+        resources = body.get("resources") or {}
+        pg_id = body.get("pg_id")
+        pg_key = None
+        if pg_id is not None:
+            pg_key = self._bundle_key_for(pg_id, body.get("bundle_index"),
+                                          resources)
+            if pg_key is None:
+                return {"ok": False, "reason": "bundle not here"}
+        if not self._fits(resources, pg_key):
+            return {"ok": False, "reason": "resources busy"}
+        self._acquire(resources, pg_key)
+        kind = "tpu" if resources.get("TPU") else "cpu"
+        w = await self._get_ready_worker(kind)
+        if w is None:
+            self._release(resources, pg_key)
+            return {"ok": False, "reason": "no worker"}
+        lease_id = os.urandom(8)
+        lease = Lease(lease_id, w, resources, pg_key)
+        self.leases[lease_id] = lease
+        w.lease_id = lease_id
+        w.actor_id = body["actor_id"]
+        try:
+            reply = await w.conn.request("create_actor", {
+                "actor_id": body["actor_id"],
+                "spec": body["spec"],
+                "lease_id": lease_id,
+            }, timeout=120.0)
+        except Exception as e:
+            await self._on_worker_dead(w, f"actor creation failed: {e}")
+            return {"ok": False, "reason": f"create_actor failed: {e}"}
+        if not reply.get("ok"):
+            w.actor_id = None
+            self.leases.pop(lease_id, None)
+            self._release(resources, pg_key)
+            w.last_idle = time.monotonic()
+            self.idle_workers[w.kind].append(w)
+            return {"ok": False, "reason": reply.get("error", "init failed"),
+                    "init_error": reply.get("error_blob")}
+        return {"ok": True, "worker_addr": w.addr, "worker_id": w.worker_id,
+                "pid": w.pid}
+
+    # ------------------------------------------------------ placement groups
+    async def rpc_prepare_bundle(self, conn, body):
+        resources = body["resources"]
+        if not self._fits(resources):
+            return {"ok": False}
+        self._acquire(resources)
+        key = (body["pg_id"], body["bundle_index"])
+        self.bundles[key] = {"reserved": dict(resources),
+                             "avail": dict(resources), "committed": False}
+        return {"ok": True}
+
+    async def rpc_commit_bundle(self, conn, body):
+        key = (body["pg_id"], body["bundle_index"])
+        if key in self.bundles:
+            self.bundles[key]["committed"] = True
+            return {"ok": True}
+        return {"ok": False}
+
+    async def rpc_return_bundle(self, conn, body):
+        key = (body["pg_id"], body["bundle_index"])
+        acct = self.bundles.pop(key, None)
+        if acct is not None:
+            self._release(acct["reserved"])
+            self._kick_scheduler()
+        return {"ok": True}
+
+    # ---------------------------------------------------------- object store
+    async def rpc_os_create(self, conn, body):
+        oid: bytes = body["oid"]
+        size: int = body["size"]
+        off = self.store.alloc(oid, size)
+        if off is None:
+            return {"error": f"object store OOM allocating {size} bytes"}
+        return {"offset": off}
+
+    async def rpc_os_seal(self, conn, body):
+        oid = body["oid"]
+        self.store.seal(oid)
+        for fut in self.seal_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(None)
+        return {"ok": True}
+
+    async def rpc_os_get(self, conn, body):
+        """Resolve objects to (offset, size) in the local arena, pulling from
+        remote nodes when needed (locations provided by owners)."""
+        oid = body["oid"]
+        timeout = body.get("timeout", 60.0)
+        location = body.get("location")  # NodeID where the object lives
+        got = self.store.get(oid)
+        if got is not None:
+            offset, size, sealed = got
+            if sealed:
+                self._track_pin(conn, oid)
+                return {"offset": offset, "size": size}
+            await self._wait_sealed(oid, timeout)
+            got = self.store.get(oid)
+            if got and got[2]:
+                self.store.release(oid)  # drop the extra pin from re-get
+                return {"offset": got[0], "size": got[1]}
+            return {"error": "timeout waiting for object seal"}
+        if location is not None and location != self.node_id:
+            ok = await self._pull_object(oid, location, timeout)
+            if not ok:
+                return {"error": f"failed to pull {oid.hex()} from "
+                                 f"{location.hex()[:8]}"}
+            got = self.store.get(oid)
+            if got and got[2]:
+                self._track_pin(conn, oid)
+                return {"offset": got[0], "size": got[1]}
+        await self._wait_sealed(oid, timeout)
+        got = self.store.get(oid)
+        if got and got[2]:
+            self._track_pin(conn, oid)
+            return {"offset": got[0], "size": got[1]}
+        return {"error": f"object {oid.hex()} not found"}
+
+    async def _wait_sealed(self, oid, timeout):
+        fut = asyncio.get_running_loop().create_future()
+        self.seal_waiters.setdefault(oid, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    async def _peer(self, node_id) -> protocol.Connection | None:
+        conn = self.peer_conns.get(node_id)
+        if conn is not None and not conn.closed:
+            return conn
+        view = self.cluster_nodes.get(node_id)
+        if view is None and self.gcs is not None:
+            for v in await self.gcs.request("get_nodes", {}):
+                self.cluster_nodes[v["node_id"]] = v
+            view = self.cluster_nodes.get(node_id)
+        if view is None:
+            return None
+        try:
+            conn = await protocol.Connection.connect(
+                view["addr"][0], view["addr"][1], handler=self._handle,
+                name="raylet-peer", timeout=cfg.connect_timeout_s)
+        except Exception:
+            return None
+        self.peer_conns[node_id] = conn
+        return conn
+
+    async def _pull_object(self, oid, location, timeout) -> bool:
+        if oid in self._pulls_inflight:
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(self._pulls_inflight[oid]), timeout)
+            except asyncio.TimeoutError:
+                return False
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls_inflight[oid] = fut
+        try:
+            ok = await self._do_pull(oid, location, timeout)
+            if not fut.done():
+                fut.set_result(ok)
+            return ok
+        except Exception as e:
+            if not fut.done():
+                fut.set_result(False)
+            logger.warning("pull %s failed: %s", oid.hex()[:8], e)
+            return False
+        finally:
+            self._pulls_inflight.pop(oid, None)
+
+    async def _do_pull(self, oid, location, timeout) -> bool:
+        peer = await self._peer(location)
+        if peer is None:
+            return False
+        meta = await peer.request("os_stat", {"oid": oid}, timeout=timeout)
+        if meta.get("error"):
+            return False
+        size = meta["size"]
+        try:
+            off = self.store.alloc(oid, size)
+        except KeyError:
+            return True  # someone else pulled it concurrently
+        if off is None:
+            return False
+        dest = self.mapping.slice(off, size)
+        chunk = cfg.fetch_chunk_bytes
+        pos = 0
+        while pos < size:
+            n = min(chunk, size - pos)
+            data = await peer.request("os_read_chunk",
+                                      {"oid": oid, "offset": pos, "len": n},
+                                      timeout=timeout)
+            if data.get("error"):
+                self.store.delete(oid)
+                return False
+            dest[pos:pos + n] = data["data"]
+            pos += n
+        self.store.seal(oid)
+        self.store.release(oid)
+        for fut in self.seal_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(None)
+        return True
+
+    async def rpc_os_stat(self, conn, body):
+        got = self.store.get(body["oid"])
+        if got is None or not got[2]:
+            if got is not None:
+                pass
+            return {"error": "not here"}
+        self.store.release(body["oid"])
+        return {"size": got[1]}
+
+    async def rpc_os_read_chunk(self, conn, body):
+        oid = body["oid"]
+        got = self.store.get(oid)
+        if got is None or not got[2]:
+            return {"error": "not here"}
+        offset, size, _ = got
+        start = body["offset"]
+        n = min(body["len"], size - start)
+        data = bytes(self.mapping.slice(offset + start, n))
+        self.store.release(oid)
+        return {"data": data}
+
+    def _track_pin(self, conn, oid: bytes):
+        pins = self._client_pins.setdefault(id(conn), {})
+        pins[oid] = pins.get(oid, 0) + 1
+
+    def _release_client_pins(self, conn):
+        """Client (worker/driver) went away: drop every pin it held so its
+        objects become evictable again (reference: plasma releases a
+        client's objects when its socket closes)."""
+        pins = self._client_pins.pop(id(conn), None)
+        if not pins:
+            return
+        for oid, count in pins.items():
+            for _ in range(count):
+                self.store.release(oid)
+
+    async def rpc_os_release(self, conn, body):
+        oid = body["oid"]
+        pins = self._client_pins.get(id(conn))
+        if pins and pins.get(oid):
+            pins[oid] -= 1
+            if pins[oid] <= 0:
+                del pins[oid]
+        self.store.release(oid)
+        return {"ok": True}
+
+    async def rpc_os_delete(self, conn, body):
+        self.store.delete(body["oid"])
+        return {"ok": True}
+
+    async def rpc_os_contains(self, conn, body):
+        return {"contains": self.store.contains(body["oid"])}
+
+    async def rpc_os_used(self, conn, body):
+        return {"used": self.store.used(), "capacity": self.store_capacity}
+
+    # ------------------------------------------------------------- lifecycle
+    async def _heartbeat_loop(self):
+        period = cfg.heartbeat_period_ms / 1000.0
+        report_period = cfg.resource_report_period_ms / 1000.0
+        last_beat = 0.0
+        while not self._shutdown:
+            await asyncio.sleep(report_period)
+            now = time.monotonic()
+            if now - last_beat < report_period:
+                continue
+            last_beat = now
+            try:
+                await self.gcs.request("heartbeat", {
+                    "node_id": self.node_id,
+                    "available": self.available,
+                    "load": self._load(),
+                })
+            except Exception:
+                if self._shutdown:
+                    return
+
+    async def rpc_shutdown(self, conn, body):
+        asyncio.get_running_loop().create_task(self.shutdown())
+        return {"ok": True}
+
+    async def rpc_ping(self, conn, body):
+        return {"ok": True, "node_id": self.node_id}
+
+    async def shutdown(self):
+        self._shutdown = True
+        for w in list(self.workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        await self.server.stop()
+        if self.gcs is not None:
+            await self.gcs.close()
+        self.mapping.close()
+        self.store.close()
+
+
+def main():
+    import argparse
+    import json
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu")
+    parser.add_argument("--store-capacity", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[raylet] %(levelname)s %(message)s")
+    resources = json.loads(args.resources)
+    labels = json.loads(args.labels)
+    if not resources:
+        from ray_tpu._private.resources import detect_node_resources
+        resources, auto_labels = detect_node_resources()
+        labels = {**auto_labels, **labels}
+
+    async def run():
+        raylet = Raylet((args.gcs_host, args.gcs_port), resources,
+                        labels=labels, host=args.host,
+                        session_dir=args.session_dir,
+                        store_capacity=args.store_capacity or None)
+        port = await raylet.start(args.port)
+        print(f"RAYLET_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

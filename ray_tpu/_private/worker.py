@@ -1,0 +1,1181 @@
+"""CoreWorker: the in-process runtime of every driver and worker.
+
+TPU-native re-design of the reference core worker (reference:
+src/ray/core_worker/core_worker.h:63 — SubmitTask core_worker.cc:1567, Put
+:892, Get :1095, ExecuteTask :2181, HandlePushTask :2543;
+CoreWorkerDirectTaskSubmitter transport/direct_task_transport.h:57 with
+per-SchedulingKey lease pools; CoreWorkerDirectActorTaskSubmitter
+direct_actor_task_submitter.h:67 with per-caller sequence numbers;
+TaskManager task_manager.h:86 for retries; ReferenceCounter
+reference_count.h:61 for ownership; memory store
+store_provider/memory_store/memory_store.h:43).
+
+Each process runs one CoreWorker: it owns the objects it creates (the owner
+resolves status/location queries from borrowers), submits tasks via
+raylet-granted worker leases and pushes them directly worker-to-worker, and
+— in worker processes — executes pushed tasks/actor methods on an executor
+pool while the asyncio loop stays responsive for the data plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import logging
+import os
+import threading
+import time
+import traceback
+from concurrent.futures import Future as CFuture, ThreadPoolExecutor
+
+from ray_tpu import exceptions as rexc
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import (ActorID, FunctionID, JobID, NodeID, ObjectID,
+                                  TaskID, WorkerID)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.shm_store import StoreMapping
+
+logger = logging.getLogger(__name__)
+
+global_worker: "CoreWorker | None" = None
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+# Owned-object states.
+PENDING = "PENDING"
+INLINE = "INLINE"
+IN_STORE = "IN_STORE"
+ERRORED = "ERRORED"
+
+
+class _RefArg:
+    """Marker for a top-level ObjectRef argument: the executor substitutes
+    the fetched value (nested refs are passed through as refs — reference
+    semantics)."""
+    __slots__ = ("ref",)
+
+    def __init__(self, ref: ObjectRef):
+        self.ref = ref
+
+
+class OwnedObject:
+    __slots__ = ("state", "blob", "location", "size", "event", "local_refs",
+                 "submitted_task")
+
+    def __init__(self):
+        self.state = PENDING
+        self.blob = None
+        self.location: NodeID | None = None
+        self.size = 0
+        self.event = asyncio.Event()
+        self.local_refs = 0
+        self.submitted_task = None  # spec kept for lineage/retries
+
+    def ready(self):
+        return self.state != PENDING
+
+
+class LeasePool:
+    """Per-SchedulingKey lease pool (reference: direct_task_transport.h:57 —
+    worker_to_lease_entry / pipelining per scheduling key)."""
+
+    def __init__(self):
+        self.queue: list = []
+        self.idle: list = []
+        self.all: dict[bytes, dict] = {}
+        self.requests_inflight = 0
+        self.return_timers: dict[bytes, asyncio.TimerHandle] = {}
+        # request_id -> raylet conn the request is queued at (for cancel)
+        self.outstanding: dict[bytes, object] = {}
+
+
+class ExecutionContext(threading.local):
+    def __init__(self):
+        self.task_id = None
+        self.actor_id = None
+        self.lease_id = None
+        self.blocked_depth = 0
+
+
+class CoreWorker:
+    def __init__(self, mode, gcs_addr, raylet_addr=None, store_path=None,
+                 store_cap=None, worker_id=None, job_id=None,
+                 host="127.0.0.1"):
+        self.mode = mode
+        self.host = host
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.job_id = job_id or JobID.from_random()
+        self.gcs_addr = gcs_addr
+        self.raylet_addr = raylet_addr
+        self.node_id: NodeID | None = None
+        self.store_path = store_path
+        self.store_cap = store_cap
+        self.mapping: StoreMapping | None = None
+        self.server = protocol.RpcServer(self._handle, host=host,
+                                         name=f"cw-{mode}")
+        self.addr: tuple[str, int] | None = None
+        self.gcs: protocol.Connection | None = None
+        self.raylet: protocol.Connection | None = None
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._loop_ready = threading.Event()
+        # ownership tables
+        self.owned: dict[ObjectID, OwnedObject] = {}
+        self._pinned: set[bytes] = set()
+        self._borrow_cache: dict[ObjectID, bytes] = {}
+        # submission state
+        self.lease_pools: dict[tuple, LeasePool] = {}
+        self._worker_conns: dict[tuple, protocol.Connection] = {}
+        self._owner_conns: dict[tuple, protocol.Connection] = {}
+        self._exported_fns: set[bytes] = set()
+        self._fn_cache: dict[bytes, object] = {}
+        # actor-caller state
+        self._actor_seq: dict[ActorID, int] = {}
+        self._actor_conns: dict[ActorID, protocol.Connection] = {}
+        self._actor_addr_cache: dict[ActorID, tuple] = {}
+        self._actor_locks: dict[ActorID, asyncio.Lock] = {}
+        # actor-executor state
+        self.actor_instance = None
+        self.actor_id: ActorID | None = None
+        self._actor_is_async = False
+        self._actor_pools: dict[str, ThreadPoolExecutor] = {}
+        self._actor_async_sems: dict[str, asyncio.Semaphore] = {}
+        self._caller_seq: dict[bytes, int] = {}
+        self._caller_buffer: dict[bytes, list] = {}
+        self._task_pool = ThreadPoolExecutor(max_workers=1,
+                                             thread_name_prefix="exec")
+        self.exec_ctx = ExecutionContext()
+        self.connected = False
+        self._shutdown = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start_driver(self):
+        """Driver mode: run the loop in a background thread."""
+        self._loop_thread = threading.Thread(target=self._loop_main,
+                                             name="ray_tpu-io", daemon=True)
+        self._loop_thread.start()
+        self._loop_ready.wait(30)
+        self._call(self._connect()).result(cfg.connect_timeout_s)
+        self.connected = True
+
+    def _loop_main(self):
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+        self._loop_ready.set()
+        self.loop.run_forever()
+
+    async def start_worker_async(self):
+        """Worker mode: called from the worker process's own loop."""
+        self.loop = asyncio.get_running_loop()
+        await self._connect()
+        self.connected = True
+
+    async def _connect(self):
+        self.addr = (self.host, await self.server.start(0))
+        self.gcs = await protocol.Connection.connect(
+            self.gcs_addr[0], self.gcs_addr[1], handler=self._handle,
+            name="cw->gcs", timeout=cfg.connect_timeout_s)
+        if self.mode == MODE_DRIVER:
+            await self.gcs.request("register_driver", {
+                "job_id": self.job_id, "pid": os.getpid(),
+                "entrypoint": " ".join(os.sys.argv)})
+        if self.raylet_addr is not None:
+            on_close = None
+            if self.mode == MODE_WORKER:
+                # A worker whose raylet died must exit, or it leaks forever
+                # (reference: workers die when the raylet socket closes,
+                # src/ray/common/client_connection.h).
+                def on_close(_conn):
+                    if not self._shutdown:
+                        logger.warning("raylet connection lost; worker exiting")
+                        os._exit(1)
+            self.raylet = await protocol.Connection.connect(
+                self.raylet_addr[0], self.raylet_addr[1], handler=self._handle,
+                name="cw->raylet", timeout=cfg.connect_timeout_s,
+                on_close=on_close)
+            reply = await self.raylet.request("register_worker", {
+                "worker_id": self.worker_id.hex(),
+                "addr": self.addr,
+                "pid": os.getpid(),
+            })
+            self.node_id = reply["node_id"]
+            if self.store_path is None:
+                # External-driver connect path: the raylet tells us where
+                # its arena lives so we can mmap the data plane.
+                self.store_path = reply.get("store_path")
+                self.store_cap = reply.get("store_capacity")
+        if self.store_path:
+            self.mapping = StoreMapping(self.store_path, self.store_cap)
+
+    def _call(self, coro) -> CFuture:
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def _run(self, coro, timeout=None):
+        """Run coro on the loop from a non-loop thread and wait."""
+        return self._call(coro).result(timeout)
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._call(self._shutdown_async()).result(5)
+        except Exception:
+            pass
+        if self._loop_thread is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._loop_thread.join(5)
+        self.connected = False
+
+    async def _shutdown_async(self):
+        await self.server.stop()
+        for conn in list(self._worker_conns.values()) + \
+                list(self._owner_conns.values()) + \
+                list(self._actor_conns.values()):
+            await conn.close()
+        if self.raylet is not None:
+            await self.raylet.close()
+        if self.gcs is not None:
+            await self.gcs.close()
+        if self.mapping is not None:
+            self.mapping.close()
+
+    # ----------------------------------------------------------- rpc server
+    async def _handle(self, conn, method, body):
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise protocol.RpcError(f"core worker: no method {method}")
+        return await fn(conn, body)
+
+    # ======================================================= OWNER-SIDE API
+    def put(self, value, _owner_ref=None) -> ObjectRef:
+        blob, _nested = serialization.serialize(value)
+        return self._run(self._put_blob(blob))
+
+    async def _put_blob(self, blob, object_id=None) -> ObjectRef:
+        oid = object_id or ObjectID.for_put()
+        entry = OwnedObject()
+        entry.local_refs = 1
+        self.owned[oid] = entry
+        size = blob.total_size()
+        if size <= cfg.max_direct_call_object_size or self.raylet is None:
+            entry.state = INLINE
+            entry.blob = blob.to_bytes()
+            entry.size = size
+        else:
+            offset = await self._store_create(oid.binary(), size)
+            blob.write_into(self.mapping.slice(offset, size))
+            await self.raylet.request("os_seal", {"oid": oid.binary()})
+            entry.state = IN_STORE
+            entry.location = self.node_id
+            entry.size = size
+        entry.event.set()
+        return ObjectRef(oid, owner_addr=self.addr, _track=True)
+
+    async def _store_create(self, oid_bin: bytes, size: int) -> int:
+        reply = await self.raylet.request("os_create",
+                                          {"oid": oid_bin, "size": size})
+        if "error" in reply:
+            raise rexc.ObjectLostError(oid_bin.hex(), reply["error"])
+        return reply["offset"]
+
+    def get(self, refs, timeout=None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        self._notify_blocked()
+        try:
+            values = self._run(self._get_async_list(refs, timeout))
+        finally:
+            self._notify_unblocked()
+        return values[0] if single else values
+
+    def get_future(self, ref: ObjectRef) -> CFuture:
+        return self._call(self._get_one(ref))
+
+    async def get_async(self, ref: ObjectRef):
+        return await self._get_one(ref)
+
+    async def _get_async_list(self, refs, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        coros = [self._get_one(r, deadline) for r in refs]
+        return list(await asyncio.gather(*coros))
+
+    async def _get_one(self, ref: ObjectRef, deadline=None):
+        blob = await self._resolve_blob(ref, deadline)
+        value = serialization.deserialize(blob)
+        if isinstance(value, _SerializedError):
+            raise value.to_exception()
+        return value
+
+    async def _resolve_blob(self, ref: ObjectRef, deadline=None):
+        entry = self.owned.get(ref.id)
+        if entry is not None:
+            if not entry.ready():
+                await self._wait_event(entry.event, deadline,
+                                       f"object {ref.id.hex()}")
+            if entry.state == INLINE:
+                return entry.blob
+            if entry.state == ERRORED:
+                return entry.blob
+            return await self._fetch_from_store(ref.id, entry.location,
+                                                deadline)
+        # Borrowed ref: ask the owner.
+        cached = self._borrow_cache.get(ref.id)
+        if cached is not None:
+            return cached
+        if ref.owner_addr is None:
+            raise rexc.ObjectLostError(ref.id.hex(), "no owner address")
+        owner = await self._owner_conn(tuple(ref.owner_addr))
+        status = await owner.request("get_object_status", {"oid": ref.id},
+                                     timeout=self._remain(deadline))
+        if status.get("error") is not None:
+            return status["error"]  # serialized error blob
+        if "blob" in status:
+            self._borrow_cache[ref.id] = status["blob"]
+            return status["blob"]
+        return await self._fetch_from_store(ref.id, status["location"],
+                                            deadline)
+
+    async def _fetch_from_store(self, oid: ObjectID, location, deadline=None):
+        if self.raylet is None:
+            raise rexc.ObjectLostError(oid.hex(), "no raylet (local mode)")
+        reply = await self.raylet.request("os_get", {
+            "oid": oid.binary(), "location": location,
+            "timeout": self._remain(deadline) or 60.0,
+        }, timeout=(self._remain(deadline) or 60.0) + 5.0)
+        if "error" in reply:
+            raise rexc.ObjectLostError(oid.hex(), reply["error"])
+        self._pinned.add(oid.binary())
+        return self.mapping.slice(reply["offset"], reply["size"])
+
+    @staticmethod
+    def _remain(deadline):
+        if deadline is None:
+            return None
+        return max(0.001, deadline - time.monotonic())
+
+    async def _wait_event(self, event, deadline, what):
+        if deadline is None:
+            await event.wait()
+        else:
+            try:
+                await asyncio.wait_for(event.wait(), self._remain(deadline))
+            except asyncio.TimeoutError:
+                raise rexc.GetTimeoutError(f"timed out waiting for {what}")
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        self._notify_blocked()
+        try:
+            return self._run(self._wait_async(refs, num_returns, timeout))
+        finally:
+            self._notify_unblocked()
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        pending = list(refs)
+        ready: list = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _ready_one(r):
+            await self._resolve_blob(r)
+            return r
+
+        tasks = {asyncio.ensure_future(_ready_one(r)): r for r in pending}
+        try:
+            while len(ready) < num_returns and tasks:
+                done, _ = await asyncio.wait(
+                    tasks.keys(), timeout=self._remain(deadline),
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for t in done:
+                    r = tasks.pop(t)
+                    if t.exception() is None:
+                        ready.append(r)
+                    else:
+                        ready.append(r)  # errored objects count as ready
+            not_ready = [tasks[t] for t in tasks]
+        finally:
+            for t in tasks:
+                t.cancel()
+        order = {id(r): i for i, r in enumerate(refs)}
+        ready.sort(key=lambda r: order.get(id(r), 0))
+        return ready, not_ready
+
+    async def _owner_conn(self, addr: tuple) -> protocol.Connection:
+        conn = self._owner_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await protocol.Connection.connect(
+                addr[0], addr[1], handler=self._handle, name="cw->owner",
+                timeout=cfg.connect_timeout_s)
+            self._owner_conns[addr] = conn
+        return conn
+
+    async def rpc_get_object_status(self, conn, body):
+        """Serve borrowers asking about an object we own (reference:
+        CoreWorkerService GetObjectStatus)."""
+        oid: ObjectID = body["oid"]
+        entry = self.owned.get(oid)
+        if entry is None:
+            return {"error": _error_blob(
+                rexc.ObjectLostError(oid.hex(), "owner has no record"))}
+        if not entry.ready():
+            await entry.event.wait()
+        if entry.state == INLINE:
+            return {"blob": entry.blob}
+        if entry.state == ERRORED:
+            return {"error": entry.blob}
+        return {"location": entry.location, "size": entry.size}
+
+    # ----------------------------------------------------------- refcounting
+    def add_local_ref(self, ref: ObjectRef):
+        entry = self.owned.get(ref.id)
+        if entry is not None:
+            entry.local_refs += 1
+
+    def remove_local_ref(self, ref: ObjectRef):
+        if self._shutdown or not self.connected:
+            return
+        entry = self.owned.get(ref.id)
+        if entry is None:
+            return
+        entry.local_refs -= 1
+        if entry.local_refs <= 0 and entry.ready():
+            self.owned.pop(ref.id, None)
+            if entry.state == IN_STORE and self.loop is not None:
+                try:
+                    self._call(self._delete_store_object(ref.id, entry))
+                except Exception:
+                    pass
+
+    async def _delete_store_object(self, oid: ObjectID, entry):
+        try:
+            if entry.location == self.node_id and self.raylet is not None:
+                await self.raylet.request("os_delete", {"oid": oid.binary()})
+        except Exception:
+            pass
+
+    # ==================================================== TASK SUBMISSION
+    def export_function(self, fn) -> bytes:
+        blob = serialization.dumps_function(fn)
+        import hashlib
+        fn_id = hashlib.sha1(blob).digest()[:16]
+        if fn_id not in self._exported_fns:
+            self._run(self.gcs.request("kv_put", {
+                "ns": "funcs", "key": fn_id, "value": blob}))
+            self._exported_fns.add(fn_id)
+            self._fn_cache[fn_id] = fn
+        return fn_id
+
+    def submit_task(self, fn_id: bytes, args, kwargs, opts: dict):
+        task_id = TaskID.from_random()
+        num_returns = opts.get("num_returns", 1)
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            entry = OwnedObject()
+            entry.local_refs = 1
+            self.owned[oid] = entry
+            refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
+        args_blob = self._pack_args(args, kwargs)
+        spec = {
+            "task_id": task_id,
+            "fn_id": fn_id,
+            "args": args_blob,
+            "num_returns": num_returns,
+            "owner_addr": self.addr,
+            "return_ids": [r.id for r in refs],
+            "resources": _normalize_resources(opts),
+            "strategy": _strategy_dict(opts.get("scheduling_strategy")),
+            "max_retries": opts.get("max_retries",
+                                    cfg.max_task_retries_default),
+            "retry_exceptions": opts.get("retry_exceptions", False),
+            "name": opts.get("name", ""),
+        }
+        pg = opts.get("placement_group")
+        if pg is not None:
+            spec["pg_id"] = pg.id
+            spec["bundle_index"] = opts.get("placement_group_bundle_index", -1)
+        self._call(self._submit(spec))
+        return refs
+
+    def _pack_args(self, args, kwargs):
+        new_args = [(_RefArg(a) if isinstance(a, ObjectRef) else a)
+                    for a in args]
+        new_kwargs = {k: (_RefArg(v) if isinstance(v, ObjectRef) else v)
+                      for k, v in kwargs.items()}
+        blob, _nested = serialization.serialize((new_args, new_kwargs))
+        return blob.to_bytes()
+
+    def _scheduling_key(self, spec):
+        res = tuple(sorted(spec["resources"].items()))
+        strat = spec.get("strategy")
+        strat_key = tuple(sorted(strat.items())) if strat else None
+        return (spec["fn_id"], res, strat_key, spec.get("pg_id"),
+                spec.get("bundle_index"))
+
+    async def _submit(self, spec):
+        key = self._scheduling_key(spec)
+        pool = self.lease_pools.get(key)
+        if pool is None:
+            pool = self.lease_pools[key] = LeasePool()
+        pool.queue.append(spec)
+        self._pump(key)
+
+    def _pump(self, key):
+        pool = self.lease_pools[key]
+        while pool.queue and pool.idle:
+            lease = pool.idle.pop()
+            timer = pool.return_timers.pop(lease["lease_id"], None)
+            if timer is not None:
+                timer.cancel()
+            spec = pool.queue.pop(0)
+            self.loop.create_task(self._push_on_lease(key, lease, spec))
+        backlog = len(pool.queue)
+        if backlog == 0 and pool.outstanding:
+            self._cancel_outstanding(pool)
+        want = min(backlog, 8) - pool.requests_inflight - len([
+            1 for e in pool.all.values() if e.get("busy")])
+        for _ in range(max(0, want)):
+            pool.requests_inflight += 1
+            self.loop.create_task(self._request_lease(key))
+
+    def _cancel_outstanding(self, pool):
+        by_conn: dict[int, tuple] = {}
+        for rid, conn in pool.outstanding.items():
+            by_conn.setdefault(id(conn), (conn, []))[1].append(rid)
+        pool.outstanding.clear()
+        for conn, rids in by_conn.values():
+            if not conn.closed:
+                self.loop.create_task(self._send_cancel(conn, rids))
+
+    async def _send_cancel(self, conn, rids):
+        try:
+            await conn.request("cancel_lease_requests", {"request_ids": rids})
+        except Exception:
+            pass
+
+    async def _request_lease(self, key):
+        pool = self.lease_pools[key]
+        spec_probe = pool.queue[0] if pool.queue else None
+        request_id = os.urandom(8)
+        try:
+            if spec_probe is None:
+                return
+            body = {
+                "resources": spec_probe["resources"],
+                "strategy": spec_probe.get("strategy"),
+                "pg_id": spec_probe.get("pg_id"),
+                "bundle_index": spec_probe.get("bundle_index"),
+                "request_id": request_id,
+            }
+            conn = self.raylet
+            if spec_probe.get("pg_id") is not None:
+                conn = await self._raylet_for_bundle(
+                    spec_probe["pg_id"], spec_probe.get("bundle_index"))
+            for _hop in range(4):
+                pool.outstanding[request_id] = conn
+                reply = await conn.request("request_worker_lease", body,
+                                           timeout=300.0)
+                pool.outstanding.pop(request_id, None)
+                if "spillback" in reply:
+                    addr = tuple(reply["spillback"])
+                    conn = await self._raylet_conn(addr)
+                    body = dict(body)
+                    body["strategy"] = None  # don't re-spread at the target
+                    continue
+                break
+            if reply.get("cancelled"):
+                return
+            if "error" in reply:
+                self._fail_queued(key, rexc.RayTpuError(reply["error"]))
+                return
+            if "worker_addr" not in reply:
+                self._fail_queued(key, rexc.RayTpuError(
+                    f"lease not granted after spillback hops: {reply}"))
+                return
+            worker_addr = tuple(reply["worker_addr"])
+            wconn = await self._worker_conn(worker_addr)
+            lease = {
+                "lease_id": reply["lease_id"],
+                "conn": wconn,
+                "raylet": conn,
+                "node_id": reply["node_id"],
+                "worker_addr": worker_addr,
+                "busy": False,
+            }
+            pool.all[lease["lease_id"]] = lease
+            pool.idle.append(lease)
+        except Exception as e:
+            logger.warning("lease request failed: %s", e)
+            self._fail_queued(key, e)
+            return
+        finally:
+            pool.requests_inflight -= 1
+        self._pump(key)
+
+    def _fail_queued(self, key, exc):
+        pool = self.lease_pools.get(key)
+        if pool is None:
+            return
+        while pool.queue:
+            spec = pool.queue.pop(0)
+            self._complete_with_error(spec, exc)
+
+    def _complete_with_error(self, spec, exc):
+        blob = _error_blob(exc if isinstance(exc, Exception)
+                           else rexc.RayTpuError(str(exc)))
+        for oid in spec["return_ids"]:
+            entry = self.owned.get(oid)
+            if entry is not None:
+                entry.state = ERRORED
+                entry.blob = blob
+                entry.event.set()
+
+    async def _raylet_for_bundle(self, pg_id, bundle_index):
+        """Route a placement-group lease to the raylet holding the bundle
+        (reference: PG-aware lease targeting via the bundle's node)."""
+        view = await self.gcs.request(
+            "wait_placement_group", {"pg_id": pg_id, "timeout": 60.0})
+        if view is None or view.get("state") != "CREATED":
+            raise rexc.RayTpuError(
+                f"placement group {pg_id.hex()[:8]} not ready "
+                f"(state={view and view.get('state')})")
+        bundle_nodes = view["bundle_nodes"]
+        if bundle_index is not None and bundle_index >= 0:
+            node_ids = [bundle_nodes[bundle_index]]
+        else:
+            node_ids = list(dict.fromkeys(bundle_nodes))
+        nodes = await self.gcs.request("get_nodes", {})
+        by_id = {n["node_id"]: n for n in nodes}
+        for nid in node_ids:
+            nview = by_id.get(nid)
+            if nview is not None and nview.get("alive"):
+                if nid == self.node_id:
+                    return self.raylet
+                return await self._raylet_conn(tuple(nview["addr"]))
+        raise rexc.RayTpuError(
+            f"no alive node holds bundles of pg {pg_id.hex()[:8]}")
+
+    async def _raylet_conn(self, addr):
+        key = ("raylet",) + tuple(addr)
+        conn = self._worker_conns.get(key)
+        if conn is None or conn.closed:
+            conn = await protocol.Connection.connect(
+                addr[0], addr[1], handler=self._handle, name="cw->raylet2",
+                timeout=cfg.connect_timeout_s)
+            self._worker_conns[key] = conn
+        return conn
+
+    async def _worker_conn(self, addr):
+        conn = self._worker_conns.get(tuple(addr))
+        if conn is None or conn.closed:
+            conn = await protocol.Connection.connect(
+                addr[0], addr[1], handler=self._handle, name="cw->worker",
+                timeout=cfg.connect_timeout_s)
+            self._worker_conns[tuple(addr)] = conn
+        return conn
+
+    async def _push_on_lease(self, key, lease, spec):
+        pool = self.lease_pools[key]
+        lease["busy"] = True
+        try:
+            reply = await lease["conn"].request("push_task", {
+                "spec": spec, "lease_id": lease["lease_id"]}, timeout=None)
+            self._record_results(spec, reply)
+        except Exception as e:
+            self._drop_lease(key, lease)
+            retries = spec.get("max_retries", 0)
+            if retries != 0 and _is_system_error(e):
+                spec["max_retries"] = retries - 1 if retries > 0 else retries
+                logger.info("retrying task %s after worker failure: %s",
+                            spec["name"] or spec["task_id"].hex()[:8], e)
+                pool.queue.append(spec)
+            else:
+                self._complete_with_error(spec, e)
+            self._pump(key)
+            return
+        lease["busy"] = False
+        if pool.queue:
+            pool.idle.append(lease)
+            self._pump(key)
+        else:
+            # Linger briefly before returning the lease: a tight
+            # submit/get loop re-uses it without a fresh lease round trip.
+            handle = self.loop.call_later(
+                0.02, lambda: self.loop.create_task(
+                    self._return_lease(key, lease)))
+            pool.return_timers[lease["lease_id"]] = handle
+            pool.idle.append(lease)
+
+    async def _return_lease(self, key, lease):
+        pool = self.lease_pools.get(key)
+        if pool is None:
+            return
+        if lease in pool.idle:
+            pool.idle.remove(lease)
+        pool.all.pop(lease["lease_id"], None)
+        pool.return_timers.pop(lease["lease_id"], None)
+        try:
+            await lease["raylet"].request("return_worker",
+                                          {"lease_id": lease["lease_id"]})
+        except Exception:
+            pass
+
+    def _drop_lease(self, key, lease):
+        pool = self.lease_pools.get(key)
+        if pool is None:
+            return
+        if lease in pool.idle:
+            pool.idle.remove(lease)
+        pool.all.pop(lease["lease_id"], None)
+        try:
+            self.loop.create_task(
+                lease["raylet"].request("return_worker",
+                                        {"lease_id": lease["lease_id"],
+                                         "kill": True}))
+        except Exception:
+            pass
+
+    def _record_results(self, spec, reply):
+        if "error" in reply:
+            blob = reply["error"]
+            for oid in spec["return_ids"]:
+                entry = self.owned.get(oid)
+                if entry is not None:
+                    entry.state = ERRORED
+                    entry.blob = blob
+                    entry.event.set()
+            return
+        for oid, result in zip(spec["return_ids"], reply["results"]):
+            entry = self.owned.get(oid)
+            if entry is None:
+                continue
+            kind = result[0]
+            if kind == "inline":
+                entry.state = INLINE
+                entry.blob = result[1]
+                entry.size = len(result[1])
+            else:  # ("store", node_id, size)
+                entry.state = IN_STORE
+                entry.location = result[1]
+                entry.size = result[2]
+            entry.event.set()
+
+    # ------------------------------------------------- blocked notifications
+    def _notify_blocked(self):
+        ctx = self.exec_ctx
+        ctx.blocked_depth += 1
+        if (self.mode == MODE_WORKER and ctx.blocked_depth == 1
+                and ctx.lease_id is not None and self.raylet is not None):
+            try:
+                self._call(self.raylet.request("worker_blocked",
+                                               {"lease_id": ctx.lease_id}))
+            except Exception:
+                pass
+
+    def _notify_unblocked(self):
+        ctx = self.exec_ctx
+        ctx.blocked_depth -= 1
+        if (self.mode == MODE_WORKER and ctx.blocked_depth == 0
+                and ctx.lease_id is not None and self.raylet is not None):
+            try:
+                self._call(self.raylet.request("worker_unblocked",
+                                               {"lease_id": ctx.lease_id}))
+            except Exception:
+                pass
+
+    # ======================================================== EXECUTION SIDE
+    async def rpc_push_task(self, conn, body):
+        spec = body["spec"]
+        lease_id = body.get("lease_id")
+        return await self.loop.run_in_executor(
+            self._task_pool, self._execute_task_sync, spec, lease_id)
+
+    def _execute_task_sync(self, spec, lease_id):
+        ctx = self.exec_ctx
+        ctx.task_id = spec["task_id"]
+        ctx.lease_id = lease_id
+        try:
+            fn = self._load_function(spec["fn_id"])
+            args, kwargs = self._unpack_args(spec["args"])
+            result = fn(*args, **kwargs)
+            return self._pack_results(result, spec)
+        except Exception as e:
+            return {"error": _error_blob(e, traceback.format_exc())}
+        finally:
+            ctx.task_id = None
+            ctx.lease_id = None
+
+    def _load_function(self, fn_id: bytes):
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            reply = self._run(self.gcs.request(
+                "kv_get", {"ns": "funcs", "key": fn_id}))
+            if reply["value"] is None:
+                raise rexc.RayTpuError(f"function {fn_id.hex()} not found")
+            fn = serialization.loads_function(reply["value"])
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    def _unpack_args(self, args_blob):
+        args, kwargs = serialization.deserialize(args_blob)
+        args = [self.get(a.ref) if isinstance(a, _RefArg) else a for a in args]
+        kwargs = {k: (self.get(v.ref) if isinstance(v, _RefArg) else v)
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _pack_results(self, result, spec):
+        num_returns = spec["num_returns"]
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)} values")
+        out = []
+        for oid, value in zip(spec["return_ids"], values):
+            blob, _ = serialization.serialize(value)
+            size = blob.total_size()
+            if size <= cfg.max_direct_call_object_size or self.raylet is None:
+                out.append(("inline", blob.to_bytes()))
+            else:
+                offset = self._run(self._store_create(oid.binary(), size))
+                blob.write_into(self.mapping.slice(offset, size))
+                self._run(self.raylet.request("os_seal",
+                                              {"oid": oid.binary()}))
+                out.append(("store", self.node_id, size))
+        return {"results": out}
+
+    # --------------------------------------------------------------- actors
+    async def rpc_create_actor(self, conn, body):
+        spec = body["spec"]
+        self.actor_id = body["actor_id"]
+        try:
+            result = await self.loop.run_in_executor(
+                self._task_pool, self._create_actor_sync, spec)
+            return result
+        except Exception as e:
+            return {"ok": False, "error": repr(e),
+                    "error_blob": _error_blob(e, traceback.format_exc())}
+
+    def _create_actor_sync(self, spec):
+        try:
+            cls = self._load_function(spec["class_id"])
+            args, kwargs = self._unpack_args(spec["init_args"])
+            import inspect
+            self.actor_instance = cls(*args, **kwargs)
+            self._actor_is_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(
+                    cls, predicate=inspect.isfunction))
+            self._max_concurrency = spec.get("max_concurrency") or (
+                1000 if self._actor_is_async else 1)
+            groups = dict(spec.get("concurrency_groups") or {})
+            # Sync methods always need a thread pool — an "async" actor can
+            # still define plain def methods (async sems are made lazily).
+            self._actor_pools["_default"] = ThreadPoolExecutor(
+                max_workers=(1 if self._actor_is_async
+                             else self._max_concurrency),
+                thread_name_prefix="actor")
+            for name, n in groups.items():
+                self._actor_pools[name] = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix=f"actor-{name}")
+            self._concurrency_groups = groups
+            return {"ok": True}
+        except Exception as e:
+            return {"ok": False, "error": repr(e),
+                    "error_blob": _error_blob(e, traceback.format_exc())}
+
+    async def rpc_push_actor_task(self, conn, body):
+        """Ordered actor-task execution (reference: ActorSchedulingQueue —
+        per-caller sequence numbers ensure submission order)."""
+        caller = body["caller_id"]
+        seq = body["seq"]
+        expected = self._caller_seq.get(caller, 0)
+        if seq != expected:
+            fut = self.loop.create_future()
+            heapq.heappush(self._caller_buffer.setdefault(caller, []),
+                           (seq, id(fut), fut, body))
+            return await fut
+        return await self._run_actor_task_in_order(caller, body)
+
+    async def _run_actor_task_in_order(self, caller, body):
+        self._caller_seq[caller] = body["seq"] + 1
+        # Release any buffered next-in-line tasks.
+        buf = self._caller_buffer.get(caller)
+        dispatch_coro = self._dispatch_actor_task(body)
+        task = self.loop.create_task(dispatch_coro)
+        while buf and buf[0][0] == self._caller_seq[caller]:
+            _seq, _tie, fut, nxt = heapq.heappop(buf)
+            self._caller_seq[caller] = nxt["seq"] + 1
+            nxt_task = self.loop.create_task(self._dispatch_actor_task(nxt))
+
+            def _transfer(t, f=fut):
+                if f.cancelled():
+                    return
+                if t.exception() is not None:
+                    f.set_exception(t.exception())
+                else:
+                    f.set_result(t.result())
+            nxt_task.add_done_callback(_transfer)
+        return await task
+
+    async def _dispatch_actor_task(self, body):
+        method_name = body["method"]
+        group = body.get("concurrency_group") or "_default"
+        if self.actor_instance is None:
+            return {"error": _error_blob(
+                rexc.ActorDiedError(self.actor_id, "actor not initialized"))}
+        method = getattr(self.actor_instance, method_name, None)
+        if method is None:
+            return {"error": _error_blob(AttributeError(
+                f"actor has no method {method_name}"))}
+        import inspect
+        spec = {"task_id": body["task_id"], "num_returns": body["num_returns"],
+                "return_ids": body["return_ids"]}
+        if inspect.iscoroutinefunction(method):
+            sem = self._actor_async_sems.get(group)
+            if sem is None:
+                n = (self._concurrency_groups.get(group)
+                     if group != "_default" else None) or self._max_concurrency
+                sem = self._actor_async_sems[group] = asyncio.Semaphore(n)
+            async with sem:
+                try:
+                    args, kwargs = await self.loop.run_in_executor(
+                        None, self._unpack_args, body["args"])
+                    result = await method(*args, **kwargs)
+                    return await self.loop.run_in_executor(
+                        None, self._pack_results, result, spec)
+                except Exception as e:
+                    return {"error": _error_blob(e, traceback.format_exc())}
+        pool = self._actor_pools.get(group) or self._actor_pools["_default"]
+        return await self.loop.run_in_executor(
+            pool, self._execute_actor_method_sync, method, body, spec)
+
+    def _execute_actor_method_sync(self, method, body, spec):
+        try:
+            args, kwargs = self._unpack_args(body["args"])
+            result = method(*args, **kwargs)
+            return self._pack_results(result, spec)
+        except Exception as e:
+            if isinstance(e, SystemExit) or isinstance(e, _ActorExit):
+                raise
+            return {"error": _error_blob(e, traceback.format_exc())}
+
+    # --------------------------------------------------- actor-caller side
+    def submit_actor_task(self, actor_id: ActorID, actor_addr, method: str,
+                          args, kwargs, num_returns=1, opts=None):
+        opts = opts or {}
+        task_id = TaskID.from_random()
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            entry = OwnedObject()
+            entry.local_refs = 1
+            self.owned[oid] = entry
+            refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
+        args_blob = self._pack_args(args, kwargs)
+        body = {
+            "task_id": task_id,
+            "method": method,
+            "args": args_blob,
+            "num_returns": num_returns,
+            "return_ids": [r.id for r in refs],
+            "caller_id": self.worker_id.binary(),
+            "concurrency_group": opts.get("concurrency_group"),
+            "owner_addr": self.addr,
+        }
+        self._call(self._submit_actor_task(actor_id, actor_addr, body,
+                                           opts.get("max_task_retries", 0)))
+        return refs
+
+    async def _actor_send(self, actor_id, actor_addr, body):
+        """Connect (or reuse), assign the next sequence number, and put the
+        request on the wire — all under the per-actor lock so wire order
+        always matches sequence order (reference: the direct actor
+        submitter's send queue preserves submission order per caller)."""
+        lock = self._actor_locks.get(actor_id)
+        if lock is None:
+            lock = self._actor_locks[actor_id] = asyncio.Lock()
+        async with lock:
+            conn = await self._actor_conn(actor_id, actor_addr)
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+            body["seq"] = seq
+            return await conn.request_send("push_actor_task", body)
+
+    async def _submit_actor_task(self, actor_id, actor_addr, body, retries):
+        view = None
+        try:
+            fut = await self._actor_send(actor_id, actor_addr, body)
+            reply = await fut
+            self._record_results({"return_ids": body["return_ids"]}, reply)
+            return
+        except Exception as e:
+            # Actor may be restarting; re-resolve its address from the GCS
+            # and, with retries enabled, resubmit to the new incarnation.
+            view = await self._wait_actor_alive(actor_id)
+            if (retries != 0 and view is not None
+                    and view.get("state") == "ALIVE"
+                    and view.get("addr") is not None):
+                try:
+                    fut = await self._actor_send(actor_id,
+                                                 tuple(view["addr"]), body)
+                    reply = await fut
+                    self._record_results(
+                        {"return_ids": body["return_ids"]}, reply)
+                    return
+                except Exception:
+                    pass
+            cause = ((view or {}).get("death_cause")
+                     if isinstance(e, protocol.ConnectionLost) else None) \
+                or str(e)
+            err = rexc.ActorDiedError(actor_id, cause)
+            blob = _error_blob(err)
+            for oid in body["return_ids"]:
+                entry = self.owned.get(oid)
+                if entry is not None:
+                    entry.state = ERRORED
+                    entry.blob = blob
+                    entry.event.set()
+
+    async def _wait_actor_alive(self, actor_id):
+        try:
+            return await self.gcs.request(
+                "wait_actor_alive", {"actor_id": actor_id, "timeout": 60.0})
+        except Exception:
+            return None
+
+    async def _actor_conn(self, actor_id, actor_addr):
+        """Resolve a live connection to the actor.  Only call while holding
+        the per-actor lock.  A reconnect to a *different* address means a new
+        actor incarnation: the sequence stream restarts at 0."""
+        conn = self._actor_conns.get(actor_id)
+        if conn is not None and not conn.closed:
+            return conn
+        if actor_addr is None or (conn is not None and conn.closed):
+            view = await self._wait_actor_alive(actor_id)
+            if view is None or view.get("addr") is None or \
+                    view.get("state") != "ALIVE":
+                raise rexc.ActorDiedError(
+                    actor_id, (view or {}).get("death_cause") or "not found")
+            actor_addr = tuple(view["addr"])
+        if self._actor_addr_cache.get(actor_id) not in (None, tuple(actor_addr)):
+            self._actor_seq[actor_id] = 0  # new incarnation, new stream
+        conn = await protocol.Connection.connect(
+            actor_addr[0], actor_addr[1], handler=self._handle,
+            name="cw->actor", timeout=cfg.connect_timeout_s)
+        self._actor_conns[actor_id] = conn
+        self._actor_addr_cache[actor_id] = tuple(actor_addr)
+        return conn
+
+    def create_actor(self, class_id: bytes, init_args, init_kwargs,
+                     opts: dict) -> ActorID:
+        actor_id = ActorID.from_random()
+        init_blob = self._pack_args(init_args, init_kwargs)
+        spec = {
+            "class_id": class_id,
+            "class_name": opts.get("class_name", ""),
+            "init_args": init_blob,
+            "resources": _normalize_resources(opts, actor=True),
+            "max_restarts": opts.get("max_restarts",
+                                     cfg.actor_max_restarts_default),
+            "max_concurrency": opts.get("max_concurrency"),
+            "concurrency_groups": opts.get("concurrency_groups"),
+            "name": opts.get("name"),
+            "namespace": opts.get("namespace", "default"),
+            "detached": opts.get("lifetime") == "detached",
+            "scheduling_strategy": _strategy_dict(
+                opts.get("scheduling_strategy")),
+        }
+        pg = opts.get("placement_group")
+        if pg is not None:
+            spec["placement_group_id"] = pg.id
+            spec["bundle_index"] = opts.get("placement_group_bundle_index")
+        reply = self._run(self.gcs.request("create_actor", {
+            "actor_id": actor_id, "spec": spec, "job_id": self.job_id}))
+        if not reply.get("ok"):
+            raise ValueError(reply.get("reason", "actor creation failed"))
+        return actor_id
+
+    # ------------------------------------------------------------ misc rpc
+    async def rpc_ping(self, conn, body):
+        return {"ok": True, "mode": self.mode}
+
+    async def rpc_exit(self, conn, body):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+
+class _ActorExit(SystemExit):
+    pass
+
+
+class _SerializedError:
+    """Wrapper stored as the value of errored objects; raising happens at
+    get() (reference: RayTaskError stored as the object value)."""
+
+    def __init__(self, exc: Exception | None, repr_str: str, tb: str):
+        self.exc = exc
+        self.repr_str = repr_str
+        self.tb = tb
+
+    def to_exception(self) -> Exception:
+        if isinstance(self.exc, (rexc.ActorError, rexc.ObjectLostError,
+                                 rexc.RayTpuError)):
+            return self.exc
+        if isinstance(self.exc, Exception):
+            return rexc._wrap_cause(self.exc, self.tb)
+        return rexc.TaskError(self.repr_str, self.tb)
+
+
+def _error_blob(exc: Exception, tb: str = "") -> bytes:
+    try:
+        blob, _ = serialization.serialize(_SerializedError(exc, repr(exc), tb))
+    except Exception:
+        blob, _ = serialization.serialize(
+            _SerializedError(None, repr(exc), tb))
+    return blob.to_bytes()
+
+
+def _is_system_error(e: Exception) -> bool:
+    return isinstance(e, (protocol.ConnectionLost, ConnectionError, OSError,
+                          asyncio.TimeoutError))
+
+
+def _normalize_resources(opts: dict, actor=False) -> dict:
+    res = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    if num_cpus is None:
+        num_cpus = 0 if actor else 1
+    if num_cpus:
+        res["CPU"] = float(num_cpus)
+    num_tpus = opts.get("num_tpus", opts.get("num_gpus"))
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    return res
+
+
+def _strategy_dict(strategy):
+    if strategy is None:
+        return None
+    if isinstance(strategy, str):
+        if strategy == "SPREAD":
+            return {"type": "spread"}
+        if strategy == "DEFAULT":
+            return None
+        return None
+    # NodeAffinitySchedulingStrategy / PlacementGroupSchedulingStrategy
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return {"type": "node_affinity", "node_id": strategy.node_id,
+                "soft": strategy.soft}
+    return None

@@ -1,0 +1,52 @@
+"""Entry point of a worker process spawned by the raylet.
+
+Reference: python/ray/_private/workers/default_worker.py — connects the
+core worker to its raylet + GCS and runs the task loop until told to exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+
+async def _amain():
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # The sitecustomize TPU hook overrides JAX_PLATFORMS via jax.config;
+        # re-pin cpu so user tasks running jax here never dial the chip
+        # tunnel (only "tpu"-kind workers may).
+        from ray_tpu._private.jax_utils import ensure_cpu
+        ensure_cpu()
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.ids import WorkerID
+    from ray_tpu._private.worker import CoreWorker, MODE_WORKER
+
+    gcs_addr = (os.environ["RT_GCS_HOST"], int(os.environ["RT_GCS_PORT"]))
+    raylet_addr = (os.environ["RT_RAYLET_HOST"],
+                   int(os.environ["RT_RAYLET_PORT"]))
+    cw = CoreWorker(
+        MODE_WORKER,
+        gcs_addr,
+        raylet_addr=raylet_addr,
+        store_path=os.environ.get("RT_STORE_PATH"),
+        store_cap=int(os.environ.get("RT_STORE_CAP", "0")) or None,
+        worker_id=WorkerID.from_hex(os.environ["RT_WORKER_ID"]),
+    )
+    worker_mod.global_worker = cw
+    await cw.start_worker_async()
+    await asyncio.Event().wait()
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {os.getpid()}] %(levelname)s %(message)s")
+    try:
+        asyncio.run(_amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

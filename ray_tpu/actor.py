@@ -1,0 +1,132 @@
+"""Actor classes and handles.
+
+Reference: python/ray/actor.py — ActorClass (:377) with _remote (:657)
+registering with the GCS, ActorHandle (:1020) submitting ordered method
+calls directly to the actor process.
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs,
+                                    self._num_returns, {})
+
+    def options(self, **opts):
+        handle, name = self._handle, self._name
+        default_num_returns = self._num_returns
+
+        class _Optioned:
+            def remote(self, *args, **kwargs):
+                num_returns = opts.get("num_returns", default_num_returns)
+                return handle._invoke(name, args, kwargs, num_returns, opts)
+
+        return _Optioned()
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "",
+                 method_meta: dict | None = None, addr=None,
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_meta = method_meta or {}
+        self._addr = addr
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        num_returns = self._method_meta.get(name, {}).get("num_returns", 1)
+        return ActorMethod(self, name, num_returns)
+
+    def _invoke(self, method, args, kwargs, num_returns, opts):
+        w = worker_mod.global_worker
+        opts = dict(opts)
+        opts.setdefault("max_task_retries", self._max_task_retries)
+        refs = w.submit_actor_task(self._actor_id, self._addr, method, args,
+                                   kwargs, num_returns=num_returns, opts=opts)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def _ray_actor_id(self):
+        return self._actor_id
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_meta, None))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:16]})"
+
+
+class ActorClass:
+    def __init__(self, cls, **default_opts):
+        self._cls = cls
+        self._default_opts = default_opts
+        self._class_id = None
+        self._exported_by = None
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            f"directly. Use '{self._cls.__name__}.remote()'.")
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_opts)
+
+    def options(self, **opts):
+        merged = {**self._default_opts, **opts}
+        parent = self
+
+        class _Optioned:
+            def remote(self, *args, **kwargs):
+                return parent._remote(args, kwargs, merged)
+
+        return _Optioned()
+
+    def _remote(self, args, kwargs, opts):
+        w = worker_mod.global_worker
+        if w is None or not w.connected:
+            raise RuntimeError("ray_tpu.init() must be called first")
+        if self._class_id is None or self._exported_by is not w:
+            self._class_id = w.export_function(self._cls)
+            self._exported_by = w
+        opts = dict(opts)
+        opts.setdefault("class_name", self._cls.__name__)
+        actor_id = w.create_actor(self._class_id, args, kwargs, opts)
+        meta = {}
+        for name in dir(self._cls):
+            m = getattr(self._cls, name, None)
+            if callable(m) and hasattr(m, "_num_returns"):
+                meta[name] = {"num_returns": m._num_returns}
+        return ActorHandle(actor_id, self._cls.__name__, meta,
+                           max_task_retries=opts.get("max_task_retries", 0))
+
+    @property
+    def bind(self):
+        from ray_tpu.dag.class_node import ClassNode
+
+        def _bind(*args, **kwargs):
+            return ClassNode(self._cls, args, kwargs, self._default_opts)
+        return _bind
+
+
+def method(num_returns=1):
+    """Decorator for actor methods declaring multiple returns (reference:
+    python/ray/actor.py ray.method)."""
+    def decorator(m):
+        m._num_returns = num_returns
+        return m
+    return decorator

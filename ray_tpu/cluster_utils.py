@@ -1,0 +1,99 @@
+"""Multi-node-in-one-process test cluster.
+
+Reference: python/ray/cluster_utils.py — Cluster.add_node (:99) spawns a
+real raylet with its own resources so distributed scheduling and
+fault-tolerance paths run without any cloud; remove_node (:165) kills it
+mid-run for fault injection.
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.api import _ensure_loop
+from ray_tpu._private.node import InProcessNode, new_session_dir
+
+
+class Cluster:
+    def __init__(self):
+        self.loop = _ensure_loop()
+        self.session_dir = new_session_dir()
+        self.head: InProcessNode | None = None
+        self.nodes: list[InProcessNode] = []
+        self._connected = False
+
+    @property
+    def gcs_addr(self):
+        return self.head.gcs_addr if self.head else None
+
+    @property
+    def address(self) -> str | None:
+        if self.head is None:
+            return None
+        return f"{self.head.gcs_addr[0]}:{self.head.gcs_addr[1]}"
+
+    def add_node(self, num_cpus=1, num_tpus=None, resources=None,
+                 labels=None, object_store_memory=None, node_name=None):
+        head = self.head is None
+        node = InProcessNode(
+            self.loop, head=head,
+            gcs_addr=None if head else self.head.gcs_addr,
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            labels=labels, object_store_memory=object_store_memory,
+            session_dir=self.session_dir, node_name=node_name).start()
+        if head:
+            self.head = node
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: InProcessNode):
+        """Kill a raylet mid-run (fault injection; reference:
+        cluster_utils.py:165)."""
+        node.kill(stop_gcs=False)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def connect(self, **kwargs):
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.worker import CoreWorker, MODE_DRIVER
+        import asyncio
+        if self.head is None:
+            raise RuntimeError("add a head node first")
+        raylet = self.head.raylet
+        cw = CoreWorker(MODE_DRIVER, self.head.gcs_addr,
+                        raylet_addr=self.head.raylet_addr,
+                        store_path=raylet.store_path,
+                        store_cap=raylet.store_capacity)
+        cw.loop = self.loop
+        asyncio.run_coroutine_threadsafe(cw._connect(), self.loop).result(60)
+        cw.connected = True
+        worker_mod.global_worker = cw
+        self._connected = True
+        return cw
+
+    def wait_for_nodes(self, count=None, timeout=60.0):
+        import asyncio
+        from ray_tpu._private import protocol
+
+        count = count if count is not None else len(self.nodes)
+
+        async def _wait():
+            conn = await protocol.Connection.connect(
+                self.head.gcs_addr[0], self.head.gcs_addr[1], name="waiter")
+            ok = await conn.request("wait_for_nodes",
+                                    {"count": count, "timeout": timeout})
+            await conn.close()
+            return ok
+
+        return asyncio.run_coroutine_threadsafe(
+            _wait(), self.loop).result(timeout + 10)
+
+    def shutdown(self):
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+        if self._connected and worker_mod.global_worker is not None:
+            worker_mod.global_worker.shutdown()
+            worker_mod.global_worker = None
+        for node in list(reversed(self.nodes)):
+            node.kill(stop_gcs=node is self.head)
+        self.nodes.clear()
+        self.head = None

@@ -1,0 +1,91 @@
+"""User-facing exception types.
+
+Reference: python/ray/exceptions.py — RayError hierarchy (RayTaskError
+wrapping the remote exception + traceback, RayActorError, GetTimeoutError,
+ObjectLostError, WorkerCrashedError).
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get().
+
+    Carries the remote traceback text like the reference's RayTaskError
+    (python/ray/exceptions.py:46)."""
+
+    def __init__(self, cause_repr: str, traceback_str: str = "",
+                 cause: BaseException | None = None):
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"{cause_repr}\n{traceback_str}")
+
+    def as_instanceof_cause(self):
+        if isinstance(self.cause, Exception):
+            return _wrap_cause(self.cause, self.traceback_str)
+        return self
+
+
+def _wrap_cause(cause: Exception, tb: str):
+    """Return an exception that is an instance of the original cause's type
+    AND of TaskError, so `except ValueError` works on the caller."""
+    cause_cls = type(cause)
+    if isinstance(cause, TaskError):
+        return cause
+    try:
+        derived = type("TaskError_" + cause_cls.__name__, (TaskError, cause_cls), {
+            "__init__": lambda self: None,
+        })
+        exc = derived()
+        exc.cause = cause
+        exc.cause_repr = repr(cause)
+        exc.traceback_str = tb
+        exc.args = (f"{cause!r}\nRemote traceback:\n{tb}",)
+        return exc
+    except TypeError:
+        return TaskError(repr(cause), tb, cause)
+
+
+class ActorError(RayTpuError):
+    """The actor died before or during this method call (reference:
+    RayActorError)."""
+
+    def __init__(self, actor_id=None, cause: str = "actor died"):
+        self.actor_id = actor_id
+        super().__init__(f"Actor {actor_id} unavailable: {cause}")
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str, cause: str = ""):
+        super().__init__(f"Object {object_id_hex} lost: {cause}")
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+# Backwards-compatible aliases matching reference names.
+RayError = RayTpuError
+RayTaskError = TaskError
+RayActorError = ActorError

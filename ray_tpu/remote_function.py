@@ -1,0 +1,61 @@
+"""@ray_tpu.remote for functions.
+
+Reference: python/ray/remote_function.py:35 RemoteFunction with _remote
+(:231) resolving options and submitting through the core worker.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ray_tpu._private import worker as worker_mod
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_opts):
+        self._function = fn
+        self._default_opts = default_opts
+        self._fn_id = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._function.__name__}' cannot be called "
+            f"directly. Use '{self._function.__name__}.remote()'.")
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_opts)
+
+    def options(self, **opts):
+        merged = {**self._default_opts, **opts}
+        parent = self
+
+        class _Optioned:
+            def remote(self, *args, **kwargs):
+                return parent._remote(args, kwargs, merged)
+
+        return _Optioned()
+
+    def _remote(self, args, kwargs, opts):
+        w = worker_mod.global_worker
+        if w is None or not w.connected:
+            raise RuntimeError("ray_tpu.init() must be called first")
+        if self._fn_id is None or self._exported_by is not w:
+            self._fn_id = w.export_function(self._function)
+            self._exported_by = w
+        num_returns = opts.get("num_returns", 1)
+        refs = w.submit_task(self._fn_id, args, kwargs, dict(opts))
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    _exported_by = None
+
+    @property
+    def bind(self):
+        from ray_tpu.dag.function_node import FunctionNode
+
+        def _bind(*args, **kwargs):
+            return FunctionNode(self._function, args, kwargs,
+                                self._default_opts)
+        return _bind

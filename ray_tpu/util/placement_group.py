@@ -1,0 +1,80 @@
+"""Placement groups: gang scheduling of resource bundles.
+
+Reference: python/ray/util/placement_group.py:127 placement_group() with
+strategies PACK/SPREAD/STRICT_PACK/STRICT_SPREAD (:129-145); backed by the
+GCS placement-group manager's 2-phase reservation.  TPU-era addition: TPU
+bundles are placed on contiguous ICI sub-meshes (see _private/placement.py).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles=None):
+        self.id = pg_id
+        self._bundles = bundles or []
+
+    @property
+    def bundle_specs(self):
+        return list(self._bundles)
+
+    @property
+    def bundle_count(self):
+        return len(self._bundles)
+
+    def ready(self) -> ObjectRef:
+        """Returns an ObjectRef resolved when the PG is created (reference:
+        PlacementGroup.ready())."""
+        from ray_tpu import remote_function
+        pg = self
+
+        def _pg_ready():
+            import ray_tpu
+            ok = ray_tpu.wait_placement_group_ready(pg, timeout=120)
+            if not ok:
+                raise TimeoutError("placement group not ready")
+            return True
+
+        fn = remote_function.RemoteFunction(_pg_ready, num_cpus=0)
+        return fn.remote()
+
+    def wait(self, timeout_seconds: float = 60.0) -> bool:
+        import ray_tpu
+        return ray_tpu.wait_placement_group_ready(self, timeout_seconds)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self._bundles))
+
+
+def placement_group(bundles, strategy: str = "PACK", name: str = "",
+                    lifetime=None) -> PlacementGroup:
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"invalid placement strategy {strategy}")
+    pg_id = PlacementGroupID.from_random()
+    w._run(w.gcs.request("create_placement_group", {
+        "pg_id": pg_id, "bundles": list(bundles), "strategy": strategy,
+        "name": name, "job_id": w.job_id}))
+    return PlacementGroup(pg_id, list(bundles))
+
+
+def remove_placement_group(pg: PlacementGroup):
+    w = worker_mod.global_worker
+    w._run(w.gcs.request("remove_placement_group", {"pg_id": pg.id}))
+
+
+def get_placement_group_state(pg: PlacementGroup):
+    w = worker_mod.global_worker
+    view = w._run(w.gcs.request("get_placement_group", {"pg_id": pg.id}))
+    return view
+
+
+def placement_group_table():
+    w = worker_mod.global_worker
+    return w._run(w.gcs.request("list_placement_groups", {}))

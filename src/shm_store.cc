@@ -1,0 +1,340 @@
+// Shared-memory object store: the TPU-era equivalent of the reference's
+// plasma store (reference: src/ray/object_manager/plasma/store.h:55,
+// object_lifecycle_manager.h:101, eviction_policy.h:105/:160, dlmalloc.cc).
+//
+// Design: the raylet process owns this library; it manages an allocation
+// arena that lives in a file under /dev/shm which every worker on the node
+// mmaps.  Clients create/seal/get objects via raylet RPC (metadata only);
+// object bytes are written/read directly through the shared mapping --
+// zero-copy on both ends, like plasma.  The allocator is a first-fit
+// free-list with coalescing (the reference vendors dlmalloc; a free list is
+// sufficient because objects are large -- small objects are inlined in the
+// owner memory store and never reach here).  Eviction is LRU over sealed,
+// unpinned objects (reference: eviction_policy.h LRUCache).
+//
+// Exposed as a plain C API for ctypes binding (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <list>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ObjectId {
+  uint8_t data[16];
+  bool operator==(const ObjectId& o) const {
+    return std::memcmp(data, o.data, 16) == 0;
+  }
+};
+
+struct ObjectIdHash {
+  size_t operator()(const ObjectId& id) const {
+    size_t h;
+    std::memcpy(&h, id.data, sizeof(h));
+    return h;
+  }
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Entry {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  bool pending_delete = false;  // freed once the last pin releases
+  int64_t refcount = 0;  // pins by clients; evictable only at 0
+  std::list<ObjectId>::iterator lru_it;
+  bool in_lru = false;
+};
+
+class Store {
+ public:
+  Store(const char* path, uint64_t capacity) : capacity_(capacity), path_(path) {
+    fd_ = ::open(path, O_RDWR | O_CREAT, 0600);
+    if (fd_ < 0) return;
+    if (::ftruncate(fd_, (off_t)capacity) != 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    base_ = ::mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd_, 0);
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    free_list_.push_back({0, capacity});
+  }
+
+  ~Store() {
+    if (base_) ::munmap(base_, capacity_);
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return base_ != nullptr; }
+
+  // 0 ok; -1 OOM (even after eviction); -2 already exists.
+  int Alloc(const ObjectId& id, uint64_t size, uint64_t* offset_out) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (objects_.count(id)) return -2;
+    uint64_t off;
+    if (!AllocFrom(size, &off)) {
+      // Evict LRU sealed+unpinned objects until it fits.
+      while (!lru_.empty()) {
+        EvictOneLocked();
+        if (AllocFrom(size, &off)) goto done;
+      }
+      return -1;
+    }
+  done:
+    Entry e;
+    e.offset = off;
+    e.size = size;
+    e.refcount = 1;  // creator holds a pin until seal+release
+    objects_.emplace(id, e);
+    used_ += size;
+    *offset_out = off;
+    return 0;
+  }
+
+  int Seal(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    it->second.sealed = true;
+    return 0;
+  }
+
+  // sealed_out=1 when ready. Pins the object (refcount+1) when found+sealed.
+  int Get(const ObjectId& id, uint64_t* offset, uint64_t* size, int* sealed_out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end() || it->second.pending_delete) return -1;
+    *offset = it->second.offset;
+    *size = it->second.size;
+    *sealed_out = it->second.sealed ? 1 : 0;
+    if (it->second.sealed) {
+      Pin(it->second, id);
+    }
+    return 0;
+  }
+
+  int Release(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    Entry& e = it->second;
+    if (e.refcount > 0) e.refcount--;
+    if (e.refcount == 0) {
+      if (e.pending_delete) {
+        FreeEntryLocked(it);
+        return 0;
+      }
+      if (e.sealed && !e.in_lru) {
+        lru_.push_front(id);
+        e.lru_it = lru_.begin();
+        e.in_lru = true;
+      }
+    }
+    return 0;
+  }
+
+  // Deferred delete: while clients hold pins (live mmap views), only mark;
+  // the region returns to the free list when the last pin releases
+  // (reference: plasma objects are freed only when no client maps them).
+  int Delete(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    if (it->second.refcount > 0) {
+      it->second.pending_delete = true;
+      if (it->second.in_lru) {
+        lru_.erase(it->second.lru_it);
+        it->second.in_lru = false;
+      }
+      return 0;
+    }
+    FreeEntryLocked(it);
+    return 0;
+  }
+
+  int Contains(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    return (it != objects_.end() && it->second.sealed &&
+            !it->second.pending_delete) ? 1 : 0;
+  }
+
+  uint64_t Used() {
+    std::lock_guard<std::mutex> g(mu_);
+    return used_;
+  }
+  uint64_t Capacity() const { return capacity_; }
+
+  int EvictBytes(uint64_t target) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t freed = 0;
+    while (freed < target && !lru_.empty()) {
+      auto it = objects_.find(lru_.back());
+      if (it == objects_.end()) {
+        lru_.pop_back();
+        continue;
+      }
+      freed += it->second.size;
+      FreeEntryLocked(it);
+    }
+    return (int)(freed >= target);
+  }
+
+ private:
+  void Pin(Entry& e, const ObjectId& id) {
+    e.refcount++;
+    if (e.in_lru) {
+      lru_.erase(e.lru_it);
+      e.in_lru = false;
+    }
+  }
+
+  void EvictOneLocked() {
+    while (!lru_.empty()) {
+      auto it = objects_.find(lru_.back());
+      if (it == objects_.end()) {
+        lru_.pop_back();
+        continue;
+      }
+      FreeEntryLocked(it);
+      return;
+    }
+  }
+
+  void FreeEntryLocked(std::unordered_map<ObjectId, Entry, ObjectIdHash>::iterator it) {
+    Entry& e = it->second;
+    if (e.in_lru) lru_.erase(e.lru_it);
+    used_ -= e.size;
+    FreeBlockInsert({e.offset, e.size});
+    objects_.erase(it);
+  }
+
+  bool AllocFrom(uint64_t size, uint64_t* off) {
+    // round to 64B so successive objects stay cache-line aligned
+    uint64_t asize = (size + 63) & ~uint64_t(63);
+    if (asize == 0) asize = 64;
+    for (auto it = free_list_.begin(); it != free_list_.end(); ++it) {
+      if (it->size >= asize) {
+        *off = it->offset;
+        if (it->size == asize) {
+          free_list_.erase(it);
+        } else {
+          it->offset += asize;
+          it->size -= asize;
+        }
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void FreeBlockInsert(FreeBlock blk) {
+    // keep the free list sorted by offset and coalesce neighbours
+    blk.size = (blk.size + 63) & ~uint64_t(63);
+    if (blk.size == 0) blk.size = 64;
+    auto it = free_list_.begin();
+    while (it != free_list_.end() && it->offset < blk.offset) ++it;
+    if (it != free_list_.begin()) {
+      auto prev = std::prev(it);
+      if (prev->offset + prev->size == blk.offset) {
+        prev->size += blk.size;
+        if (it != free_list_.end() && prev->offset + prev->size == it->offset) {
+          prev->size += it->size;
+          free_list_.erase(it);
+        }
+        return;
+      }
+    }
+    if (it != free_list_.end() && blk.offset + blk.size == it->offset) {
+      it->offset = blk.offset;
+      it->size += blk.size;
+      return;
+    }
+    free_list_.insert(it, blk);
+  }
+
+  std::mutex mu_;
+  int fd_ = -1;
+  void* base_ = nullptr;
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  std::string path_;
+  std::list<FreeBlock> free_list_;
+  std::unordered_map<ObjectId, Entry, ObjectIdHash> objects_;
+  std::list<ObjectId> lru_;
+};
+
+ObjectId MakeId(const uint8_t* id) {
+  ObjectId o;
+  std::memcpy(o.data, id, 16);
+  return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* store_create(const char* path, uint64_t capacity) {
+  Store* s = new Store(path, capacity);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void store_destroy(void* h) { delete static_cast<Store*>(h); }
+
+int store_alloc(void* h, const uint8_t* id, uint64_t size, uint64_t* offset_out) {
+  return static_cast<Store*>(h)->Alloc(MakeId(id), size, offset_out);
+}
+
+int store_seal(void* h, const uint8_t* id) {
+  return static_cast<Store*>(h)->Seal(MakeId(id));
+}
+
+int store_get(void* h, const uint8_t* id, uint64_t* offset, uint64_t* size,
+              int* sealed) {
+  return static_cast<Store*>(h)->Get(MakeId(id), offset, size, sealed);
+}
+
+int store_release(void* h, const uint8_t* id) {
+  return static_cast<Store*>(h)->Release(MakeId(id));
+}
+
+int store_delete(void* h, const uint8_t* id) {
+  return static_cast<Store*>(h)->Delete(MakeId(id));
+}
+
+int store_contains(void* h, const uint8_t* id) {
+  return static_cast<Store*>(h)->Contains(MakeId(id));
+}
+
+uint64_t store_used(void* h) { return static_cast<Store*>(h)->Used(); }
+
+uint64_t store_capacity(void* h) { return static_cast<Store*>(h)->Capacity(); }
+
+int store_evict(void* h, uint64_t bytes) {
+  return static_cast<Store*>(h)->EvictBytes(bytes);
+}
+
+}  // extern "C"

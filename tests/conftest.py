@@ -1,0 +1,46 @@
+"""Test fixtures: in-process multi-node clusters, CPU-pinned jax.
+
+Reference test strategy (SURVEY.md §4): real multi-raylet clusters inside
+one process (reference: python/ray/tests/conftest.py:235 ray_start_regular,
+:316 ray_start_cluster over cluster_utils.Cluster.add_node).
+"""
+
+import os
+
+# Pin jax to an 8-device virtual CPU host platform BEFORE anything
+# initializes a backend: tests must never dial the real TPU tunnel.
+os.environ["RT_DISABLE_TPU_DETECTION"] = "1"
+os.environ["RT_NUM_CPUS"] = os.environ.get("RT_NUM_CPUS", "4")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    from ray_tpu._private.jax_utils import ensure_cpu
+    ensure_cpu(8)
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.cluster_utils import Cluster  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A fresh single-node cluster + connected driver."""
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-raylet in-process cluster factory (reference:
+    conftest.py:316 _ray_start_cluster)."""
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()

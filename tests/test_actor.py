@@ -1,0 +1,134 @@
+"""Actor tests (reference model: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_actor_basic(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get([c.incr.remote() for _ in range(5)],
+                       timeout=120) == [1, 2, 3, 4, 5]
+
+
+def test_actor_init_args(ray_start_regular):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, a, b=10):
+            self.v = a + b
+
+        def read(self):
+            return self.v
+
+    h = Holder.remote(5, b=20)
+    assert ray_tpu.get(h.read.remote(), timeout=120) == 25
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc-test").remote()
+    h = ray_tpu.get_actor("svc-test")
+    assert ray_tpu.get(h.ping.remote(), timeout=120) == "pong"
+
+
+def test_actor_init_failure_surfaces(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("init boom")
+
+        def f(self):
+            return 1
+
+    h = Bad.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(h.f.remote(), timeout=120)
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return 1
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=120) == 1
+    ray_tpu.kill(v)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.ActorError):
+        ray_tpu.get(v.ping.remote(), timeout=120)
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Async:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x + 1
+
+    a = Async.remote()
+    assert ray_tpu.get([a.work.remote(i) for i in range(4)],
+                       timeout=120) == [1, 2, 3, 4]
+
+
+def test_mixed_sync_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Mixed:
+        def __init__(self):
+            self.state = 7
+
+        async def poll(self):
+            return "async"
+
+        def read(self):
+            return self.state
+
+    m = Mixed.remote()
+    assert ray_tpu.get(m.poll.remote(), timeout=120) == "async"
+    assert ray_tpu.get(m.read.remote(), timeout=120) == 7
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Fragile:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+            return os.getpid()
+
+        def die(self):
+            import os
+            os._exit(1)
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+    f = Fragile.remote()
+    pid1 = ray_tpu.get(f.pid.remote(), timeout=120)
+    f.die.remote()
+    time.sleep(1.0)
+    # After restart, state is fresh and the pid differs.
+    n = ray_tpu.get(f.ping.remote(), timeout=120)
+    assert n == 1
+    pid2 = ray_tpu.get(f.pid.remote(), timeout=120)
+    assert pid2 != pid1

@@ -1,0 +1,117 @@
+"""Core task/object API tests (reference model: python/ray/tests/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_task_roundtrip(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_task_batch(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(20)]
+
+
+def test_put_get_small(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=30) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_zero_copy(ray_start_regular):
+    arr = np.arange(500_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=60)
+    assert np.array_equal(out, arr)
+
+
+def test_object_ref_as_arg(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    arr = np.ones(300_000)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(double.remote(ref), timeout=60)
+    assert np.array_equal(out, arr * 2)
+
+
+def test_nested_object_ref_passthrough(ray_start_regular):
+    """Refs nested inside containers are NOT resolved (reference semantics)."""
+    @ray_tpu.remote
+    def inspect(d):
+        return type(d["ref"]).__name__
+
+    ref = ray_tpu.put(5)
+    assert ray_tpu.get(inspect.remote({"ref": ref}), timeout=60) == "ObjectRef"
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    assert ray_tpu.get(r1, timeout=60) == 1
+    assert ray_tpu.get(r2, timeout=60) == 2
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        import time
+        time.sleep(30)
+        return 2
+
+    refs = [fast.remote(), slow.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=25)
+    assert len(ready) == 1
+    assert len(not_ready) == 1
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def fib(n):
+        if n < 2:
+            return n
+        return sum(ray_tpu.get([fib.remote(n - 1), fib.remote(n - 2)]))
+
+    assert ray_tpu.get(fib.remote(4), timeout=180) == 3
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        import time
+        time.sleep(600)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=3)
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 4
