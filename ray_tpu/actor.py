@@ -44,7 +44,10 @@ class ActorHandle:
         self._max_task_retries = max_task_retries
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        # Underscored names fail fast (pickle/copy/display protocol probes
+        # must not see phantom methods) — except the framework's own "_rt_"
+        # actor-method namespace (e.g. CollectiveMixin._rt_init_collective).
+        if name.startswith("_") and not name.startswith("_rt_"):
             raise AttributeError(name)
         num_returns = self._method_meta.get(name, {}).get("num_returns", 1)
         return ActorMethod(self, name, num_returns)

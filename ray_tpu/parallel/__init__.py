@@ -1,0 +1,19 @@
+"""TPU-first parallelism: mesh axes as the unit of scale.
+
+The reference delegates tensor/pipeline/sequence/expert parallelism to user
+frameworks (SURVEY.md §2.4: TP/PP/SP/EP are absent in-tree; its value-add is
+gang scheduling + NCCL groups).  Here they are first-class: a MeshSpec
+declares dp/fsdp/tp/pp/sp/ep axes, sharding rules map parameters and
+activations onto them, and the long-context/pipeline/expert building blocks
+compile to XLA collectives over ICI.
+"""
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: F401
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    logical_to_mesh_axes,
+    shard_params,
+    with_logical_constraint,
+)
+from ray_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from ray_tpu.parallel.pipeline import pipeline_spmd  # noqa: F401
+from ray_tpu.parallel.moe import expert_parallel_moe  # noqa: F401

@@ -1,0 +1,87 @@
+"""Device mesh construction with named parallelism axes.
+
+Axes (any may be 1):
+  dp    data parallel (pure replication of params, sharded batch)
+  fsdp  fully-sharded data parallel (params sharded over this axis too)
+  tp    tensor parallel (attention heads / mlp hidden sharded)
+  pp    pipeline parallel (layer stages)
+  sp    sequence/context parallel (ring attention over sequence shards)
+  ep    expert parallel (MoE experts sharded)
+
+The reference has no analogue — its parallelism stops at gang-scheduled
+process groups (SURVEY.md §2.4).  On TPU the mesh IS the cluster-of-chips
+abstraction: axis order below is chosen so the innermost (fastest-varying)
+axes carry the heaviest collectives and land on ICI neighbours.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclass
+class MeshSpec:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    @property
+    def world_size(self) -> int:
+        return math.prod(self.axis_sizes().values())
+
+    def nontrivial_axes(self) -> tuple[str, ...]:
+        return tuple(a for a in AXIS_ORDER if getattr(self, a) > 1)
+
+    @classmethod
+    def infer(cls, n_devices: int, tp: int = 1, pp: int = 1, sp: int = 1,
+              ep: int = 1, fsdp: int = 1) -> "MeshSpec":
+        """Fill dp with whatever devices remain after the explicit axes."""
+        denom = tp * pp * sp * ep * fsdp
+        if n_devices % denom != 0:
+            raise ValueError(f"{n_devices} devices not divisible by "
+                             f"tp*pp*sp*ep*fsdp={denom}")
+        return cls(dp=n_devices // denom, fsdp=fsdp, tp=tp, pp=pp, sp=sp,
+                   ep=ep)
+
+
+def make_mesh(spec: MeshSpec, devices=None):
+    """Build a jax Mesh laid out so tp (heaviest collective traffic) varies
+    fastest across physically adjacent devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = spec.world_size
+    if len(devices) < n:
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    devices = _ici_order(devices)[:n]
+    shape = tuple(spec.axis_sizes()[a] for a in AXIS_ORDER)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def _ici_order(devices):
+    """Sort devices so consecutive entries are ICI neighbours (by mesh
+    coordinates when the backend exposes them)."""
+    def key(d):
+        coords = getattr(d, "coords", None)
+        if coords is not None:
+            return (getattr(d, "slice_index", 0) or 0, tuple(coords))
+        return (0, (d.id,))
+    return sorted(devices, key=key)
+
+
+def mesh_shape_dict(mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

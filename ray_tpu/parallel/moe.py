@@ -1,0 +1,109 @@
+"""Expert parallelism: MoE layers with experts sharded over the `ep` mesh
+axis and token routing via `lax.all_to_all` (absent from the reference —
+SURVEY.md §2.4 lists EP as delegated/absent).
+
+Dispatch is the capacity-bucketed dense formulation (Switch/GShard style):
+top-1 gating builds a [tokens, experts, capacity] one-hot dispatch tensor,
+tokens travel to their expert's shard with a single all-to-all over `ep`
+(the MoE-heavy collective, which rides ICI), expert MLPs run as one batched
+einsum per shard (MXU-friendly: one big matmul instead of per-expert
+loops), and a second all-to-all brings outputs home.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _moe_sharded(x, gate_w, w_in, w_out, axis_name, capacity_factor):
+    """Per-shard body.  x (tokens) replicated over `ep`; experts sharded:
+    w_in/w_out are the local [E_local, ...] slices.  Every shard computes
+    the (identical) routing, runs only its own experts' buckets, and a
+    single psum recombines token outputs — the collective XLA emits is the
+    reduce over ICI, the EP equivalent of the all-to-all in token-sharded
+    deployments (that variant lands with dp x ep meshes in Train)."""
+    ep = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    e_local = w_in.shape[0]
+    n_exp = e_local * ep
+    b, t, d = x.shape
+    n_tok = b * t
+    xf = x.reshape(n_tok, d)
+
+    logits = xf @ gate_w  # [N, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)
+    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
+
+    capacity = max(1, int(capacity_factor * n_tok / n_exp))
+    onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)  # [N, E]
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)  # [N]
+    keep = pos < capacity
+    # dispatch/combine over the LOCAL expert slice only: [N, E_local, C]
+    local_expert = expert_idx - my * e_local
+    in_local = (local_expert >= 0) & (local_expert < e_local) & keep
+    local_oh = jax.nn.one_hot(jnp.clip(local_expert, 0, e_local - 1),
+                              e_local) * in_local[:, None]
+    dispatch = local_oh[..., None] * jax.nn.one_hot(pos, capacity)[:, None, :]
+    combine = dispatch * gate_val[:, None, None]
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)  # [E_local, C, D]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)  # [E_local, C, D]
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    return lax.psum(y, axis_name).reshape(b, t, d)
+
+
+def expert_parallel_moe(x, gate_w, w_in, w_out, mesh=None,
+                        axis_name: str = "ep",
+                        capacity_factor: float = 2.0):
+    """Top-1 MoE layer with experts sharded over `axis_name`.
+
+    x: [B, T, D] (batch may itself be dp-sharded outside);
+    gate_w: [D, E]; w_in: [E, D, F]; w_out: [E, F, D] with E divisible by
+    the ep axis size.
+    """
+    if mesh is None:
+        return _moe_sharded(x, gate_w, w_in, w_out, axis_name,
+                            capacity_factor)
+    from jax import shard_map
+    fn = shard_map(
+        functools.partial(_moe_sharded, axis_name=axis_name,
+                          capacity_factor=capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name)),
+        out_specs=P())
+    return fn(x, gate_w, w_in, w_out)
+
+
+def reference_moe(x, gate_w, w_in, w_out, capacity_factor: float = 2.0):
+    """Single-device oracle with the same capacity semantics."""
+    return _moe_sharded_single(x, gate_w, w_in, w_out, capacity_factor)
+
+
+def _moe_sharded_single(x, gate_w, w_in, w_out, capacity_factor):
+    b, t, d = x.shape
+    n_exp = w_in.shape[0]
+    n_tok = b * t
+    xf = x.reshape(n_tok, d)
+    logits = xf @ gate_w
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)
+    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
+    capacity = max(1, int(capacity_factor * n_tok / n_exp))
+    onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
+    keep = pos < capacity
+    dispatch = (jax.nn.one_hot(expert_idx, n_exp) * keep[:, None])[..., None] \
+        * jax.nn.one_hot(pos, capacity)[:, None, :]
+    combine = dispatch * gate_val[:, None, None]
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    return y.reshape(b, t, d)

@@ -1,0 +1,100 @@
+"""SPMD pipeline parallelism: layer stages sharded over the `pp` mesh axis,
+microbatches streamed through with `lax.ppermute` (GPipe schedule expressed
+as a collective program, praxis-style — no per-stage processes).
+
+Absent from the reference (SURVEY.md §2.4: no pipeline engine in-tree).
+Each device holds the parameters of its stage.  For M microbatches and S
+stages the loop runs M+S-1 ticks; at tick t stage s computes microbatch
+t-s (when valid) and permutes its activation to stage s+1.  The bubble is
+(S-1)/(M+S-1); compute and the single-hop ICI permute overlap.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _pipeline_sharded(stage_params, x_mb, stage_fn, axis_name):
+    """Body under shard_map.
+
+    stage_params: this stage's params (leading stage dim of size 1 stripped)
+    x_mb: [M, mb, ...] full microbatched input (replicated across pp)
+    Returns [M, mb, ...] outputs (valid on every rank after final psum).
+    """
+    s_size = lax.psum(1, axis_name)
+    s_idx = lax.axis_index(axis_name)
+    n_mb = x_mb.shape[0]
+    ticks = n_mb + s_size - 1
+    perm = [(i, i + 1) for i in range(s_size - 1)]
+
+    stream0 = jnp.zeros_like(x_mb[0])
+    outputs0 = jnp.zeros_like(x_mb)
+    # Stage outputs vary over pp (they depend on the stage's params);
+    # promote the zero-initialized carries to the same varying type.
+    try:
+        stream0 = lax.pcast(stream0, (axis_name,), to="varying")
+        outputs0 = lax.pcast(outputs0, (axis_name,), to="varying")
+    except (AttributeError, TypeError, ValueError):
+        pass
+
+    def tick(carry, t):
+        stream, outputs = carry
+        mb_idx = jnp.clip(t - s_idx, 0, n_mb - 1)
+        inp = jnp.where(s_idx == 0, x_mb[jnp.clip(t, 0, n_mb - 1)], stream)
+        out = stage_fn(stage_params, inp)
+        valid = (t - s_idx >= 0) & (t - s_idx < n_mb)
+        # Last stage records its finished microbatch.
+        rec = valid & (s_idx == s_size - 1)
+        outputs = jnp.where(
+            rec,
+            outputs.at[mb_idx].set(out),
+            outputs)
+        stream_next = lax.ppermute(out, axis_name, perm)
+        return (stream_next, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (stream0, outputs0),
+                               jnp.arange(ticks))
+    # Only the last stage holds real outputs; share them with all stages
+    # (callers usually need the loss everywhere for the backward pass).
+    outputs = jnp.where(s_idx == s_size - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_spmd(stage_fn, stacked_params, x, num_microbatches: int,
+                  mesh=None, axis_name: str = "pp",
+                  params_stage_specs=None):
+    """Run `stage_fn(params, x) -> y` as a pipeline over `axis_name`.
+
+    stacked_params: pytree whose leaves have a leading stage dimension of
+    size S (the pp axis size); each device gets its own stage's slice.
+    x: [batch, ...] global input; split into `num_microbatches`.
+    Output has the same shape as stage_fn's output batched over x.
+    """
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches "
+                         f"{num_microbatches}")
+    x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+    def body(params, xm):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        return _pipeline_sharded(params, xm, stage_fn, axis_name)
+
+    if mesh is None:
+        stripped = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+        out = _pipeline_sharded(stripped, x_mb, stage_fn, axis_name)
+        return out.reshape(b, *out.shape[2:])
+
+    from jax import shard_map
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stacked_params)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P())
+    out = fn(stacked_params, x_mb)
+    return out.reshape(b, *out.shape[2:])

@@ -1,0 +1,120 @@
+"""Ring attention: exact attention over sequence shards with ICI-ring K/V
+rotation (sequence/context parallelism).
+
+Absent from the reference (SURVEY.md §5 long-context: nothing in-tree).
+Design: inside `shard_map` over the `sp` axis each device holds a sequence
+block of Q, K, V.  K/V blocks rotate around the ring via `lax.ppermute`
+(one ICI hop per step, overlapping with the block attention compute, which
+XLA schedules as async collective-permute), while a numerically-stable
+online-softmax accumulator (running max + normalizer, flash-attention
+style) folds in each visited block.  After `sp` steps every Q block has
+attended to the full sequence — memory stays O(T/sp) per device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
+    """Fold one K/V block into the online-softmax accumulator.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
+    o: [B, Tq, H, D]; m, l: [B, H, Tq]
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(tq)
+        k_pos = k_offset + jnp.arange(tk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(scores - m_new[..., None])
+    # Fully-masked rows: p would be exp(-inf - -inf); m_new stays _NEG_INF
+    # and p = exp(scores - _NEG_INF) would overflow — clamp.
+    p = jnp.where((scores <= _NEG_INF / 2) & (m_new[..., None] <= _NEG_INF / 2),
+                  0.0, p)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + \
+        jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o_new, m_new, l_new
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            scale: float):
+    """Per-shard body (runs under shard_map)."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    b, _, h, d = q.shape
+
+    # Accumulators derive from q so their shard_map varying-axis type
+    # matches the per-step updates (scan requires carry types to agree).
+    o = jnp.zeros_like(q, dtype=jnp.float32)
+    m = jnp.full_like(q[..., 0].transpose(0, 2, 1), _NEG_INF,
+                      dtype=jnp.float32)
+    l = jnp.zeros_like(m)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src = (my_idx - i) % axis_size
+        o, m, l = _block_update(
+            q, k_cur, v_cur, o, m, l,
+            q_offset=my_idx * t_local,
+            k_offset=src * t_local,
+            causal=causal, scale=scale)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o, m, l, k, v), jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-20)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name: str = "sp",
+                   causal: bool = True, scale: float | None = None):
+    """Exact attention with sequence sharded over `axis_name`.
+
+    Args are [batch, seq, heads, head_dim]; seq must divide by the axis
+    size.  Called OUTSIDE shard_map (wraps itself), or pass mesh=None and
+    axis_name to use inside an existing shard_map body.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mesh is None:
+        return _ring_attention_sharded(q, k, v, axis_name, causal, scale)
+    from jax import shard_map
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_sharded, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True, scale=None):
+    """Dense single-device attention (test oracle)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v).astype(q.dtype)

@@ -1,0 +1,60 @@
+"""Logical-axis sharding rules: map parameter/activation logical axes onto
+mesh axes, in the flax `logical axis` style but framework-neutral.
+
+Rules follow the standard megatron/fsdp decomposition:
+  embed        -> tp          (vocab-sharded embedding)
+  heads        -> tp          (attention heads)
+  mlp          -> tp          (ffn hidden)
+  layers       -> pp          (stage dimension, when stacked)
+  batch        -> (dp, fsdp)  (activations)
+  seq          -> sp          (activations, long-context)
+  experts      -> ep
+  model params additionally shard their largest remaining dim over fsdp.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+DEFAULT_RULES: dict[str, object] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "heads": "tp",
+    "kv": None,
+    "embed": None,
+    "embed_fsdp": "fsdp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": "pp",
+    "experts": "ep",
+    "stage": "pp",
+}
+
+
+def logical_to_mesh_axes(logical_axes: tuple, rules: dict | None = None):
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    return P(*(rules.get(a) if a is not None else None
+               for a in logical_axes))
+
+
+def with_logical_constraint(x, logical_axes: tuple, mesh=None,
+                            rules: dict | None = None):
+    """Annotate an intermediate with a sharding constraint (inside jit)."""
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(
+        x, spec if mesh is None else NamedSharding(mesh, spec))
+
+
+def shard_params(params, logical_specs, mesh, rules: dict | None = None):
+    """Device-put a pytree of params according to per-leaf logical axes.
+
+    `logical_specs` mirrors `params` with tuples of logical axis names."""
+    def _place(leaf, axes):
+        spec = logical_to_mesh_axes(axes, rules)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(_place, params, logical_specs)
+
+
+def named_sharding(mesh, *axes):
+    return NamedSharding(mesh, P(*axes))

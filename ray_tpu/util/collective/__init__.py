@@ -1,0 +1,15 @@
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    CollectiveMixin,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_group_handle,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.types import ReduceOp  # noqa: F401

@@ -1,0 +1,261 @@
+"""Collective communication across actors/tasks.
+
+TPU-native re-design of the reference collective layer (reference:
+python/ray/util/collective/collective.py — init_collective_group :120,
+allreduce :258, barrier :298, broadcast :373, allgather :423,
+reducescatter :472, send/recv :531/:594; NCCL backend via cupy in
+collective_group/nccl_collective_group.py:127, gloo via pygloo).
+
+On TPU the *tensor* plane never goes through host collectives: gradient
+allreduce etc. compile to XLA collectives over ICI inside jit/pjit (see
+ray_tpu.parallel).  What remains for the framework plane — rendezvous,
+barriers, CPU-side state sync (e.g. RL rollout weights), cross-host
+control — is served here by a coordinator actor per group (the reference's
+gloo/NCCL rendezvous also rides a named store actor).  Members address the
+group by name; the coordinator performs reductions on host numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util.collective.types import ReduceOp
+
+_groups: dict[str, "GroupMember"] = {}
+
+_COORD_PREFIX = "_rt_collective_coord::"
+
+
+def _reduce(arrays, op: ReduceOp):
+    out = np.array(arrays[0], copy=True)
+    for a in arrays[1:]:
+        if op == ReduceOp.SUM:
+            out = out + a
+        elif op == ReduceOp.PRODUCT:
+            out = out * a
+        elif op == ReduceOp.MIN:
+            out = np.minimum(out, a)
+        elif op == ReduceOp.MAX:
+            out = np.maximum(out, a)
+    return out
+
+
+class _Coordinator:
+    """Async actor implementing barrier-synchronized group ops.  One per
+    collective group, named, owned by whichever member created it first.
+
+    Reductions happen ONCE here and only the result travels to each member
+    (O(world) transfer per op, not O(world^2))."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+        self.world_size = world_size
+        self._rounds: dict = {}
+        self._results: dict = {}
+        self._cond = asyncio.Condition()
+        self._mailbox: dict = {}
+
+    async def collect(self, mode, round_id, rank, data):
+        """mode: "reduce:<op>" | "gather" | "src:<rank>" | "barrier"."""
+        key = (mode, round_id)
+        async with self._cond:
+            slot = self._rounds.setdefault(key, {})
+            slot[rank] = data
+            self._cond.notify_all()
+            while len(self._rounds.get(key, slot)) < self.world_size and \
+                    key not in self._results:
+                await self._cond.wait()
+            if key not in self._results:
+                full = self._rounds[key]
+                if mode.startswith("reduce:"):
+                    op = ReduceOp(mode.split(":", 1)[1])
+                    result = _reduce([full[r] for r in sorted(full)], op)
+                elif mode == "gather":
+                    result = [full[r] for r in sorted(full)]
+                elif mode.startswith("src:"):
+                    result = full[int(mode.split(":", 1)[1])]
+                else:
+                    result = True
+                self._results[key] = result
+            # Last reader cleans the round up.
+            reads = self._rounds.setdefault(("_reads",) + key, set())
+            reads.add(rank)
+            result = self._results[key]
+            if len(reads) == self.world_size:
+                self._rounds.pop(key, None)
+                self._rounds.pop(("_reads",) + key, None)
+                self._results.pop(key, None)
+            return result
+
+    async def put_mail(self, tag, data):
+        import asyncio
+        box = self._mailbox.setdefault(tag, asyncio.Queue())
+        await box.put(data)
+        return True
+
+    async def get_mail(self, tag):
+        import asyncio
+        box = self._mailbox.setdefault(tag, asyncio.Queue())
+        return await box.get()
+
+
+class GroupMember:
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._round = 0
+        coord_name = _COORD_PREFIX + group_name
+        try:
+            self.coord = ray_tpu.get_actor(coord_name)
+        except ValueError:
+            try:
+                coord_cls = ray_tpu.remote(_Coordinator)
+                self.coord = coord_cls.options(
+                    name=coord_name, num_cpus=0).remote(world_size)
+            except ValueError:
+                self.coord = ray_tpu.get_actor(coord_name)
+
+    def _next_round(self):
+        self._round += 1
+        return self._round
+
+    def collect(self, mode, value):
+        import os
+        rid = self._next_round()
+        timeout = float(os.environ.get("RT_COLLECTIVE_TIMEOUT_S", "3600"))
+        return ray_tpu.get(
+            self.coord.collect.remote(mode, rid, self.rank, value),
+            timeout=timeout)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "tcp",
+                          group_name: str = "default") -> None:
+    """Join this process to a named collective group (reference:
+    collective.py:120)."""
+    if group_name in _groups:
+        raise RuntimeError(f"already in collective group '{group_name}'")
+    _groups[group_name] = GroupMember(group_name, world_size, rank)
+
+
+def create_collective_group(actors, world_size: int, ranks: list[int],
+                            backend: str = "tcp",
+                            group_name: str = "default"):
+    """Declare a group across actor handles from the driver (reference:
+    collective.py declare_collective_group): calls init on each member."""
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor._rt_init_collective.remote(
+            world_size, rank, backend, group_name))
+    ray_tpu.get(refs, timeout=300)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Leave the group and tear down its coordinator actor so the name can
+    be reused with a different world size."""
+    g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray_tpu.kill(g.coord)
+        except Exception:
+            pass
+
+
+def get_group_handle(group_name: str = "default") -> GroupMember:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"not a member of collective group '{group_name}'; call "
+            f"init_collective_group first")
+    return g
+
+
+def _as_numpy(tensor):
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    """In-place allreduce of a host tensor across the group (reference:
+    collective.py:258).  Device tensors are fetched to host; for on-device
+    gradient reduction use XLA collectives via ray_tpu.parallel instead."""
+    g = get_group_handle(group_name)
+    out = g.collect(f"reduce:{op.value}", _as_numpy(tensor))
+    try:
+        tensor[...] = out
+        return tensor
+    except TypeError:
+        return out
+
+
+def allgather(tensor_list: list, tensor, group_name: str = "default"):
+    """Gather each rank's tensor into tensor_list (reference: :423)."""
+    g = get_group_handle(group_name)
+    gathered = g.collect("gather", _as_numpy(tensor))
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(gathered)
+    return gathered
+
+
+def reducescatter(tensor, tensor_list: list, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    """Reduce the per-rank lists elementwise; each rank keeps its slice
+    (reference: :472)."""
+    g = get_group_handle(group_name)
+    reduced = g.collect(f"reduce:{op.value}",
+                        np.stack([_as_numpy(t) for t in tensor_list]))
+    out = reduced[g.rank]
+    try:
+        tensor[...] = out
+        return tensor
+    except TypeError:
+        return out
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Broadcast from src_rank (reference: :373)."""
+    g = get_group_handle(group_name)
+    payload = _as_numpy(tensor) if g.rank == src_rank else None
+    out = g.collect(f"src:{src_rank}", payload)
+    try:
+        tensor[...] = out
+        return tensor
+    except TypeError:
+        return out
+
+
+def barrier(group_name: str = "default"):
+    """Block until every member arrives (reference: :298)."""
+    g = get_group_handle(group_name)
+    g.collect("barrier", None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    """Point-to-point send (reference: :531)."""
+    g = get_group_handle(group_name)
+    tag = f"{group_name}:{g.rank}->{dst_rank}"
+    ray_tpu.get(g.coord.put_mail.remote(tag, _as_numpy(tensor)), timeout=300)
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    """Point-to-point recv (reference: :594)."""
+    g = get_group_handle(group_name)
+    tag = f"{group_name}:{src_rank}->{g.rank}"
+    out = ray_tpu.get(g.coord.get_mail.remote(tag), timeout=300)
+    try:
+        tensor[...] = out
+        return tensor
+    except TypeError:
+        return out
+
+
+class CollectiveMixin:
+    """Mixin for actor classes whose instances join collective groups via
+    create_collective_group from the driver."""
+
+    def _rt_init_collective(self, world_size, rank, backend, group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return True

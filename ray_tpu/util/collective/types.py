@@ -1,0 +1,22 @@
+"""Collective types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+class Backend:
+    # In-slice tensor collectives compile to XLA collectives over ICI inside
+    # jit/shard_map — they are not routed through this actor-plane backend.
+    # This backend ("tcp") is the CPU/control-plane equivalent of the
+    # reference's gloo path; "xla" marks in-graph use.
+    TCP = "tcp"
+    XLA = "xla"
+    NIL = "nil"

@@ -1,0 +1,55 @@
+"""Collective group tests across actors (reference model:
+python/ray/util/collective/tests)."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+def test_allreduce_and_broadcast_across_actors(ray_start_regular):
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def __init__(self, rank):
+            self.rank = rank
+
+        def do_allreduce(self):
+            x = np.full((4,), float(self.rank + 1))
+            out = col.allreduce(x, group_name="g1")
+            return out
+
+        def do_broadcast(self):
+            x = np.full((3,), float(self.rank * 100))
+            return col.broadcast(x, src_rank=1, group_name="g1")
+
+        def do_barrier(self):
+            col.barrier(group_name="g1")
+            return True
+
+        def do_sendrecv(self):
+            if self.rank == 0:
+                col.send(np.array([42.0]), dst_rank=1, group_name="g1")
+                return None
+            buf = np.zeros(1)
+            col.recv(buf, src_rank=0, group_name="g1")
+            return buf
+
+    members = [Member.remote(i) for i in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="g1")
+
+    outs = ray_tpu.get([m.do_allreduce.remote() for m in members],
+                       timeout=300)
+    for out in outs:
+        np.testing.assert_array_equal(out, np.full((4,), 3.0))
+
+    outs = ray_tpu.get([m.do_broadcast.remote() for m in members],
+                       timeout=300)
+    for out in outs:
+        np.testing.assert_array_equal(out, np.full((3,), 100.0))
+
+    assert ray_tpu.get([m.do_barrier.remote() for m in members],
+                       timeout=300) == [True, True]
+
+    outs = ray_tpu.get([m.do_sendrecv.remote() for m in members],
+                       timeout=300)
+    np.testing.assert_array_equal(outs[1], np.array([42.0]))
