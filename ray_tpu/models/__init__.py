@@ -1,0 +1,16 @@
+"""Model zoo for the TPU-native framework.
+
+The flagship is a decoder-only transformer (models/gpt.py) whose single
+train step composes every first-class parallelism axis (dp/fsdp/tp/pp/sp/ep
+— SURVEY.md §2.4: all absent from the reference, first-class here).
+"""
+
+from ray_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    init_params,
+    forward,
+    loss_fn,
+    train_step,
+    make_train_state,
+    param_specs,
+)

@@ -1,0 +1,374 @@
+"""Flagship decoder-only transformer, SPMD-sharded over every mesh axis.
+
+One train step composes the full parallelism inventory (SURVEY.md §2.4 —
+all absent in the reference, first-class here):
+
+  dp / fsdp  batch sharding (+ ZeRO-style parameter sharding: params are
+             stored fsdp-sharded and all-gathered just-in-time inside the
+             block body; the shard_map transpose turns the gather into a
+             reduce-scatter of the gradients)
+  tp         megatron-style: attention heads and ffn hidden sharded; one
+             psum per residual branch rides ICI
+  pp         GPipe pipeline expressed as a collective program: stages are
+             the pp-shards of the stacked layer parameters, microbatch
+             activations hop stages via lax.ppermute
+  sp         ring attention (parallel/ring_attention.py): K/V blocks rotate
+             the sp ring with online-softmax accumulation — exact attention
+             with O(T/sp) memory
+  ep         MoE ffn with experts sharded over ep, combined with a single
+             psum over (ep, tp)
+
+The whole block stack runs inside ONE shard_map island over the full mesh;
+embedding/unembedding stay at the GSPMD level (vocab sharded over tp) so
+XLA inserts the input/output collectives.  bfloat16 compute on the MXU,
+fp32 params/optimizer/logits.  `mesh=None` runs the identical math on a
+single device (collectives become no-ops) — that is the driver's
+single-chip `entry()` path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.ring_attention import (
+    _ring_attention_sharded,
+    reference_attention,
+)
+
+BATCH_AXES = ("dp", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    n_experts: int = 0          # 0 = dense ffn; >0 = MoE sharded over ep
+    capacity_factor: float = 2.0
+    num_microbatches: int = 1   # pipeline microbatches (used when pp > 1)
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(cfg: GPTConfig, key) -> dict:
+    """fp32 parameter pytree; block leaves stacked over layers (leading L)."""
+    k = iter(jax.random.split(key, 16))
+    L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.head_dim,
+                      cfg.d_ff)
+    s = 0.02
+    so = s / np.sqrt(2 * L)  # residual-output scaling (GPT-2 style)
+
+    def nrm(key, shape, scale):
+        return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
+
+    blocks = {
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "wqkv": nrm(next(k), (L, D, 3, H, Dh), s),
+        "wo": nrm(next(k), (L, H, Dh, D), so),
+        "ln2": jnp.ones((L, D), jnp.float32),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        blocks["gate"] = nrm(next(k), (L, D, E), s)
+        blocks["w_in"] = nrm(next(k), (L, E, D, F), s)
+        blocks["w_out"] = nrm(next(k), (L, E, F, D), so)
+    else:
+        blocks["w1"] = nrm(next(k), (L, D, F), s)
+        blocks["w2"] = nrm(next(k), (L, F, D), so)
+    return {
+        "wte": nrm(next(k), (cfg.vocab_size, D), s),
+        "wpe": nrm(next(k), (cfg.max_seq, D), s),
+        "blocks": blocks,
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "wlm": nrm(next(k), (D, cfg.vocab_size), s),
+    }
+
+
+def param_specs(cfg: GPTConfig) -> dict:
+    """PartitionSpec pytree mirroring init_params.
+
+    Layer stack over pp; heads/ffn-hidden/vocab over tp; model dim of the
+    big matrices over fsdp (gathered just-in-time in the block body)."""
+    blocks = {
+        "ln1": P("pp", None),
+        "wqkv": P("pp", "fsdp", None, "tp", None),
+        "wo": P("pp", "tp", None, "fsdp"),
+        "ln2": P("pp", None),
+    }
+    if cfg.n_experts:
+        blocks["gate"] = P("pp", None, None)
+        blocks["w_in"] = P("pp", "ep", None, "tp")
+        blocks["w_out"] = P("pp", "ep", "tp", None)
+    else:
+        blocks["w1"] = P("pp", "fsdp", "tp")
+        blocks["w2"] = P("pp", "tp", "fsdp")
+    return {
+        "wte": P("tp", None),
+        "wpe": P(None, None),
+        "blocks": blocks,
+        "ln_f": P(None),
+        "wlm": P(None, "tp"),
+    }
+
+
+def _block_in_specs(cfg: GPTConfig) -> dict:
+    return param_specs(cfg)["blocks"]
+
+
+# ---------------------------------------------------------------------------
+# Collective helpers: no-ops when running without a mesh (single device).
+
+
+def _psum(x, names, active):
+    names = tuple(n for n in names if n in active)
+    return lax.psum(x, names) if names else x
+
+
+def _axis_index(name, active):
+    return lax.axis_index(name) if name in active else 0
+
+
+def _all_gather(x, name, axis, active):
+    if name in active:
+        return lax.all_gather(x, name, axis=axis, tiled=True)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Block body (runs inside shard_map over the full mesh, or plain when
+# mesh=None).  All shapes below are per-shard.
+
+
+def _rmsnorm(x, scale):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _attention(x, p, cfg, active, sizes):
+    """x: [b, t_local, D].  Heads sharded over tp; sequence over sp."""
+    dt = cfg.dtype
+    wqkv = _all_gather(p["wqkv"], "fsdp", 0, active).astype(dt)
+    qkv = jnp.einsum("btd,dchk->btchk", x, wqkv)  # c=3, h local heads
+    q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scale = cfg.head_dim ** -0.5
+    if "sp" in active:
+        out = _ring_attention_sharded(q, kk, v, "sp", causal=True,
+                                      scale=scale)
+    else:
+        out = reference_attention(q, kk, v, causal=True, scale=scale)
+    wo = _all_gather(p["wo"], "fsdp", 2, active).astype(dt)
+    y = jnp.einsum("bthk,hkd->btd", out, wo)
+    return _psum(y, ("tp",), active)
+
+
+def _dense_ffn(x, p, cfg, active):
+    dt = cfg.dtype
+    w1 = _all_gather(p["w1"], "fsdp", 0, active).astype(dt)
+    w2 = _all_gather(p["w2"], "fsdp", 1, active).astype(dt)
+    h = jax.nn.gelu(jnp.einsum("btd,df->btf", x, w1))
+    y = jnp.einsum("btf,fd->btd", h, w2)
+    return _psum(y, ("tp",), active)
+
+
+def _moe_ffn(x, p, cfg, active, sizes):
+    """Experts sharded over ep, expert-hidden over tp (parallel/moe.py
+    pattern, extended with the tp reduction).  Routing is computed
+    redundantly on every (ep, tp) shard; each shard runs only its local
+    experts' capacity buckets as one batched einsum (MXU-friendly)."""
+    from ray_tpu.parallel.moe import top1_dispatch
+    dt = cfg.dtype
+    ep_size = sizes.get("ep", 1)
+    my = _axis_index("ep", active)
+    b, t, d = x.shape
+    xf = x.reshape(b * t, d)
+    dispatch, combine = top1_dispatch(
+        xf, p["gate"], p["w_in"].shape[0], my, ep_size,
+        cfg.capacity_factor, dtype=dt)
+    w_in = p["w_in"].astype(dt)
+    w_out = p["w_out"].astype(dt)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    return _psum(y, ("ep", "tp"), active).reshape(b, t, d)
+
+
+def _make_layer_fn(cfg: GPTConfig, active, sizes):
+    def layer(x, lp):
+        a = _attention(_rmsnorm(x, lp["ln1"]), lp, cfg, active, sizes)
+        x = x + a
+        h = _rmsnorm(x, lp["ln2"])
+        if cfg.n_experts:
+            y = _moe_ffn(h, lp, cfg, active, sizes)
+        else:
+            y = _dense_ffn(h, lp, cfg, active)
+        return x + y, None
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+    return layer
+
+
+def _stage_fn(blocks, x, cfg, active, sizes):
+    """Scan this shard's layer stack (the full stack when pp=1)."""
+    x, _ = lax.scan(_make_layer_fn(cfg, active, sizes), x, blocks)
+    return x
+
+
+def _blocks_body(blocks, x, cfg: GPTConfig, active, sizes):
+    """x: [b_local, t_local, D] per-shard activations.
+
+    pp=1: plain layer scan.  pp>1: GPipe-as-collectives — microbatches
+    stream through the pp stages via ppermute (parallel/pipeline.py
+    pattern, inlined so the stage body can itself use sp/tp/ep
+    collectives)."""
+    pp = sizes.get("pp", 1)
+    if pp == 1:
+        return _stage_fn(blocks, x, cfg, active, sizes)
+
+    M = cfg.num_microbatches
+    b = x.shape[0]
+    assert b % M == 0, f"local batch {b} not divisible by microbatches {M}"
+    x_mb = x.reshape(M, b // M, *x.shape[1:])
+    s_idx = _axis_index("pp", active)
+    ticks = M + pp - 1
+    perm = [(i, i + 1) for i in range(pp - 1)]
+
+    stream0 = jnp.zeros_like(x_mb[0])
+    outputs0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        stream, outputs = carry
+        mb_idx = jnp.clip(t - s_idx, 0, M - 1)
+        inp = jnp.where(s_idx == 0, x_mb[jnp.clip(t, 0, M - 1)], stream)
+        out = _stage_fn(blocks, inp, cfg, active, sizes)
+        valid = (t - s_idx >= 0) & (t - s_idx < M)
+        rec = valid & (s_idx == pp - 1)
+        outputs = jnp.where(rec, outputs.at[mb_idx].set(out), outputs)
+        stream_next = lax.ppermute(out, "pp", perm)
+        return (stream_next, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (stream0, outputs0), jnp.arange(ticks))
+    # Only the last stage holds real outputs; replicate across pp (callers
+    # need the activations everywhere for the unembed + loss).
+    outputs = jnp.where(s_idx == pp - 1, outputs, jnp.zeros_like(outputs))
+    outputs = _psum(outputs, ("pp",), active)
+    return outputs.reshape(b, *x.shape[1:])
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (the body mixes psum /
+    ppermute / at-set updates whose varying-axis types the checker can't
+    always infer), across jax API versions."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (TypeError, AttributeError):
+        pass
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+    except (TypeError, AttributeError):
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+# ---------------------------------------------------------------------------
+# Forward / loss / train step
+
+
+def forward(params: dict, tokens, cfg: GPTConfig, mesh=None):
+    """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
+    B, T = tokens.shape
+    dt = cfg.dtype
+    x = jnp.take(params["wte"], tokens, axis=0)
+    x = (x + params["wpe"][:T]).astype(dt)
+
+    if mesh is None:
+        x = _blocks_body(params["blocks"], x, cfg, frozenset(), {})
+    else:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        active = frozenset(mesh.axis_names)
+        x_spec = P(BATCH_AXES, "sp", None)
+        x = lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec))
+        body = functools.partial(_blocks_body, cfg=cfg, active=active,
+                                 sizes=sizes)
+        x = _shard_map(body, mesh, (_block_in_specs(cfg), x_spec),
+                       x_spec)(params["blocks"], x)
+
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        params["wlm"].astype(jnp.float32))
+    if mesh is not None:
+        logits = lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P(BATCH_AXES, "sp", "tp")))
+    return logits
+
+
+def loss_fn(params, tokens, cfg: GPTConfig, mesh=None):
+    """Next-token cross entropy; tokens [B, T+1]."""
+    import optax
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, mesh)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return loss.mean()
+
+
+def make_train_state(cfg: GPTConfig, key, mesh=None, optimizer=None,
+                     learning_rate: float = 3e-4):
+    """Init params (+adamw state), placed according to param_specs."""
+    import optax
+    optimizer = optimizer or optax.adamw(learning_rate)
+    params = init_params(cfg, key)
+    if mesh is not None:
+        specs = param_specs(cfg)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, specs)
+    opt_state = optimizer.init(params)
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    return state, optimizer
+
+
+def train_step(state, tokens, cfg: GPTConfig, mesh=None, optimizer=None):
+    """One SGD step (not jitted — wrap with make_train_step)."""
+    import optax
+    optimizer = optimizer or optax.adamw(3e-4)
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, cfg, mesh))(state["params"])
+    updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                        state["params"])
+    new_params = optax.apply_updates(state["params"], updates)
+    return ({"params": new_params, "opt_state": new_opt,
+             "step": state["step"] + 1}, {"loss": loss})
+
+
+def make_train_step(cfg: GPTConfig, mesh=None, optimizer=None,
+                    learning_rate: float = 3e-4, donate: bool = True):
+    import optax
+    optimizer = optimizer or optax.adamw(learning_rate)
+    fn = functools.partial(train_step, cfg=cfg, mesh=mesh,
+                           optimizer=optimizer)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())

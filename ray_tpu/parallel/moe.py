@@ -20,6 +20,36 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def top1_dispatch(xf, gate_w, e_local: int, ep_rank, ep_size: int,
+                  capacity_factor: float, dtype=None):
+    """Shared top-1 capacity-bucketed routing (Switch/GShard style).
+
+    xf: [N, D] tokens; gate_w: [D, E_total].  Returns (dispatch, combine),
+    both [N, E_local, C], restricted to this shard's experts
+    [ep_rank*e_local, (ep_rank+1)*e_local).  With ep_size=1/ep_rank=0 this
+    is the single-shard routing.  Gating runs in fp32 for stable argmax/
+    softmax regardless of the compute dtype."""
+    n_tok = xf.shape[0]
+    n_exp = e_local * ep_size
+    logits = xf.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)
+    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
+    capacity = max(1, int(capacity_factor * n_tok / n_exp))
+    onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
+    keep = pos < capacity
+    local_expert = expert_idx - ep_rank * e_local
+    in_local = (local_expert >= 0) & (local_expert < e_local) & keep
+    local_oh = (jax.nn.one_hot(jnp.clip(local_expert, 0, e_local - 1),
+                               e_local) * in_local[:, None])
+    dispatch = local_oh[..., None] * jax.nn.one_hot(pos, capacity)[:, None, :]
+    if dtype is not None:
+        dispatch = dispatch.astype(dtype)
+    combine = dispatch * gate_val.astype(dispatch.dtype)[:, None, None]
+    return dispatch, combine
+
+
 def _moe_sharded(x, gate_w, w_in, w_out, axis_name, capacity_factor):
     """Per-shard body.  x (tokens) replicated over `ep`; experts sharded:
     w_in/w_out are the local [E_local, ...] slices.  Every shard computes
@@ -30,28 +60,11 @@ def _moe_sharded(x, gate_w, w_in, w_out, axis_name, capacity_factor):
     ep = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     e_local = w_in.shape[0]
-    n_exp = e_local * ep
     b, t, d = x.shape
-    n_tok = b * t
-    xf = x.reshape(n_tok, d)
-
-    logits = xf @ gate_w  # [N, E]
-    gates = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(gates, axis=-1)
-    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
-
-    capacity = max(1, int(capacity_factor * n_tok / n_exp))
-    onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)  # [N, E]
-    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)  # [N]
-    keep = pos < capacity
+    xf = x.reshape(b * t, d)
     # dispatch/combine over the LOCAL expert slice only: [N, E_local, C]
-    local_expert = expert_idx - my * e_local
-    in_local = (local_expert >= 0) & (local_expert < e_local) & keep
-    local_oh = jax.nn.one_hot(jnp.clip(local_expert, 0, e_local - 1),
-                              e_local) * in_local[:, None]
-    dispatch = local_oh[..., None] * jax.nn.one_hot(pos, capacity)[:, None, :]
-    combine = dispatch * gate_val[:, None, None]
-
+    dispatch, combine = top1_dispatch(xf, gate_w, e_local, my, ep,
+                                      capacity_factor)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)  # [E_local, C, D]
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
     out = jnp.einsum("ecf,efd->ecd", h, w_out)  # [E_local, C, D]
@@ -88,20 +101,9 @@ def reference_moe(x, gate_w, w_in, w_out, capacity_factor: float = 2.0):
 
 def _moe_sharded_single(x, gate_w, w_in, w_out, capacity_factor):
     b, t, d = x.shape
-    n_exp = w_in.shape[0]
-    n_tok = b * t
-    xf = x.reshape(n_tok, d)
-    logits = xf @ gate_w
-    gates = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(gates, axis=-1)
-    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
-    capacity = max(1, int(capacity_factor * n_tok / n_exp))
-    onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)
-    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
-    keep = pos < capacity
-    dispatch = (jax.nn.one_hot(expert_idx, n_exp) * keep[:, None])[..., None] \
-        * jax.nn.one_hot(pos, capacity)[:, None, :]
-    combine = dispatch * gate_val[:, None, None]
+    xf = x.reshape(b * t, d)
+    dispatch, combine = top1_dispatch(xf, gate_w, w_in.shape[0], 0, 1,
+                                      capacity_factor)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
     out = jnp.einsum("ecf,efd->ecd", h, w_out)

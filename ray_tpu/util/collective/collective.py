@@ -145,6 +145,12 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
                             group_name: str = "default"):
     """Declare a group across actor handles from the driver (reference:
     collective.py declare_collective_group): calls init on each member."""
+    if len(actors) != len(ranks):
+        raise ValueError(
+            f"{len(actors)} actors but {len(ranks)} ranks")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(
+            f"ranks {ranks} must be a permutation of 0..{world_size - 1}")
     refs = []
     for actor, rank in zip(actors, ranks):
         refs.append(actor._rt_init_collective.remote(
@@ -153,14 +159,15 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    """Leave the group and tear down its coordinator actor so the name can
-    be reused with a different world size."""
-    g = _groups.pop(group_name, None)
-    if g is not None and g.rank == 0:
-        try:
-            ray_tpu.kill(g.coord)
-        except Exception:
-            pass
+    """Tear down the group's coordinator actor so the name can be reused
+    with a different world size.  Works from any member OR from the driver
+    that called create_collective_group."""
+    _groups.pop(group_name, None)
+    try:
+        coord = ray_tpu.get_actor(_COORD_PREFIX + group_name)
+        ray_tpu.kill(coord)
+    except Exception:
+        pass
 
 
 def get_group_handle(group_name: str = "default") -> GroupMember:
