@@ -1,0 +1,8 @@
+import ray_tpu
+from ray_tpu import data as rd
+
+ray_tpu.init(num_cpus=4)
+ds = rd.range(16, parallelism=2).random_shuffle(seed=7)
+print("vals:", sorted(ds.take_all()))
+ray_tpu.shutdown()
+print("OK")

@@ -1,0 +1,20 @@
+import ray_tpu
+from ray_tpu.air import Checkpoint, ScalingConfig
+from ray_tpu.train import JaxConfig, JaxTrainer
+from tests.test_train import _linreg_loop
+
+ray_tpu.init(num_cpus=4)
+import ray_tpu._private.api as api
+print("session:", api._head_node.session_dir)
+trainer = JaxTrainer(
+    _linreg_loop,
+    train_loop_config={"epochs": 8},
+    jax_config=JaxConfig(use_distributed=False, virtual_cpu_devices=8),
+    scaling_config=ScalingConfig(num_workers=1, tp=2, fsdp=2),
+)
+try:
+    result = trainer.fit()
+    print("RESULT", result.metrics)
+except Exception as e:
+    print("FAILED:", e)
+ray_tpu.shutdown()

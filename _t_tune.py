@@ -1,0 +1,13 @@
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import Tuner, TuneConfig
+
+def objective(config):
+    tune.report({"score": config["a"] * 10})
+
+ray_tpu.init(num_cpus=4)
+res = Tuner(objective, param_space={"a": tune.grid_search([1, 2])},
+            tune_config=TuneConfig(metric="score", mode="max")).fit()
+for r in res:
+    print("metrics:", r.metrics, "error:", repr(r.error))
+ray_tpu.shutdown()

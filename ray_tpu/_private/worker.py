@@ -125,6 +125,11 @@ class CoreWorker:
         self.owned: dict[ObjectID, OwnedObject] = {}
         self._pinned: set[bytes] = set()
         self._borrow_cache: dict[ObjectID, bytes] = {}
+        # Argument ObjectRefs of in-flight tasks, pinned so the owner keeps
+        # serving them until the dependent task finishes (reference:
+        # TaskManager lineage pinning of task dependencies).  Keyed by the
+        # task's first return ObjectID.
+        self._arg_pins: dict[ObjectID, list] = {}
         # submission state
         self.lease_pools: dict[tuple, LeasePool] = {}
         self._worker_conns: dict[tuple, protocol.Connection] = {}
@@ -498,8 +503,19 @@ class CoreWorker:
         if pg is not None:
             spec["pg_id"] = pg.id
             spec["bundle_index"] = opts.get("placement_group_bundle_index", -1)
+        self._pin_args(refs[0].id, args, kwargs)
         self._call(self._submit(spec))
         return refs
+
+    def _pin_args(self, key: ObjectID, args, kwargs):
+        pins = [a for a in args if isinstance(a, ObjectRef)]
+        pins += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        if pins:
+            self._arg_pins[key] = pins
+
+    def _unpin_args(self, return_ids):
+        if return_ids:
+            self._arg_pins.pop(return_ids[0], None)
 
     def _pack_args(self, args, kwargs):
         new_args = [(_RefArg(a) if isinstance(a, ObjectRef) else a)
@@ -625,6 +641,7 @@ class CoreWorker:
             self._complete_with_error(spec, exc)
 
     def _complete_with_error(self, spec, exc):
+        self._unpin_args(spec.get("return_ids"))
         blob = _error_blob(exc if isinstance(exc, Exception)
                            else rexc.RayTpuError(str(exc)))
         for oid in spec["return_ids"]:
@@ -740,6 +757,7 @@ class CoreWorker:
             pass
 
     def _record_results(self, spec, reply):
+        self._unpin_args(spec.get("return_ids"))
         if "error" in reply:
             blob = reply["error"]
             for oid in spec["return_ids"]:
@@ -980,6 +998,7 @@ class CoreWorker:
             self.owned[oid] = entry
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
+        self._pin_args(refs[0].id, args, kwargs)
         body = {
             "task_id": task_id,
             "method": method,
@@ -1037,6 +1056,7 @@ class CoreWorker:
                 or str(e)
             err = rexc.ActorDiedError(actor_id, cause)
             blob = _error_blob(err)
+            self._unpin_args(body["return_ids"])
             for oid in body["return_ids"]:
                 entry = self.owned.get(oid)
                 if entry is not None:

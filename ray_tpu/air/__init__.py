@@ -1,0 +1,17 @@
+"""AIR-equivalent primitives: the shared ML-layer vocabulary.
+
+Reference: python/ray/air — Checkpoint (air/checkpoint.py:42), session
+(air/session.py), configs (air/config.py).  Here Checkpoint speaks jax
+pytrees natively (orbax-compatible directory layout) and ScalingConfig
+declares TPU mesh axes instead of GPU counts.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air import session  # noqa: F401
+from ray_tpu.air.result import Result  # noqa: F401

@@ -1,0 +1,75 @@
+"""Run-level configuration vocabulary (reference: python/ray/air/config.py
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig).
+
+TPU-era extension: ScalingConfig declares mesh parallelism axes
+(dp/fsdp/tp/pp/sp/ep) directly — the trainer turns them into a
+jax.sharding.Mesh over the gang's chips (SURVEY.md §2.4 implication).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers, what each owns, and how the mesh is carved."""
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # mesh axes (per-gang, across all chips owned by all workers)
+    dp: Optional[int] = None
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def _resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        return {"TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
+
+    def mesh_spec(self, n_devices: int) -> MeshSpec:
+        if self.dp is not None:
+            return MeshSpec(dp=self.dp, fsdp=self.fsdp, tp=self.tp,
+                            pp=self.pp, sp=self.sp, ep=self.ep)
+        return MeshSpec.infer(n_devices, tp=self.tp, pp=self.pp,
+                              sp=self.sp, ep=self.ep, fsdp=self.fsdp)
+
+    def as_placement_group_factory(self):
+        from ray_tpu.tune.execution.placement_groups import (
+            PlacementGroupFactory)
+        bundles = [self._resources for _ in range(self.num_workers)]
+        return PlacementGroupFactory(bundles,
+                                     strategy=self.placement_strategy)
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Any] = None
+    verbose: int = 1

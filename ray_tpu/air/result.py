@@ -1,0 +1,23 @@
+"""Result: the outcome of one trial/run (reference: python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[Exception] = None
+    path: str = ""
+    metrics_dataframe: Any = None
+    best_checkpoints: Optional[List[Tuple[Checkpoint, Dict]]] = None
+    config: Optional[Dict] = None
+
+    @property
+    def done(self) -> bool:
+        return self.error is None

@@ -1,0 +1,110 @@
+"""Training/tuning session: the worker-side reporting channel.
+
+Reference: python/ray/air/session.py + train/_internal/session.py:103-220
+(thread + queue handoff between the user loop and the harness).  The user's
+train function runs in a thread inside a worker actor; `session.report`
+enqueues (metrics, checkpoint) for the harness to consume; rank/mesh
+context comes from the backend that started the worker.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class _Session:
+    def __init__(self, world_rank: int = 0, world_size: int = 1,
+                 local_rank: int = 0, trial_name: str = "",
+                 trial_id: str = "", mesh: Any = None,
+                 checkpoint: Optional[Checkpoint] = None,
+                 trial_dir: str = ""):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.trial_name = trial_name
+        self.trial_id = trial_id
+        self.mesh = mesh
+        self.trial_dir = trial_dir
+        self.loaded_checkpoint = checkpoint
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.continue_event = threading.Event()
+        self.stop_requested = False
+        self.iteration = 0
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        self.iteration += 1
+        self.result_queue.put((dict(metrics), checkpoint))
+        # Block the user thread until the harness consumed the result —
+        # keeps reporting lossless and backpressured (reference:
+        # train/_internal/session.py pause-on-report semantics).
+        self.continue_event.wait()
+        self.continue_event.clear()
+        if self.stop_requested:
+            raise StopIteration("session stopped")
+
+
+_session_lock = threading.Lock()
+_sessions: dict[int, _Session] = {}
+
+
+def _set_session(s: Optional[_Session]):
+    with _session_lock:
+        if s is None:
+            _sessions.pop(threading.get_ident(), None)
+        else:
+            _sessions[threading.get_ident()] = s
+
+
+def _get_session() -> Optional[_Session]:
+    return _sessions.get(threading.get_ident())
+
+
+def _require() -> _Session:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("no active train/tune session in this thread")
+    return s
+
+
+# -- public API (reference: air/session.py) ---------------------------
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    _require().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _require().loaded_checkpoint
+
+
+def get_world_rank() -> int:
+    return _require().world_rank
+
+
+def get_world_size() -> int:
+    return _require().world_size
+
+
+def get_local_rank() -> int:
+    return _require().local_rank
+
+
+def get_trial_name() -> str:
+    return _require().trial_name
+
+
+def get_trial_id() -> str:
+    return _require().trial_id
+
+
+def get_trial_dir() -> str:
+    return _require().trial_dir
+
+
+def get_mesh():
+    """TPU-native: the jax Mesh this worker's gang trains over (None when
+    the backend didn't build one)."""
+    return _require().mesh

@@ -1,0 +1,18 @@
+"""Distributed datasets (the reference's Ray Data, SURVEY.md §2.3).
+
+Blocks (Arrow/pandas/numpy/list) live in the object store; transforms are
+lazy stages fused into one task per block (or an actor pool for stateful
+UDFs); shuffle/sort/groupby run two-round task graphs; `iter_jax_batches`
+is the TPU last-mile: numpy batches device_put with a mesh sharding.
+"""
+
+from ray_tpu.data.block import BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy, Dataset, GroupedData, TaskPoolStrategy,
+)
+from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow, from_items, from_numpy, from_pandas, range, range_tensor,
+    read_binary_files, read_csv, read_json, read_numpy, read_parquet,
+    read_text,
+)

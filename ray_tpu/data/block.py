@@ -1,0 +1,177 @@
+"""Blocks: the unit of distributed data.
+
+Reference: python/ray/data/block.py (Block = Arrow table / pandas frame /
+simple list, wrapped by a BlockAccessor).  Blocks live in the object store
+and flow between transform tasks as ObjectRefs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class BlockAccessor:
+    """Uniform view over the supported block formats: list-of-rows,
+    dict-of-numpy ("numpy batch"), pandas.DataFrame, pyarrow.Table."""
+
+    def __init__(self, block: Any):
+        self._b = block
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- introspection -------------------------------------------------
+    def num_rows(self) -> int:
+        b = self._b
+        if isinstance(b, list):
+            return len(b)
+        if isinstance(b, dict):
+            return len(next(iter(b.values()))) if b else 0
+        return len(b)  # pandas / arrow both define __len__
+
+    def size_bytes(self) -> int:
+        b = self._b
+        if isinstance(b, list):
+            import sys
+            return sum(sys.getsizeof(r) for r in b)
+        if isinstance(b, dict):
+            return sum(np.asarray(v).nbytes for v in b.values())
+        try:
+            import pyarrow as pa
+            if isinstance(b, pa.Table):
+                return b.nbytes
+        except ImportError:
+            pass
+        return int(b.memory_usage(deep=True).sum())  # pandas
+
+    def schema(self):
+        b = self._b
+        if isinstance(b, list):
+            return type(b[0]).__name__ if b else None
+        if isinstance(b, dict):
+            return {k: np.asarray(v).dtype for k, v in b.items()}
+        try:
+            import pyarrow as pa
+            if isinstance(b, pa.Table):
+                return b.schema
+        except ImportError:
+            pass
+        return b.dtypes
+
+    # -- conversion ----------------------------------------------------
+    def to_pylist(self) -> List:
+        b = self._b
+        if isinstance(b, list):
+            return list(b)
+        if isinstance(b, dict):
+            keys = list(b)
+            n = self.num_rows()
+            return [{k: np.asarray(b[k])[i] for k in keys}
+                    for i in range(n)]
+        try:
+            import pyarrow as pa
+            if isinstance(b, pa.Table):
+                return b.to_pylist()
+        except ImportError:
+            pass
+        return b.to_dict("records")
+
+    def to_numpy(self, column: Optional[str] = None):
+        b = self._b
+        if isinstance(b, dict):
+            return np.asarray(b[column]) if column else \
+                {k: np.asarray(v) for k, v in b.items()}
+        if isinstance(b, list):
+            if b and isinstance(b[0], dict):
+                keys = b[0].keys()
+                out = {k: np.asarray([r[k] for r in b]) for k in keys}
+                return out[column] if column else out
+            arr = np.asarray(b)
+            return arr
+        df = self.to_pandas()
+        if column:
+            return df[column].to_numpy()
+        return {c: df[c].to_numpy() for c in df.columns}
+
+    def to_pandas(self):
+        import pandas as pd
+        b = self._b
+        if isinstance(b, pd.DataFrame):
+            return b
+        try:
+            import pyarrow as pa
+            if isinstance(b, pa.Table):
+                return b.to_pandas()
+        except ImportError:
+            pass
+        if isinstance(b, dict):
+            return pd.DataFrame({k: np.asarray(v) for k, v in b.items()})
+        if b and isinstance(b[0], dict):
+            return pd.DataFrame(b)
+        return pd.DataFrame({"value": b})
+
+    def to_arrow(self):
+        import pyarrow as pa
+        b = self._b
+        if isinstance(b, pa.Table):
+            return b
+        return pa.Table.from_pandas(self.to_pandas(),
+                                    preserve_index=False)
+
+    def to_batch_format(self, batch_format: Optional[str]):
+        if batch_format in (None, "default", "native"):
+            return self._b
+        if batch_format == "numpy":
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        if batch_format == "pylist":
+            return self.to_pylist()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- manipulation --------------------------------------------------
+    def slice(self, start: int, end: int) -> Any:
+        b = self._b
+        if isinstance(b, list):
+            return b[start:end]
+        if isinstance(b, dict):
+            return {k: np.asarray(v)[start:end] for k, v in b.items()}
+        try:
+            import pyarrow as pa
+            if isinstance(b, pa.Table):
+                return b.slice(start, end - start)
+        except ImportError:
+            pass
+        return b.iloc[start:end]
+
+    @staticmethod
+    def combine(blocks: List[Any]) -> Any:
+        blocks = [b for b in blocks
+                  if BlockAccessor(b).num_rows() > 0] or blocks[:1]
+        first = blocks[0]
+        if isinstance(first, list):
+            out = []
+            for b in blocks:
+                out.extend(b)
+            return out
+        if isinstance(first, dict):
+            keys = first.keys()
+            return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
+                    for k in keys}
+        try:
+            import pyarrow as pa
+            if isinstance(first, pa.Table):
+                return pa.concat_tables(blocks)
+        except ImportError:
+            pass
+        import pandas as pd
+        return pd.concat(blocks, ignore_index=True)
+
+    @staticmethod
+    def empty_like(block: Any) -> Any:
+        return BlockAccessor(block).slice(0, 0)

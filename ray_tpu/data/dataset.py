@@ -1,0 +1,482 @@
+"""Dataset: lazy, distributed, block-based data transforms.
+
+Reference: python/ray/data/dataset.py:124 (map_batches :300), _internal/
+plan.py:69 (ExecutionPlan of stages), _internal/compute.py (TaskPool vs
+ActorPool strategies), _internal/push_based_shuffle.py (all-to-all).
+
+Design: a Dataset is (block_refs, lazy stage list).  Stages are per-block
+transforms executed as tasks (one task per block, full parallelism) or on
+a reusable actor pool (expensive per-actor setup, e.g. a jax model for
+batch inference).  All-to-all ops (shuffle/sort/groupby) run a two-round
+task graph: partition each block -> combine each partition.
+"""
+
+from __future__ import annotations
+
+import builtins
+import random
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+
+_GET_TIMEOUT = 600.0
+
+
+# --------------------------------------------------------------------------
+# compute strategies
+
+
+class TaskPoolStrategy:
+    """One task per block (reference: _internal/compute.py:56)."""
+
+
+class ActorPoolStrategy:
+    """A pool of long-lived transform actors (reference: compute.py:146).
+    Use for stateful/expensive-setup UDFs (model inference on TPU
+    replicas)."""
+
+    def __init__(self, size: int = 2, min_size: int = 0, max_size: int = 0):
+        self.size = max(size, min_size) or 2
+
+
+class _TransformActor:
+    def __init__(self, fn_factory):
+        self._fn = fn_factory() if fn_factory else None
+
+    def apply(self, fn_or_none, block, fn_args, fn_kwargs):
+        fn = fn_or_none if fn_or_none is not None else self._fn
+        return fn(block, *fn_args, **fn_kwargs)
+
+
+def _apply_stage_task(fn, block, fn_args, fn_kwargs):
+    return fn(block, *fn_args, **fn_kwargs)
+
+
+# --------------------------------------------------------------------------
+
+
+class Dataset:
+    def __init__(self, block_refs: List, stages: Optional[List] = None):
+        self._block_refs = list(block_refs)
+        self._stages = list(stages or [])
+
+    # ---------------------------------------------------------------- plan
+    def _with_stage(self, fn: Callable, compute=None, fn_args=(),
+                    fn_kwargs=None) -> "Dataset":
+        return Dataset(self._block_refs,
+                       self._stages + [(fn, compute, fn_args,
+                                        fn_kwargs or {})])
+
+    def _execute(self) -> List:
+        """Materialize all stages -> block refs (fused: one task per block
+        runs the whole stage chain — the reference's stage fusion)."""
+        if not self._stages:
+            return self._block_refs
+        stages = self._stages
+
+        def _fused(block):
+            for fn, _, fn_args, fn_kwargs in stages:
+                block = fn(block, *fn_args, **fn_kwargs)
+            return block
+
+        actor_stages = [s for s in stages
+                        if isinstance(s[1], ActorPoolStrategy)]
+        if actor_stages:
+            pool_size = max(s[1].size for s in actor_stages)
+            actor_cls = ray_tpu.remote(_TransformActor)
+            pool = [actor_cls.remote(None) for _ in range(pool_size)]
+            refs = []
+            for i, b in enumerate(self._block_refs):
+                actor = pool[i % pool_size]
+                refs.append(actor.apply.remote(_fused, b, (), {}))
+            out = ray_tpu.get(refs, timeout=_GET_TIMEOUT)
+            blocks = [ray_tpu.put(b) for b in out]
+            for a in pool:
+                ray_tpu.kill(a)
+        else:
+            task = ray_tpu.remote(_apply_stage_task)
+            blocks = [task.remote(_fused, b, (), {})
+                      for b in self._block_refs]
+        self._block_refs = blocks
+        self._stages = []
+        return self._block_refs
+
+    def materialize(self) -> "Dataset":
+        self._execute()
+        # Force completion so downstream count() etc. are cheap.
+        ray_tpu.wait(self._block_refs, num_returns=len(self._block_refs),
+                     timeout=_GET_TIMEOUT)
+        return self
+
+    def _blocks(self) -> List:
+        """Materialized local blocks."""
+        return ray_tpu.get(self._execute(), timeout=_GET_TIMEOUT)
+
+    # ---------------------------------------------------------- transforms
+    def map_batches(self, fn: Callable, *, batch_format: Optional[str] =
+                    "numpy", compute=None, fn_args=(), fn_kwargs=None,
+                    batch_size: Optional[int] = None, **_ignored
+                    ) -> "Dataset":
+        """Apply fn to whole blocks (reference: dataset.py:300)."""
+        def _map_batches(block, *args, **kwargs):
+            acc = BlockAccessor(block)
+            batch = acc.to_batch_format(batch_format)
+            out = fn(batch, *args, **kwargs)
+            return out
+
+        return self._with_stage(_map_batches, compute, fn_args, fn_kwargs)
+
+    def map(self, fn: Callable, compute=None) -> "Dataset":
+        def _map(block):
+            rows = BlockAccessor(block).to_pylist()
+            return [fn(r) for r in rows]
+        return self._with_stage(_map, compute)
+
+    def flat_map(self, fn: Callable, compute=None) -> "Dataset":
+        def _flat(block):
+            rows = BlockAccessor(block).to_pylist()
+            out = []
+            for r in rows:
+                out.extend(fn(r))
+            return out
+        return self._with_stage(_flat, compute)
+
+    def filter(self, fn: Callable, compute=None) -> "Dataset":
+        def _filter(block):
+            rows = BlockAccessor(block).to_pylist()
+            return [r for r in rows if fn(r)]
+        return self._with_stage(_filter, compute)
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(block):
+            df = BlockAccessor(block).to_pandas().copy()
+            df[name] = fn(df)
+            return df
+        return self._with_stage(_add, None)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def _drop(block):
+            return BlockAccessor(block).to_pandas().drop(columns=cols)
+        return self._with_stage(_drop, None)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def _sel(block):
+            return BlockAccessor(block).to_pandas()[cols]
+        return self._with_stage(_sel, None)
+
+    # ------------------------------------------------------------- shuffle
+    def repartition(self, num_blocks: int) -> "Dataset":
+        blocks = self._blocks()
+        combined = BlockAccessor.combine(blocks)
+        acc = BlockAccessor(combined)
+        n = acc.num_rows()
+        num_blocks = max(1, num_blocks)
+        per = (n + num_blocks - 1) // max(1, num_blocks)
+        parts = [acc.slice(i * per, min(n, (i + 1) * per))
+                 for i in range(num_blocks)]
+        return Dataset([ray_tpu.put(p) for p in parts])
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Two-round all-to-all (reference: push_based_shuffle.py:330):
+        round 1 splits every block into N random partitions, round 2
+        merges partition i from every block."""
+        refs = self._execute()
+        n_out = len(refs) or 1
+        seed = seed if seed is not None else random.randrange(1 << 30)
+
+        def _partition(block, idx):
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            rng = np.random.RandomState((seed + idx) % (1 << 31))
+            assign = rng.randint(0, n_out, size=rows)
+            order = np.argsort(assign, kind="stable")
+            sizes = np.bincount(assign, minlength=n_out)
+            out, start = [], 0
+            for s in sizes:
+                idxs = order[start:start + s]
+                start += s
+                out.append(_take_rows(block, idxs))
+            return out
+
+        part_task = ray_tpu.remote(_partition).options(num_returns=n_out)
+        parts = [part_task.remote(b, i) for i, b in enumerate(refs)]
+        if n_out == 1:
+            parts = [[p] for p in parts]
+
+        def _merge(*chunks):
+            merged = BlockAccessor.combine(list(chunks))
+            acc = BlockAccessor(merged)
+            rng = np.random.RandomState(seed)
+            perm = rng.permutation(acc.num_rows())
+            return _take_rows(merged, perm)
+
+        merge_task = ray_tpu.remote(_merge)
+        out = [merge_task.remote(*[parts[b][i] for b in range(len(parts))])
+               for i in range(n_out)]
+        return Dataset(out)
+
+    def sort(self, key: Optional[str] = None, descending: bool = False
+             ) -> "Dataset":
+        """Sample-partition-sort (reference: data sort via boundary
+        sampling)."""
+        blocks = self._blocks()
+        combined = BlockAccessor.combine(blocks)
+        acc = BlockAccessor(combined)
+        if key is None:
+            rows = sorted(acc.to_pylist(), reverse=descending)
+            return from_items_single(rows, len(blocks))
+        df = acc.to_pandas().sort_values(key, ascending=not descending)
+        n = len(df)
+        k = max(1, len(blocks))
+        per = (n + k - 1) // k
+        return Dataset([ray_tpu.put(df.iloc[i * per:(i + 1) * per])
+                        for i in range(k)])
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._execute())
+        for o in others:
+            refs.extend(o._execute())
+        return Dataset(refs)
+
+    def limit(self, n: int) -> "Dataset":
+        blocks = self._blocks()
+        out, left = [], n
+        for b in blocks:
+            acc = BlockAccessor(b)
+            take = min(left, acc.num_rows())
+            if take > 0:
+                out.append(acc.slice(0, take))
+                left -= take
+            if left <= 0:
+                break
+        return Dataset([ray_tpu.put(b) for b in out])
+
+    def split(self, n: int, *, locality_hints=None) -> List["Dataset"]:
+        """Split into n datasets by whole blocks (reference: dataset.py
+        split for per-worker ingest)."""
+        refs = self._execute()
+        if len(refs) < n:
+            self = self.repartition(n)
+            refs = self._block_refs
+        out = [[] for _ in range(n)]
+        for i, r in enumerate(refs):
+            out[i % n].append(r)
+        return [Dataset(rs) for rs in out]
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+        return DatasetPipeline(self, times)
+
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+        return DatasetPipeline(self, 1, blocks_per_window)
+
+    # ------------------------------------------------------------ consume
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self._blocks())
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def schema(self):
+        blocks = self._blocks()
+        for b in blocks:
+            if BlockAccessor(b).num_rows():
+                return BlockAccessor(b).schema()
+        return None
+
+    def take(self, n: int = 20) -> List:
+        out = []
+        for b in self._blocks():
+            out.extend(BlockAccessor(b).to_pylist())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> List:
+        out = []
+        for b in self._blocks():
+            out.extend(BlockAccessor(b).to_pylist())
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for r in self.take(n):
+            print(r)
+
+    def to_pandas(self):
+        return BlockAccessor(
+            BlockAccessor.combine(self._blocks())).to_pandas()
+
+    def iter_rows(self) -> Iterable:
+        for b in self._blocks():
+            yield from BlockAccessor(b).to_pylist()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: Optional[str] = "numpy",
+                     drop_last: bool = False) -> Iterable:
+        carry = None
+        for b in self._blocks():
+            if carry is not None:
+                b = BlockAccessor.combine([carry, b])
+                carry = None
+            acc = BlockAccessor(b)
+            n = acc.num_rows()
+            i = 0
+            while n - i >= batch_size:
+                yield BlockAccessor(
+                    acc.slice(i, i + batch_size)).to_batch_format(
+                        batch_format)
+                i += batch_size
+            if i < n:
+                carry = acc.slice(i, n)
+        if carry is not None and not drop_last:
+            yield BlockAccessor(carry).to_batch_format(batch_format)
+
+    def iter_torch_batches(self, *, batch_size: int = 256, **kw):
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()} \
+                if isinstance(batch, dict) else torch.as_tensor(batch)
+
+    def iter_jax_batches(self, *, batch_size: int = 256, sharding=None,
+                         **kw):
+        """TPU-native last-mile ingest: numpy batches placed on device
+        (optionally with a NamedSharding for direct mesh feeding)."""
+        import jax
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            if sharding is not None:
+                place = lambda v: jax.device_put(v, sharding)  # noqa: E731
+            else:
+                place = jax.device_put
+            yield ({k: place(v) for k, v in batch.items()}
+                   if isinstance(batch, dict) else place(batch))
+
+    # ------------------------------------------------------------ aggregate
+    def _column(self, col: Optional[str]):
+        vals = []
+        for b in self._blocks():
+            acc = BlockAccessor(b)
+            arr = acc.to_numpy(col) if col else np.asarray(acc.to_pylist())
+            vals.append(np.asarray(arr))
+        return np.concatenate(vals) if vals else np.array([])
+
+    def sum(self, on: Optional[str] = None):
+        return self._column(on).sum()
+
+    def min(self, on: Optional[str] = None):
+        return self._column(on).min()
+
+    def max(self, on: Optional[str] = None):
+        return self._column(on).max()
+
+    def mean(self, on: Optional[str] = None):
+        return self._column(on).mean()
+
+    def std(self, on: Optional[str] = None):
+        return float(self._column(on).std(ddof=1))
+
+    # ------------------------------------------------------------- output
+    def write_parquet(self, path: str) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks()):
+            BlockAccessor(b).to_arrow()
+            import pyarrow.parquet as pq
+            pq.write_table(BlockAccessor(b).to_arrow(),
+                           f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks()):
+            BlockAccessor(b).to_pandas().to_csv(
+                f"{path}/part-{i:05d}.csv", index=False)
+
+    def write_json(self, path: str) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks()):
+            BlockAccessor(b).to_pandas().to_json(
+                f"{path}/part-{i:05d}.json", orient="records", lines=True)
+
+    def write_numpy(self, path: str, column: Optional[str] = None) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks()):
+            np.save(f"{path}/part-{i:05d}.npy",
+                    BlockAccessor(b).to_numpy(column))
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._block_refs)}, "
+                f"pending_stages={len(self._stages)})")
+
+    stats = __repr__
+
+
+def _take_rows(block, idxs):
+    acc = BlockAccessor(block)
+    b = acc._b
+    if isinstance(b, list):
+        return [b[int(i)] for i in idxs]
+    if isinstance(b, dict):
+        return {k: np.asarray(v)[idxs] for k, v in b.items()}
+    try:
+        import pyarrow as pa
+        if isinstance(b, pa.Table):
+            return b.take(list(map(int, idxs)))
+    except ImportError:
+        pass
+    return b.iloc[idxs]
+
+
+def from_items_single(rows: List, num_blocks: int) -> "Dataset":
+    num_blocks = max(1, num_blocks)
+    per = (len(rows) + num_blocks - 1) // num_blocks
+    return Dataset([ray_tpu.put(rows[i * per:(i + 1) * per])
+                    for i in range(num_blocks)])
+
+
+class GroupedData:
+    """Hash-partitioned groupby (reference: data grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, agg_fn_name: str, on: Optional[str] = None):
+        df = self._ds.to_pandas()
+        g = df.groupby(self._key)
+        target = g[on] if on else g
+        out = getattr(target, agg_fn_name)()
+        out = out.reset_index()
+        return Dataset([ray_tpu.put(out)])
+
+    def count(self):
+        df = self._ds.to_pandas()
+        out = df.groupby(self._key).size().reset_index(name="count()")
+        return Dataset([ray_tpu.put(out)])
+
+    def sum(self, on=None):
+        return self._agg("sum", on)
+
+    def min(self, on=None):
+        return self._agg("min", on)
+
+    def max(self, on=None):
+        return self._agg("max", on)
+
+    def mean(self, on=None):
+        return self._agg("mean", on)
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        df = self._ds.to_pandas()
+        outs = [fn(sub) for _, sub in df.groupby(self._key)]
+        return Dataset([ray_tpu.put(o) for o in outs])

@@ -1,0 +1,47 @@
+"""DatasetPipeline: windowed/repeated streaming over a Dataset for
+compute/ingest overlap (reference: python/ray/data/dataset_pipeline.py)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from ray_tpu.data.dataset import Dataset
+
+
+class DatasetPipeline:
+    def __init__(self, ds: Dataset, times: Optional[int] = None,
+                 blocks_per_window: Optional[int] = None):
+        self._ds = ds
+        self._times = times
+        self._bpw = blocks_per_window
+        self._stages = []
+
+    def map_batches(self, fn, **kw) -> "DatasetPipeline":
+        self._stages.append(("map_batches", fn, kw))
+        return self
+
+    def random_shuffle_each_window(self, **kw) -> "DatasetPipeline":
+        self._stages.append(("random_shuffle", None, kw))
+        return self
+
+    def _apply(self, ds: Dataset) -> Dataset:
+        for name, fn, kw in self._stages:
+            ds = getattr(ds, name)(fn, **kw) if fn else \
+                getattr(ds, name)(**kw)
+        return ds
+
+    def iter_epochs(self) -> Iterable[Dataset]:
+        import itertools
+        it = (range(self._times) if self._times is not None
+              else itertools.count())
+        for _ in it:
+            yield self._apply(Dataset(self._ds._block_refs,
+                                      self._ds._stages))
+
+    def iter_batches(self, **kw) -> Iterable:
+        for epoch_ds in self.iter_epochs():
+            yield from epoch_ds.iter_batches(**kw)
+
+    def iter_rows(self) -> Iterable:
+        for epoch_ds in self.iter_epochs():
+            yield from epoch_ds.iter_rows()

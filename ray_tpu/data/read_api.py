@@ -1,0 +1,133 @@
+"""Dataset creation (reference: python/ray/data/read_api.py — range,
+from_items/numpy/pandas/arrow, read_parquet/csv/json/numpy/binary/text)."""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_PARALLELISM = 8
+
+
+def _put_blocks(blocks: List) -> Dataset:
+    return Dataset([ray_tpu.put(b) for b in blocks])
+
+
+def range(n: int, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:  # noqa: A001
+    k = max(1, min(parallelism, n or 1))
+    per = (n + k - 1) // k
+    blocks = [list(builtins.range(i * per, min(n, (i + 1) * per)))
+              for i in builtins.range(k)]
+    return _put_blocks([b for b in blocks if b] or [[]])
+
+
+def range_tensor(n: int, *, shape=(1,),
+                 parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    k = max(1, min(parallelism, n or 1))
+    per = (n + k - 1) // k
+    blocks = []
+    for i in builtins.range(k):
+        lo, hi = i * per, min(n, (i + 1) * per)
+        if lo >= hi:
+            continue
+        idx = np.arange(lo, hi).reshape((-1,) + (1,) * len(shape))
+        blocks.append({"data": np.broadcast_to(
+            idx, (hi - lo,) + tuple(shape)).copy()})
+    return _put_blocks(blocks or [{"data": np.zeros((0,) + tuple(shape))}])
+
+
+def from_items(items: List, *, parallelism: int = DEFAULT_PARALLELISM
+               ) -> Dataset:
+    import builtins
+    k = max(1, min(parallelism, len(items) or 1))
+    per = (len(items) + k - 1) // k
+    blocks = [items[i * per:(i + 1) * per] for i in builtins.range(k)]
+    return _put_blocks([b for b in blocks if b] or [[]])
+
+
+def from_numpy(arr: np.ndarray, column: str = "data",
+               parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    import builtins
+    parts = np.array_split(arr, max(1, min(parallelism, len(arr) or 1)))
+    return _put_blocks([{column: p} for p in parts if len(p)]
+                       or [{column: arr[:0]}])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _put_blocks(dfs)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _put_blocks(tables)
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)))
+        else:
+            out.extend(sorted(_glob.glob(p)) or [p])
+    return out
+
+
+def _read_files(paths, reader) -> Dataset:
+    files = _expand(paths)
+    task = ray_tpu.remote(reader)
+    return Dataset([task.remote(f) for f in files])
+
+
+def read_parquet(paths, **kw) -> Dataset:
+    def _read(f):
+        import pyarrow.parquet as pq
+        return pq.read_table(f)
+    return _read_files(paths, _read)
+
+
+def read_csv(paths, **kw) -> Dataset:
+    def _read(f):
+        import pandas as pd
+        return pd.read_csv(f)
+    return _read_files(paths, _read)
+
+
+def read_json(paths, **kw) -> Dataset:
+    def _read(f):
+        import pandas as pd
+        return pd.read_json(f, orient="records", lines=True)
+    return _read_files(paths, _read)
+
+
+def read_numpy(paths, **kw) -> Dataset:
+    def _read(f):
+        return {"data": np.load(f)}
+    return _read_files(paths, _read)
+
+
+def read_text(paths, **kw) -> Dataset:
+    def _read(f):
+        with open(f) as fh:
+            return [line.rstrip("\n") for line in fh]
+    return _read_files(paths, _read)
+
+
+def read_binary_files(paths, **kw) -> Dataset:
+    def _read(f):
+        with open(f, "rb") as fh:
+            return [{"path": f, "bytes": fh.read()}]
+    return _read_files(paths, _read)

@@ -1,0 +1,16 @@
+"""Distributed training (the reference's Ray Train, SURVEY.md §2.3/3.4).
+
+A gang of rank-labeled worker actors in a placement group runs the user's
+train_loop_per_worker; the JaxBackend wires the jax coordination service +
+device mesh (the NCCL-process-group replacement); results/checkpoints
+stream back through the session to Tune, which executes the run.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig  # noqa: F401
+from ray_tpu.train.base_trainer import (  # noqa: F401
+    BaseTrainer, TrainingFailedError,
+)
+from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
+    DataParallelTrainer,
+)
+from ray_tpu.train.jax import JaxConfig, JaxTrainer  # noqa: F401

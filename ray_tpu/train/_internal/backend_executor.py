@@ -1,0 +1,113 @@
+"""BackendExecutor: drives the WorkerGroup through a training run.
+
+Reference: python/ray/train/_internal/backend_executor.py:42 (start :92,
+start_training :274) — create the gang, run Backend setup hooks, launch
+the user loop everywhere, then stream per-round results back.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+class TrainingResult:
+    def __init__(self, metrics: dict, checkpoint: Optional[Checkpoint]):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig):
+        self.backend_config = backend_config
+        self.backend = backend_config.backend_cls()
+        self.scaling_config = scaling_config
+        self.worker_group: Optional[WorkerGroup] = None
+        self._pg = None
+
+    def start(self, placement_group=None):
+        sc = self.scaling_config
+        if placement_group is None:
+            pgf = sc.as_placement_group_factory()
+            self._pg = pgf.create()
+            ok = ray_tpu.wait_placement_group_ready(self._pg, timeout=120)
+            if not ok:
+                raise TrainingFailedError("train worker gang PG not ready")
+            placement_group = self._pg
+        self.worker_group = WorkerGroup(
+            sc.num_workers, sc._resources, placement_group)
+        # Rank/world env everywhere (reference: rank env wiring in
+        # backend_executor._setup_gang).
+        for rank, w in enumerate(self.worker_group.workers):
+            ray_tpu.get(w.set_env.remote({
+                "RT_TRAIN_WORLD_RANK": rank,
+                "RT_TRAIN_WORLD_SIZE": sc.num_workers,
+                "RT_TRAIN_LOCAL_RANK": rank,
+            }), timeout=120)
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(self, train_fn: Callable, config: dict,
+                       checkpoint: Optional[Checkpoint] = None,
+                       trial_name: str = "", trial_id: str = ""):
+        self.backend.on_training_start(self.worker_group,
+                                       self.backend_config)
+        mesh_builder = getattr(self.backend, "mesh_builder", lambda: None)()
+        refs = [
+            w.start_training.remote(
+                train_fn, config, checkpoint, trial_name, trial_id,
+                mesh_builder)
+            for w in self.worker_group.workers
+        ]
+        ray_tpu.get(refs, timeout=600)
+
+    def get_next_results(self) -> Optional[List[TrainingResult]]:
+        """One report round from every rank; None when the loop finished.
+        All ranks must report the same number of times (reference enforces
+        the same invariant)."""
+        refs = [w.next_result.remote() for w in self.worker_group.workers]
+        try:
+            raw = ray_tpu.get(refs, timeout=3600)
+        except Exception as e:
+            raise TrainingFailedError(str(e)) from e
+        finished = [r is None for r in raw]
+        if all(finished):
+            return None
+        if any(finished):
+            raise TrainingFailedError(
+                "ranks reported unevenly (some finished, some reported)")
+        return [TrainingResult(m, c) for (m, c) in raw]
+
+    def finish_training(self):
+        if self.worker_group is not None:
+            for w in self.worker_group.workers:
+                try:
+                    ray_tpu.get(w.shutdown_training.remote(), timeout=30)
+                except Exception:
+                    pass
+
+    def shutdown(self):
+        try:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+        except Exception:
+            pass
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self._pg is not None:
+            try:
+                from ray_tpu.util.placement_group import (
+                    remove_placement_group)
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None

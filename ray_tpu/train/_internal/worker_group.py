@@ -1,0 +1,128 @@
+"""WorkerGroup: the gang of rank-labeled training actors.
+
+Reference: python/ray/train/_internal/worker_group.py (WorkerGroup over
+actor handles; execute/execute_single).  Workers live in one placement
+group so the gang is scheduled atomically (reference: backend_executor
+start inside the Tune trial's PG).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+from ray_tpu.air import session as air_session
+
+
+class _TrainWorker:
+    """Actor hosting one rank of the gang."""
+
+    def __init__(self, world_rank: int, world_size: int, local_rank: int):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self._session: Optional[air_session._Session] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._env: dict = {}
+
+    # generic remote execution --------------------------------------------
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def set_env(self, env: dict):
+        import os
+        self._env.update(env)
+        os.environ.update({k: str(v) for k, v in env.items()})
+        return True
+
+    def node_info(self) -> dict:
+        return {"hostname": socket.gethostname(),
+                "rank": self.world_rank}
+
+    # training loop --------------------------------------------------------
+    def start_training(self, train_fn: Callable, config: dict,
+                       checkpoint=None, trial_name: str = "",
+                       trial_id: str = "", mesh_builder: Callable = None):
+        mesh = mesh_builder() if mesh_builder is not None else None
+        self._session = air_session._Session(
+            world_rank=self.world_rank, world_size=self.world_size,
+            local_rank=self.local_rank, trial_name=trial_name,
+            trial_id=trial_id, mesh=mesh, checkpoint=checkpoint)
+        self._error = None
+
+        def _run():
+            air_session._set_session(self._session)
+            try:
+                train_fn(config) if config is not None else train_fn()
+            except StopIteration:
+                pass
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._session.result_queue.put(None)
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self):
+        """Block until the user loop reports (or finishes).  Returns
+        (metrics, checkpoint) or None when the loop ended."""
+        item = self._session.result_queue.get()
+        if item is None:
+            if self._error is not None:
+                raise self._error
+            return None
+        self._session.continue_event.set()
+        metrics, ckpt = item
+        return (metrics, ckpt)
+
+    def shutdown_training(self):
+        if self._session is not None:
+            self._session.stop_requested = True
+            self._session.continue_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: dict,
+                 placement_group=None):
+        self.num_workers = num_workers
+        self.workers: List[Any] = []
+        cls = ray_tpu.remote(_TrainWorker)
+        for rank in range(num_workers):
+            opts = dict(
+                num_cpus=resources_per_worker.get("CPU", 0),
+                resources={k: v for k, v in resources_per_worker.items()
+                           if k != "CPU"})
+            if placement_group is not None:
+                opts["placement_group"] = placement_group
+                opts["placement_group_bundle_index"] = rank
+            self.workers.append(
+                cls.options(**opts).remote(rank, num_workers, rank))
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(
+            [w.execute.remote(fn, *args, **kwargs) for w in self.workers],
+            timeout=600)
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(
+            self.workers[rank].execute.remote(fn, *args, **kwargs),
+            timeout=600)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,30 @@
+"""Backend/BackendConfig: per-framework worker-gang setup hooks.
+
+Reference: python/ray/train/backend.py:15,27 (Backend.on_start/on_shutdown
+run framework process-group setup, e.g. torch dist.init_process_group in
+train/torch/config.py:54).  TPU-era: the JaxBackend wires the jax
+coordination service + device mesh instead of NCCL (SURVEY.md §5
+"distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_training_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):
+        pass

@@ -1,0 +1,58 @@
+"""BaseTrainer: fit() rides on Tune for execution.
+
+Reference: python/ray/train/base_trainer.py:328 — `fit` wraps the trainer
+into a Tune trainable (as_trainable :354-382) and runs a single-trial
+Tuner, so checkpointing/fault-tolerance/experiment-dirs are shared with
+tuning sweeps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 **kwargs):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def training_loop(self) -> None:
+        """Subclass hook: runs INSIDE the trial; use session.report."""
+        raise NotImplementedError
+
+    def as_trainable(self):
+        from ray_tpu.tune.execution.placement_groups import (
+            PlacementGroupFactory)
+        trainer = self
+
+        def train_func(config: Dict):
+            trainer.training_loop()
+
+        train_func.__name__ = type(self).__name__
+        # The trial actor is a lightweight supervisor; the worker gang gets
+        # its own PG from BackendExecutor.start (2-phase gang reservation).
+        train_func._pg_factory = PlacementGroupFactory([{"CPU": 0.1}])
+        return train_func
+
+    def fit(self) -> Result:
+        from ray_tpu.tune.tuner import TuneConfig, Tuner
+        tuner = Tuner(self.as_trainable(),
+                      tune_config=TuneConfig(),
+                      run_config=self.run_config)
+        grid = tuner.fit()
+        result = grid[0]
+        if result.error is not None:
+            raise TrainingFailedError(str(result.error)) from result.error
+        return result

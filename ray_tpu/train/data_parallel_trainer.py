@@ -1,0 +1,63 @@
+"""DataParallelTrainer: N rank-labeled workers run the user's
+train_loop_per_worker; results stream back through the session.
+
+Reference: python/ray/train/data_parallel_trainer.py:52 + the call stack in
+SURVEY.md §3.4 (BackendExecutor.start -> WorkerGroup -> Backend.on_start ->
+start_training -> session.report relay to Tune).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.train._internal.backend_executor import BackendExecutor
+
+
+class DataParallelTrainer(BaseTrainer):
+    _backend_config_cls = BackendConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self._train_loop = train_loop_per_worker
+        self._train_loop_config = train_loop_config or {}
+        self._backend_config = backend_config or self._backend_config_cls()
+        self._datasets = datasets or {}
+
+    def training_loop(self) -> None:
+        executor = BackendExecutor(self._backend_config,
+                                   self.scaling_config)
+        executor.start()
+        try:
+            train_fn = self._train_loop
+            config = dict(self._train_loop_config)
+            if self._datasets:
+                config["__datasets__"] = {
+                    name: ds for name, ds in self._datasets.items()}
+            executor.start_training(
+                train_fn, config, checkpoint=self.resume_from_checkpoint,
+                trial_name=session.get_trial_name(),
+                trial_id=session.get_trial_id())
+            while True:
+                results = executor.get_next_results()
+                if results is None:
+                    break
+                # rank 0 is authoritative for metrics/checkpoint
+                # (reference: data_parallel_trainer result aggregation).
+                session.report(results[0].metrics,
+                               checkpoint=results[0].checkpoint)
+            executor.finish_training()
+        finally:
+            executor.shutdown()

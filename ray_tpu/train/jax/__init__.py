@@ -1,0 +1,5 @@
+from ray_tpu.train.jax.config import JaxConfig  # noqa: F401
+from ray_tpu.train.jax.jax_trainer import JaxTrainer  # noqa: F401
+from ray_tpu.train.jax.train_loop_utils import (  # noqa: F401
+    prepare_mesh, prepare_batch_sharding,
+)

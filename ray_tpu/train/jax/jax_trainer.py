@@ -1,0 +1,39 @@
+"""JaxTrainer: the flagship TPU trainer (reference analogue:
+train/torch/torch_trainer.py:15 TorchTrainer — here the framework below is
+jax/pjit over a TPU mesh instead of torch DDP over NCCL)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _backend_config_cls = JaxConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict] = None,
+                 jax_config: Optional[JaxConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)
+
+    def training_loop(self) -> None:
+        # Hand the backend the scaling config through the config object so
+        # every worker can build the gang mesh (mesh axes live in
+        # ScalingConfig — SURVEY §2.4).
+        self._backend_config._scaling_config = self.scaling_config
+        super().training_loop()

@@ -1,0 +1,24 @@
+"""In-loop helpers for JaxTrainer user code (reference analogue:
+train/torch/train_loop_utils.py:49 prepare_model DDP-wrap — here the
+equivalents hand out the mesh and shard data/state onto it)."""
+
+from __future__ import annotations
+
+from ray_tpu.air import session
+
+
+def prepare_mesh():
+    """The gang's jax Mesh (built by JaxBackend from ScalingConfig)."""
+    mesh = session.get_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "no mesh in this session — run inside JaxTrainer")
+    return mesh
+
+
+def prepare_batch_sharding(mesh, *axes):
+    """NamedSharding for input batches: batch dim over (dp, fsdp)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if not axes:
+        axes = (("dp", "fsdp"),)
+    return NamedSharding(mesh, P(*axes))

@@ -1,0 +1,52 @@
+"""Hyperparameter tuning (the reference's Ray Tune, SURVEY.md §2.3).
+
+Experiment engine: trial generation (search algos), early-stopping and
+population-based scheduling, execution as placement-group-backed actors,
+checkpointing and fault tolerance — and the execution substrate for
+Train's `fit()`.
+"""
+
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid, TuneConfig, Tuner, with_resources,
+)
+from ray_tpu.tune.trainable import Trainable, wrap_function  # noqa: F401
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator, Searcher, choice, grid_search, loguniform,
+    qrandint, quniform, randint, sample_from, uniform,
+)
+from ray_tpu.tune import schedulers  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
+    HyperBandScheduler, MedianStoppingRule, PopulationBasedTraining,
+)
+from ray_tpu.air import session as _session
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """tune.report — alias of air.session.report (reference: tune/tune.py
+    report shim)."""
+    _session.report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint():
+    return _session.get_checkpoint()
+
+
+def run(trainable, *, config=None, num_samples: int = 1, stop=None,
+        metric=None, mode: str = "max", search_alg=None, scheduler=None,
+        max_concurrent_trials: int = 0, storage_path=None, name=None,
+        checkpoint_config=None, failure_config=None):
+    """Functional entry point (reference: tune/tune.py:129 tune.run)."""
+    from ray_tpu.air.config import RunConfig
+    tuner = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples,
+            search_alg=search_alg, scheduler=scheduler,
+            max_concurrent_trials=max_concurrent_trials),
+        run_config=RunConfig(
+            name=name, storage_path=storage_path, stop=stop,
+            checkpoint_config=checkpoint_config,
+            failure_config=failure_config))
+    return tuner.fit()

@@ -1,0 +1,33 @@
+"""PlacementGroupFactory: declarative trial resources (reference:
+python/ray/tune/execution/placement_groups.py:58)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class PlacementGroupFactory:
+    def __init__(self, bundles: List[Dict[str, float]],
+                 strategy: str = "PACK"):
+        if not bundles:
+            raise ValueError("need at least one bundle")
+        self.bundles = [dict(b) for b in bundles]
+        self.strategy = strategy
+
+    @property
+    def head_bundle(self) -> Dict[str, float]:
+        return self.bundles[0]
+
+    def create(self, name: str = ""):
+        from ray_tpu.util.placement_group import placement_group
+        return placement_group(self.bundles, strategy=self.strategy,
+                               name=name)
+
+    def __repr__(self):
+        return (f"PlacementGroupFactory({self.bundles}, "
+                f"strategy={self.strategy!r})")
+
+
+def resource_dict_to_pg_factory(resources: Dict) -> PlacementGroupFactory:
+    bundle = {k: v for k, v in (resources or {"CPU": 1}).items() if v}
+    return PlacementGroupFactory([bundle or {"CPU": 1}])

@@ -1,0 +1,314 @@
+"""Trial lifecycle + execution loop.
+
+Reference: python/ray/tune/execution/trial_runner.py:234 (TrialRunner,
+step :853) and ray_trial_executor.py:192 (trial actors inside placement
+groups).  One actor per trial, gang resources via a placement group; the
+driver loop waits on outstanding train() futures, feeds results to the
+scheduler/searcher, and performs checkpoint/PBT-exploit/fault-tolerance
+actions.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.execution.placement_groups import (
+    PlacementGroupFactory, resource_dict_to_pg_factory)
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+from ray_tpu.tune.trainable import DONE, TRAINING_ITERATION, Trainable
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class _TrialActor:
+    """The in-actor shell around a Trainable (reference: the Trainable IS
+    the actor in Ray; here the shell keeps the trainable class pickled
+    once per trial)."""
+
+    def __init__(self, trainable_cls, config, trial_id, trial_name,
+                 trial_dir):
+        self._t: Trainable = trainable_cls(
+            config=config, trial_id=trial_id, trial_name=trial_name,
+            trial_dir=trial_dir)
+
+    def train(self):
+        return self._t.train()
+
+    def save(self):
+        return self._t.save()
+
+    def restore(self, ckpt):
+        self._t.restore(ckpt)
+        return True
+
+    def reset(self, new_config):
+        return self._t.reset(new_config)
+
+    def stop(self):
+        self._t.stop()
+        return True
+
+
+class Trial:
+    def __init__(self, trainable_name: str, config: Dict,
+                 pg_factory: PlacementGroupFactory, trial_dir: str,
+                 stopping: Optional[Dict] = None):
+        self.trial_id = uuid.uuid4().hex[:8]
+        self.name = f"{trainable_name}_{self.trial_id}"
+        self.config = config
+        self.pg_factory = pg_factory
+        self.trial_dir = trial_dir
+        self.status = PENDING
+        self.actor = None
+        self.pg = None
+        self.last_result: Dict = {}
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[Exception] = None
+        self.num_failures = 0
+        self.pending_ref = None
+        self.stopping = stopping or {}
+
+    def should_stop(self, result: Dict) -> bool:
+        if result.get(DONE):
+            return True
+        for k, v in self.stopping.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def __repr__(self):
+        return f"Trial({self.name}, {self.status})"
+
+
+class TrialRunner:
+    def __init__(self, trainable_cls, *, param_space: Optional[Dict] = None,
+                 search_alg=None, scheduler=None, num_samples: int = 1,
+                 max_concurrent: int = 0, metric: Optional[str] = None,
+                 mode: str = "max", run_config: Optional[RunConfig] = None,
+                 pg_factory: Optional[PlacementGroupFactory] = None,
+                 trainable_name: str = "trainable"):
+        from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+        self.trainable_cls = trainable_cls
+        self.trainable_name = trainable_name
+        self.search_alg = search_alg or BasicVariantGenerator(
+            param_space or {}, num_samples=num_samples)
+        self.scheduler = scheduler or sched_mod.FIFOScheduler()
+        self.metric, self.mode = metric, mode
+        self.max_concurrent = max_concurrent or int(
+            os.environ.get("RT_TUNE_MAX_CONCURRENT", "8"))
+        self.run_config = run_config or RunConfig()
+        self.ckpt_config = (self.run_config.checkpoint_config
+                            or CheckpointConfig())
+        self.failure_config = (self.run_config.failure_config
+                               or FailureConfig())
+        self.pg_factory = pg_factory
+        base = self.run_config.storage_path or tempfile.mkdtemp(
+            prefix="rt_tune_")
+        self.experiment_dir = os.path.join(
+            base, self.run_config.name or f"exp_{uuid.uuid4().hex[:6]}")
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        self.trials: List[Trial] = []
+        self._stopping = self._normalize_stop(self.run_config.stop)
+
+    @staticmethod
+    def _normalize_stop(stop):
+        return dict(stop) if isinstance(stop, dict) else (stop or {})
+
+    # ---------------------------------------------------------------- setup
+    def _make_trial(self) -> Optional[Trial]:
+        cfg = self.search_alg.suggest(uuid.uuid4().hex[:8])
+        if cfg is None:
+            return None
+        pgf = self.pg_factory or resource_dict_to_pg_factory(
+            cfg.pop("__resources__", None) if isinstance(cfg, dict) else None)
+        trial = Trial(self.trainable_name, cfg, pgf, self.experiment_dir,
+                      stopping=self._stopping)
+        trial.trial_dir = os.path.join(self.experiment_dir, trial.name)
+        os.makedirs(trial.trial_dir, exist_ok=True)
+        self.trials.append(trial)
+        self.scheduler.on_trial_add(trial)
+        return trial
+
+    def _start_trial(self, trial: Trial, restore: bool = False):
+        pg = trial.pg_factory.create(name=f"pg_{trial.trial_id}")
+        ok = ray_tpu.wait_placement_group_ready(pg, timeout=120)
+        if not ok:
+            raise RuntimeError(f"placement group for {trial.name} not ready")
+        trial.pg = pg
+        head = trial.pg_factory.head_bundle
+        actor_cls = ray_tpu.remote(_TrialActor)
+        trial.actor = actor_cls.options(
+            num_cpus=head.get("CPU", 0),
+            resources={k: v for k, v in head.items() if k != "CPU"},
+            placement_group=pg, placement_group_bundle_index=0,
+        ).remote(self.trainable_cls, trial.config, trial.trial_id,
+                 trial.name, trial.trial_dir)
+        if restore and trial.checkpoint is not None:
+            ray_tpu.get(trial.actor.restore.remote(trial.checkpoint),
+                        timeout=300)
+        trial.status = RUNNING
+        trial.pending_ref = None
+
+    def _stop_trial(self, trial: Trial, status: str):
+        trial.status = status
+        if trial.actor is not None:
+            try:
+                ray_tpu.get(trial.actor.stop.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        if trial.pg is not None:
+            try:
+                from ray_tpu.util.placement_group import (
+                    remove_placement_group)
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
+
+    # ---------------------------------------------------------------- loop
+    _exhausted = False
+
+    def is_finished(self) -> bool:
+        active = any(t.status in (PENDING, RUNNING) for t in self.trials)
+        return not active and self._exhausted
+
+    def run(self, result_callback: Optional[Callable] = None) -> List[Trial]:
+        """Drive all trials to completion; returns the trial list."""
+        while True:
+            self._fill_trials()
+            running = [t for t in self.trials if t.status == RUNNING]
+            if not running and self._exhausted:
+                break
+            # Submit one train() per running trial without an outstanding
+            # future.
+            for t in running:
+                if t.pending_ref is None:
+                    t.pending_ref = t.actor.train.remote()
+            refs = [t.pending_ref for t in running]
+            by_ref = {t.pending_ref: t for t in running}
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=60.0)
+            for ref in ready:
+                trial = by_ref[ref]
+                trial.pending_ref = None
+                try:
+                    result = ray_tpu.get(ref, timeout=60.0)
+                except Exception as e:
+                    self._handle_failure(trial, e)
+                    continue
+                self._handle_result(trial, result, result_callback)
+            self._apply_exploits()
+        return self.trials
+
+    def _fill_trials(self):
+        while not self._exhausted and \
+                sum(t.status == RUNNING for t in self.trials) \
+                < self.max_concurrent:
+            trial = self._make_trial()
+            if trial is None:
+                self._exhausted = True
+                break
+            try:
+                self._start_trial(trial)
+            except Exception as e:
+                trial.error = e
+                trial.status = ERROR
+                if self.failure_config.fail_fast:
+                    raise
+
+    def _handle_result(self, trial: Trial, result: Dict,
+                       result_callback: Optional[Callable]):
+        # Merge so a bare final/done result doesn't erase reported metrics.
+        trial.last_result = {**trial.last_result, **result}
+        if result_callback is not None:
+            result_callback(trial, result)
+        self.search_alg.on_trial_result(trial.trial_id, result)
+        it = result.get(TRAINING_ITERATION, 0)
+        freq = self.ckpt_config.checkpoint_frequency
+        if freq and it % freq == 0 and not result.get(DONE):
+            try:
+                trial.checkpoint = ray_tpu.get(trial.actor.save.remote(),
+                                               timeout=300)
+            except Exception:
+                pass
+        if trial.should_stop(result):
+            decision = STOP
+        else:
+            decision = self.scheduler.on_trial_result(trial, result)
+        if decision == STOP:
+            if self.ckpt_config.checkpoint_at_end and trial.actor:
+                try:
+                    trial.checkpoint = ray_tpu.get(
+                        trial.actor.save.remote(), timeout=300)
+                except Exception:
+                    pass
+            self.search_alg.on_trial_complete(trial.trial_id, result)
+            self.scheduler.on_trial_complete(trial, result)
+            self._stop_trial(trial, TERMINATED)
+
+    def _handle_failure(self, trial: Trial, err: Exception):
+        trial.num_failures += 1
+        self._stop_trial(trial, ERROR)
+        trial.error = err
+        if trial.num_failures <= self.failure_config.max_failures:
+            # Restart from the last driver-held checkpoint.
+            try:
+                self._start_trial(trial, restore=True)
+                trial.error = None
+            except Exception as e:
+                trial.error = e
+        elif self.failure_config.fail_fast:
+            raise err
+        self.search_alg.on_trial_complete(trial.trial_id, error=True)
+
+    def _apply_exploits(self):
+        pbt = self.scheduler
+        exploits = getattr(pbt, "pending_exploits", None)
+        if not exploits:
+            return
+        by_id = {t.trial_id: t for t in self.trials}
+        for victim_id, donor_id in list(exploits.items()):
+            exploits.pop(victim_id)
+            victim, donor = by_id.get(victim_id), by_id.get(donor_id)
+            if not victim or not donor or victim.status != RUNNING \
+                    or donor.status != RUNNING:
+                continue
+            try:
+                if donor.pending_ref is not None:
+                    ray_tpu.get(donor.pending_ref, timeout=300)
+                    donor.pending_ref = donor.actor.train.remote()
+                ckpt = ray_tpu.get(donor.actor.save.remote(), timeout=300)
+                new_config = pbt.explore(donor.config)
+                if victim.pending_ref is not None:
+                    ray_tpu.get(victim.pending_ref, timeout=300)
+                    victim.pending_ref = None
+                ray_tpu.get(victim.actor.reset.remote(new_config),
+                            timeout=300)
+                ray_tpu.get(victim.actor.restore.remote(ckpt), timeout=300)
+                victim.config = new_config
+                victim.checkpoint = ckpt
+            except Exception:
+                continue
+
+
+def best_trial(trials: List[Trial], metric: str, mode: str = "max"):
+    done = [t for t in trials if t.last_result.get(metric) is not None]
+    if not done:
+        return None
+    key = lambda t: t.last_result[metric]  # noqa: E731
+    return max(done, key=key) if mode == "max" else min(done, key=key)

@@ -1,0 +1,178 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference: python/ray/tune/schedulers — ASHA (async_hyperband.py), PBT
+(pbt.py), median stopping (median_stopping_rule.py), FIFO (trial_scheduler
+.py).  Decision protocol mirrors the reference's TrialScheduler:
+on_trial_result -> CONTINUE | STOP | PAUSE-equivalent actions.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_add(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
+        pass
+
+    def choose_trial_to_run(self, trials) -> Optional[object]:
+        for t in trials:
+            if t.status == "PENDING":
+                return t
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving.  A trial reaching a rung
+    continues only if its score is in the top 1/reduction_factor of
+    results recorded at that rung so far."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.max_t, self.grace = max_t, grace_period
+        self.rf = reduction_factor
+        self._rungs: List[tuple] = []  # (level, {trial_id: score})
+        t = grace_period
+        while t < max_t:
+            self._rungs.append((t, {}))
+            t *= reduction_factor
+        self._rungs.sort(reverse=True)
+
+    def _score(self, result):
+        s = result.get(self.metric)
+        if s is None:
+            return None
+        return s if self.mode == "max" else -s
+
+    def on_trial_result(self, trial, result) -> str:
+        t = result.get("training_iteration", 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        for level, recorded in self._rungs:
+            if t < level or trial.trial_id in recorded:
+                continue
+            recorded[trial.trial_id] = score
+            vals = sorted(recorded.values(), reverse=True)
+            k = max(1, math.ceil(len(vals) / self.rf))
+            cutoff = vals[k - 1]
+            if score < cutoff:
+                return STOP
+        return CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same iteration (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric, self.mode = metric, mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._histories: Dict[str, List[float]] = {}
+
+    def _score(self, result):
+        s = result.get(self.metric)
+        return None if s is None else (s if self.mode == "max" else -s)
+
+    def on_trial_result(self, trial, result) -> str:
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        hist = self._histories.setdefault(trial.trial_id, [])
+        hist.append(score)
+        t = result.get("training_iteration", len(hist))
+        if t < self.grace:
+            return CONTINUE
+        other_avgs = [sum(h) / len(h)
+                      for tid, h in self._histories.items()
+                      if tid != trial.trial_id and h]
+        if len(other_avgs) < self.min_samples:
+            return CONTINUE
+        median = sorted(other_avgs)[len(other_avgs) // 2]
+        if max(hist) < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: at each perturbation interval, bottom-quantile trials clone the
+    state of top-quantile trials and perturb their hyperparams (reference:
+    schedulers/pbt.py).  The runner performs the actual exploit via the
+    (checkpoint, new_config) we return through `pbt_exploit`."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None):
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, float] = {}
+        self._rng = random.Random(seed)
+        self.pending_exploits: Dict[str, str] = {}  # victim -> donor
+
+    def _score(self, result):
+        s = result.get(self.metric)
+        return None if s is None else (s if self.mode == "max" else -s)
+
+    def on_trial_result(self, trial, result) -> str:
+        score = self._score(result)
+        if score is not None:
+            self._latest[trial.trial_id] = score
+        t = result.get("training_iteration", 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval or len(self._latest) < 2:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        ranked = sorted(self._latest, key=self._latest.get)
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial.trial_id in bottom:
+            donor = self._rng.choice(top)
+            if donor != trial.trial_id:
+                self.pending_exploits[trial.trial_id] = donor
+        return CONTINUE
+
+    def explore(self, config: Dict) -> Dict:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                new[key] = spec()
+            elif isinstance(spec, list):
+                new[key] = self._rng.choice(spec)
+            elif key in new:
+                new[key] = (new[key] * self._rng.choice([0.8, 1.2]))
+        return new
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand approximated by its asynchronous variant (the
+    reference ships both; ASHA dominates in practice)."""

@@ -1,0 +1,8 @@
+from ray_tpu.tune.search.sample import (  # noqa: F401
+    Categorical, Domain, Float, Function, Integer, Quantized,
+    choice, grid_search, loguniform, qrandint, quniform, randint,
+    sample_from, uniform,
+)
+from ray_tpu.tune.search.basic_variant import (  # noqa: F401
+    BasicVariantGenerator, Searcher,
+)

@@ -1,0 +1,86 @@
+"""Trial-variant generation: grid cross-product x sampled domains.
+
+Reference: python/ray/tune/search/basic_variant.py (BasicVariantGenerator)
+and search/searcher.py (Searcher interface for pluggable algorithms).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.search.sample import Domain
+
+
+class Searcher:
+    """Pluggable suggestion algorithm (reference: search/searcher.py).
+
+    Subclass and implement suggest/on_trial_complete for BO-style
+    algorithms; BasicVariantGenerator covers grid/random natively."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+def _find_grid_axes(space: Dict, prefix=()) -> List[tuple]:
+    axes = []
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+            axes.append((path, v["grid_search"]))
+        elif isinstance(v, dict):
+            axes.extend(_find_grid_axes(v, path))
+    return axes
+
+
+def _set_path(cfg: Dict, path: tuple, value):
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+def _resolve(space: Any, rng: random.Random):
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if isinstance(space, dict):
+        return {k: _resolve(v, rng) for k, v in space.items()}
+    return space
+
+
+class BasicVariantGenerator(Searcher):
+    """Expand grid_search axes into a cross-product; sample Domains for
+    each of num_samples repetitions."""
+
+    def __init__(self, param_space: Dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._space = param_space or {}
+        self._rng = random.Random(seed)
+        axes = _find_grid_axes(self._space)
+        grids = [list(vals) for _, vals in axes]
+        self._axes = [path for path, _ in axes]
+        combos = list(itertools.product(*grids)) if grids else [()]
+        self._queue: List[Dict] = []
+        for _ in range(num_samples):
+            for combo in combos:
+                cfg = _resolve(
+                    {k: v for k, v in self._space.items()}, self._rng)
+                for path, val in zip(self._axes, combo):
+                    _set_path(cfg, path, val)
+                self._queue.append(cfg)
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._queue)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if not self._queue:
+            return None
+        return self._queue.pop(0)

@@ -1,0 +1,172 @@
+"""Trainable: the unit of execution Tune schedules.
+
+Reference: python/ray/tune/trainable/trainable.py:64 (class API with
+step/save/restore) and trainable/function_trainable.py:315 (function API
+bridged through a report queue).  Here the function API runs the user
+callable in a thread whose `session.report` calls hand results to the
+driving actor one step at a time (backpressured, lossless).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air import session as air_session
+
+DONE = "done"
+TRAINING_ITERATION = "training_iteration"
+
+
+class Trainable:
+    """Class API: subclass with setup/step/save_checkpoint/load_checkpoint."""
+
+    def __init__(self, config: Optional[Dict] = None, trial_id: str = "",
+                 trial_name: str = "", trial_dir: str = ""):
+        self.config = config or {}
+        self.trial_id = trial_id
+        self.trial_name = trial_name
+        self.trial_dir = trial_dir
+        self._iteration = 0
+        self._start = time.time()
+        self.setup(self.config)
+
+    # -- user hooks ---------------------------------------------------
+    def setup(self, config: Dict) -> None:
+        pass
+
+    def step(self) -> Dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Optional[Dict]:
+        return None
+
+    def load_checkpoint(self, checkpoint: Optional[Dict]) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict) -> bool:
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- harness API --------------------------------------------------
+    def train(self) -> Dict:
+        result = self.step()
+        if not result.pop("_rt_sentinel", False):
+            self._iteration += 1
+        result.setdefault(TRAINING_ITERATION, self._iteration)
+        result.setdefault("trial_id", self.trial_id)
+        result.setdefault("time_total_s", time.time() - self._start)
+        result.setdefault(DONE, False)
+        return result
+
+    def save(self) -> Checkpoint:
+        data = self.save_checkpoint() or {}
+        data["_iteration"] = self._iteration
+        return Checkpoint.from_dict(data)
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self._iteration = data.pop("_iteration", 0)
+        self.load_checkpoint(data)
+
+    def reset(self, new_config: Dict) -> bool:
+        ok = self.reset_config(new_config)
+        if ok:
+            self.config = new_config
+        return ok
+
+    def stop(self) -> None:
+        self.cleanup()
+
+
+class FunctionTrainable(Trainable):
+    """Wraps `def train_fn(config)` using session.report for results."""
+
+    _fn: Callable = None  # set by wrap_function subclassing
+
+    def setup(self, config: Dict) -> None:
+        self._session: Optional[air_session._Session] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._fn_done = False
+        self._restore_checkpoint: Optional[Checkpoint] = None
+
+    def _runner(self):
+        air_session._set_session(self._session)
+        try:
+            self._fn(self.config)
+        except StopIteration:
+            pass
+        except BaseException as e:  # surfaced by train()
+            self._error = e
+            traceback.print_exc()
+        finally:
+            self._fn_done = True
+            self._session.result_queue.put(None)  # sentinel
+
+    def _ensure_started(self):
+        if self._thread is None:
+            self._session = air_session._Session(
+                trial_name=self.trial_name, trial_id=self.trial_id,
+                trial_dir=self.trial_dir,
+                checkpoint=self._restore_checkpoint)
+            self._thread = threading.Thread(target=self._runner, daemon=True)
+            self._thread.start()
+
+    def step(self) -> Dict:
+        self._ensure_started()
+        item = self._session.result_queue.get()
+        if item is None:
+            if self._error is not None:
+                raise self._error
+            return {DONE: True, "_rt_sentinel": True}
+        metrics, checkpoint = item
+        if checkpoint is not None:
+            self._latest_checkpoint = checkpoint
+        self._session.continue_event.set()
+        metrics.setdefault(DONE, False)
+        return metrics
+
+    _latest_checkpoint: Optional[Checkpoint] = None
+
+    def save_checkpoint(self) -> Optional[Dict]:
+        if self._latest_checkpoint is not None:
+            return {"_fn_ckpt": self._latest_checkpoint.to_dict()}
+        return None
+
+    def load_checkpoint(self, data: Optional[Dict]) -> None:
+        if data and "_fn_ckpt" in data:
+            self._restore_checkpoint = Checkpoint.from_dict(data["_fn_ckpt"])
+
+    def reset_config(self, new_config: Dict) -> bool:
+        # Tear the thread down; next step() restarts the fn fresh with the
+        # restored checkpoint (PBT exploit path).
+        if self._session is not None:
+            self._session.stop_requested = True
+            self._session.continue_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._thread = None
+        self._session = None
+        self._fn_done = False
+        self._error = None
+        return True
+
+    def cleanup(self) -> None:
+        self.reset_config(self.config)
+
+
+def wrap_function(train_fn: Callable) -> type:
+    """Build a FunctionTrainable subclass bound to `train_fn` (reference:
+    function_trainable.py:595 wrap_function)."""
+
+    class _Wrapped(FunctionTrainable):
+        _fn = staticmethod(train_fn)
+
+    _Wrapped.__name__ = getattr(train_fn, "__name__", "fn") + "_trainable"
+    return _Wrapped

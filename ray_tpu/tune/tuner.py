@@ -1,0 +1,114 @@
+"""Tuner: the experiment front door (reference: python/ray/tune/tuner.py:212
+Tuner.fit -> impl/tuner_internal.py:278 -> tune.py:129 tune.run)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune.execution.trial_runner import (
+    TERMINATED, Trial, TrialRunner, best_trial)
+from ray_tpu.tune.trainable import Trainable, wrap_function
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    search_alg: Any = None
+    scheduler: Any = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self._trials = trials
+        self._metric, self._mode = metric, mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self._trials[i]
+        return Result(metrics=t.last_result, checkpoint=t.checkpoint,
+                      error=t.error, path=t.trial_dir, config=t.config)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    @property
+    def errors(self):
+        return [t.error for t in self._trials if t.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            done = [t for t in self._trials if t.status == TERMINATED]
+            t = done[0] if done else self._trials[0]
+        else:
+            t = best_trial(self._trials, metric, mode)
+            if t is None:
+                raise ValueError(f"no trial reported metric {metric!r}")
+        return Result(metrics=t.last_result, checkpoint=t.checkpoint,
+                      error=t.error, path=t.trial_dir, config=t.config)
+
+    def get_dataframe(self):
+        import pandas as pd
+        return pd.DataFrame([{**t.last_result,
+                              **{f"config/{k}": v
+                                 for k, v in t.config.items()
+                                 if not isinstance(v, dict)}}
+                             for t in self._trials])
+
+
+class Tuner:
+    def __init__(self, trainable, *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._param_space = param_space or {}
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self._trainable_cls = trainable
+            self._name = trainable.__name__
+        elif callable(trainable):
+            self._trainable_cls = wrap_function(trainable)
+            self._name = getattr(trainable, "__name__", "fn")
+        else:
+            raise ValueError(f"cannot tune {trainable!r}")
+        self._pg_factory = getattr(trainable, "_pg_factory", None)
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        runner = TrialRunner(
+            self._trainable_cls,
+            param_space=self._param_space,
+            search_alg=tc.search_alg,
+            scheduler=tc.scheduler,
+            num_samples=tc.num_samples,
+            max_concurrent=tc.max_concurrent_trials,
+            metric=tc.metric, mode=tc.mode,
+            run_config=self._run_config,
+            pg_factory=self._pg_factory,
+            trainable_name=self._name)
+        trials = runner.run()
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+
+def with_resources(trainable, resources) -> Any:
+    """Attach trial resources (reference: tune/trainable/util.py
+    with_resources): dict {"CPU": n} or a PlacementGroupFactory."""
+    from ray_tpu.tune.execution.placement_groups import (
+        PlacementGroupFactory, resource_dict_to_pg_factory)
+    if isinstance(resources, PlacementGroupFactory):
+        pgf = resources
+    else:
+        pgf = resource_dict_to_pg_factory(resources)
+    trainable._pg_factory = pgf
+    return trainable

@@ -1,0 +1,44 @@
+"""AIR primitives: Checkpoint interconversion, configs (reference test
+style: python/ray/air/tests/test_checkpoints.py)."""
+
+import os
+
+import numpy as np
+
+from ray_tpu.air import Checkpoint, CheckpointConfig, RunConfig, ScalingConfig
+
+
+def test_checkpoint_dict_roundtrip(tmp_path):
+    ckpt = Checkpoint.from_dict({"w": 1, "arr": np.arange(3)})
+    d = ckpt.to_dict()
+    assert d["w"] == 1 and list(d["arr"]) == [0, 1, 2]
+    # dict -> dir -> dict
+    path = ckpt.to_directory(str(tmp_path / "c1"))
+    back = Checkpoint.from_directory(path).to_dict()
+    assert back["w"] == 1
+
+
+def test_checkpoint_bytes_and_uri(tmp_path):
+    ckpt = Checkpoint.from_dict({"x": 42})
+    assert Checkpoint.from_bytes(ckpt.to_bytes()).to_dict()["x"] == 42
+    uri = f"file://{tmp_path}/ck.tar"
+    ckpt.to_uri(uri)
+    assert Checkpoint.from_uri(uri).to_dict()["x"] == 42
+
+
+def test_checkpoint_pytree_roundtrip():
+    import jax.numpy as jnp
+    tree = {"a": jnp.ones((2, 2)), "b": [jnp.zeros(3)]}
+    ckpt = Checkpoint.from_pytree(tree, extra={"step": 7})
+    out = ckpt.to_pytree()
+    assert np.allclose(out["a"], 1.0) and np.allclose(out["b"][0], 0.0)
+    assert ckpt.extra()["step"] == 7
+
+
+def test_scaling_config_mesh_spec():
+    sc = ScalingConfig(num_workers=2, tp=2, sp=2)
+    spec = sc.mesh_spec(8)
+    assert spec.tp == 2 and spec.sp == 2 and spec.dp == 2
+    assert spec.world_size == 8
+    pgf = sc.as_placement_group_factory()
+    assert len(pgf.bundles) == 2

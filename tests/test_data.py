@@ -1,0 +1,122 @@
+"""Ray Data equivalent: blocks, transforms, shuffle, ingest (reference
+test style: python/ray/data/tests/test_dataset.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(ray_init):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+    assert ds.num_blocks() == 4
+
+
+def test_map_batches_and_filter(ray_init):
+    ds = rd.range(32, parallelism=4)
+    out = ds.map_batches(lambda b: [x * 2 for x in b],
+                         batch_format="pylist") \
+            .filter(lambda x: x % 4 == 0)
+    vals = out.take_all()
+    assert vals == [x * 2 for x in range(32) if (x * 2) % 4 == 0]
+
+
+def test_map_and_flat_map(ray_init):
+    ds = rd.from_items([1, 2, 3], parallelism=2)
+    assert sorted(ds.map(lambda x: x + 1).take_all()) == [2, 3, 4]
+    assert sorted(ds.flat_map(lambda x: [x, x]).take_all()) == \
+        [1, 1, 2, 2, 3, 3]
+
+
+def test_numpy_blocks_and_iter_batches(ray_init):
+    arr = np.arange(40, dtype=np.float32)
+    ds = rd.from_numpy(arr, parallelism=4)
+    assert ds.count() == 40
+    batches = list(ds.iter_batches(batch_size=16, batch_format="numpy"))
+    total = np.concatenate([b["data"] for b in batches])
+    assert np.array_equal(np.sort(total), arr)
+    assert batches[0]["data"].shape[0] == 16
+
+
+def test_random_shuffle_preserves_rows(ray_init):
+    ds = rd.range(64, parallelism=4).random_shuffle(seed=7)
+    vals = ds.take_all()
+    assert sorted(vals) == list(range(64))
+    assert vals != list(range(64))
+
+
+def test_sort_and_groupby(ray_init):
+    import pandas as pd
+    df = pd.DataFrame({"k": [1, 2, 1, 2, 3], "v": [5, 1, 3, 2, 9]})
+    ds = rd.from_pandas(df)
+    sorted_v = rd.from_pandas(df).sort("v").to_pandas()["v"].tolist()
+    assert sorted_v == [1, 2, 3, 5, 9]
+    counts = ds.groupby("k").count().to_pandas()
+    assert dict(zip(counts["k"], counts["count()"])) == {1: 2, 2: 2, 3: 1}
+    sums = ds.groupby("k").sum("v").to_pandas()
+    assert dict(zip(sums["k"], sums["v"])) == {1: 8, 2: 3, 3: 9}
+
+
+def test_split_and_union(ray_init):
+    ds = rd.range(30, parallelism=3)
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 30
+    u = shards[0].union(*shards[1:])
+    assert sorted(u.take_all()) == list(range(30))
+
+
+def test_repartition_and_limit(ray_init):
+    ds = rd.range(20, parallelism=2).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.limit(7).count() == 7
+
+
+def test_read_write_parquet_csv(ray_init, tmp_path):
+    import pandas as pd
+    df = pd.DataFrame({"a": range(10), "b": [x * x for x in range(10)]})
+    ds = rd.from_pandas(df)
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 10
+    assert back.sum("b") == sum(x * x for x in range(10))
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 10
+
+
+def test_aggregates(ray_init):
+    ds = rd.range(10, parallelism=2)
+    assert ds.sum() == 45
+    assert ds.min() == 0
+    assert ds.max() == 9
+    assert ds.mean() == 4.5
+
+
+def test_actor_pool_strategy(ray_init):
+    ds = rd.range(8, parallelism=4)
+    out = ds.map_batches(lambda b: [x + 100 for x in b],
+                         batch_format="pylist",
+                         compute=rd.ActorPoolStrategy(size=2))
+    assert sorted(out.take_all()) == [x + 100 for x in range(8)]
+
+
+def test_iter_jax_batches(ray_init):
+    import jax.numpy as jnp
+    ds = rd.from_numpy(np.arange(16, dtype=np.float32))
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert all(isinstance(b["data"], jnp.ndarray) for b in batches)
+
+
+def test_pipeline_repeat(ray_init):
+    pipe = rd.range(4, parallelism=2).repeat(3)
+    rows = list(pipe.iter_rows())
+    assert len(rows) == 12

@@ -1,0 +1,108 @@
+"""Tune: grid/random search, schedulers, checkpoints, fault handling
+(reference test style: python/ray/tune/tests/test_tune_*.py — real trial
+actors on an in-process cluster)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, CheckpointConfig
+from ray_tpu.tune import Tuner, TuneConfig
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_search_function_api(ray_init):
+    def objective(config):
+        score = config["a"] * 10 + config["b"]
+        tune.report({"score": score})
+
+    results = Tuner(
+        objective,
+        param_space={"a": tune.grid_search([1, 2]),
+                     "b": tune.grid_search([3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.metrics["score"] == 24
+    assert best.config == {"a": 2, "b": 4}
+
+
+def test_random_search_and_stop_criteria(ray_init):
+    def objective(config):
+        for i in range(100):
+            tune.report({"score": config["lr"] * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.1, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=2),
+        run_config=RunConfig(stop={"training_iteration": 3}),
+    ).fit()
+    assert len(results) == 2
+    for r in results:
+        assert r.metrics["training_iteration"] == 3
+
+
+def test_asha_stops_bad_trials(ray_init):
+    def objective(config):
+        for i in range(20):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1, 100])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(
+                metric="score", mode="max", max_t=20, grace_period=2,
+                reduction_factor=2)),
+    ).fit()
+    best = results.get_best_result()
+    assert best.config["q"] == 100
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in results)
+    assert iters[0] < 20  # the bad trial was early-stopped
+
+
+def test_checkpoint_at_end_and_class_api(ray_init):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config.get("start", 0)
+
+        def step(self):
+            self.x += 1
+            return {"score": self.x}
+
+        def save_checkpoint(self):
+            return {"x": self.x}
+
+        def load_checkpoint(self, data):
+            self.x = data["x"]
+
+    results = Tuner(
+        MyTrainable,
+        param_space={"start": 10},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            stop={"training_iteration": 4},
+            checkpoint_config=CheckpointConfig(checkpoint_at_end=True)),
+    ).fit()
+    best = results.get_best_result()
+    assert best.metrics["score"] == 14
+    assert best.checkpoint is not None
+    assert best.checkpoint.to_dict()["x"] == 14
+
+
+def test_tune_run_functional(ray_init):
+    def objective(config):
+        tune.report({"v": config["p"]})
+
+    results = tune.run(objective, config={"p": tune.grid_search([5, 6])},
+                       metric="v", mode="min")
+    assert results.get_best_result().metrics["v"] == 5
