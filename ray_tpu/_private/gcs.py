@@ -80,6 +80,7 @@ class ActorInfo:
         self.owner_conn_id = owner_conn_id
         self.job_id = job_id
         self.death_cause: str | None = None
+        self.init_error_blob: bytes | None = None
         self.pg_id = spec.get("placement_group_id")
 
     def view(self):
@@ -92,6 +93,7 @@ class ActorInfo:
             "num_restarts": self.num_restarts,
             "max_restarts": self.max_restarts,
             "death_cause": self.death_cause,
+            "init_error": self.init_error_blob,
             "class_name": self.spec.get("class_name"),
             "pid": self.spec.get("pid"),
         }
@@ -355,6 +357,18 @@ class GcsServer:
                 await asyncio.sleep(0.05)
                 continue
             if not reply.get("ok"):
+                if reply.get("init_error") is not None:
+                    # Deterministic failure inside the actor's __init__ /
+                    # class unpickle — retrying cannot help (reference:
+                    # GcsActorManager marks the actor DEAD on creation-task
+                    # failure, gcs_actor_manager.h:181-232).
+                    actor.state = DEAD
+                    actor.death_cause = reply.get("reason", "init failed")
+                    actor.init_error_blob = reply.get("init_error")
+                    await self._publish("actors", {"event": "dead",
+                                                   "actor": actor.view()})
+                    self._wake_actor_waiters(actor)
+                    return
                 await asyncio.sleep(0.02)
                 continue
             actor.node_id = node.node_id

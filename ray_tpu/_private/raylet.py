@@ -144,6 +144,15 @@ class Raylet:
                 # need it are leased TPU resources and may init jax then.
                 "JAX_PLATFORMS": os.environ.get("RT_WORKER_JAX_PLATFORMS", "cpu"),
             })
+            # The spawned `python -m ray_tpu...` must find the package even
+            # when this process imported it via a sys.path entry (script dir,
+            # editable layout) that subprocesses don't inherit.
+            import ray_tpu
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(ray_tpu.__file__)))
+            parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+            if pkg_root not in parts:
+                env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
             self._subproc_env = env
         return self._subproc_env
 
@@ -257,12 +266,22 @@ class Raylet:
                 if w.conn is not None and not w.conn.closed:
                     return w
             w = self._spawn_worker(kind)
-            try:
-                await asyncio.wait_for(w.registered.wait(),
-                                       cfg.worker_register_timeout_s)
-            except asyncio.TimeoutError:
-                await self._on_worker_dead(w, "worker failed to register")
-                return None
+            deadline = time.monotonic() + cfg.worker_register_timeout_s
+            while not w.registered.is_set():
+                if w.proc is not None and w.proc.poll() is not None:
+                    # Fast-fail: the process died during startup (bad env,
+                    # import error) — don't sit out the register timeout.
+                    await self._on_worker_dead(
+                        w, f"worker process exited rc={w.proc.returncode} "
+                           f"before registering")
+                    return None
+                if time.monotonic() >= deadline:
+                    await self._on_worker_dead(w, "worker failed to register")
+                    return None
+                try:
+                    await asyncio.wait_for(w.registered.wait(), 0.1)
+                except asyncio.TimeoutError:
+                    pass
             return w
 
     async def _on_worker_dead(self, w: WorkerHandle, reason: str):

@@ -111,8 +111,28 @@ def nested_refs_of(data) -> list[tuple]:
 
 
 def dumps_function(fn) -> bytes:
-    return cloudpickle.dumps(fn)
+    """Pickle a function/class plus the exporting process's sys.path.
+
+    cloudpickle serializes importable-module globals *by reference*; a
+    worker process can only resolve those if the defining module is on its
+    own sys.path. Drivers often have extra entries (pytest inserts the
+    test dir, scripts insert their own dir), so we ship the path list and
+    replay missing entries worker-side before unpickling (reference keeps
+    environments identical instead: python/ray/_private/function_manager.py).
+    """
+    import sys
+    payload = {"pickle": cloudpickle.dumps(fn),
+               "sys_path": [p for p in sys.path if p]}
+    return pickle.dumps(payload)
 
 
 def loads_function(data):
+    import os
+    import sys
+    payload = pickle.loads(data)
+    if isinstance(payload, dict) and "pickle" in payload:
+        for p in payload.get("sys_path") or []:
+            if p not in sys.path and os.path.isdir(p):
+                sys.path.append(p)
+        return cloudpickle.loads(payload["pickle"])
     return cloudpickle.loads(data)

@@ -503,7 +503,8 @@ class CoreWorker:
         if pg is not None:
             spec["pg_id"] = pg.id
             spec["bundle_index"] = opts.get("placement_group_bundle_index", -1)
-        self._pin_args(refs[0].id, args, kwargs)
+        if refs:  # num_returns=0 tasks have nothing to key pins on
+            self._pin_args(refs[0].id, args, kwargs)
         self._call(self._submit(spec))
         return refs
 
@@ -847,6 +848,8 @@ class CoreWorker:
 
     def _pack_results(self, result, spec):
         num_returns = spec["num_returns"]
+        if num_returns == 0:
+            return {"results": []}
         if num_returns == 1:
             values = [result]
         else:
@@ -998,7 +1001,8 @@ class CoreWorker:
             self.owned[oid] = entry
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
-        self._pin_args(refs[0].id, args, kwargs)
+        if refs:  # num_returns=0 methods have nothing to key pins on
+            self._pin_args(refs[0].id, args, kwargs)
         body = {
             "task_id": task_id,
             "method": method,
@@ -1051,7 +1055,7 @@ class CoreWorker:
                     return
                 except Exception:
                     pass
-            cause = ((view or {}).get("death_cause")
+            cause = (_death_cause_from_view(view)
                      if isinstance(e, protocol.ConnectionLost) else None) \
                 or str(e)
             err = rexc.ActorDiedError(actor_id, cause)
@@ -1083,7 +1087,7 @@ class CoreWorker:
             if view is None or view.get("addr") is None or \
                     view.get("state") != "ALIVE":
                 raise rexc.ActorDiedError(
-                    actor_id, (view or {}).get("death_cause") or "not found")
+                    actor_id, _death_cause_from_view(view) or "not found")
             actor_addr = tuple(view["addr"])
         if self._actor_addr_cache.get(actor_id) not in (None, tuple(actor_addr)):
             self._actor_seq[actor_id] = 0  # new incarnation, new stream
@@ -1161,6 +1165,24 @@ def _error_blob(exc: Exception, tb: str = "") -> bytes:
         blob, _ = serialization.serialize(
             _SerializedError(None, repr(exc), tb))
     return blob.to_bytes()
+
+
+def _death_cause_from_view(view) -> str | None:
+    """Human-readable death cause; appends the actor-init traceback shipped
+    by the executing worker (gcs ActorInfo.init_error_blob) when present."""
+    if not view:
+        return None
+    cause = view.get("death_cause")
+    blob = view.get("init_error")
+    if blob:
+        try:
+            se = serialization.deserialize(blob)
+            tb = getattr(se, "tb", "")
+            if tb:
+                cause = f"{cause or 'actor init failed'}\n{tb}"
+        except Exception:
+            pass
+    return cause
 
 
 def _is_system_error(e: Exception) -> bool:

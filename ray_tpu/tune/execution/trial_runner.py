@@ -48,6 +48,18 @@ class _TrialActor:
     def save(self):
         return self._t.save()
 
+    def latest_checkpoint(self):
+        """User-facing checkpoint for the trial Result: the most recent
+        session.report()-ed checkpoint for function trainables, else the
+        trainable's own save_checkpoint payload (reference Tune always
+        tracks the latest reported trial checkpoint)."""
+        lc = getattr(self._t, "_latest_checkpoint", None)
+        if lc is not None:
+            return lc
+        data = self._t.save_checkpoint()
+        from ray_tpu.air.checkpoint import Checkpoint as _C
+        return _C.from_dict(data) if data else None
+
     def restore(self, ckpt):
         self._t.restore(ckpt)
         return True
@@ -257,6 +269,16 @@ class TrialRunner:
                         trial.actor.save.remote(), timeout=300)
                 except Exception:
                     pass
+            elif trial.actor:
+                # Terminal: expose the latest reported checkpoint in the
+                # Result even without an explicit checkpoint config.
+                try:
+                    ckpt = ray_tpu.get(
+                        trial.actor.latest_checkpoint.remote(), timeout=300)
+                    if ckpt is not None:
+                        trial.checkpoint = ckpt
+                except Exception:
+                    pass
             self.search_alg.on_trial_complete(trial.trial_id, result)
             self.scheduler.on_trial_complete(trial, result)
             self._stop_trial(trial, TERMINATED)
@@ -290,13 +312,27 @@ class TrialRunner:
                 continue
             try:
                 if donor.pending_ref is not None:
-                    ray_tpu.get(donor.pending_ref, timeout=300)
+                    # The in-flight result must go through the normal result
+                    # path: silently dropping it loses metrics and — if it
+                    # was the fn's final report — leaves the resubmitted
+                    # train() blocked on an already-consumed sentinel.
+                    res = ray_tpu.get(donor.pending_ref, timeout=300)
+                    donor.pending_ref = None
+                    self._handle_result(donor, res, None)
+                    if donor.status != RUNNING:
+                        continue
                     donor.pending_ref = donor.actor.train.remote()
                 ckpt = ray_tpu.get(donor.actor.save.remote(), timeout=300)
                 new_config = pbt.explore(donor.config)
                 if victim.pending_ref is not None:
-                    ray_tpu.get(victim.pending_ref, timeout=300)
+                    # Same rule as the donor: in-flight results go through
+                    # the result path so metrics reach searcher/scheduler
+                    # and a DONE trial completes instead of being exploited.
+                    res = ray_tpu.get(victim.pending_ref, timeout=300)
                     victim.pending_ref = None
+                    self._handle_result(victim, res, None)
+                    if victim.status != RUNNING:
+                        continue
                 ray_tpu.get(victim.actor.reset.remote(new_config),
                             timeout=300)
                 ray_tpu.get(victim.actor.restore.remote(ckpt), timeout=300)

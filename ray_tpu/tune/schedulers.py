@@ -70,12 +70,21 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if score is None:
             return CONTINUE
         for level, recorded in self._rungs:
-            if t < level or trial.trial_id in recorded:
+            if t < level:
                 continue
-            recorded[trial.trial_id] = score
+            # Record at first arrival, then keep re-evaluating the recorded
+            # score against the rung's current cutoff on later results:
+            # under lockstep execution a bad trial can be first to every
+            # rung (cutoff == itself), so a record-time-only check never
+            # stops it (reference ASHA compares against the live rung).
+            if trial.trial_id not in recorded:
+                recorded[trial.trial_id] = score
             vals = sorted(recorded.values(), reverse=True)
             k = max(1, math.ceil(len(vals) / self.rf))
             cutoff = vals[k - 1]
+            # Judge the trial's *current* score, not its frozen rung record:
+            # a trial that improved since passing the rung must not be
+            # killed retroactively on its old milestone score.
             if score < cutoff:
                 return STOP
         return CONTINUE

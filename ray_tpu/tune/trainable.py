@@ -120,6 +120,12 @@ class FunctionTrainable(Trainable):
 
     def step(self) -> Dict:
         self._ensure_started()
+        if self._fn_done and self._session.result_queue.empty():
+            # The fn already finished and its sentinel was consumed by an
+            # earlier step(); blocking on the queue would hang forever.
+            if self._error is not None:
+                raise self._error
+            return {DONE: True, "_rt_sentinel": True}
         item = self._session.result_queue.get()
         if item is None:
             if self._error is not None:
