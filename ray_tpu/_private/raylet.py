@@ -197,16 +197,32 @@ class Raylet:
             asyncio.get_running_loop().create_task(self._await_prestart(w))
 
     async def _await_prestart(self, w: WorkerHandle):
-        try:
-            await asyncio.wait_for(w.registered.wait(),
-                                   cfg.worker_register_timeout_s)
-        except asyncio.TimeoutError:
-            await self._on_worker_dead(w, "prestarted worker never registered")
+        if not await self._wait_registered(w):
             return
         if w.lease_id is None and w not in self.idle_workers[w.kind]:
             w.last_idle = time.monotonic()
             self.idle_workers[w.kind].append(w)
             self._kick_scheduler()
+
+    async def _wait_registered(self, w: WorkerHandle) -> bool:
+        """Wait for a spawned worker to register, fast-failing if its
+        process dies during startup (bad env, import error) instead of
+        sitting out the full register timeout."""
+        deadline = time.monotonic() + cfg.worker_register_timeout_s
+        while not w.registered.is_set():
+            if w.proc is not None and w.proc.poll() is not None:
+                await self._on_worker_dead(
+                    w, f"worker process exited rc={w.proc.returncode} "
+                       f"before registering")
+                return False
+            if time.monotonic() >= deadline:
+                await self._on_worker_dead(w, "worker failed to register")
+                return False
+            try:
+                await asyncio.wait_for(w.registered.wait(), 0.1)
+            except asyncio.TimeoutError:
+                pass
+        return True
 
     def _spawn_worker(self, kind: str = "cpu") -> WorkerHandle:
         worker_id = WorkerID.from_random()
@@ -266,22 +282,8 @@ class Raylet:
                 if w.conn is not None and not w.conn.closed:
                     return w
             w = self._spawn_worker(kind)
-            deadline = time.monotonic() + cfg.worker_register_timeout_s
-            while not w.registered.is_set():
-                if w.proc is not None and w.proc.poll() is not None:
-                    # Fast-fail: the process died during startup (bad env,
-                    # import error) — don't sit out the register timeout.
-                    await self._on_worker_dead(
-                        w, f"worker process exited rc={w.proc.returncode} "
-                           f"before registering")
-                    return None
-                if time.monotonic() >= deadline:
-                    await self._on_worker_dead(w, "worker failed to register")
-                    return None
-                try:
-                    await asyncio.wait_for(w.registered.wait(), 0.1)
-                except asyncio.TimeoutError:
-                    pass
+            if not await self._wait_registered(w):
+                return None
             return w
 
     async def _on_worker_dead(self, w: WorkerHandle, reason: str):
@@ -532,11 +534,8 @@ class Raylet:
 
     async def _finish_spawn(self, w: WorkerHandle):
         try:
-            await asyncio.wait_for(w.registered.wait(),
-                                   cfg.worker_register_timeout_s)
-        except asyncio.TimeoutError:
-            await self._on_worker_dead(w, "worker failed to register")
-            return
+            if not await self._wait_registered(w):
+                return
         finally:
             self._spawns_outstanding -= 1
         if w.lease_id is None and w not in self.idle_workers[w.kind]:

@@ -130,9 +130,7 @@ def loads_function(data):
     import os
     import sys
     payload = pickle.loads(data)
-    if isinstance(payload, dict) and "pickle" in payload:
-        for p in payload.get("sys_path") or []:
-            if p not in sys.path and os.path.isdir(p):
-                sys.path.append(p)
-        return cloudpickle.loads(payload["pickle"])
-    return cloudpickle.loads(data)
+    for p in payload.get("sys_path") or []:
+        if p not in sys.path and os.path.isdir(p):
+            sys.path.append(p)
+    return cloudpickle.loads(payload["pickle"])

@@ -503,20 +503,21 @@ class CoreWorker:
         if pg is not None:
             spec["pg_id"] = pg.id
             spec["bundle_index"] = opts.get("placement_group_bundle_index", -1)
-        if refs:  # num_returns=0 tasks have nothing to key pins on
-            self._pin_args(refs[0].id, args, kwargs)
+        self._pin_args(task_id, args, kwargs)
         self._call(self._submit(spec))
         return refs
 
-    def _pin_args(self, key: ObjectID, args, kwargs):
+    def _pin_args(self, task_id, args, kwargs):
+        """Keep ObjectRef args alive until the task completes.  Keyed by
+        task_id so num_returns=0 (fire-and-forget) tasks pin too."""
         pins = [a for a in args if isinstance(a, ObjectRef)]
         pins += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
         if pins:
-            self._arg_pins[key] = pins
+            self._arg_pins[task_id] = pins
 
-    def _unpin_args(self, return_ids):
-        if return_ids:
-            self._arg_pins.pop(return_ids[0], None)
+    def _unpin_args(self, task_id):
+        if task_id is not None:
+            self._arg_pins.pop(task_id, None)
 
     def _pack_args(self, args, kwargs):
         new_args = [(_RefArg(a) if isinstance(a, ObjectRef) else a)
@@ -642,7 +643,7 @@ class CoreWorker:
             self._complete_with_error(spec, exc)
 
     def _complete_with_error(self, spec, exc):
-        self._unpin_args(spec.get("return_ids"))
+        self._unpin_args(spec.get("task_id"))
         blob = _error_blob(exc if isinstance(exc, Exception)
                            else rexc.RayTpuError(str(exc)))
         for oid in spec["return_ids"]:
@@ -758,7 +759,7 @@ class CoreWorker:
             pass
 
     def _record_results(self, spec, reply):
-        self._unpin_args(spec.get("return_ids"))
+        self._unpin_args(spec.get("task_id"))
         if "error" in reply:
             blob = reply["error"]
             for oid in spec["return_ids"]:
@@ -1001,8 +1002,7 @@ class CoreWorker:
             self.owned[oid] = entry
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
-        if refs:  # num_returns=0 methods have nothing to key pins on
-            self._pin_args(refs[0].id, args, kwargs)
+        self._pin_args(task_id, args, kwargs)
         body = {
             "task_id": task_id,
             "method": method,
@@ -1037,7 +1037,8 @@ class CoreWorker:
         try:
             fut = await self._actor_send(actor_id, actor_addr, body)
             reply = await fut
-            self._record_results({"return_ids": body["return_ids"]}, reply)
+            self._record_results({"task_id": body["task_id"],
+                                  "return_ids": body["return_ids"]}, reply)
             return
         except Exception as e:
             # Actor may be restarting; re-resolve its address from the GCS
@@ -1051,7 +1052,8 @@ class CoreWorker:
                                                  tuple(view["addr"]), body)
                     reply = await fut
                     self._record_results(
-                        {"return_ids": body["return_ids"]}, reply)
+                        {"task_id": body["task_id"],
+                         "return_ids": body["return_ids"]}, reply)
                     return
                 except Exception:
                     pass
@@ -1060,7 +1062,7 @@ class CoreWorker:
                 or str(e)
             err = rexc.ActorDiedError(actor_id, cause)
             blob = _error_blob(err)
-            self._unpin_args(body["return_ids"])
+            self._unpin_args(body["task_id"])
             for oid in body["return_ids"]:
                 entry = self.owned.get(oid)
                 if entry is not None:

@@ -42,6 +42,9 @@ class _TrialActor:
             config=config, trial_id=trial_id, trial_name=trial_name,
             trial_dir=trial_dir)
 
+    def ping(self):
+        return True
+
     def train(self):
         return self._t.train()
 
@@ -57,8 +60,7 @@ class _TrialActor:
         if lc is not None:
             return lc
         data = self._t.save_checkpoint()
-        from ray_tpu.air.checkpoint import Checkpoint as _C
-        return _C.from_dict(data) if data else None
+        return Checkpoint.from_dict(data) if data else None
 
     def restore(self, ckpt):
         self._t.restore(ckpt)
@@ -166,6 +168,11 @@ class TrialRunner:
             placement_group=pg, placement_group_bundle_index=0,
         ).remote(self.trainable_cls, trial.config, trial.trial_id,
                  trial.name, trial.trial_dir)
+        # Block until the actor is live: concurrently-started trials must
+        # begin training at the same wall-clock time, or schedulers that
+        # compare trials at a rung (ASHA) can watch one trial sprint to
+        # completion while its peer's worker is still cold-starting.
+        ray_tpu.get(trial.actor.ping.remote(), timeout=120)
         if restore and trial.checkpoint is not None:
             ray_tpu.get(trial.actor.restore.remote(trial.checkpoint),
                         timeout=300)

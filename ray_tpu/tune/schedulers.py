@@ -72,20 +72,22 @@ class AsyncHyperBandScheduler(TrialScheduler):
         for level, recorded in self._rungs:
             if t < level:
                 continue
-            # Record at first arrival, then keep re-evaluating the recorded
-            # score against the rung's current cutoff on later results:
-            # under lockstep execution a bad trial can be first to every
-            # rung (cutoff == itself), so a record-time-only check never
-            # stops it (reference ASHA compares against the live rung).
-            if trial.trial_id not in recorded:
-                recorded[trial.trial_id] = score
+            # Record at first arrival, then keep re-evaluating on later
+            # results: under lockstep execution a bad trial can be first to
+            # every rung (cutoff == itself), so a record-time-only check
+            # never stops it.  The record tracks the trial's running best
+            # at/after the rung, and the trial is judged on that record —
+            # never on a dipping live score — so the rung leader can't be
+            # stopped by its own cutoff, while trials strictly outside the
+            # top 1/rf of the rung's records are stopped as soon as enough
+            # peers record (successive-halving rule, applied continuously).
+            prev = recorded.get(trial.trial_id)
+            recorded[trial.trial_id] = score if prev is None \
+                else max(prev, score)
             vals = sorted(recorded.values(), reverse=True)
             k = max(1, math.ceil(len(vals) / self.rf))
             cutoff = vals[k - 1]
-            # Judge the trial's *current* score, not its frozen rung record:
-            # a trial that improved since passing the rung must not be
-            # killed retroactively on its old milestone score.
-            if score < cutoff:
+            if recorded[trial.trial_id] < cutoff:
                 return STOP
         return CONTINUE
 
