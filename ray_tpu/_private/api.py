@@ -177,14 +177,14 @@ def kill(actor, *, no_restart=True):
     if not isinstance(actor, ActorHandle):
         raise TypeError("ray_tpu.kill() expects an actor handle")
     w = _worker()
-    w._run(w.gcs.request("kill_actor", {"actor_id": actor._ray_actor_id,
+    w._run(w._gcs_request("kill_actor", {"actor_id": actor._ray_actor_id,
                                         "no_restart": no_restart}))
 
 
 def get_actor(name: str, namespace: str = "default"):
     from ray_tpu.actor import ActorHandle
     w = _worker()
-    view = w._run(w.gcs.request("get_named_actor",
+    view = w._run(w._gcs_request("get_named_actor",
                                 {"name": name, "namespace": namespace}))
     if view is None:
         raise ValueError(f"no actor named '{name}'")
@@ -195,7 +195,7 @@ def get_actor(name: str, namespace: str = "default"):
 def nodes():
     w = _worker()
     out = []
-    for v in w._run(w.gcs.request("get_nodes", {})):
+    for v in w._run(w._gcs_request("get_nodes", {})):
         out.append({
             "NodeID": v["node_id"].hex(),
             "Alive": v["alive"],
@@ -210,17 +210,17 @@ def nodes():
 
 def cluster_resources():
     w = _worker()
-    return w._run(w.gcs.request("cluster_resources", {}))["total"]
+    return w._run(w._gcs_request("cluster_resources", {}))["total"]
 
 
 def available_resources():
     w = _worker()
-    return w._run(w.gcs.request("cluster_resources", {}))["available"]
+    return w._run(w._gcs_request("cluster_resources", {}))["available"]
 
 
 def wait_placement_group_ready(pg, timeout: float = 60.0) -> bool:
     w = _worker()
-    view = w._run(w.gcs.request("wait_placement_group",
+    view = w._run(w._gcs_request("wait_placement_group",
                                 {"pg_id": pg.id, "timeout": timeout}))
     return view is not None and view["state"] == "CREATED"
 
@@ -259,5 +259,31 @@ def get_runtime_context() -> RuntimeContext:
     return RuntimeContext(_worker())
 
 
-def timeline():
-    return []
+def timeline(filename: str | None = None):
+    """Chrome-trace events for every process in the cluster (reference:
+    `ray timeline`, python/ray/_private/state.py chrome_tracing_dump —
+    events aggregated from the per-process telemetry pushed to the GCS
+    KV)."""
+    import json
+    import pickle
+    w = _worker()
+    keys = w._run(w._gcs_request("kv_keys",
+                                 {"ns": "telemetry", "prefix": b""}))["keys"]
+    events = []
+    for key in keys:
+        blob = w._run(w._gcs_request("kv_get",
+                                     {"ns": "telemetry",
+                                      "key": key}))["value"]
+        if blob is None:
+            continue
+        try:
+            events.extend(pickle.loads(blob).get("profile", []))
+        except Exception:
+            continue
+    # The driver's own events never round-trip through the KV push delay.
+    events.extend(w._profile_events)
+    events.sort(key=lambda e: e.get("ts", 0))
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -44,6 +44,9 @@ class _Config:
 
     # --- tasks / actors ---
     max_task_retries_default = _def("max_task_retries_default", int, 3)
+    # Lineage reconstruction attempts per lost object (reference:
+    # ray_config_def.h task_max_retries semantics for object recovery).
+    max_object_reconstructions = _def("max_object_reconstructions", int, 3)
     actor_max_restarts_default = _def("actor_max_restarts_default", int, 0)
     task_queue_warn_len = _def("task_queue_warn_len", int, 100000)
 

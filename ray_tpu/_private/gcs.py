@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 
 from ray_tpu._private import protocol
@@ -121,7 +122,7 @@ class PlacementGroupInfo:
 
 
 class GcsServer:
-    def __init__(self, host="127.0.0.1"):
+    def __init__(self, host="127.0.0.1", persist_path: str | None = None):
         self.host = host
         self.server = protocol.RpcServer(self._handle, host=host, name="gcs",
                                          on_disconnect=self._on_disconnect)
@@ -137,12 +138,111 @@ class GcsServer:
         self._node_waiters: list[asyncio.Future] = []
         self._drivers: dict[int, dict] = {}  # conn-id -> {job_id}
         self._start_time = time.time()
+        # Persistence (reference: gcs/store_client/redis_store_client.h:28 —
+        # table storage that survives GCS restart; here a pickle snapshot).
+        self._persist_path = persist_path
+        if persist_path:
+            self._load_snapshot()
 
     async def start(self, port=0):
         port = await self.server.start(port)
-        asyncio.get_running_loop().create_task(self._liveness_loop())
+        self._bg_tasks = [
+            asyncio.get_running_loop().create_task(self._liveness_loop())]
+        if self._persist_path:
+            self._bg_tasks.append(
+                asyncio.get_running_loop().create_task(
+                    self._snapshot_loop()))
         logger.info("GCS listening on %s:%s", self.host, port)
         return port
+
+    async def stop(self):
+        for t in getattr(self, "_bg_tasks", []):
+            t.cancel()
+        await self.server.stop()
+
+    # ----------------------------------------------------------- persistence
+    # KV namespaces that are ephemeral push-streams, not recovery state —
+    # excluded from snapshots (they would dominate the write cost).
+    _EPHEMERAL_KV_NS = ("telemetry",)
+
+    def _snapshot_state(self) -> dict:
+        """Copy the durable tables.  MUST run on the event-loop thread
+        (concurrent RPCs mutate these dicts); the pickle+write then happens
+        off-loop on the copies."""
+        return {
+            "kv": {ns: dict(d) for ns, d in self.kv.items()
+                   if ns not in self._EPHEMERAL_KV_NS},
+            "named_actors": dict(self.named_actors),
+            "jobs": dict(self.jobs),
+            "actors": [
+                {"actor_id": a.actor_id, "spec": dict(a.spec),
+                 "state": a.state, "addr": a.addr, "node_id": a.node_id,
+                 "worker_id": a.worker_id, "num_restarts": a.num_restarts,
+                 "death_cause": a.death_cause, "job_id": a.job_id}
+                for a in self.actors.values()
+            ],
+            "placement_groups": [
+                {"pg_id": p.pg_id, "bundles": list(p.bundles),
+                 "strategy": p.strategy, "name": p.name,
+                 "job_id": p.job_id, "state": p.state,
+                 "bundle_nodes": list(p.bundle_nodes)}
+                for p in self.placement_groups.values()
+            ],
+        }
+
+    def _write_snapshot(self, state: dict):
+        import pickle
+        tmp = self._persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self._persist_path)
+
+    def _load_snapshot(self):
+        import pickle
+        if not os.path.exists(self._persist_path):
+            return
+        try:
+            with open(self._persist_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception as e:
+            logger.warning("GCS snapshot load failed: %s", e)
+            return
+        self.kv = snap.get("kv", {})
+        self.named_actors = dict(snap.get("named_actors", {}))
+        self.jobs = dict(snap.get("jobs", {}))
+        for a in snap.get("actors", []):
+            info = ActorInfo(a["actor_id"], a["spec"], None, a["job_id"])
+            info.state = a["state"]
+            info.addr = a["addr"]
+            info.node_id = a["node_id"]
+            info.worker_id = a["worker_id"]
+            info.num_restarts = a["num_restarts"]
+            info.death_cause = a["death_cause"]
+            self.actors[info.actor_id] = info
+        for p in snap.get("placement_groups", []):
+            info = PlacementGroupInfo(p["pg_id"], p["bundles"],
+                                      p["strategy"], p["name"], p["job_id"])
+            info.state = p["state"]
+            info.bundle_nodes = p["bundle_nodes"]
+            self.placement_groups[info.pg_id] = info
+        logger.info("GCS restored %d actors / %d PGs / %d kv namespaces "
+                    "from %s", len(self.actors), len(self.placement_groups),
+                    len(self.kv), self._persist_path)
+
+    async def _snapshot_loop(self):
+        # Unconditional periodic snapshot: the tables are small (KV +
+        # actor/PG records) and a fixed cadence catches internal state
+        # transitions (actor ALIVE, PG CREATED) without instrumenting
+        # every mutation site.
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                state = self._snapshot_state()  # copy on the loop thread
+                await loop.run_in_executor(None, self._write_snapshot,
+                                           state)
+            except Exception as e:
+                logger.warning("GCS snapshot write failed: %s", e)
 
     # ------------------------------------------------------------------ rpc
     async def _handle(self, conn, method, body):
@@ -182,7 +282,24 @@ class GcsServer:
             node.available_resources = body["available"]
         if "load" in body:
             node.load = body["load"]
+        node.pending_shapes = body.get("pending_shapes", [])
         return {"ok": True}
+
+    async def rpc_get_resource_demands(self, conn, body):
+        """Aggregate demand for the autoscaler: queued lease shapes from
+        every raylet + unplaced placement-group bundles (reference:
+        LoadMetrics + pending PG demand in autoscaler.py:346)."""
+        shapes = []
+        for n in self.nodes.values():
+            if n.alive:
+                shapes.extend(getattr(n, "pending_shapes", []))
+        pending_pgs = []
+        for pg in self.placement_groups.values():
+            if pg.state in ("PENDING", "INFEASIBLE", "RESCHEDULING"):
+                pending_pgs.append({"pg_id": pg.pg_id,
+                                    "bundles": pg.bundles,
+                                    "strategy": pg.strategy})
+        return {"shapes": shapes, "pending_pgs": pending_pgs}
 
     async def rpc_get_nodes(self, conn, body):
         return [n.view() for n in self.nodes.values()]
@@ -649,12 +766,13 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--persist-path", default=None)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(levelname)s %(message)s")
 
     async def run():
-        gcs = GcsServer(host=args.host)
+        gcs = GcsServer(host=args.host, persist_path=args.persist_path)
         port = await gcs.start(args.port)
         print(f"GCS_PORT={port}", flush=True)
         sys.stdout.flush()

@@ -1,0 +1,69 @@
+"""Log monitor: tail worker log files and publish lines to GCS pubsub.
+
+Reference: python/ray/_private/log_monitor.py:100 — LogMonitor tails every
+worker log on its node and publishes via GCS pubsub; the driver mirrors
+the lines to its own stderr.  Here the monitor is a coroutine inside each
+raylet (one per node, like the reference's per-node process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+MAX_LINES_PER_TICK = 200
+
+
+class LogMonitor:
+    def __init__(self, logs_dir: str, publish, node_id_hex: str):
+        """publish: async callable(channel, message)."""
+        self.logs_dir = logs_dir
+        self.publish = publish
+        self.node_id_hex = node_id_hex
+        self._offsets: dict[str, int] = {}
+        self._stopped = False
+
+    async def run(self, period_s: float = 0.3):
+        while not self._stopped:
+            try:
+                await self.tick()
+            except Exception as e:
+                logger.debug("log monitor tick failed: %s", e)
+            await asyncio.sleep(period_s)
+
+    async def tick(self):
+        for path in glob.glob(os.path.join(self.logs_dir, "worker-*.log")):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._offsets.get(path, 0)
+            if size <= off:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(512 * 1024)
+            except OSError:
+                continue
+            # Only ship complete lines; carry partials to the next tick.
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                continue
+            self._offsets[path] = off + cut + 1
+            lines = chunk[:cut].decode("utf-8", "replace").splitlines()
+            if not lines:
+                continue
+            worker = os.path.basename(path)[len("worker-"):-len(".log")]
+            await self.publish("logs", {
+                "node": self.node_id_hex,
+                "worker": worker,
+                "lines": lines[:MAX_LINES_PER_TICK],
+            })
+
+    def stop(self):
+        self._stopped = True

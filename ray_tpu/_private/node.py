@@ -137,7 +137,8 @@ class InProcessNode:
     async def _start_async(self):
         if self.head:
             from ray_tpu._private.gcs import GcsServer
-            self.gcs_server = GcsServer()
+            self.gcs_server = GcsServer(persist_path=os.path.join(
+                self.session_dir, "gcs_snapshot.pkl"))
             port = await self.gcs_server.start(0)
             self.gcs_addr = ("127.0.0.1", port)
         from ray_tpu._private.raylet import Raylet
@@ -160,7 +161,7 @@ class InProcessNode:
             if self.raylet is not None:
                 await self.raylet.shutdown()
             if stop_gcs and self.gcs_server is not None:
-                await self.gcs_server.server.stop()
+                await self.gcs_server.stop()
         try:
             asyncio.run_coroutine_threadsafe(_kill(), self.loop).result(10)
         except Exception:

@@ -256,9 +256,12 @@ class RpcServer:
     async def stop(self):
         if self._server is not None:
             self._server.close()
+        # Close live conns BEFORE wait_closed: in py3.12 wait_closed blocks
+        # until every transport the server spawned has closed.
+        for conn in list(self.connections):
+            await conn.close()
+        if self._server is not None:
             try:
                 await self._server.wait_closed()
             except Exception:
                 pass
-        for conn in list(self.connections):
-            await conn.close()

@@ -1,0 +1,146 @@
+"""Core-runtime microbenchmarks, mirroring the reference's harness.
+
+Reference: python/ray/_private/ray_perf.py:93 — the numbers recorded in
+release/release_logs/1.13.0/microbenchmark.json (BASELINE.md) were made by
+this style of loop: time N operations end-to-end through the runtime and
+report ops/s.  Run: `python -m ray_tpu._private.ray_perf [--quick]`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name, fn, multiplier=1, results=None):
+    # Warmup.
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < MIN_SECONDS:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"{name}: {rate:.2f} /s")
+    if results is not None:
+        results[name] = rate
+    return rate
+
+
+MIN_SECONDS = 2.0
+BATCH = 100
+
+
+@ray_tpu.remote
+def noop():
+    return None
+
+
+@ray_tpu.remote
+def small(x):
+    return x
+
+
+@ray_tpu.remote
+class Actor:
+    def noop(self):
+        return None
+
+
+@ray_tpu.remote
+class AsyncActor:
+    async def noop(self):
+        return None
+
+
+@ray_tpu.remote
+class Client:
+    """Driver-in-an-actor for n:n scenarios."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def batch_calls(self, n):
+        ray_tpu.get([self.peer.noop.remote() for _ in range(n)],
+                    timeout=120)
+        return n
+
+    def batch_tasks(self, n):
+        ray_tpu.get([noop.remote() for _ in range(n)], timeout=120)
+        return n
+
+
+def main(quick: bool = False):
+    global MIN_SECONDS
+    if quick:
+        MIN_SECONDS = 0.5
+    results: dict = {}
+    ray_tpu.init(ignore_reinit_error=True)
+
+    # --- tasks ----------------------------------------------------------
+    timeit("single_client_tasks_sync",
+           lambda: ray_tpu.get(noop.remote(), timeout=60), 1, results)
+    timeit("single_client_tasks_async",
+           lambda: ray_tpu.get([noop.remote() for _ in range(BATCH)],
+                               timeout=120), BATCH, results)
+
+    # --- actors ---------------------------------------------------------
+    a = Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+    timeit("actor_calls_1_1_sync",
+           lambda: ray_tpu.get(a.noop.remote(), timeout=60), 1, results)
+    timeit("actor_calls_1_1_async",
+           lambda: ray_tpu.get([a.noop.remote() for _ in range(BATCH)],
+                               timeout=120), BATCH, results)
+    aa = AsyncActor.remote()
+    ray_tpu.get(aa.noop.remote(), timeout=60)
+    timeit("async_actor_calls_1_1",
+           lambda: ray_tpu.get([aa.noop.remote() for _ in range(BATCH)],
+                               timeout=120), BATCH, results)
+
+    # 1:n — one driver, n actors.
+    n = 4
+    actors = [Actor.remote() for _ in range(n)]
+    ray_tpu.get([x.noop.remote() for x in actors], timeout=120)
+    timeit("actor_calls_1_n_async",
+           lambda: ray_tpu.get(
+               [x.noop.remote() for x in actors for _ in range(BATCH // n)],
+               timeout=120), BATCH, results)
+
+    # n:n — n driver-actors each hammering its own peer actor.
+    peers = [Actor.remote() for _ in range(n)]
+    clients = [Client.remote(p) for p in peers]
+    ray_tpu.get([c.batch_calls.remote(1) for c in clients], timeout=120)
+    timeit("actor_calls_n_n_async",
+           lambda: ray_tpu.get(
+               [c.batch_calls.remote(BATCH) for c in clients],
+               timeout=120), BATCH * n, results)
+    timeit("multi_client_tasks_async",
+           lambda: ray_tpu.get(
+               [c.batch_tasks.remote(BATCH) for c in clients],
+               timeout=120), BATCH * n, results)
+
+    # --- object store ---------------------------------------------------
+    small_obj = b"x" * 1024
+    timeit("put_small_1kb",
+           lambda: ray_tpu.put(small_obj), 1, results)
+    big = np.random.bytes(100 * 1024 * 1024)  # 100 MB
+    r = timeit("put_gigabytes",
+               lambda: ray_tpu.put(big), 0.1, results)  # GB per put
+    big_ref = ray_tpu.put(np.frombuffer(big, dtype=np.uint8))
+    timeit("get_gigabytes",
+           lambda: ray_tpu.get(big_ref, timeout=60), 0.1, results)
+
+    ray_tpu.shutdown()
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+    main(quick="--quick" in sys.argv)

@@ -93,6 +93,16 @@ class Raylet:
         self.bundles: dict[tuple, dict] = {}
         # object store waiters: oid -> [futures] waiting for seal
         self.seal_waiters: dict[bytes, list[asyncio.Future]] = {}
+        # Spilling (reference: raylet LocalObjectManager::SpillObjects
+        # local_object_manager.h:99 + external_storage.py): primary copies
+        # move to disk under memory pressure and restore on access.
+        self.spill_dir = os.path.join(session_dir, "spill",
+                                      self.node_id.hex()[:8])
+        self._created_sizes: dict[bytes, int] = {}
+        self.primary_objects: dict[bytes, int] = {}  # sealed, creator-pinned
+        self.spilled: dict[bytes, tuple[str, int]] = {}  # oid -> (path, size)
+        self._spilling: set[bytes] = set()
+        self._restores_inflight: dict[bytes, asyncio.Future] = {}
         # cached cluster node table (from GCS pubsub)
         self.cluster_nodes: dict[NodeID, dict] = {}
         self.peer_conns: dict[NodeID, protocol.Connection] = {}
@@ -123,6 +133,21 @@ class Raylet:
         loop = asyncio.get_running_loop()
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._reap_loop())
+        if cfg.log_to_driver:
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            async def _pub(channel, message):
+                await self.gcs.request("publish", {"channel": channel,
+                                                   "message": message})
+
+            # Per-raylet log subdir: in the in-process multi-raylet test
+            # Cluster all nodes share one session dir, and each monitor
+            # must tail only its own workers.
+            self._log_monitor = LogMonitor(
+                os.path.join(self.session_dir, "logs",
+                             self.node_id.hex()[:8]), _pub,
+                self.node_id.hex())
+            loop.create_task(self._log_monitor.run())
         logger.info("raylet %s on %s:%s resources=%s", self.node_id.hex()[:8],
                     self.host, self.port, self.total_resources)
         return self.port
@@ -235,6 +260,7 @@ class Raylet:
             if "RT_WORKER_JAX_PLATFORMS_TPU" in os.environ:
                 env["JAX_PLATFORMS"] = os.environ["RT_WORKER_JAX_PLATFORMS_TPU"]
         logfile = os.path.join(self.session_dir, "logs",
+                               self.node_id.hex()[:8],
                                f"worker-{worker_id.hex()[:8]}.log")
         os.makedirs(os.path.dirname(logfile), exist_ok=True)
         out = open(logfile, "ab")
@@ -655,14 +681,114 @@ class Raylet:
     async def rpc_os_create(self, conn, body):
         oid: bytes = body["oid"]
         size: int = body["size"]
-        off = self.store.alloc(oid, size)
+        off = await self._alloc_with_spill(oid, size)
         if off is None:
-            return {"error": f"object store OOM allocating {size} bytes"}
+            return {"error": f"object store OOM allocating {size} bytes "
+                             f"(after spilling)"}
+        self._created_sizes[oid] = size
         return {"offset": off}
+
+    async def _alloc_with_spill(self, oid: bytes, size: int):
+        """alloc, spilling primary copies to disk on memory pressure (the
+        C++ store already LRU-evicts unpinned secondary copies)."""
+        off = self.store.alloc(oid, size)
+        if off is not None:
+            return off
+        await self._spill_bytes(size)
+        return self.store.alloc(oid, size)
+
+    async def _spill_bytes(self, need: int):
+        """Move primary copies to disk, oldest first, until ~need bytes of
+        pinned space have been released."""
+        os.makedirs(self.spill_dir, exist_ok=True)
+        freed = 0
+        loop = asyncio.get_running_loop()
+        for oid in list(self.primary_objects):
+            if freed >= need:
+                break
+            size = self.primary_objects.get(oid)
+            if size is None or oid in self.spilled \
+                    or oid in self._spilling:
+                # _spilling guard: concurrent OOM allocs must not spill the
+                # same object twice (double file write + pin over-release).
+                continue
+            self._spilling.add(oid)
+            got = self.store.get(oid)
+            try:
+                if got is None:
+                    self.primary_objects.pop(oid, None)
+                    continue
+                offset, sz, sealed = got
+                if not sealed:
+                    self.store.release(oid)
+                    continue
+                path = os.path.join(self.spill_dir, oid.hex())
+                data = bytes(self.mapping.slice(offset, sz))
+                await loop.run_in_executor(None, self._write_spill_file,
+                                           path, data)
+                self.store.release(oid)        # our read pin
+                self.spilled[oid] = (path, sz)
+                self.primary_objects.pop(oid, None)
+                # Deferred delete + drop the creator pin: the arena region
+                # is reclaimed once concurrent readers release.
+                self.store.delete(oid)
+                self.store.release(oid)
+                freed += sz
+                logger.info("spilled %s (%d bytes) to %s",
+                            oid.hex()[:8], sz, path)
+            finally:
+                self._spilling.discard(oid)
+
+    @staticmethod
+    def _write_spill_file(path: str, data: bytes):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    async def _restore_spilled(self, oid: bytes) -> bool:
+        """Bring a spilled object back into the arena (reference:
+        SpilledObjectReader)."""
+        ent = self.spilled.get(oid)
+        if ent is None:
+            return False
+        fut = self._restores_inflight.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._restores_inflight[oid] = fut
+        try:
+            path, size = ent
+            off = await self._alloc_with_spill(oid, size)
+            if off is None:
+                fut.set_result(False)
+                return False
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: open(path, "rb").read())
+            self.mapping.slice(off, size)[:] = data
+            self.store.seal(oid)
+            self.store.release(oid)  # restored copy is evictable (disk
+            # copy remains the primary until os_delete)
+            for w in self.seal_waiters.pop(oid, []):
+                if not w.done():
+                    w.set_result(None)
+            fut.set_result(True)
+            return True
+        except Exception as e:
+            logger.warning("restore of %s failed: %s", oid.hex()[:8], e)
+            if not fut.done():
+                fut.set_result(False)
+            return False
+        finally:
+            self._restores_inflight.pop(oid, None)
 
     async def rpc_os_seal(self, conn, body):
         oid = body["oid"]
         self.store.seal(oid)
+        size = self._created_sizes.pop(oid, None)
+        if size is not None:
+            # Client-created (not pulled): this node holds the primary copy.
+            self.primary_objects[oid] = size
         for fut in self.seal_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(None)
@@ -674,6 +800,8 @@ class Raylet:
         oid = body["oid"]
         timeout = body.get("timeout", 60.0)
         location = body.get("location")  # NodeID where the object lives
+        if oid in self.spilled and not self.store.contains(oid):
+            await self._restore_spilled(oid)
         got = self.store.get(oid)
         if got is not None:
             offset, size, sealed = got
@@ -683,7 +811,10 @@ class Raylet:
             await self._wait_sealed(oid, timeout)
             got = self.store.get(oid)
             if got and got[2]:
-                self.store.release(oid)  # drop the extra pin from re-get
+                # Keep the re-get's pin and track it: the client's later
+                # os_release must find a pin of its own to drop, not steal
+                # the creator's.
+                self._track_pin(conn, oid)
                 return {"offset": got[0], "size": got[1]}
             return {"error": "timeout waiting for object seal"}
         if location is not None and location != self.node_id:
@@ -761,7 +892,7 @@ class Raylet:
             return False
         size = meta["size"]
         try:
-            off = self.store.alloc(oid, size)
+            off = await self._alloc_with_spill(oid, size)
         except KeyError:
             return True  # someone else pulled it concurrently
         if off is None:
@@ -787,18 +918,35 @@ class Raylet:
         return True
 
     async def rpc_os_stat(self, conn, body):
-        got = self.store.get(body["oid"])
+        oid = body["oid"]
+        got = self.store.get(oid)
         if got is None or not got[2]:
-            if got is not None:
-                pass
+            spilled = self.spilled.get(oid)
+            if spilled is not None:
+                return {"size": spilled[1]}
             return {"error": "not here"}
-        self.store.release(body["oid"])
+        self.store.release(oid)
         return {"size": got[1]}
 
     async def rpc_os_read_chunk(self, conn, body):
         oid = body["oid"]
         got = self.store.get(oid)
         if got is None or not got[2]:
+            spilled = self.spilled.get(oid)
+            if spilled is not None:
+                # Serve peer pulls straight from the spill file — no need
+                # to churn the arena for a pass-through transfer.
+                path, size = spilled
+                start = body["offset"]
+                n = min(body["len"], size - start)
+                loop = asyncio.get_running_loop()
+
+                def _read():
+                    with open(path, "rb") as f:
+                        f.seek(start)
+                        return f.read(n)
+
+                return {"data": await loop.run_in_executor(None, _read)}
             return {"error": "not here"}
         offset, size, _ = got
         start = body["offset"]
@@ -833,7 +981,16 @@ class Raylet:
         return {"ok": True}
 
     async def rpc_os_delete(self, conn, body):
-        self.store.delete(body["oid"])
+        oid = body["oid"]
+        self.store.delete(oid)
+        self.primary_objects.pop(oid, None)
+        self._created_sizes.pop(oid, None)
+        spilled = self.spilled.pop(oid, None)
+        if spilled is not None:
+            try:
+                os.remove(spilled[0])
+            except OSError:
+                pass
         return {"ok": True}
 
     async def rpc_os_contains(self, conn, body):
@@ -841,6 +998,40 @@ class Raylet:
 
     async def rpc_os_used(self, conn, body):
         return {"used": self.store.used(), "capacity": self.store_capacity}
+
+    # ------------------------------------------------------ state API feeds
+    async def rpc_list_leases(self, conn, body):
+        """Running + queued work on this node (reference: per-worker task
+        state feeding python/ray/experimental/state/api.py list_tasks)."""
+        running = []
+        for lease in self.leases.values():
+            running.append({
+                "lease_id": lease.lease_id.hex(),
+                "worker_id": lease.worker.worker_id.hex(),
+                "pid": lease.worker.pid,
+                "resources": lease.resources,
+                "actor_id": (lease.worker.actor_id.hex()
+                             if lease.worker.actor_id else None),
+                "blocked": lease.blocked,
+                "state": "RUNNING",
+            })
+        queued = [{"resources": p.get("resources", {}),
+                   "state": "PENDING_NODE_ASSIGNMENT"}
+                  for p in self.pending_leases]
+        return {"running": running, "queued": queued,
+                "node_id": self.node_id.hex()}
+
+    async def rpc_list_local_objects(self, conn, body):
+        objs = []
+        for oid, size in self.primary_objects.items():
+            objs.append({"object_id": oid.hex(), "size": size,
+                         "where": "memory", "primary": True})
+        for oid, (_path, size) in self.spilled.items():
+            objs.append({"object_id": oid.hex(), "size": size,
+                         "where": "spilled", "primary": True})
+        return {"objects": objs, "node_id": self.node_id.hex(),
+                "store_used": self.store.used(),
+                "store_capacity": self.store_capacity}
 
     # ------------------------------------------------------------- lifecycle
     async def _heartbeat_loop(self):
@@ -854,14 +1045,59 @@ class Raylet:
                 continue
             last_beat = now
             try:
-                await self.gcs.request("heartbeat", {
+                reply = await self.gcs.request("heartbeat", {
                     "node_id": self.node_id,
                     "available": self.available,
                     "load": self._load(),
+                    # Resource shapes of queued leases: the autoscaler's
+                    # demand signal (reference: ResourceLoad in the
+                    # raylet->GCS resource reports feeding LoadMetrics).
+                    "pending_shapes": [dict(p["resources"])
+                                       for p in self.pending_leases[:32]],
                 })
+                if not reply.get("ok") and "unknown node" in \
+                        reply.get("reason", ""):
+                    # GCS restarted and lost the node table: re-register
+                    # (reference: NotifyGCSRestart node_manager.proto:343).
+                    await self._reconnect_gcs()
             except Exception:
                 if self._shutdown:
                     return
+                await self._reconnect_gcs()
+
+    def _register_body(self):
+        return {
+            "node_id": self.node_id,
+            "addr": (self.host, self.port),
+            "resources": self.total_resources,
+            "labels": self.labels,
+            "node_name": self.node_name,
+        }
+
+    async def _reconnect_gcs(self):
+        """Reconnect + re-register after a GCS restart, with backoff."""
+        while not self._shutdown:
+            try:
+                conn = await protocol.Connection.connect(
+                    self.gcs_addr[0], self.gcs_addr[1],
+                    handler=self._handle_gcs_push, name="raylet->gcs",
+                    timeout=5.0)
+                reply = await conn.request("register_node",
+                                           self._register_body())
+                old, self.gcs = self.gcs, conn
+                if old is not None and not old.closed:
+                    try:
+                        await old.close()
+                    except Exception:
+                        pass
+                for view in reply.get("cluster_nodes", []):
+                    self.cluster_nodes[view["node_id"]] = view
+                await self.gcs.request("subscribe", {"channels": ["nodes"]})
+                logger.info("raylet %s re-registered with GCS",
+                            self.node_id.hex()[:8])
+                return
+            except Exception:
+                await asyncio.sleep(0.5)
 
     async def rpc_shutdown(self, conn, body):
         asyncio.get_running_loop().create_task(self.shutdown())

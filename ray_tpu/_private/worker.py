@@ -50,6 +50,31 @@ IN_STORE = "IN_STORE"
 ERRORED = "ERRORED"
 
 
+class _PinView:
+    """Buffer wrapper tying a raylet read-pin to the lifetime of the
+    zero-copy views handed to user code (PEP 688 __buffer__): when the
+    last derived memoryview/ndarray dies, the pin is released and the
+    object becomes evictable/spillable again (reference: plasma client
+    Release on buffer destruction)."""
+
+    __slots__ = ("_mv", "_cb")
+
+    def __init__(self, mv: memoryview, release_cb):
+        self._mv = mv
+        self._cb = release_cb
+
+    def __buffer__(self, flags):
+        return memoryview(self._mv)
+
+    def __del__(self):
+        cb, self._cb = self._cb, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+
 class _RefArg:
     """Marker for a top-level ObjectRef argument: the executor substitutes
     the fetched value (nested refs are passed through as refs — reference
@@ -62,7 +87,7 @@ class _RefArg:
 
 class OwnedObject:
     __slots__ = ("state", "blob", "location", "size", "event", "local_refs",
-                 "submitted_task")
+                 "submitted_task", "reconstructions")
 
     def __init__(self):
         self.state = PENDING
@@ -71,7 +96,11 @@ class OwnedObject:
         self.size = 0
         self.event = asyncio.Event()
         self.local_refs = 0
-        self.submitted_task = None  # spec kept for lineage/retries
+        # The submitting task's spec, kept for lineage reconstruction
+        # (reference: TaskManager lineage, task_manager.h:86; recovery via
+        # ObjectRecoveryManager::RecoverObject object_recovery_manager.h:90).
+        self.submitted_task = None
+        self.reconstructions = 0
 
     def ready(self):
         return self.state != PENDING
@@ -130,6 +159,12 @@ class CoreWorker:
         # TaskManager lineage pinning of task dependencies).  Keyed by the
         # task's first return ObjectID.
         self._arg_pins: dict[ObjectID, list] = {}
+        # Lineage for reconstruction: task_id -> spec while any of the
+        # task's returns is still owned; arg refs move to _lineage_pins on
+        # completion so re-execution can still resolve them.
+        self._lineage: dict[TaskID, dict] = {}
+        self._lineage_pins: dict[TaskID, list] = {}
+        self._recovering: dict[TaskID, asyncio.Future] = {}
         # submission state
         self.lease_pools: dict[tuple, LeasePool] = {}
         self._worker_conns: dict[tuple, protocol.Connection] = {}
@@ -154,6 +189,10 @@ class CoreWorker:
         self.exec_ctx = ExecutionContext()
         self.connected = False
         self._shutdown = False
+        self._pubsub_handlers: dict[str, object] = {}
+        self._gcs_reconnect_lock: asyncio.Lock | None = None
+        # chrome-trace profile events for ray_tpu.timeline()
+        self._profile_events: list[dict] = []
 
     # ------------------------------------------------------------ lifecycle
     def start_driver(self):
@@ -177,6 +216,34 @@ class CoreWorker:
         await self._connect()
         self.connected = True
 
+    async def _gcs_request(self, method, body, timeout=None):
+        """GCS RPC surviving a GCS restart: reconnect once on conn loss
+        (reference: workers re-resolve the GCS after failover,
+        NotifyGCSRestart node_manager.proto:343).  Reconnects are
+        serialized so concurrent failures share one new connection rather
+        than stampeding (and leaking the losers)."""
+        try:
+            return await self.gcs.request(method, body, timeout=timeout)
+        except (protocol.ConnectionLost, ConnectionError, OSError):
+            if self._shutdown:
+                raise
+            failed = self.gcs
+            if self._gcs_reconnect_lock is None:
+                self._gcs_reconnect_lock = asyncio.Lock()
+            async with self._gcs_reconnect_lock:
+                if self.gcs is failed or self.gcs.closed:
+                    old = self.gcs
+                    self.gcs = await protocol.Connection.connect(
+                        self.gcs_addr[0], self.gcs_addr[1],
+                        handler=self._handle, name="cw->gcs",
+                        timeout=cfg.connect_timeout_s)
+                    if old is not None and not old.closed:
+                        try:
+                            await old.close()
+                        except Exception:
+                            pass
+            return await self.gcs.request(method, body, timeout=timeout)
+
     async def _connect(self):
         self.addr = (self.host, await self.server.start(0))
         self.gcs = await protocol.Connection.connect(
@@ -186,6 +253,18 @@ class CoreWorker:
             await self.gcs.request("register_driver", {
                 "job_id": self.job_id, "pid": os.getpid(),
                 "entrypoint": " ".join(os.sys.argv)})
+            if cfg.log_to_driver:
+                import sys
+
+                def _echo_logs(msg):
+                    for line in (msg or {}).get("lines", []):
+                        print(f"(worker {msg['worker']}, "
+                              f"node {msg['node'][:8]}) {line}",
+                              file=sys.stderr)
+
+                self._pubsub_handlers["logs"] = _echo_logs
+                await self.gcs.request("subscribe", {"channels": ["logs"]})
+        self.loop.create_task(self._telemetry_loop())
         if self.raylet_addr is not None:
             on_close = None
             if self.mode == MODE_WORKER:
@@ -253,6 +332,41 @@ class CoreWorker:
         if fn is None:
             raise protocol.RpcError(f"core worker: no method {method}")
         return await fn(conn, body)
+
+    async def _telemetry_loop(self):
+        """Push metric snapshots + profile events to the GCS KV every few
+        seconds (reference: the per-node metrics agent relay,
+        _private/metrics_agent.py:63; consumed by the dashboard head and
+        ray_tpu.timeline())."""
+        import pickle
+        while not self._shutdown:
+            await asyncio.sleep(2.0)
+            try:
+                from ray_tpu.util import metrics as metrics_mod
+                snaps = metrics_mod.registry_snapshot()
+                events = self._profile_events[-2000:]
+                if not snaps and not events:
+                    continue
+                await self._gcs_request("kv_put", {
+                    "ns": "telemetry", "key": self.worker_id.binary(),
+                    "value": pickle.dumps({
+                        "snapshots": snaps, "profile": events,
+                        "pid": os.getpid(), "mode": self.mode})})
+            except Exception:
+                if self._shutdown:
+                    return
+
+    async def rpc_pubsub(self, conn, body):
+        """GCS pubsub push (driver-side: mirrored worker logs, error
+        events — reference: the driver's log/error subscriber threads in
+        python/ray/_private/worker.py listen_error_messages etc.)."""
+        handler = self._pubsub_handlers.get(body.get("channel"))
+        if handler is not None:
+            try:
+                handler(body.get("message"))
+            except Exception:
+                pass
+        return None
 
     # ======================================================= OWNER-SIDE API
     def put(self, value, _owner_ref=None) -> ObjectRef:
@@ -325,8 +439,17 @@ class CoreWorker:
                 return entry.blob
             if entry.state == ERRORED:
                 return entry.blob
-            return await self._fetch_from_store(ref.id, entry.location,
-                                                deadline)
+            try:
+                return await self._fetch_from_store(ref.id, entry.location,
+                                                    deadline)
+            except rexc.ObjectLostError:
+                # The node holding the primary copy died: reconstruct by
+                # re-executing the creating task, then re-resolve.
+                await self._recover_object(ref.id, entry)
+                if entry.state in (INLINE, ERRORED):
+                    return entry.blob
+                return await self._fetch_from_store(ref.id, entry.location,
+                                                    deadline)
         # Borrowed ref: ask the owner.
         cached = self._borrow_cache.get(ref.id)
         if cached is not None:
@@ -341,8 +464,21 @@ class CoreWorker:
         if "blob" in status:
             self._borrow_cache[ref.id] = status["blob"]
             return status["blob"]
-        return await self._fetch_from_store(ref.id, status["location"],
-                                            deadline)
+        try:
+            return await self._fetch_from_store(ref.id, status["location"],
+                                                deadline)
+        except rexc.ObjectLostError:
+            # Report the loss to the owner, who recovers via lineage and
+            # tells us where the object lives now.
+            status = await owner.request("recover_object", {"oid": ref.id},
+                                         timeout=self._remain(deadline))
+            if status.get("error") is not None:
+                return status["error"]
+            if "blob" in status:
+                self._borrow_cache[ref.id] = status["blob"]
+                return status["blob"]
+            return await self._fetch_from_store(
+                ref.id, status["location"], deadline)
 
     async def _fetch_from_store(self, oid: ObjectID, location, deadline=None):
         if self.raylet is None:
@@ -353,8 +489,22 @@ class CoreWorker:
         }, timeout=(self._remain(deadline) or 60.0) + 5.0)
         if "error" in reply:
             raise rexc.ObjectLostError(oid.hex(), reply["error"])
-        self._pinned.add(oid.binary())
-        return self.mapping.slice(reply["offset"], reply["size"])
+        binary = oid.binary()
+        self._pinned.add(binary)
+        mv = self.mapping.slice(reply["offset"], reply["size"])
+
+        def _release():
+            if self._shutdown or self.loop is None or self.raylet is None:
+                return
+            self._pinned.discard(binary)
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.raylet.request("os_release", {"oid": binary}),
+                    self.loop)
+            except Exception:
+                pass
+
+        return memoryview(_PinView(mv, _release))
 
     @staticmethod
     def _remain(deadline):
@@ -434,6 +584,83 @@ class CoreWorker:
             return {"error": entry.blob}
         return {"location": entry.location, "size": entry.size}
 
+    async def rpc_recover_object(self, conn, body):
+        """A borrower failed to fetch an object we own: reconstruct it via
+        lineage and reply with the fresh status (reference: owner-driven
+        recovery, object_recovery_manager.h:41)."""
+        oid: ObjectID = body["oid"]
+        entry = self.owned.get(oid)
+        if entry is None:
+            return {"error": _error_blob(
+                rexc.ObjectLostError(oid.hex(), "owner has no record"))}
+        try:
+            if entry.ready() and entry.state == IN_STORE:
+                await self._recover_object(oid, entry)
+        except rexc.ObjectLostError as e:
+            return {"error": _error_blob(e)}
+        if not entry.ready():
+            await entry.event.wait()
+        if entry.state == INLINE:
+            return {"blob": entry.blob}
+        if entry.state == ERRORED:
+            return {"error": entry.blob}
+        return {"location": entry.location, "size": entry.size}
+
+    async def _recover_object(self, oid: ObjectID, entry: OwnedObject):
+        """Re-execute the task that created `oid` (reference:
+        TaskManager::ResubmitTask task_manager.h:135).  Deduped per task:
+        concurrent losses of sibling returns re-execute once."""
+        spec = entry.submitted_task
+        if spec is None:
+            raise rexc.ObjectLostError(
+                oid.hex(), "object lost and not reconstructable "
+                           "(ray_tpu.put objects have no lineage)")
+        task_id = spec["task_id"]
+        fut = self._recovering.get(task_id)
+        if fut is not None:
+            await asyncio.shield(fut)
+            return
+        fut = self._recovering[task_id] = self.loop.create_future()
+        try:
+            reexecutions = []
+            for rid in spec["return_ids"]:
+                e = self.owned.get(rid)
+                if e is None:
+                    continue
+                if e.reconstructions >= cfg.max_object_reconstructions:
+                    raise rexc.ObjectLostError(
+                        oid.hex(),
+                        f"exceeded {cfg.max_object_reconstructions} "
+                        "reconstruction attempts")
+                e.reconstructions += 1
+                e.state = PENDING
+                e.blob = None
+                e.location = None
+                e.event = asyncio.Event()
+                reexecutions.append(rid)
+            logger.warning(
+                "reconstructing %d object(s) by re-executing task %s",
+                len(reexecutions), task_id.hex()[:8])
+            self._pin_args_from_lineage(task_id)
+            await self._submit(dict(spec))
+            await entry.event.wait()
+            if not fut.done():
+                fut.set_result(True)
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        finally:
+            self._recovering.pop(task_id, None)
+            # Consume fut's exception if nobody else awaited it.
+            if fut.done() and fut.exception() is not None:
+                fut.exception()
+
+    def _pin_args_from_lineage(self, task_id):
+        pins = self._lineage_pins.pop(task_id, None)
+        if pins is not None:
+            self._arg_pins[task_id] = pins
+
     # ----------------------------------------------------------- refcounting
     def add_local_ref(self, ref: ObjectRef):
         entry = self.owned.get(ref.id)
@@ -454,6 +681,12 @@ class CoreWorker:
                     self._call(self._delete_store_object(ref.id, entry))
                 except Exception:
                     pass
+            spec = entry.submitted_task
+            if spec is not None and all(rid not in self.owned
+                                        for rid in spec["return_ids"]):
+                # Last live return gone: release the lineage + arg pins.
+                self._lineage.pop(spec["task_id"], None)
+                self._lineage_pins.pop(spec["task_id"], None)
 
     async def _delete_store_object(self, oid: ObjectID, entry):
         try:
@@ -468,7 +701,7 @@ class CoreWorker:
         import hashlib
         fn_id = hashlib.sha1(blob).digest()[:16]
         if fn_id not in self._exported_fns:
-            self._run(self.gcs.request("kv_put", {
+            self._run(self._gcs_request("kv_put", {
                 "ns": "funcs", "key": fn_id, "value": blob}))
             self._exported_fns.add(fn_id)
             self._fn_cache[fn_id] = fn
@@ -503,6 +736,16 @@ class CoreWorker:
         if pg is not None:
             spec["pg_id"] = pg.id
             spec["bundle_index"] = opts.get("placement_group_bundle_index", -1)
+        # Lineage: keep the spec on every return so a lost object can be
+        # reconstructed by re-executing the task (reference:
+        # task_manager.h:86 lineage, object_recovery_manager.h:90).
+        # num_returns=0 tasks have nothing to reconstruct — recording
+        # lineage for them would leak specs+arg pins forever (cleanup runs
+        # from remove_local_ref over return refs).
+        if refs:
+            for r in refs:
+                self.owned[r.id].submitted_task = spec
+            self._lineage[task_id] = spec
         self._pin_args(task_id, args, kwargs)
         self._call(self._submit(spec))
         return refs
@@ -516,8 +759,15 @@ class CoreWorker:
             self._arg_pins[task_id] = pins
 
     def _unpin_args(self, task_id):
-        if task_id is not None:
-            self._arg_pins.pop(task_id, None)
+        if task_id is None:
+            return
+        pins = self._arg_pins.pop(task_id, None)
+        # While the task's lineage is retained (its returns may need
+        # reconstruction), its args must stay fetchable: move the pins to
+        # the lineage table instead of dropping them (reference: lineage
+        # pinning of task dependencies, reference_count.h borrower docs).
+        if pins is not None and task_id in self._lineage:
+            self._lineage_pins[task_id] = pins
 
     def _pack_args(self, args, kwargs):
         new_args = [(_RefArg(a) if isinstance(a, ObjectRef) else a)
@@ -656,7 +906,7 @@ class CoreWorker:
     async def _raylet_for_bundle(self, pg_id, bundle_index):
         """Route a placement-group lease to the raylet holding the bundle
         (reference: PG-aware lease targeting via the bundle's node)."""
-        view = await self.gcs.request(
+        view = await self._gcs_request(
             "wait_placement_group", {"pg_id": pg_id, "timeout": 60.0})
         if view is None or view.get("state") != "CREATED":
             raise rexc.RayTpuError(
@@ -667,7 +917,7 @@ class CoreWorker:
             node_ids = [bundle_nodes[bundle_index]]
         else:
             node_ids = list(dict.fromkeys(bundle_nodes))
-        nodes = await self.gcs.request("get_nodes", {})
+        nodes = await self._gcs_request("get_nodes", {})
         by_id = {n["node_id"]: n for n in nodes}
         for nid in node_ids:
             nview = by_id.get(nid)
@@ -818,6 +1068,7 @@ class CoreWorker:
         ctx = self.exec_ctx
         ctx.task_id = spec["task_id"]
         ctx.lease_id = lease_id
+        t0 = time.time()
         try:
             fn = self._load_function(spec["fn_id"])
             args, kwargs = self._unpack_args(spec["args"])
@@ -826,13 +1077,30 @@ class CoreWorker:
         except Exception as e:
             return {"error": _error_blob(e, traceback.format_exc())}
         finally:
+            self._record_profile_event(
+                "task", spec.get("name") or getattr(
+                    self._fn_cache.get(spec["fn_id"]), "__name__", "task"),
+                t0)
             ctx.task_id = None
             ctx.lease_id = None
+
+    def _record_profile_event(self, cat: str, name: str, t0: float):
+        """Chrome-trace complete event (reference: core worker profiling
+        events, src/ray/core_worker/profiling.h; dumped by
+        ray_tpu.timeline())."""
+        self._profile_events.append({
+            "cat": cat, "name": name, "ph": "X",
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6,
+        })
+        if len(self._profile_events) > 10000:
+            del self._profile_events[:5000]
 
     def _load_function(self, fn_id: bytes):
         fn = self._fn_cache.get(fn_id)
         if fn is None:
-            reply = self._run(self.gcs.request(
+            reply = self._run(self._gcs_request(
                 "kv_get", {"ns": "funcs", "key": fn_id}))
             if reply["value"] is None:
                 raise rexc.RayTpuError(f"function {fn_id.hex()} not found")
@@ -980,6 +1248,7 @@ class CoreWorker:
             pool, self._execute_actor_method_sync, method, body, spec)
 
     def _execute_actor_method_sync(self, method, body, spec):
+        t0 = time.time()
         try:
             args, kwargs = self._unpack_args(body["args"])
             result = method(*args, **kwargs)
@@ -988,6 +1257,8 @@ class CoreWorker:
             if isinstance(e, SystemExit) or isinstance(e, _ActorExit):
                 raise
             return {"error": _error_blob(e, traceback.format_exc())}
+        finally:
+            self._record_profile_event("actor_task", body["method"], t0)
 
     # --------------------------------------------------- actor-caller side
     def submit_actor_task(self, actor_id: ActorID, actor_addr, method: str,
@@ -1072,7 +1343,7 @@ class CoreWorker:
 
     async def _wait_actor_alive(self, actor_id):
         try:
-            return await self.gcs.request(
+            return await self._gcs_request(
                 "wait_actor_alive", {"actor_id": actor_id, "timeout": 60.0})
         except Exception:
             return None
@@ -1123,7 +1394,7 @@ class CoreWorker:
         if pg is not None:
             spec["placement_group_id"] = pg.id
             spec["bundle_index"] = opts.get("placement_group_bundle_index")
-        reply = self._run(self.gcs.request("create_actor", {
+        reply = self._run(self._gcs_request("create_actor", {
             "actor_id": actor_id, "spec": spec, "job_id": self.job_id}))
         if not reply.get("ok"):
             raise ValueError(reply.get("reason", "actor creation failed"))

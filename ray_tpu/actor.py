@@ -117,9 +117,17 @@ class ActorClass:
         return ActorHandle(actor_id, self._cls.__name__, meta,
                            max_task_retries=opts.get("max_task_retries", 0))
 
+    def __getstate__(self):
+        # Same contract as RemoteFunction: drop per-process export caches
+        # so actor classes can cross process boundaries.
+        state = self.__dict__.copy()
+        state["_class_id"] = None
+        state["_exported_by"] = None
+        return state
+
     @property
     def bind(self):
-        from ray_tpu.dag.class_node import ClassNode
+        from ray_tpu.dag import ClassNode
 
         def _bind(*args, **kwargs):
             return ClassNode(self._cls, args, kwargs, self._default_opts)

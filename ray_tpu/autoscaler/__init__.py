@@ -1,0 +1,14 @@
+"""Autoscaler: demand-driven scaling with pluggable node providers
+(reference: python/ray/autoscaler — SURVEY.md §2.2)."""
+
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler._private.autoscaler import (  # noqa: F401
+    Monitor,
+    StandardAutoscaler,
+)
+
+__all__ = ["FakeMultiNodeProvider", "Monitor", "NodeProvider",
+           "StandardAutoscaler"]

@@ -1,0 +1,204 @@
+"""StandardAutoscaler: demand-driven cluster scaling.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py:154 (update :346)
++ resource_demand_scheduler.py:141 (get_nodes_to_launch bin-packing) +
+load_metrics.py.  Each update(): read demand from the GCS (queued lease
+shapes + unplaced PG bundles), bin-pack what doesn't fit on current
+capacity onto node types, launch; terminate nodes idle past the timeout.
+TPU slices (group_size > 1) launch and terminate atomically.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+def _fits(avail: Dict, shape: Dict) -> bool:
+    return all(avail.get(k, 0) >= v for k, v in shape.items())
+
+
+def _subtract(avail: Dict, shape: Dict) -> None:
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0) - v
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, gcs_request,
+                 idle_timeout_s: float = 60.0,
+                 max_launch_batch: int = 8):
+        """gcs_request: callable(method, body) -> reply (sync)."""
+        self.provider = provider
+        self.gcs_request = gcs_request
+        self.idle_timeout_s = idle_timeout_s
+        self.max_launch_batch = max_launch_batch
+        self._idle_since: Dict[str, float] = {}  # provider_id -> ts
+
+    # ------------------------------------------------------------- update
+    def update(self) -> Dict:
+        demands = self._collect_demands()
+        nodes = self.gcs_request("get_nodes", {})
+        launched = self._scale_up(demands, nodes)
+        terminated = self._scale_down(nodes)
+        return {"launched": launched, "terminated": terminated,
+                "pending_demands": len(demands)}
+
+    def _collect_demands(self) -> List[Dict]:
+        reply = self.gcs_request("get_resource_demands", {})
+        demands = list(reply.get("shapes", []))
+        for pg in reply.get("pending_pgs", []):
+            # Each unplaced bundle is a demand; STRICT_SPREAD bundles must
+            # land on distinct nodes, which bin-packing below honors by
+            # tagging them anti-affine.
+            strict_spread = pg.get("strategy") == "STRICT_SPREAD"
+            for b in pg["bundles"]:
+                d = dict(b)
+                if strict_spread:
+                    d["__anti_affinity__"] = pg["pg_id"]
+                demands.append(d)
+        return demands
+
+    def _scale_up(self, demands: List[Dict], nodes) -> List[str]:
+        if not demands:
+            return []
+        # Current free capacity per node (demand already running is
+        # reflected in `available`).
+        capacity = [dict(n.get("available", {}))
+                    for n in nodes if n.get("alive")]
+        anti_used: Dict[Tuple, set] = {}
+        unmet: List[Dict] = []
+        for d in demands:
+            anti = d.pop("__anti_affinity__", None)
+            placed = False
+            for i, cap in enumerate(capacity):
+                if anti is not None and i in anti_used.get(anti, set()):
+                    continue
+                if _fits(cap, d):
+                    _subtract(cap, d)
+                    if anti is not None:
+                        anti_used.setdefault(anti, set()).add(i)
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(dict(d, __anti_affinity__=anti)
+                             if anti is not None else d)
+        if not unmet:
+            return []
+        # Bin-pack unmet demand onto new virtual nodes of each type
+        # (first type whose resources cover the shape; reference:
+        # resource_demand_scheduler get_nodes_to_launch).
+        live_by_type: Dict[str, int] = {}
+        for pn in self.provider.non_terminated_nodes():
+            live_by_type[pn["node_type"]] = \
+                live_by_type.get(pn["node_type"], 0) + 1
+        to_launch: Dict[str, int] = {}
+        new_nodes: List[Tuple[str, Dict]] = []  # (type, remaining capacity)
+        new_anti: Dict[Tuple, set] = {}
+        for d in unmet:
+            anti = d.pop("__anti_affinity__", None)
+            placed = False
+            for j, (ntype, cap) in enumerate(new_nodes):
+                if anti is not None and j in new_anti.get(anti, set()):
+                    continue
+                if _fits(cap, d):
+                    _subtract(cap, d)
+                    if anti is not None:
+                        new_anti.setdefault(anti, set()).add(j)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for ntype, spec in self.provider.node_types.items():
+                group = int(spec.get("group_size", 1))
+                live_groups = live_by_type.get(ntype, 0) // group
+                if live_groups + to_launch.get(ntype, 0) + 1 \
+                        > spec.get("max_workers", 2 ** 30):
+                    continue
+                node_res = dict(spec["resources"])
+                if _fits(node_res, d):
+                    _subtract(node_res, d)
+                    idx = len(new_nodes)
+                    new_nodes.append((ntype, node_res))
+                    # A slice contributes group_size hosts of capacity.
+                    for _ in range(group - 1):
+                        new_nodes.append((ntype,
+                                          dict(spec["resources"])))
+                    if anti is not None:
+                        new_anti.setdefault(anti, set()).add(idx)
+                    to_launch[ntype] = to_launch.get(ntype, 0) + 1
+                    break
+            else:
+                logger.warning("autoscaler: demand %s unsatisfiable by "
+                               "any node type", d)
+        launched = []
+        for ntype, count in to_launch.items():
+            count = min(count, self.max_launch_batch)
+            logger.info("autoscaler: launching %d x %s", count, ntype)
+            launched += self.provider.create_nodes(ntype, count)
+        return launched
+
+    def _scale_down(self, nodes) -> List[str]:
+        """Terminate provider nodes idle (full resources available, no
+        load) past idle_timeout_s."""
+        now = time.monotonic()
+        by_raylet_id = {}
+        for n in nodes:
+            by_raylet_id[n["node_id"].hex()] = n
+        terminated = []
+        for pn in self.provider.non_terminated_nodes():
+            view = by_raylet_id.get(pn.get("raylet_node_id", ""))
+            pid = pn["provider_id"]
+            if view is None or not view.get("alive"):
+                self._idle_since.pop(pid, None)
+                continue
+            total = view.get("resources", {})
+            avail = view.get("available", {})
+            idle = (view.get("load", 0) == 0
+                    and all(avail.get(k, 0) >= v
+                            for k, v in total.items()))
+            if not idle:
+                self._idle_since.pop(pid, None)
+                continue
+            first = self._idle_since.setdefault(pid, now)
+            if now - first >= self.idle_timeout_s:
+                logger.info("autoscaler: terminating idle node %s", pid)
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)
+                terminated.append(pid)
+        return terminated
+
+
+class Monitor:
+    """Drives autoscaler.update() on an interval (reference:
+    autoscaler/_private/monitor.py)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler,
+                 interval_s: float = 1.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        import threading
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler-monitor")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                self.autoscaler.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            time.sleep(self.interval_s)
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)

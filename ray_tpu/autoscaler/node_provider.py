@@ -1,0 +1,79 @@
+"""NodeProvider: the pluggable boundary between scaling logic and
+infrastructure.
+
+Reference: python/ray/autoscaler/node_provider.py (NodeProvider base) and
+_private/fake_multi_node/node_provider.py:237 (FakeMultiNodeProvider —
+"launches" are local raylets, so autoscaling logic is testable without a
+cloud).  TPU detail: a node type may declare group_size > 1, modeling a
+multi-host TPU slice that must be acquired and released atomically.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Subclass per infrastructure (GKE queued resources, GCE, fake)."""
+
+    def __init__(self, node_types: Dict[str, Dict]):
+        # node_types: name -> {"resources": {...}, "max_workers": int,
+        #                      "group_size": int (default 1), ...}
+        self.node_types = node_types
+
+    def non_terminated_nodes(self) -> List[Dict]:
+        """[{node_id, node_type, group_id}] of live provider nodes."""
+        raise NotImplementedError
+
+    def create_nodes(self, node_type: str, count: int) -> List[str]:
+        """Launch count nodes (each group_size hosts) of node_type."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches in-process raylets on the test Cluster (reference:
+    fake_multi_node/node_provider.py:237)."""
+
+    def __init__(self, node_types: Dict[str, Dict], cluster):
+        super().__init__(node_types)
+        self.cluster = cluster
+        self._nodes: Dict[str, Dict] = {}
+
+    def non_terminated_nodes(self) -> List[Dict]:
+        return [dict(v, provider_id=k) for k, v in self._nodes.items()]
+
+    def create_nodes(self, node_type: str, count: int) -> List[str]:
+        spec = self.node_types[node_type]
+        group_size = int(spec.get("group_size", 1))
+        created = []
+        for _ in range(count):
+            group_id = uuid.uuid4().hex[:8]
+            for _host in range(group_size):
+                node = self.cluster.add_node(
+                    num_cpus=spec["resources"].get("CPU", 1),
+                    resources={k: v for k, v in spec["resources"].items()
+                               if k != "CPU"})
+                pid = uuid.uuid4().hex[:8]
+                self._nodes[pid] = {"node_type": node_type,
+                                    "group_id": group_id,
+                                    "node": node,
+                                    "raylet_node_id":
+                                        node.raylet.node_id.hex()}
+                created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        info = self._nodes.pop(provider_node_id, None)
+        if info is None:
+            return
+        # Atomic slice teardown: losing one host kills the whole group.
+        group = [k for k, v in self._nodes.items()
+                 if v["group_id"] == info["group_id"]]
+        self.cluster.remove_node(info["node"])
+        for k in group:
+            peer = self._nodes.pop(k)
+            self.cluster.remove_node(peer["node"])

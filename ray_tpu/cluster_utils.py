@@ -87,6 +87,26 @@ class Cluster:
         return asyncio.run_coroutine_threadsafe(
             _wait(), self.loop).result(timeout + 10)
 
+    def restart_gcs(self):
+        """Kill and restart the head GCS on the same port, reloading state
+        from its snapshot (reference: GCS failover with Redis persistence,
+        redis_store_client.h:28; raylets reconnect via the
+        NotifyGCSRestart-equivalent re-register path)."""
+        import asyncio
+
+        async def _do():
+            head = self.head
+            old = head.gcs_server
+            port = head.gcs_addr[1]
+            persist = old._persist_path
+            await old.stop()
+            from ray_tpu._private.gcs import GcsServer
+            new = GcsServer(persist_path=persist)
+            await new.start(port)
+            head.gcs_server = new
+
+        asyncio.run_coroutine_threadsafe(_do(), self.loop).result(60)
+
     def shutdown(self):
         import ray_tpu
         from ray_tpu._private import worker as worker_mod

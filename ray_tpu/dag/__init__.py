@@ -1,0 +1,12 @@
+"""ray_tpu.dag: lazy call-graph IR (reference: python/ray/dag)."""
+
+from ray_tpu.dag.dag_node import (  # noqa: F401
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)
+
+__all__ = ["ClassMethodNode", "ClassNode", "DAGNode", "FunctionNode",
+           "InputNode"]

@@ -1,0 +1,145 @@
+"""Lazy bind-don't-execute IR: fn.bind(x) builds a DAGNode graph.
+
+Reference: python/ray/dag/dag_node.py:22 — DAGNode with FunctionNode /
+ClassNode / ClassMethodNode / InputNode subclasses; `.execute()` walks the
+graph submitting tasks/actors; Serve graphs compile from the same IR.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """One node: an op plus bound (possibly nested-DAGNode) args."""
+
+    def __init__(self, args: Tuple, kwargs: Dict,
+                 options: Optional[Dict] = None):
+        self._bound_args = tuple(args)
+        self._bound_kwargs = dict(kwargs)
+        self._bound_options = dict(options or {})
+        self._stable_uuid = uuid.uuid4().hex
+
+    # ------------------------------------------------------------ traversal
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def _apply_recursive(self, fn, memo: Optional[Dict] = None):
+        """Bottom-up transform returning fn(node, resolved_args,
+        resolved_kwargs); shared nodes resolve once."""
+        memo = {} if memo is None else memo
+        if self._stable_uuid in memo:
+            return memo[self._stable_uuid]
+
+        def _res(v):
+            return v._apply_recursive(fn, memo) if isinstance(v, DAGNode) \
+                else v
+
+        args = tuple(_res(a) for a in self._bound_args)
+        kwargs = {k: _res(v) for k, v in self._bound_kwargs.items()}
+        out = fn(self, args, kwargs)
+        memo[self._stable_uuid] = out
+        return out
+
+    # ------------------------------------------------------------ execution
+    def execute(self, *input_args, **input_kwargs):
+        """Run the graph through the runtime; returns the root's result
+        handle (ObjectRef / ActorHandle / value)."""
+        ctx = {"args": input_args, "kwargs": input_kwargs}
+
+        def _exec(node, args, kwargs):
+            return node._execute_impl(args, kwargs, ctx)
+
+        return self._apply_recursive(_exec)
+
+    def _execute_impl(self, args, kwargs, ctx):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value supplied at execute() time (reference:
+    dag/input_node.py).  Usable as a context manager for symmetry with the
+    reference API: `with InputNode() as inp: ...`."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, args, kwargs, ctx):
+        a = ctx["args"]
+        if len(a) == 1 and not ctx["kwargs"]:
+            return a[0]
+        return a if a else None
+
+
+class FunctionNode(DAGNode):
+    """fn.bind(...) (reference: dag/function_node.py)."""
+
+    def __init__(self, fn, args, kwargs, options=None):
+        super().__init__(args, kwargs, options)
+        self._fn = fn
+
+    def _execute_impl(self, args, kwargs, ctx):
+        import ray_tpu
+        rf = ray_tpu.remote(self._fn)
+        if self._bound_options:
+            rf = rf.options(**self._bound_options)
+        # Upstream ObjectRefs pass through as task args (the runtime
+        # resolves them worker-side, preserving parallelism).
+        return rf.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """Cls.bind(...) — instantiates the actor at execute time (reference:
+    dag/class_node.py)."""
+
+    def __init__(self, cls, args, kwargs, options=None):
+        super().__init__(args, kwargs, options)
+        self._cls = cls
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundClassMethod(self, name)
+
+    def _execute_impl(self, args, kwargs, ctx):
+        import ray_tpu
+        ac = ray_tpu.remote(self._cls)
+        if self._bound_options:
+            ac = ac.options(**self._bound_options)
+        return ac.remote(*args, **kwargs)
+
+
+class _UnboundClassMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name,
+                               args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """actor_node.method.bind(...) (reference: dag/class_node.py
+    ClassMethodNode)."""
+
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args, kwargs):
+        super().__init__((class_node,) + tuple(args), kwargs)
+        self._method_name = method_name
+
+    def _execute_impl(self, args, kwargs, ctx):
+        actor_handle, *rest = args
+        method = getattr(actor_handle, self._method_name)
+        return method.remote(*rest, **kwargs)

@@ -220,20 +220,64 @@ class Dataset:
 
     def sort(self, key: Optional[str] = None, descending: bool = False
              ) -> "Dataset":
-        """Sample-partition-sort (reference: data sort via boundary
-        sampling)."""
-        blocks = self._blocks()
-        combined = BlockAccessor.combine(blocks)
-        acc = BlockAccessor(combined)
-        if key is None:
-            rows = sorted(acc.to_pylist(), reverse=descending)
-            return from_items_single(rows, len(blocks))
-        df = acc.to_pandas().sort_values(key, ascending=not descending)
-        n = len(df)
-        k = max(1, len(blocks))
-        per = (n + k - 1) // k
-        return Dataset([ray_tpu.put(df.iloc[i * per:(i + 1) * per])
-                        for i in range(k)])
+        """Distributed sample-partition-sort (reference: data/_internal/
+        sort.py — sample keys per block, compute range boundaries,
+        range-partition every block, sort each range independently).  No
+        block ever rides through the driver; output block j holds range j
+        so concatenating the blocks in order is globally sorted."""
+        refs = self._execute()
+        n = len(refs) or 1
+
+        def _sort_one(block):
+            return _local_sort(block, key, descending)
+
+        if n == 1:
+            one = ray_tpu.remote(_sort_one)
+            return Dataset([one.remote(refs[0])])
+
+        def _sample(block):
+            vals = _key_values(block, key)
+            rows = len(vals)
+            if rows == 0:
+                return vals
+            idxs = np.random.RandomState(0).randint(
+                0, rows, size=min(32, rows))
+            return vals[idxs]
+
+        sample_task = ray_tpu.remote(_sample)
+        samples = ray_tpu.get([sample_task.remote(b) for b in refs],
+                              timeout=_GET_TIMEOUT)
+        merged = np.sort(np.concatenate(
+            [s for s in samples if len(s)] or [np.array([])]))
+        if len(merged) == 0:
+            return Dataset(refs)
+        boundaries = np.array(
+            [merged[int(len(merged) * i / n)] for i in range(1, n)])
+
+        def _partition(block):
+            vals = _key_values(block, key)
+            assign = np.searchsorted(boundaries, vals, side="right")
+            if descending:
+                assign = (n - 1) - assign
+            order = np.argsort(assign, kind="stable")
+            sizes = np.bincount(assign, minlength=n)
+            out, start = [], 0
+            for s in sizes:
+                out.append(_take_rows(block, order[start:start + s]))
+                start += s
+            return out
+
+        part_task = ray_tpu.remote(_partition).options(num_returns=n)
+        parts = [part_task.remote(b) for b in refs]
+
+        def _merge_sorted(*blocks):
+            return _local_sort(BlockAccessor.combine(list(blocks)),
+                               key, descending)
+
+        merge_task = ray_tpu.remote(_merge_sorted)
+        return Dataset([
+            merge_task.remote(*[parts[i][j] for i in range(len(parts))])
+            for j in range(n)])
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -421,6 +465,32 @@ class Dataset:
     stats = __repr__
 
 
+def _key_values(block, key: Optional[str]) -> np.ndarray:
+    """The sort-key array of a block (key=None: the row values)."""
+    acc = BlockAccessor(block)
+    if key is not None:
+        return np.asarray(acc.to_numpy(key))
+    b = acc._b
+    if isinstance(b, list):
+        return np.asarray(b)
+    return np.asarray(acc.to_numpy("value"))
+
+
+def _local_sort(block, key: Optional[str], descending: bool):
+    acc = BlockAccessor(block)
+    if key is None and isinstance(acc._b, list):
+        return sorted(acc._b, reverse=descending)
+    if key is None:
+        vals = _key_values(block, None)
+        order = np.argsort(vals, kind="stable")
+        if descending:
+            order = order[::-1]
+        return _take_rows(block, order)
+    df = acc.to_pandas().sort_values(key, ascending=not descending,
+                                     kind="stable")
+    return df.reset_index(drop=True)
+
+
 def _take_rows(block, idxs):
     acc = BlockAccessor(block)
     b = acc._b
@@ -445,24 +515,56 @@ def from_items_single(rows: List, num_blocks: int) -> "Dataset":
 
 
 class GroupedData:
-    """Hash-partitioned groupby (reference: data grouped_data.py)."""
+    """Distributed hash-partitioned groupby (reference: data
+    grouped_data.py over the all-to-all shuffle): every block hash-splits
+    on the key, partition j of every block merges on a worker, and each
+    merged partition aggregates locally — a key's rows all land in the
+    same partition, so per-partition aggregation is exact and no block
+    rides through the driver."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
+    def _partitions(self) -> List[List]:
+        refs = self._ds._execute()
+        n = len(refs) or 1
+        key = self._key
+
+        def _hash_part(block):
+            import pandas as pd
+            df = BlockAccessor(block).to_pandas()
+            if n == 1:
+                return df
+            h = pd.util.hash_pandas_object(
+                df[key], index=False).to_numpy() % n
+            return [df[h == j].reset_index(drop=True) for j in range(n)]
+
+        part_task = ray_tpu.remote(_hash_part).options(num_returns=n)
+        parts = [part_task.remote(b) for b in refs]
+        if n == 1:
+            parts = [[p] for p in parts]
+        return [[parts[i][j] for i in range(len(parts))]
+                for j in range(n)]
+
     def _agg(self, agg_fn_name: str, on: Optional[str] = None):
-        df = self._ds.to_pandas()
-        g = df.groupby(self._key)
-        target = g[on] if on else g
-        out = getattr(target, agg_fn_name)()
-        out = out.reset_index()
-        return Dataset([ray_tpu.put(out)])
+        key = self._key
+
+        def _combine_agg(*dfs):
+            import pandas as pd
+            df = pd.concat(dfs, ignore_index=True)
+            if agg_fn_name == "count":
+                return df.groupby(key).size().reset_index(name="count()")
+            g = df.groupby(key)
+            target = g[on] if on else g
+            return getattr(target, agg_fn_name)().reset_index()
+
+        agg_task = ray_tpu.remote(_combine_agg)
+        return Dataset([agg_task.remote(*group)
+                        for group in self._partitions()])
 
     def count(self):
-        df = self._ds.to_pandas()
-        out = df.groupby(self._key).size().reset_index(name="count()")
-        return Dataset([ray_tpu.put(out)])
+        return self._agg("count")
 
     def sum(self, on=None):
         return self._agg("sum", on)
@@ -477,6 +579,18 @@ class GroupedData:
         return self._agg("mean", on)
 
     def map_groups(self, fn: Callable) -> Dataset:
-        df = self._ds.to_pandas()
-        outs = [fn(sub) for _, sub in df.groupby(self._key)]
-        return Dataset([ray_tpu.put(o) for o in outs])
+        key = self._key
+
+        def _apply(*dfs):
+            import pandas as pd
+            df = pd.concat(dfs, ignore_index=True)
+            outs = [fn(sub) for _, sub in df.groupby(key)]
+            if not outs:
+                return df
+            first = outs[0]
+            if isinstance(first, pd.DataFrame):
+                return pd.concat(outs, ignore_index=True)
+            return outs
+
+        t = ray_tpu.remote(_apply)
+        return Dataset([t.remote(*group) for group in self._partitions()])

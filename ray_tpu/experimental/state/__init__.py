@@ -1,0 +1,10 @@
+from ray_tpu.experimental.state.api import (  # noqa: F401
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    summarize_objects,
+    summarize_tasks,
+)

@@ -1,0 +1,139 @@
+"""State observability API: list/summarize cluster entities.
+
+Reference: python/ray/experimental/state/api.py — list_actors (:719),
+list_nodes (:810), list_tasks (:942), list_objects (:986),
+summarize_* (:1233+), backed by the GCS plus per-node raylet state feeds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _w():
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+def _gcs(method: str, body: Optional[dict] = None):
+    w = _w()
+    return w._run(w._gcs_request(method, body or {}))
+
+
+def list_nodes() -> List[Dict]:
+    out = []
+    for v in _gcs("get_nodes"):
+        out.append({
+            "node_id": v["node_id"].hex(),
+            "state": "ALIVE" if v["alive"] else "DEAD",
+            "address": list(v["addr"]),
+            "resources_total": v["resources"],
+            "resources_available": v.get("available", {}),
+            "labels": v.get("labels", {}),
+        })
+    return out
+
+
+def list_actors(detail: bool = False) -> List[Dict]:
+    out = []
+    for v in _gcs("list_actors"):
+        row = {
+            "actor_id": v["actor_id"].hex(),
+            "state": v["state"],
+            "class_name": v.get("class_name"),
+            "name": v.get("name"),
+            "node_id": v["node_id"].hex() if v.get("node_id") else None,
+            "pid": v.get("pid"),
+        }
+        if detail:
+            row.update({"num_restarts": v.get("num_restarts", 0),
+                        "death_cause": v.get("death_cause")})
+        out.append(row)
+    return out
+
+
+def list_placement_groups() -> List[Dict]:
+    out = []
+    for v in _gcs("list_placement_groups"):
+        out.append({
+            "placement_group_id": v["pg_id"].hex(),
+            "state": v["state"],
+            "name": v.get("name"),
+            "bundles": v["bundles"],
+        })
+    return out
+
+
+def list_jobs() -> List[Dict]:
+    return _gcs("list_jobs")
+
+
+async def _fanout(method: str) -> List[dict]:
+    """One RPC to every alive raylet."""
+    import asyncio
+    from ray_tpu._private import protocol
+    w = _w()
+    nodes = await w._gcs_request("get_nodes", {})
+    replies = []
+
+    async def _one(view):
+        try:
+            conn = await protocol.Connection.connect(
+                view["addr"][0], view["addr"][1], name="state-api",
+                timeout=10)
+            try:
+                return await conn.request(method, {}, timeout=10)
+            finally:
+                await conn.close()
+        except Exception:
+            return None
+
+    replies = await asyncio.gather(
+        *[_one(v) for v in nodes if v.get("alive")])
+    return [r for r in replies if r is not None]
+
+
+def list_tasks() -> List[Dict]:
+    w = _w()
+    out = []
+    for reply in w._run(_fanout("list_leases")):
+        for r in reply["running"]:
+            r["node_id"] = reply["node_id"]
+            r["type"] = "ACTOR_TASK" if r.get("actor_id") else "NORMAL_TASK"
+            out.append(r)
+        for q in reply["queued"]:
+            q["node_id"] = reply["node_id"]
+            q["type"] = "NORMAL_TASK"
+            out.append(q)
+    return out
+
+
+def list_objects() -> List[Dict]:
+    w = _w()
+    out = []
+    for reply in w._run(_fanout("list_local_objects")):
+        for o in reply["objects"]:
+            o["node_id"] = reply["node_id"]
+            out.append(o)
+    return out
+
+
+def summarize_tasks() -> Dict:
+    counts: Dict[str, int] = {}
+    for t in list_tasks():
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return {"by_state": counts, "total": sum(counts.values())}
+
+
+def summarize_objects() -> Dict:
+    objs = list_objects()
+    total = sum(o["size"] for o in objs)
+    by_where: Dict[str, int] = {}
+    for o in objs:
+        by_where[o["where"]] = by_where.get(o["where"], 0) + o["size"]
+    return {"total_objects": len(objs), "total_bytes": total,
+            "bytes_by_location": by_where}

@@ -1,0 +1,184 @@
+"""Job submission: run a shell entrypoint as a supervised cluster job.
+
+Reference: dashboard/modules/job/job_manager.py — JobManager (:320)
+starting a JobSupervisor actor (:109) per job; the supervisor runs the
+entrypoint as a subprocess, streams its output, and records status
+transitions (PENDING -> RUNNING -> SUCCEEDED/FAILED/STOPPED) that clients
+poll.  Status + logs live in the GCS KV so they survive the submitting
+client.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+JOBS_NS = "job_submissions"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class JobSupervisor:
+    """Detached actor owning one job subprocess (reference:
+    job_manager.py:109 JobSupervisor)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.env_vars = env_vars or {}
+        self.proc = None
+        self._log_chunks: List[str] = []
+        self._status = JobStatus.PENDING
+        self._message = ""
+        self._save()
+
+    def _save(self):
+        # Non-blocking KV push: run() executes ON the worker's event loop,
+        # so a blocking _run here would deadlock the actor.
+        w = ray_tpu._private.worker.global_worker
+        w._call(w._gcs_request("kv_put", {
+            "ns": JOBS_NS, "key": self.submission_id.encode(),
+            "value": pickle.dumps({
+                "submission_id": self.submission_id,
+                "entrypoint": self.entrypoint,
+                "status": self._status,
+                "message": self._message,
+                "logs": "".join(self._log_chunks[-2000:]),
+                "update_ts": time.time(),
+            })}))
+
+    async def run(self):
+        """Drive the subprocess to completion (fire-and-forget)."""
+        import asyncio
+        import os
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in self.env_vars.items()})
+        # The job connects back to this cluster (the supervisor runs in a
+        # worker whose env already carries the GCS address).
+        self._status = JobStatus.RUNNING
+        self._save()
+        try:
+            self.proc = await asyncio.create_subprocess_shell(
+                self.entrypoint, env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT)
+            assert self.proc.stdout is not None
+            while True:
+                line = await self.proc.stdout.readline()
+                if not line:
+                    break
+                self._log_chunks.append(line.decode("utf-8", "replace"))
+                if len(self._log_chunks) % 20 == 0:
+                    self._save()
+            rc = await self.proc.wait()
+            if self._status == JobStatus.STOPPED:
+                pass
+            elif rc == 0:
+                self._status = JobStatus.SUCCEEDED
+            else:
+                self._status = JobStatus.FAILED
+                self._message = f"entrypoint exited with code {rc}"
+        except Exception as e:
+            self._status = JobStatus.FAILED
+            self._message = repr(e)
+        self._save()
+        return self._status
+
+    def stop(self):
+        self._status = JobStatus.STOPPED
+        if self.proc is not None and self.proc.returncode is None:
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+        self._save()
+        return True
+
+    def ping(self):
+        return True
+
+
+class JobSubmissionClient:
+    """Reference: python/ray/dashboard/modules/job/sdk.py — the same
+    verbs, minus HTTP (the client talks straight to the cluster)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, ignore_reinit_error=True)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict] = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        env_vars = (runtime_env or {}).get("env_vars", {})
+        sup_cls = ray_tpu.remote(JobSupervisor)
+        sup = sup_cls.options(
+            name=f"_rt_job:{submission_id}", lifetime="detached",
+            num_cpus=0).remote(submission_id, entrypoint, env_vars)
+        ray_tpu.get(sup.ping.remote(), timeout=60)
+        sup.run.options(num_returns=0).remote()
+        return submission_id
+
+    def _record(self, submission_id: str) -> Optional[Dict]:
+        w = ray_tpu._private.worker.global_worker
+        blob = w._run(w._gcs_request("kv_get", {
+            "ns": JOBS_NS, "key": submission_id.encode()}))["value"]
+        return pickle.loads(blob) if blob else None
+
+    def get_job_status(self, submission_id: str) -> str:
+        rec = self._record(submission_id)
+        if rec is None:
+            raise KeyError(f"no such job {submission_id}")
+        return rec["status"]
+
+    def get_job_info(self, submission_id: str) -> Dict:
+        rec = self._record(submission_id)
+        if rec is None:
+            raise KeyError(f"no such job {submission_id}")
+        return rec
+
+    def get_job_logs(self, submission_id: str) -> str:
+        rec = self._record(submission_id)
+        return rec["logs"] if rec else ""
+
+    def stop_job(self, submission_id: str) -> bool:
+        try:
+            sup = ray_tpu.get_actor(f"_rt_job:{submission_id}")
+            return ray_tpu.get(sup.stop.remote(), timeout=30)
+        except Exception:
+            return False
+
+    def list_jobs(self) -> List[Dict]:
+        w = ray_tpu._private.worker.global_worker
+        keys = w._run(w._gcs_request(
+            "kv_keys", {"ns": JOBS_NS, "prefix": b""}))["keys"]
+        out = []
+        for k in keys:
+            rec = self._record(k.decode())
+            if rec:
+                out.append(rec)
+        return out
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {submission_id} still "
+                           f"{self.get_job_status(submission_id)}")

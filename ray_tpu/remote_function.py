@@ -51,9 +51,17 @@ class RemoteFunction:
 
     _exported_by = None
 
+    def __getstate__(self):
+        # Export caches hold the CoreWorker (unpicklable mmap); a pickled
+        # RemoteFunction re-exports lazily in the destination process.
+        state = self.__dict__.copy()
+        state["_fn_id"] = None
+        state.pop("_exported_by", None)
+        return state
+
     @property
     def bind(self):
-        from ray_tpu.dag.function_node import FunctionNode
+        from ray_tpu.dag import FunctionNode
 
         def _bind(*args, **kwargs):
             return FunctionNode(self._function, args, kwargs,

@@ -1,0 +1,17 @@
+"""rllib: reinforcement learning on ray_tpu (scoped per SURVEY.md §7
+phase 8: Algorithm-on-Trainable, WorkerSet of rollout actors, SampleBatch,
+PPO + IMPALA with jax/flax policies)."""
+
+from ray_tpu.rllib.algorithms.algorithm import (  # noqa: F401
+    Algorithm,
+    AlgorithmConfig,
+)
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala.impala import (  # noqa: F401
+    Impala,
+    ImpalaConfig,
+)
+from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
+
+__all__ = ["Algorithm", "AlgorithmConfig", "Impala", "ImpalaConfig",
+           "PPO", "PPOConfig", "SampleBatch"]

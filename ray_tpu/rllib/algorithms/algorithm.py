@@ -1,0 +1,130 @@
+"""Algorithm: the RL training harness, a Tune Trainable.
+
+Reference: rllib/algorithms/algorithm.py:145 — Algorithm subclasses
+Trainable (so Tuner drives it), builds a WorkerSet in setup(), and each
+train() call runs one `training_step` returning metrics including
+episode_reward_mean.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+from ray_tpu.rllib.policy.jax_policy import JaxPolicy
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder (reference: algorithm_config.py)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self._config: Dict = {
+            "env": None,
+            "env_config": {},
+            "num_rollout_workers": 2,
+            "rollout_fragment_length": 200,
+            "train_batch_size": 2000,
+            "gamma": 0.99,
+            "lambda": 0.95,
+            "lr": 5e-4,
+            "seed": 0,
+            "fcnet_hiddens": (64, 64),
+        }
+
+    def environment(self, env=None, env_config=None) -> "AlgorithmConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def rollouts(self, num_rollout_workers=None,
+                 rollout_fragment_length=None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self._config["num_rollout_workers"] = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self._config["rollout_fragment_length"] = \
+                rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(config=self.to_dict())
+
+
+def _default_env_creator(config: Dict):
+    import gymnasium as gym
+    env = config["env"]
+    if isinstance(env, str):
+        return gym.make(env, **config.get("env_config", {}))
+    return env(config.get("env_config", {}))
+
+
+class Algorithm(Trainable):
+    """Base: subclasses override training_step() (reference: algorithm.py
+    step :629 -> training_step :1141)."""
+
+    policy_cls = JaxPolicy
+
+    def setup(self, config: Dict):
+        defaults = AlgorithmConfig(type(self)).to_dict()
+        defaults.update(self._extra_defaults())
+        defaults.update(config)
+        self.algo_config = defaults
+        self.workers = WorkerSet(
+            _default_env_creator, self.policy_cls, self.algo_config,
+            num_workers=self.algo_config["num_rollout_workers"])
+        self._timesteps_total = 0
+        self._episode_rewards: list = []
+
+    def _extra_defaults(self) -> Dict:
+        return {}
+
+    def training_step(self) -> Dict:
+        raise NotImplementedError
+
+    def step(self) -> Dict:
+        t0 = time.time()
+        result = self.training_step()
+        stats = self.workers.episode_stats()
+        self._episode_rewards += stats["episode_rewards"]
+        recent = self._episode_rewards[-100:]
+        result.setdefault("episode_reward_mean",
+                          float(np.mean(recent)) if recent else np.nan)
+        result["episodes_total"] = len(self._episode_rewards)
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def save_checkpoint(self) -> Dict:
+        return {"weights": self.workers.local_worker.get_weights(),
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.workers.local_worker.set_weights(data["weights"])
+            self._timesteps_total = data.get("timesteps_total", 0)
+
+    def cleanup(self):
+        self.workers.stop()
+
+    # Convenience parity with the reference's `algo.train()` usage outside
+    # Tune: Trainable.train already works; expose stop() alias.
+    def stop(self):
+        super().stop()

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig  # noqa: F401

@@ -1,0 +1,75 @@
+"""IMPALA: async rollouts feeding a learner thread.
+
+Reference: rllib/algorithms/impala/impala.py:445 (learner thread wiring
+:349) — rollout workers sample continuously; ready batches stream into
+the LearnerThread; weights broadcast on a cadence, so learning and
+sampling overlap instead of alternating as in PPO.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.execution.learner_thread import LearnerThread
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(Impala)
+        self._config.update({
+            "loss": "impala",
+            "rho_clip": 1.0,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "broadcast_interval": 1,   # batches between weight pushes
+            "min_steps_per_iteration": 1000,
+        })
+
+
+class Impala(Algorithm):
+    def _extra_defaults(self) -> Dict:
+        return {"loss": "impala", "rho_clip": 1.0, "vf_loss_coeff": 0.5,
+                "entropy_coeff": 0.01, "broadcast_interval": 1,
+                "min_steps_per_iteration": 1000}
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        self.learner = LearnerThread(self.workers.local_worker.policy)
+        self.learner.start()
+        frag = self.algo_config["rollout_fragment_length"]
+        # Prime one in-flight sample per worker.
+        self._inflight = {w.sample.remote(frag): w
+                          for w in self.workers.remote_workers}
+        self._since_broadcast = 0
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        frag = cfg["rollout_fragment_length"]
+        steps_this_iter = 0
+        # Drain ready rollouts into the learner while keeping every worker
+        # busy (the async loop of impala.py:445).
+        while steps_this_iter < cfg["min_steps_per_iteration"]:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=60.0)
+            for ref in ready:
+                worker = self._inflight.pop(ref)
+                batch = ray_tpu.get(ref, timeout=60)
+                steps_this_iter += batch.count
+                self._timesteps_total += batch.count
+                self.learner.inqueue.put(batch)
+                self._since_broadcast += 1
+                if self._since_broadcast >= cfg["broadcast_interval"]:
+                    self._since_broadcast = 0
+                    wref = ray_tpu.put(self.learner.get_weights())
+                    worker.set_weights.remote(wref)
+                self._inflight[worker.sample.remote(frag)] = worker
+        return {"info": {"learner": dict(self.learner.stats),
+                         "learner_queue_size": self.learner.inqueue.qsize(),
+                         "num_batches_trained": self.learner.num_batches},
+                "num_env_steps_trained": self.learner.num_steps_trained}
+
+    def cleanup(self):
+        self.learner.stop()
+        super().cleanup()

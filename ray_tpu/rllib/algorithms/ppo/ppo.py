@@ -1,0 +1,80 @@
+"""PPO: synchronous sample -> minibatch SGD epochs -> weight broadcast.
+
+Reference: rllib/algorithms/ppo/ppo.py:288 (training_step :400) +
+execution/rollout_ops.py:36 synchronous_parallel_sample and
+execution/train_ops.py:42 train_one_step.  The learner lives in the local
+worker; on TPU the jitted train step runs each minibatch on-chip.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PPO)
+        self._config.update({
+            "lr": 1e-3,
+            "clip_param": 0.2,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.0,
+            "num_sgd_iter": 15,
+            "sgd_minibatch_size": 128,
+        })
+
+
+class PPO(Algorithm):
+    def _extra_defaults(self) -> Dict:
+        return {"lr": 1e-3, "clip_param": 0.2, "vf_loss_coeff": 0.5,
+                "entropy_coeff": 0.0, "num_sgd_iter": 15,
+                "sgd_minibatch_size": 128}
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        # 1. Synchronous parallel sampling across the worker set
+        # (reference: synchronous_parallel_sample rollout_ops.py:36).
+        target = cfg["train_batch_size"]
+        per_worker = max(1, target
+                         // max(1, len(self.workers.remote_workers)))
+        batches = []
+        collected = 0
+        while collected < target:
+            refs = self.workers.sample_all(per_worker)
+            if not refs:  # num_rollout_workers=0: sample locally
+                b = self.workers.local_worker.sample(per_worker)
+                batches.append(b)
+                collected += b.count
+                continue
+            for b in ray_tpu.get(refs, timeout=600):
+                batches.append(b)
+                collected += b.count
+        train_batch = SampleBatch.concat_samples(batches)
+        self._timesteps_total += train_batch.count
+
+        # Advantage normalization over the full batch (reference PPO
+        # standardize_fields=["advantages"]).
+        adv = train_batch["advantages"]
+        train_batch["advantages"] = (
+            (adv - adv.mean()) / max(adv.std(), 1e-6)).astype(np.float32)
+
+        # 2. SGD epochs over shuffled minibatches (train_ops.py:42).
+        policy = self.workers.local_worker.policy
+        rng = np.random.RandomState(cfg["seed"])
+        stats: Dict = {}
+        mb = min(cfg["sgd_minibatch_size"], train_batch.count)
+        for _ in range(cfg["num_sgd_iter"]):
+            shuffled = train_batch.shuffle(rng)
+            for minibatch in shuffled.minibatches(mb):
+                stats = policy.learn_on_batch(minibatch)
+
+        # 3. Broadcast fresh weights to the rollout workers.
+        self.workers.sync_weights()
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": train_batch.count}

@@ -1,0 +1,115 @@
+"""RolloutWorker: an actor stepping environments with the current policy.
+
+Reference: rllib/evaluation/rollout_worker.py:124 (sample :776) — env
+loop + policy inference + GAE postprocessing.  Workers are CPU actors;
+the learner (driver or TPU actor) trains and broadcasts weights back.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy import sample_batch as sb
+from ray_tpu.rllib.policy.sample_batch import SampleBatch, compute_gae
+
+
+class RolloutWorker:
+    def __init__(self, env_creator: Callable, policy_cls, config: Dict,
+                 worker_index: int = 0):
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.config = dict(config)
+        self.config["seed"] = self.config.get("seed", 0) + worker_index
+        self.env = env_creator(self.config)
+        obs_dim = int(np.prod(self.env.observation_space.shape))
+        num_actions = int(self.env.action_space.n)
+        self.policy = policy_cls(obs_dim, num_actions, self.config)
+        self.worker_index = worker_index
+        self._obs, _ = self.env.reset(seed=self.config["seed"])
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self._completed_rewards: List[float] = []
+        self._completed_lens: List[int] = []
+
+    def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
+        """Collect one fragment of experience with GAE advantages."""
+        horizon = num_steps or self.config.get("rollout_fragment_length",
+                                               200)
+        gamma = self.config.get("gamma", 0.99)
+        lam = self.config.get("lambda", 0.95)
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                                sb.ACTION_LOGP, sb.VF_PREDS)}
+        segments: List[SampleBatch] = []
+        seg_start = 0
+        for _ in range(horizon):
+            action, logp, vf = self.policy.compute_actions(
+                self._obs[None, :])
+            obs2, reward, terminated, truncated, _ = self.env.step(
+                int(action[0]))
+            done = terminated or truncated
+            rows[sb.OBS].append(self._obs)
+            rows[sb.ACTIONS].append(int(action[0]))
+            rows[sb.REWARDS].append(float(reward))
+            rows[sb.DONES].append(bool(terminated))
+            rows[sb.ACTION_LOGP].append(float(logp[0]))
+            rows[sb.VF_PREDS].append(float(vf[0]))
+            self._episode_reward += float(reward)
+            self._episode_len += 1
+            self._obs = obs2
+            if done:
+                self._completed_rewards.append(self._episode_reward)
+                self._completed_lens.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                self._obs, _ = self.env.reset()
+                # Close the segment at the episode boundary.
+                segments.append(self._segment(rows, seg_start,
+                                              len(rows[sb.OBS]),
+                                              last_value=0.0,
+                                              gamma=gamma, lam=lam))
+                seg_start = len(rows[sb.OBS])
+        if seg_start < len(rows[sb.OBS]):
+            # Bootstrap the truncated tail with V(s_T).
+            last_v = float(self.policy.value(self._obs[None, :])[0])
+            segments.append(self._segment(rows, seg_start,
+                                          len(rows[sb.OBS]),
+                                          last_value=last_v,
+                                          gamma=gamma, lam=lam))
+        return SampleBatch.concat_samples(segments)
+
+    def _segment(self, rows, start, end, last_value, gamma, lam):
+        seg = SampleBatch({
+            sb.OBS: np.asarray(rows[sb.OBS][start:end], np.float32),
+            sb.ACTIONS: np.asarray(rows[sb.ACTIONS][start:end], np.int32),
+            sb.REWARDS: np.asarray(rows[sb.REWARDS][start:end], np.float32),
+            sb.DONES: np.asarray(rows[sb.DONES][start:end], np.bool_),
+            sb.ACTION_LOGP: np.asarray(rows[sb.ACTION_LOGP][start:end],
+                                       np.float32),
+            sb.VF_PREDS: np.asarray(rows[sb.VF_PREDS][start:end],
+                                    np.float32),
+        })
+        return compute_gae(seg, last_value, gamma, lam)
+
+    def set_weights(self, weights) -> bool:
+        self.policy.set_weights(weights)
+        return True
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def episode_stats(self, clear: bool = True) -> Dict:
+        stats = {"episode_rewards": list(self._completed_rewards),
+                 "episode_lens": list(self._completed_lens)}
+        if clear:
+            self._completed_rewards = []
+            self._completed_lens = []
+        return stats
+
+    def stop(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass
+        return True

@@ -1,0 +1,59 @@
+"""WorkerSet: the gang of RolloutWorker actors.
+
+Reference: rllib/evaluation/worker_set.py:50 — remote workers + a local
+worker for the learner; sync_weights broadcasts through the object store
+(one put, N fetches — the reference's object-store broadcast pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import ray_tpu
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+
+
+class WorkerSet:
+    def __init__(self, env_creator: Callable, policy_cls, config: Dict,
+                 num_workers: int):
+        self.config = config
+        # Local worker holds the learner policy (reference: WorkerSet
+        # local_worker()).
+        self.local_worker = RolloutWorker(env_creator, policy_cls, config,
+                                          worker_index=0)
+        remote_cls = ray_tpu.remote(RolloutWorker)
+        self.remote_workers = [
+            remote_cls.options(num_cpus=1).remote(
+                env_creator, policy_cls, config, worker_index=i + 1)
+            for i in range(num_workers)
+        ]
+
+    def sync_weights(self):
+        """Broadcast learner weights: one shm put, each worker fetches."""
+        ref = ray_tpu.put(self.local_worker.get_weights())
+        ray_tpu.get([w.set_weights.remote(ref)
+                     for w in self.remote_workers], timeout=300)
+
+    def sample_all(self, num_steps: int) -> List:
+        """One sample() round per remote worker (refs, not values)."""
+        return [w.sample.remote(num_steps) for w in self.remote_workers]
+
+    def episode_stats(self) -> Dict:
+        stats = ray_tpu.get([w.episode_stats.remote()
+                             for w in self.remote_workers], timeout=300)
+        local = self.local_worker.episode_stats()
+        rewards = list(local["episode_rewards"])
+        lens = list(local["episode_lens"])
+        for s in stats:
+            rewards += s["episode_rewards"]
+            lens += s["episode_lens"]
+        return {"episode_rewards": rewards, "episode_lens": lens}
+
+    def stop(self):
+        for w in self.remote_workers:
+            try:
+                ray_tpu.get(w.stop.remote(), timeout=10)
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.remote_workers = []

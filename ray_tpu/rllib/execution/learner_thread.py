@@ -1,0 +1,48 @@
+"""LearnerThread: overlaps SGD with sampling for async algorithms.
+
+Reference: rllib/execution/learner_thread.py:17 — a thread draining an
+in-queue of sample batches into learn_on_batch while the driver keeps
+collecting rollouts.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+
+class LearnerThread(threading.Thread):
+    def __init__(self, policy, max_queue: int = 16):
+        super().__init__(daemon=True, name="impala-learner")
+        self.policy = policy
+        self.inqueue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self.stopped = False
+        self.stats: Dict = {}
+        self.num_batches = 0
+        self.num_steps_trained = 0
+        self._lock = threading.Lock()
+
+    def run(self):
+        while not self.stopped:
+            try:
+                batch = self.inqueue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if batch is None:
+                break
+            with self._lock:
+                self.stats = self.policy.learn_on_batch(batch)
+                self.num_batches += 1
+                self.num_steps_trained += batch.count
+
+    def get_weights(self):
+        with self._lock:
+            return self.policy.get_weights()
+
+    def stop(self):
+        self.stopped = True
+        try:
+            self.inqueue.put_nowait(None)
+        except queue.Full:
+            pass

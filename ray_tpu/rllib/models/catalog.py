@@ -1,0 +1,32 @@
+"""Model catalog: default flax networks for RL policies.
+
+Reference: rllib/models/catalog.py (ModelCatalog) + the JAX model sketches
+the reference started (rllib/models/jax/fcnet.py).  Here jax IS the
+framework: models are flax modules jitted into the policy's train step, so
+the MXU sees one fused forward/backward per SGD minibatch.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FCPolicyValueNet(nn.Module):
+    """Shared-trunk MLP with categorical-logits + value heads (reference:
+    fcnet defaults - two 256 tanh layers; 64s are plenty for classic
+    control)."""
+
+    num_actions: int
+    hiddens: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        h = x
+        for width in self.hiddens:
+            h = nn.tanh(nn.Dense(width)(h))
+        logits = nn.Dense(self.num_actions)(h)
+        value = nn.Dense(1)(h)
+        return logits, value[..., 0]

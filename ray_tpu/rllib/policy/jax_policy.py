@@ -1,0 +1,106 @@
+"""JaxPolicy: categorical-action policy with a jitted PPO-style train step.
+
+Reference: rllib/policy/policy.py:150 (Policy API: compute_actions /
+learn_on_batch / get_weights / set_weights) — re-designed jax-first: the
+entire SGD step (forward, loss, grad, adam update) is one jitted function;
+weights cross process boundaries as numpy pytrees through the object
+store.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.models.catalog import FCPolicyValueNet
+from ray_tpu.rllib.policy import sample_batch as sb
+
+
+class JaxPolicy:
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict):
+        self.config = config
+        self.model = FCPolicyValueNet(
+            num_actions=num_actions,
+            hiddens=tuple(config.get("fcnet_hiddens", (64, 64))))
+        rng = jax.random.PRNGKey(config.get("seed", 0))
+        self.params = self.model.init(
+            rng, jnp.zeros((1, obs_dim), jnp.float32))
+        self.tx = optax.adam(config.get("lr", 3e-4))
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(config.get("seed", 0) + 1)
+        self._forward = jax.jit(self.model.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+
+    # ------------------------------------------------------------ acting
+    def compute_actions(self, obs: np.ndarray) \
+            -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Returns (actions, action_logp, vf_preds)."""
+        self._rng, key = jax.random.split(self._rng)
+        logits, value = self._forward(self.params,
+                                      jnp.asarray(obs, jnp.float32))
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions]
+        return (np.asarray(actions), np.asarray(logp), np.asarray(value))
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        _, v = self._forward(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(v)
+
+    # ---------------------------------------------------------- learning
+    def _loss(self, params, batch):
+        """PPO clip objective, or IMPALA's importance-clipped policy
+        gradient when config["loss"] == "impala" (reference:
+        rllib/algorithms/ppo/ppo_torch_policy.py loss; impala vtrace rho
+        truncation — scoped to the rho-clipped advantage form)."""
+        cfg = self.config
+        logits, value = self.model.apply(params, batch[sb.OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch[sb.ACTIONS]]
+        ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
+        adv = batch[sb.ADVANTAGES]
+        if cfg.get("loss", "ppo") == "impala":
+            # Off-policy correction: truncated importance weights (the
+            # rho-bar of V-trace) applied to the advantage estimate.
+            rho = jnp.minimum(jax.lax.stop_gradient(ratio),
+                              cfg.get("rho_clip", 1.0))
+            surrogate = rho * adv * logp
+        else:
+            clip = cfg.get("clip_param", 0.2)
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        vf_loss = (value - batch[sb.VALUE_TARGETS]) ** 2
+        total = (-surrogate.mean()
+                 + cfg.get("vf_loss_coeff", 0.5) * vf_loss.mean()
+                 - cfg.get("entropy_coeff", 0.0) * entropy.mean())
+        return total, {"policy_loss": -surrogate.mean(),
+                       "vf_loss": vf_loss.mean(),
+                       "entropy": entropy.mean()}
+
+    def _train_step_impl(self, params, opt_state, batch):
+        (loss, stats), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats = dict(stats, total_loss=loss)
+        return params, opt_state, stats
+
+    def learn_on_batch(self, batch: sb.SampleBatch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._train_step(
+            self.params, self.opt_state, jbatch)
+        return {k: float(v) for k, v in stats.items()}
+
+    # ----------------------------------------------------------- weights
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)

@@ -1,0 +1,83 @@
+"""SampleBatch: the unit of experience moving between rollout and train.
+
+Reference: rllib/policy/sample_batch.py — a dict of parallel arrays with
+concat/shuffle/minibatch helpers.  Kept as plain numpy so batches ride the
+shm object store zero-copy; conversion to jax arrays happens once at the
+learner (device put = single host→HBM transfer).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "new_obs"
+ACTION_LOGP = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    """dict[str, np.ndarray] with aligned first dimensions."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in list(self.items()):
+            if not isinstance(v, np.ndarray):
+                self[k] = np.asarray(v)
+
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch({
+            k: np.concatenate([b[k] for b in batches]) for k in keys})
+
+    def shuffle(self, rng: np.random.RandomState) -> "SampleBatch":
+        perm = rng.permutation(self.count)
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for start in range(0, n - size + 1, size):
+            yield SampleBatch({k: v[start:start + size]
+                               for k, v in self.items()})
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
+                lam: float) -> SampleBatch:
+    """Generalized advantage estimation over one (possibly truncated)
+    rollout segment (reference: rllib/evaluation/postprocessing.py
+    compute_advantages)."""
+    rewards = batch[REWARDS]
+    dones = batch[DONES].astype(np.float32)
+    vf = batch[VF_PREDS]
+    n = len(rewards)
+    adv = np.zeros(n, dtype=np.float32)
+    next_v = last_value
+    next_adv = 0.0
+    for t in range(n - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_v * nonterminal - vf[t]
+        next_adv = delta + gamma * lam * nonterminal * next_adv
+        adv[t] = next_adv
+        next_v = vf[t]
+    batch[ADVANTAGES] = adv
+    batch[VALUE_TARGETS] = (adv + vf).astype(np.float32)
+    return batch

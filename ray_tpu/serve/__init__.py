@@ -1,0 +1,34 @@
+"""Serve: online model serving on ray_tpu.
+
+Deployments are reconciled by a controller actor toward their declared
+target (replicas, version, autoscaling); queries route through
+max_concurrent_queries-aware routers; HTTP ingress via an aiohttp proxy
+actor.  Reference: python/ray/serve (SURVEY.md §2.3, §3.5).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    get_proxy_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.config import (  # noqa: F401
+    AutoscalingConfig,
+    DeploymentConfig,
+    HTTPOptions,
+)
+from ray_tpu.serve.handle import DeploymentHandle, RayServeHandle  # noqa: F401
+from ray_tpu.serve._private.replica import Request  # noqa: F401
+
+__all__ = [
+    "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "HTTPOptions", "RayServeHandle", "Request",
+    "batch", "delete", "deployment", "get_deployment_handle",
+    "get_proxy_address", "run", "shutdown", "start", "status",
+]

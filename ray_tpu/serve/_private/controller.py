@@ -1,0 +1,186 @@
+"""ServeController: the Serve control plane, one detached actor.
+
+Reference: python/ray/serve/controller.py — ServeController (:61): owns the
+DeploymentStateManager and the LongPollHost, runs the reconciliation loop,
+records autoscaling metrics, and answers deploy/delete/status RPCs.
+Autoscaling policy per serve/_private/autoscaling_policy.py: desired =
+ceil(total_ongoing / target_per_replica), clamped and delayed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
+from ray_tpu.serve._private.deployment_state import (
+    DeploymentStateManager, RUNNING)
+from ray_tpu.serve._private.long_poll import LongPollHost
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+CONTROL_LOOP_PERIOD_S = 0.1
+
+
+class _AutoscaleState:
+    def __init__(self):
+        self.over_since: Optional[float] = None
+        self.under_since: Optional[float] = None
+
+
+class ServeController:
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 0):
+        import threading
+        self._long_poll = LongPollHost()
+        self._dsm = DeploymentStateManager(self._long_poll)
+        # deploy/update/shutdown all mutate the DSM from executor threads;
+        # one lock serializes them (the reconcile tick is cheap).
+        self._dsm_lock = threading.Lock()
+        self._autoscale: Dict[str, _AutoscaleState] = {}
+        self._http_config = {"host": http_host, "port": http_port}
+        self._shutdown = False
+        self._loop_started = False
+
+    # ------------------------------------------------------------ RPCs
+    async def deploy(self, name: str, config_dict: Dict,
+                     replica_config: ReplicaConfig, version: str) -> bool:
+        config = DeploymentConfig.from_dict(config_dict)
+
+        def _do():
+            with self._dsm_lock:
+                self._dsm.deploy(name, config, replica_config, version)
+
+        await asyncio.get_running_loop().run_in_executor(None, _do)
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        def _do():
+            with self._dsm_lock:
+                self._dsm.delete(name)
+
+        # The reconcile tick can hold the lock for seconds (blocking gets
+        # on hung replicas) — never acquire it on the event loop.
+        await asyncio.get_running_loop().run_in_executor(None, _do)
+        return True
+
+    async def get_deployment_statuses(self) -> List[Dict]:
+        return self._dsm.statuses()
+
+    async def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int]):
+        return await self._long_poll.listen(keys_to_snapshot_ids)
+
+    async def wait_deployments_healthy(self, names: List[str],
+                                       timeout_s: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            statuses = {s["name"]: s for s in self._dsm.statuses()}
+            if all(statuses.get(n, {}).get("status") == "HEALTHY"
+                   for n in names):
+                return True
+            if any(statuses.get(n, {}).get("status") == "DEPLOY_FAILED"
+                   for n in names):
+                return False
+            await asyncio.sleep(0.1)
+        return False
+
+    async def get_http_config(self) -> Dict:
+        return dict(self._http_config)
+
+    async def set_http_config(self, cfg: Dict):
+        self._http_config.update(cfg)
+        return True
+
+    async def graceful_shutdown(self):
+        self._shutdown = True
+
+        def _delete_all():
+            with self._dsm_lock:
+                for s in self._dsm.statuses():
+                    self._dsm.delete(s["name"])
+
+        await asyncio.get_running_loop().run_in_executor(None, _delete_all)
+
+        def _tick():
+            with self._dsm_lock:
+                self._dsm.update()
+                return not self._dsm.statuses()
+
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if await loop.run_in_executor(None, _tick):
+                break
+            await asyncio.sleep(0.1)
+        return True
+
+    # ----------------------------------------------------- control loop
+    async def run_control_loop(self):
+        """Fire-and-forget from serve.start(); reconciles forever
+        (reference: controller.py run_control_loop)."""
+        if self._loop_started:
+            return
+        self._loop_started = True
+        loop = asyncio.get_running_loop()
+
+        def _tick():
+            with self._dsm_lock:
+                self._dsm.update()
+                self._autoscale_tick()
+
+        while not self._shutdown:
+            try:
+                # Reconciliation does sync waits/kills: run off-loop so
+                # deploy/listen RPCs stay responsive.
+                await loop.run_in_executor(None, _tick)
+            except Exception:
+                logger.exception("control loop tick failed")
+            await asyncio.sleep(CONTROL_LOOP_PERIOD_S)
+
+    def _autoscale_tick(self):
+        now = time.monotonic()
+        for status in self._dsm.statuses():
+            name = status["name"]
+            ds = self._dsm.get(name)
+            if ds is None or ds.target_config is None:
+                continue
+            ac = ds.target_config.autoscaling_config
+            if ac is None or ds.deleting:
+                continue
+            running = [r for r in ds.replicas if r.state == RUNNING]
+            if not running:
+                continue
+            total = 0
+            for r in running:
+                n = r.num_ongoing()
+                if n is not None:
+                    total += n
+            desired = math.ceil(
+                total / max(ac.target_num_ongoing_requests_per_replica,
+                            1e-9) * ac.smoothing_factor)
+            desired = min(max(desired, ac.min_replicas), ac.max_replicas)
+            st = self._autoscale.setdefault(name, _AutoscaleState())
+            cur = ds.target_num_replicas
+            if desired > cur:
+                st.under_since = None
+                if st.over_since is None:
+                    st.over_since = now
+                if now - st.over_since >= ac.upscale_delay_s:
+                    logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                                name, cur, desired, total)
+                    ds.set_target_num_replicas(desired)
+                    st.over_since = None
+            elif desired < cur:
+                st.over_since = None
+                if st.under_since is None:
+                    st.under_since = now
+                if now - st.under_since >= ac.downscale_delay_s:
+                    logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                                name, cur, desired, total)
+                    ds.set_target_num_replicas(desired)
+                    st.under_since = None
+            else:
+                st.over_since = st.under_since = None

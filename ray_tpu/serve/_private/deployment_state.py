@@ -1,0 +1,335 @@
+"""Deployment state reconciliation: target state vs running replicas.
+
+Reference: python/ray/serve/_private/deployment_state.py — DeploymentState
+(:897) with the STARTING/RUNNING/STOPPING replica sets, DeploymentStateManager
+(:1567) driving update() every control-loop tick, ActorReplicaWrapper (:162)
+hiding the actor lifecycle.  Rolling updates: new-version replicas start
+first; old-version replicas stop only as new ones become ready, so serving
+capacity never drops to zero (zero-downtime rollout).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
+
+logger = logging.getLogger(__name__)
+
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+STOPPING = "STOPPING"
+
+
+class ReplicaWrapper:
+    """One replica actor's lifecycle (reference: ActorReplicaWrapper)."""
+
+    def __init__(self, deployment_name: str, version: str,
+                 config: DeploymentConfig, replica_config: ReplicaConfig):
+        self.deployment_name = deployment_name
+        self.version = version
+        self.replica_tag = f"{deployment_name}#{uuid.uuid4().hex[:8]}"
+        self.state = STARTING
+        self._config = config
+        self._replica_config = replica_config
+        self._actor = None
+        self._ready_ref = None
+        self._drain_ref = None
+
+    def start(self):
+        from ray_tpu.serve._private.replica import RTServeReplica
+        opts = dict(self._replica_config.ray_actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts.setdefault("name",
+                        f"SERVE_REPLICA::{self.replica_tag}")
+        opts.setdefault("max_concurrency", 1000)
+        cls = ray_tpu.remote(RTServeReplica)
+        self._actor = cls.options(**opts).remote(
+            self.deployment_name, self.replica_tag,
+            self._replica_config.deployment_def,
+            self._replica_config.init_args,
+            self._replica_config.init_kwargs,
+            self._config.user_config, self.version)
+        # Readiness probe: resolves when __init__ + reconfigure finished.
+        self._ready_ref = self._actor.get_metadata.remote()
+
+    def check_ready(self) -> Optional[bool]:
+        """None = still starting, True = ready, False = failed."""
+        done, _ = ray_tpu.wait([self._ready_ref], num_returns=1, timeout=0)
+        if not done:
+            return None
+        try:
+            ray_tpu.get(self._ready_ref, timeout=1)
+            self.state = RUNNING
+            return True
+        except Exception as e:
+            logger.warning("replica %s failed to start: %s",
+                           self.replica_tag, e)
+            return False
+
+    def reconfigure(self, user_config, version: str):
+        self.version = version
+        return self._actor.reconfigure.remote(user_config, version)
+
+    def begin_stop(self, timeout_s: float):
+        self.state = STOPPING
+        if self._actor is not None:
+            self._drain_ref = self._actor.prepare_for_shutdown.remote(
+                timeout_s)
+
+    def check_stopped(self) -> bool:
+        if self._actor is None:
+            return True
+        if self._drain_ref is not None:
+            done, _ = ray_tpu.wait([self._drain_ref], num_returns=1,
+                                   timeout=0)
+            if not done:
+                return False
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass
+        self._actor = None
+        return True
+
+    def running_info(self) -> Dict:
+        return {
+            "replica_tag": self.replica_tag,
+            "deployment": self.deployment_name,
+            "version": self.version,
+            "actor": self._actor,
+            "max_concurrent_queries": self._config.max_concurrent_queries,
+        }
+
+    def num_ongoing(self) -> Optional[int]:
+        try:
+            return ray_tpu.get(self._actor.num_ongoing_requests.remote(),
+                               timeout=2)
+        except Exception:
+            return None
+
+    _health_ref = None
+    _health_sent_at = 0.0
+
+    def poll_health(self, now: float) -> bool:
+        """Non-blocking health tracking: fire a probe, poll it on later
+        ticks.  Returns False when the replica must be replaced (probe
+        errored or outlived health_check_timeout_s).  One hung replica
+        must never stall the control loop (reference tracks health the
+        same way: deployment_state.py check_started/health polling)."""
+        if self._actor is None:
+            return False
+        if self._health_ref is None:
+            self._health_ref = self._actor.check_health.remote()
+            self._health_sent_at = now
+            return True
+        done, _ = ray_tpu.wait([self._health_ref], num_returns=1, timeout=0)
+        if not done:
+            if now - self._health_sent_at \
+                    > self._config.health_check_timeout_s:
+                return False
+            return True
+        try:
+            ray_tpu.get(self._health_ref, timeout=1)
+            self._health_ref = None
+            return True
+        except Exception:
+            return False
+
+
+class DeploymentState:
+    """Reconciles one deployment (reference: deployment_state.py:897)."""
+
+    def __init__(self, name: str, long_poll_host):
+        self.name = name
+        self._long_poll = long_poll_host
+        self.target_config: Optional[DeploymentConfig] = None
+        self.target_replica_config: Optional[ReplicaConfig] = None
+        self.target_version: Optional[str] = None
+        self.target_num_replicas = 0
+        self.deleting = False
+        self.replicas: List[ReplicaWrapper] = []
+        self._last_health_check = 0.0
+        self._last_broadcast: Any = None
+        self._start_failures = 0
+        self.deploy_failed = False
+
+    # ------------------------------------------------------------- target
+    def deploy(self, config: DeploymentConfig,
+               replica_config: ReplicaConfig, version: str):
+        self.target_config = config
+        self.target_replica_config = replica_config
+        self.target_version = version
+        self.deleting = False
+        self._start_failures = 0
+        self.deploy_failed = False
+        if config.autoscaling_config is not None:
+            lo = config.autoscaling_config.min_replicas
+            hi = config.autoscaling_config.max_replicas
+            self.target_num_replicas = min(
+                max(self.target_num_replicas or lo, lo), hi)
+        else:
+            self.target_num_replicas = config.num_replicas
+
+    def delete(self):
+        self.deleting = True
+        self.target_num_replicas = 0
+
+    def set_target_num_replicas(self, n: int):
+        """Autoscaler entry point."""
+        self.target_num_replicas = n
+
+    # ---------------------------------------------------------- reconcile
+    def update(self) -> bool:
+        """One reconciliation tick.  Returns True while work is pending."""
+        cfg = self.target_config
+        if cfg is None:
+            return False
+        # 1. Promote replicas that finished starting; drop failed ones.
+        for r in list(self.replicas):
+            if r.state == STARTING:
+                ready = r.check_ready()
+                if ready is False:
+                    self.replicas.remove(r)
+                    self._start_failures += 1
+                    if self._start_failures >= 3:
+                        # Constructor keeps failing: stop respawning 10x/s
+                        # forever (reference: DEPLOY_FAILED after bounded
+                        # attempts, deployment_state.py).
+                        self.deploy_failed = True
+                        logger.error(
+                            "deployment %s marked DEPLOY_FAILED after %d "
+                            "consecutive replica start failures",
+                            self.name, self._start_failures)
+                elif ready is True:
+                    self._start_failures = 0
+            elif r.state == STOPPING:
+                if r.check_stopped():
+                    self.replicas.remove(r)
+
+        running = [r for r in self.replicas if r.state == RUNNING]
+        starting = [r for r in self.replicas if r.state == STARTING]
+
+        # 2. Version rollout: light config change (user_config only) is
+        # applied in place; a code/version change replaces replicas, new
+        # before old (zero downtime).
+        stale = [r for r in running if r.version != self.target_version]
+        fresh = [r for r in running + starting
+                 if r.version == self.target_version]
+        # Start new-version replicas up to the target count.
+        want_new = 0 if self.deploy_failed \
+            else self.target_num_replicas - len(fresh)
+        for _ in range(max(0, want_new)):
+            r = ReplicaWrapper(self.name, self.target_version, cfg,
+                               self.target_replica_config)
+            r.start()
+            self.replicas.append(r)
+        # Stop stale replicas only when enough fresh ones are RUNNING to
+        # keep capacity (rolling).
+        fresh_running = [r for r in running
+                         if r.version == self.target_version]
+        allow_stop = min(len(stale),
+                         max(0, len(fresh_running) + len(stale)
+                             - self.target_num_replicas))
+        for r in stale[:allow_stop]:
+            r.begin_stop(cfg.graceful_shutdown_timeout_s)
+
+        # 3. Scale down surplus same-version replicas.
+        fresh_running = [r for r in self.replicas
+                         if r.state == RUNNING
+                         and r.version == self.target_version]
+        excess = len(fresh_running) - self.target_num_replicas
+        for r in fresh_running[:max(0, excess)]:
+            r.begin_stop(cfg.graceful_shutdown_timeout_s)
+
+        # 4. Health checks on running replicas (periodic, non-blocking).
+        now = time.monotonic()
+        if now - self._last_health_check > cfg.health_check_period_s:
+            self._last_health_check = now
+            for r in [x for x in self.replicas if x.state == RUNNING]:
+                if not r.poll_health(now):
+                    logger.warning("replica %s unhealthy; replacing",
+                                   r.replica_tag)
+                    r.state = STOPPING
+                    r.check_stopped()
+                    if r in self.replicas:
+                        self.replicas.remove(r)
+
+        # 5. Broadcast the running-replica set on change.
+        infos = [r.running_info() for r in self.replicas
+                 if r.state == RUNNING]
+        fingerprint = sorted((i["replica_tag"], i["version"])
+                             for i in infos)
+        if fingerprint != self._last_broadcast:
+            self._last_broadcast = fingerprint
+            self._long_poll.notify_changed(
+                f"replicas::{self.name}", infos)
+
+        pending = bool(
+            [r for r in self.replicas
+             if r.state != RUNNING]) or self.target_num_replicas != len(
+            [r for r in self.replicas if r.state == RUNNING])
+        return pending
+
+    def curr_status(self) -> Dict:
+        by_state: Dict[str, int] = {}
+        for r in self.replicas:
+            by_state[r.state] = by_state.get(r.state, 0) + 1
+        healthy = (not self.deleting
+                   and by_state.get(RUNNING, 0) == self.target_num_replicas
+                   and by_state.get(STARTING, 0) == 0
+                   and by_state.get(STOPPING, 0) == 0)
+        status = "HEALTHY" if healthy else \
+            ("DELETING" if self.deleting else "UPDATING")
+        if self.deploy_failed:
+            status = "DEPLOY_FAILED"
+        return {"name": self.name, "version": self.target_version,
+                "target_num_replicas": self.target_num_replicas,
+                "replica_states": by_state,
+                "status": status}
+
+
+class DeploymentStateManager:
+    """All deployments (reference: deployment_state.py:1567)."""
+
+    def __init__(self, long_poll_host):
+        self._long_poll = long_poll_host
+        self._deployments: Dict[str, DeploymentState] = {}
+
+    def deploy(self, name: str, config: DeploymentConfig,
+               replica_config: ReplicaConfig, version: str):
+        ds = self._deployments.get(name)
+        if ds is None:
+            ds = self._deployments[name] = DeploymentState(
+                name, self._long_poll)
+        ds.deploy(config, replica_config, version)
+        self._broadcast_routes()
+
+    def delete(self, name: str):
+        ds = self._deployments.get(name)
+        if ds is not None:
+            ds.delete()
+        self._broadcast_routes()
+
+    def _broadcast_routes(self):
+        self._long_poll.notify_changed(
+            "routes", {name: name for name, ds in self._deployments.items()
+                       if not ds.deleting})
+
+    def update(self) -> bool:
+        pending = False
+        for name, ds in list(self._deployments.items()):
+            pending |= ds.update()
+            if ds.deleting and not ds.replicas:
+                del self._deployments[name]
+        return pending
+
+    def get(self, name: str) -> Optional[DeploymentState]:
+        return self._deployments.get(name)
+
+    def statuses(self) -> List[Dict]:
+        return [ds.curr_status() for ds in self._deployments.values()]

@@ -1,0 +1,123 @@
+"""HTTP ingress: an aiohttp server inside an actor, routing to replicas.
+
+Reference: python/ray/serve/_private/http_proxy.py — HTTPProxyActor (:333)
+runs uvicorn in the actor's event loop; HTTPProxy.__call__ (:189) resolves
+the route prefix, forwards to the deployment through a Router, and
+translates the result to an HTTP response.  Here the server is aiohttp
+(starlette/uvicorn are not in the image) on the actor's own loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import logging
+from typing import Dict, Optional
+
+from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.serve._private.replica import Request
+from ray_tpu.serve._private.router import ReplicaSet
+
+logger = logging.getLogger(__name__)
+
+
+class HTTPProxy:
+    """Routing core shared by the actor and tests: route table via long
+    poll, one ReplicaSet per deployment."""
+
+    def __init__(self, controller_handle, loop):
+        self._controller = controller_handle
+        self._loop = loop
+        self.routes: Dict[str, str] = {}   # route prefix -> deployment
+        self._replica_sets: Dict[str, ReplicaSet] = {}
+        self._pollers: Dict[str, LongPollClient] = {}
+        self._route_poller = LongPollClient(
+            controller_handle, {"routes": self._update_routes}, loop=loop)
+
+    def _update_routes(self, routes: Dict[str, str]):
+        self.routes = dict(routes or {})
+        for deployment in self.routes.values():
+            if deployment not in self._replica_sets:
+                rs = ReplicaSet(deployment, self._loop)
+                self._replica_sets[deployment] = rs
+                self._pollers[deployment] = LongPollClient(
+                    self._controller,
+                    {f"replicas::{deployment}": rs.update_replicas},
+                    loop=self._loop)
+
+    async def handle(self, method: str, path: str, query: Dict[str, str],
+                     body: bytes, headers: Dict[str, str]):
+        """Resolve /<deployment>/rest to a replica call."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return 200, _json.dumps(
+                {"routes": sorted(self.routes)}).encode(), "application/json"
+        name = parts[0]
+        if name not in self.routes:
+            return 404, f"no deployment {name!r}".encode(), "text/plain"
+        deployment = self.routes[name]
+        rs = self._replica_sets[deployment]
+        req = Request(method=method, path="/" + "/".join(parts[1:]),
+                      query=query, body=body, headers=headers)
+        try:
+            result = await rs.assign_replica("", (req,), {})
+        except Exception as e:
+            logger.exception("request to %s failed", deployment)
+            return 500, repr(e).encode(), "text/plain"
+        if isinstance(result, (bytes, bytearray)):
+            return 200, bytes(result), "application/octet-stream"
+        if isinstance(result, str):
+            return 200, result.encode(), "text/plain"
+        try:
+            return 200, _json.dumps(result).encode(), "application/json"
+        except TypeError:
+            return 200, repr(result).encode(), "text/plain"
+
+
+class HTTPProxyActor:
+    """The actor: binds the port in __init__ via its own background loop
+    bridge; serves until killed.  One per node in a full deployment
+    (reference starts one per node via node-affinity scheduling)."""
+
+    def __init__(self, host: str, port: int, controller_name: str):
+        import ray_tpu
+        self.host = host
+        self.port = port
+        self._controller = ray_tpu.get_actor(controller_name)
+        self._proxy: Optional[HTTPProxy] = None
+        self._runner = None
+        self._site = None
+        self._ready = asyncio.Event()
+
+    async def run(self):
+        """Start the aiohttp server on the actor's event loop; returns
+        once the socket is bound (callers get readiness), then serves
+        until the actor dies."""
+        from aiohttp import web
+        loop = asyncio.get_running_loop()
+        self._proxy = HTTPProxy(self._controller, loop)
+
+        async def _handler(request: "web.Request"):
+            body = await request.read()
+            status, payload, ctype = await self._proxy.handle(
+                request.method, request.path, dict(request.query), body,
+                dict(request.headers))
+            return web.Response(status=status, body=payload,
+                                content_type=ctype.split(";")[0])
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", _handler)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        # Discover the bound port (port=0 requests an ephemeral one).
+        for sock in self._site._server.sockets:  # noqa: SLF001
+            self.port = sock.getsockname()[1]
+            break
+        self._ready.set()
+        return {"host": self.host, "port": self.port}
+
+    async def ready(self) -> Dict:
+        await self._ready.wait()
+        return {"host": self.host, "port": self.port}

@@ -1,0 +1,100 @@
+"""Long-poll config propagation: the controller hosts versioned snapshots;
+routers/proxies/handles block on `listen` and wake only when a watched key
+changes.
+
+Reference: python/ray/serve/_private/long_poll.py — LongPollHost (:179)
+with snapshot_ids + asyncio events, LongPollClient (:63) re-issuing
+listen calls in a loop.  Identical shape here, riding our actor RPC plane
+instead of Ray's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Dict, Optional, Tuple
+
+LISTEN_TIMEOUT_S = 30.0
+
+
+class LongPollHost:
+    """Lives inside the controller actor.  Keys map to (snapshot_id,
+    object); listeners block until any of their keys moves past the
+    snapshot id they already have."""
+
+    def __init__(self):
+        self._snapshot_ids: Dict[str, int] = {}
+        self._objects: Dict[str, Any] = {}
+        self._events: Dict[str, asyncio.Event] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def notify_changed(self, key: str, obj: Any) -> None:
+        """Thread-safe: often called from controller executor threads while
+        listeners wait on the actor's event loop."""
+        self._snapshot_ids[key] = self._snapshot_ids.get(key, -1) + 1
+        self._objects[key] = obj
+        ev = self._events.pop(key, None)
+        if ev is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(ev.set)
+
+    async def listen(self, keys_to_snapshot_ids: Dict[str, int]) -> Dict:
+        """Return {key: (snapshot_id, object)} for every watched key that
+        is newer than what the caller has; block (bounded) if none are."""
+        self._loop = asyncio.get_running_loop()
+        while True:
+            updated = {
+                k: (self._snapshot_ids[k], self._objects[k])
+                for k, sid in keys_to_snapshot_ids.items()
+                if self._snapshot_ids.get(k, -1) > sid
+            }
+            if updated:
+                return updated
+            waiters = []
+            for k in keys_to_snapshot_ids:
+                ev = self._events.get(k)
+                if ev is None:
+                    ev = self._events[k] = asyncio.Event()
+                waiters.append(asyncio.ensure_future(ev.wait()))
+            done, pending = await asyncio.wait(
+                waiters, timeout=LISTEN_TIMEOUT_S,
+                return_when=asyncio.FIRST_COMPLETED)
+            for p in pending:
+                p.cancel()
+            if not done:
+                return {}  # bounded poll: client re-issues
+
+
+class LongPollClient:
+    """Async-side client: loops `listen` against the controller actor and
+    invokes callbacks on updates (reference: long_poll.py:63)."""
+
+    def __init__(self, controller_handle, key_listeners: Dict[str, Callable],
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        self._controller = controller_handle
+        self._listeners = dict(key_listeners)
+        self._snapshot_ids = {k: -1 for k in self._listeners}
+        self._stopped = False
+        self._task = (loop or asyncio.get_event_loop()).create_task(
+            self._run())
+
+    async def _run(self):
+        while not self._stopped:
+            try:
+                ref = self._controller.listen_for_change.remote(
+                    dict(self._snapshot_ids))
+                # wrap_future: safe on any loop (see router.assign_replica).
+                updates = await asyncio.wrap_future(ref.future())
+            except Exception:
+                if self._stopped:
+                    return
+                await asyncio.sleep(0.5)
+                continue
+            for key, (sid, obj) in (updates or {}).items():
+                self._snapshot_ids[key] = sid
+                cb = self._listeners.get(key)
+                if cb is not None:
+                    cb(obj)
+
+    def stop(self):
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()

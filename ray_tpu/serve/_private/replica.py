@@ -1,0 +1,133 @@
+"""The replica actor: hosts one copy of the user's deployment.
+
+Reference: python/ray/serve/_private/replica.py — RayServeReplica (:231)
+wrapping the user callable (:57 create_replica_wrapper), handle_request
+dispatch, reconfigure(user_config), health checks.  TPU-native detail:
+replicas that request TPU resources are leased TPU workers, so jax inits
+the chip inside the replica process and models stay resident in HBM
+between requests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class Request:
+    """Minimal HTTP-ish request container handed to deployments reached
+    through the proxy (reference passes a starlette Request)."""
+
+    __slots__ = ("method", "path", "query", "body", "headers")
+
+    def __init__(self, method: str = "GET", path: str = "/",
+                 query: Optional[Dict[str, str]] = None, body: bytes = b"",
+                 headers: Optional[Dict[str, str]] = None):
+        self.method = method
+        self.path = path
+        self.query = query or {}
+        self.body = body
+        self.headers = headers or {}
+
+    def json(self):
+        import json
+        return json.loads(self.body or b"null")
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query, self.body,
+                          self.headers))
+
+
+class RTServeReplica:
+    """Actor class for one replica (created by the controller)."""
+
+    def __init__(self, deployment_name: str, replica_tag: str,
+                 serialized_def: bytes, init_args: tuple,
+                 init_kwargs: dict, user_config: Any, version: str):
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        self.version = version
+        self._num_ongoing = 0
+        self._num_processed = 0
+        from concurrent.futures import ThreadPoolExecutor
+        self._sync_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"replica-{replica_tag}")
+        body = cloudpickle.loads(serialized_def)
+        if inspect.isclass(body):
+            self.callable = body(*init_args, **init_kwargs)
+        else:
+            self.callable = body
+        if user_config is not None:
+            self._reconfigure_sync(user_config)
+
+    def _reconfigure_sync(self, user_config):
+        rc = getattr(self.callable, "reconfigure", None)
+        if rc is None:
+            raise ValueError(
+                f"{self.deployment_name}: user_config set but deployment "
+                "has no reconfigure(user_config) method")
+        rc(user_config)
+
+    def reconfigure(self, user_config, version: str):
+        if user_config is not None:
+            self._reconfigure_sync(user_config)
+        self.version = version
+        return True
+
+    def check_health(self):
+        hc = getattr(self.callable, "check_health", None)
+        if hc is not None:
+            hc()
+        return True
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict):
+        """One query.  `method_name` '' means call the deployment itself
+        (function deployment or __call__)."""
+        self._num_ongoing += 1
+        try:
+            target = self.callable
+            if method_name:
+                target = getattr(self.callable, method_name)
+            elif not callable(target):
+                target = self.callable.__call__
+            if inspect.iscoroutinefunction(target) or (
+                    not inspect.isfunction(target)
+                    and not inspect.ismethod(target)
+                    and inspect.iscoroutinefunction(
+                        getattr(target, "__call__", None))):
+                result = await target(*args, **kwargs)
+            else:
+                # Sync user code must not block the replica's event loop:
+                # health checks, metrics, and concurrent queries (up to
+                # max_concurrent_queries) ride the same loop.
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    self._sync_pool, lambda: target(*args, **kwargs))
+                if inspect.iscoroutine(result):
+                    result = await result
+            return result
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
+    def get_metadata(self) -> Dict:
+        return {"deployment": self.deployment_name,
+                "replica_tag": self.replica_tag,
+                "version": self.version}
+
+    def num_ongoing_requests(self) -> int:
+        return self._num_ongoing
+
+    async def prepare_for_shutdown(self, timeout_s: float = 10.0):
+        """Drain: wait for in-flight requests to finish (reference:
+        replica.py graceful shutdown loop)."""
+        deadline = time.monotonic() + timeout_s
+        while self._num_ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return True

@@ -1,0 +1,118 @@
+"""Router: assigns queries to replicas, honoring max_concurrent_queries.
+
+Reference: python/ray/serve/_private/router.py — Router (:262) +
+ReplicaSet.assign_replica (:222): pick a replica with a free slot
+(in-flight < max_concurrent_queries); if all are saturated, queue the
+query until one frees.  Replica membership arrives via long poll.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaSet:
+    """The live replicas of one deployment, with in-flight accounting."""
+
+    def __init__(self, deployment_name: str, loop):
+        self.deployment_name = deployment_name
+        self._loop = loop
+        self._replicas: List[Dict] = []
+        self._in_flight: Dict[str, int] = {}
+        self._slot_freed = asyncio.Event()
+        self.num_queued = 0
+
+    def update_replicas(self, infos: List[Dict]):
+        self._replicas = list(infos)
+        tags = {i["replica_tag"] for i in infos}
+        self._in_flight = {t: self._in_flight.get(t, 0) for t in tags}
+        self._slot_freed.set()  # membership change may free capacity
+
+    async def assign_replica(self, method_name: str, args: tuple,
+                             kwargs: dict,
+                             timeout_s: float = 120.0) -> Any:
+        """Pick a replica (power-of-two-choices among free ones), send the
+        query, and release the slot when it completes.  Bounded: a request
+        that can't be assigned within timeout_s (no replicas — deployment
+        deleted or all crashed) errors instead of hanging forever."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        self.num_queued += 1
+        try:
+            while True:
+                choice = self._pick()
+                if choice is not None:
+                    break
+                remain = deadline - _time.monotonic()
+                if remain <= 0:
+                    raise RuntimeError(
+                        f"no available replica for deployment "
+                        f"{self.deployment_name!r} within {timeout_s}s")
+                self._slot_freed.clear()
+                try:
+                    await asyncio.wait_for(self._slot_freed.wait(),
+                                           timeout=min(remain, 5.0))
+                except asyncio.TimeoutError:
+                    pass  # re-check membership; maybe replicas arrived
+        finally:
+            self.num_queued -= 1
+        tag = choice["replica_tag"]
+        self._in_flight[tag] = self._in_flight.get(tag, 0) + 1
+        try:
+            actor = choice["actor"]
+            ref = actor.handle_request.remote(method_name, args, kwargs)
+            # ref.future() rides the CoreWorker IO loop, so this await is
+            # safe on any loop (the router often runs on its own thread).
+            return await asyncio.wrap_future(ref.future())
+        finally:
+            if tag in self._in_flight:
+                self._in_flight[tag] -= 1
+            self._slot_freed.set()
+
+    def _pick(self) -> Optional[Dict]:
+        free = [r for r in self._replicas
+                if self._in_flight.get(r["replica_tag"], 0)
+                < r["max_concurrent_queries"]]
+        if not free:
+            return None
+        if len(free) == 1:
+            return free[0]
+        # Power of two choices: least-loaded of two random candidates.
+        a, b = random.sample(free, 2)
+        return a if (self._in_flight.get(a["replica_tag"], 0)
+                     <= self._in_flight.get(b["replica_tag"], 0)) else b
+
+    def stats(self) -> Dict:
+        return {"queued": self.num_queued,
+                "in_flight": sum(self._in_flight.values()),
+                "num_replicas": len(self._replicas)}
+
+
+class Router:
+    """One per handle-holding process (proxy, driver, or other actor)."""
+
+    def __init__(self, controller_handle, deployment_name: str,
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        loop = loop or asyncio.get_event_loop()
+        self.deployment_name = deployment_name
+        self.replica_set = ReplicaSet(deployment_name, loop)
+        self._long_poll = LongPollClient(
+            controller_handle,
+            {f"replicas::{deployment_name}":
+                self.replica_set.update_replicas},
+            loop=loop)
+
+    async def assign_request(self, method_name: str, args: tuple,
+                             kwargs: dict):
+        return await self.replica_set.assign_replica(
+            method_name, args, kwargs)
+
+    def stop(self):
+        self._long_poll.stop()

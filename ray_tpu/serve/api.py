@@ -1,0 +1,236 @@
+"""Serve public API.
+
+Reference: python/ray/serve/api.py — @serve.deployment (deployment.py),
+serve.start, serve.run (:428), serve.delete, serve.shutdown,
+serve.get_deployment_handle.  The controller is a detached named actor so
+deployments outlive the driver that created them.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
+                                  ReplicaConfig)
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve._private.controller import (CONTROLLER_NAME,
+                                               ServeController)
+
+logger = logging.getLogger(__name__)
+
+_http_proxy_info: Optional[Dict] = None
+
+
+def start(detached: bool = True, http_options: Optional[Dict] = None,
+          _start_proxy: bool = False):
+    """Start (or connect to) the Serve instance: the controller actor and,
+    optionally, the HTTP proxy."""
+    controller = _get_or_create_controller()
+    if _start_proxy:
+        _ensure_http_proxy(controller, http_options or {})
+    return controller
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    cls = ray_tpu.remote(ServeController)
+    controller = cls.options(
+        name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1,
+        max_concurrency=1000).remote()
+    # Kick the reconciliation loop (runs forever inside the actor).
+    controller.run_control_loop.options(num_returns=0).remote()
+    return controller
+
+
+def _ensure_http_proxy(controller, http_options: Dict) -> Dict:
+    """Start the ingress actor if not yet running; returns {host, port}."""
+    global _http_proxy_info
+    if _http_proxy_info is not None:
+        return _http_proxy_info
+    from ray_tpu.serve._private.http_proxy import HTTPProxyActor
+    name = "SERVE_PROXY"
+    try:
+        proxy = ray_tpu.get_actor(name)
+    except Exception:
+        cls = ray_tpu.remote(HTTPProxyActor)
+        proxy = cls.options(name=name, lifetime="detached", num_cpus=0.1,
+                            max_concurrency=1000).remote(
+            http_options.get("host", "127.0.0.1"),
+            http_options.get("port", 0), CONTROLLER_NAME)
+        proxy.run.options(num_returns=0).remote()
+    _http_proxy_info = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    return _http_proxy_info
+
+
+class Deployment:
+    """The declarative unit: a class/function + target config.  Immutable;
+    .options() returns a copy (reference: serve/deployment.py)."""
+
+    def __init__(self, body: Union[Callable, type], name: str,
+                 config: DeploymentConfig, init_args: tuple = (),
+                 init_kwargs: Optional[Dict] = None,
+                 ray_actor_options: Optional[Dict] = None,
+                 version: Optional[str] = None):
+        self._body = body
+        self.name = name
+        self.config = config
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs or {}
+        self.ray_actor_options = ray_actor_options or {}
+        self.version = version
+
+    def options(self, **kwargs) -> "Deployment":
+        new = Deployment(self._body, kwargs.pop("name", self.name),
+                         DeploymentConfig.from_dict(self.config.to_dict()),
+                         self.init_args, dict(self.init_kwargs),
+                         dict(self.ray_actor_options), self.version)
+        for k in ("num_replicas", "max_concurrent_queries", "user_config",
+                  "graceful_shutdown_timeout_s", "health_check_period_s",
+                  "health_check_timeout_s"):
+            if k in kwargs:
+                setattr(new.config, k, kwargs.pop(k))
+        if "autoscaling_config" in kwargs:
+            ac = kwargs.pop("autoscaling_config")
+            new.config.autoscaling_config = (
+                ac if isinstance(ac, (AutoscalingConfig, type(None)))
+                else AutoscalingConfig(**ac))
+        if "ray_actor_options" in kwargs:
+            new.ray_actor_options = kwargs.pop("ray_actor_options") or {}
+        if "init_args" in kwargs:
+            new.init_args = tuple(kwargs.pop("init_args"))
+        if "init_kwargs" in kwargs:
+            new.init_kwargs = dict(kwargs.pop("init_kwargs"))
+        if "version" in kwargs:
+            new.version = kwargs.pop("version")
+        if kwargs:
+            raise TypeError(f"unknown deployment options: {list(kwargs)}")
+        return new
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Deployment-graph style binding of init args."""
+        return self.options(init_args=args, init_kwargs=kwargs)
+
+    def deploy(self, _blocking: bool = True) -> DeploymentHandle:
+        controller = _get_or_create_controller()
+        version = self.version or uuid.uuid4().hex[:8]
+        rc = ReplicaConfig(
+            deployment_def=cloudpickle.dumps(self._body),
+            init_args=self.init_args, init_kwargs=self.init_kwargs,
+            ray_actor_options=self.ray_actor_options)
+        ray_tpu.get(controller.deploy.remote(
+            self.name, self.config.to_dict(), rc, version), timeout=60)
+        if _blocking:
+            ok = ray_tpu.get(controller.wait_deployments_healthy.remote(
+                [self.name]), timeout=180)
+            if not ok:
+                statuses = ray_tpu.get(
+                    controller.get_deployment_statuses.remote(), timeout=30)
+                raise RuntimeError(
+                    f"deployment {self.name} failed to become healthy: "
+                    f"{statuses}")
+        return DeploymentHandle(self.name, controller)
+
+    def get_handle(self) -> DeploymentHandle:
+        return DeploymentHandle(self.name, _get_or_create_controller())
+
+
+def deployment(_body=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 100,
+               user_config: Any = None,
+               autoscaling_config: Optional[Union[Dict,
+                                                  AutoscalingConfig]] = None,
+               ray_actor_options: Optional[Dict] = None,
+               version: Optional[str] = None,
+               graceful_shutdown_timeout_s: float = 10.0,
+               health_check_period_s: float = 5.0):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+
+    def _wrap(body):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            health_check_period_s=health_check_period_s)
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = (
+                autoscaling_config
+                if isinstance(autoscaling_config, AutoscalingConfig)
+                else AutoscalingConfig(**autoscaling_config))
+        return Deployment(body, name or body.__name__, cfg,
+                          ray_actor_options=ray_actor_options,
+                          version=version)
+
+    if _body is not None:
+        return _wrap(_body)
+    return _wrap
+
+
+def run(target: Deployment, *, host: str = "127.0.0.1", port: int = 0,
+        _start_proxy: bool = True) -> DeploymentHandle:
+    """Deploy and (by default) expose over HTTP; returns a handle
+    (reference: serve.run api.py:428)."""
+    if not isinstance(target, Deployment):
+        raise TypeError("serve.run expects a Deployment "
+                        "(made with @serve.deployment)")
+    controller = start(_start_proxy=_start_proxy,
+                       http_options={"host": host, "port": port})
+    return target.deploy()
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_or_create_controller())
+
+
+def get_proxy_address() -> Optional[Dict]:
+    return _http_proxy_info
+
+
+def status() -> List[Dict]:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.get_deployment_statuses.remote(),
+                       timeout=30)
+
+
+def delete(name: str, _blocking: bool = True):
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=30)
+    if _blocking:
+        import time
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(s["name"] != name for s in status()):
+                return
+            time.sleep(0.1)
+
+
+def shutdown():
+    """Tear the Serve instance down (controller + proxy + replicas)."""
+    global _http_proxy_info
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        _http_proxy_info = None
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    _http_proxy_info = None

@@ -1,0 +1,99 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Reference: python/ray/serve/batching.py — concurrent calls to the wrapped
+async method are buffered; when max_batch_size accumulate or
+batch_wait_timeout_s elapses, the underlying function runs once on the
+list of requests and each caller gets its element of the list result.
+On TPU replicas this is the lever that turns single queries into
+MXU-shaped batched forward passes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = batch_wait_timeout_s
+        self._pending: List[tuple] = []   # (arg, future)
+        self._flusher: Optional[asyncio.TimerHandle] = None
+
+    async def submit(self, instance, arg) -> Any:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.append((arg, fut))
+        if len(self._pending) >= self._max:
+            self._flush(instance)
+        elif self._flusher is None:
+            self._flusher = loop.call_later(
+                self._timeout, self._flush, instance)
+        return await fut
+
+    def _flush(self, instance):
+        if self._flusher is not None:
+            self._flusher.cancel()
+            self._flusher = None
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        args = [a for a, _ in batch]
+        futs = [f for _, f in batch]
+        loop = asyncio.get_event_loop()
+
+        async def _run():
+            try:
+                if instance is not None:
+                    results = await self._fn(instance, args)
+                else:
+                    results = await self._fn(args)
+                if not isinstance(results, (list, tuple)) \
+                        or len(results) != len(args):
+                    raise ValueError(
+                        "@serve.batch function must return a list with "
+                        f"one result per input ({len(args)} expected)")
+                for f, r in zip(futs, results):
+                    if not f.done():
+                        f.set_result(r)
+            except Exception as e:
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+
+        loop.create_task(_run())
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for an async method taking a LIST of requests."""
+
+    def _decorate(fn):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async function")
+        queues: dict = {}  # per-instance (or one for free functions)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:       # bound method: (self, item)
+                instance, item = args
+            elif len(args) == 1:     # free function: (item,)
+                instance, item = None, args[0]
+            else:
+                raise TypeError("@serve.batch methods take one argument")
+            q = queues.get(id(instance))
+            if q is None:
+                q = queues[id(instance)] = _BatchQueue(
+                    fn, max_batch_size, batch_wait_timeout_s)
+            return await q.submit(instance, item)
+
+        wrapper._rt_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return _decorate(_fn)
+    return _decorate

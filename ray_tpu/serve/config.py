@@ -1,0 +1,69 @@
+"""Serve configuration types.
+
+Reference: python/ray/serve/config.py (DeploymentConfig, AutoscalingConfig,
+HTTPOptions) — target state declared per deployment; the controller
+reconciles actual replicas toward it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Reference: serve/config.py AutoscalingConfig + the policy inputs in
+    serve/_private/autoscaling_policy.py."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    metrics_interval_s: float = 1.0
+    smoothing_factor: float = 1.0
+
+
+@dataclass
+class DeploymentConfig:
+    """Target state for one deployment (reference: serve/config.py:71
+    DeploymentConfig protobuf-backed model)."""
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Any = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    graceful_shutdown_timeout_s: float = 10.0
+    health_check_period_s: float = 5.0
+    health_check_timeout_s: float = 30.0
+
+    def to_dict(self) -> Dict:
+        d = dict(self.__dict__)
+        if self.autoscaling_config is not None:
+            d["autoscaling_config"] = dict(
+                self.autoscaling_config.__dict__)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DeploymentConfig":
+        d = dict(d)
+        ac = d.get("autoscaling_config")
+        if isinstance(ac, dict):
+            d["autoscaling_config"] = AutoscalingConfig(**ac)
+        return cls(**d)
+
+
+@dataclass
+class ReplicaConfig:
+    """How to construct one replica: the serialized deployment body +
+    actor options (reference: serve/config.py ReplicaConfig which carries
+    the pickled deployment_def)."""
+    deployment_def: bytes = b""          # cloudpickle of class or function
+    init_args: tuple = ()
+    init_kwargs: Dict = field(default_factory=dict)
+    ray_actor_options: Dict = field(default_factory=dict)
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000

@@ -1,0 +1,94 @@
+"""DeploymentHandle: call a deployment from Python.
+
+Reference: python/ray/serve/handle.py — RayServeHandle (:77): sync and
+async callers share a Router; `handle.remote()` routes through the
+replica set with max_concurrent_queries accounting.  The router lives on
+a background asyncio loop so plain (sync) driver code can hold handles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Optional
+
+_router_loop: Optional[asyncio.AbstractEventLoop] = None
+_router_loop_lock = threading.Lock()
+
+
+def _get_router_loop() -> asyncio.AbstractEventLoop:
+    """Shared background event loop hosting routers + long-poll clients
+    for every handle in this process."""
+    global _router_loop
+    with _router_loop_lock:
+        if _router_loop is None or _router_loop.is_closed():
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(target=loop.run_forever,
+                                 name="serve-router", daemon=True)
+            t.start()
+            _router_loop = loop
+        return _router_loop
+
+
+class ServeResponse:
+    """Future-like result of handle.remote() usable from sync and async
+    code (`resp.result()` or `await resp`)."""
+
+    def __init__(self, fut: concurrent.futures.Future):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return self._fut.result(timeout)
+
+    def __await__(self):
+        return asyncio.wrap_future(self._fut).__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller_handle,
+                 method_name: str = ""):
+        self.deployment_name = deployment_name
+        self._controller = controller_handle
+        self._method_name = method_name
+        self._router = None
+        self._router_lock = threading.Lock()
+
+    def _ensure_router(self):
+        if self._router is None:
+            with self._router_lock:
+                if self._router is None:
+                    from ray_tpu.serve._private.router import Router
+                    loop = _get_router_loop()
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self._make_router(loop), loop)
+                    self._router = fut.result(timeout=30)
+        return self._router
+
+    async def _make_router(self, loop):
+        from ray_tpu.serve._private.router import Router
+        return Router(self._controller, self.deployment_name, loop=loop)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, self._controller,
+                                method_name=name)
+
+    def remote(self, *args, **kwargs) -> ServeResponse:
+        router = self._ensure_router()
+        loop = _get_router_loop()
+        fut = asyncio.run_coroutine_threadsafe(
+            router.assign_request(self._method_name, args, kwargs), loop)
+        return ServeResponse(fut)
+
+    def options(self, method_name: str = "") -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self._controller,
+                                method_name=method_name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self._controller,
+                                   self._method_name))
+
+
+RayServeHandle = DeploymentHandle

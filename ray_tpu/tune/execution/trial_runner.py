@@ -154,7 +154,8 @@ class TrialRunner:
         self.scheduler.on_trial_add(trial)
         return trial
 
-    def _start_trial(self, trial: Trial, restore: bool = False):
+    def _start_trial(self, trial: Trial, restore: bool = False,
+                     defer_ping: bool = False):
         pg = trial.pg_factory.create(name=f"pg_{trial.trial_id}")
         ok = ray_tpu.wait_placement_group_ready(pg, timeout=120)
         if not ok:
@@ -172,7 +173,9 @@ class TrialRunner:
         # begin training at the same wall-clock time, or schedulers that
         # compare trials at a rung (ASHA) can watch one trial sprint to
         # completion while its peer's worker is still cold-starting.
-        ray_tpu.get(trial.actor.ping.remote(), timeout=120)
+        # (_fill_trials defers this to overlap cold-starts across trials.)
+        if not defer_ping:
+            ray_tpu.get(trial.actor.ping.remote(), timeout=120)
         if restore and trial.checkpoint is not None:
             ray_tpu.get(trial.actor.restore.remote(trial.checkpoint),
                         timeout=300)
@@ -235,6 +238,7 @@ class TrialRunner:
         return self.trials
 
     def _fill_trials(self):
+        started: List[Trial] = []
         while not self._exhausted and \
                 sum(t.status == RUNNING for t in self.trials) \
                 < self.max_concurrent:
@@ -243,10 +247,21 @@ class TrialRunner:
                 self._exhausted = True
                 break
             try:
-                self._start_trial(trial)
+                # Create all actors first (spawns overlap), await liveness
+                # below so N cold-starts cost one spawn latency, not N.
+                self._start_trial(trial, defer_ping=True)
+                started.append(trial)
             except Exception as e:
                 trial.error = e
                 trial.status = ERROR
+                if self.failure_config.fail_fast:
+                    raise
+        for trial in started:
+            try:
+                ray_tpu.get(trial.actor.ping.remote(), timeout=120)
+            except Exception as e:
+                self._stop_trial(trial, ERROR)
+                trial.error = e
                 if self.failure_config.fail_fast:
                     raise
 

@@ -58,7 +58,7 @@ def placement_group(bundles, strategy: str = "PACK", name: str = "",
     if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
         raise ValueError(f"invalid placement strategy {strategy}")
     pg_id = PlacementGroupID.from_random()
-    w._run(w.gcs.request("create_placement_group", {
+    w._run(w._gcs_request("create_placement_group", {
         "pg_id": pg_id, "bundles": list(bundles), "strategy": strategy,
         "name": name, "job_id": w.job_id}))
     return PlacementGroup(pg_id, list(bundles))
@@ -66,15 +66,15 @@ def placement_group(bundles, strategy: str = "PACK", name: str = "",
 
 def remove_placement_group(pg: PlacementGroup):
     w = worker_mod.global_worker
-    w._run(w.gcs.request("remove_placement_group", {"pg_id": pg.id}))
+    w._run(w._gcs_request("remove_placement_group", {"pg_id": pg.id}))
 
 
 def get_placement_group_state(pg: PlacementGroup):
     w = worker_mod.global_worker
-    view = w._run(w.gcs.request("get_placement_group", {"pg_id": pg.id}))
+    view = w._run(w._gcs_request("get_placement_group", {"pg_id": pg.id}))
     return view
 
 
 def placement_group_table():
     w = worker_mod.global_worker
-    return w._run(w.gcs.request("list_placement_groups", {}))
+    return w._run(w._gcs_request("list_placement_groups", {}))

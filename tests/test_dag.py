@@ -1,0 +1,47 @@
+"""ray_tpu.dag: lazy bind/execute IR (reference: python/ray/dag tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_function_dag(ray_init):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def combine(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = combine.bind(a.bind(inp), b.bind(inp))
+    ref = dag.execute(10)
+    assert ray_tpu.get(ref, timeout=60) == (10 + 1) + (10 * 2)
+
+
+def test_actor_dag(ray_init):
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    with InputNode() as inp:
+        actor = Adder.bind(100)
+        dag = actor.add.bind(inp)
+    assert ray_tpu.get(dag.execute(7), timeout=60) == 107

@@ -1,0 +1,113 @@
+"""Ops plane: log streaming, metrics, state API, timeline, job submission
+(reference test style: python/ray/tests/test_state_api.py,
+test_metrics_agent.py, dashboard/modules/job/tests)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import state as state_api
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, prometheus_text
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_worker_logs_stream_to_driver(ray_init, capfd):
+    @ray_tpu.remote
+    def shout():
+        print("HELLO_FROM_WORKER_TASK")
+        sys.stdout.flush()
+        return 1
+
+    assert ray_tpu.get(shout.remote(), timeout=60) == 1
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        err = capfd.readouterr().err
+        if "HELLO_FROM_WORKER_TASK" in err:
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError("worker stdout never reached the driver")
+
+
+def test_state_api_lists_cluster_entities(ray_init):
+    @ray_tpu.remote
+    class Sleeper:
+        def ping(self):
+            return "pong"
+
+    a = Sleeper.options(name="state-test-actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    actors = state_api.list_actors()
+    assert any(x["name"] == "state-test-actor" and x["state"] == "ALIVE"
+               for x in actors)
+    # A big object shows up in list_objects.
+    import numpy as np
+    ref = ray_tpu.put(np.zeros((600, 600)))
+    objs = state_api.list_objects()
+    assert any(o["size"] > 1_000_000 for o in objs)
+    summary = state_api.summarize_objects()
+    assert summary["total_bytes"] > 1_000_000
+
+
+def test_metrics_and_prometheus_text(ray_init):
+    c = Counter("test_requests_total", "requests", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("test_queue_depth")
+    g.set(7)
+    h = Histogram("test_latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    from ray_tpu.util.metrics import registry_snapshot
+    text = prometheus_text(registry_snapshot())
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_queue_depth 7.0" in text
+    assert 'test_latency_s_bucket{le="+Inf"} 3' in text
+    assert "test_latency_s_count 3" in text
+
+
+def test_timeline_records_task_events(ray_init):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)], timeout=60)
+    deadline = time.time() + 15
+    events = []
+    while time.time() < deadline:
+        events = ray_tpu.timeline()
+        if any(e["name"] == "traced" for e in events):
+            break
+        time.sleep(0.5)
+    assert any(e["name"] == "traced" and e["ph"] == "X" and e["dur"] > 0
+               for e in events)
+
+
+def test_job_submission_end_to_end(ray_init):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint="python -c \"print('job says hi'); import sys; "
+                   "sys.exit(0)\"")
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "job says hi" in client.get_job_logs(sid)
+    assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+    sid2 = client.submit_job(entrypoint="python -c 'import sys; "
+                                        "sys.exit(3)'")
+    assert client.wait_until_finished(sid2, timeout=120) == JobStatus.FAILED
