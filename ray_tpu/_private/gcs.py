@@ -141,6 +141,7 @@ class GcsServer:
         # Persistence (reference: gcs/store_client/redis_store_client.h:28 —
         # table storage that survives GCS restart; here a pickle snapshot).
         self._persist_path = persist_path
+        self._kv_writes = 0
         if persist_path:
             self._load_snapshot()
 
@@ -229,18 +230,34 @@ class GcsServer:
                     "from %s", len(self.actors), len(self.placement_groups),
                     len(self.kv), self._persist_path)
 
+    def _state_fingerprint(self):
+        """Cheap change detector so the snapshot loop writes only when
+        durable state moved — KV can hold 100MB runtime_env packages, and
+        re-pickling them twice a second would be sustained disk churn."""
+        kv_sizes = (self._kv_writes,) + tuple(sorted(
+            (ns, len(d)) for ns, d in self.kv.items()
+            if ns not in self._EPHEMERAL_KV_NS))
+        actors = tuple(sorted(
+            (a.actor_id.binary(), a.state, a.num_restarts)
+            for a in self.actors.values()))
+        pgs = tuple(sorted((p.pg_id.binary(), p.state)
+                           for p in self.placement_groups.values()))
+        return hash((kv_sizes, actors, pgs, len(self.jobs),
+                     len(self.named_actors)))
+
     async def _snapshot_loop(self):
-        # Unconditional periodic snapshot: the tables are small (KV +
-        # actor/PG records) and a fixed cadence catches internal state
-        # transitions (actor ALIVE, PG CREATED) without instrumenting
-        # every mutation site.
         loop = asyncio.get_running_loop()
+        last_fp = None
         while True:
             await asyncio.sleep(0.5)
             try:
+                fp = self._state_fingerprint()
+                if fp == last_fp:
+                    continue
                 state = self._snapshot_state()  # copy on the loop thread
                 await loop.run_in_executor(None, self._write_snapshot,
                                            state)
+                last_fp = fp
             except Exception as e:
                 logger.warning("GCS snapshot write failed: %s", e)
 
@@ -361,11 +378,16 @@ class GcsServer:
 
     # ------------------------------------------------------------------- kv
     async def rpc_kv_put(self, conn, body):
-        ns = self.kv.setdefault(body.get("ns", ""), {})
+        ns_name = body.get("ns", "")
+        ns = self.kv.setdefault(ns_name, {})
         overwrite = body.get("overwrite", True)
         if not overwrite and body["key"] in ns:
             return {"ok": False, "exists": True}
         ns[body["key"]] = body["value"]
+        if ns_name not in self._EPHEMERAL_KV_NS:
+            # In-place overwrites don't change namespace sizes, so the
+            # snapshot fingerprint needs an explicit write counter.
+            self._kv_writes += 1
         return {"ok": True}
 
     async def rpc_kv_get(self, conn, body):
@@ -373,8 +395,11 @@ class GcsServer:
         return {"value": ns.get(body["key"])}
 
     async def rpc_kv_del(self, conn, body):
-        ns = self.kv.get(body.get("ns", ""), {})
+        ns_name = body.get("ns", "")
+        ns = self.kv.get(ns_name, {})
         existed = ns.pop(body["key"], None) is not None
+        if existed and ns_name not in self._EPHEMERAL_KV_NS:
+            self._kv_writes += 1
         return {"ok": existed}
 
     async def rpc_kv_keys(self, conn, body):

@@ -44,25 +44,41 @@ class LogMonitor:
             off = self._offsets.get(path, 0)
             if size <= off:
                 continue
+            read_limit = 512 * 1024
             try:
                 with open(path, "rb") as f:
                     f.seek(off)
-                    chunk = f.read(512 * 1024)
+                    chunk = f.read(read_limit)
             except OSError:
                 continue
             # Only ship complete lines; carry partials to the next tick.
             cut = chunk.rfind(b"\n")
             if cut < 0:
-                continue
-            self._offsets[path] = off + cut + 1
+                if len(chunk) >= read_limit:
+                    # One line longer than the buffer would wedge this
+                    # file forever: ship it truncated and move on.
+                    self._offsets[path] = off + len(chunk)
+                    cut = len(chunk)
+                else:
+                    continue
             lines = chunk[:cut].decode("utf-8", "replace").splitlines()
             if not lines:
+                self._offsets[path] = off + cut + 1
                 continue
+            # Cap the batch WITHOUT dropping: advance the offset only
+            # past the lines actually published.
+            if len(lines) > MAX_LINES_PER_TICK:
+                lines = lines[:MAX_LINES_PER_TICK]
+                consumed = sum(len(l.encode("utf-8", "replace")) + 1
+                               for l in lines)
+                self._offsets[path] = off + consumed
+            else:
+                self._offsets[path] = off + cut + 1
             worker = os.path.basename(path)[len("worker-"):-len(".log")]
             await self.publish("logs", {
                 "node": self.node_id_hex,
                 "worker": worker,
-                "lines": lines[:MAX_LINES_PER_TICK],
+                "lines": lines,
             })
 
     def stop(self):

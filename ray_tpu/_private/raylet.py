@@ -423,6 +423,15 @@ class Raylet:
             target = self._pick_spread_target(resources)
             if target is not None:
                 return {"spillback": target}
+        elif not self._fits(resources):
+            # Feasible here but busy: shed to a node that can run it NOW,
+            # scored by post-placement critical-resource utilization
+            # (reference: hybrid pack/spread scoring,
+            # raylet/scheduling/policy/hybrid_scheduling_policy.h:48 +
+            # scorer.h — local-first, spill at saturation).
+            target = self._pick_hybrid_target(resources)
+            if target is not None:
+                return {"spillback": target}
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append({"resources": resources, "pg_key": pg_key,
                                     "future": fut,
@@ -465,6 +474,30 @@ class Raylet:
             if all(total.get(k, 0) >= v for k, v in resources.items()):
                 return tuple(view["addr"])
         return None
+
+    def _pick_hybrid_target(self, resources):
+        """Least-utilized node with the request's resources AVAILABLE
+        right now; None keeps the task queued locally."""
+        best = None
+        best_score = None
+        for view in self.cluster_nodes.values():
+            if view["node_id"] == self.node_id:
+                continue
+            avail = view.get("available", {})
+            total = view.get("resources", {})
+            if not all(avail.get(k, 0) >= v for k, v in resources.items()):
+                continue
+            # Critical-resource utilization after placing the request.
+            score = 0.0
+            for k, cap in total.items():
+                if cap <= 0:
+                    continue
+                used = cap - avail.get(k, 0) + resources.get(k, 0)
+                score = max(score, used / cap)
+            score += 0.01 * view.get("load", 0)  # backlog tiebreak
+            if best_score is None or score < best_score:
+                best, best_score = tuple(view["addr"]), score
+        return best
 
     def _pick_spread_target(self, resources):
         """SPREAD strategy: redirect to the least-loaded feasible node

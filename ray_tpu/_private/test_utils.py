@@ -1,0 +1,74 @@
+"""Test utilities: fault injection for chaos testing.
+
+Reference: python/ray/_private/test_utils.py:1098 (NodeKillerActor) and
+release/nightly_tests/setup_chaos.py — kill nodes on a cadence while a
+real workload runs, asserting the job still completes.  Here the killer
+drives the in-process Cluster fixture directly.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class NodeKiller:
+    """Kills random non-head cluster nodes every interval_s until
+    stopped.  Runs in a thread beside the driver (the in-process Cluster
+    owns all raylets, so no remote actor is needed)."""
+
+    def __init__(self, cluster, interval_s: float = 3.0,
+                 max_kills: int = 1000,
+                 node_filter: Optional[Callable] = None,
+                 replace: bool = False, seed: int = 0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.node_filter = node_filter
+        self.replace = replace
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _candidates(self):
+        out = []
+        for node in self.cluster.nodes:
+            if node is self.cluster.head:
+                continue
+            if self.node_filter is not None and not self.node_filter(node):
+                continue
+            out.append(node)
+        return out
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            if len(self.killed) >= self.max_kills:
+                return
+            targets = self._candidates()
+            if not targets:
+                continue
+            victim = self._rng.choice(targets)
+            spec = {"num_cpus": int(victim.raylet.total_resources.get(
+                        "CPU", 1)),
+                    "resources": {
+                        k: v for k, v in
+                        victim.raylet.total_resources.items()
+                        if k != "CPU"}}
+            self.killed.append(victim.raylet.node_id.hex())
+            self.cluster.remove_node(victim)
+            if self.replace:
+                self.cluster.add_node(**spec)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="node-killer")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -732,6 +732,9 @@ class CoreWorker:
             "retry_exceptions": opts.get("retry_exceptions", False),
             "name": opts.get("name", ""),
         }
+        if opts.get("runtime_env"):
+            spec["runtime_env"] = self._pack_runtime_env(
+                opts["runtime_env"])
         pg = opts.get("placement_group")
         if pg is not None:
             spec["pg_id"] = pg.id
@@ -749,6 +752,49 @@ class CoreWorker:
         self._pin_args(task_id, args, kwargs)
         self._call(self._submit(spec))
         return refs
+
+    def _pack_runtime_env(self, runtime_env):
+        from ray_tpu import runtime_env as renv
+
+        def _kv_put(ns, key, value):
+            self._run(self._gcs_request("kv_put", {
+                "ns": ns, "key": key, "value": value}))
+
+        return renv.pack(runtime_env, _kv_put)
+
+    def _apply_runtime_env(self, runtime_env):
+        """Executor side: materialize packages + env vars (reference:
+        runtime-env creation before task execution).  Returns a restore
+        callable: pooled workers are REUSED across tasks, so env vars /
+        cwd / sys.path must not leak into the next task (the reference
+        instead dedicates workers per runtime env)."""
+        if not runtime_env:
+            return None
+        import sys
+        from ray_tpu import runtime_env as renv
+
+        def _kv_get(ns, key):
+            return self._run(self._gcs_request(
+                "kv_get", {"ns": ns, "key": key}))["value"]
+
+        cache = os.path.join(
+            os.environ.get("RT_SESSION_DIR", "/tmp/ray_tpu"),
+            "runtime_envs")
+        saved_env = dict(os.environ)
+        saved_cwd = os.getcwd()
+        saved_path = list(sys.path)
+        renv.apply(runtime_env, _kv_get, cache)
+
+        def _restore():
+            os.environ.clear()
+            os.environ.update(saved_env)
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+            sys.path[:] = saved_path
+
+        return _restore
 
     def _pin_args(self, task_id, args, kwargs):
         """Keep ObjectRef args alive until the task completes.  Keyed by
@@ -1069,7 +1115,9 @@ class CoreWorker:
         ctx.task_id = spec["task_id"]
         ctx.lease_id = lease_id
         t0 = time.time()
+        restore_env = None
         try:
+            restore_env = self._apply_runtime_env(spec.get("runtime_env"))
             fn = self._load_function(spec["fn_id"])
             args, kwargs = self._unpack_args(spec["args"])
             result = fn(*args, **kwargs)
@@ -1077,6 +1125,8 @@ class CoreWorker:
         except Exception as e:
             return {"error": _error_blob(e, traceback.format_exc())}
         finally:
+            if restore_env is not None:
+                restore_env()
             self._record_profile_event(
                 "task", spec.get("name") or getattr(
                     self._fn_cache.get(spec["fn_id"]), "__name__", "task"),
@@ -1155,6 +1205,7 @@ class CoreWorker:
 
     def _create_actor_sync(self, spec):
         try:
+            self._apply_runtime_env(spec.get("runtime_env"))
             cls = self._load_function(spec["class_id"])
             args, kwargs = self._unpack_args(spec["init_args"])
             import inspect
@@ -1304,42 +1355,49 @@ class CoreWorker:
             return await conn.request_send("push_actor_task", body)
 
     async def _submit_actor_task(self, actor_id, actor_addr, body, retries):
+        """Send with restart-aware retries: each failure re-resolves the
+        actor's address from the GCS and resubmits to the new incarnation,
+        up to max_task_retries times (-1 = unbounded while the actor keeps
+        restarting) — reference: direct_actor_task_submitter.h:67 resend
+        of the unacked window across restarts."""
         view = None
-        try:
-            fut = await self._actor_send(actor_id, actor_addr, body)
-            reply = await fut
-            self._record_results({"task_id": body["task_id"],
-                                  "return_ids": body["return_ids"]}, reply)
-            return
-        except Exception as e:
-            # Actor may be restarting; re-resolve its address from the GCS
-            # and, with retries enabled, resubmit to the new incarnation.
+        first_error = None
+        attempt = 0
+        addr = actor_addr
+        while True:
+            try:
+                fut = await self._actor_send(actor_id, addr, body)
+                reply = await fut
+                self._record_results({"task_id": body["task_id"],
+                                      "return_ids": body["return_ids"]},
+                                     reply)
+                return
+            except Exception as e:
+                if first_error is None:
+                    first_error = e
+                if retries != -1 and attempt >= max(retries, 0):
+                    break
+                attempt += 1
+                # Actor may be restarting; wait for the next incarnation.
+                view = await self._wait_actor_alive(actor_id)
+                if (view is None or view.get("state") != "ALIVE"
+                        or view.get("addr") is None):
+                    break
+                addr = tuple(view["addr"])
+        if view is None:
             view = await self._wait_actor_alive(actor_id)
-            if (retries != 0 and view is not None
-                    and view.get("state") == "ALIVE"
-                    and view.get("addr") is not None):
-                try:
-                    fut = await self._actor_send(actor_id,
-                                                 tuple(view["addr"]), body)
-                    reply = await fut
-                    self._record_results(
-                        {"task_id": body["task_id"],
-                         "return_ids": body["return_ids"]}, reply)
-                    return
-                except Exception:
-                    pass
-            cause = (_death_cause_from_view(view)
-                     if isinstance(e, protocol.ConnectionLost) else None) \
-                or str(e)
-            err = rexc.ActorDiedError(actor_id, cause)
-            blob = _error_blob(err)
-            self._unpin_args(body["task_id"])
-            for oid in body["return_ids"]:
-                entry = self.owned.get(oid)
-                if entry is not None:
-                    entry.state = ERRORED
-                    entry.blob = blob
-                    entry.event.set()
+        cause = (_death_cause_from_view(view)
+                 if isinstance(first_error, protocol.ConnectionLost)
+                 else None) or str(first_error)
+        err = rexc.ActorDiedError(actor_id, cause)
+        blob = _error_blob(err)
+        self._unpin_args(body["task_id"])
+        for oid in body["return_ids"]:
+            entry = self.owned.get(oid)
+            if entry is not None:
+                entry.state = ERRORED
+                entry.blob = blob
+                entry.event.set()
 
     async def _wait_actor_alive(self, actor_id):
         try:
@@ -1390,6 +1448,9 @@ class CoreWorker:
             "scheduling_strategy": _strategy_dict(
                 opts.get("scheduling_strategy")),
         }
+        if opts.get("runtime_env"):
+            spec["runtime_env"] = self._pack_runtime_env(
+                opts["runtime_env"])
         pg = opts.get("placement_group")
         if pg is not None:
             spec["placement_group_id"] = pg.id

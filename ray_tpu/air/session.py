@@ -30,6 +30,7 @@ class _Session:
         self.mesh = mesh
         self.trial_dir = trial_dir
         self.loaded_checkpoint = checkpoint
+        self.dataset_shards: dict = {}
         self.result_queue: "queue.Queue" = queue.Queue()
         self.continue_event = threading.Event()
         self.stop_requested = False
@@ -98,6 +99,18 @@ def get_trial_name() -> str:
 
 def get_trial_id() -> str:
     return _require().trial_id
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's shard of a Dataset passed to the trainer via
+    `datasets=` (reference: air/session.py get_dataset_shard — the
+    last-mile Data -> Train ingest)."""
+    shard = _require().dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(available: {sorted(_require().dataset_shards)})")
+    return shard
 
 
 def get_trial_dir() -> str:

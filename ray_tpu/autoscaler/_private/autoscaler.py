@@ -143,33 +143,43 @@ class StandardAutoscaler:
         return launched
 
     def _scale_down(self, nodes) -> List[str]:
-        """Terminate provider nodes idle (full resources available, no
-        load) past idle_timeout_s."""
+        """Terminate provider node GROUPS that are wholly idle past
+        idle_timeout_s.  Per-group, not per-host: terminating one host of
+        a TPU slice tears down the whole slice, so a group with ANY busy
+        host must be left alone."""
         now = time.monotonic()
         by_raylet_id = {}
         for n in nodes:
             by_raylet_id[n["node_id"].hex()] = n
-        terminated = []
-        for pn in self.provider.non_terminated_nodes():
+
+        def _host_idle(pn) -> bool:
             view = by_raylet_id.get(pn.get("raylet_node_id", ""))
-            pid = pn["provider_id"]
             if view is None or not view.get("alive"):
-                self._idle_since.pop(pid, None)
-                continue
+                return False
             total = view.get("resources", {})
             avail = view.get("available", {})
-            idle = (view.get("load", 0) == 0
+            return (view.get("load", 0) == 0
                     and all(avail.get(k, 0) >= v
                             for k, v in total.items()))
-            if not idle:
-                self._idle_since.pop(pid, None)
+
+        groups: Dict[str, List[Dict]] = {}
+        for pn in self.provider.non_terminated_nodes():
+            groups.setdefault(pn.get("group_id", pn["provider_id"]),
+                              []).append(pn)
+        terminated = []
+        for gid, members in groups.items():
+            if not all(_host_idle(pn) for pn in members):
+                self._idle_since.pop(gid, None)
                 continue
-            first = self._idle_since.setdefault(pid, now)
+            first = self._idle_since.setdefault(gid, now)
             if now - first >= self.idle_timeout_s:
-                logger.info("autoscaler: terminating idle node %s", pid)
+                pid = members[0]["provider_id"]
+                logger.info("autoscaler: terminating idle group %s "
+                            "(%d host(s))", gid, len(members))
+                # Providers tear down the whole group atomically.
                 self.provider.terminate_node(pid)
-                self._idle_since.pop(pid, None)
-                terminated.append(pid)
+                self._idle_since.pop(gid, None)
+                terminated.append(gid)
         return terminated
 
 

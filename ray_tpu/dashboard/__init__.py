@@ -1,0 +1,5 @@
+"""Dashboard: HTTP observability head (reference: dashboard/)."""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard  # noqa: F401
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,136 @@
+"""Dashboard head: HTTP observability endpoint for the cluster.
+
+Reference: dashboard/head.py + modules (node/actor/state/reporter) and
+the Prometheus exposition flow (_private/metrics_agent.py -> scrape).
+Scoped: one aiohttp actor serving JSON state (the reference's REST
+surface) + /metrics in Prometheus text, aggregated from the telemetry
+snapshots every process pushes to the GCS KV.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pickle
+from typing import Dict, Optional
+
+import ray_tpu
+
+DASHBOARD_NAME = "RT_DASHBOARD"
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._ready = asyncio.Event()
+
+    async def run(self):
+        from aiohttp import web
+
+        routes = web.RouteTableDef()
+
+        loop = asyncio.get_running_loop()
+
+        def _json(data):
+            return web.json_response(text=json.dumps(data, default=str))
+
+        async def _call(fn, *args, **kwargs):
+            # State APIs are sync (they block on the CoreWorker's IO
+            # loop, which is THIS loop) — always run them off-loop.
+            import functools
+            return await loop.run_in_executor(
+                None, functools.partial(fn, *args, **kwargs))
+
+        @routes.get("/")
+        async def index(request):
+            from ray_tpu.experimental import state
+            return _json({
+                "cluster": await _call(ray_tpu.cluster_resources),
+                "available": await _call(ray_tpu.available_resources),
+                "nodes": await _call(state.list_nodes),
+            })
+
+        @routes.get("/api/nodes")
+        async def nodes(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.list_nodes))
+
+        @routes.get("/api/actors")
+        async def actors(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.list_actors, detail=True))
+
+        @routes.get("/api/tasks")
+        async def tasks(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.list_tasks))
+
+        @routes.get("/api/objects")
+        async def objects(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.summarize_objects))
+
+        @routes.get("/api/placement_groups")
+        async def pgs(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.list_placement_groups))
+
+        @routes.get("/api/jobs")
+        async def jobs(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.list_jobs))
+
+        @routes.get("/api/timeline")
+        async def timeline(request):
+            return _json(await _call(ray_tpu.timeline))
+
+        @routes.get("/metrics")
+        async def metrics(request):
+            from ray_tpu.util.metrics import (prometheus_text,
+                                              registry_snapshot)
+            w = ray_tpu._private.worker.global_worker
+            keys = (await w._gcs_request(
+                "kv_keys", {"ns": "telemetry", "prefix": b""}))["keys"]
+            snaps = list(registry_snapshot())
+            for key in keys:
+                blob = (await w._gcs_request(
+                    "kv_get", {"ns": "telemetry", "key": key}))["value"]
+                if blob is None:
+                    continue
+                try:
+                    snaps.extend(pickle.loads(blob).get("snapshots", []))
+                except Exception:
+                    continue
+            return web.Response(text=prometheus_text(snaps),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.add_routes(routes)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        for sock in site._server.sockets:  # noqa: SLF001
+            self.port = sock.getsockname()[1]
+            break
+        self._ready.set()
+        return {"host": self.host, "port": self.port}
+
+    async def ready(self) -> Dict:
+        await self._ready.wait()
+        return {"host": self.host, "port": self.port}
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dict:
+    """Start (or connect to) the dashboard head actor; returns its
+    address."""
+    try:
+        head = ray_tpu.get_actor(DASHBOARD_NAME)
+    except Exception:
+        cls = ray_tpu.remote(DashboardHead)
+        head = cls.options(name=DASHBOARD_NAME, lifetime="detached",
+                           num_cpus=0.1, max_concurrency=100).remote(
+            host, port)
+        head.run.options(num_returns=0).remote()
+    return ray_tpu.get(head.ready.remote(), timeout=60)

@@ -16,3 +16,6 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_binary_files, read_csv, read_json, read_numpy, read_parquet,
     read_text,
 )
+from ray_tpu.data.datasource import (  # noqa: F401
+    Datasource, RangeDatasource, ReadTask, read_datasource,
+)

@@ -101,6 +101,9 @@ class BlockAccessor:
         b = self._b
         if isinstance(b, pd.DataFrame):
             return b
+        if isinstance(b, np.ndarray):
+            return pd.DataFrame(b) if b.ndim > 1 \
+                else pd.DataFrame({"value": b})
         try:
             import pyarrow as pa
             if isinstance(b, pa.Table):

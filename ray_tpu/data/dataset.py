@@ -59,22 +59,32 @@ def _apply_stage_task(fn, block, fn_args, fn_kwargs):
 
 
 class Dataset:
-    def __init__(self, block_refs: List, stages: Optional[List] = None):
+    def __init__(self, block_refs: List, stages: Optional[List] = None,
+                 stats: Optional[List] = None):
         self._block_refs = list(block_refs)
         self._stages = list(stages or [])
+        # Per-stage execution records (reference: data/_internal/stats.py
+        # DatasetStats): [{"stage", "blocks", "wall_s"}].
+        self._stats = list(stats or [])
 
     # ---------------------------------------------------------------- plan
     def _with_stage(self, fn: Callable, compute=None, fn_args=(),
                     fn_kwargs=None) -> "Dataset":
         return Dataset(self._block_refs,
                        self._stages + [(fn, compute, fn_args,
-                                        fn_kwargs or {})])
+                                        fn_kwargs or {})],
+                       stats=self._stats)
 
     def _execute(self) -> List:
         """Materialize all stages -> block refs (fused: one task per block
         runs the whole stage chain — the reference's stage fusion)."""
         if not self._stages:
             return self._block_refs
+        import time as _time
+        t0 = _time.perf_counter()
+        stage_names = "+".join(
+            getattr(s[0], "__name__", "stage").lstrip("_")
+            for s in self._stages)
         stages = self._stages
 
         def _fused(block):
@@ -102,7 +112,21 @@ class Dataset:
                       for b in self._block_refs]
         self._block_refs = blocks
         self._stages = []
+        self._stats.append({"stage": stage_names,
+                            "blocks": len(blocks),
+                            "wall_s": _time.perf_counter() - t0})
         return self._block_refs
+
+    def stats(self) -> str:
+        """Human-readable per-stage execution summary (reference:
+        Dataset.stats / _internal/stats.py)."""
+        if not self._stats:
+            return "(no stages executed yet)"
+        lines = []
+        for s in self._stats:
+            lines.append(f"Stage {s['stage']}: {s['blocks']} blocks "
+                         f"submitted in {s['wall_s']:.3f}s")
+        return "\n".join(lines)
 
     def materialize(self) -> "Dataset":
         self._execute()

@@ -1,0 +1,63 @@
+"""Datasource: the pluggable boundary for custom readers/writers.
+
+Reference: python/ray/data/datasource/datasource.py — Datasource with
+prepare_read -> ReadTasks (each a no-arg callable producing blocks) and
+do_write; read_datasource runs the read tasks as cluster tasks, one block
+per ReadTask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ReadTask:
+    """A serializable unit of reading: calling it yields one block."""
+
+    def __init__(self, read_fn: Callable[[], Any],
+                 metadata: Optional[dict] = None):
+        self._read_fn = read_fn
+        self.metadata = metadata or {}
+
+    def __call__(self):
+        return self._read_fn()
+
+
+class Datasource:
+    def prepare_read(self, parallelism: int, **read_args) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def do_write(self, blocks: List, **write_args) -> None:
+        raise NotImplementedError
+
+
+class RangeDatasource(Datasource):
+    """Example in-tree datasource (reference: datasource.py
+    RangeDatasource)."""
+
+    def prepare_read(self, parallelism: int, n: int = 0,
+                     **read_args) -> List[ReadTask]:
+        per = max(1, (n + parallelism - 1) // parallelism)
+        tasks = []
+        for start in range(0, n, per):
+            end = min(start + per, n)
+            tasks.append(ReadTask(
+                lambda s=start, e=end: list(range(s, e)),
+                {"num_rows": end - start}))
+        return tasks
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = 8,
+                    **read_args):
+    """Run the datasource's read tasks as cluster tasks -> Dataset
+    (reference: read_api.py read_datasource)."""
+    from ray_tpu.data.dataset import Dataset
+    tasks = datasource.prepare_read(parallelism, **read_args)
+
+    @ray_tpu.remote
+    def _run_read(task: ReadTask):
+        return task()
+
+    return Dataset([_run_read.remote(t) for t in tasks])

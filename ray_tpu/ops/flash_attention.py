@@ -1,0 +1,177 @@
+"""Flash attention: a Pallas TPU kernel for causal attention.
+
+Re-design target: the O(S^2)-memory einsum attention is fine at seq 1024
+but dead at 8k+ (VERDICT round 1).  This kernel streams KV blocks through
+VMEM with online softmax, so memory is O(S * block) and the MXU sees
+(block_q x head_dim) @ (head_dim x block_k) matmuls.  No reference
+counterpart (the reference has no in-tree attention); algorithm follows
+the public FlashAttention recurrence (m/l running max/sum).
+
+Forward is the Pallas kernel; backward is a custom_vjp that recomputes
+probabilities blockwise in plain XLA (same O(S^2) FLOPs as flash
+backward, O(S*block) memory) — recompute-over-store is usually the right
+trade on TPU where HBM bandwidth, not FLOPs, is the bottleneck.
+
+Layout: [batch, heads, seq, head_dim]; head_dim must be a multiple of
+128 (lane tiling), block sizes multiples of the sublane tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                seq_len):
+    """One (batch*head, q_block) program: stream KV blocks with the
+    online-softmax recurrence."""
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    block_q = q.shape[0]
+    i = pl.program_id(1)
+    q_start = i * block_q
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                    # [bq, bk]
+        # Causal mask: only the diagonal block is partially visible
+        # (the loop bound excludes fully-future blocks).
+        q_ids = q_start + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+        k_ids = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + p @ v
+        return m_new, l_new, acc_new
+
+    # KV blocks 0..floor(last_q_row / block_k) inclusive.
+    n_kv = (q_start + block_q - 1) // block_k + 1
+    m, l, acc = lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # lse is [bh, s, 1]: TPU lowering wants the last two block dims
+    # (8,128)-tiled or full, which a [1, block_q] 2D block is not.
+    lse_ref[0] = (m + jnp.log(l))[:, None]
+
+
+def _flash_fwd(q, k, v, *, scale, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    grid = (b * h, s // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+                               seq_len=s)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d), lse.reshape(b, h, s)
+
+
+def _blockwise_bwd(q, k, v, out, lse, g, *, scale, block_q):
+    """Flash backward as blockwise XLA: recompute P per q-block from the
+    saved logsumexp, accumulate dq/dk/dv with a scan over q blocks."""
+    b, h, s, d = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    # delta_i = sum_j g_ij * out_ij (rowwise), per flash backward.
+    delta = (gf * of).sum(-1)                         # [b,h,s]
+
+    n_blocks = s // block_q
+    k_ids = jnp.arange(s)
+
+    def body(carry, idx):
+        dk, dv = carry
+        sl = idx * block_q
+        qb = lax.dynamic_slice_in_dim(qf, sl, block_q, axis=2)
+        gb = lax.dynamic_slice_in_dim(gf, sl, block_q, axis=2)
+        lseb = lax.dynamic_slice_in_dim(lse, sl, block_q, axis=2)
+        deltab = lax.dynamic_slice_in_dim(delta, sl, block_q, axis=2)
+        # s_ij = scale * q_i . k_j ; ds/dq = scale*k, ds/dk = scale*q.
+        sbl = jnp.einsum("bhqd,bhkd->bhqk", qb, kf) * scale
+        q_ids = sl + jnp.arange(block_q)
+        mask = q_ids[:, None] >= k_ids[None, :]
+        pb = jnp.where(mask, jnp.exp(sbl - lseb[..., None]), 0.0)
+        dpb = jnp.einsum("bhqd,bhkd->bhqk", gb, vf)
+        dsb = pb * (dpb - deltab[..., None])
+        dqb = jnp.einsum("bhqk,bhkd->bhqd", dsb, kf) * scale
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", dsb, qb) * scale
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", pb, gb)
+        return (dk, dv), dqb
+
+    (dk, dv), dq_blocks = lax.scan(
+        body, (jnp.zeros_like(kf), jnp.zeros_like(vf)),
+        jnp.arange(n_blocks))
+    # dq_blocks: [n_blocks, b, h, block_q, d] -> [b, h, s, d]
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(b, h, s, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, scale=None, block_q=DEFAULT_BLOCK_Q,
+                    block_k=DEFAULT_BLOCK_K, interpret=False):
+    """Causal flash attention. q,k,v: [batch, heads, seq, head_dim]."""
+    out, _ = _flash_fwd(q, k, v, scale=scale or q.shape[-1] ** -0.5,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret)
+    return out
+
+
+def _vjp_fwd(q, k, v, scale, block_q, block_k, interpret):
+    scale = scale or q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(q, k, v, scale=scale, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    scale = scale or q.shape[-1] ** -0.5
+    return _blockwise_bwd(q, k, v, out, lse, g, scale=scale,
+                          block_q=block_q)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def supports(seq_len: int, head_dim: int, block_q: int = DEFAULT_BLOCK_Q,
+             block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """Shape gate: lane tiling wants head_dim % 128 == 0 and the sequence
+    divisible by both blocks."""
+    return (head_dim % 128 == 0 and seq_len % block_q == 0
+            and seq_len % block_k == 0 and seq_len >= block_q)

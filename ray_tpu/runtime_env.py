@@ -1,0 +1,122 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Reference: python/ray/runtime_env/runtime_env.py (RuntimeEnv) +
+_private/runtime_env/{working_dir,py_modules}.py — working_dir/py_modules
+are content-addressed packages uploaded once (URI-cached, packaging.py)
+and materialized on workers; env_vars apply to the executing worker.
+Scoped: conda/pip/container are out (the fleet runs one prebuilt image —
+flagged unsupported rather than silently ignored).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Dict, List, Optional
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+class RuntimeEnv(dict):
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None, **extra):
+        unsupported = set(extra) - _SUPPORTED
+        if unsupported:
+            raise ValueError(
+                f"unsupported runtime_env fields {sorted(unsupported)} "
+                f"(supported: {sorted(_SUPPORTED)})")
+        super().__init__()
+        if env_vars:
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(path):
+            for name in files:
+                if name.endswith(".pyc") or "__pycache__" in root:
+                    continue
+                full = os.path.join(root, name)
+                z.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES})")
+    return data
+
+
+def pack(runtime_env: Optional[dict], gcs_kv_put) -> Optional[dict]:
+    """Driver side: upload directory packages to the GCS KV under their
+    content hash (reference: packaging.py upload_package_if_needed);
+    returns the wire form with gcs:// URIs."""
+    if not runtime_env:
+        return None
+    out = dict(runtime_env)
+    for field in ("working_dir", "py_modules"):
+        val = out.get(field)
+        if val is None:
+            continue
+        paths = [val] if isinstance(val, str) else list(val)
+        uris = []
+        for p in paths:
+            if p.startswith("gcs://"):
+                uris.append(p)
+                continue
+            data = _zip_dir(p)
+            digest = hashlib.sha1(data).hexdigest()[:16]
+            key = f"pkg_{digest}.zip".encode()
+            gcs_kv_put("runtime_env", key, data)
+            uris.append(f"gcs://{key.decode()}")
+        out[field] = uris[0] if field == "working_dir" else uris
+    return out
+
+
+# Worker-side package cache: uri -> extracted dir.
+_materialized: Dict[str, str] = {}
+
+
+def apply(runtime_env: Optional[dict], gcs_kv_get, cache_dir: str):
+    """Worker side: materialize packages + set env vars before executing
+    (reference: the runtime-env agent's create flow, minus process
+    isolation — packages are cached per URI like uri_cache.py)."""
+    if not runtime_env:
+        return
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = str(v)
+
+    def _materialize(uri: str) -> str:
+        cached = _materialized.get(uri)
+        if cached is not None:
+            return cached
+        key = uri[len("gcs://"):].encode()
+        data = gcs_kv_get("runtime_env", key)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {uri} not found")
+        dest = os.path.join(cache_dir, uri[len("gcs://"):-len(".zip")])
+        os.makedirs(dest, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            z.extractall(dest)
+        _materialized[uri] = dest
+        return dest
+
+    wd = runtime_env.get("working_dir")
+    if wd:
+        dest = _materialize(wd)
+        os.chdir(dest)
+        if dest not in sys.path:
+            sys.path.insert(0, dest)
+    for uri in runtime_env.get("py_modules") or []:
+        dest = _materialize(uri)
+        if dest not in sys.path:
+            sys.path.insert(0, dest)

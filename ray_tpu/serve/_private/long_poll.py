@@ -41,6 +41,17 @@ class LongPollHost:
         is newer than what the caller has; block (bounded) if none are."""
         self._loop = asyncio.get_running_loop()
         while True:
+            # Register events BEFORE the snapshot check: a notify from an
+            # executor thread between check and registration would
+            # otherwise be lost, stalling this listener for the full
+            # timeout while it holds stale routing state.
+            waiters = []
+            events = []
+            for k in keys_to_snapshot_ids:
+                ev = self._events.get(k)
+                if ev is None:
+                    ev = self._events[k] = asyncio.Event()
+                events.append(ev)
             updated = {
                 k: (self._snapshot_ids[k], self._objects[k])
                 for k, sid in keys_to_snapshot_ids.items()
@@ -48,12 +59,7 @@ class LongPollHost:
             }
             if updated:
                 return updated
-            waiters = []
-            for k in keys_to_snapshot_ids:
-                ev = self._events.get(k)
-                if ev is None:
-                    ev = self._events[k] = asyncio.Event()
-                waiters.append(asyncio.ensure_future(ev.wait()))
+            waiters = [asyncio.ensure_future(ev.wait()) for ev in events]
             done, pending = await asyncio.wait(
                 waiters, timeout=LISTEN_TIMEOUT_S,
                 return_when=asyncio.FIRST_COMPLETED)

@@ -51,6 +51,15 @@ class _TrainWorker:
             world_rank=self.world_rank, world_size=self.world_size,
             local_rank=self.local_rank, trial_name=trial_name,
             trial_id=trial_id, mesh=mesh, checkpoint=checkpoint)
+        datasets = (config or {}).pop("__datasets__", None)
+        if datasets:
+            # Deterministic whole-block split: every rank computes the
+            # same split and keeps its own shard (reference:
+            # data_parallel_trainer dataset sharding to workers).
+            for name, ds in datasets.items():
+                shards = ds.split(self.world_size)
+                self._session.dataset_shards[name] = \
+                    shards[self.world_rank]
         self._error = None
 
         def _run():

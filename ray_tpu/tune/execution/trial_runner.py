@@ -139,6 +139,55 @@ class TrialRunner:
     def _normalize_stop(stop):
         return dict(stop) if isinstance(stop, dict) else (stop or {})
 
+    # ------------------------------------------- experiment-level resume
+    def _save_experiment_state(self):
+        """Persist trial metadata so a crashed/interrupted experiment can
+        resume (reference: tune.run(resume=...) replaying trial state
+        from the experiment dir)."""
+        import pickle
+        state = [{"trial_id": t.trial_id, "name": t.name,
+                  "config": t.config, "status": t.status,
+                  "last_result": t.last_result,
+                  "checkpoint": t.checkpoint,
+                  "trial_dir": t.trial_dir} for t in self.trials]
+        path = os.path.join(self.experiment_dir, "experiment_state.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, path)
+
+    def restore_experiment_state(self) -> bool:
+        """Reload saved trials: TERMINATED ones keep their results;
+        unfinished ones are re-seeded PENDING (restored from their last
+        driver-held checkpoint when present).  Returns True if state was
+        found."""
+        import pickle
+        path = os.path.join(self.experiment_dir, "experiment_state.pkl")
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            saved = pickle.load(f)
+        for s in saved:
+            trial = Trial(self.trainable_name, s["config"],
+                          self.pg_factory or resource_dict_to_pg_factory(
+                              None),
+                          self.experiment_dir, stopping=self._stopping)
+            trial.trial_id = s["trial_id"]
+            trial.name = s["name"]
+            trial.trial_dir = s["trial_dir"]
+            trial.last_result = s["last_result"]
+            trial.checkpoint = s["checkpoint"]
+            if s["status"] == TERMINATED:
+                trial.status = TERMINATED
+            else:
+                trial.status = PENDING
+            self.trials.append(trial)
+            self.scheduler.on_trial_add(trial)
+        # The search space was consumed by the original run; restored
+        # experiments replay the saved trial set only.
+        self._exhausted = True
+        return True
+
     # ---------------------------------------------------------------- setup
     def _make_trial(self) -> Optional[Trial]:
         cfg = self.search_alg.suggest(uuid.uuid4().hex[:8])
@@ -213,6 +262,7 @@ class TrialRunner:
     def run(self, result_callback: Optional[Callable] = None) -> List[Trial]:
         """Drive all trials to completion; returns the trial list."""
         while True:
+            self._start_restored_trials()
             self._fill_trials()
             running = [t for t in self.trials if t.status == RUNNING]
             if not running and self._exhausted:
@@ -236,6 +286,21 @@ class TrialRunner:
                 self._handle_result(trial, result, result_callback)
             self._apply_exploits()
         return self.trials
+
+    def _start_restored_trials(self):
+        """PENDING trials seeded by restore_experiment_state (they never
+        go through _make_trial)."""
+        pending = [t for t in self.trials if t.status == PENDING]
+        for trial in pending:
+            if sum(t.status == RUNNING for t in self.trials) \
+                    >= self.max_concurrent:
+                break
+            try:
+                self._start_trial(trial, restore=trial.checkpoint
+                                  is not None)
+            except Exception as e:
+                trial.error = e
+                trial.status = ERROR
 
     def _fill_trials(self):
         started: List[Trial] = []
@@ -304,6 +369,10 @@ class TrialRunner:
             self.search_alg.on_trial_complete(trial.trial_id, result)
             self.scheduler.on_trial_complete(trial, result)
             self._stop_trial(trial, TERMINATED)
+        try:
+            self._save_experiment_state()
+        except Exception:
+            pass
 
     def _handle_failure(self, trial: Trial, err: Exception):
         trial.num_failures += 1

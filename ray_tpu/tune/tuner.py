@@ -84,6 +84,8 @@ class Tuner:
             raise ValueError(f"cannot tune {trainable!r}")
         self._pg_factory = getattr(trainable, "_pg_factory", None)
 
+    _restore_path: Optional[str] = None
+
     def fit(self) -> ResultGrid:
         tc = self._tune_config
         runner = TrialRunner(
@@ -97,8 +99,25 @@ class Tuner:
             run_config=self._run_config,
             pg_factory=self._pg_factory,
             trainable_name=self._name)
+        if self._restore_path:
+            runner.experiment_dir = self._restore_path
+            if not runner.restore_experiment_state():
+                raise FileNotFoundError(
+                    f"no experiment state under {self._restore_path!r}")
         trials = runner.run()
         return ResultGrid(trials, tc.metric, tc.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable, *,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: Tuner.restore tuner.py): finished trials keep their
+        results, unfinished ones restart from their last checkpoint."""
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        tuner._restore_path = path
+        return tuner
 
 
 def with_resources(trainable, resources) -> Any:

@@ -1,0 +1,88 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference: python/ray/util/actor_pool.py — same verbs (submit/map/
+map_unordered/get_next/has_next), re-implemented over this runtime's
+wait primitive.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in submission order."""
+        if self._next_return_index not in self._index_to_future:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._return_actor(ref)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Whichever pending result finishes first."""
+        if not self._index_to_future:
+            raise StopIteration("no pending results")
+        refs = list(self._index_to_future.values())
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result ready in time")
+        ref = ready[0]
+        for idx, r in list(self._index_to_future.items()):
+            if r == ref:
+                del self._index_to_future[idx]
+                break
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._return_actor(ref)
+        return value
+
+    def _return_actor(self, ref):
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is None:
+            return
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            new_ref = fn(actor, value)
+            self._future_to_actor[new_ref] = actor
+            self._index_to_future[self._next_task_index] = new_ref
+            self._next_task_index += 1
+        else:
+            self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

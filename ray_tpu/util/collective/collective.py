@@ -129,6 +129,13 @@ class GroupMember:
             self.coord.collect.remote(mode, rid, self.rank, value),
             timeout=timeout)
 
+    def put_mail(self, tag, data, timeout=300.0):
+        ray_tpu.get(self.coord.put_mail.remote(tag, data), timeout=timeout)
+
+    def get_mail(self, tag, timeout=300.0):
+        return ray_tpu.get(self.coord.get_mail.remote(tag),
+                           timeout=timeout)
+
 
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "tcp",
@@ -183,18 +190,91 @@ def _as_numpy(tensor):
     return np.asarray(tensor)
 
 
+# Tensors at/above this size take the ring path (object-store
+# peer-to-peer chunks) instead of moving whole through the coordinator.
+import os as _os
+RING_THRESHOLD_BYTES = int(_os.environ.get("RT_RING_THRESHOLD_BYTES",
+                                           1 << 22))
+
+
 def allreduce(tensor, group_name: str = "default",
               op: ReduceOp = ReduceOp.SUM):
     """In-place allreduce of a host tensor across the group (reference:
     collective.py:258).  Device tensors are fetched to host; for on-device
-    gradient reduction use XLA collectives via ray_tpu.parallel instead."""
+    gradient reduction use XLA collectives via ray_tpu.parallel instead.
+
+    Large tensors use a ring reduce-scatter + allgather whose chunks move
+    member-to-member through the shared-memory object store — the
+    coordinator relays only ObjectRefs, so no process ever handles
+    O(world * bytes) (reference architecture: the NCCL ring in
+    collective_group/nccl_collective_group.py:127; ours rides the
+    framework's own data plane)."""
     g = get_group_handle(group_name)
-    out = g.collect(f"reduce:{op.value}", _as_numpy(tensor))
+    arr = _as_numpy(tensor)
+    if arr.nbytes >= RING_THRESHOLD_BYTES and g.world_size > 2:
+        out = _ring_allreduce(g, arr, op)
+    else:
+        out = g.collect(f"reduce:{op.value}", arr)
     try:
         tensor[...] = out
         return tensor
     except TypeError:
         return out
+
+
+def _reduce_pair(a, b, op: ReduceOp):
+    if op == ReduceOp.SUM:
+        return a + b
+    if op == ReduceOp.PRODUCT:
+        return a * b
+    if op == ReduceOp.MIN:
+        return np.minimum(a, b)
+    return np.maximum(a, b)
+
+
+def _ring_allreduce(g: "GroupMember", arr: np.ndarray, op: ReduceOp):
+    """Ring allreduce: W-1 reduce-scatter steps + W-1 allgather steps.
+    Per-member traffic 2*(W-1)/W of the tensor, fully parallel across the
+    ring; after reduce-scatter rank r owns complete chunk (r+1) % W."""
+    w, r = g.world_size, g.rank
+    rid = g._next_round()
+    flat = arr.reshape(-1)
+    n = flat.size
+    pad = (-n) % w
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    chunks = [c.copy() for c in np.split(flat, w)]
+    nxt, prv = (r + 1) % w, (r - 1) % w
+    sent_refs = []  # keep owned until the ring drains (receivers borrow)
+
+    for s in range(w - 1):
+        send_idx = (r - s) % w
+        recv_idx = (r - s - 1) % w
+        ref = ray_tpu.put(chunks[send_idx])
+        sent_refs.append(ref)
+        # Wrapped in a tuple: a top-level ObjectRef arg would be resolved
+        # to its value at the coordinator (standard arg semantics); nested
+        # refs pass through, so only the tiny ref crosses the coordinator.
+        g.put_mail(f"rs:{rid}:{s}:{r}->{nxt}", (ref,))
+        got = g.get_mail(f"rs:{rid}:{s}:{prv}->{r}")[0]
+        chunks[recv_idx] = _reduce_pair(
+            chunks[recv_idx], ray_tpu.get(got, timeout=300), op)
+    for s in range(w - 1):
+        send_idx = (r + 1 - s) % w
+        recv_idx = (r - s) % w
+        ref = ray_tpu.put(chunks[send_idx])
+        sent_refs.append(ref)
+        g.put_mail(f"ag:{rid}:{s}:{r}->{nxt}", (ref,))
+        got = g.get_mail(f"ag:{rid}:{s}:{prv}->{r}")[0]
+        chunks[recv_idx] = np.asarray(ray_tpu.get(got, timeout=300))
+    # Everyone has fetched everything once all members reach this point;
+    # only then may the owned chunk refs be released.
+    g.collect("barrier", None)
+    del sent_refs
+    out = np.concatenate(chunks)
+    if pad:
+        out = out[:n]
+    return out.reshape(arr.shape)
 
 
 def allgather(tensor_list: list, tensor, group_name: str = "default"):

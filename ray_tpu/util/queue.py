@@ -1,0 +1,112 @@
+"""Distributed Queue: a named FIFO shared across tasks/actors.
+
+Reference: python/ray/util/queue.py — Queue backed by an actor; put/get
+with block/timeout semantics from any process in the cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+        self.maxsize = maxsize
+        self.q = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None):
+        import asyncio
+        try:
+            if timeout is None:
+                await self.q.put(item)
+            else:
+                await asyncio.wait_for(self.q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+        try:
+            if timeout is None:
+                return True, await self.q.get()
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def put_nowait_batch(self, items: List) -> bool:
+        if self.maxsize > 0 and self.q.qsize() + len(items) > self.maxsize:
+            return False
+        for item in items:
+            self.q.put_nowait(item)
+        return True
+
+    def qsize(self) -> int:
+        return self.q.qsize()
+
+    def empty(self) -> bool:
+        return self.q.empty()
+
+    def full(self) -> bool:
+        return self.q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        opts.setdefault("max_concurrency", 1000)
+        cls = ray_tpu.remote(_QueueActor)
+        self.actor = cls.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None):
+        ok = ray_tpu.get(self.actor.put.remote(
+            item, timeout if block else 0.001), timeout=None)
+        if not ok:
+            raise Full("queue full")
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        ok, item = ray_tpu.get(self.actor.get.remote(
+            timeout if block else 0.001), timeout=None)
+        if not ok:
+            raise Empty("queue empty")
+        return item
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List):
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)),
+                           timeout=60):
+            raise Full("queue full")
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote(), timeout=60)
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote(), timeout=60)
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote(), timeout=60)
+
+    def shutdown(self):
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass

@@ -1,0 +1,202 @@
+"""Workflow: durable execution of task DAGs.
+
+Reference: python/ray/workflow — workflow.run/run_async (api.py:120,166),
+per-task checkpointing in task_executor.py:50 (each task's output is
+persisted before dependents run), WorkflowManagementActor
+(workflow_access.py:88) tracking status, storage/ for the persistence
+layer.  Scoped re-design: the DAG IR is ray_tpu.dag; every node's result
+is checkpointed to the workflow's storage directory under a deterministic
+task key, so `resume` replays only the tasks whose checkpoints are
+missing (exactly-once-ish per task).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+
+_DEFAULT_STORAGE = None
+
+STATUS_RUNNING = "RUNNING"
+STATUS_SUCCESSFUL = "SUCCESSFUL"
+STATUS_FAILED = "FAILED"
+STATUS_RESUMABLE = "RESUMABLE"
+
+
+def init(storage: Optional[str] = None):
+    """Set the storage root (reference: workflow.init)."""
+    global _DEFAULT_STORAGE
+    _DEFAULT_STORAGE = storage
+
+
+def _storage_root() -> str:
+    global _DEFAULT_STORAGE
+    if _DEFAULT_STORAGE is None:
+        _DEFAULT_STORAGE = os.path.join(tempfile.gettempdir(),
+                                        "rt_workflows")
+    os.makedirs(_DEFAULT_STORAGE, exist_ok=True)
+    return _DEFAULT_STORAGE
+
+
+def _wf_dir(workflow_id: str) -> str:
+    d = os.path.join(_storage_root(), workflow_id)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _write_meta(workflow_id: str, **fields):
+    path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    meta = {}
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            meta = pickle.load(f)
+    meta.update(fields)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(meta, f)
+    os.replace(tmp, path)
+    return meta
+
+
+def _read_meta(workflow_id: str) -> Dict:
+    path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    if not os.path.exists(path):
+        return {}
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class _DurableExecutor:
+    """Executes a DAG bottom-up, checkpointing each task's output
+    (reference: _workflow_task_executor task_executor.py:50)."""
+
+    def __init__(self, workflow_id: str, args, kwargs):
+        self.workflow_id = workflow_id
+        self.dir = _wf_dir(workflow_id)
+        self.args = args
+        self.kwargs = kwargs
+        self._counters: Dict[str, int] = {}
+
+    def _task_key(self, node: FunctionNode) -> str:
+        """Deterministic per-run key: function name + visit index (the
+        bottom-up traversal order is deterministic for a given DAG)."""
+        name = getattr(node._fn, "__name__", "task")
+        idx = self._counters.get(name, 0)
+        self._counters[name] = idx + 1
+        return f"{name}__{idx}"
+
+    def execute(self, dag: DAGNode):
+        def _exec(node, args, kwargs):
+            if isinstance(node, InputNode):
+                return node._execute_impl(args, kwargs,
+                                          {"args": self.args,
+                                           "kwargs": self.kwargs})
+            if not isinstance(node, FunctionNode):
+                raise TypeError(
+                    "workflows support function DAGs (fn.bind); got "
+                    f"{type(node).__name__}")
+            key = self._task_key(node)
+            ckpt = os.path.join(self.dir, f"task__{key}.pkl")
+            if os.path.exists(ckpt):
+                with open(ckpt, "rb") as f:
+                    return pickle.load(f)
+            # Upstream values were materialized (durability barrier);
+            # run this task as a cluster task and persist its output.
+            rf = ray_tpu.remote(node._fn)
+            if node._bound_options:
+                rf = rf.options(**node._bound_options)
+            value = ray_tpu.get(rf.remote(*args, **kwargs), timeout=3600)
+            tmp = ckpt + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(value, f)
+            os.replace(tmp, ckpt)
+            return value
+
+        return dag._apply_recursive(_exec)
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        **kwargs) -> Any:
+    """Run a DAG durably to completion (reference: api.py:120)."""
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1e6):x}"
+    _write_meta(workflow_id, status=STATUS_RUNNING,
+                start_ts=time.time())
+    try:
+        result = _DurableExecutor(workflow_id, args, kwargs).execute(dag)
+    except Exception as e:
+        _write_meta(workflow_id, status=STATUS_FAILED, error=repr(e),
+                    end_ts=time.time())
+        raise
+    _write_meta(workflow_id, status=STATUS_SUCCESSFUL,
+                end_ts=time.time())
+    ckpt = os.path.join(_wf_dir(workflow_id), "result.pkl")
+    with open(ckpt, "wb") as f:
+        pickle.dump(result, f)
+    return result
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              **kwargs):
+    """Run in a background task; returns an ObjectRef to the result
+    (reference: api.py:166)."""
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1e6):x}"
+
+    # The driver-side closure carries the dag; the task replays it with
+    # the same workflow id so checkpoints land in the same directory.
+    storage = _storage_root()
+
+    @ray_tpu.remote
+    def _drive():
+        import ray_tpu.workflow as wf
+        wf.init(storage)
+        return wf.run(dag, *args, workflow_id=workflow_id, **kwargs)
+
+    return _drive.remote()
+
+
+def resume(workflow_id: str) -> Any:
+    """Return the stored result, or raise if the workflow never finished
+    (re-running an unfinished workflow requires its original DAG — call
+    run() again with the same workflow_id; completed tasks replay from
+    their checkpoints)."""
+    path = os.path.join(_wf_dir(workflow_id), "result.pkl")
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    raise RuntimeError(
+        f"workflow {workflow_id!r} has no stored result "
+        f"(status={get_status(workflow_id)}); re-run its DAG with "
+        f"run(dag, workflow_id=...) to continue from checkpoints")
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = _read_meta(workflow_id)
+    status = meta.get("status")
+    if status == STATUS_RUNNING and meta.get("end_ts") is None:
+        # Crashed mid-run (no end timestamp): resumable.
+        return STATUS_RESUMABLE
+    return status
+
+
+def list_all() -> List[Dict]:
+    root = _storage_root()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = _read_meta(wid)
+        if meta:
+            out.append({"workflow_id": wid,
+                        "status": get_status(wid)})
+    return out
+
+
+def delete(workflow_id: str):
+    import shutil
+    shutil.rmtree(os.path.join(_storage_root(), workflow_id),
+                  ignore_errors=True)

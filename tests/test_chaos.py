@@ -1,0 +1,39 @@
+"""Chaos: workloads complete while nodes die mid-run (reference:
+python/ray/tests/test_chaos.py + release/nightly_tests/setup_chaos.py)."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private.test_utils import NodeKiller
+
+
+def test_tasks_survive_node_kill_mid_pipeline(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    cluster.add_node(num_cpus=1, resources={"spot": 1})
+    cluster.add_node(num_cpus=1, resources={"spot": 1})
+    cluster.wait_for_nodes(3)
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"spot": 0.5}, max_retries=5)
+    def produce(i):
+        return np.full((300, 300), i)  # >100KiB -> remote store
+
+    @ray_tpu.remote(resources={"head": 0.1})
+    def total(x):
+        return float(x[0, 0])
+
+    produced = [produce.remote(i) for i in range(12)]
+    # Kill a spot node while results stream back; retries + lineage
+    # reconstruction must still deliver every value (replacement nodes
+    # keep the resource schedulable).
+    killer = NodeKiller(cluster, interval_s=2.0, max_kills=2,
+                        node_filter=lambda n: "spot" in
+                        n.raylet.total_resources, replace=True).start()
+    try:
+        outs = ray_tpu.get([total.remote(r) for r in produced],
+                           timeout=300)
+    finally:
+        killer.stop()
+    assert outs == [float(i) for i in range(12)]
+    assert killer.killed, "chaos harness never killed a node"

@@ -120,3 +120,12 @@ def test_pipeline_repeat(ray_init):
     pipe = rd.range(4, parallelism=2).repeat(3)
     rows = list(pipe.iter_rows())
     assert len(rows) == 12
+
+
+def test_datasource_and_stats(ray_init):
+    from ray_tpu.data import RangeDatasource, read_datasource
+
+    ds = read_datasource(RangeDatasource(), parallelism=4, n=20)
+    ds = ds.map(lambda x: x * 2)
+    assert sorted(ds.take_all()) == [x * 2 for x in range(20)]
+    assert "blocks" in ds.stats()

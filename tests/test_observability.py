@@ -111,3 +111,35 @@ def test_job_submission_end_to_end(ray_init):
     sid2 = client.submit_job(entrypoint="python -c 'import sys; "
                                         "sys.exit(3)'")
     assert client.wait_until_finished(sid2, timeout=120) == JobStatus.FAILED
+
+
+def test_dashboard_head_serves_state_and_metrics(ray_init):
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return 1
+
+    a = Pinger.options(name="dash-actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+    addr = start_dashboard()
+    base = f"http://{addr['host']}:{addr['port']}"
+    nodes = requests.get(f"{base}/api/nodes", timeout=30).json()
+    assert nodes and nodes[0]["state"] == "ALIVE"
+    actors = requests.get(f"{base}/api/actors", timeout=30).json()
+    assert any(x["name"] == "dash-actor" for x in actors)
+    # Metric round trip: the driver's registry pushes telemetry every
+    # ~2s; poll until the scrape sees it.
+    Counter("dash_test_counter").inc(5)
+    deadline = time.time() + 20
+    text = ""
+    while time.time() < deadline:
+        text = requests.get(f"{base}/metrics", timeout=30).text
+        if "dash_test_counter" in text:
+            break
+        time.sleep(0.5)
+    assert "dash_test_counter" in text

@@ -1,0 +1,76 @@
+"""Runtime environments: env_vars, working_dir, py_modules
+(reference test style: python/ray/tests/test_runtime_env*.py)."""
+
+import os
+import tempfile
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_env_vars_per_task(ray_init):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("MY_RT_FLAG")
+
+    out = ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"MY_RT_FLAG": "42"}}).remote(),
+        timeout=60)
+    assert out == "42"
+
+
+def test_py_modules_ship_code(ray_init):
+    pkg_dir = tempfile.mkdtemp(prefix="rt_pymod_")
+    mod_dir = os.path.join(pkg_dir, "shipped_mod")
+    os.makedirs(mod_dir)
+    with open(os.path.join(mod_dir, "__init__.py"), "w") as f:
+        f.write("MAGIC = 'from-shipped-module'\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import shipped_mod
+        return shipped_mod.MAGIC
+
+    out = ray_tpu.get(use_module.options(
+        runtime_env={"py_modules": [pkg_dir]}).remote(), timeout=60)
+    assert out == "from-shipped-module"
+
+
+def test_working_dir_files_visible(ray_init):
+    wd = tempfile.mkdtemp(prefix="rt_wd_")
+    with open(os.path.join(wd, "data.txt"), "w") as f:
+        f.write("payload-123")
+
+    @ray_tpu.remote
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    out = ray_tpu.get(read_file.options(
+        runtime_env={"working_dir": wd}).remote(), timeout=60)
+    assert out == "payload-123"
+
+
+def test_runtime_env_on_actor(ray_init):
+    @ray_tpu.remote
+    class EnvActor:
+        def flag(self):
+            return os.environ.get("ACTOR_RT_FLAG")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"ACTOR_RT_FLAG": "on"}}).remote()
+    assert ray_tpu.get(a.flag.remote(), timeout=60) == "on"
+
+
+def test_unsupported_field_rejected(ray_init):
+    from ray_tpu.runtime_env import RuntimeEnv
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["requests"])

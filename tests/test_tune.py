@@ -106,3 +106,32 @@ def test_tune_run_functional(ray_init):
     results = tune.run(objective, config={"p": tune.grid_search([5, 6])},
                        metric="v", mode="min")
     assert results.get_best_result().metrics["v"] == 5
+
+
+def test_experiment_level_resume(ray_init, tmp_path):
+    """Interrupted experiments resume from the experiment dir: finished
+    trials keep results, unfinished ones re-run (reference:
+    Tuner.restore / tune.run(resume=...))."""
+    marker = tmp_path / "fail_once"
+
+    def objective(config):
+        if config["x"] == 2 and not marker.exists():
+            marker.write_text("tripped")
+            raise RuntimeError("simulated crash")
+        tune.report({"score": config["x"] * 10, "done": True})
+
+    results = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path), name="exp"),
+    ).fit()
+    exp_dir = str(tmp_path / "exp")
+    failed = [r for r in results if r.error is not None]
+    assert len(failed) == 1  # x=2 crashed
+
+    restored = Tuner.restore(exp_dir, objective,
+                             tune_config=TuneConfig(metric="score",
+                                                    mode="max")).fit()
+    scores = sorted(r.metrics.get("score") for r in restored)
+    assert scores == [10, 20, 30]  # the crashed trial completed this time

@@ -1,0 +1,46 @@
+"""ActorPool + distributed Queue (reference: python/ray/util tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_actor_pool_map(ray_init):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Worker.options(num_cpus=0.5).remote()
+                      for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    range(5)))
+    assert out == [0, 2, 4, 6, 8]
+
+
+def test_queue_across_processes(ray_init):
+    q = Queue(maxsize=10)
+
+    @ray_tpu.remote
+    def producer(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q, 5), timeout=120)
+    got = [q.get(timeout=30) for _ in range(5)]
+    assert got == list(range(5))
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
