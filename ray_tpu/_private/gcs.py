@@ -142,6 +142,11 @@ class GcsServer:
         # table storage that survives GCS restart; here a pickle snapshot).
         self._persist_path = persist_path
         self._kv_writes = 0
+        # Structured cluster events (reference: src/ray/util/event.h:102
+        # EventManager + dashboard/modules/event): bounded ring, surfaced
+        # via the state API and dashboard.
+        from collections import deque
+        self.events = deque(maxlen=1000)
         if persist_path:
             self._load_snapshot()
 
@@ -242,7 +247,10 @@ class GcsServer:
             for a in self.actors.values()))
         pgs = tuple(sorted((p.pg_id.binary(), p.state)
                            for p in self.placement_groups.values()))
-        return hash((kv_sizes, actors, pgs, len(self.jobs),
+        jobs = tuple(sorted((bytes(k) if isinstance(k, bytes) else str(k),
+                             str(v.get("state")))
+                            for k, v in self.jobs.items()))
+        return hash((kv_sizes, actors, pgs, jobs,
                      len(self.named_actors)))
 
     async def _snapshot_loop(self):
@@ -355,11 +363,30 @@ class GcsServer:
                 if node.alive and now - node.last_heartbeat > timeout:
                     await self._mark_node_dead(node, "heartbeat timeout")
 
+    def _record_event(self, severity: str, label: str, message: str,
+                      source: str = "gcs"):
+        self.events.append({"ts": time.time(), "severity": severity,
+                            "label": label, "message": message,
+                            "source": source})
+
+    async def rpc_list_events(self, conn, body):
+        limit = body.get("limit", 200)
+        return list(self.events)[-limit:]
+
+    async def rpc_record_event(self, conn, body):
+        self._record_event(body.get("severity", "INFO"),
+                           body.get("label", ""),
+                           body.get("message", ""),
+                           body.get("source", "client"))
+        return {"ok": True}
+
     async def _mark_node_dead(self, node: NodeInfo, reason: str):
         if not node.alive:
             return
         node.alive = False
         logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
+        self._record_event("ERROR", "NODE_DEAD",
+                           f"node {node.node_id.hex()[:8]}: {reason}")
         await self._publish("nodes", {"event": "removed",
                                       "node_id": node.node_id,
                                       "reason": reason})
@@ -625,12 +652,20 @@ class GcsServer:
             actor.num_restarts += 1
             actor.state = RESTARTING
             actor.addr = None
+            self._record_event(
+                "WARNING", "ACTOR_RESTARTING",
+                f"actor {actor.actor_id.hex()[:8]} "
+                f"({actor.spec.get('class_name')}): {reason}")
             await self._publish("actors", {"event": "restarting",
                                            "actor": actor.view()})
             asyncio.get_running_loop().create_task(self._schedule_actor(actor))
         else:
             actor.state = DEAD
             actor.death_cause = reason
+            self._record_event(
+                "ERROR", "ACTOR_DEAD",
+                f"actor {actor.actor_id.hex()[:8]} "
+                f"({actor.spec.get('class_name')}): {reason}")
             await self._publish("actors", {"event": "dead",
                                            "actor": actor.view()})
             self._wake_actor_waiters(actor)

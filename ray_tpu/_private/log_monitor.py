@@ -52,28 +52,31 @@ class LogMonitor:
             except OSError:
                 continue
             # Only ship complete lines; carry partials to the next tick.
+            # All offset arithmetic stays in RAW bytes (decoding with
+            # errors="replace" changes byte counts).
             cut = chunk.rfind(b"\n")
             if cut < 0:
                 if len(chunk) >= read_limit:
                     # One line longer than the buffer would wedge this
                     # file forever: ship it truncated and move on.
-                    self._offsets[path] = off + len(chunk)
-                    cut = len(chunk)
+                    raw_lines = [chunk]
+                    consumed = len(chunk)
                 else:
                     continue
-            lines = chunk[:cut].decode("utf-8", "replace").splitlines()
-            if not lines:
-                self._offsets[path] = off + cut + 1
-                continue
-            # Cap the batch WITHOUT dropping: advance the offset only
-            # past the lines actually published.
-            if len(lines) > MAX_LINES_PER_TICK:
-                lines = lines[:MAX_LINES_PER_TICK]
-                consumed = sum(len(l.encode("utf-8", "replace")) + 1
-                               for l in lines)
-                self._offsets[path] = off + consumed
             else:
-                self._offsets[path] = off + cut + 1
+                raw_lines = chunk[:cut].split(b"\n")
+                if len(raw_lines) > MAX_LINES_PER_TICK:
+                    # Cap the batch WITHOUT dropping: advance only past
+                    # the lines actually published.
+                    raw_lines = raw_lines[:MAX_LINES_PER_TICK]
+                    consumed = sum(len(rl) + 1 for rl in raw_lines)
+                else:
+                    consumed = cut + 1
+            self._offsets[path] = off + consumed
+            lines = [rl.decode("utf-8", "replace") for rl in raw_lines
+                     if rl]
+            if not lines:
+                continue
             worker = os.path.basename(path)[len("worker-"):-len(".log")]
             await self.publish("logs", {
                 "node": self.node_id_hex,

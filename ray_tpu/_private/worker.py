@@ -783,7 +783,6 @@ class CoreWorker:
         saved_env = dict(os.environ)
         saved_cwd = os.getcwd()
         saved_path = list(sys.path)
-        renv.apply(runtime_env, _kv_get, cache)
 
         def _restore():
             os.environ.clear()
@@ -794,6 +793,13 @@ class CoreWorker:
                 pass
             sys.path[:] = saved_path
 
+        try:
+            renv.apply(runtime_env, _kv_get, cache)
+        except BaseException:
+            # A half-applied env (vars set, package missing) must not
+            # leak into the pooled worker.
+            _restore()
+            raise
         return _restore
 
     def _pin_args(self, task_id, args, kwargs):

@@ -81,6 +81,11 @@ class DashboardHead:
             from ray_tpu.experimental import state
             return _json(await _call(state.list_jobs))
 
+        @routes.get("/api/events")
+        async def events(request):
+            from ray_tpu.experimental import state
+            return _json(await _call(state.list_cluster_events))
+
         @routes.get("/api/timeline")
         async def timeline(request):
             return _json(await _call(ray_tpu.timeline))

@@ -1,0 +1,161 @@
+"""Preprocessors: fit on a Dataset, transform Datasets/batches.
+
+Reference: python/ray/data/preprocessors — Preprocessor base with
+fit/transform/fit_transform, StandardScaler, MinMaxScaler, LabelEncoder,
+Chain, BatchMapper.  Fit statistics aggregate per block as tasks and
+combine on the driver (sufficient statistics only — never the data).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class Preprocessor:
+    _fitted = False
+
+    def _fit(self, ds) -> None:
+        raise NotImplementedError
+
+    def _transform_pandas(self, df):
+        raise NotImplementedError
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted and type(self)._fit is not Preprocessor._fit:
+            raise RuntimeError(f"{type(self).__name__} not fitted")
+        fn = self._transform_pandas
+        return ds.map_batches(
+            lambda df: fn(df), batch_format="pandas")
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform_batch(self, df):
+        return self._transform_pandas(df)
+
+
+def _block_stats(columns):
+    def _stats(df):
+        out = {}
+        for c in columns:
+            v = df[c].to_numpy(dtype=np.float64)
+            out[c] = (len(v), v.sum(), (v ** 2).sum(), v.min() if len(v)
+                      else np.inf, v.max() if len(v) else -np.inf)
+        return [out]
+    return _stats
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference:
+    preprocessors/scaler.py StandardScaler)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds):
+        rows = ds.map_batches(_block_stats(self.columns),
+                              batch_format="pandas").take_all()
+        for c in self.columns:
+            n = sum(r[c][0] for r in rows)
+            s = sum(r[c][1] for r in rows)
+            ss = sum(r[c][2] for r in rows)
+            mean = s / max(n, 1)
+            var = max(ss / max(n, 1) - mean ** 2, 0.0)
+            self.stats_[c] = (mean, var ** 0.5 or 1.0)
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            df[c] = (df[c] - mean) / (std or 1.0)
+        return df
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds):
+        rows = ds.map_batches(_block_stats(self.columns),
+                              batch_format="pandas").take_all()
+        for c in self.columns:
+            lo = min(r[c][3] for r in rows)
+            hi = max(r[c][4] for r in rows)
+            self.stats_[c] = (lo, hi)
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            rng = (hi - lo) or 1.0
+            df[c] = (df[c] - lo) / rng
+        return df
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Dict = {}
+
+    def _fit(self, ds):
+        col = self.label_column
+        uniques = ds.map_batches(
+            lambda df: [set(df[col].unique().tolist())],
+            batch_format="pandas").take_all()
+        all_vals = sorted(set().union(*uniques))
+        self.classes_ = {v: i for i, v in enumerate(all_vals)}
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        df[self.label_column] = df[self.label_column].map(self.classes_)
+        return df
+
+
+class BatchMapper(Preprocessor):
+    """Stateless per-batch UDF (reference: preprocessors/batch_mapper)."""
+
+    def __init__(self, fn: Callable, batch_format: str = "pandas"):
+        self._fn = fn
+        self._batch_format = batch_format
+        self._fitted = True
+
+    def _fit(self, ds):
+        pass
+
+    def transform(self, ds):
+        return ds.map_batches(self._fn, batch_format=self._batch_format)
+
+    def _transform_pandas(self, df):
+        return self._fn(df)
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def fit(self, ds):
+        for p in self.preprocessors:
+            ds = p.fit_transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def _transform_pandas(self, df):
+        for p in self.preprocessors:
+            df = p._transform_pandas(df)
+        return df

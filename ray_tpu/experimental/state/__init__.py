@@ -1,4 +1,5 @@
 from ray_tpu.experimental.state.api import (  # noqa: F401
+    list_cluster_events,
     list_actors,
     list_jobs,
     list_nodes,

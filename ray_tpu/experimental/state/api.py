@@ -122,6 +122,12 @@ def list_objects() -> List[Dict]:
     return out
 
 
+def list_cluster_events(limit: int = 200) -> List[Dict]:
+    """Structured cluster events: node deaths, actor restarts/deaths
+    (reference: dashboard/modules/event + src/ray/util/event.h)."""
+    return _gcs("list_events", {"limit": limit})
+
+
 def summarize_tasks() -> Dict:
     counts: Dict[str, int] = {}
     for t in list_tasks():

@@ -11,7 +11,11 @@ from ray_tpu.rllib.algorithms.impala.impala import (  # noqa: F401
     Impala,
     ImpalaConfig,
 )
+from ray_tpu.rllib.algorithms.ddppo.ddppo import (  # noqa: F401
+    DDPPO,
+    DDPPOConfig,
+)
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
-__all__ = ["Algorithm", "AlgorithmConfig", "Impala", "ImpalaConfig",
-           "PPO", "PPOConfig", "SampleBatch"]
+__all__ = ["Algorithm", "AlgorithmConfig", "DDPPO", "DDPPOConfig",
+           "Impala", "ImpalaConfig", "PPO", "PPOConfig", "SampleBatch"]

@@ -1,0 +1,75 @@
+"""DD-PPO: decentralized PPO — no central learner, gradients allreduced
+across the rollout workers themselves.
+
+Reference: rllib/algorithms/ddppo/ddppo.py:91,131 (workers train locally
+and allreduce via torch.distributed).  Here each worker's SGD minibatch
+gradients ride the framework collective (ring allreduce for large
+models), and replicas stay bit-identical because every worker applies
+the same reduced gradients from identical initial weights.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.util import collective
+
+
+class DDPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DDPPO)
+        self._config.update({
+            "num_rollout_workers": 2,
+            "lr": 1e-3,
+            "clip_param": 0.2,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.0,
+            "num_sgd_iter": 10,
+            "sgd_minibatch_size": 128,
+            "steps_per_worker": 1000,
+        })
+
+
+class DDPPO(Algorithm):
+    def _extra_defaults(self) -> Dict:
+        return {"lr": 1e-3, "clip_param": 0.2, "vf_loss_coeff": 0.5,
+                "entropy_coeff": 0.0, "num_sgd_iter": 10,
+                "sgd_minibatch_size": 128, "steps_per_worker": 1000}
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        workers = self.workers.remote_workers
+        if len(workers) < 2:
+            raise ValueError("DD-PPO needs num_rollout_workers >= 2")
+        self._group = f"ddppo::{id(self):x}"
+        collective.create_collective_group(
+            workers, len(workers), list(range(len(workers))),
+            group_name=self._group)
+        # Identical starting point on every replica (decentralized sync
+        # correctness depends on it).
+        self.workers.sync_weights()
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        refs = [w.ddppo_epoch.remote(
+            cfg["steps_per_worker"], cfg["num_sgd_iter"],
+            cfg["sgd_minibatch_size"], self._group)
+            for w in self.workers.remote_workers]
+        outs = ray_tpu.get(refs, timeout=1800)
+        steps = sum(o["steps"] for o in outs)
+        self._timesteps_total += steps
+        # Keep the local (checkpointing/eval) policy in lockstep.
+        self.workers.local_worker.set_weights(ray_tpu.get(
+            self.workers.remote_workers[0].get_weights.remote(),
+            timeout=300))
+        return {"info": {"learner": outs[0]["stats"]},
+                "num_env_steps_trained": steps}
+
+    def cleanup(self):
+        try:
+            collective.destroy_collective_group(self._group)
+        except Exception:
+            pass
+        super().cleanup()

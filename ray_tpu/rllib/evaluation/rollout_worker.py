@@ -13,9 +13,10 @@ import numpy as np
 
 from ray_tpu.rllib.policy import sample_batch as sb
 from ray_tpu.rllib.policy.sample_batch import SampleBatch, compute_gae
+from ray_tpu.util.collective.collective import CollectiveMixin
 
 
-class RolloutWorker:
+class RolloutWorker(CollectiveMixin):
     def __init__(self, env_creator: Callable, policy_cls, config: Dict,
                  worker_index: int = 0):
         import os
@@ -91,6 +92,39 @@ class RolloutWorker:
                                     np.float32),
         })
         return compute_gae(seg, last_value, gamma, lam)
+
+    def ddppo_epoch(self, num_steps: int, num_sgd_iter: int,
+                    minibatch_size: int,
+                    group_name: str = "ddppo") -> Dict:
+        """One DD-PPO round: sample locally, then SGD with gradients
+        allreduced across the worker gang — no central learner
+        (reference: rllib/algorithms/ddppo/ddppo.py:91,131, which rides
+        torch.distributed; ours rides the framework collective ring).
+        Every member runs the same minibatch count, so the allreduce
+        rounds stay in lockstep."""
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        from ray_tpu.util import collective
+
+        group = collective.get_group_handle(group_name)
+        batch = self.sample(num_steps)
+        adv = batch[sb.ADVANTAGES]
+        batch[sb.ADVANTAGES] = (
+            (adv - adv.mean()) / max(adv.std(), 1e-6)).astype(np.float32)
+        rng = np.random.RandomState(self.config["seed"])
+        mb = min(minibatch_size, batch.count)
+        stats: Dict = {}
+        for _ in range(num_sgd_iter):
+            shuffled = batch.shuffle(rng)
+            for minibatch in shuffled.minibatches(mb):
+                grads, stats = self.policy.compute_grads(minibatch)
+                flat, unravel = ravel_pytree(grads)
+                arr = np.array(flat)  # writable copy (allreduce in-place)
+                collective.allreduce(arr, group_name=group_name)
+                self.policy.apply_grads(
+                    unravel(jnp.asarray(arr / group.world_size)))
+        return {"stats": stats, "steps": batch.count}
 
     def set_weights(self, weights) -> bool:
         self.policy.set_weights(weights)

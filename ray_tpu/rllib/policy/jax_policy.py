@@ -98,6 +98,23 @@ class JaxPolicy:
             self.params, self.opt_state, jbatch)
         return {k: float(v) for k, v in stats.items()}
 
+    # Decentralized training (DD-PPO): grads out, reduced grads in.
+    def compute_grads(self, batch: sb.SampleBatch):
+        if not hasattr(self, "_grad_step"):
+            def _impl(params, jbatch):
+                (loss, stats), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, jbatch)
+                return grads, dict(stats, total_loss=loss)
+            self._grad_step = jax.jit(_impl)
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        grads, stats = self._grad_step(self.params, jbatch)
+        return grads, {k: float(v) for k, v in stats.items()}
+
+    def apply_grads(self, grads):
+        updates, self.opt_state = self.tx.update(grads, self.opt_state,
+                                                 self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
     # ----------------------------------------------------------- weights
     def get_weights(self):
         return jax.tree_util.tree_map(np.asarray, self.params)

@@ -118,12 +118,36 @@ class Deployment:
         """Deployment-graph style binding of init args."""
         return self.options(init_args=args, init_kwargs=kwargs)
 
+    def _default_version(self) -> str:
+        """Content-derived version: re-deploying unchanged code is a
+        reconcile no-op instead of a forced rolling restart (matters for
+        composed graphs, where deploy() recurses into children)."""
+        import hashlib
+        try:
+            blob = cloudpickle.dumps(
+                (self._body, self.init_args, self.init_kwargs,
+                 self.config.to_dict(), self.ray_actor_options))
+            return hashlib.sha1(blob).hexdigest()[:8]
+        except Exception:
+            return uuid.uuid4().hex[:8]
+
     def deploy(self, _blocking: bool = True) -> DeploymentHandle:
         controller = _get_or_create_controller()
-        version = self.version or uuid.uuid4().hex[:8]
+        version = self.version or self._default_version()
+        # Model composition (reference: serve deployment graphs,
+        # _private/deployment_graph_build.py:34): Deployment-typed init
+        # args deploy first and arrive as handles, so an ingress class
+        # can `await self.child.remote(x)` its children.
+        def _resolve(v):
+            if isinstance(v, Deployment):
+                return v.deploy(_blocking=_blocking)
+            return v
+
+        init_args = tuple(_resolve(a) for a in self.init_args)
+        init_kwargs = {k: _resolve(v) for k, v in self.init_kwargs.items()}
         rc = ReplicaConfig(
             deployment_def=cloudpickle.dumps(self._body),
-            init_args=self.init_args, init_kwargs=self.init_kwargs,
+            init_args=init_args, init_kwargs=init_kwargs,
             ray_actor_options=self.ray_actor_options)
         ray_tpu.get(controller.deploy.remote(
             self.name, self.config.to_dict(), rc, version), timeout=60)

@@ -1,0 +1,5 @@
+from ray_tpu.train.torch.torch_trainer import (  # noqa: F401
+    TorchConfig,
+    TorchTrainer,
+    prepare_model,
+)

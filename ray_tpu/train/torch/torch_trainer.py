@@ -1,0 +1,105 @@
+"""TorchTrainer: CPU-torch DDP over a gang of worker actors.
+
+Reference: python/ray/train/torch/torch_trainer.py:15 + config.py:54
+(_setup_torch_process_group: rendezvous env + dist.init_process_group).
+On this framework torch is the CPU sidecar (the TPU path is JaxTrainer);
+the gloo process group rides the same gang the JaxBackend uses, proving
+the Backend seam is framework-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+
+@dataclasses.dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _setup_group(rank: int, world: int, addr: str, port: int,
+                 backend: str, timeout_s: float):
+    import datetime
+    import os
+
+    import torch.distributed as dist
+    os.environ["MASTER_ADDR"] = addr
+    os.environ["MASTER_PORT"] = str(port)
+    if not dist.is_initialized():
+        dist.init_process_group(
+            backend, rank=rank, world_size=world,
+            timeout=datetime.timedelta(seconds=timeout_s))
+    return True
+
+
+def _teardown_group():
+    import torch.distributed as dist
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig):
+        import ray_tpu
+        # Rendezvous on rank 0's host (reference: config.py:54 picks the
+        # master from worker 0's metadata).
+        info = worker_group.execute_single(0, _node_ip_and_port)
+        addr, port = info
+        world = worker_group.num_workers
+        refs = [
+            w.execute.remote(_setup_group, rank, world, addr, port,
+                             backend_config.backend,
+                             backend_config.init_timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs, timeout=backend_config.init_timeout_s + 60)
+
+    def on_shutdown(self, worker_group, backend_config: TorchConfig):
+        import ray_tpu
+        try:
+            ray_tpu.get([w.execute.remote(_teardown_group)
+                         for w in worker_group.workers], timeout=30)
+        except Exception:
+            pass
+
+
+def _node_ip_and_port():
+    return ("127.0.0.1", _free_port())
+
+
+def prepare_model(model):
+    """Wrap in DDP when the group spans >1 rank (reference:
+    train/torch/train_loop_utils.py:49 prepare_model)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+class TorchTrainer(DataParallelTrainer):
+    _backend_config_cls = TorchConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 torch_config: Optional[TorchConfig] = None,
+                 **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchConfig(),
+                         **kwargs)

@@ -41,9 +41,10 @@ class ActorPool:
             raise StopIteration("no pending results")
         ref = self._index_to_future.pop(self._next_return_index)
         self._next_return_index += 1
-        value = ray_tpu.get(ref, timeout=timeout)
+        # Return the actor BEFORE get: a raising task must not leak its
+        # actor out of the pool (reference ActorPool does the same).
         self._return_actor(ref)
-        return value
+        return ray_tpu.get(ref, timeout=timeout)
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
         """Whichever pending result finishes first."""
@@ -58,9 +59,8 @@ class ActorPool:
             if r == ref:
                 del self._index_to_future[idx]
                 break
-        value = ray_tpu.get(ref, timeout=timeout)
         self._return_actor(ref)
-        return value
+        return ray_tpu.get(ref, timeout=timeout)
 
     def _return_actor(self, ref):
         actor = self._future_to_actor.pop(ref, None)

@@ -97,7 +97,12 @@ class _Coordinator:
     async def get_mail(self, tag):
         import asyncio
         box = self._mailbox.setdefault(tag, asyncio.Queue())
-        return await box.get()
+        item = await box.get()
+        # Ring tags are single-use and globally unique: drop drained
+        # queues or a long training run leaks millions of them.
+        if box.empty():
+            self._mailbox.pop(tag, None)
+        return item
 
 
 class GroupMember:

@@ -134,11 +134,15 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
         _write_meta(workflow_id, status=STATUS_FAILED, error=repr(e),
                     end_ts=time.time())
         raise
+    # result.pkl BEFORE the SUCCESSFUL marker: the status contract is
+    # "SUCCESSFUL implies a retrievable result".
+    ckpt = os.path.join(_wf_dir(workflow_id), "result.pkl")
+    tmp = ckpt + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, ckpt)
     _write_meta(workflow_id, status=STATUS_SUCCESSFUL,
                 end_ts=time.time())
-    ckpt = os.path.join(_wf_dir(workflow_id), "result.pkl")
-    with open(ckpt, "wb") as f:
-        pickle.dump(result, f)
     return result
 
 

@@ -129,3 +129,28 @@ def test_datasource_and_stats(ray_init):
     ds = ds.map(lambda x: x * 2)
     assert sorted(ds.take_all()) == [x * 2 for x in range(20)]
     assert "blocks" in ds.stats()
+
+
+def test_preprocessors(ray_init):
+    import pandas as pd
+    from ray_tpu.data.preprocessors import (Chain, LabelEncoder,
+                                            MinMaxScaler, StandardScaler)
+
+    df = pd.DataFrame({"a": [1.0, 2.0, 3.0, 4.0],
+                       "b": [10.0, 20.0, 30.0, 40.0],
+                       "label": ["cat", "dog", "cat", "bird"]})
+    ds = rd.from_pandas([df.iloc[:2], df.iloc[2:]])
+
+    scaled = StandardScaler(["a"]).fit_transform(ds).to_pandas()
+    assert abs(scaled["a"].mean()) < 1e-9
+    assert abs(scaled["a"].std(ddof=0) - 1.0) < 1e-9
+
+    mm = MinMaxScaler(["b"]).fit_transform(ds).to_pandas()
+    assert mm["b"].min() == 0.0 and mm["b"].max() == 1.0
+
+    enc = LabelEncoder("label").fit_transform(ds).to_pandas()
+    assert set(enc["label"]) == {0, 1, 2}
+
+    chain = Chain(StandardScaler(["a"]), MinMaxScaler(["a"]))
+    out = chain.fit(ds).transform(ds).to_pandas()
+    assert out["a"].min() == 0.0 and out["a"].max() == 1.0

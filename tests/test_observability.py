@@ -143,3 +143,27 @@ def test_dashboard_head_serves_state_and_metrics(ray_init):
             break
         time.sleep(0.5)
     assert "dash_test_counter" in text
+
+
+def test_cluster_events_recorded(ray_init):
+    from ray_tpu.experimental import state
+
+    @ray_tpu.remote(max_restarts=0)
+    class Dier:
+        def die(self):
+            import os
+            os._exit(1)
+
+    a = Dier.remote()
+    try:
+        ray_tpu.get(a.die.remote(), timeout=60)
+    except Exception:
+        pass
+    deadline = time.time() + 30
+    events = []
+    while time.time() < deadline:
+        events = state.list_cluster_events()
+        if any(e["label"] == "ACTOR_DEAD" for e in events):
+            break
+        time.sleep(0.5)
+    assert any(e["label"] == "ACTOR_DEAD" for e in events)

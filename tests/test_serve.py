@@ -160,3 +160,22 @@ def test_serve_batch(serve_instance):
                                                      for i in range(8)]
     sizes = handle.seen_batches.remote().result(timeout=60)
     assert max(sizes) > 1  # concurrent calls actually batched
+
+
+def test_model_composition_child_deployments(serve_instance):
+    @serve.deployment(name="preprocess")
+    def preprocess(x):
+        return x * 2
+
+    @serve.deployment(name="ingress")
+    class Ingress:
+        def __init__(self, child):
+            self.child = child  # DeploymentHandle injected by deploy()
+
+        async def __call__(self, x):
+            return await self.child.remote(x) + 1
+
+    handle = Ingress.bind(preprocess).deploy()
+    assert handle.remote(20).result(timeout=60) == 41
+    st = {s["name"] for s in serve.status()}
+    assert {"preprocess", "ingress"} <= st

@@ -105,3 +105,35 @@ def test_dataset_ingest_shards_per_worker(ray_init):
     # data (sum over both == sum(range(20)) checked via world view).
     assert result.metrics["rank"] == 0
     assert 0 < result.metrics["shard_sum"] < sum(range(20))
+
+
+def _torch_ddp_loop(config):
+    import torch
+    import torch.distributed as dist
+    from ray_tpu.air import session
+    from ray_tpu.train.torch import prepare_model
+
+    torch.manual_seed(0)
+    model = prepare_model(torch.nn.Linear(4, 1))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    x = torch.randn(16, 4)
+    y = x.sum(dim=1, keepdim=True)
+    for _ in range(5):
+        opt.zero_grad()
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()  # DDP allreduces grads across the gang
+        opt.step()
+    session.report({"loss": float(loss),
+                    "world": dist.get_world_size()})
+
+
+def test_torch_trainer_ddp_gloo(ray_init):
+    from ray_tpu.train.torch import TorchTrainer
+
+    trainer = TorchTrainer(
+        _torch_ddp_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    assert result.metrics["world"] == 2
+    assert result.metrics["loss"] < 5.0
