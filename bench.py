@@ -75,6 +75,21 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
 
+    # Long-context entry: seq 4096 with the Pallas flash kernels (the
+    # einsum path OOMs outright at this length on one chip).  mfu_hw
+    # adjusts for remat's forward recompute (~8ND executed vs 6ND
+    # counted).
+    if on_accel:
+        # Free the seq-1024 model first: two 737M-param states + opt
+        # don't fit one chip's HBM together.
+        import gc
+        state = m = tokens = step = None
+        gc.collect()
+        try:
+            detail["long_seq_4096"] = _bench_long_seq(peak)
+        except Exception as e:
+            detail["long_seq_4096"] = {"error": repr(e)}
+
     # Core-runtime microbenchmarks vs the reference's measured floors
     # (BASELINE.md / release_logs/1.13.0/microbenchmark.json) — the
     # orchestration-overhead story the model number doesn't cover.
@@ -106,6 +121,35 @@ REFERENCE_FLOORS = {
     "put_gigabytes": 19.5,
     "get_gigabytes": 19.5,
 }
+
+
+def _bench_long_seq(peak):
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=32000, d_model=2048, n_heads=16,
+                        n_layers=12, d_ff=8192, max_seq=4096,
+                        dtype=jnp.bfloat16, remat=True, use_flash=True)
+    key = jax.random.PRNGKey(0)
+    state, _ = gpt.make_train_state(cfg, key)
+    n_params = _param_count(state["params"])
+    batch, seq, steps = 2, 4096, 6
+    tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size)
+    step = gpt.make_train_step(cfg, donate=True)
+    state, m = step(state, tokens)
+    float(jax.device_get(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, tokens)
+    float(jax.device_get(m["loss"]))
+    dt = time.perf_counter() - t0
+    tps = steps * batch * seq / dt
+    out = {"tokens_per_sec": round(tps, 2), "batch": batch, "seq": seq,
+           "attention": "pallas_flash"}
+    if peak:
+        out["mfu"] = round(6 * n_params * tps / peak, 4)
+        out["mfu_hw_remat_adjusted"] = round(8 * n_params * tps / peak, 4)
+    return out
 
 
 def _run_microbench():

@@ -100,6 +100,131 @@ def _flash_fwd(q, k, v, *, scale, block_q, block_k, interpret):
     return out.reshape(b, h, s, d), lse.reshape(b, h, s)
 
 
+def _dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
+               *, scale, block_k):
+    """dq for one q block: stream KV, recompute P from the saved lse
+    (flash backward, dq half)."""
+    q = q_ref[0].astype(jnp.float32)
+    gb = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)        # [bq, 1]
+    delta = delta_ref[0].astype(jnp.float32)    # [bq, 1]
+    block_q = q.shape[0]
+    i = pl.program_id(1)
+    q_start = i * block_q
+
+    acc0 = jnp.zeros_like(q)
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ kb.T) * scale
+        q_ids = q_start + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+        k_ids = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        p = jnp.where(q_ids >= k_ids, jnp.exp(s - lse), 0.0)
+        dp = gb @ vb.T
+        ds = p * (dp - delta)
+        return acc + ds @ kb
+
+    n_kv = (q_start + block_q - 1) // block_k + 1
+    acc = lax.fori_loop(0, n_kv, body, acc0)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, *, scale, block_q, n_q_blocks):
+    """dk/dv for one kv block: stream the q blocks that can attend to it
+    (flash backward, dk/dv half).  Requires block_q == block_k."""
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    block_k = kb.shape[0]
+    j = pl.program_id(1)
+    k_start = j * block_k
+
+    dk0 = jnp.zeros_like(kb)
+    dv0 = jnp.zeros_like(vb)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        gb = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        s = (qb @ kb.T) * scale
+        q_ids = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(q_ids >= k_ids, jnp.exp(s - lse), 0.0)
+        dv = dv + p.T @ gb
+        ds = p * (gb @ vb.T - delta)
+        dk = dk + ds.T @ qb
+        return dk, dv
+
+    # Only q blocks at/after this kv block attend to it (causal).
+    dk, dv = lax.fori_loop(j, n_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, *, scale, block, interpret):
+    b, h, s, d = q.shape
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    gf = g.reshape(b * h, s, d)
+    # delta_i = g_i . out_i, the rowwise correction of flash backward.
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    delta = delta.reshape(b * h, s, 1)
+    lse3 = lse.reshape(b * h, s, 1)
+    n_blocks = s // block
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=block),
+        grid=(b * h, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qf, gf, lse3, delta, kf, vf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, block_q=block,
+                          n_q_blocks=n_blocks),
+        grid=(b * h, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, gf, lse3, delta)
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
+            dv.reshape(b, h, s, d))
+
+
 def _blockwise_bwd(q, k, v, out, lse, g, *, scale, block_q):
     """Flash backward as blockwise XLA: recompute P per q-block from the
     saved logsumexp, accumulate dq/dk/dv with a scan over q blocks."""
@@ -162,6 +287,11 @@ def _vjp_fwd(q, k, v, scale, block_q, block_k, interpret):
 def _vjp_bwd(scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     scale = scale or q.shape[-1] ** -0.5
+    if block_q == block_k:
+        # Pallas backward: P recomputed per block pair from the saved
+        # lse, no O(block*S) XLA intermediates in HBM.
+        return _flash_bwd_pallas(q, k, v, out, lse, g, scale=scale,
+                                 block=block_q, interpret=interpret)
     return _blockwise_bwd(q, k, v, out, lse, g, scale=scale,
                           block_q=block_q)
 

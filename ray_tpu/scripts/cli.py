@@ -1,0 +1,172 @@
+"""rt — the command-line surface of the framework.
+
+Reference: python/ray/scripts/scripts.py (`ray start/stop/status/...`)
+and experimental/state/state_cli.py (`ray list actors/tasks/...`).
+Usage: python -m ray_tpu.scripts.cli <command> [...] --address host:port
+
+Commands:
+  status                      cluster resources + nodes
+  list {nodes,actors,tasks,objects,placement-groups,jobs,events}
+  summary {tasks,objects}
+  timeline [--output FILE]    chrome-trace dump
+  job submit -- <entrypoint>  supervised job; streams status
+  job logs <submission_id>
+  job stop <submission_id>
+  dashboard [--port N]        start the dashboard head, print its URL
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address):
+    import ray_tpu
+    ray_tpu.init(address=address, ignore_reinit_error=True,
+                 log_to_driver=False)
+
+
+def _print_rows(rows):
+    if not rows:
+        print("(none)")
+        return
+    cols = list(rows[0].keys())
+    widths = {c: max(len(str(c)), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    print("  ".join(str(c).ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+
+
+def cmd_status(args):
+    import ray_tpu
+    from ray_tpu.experimental import state
+    _connect(args.address)
+    print("cluster:", json.dumps(ray_tpu.cluster_resources()))
+    print("available:", json.dumps(ray_tpu.available_resources()))
+    _print_rows(state.list_nodes())
+
+
+def cmd_list(args):
+    from ray_tpu.experimental import state
+    _connect(args.address)
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+        "events": state.list_cluster_events,
+    }[args.entity]
+    rows = fn()
+    if args.format == "json":
+        print(json.dumps(rows, default=str, indent=2))
+    else:
+        _print_rows(rows)
+
+
+def cmd_summary(args):
+    from ray_tpu.experimental import state
+    _connect(args.address)
+    fn = {"tasks": state.summarize_tasks,
+          "objects": state.summarize_objects}[args.entity]
+    print(json.dumps(fn(), default=str, indent=2))
+
+
+def cmd_timeline(args):
+    import ray_tpu
+    _connect(args.address)
+    events = ray_tpu.timeline(filename=args.output)
+    if args.output:
+        print(f"wrote {len(events)} events to {args.output}")
+    else:
+        print(json.dumps(events[:50], indent=2))
+        if len(events) > 50:
+            print(f"... {len(events) - 50} more (use --output FILE)")
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    _connect(args.address)
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(f"submitted {sid}")
+        if not args.no_wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(f"status: {status}")
+            print(client.get_job_logs(sid), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.submission_id)
+              else "stop failed")
+    elif args.job_cmd == "list":
+        _print_rows([{k: v for k, v in j.items() if k != "logs"}
+                     for j in client.list_jobs()])
+
+
+def cmd_dashboard(args):
+    import time
+
+    from ray_tpu.dashboard import start_dashboard
+    _connect(args.address)
+    addr = start_dashboard(port=args.port)
+    print(f"dashboard: http://{addr['host']}:{addr['port']}")
+    if args.block:
+        while True:
+            time.sleep(3600)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="rt", description=__doc__)
+    p.add_argument("--address", default=None,
+                   help="GCS address host:port (default: local cluster)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    lp = sub.add_parser("list")
+    lp.add_argument("entity", choices=["nodes", "actors", "tasks",
+                                       "objects", "placement-groups",
+                                       "jobs", "events"])
+    lp.add_argument("--format", choices=["table", "json"],
+                    default="table")
+    lp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary")
+    sp.add_argument("entity", choices=["tasks", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    tp = sub.add_parser("timeline")
+    tp.add_argument("--output", default=None)
+    tp.set_defaults(fn=cmd_timeline)
+
+    jp = sub.add_parser("job")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=3600.0)
+    jl = jsub.add_parser("logs")
+    jl.add_argument("submission_id")
+    jst = jsub.add_parser("stop")
+    jst.add_argument("submission_id")
+    jsub.add_parser("list")
+    jp.set_defaults(fn=cmd_job)
+
+    dp = sub.add_parser("dashboard")
+    dp.add_argument("--port", type=int, default=0)
+    dp.add_argument("--block", action="store_true")
+    dp.set_defaults(fn=cmd_dashboard)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

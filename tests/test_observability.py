@@ -167,3 +167,27 @@ def test_cluster_events_recorded(ray_init):
             break
         time.sleep(0.5)
     assert any(e["label"] == "ACTOR_DEAD" for e in events)
+
+
+def test_cli_surface(ray_init, capsys):
+    from ray_tpu.scripts import cli
+
+    @ray_tpu.remote
+    class CliActor:
+        def ping(self):
+            return 1
+
+    a = CliActor.options(name="cli-actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+    cli.main(["status"])
+    out = capsys.readouterr().out
+    assert "cluster:" in out and "ALIVE" in out
+
+    cli.main(["list", "actors", "--format", "json"])
+    out = capsys.readouterr().out
+    assert "cli-actor" in out
+
+    cli.main(["summary", "objects"])
+    out = capsys.readouterr().out
+    assert "total_objects" in out
