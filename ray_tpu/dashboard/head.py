@@ -81,6 +81,14 @@ class DashboardHead:
             from ray_tpu.experimental import state
             return _json(await _call(state.list_jobs))
 
+        @routes.get("/api/serve")
+        async def serve_status(request):
+            try:
+                from ray_tpu import serve as serve_mod
+                return _json(await _call(serve_mod.status))
+            except Exception as e:
+                return _json({"error": repr(e)})
+
         @routes.get("/api/events")
         async def events(request):
             from ray_tpu.experimental import state

@@ -1,0 +1,134 @@
+"""multiprocessing.Pool drop-in over cluster tasks.
+
+Reference: python/ray/util/multiprocessing — Pool whose apply/map/starmap
+run as remote tasks, so existing Pool code scales past one machine
+without changes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+_GET_TIMEOUT = 3600.0
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        out = ray_tpu.get(self._refs, timeout=timeout or _GET_TIMEOUT)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Pool(processes) — processes bounds in-flight tasks, not workers
+    (the cluster supplies the workers)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._max_inflight = processes or 64
+        self._closed = False
+        if initializer is not None:
+            initializer(*initargs)
+        self._remote_cache: dict = {}
+
+    def _remote(self, fn):
+        rf = self._remote_cache.get(fn)
+        if rf is None:
+            rf = self._remote_cache[fn] = ray_tpu.remote(fn)
+        return rf
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args: tuple = (), kwds: dict = None):
+        self._check_open()
+        ref = self._remote(fn).remote(*args, **(kwds or {}))
+        return AsyncResult([ref], single=True)
+
+    def _submit_all(self, fn, iterables) -> List:
+        rf = self._remote(fn)
+        refs = []
+        inflight: List = []
+        for args in iterables:
+            if len(inflight) >= self._max_inflight:
+                _, inflight = ray_tpu.wait(
+                    inflight, num_returns=1, timeout=_GET_TIMEOUT)
+                inflight = list(inflight)
+            ref = rf.remote(*args)
+            refs.append(ref)
+            inflight.append(ref)
+        return refs
+
+    def map(self, fn: Callable, iterable: Iterable) -> List:
+        return self.map_async(fn, iterable).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable) -> AsyncResult:
+        self._check_open()
+        return AsyncResult(
+            self._submit_all(fn, ((x,) for x in iterable)), single=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable) -> List:
+        self._check_open()
+        return AsyncResult(self._submit_all(fn, iterable),
+                           single=False).get()
+
+    def imap(self, fn: Callable, iterable: Iterable):
+        self._check_open()
+        for ref in self._submit_all(fn, ((x,) for x in iterable)):
+            yield ray_tpu.get(ref, timeout=_GET_TIMEOUT)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable):
+        self._check_open()
+        pending = self._submit_all(fn, ((x,) for x in iterable))
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1,
+                                         timeout=_GET_TIMEOUT)
+            pending = list(pending)
+            for ref in done:
+                yield ray_tpu.get(ref, timeout=_GET_TIMEOUT)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

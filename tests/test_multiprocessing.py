@@ -1,0 +1,31 @@
+"""multiprocessing.Pool shim (reference: ray.util.multiprocessing)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _addmul(a, b):
+    return a * 10 + b
+
+
+def test_pool_map_and_apply(ray_init):
+    with Pool(processes=4) as pool:
+        assert pool.map(_sq, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert pool.apply(_addmul, (3, 4)) == 34
+        assert pool.starmap(_addmul, [(1, 2), (3, 4)]) == [12, 34]
+        assert sorted(pool.imap_unordered(_sq, range(4))) == [0, 1, 4, 9]
+        r = pool.map_async(_sq, [5])
+        assert r.get(timeout=60) == [25]
