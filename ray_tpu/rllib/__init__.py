@@ -15,7 +15,9 @@ from ray_tpu.rllib.algorithms.ddppo.ddppo import (  # noqa: F401
     DDPPO,
     DDPPOConfig,
 )
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = ["Algorithm", "AlgorithmConfig", "DDPPO", "DDPPOConfig",
-           "Impala", "ImpalaConfig", "PPO", "PPOConfig", "SampleBatch"]
+           "DQN", "DQNConfig", "Impala", "ImpalaConfig", "PPO",
+           "PPOConfig", "SampleBatch"]

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401

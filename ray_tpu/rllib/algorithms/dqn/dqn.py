@@ -1,0 +1,88 @@
+"""DQN: off-policy Q-learning with a replay buffer and target network.
+
+Reference: rllib/algorithms/dqn/dqn.py training_step — sample rollouts
+into the replay buffer, SGD on uniform replay batches, periodic target
+sync, epsilon annealed on the workers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.jax_q_policy import JaxQPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self._config.update({
+            "lr": 1e-3,
+            "buffer_capacity": 50_000,
+            "learning_starts": 1000,
+            "train_batch_size": 1000,   # env steps collected per iter
+            "sgd_batch_size": 64,
+            "num_sgd_steps": 50,
+            "target_update_freq": 4,    # iterations between target syncs
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.05,
+            "epsilon_anneal_iters": 15,
+        })
+
+
+class DQN(Algorithm):
+    policy_cls = JaxQPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return dict(DQNConfig()._config)
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        self.buffer = ReplayBuffer(self.algo_config["buffer_capacity"],
+                                   seed=self.algo_config["seed"])
+        self._iter = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._iter / max(cfg["epsilon_anneal_iters"], 1))
+        return (cfg["initial_epsilon"]
+                + frac * (cfg["final_epsilon"] - cfg["initial_epsilon"]))
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        self._iter += 1
+        eps = self._epsilon()
+        # Collect with the current epsilon on every worker.
+        per_worker = max(1, cfg["train_batch_size"]
+                         // max(1, len(self.workers.remote_workers)))
+        if self.workers.remote_workers:
+            weights = self.workers.local_worker.policy.get_weights()
+            weights["epsilon"] = eps
+            wref = ray_tpu.put(weights)
+            ray_tpu.get([w.set_weights.remote(wref)
+                         for w in self.workers.remote_workers],
+                        timeout=300)
+            batches = ray_tpu.get(
+                self.workers.sample_all(per_worker), timeout=600)
+        else:
+            self.workers.local_worker.policy.epsilon = eps
+            batches = [self.workers.local_worker.sample(per_worker)]
+        batch = SampleBatch.concat_samples(batches)
+        self.buffer.add(batch)
+        self._timesteps_total += batch.count
+
+        policy = self.workers.local_worker.policy
+        stats: Dict = {}
+        if len(self.buffer) >= cfg["learning_starts"]:
+            for _ in range(cfg["num_sgd_steps"]):
+                stats = policy.learn_on_batch(
+                    self.buffer.sample(cfg["sgd_batch_size"]))
+            if self._iter % cfg["target_update_freq"] == 0:
+                policy.update_target()
+        return {"info": {"learner": stats,
+                         "buffer_size": len(self.buffer),
+                         "epsilon": eps},
+                "num_env_steps_trained": batch.count}

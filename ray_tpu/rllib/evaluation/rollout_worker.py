@@ -41,7 +41,8 @@ class RolloutWorker(CollectiveMixin):
         gamma = self.config.get("gamma", 0.99)
         lam = self.config.get("lambda", 0.95)
         rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
-                                sb.ACTION_LOGP, sb.VF_PREDS)}
+                                sb.NEXT_OBS, sb.ACTION_LOGP,
+                                sb.VF_PREDS)}
         segments: List[SampleBatch] = []
         seg_start = 0
         for _ in range(horizon):
@@ -54,6 +55,7 @@ class RolloutWorker(CollectiveMixin):
             rows[sb.ACTIONS].append(int(action[0]))
             rows[sb.REWARDS].append(float(reward))
             rows[sb.DONES].append(bool(terminated))
+            rows[sb.NEXT_OBS].append(obs2)
             rows[sb.ACTION_LOGP].append(float(logp[0]))
             rows[sb.VF_PREDS].append(float(vf[0]))
             self._episode_reward += float(reward)
@@ -86,6 +88,8 @@ class RolloutWorker(CollectiveMixin):
             sb.ACTIONS: np.asarray(rows[sb.ACTIONS][start:end], np.int32),
             sb.REWARDS: np.asarray(rows[sb.REWARDS][start:end], np.float32),
             sb.DONES: np.asarray(rows[sb.DONES][start:end], np.bool_),
+            sb.NEXT_OBS: np.asarray(rows[sb.NEXT_OBS][start:end],
+                                    np.float32),
             sb.ACTION_LOGP: np.asarray(rows[sb.ACTION_LOGP][start:end],
                                        np.float32),
             sb.VF_PREDS: np.asarray(rows[sb.VF_PREDS][start:end],

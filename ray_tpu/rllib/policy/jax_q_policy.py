@@ -1,0 +1,111 @@
+"""JaxQPolicy: epsilon-greedy Q-learning policy with a target network.
+
+Reference: rllib/algorithms/dqn/dqn_torch_policy.py (TD loss + target
+net) re-derived in jax: the whole TD step (double-DQN target, huber
+loss, adam update) is one jitted function.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.policy import sample_batch as sb
+
+
+class QNet(nn.Module):
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        return nn.Dense(self.num_actions)(h)
+
+
+class JaxQPolicy:
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict):
+        self.config = config
+        self.num_actions = num_actions
+        self.model = QNet(num_actions=num_actions,
+                          hiddens=tuple(config.get("fcnet_hiddens",
+                                                   (64, 64))))
+        rng = jax.random.PRNGKey(config.get("policy_seed",
+                                            config.get("seed", 0)))
+        self.params = self.model.init(
+            rng, jnp.zeros((1, obs_dim), jnp.float32))
+        self.target_params = self.params
+        self.tx = optax.adam(config.get("lr", 1e-3))
+        self.opt_state = self.tx.init(self.params)
+        self.epsilon = config.get("initial_epsilon", 1.0)
+        self._rng = np.random.RandomState(config.get("seed", 0) + 7)
+        self._forward = jax.jit(self.model.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+
+    # ------------------------------------------------------------ acting
+    def compute_actions(self, obs: np.ndarray):
+        """Epsilon-greedy; returns (actions, logp, vf) — logp/vf are
+        placeholders so RolloutWorker's row schema stays uniform."""
+        q = np.asarray(self._forward(self.params,
+                                     jnp.asarray(obs, jnp.float32)))
+        greedy = q.argmax(axis=-1)
+        explore = self._rng.rand(len(greedy)) < self.epsilon
+        random_a = self._rng.randint(0, self.num_actions, size=len(greedy))
+        actions = np.where(explore, random_a, greedy)
+        zeros = np.zeros(len(greedy), np.float32)
+        return actions.astype(np.int64), zeros, zeros
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        q = self._forward(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(q.max(axis=-1))
+
+    # ---------------------------------------------------------- learning
+    def _train_step_impl(self, params, target_params, opt_state, batch):
+        gamma = self.config.get("gamma", 0.99)
+
+        def loss_fn(p):
+            q = self.model.apply(p, batch["obs"])
+            qa = q[jnp.arange(q.shape[0]), batch["actions"]]
+            # Double DQN: online net picks, target net evaluates.
+            q_next_online = self.model.apply(p, batch["new_obs"])
+            next_a = q_next_online.argmax(axis=-1)
+            q_next_target = self.model.apply(target_params,
+                                             batch["new_obs"])
+            q_next = q_next_target[jnp.arange(q.shape[0]), next_a]
+            target = batch["rewards"] + gamma * q_next * (
+                1.0 - batch["dones"].astype(jnp.float32))
+            td = qa - jax.lax.stop_gradient(target)
+            return optax.huber_loss(td).mean(), jnp.abs(td).mean()
+
+        (loss, td_err), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"total_loss": loss,
+                                   "mean_td_error": td_err}
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._train_step(
+            self.params, self.target_params, self.opt_state, jbatch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self):
+        self.target_params = self.params
+
+    # ----------------------------------------------------------- weights
+    def get_weights(self):
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "epsilon": self.epsilon}
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray,
+                                             weights["params"])
+        self.epsilon = weights["epsilon"]

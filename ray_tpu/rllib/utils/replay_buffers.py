@@ -1,0 +1,42 @@
+"""Replay buffers (reference: rllib/utils/replay_buffers — ring storage
+with uniform sampling; the prioritized variant is scoped out)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = capacity
+        self._storage: Dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, batch: SampleBatch) -> None:
+        n = batch.count
+        if not self._storage:
+            for k, v in batch.items():
+                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                            v.dtype)
+        for start in range(0, n, self.capacity):
+            chunk = {k: v[start:start + self.capacity]
+                     for k, v in batch.items()}
+            m = len(next(iter(chunk.values())))
+            idx = (self._next + np.arange(m)) % self.capacity
+            for k, v in chunk.items():
+                self._storage[k][idx] = v
+            self._next = int((self._next + m) % self.capacity)
+            self._size = int(min(self._size + m, self.capacity))
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        idx = self._rng.randint(0, self._size, size=batch_size)
+        return SampleBatch({k: v[idx] for k, v in self._storage.items()})

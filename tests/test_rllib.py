@@ -92,3 +92,26 @@ def test_ddppo_decentralized_learning(ray_init):
         best = max(best, r["episode_reward_mean"])
     assert best > 25  # clearly learning within a few rounds
     algo.stop()
+
+
+def test_dqn_cartpole_improves(ray_init):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=200)
+            .training(train_batch_size=1000, learning_starts=1000,
+                      num_sgd_steps=100, epsilon_anneal_iters=8)
+            .debugging(seed=11)
+            .build())
+    best = 0.0
+    for i in range(15):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+    assert r["info"]["buffer_size"] >= 1000
+    assert np.isfinite(r["info"]["learner"]["total_loss"])
+    # epsilon-annealed Q-learning clearly improves over the random policy
+    # (~22 reward on CartPole; the strict learning-regression bar is
+    # PPO's >=150 — DQN at this step budget asserts improvement).
+    assert best > 32, f"DQN failed to improve (best={best})"
+    algo.stop()
