@@ -83,12 +83,23 @@ def prefault_store():
     w = worker_mod.global_worker
     if w is None or w.mapping is None:
         return
+    if w.raylet is not None:
+        # Refuse unless the arena is empty: an already-running session
+        # (init(ignore_reinit_error=True) reuse) may hold live objects.
+        try:
+            used = w._run(w.raylet.request("os_used", {}))["used"]
+        except Exception:
+            return
+        if used:
+            print(f"store prefault skipped: {used} bytes in use")
+            return
     mv = w.mapping.view
     cap = len(mv)
     zero = bytes(1 << 22)
     t0 = time.perf_counter()
-    for off in range(0, cap - len(zero), len(zero)):
-        mv[off:off + len(zero)] = zero
+    for off in range(0, cap, len(zero)):
+        end = min(off + len(zero), cap)
+        mv[off:end] = zero[:end - off]
     print(f"store prefault: {cap >> 20} MB in "
           f"{time.perf_counter() - t0:.1f}s")
 

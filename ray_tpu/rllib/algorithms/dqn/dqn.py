@@ -86,3 +86,16 @@ class DQN(Algorithm):
                          "buffer_size": len(self.buffer),
                          "epsilon": eps},
                 "num_env_steps_trained": batch.count}
+
+    def save_checkpoint(self) -> Dict:
+        # Exploration schedule must survive restore (epsilon derives from
+        # _iter); the replay buffer is deliberately not persisted — it
+        # refills within a few iterations.
+        data = super().save_checkpoint()
+        data["dqn_iter"] = self._iter
+        return data
+
+    def load_checkpoint(self, data) -> None:
+        super().load_checkpoint(data)
+        if data:
+            self._iter = data.get("dqn_iter", 0)

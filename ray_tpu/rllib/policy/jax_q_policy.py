@@ -91,8 +91,12 @@ class JaxQPolicy:
         return params, opt_state, {"total_loss": loss,
                                    "mean_td_error": td_err}
 
+    _TRAIN_KEYS = ("obs", "actions", "rewards", "dones", "new_obs")
+
     def learn_on_batch(self, batch) -> Dict[str, float]:
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        # Only the TD-loss inputs go to device; replay rows also carry
+        # GAE fields (shared rollout schema) the Q loss never reads.
+        jbatch = {k: jnp.asarray(batch[k]) for k in self._TRAIN_KEYS}
         self.params, self.opt_state, stats = self._train_step(
             self.params, self.target_params, self.opt_state, jbatch)
         return {k: float(v) for k, v in stats.items()}

@@ -1,0 +1,1 @@
+from ray_tpu.train.sklearn.sklearn_trainer import SklearnTrainer  # noqa: F401

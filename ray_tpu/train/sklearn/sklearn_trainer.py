@@ -1,0 +1,68 @@
+"""SklearnTrainer: fit a scikit-learn estimator as a supervised trial.
+
+Reference: python/ray/train/sklearn/sklearn_trainer.py — the estimator
+fits inside a worker (CPU-parallel via joblib n_jobs), metrics and the
+fitted model come back as a Result + Checkpoint.  Rides BaseTrainer ->
+Tune like every other trainer, so retries/experiment dirs are shared.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional
+
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.base_trainer import BaseTrainer
+
+MODEL_KEY = "estimator"
+
+
+class SklearnTrainer(BaseTrainer):
+    def __init__(self, *, estimator, datasets: Dict,
+                 label_column: Optional[str] = None,
+                 params: Optional[Dict] = None,
+                 scoring: Optional[Dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config)
+        self._estimator = estimator
+        self._datasets = datasets
+        self._label_column = label_column
+        self._params = params or {}
+        self._scoring = scoring or {}
+
+    def _xy(self, ds):
+        df = ds.to_pandas() if hasattr(ds, "to_pandas") else ds
+        if self._label_column is None:
+            return df, None
+        return (df.drop(columns=[self._label_column]),
+                df[self._label_column])
+
+    def training_loop(self) -> None:
+        est = self._estimator
+        if self._params:
+            est = est.set_params(**self._params)
+        x, y = self._xy(self._datasets["train"])
+        est.fit(x, y)
+        metrics: Dict[str, Any] = {}
+        for name, ds in self._datasets.items():
+            if name == "train":
+                continue
+            vx, vy = self._xy(ds)
+            metrics[f"{name}_score"] = float(est.score(vx, vy))
+        if self._scoring:
+            vx, vy = self._xy(self._datasets.get("valid",
+                                                 self._datasets["train"]))
+            for name, fn in self._scoring.items():
+                metrics[name] = float(fn(est, vx, vy))
+        if "train_score" not in metrics:
+            metrics["train_score"] = float(est.score(x, y))
+        session.report(metrics, checkpoint=Checkpoint.from_dict(
+            {MODEL_KEY: pickle.dumps(est)}))
+
+    @staticmethod
+    def get_model(checkpoint: Checkpoint):
+        return pickle.loads(checkpoint.to_dict()[MODEL_KEY])

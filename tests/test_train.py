@@ -137,3 +137,25 @@ def test_torch_trainer_ddp_gloo(ray_init):
     result = trainer.fit()
     assert result.metrics["world"] == 2
     assert result.metrics["loss"] < 5.0
+
+
+def test_sklearn_trainer(ray_init):
+    import pandas as pd
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data as rd
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({"a": rng.randn(200), "b": rng.randn(200)})
+    df["y"] = (df["a"] + df["b"] > 0).astype(int)
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(),
+        datasets={"train": rd.from_pandas(df.iloc[:150]),
+                  "valid": rd.from_pandas(df.iloc[150:])},
+        label_column="y",
+    )
+    result = trainer.fit()
+    assert result.metrics["valid_score"] > 0.9
+    model = SklearnTrainer.get_model(result.checkpoint)
+    assert model.predict(df[["a", "b"]].iloc[:5]).shape == (5,)
