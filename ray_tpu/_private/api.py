@@ -138,8 +138,9 @@ def remote(*args, **kwargs):
             return ActorClass(target, **opts)
         return RemoteFunction(target, **opts)
 
-    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0])
-                                          or inspect.isclass(args[0])):
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        # Any callable works bare: python/builtin functions, classes,
+        # functools.partial, callables with __call__.
         return _make(args[0], {})
     if args:
         raise TypeError("@remote takes keyword options only, e.g. "
