@@ -1071,6 +1071,8 @@ class Raylet:
         period = cfg.heartbeat_period_ms / 1000.0
         report_period = cfg.resource_report_period_ms / 1000.0
         last_beat = 0.0
+        last_report = None
+        last_full = 0.0
         while not self._shutdown:
             await asyncio.sleep(report_period)
             now = time.monotonic()
@@ -1078,16 +1080,27 @@ class Raylet:
                 continue
             last_beat = now
             try:
-                reply = await self.gcs.request("heartbeat", {
-                    "node_id": self.node_id,
-                    "available": self.available,
-                    "load": self._load(),
+                body = {"node_id": self.node_id}
+                report = (dict(self.available), self._load(),
+                          [dict(p["resources"])
+                           for p in self.pending_leases[:32]])
+                # Versioned-sync economy (reference: ray_syncer.h:88 —
+                # only changed snapshots travel): unchanged resource
+                # state sends a liveness-only beat at the slow period;
+                # the full payload goes when something moved.
+                if report == last_report and now - last_full < period:
+                    continue  # nothing changed; skip this fast tick
+                body.update({
+                    "available": report[0],
+                    "load": report[1],
                     # Resource shapes of queued leases: the autoscaler's
-                    # demand signal (reference: ResourceLoad in the
-                    # raylet->GCS resource reports feeding LoadMetrics).
-                    "pending_shapes": [dict(p["resources"])
-                                       for p in self.pending_leases[:32]],
+                    # demand signal (reference: ResourceLoad feeding
+                    # LoadMetrics).
+                    "pending_shapes": report[2],
                 })
+                last_report = report
+                last_full = now
+                reply = await self.gcs.request("heartbeat", body)
                 if not reply.get("ok") and "unknown node" in \
                         reply.get("reason", ""):
                     # GCS restarted and lost the node table: re-register

@@ -40,6 +40,22 @@ logger = logging.getLogger(__name__)
 
 global_worker: "CoreWorker | None" = None
 
+# Distributed trace context, propagated inside task specs (reference:
+# util/tracing/tracing_helper.py — otel context rides the TaskSpec; here
+# the span tree lands in ray_tpu.timeline() chrome-trace args).
+import contextvars  # noqa: E402
+
+_TRACE: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_trace", default=None)  # (trace_id, span_id) | None
+
+
+def _trace_for_submit():
+    """Current (or fresh) trace context to stamp on an outgoing task."""
+    ctx = _TRACE.get()
+    if ctx is None:
+        return {"trace_id": os.urandom(8).hex(), "parent_id": None}
+    return {"trace_id": ctx[0], "parent_id": ctx[1]}
+
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
 
@@ -337,10 +353,28 @@ class CoreWorker:
         """Push metric snapshots + profile events to the GCS KV every few
         seconds (reference: the per-node metrics agent relay,
         _private/metrics_agent.py:63; consumed by the dashboard head and
-        ray_tpu.timeline())."""
+        ray_tpu.timeline()).  Also measures this process's event-loop lag
+        (reference: the instrumented asio event loop, event_stats.h) —
+        sustained lag means a handler is blocking the IO plane."""
         import pickle
+        lag_gauge = None
+        try:
+            from ray_tpu.util.metrics import Gauge
+            lag_gauge = Gauge(
+                "rt_event_loop_lag_ms",
+                "scheduling delay of the CoreWorker IO loop",
+                tag_keys=("mode",))
+        except Exception:
+            pass
         while not self._shutdown:
+            t0 = time.monotonic()
             await asyncio.sleep(2.0)
+            if lag_gauge is not None:
+                lag = max(0.0, (time.monotonic() - t0 - 2.0) * 1000)
+                try:
+                    lag_gauge.set(round(lag, 2), tags={"mode": self.mode})
+                except Exception:
+                    pass
             try:
                 from ray_tpu.util import metrics as metrics_mod
                 snaps = metrics_mod.registry_snapshot()
@@ -731,6 +765,7 @@ class CoreWorker:
                                     cfg.max_task_retries_default),
             "retry_exceptions": opts.get("retry_exceptions", False),
             "name": opts.get("name", ""),
+            "trace": _trace_for_submit(),
         }
         if opts.get("runtime_env"):
             spec["runtime_env"] = self._pack_runtime_env(
@@ -1122,6 +1157,7 @@ class CoreWorker:
         ctx.lease_id = lease_id
         t0 = time.time()
         restore_env = None
+        span = self._enter_span(spec.get("trace"))
         try:
             restore_env = self._apply_runtime_env(spec.get("runtime_env"))
             fn = self._load_function(spec["fn_id"])
@@ -1136,20 +1172,36 @@ class CoreWorker:
             self._record_profile_event(
                 "task", spec.get("name") or getattr(
                     self._fn_cache.get(spec["fn_id"]), "__name__", "task"),
-                t0)
+                t0, trace=span)
             ctx.task_id = None
             ctx.lease_id = None
 
-    def _record_profile_event(self, cat: str, name: str, t0: float):
+    @staticmethod
+    def _enter_span(trace):
+        """Adopt the submitter's trace context with a fresh span id so
+        tasks submitted from here link as children."""
+        if not trace:
+            return None
+        span = {"trace_id": trace["trace_id"],
+                "span_id": os.urandom(4).hex(),
+                "parent_id": trace.get("parent_id")}
+        _TRACE.set((span["trace_id"], span["span_id"]))
+        return span
+
+    def _record_profile_event(self, cat: str, name: str, t0: float,
+                              trace=None):
         """Chrome-trace complete event (reference: core worker profiling
         events, src/ray/core_worker/profiling.h; dumped by
-        ray_tpu.timeline())."""
-        self._profile_events.append({
+        ray_tpu.timeline()).  Trace args link spans across processes."""
+        event = {
             "cat": cat, "name": name, "ph": "X",
             "pid": os.getpid(),
             "tid": threading.get_ident() & 0xFFFF,
             "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6,
-        })
+        }
+        if trace:
+            event["args"] = trace
+        self._profile_events.append(event)
         if len(self._profile_events) > 10000:
             del self._profile_events[:5000]
 
@@ -1306,6 +1358,7 @@ class CoreWorker:
 
     def _execute_actor_method_sync(self, method, body, spec):
         t0 = time.time()
+        span = self._enter_span(body.get("trace"))
         try:
             args, kwargs = self._unpack_args(body["args"])
             result = method(*args, **kwargs)
@@ -1315,7 +1368,8 @@ class CoreWorker:
                 raise
             return {"error": _error_blob(e, traceback.format_exc())}
         finally:
-            self._record_profile_event("actor_task", body["method"], t0)
+            self._record_profile_event("actor_task", body["method"], t0,
+                                       trace=span)
 
     # --------------------------------------------------- actor-caller side
     def submit_actor_task(self, actor_id: ActorID, actor_addr, method: str,
@@ -1335,6 +1389,7 @@ class CoreWorker:
             "task_id": task_id,
             "method": method,
             "args": args_blob,
+            "trace": _trace_for_submit(),
             "num_returns": num_returns,
             "return_ids": [r.id for r in refs],
             "caller_id": self.worker_id.binary(),

@@ -81,6 +81,40 @@ class DashboardHead:
             from ray_tpu.experimental import state
             return _json(await _call(state.list_jobs))
 
+        @routes.put("/api/serve/applications")
+        async def serve_deploy(request):
+            """REST deploy (reference: serve REST schema / PUT
+            api/serve/applications): [{"import_path": "module:attr",
+            <deployment options...>}, ...]."""
+            import importlib
+            payload = await request.json()
+            apps = payload.get("deployments", payload.get(
+                "applications", []))
+
+            def _deploy_all():
+                from ray_tpu.serve.api import Deployment
+                deployed = []
+                for spec in apps:
+                    mod_name, _, attr = spec["import_path"].partition(":")
+                    target = getattr(importlib.import_module(mod_name),
+                                     attr)
+                    if not isinstance(target, Deployment):
+                        raise TypeError(
+                            f"{spec['import_path']} is not a Deployment")
+                    opts = {k: v for k, v in spec.items()
+                            if k != "import_path"}
+                    if opts:
+                        target = target.options(**opts)
+                    target.deploy()
+                    deployed.append(target.name)
+                return deployed
+
+            try:
+                deployed = await _call(_deploy_all)
+            except Exception as e:
+                return web.json_response({"error": repr(e)}, status=400)
+            return _json({"deployed": deployed})
+
         @routes.get("/api/serve")
         async def serve_status(request):
             try:

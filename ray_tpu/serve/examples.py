@@ -1,0 +1,10 @@
+"""Importable example deployments (used by REST-deploy tests/docs)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="rest_echo")
+def rest_echo(req):
+    if hasattr(req, "query"):
+        return {"echo": req.query.get("msg", "")}
+    return {"echo": req}

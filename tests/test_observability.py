@@ -191,3 +191,30 @@ def test_cli_surface(ray_init, capsys):
     cli.main(["summary", "objects"])
     out = capsys.readouterr().out
     assert "total_objects" in out
+
+
+def test_trace_context_links_nested_tasks(ray_init):
+    @ray_tpu.remote
+    def child():
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote(), timeout=60)
+
+    assert ray_tpu.get(parent.remote(), timeout=120) == 1
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        events = ray_tpu.timeline()
+        by_name = {}
+        for e in events:
+            if e.get("args", {}).get("trace_id"):
+                by_name.setdefault(e["name"], []).append(e["args"])
+        if "parent" in by_name and "child" in by_name:
+            break
+        time.sleep(0.5)
+    p = by_name["parent"][0]
+    c = by_name["child"][0]
+    # Same trace; the child's parent span is the parent task's span.
+    assert c["trace_id"] == p["trace_id"]
+    assert c["parent_id"] == p["span_id"]

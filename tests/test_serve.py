@@ -179,3 +179,26 @@ def test_model_composition_child_deployments(serve_instance):
     assert handle.remote(20).result(timeout=60) == 41
     st = {s["name"] for s in serve.status()}
     assert {"preprocess", "ingress"} <= st
+
+
+def test_rest_deploy_via_dashboard(serve_instance):
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard
+
+    addr = start_dashboard()
+    base = f"http://{addr['host']}:{addr['port']}"
+    r = requests.put(f"{base}/api/serve/applications", json={
+        "deployments": [{
+            "import_path": "ray_tpu.serve.examples:rest_echo",
+            "num_replicas": 1,
+        }]}, timeout=120)
+    assert r.status_code == 200, r.text
+    assert r.json()["deployed"] == ["rest_echo"]
+    h = serve.get_deployment_handle("rest_echo")
+    assert h.remote("ping").result(timeout=60) == {"echo": "ping"}
+    # Bad import path is a 400, not a hang.
+    r = requests.put(f"{base}/api/serve/applications", json={
+        "deployments": [{"import_path": "nosuch.module:thing"}]},
+        timeout=60)
+    assert r.status_code == 400
