@@ -1,0 +1,3 @@
+from ray_tpu.train.huggingface.transformers_trainer import (  # noqa: F401
+    TransformersTrainer,
+)

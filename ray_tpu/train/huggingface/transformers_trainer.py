@@ -1,0 +1,54 @@
+"""TransformersTrainer: run a HuggingFace Trainer per worker rank.
+
+Reference: python/ray/train/huggingface/huggingface_trainer.py — the
+user's trainer_init_per_worker builds a transformers.Trainer inside each
+rank; the gang's torch process group (gloo here, TorchBackend) makes HF/
+accelerate data-parallel; metrics from the HF log history reach the
+Result through session.report.  On this framework the TPU fine-tuning
+path is JaxTrainer + models/gpt; this trainer covers existing HF/torch
+codebases on CPU workers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train.torch.torch_trainer import TorchConfig, TorchTrainer
+
+
+class TransformersTrainer(TorchTrainer):
+    def __init__(self, trainer_init_per_worker: Callable, *,
+                 trainer_init_config: Optional[Dict] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 **kwargs):
+        def train_loop(config: Dict):
+            import os
+            import tempfile
+
+            import torch.distributed as dist
+            # transformers/accelerate discover the gang via env.
+            if dist.is_initialized():
+                os.environ.setdefault("RANK", str(dist.get_rank()))
+                os.environ.setdefault("WORLD_SIZE",
+                                      str(dist.get_world_size()))
+                os.environ.setdefault("LOCAL_RANK",
+                                      str(dist.get_rank()))
+            hf_trainer = trainer_init_per_worker(config)
+            result = hf_trainer.train()
+            metrics = dict(result.metrics or {})
+            for row in reversed(hf_trainer.state.log_history):
+                if "loss" in row:
+                    metrics.setdefault("loss", row["loss"])
+                    break
+            ckpt = None
+            if session.get_world_rank() == 0:
+                out = tempfile.mkdtemp(prefix="hf_ckpt_")
+                hf_trainer.save_model(out)
+                ckpt = Checkpoint.from_directory(out)
+            session.report(metrics, checkpoint=ckpt)
+
+        super().__init__(train_loop,
+                         train_loop_config=trainer_init_config or {},
+                         torch_config=torch_config, **kwargs)

@@ -159,3 +159,40 @@ def test_sklearn_trainer(ray_init):
     assert result.metrics["valid_score"] > 0.9
     model = SklearnTrainer.get_model(result.checkpoint)
     assert model.predict(df[["a", "b"]].iloc[:5]).shape == (5,)
+
+
+def _hf_trainer_init(config):
+    import torch
+    from transformers import (GPT2Config, GPT2LMHeadModel, Trainer,
+                              TrainingArguments)
+
+    model = GPT2LMHeadModel(GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2))
+
+    class Toy(torch.utils.data.Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            ids = torch.randint(0, 64, (16,))
+            return {"input_ids": ids, "labels": ids}
+
+    args = TrainingArguments(
+        output_dir=config["output_dir"], num_train_epochs=1,
+        per_device_train_batch_size=8, report_to=[], logging_steps=1,
+        use_cpu=True, save_strategy="no", disable_tqdm=True)
+    return Trainer(model=model, args=args, train_dataset=Toy())
+
+
+def test_transformers_trainer(ray_init, tmp_path):
+    from ray_tpu.train.huggingface import TransformersTrainer
+
+    trainer = TransformersTrainer(
+        _hf_trainer_init,
+        trainer_init_config={"output_dir": str(tmp_path / "hf")},
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    result = trainer.fit()
+    assert result.metrics.get("train_loss") is not None or \
+        result.metrics.get("loss") is not None
+    assert result.checkpoint is not None
