@@ -25,7 +25,6 @@ class TransformersTrainer(TorchTrainer):
                  **kwargs):
         def train_loop(config: Dict):
             import os
-            import tempfile
 
             import torch.distributed as dist
             # transformers/accelerate discover the gang via env.
@@ -36,6 +35,15 @@ class TransformersTrainer(TorchTrainer):
                 os.environ.setdefault("LOCAL_RANK",
                                       str(dist.get_rank()))
             hf_trainer = trainer_init_per_worker(config)
+            # Weights-level resume on restarts (optimizer state is not
+            # carried — documented divergence from HF's own
+            # resume_from_checkpoint, which needs its internal
+            # checkpoint-dir layout).
+            restored = session.get_checkpoint()
+            if restored is not None:
+                state = restored.to_dict().get("model_state")
+                if state:
+                    hf_trainer.model.load_state_dict(state)
             result = hf_trainer.train()
             metrics = dict(result.metrics or {})
             for row in reversed(hf_trainer.state.log_history):
@@ -44,9 +52,12 @@ class TransformersTrainer(TorchTrainer):
                     break
             ckpt = None
             if session.get_world_rank() == 0:
-                out = tempfile.mkdtemp(prefix="hf_ckpt_")
-                hf_trainer.save_model(out)
-                ckpt = Checkpoint.from_directory(out)
+                # state_dict into a dict checkpoint: round-trips through
+                # Tune save/restore (directory checkpoints don't) and
+                # leaves nothing on /tmp.
+                ckpt = Checkpoint.from_dict({"model_state": {
+                    k: v.detach().cpu()
+                    for k, v in hf_trainer.model.state_dict().items()}})
             session.report(metrics, checkpoint=ckpt)
 
         super().__init__(train_loop,

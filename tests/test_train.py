@@ -195,4 +195,5 @@ def test_transformers_trainer(ray_init, tmp_path):
     result = trainer.fit()
     assert result.metrics.get("train_loss") is not None or \
         result.metrics.get("loss") is not None
-    assert result.checkpoint is not None
+    state = result.checkpoint.to_dict()["model_state"]
+    assert any(k.endswith("wte.weight") for k in state)
