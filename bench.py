@@ -120,6 +120,8 @@ REFERENCE_FLOORS = {
     "multi_client_tasks_async": 33374.0,
     "put_gigabytes": 19.5,
     "get_gigabytes": 19.5,
+    "actor_launch_per_s": 321.7,
+    "placement_group_per_s": 15.4,
 }
 
 

@@ -62,6 +62,8 @@ class GPTConfig:
     # Pallas flash attention for long sequences (TPU only; falls back to
     # the einsum reference off-TPU or on non-tiling shapes).
     use_flash: bool = True
+    # False = bidirectional attention (encoder models, e.g. models/vit).
+    causal: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -176,6 +178,9 @@ def _attention(x, p, cfg, active, sizes):
     q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     scale = cfg.head_dim ** -0.5
     if "sp" in active:
+        if not cfg.causal:
+            raise NotImplementedError(
+                "sequence-parallel (sp) attention is causal-only")
         out = _ring_attention_sharded(q, kk, v, "sp", causal=True,
                                       scale=scale)
     else:
@@ -186,13 +191,14 @@ def _attention(x, p, cfg, active, sizes):
             # Below ~2k XLA's fused einsum attention wins (measured on
             # v5e: 52% vs 50% MFU at 1024); flash pays off where the
             # O(S^2) score tensor stops fitting the fusion budget.
-            if t >= 2048 and fa.supports(t, cfg.head_dim):
+            if cfg.causal and t >= 2048 and fa.supports(t, cfg.head_dim):
                 # [b,t,h,k] -> [b,h,t,k] for the kernel and back.
                 out = fa.flash_attention(
                     q.transpose(0, 2, 1, 3), kk.transpose(0, 2, 1, 3),
                     v.transpose(0, 2, 1, 3), scale).transpose(0, 2, 1, 3)
         if out is None:
-            out = reference_attention(q, kk, v, causal=True, scale=scale)
+            out = reference_attention(q, kk, v, causal=cfg.causal,
+                                      scale=scale)
     wo = _all_gather(p["wo"], "fsdp", 2, active).astype(dt)
     y = jnp.einsum("bthk,hkd->btd", out, wo)
     return _psum(y, ("tp",), active)

@@ -1,0 +1,167 @@
+"""Vision Transformer: second model family on the same mesh machinery.
+
+No reference counterpart (the reference ships no in-tree models); this
+exists to show the parallelism substrate generalizes beyond the decoder:
+the encoder reuses gpt's block stack (bidirectional attention via
+GPTConfig(causal=False)) with the same dp/fsdp/tp/pp shardings, so ViT
+training scales with the identical mesh recipe as the flagship GPT.
+
+Layout: images [B, H, W, C] -> patches [B, N, P*P*C] -> transformer ->
+mean-pooled classification head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import gpt
+from ray_tpu.models.gpt import BATCH_AXES, _rmsnorm, _shard_map
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 1024
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        assert self.image_size % self.patch_size == 0
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    def gpt_cfg(self) -> gpt.GPTConfig:
+        """The encoder core, expressed as a bidirectional GPT stack."""
+        return gpt.GPTConfig(
+            vocab_size=8, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff,
+            max_seq=self.num_patches, dtype=self.dtype,
+            remat=self.remat, causal=False, use_flash=False)
+
+
+def init_params(cfg: ViTConfig, key) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    core = gpt.init_params(cfg.gpt_cfg(), k1)
+    s = 0.02
+    return {
+        "patch_embed": (s * jax.random.normal(
+            k2, (cfg.patch_dim, cfg.d_model))).astype(jnp.float32),
+        "pos": (s * jax.random.normal(
+            k3, (cfg.num_patches, cfg.d_model))).astype(jnp.float32),
+        "blocks": core["blocks"],
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "head": (s * jax.random.normal(
+            k4, (cfg.d_model, cfg.num_classes))).astype(jnp.float32),
+    }
+
+
+def param_specs(cfg: ViTConfig) -> dict:
+    core = gpt.param_specs(cfg.gpt_cfg())
+    return {
+        "patch_embed": P(None, None),
+        "pos": P(None, None),
+        "blocks": core["blocks"],
+        "ln_f": P(None),
+        "head": P(None, "tp"),
+    }
+
+
+def _patchify(images, cfg: ViTConfig):
+    """[B, H, W, C] -> [B, N, P*P*C]."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def forward(params: dict, images, cfg: ViTConfig, mesh=None):
+    """images [B, H, W, C] float -> logits [B, num_classes] (fp32)."""
+    gcfg = cfg.gpt_cfg()
+    x = _patchify(images.astype(jnp.float32), cfg)
+    x = (x @ params["patch_embed"] + params["pos"]).astype(cfg.dtype)
+
+    if mesh is None:
+        x = gpt._blocks_body(params["blocks"], x, gcfg, frozenset(), {})
+    else:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        # Size-1 axes contribute nothing (their collectives are no-ops)
+        # and sp/ep size-1 must not trip the causal-only/expert guards.
+        active = frozenset(n for n in mesh.axis_names if sizes[n] > 1)
+        x_spec = P(BATCH_AXES, None, None)
+        x = lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec))
+        body = functools.partial(gpt._blocks_body, cfg=gcfg,
+                                 active=active, sizes=sizes)
+        x = _shard_map(body, mesh,
+                       (gpt._block_in_specs(gcfg), x_spec),
+                       x_spec)(params["blocks"], x)
+
+    x = _rmsnorm(x, params["ln_f"]).astype(jnp.float32)
+    pooled = x.mean(axis=1)
+    logits = pooled @ params["head"].astype(jnp.float32)
+    if mesh is not None:
+        logits = lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P(BATCH_AXES, "tp")))
+    return logits
+
+
+def loss_fn(params, images, labels, cfg: ViTConfig, mesh=None):
+    import optax
+    logits = forward(params, images, cfg, mesh)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def make_train_state(cfg: ViTConfig, key, mesh=None, optimizer=None,
+                     learning_rate: float = 1e-3):
+    import optax
+    optimizer = optimizer or optax.adamw(learning_rate)
+    params = init_params(cfg, key)
+    if mesh is not None:
+        specs = param_specs(cfg)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, specs)
+    opt_state = optimizer.init(params)
+    return ({"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}, optimizer)
+
+
+def train_step(state, images, labels, cfg: ViTConfig, mesh=None,
+               optimizer=None):
+    import optax
+    optimizer = optimizer or optax.adamw(1e-3)
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(p, images, labels, cfg, mesh))(state["params"])
+    updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                        state["params"])
+    return ({"params": optax.apply_updates(state["params"], updates),
+             "opt_state": new_opt, "step": state["step"] + 1},
+            {"loss": loss})
+
+
+def make_train_step(cfg: ViTConfig, mesh=None, optimizer=None,
+                    learning_rate: float = 1e-3, donate: bool = True):
+    import optax
+    optimizer = optimizer or optax.adamw(learning_rate)
+    fn = functools.partial(train_step, cfg=cfg, mesh=mesh,
+                           optimizer=optimizer)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
