@@ -12,7 +12,8 @@ from ray_tpu.data.dataset import (  # noqa: F401
 )
 from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
-    from_arrow, from_items, from_numpy, from_pandas, range, range_tensor,
+    from_arrow, from_huggingface, from_items, from_numpy, from_pandas,
+    range, range_tensor,
     read_binary_files, read_csv, read_json, read_numpy, read_parquet,
     read_text,
 )

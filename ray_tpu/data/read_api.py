@@ -131,3 +131,14 @@ def read_binary_files(paths, **kw) -> Dataset:
         with open(f, "rb") as fh:
             return [{"path": f, "bytes": fh.read()}]
     return _read_files(paths, _read)
+
+
+def from_huggingface(dataset, parallelism: int = DEFAULT_PARALLELISM
+                     ) -> Dataset:
+    """A HuggingFace datasets.Dataset -> blocks (reference:
+    read_api.from_huggingface)."""
+    import builtins
+    df = dataset.to_pandas()
+    n = max(1, min(parallelism, len(df) or 1))
+    return from_pandas([df.iloc[i::n].reset_index(drop=True)
+                        for i in builtins.range(n)])

@@ -47,12 +47,14 @@ class ServeController:
 
     # ------------------------------------------------------------ RPCs
     async def deploy(self, name: str, config_dict: Dict,
-                     replica_config: ReplicaConfig, version: str) -> bool:
+                     replica_config: ReplicaConfig, version: str,
+                     route_prefix: str = None) -> bool:
         config = DeploymentConfig.from_dict(config_dict)
 
         def _do():
             with self._dsm_lock:
-                self._dsm.deploy(name, config, replica_config, version)
+                self._dsm.deploy(name, config, replica_config, version,
+                                 route_prefix=route_prefix)
 
         await asyncio.get_running_loop().run_in_executor(None, _do)
         return True

@@ -301,11 +301,13 @@ class DeploymentStateManager:
         self._deployments: Dict[str, DeploymentState] = {}
 
     def deploy(self, name: str, config: DeploymentConfig,
-               replica_config: ReplicaConfig, version: str):
+               replica_config: ReplicaConfig, version: str,
+               route_prefix: str = None):
         ds = self._deployments.get(name)
         if ds is None:
             ds = self._deployments[name] = DeploymentState(
                 name, self._long_poll)
+        ds.route_prefix = route_prefix or f"/{name}"
         ds.deploy(config, replica_config, version)
         self._broadcast_routes()
 
@@ -316,8 +318,11 @@ class DeploymentStateManager:
         self._broadcast_routes()
 
     def _broadcast_routes(self):
+        # Route table: URL prefix -> deployment (reference: the proxy's
+        # route_prefix matching).
         self._long_poll.notify_changed(
-            "routes", {name: name for name, ds in self._deployments.items()
+            "routes", {getattr(ds, "route_prefix", f"/{name}"): name
+                       for name, ds in self._deployments.items()
                        if not ds.deleting})
 
     def update(self) -> bool:

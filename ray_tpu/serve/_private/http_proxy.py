@@ -47,17 +47,22 @@ class HTTPProxy:
 
     async def handle(self, method: str, path: str, query: Dict[str, str],
                      body: bytes, headers: Dict[str, str]):
-        """Resolve /<deployment>/rest to a replica call."""
-        parts = [p for p in path.split("/") if p]
-        if not parts:
+        """Longest-route_prefix match -> replica call (reference:
+        http_proxy.py route matching)."""
+        if path in ("", "/"):
             return 200, _json.dumps(
                 {"routes": sorted(self.routes)}).encode(), "application/json"
-        name = parts[0]
-        if name not in self.routes:
-            return 404, f"no deployment {name!r}".encode(), "text/plain"
-        deployment = self.routes[name]
+        match = None
+        for prefix in self.routes:
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                if match is None or len(prefix) > len(match):
+                    match = prefix
+        if match is None:
+            return 404, f"no route for {path!r}".encode(), "text/plain"
+        deployment = self.routes[match]
         rs = self._replica_sets[deployment]
-        req = Request(method=method, path="/" + "/".join(parts[1:]),
+        rest = path[len(match.rstrip("/")):] or "/"
+        req = Request(method=method, path=rest,
                       query=query, body=body, headers=headers)
         try:
             result = await rs.assign_replica("", (req,), {})

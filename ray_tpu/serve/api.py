@@ -78,9 +78,11 @@ class Deployment:
                  config: DeploymentConfig, init_args: tuple = (),
                  init_kwargs: Optional[Dict] = None,
                  ray_actor_options: Optional[Dict] = None,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 route_prefix: Optional[str] = None):
         self._body = body
         self.name = name
+        self.route_prefix = route_prefix
         self.config = config
         self.init_args = init_args
         self.init_kwargs = init_kwargs or {}
@@ -91,7 +93,8 @@ class Deployment:
         new = Deployment(self._body, kwargs.pop("name", self.name),
                          DeploymentConfig.from_dict(self.config.to_dict()),
                          self.init_args, dict(self.init_kwargs),
-                         dict(self.ray_actor_options), self.version)
+                         dict(self.ray_actor_options), self.version,
+                         kwargs.pop("route_prefix", self.route_prefix))
         for k in ("num_replicas", "max_concurrent_queries", "user_config",
                   "graceful_shutdown_timeout_s", "health_check_period_s",
                   "health_check_timeout_s"):
@@ -150,7 +153,8 @@ class Deployment:
             init_args=init_args, init_kwargs=init_kwargs,
             ray_actor_options=self.ray_actor_options)
         ray_tpu.get(controller.deploy.remote(
-            self.name, self.config.to_dict(), rc, version), timeout=60)
+            self.name, self.config.to_dict(), rc, version,
+            self.route_prefix or f"/{self.name}"), timeout=60)
         if _blocking:
             ok = ray_tpu.get(controller.wait_deployments_healthy.remote(
                 [self.name]), timeout=180)
@@ -173,6 +177,7 @@ def deployment(_body=None, *, name: Optional[str] = None,
                                                   AutoscalingConfig]] = None,
                ray_actor_options: Optional[Dict] = None,
                version: Optional[str] = None,
+               route_prefix: Optional[str] = None,
                graceful_shutdown_timeout_s: float = 10.0,
                health_check_period_s: float = 5.0):
     """@serve.deployment decorator (reference: serve/api.py deployment)."""
@@ -191,7 +196,7 @@ def deployment(_body=None, *, name: Optional[str] = None,
                 else AutoscalingConfig(**autoscaling_config))
         return Deployment(body, name or body.__name__, cfg,
                           ray_actor_options=ray_actor_options,
-                          version=version)
+                          version=version, route_prefix=route_prefix)
 
     if _body is not None:
         return _wrap(_body)

@@ -154,3 +154,13 @@ def test_preprocessors(ray_init):
     chain = Chain(StandardScaler(["a"]), MinMaxScaler(["a"]))
     out = chain.fit(ds).transform(ds).to_pandas()
     assert out["a"].min() == 0.0 and out["a"].max() == 1.0
+
+
+def test_from_huggingface(ray_init):
+    import datasets as hf
+
+    hfds = hf.Dataset.from_dict({"x": list(range(12)),
+                                 "y": ["a"] * 6 + ["b"] * 6})
+    ds = rd.from_huggingface(hfds, parallelism=3)
+    assert ds.count() == 12
+    assert sorted(ds.to_pandas()["x"]) == list(range(12))

@@ -202,3 +202,20 @@ def test_rest_deploy_via_dashboard(serve_instance):
         "deployments": [{"import_path": "nosuch.module:thing"}]},
         timeout=60)
     assert r.status_code == 400
+
+
+def test_route_prefix(serve_instance):
+    import requests
+
+    @serve.deployment(name="prefixed", route_prefix="/api/v2/echo")
+    def prefixed(req):
+        return {"path": req.path}
+
+    serve.run(prefixed, _start_proxy=True)
+    addr = serve.get_proxy_address()
+    base = f"http://{addr['host']}:{addr['port']}"
+    r = requests.get(f"{base}/api/v2/echo/sub/path", timeout=30)
+    assert r.status_code == 200
+    assert r.json() == {"path": "/sub/path"}
+    assert requests.get(f"{base}/api/v2/other", timeout=30
+                        ).status_code == 404
