@@ -37,3 +37,43 @@ def test_tasks_survive_node_kill_mid_pipeline(ray_start_cluster):
         killer.stop()
     assert outs == [float(i) for i in range(12)]
     assert killer.killed, "chaos harness never killed a node"
+
+
+def test_serve_replicas_replaced_after_node_death(ray_start_cluster):
+    from ray_tpu import serve
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    spot = cluster.add_node(num_cpus=1, resources={"spot": 1})
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+    serve.start()
+    try:
+        @serve.deployment(
+            name="pinned", num_replicas=1,
+            ray_actor_options={"resources": {"spot": 0.5},
+                               "num_cpus": 0.1})
+        def pinned(x):
+            return x * 3
+
+        handle = pinned.deploy()
+        assert handle.remote(2).result(timeout=60) == 6
+
+        # Kill the node hosting the replica; offer a replacement.
+        cluster.remove_node(spot)
+        cluster.add_node(num_cpus=1, resources={"spot": 1})
+
+        # The controller's health check replaces the dead replica and the
+        # router learns the new one via long poll.
+        import time
+        deadline = time.time() + 120
+        out = None
+        while time.time() < deadline:
+            try:
+                out = handle.remote(5).result(timeout=20)
+                break
+            except Exception:
+                time.sleep(1)
+        assert out == 15, "serve never recovered from replica-node death"
+    finally:
+        serve.shutdown()
