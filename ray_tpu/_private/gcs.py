@@ -570,7 +570,12 @@ class GcsServer:
         else:
             candidates = [n for n in self.nodes.values() if n.alive]
         if strategy and strategy.get("type") == "node_affinity":
-            node = self.nodes.get(strategy["node_id"])
+            nid = strategy["node_id"]
+            node = self.nodes.get(nid)
+            if node is None and isinstance(nid, str):
+                # Callers commonly pass the hex form from ray_tpu.nodes().
+                node = next((n for k, n in self.nodes.items()
+                             if k.hex() == nid), None)
             if node and node.alive and self._fits(node, resources):
                 return node
             if not strategy.get("soft", False):

@@ -12,6 +12,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     deployment,
     get_deployment_handle,
     get_proxy_address,
+    get_proxy_addresses,
     run,
     shutdown,
     start,
@@ -30,5 +31,5 @@ __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "HTTPOptions", "RayServeHandle", "Request",
     "batch", "delete", "deployment", "get_deployment_handle",
-    "get_proxy_address", "run", "shutdown", "start", "status",
+    "get_proxy_address", "get_proxy_addresses", "run", "shutdown", "start", "status",
 ]

@@ -50,24 +50,55 @@ def _get_or_create_controller():
     return controller
 
 
-def _ensure_http_proxy(controller, http_options: Dict) -> Dict:
-    """Start the ingress actor if not yet running; returns {host, port}."""
-    global _http_proxy_info
-    if _http_proxy_info is not None:
-        return _http_proxy_info
+_http_proxy_addrs: List[Dict] = []
+
+
+def _start_one_proxy(name: str, http_options: Dict, strategy=None) -> Dict:
     from ray_tpu.serve._private.http_proxy import HTTPProxyActor
-    name = "SERVE_PROXY"
     try:
         proxy = ray_tpu.get_actor(name)
     except Exception:
         cls = ray_tpu.remote(HTTPProxyActor)
-        proxy = cls.options(name=name, lifetime="detached", num_cpus=0.1,
-                            max_concurrency=1000).remote(
+        opts = dict(name=name, lifetime="detached", num_cpus=0.1,
+                    max_concurrency=1000)
+        if strategy is not None:
+            opts["scheduling_strategy"] = strategy
+        proxy = cls.options(**opts).remote(
             http_options.get("host", "127.0.0.1"),
             http_options.get("port", 0), CONTROLLER_NAME)
         proxy.run.options(num_returns=0).remote()
-    _http_proxy_info = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    return ray_tpu.get(proxy.ready.remote(), timeout=60)
+
+
+def _ensure_http_proxy(controller, http_options: Dict) -> Dict:
+    """Start ingress: one proxy by default, or one per node with
+    location="EveryNode" (reference: per-node HTTPProxyActors managed by
+    http_state.py)."""
+    global _http_proxy_info, _http_proxy_addrs
+    if _http_proxy_info is not None:
+        return _http_proxy_info
+    if http_options.get("location") == "EveryNode":
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        addrs = []
+        for node in ray_tpu.nodes():
+            if not node.get("Alive", True):
+                continue
+            nid = node["NodeID"]
+            addrs.append(_start_one_proxy(
+                f"SERVE_PROXY::{nid[:8]}", http_options,
+                NodeAffinitySchedulingStrategy(node_id=nid)))
+        _http_proxy_addrs = addrs
+        _http_proxy_info = addrs[0]
+        return _http_proxy_info
+    _http_proxy_info = _start_one_proxy("SERVE_PROXY", http_options)
+    _http_proxy_addrs = [_http_proxy_info]
     return _http_proxy_info
+
+
+def get_proxy_addresses() -> List[Dict]:
+    """All ingress endpoints (one per node with location=EveryNode)."""
+    return list(_http_proxy_addrs)
 
 
 class Deployment:
@@ -243,7 +274,7 @@ def delete(name: str, _blocking: bool = True):
 
 def shutdown():
     """Tear the Serve instance down (controller + proxy + replicas)."""
-    global _http_proxy_info
+    global _http_proxy_info, _http_proxy_addrs
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
@@ -253,13 +284,20 @@ def shutdown():
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=60)
     except Exception:
         pass
+    proxy_names = ["SERVE_PROXY"]
     try:
-        proxy = ray_tpu.get_actor("SERVE_PROXY")
-        ray_tpu.kill(proxy)
+        proxy_names += [f"SERVE_PROXY::{n['NodeID'][:8]}"
+                        for n in ray_tpu.nodes()]
     except Exception:
         pass
+    for name in proxy_names:
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(name))
+        except Exception:
+            pass
     try:
         ray_tpu.kill(controller)
     except Exception:
         pass
     _http_proxy_info = None
+    _http_proxy_addrs = []

@@ -219,3 +219,30 @@ def test_route_prefix(serve_instance):
     assert r.json() == {"path": "/sub/path"}
     assert requests.get(f"{base}/api/v2/other", timeout=30
                         ).status_code == 404
+
+
+def test_proxy_per_node(ray_start_cluster):
+    import requests
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    cluster.add_node(num_cpus=2, resources={"other": 1})
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+    serve.start(_start_proxy=True,
+                http_options={"location": "EveryNode"})
+    try:
+        @serve.deployment(name="everywhere")
+        def everywhere(req):
+            return "pong"
+
+        everywhere.deploy()
+        addrs = serve.get_proxy_addresses()
+        assert len(addrs) == 2, addrs
+        for addr in addrs:
+            r = requests.get(
+                f"http://{addr['host']}:{addr['port']}/everywhere",
+                timeout=60)
+            assert r.status_code == 200 and r.text == "pong"
+    finally:
+        serve.shutdown()
