@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 
 from ray_tpu._private.api import (  # noqa: F401
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -34,6 +35,7 @@ from ray_tpu import exceptions  # noqa: F401
 
 from ray_tpu.exceptions import (  # noqa: F401
     ActorDiedError,
+    TaskCancelledError,
     ActorError,
     GetTimeoutError,
     ObjectLostError,
@@ -43,6 +45,7 @@ from ray_tpu.exceptions import (  # noqa: F401
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "cancel",
     "kill", "get_actor", "nodes", "cluster_resources",
     "available_resources", "get_runtime_context", "ObjectRef", "method",
     "exceptions", "__version__",

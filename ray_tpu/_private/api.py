@@ -173,6 +173,12 @@ def wait(refs, *, num_returns=1, timeout=None, fetch_local=True):
                           fetch_local=fetch_local)
 
 
+def cancel(ref, *, force: bool = False) -> bool:
+    """Cancel a task (reference: ray.cancel worker.py): True if the task
+    was stopped (dequeued, or its worker killed with force=True)."""
+    return _worker().cancel_task(ref, force=force)
+
+
 def kill(actor, *, no_restart=True):
     from ray_tpu.actor import ActorHandle
     if not isinstance(actor, ActorHandle):

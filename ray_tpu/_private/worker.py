@@ -181,6 +181,8 @@ class CoreWorker:
         self._lineage: dict[TaskID, dict] = {}
         self._lineage_pins: dict[TaskID, list] = {}
         self._recovering: dict[TaskID, asyncio.Future] = {}
+        # task_id -> (lease, spec) while pushed to a worker (for cancel)
+        self._inflight_tasks: dict[TaskID, tuple] = {}
         # submission state
         self.lease_pools: dict[tuple, LeasePool] = {}
         self._worker_conns: dict[tuple, protocol.Connection] = {}
@@ -788,6 +790,39 @@ class CoreWorker:
         self._call(self._submit(spec))
         return refs
 
+    def cancel_task(self, ref, force: bool = False) -> bool:
+        """Cancel the task that produces `ref` (reference: ray.cancel,
+        core_worker CancelTask): queued tasks are dequeued and their
+        returns error with TaskCancelledError; running tasks are killed
+        only with force=True (their worker is torn down)."""
+        entry = self.owned.get(ref.id)
+        spec = entry.submitted_task if entry is not None else None
+        if spec is None:
+            raise ValueError(
+                "ray_tpu.cancel only applies to task returns "
+                "(puts and completed-and-released tasks cannot be "
+                "cancelled)")
+        return self._run(self._cancel(spec, force))
+
+    async def _cancel(self, spec, force: bool) -> bool:
+        task_id = spec["task_id"]
+        for pool in self.lease_pools.values():
+            if spec in pool.queue:
+                pool.queue.remove(spec)
+                self._complete_with_error(spec, rexc.TaskCancelledError(
+                    f"task {task_id.hex()[:8]} cancelled before start"))
+                return True
+        inflight = self._inflight_tasks.get(task_id)
+        if inflight is not None:
+            lease, ispec = inflight
+            ispec["cancelled"] = True
+            if force:
+                key = self._scheduling_key(ispec)
+                self._drop_lease(key, lease)
+                return True
+            return False
+        return False
+
     def _pack_runtime_env(self, runtime_env):
         from ray_tpu import runtime_env as renv
 
@@ -1037,12 +1072,18 @@ class CoreWorker:
     async def _push_on_lease(self, key, lease, spec):
         pool = self.lease_pools[key]
         lease["busy"] = True
+        self._inflight_tasks[spec["task_id"]] = (lease, spec)
         try:
             reply = await lease["conn"].request("push_task", {
                 "spec": spec, "lease_id": lease["lease_id"]}, timeout=None)
             self._record_results(spec, reply)
         except Exception as e:
             self._drop_lease(key, lease)
+            if spec.get("cancelled"):
+                self._complete_with_error(spec, rexc.TaskCancelledError(
+                    f"task {spec['task_id'].hex()[:8]} cancelled"))
+                self._pump(key)
+                return
             retries = spec.get("max_retries", 0)
             if retries != 0 and _is_system_error(e):
                 spec["max_retries"] = retries - 1 if retries > 0 else retries
@@ -1053,6 +1094,8 @@ class CoreWorker:
                 self._complete_with_error(spec, e)
             self._pump(key)
             return
+        finally:
+            self._inflight_tasks.pop(spec["task_id"], None)
         lease["busy"] = False
         if pool.queue:
             pool.idle.append(lease)

@@ -72,6 +72,11 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled with ray_tpu.cancel (reference:
+    ray.exceptions.TaskCancelledError)."""
+
+
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id_hex: str, cause: str = ""):
         super().__init__(f"Object {object_id_hex} lost: {cause}")

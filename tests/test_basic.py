@@ -1,5 +1,7 @@
 """Core task/object API tests (reference model: python/ray/tests/test_basic.py)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -115,3 +117,37 @@ def test_get_timeout(ray_start_regular):
 def test_cluster_resources(ray_start_regular):
     res = ray_tpu.cluster_resources()
     assert res.get("CPU", 0) >= 4
+
+
+def test_cancel_queued_and_running_tasks(ray_start_regular, tmp_path):
+    import time
+
+    started = str(tmp_path / "started")
+
+    @ray_tpu.remote(num_cpus=4, max_retries=0)
+    def hog(marker):
+        open(marker, "w").write("x")
+        time.sleep(30)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=4, max_retries=0)
+    def queued():
+        return "ran"
+
+    running = hog.remote(started)
+    deadline = time.time() + 60
+    while not os.path.exists(started):  # wait until actually executing
+        assert time.time() < deadline, "hog never started"
+        time.sleep(0.2)
+    waiting = queued.remote()  # queued: all CPUs held by hog
+    # Cancel the queued task: it never starts.
+    assert ray_tpu.cancel(waiting)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(waiting, timeout=30)
+    # Non-forced cancel of a running task is a no-op (returns False)...
+    assert ray_tpu.cancel(running) is False
+    # ...force kills its worker and errors the ref quickly.
+    assert ray_tpu.cancel(running, force=True)
+    with pytest.raises(Exception) as exc_info:
+        ray_tpu.get(running, timeout=30)
+    assert isinstance(exc_info.value, ray_tpu.TaskCancelledError)
