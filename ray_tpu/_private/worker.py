@@ -799,25 +799,32 @@ class CoreWorker:
         spec = entry.submitted_task if entry is not None else None
         if spec is None:
             raise ValueError(
-                "ray_tpu.cancel only applies to task returns "
-                "(puts and completed-and-released tasks cannot be "
-                "cancelled)")
+                "ray_tpu.cancel only applies to normal-task returns: "
+                "puts have no task, completed-and-released tasks are "
+                "gone, and actor-task cancellation is not supported "
+                "(kill the actor instead)")
         return self._run(self._cancel(spec, force))
 
     async def _cancel(self, spec, force: bool) -> bool:
         task_id = spec["task_id"]
-        for pool in self.lease_pools.values():
-            if spec in pool.queue:
-                pool.queue.remove(spec)
-                self._complete_with_error(spec, rexc.TaskCancelledError(
-                    f"task {task_id.hex()[:8]} cancelled before start"))
-                return True
+        key = self._scheduling_key(spec)
+        pool = self.lease_pools.get(key)
+        if pool is not None and any(s is spec for s in pool.queue):
+            pool.queue[:] = [s for s in pool.queue if s is not spec]
+            self._complete_with_error(spec, rexc.TaskCancelledError(
+                f"task {task_id.hex()[:8]} cancelled before start"))
+            # Re-pump: with the queue drained this cancels the stale
+            # outstanding lease request, or a granted lease would park
+            # in pool.idle forever holding its worker's resources.
+            self._pump(key)
+            return True
         inflight = self._inflight_tasks.get(task_id)
         if inflight is not None:
             lease, ispec = inflight
-            ispec["cancelled"] = True
             if force:
-                key = self._scheduling_key(ispec)
+                # Mark ONLY when actually stopping: a no-op cancel must
+                # not poison later legitimate retries/reconstruction.
+                ispec["cancelled"] = True
                 self._drop_lease(key, lease)
                 return True
             return False
@@ -1078,12 +1085,13 @@ class CoreWorker:
                 "spec": spec, "lease_id": lease["lease_id"]}, timeout=None)
             self._record_results(spec, reply)
         except Exception as e:
-            self._drop_lease(key, lease)
             if spec.get("cancelled"):
+                # _cancel already dropped this lease; don't double-kill.
                 self._complete_with_error(spec, rexc.TaskCancelledError(
                     f"task {spec['task_id'].hex()[:8]} cancelled"))
                 self._pump(key)
                 return
+            self._drop_lease(key, lease)
             retries = spec.get("max_retries", 0)
             if retries != 0 and _is_system_error(e):
                 spec["max_retries"] = retries - 1 if retries > 0 else retries

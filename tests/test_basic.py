@@ -151,3 +151,6 @@ def test_cancel_queued_and_running_tasks(ray_start_regular, tmp_path):
     with pytest.raises(Exception) as exc_info:
         ray_tpu.get(running, timeout=30)
     assert isinstance(exc_info.value, ray_tpu.TaskCancelledError)
+    # No leaked leases: a fresh full-width task still schedules (the
+    # cancelled queued task's stale lease request was re-pumped away).
+    assert ray_tpu.get(queued.remote(), timeout=60) == "ran"
