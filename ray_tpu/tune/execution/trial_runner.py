@@ -77,8 +77,9 @@ class _TrialActor:
 class Trial:
     def __init__(self, trainable_name: str, config: Dict,
                  pg_factory: PlacementGroupFactory, trial_dir: str,
-                 stopping: Optional[Dict] = None):
-        self.trial_id = uuid.uuid4().hex[:8]
+                 stopping: Optional[Dict] = None,
+                 trial_id: Optional[str] = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
         self.name = f"{trainable_name}_{self.trial_id}"
         self.config = config
         self.pg_factory = pg_factory
@@ -190,13 +191,17 @@ class TrialRunner:
 
     # ---------------------------------------------------------------- setup
     def _make_trial(self) -> Optional[Trial]:
-        cfg = self.search_alg.suggest(uuid.uuid4().hex[:8])
+        # The id handed to the searcher IS the trial's id, so BO-style
+        # searchers can pair on_trial_complete results with their
+        # suggestions (reference: search/searcher.py contract).
+        tid = uuid.uuid4().hex[:8]
+        cfg = self.search_alg.suggest(tid)
         if cfg is None:
             return None
         pgf = self.pg_factory or resource_dict_to_pg_factory(
             cfg.pop("__resources__", None) if isinstance(cfg, dict) else None)
         trial = Trial(self.trainable_name, cfg, pgf, self.experiment_dir,
-                      stopping=self._stopping)
+                      stopping=self._stopping, trial_id=tid)
         trial.trial_dir = os.path.join(self.experiment_dir, trial.name)
         os.makedirs(trial.trial_dir, exist_ok=True)
         self.trials.append(trial)
@@ -205,17 +210,22 @@ class TrialRunner:
 
     def _start_trial(self, trial: Trial, restore: bool = False,
                      defer_ping: bool = False):
-        pg = trial.pg_factory.create(name=f"pg_{trial.trial_id}")
-        ok = ray_tpu.wait_placement_group_ready(pg, timeout=120)
+        if trial.pg is None:
+            trial.pg = trial.pg_factory.create(name=f"pg_{trial.trial_id}")
+        ok = ray_tpu.wait_placement_group_ready(trial.pg, timeout=120)
         if not ok:
             raise RuntimeError(f"placement group for {trial.name} not ready")
-        trial.pg = pg
+        self._launch_trial(trial, restore=restore, defer_ping=defer_ping)
+
+    def _launch_trial(self, trial: Trial, restore: bool = False,
+                      defer_ping: bool = False):
+        """Create the trial actor inside its (ready) placement group."""
         head = trial.pg_factory.head_bundle
         actor_cls = ray_tpu.remote(_TrialActor)
         trial.actor = actor_cls.options(
             num_cpus=head.get("CPU", 0),
             resources={k: v for k, v in head.items() if k != "CPU"},
-            placement_group=pg, placement_group_bundle_index=0,
+            placement_group=trial.pg, placement_group_bundle_index=0,
         ).remote(self.trainable_cls, trial.config, trial.trial_id,
                  trial.name, trial.trial_dir)
         # Block until the actor is live: concurrently-started trials must
@@ -265,8 +275,13 @@ class TrialRunner:
             self._start_restored_trials()
             self._fill_trials()
             running = [t for t in self.trials if t.status == RUNNING]
-            if not running and self._exhausted:
-                break
+            if not running:
+                if self._exhausted and not self._staged():
+                    break
+                # Staged trials are waiting for reservations to land;
+                # don't spin hot while nothing is training.
+                time.sleep(0.2)
+                continue
             # Submit one train() per running trial without an outstanding
             # future.
             for t in running:
@@ -302,23 +317,57 @@ class TrialRunner:
                 trial.error = e
                 trial.status = ERROR
 
+    def _staged(self) -> List[Trial]:
+        return [t for t in self.trials
+                if t.status == PENDING and t.pg is not None
+                and t.actor is None]
+
     def _fill_trials(self):
-        started: List[Trial] = []
+        # Stage new trials — create their placement groups WITHOUT
+        # blocking on readiness, so more trials than free resources never
+        # stalls the result loop (reference: RayTrialExecutor stages PGs
+        # via _pg_manager and promotes trials as reservations land).
         while not self._exhausted and \
-                sum(t.status == RUNNING for t in self.trials) \
-                < self.max_concurrent:
+                sum(t.status == RUNNING or (t.status == PENDING
+                                            and t.pg is not None)
+                    for t in self.trials) < self.max_concurrent:
             trial = self._make_trial()
             if trial is None:
                 self._exhausted = True
                 break
+            trial.pg = trial.pg_factory.create(
+                name=f"pg_{trial.trial_id}")
+            trial.staged_at = time.monotonic()
+        # Promote every staged trial whose 2-phase reservation is done.
+        started: List[Trial] = []
+        any_running = any(t.status == RUNNING for t in self.trials)
+        for trial in self._staged():
+            if not ray_tpu.wait_placement_group_ready(trial.pg,
+                                                      timeout=0.05):
+                if any_running:
+                    # Queued behind live trials — restart the idle clock
+                    # so only time with the cluster otherwise idle counts
+                    # toward infeasibility.
+                    trial.staged_at = time.monotonic()
+                elif time.monotonic() - getattr(trial, "staged_at", 0) \
+                        > 300:
+                    # Overdemand guard: the reservation cannot land even
+                    # with the cluster idle — the trial is infeasible.
+                    self._stop_trial(trial, ERROR)
+                    trial.error = RuntimeError(
+                        f"placement group for {trial.name} cannot be "
+                        f"scheduled")
+                    if self.failure_config.fail_fast:
+                        raise trial.error
+                continue
             try:
                 # Create all actors first (spawns overlap), await liveness
                 # below so N cold-starts cost one spawn latency, not N.
-                self._start_trial(trial, defer_ping=True)
+                self._launch_trial(trial, defer_ping=True)
                 started.append(trial)
             except Exception as e:
+                self._stop_trial(trial, ERROR)
                 trial.error = e
-                trial.status = ERROR
                 if self.failure_config.fail_fast:
                     raise
         for trial in started:
@@ -383,9 +432,11 @@ class TrialRunner:
             try:
                 self._start_trial(trial, restore=True)
                 trial.error = None
+                return  # restarted: the searcher will hear the real end
             except Exception as e:
                 trial.error = e
         elif self.failure_config.fail_fast:
+            self.search_alg.on_trial_complete(trial.trial_id, error=True)
             raise err
         self.search_alg.on_trial_complete(trial.trial_id, error=True)
 

@@ -6,3 +6,4 @@ from ray_tpu.tune.search.sample import (  # noqa: F401
 from ray_tpu.tune.search.basic_variant import (  # noqa: F401
     BasicVariantGenerator, Searcher,
 )
+from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401

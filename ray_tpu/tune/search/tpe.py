@@ -1,0 +1,236 @@
+"""TPE searcher: tree-structured Parzen estimator suggestion.
+
+Reference: python/ray/tune/search/hyperopt (HyperOptSearch wraps
+hyperopt's TPE); the external dependency is not available here, so the
+algorithm itself is implemented natively (Bergstra et al. 2011): split
+completed trials into good/bad by the gamma quantile, model each with a
+Parzen window (KDE over floats / count smoothing over categoricals), and
+suggest the candidate maximizing the density ratio l(x)/g(x).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search.basic_variant import Searcher, _set_path
+from ray_tpu.tune.search.sample import (
+    Categorical,
+    Domain,
+    Float,
+    Integer,
+    Quantized,
+)
+
+
+def _flatten_domains(space: Dict, prefix=()) -> List[Tuple[tuple, Domain]]:
+    out = []
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, Domain):
+            out.append((path, v))
+        elif isinstance(v, dict):
+            if set(v.keys()) == {"grid_search"}:
+                raise ValueError("TPESearcher does not support "
+                                 "grid_search markers; use Domains")
+            out.extend(_flatten_domains(v, path))
+    return out
+
+
+def _get_path(cfg: Dict, path: tuple):
+    for k in path:
+        cfg = cfg[k]
+    return cfg
+
+
+class _FloatTPE:
+    """1-D Parzen estimator over a (possibly log) float domain."""
+
+    def __init__(self, lower, upper, log: bool, integer: bool = False,
+                 q: float | None = None):
+        self.log = log
+        self.integer = integer
+        self.q = q
+        self.lo = math.log(lower) if log else lower
+        self.hi = math.log(upper) if log else upper
+
+    def _to_internal(self, v):
+        return math.log(v) if self.log else float(v)
+
+    def _to_value(self, x):
+        v = math.exp(x) if self.log else x
+        if self.q:
+            v = round(v / self.q) * self.q
+        if self.integer:
+            v = int(round(v))
+        return v
+
+    def _kde(self, obs: np.ndarray):
+        # Bandwidth: range-scaled Scott-style floor keeps the estimator
+        # exploratory when observations cluster.
+        width = self.hi - self.lo
+        if len(obs) < 2:
+            bw = width
+        else:
+            bw = max(np.std(obs) * len(obs) ** -0.2, width / 20.0)
+        return obs, max(bw, 1e-12)
+
+    def sample_from(self, obs: np.ndarray, rng: random.Random):
+        centers, bw = self._kde(obs)
+        c = centers[rng.randrange(len(centers))]
+        x = rng.gauss(c, bw)
+        return min(max(x, self.lo), self.hi)
+
+    def logpdf(self, x: float, obs: np.ndarray) -> float:
+        centers, bw = self._kde(obs)
+        z = (x - centers) / bw
+        comps = -0.5 * z * z - math.log(bw * math.sqrt(2 * math.pi))
+        m = float(np.max(comps))
+        return m + math.log(float(np.mean(np.exp(comps - m))) + 1e-300)
+
+
+class _CatTPE:
+    def __init__(self, categories: List):
+        self.categories = categories
+
+    def _counts(self, obs: List) -> np.ndarray:
+        counts = np.ones(len(self.categories))  # +1 smoothing
+        index = {self._key(c): i
+                 for i, c in enumerate(self.categories)}
+        for o in obs:
+            counts[index[self._key(o)]] += 1
+        return counts / counts.sum()
+
+    @staticmethod
+    def _key(v):
+        return repr(v)
+
+    def sample_from(self, obs: List, rng: random.Random):
+        p = self._counts(obs)
+        r = rng.random()
+        return self.categories[int(np.searchsorted(np.cumsum(p), r))]
+
+    def logpdf(self, v, obs: List) -> float:
+        p = self._counts(obs)
+        idx = [self._key(c) for c in self.categories].index(self._key(v))
+        return math.log(p[idx])
+
+
+def _make_estimator(domain: Domain):
+    if isinstance(domain, Quantized):
+        inner = domain.inner
+        if isinstance(inner, Float):
+            return _FloatTPE(inner.lower, inner.upper, inner.log,
+                             q=domain.q)
+        if isinstance(inner, Integer):
+            return _FloatTPE(inner.lower, inner.upper - 1, False,
+                             integer=True, q=domain.q)
+        raise ValueError(f"unsupported quantized domain {inner!r}")
+    if isinstance(domain, Float):
+        return _FloatTPE(domain.lower, domain.upper, domain.log)
+    if isinstance(domain, Integer):
+        return _FloatTPE(domain.lower, max(domain.upper - 1,
+                                           domain.lower), False,
+                         integer=True)
+    if isinstance(domain, Categorical):
+        return _CatTPE(domain.categories)
+    raise ValueError(f"unsupported domain for TPE: {domain!r}")
+
+
+class TPESearcher(Searcher):
+    def __init__(self, param_space: Dict, metric: str,
+                 mode: str = "min", num_samples: int = 64,
+                 n_startup: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self._space = param_space
+        self._domains = _flatten_domains(param_space)
+        self._estimators = {path: _make_estimator(d)
+                            for path, d in self._domains}
+        self.metric, self.mode = metric, mode
+        self._budget = num_samples
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._suggested: Dict[str, Dict] = {}
+        self._history: List[Tuple[Dict, float]] = []
+
+    @property
+    def total_trials(self) -> int:
+        return self._budget
+
+    # ----------------------------------------------------------- suggest
+    def _random_config(self) -> Dict:
+        cfg: Dict = {}
+        for path, domain in self._domains:
+            _set_path(cfg, path, domain.sample(self._rng))
+        # Carry through non-domain constants.
+        self._fill_constants(cfg, self._space, ())
+        return cfg
+
+    def _fill_constants(self, cfg, space, prefix):
+        for k, v in space.items():
+            path = prefix + (k,)
+            if isinstance(v, Domain):
+                continue
+            if isinstance(v, dict):
+                self._fill_constants(cfg, v, path)
+            else:
+                _set_path(cfg, path, v)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._budget <= 0:
+            return None
+        self._budget -= 1
+        if len(self._history) < self.n_startup:
+            cfg = self._random_config()
+            self._suggested[trial_id] = cfg
+            return cfg
+
+        scores = np.array([s for _, s in self._history])
+        if self.mode == "max":
+            scores = -scores
+        n_good = max(1, int(math.ceil(self.gamma * len(scores))))
+        order = np.argsort(scores)
+        good = [self._history[i][0] for i in order[:n_good]]
+        bad = [self._history[i][0] for i in order[n_good:]] or good
+
+        cfg: Dict = {}
+        for path, domain in self._domains:
+            est = self._estimators[path]
+            if isinstance(est, _FloatTPE):
+                g_obs = np.array([est._to_internal(_get_path(c, path))
+                                  for c in good])
+                b_obs = np.array([est._to_internal(_get_path(c, path))
+                                  for c in bad])
+                cands = [est.sample_from(g_obs, self._rng)
+                         for _ in range(self.n_candidates)]
+                ratios = [est.logpdf(x, g_obs) - est.logpdf(x, b_obs)
+                          for x in cands]
+                best = cands[int(np.argmax(ratios))]
+                _set_path(cfg, path, est._to_value(best))
+            else:
+                g_obs = [_get_path(c, path) for c in good]
+                b_obs = [_get_path(c, path) for c in bad]
+                cands = [est.sample_from(g_obs, self._rng)
+                         for _ in range(self.n_candidates)]
+                ratios = [est.logpdf(x, g_obs) - est.logpdf(x, b_obs)
+                          for x in cands]
+                _set_path(cfg, path, cands[int(np.argmax(ratios))])
+        self._fill_constants(cfg, self._space, ())
+        self._suggested[trial_id] = cfg
+        return cfg
+
+    # ----------------------------------------------------------- results
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or error or not result \
+                or self.metric not in result:
+            return
+        self._history.append((cfg, float(result[self.metric])))

@@ -1,0 +1,85 @@
+"""Native TPE searcher: unit convergence + Tuner integration
+(reference: tune/tests/test_searchers.py over search/hyperopt)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.search import choice, loguniform, uniform
+from ray_tpu.tune.search.tpe import TPESearcher
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _objective_value(cfg):
+    penalty = 0.0 if cfg["kind"] == "good" else 0.5
+    return (cfg["x"] - 0.7) ** 2 + penalty
+
+
+def test_tpe_concentrates_on_optimum():
+    space = {"x": uniform(0.0, 1.0),
+             "kind": choice(["good", "bad"]),
+             "const": 3}
+    searcher = TPESearcher(space, metric="loss", mode="min",
+                           num_samples=60, n_startup=10, seed=0)
+    history = []
+    for i in range(60):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert cfg is not None and cfg["const"] == 3
+        loss = _objective_value(cfg)
+        searcher.on_trial_complete(tid, {"loss": loss})
+        history.append((cfg, loss))
+    assert searcher.suggest("overflow") is None  # budget exhausted
+
+    best = min(h[1] for h in history)
+    assert best < 0.02, f"TPE best loss {best}"
+    # The model phase should concentrate near x=0.7 / kind=good compared
+    # to the random startup phase.
+    startup = [c["x"] for c, _ in history[:10]]
+    model = [c["x"] for c, _ in history[-20:]]
+    assert abs(np.mean(model) - 0.7) < abs(np.mean(startup) - 0.7) + 0.05
+    model_kinds = [c["kind"] for c, _ in history[-20:]]
+    assert model_kinds.count("good") >= 12
+
+
+def test_tpe_log_domain_and_max_mode():
+    space = {"lr": loguniform(1e-5, 1e-1)}
+    searcher = TPESearcher(space, metric="score", mode="max",
+                           num_samples=40, n_startup=8, seed=1)
+    for i in range(40):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        # Peak score at lr = 1e-3.
+        score = -abs(np.log10(cfg["lr"]) + 3)
+        searcher.on_trial_complete(tid, {"score": score})
+    tail = [searcher._history[i][0]["lr"] for i in range(-10, 0)]
+    geo = 10 ** np.mean(np.log10(tail))
+    assert 1e-4 < geo < 1e-2, f"TPE geo-mean lr {geo}"
+
+
+def test_tpe_drives_tuner(ray_init):
+    def objective(config):
+        from ray_tpu.air import session
+        session.report(
+            {"loss": (config["x"] - 0.25) ** 2, "done": True})
+
+    space = {"x": uniform(0.0, 1.0)}
+    results = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(
+            metric="loss", mode="min",
+            search_alg=TPESearcher(space, metric="loss", mode="min",
+                                   num_samples=12, n_startup=4,
+                                   seed=2)),
+    ).fit()
+    assert len(results) == 12
+    best = results.get_best_result()
+    assert best.metrics["loss"] < 0.05
