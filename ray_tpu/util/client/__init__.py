@@ -1,0 +1,20 @@
+"""Out-of-cluster client (the reference's "Ray Client", ray://).
+
+Reference: python/ray/util/client + util/client/ARCHITECTURE.md — lets a
+process that is NOT part of the cluster drive it through a single proxy
+endpoint.  `connect()` returns a :class:`ClientAPI` mirroring the
+ray_tpu module verbs (put/get/wait/remote/kill/...).
+"""
+
+from ray_tpu.util.client.server import ClientServer  # noqa: F401
+from ray_tpu.util.client.worker import (  # noqa: F401
+    ClientAPI,
+    ClientActorHandle,
+    ClientObjectRef,
+)
+
+
+def connect(address: str, timeout: float = 30.0) -> ClientAPI:
+    """Connect to a running ClientServer at "host:port"."""
+    host, port = address.rsplit(":", 1)
+    return ClientAPI(host, int(port), timeout=timeout)

@@ -1,0 +1,48 @@
+"""Shared pickling machinery for the out-of-cluster client.
+
+Reference: python/ray/util/client (ray://) ships a pickled IR of calls to
+a proxy server inside the cluster (util/client/ARCHITECTURE.md).  The
+TPU-native build keeps the idea — client-side stubs, server-side real
+ObjectRefs/ActorHandles — but rides the framework's own length-prefixed
+RPC plane instead of gRPC, and maps stubs <-> real handles with pickle's
+persistent-id hook instead of a protobuf IR.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+import cloudpickle
+
+_PROTO = 5
+
+
+class _ClientPickler(cloudpickle.CloudPickler):
+    """cloudpickle that externalizes refs/handles via persistent_id."""
+
+    def __init__(self, file, persist_fn):
+        super().__init__(file, protocol=_PROTO)
+        self._persist_fn = persist_fn
+
+    def persistent_id(self, obj):
+        return self._persist_fn(obj)
+
+
+class _ClientUnpickler(pickle.Unpickler):
+    def __init__(self, file, load_fn):
+        super().__init__(file)
+        self._load_fn = load_fn
+
+    def persistent_load(self, pid):
+        return self._load_fn(pid)
+
+
+def dumps_with(obj, persist_fn) -> bytes:
+    buf = io.BytesIO()
+    _ClientPickler(buf, persist_fn).dump(obj)
+    return buf.getvalue()
+
+
+def loads_with(data: bytes, load_fn):
+    return _ClientUnpickler(io.BytesIO(data), load_fn).load()

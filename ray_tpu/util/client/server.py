@@ -1,0 +1,222 @@
+"""Client server: the in-cluster proxy that out-of-cluster clients drive.
+
+Reference: python/ray/util/client/server — a gRPC server inside the
+cluster that executes pickled client calls against a real driver and
+hands back ticket stubs.  Here: one RpcServer on the framework protocol
+plane; the hosting process is (or becomes) a real driver, and every
+client request is executed through the public API in a worker thread so
+the RPC loop never blocks on cluster waits.  Divergence from the
+reference (noted): all clients share the hosting driver's ownership
+context rather than getting an isolated per-client driver — lifetime of
+client-created objects is scoped to this server process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict
+
+import ray_tpu
+from ray_tpu._private import protocol
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.actor import ActorHandle
+from ray_tpu.util.client.common import dumps_with, loads_with
+
+
+class ClientServer:
+    """Serves out-of-cluster clients over the protocol plane."""
+
+    def __init__(self):
+        self._refs: Dict[str, ObjectRef] = {}
+        self._actors: Dict[str, ActorHandle] = {}
+        self._server: protocol.RpcServer | None = None
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------- ref/handle maps
+    def _persist(self, obj):
+        """Server->client: externalize real refs/handles as stub ids."""
+        if isinstance(obj, ObjectRef):
+            with self._lock:
+                self._refs.setdefault(obj.hex(), obj)
+            return ("ref", obj.hex())
+        if isinstance(obj, ActorHandle):
+            with self._lock:
+                self._actors.setdefault(obj._actor_id.hex(), obj)
+            return ("actor", obj._actor_id.hex(), obj._class_name)
+        return None
+
+    def _load(self, pid):
+        """Client->server: resolve stub ids back to real refs/handles."""
+        kind = pid[0]
+        if kind == "ref":
+            with self._lock:
+                ref = self._refs.get(pid[1])
+            if ref is None:
+                raise KeyError(f"client ref {pid[1]} unknown/released")
+            return ref
+        if kind == "actor":
+            with self._lock:
+                handle = self._actors.get(pid[1])
+            if handle is None:
+                raise KeyError(f"client actor {pid[1]} unknown")
+            return handle
+        raise ValueError(f"bad persistent id {pid!r}")
+
+    def _track(self, refs):
+        refs = refs if isinstance(refs, list) else [refs]
+        with self._lock:
+            for r in refs:
+                self._refs[r.hex()] = r
+        return [r.hex() for r in refs]
+
+    # --------------------------------------------------------- handlers
+    async def _handle(self, conn, method, body):
+        fn = getattr(self, "_rpc_" + method, None)
+        if fn is None:
+            raise ValueError(f"unknown client rpc {method}")
+        return await asyncio.to_thread(fn, body or {})
+
+    def _rpc_hello(self, body):
+        return {"ok": True, "protocol": 1}
+
+    def _rpc_put(self, body):
+        value = loads_with(body["blob"], self._load)
+        ref = ray_tpu.put(value)
+        return self._track(ref)[0]
+
+    def _rpc_get(self, body):
+        refs = [self._load(("ref", h)) for h in body["ids"]]
+        values = ray_tpu.get(refs, timeout=body.get("timeout"))
+        if not isinstance(values, list):
+            values = [values]
+        return [dumps_with(v, self._persist) for v in values]
+
+    def _rpc_wait(self, body):
+        refs = [self._load(("ref", h)) for h in body["ids"]]
+        ready, pending = ray_tpu.wait(
+            refs, num_returns=body.get("num_returns", 1),
+            timeout=body.get("timeout"),
+            fetch_local=body.get("fetch_local", True))
+        return ([r.hex() for r in ready], [r.hex() for r in pending])
+
+    def _rpc_task(self, body):
+        payload = loads_with(body["blob"], self._load)
+        fn, args, kwargs = payload
+        opts = body.get("opts") or {}
+        rf = ray_tpu.remote(fn)
+        out = rf.options(**opts).remote(*args, **kwargs) if opts \
+            else rf.remote(*args, **kwargs)
+        return self._track(out)
+
+    def _rpc_create_actor(self, body):
+        payload = loads_with(body["blob"], self._load)
+        cls, args, kwargs = payload
+        opts = body.get("opts") or {}
+        ac = ray_tpu.remote(cls)
+        handle = ac.options(**opts).remote(*args, **kwargs) if opts \
+            else ac.remote(*args, **kwargs)
+        with self._lock:
+            self._actors[handle._actor_id.hex()] = handle
+        return {"actor": handle._actor_id.hex(),
+                "class_name": handle._class_name,
+                "method_meta": handle._method_meta}
+
+    def _rpc_actor_call(self, body):
+        handle = self._load(("actor", body["actor"]))
+        payload = loads_with(body["blob"], self._load)
+        args, kwargs = payload
+        num_returns = body.get("num_returns", 1)
+        out = handle._invoke(body["method"], args, kwargs,
+                             num_returns, body.get("opts") or {})
+        return self._track(out)
+
+    def _rpc_get_actor(self, body):
+        handle = ray_tpu.get_actor(body["name"],
+                                   body.get("namespace", "default"))
+        with self._lock:
+            self._actors[handle._actor_id.hex()] = handle
+        return {"actor": handle._actor_id.hex(),
+                "class_name": handle._class_name,
+                "method_meta": handle._method_meta}
+
+    def _rpc_kill(self, body):
+        handle = self._load(("actor", body["actor"]))
+        ray_tpu.kill(handle, no_restart=body.get("no_restart", True))
+        with self._lock:
+            self._actors.pop(body["actor"], None)
+        return True
+
+    def _rpc_cancel(self, body):
+        ref = self._load(("ref", body["id"]))
+        return ray_tpu.cancel(ref, force=body.get("force", False))
+
+    def _rpc_release(self, body):
+        with self._lock:
+            for h in body["ids"]:
+                self._refs.pop(h, None)
+        return True
+
+    def _rpc_cluster_info(self, body):
+        kind = body.get("kind", "nodes")
+        if kind == "nodes":
+            return ray_tpu.nodes()
+        if kind == "cluster_resources":
+            return ray_tpu.cluster_resources()
+        if kind == "available_resources":
+            return ray_tpu.available_resources()
+        raise ValueError(kind)
+
+    # ---------------------------------------------------------- running
+    async def _start_async(self, host: str, port: int):
+        self._server = protocol.RpcServer(self._handle, host=host,
+                                          name="client-server")
+        await self._server.start(port)
+        return self._server.port
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start serving on the framework's background loop; returns the
+        bound port."""
+        from ray_tpu._private.api import _ensure_loop
+        loop = _ensure_loop()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._start_async(host, port), loop)
+        self.port = fut.result(30)
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            from ray_tpu._private.api import _ensure_loop
+            loop = _ensure_loop()
+            asyncio.run_coroutine_threadsafe(
+                self._server.stop(), loop).result(10)
+            self._server = None
+
+
+def main(argv=None):
+    """`python -m ray_tpu.util.client.server --address HOST:PORT
+    [--listen-port N]` — join the cluster as a driver and serve
+    clients."""
+    import argparse
+    import signal
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True,
+                   help="GCS address host:port of the cluster to join")
+    p.add_argument("--listen-host", default="0.0.0.0")
+    p.add_argument("--listen-port", type=int, default=10001)
+    args = p.parse_args(argv)
+    ray_tpu.init(address=args.address)
+    srv = ClientServer()
+    port = srv.start(args.listen_host, args.listen_port)
+    print(f"ray_tpu client server listening on "
+          f"{args.listen_host}:{port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,264 @@
+"""Client-side API: drive a cluster from outside it.
+
+Reference: python/ray/util/client/worker.py — a thin synchronous facade
+whose every verb becomes an RPC to the in-cluster proxy; ObjectRefs and
+ActorHandles exist client-side only as stubs.  Usage:
+
+    from ray_tpu.util import client
+    api = client.connect("head-host:10001")
+    ref = api.put(42)
+    api.get(ref)                      # -> 42
+    f = api.remote(lambda x: x + 1)
+    api.get(f.remote(1))              # -> 2
+    api.disconnect()
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, List
+
+from ray_tpu._private import protocol
+from ray_tpu.util.client.common import dumps_with, loads_with
+
+
+class ClientObjectRef:
+    __slots__ = ("id", "_api", "__weakref__")
+
+    def __init__(self, ref_id: str, api: "ClientAPI"):
+        self.id = ref_id
+        self._api = api
+        api._live_refs[ref_id] = api._live_refs.get(ref_id, 0) + 1
+
+    def hex(self) -> str:
+        return self.id
+
+    def __del__(self):
+        try:
+            self._api._release(self.id)
+        except Exception:
+            pass
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.id})"
+
+
+class ClientActorMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+        self._opts: Dict = {}
+
+    def options(self, **opts) -> "ClientActorMethod":
+        m = ClientActorMethod(self._handle, self._name)
+        m._opts = opts
+        return m
+
+    def remote(self, *args, **kwargs):
+        return self._handle._api._actor_call(
+            self._handle, self._name, args, kwargs, self._opts)
+
+
+class ClientActorHandle:
+    def __init__(self, actor_id: str, class_name: str, method_meta: Dict,
+                 api: "ClientAPI"):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_meta = method_meta or {}
+        self._api = api
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ClientActorHandle({self._class_name}, {self._actor_id[:16]})"
+
+
+class ClientRemoteFunction:
+    def __init__(self, fn, api: "ClientAPI", opts: Dict | None = None):
+        self._fn = fn
+        self._api = api
+        self._opts = opts or {}
+
+    def options(self, **opts) -> "ClientRemoteFunction":
+        return ClientRemoteFunction(self._fn, self._api,
+                                    {**self._opts, **opts})
+
+    def remote(self, *args, **kwargs):
+        return self._api._task(self._fn, args, kwargs, self._opts)
+
+
+class ClientRemoteClass:
+    def __init__(self, cls, api: "ClientAPI", opts: Dict | None = None):
+        self._cls = cls
+        self._api = api
+        self._opts = opts or {}
+
+    def options(self, **opts) -> "ClientRemoteClass":
+        return ClientRemoteClass(self._cls, self._api,
+                                 {**self._opts, **opts})
+
+    def remote(self, *args, **kwargs):
+        return self._api._create_actor(self._cls, args, kwargs,
+                                       self._opts)
+
+
+class ClientAPI:
+    """The connected client: mirrors the ray_tpu module verbs."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._live_refs: Dict[str, int] = {}
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        name="rt-client-io", daemon=True)
+        self._thread.start()
+        self._conn: protocol.Connection = self._call_async(
+            protocol.Connection.connect(host, port, handler=self._on_push,
+                                        name="client"), timeout)
+        self._req("hello")
+
+    # ------------------------------------------------------- plumbing
+    def _call_async(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def _req(self, method: str, body=None, timeout: float | None = 300.0):
+        return self._call_async(
+            self._conn.request(method, body, timeout=timeout),
+            None if timeout is None else timeout + 5)
+
+    async def _on_push(self, conn, method, body):
+        return None
+
+    def _persist(self, obj):
+        """Client->server: stubs travel as persistent ids."""
+        if isinstance(obj, ClientObjectRef):
+            return ("ref", obj.id)
+        if isinstance(obj, ClientActorHandle):
+            return ("actor", obj._actor_id)
+        return None
+
+    def _load(self, pid):
+        """Server->client: real refs/handles arrive as stub ids."""
+        if pid[0] == "ref":
+            return ClientObjectRef(pid[1], self)
+        if pid[0] == "actor":
+            return ClientActorHandle(pid[1], pid[2], {}, self)
+        raise ValueError(f"bad persistent id {pid!r}")
+
+    def _release(self, ref_id: str):
+        n = self._live_refs.get(ref_id, 0) - 1
+        if n > 0:
+            self._live_refs[ref_id] = n
+            return
+        self._live_refs.pop(ref_id, None)
+        if self._conn is not None and not self._conn.closed:
+            asyncio.run_coroutine_threadsafe(
+                self._conn.push("release", {"ids": [ref_id]}), self._loop)
+
+    # ------------------------------------------------------- public API
+    def put(self, value) -> ClientObjectRef:
+        blob = dumps_with(value, self._persist)
+        return ClientObjectRef(self._req("put", {"blob": blob}), self)
+
+    def get(self, refs, *, timeout: float | None = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        # timeout=None must block exactly as long as the server-side get
+        # does — no hidden RPC deadline.
+        blobs = self._req("get", {"ids": [r.id for r in refs],
+                                  "timeout": timeout},
+                          timeout=None if timeout is None
+                          else timeout + 30)
+        values = [loads_with(b, self._load) for b in blobs]
+        return values[0] if single else values
+
+    def wait(self, refs, *, num_returns: int = 1,
+             timeout: float | None = None, fetch_local: bool = True):
+        by_id = {r.id: r for r in refs}
+        ready, pending = self._req(
+            "wait", {"ids": [r.id for r in refs],
+                     "num_returns": num_returns, "timeout": timeout,
+                     "fetch_local": fetch_local},
+            timeout=None if timeout is None else timeout + 30)
+        return ([by_id[h] for h in ready], [by_id[h] for h in pending])
+
+    def remote(self, target=None, **opts):
+        """Decorator/wrapper parity with ray_tpu.remote."""
+        if target is None:
+            return lambda t: self.remote(t, **opts)
+        if isinstance(target, type):
+            return ClientRemoteClass(target, self, opts)
+        return ClientRemoteFunction(target, self, opts)
+
+    def _task(self, fn, args, kwargs, opts) -> ClientObjectRef:
+        blob = dumps_with((fn, args, kwargs), self._persist)
+        hexes = self._req("task", {"blob": blob, "opts": opts})
+        refs = [ClientObjectRef(h, self) for h in hexes]
+        return refs[0] if len(refs) == 1 else refs
+
+    def _create_actor(self, cls, args, kwargs, opts) -> ClientActorHandle:
+        blob = dumps_with((cls, args, kwargs), self._persist)
+        info = self._req("create_actor", {"blob": blob, "opts": opts})
+        return ClientActorHandle(info["actor"], info["class_name"],
+                                 info["method_meta"], self)
+
+    def _actor_call(self, handle, method, args, kwargs, opts):
+        blob = dumps_with((args, kwargs), self._persist)
+        num_returns = opts.get("num_returns", 1)
+        hexes = self._req("actor_call",
+                          {"actor": handle._actor_id, "method": method,
+                           "blob": blob, "opts": opts,
+                           "num_returns": num_returns})
+        refs = [ClientObjectRef(h, self) for h in hexes]
+        return refs[0] if num_returns == 1 else refs
+
+    def get_actor(self, name: str,
+                  namespace: str = "default") -> ClientActorHandle:
+        info = self._req("get_actor", {"name": name,
+                                       "namespace": namespace})
+        return ClientActorHandle(info["actor"], info["class_name"],
+                                 info["method_meta"], self)
+
+    def kill(self, handle: ClientActorHandle, *, no_restart: bool = True):
+        return self._req("kill", {"actor": handle._actor_id,
+                                  "no_restart": no_restart})
+
+    def cancel(self, ref: ClientObjectRef, *, force: bool = False):
+        return self._req("cancel", {"id": ref.id, "force": force})
+
+    def nodes(self) -> List[Dict]:
+        return self._req("cluster_info", {"kind": "nodes"})
+
+    def cluster_resources(self) -> Dict:
+        return self._req("cluster_info", {"kind": "cluster_resources"})
+
+    def available_resources(self) -> Dict:
+        return self._req("cluster_info",
+                         {"kind": "available_resources"})
+
+    def disconnect(self):
+        if self._conn is not None:
+            try:
+                self._call_async(self._conn.close(), 10)
+            except Exception:
+                pass
+            self._conn = None
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disconnect()

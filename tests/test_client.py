@@ -1,0 +1,74 @@
+"""Out-of-cluster client: a ClientAPI drives the cluster through the
+proxy server (reference: python/ray/tests/test_client.py over
+util/client)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import client as rt_client
+
+
+@pytest.fixture
+def client_api():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    server = rt_client.ClientServer()
+    port = server.start("127.0.0.1", 0)
+    api = rt_client.connect(f"127.0.0.1:{port}")
+    yield api
+    api.disconnect()
+    server.stop()
+    ray_tpu.shutdown()
+
+
+def test_client_put_get_roundtrip(client_api):
+    ref = client_api.put({"a": np.arange(5)})
+    out = client_api.get(ref)
+    np.testing.assert_array_equal(out["a"], np.arange(5))
+
+
+def test_client_task_and_nested_ref(client_api):
+    f = client_api.remote(lambda x, y: x + y)
+    base = client_api.put(10)
+    # A client-side stub ref resolves to the real object server-side.
+    ref = f.remote(base, 32)
+    assert client_api.get(ref) == 42
+
+
+def test_client_actor_lifecycle(client_api):
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    actor = client_api.remote(Counter).remote(100)
+    assert client_api.get(actor.add.remote(1)) == 101
+    assert client_api.get(actor.add.remote(2)) == 103
+    client_api.kill(actor)
+
+
+def test_client_named_actor_and_wait(client_api):
+    class Holder:
+        def val(self):
+            return "here"
+
+    client_api.remote(Holder).options(name="holder-x",
+                                      lifetime="detached").remote()
+    got = client_api.get_actor("holder-x")
+    assert client_api.get(got.val.remote()) == "here"
+
+    slow = client_api.remote(lambda: 1)
+    refs = [slow.remote() for _ in range(3)]
+    ready, pending = client_api.wait(refs, num_returns=3, timeout=60)
+    assert len(ready) == 3 and not pending
+    client_api.kill(got)
+
+
+def test_client_cluster_info(client_api):
+    nodes = client_api.nodes()
+    assert len(nodes) >= 1
+    total = client_api.cluster_resources()
+    assert total.get("CPU", 0) >= 2
