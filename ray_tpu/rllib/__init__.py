@@ -16,8 +16,23 @@ from ray_tpu.rllib.algorithms.ddppo.ddppo import (  # noqa: F401
     DDPPOConfig,
 )
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.a2c.a2c import A2C, A2CConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.appo.appo import (  # noqa: F401
+    APPO,
+    APPOConfig,
+)
+from ray_tpu.rllib.algorithms.es.es import ES, ESConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+)
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
-__all__ = ["Algorithm", "AlgorithmConfig", "DDPPO", "DDPPOConfig",
-           "DQN", "DQNConfig", "Impala", "ImpalaConfig", "PPO",
-           "PPOConfig", "SampleBatch"]
+__all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "Algorithm",
+           "AlgorithmConfig", "BC", "BCConfig", "DDPPO", "DDPPOConfig",
+           "DQN", "DQNConfig", "ES", "ESConfig", "Impala",
+           "ImpalaConfig", "MARWIL", "MARWILConfig", "PPO", "PPOConfig",
+           "SAC", "SACConfig", "SampleBatch"]

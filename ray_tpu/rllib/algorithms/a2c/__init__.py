@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.a2c.a2c import A2C, A2CConfig  # noqa: F401

@@ -1,0 +1,100 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference: rllib/algorithms/a2c/a2c.py (training_step = sync sample ->
+one SGD pass -> broadcast; A2C is A3C's synchronous form, see
+rllib/algorithms/a3c/a3c.py for the loss) — re-derived jax-first: the
+vanilla policy-gradient loss is one jitted value_and_grad step on the
+learner, rollouts ride the CPU actor gang.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import sample_batch as sb
+from ray_tpu.rllib.policy.jax_policy import JaxPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class A2CPolicy(JaxPolicy):
+    """Plain advantage actor-critic loss (no ratio clipping): the
+    on-policy gradient -logp(a|s) * A with a value-function head and
+    entropy bonus (reference: a3c loss in
+    rllib/algorithms/a3c/a3c_torch_policy.py)."""
+
+    def _loss(self, params, batch):
+        cfg = self.config
+        logits, value = self.model.apply(params, batch[sb.OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch[sb.ACTIONS]]
+        adv = batch[sb.ADVANTAGES]
+        pg_loss = -(logp * adv).mean()
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        vf_loss = ((value - batch[sb.VALUE_TARGETS]) ** 2).mean()
+        total = (pg_loss
+                 + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+                 - cfg.get("entropy_coeff", 0.01) * entropy.mean())
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy.mean()}
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(A2C)
+        self._config.update({
+            "lr": 1e-3,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "microbatch_size": 0,  # 0 = single pass over the full batch
+        })
+
+
+class A2C(Algorithm):
+    policy_cls = A2CPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return {"lr": 1e-3, "vf_loss_coeff": 0.5, "entropy_coeff": 0.01,
+                "microbatch_size": 0}
+
+    def training_step(self) -> Dict:
+        """Sync sample across the gang, one gradient pass, broadcast
+        (reference a2c.py training_step; microbatching optional)."""
+        cfg = self.algo_config
+        target = cfg["train_batch_size"]
+        per_worker = max(1, target
+                         // max(1, len(self.workers.remote_workers)))
+        batches = []
+        collected = 0
+        while collected < target:
+            refs = self.workers.sample_all(per_worker)
+            if not refs:
+                b = self.workers.local_worker.sample(per_worker)
+                batches.append(b)
+                collected += b.count
+                continue
+            for b in ray_tpu.get(refs, timeout=600):
+                batches.append(b)
+                collected += b.count
+        train_batch = SampleBatch.concat_samples(batches)
+        self._timesteps_total += train_batch.count
+
+        adv = train_batch[sb.ADVANTAGES]
+        train_batch[sb.ADVANTAGES] = (
+            (adv - adv.mean()) / max(adv.std(), 1e-6)).astype(np.float32)
+
+        policy = self.workers.local_worker.policy
+        mb = cfg["microbatch_size"] or train_batch.count
+        stats: Dict = {}
+        for minibatch in train_batch.minibatches(min(mb,
+                                                     train_batch.count)):
+            stats = policy.learn_on_batch(minibatch)
+
+        self.workers.sync_weights()
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": train_batch.count}

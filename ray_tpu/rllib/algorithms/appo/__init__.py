@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.appo.appo import (  # noqa: F401
+    APPO,
+    APPOConfig,
+)

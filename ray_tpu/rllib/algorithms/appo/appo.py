@@ -1,0 +1,36 @@
+"""APPO: asynchronous PPO — IMPALA's async architecture with PPO's
+clipped surrogate objective.
+
+Reference: rllib/algorithms/appo/appo.py (subclasses Impala, swaps the
+loss for the clipped surrogate + periodic target update; we scope to the
+clipped-surrogate form over slightly-stale rollouts).  The architecture
+(rollout workers streaming into a learner thread, weights broadcast on a
+cadence) is inherited from our Impala.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.rllib.algorithms.algorithm import AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala.impala import Impala
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(APPO)
+        self._config.update({
+            "loss": "ppo",          # clipped surrogate on async rollouts
+            "clip_param": 0.2,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "broadcast_interval": 1,
+            "min_steps_per_iteration": 1000,
+        })
+
+
+class APPO(Impala):
+    def _extra_defaults(self) -> Dict:
+        return {"loss": "ppo", "clip_param": 0.2, "vf_loss_coeff": 0.5,
+                "entropy_coeff": 0.01, "broadcast_interval": 1,
+                "min_steps_per_iteration": 1000}

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.es.es import ES, ESConfig  # noqa: F401

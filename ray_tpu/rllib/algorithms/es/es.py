@@ -1,0 +1,208 @@
+"""ES: evolution strategies (OpenAI-ES) — derivative-free policy search
+by sampling parameter perturbations and estimating the gradient from
+episode returns.
+
+Reference: rllib/algorithms/es/es.py (Worker actors evaluate mirrored
+noise pairs; the driver aggregates rank-normalized returns into a
+gradient step; shared noise table).  Re-designed for this runtime:
+evaluations are stateless remote *tasks* fanned out per iteration (the
+framework's cheap-task path replaces the reference's persistent noise
+workers), and the policy is a tiny numpy MLP — rollouts are pure CPU
+env-stepping where jax tracing would be overhead, so the hot loop stays
+numpy while the framework supplies the parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.tune.trainable import Trainable
+
+
+def _mlp_shapes(obs_dim: int, num_actions: int,
+                hiddens: Tuple[int, ...]) -> List[Tuple[int, int]]:
+    dims = (obs_dim,) + tuple(hiddens) + (num_actions,)
+    return [(dims[i], dims[i + 1]) for i in range(len(dims) - 1)]
+
+
+def _unflatten(flat: np.ndarray, shapes) -> List[Tuple[np.ndarray,
+                                                       np.ndarray]]:
+    layers, off = [], 0
+    for n_in, n_out in shapes:
+        w = flat[off:off + n_in * n_out].reshape(n_in, n_out)
+        off += n_in * n_out
+        b = flat[off:off + n_out]
+        off += n_out
+        layers.append((w, b))
+    return layers
+
+
+def _mlp_act(layers, obs: np.ndarray) -> int:
+    h = obs
+    for i, (w, b) in enumerate(layers):
+        h = h @ w + b
+        if i < len(layers) - 1:
+            h = np.tanh(h)
+    return int(np.argmax(h))
+
+
+def _episode_return(layers, env, max_steps: int,
+                    seed: int) -> Tuple[float, int]:
+    obs, _ = env.reset(seed=seed)
+    total = 0.0
+    steps = 0
+    for _ in range(max_steps):
+        obs, reward, terminated, truncated, _ = env.step(
+            _mlp_act(layers, obs))
+        total += float(reward)
+        steps += 1
+        if terminated or truncated:
+            break
+    return total, steps
+
+
+def _es_eval(flat_params: np.ndarray, noise_seed: int, sigma: float,
+             env_name: str, env_config: Dict, shapes,
+             episodes: int, max_steps: int) -> Tuple[int, float, float,
+                                                     int]:
+    """Evaluate one mirrored perturbation pair (+eps, -eps).
+
+    Runs as a remote task; the same noise is regenerated from the seed on
+    the driver (the reference's shared-noise-table trick without the
+    table: the seed IS the index)."""
+    import gymnasium as gym
+    rng = np.random.RandomState(noise_seed)
+    eps = rng.randn(flat_params.size).astype(np.float32)
+    env = gym.make(env_name, **(env_config or {}))
+    steps = 0
+    rets = []
+    for sign in (1.0, -1.0):
+        layers = _unflatten(flat_params + sign * sigma * eps, shapes)
+        r = 0.0
+        for ep in range(episodes):
+            ret, n = _episode_return(layers, env, max_steps,
+                                     seed=noise_seed * 1000 + ep)
+            r += ret
+            steps += n
+        rets.append(r / episodes)
+    env.close()
+    return noise_seed, rets[0], rets[1], steps
+
+
+class ESConfig:
+    def __init__(self):
+        self.algo_class = ES
+        self._config: Dict = {
+            "env": "CartPole-v1",
+            "env_config": {},
+            "pop_size": 16,          # mirrored pairs per iteration
+            "sigma": 0.05,
+            "lr": 0.03,
+            "episodes_per_eval": 1,
+            "max_episode_steps": 500,
+            "fcnet_hiddens": (32, 32),
+            "seed": 0,
+            "l2_coeff": 0.005,
+        }
+
+    def environment(self, env=None, env_config=None) -> "ESConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "ESConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "ESConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "ES":
+        return ES(config=self.to_dict())
+
+
+class ES(Trainable):
+    """Each train() = one ES generation: fan out pop_size mirrored
+    evaluations as tasks, rank-normalize returns, take one gradient
+    step (reference es.py _train)."""
+
+    def setup(self, config: Dict):
+        defaults = ESConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        import gymnasium as gym
+        env = gym.make(self.cfg["env"], **self.cfg["env_config"])
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+        self.shapes = _mlp_shapes(obs_dim, num_actions,
+                                  tuple(self.cfg["fcnet_hiddens"]))
+        n = sum(i * o + o for i, o in self.shapes)
+        rng = np.random.RandomState(self.cfg["seed"])
+        self.flat_params = (rng.randn(n) * 0.1).astype(np.float32)
+        self._eval_task = ray_tpu.remote(_es_eval)
+        self._next_seed = self.cfg["seed"] * 100_000 + 1
+        self._timesteps_total = 0
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        seeds = [self._next_seed + i for i in range(cfg["pop_size"])]
+        self._next_seed += cfg["pop_size"]
+        params_ref = ray_tpu.put(self.flat_params)
+        refs = [self._eval_task.remote(
+            params_ref, s, cfg["sigma"], cfg["env"], cfg["env_config"],
+            self.shapes, cfg["episodes_per_eval"],
+            cfg["max_episode_steps"]) for s in seeds]
+        results = ray_tpu.get(refs, timeout=600)
+
+        # Rank normalization over all 2*pop returns (es.py
+        # compute_centered_ranks).
+        rets = np.array([[rp, rn] for _, rp, rn, _ in results],
+                        np.float32)
+        flat_rets = rets.reshape(-1)
+        ranks = np.empty_like(flat_rets)
+        ranks[flat_rets.argsort()] = np.arange(flat_rets.size)
+        centered = (ranks / (flat_rets.size - 1) - 0.5).reshape(
+            rets.shape)
+
+        grad = np.zeros_like(self.flat_params)
+        for (seed, _, _, steps), (cp, cn) in zip(results, centered):
+            rng = np.random.RandomState(seed)
+            eps = rng.randn(self.flat_params.size).astype(np.float32)
+            grad += (cp - cn) * eps
+            self._timesteps_total += steps
+        grad /= (2 * cfg["pop_size"] * cfg["sigma"])
+        self.flat_params = ((1 - cfg["l2_coeff"] * cfg["lr"])
+                            * self.flat_params
+                            + cfg["lr"] * grad).astype(np.float32)
+
+        # Report the unperturbed policy's return as the learning metric.
+        import gymnasium as gym
+        env = gym.make(cfg["env"], **cfg["env_config"])
+        layers = _unflatten(self.flat_params, self.shapes)
+        eval_ret, _ = _episode_return(layers, env,
+                                      cfg["max_episode_steps"],
+                                      seed=int(self._next_seed))
+        env.close()
+        return {"episode_reward_mean": eval_ret,
+                "pop_reward_mean": float(rets.mean()),
+                "timesteps_total": self._timesteps_total}
+
+    def save_checkpoint(self) -> Dict:
+        return {"flat_params": self.flat_params,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.flat_params = data["flat_params"]
+            self._timesteps_total = data.get("timesteps_total", 0)

@@ -1,0 +1,6 @@
+from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+)

@@ -1,0 +1,139 @@
+"""MARWIL + BC: offline RL from a fixed batch of experience.
+
+Reference: rllib/algorithms/marwil/marwil.py (exponentially-weighted
+imitation: policy loss -exp(beta * A) * logp with a value head fit to
+monte-carlo returns; BC is the beta=0 special case,
+rllib/algorithms/bc/bc.py).  Re-derived jax-first: the weighted
+imitation step is one jitted value_and_grad; the offline batch lives in
+the object store and minibatches slice it zero-copy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import sample_batch as sb
+from ray_tpu.rllib.policy.jax_policy import JaxPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class MARWILPolicy(JaxPolicy):
+    def _loss(self, params, batch):
+        cfg = self.config
+        beta = cfg.get("beta", 1.0)
+        logits, value = self.model.apply(params, batch[sb.OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch[sb.ACTIONS]]
+        vt = batch[sb.VALUE_TARGETS]
+        adv = vt - jax.lax.stop_gradient(value)
+        # Batch-normalized advantage inside the exponential weight
+        # (reference keeps a running moment estimate; per-batch std is
+        # the jit-friendly equivalent at this scale).
+        adv_n = adv / (jnp.std(adv) + 1e-6)
+        weight = jnp.minimum(jnp.exp(beta * adv_n),
+                             cfg.get("max_weight", 20.0))
+        imitation = -(jax.lax.stop_gradient(weight) * logp).mean()
+        vf_loss = ((value - vt) ** 2).mean()
+        total = imitation + cfg.get("vf_loss_coeff", 1.0) * vf_loss
+        return total, {"policy_loss": imitation, "vf_loss": vf_loss,
+                       "mean_weight": weight.mean()}
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(MARWIL)
+        self._config.update({
+            "beta": 1.0,
+            "vf_loss_coeff": 1.0,
+            "max_weight": 20.0,
+            "lr": 5e-4,
+            "num_rollout_workers": 0,   # offline: no rollout gang
+            "sgd_minibatch_size": 256,
+            "num_sgd_iter": 20,
+            "evaluation_steps": 500,    # env steps of eval per train()
+            "input_data": None,         # dict: obs/actions/rewards/dones
+        })
+
+    def offline_data(self, input_data) -> "MARWILConfig":
+        self._config["input_data"] = input_data
+        return self
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning: MARWIL with beta=0 (pure imitation, reference
+    bc.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BC
+        self._config.update({"beta": 0.0, "vf_loss_coeff": 0.0})
+
+
+class MARWIL(Algorithm):
+    policy_cls = MARWILPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return {"beta": 1.0, "vf_loss_coeff": 1.0, "max_weight": 20.0,
+                "lr": 5e-4, "num_rollout_workers": 0,
+                "sgd_minibatch_size": 256, "num_sgd_iter": 20,
+                "evaluation_steps": 500, "input_data": None}
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        data = self.algo_config.get("input_data")
+        if data is None:
+            raise ValueError("MARWIL/BC needs config['input_data'] with "
+                             "obs/actions/rewards/dones arrays")
+        batch = SampleBatch({k: np.asarray(v) for k, v in data.items()})
+        batch[sb.VALUE_TARGETS] = _mc_returns(
+            batch[sb.REWARDS].astype(np.float32),
+            batch[sb.DONES].astype(np.float32),
+            self.algo_config["gamma"])
+        self.offline_batch = batch
+        self._rng = np.random.RandomState(self.algo_config["seed"])
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        policy = self.workers.local_worker.policy
+        mb = min(cfg["sgd_minibatch_size"], self.offline_batch.count)
+        stats: Dict = {}
+        for _ in range(cfg["num_sgd_iter"]):
+            shuffled = self.offline_batch.shuffle(self._rng)
+            for minibatch in shuffled.minibatches(mb):
+                stats = policy.learn_on_batch(minibatch)
+        self._timesteps_total += cfg["num_sgd_iter"] \
+            * self.offline_batch.count
+        # Online evaluation of the cloned policy (reference: evaluation
+        # workers; here the local worker doubles as the eval sampler).
+        if cfg["evaluation_steps"]:
+            self.workers.local_worker.sample(cfg["evaluation_steps"])
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": 0,
+                "num_offline_steps_trained": self.offline_batch.count}
+
+
+class BC(MARWIL):
+    policy_cls = MARWILPolicy
+
+    def _extra_defaults(self) -> Dict:
+        d = super()._extra_defaults()
+        d.update({"beta": 0.0, "vf_loss_coeff": 0.0})
+        return d
+
+
+def _mc_returns(rewards: np.ndarray, dones: np.ndarray,
+                gamma: float) -> np.ndarray:
+    """Discounted monte-carlo returns, resetting at episode boundaries
+    (reference: marwil postprocess_advantages with
+    use_gae=False)."""
+    out = np.zeros_like(rewards)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = rewards[t] + gamma * acc * (1.0 - dones[t])
+        out[t] = acc
+    return out

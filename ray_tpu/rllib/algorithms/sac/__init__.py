@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401

@@ -1,0 +1,70 @@
+"""SAC: off-policy soft actor-critic with replay.
+
+Reference: rllib/algorithms/sac/sac.py (training_step: store rollouts in
+the replay buffer, SGD on replay batches, polyak target updates) —
+discrete-action scope; the stochastic policy itself explores, so no
+epsilon schedule is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.jax_sac_policy import JaxSACPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self._config.update({
+            "lr": 3e-4,
+            "tau": 0.995,              # polyak coefficient per update
+            "initial_alpha": 0.1,
+            "buffer_capacity": 50_000,
+            "learning_starts": 500,
+            "train_batch_size": 500,   # env steps collected per iter
+            "sgd_batch_size": 128,
+            "num_sgd_steps": 64,
+        })
+
+
+class SAC(Algorithm):
+    policy_cls = JaxSACPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return dict(SACConfig()._config)
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        self.buffer = ReplayBuffer(self.algo_config["buffer_capacity"],
+                                   seed=self.algo_config["seed"])
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        per_worker = max(1, cfg["train_batch_size"]
+                         // max(1, len(self.workers.remote_workers)))
+        if self.workers.remote_workers:
+            batches = ray_tpu.get(
+                self.workers.sample_all(per_worker), timeout=600)
+        else:
+            batches = [self.workers.local_worker.sample(per_worker)]
+        batch = SampleBatch.concat_samples(batches)
+        self.buffer.add(batch)
+        self._timesteps_total += batch.count
+
+        policy = self.workers.local_worker.policy
+        stats: Dict = {}
+        if len(self.buffer) >= cfg["learning_starts"]:
+            for _ in range(cfg["num_sgd_steps"]):
+                stats = policy.learn_on_batch(
+                    self.buffer.sample(cfg["sgd_batch_size"]))
+                policy.update_target()
+        if self.workers.remote_workers:
+            self.workers.sync_weights()
+        return {"info": {"learner": stats,
+                         "buffer_size": len(self.buffer)},
+                "num_env_steps_trained": batch.count}

@@ -1,0 +1,192 @@
+"""JaxSACPolicy: discrete-action soft actor-critic.
+
+Reference: rllib/algorithms/sac/sac_torch_policy.py (twin soft-Q nets,
+stochastic policy, entropy temperature alpha with automatic tuning) —
+scoped to the discrete-action form (Christodoulou 2019, "SAC for
+discrete action settings": expectations over the action simplex replace
+the reparameterized sample).  jax-first: actor, twin critics, alpha and
+all three adam updates run as ONE jitted train step, so each SGD
+minibatch is a single fused device program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.policy import sample_batch as sb
+
+
+class _PiNet(nn.Module):
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        return nn.Dense(self.num_actions)(h)  # logits
+
+
+class _TwinQNet(nn.Module):
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        outs = []
+        for _ in range(2):
+            h = x
+            for width in self.hiddens:
+                h = nn.relu(nn.Dense(width)(h))
+            outs.append(nn.Dense(self.num_actions)(h))
+        return outs[0], outs[1]
+
+
+class JaxSACPolicy:
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict):
+        self.config = config
+        self.num_actions = num_actions
+        hid = tuple(config.get("fcnet_hiddens", (64, 64)))
+        self.pi = _PiNet(num_actions=num_actions, hiddens=hid)
+        self.q = _TwinQNet(num_actions=num_actions, hiddens=hid)
+        rng = jax.random.PRNGKey(config.get("seed", 0))
+        k1, k2, self._rng = jax.random.split(rng, 3)
+        dummy = jnp.zeros((1, obs_dim), jnp.float32)
+        self.pi_params = self.pi.init(k1, dummy)
+        self.q_params = self.q.init(k2, dummy)
+        self.target_q_params = self.q_params
+        # Entropy temperature: optimized in log space toward the target
+        # entropy (a fraction of max entropy for discrete spaces).
+        self.log_alpha = jnp.asarray(
+            np.log(config.get("initial_alpha", 0.1)), jnp.float32)
+        # Target entropy: a modest fraction of max entropy.  The 0.98
+        # factor from the discrete-SAC paper pins the policy close to
+        # uniform on small action spaces (log 2 = 0.69 nats); half of max
+        # keeps exploration pressure without forbidding exploitation.
+        self.target_entropy = config.get(
+            "target_entropy", 0.5 * float(np.log(num_actions)))
+        lr = config.get("lr", 3e-4)
+        self.pi_tx = optax.adam(lr)
+        self.q_tx = optax.adam(lr)
+        self.a_tx = optax.adam(lr)
+        self.pi_opt = self.pi_tx.init(self.pi_params)
+        self.q_opt = self.q_tx.init(self.q_params)
+        self.a_opt = self.a_tx.init(self.log_alpha)
+        self._forward = jax.jit(self.pi.apply)
+        self._train = jax.jit(self._train_impl)
+
+    # ------------------------------------------------------------ acting
+    def compute_actions(self, obs: np.ndarray):
+        """Sample from the categorical policy; (actions, logp, vf)
+        placeholders keep RolloutWorker's schema."""
+        self._rng, key = jax.random.split(self._rng)
+        logits = self._forward(self.pi_params,
+                               jnp.asarray(obs, jnp.float32))
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions]
+        zeros = np.zeros(len(obs), np.float32)
+        return np.asarray(actions), np.asarray(logp), zeros
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)
+
+    # ---------------------------------------------------------- learning
+    def _train_impl(self, pi_params, q_params, target_q, log_alpha,
+                    pi_opt, q_opt, a_opt, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        alpha = jnp.exp(log_alpha)
+        obs, acts = batch[sb.OBS], batch[sb.ACTIONS]
+        rew = batch[sb.REWARDS]
+        done = batch[sb.DONES].astype(jnp.float32)
+        nobs = batch[sb.NEXT_OBS]
+
+        # Soft state value under the target critics:
+        #   V(s') = E_pi [ min Q_target(s',a) - alpha log pi(a|s') ]
+        next_logits = self.pi.apply(pi_params, nobs)
+        next_p = jax.nn.softmax(next_logits)
+        next_logp = jax.nn.log_softmax(next_logits)
+        tq1, tq2 = self.q.apply(target_q, nobs)
+        next_v = jnp.sum(
+            next_p * (jnp.minimum(tq1, tq2) - alpha * next_logp), axis=-1)
+        td_target = jax.lax.stop_gradient(
+            rew + gamma * (1.0 - done) * next_v)
+
+        def q_loss_fn(qp):
+            q1, q2 = self.q.apply(qp, obs)
+            idx = jnp.arange(obs.shape[0])
+            l1 = ((q1[idx, acts] - td_target) ** 2).mean()
+            l2 = ((q2[idx, acts] - td_target) ** 2).mean()
+            return l1 + l2
+
+        q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_params)
+        q_updates, q_opt = self.q_tx.update(q_grads, q_opt, q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        def pi_loss_fn(pp):
+            logits = self.pi.apply(pp, obs)
+            p = jax.nn.softmax(logits)
+            logp = jax.nn.log_softmax(logits)
+            q1, q2 = self.q.apply(q_params, obs)
+            qmin = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+            loss = jnp.sum(p * (alpha * logp - qmin), axis=-1).mean()
+            entropy = -jnp.sum(p * logp, axis=-1).mean()
+            return loss, entropy
+
+        (pi_loss, entropy), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True)(pi_params)
+        pi_updates, pi_opt = self.pi_tx.update(pi_grads, pi_opt,
+                                               pi_params)
+        pi_params = optax.apply_updates(pi_params, pi_updates)
+
+        def alpha_loss_fn(la):
+            return jnp.exp(la) * jax.lax.stop_gradient(
+                entropy - self.target_entropy)
+
+        a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+        a_updates, a_opt = self.a_tx.update(a_grad, a_opt, log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+        stats = {"q_loss": q_loss, "policy_loss": pi_loss,
+                 "alpha_loss": a_loss, "alpha": jnp.exp(log_alpha),
+                 "entropy": entropy, "total_loss": q_loss + pi_loss}
+        return (pi_params, q_params, log_alpha, pi_opt, q_opt, a_opt,
+                stats)
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (self.pi_params, self.q_params, self.log_alpha, self.pi_opt,
+         self.q_opt, self.a_opt, stats) = self._train(
+            self.pi_params, self.q_params, self.target_q_params,
+            self.log_alpha, self.pi_opt, self.q_opt, self.a_opt, jbatch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self, tau: float | None = None):
+        """Polyak update of the target critics (tau=1 -> hard sync)."""
+        tau = self.config.get("tau", 0.995) if tau is None else tau
+        self.target_q_params = jax.tree_util.tree_map(
+            lambda t, s: tau * t + (1.0 - tau) * s,
+            self.target_q_params, self.q_params)
+
+    # ----------------------------------------------------------- weights
+    def get_weights(self):
+        return {"pi": jax.tree_util.tree_map(np.asarray, self.pi_params),
+                "q": jax.tree_util.tree_map(np.asarray, self.q_params)}
+
+    def set_weights(self, weights):
+        if "epsilon" in weights:  # schema parity with JaxQPolicy pushes
+            weights = {k: v for k, v in weights.items()
+                       if k != "epsilon"}
+        self.pi_params = jax.tree_util.tree_map(jnp.asarray,
+                                                weights["pi"])
+        if "q" in weights:
+            self.q_params = jax.tree_util.tree_map(jnp.asarray,
+                                                   weights["q"])

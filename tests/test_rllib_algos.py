@@ -1,0 +1,154 @@
+"""Per-algorithm smoke/learning tests for the wider RLlib family
+(reference: rllib/algorithms/*/tests — each algorithm gets a
+build-train-improve check)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    A2CConfig,
+    APPOConfig,
+    BCConfig,
+    ESConfig,
+    MARWILConfig,
+    SACConfig,
+)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_a2c_cartpole_improves(ray_init):
+    algo = (A2CConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=200)
+            .training(train_batch_size=1000, lr=2e-3,
+                      microbatch_size=0)
+            .debugging(seed=5)
+            .build())
+    best = 0.0
+    for _ in range(20):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best >= 60:
+            break
+    algo.stop()
+    # Random CartPole is ~22; A2C at this budget clearly improves.
+    assert best >= 60, f"A2C failed to improve (best={best})"
+
+
+def test_appo_async_throughput_and_loss(ray_init):
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=100)
+            .training(min_steps_per_iteration=500)
+            .build())
+    first = algo.train()
+    second = algo.train()
+    assert second["timesteps_total"] > first["timesteps_total"] > 0
+    assert second["info"]["num_batches_trained"] > 0
+    assert np.isfinite(
+        second["info"]["learner"].get("total_loss", np.inf))
+    algo.stop()
+
+
+def test_es_cartpole_improves(ray_init):
+    algo = (ESConfig()
+            .environment("CartPole-v1")
+            .training(pop_size=12, sigma=0.1, lr=0.1,
+                      fcnet_hiddens=(16,), max_episode_steps=200)
+            .debugging(seed=1)
+            .build())
+    best = 0.0
+    for _ in range(12):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best >= 80:
+            break
+    algo.stop()
+    assert best >= 80, f"ES failed to improve (best={best})"
+    assert r["timesteps_total"] > 0
+
+
+def _expert_cartpole_data(n_steps: int, seed: int = 0):
+    """Heuristic expert: push the cart toward the falling pole — scores
+    ~200 on CartPole-v1, far above the ~22 random baseline."""
+    import gymnasium as gym
+    env = gym.make("CartPole-v1")
+    obs, _ = env.reset(seed=seed)
+    rows = {"obs": [], "actions": [], "rewards": [], "dones": []}
+    for _ in range(n_steps):
+        action = 1 if (obs[2] + 0.5 * obs[3]) > 0 else 0
+        rows["obs"].append(obs)
+        rows["actions"].append(action)
+        obs, reward, terminated, truncated, _ = env.step(action)
+        rows["rewards"].append(float(reward))
+        rows["dones"].append(bool(terminated or truncated))
+        if terminated or truncated:
+            obs, _ = env.reset()
+    env.close()
+    return {"obs": np.asarray(rows["obs"], np.float32),
+            "actions": np.asarray(rows["actions"], np.int32),
+            "rewards": np.asarray(rows["rewards"], np.float32),
+            "dones": np.asarray(rows["dones"], np.bool_)}
+
+
+def test_bc_clones_expert(ray_init):
+    data = _expert_cartpole_data(3000)
+    algo = (BCConfig()
+            .environment("CartPole-v1")
+            .offline_data(data)
+            .training(num_sgd_iter=10, lr=1e-3, evaluation_steps=600)
+            .debugging(seed=2)
+            .build())
+    best = 0.0
+    for _ in range(5):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+    algo.stop()
+    # The clone should far exceed the ~22 random baseline.
+    assert best >= 100, f"BC failed to clone the expert (best={best})"
+
+
+def test_sac_cartpole_improves(ray_init):
+    algo = (SACConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=200)
+            .training(train_batch_size=500, learning_starts=500,
+                      num_sgd_steps=64, lr=3e-3)
+            .debugging(seed=9)
+            .build())
+    best = 0.0
+    for _ in range(10):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best >= 40:
+            break
+    algo.stop()
+    assert np.isfinite(r["info"]["learner"].get("total_loss", np.nan))
+    # Random CartPole is ~22; soft-Q learning clearly improves within the
+    # step budget (the strict >=150 learning-regression bar is PPO's;
+    # measured curve: ~38 by iter 8, entropy pulled to its target).
+    assert best >= 40, f"SAC failed to improve (best={best})"
+
+
+def test_marwil_weighted_imitation(ray_init):
+    data = _expert_cartpole_data(2000, seed=3)
+    algo = (MARWILConfig()
+            .environment("CartPole-v1")
+            .offline_data(data)
+            .training(beta=1.0, num_sgd_iter=10, lr=1e-3,
+                      evaluation_steps=400)
+            .debugging(seed=4)
+            .build())
+    r = algo.train()
+    stats = r["info"]["learner"]
+    assert np.isfinite(stats["total_loss"])
+    assert stats["mean_weight"] > 0
+    assert r["num_offline_steps_trained"] == 2000
+    algo.stop()
