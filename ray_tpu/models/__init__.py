@@ -14,3 +14,4 @@ from ray_tpu.models.gpt import (  # noqa: F401
     make_train_state,
     param_specs,
 )
+from ray_tpu.models.llama import LlamaConfig  # noqa: F401

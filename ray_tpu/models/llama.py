@@ -1,0 +1,280 @@
+"""LLaMA-family decoder: RoPE + GQA + SwiGLU + RMSNorm, SPMD-sharded.
+
+The reference frameworks stop at gang-scheduling (SURVEY.md §2.4); model
+families here are first-class and share one mesh vocabulary (see
+models/gpt.py for the flagship that adds pp/ep).  This family covers the
+modern-decoder recipe:
+
+  RoPE    rotary position embedding — no learned position table; under
+          sp the global position offset comes from the shard's ring index
+  GQA     grouped-query attention: n_kv_heads < n_heads; K/V heads are
+          sharded over tp alongside Q heads and broadcast to the query
+          groups at use (kv projections and cache stay Hkv-sized)
+  SwiGLU  silu(x W_g) * (x W_u) W_d, hidden sharded over tp
+  RMSNorm no-mean normalization (fp32 accumulation)
+
+Mesh axes: dp / fsdp (ZeRO-style just-in-time gather) / tp (heads +
+ffn hidden + vocab) / sp (ring attention).  `mesh=None` runs the same
+math on one device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.gpt import (
+    BATCH_AXES,
+    _all_gather,
+    _axis_index,
+    _psum,
+    _rmsnorm,
+    _shard_map,
+)
+from ray_tpu.parallel.ring_attention import (
+    _ring_attention_sharded,
+    reference_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    n_layers: int = 8
+    d_ff: int = 1536            # SwiGLU hidden width
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def group_size(self) -> int:
+        assert self.n_heads % self.n_kv_heads == 0
+        return self.n_heads // self.n_kv_heads
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(cfg: LlamaConfig, key) -> dict:
+    k = iter(jax.random.split(key, 16))
+    L, D, H, Hk, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.head_dim, cfg.d_ff)
+    s = 0.02
+    so = s / np.sqrt(2 * L)
+
+    def nrm(key, shape, scale):
+        return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
+
+    blocks = {
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "wq": nrm(next(k), (L, D, H, Dh), s),
+        "wkv": nrm(next(k), (L, D, 2, Hk, Dh), s),
+        "wo": nrm(next(k), (L, H, Dh, D), so),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "w_gate": nrm(next(k), (L, D, F), s),
+        "w_up": nrm(next(k), (L, D, F), s),
+        "w_down": nrm(next(k), (L, F, D), so),
+    }
+    return {
+        "wte": nrm(next(k), (cfg.vocab_size, D), s),
+        "blocks": blocks,
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "wlm": nrm(next(k), (D, cfg.vocab_size), s),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """Q and KV heads over tp (needs n_kv_heads % tp == 0); model dim of
+    the big matrices over fsdp, gathered just-in-time in the block."""
+    blocks = {
+        "ln1": P(None, None),
+        "wq": P(None, "fsdp", "tp", None),
+        "wkv": P(None, "fsdp", None, "tp", None),
+        "wo": P(None, "tp", None, "fsdp"),
+        "ln2": P(None, None),
+        "w_gate": P(None, "fsdp", "tp"),
+        "w_up": P(None, "fsdp", "tp"),
+        "w_down": P(None, "tp", "fsdp"),
+    }
+    return {
+        "wte": P("tp", None),
+        "blocks": blocks,
+        "ln_f": P(None),
+        "wlm": P(None, "tp"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# RoPE
+
+
+def _rope(x, t0, theta: float):
+    """x: [b, t, h, d] -> rotated (rotate-half form).  t0 = global
+    position of this shard's first token (nonzero under sp)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = (t0 + jnp.arange(t, dtype=jnp.float32))[:, None] * freqs[None, :]
+    cos = jnp.cos(pos)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(pos)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Block body (inside shard_map, or plain when mesh=None)
+
+
+def _attention(x, p, cfg: LlamaConfig, active):
+    dt = cfg.dtype
+    wq = _all_gather(p["wq"], "fsdp", 0, active).astype(dt)
+    wkv = _all_gather(p["wkv"], "fsdp", 0, active).astype(dt)
+    q = jnp.einsum("btd,dhk->bthk", x, wq)
+    kv = jnp.einsum("btd,dchk->btchk", x, wkv)
+    kk, v = kv[:, :, 0], kv[:, :, 1]
+
+    t_local = x.shape[1]
+    t0 = (_axis_index("sp", active) * t_local).astype(jnp.float32) \
+        if "sp" in active else jnp.float32(0)
+    q = _rope(q, t0, cfg.rope_theta)
+    kk = _rope(kk, t0, cfg.rope_theta)
+
+    # GQA: broadcast each kv head to its query group for the attention
+    # math (local head counts divide evenly: repeat = H/Hkv, tp-invariant).
+    rep = q.shape[2] // kk.shape[2]
+    if rep > 1:
+        kk = jnp.repeat(kk, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    scale = cfg.head_dim ** -0.5
+    if "sp" in active:
+        out = _ring_attention_sharded(q, kk, v, "sp", causal=True,
+                                      scale=scale)
+    else:
+        out = None
+        if cfg.use_flash and jax.default_backend() == "tpu":
+            from ray_tpu.ops import flash_attention as fa
+            t = q.shape[1]
+            if t >= 2048 and fa.supports(t, cfg.head_dim):
+                out = fa.flash_attention(
+                    q.transpose(0, 2, 1, 3), kk.transpose(0, 2, 1, 3),
+                    v.transpose(0, 2, 1, 3), scale).transpose(0, 2, 1, 3)
+        if out is None:
+            out = reference_attention(q, kk, v, causal=True, scale=scale)
+    wo = _all_gather(p["wo"], "fsdp", 2, active).astype(dt)
+    y = jnp.einsum("bthk,hkd->btd", out, wo)
+    return _psum(y, ("tp",), active)
+
+
+def _swiglu_ffn(x, p, cfg: LlamaConfig, active):
+    dt = cfg.dtype
+    wg = _all_gather(p["w_gate"], "fsdp", 0, active).astype(dt)
+    wu = _all_gather(p["w_up"], "fsdp", 0, active).astype(dt)
+    wd = _all_gather(p["w_down"], "fsdp", 1, active).astype(dt)
+    h = jax.nn.silu(jnp.einsum("btd,df->btf", x, wg)) \
+        * jnp.einsum("btd,df->btf", x, wu)
+    y = jnp.einsum("btf,fd->btd", h, wd)
+    return _psum(y, ("tp",), active)
+
+
+def _blocks_body(blocks, x, cfg: LlamaConfig, active):
+    def layer(x, lp):
+        x = x + _attention(_rmsnorm(x, lp["ln1"]), lp, cfg, active)
+        x = x + _swiglu_ffn(_rmsnorm(x, lp["ln2"]), lp, cfg, active)
+        return x, None
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+    x, _ = lax.scan(layer, x, blocks)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Forward / loss / train step (mirrors models/gpt.py)
+
+
+def forward(params: dict, tokens, cfg: LlamaConfig, mesh=None):
+    """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
+    dt = cfg.dtype
+    x = jnp.take(params["wte"], tokens, axis=0).astype(dt)
+
+    if mesh is None:
+        x = _blocks_body(params["blocks"], x, cfg, frozenset())
+    else:
+        active = frozenset(mesh.axis_names)
+        x_spec = P(BATCH_AXES, "sp", None)
+        x = lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec))
+        body = functools.partial(_blocks_body, cfg=cfg, active=active)
+        x = _shard_map(body, mesh,
+                       (param_specs(cfg)["blocks"], x_spec),
+                       x_spec)(params["blocks"], x)
+
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        params["wlm"].astype(jnp.float32))
+    if mesh is not None:
+        logits = lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P(BATCH_AXES, "sp", "tp")))
+    return logits
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None):
+    import optax
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, mesh)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return loss.mean()
+
+
+def make_train_state(cfg: LlamaConfig, key, mesh=None, optimizer=None,
+                     learning_rate: float = 3e-4):
+    import optax
+    optimizer = optimizer or optax.adamw(learning_rate)
+    params = init_params(cfg, key)
+    if mesh is not None:
+        specs = param_specs(cfg)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, specs)
+    opt_state = optimizer.init(params)
+    return ({"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}, optimizer)
+
+
+def train_step(state, tokens, cfg: LlamaConfig, mesh=None, optimizer=None):
+    import optax
+    optimizer = optimizer or optax.adamw(3e-4)
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, cfg, mesh))(state["params"])
+    updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                        state["params"])
+    new_params = optax.apply_updates(state["params"], updates)
+    return ({"params": new_params, "opt_state": new_opt,
+             "step": state["step"] + 1}, {"loss": loss})
+
+
+def make_train_step(cfg: LlamaConfig, mesh=None, optimizer=None,
+                    learning_rate: float = 3e-4, donate: bool = True):
+    import optax
+    optimizer = optimizer or optax.adamw(learning_rate)
+    fn = functools.partial(train_step, cfg=cfg, mesh=mesh,
+                           optimizer=optimizer)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
