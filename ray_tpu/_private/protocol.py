@@ -23,6 +23,7 @@ import io
 import logging
 import pickle
 import struct
+import time
 import traceback
 
 logger = logging.getLogger(__name__)
@@ -38,6 +39,33 @@ _PICKLE_PROTO = 5
 
 class RpcError(Exception):
     pass
+
+
+# Per-method handler service-time accounting for every RPC served by this
+# process (reference: the instrumented asio event loop's per-handler stats,
+# src/ray/common/event_stats.h).  Accumulation is three float ops per call;
+# snapshots ride the telemetry push and back `handler_stats()` debugging.
+HANDLER_STATS: dict = {}
+
+
+def _record_handler(method: str, dt: float) -> None:
+    s = HANDLER_STATS.get(method)
+    if s is None:
+        s = HANDLER_STATS[method] = [0, 0.0, 0.0]
+    s[0] += 1
+    s[1] += dt
+    if dt > s[2]:
+        s[2] = dt
+
+
+def handler_stats_snapshot() -> dict:
+    """{method: {count, total_s, max_s, mean_ms}} served by this process."""
+    out = {}
+    for m, (c, t, mx) in HANDLER_STATS.items():
+        out[m] = {"count": c, "total_s": round(t, 6),
+                  "max_s": round(mx, 6),
+                  "mean_ms": round(1000.0 * t / c, 3) if c else 0.0}
+    return out
 
 
 class RemoteError(RpcError):
@@ -138,7 +166,13 @@ class Connection:
         try:
             if self.handler is None:
                 raise RpcError(f"connection {self.name} has no handler")
-            result = await self.handler(self, method, body)
+            _t0 = time.perf_counter()
+            try:
+                result = await self.handler(self, method, body)
+            finally:
+                # Failing handlers count too — they are exactly the calls
+                # these stats exist to surface.
+                _record_handler(method, time.perf_counter() - _t0)
             if not push:
                 await self._send(KIND_REP, msg_id, dumps(result))
         except Exception as e:

@@ -1,0 +1,216 @@
+"""Typed task/actor specifications.
+
+Reference: src/ray/common/task/task_spec.h — TaskSpecification wraps the
+wire message (protobuf there) with typed accessors, so every layer names
+fields instead of poking at raw maps.  Here the wire format is the
+pickled dict that rides the RPC plane; the spec classes subclass dict so
+the wire format, the in-memory lineage entry, and the typed view are the
+same object (no conversion on the hot path), while construction is
+centralized and validated in one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.ids import ActorID, TaskID, ObjectID
+
+
+class TaskSpec(dict):
+    """A normal (stateless) task submission.
+
+    Dict-compatible for the wire; typed accessors for the runtime
+    (reference: task_spec.h TaskSpecification::TaskId/GetRequiredResources
+    /GetSchedulingStrategy/...).
+    """
+
+    REQUIRED = ("task_id", "fn_id", "args", "num_returns", "owner_addr",
+                "return_ids", "resources")
+
+    @classmethod
+    def new(cls, *, task_id: TaskID, fn_id: bytes, args_blob,
+            num_returns: int, owner_addr, return_ids: List[ObjectID],
+            resources: Dict[str, float], strategy: Optional[Dict],
+            max_retries: int, retry_exceptions: bool, name: str,
+            trace, runtime_env: Optional[Dict] = None,
+            pg_id=None, bundle_index: int = -1) -> "TaskSpec":
+        spec = cls(
+            task_id=task_id,
+            fn_id=fn_id,
+            args=args_blob,
+            num_returns=num_returns,
+            owner_addr=owner_addr,
+            return_ids=return_ids,
+            resources=resources,
+            strategy=strategy,
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            name=name,
+            trace=trace,
+        )
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
+        if pg_id is not None:
+            spec["pg_id"] = pg_id
+            spec["bundle_index"] = bundle_index
+        return spec
+
+    def validate(self) -> "TaskSpec":
+        missing = [k for k in self.REQUIRED if k not in self]
+        if missing:
+            raise ValueError(f"TaskSpec missing fields {missing}")
+        if len(self["return_ids"]) != self["num_returns"]:
+            raise ValueError("return_ids/num_returns mismatch")
+        return self
+
+    # ------------------------------------------------------------ fields
+    @property
+    def task_id(self) -> TaskID:
+        return self["task_id"]
+
+    @property
+    def fn_id(self) -> bytes:
+        return self["fn_id"]
+
+    @property
+    def num_returns(self) -> int:
+        return self["num_returns"]
+
+    @property
+    def return_ids(self) -> List[ObjectID]:
+        return self["return_ids"]
+
+    @property
+    def owner_addr(self):
+        return self["owner_addr"]
+
+    @property
+    def resources(self) -> Dict[str, float]:
+        return self["resources"]
+
+    @property
+    def strategy(self) -> Optional[Dict]:
+        return self.get("strategy")
+
+    @property
+    def max_retries(self) -> int:
+        return self.get("max_retries", 0)
+
+    @property
+    def name(self) -> str:
+        return self.get("name", "")
+
+    @property
+    def pg_id(self):
+        return self.get("pg_id")
+
+    @property
+    def bundle_index(self) -> int:
+        return self.get("bundle_index", -1)
+
+    @property
+    def runtime_env(self) -> Optional[Dict]:
+        return self.get("runtime_env")
+
+
+class ActorTaskSpec(dict):
+    """A method invocation pushed directly to an actor process
+    (reference: task_spec.h actor-task fields + the direct actor
+    submitter's per-caller sequence numbers)."""
+
+    @classmethod
+    def new(cls, *, task_id: TaskID, method: str, args_blob,
+            num_returns: int, return_ids: List[ObjectID], caller_id: bytes,
+            owner_addr, trace,
+            concurrency_group: Optional[str] = None) -> "ActorTaskSpec":
+        return cls(
+            task_id=task_id,
+            method=method,
+            args=args_blob,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            caller_id=caller_id,
+            owner_addr=owner_addr,
+            trace=trace,
+            concurrency_group=concurrency_group,
+        )
+
+    @property
+    def task_id(self) -> TaskID:
+        return self["task_id"]
+
+    @property
+    def method(self) -> str:
+        return self["method"]
+
+    @property
+    def num_returns(self) -> int:
+        return self["num_returns"]
+
+    @property
+    def return_ids(self) -> List[ObjectID]:
+        return self["return_ids"]
+
+    @property
+    def seq(self) -> Optional[int]:
+        return self.get("seq")
+
+
+class ActorCreationSpec(dict):
+    """An actor-creation request registered with the GCS (reference:
+    task_spec.h actor-creation fields / gcs_actor_manager.h RegisterActor
+    payload)."""
+
+    @classmethod
+    def new(cls, *, class_id: bytes, class_name: str, init_blob,
+            resources: Dict[str, float], max_restarts: int,
+            max_concurrency: Optional[int],
+            concurrency_groups: Optional[Dict], name: Optional[str],
+            namespace: str, detached: bool,
+            scheduling_strategy: Optional[Dict],
+            runtime_env: Optional[Dict] = None,
+            placement_group_id=None,
+            bundle_index: Optional[int] = None) -> "ActorCreationSpec":
+        spec = cls(
+            class_id=class_id,
+            class_name=class_name,
+            init_args=init_blob,
+            resources=resources,
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            concurrency_groups=concurrency_groups,
+            name=name,
+            namespace=namespace,
+            detached=detached,
+            scheduling_strategy=scheduling_strategy,
+        )
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
+        if placement_group_id is not None:
+            spec["placement_group_id"] = placement_group_id
+            spec["bundle_index"] = bundle_index
+        return spec
+
+    @property
+    def class_name(self) -> str:
+        return self.get("class_name", "")
+
+    @property
+    def resources(self) -> Dict[str, float]:
+        return self["resources"]
+
+    @property
+    def max_restarts(self) -> int:
+        return self.get("max_restarts", 0)
+
+    @property
+    def detached(self) -> bool:
+        return self.get("detached", False)
+
+    @property
+    def name(self) -> Optional[str]:
+        return self.get("name")
+
+    @property
+    def namespace(self) -> str:
+        return self.get("namespace", "default")

@@ -35,6 +35,8 @@ from ray_tpu._private.ids import (ActorID, FunctionID, JobID, NodeID, ObjectID,
                                   TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.shm_store import StoreMapping
+from ray_tpu._private.task_spec import (ActorCreationSpec, ActorTaskSpec,
+                                        TaskSpec)
 
 logger = logging.getLogger(__name__)
 
@@ -387,6 +389,8 @@ class CoreWorker:
                     "ns": "telemetry", "key": self.worker_id.binary(),
                     "value": pickle.dumps({
                         "snapshots": snaps, "profile": events,
+                        "rpc_handlers":
+                            protocol.handler_stats_snapshot(),
                         "pid": os.getpid(), "mode": self.mode})})
             except Exception:
                 if self._shutdown:
@@ -678,7 +682,7 @@ class CoreWorker:
                 "reconstructing %d object(s) by re-executing task %s",
                 len(reexecutions), task_id.hex()[:8])
             self._pin_args_from_lineage(task_id)
-            await self._submit(dict(spec))
+            await self._submit(TaskSpec(spec))
             await entry.event.wait()
             if not fut.done():
                 fut.set_result(True)
@@ -754,28 +758,26 @@ class CoreWorker:
             self.owned[oid] = entry
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
-        spec = {
-            "task_id": task_id,
-            "fn_id": fn_id,
-            "args": args_blob,
-            "num_returns": num_returns,
-            "owner_addr": self.addr,
-            "return_ids": [r.id for r in refs],
-            "resources": _normalize_resources(opts),
-            "strategy": _strategy_dict(opts.get("scheduling_strategy")),
-            "max_retries": opts.get("max_retries",
-                                    cfg.max_task_retries_default),
-            "retry_exceptions": opts.get("retry_exceptions", False),
-            "name": opts.get("name", ""),
-            "trace": _trace_for_submit(),
-        }
-        if opts.get("runtime_env"):
-            spec["runtime_env"] = self._pack_runtime_env(
-                opts["runtime_env"])
         pg = opts.get("placement_group")
-        if pg is not None:
-            spec["pg_id"] = pg.id
-            spec["bundle_index"] = opts.get("placement_group_bundle_index", -1)
+        spec = TaskSpec.new(
+            task_id=task_id,
+            fn_id=fn_id,
+            args_blob=args_blob,
+            num_returns=num_returns,
+            owner_addr=self.addr,
+            return_ids=[r.id for r in refs],
+            resources=_normalize_resources(opts),
+            strategy=_strategy_dict(opts.get("scheduling_strategy")),
+            max_retries=opts.get("max_retries",
+                                 cfg.max_task_retries_default),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            name=opts.get("name", ""),
+            trace=_trace_for_submit(),
+            runtime_env=(self._pack_runtime_env(opts["runtime_env"])
+                         if opts.get("runtime_env") else None),
+            pg_id=pg.id if pg is not None else None,
+            bundle_index=opts.get("placement_group_bundle_index", -1),
+        ).validate()
         # Lineage: keep the spec on every return so a lost object can be
         # reconstructed by re-executing the task (reference:
         # task_manager.h:86 lineage, object_recovery_manager.h:90).
@@ -1436,17 +1438,17 @@ class CoreWorker:
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
         self._pin_args(task_id, args, kwargs)
-        body = {
-            "task_id": task_id,
-            "method": method,
-            "args": args_blob,
-            "trace": _trace_for_submit(),
-            "num_returns": num_returns,
-            "return_ids": [r.id for r in refs],
-            "caller_id": self.worker_id.binary(),
-            "concurrency_group": opts.get("concurrency_group"),
-            "owner_addr": self.addr,
-        }
+        body = ActorTaskSpec.new(
+            task_id=task_id,
+            method=method,
+            args_blob=args_blob,
+            trace=_trace_for_submit(),
+            num_returns=num_returns,
+            return_ids=[r.id for r in refs],
+            caller_id=self.worker_id.binary(),
+            concurrency_group=opts.get("concurrency_group"),
+            owner_addr=self.addr,
+        )
         self._call(self._submit_actor_task(actor_id, actor_addr, body,
                                            opts.get("max_task_retries", 0)))
         return refs
@@ -1545,28 +1547,27 @@ class CoreWorker:
                      opts: dict) -> ActorID:
         actor_id = ActorID.from_random()
         init_blob = self._pack_args(init_args, init_kwargs)
-        spec = {
-            "class_id": class_id,
-            "class_name": opts.get("class_name", ""),
-            "init_args": init_blob,
-            "resources": _normalize_resources(opts, actor=True),
-            "max_restarts": opts.get("max_restarts",
-                                     cfg.actor_max_restarts_default),
-            "max_concurrency": opts.get("max_concurrency"),
-            "concurrency_groups": opts.get("concurrency_groups"),
-            "name": opts.get("name"),
-            "namespace": opts.get("namespace", "default"),
-            "detached": opts.get("lifetime") == "detached",
-            "scheduling_strategy": _strategy_dict(
-                opts.get("scheduling_strategy")),
-        }
-        if opts.get("runtime_env"):
-            spec["runtime_env"] = self._pack_runtime_env(
-                opts["runtime_env"])
         pg = opts.get("placement_group")
-        if pg is not None:
-            spec["placement_group_id"] = pg.id
-            spec["bundle_index"] = opts.get("placement_group_bundle_index")
+        spec = ActorCreationSpec.new(
+            class_id=class_id,
+            class_name=opts.get("class_name", ""),
+            init_blob=init_blob,
+            resources=_normalize_resources(opts, actor=True),
+            max_restarts=opts.get("max_restarts",
+                                  cfg.actor_max_restarts_default),
+            max_concurrency=opts.get("max_concurrency"),
+            concurrency_groups=opts.get("concurrency_groups"),
+            name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            detached=opts.get("lifetime") == "detached",
+            scheduling_strategy=_strategy_dict(
+                opts.get("scheduling_strategy")),
+            runtime_env=(self._pack_runtime_env(opts["runtime_env"])
+                         if opts.get("runtime_env") else None),
+            placement_group_id=pg.id if pg is not None else None,
+            bundle_index=(opts.get("placement_group_bundle_index")
+                          if pg is not None else None),
+        )
         reply = self._run(self._gcs_request("create_actor", {
             "actor_id": actor_id, "spec": spec, "job_id": self.job_id}))
         if not reply.get("ok"):
