@@ -158,18 +158,20 @@ def _attention(x, p, cfg: LlamaConfig, active):
     q = _rope(q, t0, cfg.rope_theta)
     kk = _rope(kk, t0, cfg.rope_theta)
 
-    # GQA: broadcast each kv head to its query group for the attention
-    # math (local head counts divide evenly: repeat = H/Hkv, tp-invariant).
+    # GQA: each kv head serves a group of rep = H/Hkv query heads
+    # (tp-invariant since both are sharded over tp).  Under sp the ring
+    # rotates K/V at Hkv size — the wire and cache keep GQA's saving —
+    # and each step broadcasts the received block locally; off-ring the
+    # broadcast happens once up front.
     rep = q.shape[2] // kk.shape[2]
-    if rep > 1:
-        kk = jnp.repeat(kk, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
     scale = cfg.head_dim ** -0.5
     if "sp" in active:
         out = _ring_attention_sharded(q, kk, v, "sp", causal=True,
-                                      scale=scale)
+                                      scale=scale, kv_repeat=rep)
     else:
+        if rep > 1:
+            kk = jnp.repeat(kk, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         out = None
         if cfg.use_flash and jax.default_backend() == "tpu":
             from ray_tpu.ops import flash_attention as fa
@@ -213,6 +215,9 @@ def _blocks_body(blocks, x, cfg: LlamaConfig, active):
 
 def forward(params: dict, tokens, cfg: LlamaConfig, mesh=None):
     """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
+    if tokens.shape[1] > cfg.max_seq:
+        raise ValueError(f"sequence length {tokens.shape[1]} exceeds "
+                         f"max_seq={cfg.max_seq}")
     dt = cfg.dtype
     x = jnp.take(params["wte"], tokens, axis=0).astype(dt)
 

@@ -51,8 +51,13 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
 
 
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
-                            scale: float):
-    """Per-shard body (runs under shard_map)."""
+                            scale: float, kv_repeat: int = 1):
+    """Per-shard body (runs under shard_map).
+
+    kv_repeat > 1 = grouped-query attention: k/v carry Hkv = H/kv_repeat
+    heads and ROTATE at that size (the ring wire and the K/V cache stay
+    Hkv-sized); each step broadcasts the received block to the full head
+    count locally before the online-softmax update."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
@@ -70,8 +75,12 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
     def step(carry, i):
         o, m, l, k_cur, v_cur = carry
         src = (my_idx - i) % axis_size
+        k_blk, v_blk = k_cur, v_cur
+        if kv_repeat > 1:  # local broadcast, after the ring transfer
+            k_blk = jnp.repeat(k_cur, kv_repeat, axis=2)
+            v_blk = jnp.repeat(v_cur, kv_repeat, axis=2)
         o, m, l = _block_update(
-            q, k_cur, v_cur, o, m, l,
+            q, k_blk, v_blk, o, m, l,
             q_offset=my_idx * t_local,
             k_offset=src * t_local,
             causal=causal, scale=scale)

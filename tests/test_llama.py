@@ -67,10 +67,28 @@ def test_train_step_reduces_loss():
     assert float(m["loss"]) < float(m0["loss"])
 
 
-def test_gqa_head_broadcast_matches_mha_when_equal():
-    """n_kv_heads == n_heads degenerates to standard MHA."""
-    cfg_gqa = _cfg(n_kv_heads=4)
+def test_gqa_equals_mha_with_tiled_kv_weights():
+    """GQA with each kv head's weights tiled to every head of its query
+    group must equal full MHA — the oracle for the group-broadcast
+    mapping."""
+    cfg_gqa = _cfg(n_kv_heads=2)
+    cfg_mha = _cfg(n_kv_heads=4)
     params = llama.init_params(cfg_gqa, KEY)
+    rep = cfg_gqa.n_heads // cfg_gqa.n_kv_heads
+    mha_params = {k: v for k, v in params.items()}
+    mha_params["blocks"] = dict(params["blocks"])
+    # wkv: [L, D, 2, Hkv, Dh] -> tile kv head g to query heads of group g.
+    mha_params["blocks"]["wkv"] = np.repeat(
+        np.asarray(params["blocks"]["wkv"]), rep, axis=3)
     toks = _tokens(b=2, t=17)
-    out = llama.forward(params, toks, cfg_gqa)
-    assert np.all(np.isfinite(np.asarray(out)))
+    out_gqa = llama.forward(params, toks, cfg_gqa)
+    out_mha = llama.forward(mha_params, toks, cfg_mha)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_max_seq_enforced():
+    cfg = _cfg(max_seq=16)
+    params = llama.init_params(cfg, KEY)
+    with pytest.raises(ValueError, match="max_seq"):
+        llama.forward(params, _tokens(b=1, t=17), cfg)
