@@ -158,6 +158,13 @@ def _worker() -> CoreWorker:
     return cw
 
 
+def _gcs():
+    """Typed GCS accessor facade for the connected driver (reference:
+    gcs/gcs_client/accessor.h via global_state_accessor.h)."""
+    from ray_tpu._private.gcs_client import global_gcs_client
+    return global_gcs_client()
+
+
 def get(refs, *, timeout=None):
     return _worker().get(refs, timeout=timeout)
 
@@ -183,16 +190,12 @@ def kill(actor, *, no_restart=True):
     from ray_tpu.actor import ActorHandle
     if not isinstance(actor, ActorHandle):
         raise TypeError("ray_tpu.kill() expects an actor handle")
-    w = _worker()
-    w._run(w._gcs_request("kill_actor", {"actor_id": actor._ray_actor_id,
-                                        "no_restart": no_restart}))
+    _gcs().actors.kill(actor._ray_actor_id, no_restart=no_restart)
 
 
 def get_actor(name: str, namespace: str = "default"):
     from ray_tpu.actor import ActorHandle
-    w = _worker()
-    view = w._run(w._gcs_request("get_named_actor",
-                                {"name": name, "namespace": namespace}))
+    view = _gcs().actors.get_by_name(name, namespace)
     if view is None:
         raise ValueError(f"no actor named '{name}'")
     return ActorHandle(view["actor_id"], view.get("class_name", ""),
@@ -200,9 +203,8 @@ def get_actor(name: str, namespace: str = "default"):
 
 
 def nodes():
-    w = _worker()
     out = []
-    for v in w._run(w._gcs_request("get_nodes", {})):
+    for v in _gcs().nodes.get_all():
         out.append({
             "NodeID": v["node_id"].hex(),
             "Alive": v["alive"],
@@ -216,19 +218,15 @@ def nodes():
 
 
 def cluster_resources():
-    w = _worker()
-    return w._run(w._gcs_request("cluster_resources", {}))["total"]
+    return _gcs().nodes.cluster_resources()["total"]
 
 
 def available_resources():
-    w = _worker()
-    return w._run(w._gcs_request("cluster_resources", {}))["available"]
+    return _gcs().nodes.cluster_resources()["available"]
 
 
 def wait_placement_group_ready(pg, timeout: float = 60.0) -> bool:
-    w = _worker()
-    view = w._run(w._gcs_request("wait_placement_group",
-                                {"pg_id": pg.id, "timeout": timeout}))
+    view = _gcs().placement_groups.wait_ready(pg.id, timeout=timeout)
     return view is not None and view["state"] == "CREATED"
 
 

@@ -1,0 +1,138 @@
+"""Typed GCS client: one accessor per metadata table.
+
+Reference: src/ray/gcs/gcs_client/accessor.h (Node/Actor/Job/PG/KV
+accessors on GcsClient) and global_state_accessor.h (the synchronous
+view backing `ray.nodes()` / state APIs).  Callers name operations
+(`gcs.nodes.get_all()`) instead of assembling raw RPC method strings;
+every call rides the worker's reconnect-once request path, so GCS
+restarts stay transparent here too.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class _Accessor:
+    def __init__(self, worker):
+        self._w = worker
+
+    def _call(self, method: str, body: Optional[Dict] = None):
+        return self._w._run(self._w._gcs_request(method, body or {}))
+
+
+class NodeAccessor(_Accessor):
+    def get_all(self) -> List[Dict]:
+        return self._call("get_nodes")
+
+    def wait_for(self, count: int, timeout: float = 30.0) -> bool:
+        return self._call("wait_for_nodes",
+                          {"count": count, "timeout": timeout}).get("ok",
+                                                                    False)
+
+    def drain(self, node_id) -> Dict:
+        return self._call("drain_node", {"node_id": node_id})
+
+    def resource_demands(self) -> Dict:
+        return self._call("get_resource_demands")
+
+    def cluster_resources(self) -> Dict:
+        """{'total': {...}, 'available': {...}} aggregated over nodes."""
+        return self._call("cluster_resources")
+
+
+class ActorAccessor(_Accessor):
+    def get(self, actor_id) -> Dict:
+        return self._call("get_actor", {"actor_id": actor_id})
+
+    def get_by_name(self, name: str,
+                    namespace: str = "default") -> Dict:
+        return self._call("get_named_actor",
+                          {"name": name, "namespace": namespace})
+
+    def list(self, **filters) -> List[Dict]:
+        return self._call("list_actors", filters)
+
+    def list_named(self, namespace: Optional[str] = None) -> List:
+        return self._call("list_named_actors",
+                          {"namespace": namespace})
+
+    def kill(self, actor_id, no_restart: bool = True) -> Dict:
+        return self._call("kill_actor", {"actor_id": actor_id,
+                                         "no_restart": no_restart})
+
+    def wait_alive(self, actor_id, timeout: float = 60.0) -> Dict:
+        return self._call("wait_actor_alive",
+                          {"actor_id": actor_id, "timeout": timeout})
+
+
+class JobAccessor(_Accessor):
+    def list(self) -> List[Dict]:
+        return self._call("list_jobs")
+
+
+class PlacementGroupAccessor(_Accessor):
+    def get(self, pg_id) -> Dict:
+        return self._call("get_placement_group", {"pg_id": pg_id})
+
+    def list(self) -> List[Dict]:
+        return self._call("list_placement_groups")
+
+    def wait_ready(self, pg_id, timeout: float = 60.0) -> Dict:
+        return self._call("wait_placement_group",
+                          {"pg_id": pg_id, "timeout": timeout})
+
+    def remove(self, pg_id) -> Dict:
+        return self._call("remove_placement_group", {"pg_id": pg_id})
+
+
+class KVAccessor(_Accessor):
+    """Internal KV (reference: gcs_kv_manager.h InternalKVInterface)."""
+
+    def put(self, ns: str, key, value) -> Dict:
+        return self._call("kv_put", {"ns": ns, "key": key,
+                                     "value": value})
+
+    def get(self, ns: str, key):
+        return self._call("kv_get", {"ns": ns, "key": key}).get("value")
+
+    def delete(self, ns: str, key) -> Dict:
+        return self._call("kv_del", {"ns": ns, "key": key})
+
+    def keys(self, ns: str, prefix: bytes = b"") -> List:
+        return self._call("kv_keys",
+                          {"ns": ns, "prefix": prefix})["keys"]
+
+
+class EventAccessor(_Accessor):
+    def list(self, **filters) -> List[Dict]:
+        return self._call("list_events", filters)
+
+    def record(self, event: Dict) -> Dict:
+        return self._call("record_event", event)
+
+
+class GcsClient:
+    """Typed synchronous facade over the GCS for in-process callers."""
+
+    def __init__(self, worker):
+        self.nodes = NodeAccessor(worker)
+        self.actors = ActorAccessor(worker)
+        self.jobs = JobAccessor(worker)
+        self.placement_groups = PlacementGroupAccessor(worker)
+        self.kv = KVAccessor(worker)
+        self.events = EventAccessor(worker)
+        self._w = worker
+
+    def ping(self) -> Dict:
+        return self._w._run(self._w._gcs_request("ping", {}))
+
+
+def global_gcs_client() -> GcsClient:
+    """The connected driver/worker's GcsClient (reference:
+    GlobalStateAccessor usage from the Python state APIs)."""
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return GcsClient(w)

@@ -19,6 +19,13 @@ def _w():
     return w
 
 
+def _client():
+    """Typed accessor facade (reference: accessor.h / the
+    GlobalStateAccessor that backs these state APIs)."""
+    from ray_tpu._private.gcs_client import global_gcs_client
+    return global_gcs_client()
+
+
 def _gcs(method: str, body: Optional[dict] = None):
     w = _w()
     return w._run(w._gcs_request(method, body or {}))
@@ -26,7 +33,7 @@ def _gcs(method: str, body: Optional[dict] = None):
 
 def list_nodes() -> List[Dict]:
     out = []
-    for v in _gcs("get_nodes"):
+    for v in _client().nodes.get_all():
         out.append({
             "node_id": v["node_id"].hex(),
             "state": "ALIVE" if v["alive"] else "DEAD",
@@ -40,7 +47,7 @@ def list_nodes() -> List[Dict]:
 
 def list_actors(detail: bool = False) -> List[Dict]:
     out = []
-    for v in _gcs("list_actors"):
+    for v in _client().actors.list():
         row = {
             "actor_id": v["actor_id"].hex(),
             "state": v["state"],
@@ -58,7 +65,7 @@ def list_actors(detail: bool = False) -> List[Dict]:
 
 def list_placement_groups() -> List[Dict]:
     out = []
-    for v in _gcs("list_placement_groups"):
+    for v in _client().placement_groups.list():
         out.append({
             "placement_group_id": v["pg_id"].hex(),
             "state": v["state"],
@@ -69,7 +76,7 @@ def list_placement_groups() -> List[Dict]:
 
 
 def list_jobs() -> List[Dict]:
-    return _gcs("list_jobs")
+    return _client().jobs.list()
 
 
 async def _fanout(method: str) -> List[dict]:
@@ -125,7 +132,7 @@ def list_objects() -> List[Dict]:
 def list_cluster_events(limit: int = 200) -> List[Dict]:
     """Structured cluster events: node deaths, actor restarts/deaths
     (reference: dashboard/modules/event + src/ray/util/event.h)."""
-    return _gcs("list_events", {"limit": limit})
+    return _client().events.list(limit=limit)
 
 
 def summarize_tasks() -> Dict:

@@ -1,0 +1,56 @@
+"""Typed GCS accessor layer (reference: gcs/gcs_client/accessor.h,
+global_state_accessor.h)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs_client import global_gcs_client
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_accessors_cover_tables(ray_init):
+    gcs = global_gcs_client()
+    assert gcs.ping().get("ok")
+
+    nodes = gcs.nodes.get_all()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    res = gcs.nodes.cluster_resources()
+    assert res["total"].get("CPU", 0) >= 2
+
+    @ray_tpu.remote
+    class Named:
+        def who(self):
+            return "me"
+
+    h = Named.options(name="gcs-client-probe").remote()
+    assert ray_tpu.get(h.who.remote(), timeout=60) == "me"
+    view = gcs.actors.get_by_name("gcs-client-probe")
+    assert view is not None
+    listed = gcs.actors.list()
+    assert any(v.get("name") == "gcs-client-probe" for v in listed)
+    gcs.actors.kill(view["actor_id"])
+
+    gcs.kv.put("test-ns", b"k", b"v")
+    assert gcs.kv.get("test-ns", b"k") == b"v"
+    assert b"k" in gcs.kv.keys("test-ns")
+    gcs.kv.delete("test-ns", b"k")
+    assert gcs.kv.get("test-ns", b"k") is None
+
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    pg = placement_group([{"CPU": 0.1}])
+    assert ray_tpu.wait_placement_group_ready(pg, timeout=60)
+    pgs = gcs.placement_groups.list()
+    assert any(v["pg_id"] == pg.id for v in pgs)
+    remove_placement_group(pg)
+
+
+def test_global_client_requires_init():
+    with pytest.raises(RuntimeError):
+        global_gcs_client()
