@@ -1,9 +1,10 @@
 """SAC: off-policy soft actor-critic with replay.
 
 Reference: rllib/algorithms/sac/sac.py (training_step: store rollouts in
-the replay buffer, SGD on replay batches, polyak target updates) —
-discrete-action scope; the stochastic policy itself explores, so no
-epsilon schedule is needed.
+the replay buffer, SGD on replay batches, polyak target updates).
+Discrete envs use the categorical soft-Q policy; Box envs the
+tanh-Gaussian reparameterized one — either way the stochastic policy
+itself explores, so no epsilon schedule is needed.
 """
 
 from __future__ import annotations
@@ -12,7 +13,7 @@ from typing import Dict
 
 import ray_tpu
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.policy.jax_sac_policy import JaxSACPolicy
+from ray_tpu.rllib.policy.jax_sac_policy import SACPolicy
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
 from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
 
@@ -33,7 +34,7 @@ class SACConfig(AlgorithmConfig):
 
 
 class SAC(Algorithm):
-    policy_cls = JaxSACPolicy
+    policy_cls = SACPolicy
 
     def _extra_defaults(self) -> Dict:
         return dict(SACConfig()._config)

@@ -25,7 +25,22 @@ class RolloutWorker(CollectiveMixin):
         self.config["seed"] = self.config.get("seed", 0) + worker_index
         self.env = env_creator(self.config)
         obs_dim = int(np.prod(self.env.observation_space.shape))
-        num_actions = int(self.env.action_space.n)
+        space = self.env.action_space
+        if hasattr(space, "n"):  # Discrete
+            self._discrete = True
+            num_actions = int(space.n)
+        else:  # Box: actions are float vectors
+            if not getattr(policy_cls, "supports_continuous", False):
+                raise TypeError(
+                    f"{policy_cls.__name__} only supports Discrete "
+                    f"action spaces, got {space} — use an algorithm "
+                    f"with a continuous policy (e.g. SAC)")
+            self._discrete = False
+            num_actions = int(np.prod(space.shape))
+            self._act_shape = space.shape
+            self.config["_continuous"] = True
+            self.config["_act_low"] = np.asarray(space.low, np.float32)
+            self.config["_act_high"] = np.asarray(space.high, np.float32)
         self.policy = policy_cls(obs_dim, num_actions, self.config)
         self.worker_index = worker_index
         self._obs, _ = self.env.reset(seed=self.config["seed"])
@@ -48,11 +63,17 @@ class RolloutWorker(CollectiveMixin):
         for _ in range(horizon):
             action, logp, vf = self.policy.compute_actions(
                 self._obs[None, :])
+            if self._discrete:
+                act_env = int(action[0])
+                act_row = act_env
+            else:
+                act_row = np.asarray(action[0], np.float32)
+                act_env = act_row.reshape(self._act_shape)
             obs2, reward, terminated, truncated, _ = self.env.step(
-                int(action[0]))
+                act_env)
             done = terminated or truncated
             rows[sb.OBS].append(self._obs)
-            rows[sb.ACTIONS].append(int(action[0]))
+            rows[sb.ACTIONS].append(act_row)
             rows[sb.REWARDS].append(float(reward))
             rows[sb.DONES].append(bool(terminated))
             rows[sb.NEXT_OBS].append(obs2)
@@ -83,9 +104,11 @@ class RolloutWorker(CollectiveMixin):
         return SampleBatch.concat_samples(segments)
 
     def _segment(self, rows, start, end, last_value, gamma, lam):
+        act_dtype = np.int32 if self._discrete else np.float32
         seg = SampleBatch({
             sb.OBS: np.asarray(rows[sb.OBS][start:end], np.float32),
-            sb.ACTIONS: np.asarray(rows[sb.ACTIONS][start:end], np.int32),
+            sb.ACTIONS: np.asarray(rows[sb.ACTIONS][start:end],
+                                   act_dtype),
             sb.REWARDS: np.asarray(rows[sb.REWARDS][start:end], np.float32),
             sb.DONES: np.asarray(rows[sb.DONES][start:end], np.bool_),
             sb.NEXT_OBS: np.asarray(rows[sb.NEXT_OBS][start:end],

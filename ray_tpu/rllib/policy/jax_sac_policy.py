@@ -190,3 +190,211 @@ class JaxSACPolicy:
         if "q" in weights:
             self.q_params = jax.tree_util.tree_map(jnp.asarray,
                                                    weights["q"])
+
+
+class _GaussianPiNet(nn.Module):
+    """Tanh-squashed diagonal Gaussian actor head."""
+
+    act_dim: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        mean = nn.Dense(self.act_dim)(h)
+        log_std = jnp.clip(nn.Dense(self.act_dim)(h), -10.0, 2.0)
+        return mean, log_std
+
+
+class _QSANet(nn.Module):
+    """Twin Q(s, a) critics over concatenated state-action input."""
+
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        outs = []
+        for _ in range(2):
+            h = x
+            for width in self.hiddens:
+                h = nn.relu(nn.Dense(width)(h))
+            outs.append(nn.Dense(1)(h)[..., 0])
+        return outs[0], outs[1]
+
+
+class JaxSACGaussianPolicy:
+    """Continuous-action SAC (the reference's primary SAC form,
+    rllib/algorithms/sac/sac_torch_policy.py): tanh-squashed Gaussian
+    reparameterized actor, twin Q(s,a), target entropy -act_dim.  The
+    whole update (critics, actor, temperature, their three adam steps)
+    is one jitted function."""
+
+    supports_continuous = True
+
+    def __init__(self, obs_dim: int, act_dim: int, config: Dict):
+        self.config = config
+        self.act_dim = act_dim
+        low = np.asarray(config.get("_act_low", -np.ones(act_dim)),
+                         np.float32).reshape(-1)
+        high = np.asarray(config.get("_act_high", np.ones(act_dim)),
+                          np.float32).reshape(-1)
+        if not (np.all(np.isfinite(low)) and np.all(np.isfinite(high))):
+            raise ValueError(
+                "tanh-squashed SAC needs a bounded Box action space; "
+                f"got low={low}, high={high} — wrap the env with a "
+                "bounded action wrapper")
+        self._scale = jnp.asarray((high - low) / 2.0)
+        self._mid = jnp.asarray((high + low) / 2.0)
+        hid = tuple(config.get("fcnet_hiddens", (64, 64)))
+        self.pi = _GaussianPiNet(act_dim=act_dim, hiddens=hid)
+        self.q = _QSANet(hiddens=hid)
+        rng = jax.random.PRNGKey(config.get("seed", 0))
+        k1, k2, self._rng = jax.random.split(rng, 3)
+        dummy_o = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy_a = jnp.zeros((1, act_dim), jnp.float32)
+        self.pi_params = self.pi.init(k1, dummy_o)
+        self.q_params = self.q.init(k2, dummy_o, dummy_a)
+        self.target_q_params = self.q_params
+        self.log_alpha = jnp.asarray(
+            np.log(config.get("initial_alpha", 0.1)), jnp.float32)
+        self.target_entropy = config.get("target_entropy",
+                                         -float(act_dim))
+        lr = config.get("lr", 3e-4)
+        self.pi_tx = optax.adam(lr)
+        self.q_tx = optax.adam(lr)
+        self.a_tx = optax.adam(lr)
+        self.pi_opt = self.pi_tx.init(self.pi_params)
+        self.q_opt = self.q_tx.init(self.q_params)
+        self.a_opt = self.a_tx.init(self.log_alpha)
+        self._sample_act = jax.jit(self._sample_act_impl)
+        self._train = jax.jit(self._train_impl)
+
+    # --------------------------------------------------------- sampling
+    def _squash(self, u):
+        return jnp.tanh(u) * self._scale + self._mid
+
+    def _sample_logp(self, params, obs, key):
+        """Reparameterized sample + tanh-corrected log-prob."""
+        mean, log_std = self.pi.apply(params, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(key, mean.shape)
+        logp_u = jnp.sum(
+            -0.5 * ((u - mean) / std) ** 2 - log_std
+            - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+        # Change of variables through tanh (+ the affine scale).
+        logp = logp_u - jnp.sum(
+            jnp.log(self._scale * (1 - jnp.tanh(u) ** 2) + 1e-6),
+            axis=-1)
+        return self._squash(u), logp
+
+    def _sample_act_impl(self, params, obs, key):
+        act, logp = self._sample_logp(params, obs, key)
+        return act, logp
+
+    def compute_actions(self, obs: np.ndarray):
+        self._rng, key = jax.random.split(self._rng)
+        act, logp = self._sample_act(self.pi_params,
+                                     jnp.asarray(obs, jnp.float32), key)
+        zeros = np.zeros(len(obs), np.float32)
+        return np.asarray(act), np.asarray(logp), zeros
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)
+
+    # --------------------------------------------------------- learning
+    def _train_impl(self, pi_params, q_params, target_q, log_alpha,
+                    pi_opt, q_opt, a_opt, batch, key):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        alpha = jnp.exp(log_alpha)
+        obs = batch[sb.OBS]
+        acts = batch[sb.ACTIONS]
+        rew = batch[sb.REWARDS]
+        done = batch[sb.DONES].astype(jnp.float32)
+        nobs = batch[sb.NEXT_OBS]
+        k1, k2 = jax.random.split(key)
+
+        next_a, next_logp = self._sample_logp(pi_params, nobs, k1)
+        tq1, tq2 = self.q.apply(target_q, nobs, next_a)
+        next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+        td_target = jax.lax.stop_gradient(
+            rew + gamma * (1.0 - done) * next_v)
+
+        def q_loss_fn(qp):
+            q1, q2 = self.q.apply(qp, obs, acts)
+            return ((q1 - td_target) ** 2).mean() \
+                + ((q2 - td_target) ** 2).mean()
+
+        q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_params)
+        q_updates, q_opt = self.q_tx.update(q_grads, q_opt, q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        def pi_loss_fn(pp):
+            a, logp = self._sample_logp(pp, obs, k2)
+            q1, q2 = self.q.apply(q_params, obs, a)
+            qmin = jnp.minimum(q1, q2)
+            return (alpha * logp - qmin).mean(), logp.mean()
+
+        (pi_loss, mean_logp), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True)(pi_params)
+        pi_updates, pi_opt = self.pi_tx.update(pi_grads, pi_opt,
+                                               pi_params)
+        pi_params = optax.apply_updates(pi_params, pi_updates)
+
+        def alpha_loss_fn(la):
+            return -jnp.exp(la) * jax.lax.stop_gradient(
+                mean_logp + self.target_entropy)
+
+        a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+        a_updates, a_opt = self.a_tx.update(a_grad, a_opt, log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+        stats = {"q_loss": q_loss, "policy_loss": pi_loss,
+                 "alpha_loss": a_loss, "alpha": jnp.exp(log_alpha),
+                 "entropy": -mean_logp,
+                 "total_loss": q_loss + pi_loss}
+        return (pi_params, q_params, log_alpha, pi_opt, q_opt, a_opt,
+                stats)
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        self._rng, key = jax.random.split(self._rng)
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (self.pi_params, self.q_params, self.log_alpha, self.pi_opt,
+         self.q_opt, self.a_opt, stats) = self._train(
+            self.pi_params, self.q_params, self.target_q_params,
+            self.log_alpha, self.pi_opt, self.q_opt, self.a_opt,
+            jbatch, key)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self, tau: float | None = None):
+        tau = self.config.get("tau", 0.995) if tau is None else tau
+        self.target_q_params = jax.tree_util.tree_map(
+            lambda t, s: tau * t + (1.0 - tau) * s,
+            self.target_q_params, self.q_params)
+
+    def get_weights(self):
+        return {"pi": jax.tree_util.tree_map(np.asarray, self.pi_params),
+                "q": jax.tree_util.tree_map(np.asarray, self.q_params)}
+
+    def set_weights(self, weights):
+        self.pi_params = jax.tree_util.tree_map(jnp.asarray,
+                                                weights["pi"])
+        if "q" in weights:
+            self.q_params = jax.tree_util.tree_map(jnp.asarray,
+                                                   weights["q"])
+
+
+class SACPolicy:
+    """Dispatching constructor: discrete envs get the categorical
+    soft-Q policy, Box envs the tanh-Gaussian one (RolloutWorker marks
+    continuous spaces with config['_continuous'])."""
+
+    supports_continuous = True
+
+    def __new__(cls, obs_dim: int, num_actions: int, config: Dict):
+        if config.get("_continuous"):
+            return JaxSACGaussianPolicy(obs_dim, num_actions, config)
+        return JaxSACPolicy(obs_dim, num_actions, config)
