@@ -59,11 +59,23 @@ class GPTConfig:
     num_microbatches: int = 1   # pipeline microbatches (used when pp > 1)
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full": checkpoint the whole layer (min memory, recomputes
+    # attention — including the flash forward — in the backward).
+    # "ffn": checkpoint only the ffn branch; attention residuals
+    # (q/k/v/out/lse) are stored, so the quadratic-cost flash forward
+    # never re-runs.  ~1/3 less recompute at long seq for
+    # O(B*T*D) extra HBM per layer.
+    remat_mode: str = "full"
     # Pallas flash attention for long sequences (TPU only; falls back to
     # the einsum reference off-TPU or on non-tiling shapes).
     use_flash: bool = True
     # False = bidirectional attention (encoder models, e.g. models/vit).
     causal: bool = True
+
+    def __post_init__(self):
+        if self.remat_mode not in ("full", "ffn"):
+            raise ValueError(f"remat_mode must be 'full' or 'ffn', "
+                             f"got {self.remat_mode!r}")
 
     @property
     def head_dim(self) -> int:
@@ -237,15 +249,41 @@ def _moe_ffn(x, p, cfg, active, sizes):
 
 
 def _make_layer_fn(cfg: GPTConfig, active, sizes):
-    def layer(x, lp):
-        a = _attention(_rmsnorm(x, lp["ln1"]), lp, cfg, active, sizes)
-        x = x + a
+    def ffn_branch(x, lp):
         h = _rmsnorm(x, lp["ln2"])
         if cfg.n_experts:
             y = _moe_ffn(h, lp, cfg, active, sizes)
         else:
             y = _dense_ffn(h, lp, cfg, active)
-        return x + y, None
+        return x + y
+
+    if cfg.remat and cfg.remat_mode == "ffn":
+        # With the flash kernel, attention stays un-rematted (its
+        # residuals — incl. the flash lse — are O(B*T*D) and stored, so
+        # the O(T^2) forward never re-runs); the ffn branch and the
+        # pre-attention norm recompute (the norm's checkpoint avoids
+        # storing stacked fp32 upcasts of x).
+        ffn_ckpt = jax.checkpoint(ffn_branch)
+        norm_ckpt = jax.checkpoint(_rmsnorm)
+
+        def attn_branch(x, lp):
+            return x + _attention(norm_ckpt(x, lp["ln1"]), lp, cfg,
+                                  active, sizes)
+
+        if not (cfg.use_flash and jax.default_backend() == "tpu"):
+            # The einsum attention would store O(T^2) probabilities per
+            # layer if left un-rematted — checkpoint it too (two-segment
+            # remat instead of whole-layer).
+            attn_branch = jax.checkpoint(attn_branch)
+
+        def layer(x, lp):
+            return ffn_ckpt(attn_branch(x, lp), lp), None
+        return layer
+
+    def layer(x, lp):
+        a = _attention(_rmsnorm(x, lp["ln1"]), lp, cfg, active, sizes)
+        x = x + a
+        return ffn_branch(x, lp), None
     if cfg.remat:
         layer = jax.checkpoint(layer)
     return layer

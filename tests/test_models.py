@@ -64,6 +64,30 @@ def test_train_step_reduces_loss():
     assert float(m["loss"]) < float(m0["loss"])
 
 
+def test_remat_modes_agree():
+    """remat=False, remat_mode='full', and remat_mode='ffn' are the same
+    math — gradients must match exactly (checkpointing only changes the
+    memory/recompute schedule)."""
+    import optax
+    toks = _tokens(b=2, t=17)
+    losses, grads = [], []
+    for remat, mode in ((False, "full"), (True, "full"), (True, "ffn")):
+        cfg = _cfg(remat=remat, remat_mode=mode)
+        params = gpt.init_params(cfg, KEY)
+        loss, g = jax.value_and_grad(
+            lambda p: gpt.loss_fn(p, toks, cfg))(params)
+        losses.append(float(loss))
+        grads.append(g)
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    assert losses[0] == pytest.approx(losses[2], rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(grads[0]),
+                    jax.tree_util.tree_leaves(grads[2])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="remat_mode"):
+        _cfg(remat_mode="fnn")
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__
     fn, args = __graft_entry__.entry()
