@@ -46,10 +46,13 @@ def _ensure_loop():
 def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
          num_gpus=None, resources=None, object_store_memory=None,
          namespace: str = "default", ignore_reinit_error: bool = False,
+         local_mode: bool = False,
          _system_config: dict | None = None, log_to_driver: bool = True,
          runtime_env=None, **kwargs):
     """Start a cluster on this machine (address=None) or connect to one
-    ("host:gcs_port")."""
+    ("host:gcs_port").  local_mode=True runs everything inline in this
+    process (reference: ray.init(local_mode=True)) — no workers, no
+    store; for debugging and runtime-free unit tests."""
     global _head_node
     with _state_lock:
         if worker_mod.global_worker is not None and \
@@ -60,6 +63,12 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
                                "(use ignore_reinit_error=True)")
         if _system_config:
             apply_system_config(_system_config)
+        if local_mode:
+            from ray_tpu._private.local_mode import LocalModeWorker
+            w = LocalModeWorker(namespace=namespace)
+            worker_mod.global_worker = w
+            atexit.register(shutdown)
+            return w
         if num_tpus is None:
             num_tpus = num_gpus
         loop = _ensure_loop()
@@ -190,12 +199,20 @@ def kill(actor, *, no_restart=True):
     from ray_tpu.actor import ActorHandle
     if not isinstance(actor, ActorHandle):
         raise TypeError("ray_tpu.kill() expects an actor handle")
+    w = _worker()
+    if getattr(w, "mode", None) == "local":
+        w.kill_actor_local(actor._ray_actor_id)
+        return
     _gcs().actors.kill(actor._ray_actor_id, no_restart=no_restart)
 
 
 def get_actor(name: str, namespace: str = "default"):
     from ray_tpu.actor import ActorHandle
-    view = _gcs().actors.get_by_name(name, namespace)
+    w = _worker()
+    if getattr(w, "mode", None) == "local":
+        view = w.get_named_actor(name, namespace)
+    else:
+        view = _gcs().actors.get_by_name(name, namespace)
     if view is None:
         raise ValueError(f"no actor named '{name}'")
     return ActorHandle(view["actor_id"], view.get("class_name", ""),

@@ -1,0 +1,229 @@
+"""Local mode: the whole API surface executed inline in one process.
+
+Reference: ray.init(local_mode=True) (python/ray/_private/worker.py) and
+the C++ mock layer (src/mock/ray) — a runtime-free seam for debugging
+user code (breakpoints work, stack traces are local, no worker spawn
+latency) and for unit tests that don't want a cluster.  Tasks run
+synchronously at submission; actors are plain objects; the object store
+is a dict.  GCS-backed verbs (nodes, placement groups, named actors
+across processes) raise a clear error.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Callable, Dict, Tuple
+
+from ray_tpu import exceptions as rexc
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class _ExecCtx:
+    task_id = None
+
+
+def _resolve(obj, store):
+    """Replace TOP-LEVEL ObjectRef args with their stored values (the
+    real runtime's semantics: nested refs inside containers stay refs
+    and resolve via get/await)."""
+    if isinstance(obj, ObjectRef):
+        return store[obj.id]
+    if isinstance(obj, list):
+        return [store[o.id] if isinstance(o, ObjectRef) else o
+                for o in obj]
+    if isinstance(obj, dict):
+        return {k: store[v.id] if isinstance(v, ObjectRef) else v
+                for k, v in obj.items()}
+    return obj
+
+
+class _Stored:
+    """Either a value or a captured exception (re-raised at get)."""
+
+    __slots__ = ("value", "error")
+
+    def __init__(self, value=None, error=None):
+        self.value = value
+        self.error = error
+
+
+class LocalModeWorker:
+    """Duck-type of CoreWorker for the verbs the public API uses."""
+
+    mode = "local"
+    connected = True
+
+    def __init__(self, namespace: str = "default"):
+        self.namespace = namespace
+        self._store: Dict[ObjectID, Any] = {}
+        self._errors: Dict[ObjectID, Exception] = {}
+        self._functions: Dict[bytes, Callable] = {}
+        self._actors: Dict[ActorID, Any] = {}
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+        self._actor_meta: Dict[ActorID, str] = {}
+        self._lock = threading.RLock()
+        # RuntimeContext surface (api.get_runtime_context reads these).
+        self.job_id = JobID.from_random()
+        self.worker_id = None
+        self.node_id = NodeID.from_random()
+        self.actor_id = None
+        self.exec_ctx = _ExecCtx()
+
+    # ------------------------------------------------------------ store
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.from_random()
+        with self._lock:
+            self._store[oid] = value
+        return ObjectRef(oid)
+
+    def _store_result(self, value, error=None):
+        oid = ObjectID.from_random()
+        with self._lock:
+            if error is not None:
+                self._errors[oid] = error
+            else:
+                self._store[oid] = value
+        return ObjectRef(oid)
+
+    def get(self, refs, *, timeout=None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = []
+        with self._lock:
+            for r in refs:
+                if r.id in self._errors:
+                    raise self._errors[r.id]
+                if r.id not in self._store:
+                    raise rexc.ObjectLostError(
+                        r.hex(), "unknown object in local mode")
+                out.append(self._store[r.id])
+        return out[0] if single else out
+
+    def wait(self, refs, *, num_returns=1, timeout=None,
+             fetch_local=True):
+        # Everything is materialized at submission in local mode.
+        return refs[:num_returns], refs[num_returns:]
+
+    # ------------------------------------------------------------ tasks
+    def export_function(self, fn) -> bytes:
+        fn_id = uuid.uuid4().bytes
+        self._functions[fn_id] = fn
+        return fn_id
+
+    def submit_task(self, fn_id: bytes, args, kwargs, opts: dict):
+        fn = self._functions[fn_id]
+        num_returns = opts.get("num_returns", 1)
+        try:
+            with self._lock:
+                args = _resolve(list(args), self._store)
+                kwargs = _resolve(dict(kwargs), self._store)
+            result = fn(*args, **kwargs)
+            err = None
+        except Exception as e:
+            result, err = None, e
+        if err is not None or num_returns == 1:
+            refs = [self._store_result(result, err)]
+            if num_returns != 1:
+                refs = refs * num_returns
+            return refs
+        if num_returns == 0:
+            return []
+        vals = list(result)
+        if len(vals) != num_returns:
+            raise ValueError(f"task returned {len(vals)} values, "
+                             f"expected {num_returns}")
+        return [self._store_result(v) for v in vals]
+
+    def cancel_task(self, ref, force: bool = False) -> bool:
+        return False  # tasks finish at submission; nothing to cancel
+
+    # ----------------------------------------------------------- actors
+    def create_actor(self, class_id: bytes, init_args, init_kwargs,
+                     opts: dict) -> ActorID:
+        cls = self._functions[class_id]
+        with self._lock:
+            init_args = _resolve(list(init_args), self._store)
+            init_kwargs = _resolve(dict(init_kwargs), self._store)
+        instance = cls(*init_args, **init_kwargs)
+        actor_id = ActorID.from_random()
+        self._actors[actor_id] = instance
+        self._actor_meta[actor_id] = opts.get("class_name",
+                                              cls.__name__)
+        name = opts.get("name")
+        if name:
+            self._named[(opts.get("namespace", self.namespace),
+                         name)] = actor_id
+        return actor_id
+
+    def submit_actor_task(self, actor_id, actor_addr, method, args,
+                          kwargs, num_returns=1, opts=None):
+        instance = self._actors.get(actor_id)
+        if instance is None:
+            raise rexc.ActorDiedError(actor_id, "actor killed "
+                                                "(local mode)")
+        try:
+            with self._lock:
+                args = _resolve(list(args), self._store)
+                kwargs = _resolve(dict(kwargs), self._store)
+            bound = getattr(instance, method)
+            result = bound(*args, **kwargs)
+            import inspect
+            if inspect.iscoroutine(result):
+                import asyncio
+                result = asyncio.new_event_loop().run_until_complete(
+                    result)
+            err = None
+        except rexc.ActorDiedError:
+            raise
+        except Exception as e:
+            result, err = None, e
+        if err is not None or num_returns == 1:
+            refs = [self._store_result(result, err)]
+            if num_returns not in (0, 1):
+                refs = refs * num_returns  # same error at every position
+            return refs
+        vals = list(result)
+        if len(vals) != num_returns:
+            raise ValueError(f"actor method returned {len(vals)} values, "
+                             f"expected {num_returns}")
+        return [self._store_result(v) for v in vals]
+
+    async def get_async(self, ref):
+        """`await ref` inside async methods: the value is already local."""
+        return self.get(ref)
+
+    def kill_actor_local(self, actor_id):
+        self._actors.pop(actor_id, None)
+        for key, aid in list(self._named.items()):
+            if aid == actor_id:
+                del self._named[key]
+
+    def get_named_actor(self, name: str, namespace: str):
+        aid = self._named.get((namespace, name))
+        if aid is None or aid not in self._actors:
+            return None
+        return {"actor_id": aid,
+                "class_name": self._actor_meta.get(aid, ""),
+                "addr": None}
+
+    # ------------------------------------------------------- lifecycle
+    def shutdown(self):
+        with self._lock:
+            self._store.clear()
+            self._actors.clear()
+            self._named.clear()
+
+    def _unsupported(self, what: str):
+        raise RuntimeError(
+            f"{what} is not available in local mode "
+            f"(ray_tpu.init(local_mode=True) runs everything inline "
+            f"in this process); start a real cluster for it")
+
+    def _gcs_request(self, method, body=None):
+        self._unsupported(f"GCS rpc {method!r}")
+
+    def _run(self, coro):
+        self._unsupported("runtime coroutines")

@@ -1,0 +1,97 @@
+"""local_mode=True: the inline runtime-free execution seam (reference:
+ray.init(local_mode=True); the mock layer role of src/mock/ray)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def local():
+    ray_tpu.init(local_mode=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_tasks_run_inline(local):
+    calls = []
+
+    @ray_tpu.remote
+    def f(x):
+        calls.append(x)  # visible: same process, no pickling round-trip
+        return x + 1
+
+    ref = f.remote(1)
+    assert calls == [1]  # executed at submission
+    assert ray_tpu.get(ref) == 2
+    nested = f.remote(ref)
+    assert ray_tpu.get(nested) == 3  # refs resolve as args
+
+
+def test_put_get_wait(local):
+    r = ray_tpu.put(np.arange(4))
+    np.testing.assert_array_equal(ray_tpu.get(r), np.arange(4))
+    ready, pending = ray_tpu.wait([r], num_returns=1)
+    assert ready == [r] and not pending
+
+
+def test_actor_lifecycle_and_named(local):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, n0):
+            self.n = n0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.options(name="ctr").remote(10)
+    assert ray_tpu.get(c.add.remote(5)) == 15
+    again = ray_tpu.get_actor("ctr")
+    assert ray_tpu.get(again.add.remote(1)) == 16
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.add.remote(1))
+
+
+def test_task_errors_surface_at_get(local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("inline failure")
+
+    ref = boom.remote()
+    with pytest.raises(ValueError, match="inline failure"):
+        ray_tpu.get(ref)
+
+
+def test_multiple_returns(local):
+    @ray_tpu.remote
+    def pair():
+        return 1, 2
+
+    a, b = pair.options(num_returns=2).remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_cluster_verbs_raise_clearly(local):
+    with pytest.raises(RuntimeError, match="local mode"):
+        ray_tpu.nodes()
+
+
+def test_runtime_context_and_await(local):
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_job_id()) > 0
+    assert ctx.get_node_id()
+    assert ctx.get_actor_id() is None
+
+    @ray_tpu.remote
+    class Awaiter:
+        async def pull(self, refs):
+            # Nested refs stay refs (real-runtime semantics) and
+            # resolve via await.
+            return await refs[0] + 1
+
+    a = Awaiter.remote()
+    ref = ray_tpu.put(41)
+    assert ray_tpu.get(a.pull.remote([ref])) == 42
