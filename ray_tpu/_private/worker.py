@@ -37,6 +37,7 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.shm_store import StoreMapping
 from ray_tpu._private.task_spec import (ActorCreationSpec, ActorTaskSpec,
                                         TaskSpec)
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -1257,6 +1258,10 @@ class CoreWorker:
         self._profile_events.append(event)
         if len(self._profile_events) > 10000:
             del self._profile_events[:5000]
+        # Optional live span export (no-op unless this process called
+        # tracing.enable_tracing — reference: tracing_helper's lazily
+        # enabled otel spans).
+        _tracing.maybe_export(event)
 
     def _load_function(self, fn_id: bytes):
         fn = self._fn_cache.get(fn_id)

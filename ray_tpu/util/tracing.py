@@ -1,0 +1,71 @@
+"""Optional span export to OpenTelemetry (or any tracer-shaped object).
+
+Reference: python/ray/util/tracing/tracing_helper.py — otel is imported
+lazily (:35-59) and spans wrap task/actor submission+execution, with
+context propagated inside the TaskSpec.  Here the propagation already
+exists (trace ids ride every spec and land in `ray_tpu.timeline()`
+chrome-trace args); this module bridges those same events to a live
+tracer.  Enable per process:
+
+    from ray_tpu.util import tracing
+    tracing.enable_tracing()            # otel global tracer, if installed
+    tracing.enable_tracing(my_tracer)   # or any object with start_span()
+
+Worker processes inherit nothing automatically — enable inside the task/
+actor (e.g. from the runtime env) exactly as the reference requires its
+`--tracing-startup-hook`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_tracer = None
+
+
+def enable_tracing(tracer: Optional[Any] = None) -> None:
+    """Register a tracer for this process.
+
+    tracer contract (a subset of otel's Tracer): ``span =
+    tracer.start_span(name, attributes=..., start_time=ns)`` then
+    ``span.end(end_time=ns)``.  With tracer=None, uses
+    ``opentelemetry.trace.get_tracer("ray_tpu")`` (raises ImportError if
+    the optional dependency is absent, mirroring the reference's lazy
+    import)."""
+    global _tracer
+    if tracer is None:
+        from opentelemetry import trace as ot  # optional dependency
+        tracer = ot.get_tracer("ray_tpu")
+    _tracer = tracer
+
+
+def disable_tracing() -> None:
+    global _tracer
+    _tracer = None
+
+
+def is_enabled() -> bool:
+    return _tracer is not None
+
+
+def maybe_export(event: Dict) -> None:
+    """Export one chrome-trace complete event ({ts,dur} in us; args
+    carry trace_id/span_id/parent_id) as a span.  No-op unless
+    enable_tracing() ran in this process; never raises into the
+    runtime."""
+    t = _tracer
+    if t is None:
+        return
+    try:
+        start_ns = int(event["ts"] * 1e3)
+        end_ns = int((event["ts"] + event["dur"]) * 1e3)
+        attrs = {"ray_tpu.category": event.get("cat", "")}
+        for k in ("trace_id", "span_id", "parent_id"):
+            v = (event.get("args") or {}).get(k)
+            if v:
+                attrs[f"ray_tpu.{k}"] = v
+        span = t.start_span(event["name"], attributes=attrs,
+                            start_time=start_ns)
+        span.end(end_time=end_ns)
+    except Exception:
+        pass
