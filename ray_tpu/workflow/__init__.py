@@ -204,3 +204,42 @@ def delete(workflow_id: str):
     import shutil
     shutil.rmtree(os.path.join(_storage_root(), workflow_id),
                   ignore_errors=True)
+
+
+class EventListener:
+    """External-event hookup (reference: workflow/event_listener.py
+    EventListener.poll_for_event + api.py wait_for_event).  Subclass and
+    implement ``poll_for_event`` (sync or async) to block until the
+    event arrives; its return value becomes the node's output."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def wait_for_event(event_listener_cls, *args, **kwargs) -> FunctionNode:
+    """A DAG node that completes when the listener's event arrives.
+
+    The received payload is checkpointed like any task output, so a
+    resumed workflow replays it WITHOUT waiting for the event again —
+    the exactly-once contract events exist for (reference:
+    workflow/api.py wait_for_event)."""
+    if not (isinstance(event_listener_cls, type)
+            and issubclass(event_listener_cls, EventListener)):
+        raise TypeError("wait_for_event expects an EventListener "
+                        "subclass")
+
+    def _wait(*a, **kw):
+        import asyncio
+        import inspect
+        listener = event_listener_cls()
+        res = listener.poll_for_event(*a, **kw)
+        if inspect.isawaitable(res):
+            loop = asyncio.new_event_loop()
+            try:
+                res = loop.run_until_complete(res)
+            finally:
+                loop.close()
+        return res
+
+    _wait.__name__ = f"event_{event_listener_cls.__name__}"
+    return FunctionNode(_wait, args, kwargs)

@@ -68,3 +68,41 @@ def test_workflow_resume_skips_completed_tasks(wf_env):
     assert workflow.run(dag2, 1, workflow_id="w2") == 200
     assert len(open(calls_file).read().splitlines()) == 1  # still one
     os.remove(calls_file)
+
+
+def test_wait_for_event_durable(wf_env):
+    """An event node blocks until its listener fires; once received the
+    payload is checkpointed, so re-running the workflow does not wait
+    again (reference: workflow events exactly-once contract)."""
+    flag = os.path.join(wf_env, "fire-event")
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path):
+            import time as _t
+            for _ in range(200):
+                if os.path.exists(path):
+                    with open(path) as f:
+                        return f.read()
+                _t.sleep(0.1)
+            raise TimeoutError("event never fired")
+
+    @ray_tpu.remote
+    def combine(payload, y):
+        return f"{payload}+{y}"
+
+    dag = combine.bind(workflow.wait_for_event(FileEvent, flag), 7)
+    ref = workflow.run_async(dag, workflow_id="wev")
+    _, pending = ray_tpu.wait([ref], timeout=1.5)
+    assert pending, "workflow finished before the event fired"
+    with open(flag, "w") as f:
+        f.write("go")
+    assert ray_tpu.get(ref, timeout=120) == "go+7"
+    # Durability: the event payload replays from its checkpoint even
+    # though the event source is gone.
+    os.remove(flag)
+    assert workflow.run(dag, workflow_id="wev") == "go+7"
+
+
+def test_wait_for_event_type_check(wf_env):
+    with pytest.raises(TypeError):
+        workflow.wait_for_event(object)
