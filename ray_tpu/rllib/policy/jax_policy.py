@@ -34,7 +34,25 @@ class JaxPolicy:
         self.opt_state = self.tx.init(self.params)
         self._rng = jax.random.PRNGKey(config.get("seed", 0) + 1)
         self._forward = jax.jit(self.model.apply)
-        self._train_step = jax.jit(self._train_step_impl)
+        # Multi-chip learner (reference: the multi-GPU tower stack,
+        # rllib/execution/multi_gpu_learner_thread.py — re-designed as
+        # SPMD): config["learner_dp"] > 1 shards each SGD minibatch over
+        # a dp mesh; params/opt replicate, XLA inserts the gradient
+        # psum.  Same math as single-chip (oracle-tested).
+        self._mesh = None
+        dp = int(config.get("learner_dp", 0) or 0)
+        if dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+            self._mesh = make_mesh(MeshSpec(dp=dp))
+            batch_sh = NamedSharding(self._mesh, P("dp"))
+            repl = NamedSharding(self._mesh, P())
+            self._train_step = jax.jit(
+                self._train_step_impl,
+                in_shardings=(repl, repl, batch_sh),
+                out_shardings=(repl, repl, repl))
+        else:
+            self._train_step = jax.jit(self._train_step_impl)
 
     # ------------------------------------------------------------ acting
     def compute_actions(self, obs: np.ndarray) \
@@ -94,6 +112,17 @@ class JaxPolicy:
 
     def learn_on_batch(self, batch: sb.SampleBatch) -> Dict[str, float]:
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        if self._mesh is not None:
+            # Exact-parity contract with the single-chip learner: rows
+            # must shard evenly over dp (silent trimming would change
+            # the gradient).
+            dp = self._mesh.devices.size
+            rows = next(iter(jbatch.values())).shape[0]
+            if rows % dp != 0:
+                raise ValueError(
+                    f"minibatch of {rows} rows does not divide over "
+                    f"learner_dp={dp}; pick sgd_minibatch_size as a "
+                    f"multiple of learner_dp")
         self.params, self.opt_state, stats = self._train_step(
             self.params, self.opt_state, jbatch)
         return {k: float(v) for k, v in stats.items()}
