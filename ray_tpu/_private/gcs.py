@@ -52,6 +52,11 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.load = 0  # queued lease count reported by the raylet
+        self.pending_shapes: list = []
+        # Versioned resource sync (reference: ray_syncer.h).
+        self.sync_version = 0
+        self.sync_beats = 0
+        self.sync_payloads = 0
 
     def view(self):
         return {
@@ -62,6 +67,11 @@ class NodeInfo:
             "labels": self.labels,
             "alive": self.alive,
             "load": self.load,
+            # Versioned-sync introspection (beats = all heartbeats,
+            # payloads = beats that carried a resource snapshot).
+            "sync_version": self.sync_version,
+            "sync_beats": self.sync_beats,
+            "sync_payloads": self.sync_payloads,
         }
 
 
@@ -299,16 +309,22 @@ class GcsServer:
         return {"ok": True, "cluster_nodes": [n.view() for n in self.nodes.values()]}
 
     async def rpc_heartbeat(self, conn, body):
+        """Liveness + versioned resource sync: payload-free beats just
+        refresh liveness; beats carrying a payload advance the node's
+        acked sync version (reference: ray_syncer.h versioned
+        snapshots)."""
         node = self.nodes.get(body["node_id"])
         if node is None:
             return {"ok": False, "reason": "unknown node (gcs restarted?)"}
         node.last_heartbeat = time.monotonic()
         if "available" in body:
             node.available_resources = body["available"]
-        if "load" in body:
-            node.load = body["load"]
-        node.pending_shapes = body.get("pending_shapes", [])
-        return {"ok": True}
+            node.load = body.get("load", node.load)
+            node.pending_shapes = body.get("pending_shapes", [])
+            node.sync_version = body.get("version", 0)
+            node.sync_payloads += 1
+        node.sync_beats += 1
+        return {"ok": True, "acked_version": node.sync_version}
 
     async def rpc_get_resource_demands(self, conn, body):
         """Aggregate demand for the autoscaler: queued lease shapes from

@@ -1068,47 +1068,62 @@ class Raylet:
 
     # ------------------------------------------------------------- lifecycle
     async def _heartbeat_loop(self):
-        period = cfg.heartbeat_period_ms / 1000.0
+        """Versioned-snapshot resource sync (reference: RaySyncer,
+        common/ray_syncer/ray_syncer.h:88 — reporters version their
+        snapshots; only versions the receiver hasn't acked travel).
+
+        Every tick sends a liveness beat carrying just (node_id,
+        version); the resource payload is attached only while the GCS's
+        acked version lags the local one.  A restarted GCS acks 0, so
+        the next beat automatically carries a full snapshot."""
         report_period = cfg.resource_report_period_ms / 1000.0
-        last_beat = 0.0
+        beat_period = cfg.heartbeat_period_ms / 1000.0
         last_report = None
-        last_full = 0.0
+        last_beat = 0.0
+        self._sync_version = 0
+        self._gcs_acked_version = -1
         while not self._shutdown:
             await asyncio.sleep(report_period)
-            now = time.monotonic()
-            if now - last_beat < report_period:
-                continue
-            last_beat = now
             try:
-                body = {"node_id": self.node_id}
                 report = (dict(self.available), self._load(),
                           [dict(p["resources"])
                            for p in self.pending_leases[:32]])
-                # Versioned-sync economy (reference: ray_syncer.h:88 —
-                # only changed snapshots travel): unchanged resource
-                # state sends a liveness-only beat at the slow period;
-                # the full payload goes when something moved.
-                if report == last_report and now - last_full < period:
-                    continue  # nothing changed; skip this fast tick
-                body.update({
-                    "available": report[0],
-                    "load": report[1],
-                    # Resource shapes of queued leases: the autoscaler's
-                    # demand signal (reference: ResourceLoad feeding
-                    # LoadMetrics).
-                    "pending_shapes": report[2],
-                })
-                last_report = report
-                last_full = now
+                if report != last_report:
+                    self._sync_version += 1
+                    last_report = report
+                need_payload = \
+                    self._gcs_acked_version < self._sync_version
+                now = time.monotonic()
+                # Payload deltas ride the fast tick; liveness-only beats
+                # ride the slow heartbeat period (an idle node costs one
+                # tiny RPC per heartbeat_period_ms).
+                if not need_payload and now - last_beat < beat_period:
+                    continue
+                last_beat = now
+                body = {"node_id": self.node_id,
+                        "version": self._sync_version}
+                if need_payload:
+                    body.update({
+                        "available": report[0],
+                        "load": report[1],
+                        # Resource shapes of queued leases: the
+                        # autoscaler's demand signal (reference:
+                        # ResourceLoad feeding LoadMetrics).
+                        "pending_shapes": report[2],
+                    })
                 reply = await self.gcs.request("heartbeat", body)
-                if not reply.get("ok") and "unknown node" in \
-                        reply.get("reason", ""):
+                if reply.get("ok"):
+                    self._gcs_acked_version = reply.get(
+                        "acked_version", self._gcs_acked_version)
+                elif "unknown node" in reply.get("reason", ""):
                     # GCS restarted and lost the node table: re-register
                     # (reference: NotifyGCSRestart node_manager.proto:343).
+                    self._gcs_acked_version = -1
                     await self._reconnect_gcs()
             except Exception:
                 if self._shutdown:
                     return
+                self._gcs_acked_version = -1
                 await self._reconnect_gcs()
 
     def _register_body(self):

@@ -34,13 +34,22 @@ class JaxPolicy:
         self.opt_state = self.tx.init(self.params)
         self._rng = jax.random.PRNGKey(config.get("seed", 0) + 1)
         self._forward = jax.jit(self.model.apply)
-        # Multi-chip learner (reference: the multi-GPU tower stack,
-        # rllib/execution/multi_gpu_learner_thread.py — re-designed as
-        # SPMD): config["learner_dp"] > 1 shards each SGD minibatch over
-        # a dp mesh; params/opt replicate, XLA inserts the gradient
-        # psum.  Same math as single-chip (oracle-tested).
         self._mesh = None
-        dp = int(config.get("learner_dp", 0) or 0)
+        self._train_step = None
+
+    def _ensure_train_step(self):
+        """Build the (possibly dp-sharded) SGD step on first use.
+
+        Multi-chip learner (reference: the multi-GPU tower stack,
+        rllib/execution/multi_gpu_learner_thread.py — re-designed as
+        SPMD): config["learner_dp"] > 1 shards each SGD minibatch over a
+        dp mesh; params/opt replicate, XLA inserts the gradient psum.
+        Same math as single-chip (oracle-tested).  Built lazily so
+        sampling-only rollout workers — whose hosts may not even have
+        learner_dp devices — never construct the mesh."""
+        if self._train_step is not None:
+            return
+        dp = int(self.config.get("learner_dp", 0) or 0)
         if dp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from ray_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -111,6 +120,7 @@ class JaxPolicy:
         return params, opt_state, stats
 
     def learn_on_batch(self, batch: sb.SampleBatch) -> Dict[str, float]:
+        self._ensure_train_step()
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self._mesh is not None:
             # Exact-parity contract with the single-chip learner: rows
