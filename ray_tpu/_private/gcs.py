@@ -465,7 +465,9 @@ class GcsServer:
         if not subs:
             return
         dead = []
-        for conn in subs:
+        # Snapshot: the awaits below yield, and concurrent
+        # subscribe/disconnect handlers mutate the live set.
+        for conn in list(subs):
             if conn.closed:
                 dead.append(conn)
                 continue

@@ -112,6 +112,7 @@ class Raylet:
         # per-instance pull dedup (a class attribute would be shared across
         # the in-process multi-raylet test Cluster)
         self._pulls_inflight: dict = {}
+        self._push_recv: dict = {}  # oid -> (arena offset, start ts)
         # pins held on behalf of each client conn: id(conn) -> {oid: count}
         self._client_pins: dict[int, dict[bytes, int]] = {}
 
@@ -799,12 +800,9 @@ class Raylet:
             data = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: open(path, "rb").read())
             self.mapping.slice(off, size)[:] = data
-            self.store.seal(oid)
-            self.store.release(oid)  # restored copy is evictable (disk
-            # copy remains the primary until os_delete)
-            for w in self.seal_waiters.pop(oid, []):
-                if not w.done():
-                    w.set_result(None)
+            # Restored copy is evictable (the disk copy remains the
+            # primary until os_delete).
+            self._seal_release_notify(oid)
             fut.set_result(True)
             return True
         except Exception as e:
@@ -943,11 +941,7 @@ class Raylet:
                 return False
             dest[pos:pos + n] = data["data"]
             pos += n
-        self.store.seal(oid)
-        self.store.release(oid)
-        for fut in self.seal_waiters.pop(oid, []):
-            if not fut.done():
-                fut.set_result(None)
+        self._seal_release_notify(oid)
         return True
 
     async def rpc_os_stat(self, conn, body):
@@ -1028,6 +1022,113 @@ class Raylet:
 
     async def rpc_os_contains(self, conn, body):
         return {"contains": self.store.contains(body["oid"])}
+
+    # ---------------------------------------------------------- push path
+    # Reference: the PushManager half of the object manager
+    # (src/ray/object_manager/push_manager.h) — the owner side streams
+    # chunks unsolicited so broadcast-shaped flows (weight sync, large
+    # shared args) pre-position copies instead of N cold pulls.
+
+    def _seal_release_notify(self, oid):
+        """Seal a transferred-in copy, drop the creator pin, and wake
+        seal waiters (shared by the pull, restore, and push receive
+        paths)."""
+        self.store.seal(oid)
+        self.store.release(oid)
+        for fut in self.seal_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def rpc_os_push_to(self, conn, body):
+        """Replicate a local sealed object to peer raylets (targets are
+        node ids).  Transfers run concurrently — one slow peer doesn't
+        serialize the broadcast."""
+        oid = body["oid"]
+        results = await asyncio.gather(
+            *(self._push_object(oid, node_id)
+              for node_id in body["targets"]))
+        pushed, failed = [], []
+        for node_id, ok in zip(body["targets"], results):
+            (pushed if ok else failed).append(node_id.hex())
+        return {"pushed": pushed, "failed": failed}
+
+    async def _push_object(self, oid, target_node_id) -> bool:
+        got = self.store.get(oid)  # pins while we stream
+        if got is None:
+            # Spilled locally? Restore, then stream (the pull path
+            # serves spilled objects too).
+            if oid in self.spilled and await self._restore_spilled(oid):
+                got = self.store.get(oid)
+            if got is None:
+                return False
+        offset, size, sealed = got
+        if not sealed:
+            self.store.release(oid)
+            return False
+        try:
+            peer = await self._peer(target_node_id)
+            if peer is None:
+                return False
+            chunk = cfg.fetch_chunk_bytes
+            pos = 0
+            while pos < size:
+                n = min(chunk, size - pos)
+                data = bytes(self.mapping.slice(offset + pos, n))
+                reply = await peer.request(
+                    "os_push", {"oid": oid, "size": size,
+                                "offset": pos, "data": data},
+                    timeout=60)
+                if reply.get("skip"):
+                    return True  # receiver already has/is getting it
+                if reply.get("error"):
+                    return False
+                pos += n
+            return True
+        except Exception as e:
+            logger.warning("push %s to %s failed: %s", oid.hex()[:8],
+                           target_node_id, e)
+            return False
+        finally:
+            self.store.release(oid)
+
+    async def rpc_os_push(self, conn, body):
+        """Receive one pushed chunk: allocate on the first, seal after
+        the last (the receiving half of the push path)."""
+        oid, size = body["oid"], body["size"]
+        now = time.monotonic()
+        if body["offset"] == 0:
+            # Sweep transfers whose sender died mid-stream so their
+            # unsealed allocations don't leak the arena.
+            for stale, (_, t0) in list(self._push_recv.items()):
+                if now - t0 > 120 and stale != oid:
+                    self._push_recv.pop(stale, None)
+                    self.store.delete(stale)
+            if oid in self._push_recv:
+                # A dead transfer for this oid: restart it cleanly.
+                self._push_recv.pop(oid, None)
+                self.store.delete(oid)
+            elif self.store.contains(oid) \
+                    or oid in self._pulls_inflight:
+                return {"skip": True}
+            try:
+                off = await self._alloc_with_spill(oid, size)
+            except KeyError:
+                return {"skip": True}  # concurrent pull/push won
+            if off is None:
+                return {"error": "object store OOM receiving push"}
+            self._push_recv[oid] = (off, now)
+        else:
+            ent = self._push_recv.get(oid)
+            if ent is None:
+                return {"error": "push chunk without a first chunk"}
+            off = ent[0]
+        data = body["data"]
+        dest = self.mapping.slice(off, size)
+        dest[body["offset"]:body["offset"] + len(data)] = data
+        if body["offset"] + len(data) >= size:
+            self._push_recv.pop(oid, None)
+            self._seal_release_notify(oid)
+        return {"ok": True}
 
     async def rpc_os_used(self, conn, body):
         return {"used": self.store.used(), "capacity": self.store_capacity}

@@ -1,0 +1,51 @@
+"""Proactive object replication (the push half of the object manager).
+
+Reference: src/ray/object_manager/push_manager.h — the reference pushes
+task args/returns to nodes known to need them instead of waiting for N
+cold pulls.  Here the same machinery is exposed for broadcast-shaped
+flows: ``push_object(ref)`` streams the object's chunks from this node's
+raylet to every (or selected) peer raylet, so subsequent reads there are
+local.  Inline objects (≤ the inline threshold) travel inside specs and
+need no push.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.object_ref import ObjectRef
+
+
+def push_object(ref: ObjectRef,
+                node_ids: Optional[List] = None) -> Dict:
+    """Replicate a shm-store object to peer nodes ahead of demand.
+
+    node_ids: node-id hex strings (or NodeID objects) to push to;
+    None = every other alive node.  Returns {"pushed": [...node id
+    hex], "failed": [...node id hex]}."""
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    if w.raylet is None:
+        raise RuntimeError("no raylet connection (local mode?)")
+    from ray_tpu._private.gcs_client import global_gcs_client
+    wanted = None
+    if node_ids is not None:
+        wanted = {n.hex() if hasattr(n, "hex") else str(n)
+                  for n in node_ids}
+    my_addr = tuple(w.raylet_addr) if w.raylet_addr else None
+    targets = []
+    for view in global_gcs_client().nodes.get_all():
+        if not view["alive"]:
+            continue
+        if tuple(view["addr"]) == my_addr:
+            continue
+        if wanted is not None and view["node_id"].hex() not in wanted:
+            continue
+        targets.append(view["node_id"])
+    if not targets:
+        return {"pushed": [], "failed": []}
+    return w._run(w.raylet.request(
+        "os_push_to", {"oid": ref.id.binary(), "targets": targets},
+        timeout=300))
