@@ -22,6 +22,7 @@ from ray_tpu.rllib.algorithms.appo.appo import (  # noqa: F401
     APPOConfig,
 )
 from ray_tpu.rllib.algorithms.es.es import ES, ESConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.pg.pg import PG, PGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
     BC,
@@ -34,5 +35,5 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "Algorithm",
            "AlgorithmConfig", "BC", "BCConfig", "DDPPO", "DDPPOConfig",
            "DQN", "DQNConfig", "ES", "ESConfig", "Impala",
-           "ImpalaConfig", "MARWIL", "MARWILConfig", "PPO", "PPOConfig",
-           "SAC", "SACConfig", "SampleBatch"]
+           "ImpalaConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
+           "PPO", "PPOConfig", "SAC", "SACConfig", "SampleBatch"]

@@ -1,0 +1,34 @@
+"""PG: vanilla policy gradient (REINFORCE with a value baseline via
+GAE's lam=1 degenerate form).
+
+Reference: rllib/algorithms/pg/pg.py — the minimal on-policy algorithm:
+sample synchronously, one gradient step on -logp * advantage.  lambda=1
+makes GAE degenerate to Monte Carlo returns minus the value baseline;
+the shared A2C jitted loss runs with the entropy coefficient zeroed and
+the vf coefficient kept for the baseline fit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.rllib.algorithms.a2c.a2c import A2C
+from ray_tpu.rllib.algorithms.algorithm import AlgorithmConfig
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PG)
+        self._config.update({
+            "lr": 2e-3,
+            "lambda": 1.0,          # GAE -> Monte Carlo returns
+            "vf_loss_coeff": 0.5,   # baseline fit only
+            "entropy_coeff": 0.0,
+            "microbatch_size": 0,
+        })
+
+
+class PG(A2C):
+    def _extra_defaults(self) -> Dict:
+        return {"lr": 2e-3, "lambda": 1.0, "vf_loss_coeff": 0.5,
+                "entropy_coeff": 0.0, "microbatch_size": 0}

@@ -12,6 +12,7 @@ from ray_tpu.rllib import (
     BCConfig,
     ESConfig,
     MARWILConfig,
+    PGConfig,
     SACConfig,
 )
 
@@ -219,3 +220,23 @@ def test_marwil_weighted_imitation(ray_init):
     assert stats["mean_weight"] > 0
     assert r["num_offline_steps_trained"] == 2000
     algo.stop()
+
+
+def test_pg_cartpole_improves(ray_init):
+    algo = (PGConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=200)
+            .training(train_batch_size=1000, lr=2e-3)
+            .debugging(seed=8)
+            .build())
+    best = 0.0
+    for _ in range(20):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best >= 40:
+            break
+    algo.stop()
+    # Random CartPole is ~22; REINFORCE-with-baseline clearly improves
+    # (Monte Carlo advantages are noisier than GAE's, so the bar sits
+    # below A2C's; measured ~47 by iter 20 at this seed).
+    assert best >= 40, f"PG failed to improve (best={best})"
