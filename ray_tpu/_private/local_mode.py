@@ -24,17 +24,24 @@ class _ExecCtx:
     task_id = None
 
 
-def _resolve(obj, store):
+def _resolve(obj, store, errors):
     """Replace TOP-LEVEL ObjectRef args with their stored values (the
     real runtime's semantics: nested refs inside containers stay refs
-    and resolve via get/await)."""
+    and resolve via get/await).  A ref whose task failed re-raises the
+    original exception (matching the runtime: a failed dependency
+    propagates the underlying task error to the consumer)."""
+    def _lookup(ref):
+        if ref.id in errors:
+            raise errors[ref.id]
+        return store[ref.id]
+
     if isinstance(obj, ObjectRef):
-        return store[obj.id]
+        return _lookup(obj)
     if isinstance(obj, list):
-        return [store[o.id] if isinstance(o, ObjectRef) else o
+        return [_lookup(o) if isinstance(o, ObjectRef) else o
                 for o in obj]
     if isinstance(obj, dict):
-        return {k: store[v.id] if isinstance(v, ObjectRef) else v
+        return {k: _lookup(v) if isinstance(v, ObjectRef) else v
                 for k, v in obj.items()}
     return obj
 
@@ -118,8 +125,8 @@ class LocalModeWorker:
         num_returns = opts.get("num_returns", 1)
         try:
             with self._lock:
-                args = _resolve(list(args), self._store)
-                kwargs = _resolve(dict(kwargs), self._store)
+                args = _resolve(list(args), self._store, self._errors)
+                kwargs = _resolve(dict(kwargs), self._store, self._errors)
             result = fn(*args, **kwargs)
             err = None
         except Exception as e:
@@ -145,8 +152,8 @@ class LocalModeWorker:
                      opts: dict) -> ActorID:
         cls = self._functions[class_id]
         with self._lock:
-            init_args = _resolve(list(init_args), self._store)
-            init_kwargs = _resolve(dict(init_kwargs), self._store)
+            init_args = _resolve(list(init_args), self._store, self._errors)
+            init_kwargs = _resolve(dict(init_kwargs), self._store, self._errors)
         instance = cls(*init_args, **init_kwargs)
         actor_id = ActorID.from_random()
         self._actors[actor_id] = instance
@@ -166,8 +173,8 @@ class LocalModeWorker:
                                                 "(local mode)")
         try:
             with self._lock:
-                args = _resolve(list(args), self._store)
-                kwargs = _resolve(dict(kwargs), self._store)
+                args = _resolve(list(args), self._store, self._errors)
+                kwargs = _resolve(dict(kwargs), self._store, self._errors)
             bound = getattr(instance, method)
             result = bound(*args, **kwargs)
             import inspect

@@ -112,7 +112,9 @@ class Raylet:
         # per-instance pull dedup (a class attribute would be shared across
         # the in-process multi-raylet test Cluster)
         self._pulls_inflight: dict = {}
-        self._push_recv: dict = {}  # oid -> (arena offset, start ts)
+        # In-flight push receives: oid -> {"off": arena offset,
+        # "sender": id(sender conn), "last": last-chunk ts, "received": bytes}
+        self._push_recv: dict = {}
         # pins held on behalf of each client conn: id(conn) -> {oid: count}
         self._client_pins: dict[int, dict[bytes, int]] = {}
 
@@ -209,9 +211,24 @@ class Raylet:
 
     async def _on_conn_lost(self, conn):
         self._release_client_pins(conn)
+        self._abort_pushes_from(conn)
         for w in list(self.workers.values()):
             if w.conn is conn:
                 await self._on_worker_dead(w, "worker connection lost")
+
+    def _abort_pushes_from(self, conn):
+        """Sender connection died: drop its in-flight push transfers so the
+        unsealed allocations don't sit in the arena until the stale sweep,
+        and so an immediate re-push (new connection) isn't answered {skip}.
+        Waiters are woken to re-check the store / fall back to a pull."""
+        sender = id(conn)
+        for oid, ent in list(self._push_recv.items()):
+            if ent["sender"] == sender:
+                self._push_recv.pop(oid, None)
+                self.store.delete(oid)
+                for fut in self.seal_waiters.pop(oid, []):
+                    if not fut.done():
+                        fut.set_result(None)
 
     # ------------------------------------------------------- worker lifecycle
     def prestart_workers(self, n: int, kind: str = "cpu"):
@@ -915,6 +932,20 @@ class Raylet:
             self._pulls_inflight.pop(oid, None)
 
     async def _do_pull(self, oid, location, timeout) -> bool:
+        if oid in self._push_recv:
+            # A push of this object is already streaming in: wait for its
+            # seal instead of double-allocating.  If the pushing sender
+            # dies, _abort_pushes_from (conn loss) or the stale sweep
+            # cleans the transfer and wakes us to fall through to a pull.
+            await self._wait_sealed(oid, timeout)
+            got = self.store.get(oid)
+            if got is not None and got[2]:
+                self.store.release(oid)  # get() pinned the sealed copy
+                return True
+            if oid in self._push_recv:
+                # Push stream still live after the full timeout: it owns
+                # the allocation, so a pull can't proceed.
+                return False
         peer = await self._peer(location)
         if peer is None:
             return False
@@ -925,7 +956,15 @@ class Raylet:
         try:
             off = await self._alloc_with_spill(oid, size)
         except KeyError:
-            return True  # someone else pulled it concurrently
+            # oid already has an allocation: a concurrent pull/push sealed
+            # (or is sealing) it.  Only a SEALED copy counts as success —
+            # an unsealed residue means the transfer died and this pull
+            # cannot recover it (the owner will retry).
+            got = self.store.get(oid)
+            if got is not None and got[2]:
+                self.store.release(oid)
+                return True
+            return False
         if off is None:
             return False
         dest = self.mapping.slice(off, size)
@@ -1091,20 +1130,38 @@ class Raylet:
         finally:
             self.store.release(oid)
 
+    def _sweep_stale_pushes(self, now):
+        """Drop transfers with no chunk activity for >120s (sender died
+        mid-stream) so their unsealed allocations don't leak the arena.
+        Staleness is measured from the LAST chunk, so a legitimately slow
+        large push is never swept while it is still making progress.  Waiters
+        are woken (they re-check the store and fall back to a pull or a
+        timeout error instead of hanging out their full timeout)."""
+        for stale, ent in list(self._push_recv.items()):
+            if now - ent["last"] > 120:
+                self._push_recv.pop(stale, None)
+                self.store.delete(stale)
+                for fut in self.seal_waiters.pop(stale, []):
+                    if not fut.done():
+                        fut.set_result(None)
+
     async def rpc_os_push(self, conn, body):
-        """Receive one pushed chunk: allocate on the first, seal after
-        the last (the receiving half of the push path)."""
+        """Receive one pushed chunk: allocate on the first, seal once every
+        byte has arrived.  Each transfer is owned by the sender connection
+        that opened it — a concurrent push of the same oid from a second
+        sender is answered {skip} rather than clobbering the live transfer
+        (reference: PushManager dedups pushes per (object, node))."""
         oid, size = body["oid"], body["size"]
         now = time.monotonic()
+        sender = id(conn)
         if body["offset"] == 0:
-            # Sweep transfers whose sender died mid-stream so their
-            # unsealed allocations don't leak the arena.
-            for stale, (_, t0) in list(self._push_recv.items()):
-                if now - t0 > 120 and stale != oid:
-                    self._push_recv.pop(stale, None)
-                    self.store.delete(stale)
-            if oid in self._push_recv:
-                # A dead transfer for this oid: restart it cleanly.
+            self._sweep_stale_pushes(now)
+            ent = self._push_recv.get(oid)
+            if ent is not None:
+                if ent["sender"] != sender:
+                    # A live transfer from another sender owns this oid.
+                    return {"skip": True}
+                # Same sender restarting its own stream: start clean.
                 self._push_recv.pop(oid, None)
                 self.store.delete(oid)
             elif self.store.contains(oid) \
@@ -1116,16 +1173,22 @@ class Raylet:
                 return {"skip": True}  # concurrent pull/push won
             if off is None:
                 return {"error": "object store OOM receiving push"}
-            self._push_recv[oid] = (off, now)
+            self._push_recv[oid] = {"off": off, "sender": sender,
+                                    "last": now, "received": 0}
+            ent = self._push_recv[oid]
         else:
             ent = self._push_recv.get(oid)
             if ent is None:
                 return {"error": "push chunk without a first chunk"}
-            off = ent[0]
+            if ent["sender"] != sender:
+                return {"skip": True}  # not this transfer's owner
+            ent["last"] = now
+        off = ent["off"]
         data = body["data"]
         dest = self.mapping.slice(off, size)
         dest[body["offset"]:body["offset"] + len(data)] = data
-        if body["offset"] + len(data) >= size:
+        ent["received"] += len(data)
+        if ent["received"] >= size:
             self._push_recv.pop(oid, None)
             self._seal_release_notify(oid)
         return {"ok": True}

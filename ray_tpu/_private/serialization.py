@@ -67,17 +67,41 @@ def _convert_jax_arrays(value):
     return value
 
 
+_OOB_BYTES_MIN = 64 * 1024
+
+
+class _OOBBytes:
+    """Carrier that moves a large top-level bytes/bytearray payload through
+    pickle-5 OUT-OF-BAND instead of copying it into the in-band stream.
+    pickle only externalizes PickleBuffer objects, and plain bytes are
+    always serialized in-band — so a 100MB `put(b"...")` would otherwise
+    cost two extra copies (stream assembly + stream→shm).  Unpickling
+    reconstructs the original type directly; the wrapper never survives."""
+
+    __slots__ = ("ctor", "payload")
+
+    def __init__(self, ctor, payload):
+        self.ctor = ctor          # bytes or bytearray
+        self.payload = payload
+
+    def __reduce_ex__(self, protocol):
+        return (self.ctor, (pickle.PickleBuffer(self.payload),))
+
+
 def serialize(value) -> tuple[SerializedObject, list[ObjectRef]]:
     """Serialize ``value``; returns the payload and any ObjectRefs nested in it."""
     buffers: list = []
+    target = value
+    if type(value) in (bytes, bytearray) and len(value) >= _OOB_BYTES_MIN:
+        target = _OOBBytes(type(value), value)
     with track_nested_refs() as nested:
         try:
-            inband = pickle.dumps(value, protocol=_PROTO,
+            inband = pickle.dumps(target, protocol=_PROTO,
                                   buffer_callback=buffers.append)
         except Exception:
             buffers.clear()
             nested.clear()  # refs tracked during the failed attempt
-            inband = cloudpickle.dumps(value, protocol=_PROTO,
+            inband = cloudpickle.dumps(target, protocol=_PROTO,
                                        buffer_callback=buffers.append)
     raw_bufs = [b.raw() for b in buffers]
     ref_states = [(r.id, r.owner_addr) for r in nested]

@@ -357,6 +357,11 @@ class TrialRunner:
                     trial.error = RuntimeError(
                         f"placement group for {trial.name} cannot be "
                         f"scheduled")
+                    # The searcher paired a suggestion with this trial id;
+                    # it must hear the trial ended or it leaks the slot
+                    # (BO searchers never learn the outcome otherwise).
+                    self.search_alg.on_trial_complete(trial.trial_id,
+                                                      error=True)
                     if self.failure_config.fail_fast:
                         raise trial.error
                 continue
@@ -368,6 +373,8 @@ class TrialRunner:
             except Exception as e:
                 self._stop_trial(trial, ERROR)
                 trial.error = e
+                self.search_alg.on_trial_complete(trial.trial_id,
+                                                  error=True)
                 if self.failure_config.fail_fast:
                     raise
         for trial in started:
@@ -376,6 +383,8 @@ class TrialRunner:
             except Exception as e:
                 self._stop_trial(trial, ERROR)
                 trial.error = e
+                self.search_alg.on_trial_complete(trial.trial_id,
+                                                  error=True)
                 if self.failure_config.fail_fast:
                     raise
 
