@@ -39,6 +39,9 @@ class _Config:
 
     # --- scheduling ---
     max_workers_per_node = _def("max_workers_per_node", int, 64)
+    # Fork-server worker spawn (zygote.py): pay the interpreter+import cost
+    # once per node, fork workers in ~10ms after that.
+    worker_zygote_enabled = _def("worker_zygote_enabled", bool, True)
     idle_worker_keep_s = _def("idle_worker_keep_s", float, 300.0)
     lease_spillback_threshold = _def("lease_spillback_threshold", float, 1.0)
 
@@ -48,6 +51,11 @@ class _Config:
     # ray_config_def.h task_max_retries semantics for object recovery).
     max_object_reconstructions = _def("max_object_reconstructions", int, 3)
     actor_max_restarts_default = _def("actor_max_restarts_default", int, 0)
+    # How long a caller waits for a restarting actor to come back ALIVE
+    # before treating it as dead (reference: the direct actor submitter
+    # holds queued tasks while the GCS reports RESTARTING).  Generous on
+    # purpose: a restart on a loaded 1-CPU host can take minutes.
+    actor_restart_wait_s = _def("actor_restart_wait_s", float, 300.0)
     task_queue_warn_len = _def("task_queue_warn_len", int, 100000)
 
     # --- logging ---

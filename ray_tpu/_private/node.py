@@ -53,57 +53,138 @@ def _read_port(proc, tag, timeout=30.0):
 
 
 class NodeProcesses:
-    """Out-of-process GCS + raylet for a real (head) node."""
+    """Out-of-process GCS + raylet — a REAL node, reachable across hosts.
+
+    Reference: python/ray/_private/node.py:1084 start_ray_processes with
+    command assembly services.py:1381 (gcs_server) / :1440 (raylet).  The
+    head node spawns the GCS process; every node spawns a raylet process
+    (which owns the node's shm store and worker pool).  ``host`` is the
+    bind + advertise address — pass the machine's routable IP for
+    multi-host clusters (the default loopback only works single-machine).
+    ``rt start --head`` / ``rt start --address`` (scripts/cli.py) and the
+    out-of-process test ``ProcessCluster`` both build on this."""
 
     def __init__(self, session_dir=None, num_cpus=None, num_tpus=None,
                  resources=None, object_store_memory=None, head=True,
-                 gcs_addr=None):
+                 gcs_addr=None, host="127.0.0.1", gcs_port=0, labels=None,
+                 node_name=None, register_atexit=True):
         self.session_dir = session_dir or new_session_dir()
-        self.procs: list[subprocess.Popen] = []
-        self.gcs_addr = gcs_addr
+        self.gcs_proc: subprocess.Popen | None = None
+        self.raylet_proc: subprocess.Popen | None = None
+        self.gcs_addr = tuple(gcs_addr) if gcs_addr else None
         self.raylet_addr = None
         self.head = head
+        self.host = host
+        self.gcs_port = gcs_port
+        self.node_name = node_name
+        self._register_atexit = register_atexit
         self._resources, self._labels = detect_node_resources(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+        if labels:
+            self._labels.update(labels)
         self._object_store_memory = (object_store_memory
                                      or cfg.object_store_memory_bytes)
+
+    def _logfile(self, tag):
+        path = os.path.join(self.session_dir, "logs", f"{tag}.err")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        return open(path, "ab")
 
     def start(self):
         env = dict(os.environ)
         env.update(cfg.to_env())
         if self.head:
-            gcs = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.gcs"],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
-                start_new_session=True)
-            self.procs.append(gcs)
-            port = _read_port(gcs, "GCS_PORT")
-            self.gcs_addr = ("127.0.0.1", port)
+            self.gcs_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.gcs",
+                 "--host", self.host,
+                 "--port", str(self.gcs_port),
+                 "--persist-path",
+                 os.path.join(self.session_dir, "gcs_snapshot.pkl")],
+                stdout=subprocess.PIPE, stderr=self._logfile("gcs"),
+                env=env, start_new_session=True)
+            port = _read_port(self.gcs_proc, "GCS_PORT")
+            self.gcs_addr = (self.host, port)
+        self.start_raylet()
+        if self._register_atexit:
+            atexit.register(self.kill)
+        return self
+
+    def start_raylet(self):
+        """(Re)spawn this node's raylet (also used after a SIGKILL in
+        chaos flows to simulate a machine coming back)."""
         import json
-        raylet = subprocess.Popen(
+        env = dict(os.environ)
+        env.update(cfg.to_env())
+        self.raylet_proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.raylet",
+             "--host", self.host,
              "--gcs-host", self.gcs_addr[0],
              "--gcs-port", str(self.gcs_addr[1]),
              "--resources", json.dumps(self._resources),
              "--labels", json.dumps(self._labels),
              "--session-dir", self.session_dir,
-             "--store-capacity", str(self._object_store_memory)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
-            start_new_session=True)
-        self.procs.append(raylet)
-        rport = _read_port(raylet, "RAYLET_PORT")
-        self.raylet_addr = ("127.0.0.1", rport)
-        atexit.register(self.kill)
-        return self
+             "--store-capacity", str(self._object_store_memory)]
+            + (["--node-name", self.node_name] if self.node_name else []),
+            stdout=subprocess.PIPE, stderr=self._logfile("raylet"),
+            env=env, start_new_session=True)
+        rport = _read_port(self.raylet_proc, "RAYLET_PORT")
+        self.raylet_addr = (self.host, rport)
+        return self.raylet_addr
+
+    def restart_gcs(self):
+        """Respawn the GCS on its previous port, reloading the snapshot
+        (reference: GCS failover with Redis persistence)."""
+        if not self.head or self.gcs_addr is None:
+            raise RuntimeError("not a head node")
+        env = dict(os.environ)
+        env.update(cfg.to_env())
+        self.gcs_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.gcs",
+             "--host", self.host,
+             "--port", str(self.gcs_addr[1]),
+             "--persist-path",
+             os.path.join(self.session_dir, "gcs_snapshot.pkl")],
+            stdout=subprocess.PIPE, stderr=self._logfile("gcs"),
+            env=env, start_new_session=True)
+        _read_port(self.gcs_proc, "GCS_PORT")
+
+    @property
+    def procs(self):
+        return [p for p in (self.gcs_proc, self.raylet_proc)
+                if p is not None]
+
+    def pids(self):
+        return {("gcs" if p is self.gcs_proc else "raylet"): p.pid
+                for p in self.procs}
+
+    def kill_raylet(self, sig=None):
+        """SIGKILL (default) the raylet process — real fault injection;
+        its workers die with it (they exit when the raylet socket
+        closes)."""
+        import signal as _signal
+        p = self.raylet_proc
+        if p is not None and p.poll() is None:
+            try:
+                os.kill(p.pid, sig or _signal.SIGKILL)
+                p.wait(10)
+            except Exception:
+                pass
+
+    def kill_gcs(self, sig=None):
+        import signal as _signal
+        p = self.gcs_proc
+        if p is not None and p.poll() is None:
+            try:
+                os.kill(p.pid, sig or _signal.SIGKILL)
+                p.wait(10)
+            except Exception:
+                pass
 
     def kill(self):
-        for p in self.procs:
-            if p.poll() is None:
-                try:
-                    p.kill()
-                except Exception:
-                    pass
-        self.procs = []
+        self.kill_raylet()
+        self.kill_gcs()
+        self.gcs_proc = None
+        self.raylet_proc = None
 
 
 class InProcessNode:

@@ -109,6 +109,8 @@ class Raylet:
         self._next_lease = 0
         self._shutdown = False
         self._subproc_env = None
+        self._zygote = None  # ZygoteClient once warm (fast fork spawn)
+        self._spawn_sem_cap = None
         # per-instance pull dedup (a class attribute would be shared across
         # the in-process multi-raylet test Cluster)
         self._pulls_inflight: dict = {}
@@ -117,6 +119,9 @@ class Raylet:
         self._push_recv: dict = {}
         # pins held on behalf of each client conn: id(conn) -> {oid: count}
         self._client_pins: dict[int, dict[bytes, int]] = {}
+        # unsealed creates per client conn (freed if the client dies
+        # before sealing): id(conn) -> {oid}
+        self._creating: dict[int, set[bytes]] = {}
 
     # -------------------------------------------------------------- startup
     async def start(self, port=0):
@@ -136,6 +141,8 @@ class Raylet:
         loop = asyncio.get_running_loop()
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._reap_loop())
+        if cfg.worker_zygote_enabled:
+            loop.create_task(self._start_zygote())
         if cfg.log_to_driver:
             from ray_tpu._private.log_monitor import LogMonitor
 
@@ -212,9 +219,25 @@ class Raylet:
     async def _on_conn_lost(self, conn):
         self._release_client_pins(conn)
         self._abort_pushes_from(conn)
+        for oid in self._creating.pop(id(conn), ()):
+            got = self.store.get(oid)
+            if got is not None and not got[2]:
+                # Client died mid-create: free the unsealed allocation.
+                self._created_sizes.pop(oid, None)
+                self._discard_unsealed(oid)
+            elif got is not None and got[2]:
+                self.store.release(oid)  # drop the probe pin
         for w in list(self.workers.values()):
             if w.conn is conn:
                 await self._on_worker_dead(w, "worker connection lost")
+
+    def _discard_unsealed(self, oid: bytes):
+        """Free an unsealed allocation made by a transfer that died.  The
+        alloc-time creator pin (shm_store.cc Alloc: refcount=1) must be
+        released alongside the delete, or the entry stays pending_delete
+        forever — bytes leaked AND the oid poisoned on this node."""
+        self.store.delete(oid)
+        self.store.release(oid)
 
     def _abort_pushes_from(self, conn):
         """Sender connection died: drop its in-flight push transfers so the
@@ -225,7 +248,7 @@ class Raylet:
         for oid, ent in list(self._push_recv.items()):
             if ent["sender"] == sender:
                 self._push_recv.pop(oid, None)
-                self.store.delete(oid)
+                self._discard_unsealed(oid)
                 for fut in self.seal_waiters.pop(oid, []):
                     if not fut.done():
                         fut.set_result(None)
@@ -267,19 +290,64 @@ class Raylet:
                 pass
         return True
 
-    def _spawn_worker(self, kind: str = "cpu") -> WorkerHandle:
-        worker_id = WorkerID.from_random()
+    async def _start_zygote(self):
+        """Spawn the warm fork-server (zygote.py): one ~2s interpreter +
+        import cost per node, after which workers fork in ~10ms instead of
+        cold-starting.  Until it's ready, _spawn_worker falls back to
+        Popen cold starts."""
+        from ray_tpu._private.zygote import ZygoteClient
+        sock_path = os.path.join(self.session_dir,
+                                 f"zygote_{self.node_id.hex()[:8]}.sock")
+        env = dict(self._worker_env())
+        env.pop("RT_WORKER_ID", None)
+        logfile = os.path.join(self.session_dir, "logs",
+                               self.node_id.hex()[:8], "zygote.log")
+        os.makedirs(os.path.dirname(logfile), exist_ok=True)
+        out = open(logfile, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.zygote", sock_path],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        out.close()
+        zy = ZygoteClient(sock_path, proc)
+        if await zy.wait_ready():
+            self._zygote = zy
+            logger.info("zygote ready on %s", self.node_id.hex()[:8])
+        else:
+            logger.warning("zygote failed to start; using cold spawns")
+            zy.kill()
+
+    def _worker_env_for(self, worker_id, kind: str):
         env = dict(self._worker_env())
         env["RT_WORKER_ID"] = worker_id.hex()
+        unset = []
         if kind == "tpu":
             # TPU workers get the real backend (axon/tpu); cpu workers are
             # pinned to the host platform so they never grab the chip.
             env.pop("JAX_PLATFORMS", None)
+            unset.append("JAX_PLATFORMS")
             if "RT_WORKER_JAX_PLATFORMS_TPU" in os.environ:
                 env["JAX_PLATFORMS"] = os.environ["RT_WORKER_JAX_PLATFORMS_TPU"]
-        logfile = os.path.join(self.session_dir, "logs",
-                               self.node_id.hex()[:8],
-                               f"worker-{worker_id.hex()[:8]}.log")
+                unset = []
+        return env, unset
+
+    def _worker_logfile(self, worker_id):
+        return os.path.join(self.session_dir, "logs",
+                            self.node_id.hex()[:8],
+                            f"worker-{worker_id.hex()[:8]}.log")
+
+    def _spawn_worker(self, kind: str = "cpu") -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env, unset = self._worker_env_for(worker_id, kind)
+        logfile = self._worker_logfile(worker_id)
+        if self._zygote is not None and self._zygote.ready:
+            # proc is attached asynchronously when the fork reply lands;
+            # _wait_registered tolerates proc=None meanwhile.
+            w = WorkerHandle(worker_id, None, kind=kind)
+            self.workers[worker_id] = w
+            asyncio.get_running_loop().create_task(
+                self._fork_worker(w, env, unset, logfile))
+            return w
         os.makedirs(os.path.dirname(logfile), exist_ok=True)
         out = open(logfile, "ab")
         proc = subprocess.Popen(
@@ -290,6 +358,25 @@ class Raylet:
         w = WorkerHandle(worker_id, proc, kind=kind)
         self.workers[worker_id] = w
         return w
+
+    async def _fork_worker(self, w: WorkerHandle, env, unset, logfile):
+        from ray_tpu._private.zygote import PidHandle
+        try:
+            pid = await self._zygote.fork(env, logfile, unset_env=unset)
+            w.proc = PidHandle(pid)
+            w.pid = pid
+        except Exception as e:
+            logger.warning("zygote fork failed (%s); cold-starting", e)
+            if w.worker_id not in self.workers:
+                return  # already reaped
+            os.makedirs(os.path.dirname(logfile), exist_ok=True)
+            out = open(logfile, "ab")
+            w.proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            out.close()
+            w.pid = w.proc.pid
 
     async def rpc_register_worker(self, conn, body):
         worker_id = WorkerID.from_hex(body["worker_id"])
@@ -306,6 +393,13 @@ class Raylet:
                 "store_path": self.store_path,
                 "store_capacity": self.store_capacity}
 
+    def _spawn_cap(self) -> int:
+        """Concurrent-spawn bound: wide for ~10ms zygote forks, narrow for
+        ~2s interpreter cold starts."""
+        if self._zygote is not None and self._zygote.ready:
+            return 16
+        return max(2, int(self.total_resources.get("CPU", 2)))
+
     async def _get_ready_worker(self, kind: str = "cpu") -> WorkerHandle | None:
         idle = self.idle_workers[kind]
         while idle:
@@ -314,11 +408,15 @@ class Raylet:
                 return w
         if len(self.workers) >= cfg.max_workers_per_node:
             return None
-        if self._spawn_sem is None:
-            # Bound concurrent cold starts: on a small host an unbounded
-            # spawn storm (each ~2s of CPU) starves the running tasks.
-            self._spawn_sem = asyncio.Semaphore(
-                max(2, int(self.total_resources.get("CPU", 2))))
+        # Bound concurrent cold starts: on a small host an unbounded
+        # spawn storm (each ~2s of CPU) starves the running tasks.
+        # Zygote forks are ~10ms, so they get a much wider bound; the
+        # semaphore is rebuilt whenever the cap changes (zygote warming
+        # up or dying) rather than frozen at first use.
+        cap = self._spawn_cap()
+        if self._spawn_sem is None or self._spawn_sem_cap != cap:
+            self._spawn_sem = asyncio.Semaphore(cap)
+            self._spawn_sem_cap = cap
         async with self._spawn_sem:
             idle = self.idle_workers[kind]
             if idle:
@@ -597,8 +695,9 @@ class Raylet:
     def _ensure_spawning(self, kind: str, demand: int):
         """Keep at most `demand` additional cold starts in flight, bounded by
         the node CPU count and the pool cap (reference: WorkerPool
-        maximum_startup_concurrency)."""
-        cap = max(2, int(self.total_resources.get("CPU", 2)))
+        maximum_startup_concurrency).  Zygote forks are cheap, so the
+        bound widens once the fork server is warm."""
+        cap = self._spawn_cap()
         can_spawn = min(
             demand - self._spawns_outstanding,
             cap - self._spawns_outstanding,
@@ -737,6 +836,9 @@ class Raylet:
             return {"error": f"object store OOM allocating {size} bytes "
                              f"(after spilling)"}
         self._created_sizes[oid] = size
+        # Remember who is mid-create: if the client dies before sealing,
+        # its unsealed allocation must be discarded (conn-loss handler).
+        self._creating.setdefault(id(conn), set()).add(oid)
         return {"offset": off}
 
     async def _alloc_with_spill(self, oid: bytes, size: int):
@@ -808,6 +910,7 @@ class Raylet:
             return await asyncio.shield(fut)
         fut = asyncio.get_running_loop().create_future()
         self._restores_inflight[oid] = fut
+        off = None
         try:
             path, size = ent
             off = await self._alloc_with_spill(oid, size)
@@ -824,6 +927,8 @@ class Raylet:
             return True
         except Exception as e:
             logger.warning("restore of %s failed: %s", oid.hex()[:8], e)
+            if off is not None:
+                self._discard_unsealed(oid)
             if not fut.done():
                 fut.set_result(False)
             return False
@@ -832,6 +937,9 @@ class Raylet:
 
     async def rpc_os_seal(self, conn, body):
         oid = body["oid"]
+        creating = self._creating.get(id(conn))
+        if creating is not None:
+            creating.discard(oid)
         self.store.seal(oid)
         size = self._created_sizes.pop(oid, None)
         if size is not None:
@@ -976,7 +1084,7 @@ class Raylet:
                                       {"oid": oid, "offset": pos, "len": n},
                                       timeout=timeout)
             if data.get("error"):
-                self.store.delete(oid)
+                self._discard_unsealed(oid)
                 return False
             dest[pos:pos + n] = data["data"]
             pos += n
@@ -1048,8 +1156,13 @@ class Raylet:
 
     async def rpc_os_delete(self, conn, body):
         oid = body["oid"]
+        was_primary = self.primary_objects.pop(oid, None) is not None
         self.store.delete(oid)
-        self.primary_objects.pop(oid, None)
+        if was_primary:
+            # Drop the creator pin (held since alloc so the primary copy
+            # could never be LRU-evicted).  Without this the delete stays
+            # deferred forever and a put/delete loop leaks the arena dry.
+            self.store.release(oid)
         self._created_sizes.pop(oid, None)
         spilled = self.spilled.pop(oid, None)
         if spilled is not None:
@@ -1140,7 +1253,7 @@ class Raylet:
         for stale, ent in list(self._push_recv.items()):
             if now - ent["last"] > 120:
                 self._push_recv.pop(stale, None)
-                self.store.delete(stale)
+                self._discard_unsealed(stale)
                 for fut in self.seal_waiters.pop(stale, []):
                     if not fut.done():
                         fut.set_result(None)
@@ -1163,7 +1276,7 @@ class Raylet:
                     return {"skip": True}
                 # Same sender restarting its own stream: start clean.
                 self._push_recv.pop(oid, None)
-                self.store.delete(oid)
+                self._discard_unsealed(oid)
             elif self.store.contains(oid) \
                     or oid in self._pulls_inflight:
                 return {"skip": True}
@@ -1339,6 +1452,9 @@ class Raylet:
                     w.proc.kill()
                 except Exception:
                     pass
+        if self._zygote is not None:
+            self._zygote.kill()
+            self._zygote = None
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()
@@ -1358,6 +1474,8 @@ def main():
     parser.add_argument("--labels", default="{}")
     parser.add_argument("--session-dir", default="/tmp/ray_tpu")
     parser.add_argument("--store-capacity", type=int, default=0)
+    parser.add_argument("--node-name", default=None)
+    parser.add_argument("--prestart-workers", type=int, default=-1)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="[raylet] %(levelname)s %(message)s")
@@ -1372,10 +1490,23 @@ def main():
         raylet = Raylet((args.gcs_host, args.gcs_port), resources,
                         labels=labels, host=args.host,
                         session_dir=args.session_dir,
-                        store_capacity=args.store_capacity or None)
+                        store_capacity=args.store_capacity or None,
+                        node_name=args.node_name)
         port = await raylet.start(args.port)
         print(f"RAYLET_PORT={port}", flush=True)
-        await asyncio.Event().wait()
+        n_warm = args.prestart_workers
+        if n_warm < 0:
+            n_warm = min(2, max(1, int(resources.get("CPU", 1))))
+        if n_warm:
+            raylet.prestart_workers(n_warm)
+        # Graceful SIGTERM (rt stop): close the store so the RAM-backed
+        # /dev/shm arena is unlinked instead of leaking until reboot.
+        import signal as _signal
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        loop.add_signal_handler(_signal.SIGTERM, stop.set)
+        await stop.wait()
+        await raylet.shutdown()
 
     asyncio.run(run())
 

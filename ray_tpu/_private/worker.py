@@ -197,6 +197,11 @@ class CoreWorker:
         self._actor_conns: dict[ActorID, protocol.Connection] = {}
         self._actor_addr_cache: dict[ActorID, tuple] = {}
         self._actor_locks: dict[ActorID, asyncio.Lock] = {}
+        # Unacked submission window per actor: seq -> entry.  Held across
+        # incarnations and resent IN ORDER on restart (reference:
+        # direct_actor_task_submitter.h:67 resend of the unacked window).
+        self._actor_unacked: dict[ActorID, dict[int, dict]] = {}
+        self._actor_recovering: dict[ActorID, asyncio.Future] = {}
         # actor-executor state
         self.actor_instance = None
         self.actor_id: ActorID | None = None
@@ -1458,11 +1463,12 @@ class CoreWorker:
                                            opts.get("max_task_retries", 0)))
         return refs
 
-    async def _actor_send(self, actor_id, actor_addr, body):
-        """Connect (or reuse), assign the next sequence number, and put the
-        request on the wire — all under the per-actor lock so wire order
-        always matches sequence order (reference: the direct actor
-        submitter's send queue preserves submission order per caller)."""
+    async def _actor_send(self, actor_id, actor_addr, entry):
+        """Connect (or reuse), assign the next sequence number, put the
+        request on the wire, and register the entry in the actor's unacked
+        window — all under the per-actor lock so wire order always matches
+        sequence order (reference: the direct actor submitter's send queue
+        preserves submission order per caller)."""
         lock = self._actor_locks.get(actor_id)
         if lock is None:
             lock = self._actor_locks[actor_id] = asyncio.Lock()
@@ -1470,23 +1476,64 @@ class CoreWorker:
             conn = await self._actor_conn(actor_id, actor_addr)
             seq = self._actor_seq.get(actor_id, 0)
             self._actor_seq[actor_id] = seq + 1
+            body = entry["body"]
             body["seq"] = seq
-            return await conn.request_send("push_actor_task", body)
+            entry["seq"] = seq
+            entry["conn"] = conn
+            try:
+                entry["fut"] = await conn.request_send("push_actor_task",
+                                                       body)
+            except Exception:
+                # The send never hit the wire: roll the sequence number
+                # back (we still hold the lock, so nobody interleaved) —
+                # a burned seq would wedge the actor's in-order queue.
+                self._actor_seq[actor_id] = seq
+                raise
+            self._actor_unacked.setdefault(actor_id, {})[seq] = entry
 
     async def _submit_actor_task(self, actor_id, actor_addr, body, retries):
-        """Send with restart-aware retries: each failure re-resolves the
-        actor's address from the GCS and resubmits to the new incarnation,
-        up to max_task_retries times (-1 = unbounded while the actor keeps
-        restarting) — reference: direct_actor_task_submitter.h:67 resend
-        of the unacked window across restarts."""
-        view = None
+        """Submit through the per-actor unacked window.  On a connection
+        loss the whole window is held, the next incarnation is awaited
+        (patiently — a restart under load may take minutes), and every
+        entry with retry budget left is resent IN ORIGINAL ORDER by one
+        shared recovery pass; entries out of budget fail with
+        ActorDiedError.  -1 retries = unbounded while the actor keeps
+        restarting.  Reference: direct_actor_task_submitter.h:67."""
+        entry = {"body": body, "retries": retries, "attempts": 0,
+                 "fut": None, "seq": None, "conn": None, "failed": None}
         first_error = None
-        attempt = 0
         addr = actor_addr
         while True:
+            if entry["fut"] is None and entry["failed"] is None:
+                # Not on a wire (initial submit, or a send that failed
+                # before reaching the socket): send on the current
+                # incarnation.
+                if retries != -1 and entry["attempts"] > max(retries, 0):
+                    break
+                try:
+                    await self._actor_send(actor_id, addr, entry)
+                except Exception as e:
+                    if first_error is None:
+                        first_error = e
+                    entry["attempts"] += 1
+                    if retries != -1 and entry["attempts"] > max(retries, 0):
+                        break
+                    try:
+                        await self._actor_recover(actor_id, None)
+                    except rexc.ActorDiedError as e2:
+                        if first_error is None:
+                            first_error = e2
+                        break
+                    except Exception:
+                        pass  # transient; the budget check above bounds us
+                    addr = None  # re-resolve from the GCS on the resend
+                    continue
+            if entry["failed"] is not None:
+                break  # recovery exhausted this entry's retry budget
+            fut = entry["fut"]
             try:
-                fut = await self._actor_send(actor_id, addr, body)
                 reply = await fut
+                self._actor_unacked.get(actor_id, {}).pop(entry["seq"], None)
                 self._record_results({"task_id": body["task_id"],
                                       "return_ids": body["return_ids"]},
                                      reply)
@@ -1494,36 +1541,136 @@ class CoreWorker:
             except Exception as e:
                 if first_error is None:
                     first_error = e
-                if retries != -1 and attempt >= max(retries, 0):
-                    break
-                attempt += 1
-                # Actor may be restarting; wait for the next incarnation.
-                view = await self._wait_actor_alive(actor_id)
-                if (view is None or view.get("state") != "ALIVE"
-                        or view.get("addr") is None):
-                    break
-                addr = tuple(view["addr"])
-        if view is None:
-            view = await self._wait_actor_alive(actor_id)
-        cause = (_death_cause_from_view(view)
-                 if isinstance(first_error, protocol.ConnectionLost)
-                 else None) or str(first_error)
+            if entry["fut"] is not fut or entry["failed"] is not None:
+                # A concurrent recovery already resent (or failed) this
+                # entry while we were waking up: act on its decision.
+                continue
+            if retries != -1 and entry["attempts"] >= max(retries, 0):
+                break
+            try:
+                await self._actor_recover(actor_id, entry.get("conn"))
+            except rexc.ActorDiedError as e:
+                # Terminal: the GCS reported DEAD (or gave up entirely).
+                if first_error is None:
+                    first_error = e
+                break
+            except Exception as e:
+                # Transient: the next incarnation crashed between the GCS
+                # reporting ALIVE and our reconnect.  Consume a retry and
+                # go around (the wait inside recovery throttles the loop).
+                if first_error is None:
+                    first_error = e
+                entry["attempts"] += 1
+                continue
+            if entry["fut"] is fut and entry["failed"] is None:
+                # Recovery declined (live connection already in place —
+                # e.g. an earlier recovery crashed mid-window and lost this
+                # entry): resend it ourselves on the live connection.
+                self._actor_unacked.get(actor_id, {}).pop(entry["seq"], None)
+                entry["fut"] = None
+                entry["attempts"] += 1
+        self._actor_unacked.get(actor_id, {}).pop(entry.get("seq"), None)
+        view = await self._wait_actor_alive(actor_id, overall_timeout=1.0)
+        cause = (entry["failed"]
+                 or (_death_cause_from_view(view)
+                     if isinstance(first_error, protocol.ConnectionLost)
+                     else None)
+                 or str(first_error))
         err = rexc.ActorDiedError(actor_id, cause)
         blob = _error_blob(err)
         self._unpin_args(body["task_id"])
         for oid in body["return_ids"]:
-            entry = self.owned.get(oid)
-            if entry is not None:
-                entry.state = ERRORED
-                entry.blob = blob
-                entry.event.set()
+            oentry = self.owned.get(oid)
+            if oentry is not None:
+                oentry.state = ERRORED
+                oentry.blob = blob
+                oentry.event.set()
 
-    async def _wait_actor_alive(self, actor_id):
+    async def _actor_recover(self, actor_id, failed_conn):
+        """Single-flight per actor: wait for the next ALIVE incarnation,
+        reconnect, and resend the entire unacked window in original-seq
+        order.  Entries whose retry budget is exhausted are marked failed
+        instead of resent.  Raises if the actor is terminally DEAD.
+
+        `failed_conn` is the connection the caller observed failing; if
+        the current connection is already a LIVE different one, another
+        recovery has run and this call is a no-op (resending the window
+        over a live connection would double-execute tasks)."""
+        rec = self._actor_recovering.get(actor_id)
+        if rec is not None:
+            await asyncio.shield(rec)
+            return
+        cur = self._actor_conns.get(actor_id)
+        if (cur is not None and not cur.closed
+                and (failed_conn is None or cur is not failed_conn)):
+            return
+        rec = self.loop.create_future()
+        self._actor_recovering[actor_id] = rec
         try:
-            return await self._gcs_request(
-                "wait_actor_alive", {"actor_id": actor_id, "timeout": 60.0})
-        except Exception:
-            return None
+            stale = self._actor_conns.get(actor_id)
+            view = await self._wait_actor_alive(actor_id)
+            if (view is None or view.get("state") != "ALIVE"
+                    or view.get("addr") is None):
+                raise rexc.ActorDiedError(
+                    actor_id, _death_cause_from_view(view) or "not found")
+            lock = self._actor_locks.get(actor_id)
+            if lock is None:
+                lock = self._actor_locks[actor_id] = asyncio.Lock()
+            async with lock:
+                conn = self._actor_conns.get(actor_id)
+                if conn is stale or (conn is not None and conn.closed):
+                    self._actor_conns.pop(actor_id, None)
+                # _actor_conn resets the seq stream on address change.
+                conn = await self._actor_conn(actor_id, tuple(view["addr"]))
+                unacked = self._actor_unacked.get(actor_id) or {}
+                entries = [unacked[s] for s in sorted(unacked)]
+                unacked.clear()
+                for ent in entries:
+                    ent["attempts"] += 1
+                    r = ent["retries"]
+                    if r != -1 and ent["attempts"] > max(r, 0):
+                        ent["failed"] = ("task was submitted to a previous "
+                                         "incarnation and is out of retries")
+                        ent["fut"] = None
+                        continue
+                    seq = self._actor_seq.get(actor_id, 0)
+                    self._actor_seq[actor_id] = seq + 1
+                    ent["body"]["seq"] = seq
+                    ent["seq"] = seq
+                    ent["fut"] = await conn.request_send("push_actor_task",
+                                                         ent["body"])
+                    unacked[seq] = ent
+            rec.set_result(None)
+        except Exception as e:
+            rec.set_exception(e)
+            raise
+        finally:
+            self._actor_recovering.pop(actor_id, None)
+            if not rec.done():
+                rec.set_result(None)
+
+    async def _wait_actor_alive(self, actor_id, overall_timeout=None):
+        """Wait until the actor is in a TERMINAL-for-us state: ALIVE or
+        DEAD.  A restart in progress (RESTARTING/PENDING) keeps waiting up
+        to `overall_timeout` (default cfg.actor_restart_wait_s) instead of
+        being misread as death — a restart on a loaded host can take far
+        longer than one RPC's patience."""
+        overall = (overall_timeout if overall_timeout is not None
+                   else cfg.actor_restart_wait_s)
+        deadline = time.monotonic() + overall
+        view = None
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return view
+            try:
+                view = await self._gcs_request(
+                    "wait_actor_alive",
+                    {"actor_id": actor_id, "timeout": min(30.0, remain)})
+            except Exception:
+                return view
+            if view is None or view.get("state") in ("ALIVE", "DEAD"):
+                return view
 
     async def _actor_conn(self, actor_id, actor_addr):
         """Resolve a live connection to the actor.  Only call while holding

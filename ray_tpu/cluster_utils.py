@@ -117,3 +117,110 @@ class Cluster:
             node.kill(stop_gcs=node is self.head)
         self.nodes.clear()
         self.head = None
+
+
+class ProcessCluster:
+    """Multi-node cluster of REAL OS processes (one GCS process + one
+    raylet process per node), for SIGKILL-grade fault injection and for
+    validating the actual deployment topology (reference:
+    python/ray/cluster_utils.py Cluster — each add_node spawns a real
+    raylet process; tests kill them mid-run)."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        from ray_tpu._private.node import new_session_dir
+        self.host = host
+        self.session_dir = new_session_dir()
+        self.head = None
+        self.nodes: list = []
+        self._connected = False
+        self._raylet_pids: set[int] = set()
+
+    @property
+    def gcs_addr(self):
+        return self.head.gcs_addr if self.head else None
+
+    @property
+    def address(self) -> str | None:
+        if self.head is None:
+            return None
+        return f"{self.head.gcs_addr[0]}:{self.head.gcs_addr[1]}"
+
+    def add_node(self, num_cpus=1, num_tpus=None, resources=None,
+                 labels=None, object_store_memory=None, node_name=None):
+        from ray_tpu._private.node import NodeProcesses
+        head = self.head is None
+        node = NodeProcesses(
+            session_dir=self.session_dir, head=head,
+            gcs_addr=None if head else self.head.gcs_addr,
+            host=self.host, num_cpus=num_cpus, num_tpus=num_tpus,
+            resources=resources, labels=labels,
+            object_store_memory=object_store_memory,
+            node_name=node_name).start()
+        if head:
+            self.head = node
+        self.nodes.append(node)
+        self._raylet_pids.add(node.raylet_proc.pid)
+        return node
+
+    def remove_node(self, node, graceful: bool = False):
+        """SIGKILL a node's raylet process (real fault injection; its
+        workers die when the raylet socket closes)."""
+        import signal
+        node.kill_raylet(sig=signal.SIGTERM if graceful else signal.SIGKILL)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def kill_gcs(self):
+        self.head.kill_gcs()
+
+    def restart_gcs(self):
+        self.head.restart_gcs()
+
+    def connect(self, **kwargs):
+        """Connect this process as a driver, exactly the way an external
+        `ray_tpu.init(address=...)` driver would (raylet discovery + store
+        path from the register reply)."""
+        import ray_tpu
+        cw = ray_tpu.init(address=self.address, **kwargs)
+        self._connected = True
+        return cw
+
+    def wait_for_nodes(self, count=None, timeout=60.0):
+        import asyncio
+        from ray_tpu._private import protocol
+        from ray_tpu._private.api import _ensure_loop
+
+        count = count if count is not None else len(self.nodes)
+        loop = _ensure_loop()
+
+        async def _wait():
+            conn = await protocol.Connection.connect(
+                self.head.gcs_addr[0], self.head.gcs_addr[1], name="waiter")
+            ok = await conn.request("wait_for_nodes",
+                                    {"count": count, "timeout": timeout})
+            await conn.close()
+            return ok
+
+        return asyncio.run_coroutine_threadsafe(
+            _wait(), loop).result(timeout + 10)
+
+    def shutdown(self):
+        import glob
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+        if self._connected and worker_mod.global_worker is not None:
+            ray_tpu.shutdown()
+        pids = set(self._raylet_pids)
+        for node in list(reversed(self.nodes)):
+            node.kill()
+        self.nodes.clear()
+        self.head = None
+        # SIGKILLed raylets can't clean their shm arenas; sweep ONLY this
+        # cluster's (the arena filename ends with the raylet's pid).
+        import os
+        for pid in pids:
+            for path in glob.glob(f"/dev/shm/rt_store_*_{pid}"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass

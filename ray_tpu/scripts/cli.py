@@ -40,6 +40,126 @@ def _print_rows(rows):
         print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
 
 
+_STATE_DIR = "/tmp/ray_tpu"
+_STATE_FILE = f"{_STATE_DIR}/started_nodes.json"
+
+
+def _load_started():
+    import os
+    if not os.path.exists(_STATE_FILE):
+        return []
+    try:
+        with open(_STATE_FILE) as f:
+            return json.load(f)
+    except Exception:
+        return []
+
+
+def _save_started(entries):
+    import os
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    with open(_STATE_FILE, "w") as f:
+        json.dump(entries, f, indent=2)
+
+
+def cmd_start(args):
+    """Bring up this machine's node processes and leave them running
+    (reference: `ray start --head` / `ray start --address`,
+    python/ray/scripts/scripts.py:532).  The head runs GCS + raylet; a
+    joining node runs just a raylet registered to --address."""
+    from ray_tpu._private.node import NodeProcesses, new_session_dir
+
+    if not args.head and args.address is None:
+        p_err = ("rt start needs --head (start a new cluster) or "
+                 "--address host:port (join one)")
+        print(p_err, file=sys.stderr)
+        sys.exit(2)
+    head = args.address is None
+    gcs_addr = None
+    if not head:
+        host, port = args.address.rsplit(":", 1)
+        gcs_addr = (host, int(port))
+    resources = json.loads(args.resources) if args.resources else None
+    node = NodeProcesses(
+        session_dir=new_session_dir(),
+        head=head, gcs_addr=gcs_addr,
+        host=args.node_ip, gcs_port=args.port,
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=resources, node_name=args.node_name,
+        register_atexit=False,  # processes outlive this CLI invocation
+    ).start()
+    entries = _load_started()
+    entries.append({
+        "head": head,
+        "gcs_address": f"{node.gcs_addr[0]}:{node.gcs_addr[1]}",
+        "raylet_address": f"{node.raylet_addr[0]}:{node.raylet_addr[1]}",
+        "session_dir": node.session_dir,
+        "pids": node.pids(),
+    })
+    _save_started(entries)
+    if head:
+        print(f"started head node")
+        print(f"  GCS address: {node.gcs_addr[0]}:{node.gcs_addr[1]}")
+        print(f"  connect a driver:   ray_tpu.init(address="
+              f"\"{node.gcs_addr[0]}:{node.gcs_addr[1]}\")")
+        print(f"  join another node:  rt start --address "
+              f"{node.gcs_addr[0]}:{node.gcs_addr[1]} "
+              f"--node-ip <that machine's IP>")
+    else:
+        print(f"started worker node, joined {args.address}")
+    print(f"  raylet: {node.raylet_addr[0]}:{node.raylet_addr[1]}"
+          f"  session: {node.session_dir}")
+    print(f"  stop with: rt stop")
+
+
+def cmd_stop(args):
+    """Kill every node process started by `rt start` on this machine.
+    SIGTERM first (the raylet closes its store gracefully, unlinking the
+    /dev/shm arena), SIGKILL stragglers, then sweep any arena files the
+    killed raylets left behind."""
+    import glob
+    import os
+    import signal
+    import time
+    entries = _load_started()
+    if not entries:
+        print("no started nodes recorded")
+        return
+    pids = [(role, pid) for e in entries
+            for role, pid in e.get("pids", {}).items()]
+    stopped = 0
+    for role, pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+        except Exception as e:
+            print(f"failed to stop {role} pid {pid}: {e}")
+    deadline = time.monotonic() + 10
+    live = {pid for _, pid in pids}
+    while live and time.monotonic() < deadline:
+        for pid in list(live):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                live.discard(pid)
+        time.sleep(0.2)
+    for pid in live:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    for _, pid in pids:
+        for path in glob.glob(f"/dev/shm/rt_store_*_{pid}"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    _save_started([])
+    print(f"stopped {stopped} processes")
+
+
 def cmd_status(args):
     import ray_tpu
     from ray_tpu.experimental import state
@@ -127,6 +247,26 @@ def main(argv=None):
     p.add_argument("--address", default=None,
                    help="GCS address host:port (default: local cluster)")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    st = sub.add_parser("start", help="start node processes on this machine")
+    st.add_argument("--head", action="store_true",
+                    help="start a head node (GCS + raylet)")
+    st.add_argument("--address", default=None,
+                    help="GCS host:port of the cluster to join")
+    st.add_argument("--port", type=int, default=0,
+                    help="GCS port for --head (default: any free port)")
+    st.add_argument("--node-ip", default="127.0.0.1",
+                    help="bind/advertise address — set to this machine's "
+                         "routable IP for multi-host clusters")
+    st.add_argument("--num-cpus", type=int, default=None)
+    st.add_argument("--num-tpus", type=int, default=None)
+    st.add_argument("--resources", default=None,
+                    help='extra resources as JSON, e.g. \'{"A": 2}\'')
+    st.add_argument("--node-name", default=None)
+    st.set_defaults(fn=cmd_start)
+
+    sub.add_parser("stop", help="stop node processes started by rt start") \
+        .set_defaults(fn=cmd_stop)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 
