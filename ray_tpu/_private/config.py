@@ -36,6 +36,10 @@ class _Config:
     # ray_config_def.h max_direct_call_object_size = 100KiB).
     max_direct_call_object_size = _def("max_direct_call_object_size", int, 100 * 1024)
     fetch_chunk_bytes = _def("fetch_chunk_bytes", int, 8 * 1024**2)
+    # How long an object creation may wait for transiently-pinned memory
+    # to free before reporting OOM (reference: plasma's create-request
+    # queue + object_store_full_delay semantics).
+    create_retry_timeout_s = _def("create_retry_timeout_s", float, 120.0)
 
     # --- scheduling ---
     max_workers_per_node = _def("max_workers_per_node", int, 64)

@@ -523,6 +523,7 @@ class Raylet:
         resources = body.get("resources") or {}
         pg_id = body.get("pg_id")
         bundle_index = body.get("bundle_index")
+        hopped = body.get("hops", 0) > 0
         pg_key = None
         if pg_id is not None:
             pg_key = self._bundle_key_for(pg_id, bundle_index, resources)
@@ -539,6 +540,12 @@ class Raylet:
             target = self._pick_spread_target(resources)
             if target is not None:
                 return {"spillback": target}
+        elif hopped:
+            # Already spilled here once: queue locally — re-spilling on a
+            # stale resource view of the sender ping-pongs the request
+            # until its hop budget dies (reference: the lease protocol's
+            # spillback count).
+            pass
         elif not self._fits(resources):
             # Feasible here but busy: shed to a node that can run it NOW,
             # scored by post-placement critical-resource utilization
@@ -646,6 +653,8 @@ class Raylet:
     async def _schedule_leases(self):
         """Grant pending lease requests from the idle pool; never block on a
         worker cold-start (spawns run as background tasks and re-kick)."""
+        if self._shutdown:
+            return  # the store handle is gone; a late kick must not touch it
         if self._scheduling:
             self._kick_pending = True
             return
@@ -658,6 +667,19 @@ class Raylet:
                     continue
                 if not self._fits(req["resources"], req["pg_key"]):
                     continue
+                if len(self.leases) >= 1:
+                    # Object-store backpressure (reference: memory-aware
+                    # admission in the raylet): admitting more tasks while
+                    # the arena is nearly all PINNED only adds more pinned
+                    # args — the running tasks must finish (and release
+                    # pins) first.  Gate on pinned+unsealed, not used():
+                    # unpinned secondary copies are evictable on demand
+                    # and must not throttle admission.  One lease always
+                    # proceeds so the node can't wedge.
+                    st = self.store.stats()
+                    if (st["pinned_bytes"] + st["unsealed_bytes"]
+                            > 0.85 * self.store_capacity):
+                        break
                 kind = "tpu" if req["resources"].get("TPU") else "cpu"
                 w = None
                 idle = self.idle_workers[kind]
@@ -833,6 +855,35 @@ class Raylet:
         size: int = body["size"]
         off = await self._alloc_with_spill(oid, size)
         if off is None:
+            # Memory is transiently pinned by running tasks' zero-copy
+            # args: QUEUE the create instead of failing (reference: the
+            # plasma store's create-request queue blocks until eviction
+            # frees room).  Pins drop as tasks finish; only a working
+            # set that can never fit should error.
+            deadline = (asyncio.get_running_loop().time()
+                        + cfg.create_retry_timeout_s)
+            while off is None and not self._shutdown and \
+                    asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.2)
+                if self._shutdown:
+                    return {"error": "raylet shutting down"}
+                off = await self._alloc_with_spill(oid, size)
+        if off is None:
+            try:
+                holders = {}
+                for conn_id, pins in self._client_pins.items():
+                    who = "?"
+                    for w in self.workers.values():
+                        if w.conn is not None and id(w.conn) == conn_id:
+                            who = f"worker:{w.pid}"
+                            break
+                    holders[f"{who}#{conn_id % 9973}"] = sum(pins.values())
+                logger.warning(
+                    "create of %d bytes timed out; stats=%s primaries=%d "
+                    "holders=%s", size, self.store.stats(),
+                    len(self.primary_objects), holders)
+            except Exception:
+                pass
             return {"error": f"object store OOM allocating {size} bytes "
                              f"(after spilling)"}
         self._created_sizes[oid] = size
@@ -843,16 +894,23 @@ class Raylet:
 
     async def _alloc_with_spill(self, oid: bytes, size: int):
         """alloc, spilling primary copies to disk on memory pressure (the
-        C++ store already LRU-evicts unpinned secondary copies)."""
+        C++ store already LRU-evicts unpinned secondary copies).  Spills
+        escalate: a fragmented arena may need several times `size` freed
+        before first-fit finds a contiguous hole, so keep spilling until
+        the alloc lands or nothing spillable remains."""
         off = self.store.alloc(oid, size)
-        if off is not None:
-            return off
-        await self._spill_bytes(size)
-        return self.store.alloc(oid, size)
+        attempt = 0
+        while off is None and attempt < 6:
+            freed = await self._spill_bytes(size * (1 + attempt))
+            off = self.store.alloc(oid, size)
+            if freed == 0 and off is None:
+                break
+            attempt += 1
+        return off
 
-    async def _spill_bytes(self, need: int):
+    async def _spill_bytes(self, need: int) -> int:
         """Move primary copies to disk, oldest first, until ~need bytes of
-        pinned space have been released."""
+        pinned space have been released.  Returns bytes freed."""
         os.makedirs(self.spill_dir, exist_ok=True)
         freed = 0
         loop = asyncio.get_running_loop()
@@ -891,6 +949,7 @@ class Raylet:
                             oid.hex()[:8], sz, path)
             finally:
                 self._spilling.discard(oid)
+        return freed
 
     @staticmethod
     def _write_spill_file(path: str, data: bytes):
@@ -1152,6 +1211,9 @@ class Raylet:
             if pins[oid] <= 0:
                 del pins[oid]
         self.store.release(oid)
+        if self.pending_leases:
+            # Freed pins may clear the store-pressure admission gate.
+            self._kick_scheduler()
         return {"ok": True}
 
     async def rpc_os_delete(self, conn, body):

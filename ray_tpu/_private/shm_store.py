@@ -66,6 +66,9 @@ def _load_lib():
         lib.store_capacity.argtypes = [ctypes.c_void_p]
         lib.store_evict.restype = ctypes.c_int
         lib.store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.store_stats.restype = None
+        lib.store_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 6
         _LIB = lib
         return lib
 
@@ -82,6 +85,8 @@ class StoreServer:
             raise RuntimeError(f"failed to create shm store at {path}")
 
     def alloc(self, object_id: bytes, size: int) -> int | None:
+        if not self.handle:  # closed: callers treat as OOM / absent
+            return None
         off = ctypes.c_uint64()
         rc = self.lib.store_alloc(self.handle, object_id, size, ctypes.byref(off))
         if rc == 0:
@@ -91,10 +96,14 @@ class StoreServer:
         return None  # OOM
 
     def seal(self, object_id: bytes) -> bool:
+        if not self.handle:
+            return False
         return self.lib.store_seal(self.handle, object_id) == 0
 
     def get(self, object_id: bytes):
         """Returns (offset, size, sealed) or None; pins when sealed."""
+        if not self.handle:
+            return None
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         sealed = ctypes.c_int()
@@ -105,16 +114,37 @@ class StoreServer:
         return off.value, size.value, bool(sealed.value)
 
     def release(self, object_id: bytes) -> bool:
+        if not self.handle:
+            return False
         return self.lib.store_release(self.handle, object_id) == 0
 
     def delete(self, object_id: bytes) -> bool:
+        if not self.handle:
+            return False
         return self.lib.store_delete(self.handle, object_id) == 0
 
     def contains(self, object_id: bytes) -> bool:
+        if not self.handle:
+            return False
         return self.lib.store_contains(self.handle, object_id) == 1
 
     def used(self) -> int:
+        if not self.handle:
+            return 0
         return self.lib.store_used(self.handle)
+
+    def stats(self) -> dict:
+        """Fragmentation/pin diagnostics (largest_free is the biggest
+        contiguous hole — the real bound on the next large alloc)."""
+        if not self.handle:
+            return {k: 0 for k in ("used", "largest_free", "lru_bytes",
+                                   "pinned_bytes", "unsealed_bytes",
+                                   "n_objects")}
+        vals = [ctypes.c_uint64() for _ in range(6)]
+        self.lib.store_stats(self.handle, *[ctypes.byref(v) for v in vals])
+        keys = ("used", "largest_free", "lru_bytes", "pinned_bytes",
+                "unsealed_bytes", "n_objects")
+        return dict(zip(keys, (v.value for v in vals)))
 
     def close(self):
         if self.handle:

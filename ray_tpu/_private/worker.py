@@ -922,12 +922,29 @@ class CoreWorker:
                 spec.get("bundle_index"))
 
     async def _submit(self, spec):
+        await self._wait_args_ready(spec)
         key = self._scheduling_key(spec)
         pool = self.lease_pools.get(key)
         if pool is None:
             pool = self.lease_pools[key] = LeasePool()
         pool.queue.append(spec)
         self._pump(key)
+
+    async def _wait_args_ready(self, spec):
+        """Dependency resolution BEFORE dispatch (reference:
+        DependencyResolver in direct_task_transport.h — a task is pushed
+        only once its args exist).  Without this, dispatched tasks sit on
+        workers blocking in the arg fetch; each blocked worker releases
+        its CPU, the raylet admits yet another task, and an all-to-all
+        under memory pressure amplifies into dozens of half-running tasks
+        whose pinned args wedge the object store."""
+        pins = self._arg_pins.get(spec["task_id"])
+        if not pins:
+            return
+        for ref in pins:
+            entry = self.owned.get(ref.id)
+            if entry is not None and not entry.ready():
+                await entry.event.wait()
 
     def _pump(self, key):
         pool = self.lease_pools[key]
@@ -990,6 +1007,11 @@ class CoreWorker:
                     conn = await self._raylet_conn(addr)
                     body = dict(body)
                     body["strategy"] = None  # don't re-spread at the target
+                    # A spilled request must not bounce again on the
+                    # target's (possibly stale) view of us — it queues
+                    # there instead (reference: spillback counts in the
+                    # lease protocol prevent ping-pong).
+                    body["hops"] = body.get("hops", 0) + 1
                     continue
                 break
             if reply.get("cancelled"):
@@ -1279,10 +1301,32 @@ class CoreWorker:
             self._fn_cache[fn_id] = fn
         return fn
 
+    def _get_arg(self, ref):
+        """Fetch a task argument without IMMEDIATELY taking the
+        blocked-worker CPU release.
+
+        The CPU release exists so user code calling get() on a
+        not-yet-scheduled task can't deadlock the pool — but releasing
+        it for every arg fetch lets the raylet admit another task whose
+        pinned args deepen the very memory pressure stalling the fetch
+        (observed: 7 concurrent tasks on a 2-CPU node, the arena 100%
+        pinned by their args, every create wedged).  Submitter-owned
+        args are dispatch-gated on readiness (_wait_args_ready), so the
+        short first attempt covers them; borrowed refs and actor-task
+        args are NOT gated, so after the grace window this falls back to
+        the releasing path — a fetch truly waiting on an unscheduled
+        producer still frees its CPU and the pool keeps moving."""
+        try:
+            return self._run(self._get_async_list([ref], 2.0))[0]
+        except Exception:
+            pass
+        return self.get(ref)
+
     def _unpack_args(self, args_blob):
         args, kwargs = serialization.deserialize(args_blob)
-        args = [self.get(a.ref) if isinstance(a, _RefArg) else a for a in args]
-        kwargs = {k: (self.get(v.ref) if isinstance(v, _RefArg) else v)
+        args = [self._get_arg(a.ref) if isinstance(a, _RefArg) else a
+                for a in args]
+        kwargs = {k: (self._get_arg(v.ref) if isinstance(v, _RefArg) else v)
                   for k, v in kwargs.items()}
         return args, kwargs
 

@@ -25,6 +25,26 @@ from ray_tpu.data.block import BlockAccessor
 _GET_TIMEOUT = 600.0
 
 
+class DataContext:
+    """Process-wide data-layer knobs (reference: DatasetContext).
+
+    target_max_block_size bounds materialized block sizes: oversized
+    stage outputs are split by row-range tasks (reference: dynamic block
+    splitting in _internal/block_list mutations).  target_shuffle_rounds
+    controls the push-based shuffle's map/merge overlap."""
+
+    target_max_block_size: Optional[int] = 128 * 1024 * 1024
+    target_shuffle_rounds: int = 4
+
+    _instance = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+
 # --------------------------------------------------------------------------
 # compute strategies
 
@@ -53,6 +73,39 @@ class _TransformActor:
 
 def _apply_stage_task(fn, block, fn_args, fn_kwargs):
     return fn(block, *fn_args, **fn_kwargs)
+
+
+def _accum_blocks(*blocks):
+    return BlockAccessor.combine(list(blocks))
+
+
+def _push_shuffle(refs: List, partition_fn: Callable, n_out: int) -> List:
+    """Pipelined all-to-all core (reference: push_based_shuffle.py:330).
+
+    Map tasks (`partition_fn(block, idx) -> n_out partitions`) are
+    launched in rounds; after each round, per-output accumulator tasks
+    fold that round's partitions into a running block.  Because the
+    accumulators only depend on their round's maps, they execute while
+    later rounds' maps are still running — map/merge overlap instead of
+    a global barrier — and peak memory per merge is one round's
+    partitions, not the whole dataset's."""
+    if not refs:
+        return []
+    rounds = max(1, DataContext.get_current().target_shuffle_rounds)
+    round_size = max(1, (len(refs) + rounds - 1) // rounds)
+    part_task = ray_tpu.remote(partition_fn).options(num_returns=n_out)
+    accum = ray_tpu.remote(_accum_blocks)
+    acc_refs: List = [None] * n_out
+    for r0 in range(0, len(refs), round_size):
+        chunk = refs[r0:r0 + round_size]
+        parts = [part_task.remote(b, r0 + i) for i, b in enumerate(chunk)]
+        if n_out == 1:
+            parts = [[p] for p in parts]
+        for i in range(n_out):
+            cols = [parts[b][i] for b in range(len(parts))]
+            prev = [] if acc_refs[i] is None else [acc_refs[i]]
+            acc_refs[i] = accum.remote(*prev, *cols)
+    return acc_refs
 
 
 # --------------------------------------------------------------------------
@@ -133,7 +186,44 @@ class Dataset:
         # Force completion so downstream count() etc. are cheap.
         ray_tpu.wait(self._block_refs, num_returns=len(self._block_refs),
                      timeout=_GET_TIMEOUT)
+        self._enforce_block_size()
         return self
+
+    def _enforce_block_size(self, target: Optional[int] = None):
+        """Dynamic block splitting (reference: dynamic block splitting by
+        target_max_block_size): any materialized block over the target is
+        split into row-range sub-blocks by a task where it lives.  The
+        driver sees only sizes, never bytes."""
+        target = target or DataContext.get_current().target_max_block_size
+        if not target or not self._block_refs:
+            return
+
+        def _size(block):
+            return BlockAccessor(block).size_bytes()
+
+        size_task = ray_tpu.remote(_size)
+        sizes = ray_tpu.get([size_task.remote(b) for b in self._block_refs],
+                            timeout=_GET_TIMEOUT)
+        if all(s <= target for s in sizes):
+            return
+
+        def _split(block, pieces):
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            per = (rows + pieces - 1) // pieces
+            return [acc.slice(i * per, min(rows, (i + 1) * per))
+                    for i in range(pieces)]
+
+        new_refs: List = []
+        for ref, size in zip(self._block_refs, sizes):
+            if size <= target:
+                new_refs.append(ref)
+                continue
+            pieces = int(-(-size // target))
+            split = ray_tpu.remote(_split).options(num_returns=pieces)
+            out = split.remote(ref, pieces)
+            new_refs.extend(out if isinstance(out, list) else [out])
+        self._block_refs = new_refs
 
     def _blocks(self) -> List:
         """Materialized local blocks."""
@@ -193,20 +283,57 @@ class Dataset:
 
     # ------------------------------------------------------------- shuffle
     def repartition(self, num_blocks: int) -> "Dataset":
-        blocks = self._blocks()
-        combined = BlockAccessor.combine(blocks)
-        acc = BlockAccessor(combined)
-        n = acc.num_rows()
+        """Distributed repartition: every block is sliced into per-output
+        row ranges by a task where the block LIVES, and each output is
+        assembled by a merge task — no block ever rides through the
+        driver (the driver only sees row counts)."""
+        refs = self._execute()
         num_blocks = max(1, num_blocks)
-        per = (n + num_blocks - 1) // max(1, num_blocks)
-        parts = [acc.slice(i * per, min(n, (i + 1) * per))
-                 for i in range(num_blocks)]
-        return Dataset([ray_tpu.put(p) for p in parts])
+        if not refs:
+            return Dataset([ray_tpu.put([]) for _ in range(num_blocks)])
+
+        def _rows(block):
+            return BlockAccessor(block).num_rows()
+
+        rows_task = ray_tpu.remote(_rows)
+        counts = ray_tpu.get([rows_task.remote(b) for b in refs],
+                             timeout=_GET_TIMEOUT)
+        total = sum(counts)
+        per = (total + num_blocks - 1) // num_blocks
+        # Global row ranges -> per-input slice lists.
+        starts = np.cumsum([0] + counts)
+
+        def _slices(block, first_row):
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            out = []
+            for j in range(num_blocks):
+                lo = max(0, j * per - first_row)
+                hi = min(rows, (j + 1) * per - first_row)
+                out.append(acc.slice(lo, max(lo, hi)))
+            return out
+
+        slice_task = ray_tpu.remote(_slices).options(
+            num_returns=num_blocks)
+        parts = [slice_task.remote(b, int(starts[i]))
+                 for i, b in enumerate(refs)]
+        if num_blocks == 1:
+            parts = [[p] for p in parts]
+
+        def _cat(*chunks):
+            return BlockAccessor.combine(list(chunks))
+
+        cat = ray_tpu.remote(_cat)
+        return Dataset([cat.remote(*[parts[i][j] for i in range(len(parts))])
+                        for j in range(num_blocks)])
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Two-round all-to-all (reference: push_based_shuffle.py:330):
-        round 1 splits every block into N random partitions, round 2
-        merges partition i from every block."""
+        """Push-based shuffle (reference: _internal/push_based_shuffle.py
+        :330): map tasks run in ROUNDS, and each round's partitions are
+        folded into per-output accumulator blocks immediately — merge
+        work for round t overlaps map work for round t+1 instead of one
+        barrier-merge at the end, and no merge task ever holds more than
+        one round's partitions."""
         refs = self._execute()
         n_out = len(refs) or 1
         seed = seed if seed is not None else random.randrange(1 << 30)
@@ -225,22 +352,14 @@ class Dataset:
                 out.append(_take_rows(block, idxs))
             return out
 
-        part_task = ray_tpu.remote(_partition).options(num_returns=n_out)
-        parts = [part_task.remote(b, i) for i, b in enumerate(refs)]
-        if n_out == 1:
-            parts = [[p] for p in parts]
+        def _finalize(block, out_idx):
+            acc = BlockAccessor(block)
+            rng = np.random.RandomState((seed ^ 0x5bd1e995) + out_idx)
+            return _take_rows(block, rng.permutation(acc.num_rows()))
 
-        def _merge(*chunks):
-            merged = BlockAccessor.combine(list(chunks))
-            acc = BlockAccessor(merged)
-            rng = np.random.RandomState(seed)
-            perm = rng.permutation(acc.num_rows())
-            return _take_rows(merged, perm)
-
-        merge_task = ray_tpu.remote(_merge)
-        out = [merge_task.remote(*[parts[b][i] for b in range(len(parts))])
-               for i in range(n_out)]
-        return Dataset(out)
+        out = _push_shuffle(refs, _partition, n_out)
+        fin = ray_tpu.remote(_finalize)
+        return Dataset([fin.remote(b, i) for i, b in enumerate(out)])
 
     def sort(self, key: Optional[str] = None, descending: bool = False
              ) -> "Dataset":
@@ -278,7 +397,7 @@ class Dataset:
         boundaries = np.array(
             [merged[int(len(merged) * i / n)] for i in range(1, n)])
 
-        def _partition(block):
+        def _partition(block, _idx):
             vals = _key_values(block, key)
             assign = np.searchsorted(boundaries, vals, side="right")
             if descending:
@@ -291,17 +410,15 @@ class Dataset:
                 start += s
             return out
 
-        part_task = ray_tpu.remote(_partition).options(num_returns=n)
-        parts = [part_task.remote(b) for b in refs]
+        def _sort_range(block):
+            return _local_sort(block, key, descending)
 
-        def _merge_sorted(*blocks):
-            return _local_sort(BlockAccessor.combine(list(blocks)),
-                               key, descending)
-
-        merge_task = ray_tpu.remote(_merge_sorted)
-        return Dataset([
-            merge_task.remote(*[parts[i][j] for i in range(len(parts))])
-            for j in range(n)])
+        # Pipelined range exchange: accumulators concatenate each round's
+        # range-partitions while later rounds still partition; the final
+        # per-range sort runs once per output.
+        out = _push_shuffle(refs, _partition, n)
+        sort_range = ray_tpu.remote(_sort_range)
+        return Dataset([sort_range.remote(b) for b in out])
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)

@@ -39,7 +39,9 @@ def _wrap_cause(cause: Exception, tb: str):
         return cause
     try:
         derived = type("TaskError_" + cause_cls.__name__, (TaskError, cause_cls), {
-            "__init__": lambda self: None,
+            # Must swallow positional args: unpickling an exception calls
+            # cls(*self.args), and these wrappers carry a message arg.
+            "__init__": lambda self, *a: None,
         })
         exc = derived()
         exc.cause = cause

@@ -59,13 +59,19 @@ class GPTConfig:
     num_microbatches: int = 1   # pipeline microbatches (used when pp > 1)
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # "full": checkpoint the whole layer (min memory, recomputes
-    # attention — including the flash forward — in the backward).
-    # "ffn": checkpoint only the ffn branch; attention residuals
-    # (q/k/v/out/lse) are stored, so the quadratic-cost flash forward
-    # never re-runs.  ~1/3 less recompute at long seq for
-    # O(B*T*D) extra HBM per layer.
+    # "full": checkpoint the whole layer — minimum HBM, the backward
+    # recomputes the layer forward (including the flash kernel).  Set
+    # remat_save_attn=True to additionally pin the attention output
+    # across the checkpoint (skips the O(T^2) re-run for O(B*T*D) HBM
+    # per layer).
+    # "ffn": checkpoint only the ffn branch; all attention residuals
+    # (q/k/v/out/lse) are stored.  More HBM than "full".
     remat_mode: str = "full"
+    # With remat_mode="full": additionally pin the attention output
+    # across the layer checkpoint (skips the O(T^2) forward re-run in
+    # the backward at O(B*T*D) HBM per layer).  Off by default — on
+    # 16G-HBM v5e the lost batch size outweighs the saved recompute.
+    remat_save_attn: bool = False
     # Pallas flash attention for long sequences (TPU only; falls back to
     # the einsum reference off-TPU or on non-tiling shapes).
     use_flash: bool = True
@@ -211,6 +217,12 @@ def _attention(x, p, cfg, active, sizes):
         if out is None:
             out = reference_attention(q, kk, v, causal=cfg.causal,
                                       scale=scale)
+    # Name the attention output so the remat policy can pin it in HBM:
+    # under "full" remat everything else in the layer is recomputed, but
+    # re-running the O(T^2) attention forward would be the one recompute
+    # that actually costs (the rest is cheap matmuls/elementwise).
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "attn_out")
     wo = _all_gather(p["wo"], "fsdp", 2, active).astype(dt)
     y = jnp.einsum("bthk,hkd->btd", out, wo)
     return _psum(y, ("tp",), active)
@@ -285,7 +297,14 @@ def _make_layer_fn(cfg: GPTConfig, active, sizes):
         x = x + a
         return ffn_branch(x, lp), None
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        # Measured on v5e at seq 4096: pinning attn_out in HBM
+        # (save_only_these_names) forces batch 8 -> 7 and nets LESS
+        # throughput (45.6% vs 52.6% MFU), so the recompute-everything
+        # policy stays the default; flip remat_save_attn on chips with
+        # more HBM headroom.
+        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
+                  if cfg.remat_save_attn else None)
+        layer = jax.checkpoint(layer, policy=policy)
     return layer
 
 
@@ -378,8 +397,12 @@ def forward(params: dict, tokens, cfg: GPTConfig, mesh=None):
                        x_spec)(params["blocks"], x)
 
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        params["wlm"].astype(jnp.float32))
+    # bf16 operands, f32 accumulation: upcasting the INPUTS would push
+    # the lm-head matmul off the fast MXU path (and the [B,T,vocab]
+    # logits are produced in f32 either way for a stable softmax).
+    logits = jnp.einsum("btd,dv->btv", x.astype(cfg.dtype),
+                        params["wlm"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
     if mesh is not None:
         logits = lax.with_sharding_constraint(
             logits, NamedSharding(mesh, P(BATCH_AXES, "sp", "tp")))

@@ -16,6 +16,10 @@ from ray_tpu.rllib.algorithms.ddppo.ddppo import (  # noqa: F401
     DDPPOConfig,
 )
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import (  # noqa: F401
+    ApexDQN,
+    ApexDQNConfig,
+)
 from ray_tpu.rllib.algorithms.a2c.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo.appo import (  # noqa: F401
     APPO,
@@ -33,7 +37,8 @@ from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "Algorithm",
-           "AlgorithmConfig", "BC", "BCConfig", "DDPPO", "DDPPOConfig",
+           "AlgorithmConfig", "ApexDQN", "ApexDQNConfig", "BC",
+           "BCConfig", "DDPPO", "DDPPOConfig",
            "DQN", "DQNConfig", "ES", "ESConfig", "Impala",
            "ImpalaConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
            "PPO", "PPOConfig", "SAC", "SACConfig", "SampleBatch"]

@@ -56,6 +56,18 @@ class AlgorithmConfig:
         self._config.update(kwargs)
         return self
 
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None
+                    ) -> "AlgorithmConfig":
+        """Configure multi-agent training (reference:
+        algorithm_config.py multi_agent()).  `policies` maps policy_id ->
+        PolicySpec (or an agent_id whose spaces size the policy);
+        `policy_mapping_fn(agent_id) -> policy_id`."""
+        if policies is not None:
+            self._config["policies"] = policies
+        if policy_mapping_fn is not None:
+            self._config["policy_mapping_fn"] = policy_mapping_fn
+        return self
+
     def debugging(self, seed=None) -> "AlgorithmConfig":
         if seed is not None:
             self._config["seed"] = seed
@@ -87,9 +99,19 @@ class Algorithm(Trainable):
         defaults.update(self._extra_defaults())
         defaults.update(config)
         self.algo_config = defaults
+        self.is_multi_agent = bool(self.algo_config.get("policies"))
+        worker_cls = None
+        if self.is_multi_agent:
+            from ray_tpu.rllib.evaluation.multi_agent_worker import (
+                MultiAgentRolloutWorker)
+            worker_cls = MultiAgentRolloutWorker
+            self.algo_config.setdefault(
+                "policy_mapping_fn",
+                lambda agent_id, *a, **kw: "default_policy")
         self.workers = WorkerSet(
             _default_env_creator, self.policy_cls, self.algo_config,
-            num_workers=self.algo_config["num_rollout_workers"])
+            num_workers=self.algo_config["num_rollout_workers"],
+            worker_cls=worker_cls)
         self._timesteps_total = 0
         self._episode_rewards: list = []
 

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import ApexDQN, ApexDQNConfig
+
+__all__ = ["ApexDQN", "ApexDQNConfig"]

@@ -1,0 +1,189 @@
+"""Ape-X DQN: distributed replay — samplers, replay shards, one learner.
+
+Reference: rllib/algorithms/apex_dqn/apex_dqn.py — N rollout workers with
+a per-worker epsilon ladder push experience straight into M REPLAY ACTORS
+(sharded buffers); the learner loop pulls training batches from the
+shards round-robin while sampling continues, and broadcasts weights
+periodically.  Decoupling sampling from learning is the point: neither
+waits on the other (throughput-positive vs plain DQN's lockstep loop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.jax_q_policy import JaxQPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class ReplayActor:
+    """One shard of the distributed replay memory (reference:
+    apex_dqn's ReplayActor over a replay buffer shard)."""
+
+    def __init__(self, capacity: int, seed: int):
+        self.buffer = ReplayBuffer(capacity, seed=seed)
+        self.added = 0
+
+    def add(self, batch: SampleBatch) -> int:
+        self.buffer.add(batch)
+        self.added += batch.count
+        return batch.count
+
+    def ready(self, min_size: int) -> bool:
+        return len(self.buffer) >= min_size
+
+    def replay(self, batch_size: int):
+        if len(self.buffer) == 0:
+            return None
+        return self.buffer.sample(batch_size)
+
+    def stats(self) -> Dict:
+        return {"size": len(self.buffer), "added": self.added}
+
+
+class ApexDQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(ApexDQN)
+        self._config.update({
+            "lr": 1e-3,
+            "num_replay_shards": 2,
+            "buffer_capacity": 50_000,
+            "learning_starts": 500,
+            "train_batch_size": 1000,     # env steps sampled per iter
+            "sgd_batch_size": 64,
+            "num_sgd_steps": 40,
+            "target_update_freq": 2,
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.02,
+            "epsilon_anneal_iters": 10,
+            # Per-worker epsilon ladder (reference: Ape-X's per-actor
+            # exploration schedule eps_i = eps^(1 + i/(N-1) * alpha)).
+            "epsilon_ladder_alpha": 3.0,
+        })
+
+
+class ApexDQN(Algorithm):
+    policy_cls = JaxQPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return dict(ApexDQNConfig()._config)
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        cfg = self.algo_config
+        shards = max(1, cfg["num_replay_shards"])
+        replay_cls = ray_tpu.remote(ReplayActor)
+        per_shard = max(1, cfg["buffer_capacity"] // shards)
+        self.replay_actors = [
+            replay_cls.options(num_cpus=0).remote(per_shard,
+                                                  cfg["seed"] + i)
+            for i in range(shards)]
+        self._iter = 0
+        self._replay_rr = 0
+        self._sample_refs: List = []
+        self._add_refs: List = []
+
+    def _worker_epsilons(self, base: float) -> List[float]:
+        """Epsilon ladder: worker i explores at base^(1+alpha*i/(N-1))."""
+        cfg = self.algo_config
+        n = max(1, len(self.workers.remote_workers))
+        alpha = cfg["epsilon_ladder_alpha"]
+        out = []
+        for i in range(n):
+            exp = 1.0 + alpha * (i / max(1, n - 1))
+            out.append(float(np.clip(base ** exp, cfg["final_epsilon"],
+                                     1.0)))
+        return out
+
+    def _base_epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._iter / max(cfg["epsilon_anneal_iters"], 1))
+        return (cfg["initial_epsilon"]
+                + frac * (cfg["final_epsilon"] - cfg["initial_epsilon"]))
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        self._iter += 1
+        workers = self.workers.remote_workers
+        policy = self.workers.local_worker.policy
+
+        # 1. Kick off ASYNC sampling on every worker (per-worker epsilon
+        # ladder: low-index workers exploit, high-index explore).
+        if workers:
+            eps = self._worker_epsilons(self._base_epsilon())
+            weights = policy.get_weights()
+            per_worker = max(1, cfg["train_batch_size"] // len(workers))
+            self._sample_refs = []
+            for i, w in enumerate(workers):
+                wcopy = dict(weights)
+                wcopy["epsilon"] = eps[i]
+                w.set_weights.remote(ray_tpu.put(wcopy))
+                self._sample_refs.append(w.sample.remote(per_worker))
+        else:
+            self.workers.local_worker.policy.epsilon = self._base_epsilon()
+            b = self.workers.local_worker.sample(cfg["train_batch_size"])
+            self._sample_refs = [ray_tpu.put(b)]
+
+        # 2. Route finished fragments into replay shards WITHOUT waiting
+        # for stragglers (async pipeline: learner trains below while the
+        # slow workers keep sampling).
+        ready, pending = ray_tpu.wait(
+            list(self._sample_refs),
+            num_returns=len(self._sample_refs), timeout=30)
+        added = 0
+        for ref in ready:
+            shard = self.replay_actors[self._replay_rr
+                                       % len(self.replay_actors)]
+            self._replay_rr += 1
+            self._add_refs.append(shard.add.remote(ref))
+            added += 1
+        self._sample_refs = list(pending)
+        # Reap completed adds (keep the pipeline bounded).
+        if self._add_refs:
+            done, self._add_refs = ray_tpu.wait(
+                self._add_refs, num_returns=len(self._add_refs),
+                timeout=30)
+            self._timesteps_total += sum(ray_tpu.get(done, timeout=60))
+
+        # 3. Learner: pull batches from shards round-robin and SGD.
+        stats: Dict = {}
+        trained = 0
+        readiness = ray_tpu.get(
+            [ra.ready.remote(cfg["learning_starts"]
+                             // len(self.replay_actors))
+             for ra in self.replay_actors], timeout=60)
+        if any(readiness):
+            live = [ra for ra, ok in zip(self.replay_actors, readiness)
+                    if ok]
+            # Prefetch: request the next replay batch while training on
+            # the current one (the reference's learner thread overlap).
+            pending_batch = live[0].replay.remote(cfg["sgd_batch_size"])
+            for i in range(cfg["num_sgd_steps"]):
+                nxt = live[(i + 1) % len(live)].replay.remote(
+                    cfg["sgd_batch_size"])
+                batch = ray_tpu.get(pending_batch, timeout=120)
+                pending_batch = nxt
+                if batch is None:
+                    continue
+                stats = policy.learn_on_batch(batch)
+                trained += batch.count
+            ray_tpu.get(pending_batch, timeout=120)
+            if self._iter % cfg["target_update_freq"] == 0:
+                policy.update_target()
+        return {"info": {"learner": stats,
+                         "replay_shards": len(self.replay_actors)},
+                "num_env_steps_trained": trained,
+                "fragments_routed": added}
+
+    def cleanup(self):
+        for ra in self.replay_actors:
+            try:
+                ray_tpu.kill(ra)
+            except Exception:
+                pass
+        super().cleanup()

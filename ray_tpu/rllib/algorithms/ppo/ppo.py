@@ -55,6 +55,8 @@ class PPO(Algorithm):
             for b in ray_tpu.get(refs, timeout=600):
                 batches.append(b)
                 collected += b.count
+        if self.is_multi_agent:
+            return self._multi_agent_train(batches)
         train_batch = SampleBatch.concat_samples(batches)
         self._timesteps_total += train_batch.count
 
@@ -78,3 +80,32 @@ class PPO(Algorithm):
         self.workers.sync_weights()
         return {"info": {"learner": stats},
                 "num_env_steps_trained": train_batch.count}
+
+    def _multi_agent_train(self, batches) -> Dict:
+        """Per-policy SGD over a MultiAgentBatch (reference: multi-agent
+        train_one_step — each policy trains only on the experience its
+        agents generated)."""
+        from ray_tpu.rllib.evaluation.multi_agent_worker import (
+            MultiAgentBatch)
+        cfg = self.algo_config
+        ma = MultiAgentBatch.concat_samples(batches)
+        self._timesteps_total += ma.count
+        rng = np.random.RandomState(cfg["seed"])
+        policies = self.workers.local_worker.policies
+        stats: Dict = {}
+        for pid, batch in ma.items():
+            if pid not in policies or batch.count == 0:
+                continue
+            adv = batch["advantages"]
+            batch["advantages"] = (
+                (adv - adv.mean()) / max(adv.std(), 1e-6)
+            ).astype(np.float32)
+            policy = policies[pid]
+            mb = min(cfg["sgd_minibatch_size"], batch.count)
+            for _ in range(cfg["num_sgd_iter"]):
+                shuffled = batch.shuffle(rng)
+                for minibatch in shuffled.minibatches(mb):
+                    stats[pid] = policy.learn_on_batch(minibatch)
+        self.workers.sync_weights()
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": ma.count}

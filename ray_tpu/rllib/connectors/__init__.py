@@ -1,0 +1,16 @@
+from ray_tpu.rllib.connectors.connector import (
+    ActionConnector,
+    ClipActionsConnector,
+    Connector,
+    ConnectorPipeline,
+    FlattenObsConnector,
+    MeanStdObsFilter,
+    ObsConnector,
+    get_default_pipelines,
+)
+
+__all__ = [
+    "Connector", "ConnectorPipeline", "ObsConnector", "ActionConnector",
+    "FlattenObsConnector", "MeanStdObsFilter", "ClipActionsConnector",
+    "get_default_pipelines",
+]

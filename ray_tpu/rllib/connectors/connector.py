@@ -1,0 +1,128 @@
+"""Connectors: composable transform pipelines between env and policy.
+
+Reference: rllib/connectors/ — per-policy pipelines that adapt raw env
+observations into policy inputs (agent connectors) and policy outputs
+into env actions (action connectors), carried with checkpoints so serving
+uses the exact training-time preprocessing.
+
+Two pipelines per worker:
+  obs pipeline:    env obs  -> policy input  (flatten, dtype, filters)
+  action pipeline: policy action -> env action (clip/unsquash)
+
+Stateful connectors (MeanStdObsFilter) expose get_state/set_state so
+weight sync can carry filter statistics to every worker, the same way
+the reference syncs its filters alongside weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Connector:
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def get_state(self) -> Optional[Dict]:
+        return None
+
+    def set_state(self, state: Dict):
+        pass
+
+
+class ObsConnector(Connector):
+    """Marker base for observation-side connectors."""
+
+
+class ActionConnector(Connector):
+    """Marker base for action-side connectors."""
+
+
+class FlattenObsConnector(ObsConnector):
+    """Flatten any obs shape to a float32 vector (reference:
+    connectors/agent/obs_preproc.py over the flatten preprocessor)."""
+
+    def __call__(self, obs):
+        return np.asarray(obs, np.float32).reshape(-1)
+
+
+class MeanStdObsFilter(ObsConnector):
+    """Running mean/std normalization (reference: the MeanStdFilter agent
+    connector).  Uses Welford accumulation; statistics ride along with
+    weight syncs via get_state/set_state."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.count = 0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+        self.eps = eps
+
+    def __call__(self, obs):
+        x = np.asarray(obs, np.float64).reshape(-1)
+        if self.mean is None:
+            self.mean = np.zeros_like(x)
+            self.m2 = np.zeros_like(x)
+        self.count += 1
+        delta = x - self.mean
+        self.mean += delta / self.count
+        self.m2 += delta * (x - self.mean)
+        if self.count < 2:
+            return x.astype(np.float32)
+        std = np.sqrt(self.m2 / (self.count - 1)) + self.eps
+        return ((x - self.mean) / std).astype(np.float32)
+
+    def get_state(self):
+        return {"count": self.count,
+                "mean": None if self.mean is None else self.mean.copy(),
+                "m2": None if self.m2 is None else self.m2.copy()}
+
+    def set_state(self, state):
+        self.count = state["count"]
+        self.mean = state["mean"]
+        self.m2 = state["m2"]
+
+
+class ClipActionsConnector(ActionConnector):
+    """Clip continuous actions into the env's bounds (reference:
+    connectors/action/clip.py)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, action):
+        return np.clip(action, self.low, self.high)
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: List[Connector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, x):
+        for c in self.connectors:
+            x = c(x)
+        return x
+
+    def get_state(self):
+        return [c.get_state() for c in self.connectors]
+
+    def set_state(self, states):
+        for c, s in zip(self.connectors, states):
+            if s is not None:
+                c.set_state(s)
+
+
+def get_default_pipelines(config: Dict, action_space=None):
+    """Build the (obs, action) pipelines from config keys
+    `obs_filter` ("flatten" | "meanstd") and `clip_actions`."""
+    obs: List[Connector] = [FlattenObsConnector()]
+    if config.get("obs_filter") == "meanstd":
+        obs.append(MeanStdObsFilter())
+    act: List[Connector] = []
+    if config.get("clip_actions") and action_space is not None \
+            and hasattr(action_space, "low"):
+        act.append(ClipActionsConnector(action_space.low,
+                                        action_space.high))
+    return ConnectorPipeline(obs), ConnectorPipeline(act)

@@ -1,0 +1,87 @@
+"""Multi-agent environment API.
+
+Reference: rllib/env/multi_agent_env.py — an env whose reset/step speak
+per-agent dicts; episode termination is signalled via the "__all__" key.
+Agents may come and go between steps (only agents present in the obs dict
+act next step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+
+class MultiAgentEnv:
+    """Dict-in / dict-out environment.
+
+    reset(seed)  -> ({agent_id: obs}, {agent_id: info})
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos)
+      where terminateds/truncateds carry per-agent flags plus "__all__".
+
+    Subclasses define `observation_space(agent_id)` / `action_space
+    (agent_id)` (gym spaces) so workers can size per-policy networks.
+    """
+
+    possible_agents: Tuple[str, ...] = ()
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+    def observation_space(self, agent_id: str):
+        raise NotImplementedError
+
+    def action_space(self, agent_id: str):
+        raise NotImplementedError
+
+
+def make_multi_agent(env_creator):
+    """Wrap a single-agent env creator into an N-agent env of independent
+    copies (reference: rllib/env/multi_agent_env.py make_multi_agent) —
+    agent i steps its own copy; episodes end when all copies end."""
+
+    class _IndependentCopies(MultiAgentEnv):
+        def __init__(self, config=None):
+            config = dict(config or {})
+            self.num = int(config.pop("num_agents", 2))
+            self.envs = [env_creator(config) for _ in range(self.num)]
+            self.possible_agents = tuple(
+                f"agent_{i}" for i in range(self.num))
+            self._done = [False] * self.num
+
+        def observation_space(self, agent_id):
+            return self.envs[0].observation_space
+
+        def action_space(self, agent_id):
+            return self.envs[0].action_space
+
+        def reset(self, *, seed=None):
+            obs, infos = {}, {}
+            for i, env in enumerate(self.envs):
+                o, info = env.reset(
+                    seed=None if seed is None else seed + i)
+                obs[f"agent_{i}"] = o
+                infos[f"agent_{i}"] = info
+                self._done[i] = False
+            return obs, infos
+
+        def step(self, action_dict):
+            obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
+            for i, env in enumerate(self.envs):
+                aid = f"agent_{i}"
+                if self._done[i] or aid not in action_dict:
+                    continue
+                o, r, te, tr, info = env.step(action_dict[aid])
+                obs[aid], rews[aid] = o, r
+                terms[aid], truncs[aid], infos[aid] = te, tr, info
+                if te or tr:
+                    self._done[i] = True
+                    obs.pop(aid)  # agent is gone until the next reset
+            terms["__all__"] = all(self._done)
+            truncs["__all__"] = False
+            return obs, rews, terms, truncs, infos
+
+    return _IndependentCopies

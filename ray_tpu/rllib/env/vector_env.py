@@ -1,0 +1,48 @@
+"""VectorEnv: N same-type envs stepped as a batch.
+
+Reference: rllib/env/vector_env.py — one policy forward serves N envs per
+step (`num_envs_per_worker`), amortizing inference over the batch; envs
+that finish are reset individually (`reset_at`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class VectorEnv:
+    def __init__(self, envs: List):
+        assert envs, "need at least one env"
+        self.envs = envs
+        self.num_envs = len(envs)
+        self.observation_space = envs[0].observation_space
+        self.action_space = envs[0].action_space
+
+    @classmethod
+    def from_creator(cls, creator: Callable, num_envs: int,
+                     config=None) -> "VectorEnv":
+        return cls([creator(dict(config or {})) for _ in range(num_envs)])
+
+    def vector_reset(self, *, seed: Optional[int] = None):
+        obs = []
+        for i, env in enumerate(self.envs):
+            o, _ = env.reset(seed=None if seed is None else seed + i)
+            obs.append(o)
+        return np.asarray(obs, np.float32)
+
+    def reset_at(self, index: int):
+        o, _ = self.envs[index].reset()
+        return np.asarray(o, np.float32)
+
+    def vector_step(self, actions):
+        obs, rews, terms, truncs = [], [], [], []
+        for env, a in zip(self.envs, actions):
+            o, r, te, tr, _ = env.step(a)
+            obs.append(o)
+            rews.append(float(r))
+            terms.append(bool(te))
+            truncs.append(bool(tr))
+        return (np.asarray(obs, np.float32), np.asarray(rews, np.float32),
+                np.asarray(terms), np.asarray(truncs))

@@ -1,0 +1,180 @@
+"""Multi-agent rollout worker: per-agent trajectories routed to policies.
+
+Reference: rllib/evaluation/rollout_worker.py multi-agent path +
+episode_v2's per-agent trajectory builders — each agent's experience is
+collected under the policy that controlled it (policy_mapping_fn), GAE is
+computed per agent-episode with that policy's value head, and sample()
+returns a MultiAgentBatch {policy_id: SampleBatch}.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy import sample_batch as sb
+from ray_tpu.rllib.policy.policy_map import PolicyMap, PolicySpec
+from ray_tpu.rllib.policy.sample_batch import SampleBatch, compute_gae
+
+
+class MultiAgentBatch(dict):
+    """policy_id -> SampleBatch (reference: policy/sample_batch.py
+    MultiAgentBatch)."""
+
+    @property
+    def count(self) -> int:
+        return sum(b.count for b in self.values())
+
+    @staticmethod
+    def concat_samples(batches: List["MultiAgentBatch"]
+                       ) -> "MultiAgentBatch":
+        out: Dict[str, List[SampleBatch]] = {}
+        for mb in batches:
+            for pid, b in mb.items():
+                out.setdefault(pid, []).append(b)
+        return MultiAgentBatch({
+            pid: SampleBatch.concat_samples(bs)
+            for pid, bs in out.items()})
+
+
+class _AgentTrajectory:
+    """Accumulates one agent's rows until its episode segment closes."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self):
+        self.rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                     sb.DONES, sb.NEXT_OBS,
+                                     sb.ACTION_LOGP, sb.VF_PREDS)}
+
+    def add(self, obs, action, reward, done, next_obs, logp, vf):
+        r = self.rows
+        r[sb.OBS].append(obs)
+        r[sb.ACTIONS].append(action)
+        r[sb.REWARDS].append(float(reward))
+        r[sb.DONES].append(bool(done))
+        r[sb.NEXT_OBS].append(next_obs)
+        r[sb.ACTION_LOGP].append(float(logp))
+        r[sb.VF_PREDS].append(float(vf))
+
+    def __len__(self):
+        return len(self.rows[sb.OBS])
+
+    def to_segment(self, last_value: float, gamma: float,
+                   lam: float) -> SampleBatch:
+        r = self.rows
+        seg = SampleBatch({
+            sb.OBS: np.asarray(r[sb.OBS], np.float32),
+            sb.ACTIONS: np.asarray(r[sb.ACTIONS], np.int32),
+            sb.REWARDS: np.asarray(r[sb.REWARDS], np.float32),
+            sb.DONES: np.asarray(r[sb.DONES], np.bool_),
+            sb.NEXT_OBS: np.asarray(r[sb.NEXT_OBS], np.float32),
+            sb.ACTION_LOGP: np.asarray(r[sb.ACTION_LOGP], np.float32),
+            sb.VF_PREDS: np.asarray(r[sb.VF_PREDS], np.float32),
+        })
+        return compute_gae(seg, last_value, gamma, lam)
+
+
+class MultiAgentRolloutWorker:
+    def __init__(self, env_creator: Callable, policy_cls, config: Dict,
+                 worker_index: int = 0):
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.config = dict(config)
+        self.config["seed"] = self.config.get("seed", 0) + worker_index
+        self.env = env_creator(self.config)
+        self.mapping_fn = self.config["policy_mapping_fn"]
+        specs = {}
+        for pid, spec in self.config["policies"].items():
+            if isinstance(spec, PolicySpec):
+                specs[pid] = spec
+            else:  # infer from an agent this policy controls
+                agent = spec
+                space = self.env.action_space(agent)
+                obs_dim = int(np.prod(
+                    self.env.observation_space(agent).shape))
+                specs[pid] = PolicySpec(obs_dim, int(space.n))
+        self.policies = PolicyMap(specs, self.config, policy_cls)
+        self.worker_index = worker_index
+        self._obs, _ = self.env.reset(seed=self.config["seed"])
+        self._traj: Dict[str, _AgentTrajectory] = {}
+        self._episode_reward = 0.0
+        self._completed_rewards: List[float] = []
+
+    # ------------------------------------------------------------- sampling
+    def sample(self, num_steps: Optional[int] = None) -> MultiAgentBatch:
+        horizon = num_steps or self.config.get("rollout_fragment_length",
+                                               200)
+        gamma = self.config.get("gamma", 0.99)
+        lam = self.config.get("lambda", 0.95)
+        segments: Dict[str, List[SampleBatch]] = {}
+
+        def close(agent_id, last_value):
+            traj = self._traj.pop(agent_id, None)
+            if traj is None or len(traj) == 0:
+                return
+            pid = self.mapping_fn(agent_id)
+            segments.setdefault(pid, []).append(
+                traj.to_segment(last_value, gamma, lam))
+
+        for _ in range(horizon):
+            # Group live agents by policy; one batched forward per policy.
+            by_policy: Dict[str, List[str]] = {}
+            for aid in self._obs:
+                by_policy.setdefault(self.mapping_fn(aid), []).append(aid)
+            actions, logps, vfs = {}, {}, {}
+            for pid, aids in by_policy.items():
+                obs = np.asarray([self._obs[a] for a in aids], np.float32)
+                a, lp, vf = self.policies[pid].compute_actions(obs)
+                for i, aid in enumerate(aids):
+                    actions[aid] = int(a[i])
+                    logps[aid] = float(lp[i])
+                    vfs[aid] = float(vf[i])
+            obs2, rews, terms, truncs, _ = self.env.step(actions)
+            for aid in actions:
+                traj = self._traj.setdefault(aid, _AgentTrajectory())
+                terminated = bool(terms.get(aid, False))
+                traj.add(self._obs[aid], actions[aid],
+                         rews.get(aid, 0.0), terminated,
+                         obs2.get(aid, self._obs[aid]),
+                         logps[aid], vfs[aid])
+                self._episode_reward += float(rews.get(aid, 0.0))
+                if terminated or truncs.get(aid, False):
+                    close(aid, 0.0)
+            if terms.get("__all__") or truncs.get("__all__"):
+                for aid in list(self._traj):
+                    close(aid, 0.0)
+                self._completed_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = obs2
+        # Bootstrap still-open trajectories with each policy's V(s).
+        for aid in list(self._traj):
+            pid = self.mapping_fn(aid)
+            if aid in self._obs:
+                v = float(self.policies[pid].value(
+                    np.asarray(self._obs[aid], np.float32)[None, :])[0])
+            else:
+                v = 0.0
+            close(aid, v)
+        return MultiAgentBatch({
+            pid: SampleBatch.concat_samples(segs)
+            for pid, segs in segments.items()})
+
+    # ------------------------------------------------------------- plumbing
+    def get_weights(self):
+        return self.policies.get_weights()
+
+    def set_weights(self, weights):
+        self.policies.set_weights(weights)
+
+    def episode_stats(self) -> Dict:
+        rewards = self._completed_rewards
+        self._completed_rewards = []
+        return {"episode_rewards": rewards,
+                "episode_lens": [0] * len(rewards)}
+
+    def stop(self):
+        return True

@@ -43,7 +43,27 @@ class RolloutWorker(CollectiveMixin):
             self.config["_act_high"] = np.asarray(space.high, np.float32)
         self.policy = policy_cls(obs_dim, num_actions, self.config)
         self.worker_index = worker_index
-        self._obs, _ = self.env.reset(seed=self.config["seed"])
+        # Connector pipelines (reference: rllib/connectors/) adapt env
+        # obs -> policy input and policy action -> env action.
+        from ray_tpu.rllib.connectors import get_default_pipelines
+        self._obs_pipe, self._act_pipe = get_default_pipelines(
+            self.config, action_space=space)
+        # Vectorized sampling (reference: env/vector_env.py): one policy
+        # forward serves num_envs_per_worker envs per step.
+        self._num_envs = int(self.config.get("num_envs_per_worker", 1))
+        if self._num_envs > 1:
+            from ray_tpu.rllib.env.vector_env import VectorEnv
+            self.venv = VectorEnv(
+                [self.env] + [env_creator(self.config)
+                              for _ in range(self._num_envs - 1)])
+            self._vobs = [self._obs_pipe(o) for o in
+                          self.venv.vector_reset(seed=self.config["seed"])]
+            self._vep_reward = [0.0] * self._num_envs
+            self._vep_len = [0] * self._num_envs
+        else:
+            self.venv = None
+            self._obs, _ = self.env.reset(seed=self.config["seed"])
+            self._obs = self._obs_pipe(self._obs)
         self._episode_reward = 0.0
         self._episode_len = 0
         self._completed_rewards: List[float] = []
@@ -55,6 +75,8 @@ class RolloutWorker(CollectiveMixin):
                                                200)
         gamma = self.config.get("gamma", 0.99)
         lam = self.config.get("lambda", 0.95)
+        if self.venv is not None:
+            return self._sample_vector(horizon, gamma, lam)
         rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
                                 sb.NEXT_OBS, sb.ACTION_LOGP,
                                 sb.VF_PREDS)}
@@ -69,8 +91,12 @@ class RolloutWorker(CollectiveMixin):
             else:
                 act_row = np.asarray(action[0], np.float32)
                 act_env = act_row.reshape(self._act_shape)
+            if not self._discrete and self._act_pipe.connectors:
+                act_env = np.asarray(self._act_pipe(act_env),
+                                     np.float32).reshape(self._act_shape)
             obs2, reward, terminated, truncated, _ = self.env.step(
                 act_env)
+            obs2 = self._obs_pipe(obs2)
             done = terminated or truncated
             rows[sb.OBS].append(self._obs)
             rows[sb.ACTIONS].append(act_row)
@@ -88,6 +114,7 @@ class RolloutWorker(CollectiveMixin):
                 self._episode_reward = 0.0
                 self._episode_len = 0
                 self._obs, _ = self.env.reset()
+                self._obs = self._obs_pipe(self._obs)
                 # Close the segment at the episode boundary.
                 segments.append(self._segment(rows, seg_start,
                                               len(rows[sb.OBS]),
@@ -101,6 +128,65 @@ class RolloutWorker(CollectiveMixin):
                                           len(rows[sb.OBS]),
                                           last_value=last_v,
                                           gamma=gamma, lam=lam))
+        return SampleBatch.concat_samples(segments)
+
+    def _sample_vector(self, horizon: int, gamma: float,
+                       lam: float) -> SampleBatch:
+        """Vectorized fragment: each of the N envs contributes
+        horizon // N steps; one batched policy forward per step serves
+        all envs (reference: the vector-env sampler path)."""
+        n = self._num_envs
+        steps = max(1, horizon // n)
+        rows = [
+            {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                             sb.NEXT_OBS, sb.ACTION_LOGP, sb.VF_PREDS)}
+            for _ in range(n)]
+        segments: List[SampleBatch] = []
+        seg_start = [0] * n
+        for _ in range(steps):
+            obs_batch = np.asarray(self._vobs, np.float32)
+            actions, logps, vfs = self.policy.compute_actions(obs_batch)
+            if self._discrete:
+                env_actions = [int(a) for a in actions]
+                act_rows = env_actions
+            else:
+                act_rows = [np.asarray(a, np.float32) for a in actions]
+                env_actions = [
+                    np.asarray(self._act_pipe(a), np.float32).reshape(
+                        self._act_shape) if self._act_pipe.connectors
+                    else a.reshape(self._act_shape) for a in act_rows]
+            obs2, rews, terms, truncs = self.venv.vector_step(env_actions)
+            for i in range(n):
+                r = rows[i]
+                o2 = self._obs_pipe(obs2[i])
+                r[sb.OBS].append(self._vobs[i])
+                r[sb.ACTIONS].append(act_rows[i])
+                r[sb.REWARDS].append(float(rews[i]))
+                r[sb.DONES].append(bool(terms[i]))
+                r[sb.NEXT_OBS].append(o2)
+                r[sb.ACTION_LOGP].append(float(logps[i]))
+                r[sb.VF_PREDS].append(float(vfs[i]))
+                self._vep_reward[i] += float(rews[i])
+                self._vep_len[i] += 1
+                if terms[i] or truncs[i]:
+                    self._completed_rewards.append(self._vep_reward[i])
+                    self._completed_lens.append(self._vep_len[i])
+                    self._vep_reward[i] = 0.0
+                    self._vep_len[i] = 0
+                    segments.append(self._segment(
+                        r, seg_start[i], len(r[sb.OBS]), last_value=0.0,
+                        gamma=gamma, lam=lam))
+                    seg_start[i] = len(r[sb.OBS])
+                    self._vobs[i] = self._obs_pipe(self.venv.reset_at(i))
+                else:
+                    self._vobs[i] = o2
+        for i in range(n):
+            if seg_start[i] < len(rows[i][sb.OBS]):
+                last_v = float(self.policy.value(
+                    np.asarray(self._vobs[i], np.float32)[None, :])[0])
+                segments.append(self._segment(
+                    rows[i], seg_start[i], len(rows[i][sb.OBS]),
+                    last_value=last_v, gamma=gamma, lam=lam))
         return SampleBatch.concat_samples(segments)
 
     def _segment(self, rows, start, end, last_value, gamma, lam):

@@ -15,13 +15,14 @@ from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
 
 class WorkerSet:
     def __init__(self, env_creator: Callable, policy_cls, config: Dict,
-                 num_workers: int):
+                 num_workers: int, worker_cls=None):
         self.config = config
+        worker_cls = worker_cls or RolloutWorker
         # Local worker holds the learner policy (reference: WorkerSet
         # local_worker()).
-        self.local_worker = RolloutWorker(env_creator, policy_cls, config,
-                                          worker_index=0)
-        remote_cls = ray_tpu.remote(RolloutWorker)
+        self.local_worker = worker_cls(env_creator, policy_cls, config,
+                                       worker_index=0)
+        remote_cls = ray_tpu.remote(worker_cls)
         self.remote_workers = [
             remote_cls.options(num_cpus=1).remote(
                 env_creator, policy_cls, config, worker_index=i + 1)

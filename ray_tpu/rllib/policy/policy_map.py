@@ -1,0 +1,53 @@
+"""PolicyMap: the per-worker collection of named policies.
+
+Reference: rllib/policy/policy_map.py — maps policy_id -> Policy, with a
+policy_mapping_fn deciding which policy controls which agent.  Here every
+policy is a jax policy instance; specs carry (obs_dim, num_actions,
+config-overrides).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+
+class PolicySpec:
+    """What to build a policy from (reference: rllib PolicySpec)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 config: Optional[Dict] = None, policy_cls=None):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.config = dict(config or {})
+        self.policy_cls = policy_cls
+
+
+class PolicyMap(dict):
+    """policy_id -> policy instance; builds lazily from specs."""
+
+    def __init__(self, specs: Dict[str, PolicySpec], base_config: Dict,
+                 default_policy_cls):
+        super().__init__()
+        self._specs = specs
+        self._base = dict(base_config)
+        self._default_cls = default_policy_cls
+        for pid, spec in specs.items():
+            cls = spec.policy_cls or default_policy_cls
+            cfg = dict(self._base)
+            cfg.update(spec.config)
+            self[pid] = cls(spec.obs_dim, spec.num_actions, cfg)
+
+    def get_weights(self) -> Dict[str, object]:
+        return {pid: pol.get_weights() for pid, pol in self.items()}
+
+    def set_weights(self, weights: Dict[str, object]):
+        for pid, w in weights.items():
+            if pid in self:
+                self[pid].set_weights(w)
+
+
+def default_policy_mapping_fn(agent_id, *args, **kwargs) -> str:
+    return "default_policy"
+
+
+Mapping = Callable[..., str]

@@ -17,8 +17,10 @@ from ray_tpu.tune.search import (  # noqa: F401
 from ray_tpu.tune import schedulers  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    HyperBandScheduler, MedianStoppingRule, PopulationBasedTraining,
+    HyperBandScheduler, MedianStoppingRule, PB2,
+    PopulationBasedTraining,
 )
+from ray_tpu.tune import storage  # noqa: F401
 from ray_tpu.air import session as _session
 
 

@@ -130,8 +130,22 @@ class TrialRunner:
         self.pg_factory = pg_factory
         base = self.run_config.storage_path or tempfile.mkdtemp(
             prefix="rt_tune_")
-        self.experiment_dir = os.path.join(
-            base, self.run_config.name or f"exp_{uuid.uuid4().hex[:6]}")
+        exp_name = self.run_config.name or f"exp_{uuid.uuid4().hex[:6]}"
+        from ray_tpu.tune.storage import get_storage, is_remote_uri
+        if is_remote_uri(base):
+            # Remote storage URI: work out of a local scratch dir and
+            # sync state through the storage backend (reference:
+            # tune/syncer.py — checkpoints/state survive the head node).
+            self.storage = get_storage(base)
+            self._storage_prefix = exp_name
+            self.experiment_dir = os.path.join(
+                tempfile.mkdtemp(prefix="rt_tune_scratch_"), exp_name)
+        else:
+            base = base[len("file://"):] if base.startswith("file://") \
+                else base
+            self.storage = None
+            self._storage_prefix = exp_name
+            self.experiment_dir = os.path.join(base, exp_name)
         os.makedirs(self.experiment_dir, exist_ok=True)
         self.trials: List[Trial] = []
         self._stopping = self._normalize_stop(self.run_config.stop)
@@ -156,6 +170,12 @@ class TrialRunner:
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, path)
+        if self.storage is not None:
+            # Sync up: trial metadata + driver-held checkpoints ride in
+            # the state blob, so this one upload makes the experiment
+            # resumable from the storage backend alone.
+            self.storage.upload_file(
+                path, f"{self._storage_prefix}/experiment_state.pkl")
 
     def restore_experiment_state(self) -> bool:
         """Reload saved trials: TERMINATED ones keep their results;
@@ -164,6 +184,11 @@ class TrialRunner:
         found."""
         import pickle
         path = os.path.join(self.experiment_dir, "experiment_state.pkl")
+        if self.storage is not None:
+            rel = f"{self._storage_prefix}/experiment_state.pkl"
+            if not self.storage.exists(rel):
+                return False
+            self.storage.download_file(rel, path)
         if not os.path.exists(path):
             return False
         with open(path, "rb") as f:

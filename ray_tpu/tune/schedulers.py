@@ -184,6 +184,109 @@ class PopulationBasedTraining(TrialScheduler):
         return new
 
 
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits: PBT where exploit targets' new
+    hyperparameters come from a GP-UCB model over (time, config) ->
+    score improvement, instead of random perturbation (reference:
+    tune/schedulers/pb2.py — implemented natively with a numpy RBF-kernel
+    GP; no external BO dependency).
+
+    hyperparam_bounds: {name: (low, high)} continuous ranges the bandit
+    searches over."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.5, seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = dict(hyperparam_bounds or {})
+        self.kappa = ucb_kappa
+        self._np_rng = None
+        # Observation rows: (t, normalized config vector, score delta).
+        self._obs_t: list = []
+        self._obs_x: list = []
+        self._obs_y: list = []
+        self._prev_score: Dict[str, float] = {}
+        self._trial_config: Dict[str, Dict] = {}
+
+    def _rng_np(self):
+        import numpy as np
+        if self._np_rng is None:
+            self._np_rng = np.random.RandomState(
+                self._rng.randrange(1 << 31))
+        return self._np_rng
+
+    def _norm(self, config: Dict):
+        import numpy as np
+        out = []
+        for name, (lo, hi) in self.bounds.items():
+            v = float(config.get(name, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return np.asarray(out)
+
+    def on_trial_result(self, trial, result) -> str:
+        score = self._score(result)
+        if score is not None:
+            prev = self._prev_score.get(trial.trial_id)
+            self._prev_score[trial.trial_id] = score
+            self._trial_config[trial.trial_id] = dict(trial.config)
+            if prev is not None and self.bounds:
+                self._obs_t.append(
+                    float(result.get("training_iteration", 0)))
+                self._obs_x.append(self._norm(trial.config))
+                self._obs_y.append(score - prev)
+        return super().on_trial_result(trial, result)
+
+    def explore(self, config: Dict) -> Dict:
+        """GP-UCB over the bounded hyperparams: fit an RBF-kernel GP on
+        (t, x) -> score-delta observations, score a random candidate set,
+        take the UCB argmax.  Cold-starts (too few observations) fall
+        back to uniform sampling inside the bounds."""
+        import numpy as np
+        new = dict(config)
+        if not self.bounds:
+            return new
+        rng = self._rng_np()
+        n_cand = 64
+        cands = rng.uniform(size=(n_cand, len(self.bounds)))
+        if len(self._obs_y) >= 4:
+            t = np.asarray(self._obs_t)
+            t = (t - t.min()) / max(t.max() - t.min(), 1e-12)
+            X = np.column_stack([t, np.vstack(self._obs_x)])
+            y = np.asarray(self._obs_y)
+            y_std = y.std() or 1.0
+            y_n = (y - y.mean()) / y_std
+            t_now = 1.0
+            C = np.column_stack([np.full(n_cand, t_now), cands])
+            ls = 0.3  # RBF length scale in normalized units
+            noise = 1e-2
+
+            def rbf(A, B):
+                d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = rbf(X, X) + noise * np.eye(len(X))
+            Ks = rbf(C, X)
+            try:
+                Kinv_y = np.linalg.solve(K, y_n)
+                mu = Ks @ Kinv_y
+                Kinv_Ks = np.linalg.solve(K, Ks.T)
+                var = np.clip(1.0 - (Ks * Kinv_Ks.T).sum(1), 1e-9, None)
+                ucb = mu + self.kappa * np.sqrt(var)
+                best = cands[int(np.argmax(ucb))]
+            except np.linalg.LinAlgError:
+                best = cands[0]
+        else:
+            best = cands[0]
+        for j, (name, (lo, hi)) in enumerate(self.bounds.items()):
+            new[name] = float(lo + best[j] * (hi - lo))
+        return new
+
+
 class HyperBandScheduler(AsyncHyperBandScheduler):
     """Synchronous HyperBand approximated by its asynchronous variant (the
     reference ships both; ASHA dominates in practice)."""

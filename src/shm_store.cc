@@ -228,25 +228,64 @@ class Store {
     objects_.erase(it);
   }
 
+  // Best-fit, with small allocations carved from the TOP of their hole
+  // and large ones from the bottom.  First-fit checkerboarded the arena:
+  // a handful of long-pinned objects scattered at low offsets left no
+  // contiguous hole for a large block even with most bytes free
+  // (observed: 14MB alloc failing in a 144MB arena that was >70%
+  // evictable).  Best-fit preserves the big holes; the small/large split
+  // keeps short-lived small objects from splitting them.
+  static constexpr uint64_t kSmallObject = 1 << 20;
+
   bool AllocFrom(uint64_t size, uint64_t* off) {
     // round to 64B so successive objects stay cache-line aligned
     uint64_t asize = (size + 63) & ~uint64_t(63);
     if (asize == 0) asize = 64;
+    auto best = free_list_.end();
     for (auto it = free_list_.begin(); it != free_list_.end(); ++it) {
-      if (it->size >= asize) {
-        *off = it->offset;
-        if (it->size == asize) {
-          free_list_.erase(it);
-        } else {
-          it->offset += asize;
-          it->size -= asize;
-        }
-        return true;
+      if (it->size >= asize &&
+          (best == free_list_.end() || it->size < best->size)) {
+        best = it;
+        if (it->size == asize) break;  // exact fit
       }
     }
-    return false;
+    if (best == free_list_.end()) return false;
+    if (best->size == asize) {
+      *off = best->offset;
+      free_list_.erase(best);
+    } else if (asize < kSmallObject) {
+      *off = best->offset + best->size - asize;  // carve from the top
+      best->size -= asize;
+    } else {
+      *off = best->offset;
+      best->offset += asize;
+      best->size -= asize;
+    }
+    return true;
   }
 
+ public:
+  void Stats(uint64_t* used, uint64_t* largest_free, uint64_t* lru_bytes,
+             uint64_t* pinned_bytes, uint64_t* unsealed_bytes,
+             uint64_t* n_objects) {
+    std::lock_guard<std::mutex> g(mu_);
+    *used = used_;
+    *largest_free = 0;
+    for (const auto& b : free_list_)
+      if (b.size > *largest_free) *largest_free = b.size;
+    *lru_bytes = 0;
+    *pinned_bytes = 0;
+    *unsealed_bytes = 0;
+    *n_objects = objects_.size();
+    for (const auto& kv : objects_) {
+      const Entry& e = kv.second;
+      if (e.in_lru) *lru_bytes += e.size;
+      if (e.refcount > 0 && e.sealed) *pinned_bytes += e.size;
+      if (!e.sealed) *unsealed_bytes += e.size;
+    }
+  }
+
+ private:
   void FreeBlockInsert(FreeBlock blk) {
     // keep the free list sorted by offset and coalesce neighbours
     blk.size = (blk.size + 63) & ~uint64_t(63);
@@ -335,6 +374,13 @@ uint64_t store_capacity(void* h) { return static_cast<Store*>(h)->Capacity(); }
 
 int store_evict(void* h, uint64_t bytes) {
   return static_cast<Store*>(h)->EvictBytes(bytes);
+}
+
+void store_stats(void* h, uint64_t* used, uint64_t* largest_free,
+                 uint64_t* lru_bytes, uint64_t* pinned_bytes,
+                 uint64_t* unsealed_bytes, uint64_t* n_objects) {
+  static_cast<Store*>(h)->Stats(used, largest_free, lru_bytes,
+                                pinned_bytes, unsealed_bytes, n_objects);
 }
 
 }  // extern "C"

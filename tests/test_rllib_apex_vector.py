@@ -1,0 +1,93 @@
+"""Apex-DQN (distributed replay), vector envs, connectors.
+
+Reference: rllib/algorithms/apex_dqn/apex_dqn.py, rllib/env/vector_env.py,
+rllib/connectors/."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import ApexDQNConfig, PPOConfig
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_apex_dqn_distributed_replay_learns(ray_init):
+    algo = (ApexDQNConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=200)
+            .training(train_batch_size=800, num_replay_shards=2,
+                      num_sgd_steps=100, sgd_batch_size=64, lr=1e-3,
+                      learning_starts=400, epsilon_anneal_iters=5)
+            .debugging(seed=3)
+            .build())
+    best = 0.0
+    trained = 0
+    routed = 0
+    for _ in range(22):
+        r = algo.train()
+        best = max(best, r.get("episode_reward_mean") or 0.0)
+        trained += r.get("num_env_steps_trained", 0)
+        routed += r.get("fragments_routed", 0)
+        if best >= 60:
+            break
+    stats = ray_tpu.get(
+        [ra.stats.remote() for ra in algo.replay_actors], timeout=60)
+    algo.stop()
+    # Replay shards really received experience, the learner really
+    # trained from them, and the policy improved over random (~22) —
+    # same improvement bar as the plain DQN test (not PPO's >=150).
+    assert all(s["added"] > 0 for s in stats), stats
+    assert trained > 0
+    assert routed > 0
+    assert best >= 60, f"Apex-DQN failed to learn (best={best})"
+
+
+def test_vector_env_sampling_ppo(ray_init):
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=400)
+            .training(train_batch_size=800, num_sgd_iter=12,
+                      sgd_minibatch_size=128, lr=2e-3,
+                      num_envs_per_worker=4)
+            .debugging(seed=11)
+            .build())
+    # The local worker steps 4 envs per policy forward; fragments from
+    # all envs still train correctly (same improvement bar as A2C's).
+    best = 0.0
+    for _ in range(20):
+        r = algo.train()
+        best = max(best, r.get("episode_reward_mean") or 0.0)
+        if best >= 70:
+            break
+    algo.stop()
+    assert best >= 70, f"vector-env PPO failed to learn (best={best})"
+
+
+def test_meanstd_obs_connector():
+    from ray_tpu.rllib.connectors import MeanStdObsFilter
+    f = MeanStdObsFilter()
+    rng = np.random.RandomState(0)
+    outs = [f(rng.normal(5.0, 2.0, size=3)) for _ in range(500)]
+    tail = np.stack(outs[-200:])
+    # Normalized stream: near zero-mean unit-variance.
+    assert abs(tail.mean()) < 0.3
+    assert 0.6 < tail.std() < 1.4
+    # State round-trips (synced alongside weights).
+    state = f.get_state()
+    g = MeanStdObsFilter()
+    g.set_state(state)
+    x = rng.normal(5.0, 2.0, size=3)
+    np.testing.assert_allclose(f.get_state()["mean"], g.get_state()["mean"])
+
+
+def test_clip_actions_connector():
+    from ray_tpu.rllib.connectors import ClipActionsConnector
+    c = ClipActionsConnector(low=[-1.0, -1.0], high=[1.0, 1.0])
+    out = c(np.array([3.0, -0.5]))
+    np.testing.assert_allclose(out, [1.0, -0.5])

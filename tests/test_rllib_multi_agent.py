@@ -1,0 +1,121 @@
+"""Multi-agent RLlib: two policies, distinct mappings, both must learn.
+
+Reference: rllib/env/multi_agent_env.py + policy_map.py + the multi-agent
+paths of PPO's training_step.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPOConfig
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv, make_multi_agent
+from ray_tpu.rllib.policy.policy_map import PolicySpec
+
+
+class TwoTargetEnv(MultiAgentEnv):
+    """Each step both agents see a one-hot target (dim 4).  agent_0 is
+    rewarded for answering the target index, agent_1 for answering
+    (target + 1) % 4 — so the two policies must learn DIFFERENT
+    mappings.  Episode length 16."""
+
+    possible_agents = ("agent_0", "agent_1")
+
+    def __init__(self, config=None):
+        self._rng = np.random.RandomState((config or {}).get("seed", 0))
+        self._t = 0
+        self._targets = {}
+
+    def observation_space(self, agent_id):
+        import gymnasium as gym
+        return gym.spaces.Box(0.0, 1.0, shape=(4,), dtype=np.float32)
+
+    def action_space(self, agent_id):
+        import gymnasium as gym
+        return gym.spaces.Discrete(4)
+
+    def _obs(self):
+        out = {}
+        for aid in self.possible_agents:
+            t = int(self._rng.randint(0, 4))
+            self._targets[aid] = t
+            onehot = np.zeros(4, np.float32)
+            onehot[t] = 1.0
+            out[aid] = onehot
+        return out
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._t = 0
+        return self._obs(), {aid: {} for aid in self.possible_agents}
+
+    def step(self, action_dict):
+        rewards = {}
+        for aid, act in action_dict.items():
+            want = self._targets[aid]
+            if aid == "agent_1":
+                want = (want + 1) % 4
+            rewards[aid] = 1.0 if int(act) == want else 0.0
+        self._t += 1
+        done = self._t >= 16
+        obs = {} if done else self._obs()
+        terms = {aid: done for aid in action_dict}
+        terms["__all__"] = done
+        truncs = {aid: False for aid in action_dict}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def test_multi_agent_ppo_two_policies_learn():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        config = (
+            PPOConfig()
+            .environment(TwoTargetEnv)
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=256)
+            .training(train_batch_size=512, num_sgd_iter=8,
+                      sgd_minibatch_size=128, lr=5e-3, entropy_coeff=0.01)
+            .multi_agent(
+                policies={"p0": PolicySpec(4, 4), "p1": PolicySpec(4, 4)},
+                policy_mapping_fn=lambda aid, *a, **kw:
+                    "p0" if aid == "agent_0" else "p1")
+        )
+        algo = config.build()
+        best = -np.inf
+        for _ in range(12):
+            result = algo.step()
+            r = result.get("episode_reward_mean")
+            if r == r and r is not None:
+                best = max(best, r)
+        # Max per episode = 2 agents x 16 steps = 32; random ~8.
+        assert best >= 24, f"multi-agent PPO failed to learn: best={best}"
+        algo.cleanup()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_make_multi_agent_wraps_single_env():
+    class _Const:
+        def __init__(self, cfg=None):
+            import gymnasium as gym
+            self.observation_space = gym.spaces.Box(
+                0, 1, shape=(2,), dtype=np.float32)
+            self.action_space = gym.spaces.Discrete(2)
+            self._t = 0
+
+        def reset(self, seed=None):
+            self._t = 0
+            return np.zeros(2, np.float32), {}
+
+        def step(self, a):
+            self._t += 1
+            return (np.zeros(2, np.float32), 1.0, self._t >= 3, False, {})
+
+    env_cls = make_multi_agent(lambda cfg: _Const(cfg))
+    env = env_cls({"num_agents": 3})
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+    for _ in range(3):
+        obs, rews, terms, truncs, _ = env.step({a: 0 for a in obs})
+    assert terms["__all__"]
