@@ -53,6 +53,7 @@ class NodeInfo:
         self.last_heartbeat = time.monotonic()
         self.load = 0  # queued lease count reported by the raylet
         self.pending_shapes: list = []
+        self.node_stats: dict = {}  # hardware report (cpu/mem/disk/store)
         # Versioned resource sync (reference: ray_syncer.h).
         self.sync_version = 0
         self.sync_beats = 0
@@ -72,6 +73,7 @@ class NodeInfo:
             "sync_version": self.sync_version,
             "sync_beats": self.sync_beats,
             "sync_payloads": self.sync_payloads,
+            "node_stats": self.node_stats,
         }
 
 
@@ -323,6 +325,10 @@ class GcsServer:
             node.pending_shapes = body.get("pending_shapes", [])
             node.sync_version = body.get("version", 0)
             node.sync_payloads += 1
+        if "node_stats" in body:
+            # Hardware utilization relayed by the node's reporter
+            # (reference: reporter_agent stats feeding the dashboard).
+            node.node_stats = body["node_stats"]
         node.sync_beats += 1
         return {"ok": True, "acked_version": node.sync_version}
 

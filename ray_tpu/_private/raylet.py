@@ -39,8 +39,10 @@ logger = logging.getLogger(__name__)
 
 
 class WorkerHandle:
-    def __init__(self, worker_id, proc, conn=None, kind="cpu"):
+    def __init__(self, worker_id, proc, conn=None, kind="cpu",
+                 env_key: str = ""):
         self.kind = kind
+        self.env_key = env_key  # content address of the pip venv ("" = base)
         self.worker_id: WorkerID = worker_id
         self.proc: subprocess.Popen | None = proc
         self.conn: protocol.Connection | None = conn
@@ -82,9 +84,11 @@ class Raylet:
         self.store = StoreServer(self.store_path, store_capacity)
         self.store_capacity = store_capacity
         self.mapping = StoreMapping(self.store_path, store_capacity)
-        # workers
+        # workers, pooled by (kind, env_key): a pip-venv task only ever
+        # reuses a worker whose venv matches (reference: worker_pool.h
+        # matching runtime_env hashes on PopWorker)
         self.workers: dict[WorkerID, WorkerHandle] = {}
-        self.idle_workers: dict[str, list[WorkerHandle]] = {"cpu": [], "tpu": []}
+        self.idle_workers: dict[tuple, list[WorkerHandle]] = {}
         self._spawn_sem = None  # created lazily on the loop
         self.leases: dict[bytes, Lease] = {}
         self.pending_leases: list[dict] = []  # queued lease requests
@@ -254,6 +258,64 @@ class Raylet:
                         fut.set_result(None)
 
     # ------------------------------------------------------- worker lifecycle
+    def _idle(self, kind: str, env_key: str = "") -> list:
+        return self.idle_workers.setdefault((kind, env_key), [])
+
+    def _ensure_venv(self, env_key: str, pip_specs: list) -> str:
+        """Create (once) the content-addressed virtualenv for a pip
+        runtime env and return its interpreter path (reference:
+        _private/runtime_env/pip.py — spec-hash-keyed cached envs).
+        Blocking; call from an executor thread."""
+        import subprocess as sp
+        root = os.path.join(self.session_dir, "venvs", env_key)
+        py = os.path.join(root, "bin", "python")
+        done_marker = os.path.join(root, ".ready")
+        if os.path.exists(done_marker):
+            return py
+        lock = root + ".lock"
+        os.makedirs(os.path.dirname(root), exist_ok=True)
+        import time as _time
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                if os.path.exists(done_marker):
+                    return py
+                _time.sleep(0.5)
+        try:
+            if not os.path.exists(done_marker):
+                sp.check_call([sys.executable, "-m", "venv",
+                               "--system-site-packages", root],
+                              stdout=sp.DEVNULL, stderr=sp.STDOUT)
+                # The venv overlays the BASE interpreter's site-packages;
+                # when this process itself runs inside a venv (common:
+                # /opt/venv), the parent's packages (jax, setuptools...)
+                # live one level up and --system-site-packages misses
+                # them.  A .pth appends the parent's site dirs AFTER the
+                # venv's own, so pip installs still shadow the overlay.
+                import site
+                parents = [p for p in site.getsitepackages()
+                           if os.path.isdir(p)]
+                vsite = sp.check_output(
+                    [py, "-c", "import site;"
+                     "print(site.getsitepackages()[-1])"]).decode().strip()
+                with open(os.path.join(vsite, "_parent_overlay.pth"),
+                          "w") as f:
+                    f.write("\n".join(parents) + "\n")
+                sp.check_call([py, "-m", "pip", "install", "--quiet",
+                               "--no-build-isolation"] + list(pip_specs),
+                              stdout=sp.DEVNULL)
+                with open(done_marker, "w") as f:
+                    f.write("\n".join(pip_specs))
+            return py
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
+
     def prestart_workers(self, n: int, kind: str = "cpu"):
         """Spawn warm workers ahead of demand (reference: WorkerPool
         PrestartWorkers — python startup is expensive, ~2s with jax in the
@@ -265,17 +327,22 @@ class Raylet:
     async def _await_prestart(self, w: WorkerHandle):
         if not await self._wait_registered(w):
             return
-        if w.lease_id is None and w not in self.idle_workers[w.kind]:
+        pool = self._idle(w.kind, w.env_key)
+        if w.lease_id is None and w not in pool:
             w.last_idle = time.monotonic()
-            self.idle_workers[w.kind].append(w)
+            pool.append(w)
             self._kick_scheduler()
 
     async def _wait_registered(self, w: WorkerHandle) -> bool:
         """Wait for a spawned worker to register, fast-failing if its
         process dies during startup (bad env, import error) instead of
-        sitting out the full register timeout."""
-        deadline = time.monotonic() + cfg.worker_register_timeout_s
+        sitting out the full register timeout.  Venv workers get triple
+        patience: pip may be building their environment first."""
+        deadline = time.monotonic() + cfg.worker_register_timeout_s * (
+            3 if w.env_key else 1)
         while not w.registered.is_set():
+            if getattr(w, "dead", False):
+                return False
             if w.proc is not None and w.proc.poll() is not None:
                 await self._on_worker_dead(
                     w, f"worker process exited rc={w.proc.returncode} "
@@ -288,7 +355,8 @@ class Raylet:
                 await asyncio.wait_for(w.registered.wait(), 0.1)
             except asyncio.TimeoutError:
                 pass
-        return True
+        # The event is also set by _on_worker_dead to break this wait.
+        return not getattr(w, "dead", False)
 
     async def _start_zygote(self):
         """Spawn the warm fork-server (zygote.py): one ~2s interpreter +
@@ -336,10 +404,21 @@ class Raylet:
                             self.node_id.hex()[:8],
                             f"worker-{worker_id.hex()[:8]}.log")
 
-    def _spawn_worker(self, kind: str = "cpu") -> WorkerHandle:
+    def _spawn_worker(self, kind: str = "cpu", env_key: str = "",
+                      pip_specs: list | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env, unset = self._worker_env_for(worker_id, kind)
         logfile = self._worker_logfile(worker_id)
+        if env_key:
+            # pip runtime env: dedicated interpreter from the cached venv
+            # (built asynchronously; the zygote can't serve these — its
+            # warm image is the base interpreter).
+            w = WorkerHandle(worker_id, None, kind=kind, env_key=env_key)
+            self.workers[worker_id] = w
+            asyncio.get_running_loop().create_task(
+                self._spawn_venv_worker(w, env, env_key,
+                                        list(pip_specs or []), logfile))
+            return w
         if self._zygote is not None and self._zygote.ready:
             # proc is attached asynchronously when the fork reply lands;
             # _wait_registered tolerates proc=None meanwhile.
@@ -358,6 +437,24 @@ class Raylet:
         w = WorkerHandle(worker_id, proc, kind=kind)
         self.workers[worker_id] = w
         return w
+
+    async def _spawn_venv_worker(self, w: WorkerHandle, env, env_key,
+                                 pip_specs, logfile):
+        try:
+            py = await asyncio.get_running_loop().run_in_executor(
+                None, self._ensure_venv, env_key, pip_specs)
+            os.makedirs(os.path.dirname(logfile), exist_ok=True)
+            out = open(logfile, "ab")
+            w.proc = subprocess.Popen(
+                [py, "-m", "ray_tpu._private.worker_main"],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            out.close()
+            w.pid = w.proc.pid
+        except Exception as e:
+            logger.warning("venv worker spawn failed: %s", e)
+            await self._on_worker_dead(
+                w, f"pip runtime_env creation failed: {e}")
 
     async def _fork_worker(self, w: WorkerHandle, env, unset, logfile):
         from ray_tpu._private.zygote import PidHandle
@@ -400,8 +497,11 @@ class Raylet:
             return 16
         return max(2, int(self.total_resources.get("CPU", 2)))
 
-    async def _get_ready_worker(self, kind: str = "cpu") -> WorkerHandle | None:
-        idle = self.idle_workers[kind]
+    async def _get_ready_worker(self, kind: str = "cpu",
+                                env_key: str = "",
+                                pip_specs: list | None = None
+                                ) -> WorkerHandle | None:
+        idle = self._idle(kind, env_key)
         while idle:
             w = idle.pop()
             if w.conn is not None and not w.conn.closed:
@@ -418,20 +518,27 @@ class Raylet:
             self._spawn_sem = asyncio.Semaphore(cap)
             self._spawn_sem_cap = cap
         async with self._spawn_sem:
-            idle = self.idle_workers[kind]
+            idle = self._idle(kind, env_key)
             if idle:
                 w = idle.pop()
                 if w.conn is not None and not w.conn.closed:
                     return w
-            w = self._spawn_worker(kind)
+            w = self._spawn_worker(kind, env_key=env_key,
+                                   pip_specs=pip_specs)
             if not await self._wait_registered(w):
                 return None
             return w
 
     async def _on_worker_dead(self, w: WorkerHandle, reason: str):
+        if getattr(w, "dead", False):
+            return  # already reaped (e.g. spawn failure + register timeout)
+        w.dead = True
+        w.registered.set()  # wake _wait_registered immediately, not at
+        # its deadline — it checks w.dead and reports the spawn failure
         self.workers.pop(w.worker_id, None)
-        if w in self.idle_workers[w.kind]:
-            self.idle_workers[w.kind].remove(w)
+        pool = self._idle(w.kind, w.env_key)
+        if w in pool:
+            pool.remove(w)
         if w.lease_id is not None:
             lease = self.leases.pop(w.lease_id, None)
             if lease is not None:
@@ -471,7 +578,7 @@ class Raylet:
                         w, f"worker exited with code {w.proc.returncode}")
             # trim long-idle workers
             now = time.monotonic()
-            for kind, idle in self.idle_workers.items():
+            for key, idle in self.idle_workers.items():
                 keep = []
                 for w in idle:
                     if now - w.last_idle > cfg.idle_worker_keep_s:
@@ -482,7 +589,7 @@ class Raylet:
                                 pass
                     else:
                         keep.append(w)
-                self.idle_workers[kind] = keep
+                self.idle_workers[key] = keep
 
     # ------------------------------------------------------------ resources
     def _fits(self, resources: dict, pg_key=None) -> bool:
@@ -558,6 +665,8 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append({"resources": resources, "pg_key": pg_key,
                                     "future": fut,
+                                    "env_key": body.get("env_key", ""),
+                                    "pip": body.get("pip") or [],
                                     "request_id": body.get("request_id")})
         self._kick_scheduler()
         granted = await fut
@@ -660,36 +769,41 @@ class Raylet:
             return
         self._scheduling = True
         try:
-            need_spawn = {"cpu": 0, "tpu": 0}
+            need_spawn: dict = {}
+            # Object-store backpressure (reference: memory-aware admission
+            # in the raylet): admitting more tasks while the arena is
+            # nearly all PINNED only adds more pinned args — the running
+            # tasks must finish (and release pins) first.  Gate on
+            # pinned+unsealed, not used(): unpinned secondary copies are
+            # evictable on demand and must not throttle admission.  One
+            # lease always proceeds so the node can't wedge.  Sampled
+            # once per pass (it scans the object table under the store
+            # mutex).
+            store_pressured = False
+            if len(self.leases) >= 1 and self.pending_leases:
+                st = self.store.stats()
+                store_pressured = (st["pinned_bytes"] + st["unsealed_bytes"]
+                                   > 0.85 * self.store_capacity)
             for req in list(self.pending_leases):
                 if req["future"].done():
                     self.pending_leases.remove(req)
                     continue
                 if not self._fits(req["resources"], req["pg_key"]):
                     continue
-                if len(self.leases) >= 1:
-                    # Object-store backpressure (reference: memory-aware
-                    # admission in the raylet): admitting more tasks while
-                    # the arena is nearly all PINNED only adds more pinned
-                    # args — the running tasks must finish (and release
-                    # pins) first.  Gate on pinned+unsealed, not used():
-                    # unpinned secondary copies are evictable on demand
-                    # and must not throttle admission.  One lease always
-                    # proceeds so the node can't wedge.
-                    st = self.store.stats()
-                    if (st["pinned_bytes"] + st["unsealed_bytes"]
-                            > 0.85 * self.store_capacity):
-                        break
+                if store_pressured and len(self.leases) >= 1:
+                    break
                 kind = "tpu" if req["resources"].get("TPU") else "cpu"
+                env_key = req.get("env_key", "")
                 w = None
-                idle = self.idle_workers[kind]
+                idle = self._idle(kind, env_key)
                 while idle:
                     cand = idle.pop()
                     if cand.conn is not None and not cand.conn.closed:
                         w = cand
                         break
                 if w is None:
-                    need_spawn[kind] += 1
+                    spec = (kind, env_key, tuple(req.get("pip") or ()))
+                    need_spawn[spec] = need_spawn.get(spec, 0) + 1
                     continue
                 self._acquire(req["resources"], req["pg_key"])
                 self.pending_leases.remove(req)
@@ -703,8 +817,9 @@ class Raylet:
                     "worker_id": w.worker_id,
                     "node_id": self.node_id,
                 })
-            for kind, n in need_spawn.items():
-                self._ensure_spawning(kind, n)
+            for (kind, env_key, pip_specs), n in need_spawn.items():
+                self._ensure_spawning(kind, n, env_key=env_key,
+                                      pip_specs=list(pip_specs))
         finally:
             self._scheduling = False
             if self._kick_pending and self.pending_leases:
@@ -714,7 +829,8 @@ class Raylet:
 
     _spawns_outstanding = 0
 
-    def _ensure_spawning(self, kind: str, demand: int):
+    def _ensure_spawning(self, kind: str, demand: int,
+                         env_key: str = "", pip_specs: list | None = None):
         """Keep at most `demand` additional cold starts in flight, bounded by
         the node CPU count and the pool cap (reference: WorkerPool
         maximum_startup_concurrency).  Zygote forks are cheap, so the
@@ -727,7 +843,8 @@ class Raylet:
         )
         for _ in range(max(0, can_spawn)):
             self._spawns_outstanding += 1
-            w = self._spawn_worker(kind)
+            w = self._spawn_worker(kind, env_key=env_key,
+                                   pip_specs=pip_specs)
             asyncio.get_running_loop().create_task(self._finish_spawn(w))
 
     async def _finish_spawn(self, w: WorkerHandle):
@@ -736,9 +853,10 @@ class Raylet:
                 return
         finally:
             self._spawns_outstanding -= 1
-        if w.lease_id is None and w not in self.idle_workers[w.kind]:
+        pool = self._idle(w.kind, w.env_key)
+        if w.lease_id is None and w not in pool:
             w.last_idle = time.monotonic()
-            self.idle_workers[w.kind].append(w)
+            pool.append(w)
         self._kick_scheduler()
 
     async def rpc_return_worker(self, conn, body):
@@ -752,7 +870,7 @@ class Raylet:
             await self._on_worker_dead(w, "lease returned with kill")
         elif w.conn is not None and not w.conn.closed:
             w.last_idle = time.monotonic()
-            self.idle_workers[w.kind].append(w)
+            self._idle(w.kind, w.env_key).append(w)
         self._kick_scheduler()
         return {"ok": True}
 
@@ -794,7 +912,11 @@ class Raylet:
             return {"ok": False, "reason": "resources busy"}
         self._acquire(resources, pg_key)
         kind = "tpu" if resources.get("TPU") else "cpu"
-        w = await self._get_ready_worker(kind)
+        renv = (body.get("spec") or {}).get("runtime_env") or {}
+        from ray_tpu.runtime_env import pip_env_key
+        w = await self._get_ready_worker(kind,
+                                         env_key=pip_env_key(renv),
+                                         pip_specs=renv.get("pip"))
         if w is None:
             self._release(resources, pg_key)
             return {"ok": False, "reason": "no worker"}
@@ -817,7 +939,7 @@ class Raylet:
             self.leases.pop(lease_id, None)
             self._release(resources, pg_key)
             w.last_idle = time.monotonic()
-            self.idle_workers[w.kind].append(w)
+            self._idle(w.kind, w.env_key).append(w)
             return {"ok": False, "reason": reply.get("error", "init failed"),
                     "init_error": reply.get("error_blob")}
         return {"ok": True, "worker_addr": w.addr, "worker_id": w.worker_id,
@@ -853,6 +975,11 @@ class Raylet:
     async def rpc_os_create(self, conn, body):
         oid: bytes = body["oid"]
         size: int = body["size"]
+        if size > self.store_capacity:
+            # Can never fit — fail NOW, not after the full retry window.
+            return {"error": f"object of {size} bytes exceeds the "
+                             f"object store capacity "
+                             f"({self.store_capacity} bytes)"}
         off = await self._alloc_with_spill(oid, size)
         if off is None:
             # Memory is transiently pinned by running tasks' zero-copy
@@ -1419,6 +1546,7 @@ class Raylet:
         beat_period = cfg.heartbeat_period_ms / 1000.0
         last_report = None
         last_beat = 0.0
+        self._last_hw_report = 0.0
         self._sync_version = 0
         self._gcs_acked_version = -1
         while not self._shutdown:
@@ -1441,6 +1569,16 @@ class Raylet:
                 last_beat = now
                 body = {"node_id": self.node_id,
                         "version": self._sync_version}
+                # Hardware report rides the slow beat (reference:
+                # reporter_agent.py relaying psutil stats; here the
+                # per-node raylet process samples directly).
+                if now - self._last_hw_report >= beat_period:
+                    self._last_hw_report = now
+                    from ray_tpu._private.reporter import sample_node_stats
+                    body["node_stats"] = sample_node_stats(
+                        session_dir=self.session_dir, store=self.store,
+                        store_capacity=self.store_capacity,
+                        n_workers=len(self.workers))
                 if need_payload:
                     body.update({
                         "available": report[0],

@@ -1,0 +1,76 @@
+"""Per-node hardware reporter.
+
+Reference: dashboard/modules/reporter/reporter_agent.py:12,42 — each
+node's dashboard agent samples psutil/gpustat and relays utilization to
+the metrics path.  Here the reporter runs inside the per-node raylet
+process (the raylet IS per-node in the real process topology), samples
+cpu/mem/disk (+ object-store occupancy and TPU resource presence), and
+ships the snapshot to the GCS on the heartbeat channel, where the state
+API, `rt status`, and the dashboard read it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def sample_node_stats(session_dir: str | None = None,
+                      store=None, store_capacity: int = 0,
+                      n_workers: int = 0) -> dict:
+    """One hardware snapshot.  psutil when available; /proc fallback."""
+    out: dict = {"ts": time.time(), "pid": os.getpid(),
+                 "workers": n_workers}
+    try:
+        import psutil
+        out["cpu_percent"] = psutil.cpu_percent(interval=None)
+        out["cpu_count"] = psutil.cpu_count()
+        vm = psutil.virtual_memory()
+        out["mem_total"] = int(vm.total)
+        out["mem_used"] = int(vm.total - vm.available)
+        out["mem_percent"] = float(vm.percent)
+        la = os.getloadavg()
+        out["load_avg_1m"] = round(la[0], 2)
+    except Exception:
+        try:
+            la = os.getloadavg()
+            out["load_avg_1m"] = round(la[0], 2)
+        except OSError:
+            pass
+    try:
+        import shutil
+        du = shutil.disk_usage(session_dir or "/tmp")
+        out["disk_total"] = int(du.total)
+        out["disk_used"] = int(du.used)
+        out["disk_percent"] = round(100.0 * du.used / max(du.total, 1), 1)
+    except Exception:
+        pass
+    if store is not None and store_capacity:
+        try:
+            st = store.stats()
+            out["object_store_used"] = int(st["used"])
+            out["object_store_capacity"] = int(store_capacity)
+            out["object_store_pinned"] = int(st["pinned_bytes"])
+        except Exception:
+            pass
+    return out
+
+
+def format_utilization(stats: dict | None) -> str:
+    """One-line human rendering for `rt status` (empty when absent)."""
+    if not stats:
+        return ""
+    parts = []
+    if "cpu_percent" in stats:
+        parts.append(f"cpu {stats['cpu_percent']:.0f}%")
+    if "mem_percent" in stats:
+        parts.append(f"mem {stats['mem_percent']:.0f}%")
+    if "object_store_used" in stats and stats.get("object_store_capacity"):
+        pct = 100.0 * stats["object_store_used"] / \
+            stats["object_store_capacity"]
+        parts.append(f"store {pct:.0f}%")
+    if "disk_percent" in stats:
+        parts.append(f"disk {stats['disk_percent']:.0f}%")
+    if "workers" in stats:
+        parts.append(f"workers {stats['workers']}")
+    return " ".join(parts)

@@ -918,8 +918,10 @@ class CoreWorker:
         res = tuple(sorted(spec["resources"].items()))
         strat = spec.get("strategy")
         strat_key = tuple(sorted(strat.items())) if strat else None
+        from ray_tpu.runtime_env import pip_env_key
         return (spec["fn_id"], res, strat_key, spec.get("pg_id"),
-                spec.get("bundle_index"))
+                spec.get("bundle_index"),
+                pip_env_key(spec.get("runtime_env")))
 
     async def _submit(self, spec):
         await self._wait_args_ready(spec)
@@ -993,6 +995,11 @@ class CoreWorker:
                 "bundle_index": spec_probe.get("bundle_index"),
                 "request_id": request_id,
             }
+            renv = spec_probe.get("runtime_env") or {}
+            if renv.get("pip"):
+                from ray_tpu.runtime_env import pip_env_key
+                body["env_key"] = pip_env_key(renv)
+                body["pip"] = list(renv["pip"])
             conn = self.raylet
             if spec_probe.get("pg_id") is not None:
                 conn = await self._raylet_for_bundle(

@@ -211,8 +211,12 @@ class Dataset:
             acc = BlockAccessor(block)
             rows = acc.num_rows()
             per = (rows + pieces - 1) // pieces
-            return [acc.slice(i * per, min(rows, (i + 1) * per))
-                    for i in range(pieces)]
+            out = []
+            for i in range(pieces):
+                lo = min(rows, i * per)
+                hi = min(rows, (i + 1) * per)
+                out.append(acc.slice(lo, max(lo, hi)))
+            return out
 
         new_refs: List = []
         for ref, size in zip(self._block_refs, sizes):

@@ -41,6 +41,7 @@ def list_nodes() -> List[Dict]:
             "resources_total": v["resources"],
             "resources_available": v.get("available", {}),
             "labels": v.get("labels", {}),
+            "node_stats": v.get("node_stats", {}),
         })
     return out
 

@@ -114,27 +114,31 @@ class ApexDQN(Algorithm):
 
         # 1. Kick off ASYNC sampling on every worker (per-worker epsilon
         # ladder: low-index workers exploit, high-index explore).
+        # Stragglers carried over from the previous iteration stay in
+        # the pool — their experience routes when they finish.
+        carried = list(self._sample_refs)
         if workers:
             eps = self._worker_epsilons(self._base_epsilon())
             weights = policy.get_weights()
             per_worker = max(1, cfg["train_batch_size"] // len(workers))
-            self._sample_refs = []
+            fresh = []
             for i, w in enumerate(workers):
                 wcopy = dict(weights)
                 wcopy["epsilon"] = eps[i]
                 w.set_weights.remote(ray_tpu.put(wcopy))
-                self._sample_refs.append(w.sample.remote(per_worker))
+                fresh.append(w.sample.remote(per_worker))
         else:
             self.workers.local_worker.policy.epsilon = self._base_epsilon()
             b = self.workers.local_worker.sample(cfg["train_batch_size"])
-            self._sample_refs = [ray_tpu.put(b)]
+            fresh = [ray_tpu.put(b)]
+        self._sample_refs = carried + fresh
 
         # 2. Route finished fragments into replay shards WITHOUT waiting
         # for stragglers (async pipeline: learner trains below while the
         # slow workers keep sampling).
         ready, pending = ray_tpu.wait(
             list(self._sample_refs),
-            num_returns=len(self._sample_refs), timeout=30)
+            num_returns=len(self._sample_refs), timeout=10)
         added = 0
         for ref in ready:
             shard = self.replay_actors[self._replay_rr

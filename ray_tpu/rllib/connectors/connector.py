@@ -50,8 +50,10 @@ class FlattenObsConnector(ObsConnector):
 
 class MeanStdObsFilter(ObsConnector):
     """Running mean/std normalization (reference: the MeanStdFilter agent
-    connector).  Uses Welford accumulation; statistics ride along with
-    weight syncs via get_state/set_state."""
+    connector).  Welford accumulation, per worker.  State travels in the
+    worker's weights dict (checkpoint/restore); a receiving worker adopts
+    it only when it has seen MORE data than its own (monotonic guard), so
+    weight broadcasts never reset a sampler's running estimator."""
 
     def __init__(self, eps: float = 1e-8):
         self.count = 0

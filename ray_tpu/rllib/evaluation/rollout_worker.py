@@ -239,12 +239,33 @@ class RolloutWorker(CollectiveMixin):
                     unravel(jnp.asarray(arr / group.world_size)))
         return {"stats": stats, "steps": batch.count}
 
+    @staticmethod
+    def _filter_count(state) -> int:
+        return sum((s or {}).get("count", 0) for s in (state or []))
+
     def set_weights(self, weights) -> bool:
+        # Connector filter statistics ride along (checkpoint restore /
+        # cross-worker carry) in a shallow envelope key that MUST be
+        # stripped before reaching the policy (whose weights are a raw
+        # params pytree).  Applied only when the incoming state has seen
+        # MORE data than ours, so a learner broadcast never resets a
+        # sampling worker's running estimator.
+        state = None
+        if isinstance(weights, dict) and "_obs_filters" in weights:
+            weights = dict(weights)
+            state = weights.pop("_obs_filters")
         self.policy.set_weights(weights)
+        if state and self._filter_count(state) > self._filter_count(
+                self._obs_pipe.get_state()):
+            self._obs_pipe.set_state(state)
         return True
 
     def get_weights(self):
-        return self.policy.get_weights()
+        w = self.policy.get_weights()
+        if isinstance(w, dict):
+            w = dict(w)
+            w["_obs_filters"] = self._obs_pipe.get_state()
+        return w
 
     def episode_stats(self, clear: bool = True) -> Dict:
         stats = {"episode_rewards": list(self._completed_rewards),

@@ -1,11 +1,14 @@
 """Runtime environments: per-task/actor execution environments.
 
 Reference: python/ray/runtime_env/runtime_env.py (RuntimeEnv) +
-_private/runtime_env/{working_dir,py_modules}.py — working_dir/py_modules
-are content-addressed packages uploaded once (URI-cached, packaging.py)
-and materialized on workers; env_vars apply to the executing worker.
-Scoped: conda/pip/container are out (the fleet runs one prebuilt image —
-flagged unsupported rather than silently ignored).
+_private/runtime_env/{working_dir,py_modules,pip}.py — working_dir/
+py_modules are content-addressed packages uploaded once (URI-cached,
+packaging.py) and materialized on workers; env_vars apply to the
+executing worker; `pip` gives the task a DEDICATED worker running in a
+content-addressed virtualenv (pip-spec hash -> cached venv, reference
+pip.py) so two tasks in one cluster can import different versions of the
+same package.  Scoped: conda/container are out (the fleet runs one
+prebuilt image — flagged unsupported rather than silently ignored).
 """
 
 from __future__ import annotations
@@ -17,14 +20,15 @@ import sys
 import zipfile
 from typing import Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
 
 class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
-                 py_modules: Optional[List[str]] = None, **extra):
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[List[str]] = None, **extra):
         unsupported = set(extra) - _SUPPORTED
         if unsupported:
             raise ValueError(
@@ -37,6 +41,18 @@ class RuntimeEnv(dict):
             self["working_dir"] = working_dir
         if py_modules:
             self["py_modules"] = list(py_modules)
+        if pip:
+            self["pip"] = [str(p) for p in pip]
+
+
+def pip_env_key(runtime_env: Optional[dict]) -> str:
+    """Content address of a pip runtime env ('' = the default
+    interpreter).  Workers are pooled per key: a task only ever runs on
+    a worker whose venv matches."""
+    if not runtime_env or not runtime_env.get("pip"):
+        return ""
+    h = hashlib.sha1("\n".join(sorted(runtime_env["pip"])).encode())
+    return h.hexdigest()[:16]
 
 
 def _zip_dir(path: str) -> bytes:

@@ -162,11 +162,17 @@ def cmd_stop(args):
 
 def cmd_status(args):
     import ray_tpu
+    from ray_tpu._private.reporter import format_utilization
     from ray_tpu.experimental import state
     _connect(args.address)
     print("cluster:", json.dumps(ray_tpu.cluster_resources()))
     print("available:", json.dumps(ray_tpu.available_resources()))
-    _print_rows(state.list_nodes())
+    rows = []
+    for n in state.list_nodes():
+        stats = n.pop("node_stats", {})
+        n["utilization"] = format_utilization(stats) or "(pending)"
+        rows.append(n)
+    _print_rows(rows)
 
 
 def cmd_list(args):

@@ -218,3 +218,26 @@ def test_trace_context_links_nested_tasks(ray_init):
     # Same trace; the child's parent span is the parent task's span.
     assert c["trace_id"] == p["trace_id"]
     assert c["parent_id"] == p["span_id"]
+
+
+def test_node_hardware_reporter(ray_start_regular):
+    """Per-node hardware utilization flows raylet -> GCS -> state API
+    (reference: dashboard reporter agent relaying psutil stats)."""
+    import time
+    from ray_tpu.experimental import state
+    from ray_tpu._private.reporter import format_utilization
+
+    stats = {}
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        nodes = state.list_nodes()
+        stats = nodes[0].get("node_stats", {})
+        if stats.get("mem_total"):
+            break
+        time.sleep(1)
+    assert stats.get("mem_total", 0) > 0
+    assert stats.get("disk_total", 0) > 0
+    assert stats.get("object_store_capacity", 0) > 0
+    assert "cpu_percent" in stats
+    line = format_utilization(stats)
+    assert "mem" in line and "store" in line

@@ -73,4 +73,48 @@ def test_runtime_env_on_actor(ray_init):
 def test_unsupported_field_rejected(ray_init):
     from ray_tpu.runtime_env import RuntimeEnv
     with pytest.raises(ValueError):
-        RuntimeEnv(pip=["requests"])
+        RuntimeEnv(conda={"dependencies": ["x"]})
+
+
+def _make_pkg(tmpdir, version):
+    """A tiny installable package whose module reports its version."""
+    root = os.path.join(tmpdir, f"rtenvtestpkg_{version.replace('.', '_')}")
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, "setup.py"), "w") as f:
+        f.write(
+            "from setuptools import setup\n"
+            f"setup(name='rtenvtestpkg', version='{version}', "
+            "py_modules=['rtenvtestpkg'])\n")
+    with open(os.path.join(root, "rtenvtestpkg.py"), "w") as f:
+        f.write(f"VERSION = '{version}'\n")
+    return root
+
+
+def test_pip_venv_isolation(ray_init, tmp_path):
+    """Two tasks in ONE cluster import DIFFERENT versions of the same
+    package (reference: _private/runtime_env/pip.py — spec-hashed cached
+    venvs; each pip task runs on a worker dedicated to its venv).  Local
+    directory installs keep the test network-free."""
+    v1 = _make_pkg(str(tmp_path), "1.0")
+    v2 = _make_pkg(str(tmp_path), "2.0")
+
+    @ray_tpu.remote
+    def which_version():
+        import rtenvtestpkg
+        return rtenvtestpkg.VERSION
+
+    r1 = which_version.options(runtime_env={"pip": [v1]}).remote()
+    r2 = which_version.options(runtime_env={"pip": [v2]}).remote()
+    assert ray_tpu.get(r1, timeout=300) == "1.0"
+    assert ray_tpu.get(r2, timeout=300) == "2.0"
+
+    # The base interpreter must NOT see the package at all.
+    @ray_tpu.remote
+    def base_has_pkg():
+        try:
+            import rtenvtestpkg  # noqa: F401
+            return True
+        except ImportError:
+            return False
+
+    assert ray_tpu.get(base_has_pkg.remote(), timeout=60) is False
