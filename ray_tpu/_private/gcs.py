@@ -151,8 +151,16 @@ class GcsServer:
         self._drivers: dict[int, dict] = {}  # conn-id -> {job_id}
         self._start_time = time.time()
         # Persistence (reference: gcs/store_client/redis_store_client.h:28 —
-        # table storage that survives GCS restart; here a pickle snapshot).
+        # table storage that survives GCS restart; pluggable backends per
+        # gcs/store_client — persist_path accepts a URI: plain/file://
+        # (atomic-rename snapshot), sqlite:// (transactional versioned,
+        # point at a shared mount for cross-machine failover), or a
+        # registered external scheme).
         self._persist_path = persist_path
+        self._store_client = None
+        if persist_path:
+            from ray_tpu._private.gcs_storage import get_store_client
+            self._store_client = get_store_client(persist_path)
         self._kv_writes = 0
         # Structured cluster events (reference: src/ray/util/event.h:102
         # EventManager + dashboard/modules/event): bounded ring, surfaced
@@ -210,18 +218,15 @@ class GcsServer:
 
     def _write_snapshot(self, state: dict):
         import pickle
-        tmp = self._persist_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        os.replace(tmp, self._persist_path)
+        self._store_client.write(pickle.dumps(state))
 
     def _load_snapshot(self):
         import pickle
-        if not os.path.exists(self._persist_path):
-            return
         try:
-            with open(self._persist_path, "rb") as f:
-                snap = pickle.load(f)
+            blob = self._store_client.read()
+            if blob is None:
+                return
+            snap = pickle.loads(blob)
         except Exception as e:
             logger.warning("GCS snapshot load failed: %s", e)
             return

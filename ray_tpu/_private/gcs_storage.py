@@ -1,0 +1,124 @@
+"""Pluggable GCS metadata persistence.
+
+Reference: src/ray/gcs/store_client/ — StoreClient (store_client.h) with
+InMemoryStoreClient and RedisStoreClient (redis_store_client.h:28), the
+seam that makes head-node loss survivable: put the backend somewhere that
+outlives the head machine and a fresh GCS on ANY machine reloads cluster
+metadata from it.
+
+Backends here: file snapshots (default, same behavior as before),
+sqlite (transactional, versioned history — point at a shared mount for
+cross-machine failover), and a registry for external schemes (an
+object-store/redis-like service registers a factory).  Addressed by URI:
+
+    /plain/path or file:///path  -> FileStoreClient
+    sqlite:///path/to/db         -> SqliteStoreClient
+    <scheme>://...               -> via register_gcs_store
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+
+class GcsStoreClient:
+    """Snapshot-blob storage (reference: store_client.h — narrowed to the
+    snapshot granularity the GCS persists at)."""
+
+    def write(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class FileStoreClient(GcsStoreClient):
+    """Atomic-rename file snapshot (the in-tree default)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, data: bytes) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self.path)
+
+    def read(self) -> Optional[bytes]:
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            return f.read()
+
+    def describe(self) -> str:
+        return f"file:{self.path}"
+
+
+class SqliteStoreClient(GcsStoreClient):
+    """Transactional versioned snapshots in sqlite (the external-backend
+    role of redis_store_client.h:28 without a network dependency: place
+    the db on storage that outlives the head node and a replacement GCS
+    restores from it).  Keeps a bounded history of recent snapshots."""
+
+    KEEP = 8
+
+    def __init__(self, path: str):
+        import sqlite3
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS gcs_snapshots ("
+            "version INTEGER PRIMARY KEY AUTOINCREMENT, "
+            "ts REAL, data BLOB)")
+        self._conn.commit()
+
+    def write(self, data: bytes) -> None:
+        import time
+        with self._conn:
+            self._conn.execute(
+                "INSERT INTO gcs_snapshots (ts, data) VALUES (?, ?)",
+                (time.time(), data))
+            self._conn.execute(
+                "DELETE FROM gcs_snapshots WHERE version NOT IN "
+                "(SELECT version FROM gcs_snapshots "
+                "ORDER BY version DESC LIMIT ?)", (self.KEEP,))
+
+    def read(self) -> Optional[bytes]:
+        row = self._conn.execute(
+            "SELECT data FROM gcs_snapshots "
+            "ORDER BY version DESC LIMIT 1").fetchone()
+        return bytes(row[0]) if row else None
+
+    def describe(self) -> str:
+        return f"sqlite:{self.path}"
+
+
+_SCHEMES: Dict[str, Callable[[str], GcsStoreClient]] = {
+    # `rest` is everything after "://": "scheme:///abs/path" -> "/abs/path",
+    # "scheme://rel/path" -> "rel/path".
+    "file": lambda rest: FileStoreClient(rest),
+    "sqlite": lambda rest: SqliteStoreClient(rest),
+}
+
+
+def register_gcs_store(scheme: str,
+                       factory: Callable[[str], GcsStoreClient]) -> None:
+    """Plug an external metadata backend (e.g. a redis-like service).
+    Registering an existing scheme overrides the built-in."""
+    _SCHEMES[scheme] = factory
+
+
+def get_store_client(uri: str) -> GcsStoreClient:
+    if "://" in uri:
+        scheme, rest = uri.split("://", 1)
+        if scheme in _SCHEMES:
+            return _SCHEMES[scheme](rest)
+        raise ValueError(f"no GCS storage backend for scheme {scheme!r} "
+                         f"(register one with register_gcs_store)")
+    return FileStoreClient(uri)

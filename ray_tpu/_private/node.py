@@ -35,8 +35,8 @@ def new_session_dir():
     return session
 
 
-def _read_port(proc, tag, timeout=30.0):
-    pattern = re.compile(rf"{tag}=(\d+)")
+def _read_tag(proc, tag, timeout=30.0, convert=int):
+    pattern = re.compile(rf"{tag}=(\S+)")
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         line = proc.stdout.readline()
@@ -48,8 +48,12 @@ def _read_port(proc, tag, timeout=30.0):
             continue
         m = pattern.search(line.decode(errors="replace"))
         if m:
-            return int(m.group(1))
+            return convert(m.group(1))
     raise RuntimeError(f"timed out waiting for {tag}")
+
+
+def _read_port(proc, tag, timeout=30.0):
+    return _read_tag(proc, tag, timeout, convert=int)
 
 
 class NodeProcesses:
@@ -129,6 +133,8 @@ class NodeProcesses:
             env=env, start_new_session=True)
         rport = _read_port(self.raylet_proc, "RAYLET_PORT")
         self.raylet_addr = (self.host, rport)
+        self.raylet_node_id = _read_tag(self.raylet_proc, "RAYLET_NODE_ID",
+                                        convert=str)
         return self.raylet_addr
 
     def restart_gcs(self):

@@ -126,6 +126,8 @@ class Raylet:
         # unsealed creates per client conn (freed if the client dies
         # before sealing): id(conn) -> {oid}
         self._creating: dict[int, set[bytes]] = {}
+        # resource shapes already warned about as infeasible (event dedup)
+        self._infeasible_warned: set[tuple] = set()
 
     # -------------------------------------------------------------- startup
     async def start(self, port=0):
@@ -212,6 +214,7 @@ class Raylet:
                 if msg["event"] == "added":
                     view = msg["node"]
                     self.cluster_nodes[view["node_id"]] = view
+                    self._respill_pending(view)
                 elif msg["event"] == "removed":
                     self.cluster_nodes.pop(msg["node_id"], None)
                     conn2 = self.peer_conns.pop(msg["node_id"], None)
@@ -219,6 +222,22 @@ class Raylet:
                         await conn2.close()
             return None
         return await self._handle(conn, method, body)
+
+    def _respill_pending(self, new_node_view):
+        """A node joined: queued requests this node can NEVER satisfy but
+        the new node can are answered with a spillback to it (the path
+        that un-wedges infeasible-queued demand after a scale-up)."""
+        total = new_node_view.get("resources", {})
+        addr = tuple(new_node_view["addr"])
+        for req in list(self.pending_leases):
+            if req["future"].done():
+                continue
+            res = req["resources"]
+            if self._fits_total(res):
+                continue  # locally feasible: the scheduler will grant it
+            if all(total.get(k, 0) >= v for k, v in res.items()):
+                req["future"].set_result({"spillback": addr})
+                self.pending_leases.remove(req)
 
     async def _on_conn_lost(self, conn):
         self._release_client_pins(conn)
@@ -642,7 +661,26 @@ class Raylet:
             target = self._pick_spillback(resources)
             if target is not None:
                 return {"spillback": target}
-            return {"error": f"resources {resources} infeasible cluster-wide"}
+            # Infeasible CLUSTER-WIDE: queue, don't error (reference: the
+            # raylet's infeasible task queue — the request becomes
+            # autoscaler demand via pending_shapes, and _respill_pending
+            # redirects it when a capable node joins).  Surface the wait
+            # as a cluster event ONCE PER SHAPE (a fan-out of identical
+            # requests must not flood the bounded event ring).
+            shape = tuple(sorted(resources.items()))
+            if shape not in self._infeasible_warned:
+                self._infeasible_warned.add(shape)
+                try:
+                    await self.gcs.request("publish", {
+                        "channel": "events",
+                        "message": {"severity": "WARNING",
+                                    "source": "raylet",
+                                    "message": f"task demand {resources} "
+                                               f"is infeasible on the "
+                                               f"current cluster; waiting "
+                                               f"for scale-up"}})
+                except Exception:
+                    pass
         elif (body.get("strategy") or {}).get("type") == "spread":
             target = self._pick_spread_target(resources)
             if target is not None:
@@ -1694,6 +1732,9 @@ def main():
                         node_name=args.node_name)
         port = await raylet.start(args.port)
         print(f"RAYLET_PORT={port}", flush=True)
+        # Consumed by NodeProcesses so provider-launched nodes can be
+        # matched to GCS node views (autoscaler idle drain).
+        print(f"RAYLET_NODE_ID={raylet.node_id.hex()}", flush=True)
         n_warm = args.prestart_workers
         if n_warm < 0:
             n_warm = min(2, max(1, int(resources.get("CPU", 1))))

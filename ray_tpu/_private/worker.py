@@ -1006,8 +1006,11 @@ class CoreWorker:
                     spec_probe["pg_id"], spec_probe.get("bundle_index"))
             for _hop in range(4):
                 pool.outstanding[request_id] = conn
-                reply = await conn.request("request_worker_lease", body,
-                                           timeout=300.0)
+                # No RPC timeout: a cluster-wide-infeasible request stays
+                # queued at the raylet as autoscaler demand (reference:
+                # infeasible tasks wait for scale-up, they don't error).
+                # Conn loss / explicit cancellation still wake this.
+                reply = await conn.request("request_worker_lease", body)
                 pool.outstanding.pop(request_id, None)
                 if "spillback" in reply:
                     addr = tuple(reply["spillback"])

@@ -2,6 +2,7 @@
 (reference: python/ray/autoscaler — SURVEY.md §2.2)."""
 
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    LocalProcessNodeProvider,
     FakeMultiNodeProvider,
     NodeProvider,
 )
@@ -10,5 +11,5 @@ from ray_tpu.autoscaler._private.autoscaler import (  # noqa: F401
     StandardAutoscaler,
 )
 
-__all__ = ["FakeMultiNodeProvider", "Monitor", "NodeProvider",
-           "StandardAutoscaler"]
+__all__ = ["FakeMultiNodeProvider", "LocalProcessNodeProvider",
+           "Monitor", "NodeProvider", "StandardAutoscaler"]

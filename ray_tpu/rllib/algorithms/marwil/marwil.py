@@ -88,8 +88,15 @@ class MARWIL(Algorithm):
         data = self.algo_config.get("input_data")
         if data is None:
             raise ValueError("MARWIL/BC needs config['input_data'] with "
-                             "obs/actions/rewards/dones arrays")
-        batch = SampleBatch({k: np.asarray(v) for k, v in data.items()})
+                             "obs/actions/rewards/dones arrays, or a "
+                             "path/glob of offline .json files")
+        if isinstance(data, str):
+            # Offline dataset files (reference: rllib/offline JsonReader
+            # feeding BC/MARWIL via config.offline_data(input_=...)).
+            from ray_tpu.rllib.offline import read_sample_batches
+            batch = read_sample_batches(data)
+        else:
+            batch = SampleBatch({k: np.asarray(v) for k, v in data.items()})
         batch[sb.VALUE_TARGETS] = _mc_returns(
             batch[sb.REWARDS].astype(np.float32),
             batch[sb.DONES].astype(np.float32),

@@ -68,6 +68,13 @@ class RolloutWorker(CollectiveMixin):
         self._episode_len = 0
         self._completed_rewards: List[float] = []
         self._completed_lens: List[int] = []
+        # Offline output (reference: rollout config `output` -> offline/
+        # json_writer): every sampled fragment is appended as a dataset
+        # row usable by BC/MARWIL via input_data=<path>.
+        self._output_writer = None
+        if self.config.get("output"):
+            from ray_tpu.rllib.offline import JsonWriter
+            self._output_writer = JsonWriter(self.config["output"])
 
     def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
         """Collect one fragment of experience with GAE advantages."""
@@ -128,7 +135,10 @@ class RolloutWorker(CollectiveMixin):
                                           len(rows[sb.OBS]),
                                           last_value=last_v,
                                           gamma=gamma, lam=lam))
-        return SampleBatch.concat_samples(segments)
+        batch = SampleBatch.concat_samples(segments)
+        if self._output_writer is not None:
+            self._output_writer.write(batch)
+        return batch
 
     def _sample_vector(self, horizon: int, gamma: float,
                        lam: float) -> SampleBatch:
@@ -187,7 +197,10 @@ class RolloutWorker(CollectiveMixin):
                 segments.append(self._segment(
                     rows[i], seg_start[i], len(rows[i][sb.OBS]),
                     last_value=last_v, gamma=gamma, lam=lam))
-        return SampleBatch.concat_samples(segments)
+        batch = SampleBatch.concat_samples(segments)
+        if self._output_writer is not None:
+            self._output_writer.write(batch)
+        return batch
 
     def _segment(self, rows, start, end, last_value, gamma, lam):
         act_dtype = np.int32 if self._discrete else np.float32

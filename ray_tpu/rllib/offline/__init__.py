@@ -1,0 +1,7 @@
+from ray_tpu.rllib.offline.json_io import (
+    JsonReader,
+    JsonWriter,
+    read_sample_batches,
+)
+
+__all__ = ["JsonReader", "JsonWriter", "read_sample_batches"]

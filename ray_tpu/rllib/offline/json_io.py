@@ -1,0 +1,120 @@
+"""Offline dataset IO: newline-delimited JSON sample batches.
+
+Reference: rllib/offline/{json_writer,json_reader}.py — rollouts written
+as JSON lines of column lists, read back for offline algorithms (BC /
+MARWIL) and for sharing experience between clusters.  Workers write
+through `output` (rollout config); readers shuffle across files.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import time
+from typing import Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class JsonWriter:
+    """Append sample batches to timestamped .json files (one JSON object
+    per line, columns as lists; reference: offline/json_writer.py)."""
+
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_file_size = max_file_size
+        self._f = None
+        self._bytes = 0
+
+    def _rotate(self):
+        if self._f is not None:
+            self._f.close()
+        name = f"output-{time.strftime('%Y-%m-%d_%H-%M-%S')}" \
+               f"_{os.getpid()}_{int(time.time()*1e3) % 100000}.json"
+        self._f = open(os.path.join(self.path, name), "a")
+        self._bytes = 0
+
+    def write(self, batch: SampleBatch) -> None:
+        row = {k: np.asarray(v).tolist() for k, v in batch.items()}
+        line = json.dumps(row) + "\n"
+        if self._f is None or self._bytes + len(line) > self.max_file_size:
+            self._rotate()
+        self._f.write(line)
+        self._f.flush()
+        self._bytes += len(line)
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+_DTYPES = {
+    "obs": np.float32, "new_obs": np.float32, "actions": None,
+    "rewards": np.float32, "dones": np.bool_, "action_logp": np.float32,
+    "vf_preds": np.float32, "advantages": np.float32,
+    "value_targets": np.float32,
+}
+
+
+def _to_batch(row: Dict) -> SampleBatch:
+    out = {}
+    for k, v in row.items():
+        dtype = _DTYPES.get(k)
+        arr = np.asarray(v, dtype) if dtype else np.asarray(v)
+        if k == "actions" and arr.dtype.kind in "iu":
+            arr = arr.astype(np.int32)
+        out[k] = arr
+    return SampleBatch(out)
+
+
+class JsonReader:
+    """Iterate sample batches from .json files or a glob/directory
+    (reference: offline/json_reader.py — cycles forever, shuffling file
+    order, so `next()` always yields)."""
+
+    def __init__(self, inputs: Union[str, List[str]], seed: int = 0):
+        if isinstance(inputs, str):
+            if os.path.isdir(inputs):
+                inputs = os.path.join(inputs, "*.json")
+            self.files = sorted(_glob.glob(inputs))
+        else:
+            self.files = list(inputs)
+        if not self.files:
+            raise ValueError(f"no offline input files match {inputs!r}")
+        self._rng = np.random.RandomState(seed)
+        self._iter = self._rows()
+
+    def _rows(self) -> Iterator[SampleBatch]:
+        while True:
+            order = list(self.files)
+            self._rng.shuffle(order)
+            for path in order:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield _to_batch(json.loads(line))
+
+    def next(self) -> SampleBatch:
+        return next(self._iter)
+
+    def read_all(self) -> SampleBatch:
+        """One pass over every file, concatenated (for fixed-dataset
+        offline training)."""
+        batches = []
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        batches.append(_to_batch(json.loads(line)))
+        return SampleBatch.concat_samples(batches)
+
+
+def read_sample_batches(inputs: Union[str, List[str]]) -> SampleBatch:
+    return JsonReader(inputs).read_all()

@@ -1,114 +1,10 @@
-"""Pluggable experiment/checkpoint storage.
+"""Tune's storage seam — shared with workflow (see util/storage.py)."""
 
-Reference: tune/syncer.py + air/_internal/remote_storage.py — experiment
-state and checkpoints sync through a storage abstraction addressed by
-URI, so a head-node loss doesn't lose the experiment and resume works
-from any machine.  Local filesystem ships in-tree; other schemes register
-via `register_storage` (the reference delegates to pyarrow.fs — here the
-seam is explicit and dependency-free).
-"""
-
-from __future__ import annotations
-
-import os
-import shutil
-from typing import Callable, Dict
-
-
-class Storage:
-    """Byte-level KV over a URI prefix."""
-
-    def write_bytes(self, rel: str, data: bytes) -> None:
-        raise NotImplementedError
-
-    def read_bytes(self, rel: str) -> bytes:
-        raise NotImplementedError
-
-    def exists(self, rel: str) -> bool:
-        raise NotImplementedError
-
-    def upload_file(self, local_path: str, rel: str) -> None:
-        with open(local_path, "rb") as f:
-            self.write_bytes(rel, f.read())
-
-    def download_file(self, rel: str, local_path: str) -> None:
-        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-        with open(local_path, "wb") as f:
-            f.write(self.read_bytes(rel))
-
-
-class LocalStorage(Storage):
-    def __init__(self, root: str):
-        self.root = root
-        os.makedirs(root, exist_ok=True)
-
-    def _path(self, rel: str) -> str:
-        return os.path.join(self.root, rel)
-
-    def write_bytes(self, rel: str, data: bytes) -> None:
-        path = self._path(rel)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
-
-    def read_bytes(self, rel: str) -> bytes:
-        with open(self._path(rel), "rb") as f:
-            return f.read()
-
-    def exists(self, rel: str) -> bool:
-        return os.path.exists(self._path(rel))
-
-    def upload_file(self, local_path: str, rel: str) -> None:
-        dest = self._path(rel)
-        if os.path.abspath(local_path) == os.path.abspath(dest):
-            return  # experiment dir IS the storage root
-        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
-        shutil.copy2(local_path, dest)
-
-
-class MemStorage(Storage):
-    """In-memory backend (scheme mem://) — the pluggability seam's test
-    double, and a stand-in for object-store-backed storage."""
-
-    _buckets: Dict[str, Dict[str, bytes]] = {}
-
-    def __init__(self, bucket: str):
-        self.data = MemStorage._buckets.setdefault(bucket, {})
-
-    def write_bytes(self, rel: str, data: bytes) -> None:
-        self.data[rel] = bytes(data)
-
-    def read_bytes(self, rel: str) -> bytes:
-        return self.data[rel]
-
-    def exists(self, rel: str) -> bool:
-        return rel in self.data
-
-
-_SCHEMES: Dict[str, Callable[[str], Storage]] = {
-    "file": lambda rest: LocalStorage(rest),
-    "mem": lambda rest: MemStorage(rest),
-}
-
-
-def register_storage(scheme: str, factory: Callable[[str], Storage]):
-    """Plug a new URI scheme (e.g. "gs", "s3") into tune's sync path."""
-    _SCHEMES[scheme] = factory
-
-
-def get_storage(uri: str) -> Storage:
-    """file:///path, mem://bucket, /plain/path -> Storage."""
-    if "://" in uri:
-        scheme, rest = uri.split("://", 1)
-        if scheme not in _SCHEMES:
-            raise ValueError(
-                f"no storage backend for scheme {scheme!r} "
-                f"(register one with tune.storage.register_storage)")
-        return _SCHEMES[scheme](rest)
-    return LocalStorage(uri)
-
-
-def is_remote_uri(path: str) -> bool:
-    return "://" in path and not path.startswith("file://")
+from ray_tpu.util.storage import (  # noqa: F401
+    LocalStorage,
+    MemStorage,
+    Storage,
+    get_storage,
+    is_remote_uri,
+    register_storage,
+)

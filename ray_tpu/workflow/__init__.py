@@ -1,18 +1,23 @@
-"""Workflow: durable execution of task DAGs.
+"""Workflow: durable execution of task DAGs, continuations, virtual actors.
 
 Reference: python/ray/workflow — workflow.run/run_async (api.py:120,166),
 per-task checkpointing in task_executor.py:50 (each task's output is
 persisted before dependents run), WorkflowManagementActor
-(workflow_access.py:88) tracking status, storage/ for the persistence
-layer.  Scoped re-design: the DAG IR is ray_tpu.dag; every node's result
-is checkpointed to the workflow's storage directory under a deterministic
-task key, so `resume` replays only the tasks whose checkpoints are
-missing (exactly-once-ish per task).
+(workflow_access.py:88) tracking status, storage/ for persistence,
+virtual actors (durable per-method-journaled actors), and dynamic
+sub-workflows (a task RETURNING a DAG continues the workflow with it —
+workflow.continuation).
+
+Re-design: the DAG IR is ray_tpu.dag; every node's result is checkpointed
+under a deterministic task key through the pluggable byte-storage layer
+(ray_tpu.util.storage: local paths, file://, mem://, registered schemes),
+so `resume` replays only the tasks whose checkpoints are missing
+(exactly-once-ish per task) and the whole workflow state survives the
+driver machine when the storage URI points somewhere durable.
 """
 
 from __future__ import annotations
 
-import hashlib
 import os
 import pickle
 import tempfile
@@ -21,8 +26,9 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+from ray_tpu.util.storage import Storage, get_storage
 
-_DEFAULT_STORAGE = None
+_DEFAULT_STORAGE: Optional[str] = None
 
 STATUS_RUNNING = "RUNNING"
 STATUS_SUCCESSFUL = "SUCCESSFUL"
@@ -31,57 +37,65 @@ STATUS_RESUMABLE = "RESUMABLE"
 
 
 def init(storage: Optional[str] = None):
-    """Set the storage root (reference: workflow.init)."""
+    """Set the storage root — a path or URI (reference: workflow.init)."""
     global _DEFAULT_STORAGE
     _DEFAULT_STORAGE = storage
 
 
-def _storage_root() -> str:
+def _storage_uri() -> str:
     global _DEFAULT_STORAGE
     if _DEFAULT_STORAGE is None:
         _DEFAULT_STORAGE = os.path.join(tempfile.gettempdir(),
                                         "rt_workflows")
-    os.makedirs(_DEFAULT_STORAGE, exist_ok=True)
     return _DEFAULT_STORAGE
 
 
-def _wf_dir(workflow_id: str) -> str:
-    d = os.path.join(_storage_root(), workflow_id)
-    os.makedirs(d, exist_ok=True)
-    return d
+_STORE_CACHE: Dict[str, Storage] = {}
+
+
+def _store() -> Storage:
+    uri = _storage_uri()
+    st = _STORE_CACHE.get(uri)
+    if st is None:
+        st = _STORE_CACHE[uri] = get_storage(uri)
+    return st
+
+
+def _put(key: str, value: Any):
+    _store().write_bytes(key, pickle.dumps(value))
+
+
+def _get(key: str, default=None):
+    st = _store()
+    if not st.exists(key):
+        return default
+    return pickle.loads(st.read_bytes(key))
 
 
 def _write_meta(workflow_id: str, **fields):
-    path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
-    meta = {}
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            meta = pickle.load(f)
+    key = f"{workflow_id}/meta.pkl"
+    meta = _get(key, {}) or {}
     meta.update(fields)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(meta, f)
-    os.replace(tmp, path)
+    _put(key, meta)
     return meta
 
 
 def _read_meta(workflow_id: str) -> Dict:
-    path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
-    if not os.path.exists(path):
-        return {}
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    return _get(f"{workflow_id}/meta.pkl", {}) or {}
 
 
 class _DurableExecutor:
     """Executes a DAG bottom-up, checkpointing each task's output
-    (reference: _workflow_task_executor task_executor.py:50)."""
+    (reference: _workflow_task_executor task_executor.py:50).  A task
+    that RETURNS a DAGNode continues the workflow with that sub-DAG
+    (reference: workflow.continuation / dynamic workflows) — the
+    sub-DAG's tasks checkpoint under the parent task's key prefix."""
 
-    def __init__(self, workflow_id: str, args, kwargs):
+    def __init__(self, workflow_id: str, args, kwargs, prefix: str = ""):
         self.workflow_id = workflow_id
-        self.dir = _wf_dir(workflow_id)
         self.args = args
         self.kwargs = kwargs
+        self.prefix = prefix
         self._counters: Dict[str, int] = {}
 
     def _task_key(self, node: FunctionNode) -> str:
@@ -90,7 +104,7 @@ class _DurableExecutor:
         name = getattr(node._fn, "__name__", "task")
         idx = self._counters.get(name, 0)
         self._counters[name] = idx + 1
-        return f"{name}__{idx}"
+        return f"{self.prefix}{name}__{idx}"
 
     def execute(self, dag: DAGNode):
         def _exec(node, args, kwargs):
@@ -103,20 +117,27 @@ class _DurableExecutor:
                     "workflows support function DAGs (fn.bind); got "
                     f"{type(node).__name__}")
             key = self._task_key(node)
-            ckpt = os.path.join(self.dir, f"task__{key}.pkl")
-            if os.path.exists(ckpt):
-                with open(ckpt, "rb") as f:
-                    return pickle.load(f)
+            ckpt = f"{self.workflow_id}/task__{key}.pkl"
+            st = _store()
+            if st.exists(ckpt):
+                return pickle.loads(st.read_bytes(ckpt))
             # Upstream values were materialized (durability barrier);
             # run this task as a cluster task and persist its output.
             rf = ray_tpu.remote(node._fn)
             if node._bound_options:
                 rf = rf.options(**node._bound_options)
             value = ray_tpu.get(rf.remote(*args, **kwargs), timeout=3600)
-            tmp = ckpt + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(value, f)
-            os.replace(tmp, ckpt)
+            if isinstance(value, DAGNode):
+                # Dynamic sub-workflow: the task decided the next stage
+                # at runtime.  Execute it durably under this task's key
+                # prefix, checkpoint the FINAL value under this task's
+                # key (a resume replays the whole continuation from its
+                # own checkpoints).
+                sub = _DurableExecutor(self.workflow_id, self.args,
+                                       self.kwargs,
+                                       prefix=f"{key}.")
+                value = sub.execute(value)
+            st.write_bytes(ckpt, pickle.dumps(value))
             return value
 
         return dag._apply_recursive(_exec)
@@ -136,11 +157,7 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
         raise
     # result.pkl BEFORE the SUCCESSFUL marker: the status contract is
     # "SUCCESSFUL implies a retrievable result".
-    ckpt = os.path.join(_wf_dir(workflow_id), "result.pkl")
-    tmp = ckpt + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(result, f)
-    os.replace(tmp, ckpt)
+    _put(f"{workflow_id}/result.pkl", result)
     _write_meta(workflow_id, status=STATUS_SUCCESSFUL,
                 end_ts=time.time())
     return result
@@ -153,8 +170,8 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
     workflow_id = workflow_id or f"workflow_{int(time.time() * 1e6):x}"
 
     # The driver-side closure carries the dag; the task replays it with
-    # the same workflow id so checkpoints land in the same directory.
-    storage = _storage_root()
+    # the same workflow id so checkpoints land in the same storage.
+    storage = _storage_uri()
 
     @ray_tpu.remote
     def _drive():
@@ -170,10 +187,10 @@ def resume(workflow_id: str) -> Any:
     (re-running an unfinished workflow requires its original DAG — call
     run() again with the same workflow_id; completed tasks replay from
     their checkpoints)."""
-    path = os.path.join(_wf_dir(workflow_id), "result.pkl")
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            return pickle.load(f)
+    st = _store()
+    key = f"{workflow_id}/result.pkl"
+    if st.exists(key):
+        return pickle.loads(st.read_bytes(key))
     raise RuntimeError(
         f"workflow {workflow_id!r} has no stored result "
         f"(status={get_status(workflow_id)}); re-run its DAG with "
@@ -190,20 +207,111 @@ def get_status(workflow_id: str) -> Optional[str]:
 
 
 def list_all() -> List[Dict]:
-    root = _storage_root()
+    st = _store()
+    seen = set()
     out = []
-    for wid in sorted(os.listdir(root)):
-        meta = _read_meta(wid)
-        if meta:
-            out.append({"workflow_id": wid,
-                        "status": get_status(wid)})
+    for key in st.list_prefix(""):
+        wid = key.split("/", 1)[0]
+        if wid in seen or not wid:
+            continue
+        seen.add(wid)
+        if _read_meta(wid):
+            out.append({"workflow_id": wid, "status": get_status(wid)})
     return out
 
 
 def delete(workflow_id: str):
-    import shutil
-    shutil.rmtree(os.path.join(_storage_root(), workflow_id),
-                  ignore_errors=True)
+    _store().delete_prefix(workflow_id)
+
+
+# --------------------------------------------------------- virtual actors
+# Reference: the workflow virtual-actor API (durable actors whose state
+# is journaled per method call; workflow_access.py get_actor).  State
+# versions live in storage: a handle on ANY machine resumes the actor
+# from its latest version; each mutating call persists state BEFORE the
+# result is returned.
+
+
+def _vactor_step(cls_blob, state, method_name, args, kwargs):
+    import cloudpickle
+    cls = cloudpickle.loads(cls_blob)
+    obj = cls.__new__(cls)
+    obj.__dict__.update(state)
+    result = getattr(obj, method_name)(*args, **kwargs)
+    return dict(obj.__dict__), result
+
+
+class _VirtualMethod:
+    def __init__(self, handle: "VirtualActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def run(self, *args, **kwargs):
+        return self._handle._call(self._name, args, kwargs)
+
+    # Parity alias with the reference's .run_async().run() pairing.
+    __call__ = run
+
+
+class VirtualActorHandle:
+    def __init__(self, cls, actor_id: str, init_args, init_kwargs):
+        self._cls = cls
+        self.actor_id = actor_id
+        self._prefix = f"virtual_actors/{actor_id}"
+        st = _store()
+        if not st.exists(f"{self._prefix}/state.pkl"):
+            obj = cls(*init_args, **init_kwargs)
+            self._save(dict(obj.__dict__), version=0)
+
+    def _save(self, state: dict, version: int):
+        _put(f"{self._prefix}/state.pkl",
+             {"state": state, "version": version,
+              "cls": self._cls.__name__})
+
+    def _load(self) -> dict:
+        return _get(f"{self._prefix}/state.pkl")
+
+    def _call(self, method_name: str, args, kwargs):
+        snap = self._load()
+        readonly = getattr(getattr(self._cls, method_name, None),
+                           "_workflow_readonly", False)
+        import cloudpickle
+        step = ray_tpu.remote(_vactor_step)
+        # The class ships BY VALUE: driver-script (__main__) classes
+        # aren't importable on workers.
+        new_state, result = ray_tpu.get(
+            step.remote(cloudpickle.dumps(self._cls), snap["state"],
+                        method_name, args, kwargs), timeout=3600)
+        if not readonly:
+            # Persist state BEFORE surfacing the result: a crash after
+            # this point re-reads the already-updated state; a crash
+            # before it replays the method (at-least-once, like the
+            # reference's journaled virtual actors).
+            self._save(new_state, snap["version"] + 1)
+        return result
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _VirtualMethod(self, name)
+
+
+def virtual_actor(cls):
+    """Class decorator: adds `get_or_create(actor_id, *init_args)`
+    returning a durable handle (reference: workflow virtual actors)."""
+
+    def get_or_create(actor_id: str, *init_args, **init_kwargs):
+        return VirtualActorHandle(cls, actor_id, init_args, init_kwargs)
+
+    cls.get_or_create = staticmethod(get_or_create)
+    return cls
+
+
+def readonly(fn):
+    """Mark a virtual-actor method as non-mutating: its calls skip the
+    state write (reference: @workflow.virtual_actor.readonly)."""
+    fn._workflow_readonly = True
+    return fn
 
 
 class EventListener:
@@ -243,3 +351,10 @@ def wait_for_event(event_listener_cls, *args, **kwargs) -> FunctionNode:
 
     _wait.__name__ = f"event_{event_listener_cls.__name__}"
     return FunctionNode(_wait, args, kwargs)
+
+
+def continuation(dag: DAGNode) -> DAGNode:
+    """Explicit marker for dynamic sub-workflows (reference:
+    workflow.continuation).  Returning a DAG from a workflow task already
+    continues with it; this exists for API parity and readability."""
+    return dag

@@ -55,15 +55,20 @@ def test_queued_task_demand_and_idle_drain(ray_start_cluster):
     def use_accel():
         return ray_tpu.get_runtime_context().get_node_id()
 
-    ref = use_accel.remote()  # queued: no accel capacity anywhere
+    ref = use_accel.remote()  # queued infeasible: becomes autoscaler demand
     deadline = time.time() + 60
-    done = False
-    while time.time() < deadline and not done:
+    result = None
+    while time.time() < deadline and result is None:
         autoscaler.update()
-        done = bool(ray_tpu.wait([ref], num_returns=1, timeout=3)[0])
-    # Completion proves scale-up: nothing else in the cluster offers
-    # `accel`.  (The node may already be idle-drained by now.)
-    assert done, "queued task demand never triggered scale-up"
+        try:
+            result = ray_tpu.get(ref, timeout=3)
+        except ray_tpu.exceptions.GetTimeoutError:
+            result = None
+    # SUCCESSFUL completion proves scale-up (a wait()-based check would
+    # also accept an errored ref): nothing else in the cluster offers
+    # `accel`.
+    assert result is not None, \
+        "queued task demand never triggered scale-up"
 
     # Idle drain: after the work is done the node terminates.
     deadline = time.time() + 60

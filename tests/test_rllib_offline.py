@@ -1,0 +1,82 @@
+"""Offline dataset IO: rollout `output` -> json files -> BC training.
+
+Reference: rllib/offline/{json_writer,json_reader}.py."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import BCConfig, PPOConfig
+from ray_tpu.rllib.offline import JsonReader, JsonWriter, read_sample_batches
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_json_writer_reader_roundtrip(tmp_path):
+    out = str(tmp_path / "ds")
+    w = JsonWriter(out)
+    b = SampleBatch({
+        "obs": np.random.randn(10, 4).astype(np.float32),
+        "actions": np.arange(10, dtype=np.int32) % 2,
+        "rewards": np.ones(10, np.float32),
+        "dones": np.zeros(10, bool),
+    })
+    w.write(b)
+    w.write(b)
+    w.close()
+    files = glob.glob(os.path.join(out, "*.json"))
+    assert files
+    all_rows = read_sample_batches(out)
+    assert all_rows.count == 20
+    np.testing.assert_allclose(all_rows["obs"][:10], b["obs"], rtol=1e-6)
+    # Streaming reader cycles forever.
+    r = JsonReader(out)
+    assert r.next().count == 10
+
+
+def test_collect_then_bc_from_files(ray_init, tmp_path):
+    """PPO collects CartPole experience with rollout output=<dir>; BC
+    then trains purely from the files (input_data=<path>)."""
+    out = str(tmp_path / "cartpole_ds")
+    collector = (PPOConfig()
+                 .environment("CartPole-v1")
+                 .rollouts(num_rollout_workers=0,
+                           rollout_fragment_length=250)
+                 .training(train_batch_size=1500, num_sgd_iter=6,
+                           sgd_minibatch_size=128, lr=2e-3)
+                 .debugging(seed=1)
+                 .build())
+    # Train FIRST, then record: the dataset holds the trained policy's
+    # behavior, not the random warmup (expert data for cloning).
+    for _ in range(6):
+        collector.train()
+    worker = collector.workers.local_worker
+    writer = JsonWriter(out)
+    for _ in range(4):
+        writer.write(worker.sample(1000))
+    writer.close()
+    collector.stop()
+    assert glob.glob(os.path.join(out, "*.json"))
+
+    bc = (BCConfig()
+          .environment("CartPole-v1")
+          .training(num_sgd_iter=25, sgd_minibatch_size=256, lr=2e-3)
+          .offline_data(input_data=out)
+          .debugging(seed=2)
+          .build())
+    best = 0.0
+    for _ in range(4):
+        r = bc.train()
+        best = max(best, r.get("episode_reward_mean") or 0.0)
+    bc.stop()
+    # Cloning the trained policy's behavior clearly beats random (~22).
+    assert best >= 40, f"BC from offline files failed (best={best})"
