@@ -114,11 +114,42 @@ def register_gcs_store(scheme: str,
     _SCHEMES[scheme] = factory
 
 
+class _StorageBlobAdapter(GcsStoreClient):
+    """Adapts a generic ray_tpu.util.storage backend to the snapshot-blob
+    interface, so external schemes registered ONCE in util.storage (the
+    seam tune and workflow share) also serve GCS persistence — no double
+    registration."""
+
+    _KEY = "gcs_snapshot.pkl"
+
+    def __init__(self, storage):
+        self._st = storage
+
+    def write(self, data: bytes) -> None:
+        self._st.write_bytes(self._KEY, data)
+
+    def read(self):
+        if not self._st.exists(self._KEY):
+            return None
+        return self._st.read_bytes(self._KEY)
+
+    def describe(self) -> str:
+        return f"util.storage:{type(self._st).__name__}"
+
+
 def get_store_client(uri: str) -> GcsStoreClient:
     if "://" in uri:
         scheme, rest = uri.split("://", 1)
         if scheme in _SCHEMES:
             return _SCHEMES[scheme](rest)
-        raise ValueError(f"no GCS storage backend for scheme {scheme!r} "
-                         f"(register one with register_gcs_store)")
+        # Fall back to the shared byte-storage registry (mem://,
+        # externally registered schemes) via the blob adapter.
+        try:
+            from ray_tpu.util.storage import get_storage
+            return _StorageBlobAdapter(get_storage(uri))
+        except ValueError:
+            raise ValueError(
+                f"no GCS storage backend for scheme {scheme!r} (register "
+                f"one with register_gcs_store or util.storage."
+                f"register_storage)")
     return FileStoreClient(uri)

@@ -229,6 +229,12 @@ class Raylet:
         that un-wedges infeasible-queued demand after a scale-up)."""
         total = new_node_view.get("resources", {})
         addr = tuple(new_node_view["addr"])
+        # Shapes the new node satisfies are feasible again: forget the
+        # warn-dedup so a LATER scale-down + new infeasible demand of the
+        # same shape warns operators again.
+        for shape in list(self._infeasible_warned):
+            if all(total.get(k, 0) >= v for k, v in shape):
+                self._infeasible_warned.discard(shape)
         for req in list(self.pending_leases):
             if req["future"].done():
                 continue

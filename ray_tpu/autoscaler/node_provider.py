@@ -99,10 +99,12 @@ class LocalProcessNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> List[Dict]:
         out = []
         for k, v in list(self._nodes.items()):
+            if k not in self._nodes:
+                continue  # reaped as a dead host's group peer below
             if v["node"].raylet_proc.poll() is not None:
                 # Process died out from under us: atomic-slice contract —
                 # tear down the whole group, same as terminate_node.
-                self._nodes.pop(k)
+                self._nodes.pop(k, None)
                 for peer_key in [pk for pk, pv in self._nodes.items()
                                  if pv["group_id"] == v["group_id"]]:
                     self._nodes.pop(peer_key)["node"].kill_raylet()

@@ -51,6 +51,14 @@ class DashboardHead:
                 "nodes": await _call(state.list_nodes),
             })
 
+        @routes.get("/ui")
+        async def ui(request):
+            # The web client (reference: dashboard/client React app —
+            # scoped to one dependency-free page polling the JSON API).
+            from ray_tpu.dashboard.ui import INDEX_HTML
+            return web.Response(text=INDEX_HTML,
+                                content_type="text/html")
+
         @routes.get("/api/nodes")
         async def nodes(request):
             from ray_tpu.experimental import state

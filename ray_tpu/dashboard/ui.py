@@ -1,0 +1,134 @@
+"""The dashboard's web UI: one dependency-free HTML page.
+
+Reference role: the dashboard React client (dashboard/client) — scoped to
+a single self-contained page that polls the head's JSON endpoints
+(/api/nodes, /api/actors, /api/jobs, /api/serve, /api/events) and renders
+cluster resources, per-node hardware utilization, actors, jobs, serve
+applications, and recent events.  No build step, no bundler: the head
+serves this string at "/ui".
+"""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 0; background: #f6f7f9; color: #1a1c20; }
+  header { background: #14202e; color: #fff; padding: 10px 20px;
+           display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 17px; margin: 0; font-weight: 600; }
+  header span { color: #9fb2c8; font-size: 12px; }
+  main { padding: 16px 20px; max-width: 1200px; margin: 0 auto; }
+  section { background: #fff; border: 1px solid #e3e6ea;
+            border-radius: 8px; margin-bottom: 16px; padding: 12px 16px; }
+  h2 { font-size: 13px; text-transform: uppercase; letter-spacing: .06em;
+       color: #5a6472; margin: 0 0 8px; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th { text-align: left; color: #5a6472; font-weight: 600;
+       border-bottom: 1px solid #e3e6ea; padding: 4px 10px 4px 0; }
+  td { border-bottom: 1px solid #f0f2f4; padding: 4px 10px 4px 0;
+       font-variant-numeric: tabular-nums; }
+  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px;
+          font-size: 12px; }
+  .ALIVE, .RUNNING, .SUCCEEDED { background: #e2f4e6; color: #1d7a33; }
+  .DEAD, .FAILED { background: #fbe3e4; color: #b3262e; }
+  .PENDING, .RESTARTING { background: #fdf3d7; color: #8a6d0a; }
+  .bar { background: #edf0f3; border-radius: 4px; height: 10px;
+         width: 120px; display: inline-block; vertical-align: middle; }
+  .bar i { display: block; height: 100%; border-radius: 4px;
+           background: #3d7fd9; }
+  .muted { color: #8a93a0; }
+  code { font-size: 12px; }
+</style>
+</head>
+<body>
+<header><h1>ray_tpu</h1>
+  <span id="summary">connecting…</span></header>
+<main>
+  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section><h2>Actors</h2><table id="actors"></table></section>
+  <section><h2>Jobs</h2><table id="jobs"></table></section>
+  <section><h2>Serve</h2><pre id="serve" class="muted"></pre></section>
+  <section><h2>Events</h2><table id="events"></table></section>
+</main>
+<script>
+const fmtB = (b) => b >= 1<<30 ? (b/(1<<30)).toFixed(1)+'G'
+  : b >= 1<<20 ? (b/(1<<20)).toFixed(0)+'M' : b + 'B';
+const bar = (pct) =>
+  `<span class="bar"><i style="width:${Math.min(100, pct||0)}%"></i></span>
+   <span class="muted">${(pct||0).toFixed(0)}%</span>`;
+const pill = (s) => `<span class="pill ${s}">${s}</span>`;
+const row = (cells) => '<tr>' + cells.map(c => `<td>${c}</td>`).join('') +
+  '</tr>';
+const head = (cols) => '<tr>' + cols.map(c => `<th>${c}</th>`).join('') +
+  '</tr>';
+
+async function j(path) {
+  const r = await fetch(path);
+  return r.json();
+}
+
+async function refresh() {
+  try {
+    const nodes = await j('/api/nodes');
+    const alive = nodes.filter(n => n.state === 'ALIVE').length;
+    let cpus = 0;
+    nodes.forEach(n => { cpus += (n.resources_total.CPU || 0); });
+    document.getElementById('summary').textContent =
+      `${alive}/${nodes.length} nodes alive · ${cpus} CPUs · ` +
+      new Date().toLocaleTimeString();
+    document.getElementById('nodes').innerHTML =
+      head(['node', 'state', 'address', 'cpu', 'mem', 'store',
+            'workers', 'resources']) +
+      nodes.map(n => {
+        const s = n.node_stats || {};
+        const storePct = s.object_store_capacity ?
+          100 * s.object_store_used / s.object_store_capacity : 0;
+        return row([
+          `<code>${n.node_id.slice(0, 10)}</code>`, pill(n.state),
+          `${n.address[0]}:${n.address[1]}`,
+          bar(s.cpu_percent), bar(s.mem_percent), bar(storePct),
+          s.workers ?? '—',
+          `<code>${JSON.stringify(n.resources_total)}</code>`]);
+      }).join('');
+
+    const actors = await j('/api/actors');
+    document.getElementById('actors').innerHTML =
+      head(['actor', 'class', 'state', 'restarts', 'node']) +
+      actors.slice(0, 50).map(a => row([
+        `<code>${(a.actor_id||'').slice(0, 10)}</code>`,
+        a.class_name || '—', pill(a.state || '—'),
+        a.num_restarts ?? 0,
+        `<code>${(a.node_id||'').slice(0, 10) || '—'}</code>`]))
+      .join('');
+
+    const jobs = await j('/api/jobs');
+    document.getElementById('jobs').innerHTML =
+      head(['job', 'status', 'entrypoint']) +
+      jobs.slice(0, 20).map(x => row([
+        `<code>${x.submission_id || x.job_id || ''}</code>`,
+        pill(x.status || '—'),
+        `<code>${(x.entrypoint||'').slice(0, 80)}</code>`])).join('');
+
+    const serve = await j('/api/serve');
+    document.getElementById('serve').textContent =
+      JSON.stringify(serve, null, 1).slice(0, 2000);
+
+    const events = await j('/api/events');
+    document.getElementById('events').innerHTML =
+      head(['severity', 'source', 'message']) +
+      events.slice(-25).reverse().map(e => row([
+        pill(e.severity || 'INFO'), e.source || '—',
+        (e.message || '').slice(0, 140)])).join('');
+  } catch (err) {
+    document.getElementById('summary').textContent = 'error: ' + err;
+  }
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

@@ -44,12 +44,20 @@ class AlgorithmConfig:
         return self
 
     def rollouts(self, num_rollout_workers=None,
-                 rollout_fragment_length=None) -> "AlgorithmConfig":
+                 rollout_fragment_length=None, num_envs_per_worker=None,
+                 output=None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self._config["num_rollout_workers"] = num_rollout_workers
         if rollout_fragment_length is not None:
             self._config["rollout_fragment_length"] = \
                 rollout_fragment_length
+        if num_envs_per_worker is not None:
+            self._config["num_envs_per_worker"] = num_envs_per_worker
+        if output is not None:
+            # Offline recording: every sampled fragment is appended as a
+            # dataset row (reference: rollout config `output` ->
+            # offline/json_writer).
+            self._config["output"] = output
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

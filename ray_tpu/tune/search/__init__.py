@@ -7,3 +7,4 @@ from ray_tpu.tune.search.basic_variant import (  # noqa: F401
     BasicVariantGenerator, Searcher,
 )
 from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401
+from ray_tpu.tune.search.gp import GPSearch  # noqa: F401

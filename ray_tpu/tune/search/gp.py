@@ -1,0 +1,186 @@
+"""Native Gaussian-process Bayesian-optimization searcher.
+
+Reference role: tune/search/bayesopt (the BayesOptSearch adapter over the
+external `bayesian-optimization` package) — implemented natively with a
+numpy RBF-kernel GP and expected-improvement acquisition, no external BO
+dependency (same stance as the native TPE searcher and the PB2
+scheduler's GP).
+
+Continuous (`Float`, log-aware) and `Integer` dimensions are modeled in a
+normalized [0,1] box; `Categorical` dimensions are one-hot.  Until
+`n_startup` observations exist, suggestions are random.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search.basic_variant import Searcher
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.basic_variant import _set_path
+from ray_tpu.tune.search.tpe import _flatten_domains, _get_path
+
+
+class _Dim:
+    """One search dimension <-> its normalized encoding."""
+
+    def __init__(self, path: tuple, domain: Domain):
+        self.path = path
+        self.domain = domain
+        if isinstance(domain, Categorical):
+            self.width = len(domain.categories)
+        elif isinstance(domain, (Float, Integer)):
+            self.width = 1
+        else:
+            raise ValueError(
+                f"GPSearch supports Float/Integer/Categorical domains; "
+                f"got {type(domain).__name__} at {'.'.join(path)}")
+
+    def encode(self, value) -> List[float]:
+        d = self.domain
+        if isinstance(d, Categorical):
+            out = [0.0] * self.width
+            out[d.categories.index(value)] = 1.0
+            return out
+        lo, hi = float(d.lower), float(d.upper)
+        if isinstance(d, Float) and d.log:
+            return [(math.log(value) - math.log(lo))
+                    / max(math.log(hi) - math.log(lo), 1e-12)]
+        return [(float(value) - lo) / max(hi - lo, 1e-12)]
+
+    def decode(self, xs: List[float]):
+        d = self.domain
+        if isinstance(d, Categorical):
+            return d.categories[int(np.argmax(xs))]
+        u = min(1.0, max(0.0, xs[0]))
+        lo, hi = float(d.lower), float(d.upper)
+        if isinstance(d, Float):
+            if d.log:
+                return math.exp(math.log(lo)
+                                + u * (math.log(hi) - math.log(lo)))
+            return lo + u * (hi - lo)
+        return int(round(lo + u * (hi - 1 - lo)))
+
+
+class GPSearch(Searcher):
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max",
+                 num_samples: int = 32, n_startup: int = 6,
+                 n_candidates: int = 256, length_scale: float = 0.25,
+                 xi: float = 0.01, seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self._space = param_space
+        self.dims = [_Dim(path, d)
+                     for path, d in _flatten_domains(param_space)]
+        self.metric, self.mode = metric, mode
+        self._budget = num_samples
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.ls = length_scale
+        self.xi = xi
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.RandomState(
+            self._rng.randrange(1 << 31))
+        self._suggested: Dict[str, Dict] = {}
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    @property
+    def total_trials(self) -> int:
+        return self._budget
+
+    # ------------------------------------------------------------- encoding
+    def _encode_cfg(self, cfg: Dict) -> np.ndarray:
+        xs: List[float] = []
+        for dim in self.dims:
+            xs.extend(dim.encode(_get_path(cfg, dim.path)))
+        return np.asarray(xs)
+
+    def _decode_vec(self, x: np.ndarray) -> Dict:
+        cfg: Dict = {}
+        i = 0
+        for dim in self.dims:
+            _set_path(cfg, dim.path, dim.decode(list(x[i:i + dim.width])))
+            i += dim.width
+        self._fill_constants(cfg, self._space, ())
+        return cfg
+
+    def _fill_constants(self, cfg, space, prefix):
+        for k, v in space.items():
+            path = prefix + (k,)
+            if isinstance(v, Domain):
+                continue
+            if isinstance(v, dict):
+                self._fill_constants(cfg, v, path)
+            else:
+                _set_path(cfg, path, v)
+
+    def _random_cfg(self) -> Dict:
+        cfg: Dict = {}
+        for dim in self.dims:
+            _set_path(cfg, dim.path, dim.domain.sample(self._rng))
+        self._fill_constants(cfg, self._space, ())
+        return cfg
+
+    # -------------------------------------------------------------- suggest
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._budget <= 0:
+            return None
+        self._budget -= 1
+        if len(self._y) < self.n_startup:
+            cfg = self._random_cfg()
+        else:
+            cfg = self._gp_suggest()
+        self._suggested[trial_id] = cfg
+        return cfg
+
+    def _gp_suggest(self) -> Dict:
+        X = np.vstack(self._X)
+        y = np.asarray(self._y, float)
+        if self.mode == "min":
+            y = -y
+        y_mean, y_std = y.mean(), y.std() or 1.0
+        yn = (y - y_mean) / y_std
+        width = X.shape[1]
+        cands = self._np_rng.uniform(size=(self.n_candidates, width))
+        # A few perturbations of the incumbent sharpen exploitation.
+        best_x = X[int(np.argmax(yn))]
+        local = np.clip(best_x[None, :] + self._np_rng.normal(
+            0, 0.1, size=(32, width)), 0, 1)
+        cands = np.vstack([cands, local])
+
+        def rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * self.ls * self.ls))
+
+        K = rbf(X, X) + 1e-3 * np.eye(len(X))
+        Ks = rbf(cands, X)
+        try:
+            Kinv_y = np.linalg.solve(K, yn)
+            mu = Ks @ Kinv_y
+            Kinv_Ks = np.linalg.solve(K, Ks.T)
+            var = np.clip(1.0 - (Ks * Kinv_Ks.T).sum(1), 1e-9, None)
+        except np.linalg.LinAlgError:
+            return self._random_cfg()
+        sigma = np.sqrt(var)
+        # Expected improvement over the incumbent.
+        best = yn.max()
+        z = (mu - best - self.xi) / sigma
+        phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        Phi = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = (mu - best - self.xi) * Phi + sigma * phi
+        return self._decode_vec(cands[int(np.argmax(ei))])
+
+    # -------------------------------------------------------------- results
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or error or not result \
+                or self.metric not in result:
+            return
+        self._X.append(self._encode_cfg(cfg))
+        self._y.append(float(result[self.metric]))

@@ -29,7 +29,9 @@ def test_apex_dqn_distributed_replay_learns(ray_init):
     best = 0.0
     trained = 0
     routed = 0
-    for _ in range(22):
+    # Generous iteration budget: suite load on the 1-CPU host slows
+    # the async routing (stragglers carry over), costing sample volume.
+    for _ in range(30):
         r = algo.train()
         best = max(best, r.get("episode_reward_mean") or 0.0)
         trained += r.get("num_env_steps_trained", 0)

@@ -43,6 +43,25 @@ def test_json_writer_reader_roundtrip(tmp_path):
     assert r.next().count == 10
 
 
+def test_rollout_output_config_records(ray_init, tmp_path):
+    """The worker-side writer branch: rollouts(output=dir) records every
+    sampled fragment without any manual writer."""
+    out = str(tmp_path / "auto_ds")
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=200,
+                      output=out)
+            .training(train_batch_size=200, num_sgd_iter=2,
+                      sgd_minibatch_size=64)
+            .debugging(seed=0)
+            .build())
+    algo.train()
+    algo.stop()
+    files = glob.glob(os.path.join(out, "*.json"))
+    assert files, "rollout output recorded nothing"
+    assert read_sample_batches(out).count >= 200
+
+
 def test_collect_then_bc_from_files(ray_init, tmp_path):
     """PPO collects CartPole experience with rollout output=<dir>; BC
     then trains purely from the files (input_data=<path>)."""

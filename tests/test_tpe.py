@@ -83,3 +83,36 @@ def test_tpe_drives_tuner(ray_init):
     assert len(results) == 12
     best = results.get_best_result()
     assert best.metrics["loss"] < 0.05
+
+
+def test_gp_search_finds_optimum(ray_init):
+    """Native GP-EI searcher (reference role: search/bayesopt adapter)
+    beats the random-startup baseline on a smooth 2-D surface."""
+    from ray_tpu import tune
+    from ray_tpu.tune.search.gp import GPSearch
+
+    def objective(config):
+        x, y = config["x"], config["y"]
+        score = -((x - 0.3) ** 2 + (y - 0.7) ** 2)
+        tune.report({"score": score, "done": True})
+
+    space = {"x": tune.uniform(0.0, 1.0), "y": tune.uniform(0.0, 1.0)}
+    gp = GPSearch(space, metric="score", mode="max", num_samples=24,
+                  n_startup=6, seed=5)
+    tuner = tune.Tuner(objective, param_space=space,
+                       tune_config=tune.TuneConfig(
+                           search_alg=gp, metric="score", mode="max"))
+    results = tuner.fit()
+    best = results.get_best_result(metric="score", mode="max")
+    # Within a modest radius of the optimum (random-only over 24 samples
+    # lands this close with probability ~55%; the GP reliably does).
+    assert best.metrics["score"] > -0.01, best.metrics
+    # Categorical + log dims also encode/decode.
+    space2 = {"lr": tune.loguniform(1e-5, 1e-1),
+              "act": tune.choice(["relu", "tanh"])}
+    gp2 = GPSearch(space2, metric="score", mode="max", num_samples=4,
+                   n_startup=1, seed=0)
+    c1 = gp2.suggest("a")
+    gp2.on_trial_complete("a", {"score": 1.0})
+    c2 = gp2.suggest("b")
+    assert 1e-5 <= c2["lr"] <= 1e-1 and c2["act"] in ("relu", "tanh")
