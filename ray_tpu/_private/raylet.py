@@ -300,17 +300,64 @@ class Raylet:
         lock = root + ".lock"
         os.makedirs(os.path.dirname(root), exist_ok=True)
         import time as _time
+
+        def _lock_stale() -> bool:
+            # The builder writes its pid into the lock; a SIGKILLed builder
+            # (the chaos-test fault mode) orphans it. Dead pid or an
+            # untouched lock older than the build bound means stale.
+            try:
+                with open(lock) as f:
+                    pid = int(f.read().strip() or "0")
+            except (OSError, ValueError):
+                pid = 0
+            if pid:
+                try:
+                    os.kill(pid, 0)
+                    return False  # builder is alive: never stale
+                except ProcessLookupError:
+                    return True
+                except PermissionError:
+                    return False  # alive, different uid
+            # No readable pid (partial write / legacy lock): fall back to
+            # age — an untouched lock older than any plausible build.
+            try:
+                return _time.time() - os.path.getmtime(lock) > 600.0
+            except OSError:
+                return False
+
+        deadline = _time.monotonic() + 900.0
         while True:
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
                 os.close(fd)
                 break
             except FileExistsError:
                 if os.path.exists(done_marker):
                     return py
+                if _lock_stale():
+                    # Clear an orphaned lock.  rename() is atomic, so at
+                    # most one waiter unlinks it; everyone then races on
+                    # O_EXCL as usual, and ONLY the lock holder touches
+                    # the half-built root (below) — no rmtree here, so a
+                    # concurrent winner's build can't be deleted.
+                    try:
+                        os.rename(lock, lock + f".claimed.{os.getpid()}")
+                        os.unlink(lock + f".claimed.{os.getpid()}")
+                    except OSError:
+                        pass
+                    continue
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"timed out waiting for venv build lock {lock}")
                 _time.sleep(0.5)
         try:
             if not os.path.exists(done_marker):
+                # We hold the lock: safe to clear any half-built root left
+                # by a SIGKILLed predecessor before building fresh.
+                if os.path.isdir(root):
+                    import shutil
+                    shutil.rmtree(root, ignore_errors=True)
                 sp.check_call([sys.executable, "-m", "venv",
                                "--system-site-packages", root],
                               stdout=sp.DEVNULL, stderr=sp.STDOUT)

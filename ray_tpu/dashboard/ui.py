@@ -59,7 +59,10 @@ const fmtB = (b) => b >= 1<<30 ? (b/(1<<30)).toFixed(1)+'G'
 const bar = (pct) =>
   `<span class="bar"><i style="width:${Math.min(100, pct||0)}%"></i></span>
    <span class="muted">${(pct||0).toFixed(0)}%</span>`;
-const pill = (s) => `<span class="pill ${s}">${s}</span>`;
+const esc = (s) => String(s).replace(/[&<>"']/g, c => ({'&':'&amp;',
+  '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
+const pill = (s) => `<span class="pill ${/^[A-Z_]+$/.test(s) ? s : ''}">` +
+  `${esc(s)}</span>`;
 const row = (cells) => '<tr>' + cells.map(c => `<td>${c}</td>`).join('') +
   '</tr>';
 const head = (cols) => '<tr>' + cols.map(c => `<th>${c}</th>`).join('') +
@@ -87,30 +90,31 @@ async function refresh() {
         const storePct = s.object_store_capacity ?
           100 * s.object_store_used / s.object_store_capacity : 0;
         return row([
-          `<code>${n.node_id.slice(0, 10)}</code>`, pill(n.state),
-          `${n.address[0]}:${n.address[1]}`,
+          `<code>${esc(n.node_id.slice(0, 10))}</code>`, pill(n.state),
+          esc(`${n.address[0]}:${n.address[1]}`),
           bar(s.cpu_percent), bar(s.mem_percent), bar(storePct),
           s.workers ?? '—',
-          `<code>${JSON.stringify(n.resources_total)}</code>`]);
+          `<code>${esc(JSON.stringify(n.resources_total))}</code>`]);
       }).join('');
 
     const actors = await j('/api/actors');
     document.getElementById('actors').innerHTML =
       head(['actor', 'class', 'state', 'restarts', 'node']) +
       actors.slice(0, 50).map(a => row([
-        `<code>${(a.actor_id||'').slice(0, 10)}</code>`,
-        a.class_name || '—', pill(a.state || '—'),
+        `<code>${esc((a.actor_id||'').slice(0, 10))}</code>`,
+        esc(a.class_name || '—'), pill(a.state || '—'),
         a.num_restarts ?? 0,
-        `<code>${(a.node_id||'').slice(0, 10) || '—'}</code>`]))
+        `<code>${esc((a.node_id||'').slice(0, 10) || '—')}</code>`]))
       .join('');
 
     const jobs = await j('/api/jobs');
     document.getElementById('jobs').innerHTML =
       head(['job', 'status', 'entrypoint']) +
       jobs.slice(0, 20).map(x => row([
-        `<code>${x.submission_id || x.job_id || ''}</code>`,
+        `<code>${esc(x.submission_id || x.job_id || '')}</code>`,
         pill(x.status || '—'),
-        `<code>${(x.entrypoint||'').slice(0, 80)}</code>`])).join('');
+        `<code>${esc((x.entrypoint||'').slice(0, 80))}</code>`]))
+      .join('');
 
     const serve = await j('/api/serve');
     document.getElementById('serve').textContent =
@@ -120,8 +124,8 @@ async function refresh() {
     document.getElementById('events').innerHTML =
       head(['severity', 'source', 'message']) +
       events.slice(-25).reverse().map(e => row([
-        pill(e.severity || 'INFO'), e.source || '—',
-        (e.message || '').slice(0, 140)])).join('');
+        pill(e.severity || 'INFO'), esc(e.source || '—'),
+        esc((e.message || '').slice(0, 140))])).join('');
   } catch (err) {
     document.getElementById('summary').textContent = 'error: ' + err;
   }

@@ -125,8 +125,21 @@ def cmd_stop(args):
     if not entries:
         print("no started nodes recorded")
         return
-    pids = [(role, pid) for e in entries
-            for role, pid in e.get("pids", {}).items()]
+    def _is_ours(pid: int) -> bool:
+        # PIDs recycle (reboot or wraparound); only signal a pid whose
+        # cmdline still looks like one of our node processes.
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            return False
+        return "ray_tpu" in cmd
+
+    all_pids = [(role, pid) for e in entries
+                for role, pid in e.get("pids", {}).items()]
+    # Only SIGNAL pids that still look like ours (pid recycling); the shm
+    # sweep below still covers arenas left by already-dead raylets.
+    pids = [(role, pid) for role, pid in all_pids if _is_ours(pid)]
     stopped = 0
     for role, pid in pids:
         try:
@@ -150,7 +163,7 @@ def cmd_stop(args):
             os.kill(pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-    for _, pid in pids:
+    for _, pid in all_pids:
         for path in glob.glob(f"/dev/shm/rt_store_*_{pid}"):
             try:
                 os.unlink(path)

@@ -50,6 +50,11 @@ class _Dim:
         if isinstance(d, Float) and d.log:
             return [(math.log(value) - math.log(lo))
                     / max(math.log(hi) - math.log(lo), 1e-12)]
+        if isinstance(d, Integer):
+            # Integer.sample is exclusive-upper, so decode spans
+            # [lo, hi-1]; normalize with the same span so
+            # decode(encode(v)) == v.
+            return [(float(value) - lo) / max(hi - 1 - lo, 1e-12)]
         return [(float(value) - lo) / max(hi - lo, 1e-12)]
 
     def decode(self, xs: List[float]):

@@ -35,6 +35,20 @@ class Storage:
     def delete_prefix(self, prefix: str) -> None:
         raise NotImplementedError
 
+    def delete(self, rel: str) -> None:
+        """Delete a single key; absent keys are a no-op."""
+        raise NotImplementedError
+
+    def write_bytes_if_absent(self, rel: str, data: bytes) -> bool:
+        """Create `rel` only if it doesn't exist; True iff this call
+        created it.  Backends with native atomic create (local files,
+        GCS if-generation-match, S3 If-None-Match) should override —
+        this default is check-then-write, atomic only per-process."""
+        if self.exists(rel):
+            return False
+        self.write_bytes(rel, data)
+        return True
+
     def upload_file(self, local_path: str, rel: str) -> None:
         with open(local_path, "rb") as f:
             self.write_bytes(rel, f.read())
@@ -67,6 +81,29 @@ class LocalStorage(Storage):
 
     def exists(self, rel: str) -> bool:
         return os.path.exists(self._path(rel))
+
+    def write_bytes_if_absent(self, rel: str, data: bytes) -> bool:
+        # Write the full content to a tmp file first, then link() it into
+        # place: link fails atomically if the key exists, and a crash can
+        # never leave a partially-written (empty) key claiming the slot.
+        path = self._path(rel)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.claim.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+
+    def delete(self, rel: str) -> None:
+        try:
+            os.unlink(self._path(rel))
+        except FileNotFoundError:
+            pass
 
     def upload_file(self, local_path: str, rel: str) -> None:
         dest = self._path(rel)
@@ -109,6 +146,10 @@ class MemStorage(Storage):
     def exists(self, rel: str) -> bool:
         return rel in self.data
 
+    def write_bytes_if_absent(self, rel: str, data: bytes) -> bool:
+        new = bytes(data)
+        return self.data.setdefault(rel, new) is new  # GIL-atomic
+
     def list_prefix(self, prefix: str) -> list:
         if not prefix.strip("/"):
             return sorted(self.data)
@@ -120,6 +161,9 @@ class MemStorage(Storage):
         for k in list(self.data):
             if k.startswith(p):
                 del self.data[k]
+
+    def delete(self, rel: str) -> None:
+        self.data.pop(rel, None)
 
 
 _SCHEMES: Dict[str, Callable[[str], Storage]] = {

@@ -259,36 +259,73 @@ class VirtualActorHandle:
         self.actor_id = actor_id
         self._prefix = f"virtual_actors/{actor_id}"
         st = _store()
-        if not st.exists(f"{self._prefix}/state.pkl"):
+        has_state = (st.exists(f"{self._prefix}/state.pkl")
+                     or any("/state.v" in k
+                            for k in st.list_prefix(self._prefix)))
+        if not has_state:
             obj = cls(*init_args, **init_kwargs)
             self._save(dict(obj.__dict__), version=0)
 
-    def _save(self, state: dict, version: int):
-        _put(f"{self._prefix}/state.pkl",
-             {"state": state, "version": version,
-              "cls": self._cls.__name__})
+    def _save(self, state: dict, version: int) -> bool:
+        """Claim `version` by exclusive create of its key; False = another
+        handle won the version (compare-and-swap, lost-update-proof on
+        backends with atomic create — LocalStorage/MemStorage in-tree)."""
+        import pickle
+        blob = pickle.dumps({"state": state, "version": version,
+                             "cls": self._cls.__name__})
+        won = _store().write_bytes_if_absent(
+            f"{self._prefix}/state.v{version:08d}.pkl", blob)
+        if won:
+            # GC old versions (keep a small window so a concurrent
+            # reader's max(keys) never dangles mid-listing); bounds both
+            # storage and per-call list_prefix cost.
+            st = _store()
+            old = sorted(k for k in st.list_prefix(self._prefix)
+                         if "/state.v" in k)[:-4]
+            for k in old:
+                try:
+                    st.delete(k)
+                except (NotImplementedError, OSError, KeyError):
+                    break
+        return won
 
     def _load(self) -> dict:
-        return _get(f"{self._prefix}/state.pkl")
+        keys = [k for k in _store().list_prefix(self._prefix)
+                if "/state.v" in k]
+        return _get(max(keys)) if keys else _get(
+            f"{self._prefix}/state.pkl")
 
     def _call(self, method_name: str, args, kwargs):
-        snap = self._load()
         readonly = getattr(getattr(self._cls, method_name, None),
                            "_workflow_readonly", False)
         import cloudpickle
         step = ray_tpu.remote(_vactor_step)
         # The class ships BY VALUE: driver-script (__main__) classes
         # aren't importable on workers.
-        new_state, result = ray_tpu.get(
-            step.remote(cloudpickle.dumps(self._cls), snap["state"],
-                        method_name, args, kwargs), timeout=3600)
-        if not readonly:
+        cls_blob = cloudpickle.dumps(self._cls)
+        if readonly:
+            snap = self._load()
+            _, result = ray_tpu.get(
+                step.remote(cls_blob, snap["state"], method_name, args,
+                            kwargs), timeout=3600)
+            return result
+        for _ in range(16):
+            snap = self._load()
+            new_state, result = ray_tpu.get(
+                step.remote(cls_blob, snap["state"], method_name, args,
+                            kwargs), timeout=3600)
             # Persist state BEFORE surfacing the result: a crash after
             # this point re-reads the already-updated state; a crash
             # before it replays the method (at-least-once, like the
-            # reference's journaled virtual actors).
-            self._save(new_state, snap["version"] + 1)
-        return result
+            # reference's journaled virtual actors).  The exclusive
+            # create claims version N+1; losing the claim means another
+            # handle interleaved (resume-from-any-machine), so replay
+            # against its state rather than silently dropping an update.
+            if self._save(new_state, snap["version"] + 1):
+                return result
+        raise RuntimeError(
+            f"virtual actor {self.actor_id}.{method_name}: too many "
+            "concurrent-update conflicts")
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
