@@ -12,6 +12,7 @@ import inspect
 import threading
 
 from ray_tpu import exceptions as rexc
+from ray_tpu._private import protocol
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import apply_system_config
 from ray_tpu._private.node import InProcessNode, new_session_dir
@@ -33,6 +34,7 @@ def _ensure_loop():
         global _loop
         _loop = asyncio.new_event_loop()
         asyncio.set_event_loop(_loop)
+        protocol.enable_eager_tasks(_loop)
         ready.set()
         _loop.run_forever()
 

@@ -867,6 +867,7 @@ def main():
 
     async def run():
         gcs = GcsServer(host=args.host, persist_path=args.persist_path)
+        protocol.enable_eager_tasks()
         port = await gcs.start(args.port)
         print(f"GCS_PORT={port}", flush=True)
         sys.stdout.flush()

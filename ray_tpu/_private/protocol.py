@@ -41,6 +41,21 @@ class RpcError(Exception):
     pass
 
 
+def enable_eager_tasks(loop=None) -> None:
+    """Eager task execution (py3.12+): create_task runs the coroutine
+    synchronously until its first true suspension, removing a loop-
+    scheduling hop from every RPC serve/submit on the control plane.
+    Semantics note: task bodies may now run BEFORE create_task returns —
+    callers must not rely on deferred start (reviewed: protocol/worker/
+    raylet/gcs call sites hold no such assumption)."""
+    factory = getattr(asyncio, "eager_task_factory", None)
+    if factory is None:
+        return
+    if loop is None:
+        loop = asyncio.get_event_loop()
+    loop.set_task_factory(factory)
+
+
 # Per-method handler service-time accounting for every RPC served by this
 # process (reference: the instrumented asio event loop's per-handler stats,
 # src/ray/common/event_stats.h).  Accumulation is three float ops per call;
@@ -109,8 +124,10 @@ class Connection:
         self._next_id = 1
         self._pending: dict[int, asyncio.Future] = {}
         self._closed = False
-        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
         self._write_lock = asyncio.Lock()
+        # Last: under an eager task factory this may start reading (and
+        # serving) immediately, so every attribute must already exist.
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
 
     @classmethod
     async def connect(cls, host: str, port: int, handler=None, name: str = "?",
@@ -188,13 +205,25 @@ class Connection:
     async def _send(self, kind: int, msg_id: int, payload: bytes):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
-        async with self._write_lock:
+        # Buffered writes, no lock: StreamWriter.write is synchronous and
+        # there is no await between the two calls, so header+payload can't
+        # interleave with another sender (and skipping concatenation
+        # avoids copying large payloads).  drain() (an await + lock-step
+        # with the transport) only matters for backpressure — apply it
+        # once the send buffer is actually deep.
+        try:
             self.writer.write(_HDR.pack(len(payload), kind, msg_id))
             self.writer.write(payload)
-            try:
-                await self.writer.drain()
-            except (ConnectionResetError, OSError) as e:
-                raise ConnectionLost(str(e)) from e
+        except (ConnectionResetError, OSError) as e:
+            raise ConnectionLost(str(e)) from e
+        transport = self.writer.transport
+        if (transport is not None
+                and transport.get_write_buffer_size() > 1 << 20):
+            async with self._write_lock:
+                try:
+                    await self.writer.drain()
+                except (ConnectionResetError, OSError) as e:
+                    raise ConnectionLost(str(e)) from e
 
     async def request_send(self, method: str, body=None):
         """Send a request and return the reply future WITHOUT awaiting it.

@@ -1590,6 +1590,15 @@ class Raylet:
         return {"used": self.store.used(), "capacity": self.store_capacity}
 
     # ------------------------------------------------------ state API feeds
+    async def rpc_pool_stats(self, conn, body):
+        """Worker-pool quiescence probe: spawned-but-unregistered workers
+        are still paying interpreter startup (~2s of CPU each with jax in
+        the image) — benchmarks and tests wait for zero before timing."""
+        unregistered = sum(1 for w in self.workers.values()
+                           if not w.registered.is_set())
+        return {"workers": len(self.workers), "starting": unregistered,
+                "leases": len(self.leases)}
+
     async def rpc_list_leases(self, conn, body):
         """Running + queued work on this node (reference: per-worker task
         state feeding python/ray/experimental/state/api.py list_tasks)."""
@@ -1798,6 +1807,7 @@ def main():
         import signal as _signal
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
+        protocol.enable_eager_tasks(loop)
         loop.add_signal_handler(_signal.SIGTERM, stop.set)
         await stop.wait()
         await raylet.shutdown()

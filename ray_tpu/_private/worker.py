@@ -106,7 +106,7 @@ class _RefArg:
 
 class OwnedObject:
     __slots__ = ("state", "blob", "location", "size", "event", "local_refs",
-                 "submitted_task", "reconstructions")
+                 "submitted_task", "reconstructions", "cf_waiters")
 
     def __init__(self):
         self.state = PENDING
@@ -120,9 +120,23 @@ class OwnedObject:
         # ObjectRecoveryManager::RecoverObject object_recovery_manager.h:90).
         self.submitted_task = None
         self.reconstructions = 0
+        # concurrent.futures waiters from sync get() fast paths on other
+        # threads; fired (on the loop thread) the moment the entry lands.
+        self.cf_waiters = None
 
     def ready(self):
         return self.state != PENDING
+
+    def set_ready(self):
+        """Mark ready: wake loop-side awaiters and cross-thread waiters.
+        Loop-thread only."""
+        self.event.set()
+        waiters = self.cf_waiters
+        if waiters:
+            self.cf_waiters = None
+            for f in waiters:
+                if not f.done():
+                    f.set_result(None)
 
 
 class LeasePool:
@@ -233,12 +247,14 @@ class CoreWorker:
     def _loop_main(self):
         self.loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self.loop)
+        protocol.enable_eager_tasks(self.loop)
         self._loop_ready.set()
         self.loop.run_forever()
 
     async def start_worker_async(self):
         """Worker mode: called from the worker process's own loop."""
         self.loop = asyncio.get_running_loop()
+        protocol.enable_eager_tasks(self.loop)
         await self._connect()
         self.connected = True
 
@@ -425,18 +441,21 @@ class CoreWorker:
         entry.local_refs = 1
         self.owned[oid] = entry
         size = blob.total_size()
+        # state is written LAST: the sync-get fast path reads ready()
+        # lock-free from other threads, so blob/location/size must be
+        # visible before the state flip (GIL gives the ordering).
         if size <= cfg.max_direct_call_object_size or self.raylet is None:
-            entry.state = INLINE
             entry.blob = blob.to_bytes()
             entry.size = size
+            entry.state = INLINE
         else:
             offset = await self._store_create(oid.binary(), size)
             blob.write_into(self.mapping.slice(offset, size))
             await self.raylet.request("os_seal", {"oid": oid.binary()})
-            entry.state = IN_STORE
             entry.location = self.node_id
             entry.size = size
-        entry.event.set()
+            entry.state = IN_STORE
+        entry.set_ready()
         return ObjectRef(oid, owner_addr=self.addr, _track=True)
 
     async def _store_create(self, oid_bin: bytes, size: int) -> int:
@@ -447,15 +466,68 @@ class CoreWorker:
         return reply["offset"]
 
     def get(self, refs, timeout=None):
-        single = isinstance(refs, ObjectRef)
-        if single:
-            refs = [refs]
+        if isinstance(refs, ObjectRef):
+            return self._get_sync_single(refs, timeout)
         self._notify_blocked()
         try:
             values = self._run(self._get_async_list(refs, timeout))
         finally:
             self._notify_unblocked()
-        return values[0] if single else values
+        return values
+
+    def _get_sync_single(self, ref, timeout):
+        """Sync-get fast path for one OWNED ref: wait on a plain
+        concurrent future fired straight from the reply handler, then
+        deserialize on the calling thread — no coroutine, no loop-side
+        gather, and the loop never spends time deserializing.  Borrowed
+        refs, in-store objects, and recovery fall back to the full async
+        path with whatever remains of the ONE timeout budget."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        entry = self.owned.get(ref.id)
+        if entry is not None and not entry.ready():
+            waiter = CFuture()
+
+            def _attach():
+                if entry.ready():
+                    if not waiter.done():
+                        waiter.set_result(None)
+                else:
+                    if entry.cf_waiters is None:
+                        entry.cf_waiters = []
+                    entry.cf_waiters.append(waiter)
+
+            def _detach():
+                if entry.cf_waiters is not None:
+                    try:
+                        entry.cf_waiters.remove(waiter)
+                    except ValueError:
+                        pass
+
+            self.loop.call_soon_threadsafe(_attach)
+            self._notify_blocked()
+            try:
+                waiter.result(timeout)
+            except TimeoutError:
+                # Prune the dead waiter: a caller polling with short
+                # timeouts must not grow entry.cf_waiters unboundedly.
+                self.loop.call_soon_threadsafe(_detach)
+                raise rexc.GetTimeoutError(
+                    f"timed out waiting for object {ref.id.hex()}")
+            finally:
+                self._notify_unblocked()
+        if (entry is not None
+                and (entry.state == INLINE or entry.state == ERRORED)):
+            value = serialization.deserialize(entry.blob)
+            if isinstance(value, _SerializedError):
+                raise value.to_exception()
+            return value
+        # Borrowed / in-store / recovery: async path, remaining budget.
+        remaining = self._remain(deadline)
+        self._notify_blocked()
+        try:
+            return self._run(self._get_async_list([ref], remaining))[0]
+        finally:
+            self._notify_unblocked()
 
     def get_future(self, ref: ObjectRef) -> CFuture:
         return self._call(self._get_one(ref))
@@ -795,7 +867,13 @@ class CoreWorker:
                 self.owned[r.id].submitted_task = spec
             self._lineage[task_id] = spec
         self._pin_args(task_id, args, kwargs)
-        self._call(self._submit(spec))
+        if task_id in self._arg_pins:
+            self._call(self._submit(spec))
+        else:
+            # No ObjectRef args -> nothing to await before dispatch; a
+            # plain callback skips run_coroutine_threadsafe's coroutine +
+            # future-chaining overhead (~25us on the sync hot path).
+            self.loop.call_soon_threadsafe(self._enqueue_spec, spec)
         return refs
 
     def cancel_task(self, ref, force: bool = False) -> bool:
@@ -906,7 +984,15 @@ class CoreWorker:
         if pins is not None and task_id in self._lineage:
             self._lineage_pins[task_id] = pins
 
+    _EMPTY_ARGS_BLOB: bytes | None = None
+
     def _pack_args(self, args, kwargs):
+        if not args and not kwargs:
+            blob = CoreWorker._EMPTY_ARGS_BLOB
+            if blob is None:
+                b, _ = serialization.serialize(([], {}))
+                blob = CoreWorker._EMPTY_ARGS_BLOB = b.to_bytes()
+            return blob
         new_args = [(_RefArg(a) if isinstance(a, ObjectRef) else a)
                     for a in args]
         new_kwargs = {k: (_RefArg(v) if isinstance(v, ObjectRef) else v)
@@ -925,6 +1011,9 @@ class CoreWorker:
 
     async def _submit(self, spec):
         await self._wait_args_ready(spec)
+        self._enqueue_spec(spec)
+
+    def _enqueue_spec(self, spec):
         key = self._scheduling_key(spec)
         pool = self.lease_pools.get(key)
         if pool is None:
@@ -1068,9 +1157,9 @@ class CoreWorker:
         for oid in spec["return_ids"]:
             entry = self.owned.get(oid)
             if entry is not None:
-                entry.state = ERRORED
                 entry.blob = blob
-                entry.event.set()
+                entry.state = ERRORED  # last: lock-free readers order on it
+                entry.set_ready()
 
     async def _raylet_for_bundle(self, pg_id, bundle_index):
         """Route a placement-group lease to the raylet holding the bundle
@@ -1193,9 +1282,9 @@ class CoreWorker:
             for oid in spec["return_ids"]:
                 entry = self.owned.get(oid)
                 if entry is not None:
-                    entry.state = ERRORED
                     entry.blob = blob
-                    entry.event.set()
+                    entry.state = ERRORED  # last: lock-free readers
+                    entry.set_ready()
             return
         for oid, result in zip(spec["return_ids"], reply["results"]):
             entry = self.owned.get(oid)
@@ -1203,14 +1292,14 @@ class CoreWorker:
                 continue
             kind = result[0]
             if kind == "inline":
-                entry.state = INLINE
                 entry.blob = result[1]
                 entry.size = len(result[1])
+                entry.state = INLINE  # last: lock-free readers order on it
             else:  # ("store", node_id, size)
-                entry.state = IN_STORE
                 entry.location = result[1]
                 entry.size = result[2]
-            entry.event.set()
+                entry.state = IN_STORE
+            entry.set_ready()
 
     # ------------------------------------------------- blocked notifications
     def _notify_blocked(self):
@@ -1513,9 +1602,17 @@ class CoreWorker:
             concurrency_group=opts.get("concurrency_group"),
             owner_addr=self.addr,
         )
-        self._call(self._submit_actor_task(actor_id, actor_addr, body,
-                                           opts.get("max_task_retries", 0)))
+        self.loop.call_soon_threadsafe(
+            self._spawn_actor_submit, actor_id, actor_addr, body,
+            opts.get("max_task_retries", 0))
         return refs
+
+    def _spawn_actor_submit(self, actor_id, actor_addr, body, retries):
+        t = self.loop.create_task(
+            self._submit_actor_task(actor_id, actor_addr, body, retries))
+        # The submitter reports failures through the return entries; retrieve
+        # any stray exception so task GC doesn't log it.
+        t.add_done_callback(lambda t: t.cancelled() or t.exception())
 
     async def _actor_send(self, actor_id, actor_addr, entry):
         """Connect (or reuse), assign the next sequence number, put the
@@ -1636,9 +1733,9 @@ class CoreWorker:
         for oid in body["return_ids"]:
             oentry = self.owned.get(oid)
             if oentry is not None:
-                oentry.state = ERRORED
                 oentry.blob = blob
-                oentry.event.set()
+                oentry.state = ERRORED  # last: lock-free readers
+                oentry.set_ready()
 
     async def _actor_recover(self, actor_id, failed_conn):
         """Single-flight per actor: wait for the next ALIVE incarnation,
