@@ -26,6 +26,26 @@ class TrainingFailedError(RuntimeError):
     pass
 
 
+class TrainingWorkerError(TrainingFailedError):
+    """A gang worker died from a SYSTEM fault (actor/node death), not a
+    user-code exception — the gang can be restarted from the last
+    checkpoint (reference: backend_executor.py:274 catching
+    RayActorError into TrainingWorkerError for the retry loop)."""
+
+
+def _is_worker_death(e: BaseException) -> bool:
+    from ray_tpu._private import protocol
+    from ray_tpu import exceptions as rexc
+    if isinstance(e, rexc.TaskError):
+        # A USER exception re-raised from the train loop (remote errors
+        # multi-inherit TaskError + the original type) — even if the
+        # original type is e.g. ConnectionError, restarts won't help.
+        return False
+    return isinstance(e, (rexc.ActorDiedError, rexc.ActorUnavailableError,
+                          rexc.WorkerCrashedError, rexc.ObjectLostError,
+                          protocol.ConnectionLost, ConnectionError))
+
+
 class BackendExecutor:
     def __init__(self, backend_config: BackendConfig,
                  scaling_config: ScalingConfig):
@@ -35,26 +55,59 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
         self._pg = None
 
+    _placement_group = None
+
     def start(self, placement_group=None):
+        """Idempotent: a retried start after a partial failure reuses the
+        placement group and replaces any partially-created gang."""
         sc = self.scaling_config
-        if placement_group is None:
-            pgf = sc.as_placement_group_factory()
-            self._pg = pgf.create()
-            ok = ray_tpu.wait_placement_group_ready(self._pg, timeout=120)
-            if not ok:
-                raise TrainingFailedError("train worker gang PG not ready")
-            placement_group = self._pg
+        if self._placement_group is None:
+            if placement_group is None:
+                pgf = sc.as_placement_group_factory()
+                self._pg = pgf.create()
+                ok = ray_tpu.wait_placement_group_ready(self._pg,
+                                                        timeout=120)
+                if not ok:
+                    raise TrainingFailedError(
+                        "train worker gang PG not ready")
+                placement_group = self._pg
+            self._placement_group = placement_group
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+            self.worker_group = None
+        self._start_workers()
+
+    def _start_workers(self):
+        sc = self.scaling_config
         self.worker_group = WorkerGroup(
-            sc.num_workers, sc._resources, placement_group)
-        # Rank/world env everywhere (reference: rank env wiring in
-        # backend_executor._setup_gang).
-        for rank, w in enumerate(self.worker_group.workers):
-            ray_tpu.get(w.set_env.remote({
-                "RT_TRAIN_WORLD_RANK": rank,
-                "RT_TRAIN_WORLD_SIZE": sc.num_workers,
-                "RT_TRAIN_LOCAL_RANK": rank,
-            }), timeout=120)
-        self.backend.on_start(self.worker_group, self.backend_config)
+            sc.num_workers, sc._resources, self._placement_group)
+        try:
+            # Rank/world env everywhere (reference: rank env wiring in
+            # backend_executor._setup_gang).
+            for rank, w in enumerate(self.worker_group.workers):
+                ray_tpu.get(w.set_env.remote({
+                    "RT_TRAIN_WORLD_RANK": rank,
+                    "RT_TRAIN_WORLD_SIZE": sc.num_workers,
+                    "RT_TRAIN_LOCAL_RANK": rank,
+                }), timeout=120)
+            self.backend.on_start(self.worker_group, self.backend_config)
+        except Exception as e:
+            if _is_worker_death(e):
+                raise TrainingWorkerError(str(e)) from e
+            raise
+
+    def restart(self):
+        """Gang-level fault recovery: tear the (partially dead) gang down
+        and start a fresh one in the same placement group.  The backend's
+        on_start runs again on the new incarnation, so the jax
+        coordination service re-initializes with a fresh coordinator
+        (SURVEY hard-part #4: collective rendezvous lifecycle tied to
+        actor restarts).  Reference: backend_executor start/shutdown
+        around worker failures."""
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+            self.worker_group = None
+        self._start_workers()
 
     def start_training(self, train_fn: Callable, config: dict,
                        checkpoint: Optional[Checkpoint] = None,
@@ -68,7 +121,12 @@ class BackendExecutor:
                 mesh_builder)
             for w in self.worker_group.workers
         ]
-        ray_tpu.get(refs, timeout=600)
+        try:
+            ray_tpu.get(refs, timeout=600)
+        except Exception as e:
+            if _is_worker_death(e):
+                raise TrainingWorkerError(str(e)) from e
+            raise
 
     def get_next_results(self) -> Optional[List[TrainingResult]]:
         """One report round from every rank; None when the loop finished.
@@ -78,6 +136,8 @@ class BackendExecutor:
         try:
             raw = ray_tpu.get(refs, timeout=3600)
         except Exception as e:
+            if _is_worker_death(e):
+                raise TrainingWorkerError(str(e)) from e
             raise TrainingFailedError(str(e)) from e
         finished = [r is None for r in raw]
         if all(finished):

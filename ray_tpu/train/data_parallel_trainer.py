@@ -37,27 +37,63 @@ class DataParallelTrainer(BaseTrainer):
         self._datasets = datasets or {}
 
     def training_loop(self) -> None:
+        from ray_tpu.train._internal.backend_executor import (
+            TrainingWorkerError)
+        fc = self.run_config.failure_config
+        # The gang-restart budget: FailureConfig.max_failures if the user
+        # set one, else 3 (reference: BackendExecutor default retries).
+        # Distinct from Tune trial retries — a gang restart resumes from
+        # the last in-trial checkpoint WITHOUT restarting the trial.
+        budget = fc.max_failures if fc is not None else 3
         executor = BackendExecutor(self._backend_config,
                                    self.scaling_config)
-        executor.start()
+        latest_ckpt = self.resume_from_checkpoint
+        started = restart_pending = False
         try:
-            train_fn = self._train_loop
-            config = dict(self._train_loop_config)
-            if self._datasets:
-                config["__datasets__"] = {
-                    name: ds for name, ds in self._datasets.items()}
-            executor.start_training(
-                train_fn, config, checkpoint=self.resume_from_checkpoint,
-                trial_name=session.get_trial_name(),
-                trial_id=session.get_trial_id())
             while True:
-                results = executor.get_next_results()
-                if results is None:
-                    break
-                # rank 0 is authoritative for metrics/checkpoint
-                # (reference: data_parallel_trainer result aggregation).
-                session.report(results[0].metrics,
-                               checkpoint=results[0].checkpoint)
-            executor.finish_training()
+                try:
+                    if restart_pending:
+                        executor.restart()
+                        restart_pending = False
+                    if not started:
+                        executor.start()
+                        started = True
+                    config = dict(self._train_loop_config)
+                    if self._datasets:
+                        config["__datasets__"] = dict(self._datasets)
+                    executor.start_training(
+                        self._train_loop, config, checkpoint=latest_ckpt,
+                        trial_name=session.get_trial_name(),
+                        trial_id=session.get_trial_id())
+                    while True:
+                        results = executor.get_next_results()
+                        if results is None:
+                            break
+                        # rank 0 is authoritative for metrics/checkpoint
+                        # (reference: data_parallel_trainer result
+                        # aggregation).
+                        if results[0].checkpoint is not None:
+                            latest_ckpt = results[0].checkpoint
+                        session.report(results[0].metrics,
+                                       checkpoint=results[0].checkpoint)
+                    executor.finish_training()
+                    return
+                except TrainingWorkerError as e:
+                    # budget semantics: -1 = unlimited (reference
+                    # FailureConfig convention), 0 = fail fast.
+                    if budget == 0:
+                        raise
+                    if budget > 0:
+                        budget -= 1
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "train gang worker died (%s); restarting gang "
+                        "from last checkpoint (%s restarts left)",
+                        e, "inf" if budget < 0 else budget)
+                    # The restart itself runs at the TOP of the loop so a
+                    # failure during recovery consumes budget too instead
+                    # of escaping the retry path.
+                    if started:
+                        restart_pending = True
         finally:
             executor.shutdown()
