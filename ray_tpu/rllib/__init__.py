@@ -28,6 +28,11 @@ from ray_tpu.rllib.algorithms.appo.appo import (  # noqa: F401
 from ray_tpu.rllib.algorithms.es.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.pg.pg import PG, PGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ddpg.ddpg import (  # noqa: F401
+    DDPG,
+    DDPGConfig,
+)
+from ray_tpu.rllib.algorithms.td3.td3 import TD3, TD3Config  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
     BC,
     BCConfig,
@@ -38,7 +43,8 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "Algorithm",
            "AlgorithmConfig", "ApexDQN", "ApexDQNConfig", "BC",
-           "BCConfig", "DDPPO", "DDPPOConfig",
+           "BCConfig", "DDPG", "DDPGConfig", "DDPPO", "DDPPOConfig",
            "DQN", "DQNConfig", "ES", "ESConfig", "Impala",
            "ImpalaConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
-           "PPO", "PPOConfig", "SAC", "SACConfig", "SampleBatch"]
+           "PPO", "PPOConfig", "SAC", "SACConfig", "SampleBatch",
+           "TD3", "TD3Config"]

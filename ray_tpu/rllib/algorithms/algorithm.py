@@ -76,6 +76,18 @@ class AlgorithmConfig:
             self._config["policy_mapping_fn"] = policy_mapping_fn
         return self
 
+    def serving(self, policy_server: bool = True,
+                server_host: str = "127.0.0.1",
+                server_port: int = 0) -> "AlgorithmConfig":
+        """External-env serving (reference: policy_server_input.py):
+        rollouts come from external clients over HTTP instead of local
+        env sampling; the algorithm exposes `algo.policy_server`."""
+        self._config["input"] = ("policy_server" if policy_server
+                                 else "sampler")
+        self._config["policy_server_host"] = server_host
+        self._config["policy_server_port"] = server_port
+        return self
+
     def debugging(self, seed=None) -> "AlgorithmConfig":
         if seed is not None:
             self._config["seed"] = seed
@@ -122,6 +134,20 @@ class Algorithm(Trainable):
             worker_cls=worker_cls)
         self._timesteps_total = 0
         self._episode_rewards: list = []
+        self.policy_server = None
+        if self.algo_config.get("input") == "policy_server":
+            if not getattr(self, "supports_policy_server", False):
+                raise ValueError(
+                    f"{type(self).__name__} does not consume external-"
+                    "env serving input (.serving()); algorithms that do "
+                    "declare supports_policy_server = True (e.g. DQN)")
+            from ray_tpu.rllib.env.policy_server_input import (
+                PolicyServerInput)
+            self.policy_server = PolicyServerInput(
+                lambda: self.workers.local_worker.policy,
+                host=self.algo_config.get("policy_server_host",
+                                          "127.0.0.1"),
+                port=self.algo_config.get("policy_server_port", 0))
 
     def _extra_defaults(self) -> Dict:
         return {}
@@ -152,6 +178,11 @@ class Algorithm(Trainable):
             self._timesteps_total = data.get("timesteps_total", 0)
 
     def cleanup(self):
+        if self.policy_server is not None:
+            try:
+                self.policy_server.shutdown()
+            except Exception:
+                pass
         self.workers.stop()
 
     # Convenience parity with the reference's `algo.train()` usage outside

@@ -23,10 +23,18 @@ from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
 
 class ReplayActor:
     """One shard of the distributed replay memory (reference:
-    apex_dqn's ReplayActor over a replay buffer shard)."""
+    apex_dqn's ReplayActor over a PRIORITIZED buffer shard — the
+    distributed prioritization is Ape-X's namesake mechanism)."""
 
-    def __init__(self, capacity: int, seed: int):
-        self.buffer = ReplayBuffer(capacity, seed=seed)
+    def __init__(self, capacity: int, seed: int, prioritized: bool = True,
+                 alpha: float = 0.6, beta: float = 0.4):
+        from ray_tpu.rllib.utils.replay_buffers import make_buffer
+        self.buffer = make_buffer(
+            {"prioritized_replay": prioritized,
+             "prioritized_replay_alpha": alpha,
+             "prioritized_replay_beta": beta},
+            capacity=capacity, seed=seed)
+        self.prioritized = prioritized
         self.added = 0
 
     def add(self, batch: SampleBatch) -> int:
@@ -41,6 +49,13 @@ class ReplayActor:
         if len(self.buffer) == 0:
             return None
         return self.buffer.sample(batch_size)
+
+    def update_priorities(self, idx, td_errors) -> bool:
+        """Learner feedback: fresh TD errors for rows sampled from THIS
+        shard (reference: apex learner's priority update round trip)."""
+        if self.prioritized:
+            self.buffer.update_priorities(idx, td_errors)
+        return True
 
     def stats(self) -> Dict:
         return {"size": len(self.buffer), "added": self.added}
@@ -64,6 +79,11 @@ class ApexDQNConfig(AlgorithmConfig):
             # Per-worker epsilon ladder (reference: Ape-X's per-actor
             # exploration schedule eps_i = eps^(1 + i/(N-1) * alpha)).
             "epsilon_ladder_alpha": 3.0,
+            # Distributed prioritized replay — on by default: Ape-X
+            # without prioritization is just parallel DQN.
+            "prioritized_replay": True,
+            "prioritized_replay_alpha": 0.6,
+            "prioritized_replay_beta": 0.4,
         })
 
 
@@ -80,8 +100,11 @@ class ApexDQN(Algorithm):
         replay_cls = ray_tpu.remote(ReplayActor)
         per_shard = max(1, cfg["buffer_capacity"] // shards)
         self.replay_actors = [
-            replay_cls.options(num_cpus=0).remote(per_shard,
-                                                  cfg["seed"] + i)
+            replay_cls.options(num_cpus=0).remote(
+                per_shard, cfg["seed"] + i,
+                prioritized=cfg.get("prioritized_replay", True),
+                alpha=cfg["prioritized_replay_alpha"],
+                beta=cfg["prioritized_replay_beta"])
             for i in range(shards)]
         self._iter = 0
         self._replay_rr = 0
@@ -166,15 +189,23 @@ class ApexDQN(Algorithm):
                     if ok]
             # Prefetch: request the next replay batch while training on
             # the current one (the reference's learner thread overlap).
+            prioritized = cfg.get("prioritized_replay", True)
             pending_batch = live[0].replay.remote(cfg["sgd_batch_size"])
+            pending_shard = live[0]
             for i in range(cfg["num_sgd_steps"]):
-                nxt = live[(i + 1) % len(live)].replay.remote(
-                    cfg["sgd_batch_size"])
+                nxt_shard = live[(i + 1) % len(live)]
+                nxt = nxt_shard.replay.remote(cfg["sgd_batch_size"])
                 batch = ray_tpu.get(pending_batch, timeout=120)
-                pending_batch = nxt
+                shard = pending_shard
+                pending_batch, pending_shard = nxt, nxt_shard
                 if batch is None:
                     continue
                 stats = policy.learn_on_batch(batch)
+                if prioritized and "batch_indexes" in batch:
+                    # Fire-and-forget priority feedback to the shard the
+                    # rows came from; the learner never blocks on it.
+                    shard.update_priorities.remote(
+                        batch["batch_indexes"], policy.last_td_errors)
                 trained += batch.count
             ray_tpu.get(pending_batch, timeout=120)
             if self._iter % cfg["target_update_freq"] == 0:

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, DDPGConfig  # noqa: F401

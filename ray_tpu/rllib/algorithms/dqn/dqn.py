@@ -30,6 +30,11 @@ class DQNConfig(AlgorithmConfig):
             "initial_epsilon": 1.0,
             "final_epsilon": 0.05,
             "epsilon_anneal_iters": 15,
+            # Prioritized replay (reference: dqn.py default
+            # replay_buffer_config prioritized_replay_alpha/beta).
+            "prioritized_replay": False,
+            "prioritized_replay_alpha": 0.6,
+            "prioritized_replay_beta": 0.4,
         })
 
 
@@ -39,10 +44,13 @@ class DQN(Algorithm):
     def _extra_defaults(self) -> Dict:
         return dict(DQNConfig()._config)
 
+    supports_policy_server = True
+
     def setup(self, config: Dict):
         super().setup(config)
-        self.buffer = ReplayBuffer(self.algo_config["buffer_capacity"],
-                                   seed=self.algo_config["seed"])
+        cfg = self.algo_config
+        from ray_tpu.rllib.utils.replay_buffers import make_buffer
+        self.buffer = make_buffer(cfg)
         self._iter = 0
 
     def _epsilon(self) -> float:
@@ -55,6 +63,22 @@ class DQN(Algorithm):
         cfg = self.algo_config
         self._iter += 1
         eps = self._epsilon()
+        if self.policy_server is not None:
+            # External-env serving: experience arrives from clients over
+            # HTTP; block for at least one completed episode, then take
+            # whatever else already landed.
+            self.workers.local_worker.policy.epsilon = eps
+            batches = []
+            first = self.policy_server.next(timeout=60.0)
+            if first is not None:
+                batches = [first] + self.policy_server.try_drain()
+            if not batches:
+                return {"info": {"learner": {},
+                                 "buffer_size": len(self.buffer),
+                                 "epsilon": eps},
+                        "num_env_steps_trained": 0}
+            batch = SampleBatch.concat_samples(batches)
+            return self._learn_from(batch, eps)
         # Collect with the current epsilon on every worker.
         per_worker = max(1, cfg["train_batch_size"]
                          // max(1, len(self.workers.remote_workers)))
@@ -71,21 +95,41 @@ class DQN(Algorithm):
             self.workers.local_worker.policy.epsilon = eps
             batches = [self.workers.local_worker.sample(per_worker)]
         batch = SampleBatch.concat_samples(batches)
+        return self._learn_from(batch, eps)
+
+    def _learn_from(self, batch: SampleBatch, eps: float) -> Dict:
+        cfg = self.algo_config
         self.buffer.add(batch)
         self._timesteps_total += batch.count
 
         policy = self.workers.local_worker.policy
         stats: Dict = {}
+        prioritized = cfg.get("prioritized_replay")
+        if prioritized:
+            # Anneal beta -> 1 (full IS correction at convergence),
+            # reference: prioritized replay beta schedule in dqn.py.
+            frac = min(1.0, self._iter
+                       / max(cfg["epsilon_anneal_iters"], 1))
+            self.buffer.beta = (cfg["prioritized_replay_beta"]
+                                + frac
+                                * (1.0 - cfg["prioritized_replay_beta"]))
         if len(self.buffer) >= cfg["learning_starts"]:
             for _ in range(cfg["num_sgd_steps"]):
-                stats = policy.learn_on_batch(
-                    self.buffer.sample(cfg["sgd_batch_size"]))
+                replay = self.buffer.sample(cfg["sgd_batch_size"])
+                stats = policy.learn_on_batch(replay)
+                if prioritized:
+                    # Feed the learner's fresh TD errors back as
+                    # priorities (reference: dqn training_step
+                    # update_priorities after train).
+                    self.buffer.update_priorities(
+                        replay["batch_indexes"], policy.last_td_errors)
             if self._iter % cfg["target_update_freq"] == 0:
                 policy.update_target()
         return {"info": {"learner": stats,
                          "buffer_size": len(self.buffer),
                          "epsilon": eps},
                 "num_env_steps_trained": batch.count}
+
 
     def save_checkpoint(self) -> Dict:
         # Exploration schedule must survive restore (epsilon derives from

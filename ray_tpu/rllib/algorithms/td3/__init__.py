@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.td3.td3 import TD3, TD3Config  # noqa: F401

@@ -1,0 +1,28 @@
+"""TD3: twin delayed DDPG (Fujimoto et al. 2018).
+
+Reference: rllib/algorithms/td3/td3.py — in the reference, TD3 IS a DDPG
+config preset: twin clipped-double-Q critics, delayed policy/target
+updates, and target-policy smoothing noise.  Mirrored here the same way;
+the mechanics live in policy/jax_ddpg_policy.py.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, DDPGConfig
+
+
+class TD3Config(DDPGConfig):
+    def __init__(self):
+        super().__init__(TD3)
+        self._config.update({
+            "twin_q": True,
+            "policy_delay": 2,
+            "target_noise": 0.2,
+            "target_noise_clip": 0.5,
+            "exploration_noise": 0.1,
+        })
+
+
+class TD3(DDPG):
+    def _extra_defaults(self):
+        return dict(TD3Config()._config)

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.env.policy_client import PolicyClient  # noqa: F401
+from ray_tpu.rllib.env.policy_server_input import (  # noqa: F401
+    PolicyServerInput,
+)

@@ -1,0 +1,53 @@
+"""PolicyClient: drive episodes against a PolicyServerInput over HTTP.
+
+Reference: rllib/env/policy_client.py:46 — the external simulator's side
+of the serving protocol: start_episode / get_action / log_returns /
+end_episode.  Stdlib urllib only, so any external process with this one
+file's worth of protocol can participate.
+"""
+
+from __future__ import annotations
+
+import pickle
+import urllib.request
+from typing import Optional
+
+
+class PolicyClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, verb: str, body: dict):
+        req = urllib.request.Request(
+            f"{self.address}/{verb}", data=pickle.dumps(body),
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                reply = pickle.loads(r.read())
+        except urllib.error.HTTPError as e:
+            reply = pickle.loads(e.read())
+        if not reply.get("ok"):
+            raise RuntimeError(f"policy server error: "
+                               f"{reply.get('error')}")
+        return reply.get("result")
+
+    def start_episode(self, episode_id: Optional[str] = None) -> str:
+        return self._call("start_episode", {"episode_id": episode_id})
+
+    def get_action(self, episode_id: str, observation):
+        return self._call("get_action", {"episode_id": episode_id,
+                                         "observation": observation})
+
+    def log_action(self, episode_id: str, observation, action):
+        self._call("log_action", {"episode_id": episode_id,
+                                  "observation": observation,
+                                  "action": action})
+
+    def log_returns(self, episode_id: str, reward: float):
+        self._call("log_returns", {"episode_id": episode_id,
+                                   "reward": float(reward)})
+
+    def end_episode(self, episode_id: str, observation):
+        self._call("end_episode", {"episode_id": episode_id,
+                                   "observation": observation})

@@ -1,0 +1,204 @@
+"""PolicyServerInput: serve a live policy to EXTERNAL simulators over
+HTTP and turn their experience into training batches.
+
+Reference: rllib/env/policy_server_input.py:87 + policy_client.py:46 —
+an external process (a game server, a robot, a simulator we don't
+control) drives episodes through a REST API: it asks the server for
+actions and logs rewards; the server executes inference with the
+algorithm's current policy and assembles completed episodes into
+SampleBatches that training consumes like any rollout.
+
+Wire format: POST <verb> with a pickled dict body; pickled dict reply
+(the reference uses pickled payloads over HTTP the same way).  The
+server is for trusted, in-deployment simulators — same trust model as
+the reference.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class _Episode:
+    def __init__(self):
+        self.obs: List[np.ndarray] = []
+        self.actions: List = []
+        # rewards[i] accumulates ALL log_returns calls between action i
+        # and action i+1 (the reference client supports intermediate
+        # rewards between get_action calls).
+        self.rewards: List[float] = []
+        self.logps: List[float] = []
+        self.last_touch = time.monotonic()
+
+
+class PolicyServerInput:
+    """HTTP front-end for external-env rollouts.
+
+    `policy_fn` returns the LIVE policy object on every call, so weight
+    updates between training iterations are served immediately.
+    Completed episodes land in an internal queue; `next()` hands them to
+    the training loop (the InputReader contract, reference:
+    offline/input_reader.py + policy_server_input.py)."""
+
+    def __init__(self, policy_fn: Callable[[], object],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._policy_fn = policy_fn
+        self._episodes: Dict[str, _Episode] = {}
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[SampleBatch]" = queue.Queue()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = pickle.loads(self.rfile.read(length))
+                    reply = outer._dispatch(self.path.strip("/"), body)
+                    blob = pickle.dumps({"ok": True, "result": reply})
+                    self.send_response(200)
+                except Exception as e:  # surfaced client-side
+                    blob = pickle.dumps({"ok": False, "error": repr(e)})
+                    self.send_response(500)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.address = (f"http://{self._server.server_address[0]}:"
+                        f"{self._server.server_address[1]}")
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # --------------------------------------------------------- protocol
+    _EPISODE_TTL_S = 3600.0
+    _MAX_EPISODES = 10_000
+
+    def _gc_episodes_locked(self):
+        """Drop abandoned episodes (client crashed before end_episode):
+        idle past the TTL, or oldest-first past the cap."""
+        now = time.monotonic()
+        stale = [eid for eid, ep in self._episodes.items()
+                 if now - ep.last_touch > self._EPISODE_TTL_S]
+        for eid in stale:
+            del self._episodes[eid]
+        while len(self._episodes) > self._MAX_EPISODES:
+            oldest = min(self._episodes,
+                         key=lambda e: self._episodes[e].last_touch)
+            del self._episodes[oldest]
+
+    def _episode(self, body) -> _Episode:
+        ep = self._episodes.get(body["episode_id"])
+        if ep is None:
+            raise KeyError(f"unknown episode {body['episode_id']}")
+        ep.last_touch = time.monotonic()
+        return ep
+
+    def _dispatch(self, verb: str, body: Dict):
+        if verb == "start_episode":
+            with self._lock:
+                self._gc_episodes_locked()
+                eid = body.get("episode_id") or uuid.uuid4().hex[:12]
+                self._episodes[eid] = _Episode()
+            return eid
+        if verb == "get_action":
+            obs = np.asarray(body["observation"], np.float32)
+            with self._lock:
+                self._episode(body)  # exists + touch
+            # Inference OUTSIDE the lock: concurrent clients must not
+            # serialize on each other's forward passes.
+            policy = self._policy_fn()
+            action, logp, _ = policy.compute_actions(obs[None, :])
+            with self._lock:
+                ep = self._episode(body)
+                ep.obs.append(obs)
+                ep.actions.append(action[0])
+                ep.logps.append(float(logp[0]))
+                ep.rewards.append(0.0)
+            return action[0]
+        if verb == "log_action":
+            # Client-side action (off-policy logging, reference:
+            # policy_client.log_action).
+            obs = np.asarray(body["observation"], np.float32)
+            with self._lock:
+                ep = self._episode(body)
+                ep.obs.append(obs)
+                ep.actions.append(body["action"])
+                ep.logps.append(0.0)
+                ep.rewards.append(0.0)
+            return None
+        if verb == "log_returns":
+            with self._lock:
+                ep = self._episode(body)
+                if not ep.rewards:
+                    raise ValueError("log_returns before any action")
+                ep.rewards[-1] += float(body["reward"])
+            return None
+        if verb == "end_episode":
+            final_obs = np.asarray(body["observation"], np.float32)
+            with self._lock:
+                self._episode(body)
+                ep = self._episodes.pop(body["episode_id"])
+            batch = self._assemble(ep, final_obs)
+            if batch is not None:
+                self._queue.put(batch)
+            return None
+        raise ValueError(f"unknown verb {verb}")
+
+    @staticmethod
+    def _assemble(ep: _Episode, final_obs) -> Optional[SampleBatch]:
+        n = len(ep.actions)
+        if n == 0:
+            return None
+        rewards = ep.rewards
+        new_obs = ep.obs[1:] + [final_obs]
+        dones = np.zeros(n, np.bool_)
+        dones[-1] = True
+        acts = np.asarray(ep.actions)
+        if acts.dtype.kind in "iu":
+            acts = acts.astype(np.int64)
+        else:
+            acts = acts.astype(np.float32)
+        return SampleBatch({
+            "obs": np.asarray(ep.obs, np.float32),
+            "actions": acts,
+            "rewards": np.asarray(rewards[:n], np.float32),
+            "dones": dones,
+            "new_obs": np.asarray(new_obs, np.float32),
+            "action_logp": np.asarray(ep.logps, np.float32),
+            "vf_preds": np.zeros(n, np.float32),
+        })
+
+    # ------------------------------------------------------ input reader
+    def next(self, timeout: Optional[float] = None
+             ) -> Optional[SampleBatch]:
+        """The next completed episode (None on timeout)."""
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def try_drain(self) -> List[SampleBatch]:
+        out = []
+        while True:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                return out
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._thread.join(timeout=5)

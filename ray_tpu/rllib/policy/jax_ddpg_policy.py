@@ -1,0 +1,228 @@
+"""JaxDDPGPolicy: deterministic-policy-gradient actor-critic, covering
+both DDPG (Lillicrap et al. 2016) and TD3 (Fujimoto et al. 2018).
+
+Reference: rllib/algorithms/ddpg/ddpg_torch_policy.py and
+rllib/algorithms/td3/td3.py (TD3 = DDPG config preset with twin_q,
+policy_delay=2, target-policy smoothing) — re-derived jax-first: the
+critic update, (delayed) actor update, and polyak target updates run as
+ONE jitted train step; the delay is a traced modulo counter so the
+compiled program is identical every step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class _ActorNet(nn.Module):
+    act_dim: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        # tanh output in [-1, 1]; the policy rescales to the Box bounds.
+        return jnp.tanh(nn.Dense(self.act_dim)(h))
+
+
+class _CriticNet(nn.Module):
+    """One or two Q(s, a) heads (twin critics are TD3's clipped
+    double-Q trick)."""
+
+    n_heads: int = 1
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        outs = []
+        for _ in range(self.n_heads):
+            h = x
+            for width in self.hiddens:
+                h = nn.relu(nn.Dense(width)(h))
+            outs.append(nn.Dense(1)(h)[..., 0])
+        return outs
+
+
+class JaxDDPGPolicy:
+    supports_continuous = True
+
+    def __init__(self, obs_dim: int, act_dim: int, config: Dict):
+        if not config.get("_continuous"):
+            raise TypeError("DDPG/TD3 require a continuous (Box) action "
+                            "space; use DQN/PPO for discrete envs")
+        self.config = config
+        self.act_dim = act_dim
+        low = np.asarray(config.get("_act_low", -np.ones(act_dim)),
+                         np.float32).reshape(-1)
+        high = np.asarray(config.get("_act_high", np.ones(act_dim)),
+                          np.float32).reshape(-1)
+        if not (np.all(np.isfinite(low)) and np.all(np.isfinite(high))):
+            raise ValueError("DDPG needs a bounded Box action space; got "
+                             f"low={low} high={high}")
+        self._low, self._high = low, high
+        self._scale = jnp.asarray((high - low) / 2.0)
+        self._mid = jnp.asarray((high + low) / 2.0)
+
+        self.twin_q = bool(config.get("twin_q", False))
+        self.policy_delay = int(config.get("policy_delay", 1))
+        self.target_noise = float(config.get("target_noise", 0.0))
+        self.target_noise_clip = float(config.get("target_noise_clip",
+                                                  0.5))
+        self.tau = float(config.get("tau", 0.995))
+        self.explore_noise = float(config.get("exploration_noise", 0.1))
+
+        hid = tuple(config.get("fcnet_hiddens", (64, 64)))
+        self.actor = _ActorNet(act_dim=act_dim, hiddens=hid)
+        self.critic = _CriticNet(n_heads=2 if self.twin_q else 1,
+                                 hiddens=hid)
+        rng = jax.random.PRNGKey(config.get("policy_seed",
+                                            config.get("seed", 0)))
+        k1, k2, self._rng = jax.random.split(rng, 3)
+        dummy_o = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy_a = jnp.zeros((1, act_dim), jnp.float32)
+        self.actor_params = self.actor.init(k1, dummy_o)
+        self.critic_params = self.critic.init(k2, dummy_o, dummy_a)
+        self.target_actor = self.actor_params
+        self.target_critic = self.critic_params
+        actor_lr = config.get("actor_lr", config.get("lr", 1e-3))
+        critic_lr = config.get("critic_lr", config.get("lr", 1e-3))
+        self.actor_tx = optax.adam(actor_lr)
+        self.critic_tx = optax.adam(critic_lr)
+        self.actor_opt = self.actor_tx.init(self.actor_params)
+        self.critic_opt = self.critic_tx.init(self.critic_params)
+        self._step_count = 0
+        self._np_rng = np.random.RandomState(config.get("seed", 0) + 13)
+        self._forward = jax.jit(self.actor.apply)
+        self._train = jax.jit(self._train_impl,
+                              static_argnames=("update_actor",))
+
+    # --------------------------------------------------------- acting
+    def _rescale(self, a):
+        return a * self._scale + self._mid
+
+    def compute_actions(self, obs: np.ndarray):
+        """Deterministic action + Gaussian exploration noise (the
+        reference's OU noise is near-equivalent at these scales and
+        Gaussian is TD3's choice)."""
+        a = np.asarray(self._forward(self.actor_params,
+                                     jnp.asarray(obs, jnp.float32)))
+        noise = self._np_rng.randn(*a.shape) * self.explore_noise
+        a = np.clip(a + noise, -1.0, 1.0)
+        a = np.asarray(self._rescale(jnp.asarray(a)), np.float32)
+        zeros = np.zeros(len(obs), np.float32)
+        return a, zeros, zeros
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)
+
+    # ------------------------------------------------------- learning
+    def _train_impl(self, actor_params, critic_params, target_actor,
+                    target_critic, actor_opt, critic_opt, batch, key,
+                    update_actor: bool):
+        gamma = self.config.get("gamma", 0.99)
+        obs = batch["obs"]
+        acts = batch["actions"]
+        rew = batch["rewards"]
+        done = batch["dones"].astype(jnp.float32)
+        nobs = batch["new_obs"]
+
+        # Target action with TD3's target-policy smoothing (zero noise
+        # degrades to vanilla DDPG).
+        na = self.actor.apply(target_actor, nobs)
+        if self.target_noise > 0.0:
+            eps = jnp.clip(
+                jax.random.normal(key, na.shape) * self.target_noise,
+                -self.target_noise_clip, self.target_noise_clip)
+            na = jnp.clip(na + eps, -1.0, 1.0)
+        tq = self.critic.apply(target_critic, nobs, self._rescale(na))
+        q_next = jnp.minimum(*tq) if self.twin_q else tq[0]
+        td_target = jax.lax.stop_gradient(
+            rew + gamma * (1.0 - done) * q_next)
+
+        def critic_loss_fn(cp):
+            qs = self.critic.apply(cp, obs, acts)
+            # Importance-sampling weights from prioritized replay
+            # (all-ones under uniform replay).
+            w = batch["weights"]
+            loss = sum((w * (q - td_target) ** 2).mean() for q in qs)
+            return loss, qs[0] - td_target
+
+        (c_loss, td_err), c_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True)(critic_params)
+        c_updates, critic_opt = self.critic_tx.update(
+            c_grads, critic_opt, critic_params)
+        critic_params = optax.apply_updates(critic_params, c_updates)
+
+        def actor_loss_fn(ap):
+            a = self.actor.apply(ap, obs)
+            q = self.critic.apply(critic_params, obs, self._rescale(a))[0]
+            return -q.mean()
+
+        if update_actor:
+            a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(
+                actor_params)
+            a_updates, actor_opt = self.actor_tx.update(
+                a_grads, actor_opt, actor_params)
+            actor_params = optax.apply_updates(actor_params, a_updates)
+            # Polyak targets move only with the actor (TD3 delays both).
+            tau = self.tau
+            target_actor = jax.tree_util.tree_map(
+                lambda t, o: tau * t + (1 - tau) * o, target_actor,
+                actor_params)
+            target_critic = jax.tree_util.tree_map(
+                lambda t, o: tau * t + (1 - tau) * o, target_critic,
+                critic_params)
+        else:
+            a_loss = jnp.float32(0.0)
+        return (actor_params, critic_params, target_actor, target_critic,
+                actor_opt, critic_opt,
+                {"critic_loss": c_loss, "actor_loss": a_loss,
+                 "mean_td_error": jnp.abs(td_err).mean()}, td_err)
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(batch[k])
+                  for k in ("obs", "actions", "rewards", "dones",
+                            "new_obs")}
+        jbatch["weights"] = (
+            jnp.asarray(batch["weights"], jnp.float32)
+            if "weights" in batch
+            else jnp.ones(len(batch["obs"]), jnp.float32))
+        self._step_count += 1
+        update_actor = (self._step_count % self.policy_delay) == 0
+        self._rng, key = jax.random.split(self._rng)
+        (self.actor_params, self.critic_params, self.target_actor,
+         self.target_critic, self.actor_opt, self.critic_opt, stats,
+         td_err) = self._train(
+            self.actor_params, self.critic_params, self.target_actor,
+            self.target_critic, self.actor_opt, self.critic_opt, jbatch,
+            key, update_actor=update_actor)
+        self.last_td_errors = np.asarray(td_err)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self):
+        """Targets update inside the train step (polyak); no-op kept for
+        interface parity with the Q policies."""
+
+    # -------------------------------------------------------- weights
+    def get_weights(self):
+        t = jax.tree_util.tree_map
+        return {"actor": t(np.asarray, self.actor_params),
+                "critic": t(np.asarray, self.critic_params),
+                "target_actor": t(np.asarray, self.target_actor),
+                "target_critic": t(np.asarray, self.target_critic)}
+
+    def set_weights(self, weights):
+        t = jax.tree_util.tree_map
+        self.actor_params = t(jnp.asarray, weights["actor"])
+        self.critic_params = t(jnp.asarray, weights["critic"])
+        self.target_actor = t(jnp.asarray, weights["target_actor"])
+        self.target_critic = t(jnp.asarray, weights["target_critic"])

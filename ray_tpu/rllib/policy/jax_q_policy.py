@@ -82,14 +82,18 @@ class JaxQPolicy:
             target = batch["rewards"] + gamma * q_next * (
                 1.0 - batch["dones"].astype(jnp.float32))
             td = qa - jax.lax.stop_gradient(target)
-            return optax.huber_loss(td).mean(), jnp.abs(td).mean()
+            # Importance-sampling weights from prioritized replay scale
+            # each sample's loss (reference: dqn policy build_q_losses
+            # PRIO_WEIGHTS); uniform replay passes all-ones.
+            loss = (batch["weights"] * optax.huber_loss(td)).mean()
+            return loss, td
 
-        (loss, td_err), grads = jax.value_and_grad(
+        (loss, td), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         updates, opt_state = self.tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, {"total_loss": loss,
-                                   "mean_td_error": td_err}
+        return params, opt_state, td, {"total_loss": loss,
+                                       "mean_td_error": jnp.abs(td).mean()}
 
     _TRAIN_KEYS = ("obs", "actions", "rewards", "dones", "new_obs")
 
@@ -97,9 +101,17 @@ class JaxQPolicy:
         # Only the TD-loss inputs go to device; replay rows also carry
         # GAE fields (shared rollout schema) the Q loss never reads.
         jbatch = {k: jnp.asarray(batch[k]) for k in self._TRAIN_KEYS}
-        self.params, self.opt_state, stats = self._train_step(
+        n = len(batch["obs"])
+        jbatch["weights"] = (jnp.asarray(batch["weights"], jnp.float32)
+                             if "weights" in batch
+                             else jnp.ones(n, jnp.float32))
+        self.params, self.opt_state, td, stats = self._train_step(
             self.params, self.target_params, self.opt_state, jbatch)
-        return {k: float(v) for k, v in stats.items()}
+        out = {k: float(v) for k, v in stats.items()}
+        # Per-sample TD errors drive priority updates in prioritized
+        # replay (reference: prio feedback loop in dqn training_step).
+        self.last_td_errors = np.asarray(td)
+        return out
 
     def update_target(self):
         self.target_params = self.params

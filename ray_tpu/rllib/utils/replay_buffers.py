@@ -1,5 +1,7 @@
 """Replay buffers (reference: rllib/utils/replay_buffers — ring storage
-with uniform sampling; the prioritized variant is scoped out)."""
+with uniform sampling, plus the proportional prioritized variant,
+reference: rllib/utils/replay_buffers/prioritized_replay_buffer.py —
+sum-tree sampling by TD-error priority with importance weights)."""
 
 from __future__ import annotations
 
@@ -40,3 +42,117 @@ class ReplayBuffer:
     def sample(self, batch_size: int) -> SampleBatch:
         idx = self._rng.randint(0, self._size, size=batch_size)
         return SampleBatch({k: v[idx] for k, v in self._storage.items()})
+
+
+def make_buffer(cfg: Dict, capacity_key: str = "buffer_capacity",
+                capacity: Optional[int] = None,
+                seed: Optional[int] = None) -> "ReplayBuffer":
+    """Buffer from an algorithm config: the single seam for the
+    prioritized-vs-uniform choice (used by DQN, DDPG/TD3, Ape-X)."""
+    cap = capacity if capacity is not None else cfg[capacity_key]
+    seed = seed if seed is not None else cfg.get("seed", 0)
+    if cfg.get("prioritized_replay"):
+        return PrioritizedReplayBuffer(
+            cap, seed=seed,
+            alpha=cfg.get("prioritized_replay_alpha", 0.6),
+            beta=cfg.get("prioritized_replay_beta", 0.4))
+    return ReplayBuffer(cap, seed=seed)
+
+
+class _SumTree:
+    """Flat-array binary sum tree over `capacity` leaves: O(log n)
+    priority updates and prefix-sum sampling (reference:
+    rllib/execution/segment_tree.py SumSegmentTree)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # Round up to a power of two so leaves form one contiguous level.
+        self._base = 1
+        while self._base < capacity:
+            self._base *= 2
+        self._tree = np.zeros(2 * self._base, np.float64)
+
+    def set(self, idx: np.ndarray, value: np.ndarray) -> None:
+        pos = np.asarray(idx, np.int64) + self._base
+        self._tree[pos] = value
+        pos //= 2
+        # Walk each touched path to the root; vectorized over the batch.
+        while pos[0] >= 1:
+            left = self._tree[2 * pos]
+            right = self._tree[2 * pos + 1]
+            self._tree[pos] = left + right
+            pos = np.unique(pos // 2)
+            if pos[0] == 0:
+                break
+
+    def total(self) -> float:
+        return float(self._tree[1])
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        return self._tree[np.asarray(idx, np.int64) + self._base]
+
+    def find_prefix(self, prefix: np.ndarray) -> np.ndarray:
+        """For each prefix sum, the leaf index where it lands."""
+        prefix = np.asarray(prefix, np.float64).copy()
+        pos = np.ones(len(prefix), np.int64)
+        while pos[0] < self._base:
+            left = 2 * pos
+            left_sum = self._tree[left]
+            go_right = prefix > left_sum
+            prefix = np.where(go_right, prefix - left_sum, prefix)
+            pos = np.where(go_right, left + 1, left)
+        return pos - self._base
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized experience replay (Schaul et al. 2016).
+
+    sample() returns two extra columns: "weights" (importance-sampling
+    corrections, normalized by the max weight) and "batch_indexes"
+    (for update_priorities after the learner computes new TD errors).
+    Reference: rllib/utils/replay_buffers/prioritized_replay_buffer.py.
+    """
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0,
+                 alpha: float = 0.6, beta: float = 0.4,
+                 eps: float = 1e-6):
+        super().__init__(capacity, seed)
+        assert alpha >= 0
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._tree = _SumTree(capacity)
+        self._max_priority = 1.0
+
+    def add(self, batch: SampleBatch) -> None:
+        n = batch.count
+        first = self._next
+        super().add(batch)
+        # New samples get max priority so everything is seen at least
+        # once before TD errors take over.
+        idx = (first + np.arange(min(n, self.capacity))) % self.capacity
+        self._tree.set(idx, np.full(len(idx),
+                                    self._max_priority ** self.alpha))
+
+    def sample(self, batch_size: int, beta: Optional[float] = None
+               ) -> SampleBatch:
+        beta = self.beta if beta is None else beta
+        total = self._tree.total()
+        # Stratified prefix sampling across the mass.
+        seg = total / batch_size
+        prefix = (np.arange(batch_size) + self._rng.rand(batch_size)) * seg
+        idx = np.minimum(self._tree.find_prefix(prefix), self._size - 1)
+        prios = np.maximum(self._tree.get(idx), 1e-12)
+        probs = prios / total
+        weights = (self._size * probs) ** (-beta)
+        weights = weights / weights.max()
+        out = {k: v[idx] for k, v in self._storage.items()}
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx.astype(np.int64)
+        return SampleBatch(out)
+
+    def update_priorities(self, idx: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        prios = np.abs(np.asarray(td_errors, np.float64)) + self.eps
+        self._max_priority = max(self._max_priority, float(prios.max()))
+        self._tree.set(np.asarray(idx, np.int64), prios ** self.alpha)

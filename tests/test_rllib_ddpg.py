@@ -1,0 +1,138 @@
+"""DDPG/TD3 continuous control (reference: rllib/algorithms/ddpg,
+rllib/algorithms/td3 — mechanics + learning checks)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import DDPGConfig, TD3Config
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class ReachEnv:
+    """1-D deterministic reach task: drive x to the origin.  Dense
+    quadratic reward makes it solvable in a few hundred updates — a
+    fast, non-flaky 'does the DPG machinery learn at all' probe."""
+
+    def __init__(self, horizon=40, seed=0):
+        import gymnasium as gym
+        self.observation_space = gym.spaces.Box(-2.0, 2.0, (1,),
+                                                np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self._rng = np.random.RandomState(seed)
+        self.horizon = horizon
+
+    def reset(self, **kwargs):
+        self.x = self._rng.uniform(-1.0, 1.0)
+        self.t = 0
+        return np.array([self.x], np.float32), {}
+
+    def step(self, action):
+        self.x = float(np.clip(self.x + 0.2 * float(action[0]),
+                               -2.0, 2.0))
+        self.t += 1
+        reward = -self.x ** 2
+        truncated = self.t >= self.horizon
+        return (np.array([self.x], np.float32), reward, False,
+                truncated, {})
+
+
+def test_ddpg_pendulum_mechanics(ray_init):
+    algo = (DDPGConfig()
+            .environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=200)
+            .training(train_batch_size=400, learning_starts=400,
+                      num_sgd_steps=40)
+            .debugging(seed=3)
+            .build())
+    worker = algo.workers.local_worker
+    assert not worker._discrete
+    batch = worker.sample(64)
+    acts = batch["actions"]
+    assert acts.dtype == np.float32 and acts.shape[1] == 1
+    assert np.all(acts >= -2.0 - 1e-5) and np.all(acts <= 2.0 + 1e-5)
+    for _ in range(3):
+        r = algo.train()
+    stats = r["info"]["learner"]
+    assert stats, "learner never ran"
+    assert np.isfinite(stats["critic_loss"])
+    assert np.isfinite(stats["actor_loss"])
+    assert r["episode_reward_mean"] > -1650  # not degenerate
+    algo.stop()
+
+
+def test_ddpg_learns_reach_task(ray_init):
+    algo = (DDPGConfig()
+            .environment(lambda cfg: ReachEnv())
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=120)
+            .training(train_batch_size=240, learning_starts=240,
+                      num_sgd_steps=120, sgd_batch_size=64,
+                      gamma=0.9, exploration_noise=0.2)
+            .debugging(seed=5)
+            .build())
+    best = -1e9
+    for _ in range(25):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best > -4.0:
+            break
+    algo.stop()
+    # Random walk scores ~-15 per 40-step episode; a trained reacher
+    # pins x near 0.
+    assert best > -6.0, f"DDPG failed the reach task (best={best})"
+
+
+def test_td3_learns_reach_and_uses_td3_mechanics(ray_init):
+    algo = (TD3Config()
+            .environment(lambda cfg: ReachEnv())
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=120)
+            .training(train_batch_size=240, learning_starts=240,
+                      num_sgd_steps=120, sgd_batch_size=64,
+                      gamma=0.9, exploration_noise=0.2)
+            .debugging(seed=6)
+            .build())
+    policy = algo.workers.local_worker.policy
+    assert policy.twin_q and policy.policy_delay == 2
+    assert policy.target_noise > 0
+    # Twin critics really exist: two heads in the critic pytree.
+    import jax
+    n_dense = len([k for k in jax.tree_util.tree_leaves(
+        policy.critic_params)])
+    assert n_dense >= 12  # 2 heads x 3 layers x (kernel, bias)
+    best = -1e9
+    for _ in range(25):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best > -4.0:
+            break
+    algo.stop()
+    assert best > -6.0, f"TD3 failed the reach task (best={best})"
+
+
+@pytest.mark.slow
+def test_td3_pendulum_improves(ray_init):
+    """TD3 climbs the Pendulum learning curve (slow tier: ~25k env
+    steps; matches public TD3 baselines' pace on this env)."""
+    algo = (TD3Config()
+            .environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=200)
+            .training(train_batch_size=400, learning_starts=400,
+                      num_sgd_steps=300, sgd_batch_size=128,
+                      actor_lr=1e-3, critic_lr=1e-3, gamma=0.9,
+                      exploration_noise=0.15)
+            .debugging(seed=7)
+            .build())
+    best = -1e9
+    for _ in range(60):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best > -600:
+            break
+    algo.stop()
+    assert best > -800, f"TD3 failed to improve on Pendulum (best={best})"

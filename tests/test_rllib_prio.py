@@ -1,0 +1,155 @@
+"""Prioritized replay (reference:
+rllib/utils/replay_buffers/prioritized_replay_buffer.py): sum-tree
+mechanics, the prioritized-beats-uniform property on a signal-sparse
+task, and the DQN/Ape-X wiring."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer, _SumTree)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_sum_tree_mechanics():
+    t = _SumTree(10)
+    t.set(np.arange(10), np.ones(10))
+    assert t.total() == pytest.approx(10.0)
+    assert list(t.find_prefix(np.array([0.5, 3.5, 9.5]))) == [0, 3, 9]
+    t.set(np.array([2]), np.array([5.0]))
+    assert t.total() == pytest.approx(14.0)
+    # mass shift: prefix 4.0 now lands inside leaf 2's [2, 7) span
+    assert t.find_prefix(np.array([4.0]))[0] == 2
+
+
+def test_prioritized_sampling_follows_td_errors():
+    b = PrioritizedReplayBuffer(capacity=128, seed=1, alpha=1.0, beta=0.4)
+    b.add(SampleBatch({
+        "obs": np.arange(100, dtype=np.float32).reshape(100, 1)}))
+    s = b.sample(10)
+    boost = s["batch_indexes"]
+    b.update_priorities(boost, np.full(10, 50.0))
+    s2 = b.sample(512)
+    frac = np.isin(s2["batch_indexes"], boost).mean()
+    # mass: 10*50 vs 90*1 -> expected ~0.85 of draws from the boosted set
+    assert frac > 0.7, frac
+    # importance weights compensate: boosted rows get LOWER weights
+    w_boost = s2["weights"][np.isin(s2["batch_indexes"], boost)]
+    w_rest = s2["weights"][~np.isin(s2["batch_indexes"], boost)]
+    if len(w_rest):
+        assert w_boost.mean() < w_rest.mean()
+
+
+def _cliffwalk_data(n_states=16, episodes=2000, seed=0):
+    """Blind Cliffwalk (Schaul et al. 2016 §1): action 1 advances along
+    a chain, action 0 ends the episode; only completing the whole chain
+    pays reward 1.  A random behavior policy makes the reward-bearing
+    transition exponentially rare — the signal-sparse regime
+    prioritized replay was built for."""
+    rng = np.random.RandomState(seed)
+    eye = np.eye(n_states, dtype=np.float32)
+    rows = {"obs": [], "actions": [], "rewards": [], "dones": [],
+            "new_obs": []}
+
+    def add(s, a, r, d, s2):
+        rows["obs"].append(eye[s])
+        rows["actions"].append(a)
+        rows["rewards"].append(r)
+        rows["dones"].append(d)
+        rows["new_obs"].append(eye[s2])
+
+    for _ in range(episodes):
+        s = 0
+        while True:
+            a = rng.randint(0, 2)
+            if a == 0:  # fall off the cliff: episode over, no reward
+                add(s, a, 0.0, True, s)
+                break
+            if s == n_states - 1:  # completed the chain
+                add(s, a, 1.0, True, s)
+                break
+            add(s, a, 0.0, False, s + 1)
+            s += 1
+    # Random exploration at 2^-16 success odds may see zero successes;
+    # seed two so both buffers contain the needle at equal frequency.
+    for _ in range(2):
+        for s in range(n_states - 1):
+            add(s, 1, 0.0, False, s + 1)
+        add(n_states - 1, 1, 1.0, True, n_states - 1)
+    return SampleBatch({
+        "obs": np.asarray(rows["obs"], np.float32),
+        "actions": np.asarray(rows["actions"], np.int64),
+        "rewards": np.asarray(rows["rewards"], np.float32),
+        "dones": np.asarray(rows["dones"], np.bool_),
+        "new_obs": np.asarray(rows["new_obs"], np.float32)})
+
+
+def _train_q(buffer, data, n_states, gamma=0.9, steps=300,
+             prioritized=False):
+    from ray_tpu.rllib.policy.jax_q_policy import JaxQPolicy
+    policy = JaxQPolicy(n_states, 2, {"lr": 1e-2, "seed": 0,
+                                      "policy_seed": 0, "gamma": gamma,
+                                      "fcnet_hiddens": (32,)})
+    buffer.add(data)
+    for i in range(steps):
+        batch = buffer.sample(32)
+        policy.learn_on_batch(batch)
+        if prioritized:
+            buffer.update_priorities(batch["batch_indexes"],
+                                     policy.last_td_errors)
+        if (i + 1) % 20 == 0:
+            policy.update_target()
+    # Error of Q(s, advance) against the analytic optimum gamma^(n-1-s).
+    import jax.numpy as jnp
+    eye = np.eye(n_states, dtype=np.float32)
+    q = np.asarray(policy._forward(policy.params, jnp.asarray(eye)))
+    true_q = gamma ** np.arange(n_states - 1, -1, -1)
+    return float(np.abs(q[:, 1] - true_q).mean())
+
+
+def test_prioritized_beats_uniform_on_sparse_signal():
+    """Same SGD budget, same data: prioritized replay propagates the
+    rare reward back through the chain far faster than uniform replay —
+    the property prioritization exists for.  beta=0 isolates the
+    sampling-concentration effect (the paper anneals beta toward 1 for
+    unbiasedness at convergence).  Measured at these seeds: uniform
+    ~0.28 vs prioritized ~0.11 mean |Q - Q*|."""
+    data = _cliffwalk_data()
+    n = 16
+    uni_err = _train_q(ReplayBuffer(16384, seed=2), data, n)
+    pri_err = _train_q(
+        PrioritizedReplayBuffer(16384, seed=2, alpha=1.0, beta=0.0),
+        data, n, prioritized=True)
+    assert pri_err < uni_err * 0.6, (
+        f"prioritized ({pri_err:.3f}) not clearly better than uniform "
+        f"({uni_err:.3f}) on Blind Cliffwalk")
+
+
+def test_dqn_prioritized_cartpole_improves(ray_init):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=200)
+            .training(train_batch_size=1000, learning_starts=1000,
+                      num_sgd_steps=100, epsilon_anneal_iters=8,
+                      prioritized_replay=True)
+            .debugging(seed=11)
+            .build())
+    assert isinstance(algo.buffer, PrioritizedReplayBuffer)
+    best = 0.0
+    for _ in range(25):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best > 40:
+            break
+    algo.stop()
+    assert best > 32, f"prioritized DQN failed to improve (best={best})"
