@@ -33,6 +33,18 @@ from ray_tpu.rllib.algorithms.ddpg.ddpg import (  # noqa: F401
     DDPGConfig,
 )
 from ray_tpu.rllib.algorithms.td3.td3 import TD3, TD3Config  # noqa: F401
+from ray_tpu.rllib.algorithms.simple_q.simple_q import (  # noqa: F401
+    SimpleQ,
+    SimpleQConfig,
+)
+from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.a3c.a3c import A3C, A3CConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.bandit.bandit import (  # noqa: F401
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+)
 from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
     BC,
     BCConfig,
@@ -41,10 +53,12 @@ from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
 )
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
-__all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "Algorithm",
-           "AlgorithmConfig", "ApexDQN", "ApexDQNConfig", "BC",
-           "BCConfig", "DDPG", "DDPGConfig", "DDPPO", "DDPPOConfig",
+__all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
+           "Algorithm", "AlgorithmConfig", "ApexDQN", "ApexDQNConfig",
+           "BC", "BCConfig", "BanditLinTS", "BanditLinTSConfig",
+           "BanditLinUCB", "BanditLinUCBConfig", "CQL", "CQLConfig",
+           "DDPG", "DDPGConfig", "DDPPO", "DDPPOConfig",
            "DQN", "DQNConfig", "ES", "ESConfig", "Impala",
            "ImpalaConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
            "PPO", "PPOConfig", "SAC", "SACConfig", "SampleBatch",
-           "TD3", "TD3Config"]
+           "SimpleQ", "SimpleQConfig", "TD3", "TD3Config"]

@@ -1,0 +1,85 @@
+"""A3C: asynchronous advantage actor-critic.
+
+Reference: rllib/algorithms/a3c/a3c.py — each rollout worker computes
+GRADIENTS on its own fragment; the learner applies them the moment they
+arrive (no barrier) and ships fresh weights back to just that worker.
+A2C (a2c.py) is the synchronous form sharing the same loss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.a2c.a2c import A2CPolicy
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class A3CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(A3C)
+        self._config.update({
+            "lr": 1e-3,
+            "entropy_coeff": 0.01,
+            "vf_loss_coeff": 0.5,
+            "grads_per_step": 8,  # async grad applications per train()
+        })
+
+
+class A3C(Algorithm):
+    policy_cls = A2CPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return dict(A3CConfig()._config)
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        # In-flight gradient computations persist ACROSS training_step
+        # calls: no end-of-step drain, no discarded worker compute.
+        self._inflight: Dict = {}
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        policy = self.workers.local_worker.policy
+        workers = self.workers.remote_workers
+        frag = cfg["rollout_fragment_length"]
+        stats: Dict = {}
+        trained = 0
+        if not workers:
+            # Degenerate single-process form: one sample+grad per call.
+            for _ in range(cfg["grads_per_step"]):
+                batch = self.workers.local_worker.sample(frag)
+                grads, stats = policy.compute_grads(batch)
+                policy.apply_grads(grads)
+                trained += batch.count
+            self._timesteps_total += trained
+            return {"info": {"learner": stats},
+                    "num_env_steps_trained": trained}
+        # Keep one in-flight gradient computation per worker; apply each
+        # as it lands and immediately refresh THAT worker's weights and
+        # relaunch it — no synchronization barrier across workers, and
+        # in-flight work carries over to the next training_step.
+        busy = set(self._inflight.values())
+        for w in workers:
+            if w not in busy:
+                w.set_weights.remote(
+                    ray_tpu.put(self.workers.local_worker.get_weights()))
+                self._inflight[w.sample_with_grads.remote(frag)] = w
+        applied = 0
+        while applied < cfg["grads_per_step"]:
+            done, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                   timeout=300)
+            if not done:
+                break
+            ref = done[0]
+            w = self._inflight.pop(ref)
+            grads, count, stats = ray_tpu.get(ref, timeout=60)
+            policy.apply_grads(grads)
+            applied += 1
+            trained += count
+            w.set_weights.remote(
+                ray_tpu.put(self.workers.local_worker.get_weights()))
+            self._inflight[w.sample_with_grads.remote(frag)] = w
+        self._timesteps_total += trained
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": trained}

@@ -160,6 +160,10 @@ class Algorithm(Trainable):
         result = self.training_step()
         stats = self.workers.episode_stats()
         self._episode_rewards += stats["episode_rewards"]
+        if self.policy_server is not None:
+            # External-env episodes completed over HTTP count too.
+            self._episode_rewards += \
+                self.policy_server.drain_episode_rewards()
         recent = self._episode_rewards[-100:]
         result.setdefault("episode_reward_mean",
                           float(np.mean(recent)) if recent else np.nan)

@@ -1,0 +1,6 @@
+from ray_tpu.rllib.algorithms.bandit.bandit import (  # noqa: F401
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+)

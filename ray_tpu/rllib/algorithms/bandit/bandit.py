@@ -1,0 +1,136 @@
+"""Contextual bandits: LinUCB and linear Thompson sampling.
+
+Reference: rllib/algorithms/bandit/bandit.py (BanditLinUCB/BanditLinTS
+over rllib/algorithms/bandit/bandit_torch_model.py's
+DiscreteLinearModel).  Closed-form ridge-regression posteriors per arm —
+exact Sherman-Morrison updates, no SGD, so this is numpy, not a neural
+policy.  Envs are one-step: obs = context, Discrete arms, reward per
+pull (see SimpleContextualBandit in the tests, mirroring
+rllib/env/bandit_envs_discrete.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class LinearBanditPolicy:
+    """Per-arm ridge regression: A_a = I + sum x x^T, b_a = sum r x.
+    UCB mode scores theta^T x + alpha sqrt(x^T A^-1 x); TS mode samples
+    theta ~ N(theta_hat, nu^2 A^-1)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict):
+        self.config = config
+        self.mode = config.get("bandit_mode", "ucb")
+        self.alpha = float(config.get("ucb_alpha", 1.0))
+        self.nu = float(config.get("ts_nu", 0.5))
+        self.num_actions = num_actions
+        self.obs_dim = obs_dim
+        self._rng = np.random.RandomState(config.get("seed", 0))
+        self.A_inv = np.stack([np.eye(obs_dim, dtype=np.float64)
+                               for _ in range(num_actions)])
+        self.b = np.zeros((num_actions, obs_dim), np.float64)
+
+    # ---------------------------------------------------------- acting
+    def compute_actions(self, obs: np.ndarray):
+        obs = np.asarray(obs, np.float64)
+        theta = np.einsum("aij,aj->ai", self.A_inv, self.b)
+        actions = []
+        for x in obs:
+            if self.mode == "ts":
+                scores = [
+                    float(self._rng.multivariate_normal(
+                        theta[a], self.nu ** 2 * self.A_inv[a]) @ x)
+                    for a in range(self.num_actions)]
+            else:
+                scores = [
+                    float(theta[a] @ x + self.alpha
+                          * np.sqrt(x @ self.A_inv[a] @ x))
+                    for a in range(self.num_actions)]
+            actions.append(int(np.argmax(scores)))
+        zeros = np.zeros(len(obs), np.float32)
+        return np.asarray(actions, np.int64), zeros, zeros
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)
+
+    # -------------------------------------------------------- learning
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        obs = np.asarray(batch["obs"], np.float64)
+        acts = np.asarray(batch["actions"], np.int64)
+        rews = np.asarray(batch["rewards"], np.float64)
+        for x, a, r in zip(obs, acts, rews):
+            # Sherman-Morrison rank-1 update of A_inv.
+            Ax = self.A_inv[a] @ x
+            self.A_inv[a] -= np.outer(Ax, Ax) / (1.0 + x @ Ax)
+            self.b[a] += r * x
+        theta = np.einsum("aij,aj->ai", self.A_inv, self.b)
+        pred = np.einsum("ni,ni->n", theta[acts], obs)
+        return {"total_loss": float(((pred - rews) ** 2).mean()),
+                "mean_reward": float(rews.mean())}
+
+    def update_target(self):
+        pass
+
+    # --------------------------------------------------------- weights
+    def get_weights(self):
+        return {"A_inv": self.A_inv.copy(), "b": self.b.copy()}
+
+    def set_weights(self, weights):
+        self.A_inv = np.asarray(weights["A_inv"], np.float64).copy()
+        self.b = np.asarray(weights["b"], np.float64).copy()
+
+
+class BanditLinUCBConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BanditLinUCB)
+        self._config.update({
+            "bandit_mode": "ucb",
+            "ucb_alpha": 1.0,
+            "ts_nu": 0.5,
+            "num_rollout_workers": 0,
+            "rollout_fragment_length": 100,
+            "train_batch_size": 100,
+        })
+
+
+class BanditLinTSConfig(BanditLinUCBConfig):
+    def __init__(self):
+        super().__init__(BanditLinTS)
+        self._config.update({"bandit_mode": "ts"})
+
+
+class BanditLinUCB(Algorithm):
+    policy_cls = LinearBanditPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return dict(BanditLinUCBConfig()._config)
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        per_worker = max(1, cfg["train_batch_size"]
+                         // max(1, len(self.workers.remote_workers)))
+        if self.workers.remote_workers:
+            batches = ray_tpu.get(
+                self.workers.sample_all(per_worker), timeout=600)
+        else:
+            batches = [self.workers.local_worker.sample(per_worker)]
+        batch = SampleBatch.concat_samples(batches)
+        policy = self.workers.local_worker.policy
+        stats = policy.learn_on_batch(batch)
+        if self.workers.remote_workers:
+            self.workers.sync_weights()
+        self._timesteps_total += batch.count
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": batch.count}
+
+
+class BanditLinTS(BanditLinUCB):
+    def _extra_defaults(self) -> Dict:
+        return dict(BanditLinTSConfig()._config)

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig  # noqa: F401

@@ -35,6 +35,9 @@ class DQNConfig(AlgorithmConfig):
             "prioritized_replay": False,
             "prioritized_replay_alpha": 0.6,
             "prioritized_replay_beta": 0.4,
+            # Iterations to anneal beta -> 1.0 (its own schedule — NOT
+            # tied to the epsilon schedule).
+            "prioritized_replay_beta_anneal_iters": 20,
         })
 
 
@@ -109,7 +112,9 @@ class DQN(Algorithm):
             # Anneal beta -> 1 (full IS correction at convergence),
             # reference: prioritized replay beta schedule in dqn.py.
             frac = min(1.0, self._iter
-                       / max(cfg["epsilon_anneal_iters"], 1))
+                       / max(cfg.get(
+                           "prioritized_replay_beta_anneal_iters", 20),
+                           1))
             self.buffer.beta = (cfg["prioritized_replay_beta"]
                                 + frac
                                 * (1.0 - cfg["prioritized_replay_beta"]))

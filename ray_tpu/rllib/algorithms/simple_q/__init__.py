@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.simple_q.simple_q import (  # noqa: F401
+    SimpleQ,
+    SimpleQConfig,
+)

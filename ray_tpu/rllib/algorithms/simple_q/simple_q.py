@@ -1,0 +1,29 @@
+"""SimpleQ: vanilla DQN — the minimal Q-learning reference point.
+
+Reference: rllib/algorithms/simple_q/simple_q.py — plain TD(0) targets
+from a target network: no double-Q, no dueling, no prioritization.
+Shares the replay/epsilon machinery with DQN (dqn.py); only the target
+computation differs (policy double_q=False).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+
+
+class SimpleQConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SimpleQ
+        self._config.update({
+            "double_q": False,
+            "prioritized_replay": False,
+        })
+
+
+class SimpleQ(DQN):
+    def _extra_defaults(self) -> Dict:
+        d = dict(SimpleQConfig()._config)
+        return d

@@ -56,6 +56,7 @@ class PolicyServerInput:
         self._episodes: Dict[str, _Episode] = {}
         self._lock = threading.Lock()
         self._queue: "queue.Queue[SampleBatch]" = queue.Queue()
+        self._episode_rewards: List[float] = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -152,6 +153,7 @@ class PolicyServerInput:
             with self._lock:
                 self._episode(body)
                 ep = self._episodes.pop(body["episode_id"])
+                self._episode_rewards.append(float(sum(ep.rewards)))
             batch = self._assemble(ep, final_obs)
             if batch is not None:
                 self._queue.put(batch)
@@ -190,6 +192,14 @@ class PolicyServerInput:
             return self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
+
+    def drain_episode_rewards(self) -> List[float]:
+        """Completed external episodes' returns since the last call
+        (feeds episode_reward_mean)."""
+        with self._lock:
+            out = self._episode_rewards
+            self._episode_rewards = []
+        return out
 
     def try_drain(self) -> List[SampleBatch]:
         out = []

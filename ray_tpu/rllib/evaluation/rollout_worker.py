@@ -256,6 +256,16 @@ class RolloutWorker(CollectiveMixin):
     def _filter_count(state) -> int:
         return sum((s or {}).get("count", 0) for s in (state or []))
 
+    def sample_with_grads(self, num_steps: Optional[int] = None):
+        """A3C worker step: sample a fragment and compute the policy
+        gradient LOCALLY (reference: a3c's worker-side grad computation);
+        returns (grads, count, stats) for async application."""
+        import jax
+        batch = self.sample(num_steps)
+        grads, stats = self.policy.compute_grads(batch)
+        return (jax.tree_util.tree_map(np.asarray, grads), batch.count,
+                stats)
+
     def set_weights(self, weights) -> bool:
         # Connector filter statistics ride along (checkpoint restore /
         # cross-worker carry) in a shallow envelope key that MUST be

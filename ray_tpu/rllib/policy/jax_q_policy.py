@@ -73,12 +73,16 @@ class JaxQPolicy:
         def loss_fn(p):
             q = self.model.apply(p, batch["obs"])
             qa = q[jnp.arange(q.shape[0]), batch["actions"]]
-            # Double DQN: online net picks, target net evaluates.
-            q_next_online = self.model.apply(p, batch["new_obs"])
-            next_a = q_next_online.argmax(axis=-1)
             q_next_target = self.model.apply(target_params,
                                              batch["new_obs"])
-            q_next = q_next_target[jnp.arange(q.shape[0]), next_a]
+            if self.config.get("double_q", True):
+                # Double DQN: online net picks, target net evaluates.
+                q_next_online = self.model.apply(p, batch["new_obs"])
+                next_a = q_next_online.argmax(axis=-1)
+                q_next = q_next_target[jnp.arange(q.shape[0]), next_a]
+            else:
+                # Vanilla Q-learning target (reference: simple_q).
+                q_next = q_next_target.max(axis=-1)
             target = batch["rewards"] + gamma * q_next * (
                 1.0 - batch["dones"].astype(jnp.float32))
             td = qa - jax.lax.stop_gradient(target)

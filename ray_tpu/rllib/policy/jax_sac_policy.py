@@ -315,7 +315,7 @@ class JaxSACGaussianPolicy:
         rew = batch[sb.REWARDS]
         done = batch[sb.DONES].astype(jnp.float32)
         nobs = batch[sb.NEXT_OBS]
-        k1, k2 = jax.random.split(key)
+        k1, k2, k3, k4 = jax.random.split(key, 4)
 
         next_a, next_logp = self._sample_logp(pi_params, nobs, k1)
         tq1, tq2 = self.q.apply(target_q, nobs, next_a)
@@ -323,10 +323,38 @@ class JaxSACGaussianPolicy:
         td_target = jax.lax.stop_gradient(
             rew + gamma * (1.0 - done) * next_v)
 
+        # CQL(H) conservative penalty weight (reference:
+        # rllib/algorithms/cql/cql_torch_policy.py): 0 = plain SAC.
+        cql_w = float(cfg.get("cql_min_q_weight", 0.0))
+        n_cql = int(cfg.get("cql_n_actions", 4))
+
         def q_loss_fn(qp):
             q1, q2 = self.q.apply(qp, obs, acts)
-            return ((q1 - td_target) ** 2).mean() \
+            loss = ((q1 - td_target) ** 2).mean() \
                 + ((q2 - td_target) ** 2).mean()
+            if cql_w > 0.0:
+                # Push down logsumexp Q over sampled (OOD) actions while
+                # holding up Q on dataset actions.
+                B = obs.shape[0]
+                rand_u = jax.random.uniform(
+                    k3, (n_cql, B, self.act_dim), minval=-1.0,
+                    maxval=1.0)
+                rand_a = rand_u * self._scale + self._mid
+                pi_a, _ = self._sample_logp(
+                    pi_params, jnp.tile(obs, (n_cql, 1)), k4)
+                pi_a = pi_a.reshape(n_cql, B, self.act_dim)
+                cat = jnp.concatenate([rand_a, pi_a], axis=0)
+                flat = cat.reshape(-1, self.act_dim)
+                obs_rep = jnp.tile(obs, (2 * n_cql, 1))
+                cq1, cq2 = self.q.apply(qp, obs_rep, flat)
+                cq1 = cq1.reshape(2 * n_cql, B)
+                cq2 = cq2.reshape(2 * n_cql, B)
+                gap1 = (jax.scipy.special.logsumexp(cq1, axis=0).mean()
+                        - q1.mean())
+                gap2 = (jax.scipy.special.logsumexp(cq2, axis=0).mean()
+                        - q2.mean())
+                loss = loss + cql_w * (gap1 + gap2)
+            return loss
 
         q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_params)
         q_updates, q_opt = self.q_tx.update(q_grads, q_opt, q_params)
