@@ -86,8 +86,114 @@ class DashboardHead:
 
         @routes.get("/api/jobs")
         async def jobs(request):
+            """Driver jobs + submitted jobs in one listing (reference:
+            job_head merges submission records with job-table rows)."""
             from ray_tpu.experimental import state
-            return _json(await _call(state.list_jobs))
+            from ray_tpu.job_submission import JobSubmissionClient
+            out = list(await _call(state.list_jobs))
+
+            def _submissions():
+                try:
+                    subs = JobSubmissionClient().list_jobs()
+                except Exception:
+                    return []
+                for s in subs:
+                    s.pop("logs", None)
+                return subs
+
+            out += await _call(_submissions)
+            return _json(out)
+
+        @routes.get("/api/submissions")
+        async def submissions(request):
+            """Submitted jobs ONLY (stable shape for the SDK's
+            list_jobs; /api/jobs merges driver jobs in for the UI)."""
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            def _subs():
+                subs = JobSubmissionClient().list_jobs()
+                for s in subs:
+                    s.pop("logs", None)
+                return subs
+
+            return _json(await _call(_subs))
+
+        @routes.post("/api/jobs")
+        async def submit_job(request):
+            """Remote job submission over plain HTTP (reference:
+            dashboard/modules/job/job_head.py POST /api/jobs/): body
+            {"entrypoint": "...", "submission_id"?, "runtime_env"?}."""
+            from ray_tpu.job_submission import JobSubmissionClient
+            payload = await request.json()
+            if not payload.get("entrypoint"):
+                return web.json_response(
+                    {"error": "missing entrypoint"}, status=400)
+
+            def _submit():
+                client = JobSubmissionClient()
+                return client.submit_job(
+                    entrypoint=payload["entrypoint"],
+                    submission_id=payload.get("submission_id"),
+                    runtime_env=payload.get("runtime_env"))
+
+            try:
+                sid = await _call(_submit)
+            except Exception as e:
+                return web.json_response({"error": repr(e)}, status=500)
+            return _json({"submission_id": sid})
+
+        @routes.get("/api/jobs/{submission_id}")
+        async def job_info(request):
+            from ray_tpu.job_submission import JobSubmissionClient
+            sid = request.match_info["submission_id"]
+            try:
+                info = await _call(
+                    lambda: JobSubmissionClient().get_job_info(sid))
+            except KeyError:
+                return web.json_response({"error": "no such job"},
+                                         status=404)
+            info.pop("logs", None)
+            return _json(info)
+
+        @routes.get("/api/jobs/{submission_id}/logs")
+        async def job_logs(request):
+            """Job logs; `?follow=1` streams chunks until the job
+            reaches a terminal state (reference: job_head log
+            tailing)."""
+            from ray_tpu.job_submission import JobStatus, \
+                JobSubmissionClient
+            sid = request.match_info["submission_id"]
+            client = JobSubmissionClient()
+            if request.query.get("follow") not in ("1", "true"):
+                logs = await _call(lambda: client.get_job_logs(sid))
+                return web.Response(text=logs,
+                                    content_type="text/plain")
+            resp = web.StreamResponse()
+            resp.content_type = "text/plain"
+            await resp.prepare(request)
+            sent = 0
+            while True:
+                try:
+                    rec = await _call(client.get_job_info, sid)
+                except KeyError:
+                    break
+                from ray_tpu.job_submission import _window_delta
+                chunk, sent = _window_delta(rec, sent)
+                if chunk:
+                    await resp.write(chunk.encode())
+                if rec.get("status") in JobStatus.TERMINAL:
+                    break
+                await asyncio.sleep(0.5)
+            await resp.write_eof()
+            return resp
+
+        @routes.post("/api/jobs/{submission_id}/stop")
+        async def job_stop(request):
+            from ray_tpu.job_submission import JobSubmissionClient
+            sid = request.match_info["submission_id"]
+            ok = await _call(
+                lambda: JobSubmissionClient().stop_job(sid))
+            return _json({"stopped": bool(ok)})
 
         @routes.put("/api/serve/applications")
         async def serve_deploy(request):

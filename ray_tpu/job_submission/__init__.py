@@ -41,6 +41,7 @@ class JobSupervisor:
         self.env_vars = env_vars or {}
         self.proc = None
         self._log_chunks: List[str] = []
+        self._total_chars = 0  # absolute log length incl. dropped prefix
         self._status = JobStatus.PENDING
         self._message = ""
         self._save()
@@ -56,7 +57,10 @@ class JobSupervisor:
                 "entrypoint": self.entrypoint,
                 "status": self._status,
                 "message": self._message,
+                # Sliding window + the ABSOLUTE end offset, so tailers
+                # can track progress even after the window slides.
                 "logs": "".join(self._log_chunks[-2000:]),
+                "logs_end": self._total_chars,
                 "update_ts": time.time(),
             })}))
 
@@ -80,7 +84,9 @@ class JobSupervisor:
                 line = await self.proc.stdout.readline()
                 if not line:
                     break
-                self._log_chunks.append(line.decode("utf-8", "replace"))
+                text = line.decode("utf-8", "replace")
+                self._log_chunks.append(text)
+                self._total_chars += len(text)
                 if len(self._log_chunks) % 20 == 0:
                     self._save()
             rc = await self.proc.wait()
@@ -111,17 +117,62 @@ class JobSupervisor:
         return True
 
 
+def _window_delta(rec: Dict, sent: int):
+    """New log text since absolute offset `sent`, given a record with a
+    sliding `logs` window ending at absolute offset `logs_end`."""
+    logs = rec.get("logs", "")
+    end = rec.get("logs_end", len(logs))
+    if end <= sent:
+        return "", sent
+    start = end - len(logs)  # absolute offset of the window start
+    return logs[max(0, sent - start):], end
+
+
 class JobSubmissionClient:
     """Reference: python/ray/dashboard/modules/job/sdk.py — the same
-    verbs, minus HTTP (the client talks straight to the cluster)."""
+    verbs.  An `http://host:port` address talks to the dashboard head's
+    REST API from OUTSIDE the cluster (no driver connection at all);
+    any other address connects directly like a driver."""
 
     def __init__(self, address: Optional[str] = None):
+        self._http = None
+        if address and address.startswith("http"):
+            self._http = address.rstrip("/")
+            return
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=address, ignore_reinit_error=True)
+
+    # ------------------------------------------------------- HTTP plane
+    def _rest(self, method: str, path: str, body: Optional[Dict] = None):
+        import json
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self._http}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                raw = r.read().decode()
+        except urllib.error.HTTPError as e:
+            raw = e.read().decode()
+            try:
+                err = json.loads(raw).get("error", raw)
+            except Exception:
+                err = raw
+            if e.code == 404:
+                raise KeyError(err) from None
+            raise RuntimeError(f"job REST error: {err}") from None
+        return json.loads(raw) if raw else None
 
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    runtime_env: Optional[Dict] = None) -> str:
+        if self._http:
+            reply = self._rest("POST", "/api/jobs", {
+                "entrypoint": entrypoint,
+                "submission_id": submission_id,
+                "runtime_env": runtime_env})
+            return reply["submission_id"]
         submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         env_vars = (runtime_env or {}).get("env_vars", {})
         sup_cls = ray_tpu.remote(JobSupervisor)
@@ -139,22 +190,60 @@ class JobSubmissionClient:
         return pickle.loads(blob) if blob else None
 
     def get_job_status(self, submission_id: str) -> str:
-        rec = self._record(submission_id)
-        if rec is None:
-            raise KeyError(f"no such job {submission_id}")
-        return rec["status"]
+        return self.get_job_info(submission_id)["status"]
 
     def get_job_info(self, submission_id: str) -> Dict:
+        if self._http:
+            return self._rest("GET", f"/api/jobs/{submission_id}")
         rec = self._record(submission_id)
         if rec is None:
             raise KeyError(f"no such job {submission_id}")
         return rec
 
     def get_job_logs(self, submission_id: str) -> str:
+        if self._http:
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"{self._http}/api/jobs/{submission_id}/logs",
+                    timeout=60) as r:
+                return r.read().decode()
         rec = self._record(submission_id)
         return rec["logs"] if rec else ""
 
+    def tail_job_logs(self, submission_id: str):
+        """Yield log chunks until the job reaches a terminal state
+        (HTTP mode streams the server's chunked ?follow=1 response)."""
+        if self._http:
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"{self._http}/api/jobs/{submission_id}/logs"
+                    "?follow=1", timeout=3600) as r:
+                while True:
+                    # read1: return each transfer chunk as it arrives
+                    # (read(n) would block accumulating n bytes,
+                    # defeating the live tail).
+                    chunk = r.read1(65536)
+                    if not chunk:
+                        return
+                    yield chunk.decode("utf-8", "replace")
+        else:
+            sent = 0
+            while True:
+                rec = self._record(submission_id)
+                if rec is None:
+                    return
+                chunk, sent = _window_delta(rec, sent)
+                if chunk:
+                    yield chunk
+                if rec.get("status") in JobStatus.TERMINAL:
+                    return
+                time.sleep(0.5)
+
     def stop_job(self, submission_id: str) -> bool:
+        if self._http:
+            reply = self._rest("POST",
+                               f"/api/jobs/{submission_id}/stop")
+            return bool(reply.get("stopped"))
         try:
             sup = ray_tpu.get_actor(f"_rt_job:{submission_id}")
             return ray_tpu.get(sup.stop.remote(), timeout=30)
@@ -162,6 +251,10 @@ class JobSubmissionClient:
             return False
 
     def list_jobs(self) -> List[Dict]:
+        if self._http:
+            # /api/submissions: submission records only, matching the
+            # direct-mode shape (/api/jobs also merges driver jobs).
+            return self._rest("GET", "/api/submissions") or []
         w = ray_tpu._private.worker.global_worker
         keys = w._run(w._gcs_request(
             "kv_keys", {"ns": JOBS_NS, "prefix": b""}))["keys"]

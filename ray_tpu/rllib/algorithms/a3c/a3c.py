@@ -60,10 +60,11 @@ class A3C(Algorithm):
         # relaunch it — no synchronization barrier across workers, and
         # in-flight work carries over to the next training_step.
         busy = set(self._inflight.values())
-        for w in workers:
-            if w not in busy:
-                w.set_weights.remote(
-                    ray_tpu.put(self.workers.local_worker.get_weights()))
+        idle = [w for w in workers if w not in busy]
+        if idle:
+            wref = ray_tpu.put(self.workers.local_worker.get_weights())
+            for w in idle:
+                w.set_weights.remote(wref)
                 self._inflight[w.sample_with_grads.remote(frag)] = w
         applied = 0
         while applied < cfg["grads_per_step"]:

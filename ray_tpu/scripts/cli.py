@@ -229,8 +229,14 @@ def cmd_timeline(args):
 
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
-    _connect(args.address)
-    client = JobSubmissionClient()
+    if args.address and args.address.startswith("http"):
+        # Remote REST submission against the dashboard head — works
+        # from machines that are NOT cluster members (reference:
+        # `ray job submit --address http://head:8265`).
+        client = JobSubmissionClient(args.address)
+    else:
+        _connect(args.address)
+        client = JobSubmissionClient()
     if args.job_cmd == "submit":
         sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
         print(f"submitted {sid}")

@@ -1,0 +1,68 @@
+"""Remote job submission over HTTP (reference:
+dashboard/modules/job/job_head.py REST + sdk.py JobSubmissionClient):
+submit/poll/logs from a client that holds ONLY the dashboard URL."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture
+def dashboard(tmp_path):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    from ray_tpu.dashboard import start_dashboard
+    addr = start_dashboard()
+    yield f"http://{addr['host']}:{addr['port']}"
+    ray_tpu.shutdown()
+
+
+def test_submit_poll_logs_over_http_only(dashboard):
+    # The client touches nothing but HTTP: no driver connection.
+    client = JobSubmissionClient(dashboard)
+    assert client._http  # REST mode, not driver mode
+    sid = client.submit_job(
+        entrypoint="python -c \"print('hello-from-job'); print(6*7)\"")
+    status = client.wait_until_finished(sid, timeout=180)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "hello-from-job" in logs and "42" in logs
+    info = client.get_job_info(sid)
+    assert info["submission_id"] == sid
+    assert any(j.get("submission_id") == sid
+               for j in client.list_jobs())
+
+
+def test_streaming_logs_and_stop(dashboard):
+    client = JobSubmissionClient(dashboard)
+    sid = client.submit_job(
+        entrypoint="python -u -c \""
+                   "import time\n"
+                   "for i in range(40):\n"
+                   "    print('tick', i, flush=True)\n"
+                   "    time.sleep(0.3)\"")
+    # Stream the follow endpoint while the job runs.
+    chunks = []
+    for chunk in client.tail_job_logs(sid):
+        chunks.append(chunk)
+        if sum(c.count("tick") for c in chunks) >= 3:
+            break
+    assert sum(c.count("tick") for c in chunks) >= 3
+    assert client.stop_job(sid)
+    status = client.wait_until_finished(sid, timeout=60)
+    assert status == JobStatus.STOPPED
+
+
+def test_rest_error_paths(dashboard):
+    client = JobSubmissionClient(dashboard)
+    with pytest.raises(KeyError):
+        client.get_job_info("raysubmit_doesnotexist")
+    # Missing entrypoint -> 400 surfaced as RuntimeError.
+    req = urllib.request.Request(
+        f"{dashboard}/api/jobs", data=json.dumps({}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(req, timeout=30)
