@@ -84,39 +84,32 @@ class DashboardHead:
             from ray_tpu.experimental import state
             return _json(await _call(state.list_placement_groups))
 
+        def _submission_records():
+            """Submitted-job records, logs stripped (shared by
+            /api/jobs and /api/submissions)."""
+            from ray_tpu.job_submission import JobSubmissionClient
+            try:
+                subs = JobSubmissionClient().list_jobs()
+            except Exception:
+                return []
+            for s in subs:
+                s.pop("logs", None)
+            return subs
+
         @routes.get("/api/jobs")
         async def jobs(request):
             """Driver jobs + submitted jobs in one listing (reference:
             job_head merges submission records with job-table rows)."""
             from ray_tpu.experimental import state
-            from ray_tpu.job_submission import JobSubmissionClient
             out = list(await _call(state.list_jobs))
-
-            def _submissions():
-                try:
-                    subs = JobSubmissionClient().list_jobs()
-                except Exception:
-                    return []
-                for s in subs:
-                    s.pop("logs", None)
-                return subs
-
-            out += await _call(_submissions)
+            out += await _call(_submission_records)
             return _json(out)
 
         @routes.get("/api/submissions")
         async def submissions(request):
             """Submitted jobs ONLY (stable shape for the SDK's
             list_jobs; /api/jobs merges driver jobs in for the UI)."""
-            from ray_tpu.job_submission import JobSubmissionClient
-
-            def _subs():
-                subs = JobSubmissionClient().list_jobs()
-                for s in subs:
-                    s.pop("logs", None)
-                return subs
-
-            return _json(await _call(_subs))
+            return _json(await _call(_submission_records))
 
         @routes.post("/api/jobs")
         async def submit_job(request):
@@ -198,33 +191,13 @@ class DashboardHead:
         @routes.put("/api/serve/applications")
         async def serve_deploy(request):
             """REST deploy (reference: serve REST schema / PUT
-            api/serve/applications): [{"import_path": "module:attr",
-            <deployment options...>}, ...]."""
-            import importlib
+            api/serve/applications): the declarative config shape,
+            schema-validated (serve/schema.py)."""
+            from ray_tpu.serve import schema as serve_schema
             payload = await request.json()
-            apps = payload.get("deployments", payload.get(
-                "applications", []))
-
-            def _deploy_all():
-                from ray_tpu.serve.api import Deployment
-                deployed = []
-                for spec in apps:
-                    mod_name, _, attr = spec["import_path"].partition(":")
-                    target = getattr(importlib.import_module(mod_name),
-                                     attr)
-                    if not isinstance(target, Deployment):
-                        raise TypeError(
-                            f"{spec['import_path']} is not a Deployment")
-                    opts = {k: v for k, v in spec.items()
-                            if k != "import_path"}
-                    if opts:
-                        target = target.options(**opts)
-                    target.deploy()
-                    deployed.append(target.name)
-                return deployed
-
             try:
-                deployed = await _call(_deploy_all)
+                deployed = await _call(serve_schema.apply_config,
+                                       payload)
             except Exception as e:
                 return web.json_response({"error": repr(e)}, status=400)
             return _json({"deployed": deployed})

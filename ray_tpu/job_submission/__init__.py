@@ -89,6 +89,12 @@ class JobSupervisor:
                 self._total_chars += len(text)
                 if len(self._log_chunks) % 20 == 0:
                     self._save()
+                    # Only the last 2000 chunks are ever persisted;
+                    # trimming keeps the supervisor's memory bounded on
+                    # chatty long-running jobs (lossless: _total_chars
+                    # already carries the absolute offset).
+                    if len(self._log_chunks) > 4000:
+                        del self._log_chunks[:-2000]
             rc = await self.proc.wait()
             if self._status == JobStatus.STOPPED:
                 pass
@@ -215,17 +221,25 @@ class JobSubmissionClient:
         (HTTP mode streams the server's chunked ?follow=1 response)."""
         if self._http:
             import urllib.request
+            import codecs
+            decoder = codecs.getincrementaldecoder("utf-8")("replace")
             with urllib.request.urlopen(
                     f"{self._http}/api/jobs/{submission_id}/logs"
                     "?follow=1", timeout=3600) as r:
                 while True:
                     # read1: return each transfer chunk as it arrives
                     # (read(n) would block accumulating n bytes,
-                    # defeating the live tail).
+                    # defeating the live tail); incremental decode keeps
+                    # multibyte characters split across chunks intact.
                     chunk = r.read1(65536)
                     if not chunk:
+                        tail = decoder.decode(b"", final=True)
+                        if tail:
+                            yield tail
                         return
-                    yield chunk.decode("utf-8", "replace")
+                    text = decoder.decode(chunk)
+                    if text:
+                        yield text
         else:
             sent = 0
             while True:

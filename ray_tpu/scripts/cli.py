@@ -255,6 +255,29 @@ def cmd_job(args):
                      for j in client.list_jobs()])
 
 
+def cmd_serve(args):
+    """Declarative serve verbs (reference: `serve deploy/build/status`
+    over the schema-validated config YAML)."""
+    from ray_tpu.serve import schema as serve_schema
+    if args.serve_cmd != "build":
+        # build is purely local (imports + YAML emit) — no cluster.
+        _connect(args.address)
+    if args.serve_cmd == "deploy":
+        config = serve_schema.load_config_file(args.config_file)
+        deployed = serve_schema.apply_config(config)
+        print(f"deployed: {', '.join(deployed)}")
+    elif args.serve_cmd == "build":
+        config = serve_schema.build_config(args.import_paths)
+        text = serve_schema.dump_config_file(config, args.output)
+        if args.output:
+            print(f"wrote {args.output}")
+        else:
+            print(text, end="")
+    elif args.serve_cmd == "status":
+        from ray_tpu import serve as serve_mod
+        print(json.dumps(serve_mod.status(), indent=2, default=str))
+
+
 def cmd_dashboard(args):
     import time
 
@@ -328,6 +351,18 @@ def main(argv=None):
     dp.add_argument("--port", type=int, default=0)
     dp.add_argument("--block", action="store_true")
     dp.set_defaults(fn=cmd_dashboard)
+
+    svp = sub.add_parser("serve", help="declarative serve config verbs")
+    svsub = svp.add_subparsers(dest="serve_cmd", required=True)
+    svd = svsub.add_parser("deploy", help="apply a serve config YAML")
+    svd.add_argument("config_file")
+    svb = svsub.add_parser("build",
+                           help="emit config YAML for deployments")
+    svb.add_argument("import_paths", nargs="+",
+                     help="module:deployment import paths")
+    svb.add_argument("-o", "--output", default=None)
+    svsub.add_parser("status")
+    svp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     args.fn(args)

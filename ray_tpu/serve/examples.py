@@ -1,5 +1,7 @@
 """Importable example deployments (used by REST-deploy tests/docs)."""
 
+import os
+
 from ray_tpu import serve
 
 
@@ -8,3 +10,10 @@ def rest_echo(req):
     if hasattr(req, "query"):
         return {"echo": req.query.get("msg", "")}
     return {"echo": req}
+
+
+@serve.deployment(name="pid_echo")
+def pid_echo(req):
+    """Reports its replica's pid — lets tests prove which requests hit
+    restarted vs surviving replicas across config re-applies."""
+    return {"pid": os.getpid()}

@@ -1,0 +1,138 @@
+"""Declarative Serve config: schema, build, and deploy.
+
+Reference: python/ray/serve/schema.py:202 (ServeApplicationSchema — the
+YAML the `serve build` / `serve deploy` CLI round-trips) and
+serve/scripts.py.  The config describes deployments by import path plus
+option overrides; applying it is idempotent and version-preserving —
+deployments whose code and options are unchanged keep their
+content-derived version, so the controller's reconciliation leaves their
+replicas untouched (zero-downtime re-apply)."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, Optional
+
+_DEPLOYMENT_KEYS = {
+    "name": str,
+    "import_path": str,
+    "num_replicas": int,
+    "max_concurrent_queries": int,
+    "user_config": dict,
+    "ray_actor_options": dict,
+    "route_prefix": (str, type(None)),
+    "version": str,
+    "autoscaling_config": dict,
+    "graceful_shutdown_timeout_s": (int, float),
+    "health_check_period_s": (int, float),
+    "health_check_timeout_s": (int, float),
+}
+
+
+class ServeConfigError(ValueError):
+    pass
+
+
+def validate_config(config: Dict) -> List[Dict]:
+    """Validate a declarative config; returns the deployment spec list.
+
+    Accepted top-level shapes: {"applications": [...]} (reference
+    multi-app schema) or {"deployments": [...]} (single-app schema)."""
+    if not isinstance(config, dict):
+        raise ServeConfigError(
+            f"config must be a mapping, got {type(config).__name__}")
+    specs = config.get("applications", config.get("deployments"))
+    if not isinstance(specs, list) or not specs:
+        raise ServeConfigError(
+            "config needs a non-empty 'applications' (or 'deployments') "
+            "list")
+    for i, spec in enumerate(specs):
+        if not isinstance(spec, dict):
+            raise ServeConfigError(f"applications[{i}] must be a mapping")
+        if not spec.get("import_path"):
+            raise ServeConfigError(
+                f"applications[{i}] is missing required 'import_path' "
+                "(format: module.submodule:deployment_attr)")
+        if ":" not in spec["import_path"]:
+            raise ServeConfigError(
+                f"applications[{i}].import_path "
+                f"{spec['import_path']!r} must be 'module:attribute'")
+        for key, value in spec.items():
+            expected = _DEPLOYMENT_KEYS.get(key)
+            if expected is None:
+                raise ServeConfigError(
+                    f"applications[{i}] has unknown option {key!r}; "
+                    f"valid: {sorted(_DEPLOYMENT_KEYS)}")
+            if not isinstance(value, expected):
+                raise ServeConfigError(
+                    f"applications[{i}].{key} must be "
+                    f"{getattr(expected, '__name__', expected)}, got "
+                    f"{type(value).__name__}")
+    return specs
+
+
+def _resolve(import_path: str):
+    from ray_tpu.serve.api import Deployment
+    mod_name, _, attr = import_path.partition(":")
+    target = getattr(importlib.import_module(mod_name), attr, None)
+    if not isinstance(target, Deployment):
+        raise ServeConfigError(
+            f"{import_path} does not resolve to a serve Deployment")
+    return target
+
+
+def apply_config(config: Dict) -> List[str]:
+    """Validate + deploy every application; returns deployed names.
+    Unchanged deployments keep their content-derived version, so the
+    re-apply is a controller no-op for them."""
+    specs = validate_config(config)
+    deployed = []
+    for spec in specs:
+        target = _resolve(spec["import_path"])
+        opts = {k: v for k, v in spec.items() if k != "import_path"}
+        if opts:
+            target = target.options(**opts)
+        target.deploy()
+        deployed.append(target.name)
+    return deployed
+
+
+def build_config(import_paths: List[str]) -> Dict:
+    """`serve build`: resolve deployments and emit the declarative
+    config capturing their CURRENT options (reference: serve build
+    emitting ServeApplicationSchema YAML)."""
+    apps = []
+    for path in import_paths:
+        d = _resolve(path)
+        spec: Dict = {"name": d.name, "import_path": path}
+        cfg = d.config.to_dict()
+        for key in ("num_replicas", "max_concurrent_queries",
+                    "graceful_shutdown_timeout_s",
+                    "health_check_period_s", "health_check_timeout_s"):
+            if key in cfg:
+                spec[key] = cfg[key]
+        if cfg.get("user_config"):
+            spec["user_config"] = cfg["user_config"]
+        if cfg.get("autoscaling_config"):
+            spec["autoscaling_config"] = dict(cfg["autoscaling_config"])
+        if d.route_prefix is not None:
+            spec["route_prefix"] = d.route_prefix
+        if getattr(d, "ray_actor_options", None):
+            spec["ray_actor_options"] = dict(d.ray_actor_options)
+        apps.append(spec)
+    return {"applications": apps}
+
+
+def load_config_file(path: str) -> Dict:
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def dump_config_file(config: Dict, path: Optional[str] = None) -> str:
+    import yaml
+    text = yaml.safe_dump(config, sort_keys=False)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
