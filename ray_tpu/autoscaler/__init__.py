@@ -10,6 +10,18 @@ from ray_tpu.autoscaler._private.autoscaler import (  # noqa: F401
     Monitor,
     StandardAutoscaler,
 )
+from ray_tpu.autoscaler.config import (  # noqa: F401
+    ClusterConfigError,
+    load_cluster_config,
+    validate_cluster_config,
+)
+from ray_tpu.autoscaler.tpu_pod_provider import (  # noqa: F401
+    MockQueuedResourceAPI,
+    TPUPodProvider,
+)
 
-__all__ = ["FakeMultiNodeProvider", "LocalProcessNodeProvider",
-           "Monitor", "NodeProvider", "StandardAutoscaler"]
+__all__ = ["ClusterConfigError", "FakeMultiNodeProvider",
+           "LocalProcessNodeProvider", "MockQueuedResourceAPI",
+           "Monitor", "NodeProvider", "StandardAutoscaler",
+           "TPUPodProvider", "load_cluster_config",
+           "validate_cluster_config"]

@@ -1,0 +1,94 @@
+"""Autoscaler monitor process: `rt up` launches this on the head.
+
+Reference: python/ray/autoscaler/_private/monitor.py — a standalone
+process polling the GCS for resource demand and driving
+StandardAutoscaler.update() on an interval.  It also persists the pids
+of provider-launched node processes into the cluster state file so
+`rt down` can tear the whole cluster down without this process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("rt-autoscaler-monitor")
+
+
+def _persist_worker_pids(state_file: str, provider) -> None:
+    try:
+        with open(state_file) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        state = {}
+    pids = {}
+    for info in getattr(provider, "_nodes", {}).values():
+        node = info.get("node")
+        if node is None:
+            continue
+        for role, pid in node.pids().items():
+            pids[f"{role}:{pid}"] = pid
+    state["worker_pids"] = sorted(set(pids.values()))
+    tmp = state_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=2)
+    os.replace(tmp, state_file)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("config_file")
+    p.add_argument("--gcs", required=True, help="host:port")
+    p.add_argument("--state-file", required=True)
+    p.add_argument("--interval", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[monitor] %(levelname)s %(message)s")
+    from ray_tpu.autoscaler import StandardAutoscaler
+    from ray_tpu.autoscaler.config import (load_cluster_config,
+                                           min_worker_demands,
+                                           provider_from_config)
+    config = load_cluster_config(args.config_file)
+    host, port = args.gcs.rsplit(":", 1)
+    gcs_addr = (host, int(port))
+
+    import ray_tpu
+    ray_tpu.init(address=args.gcs)
+    from ray_tpu._private import worker as worker_mod
+
+    def gcs_request(method, body):
+        w = worker_mod.global_worker
+        return w._run(w._gcs_request(method, body))
+
+    provider = provider_from_config(config, gcs_addr=gcs_addr)
+    autoscaler = StandardAutoscaler(
+        provider, gcs_request,
+        idle_timeout_s=config["idle_timeout_minutes"] * 60.0)
+
+    # Bring up min_workers before demand exists (reference:
+    # ResourceDemandScheduler treats min_workers as standing demand).
+    for name, nt in config["available_node_types"].items():
+        want = nt.get("min_workers", 0)
+        have = len([n for n in provider.non_terminated_nodes()
+                    if n["node_type"] == name]) // nt.get("group_size", 1)
+        if want > have:
+            logger.info("launching %d min_workers of %s", want - have,
+                        name)
+            provider.create_nodes(name, want - have)
+    _persist_worker_pids(args.state_file, provider)
+
+    while True:
+        try:
+            autoscaler.update()
+            _persist_worker_pids(args.state_file, provider)
+        except Exception:
+            logger.exception("autoscaler update failed")
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Command runners: how the cluster launcher executes bootstrap commands
+on nodes.
+
+Reference: python/ray/autoscaler/_private/command_runner.py
+(SSHCommandRunner/DockerCommandRunner) + updater.py (NodeUpdater running
+setup_commands then the start command).  Two runners ship in-tree:
+subprocess (same host — the process provider's transport) and ssh
+(remote hosts; the TPU-pod path runs `gcloud compute tpus tpu-vm ssh`
+or plain ssh to each slice host).
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict, List, Optional
+
+
+class CommandRunnerError(RuntimeError):
+    def __init__(self, cmd: str, rc: int, output: str):
+        super().__init__(f"command failed (rc={rc}): {cmd}\n{output}")
+        self.cmd = cmd
+        self.rc = rc
+        self.output = output
+
+
+class SubprocessCommandRunner:
+    """Runs node commands as local subprocesses (the fake/local-process
+    providers' transport)."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        self.env = env
+
+    def run(self, cmd: str, timeout: float = 600.0) -> str:
+        import os
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        proc = subprocess.run(cmd, shell=True, env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise CommandRunnerError(cmd, proc.returncode,
+                                     proc.stdout + proc.stderr)
+        return proc.stdout
+
+
+class SSHCommandRunner:
+    """Runs node commands over ssh (reference: SSHCommandRunner —
+    same option set: key file, user, connection hardening flags)."""
+
+    def __init__(self, host: str, user: Optional[str] = None,
+                 ssh_key: Optional[str] = None,
+                 ssh_options: Optional[List[str]] = None):
+        self.host = host
+        self.user = user
+        self.ssh_key = ssh_key
+        self.ssh_options = ssh_options or [
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "ConnectTimeout=10",
+        ]
+
+    def _ssh_argv(self, cmd: str) -> List[str]:
+        argv = ["ssh"] + list(self.ssh_options)
+        if self.ssh_key:
+            argv += ["-i", self.ssh_key]
+        target = f"{self.user}@{self.host}" if self.user else self.host
+        return argv + [target, cmd]
+
+    def run(self, cmd: str, timeout: float = 600.0) -> str:
+        proc = subprocess.run(self._ssh_argv(cmd), capture_output=True,
+                              text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise CommandRunnerError(cmd, proc.returncode,
+                                     proc.stdout + proc.stderr)
+        return proc.stdout
+
+
+class NodeUpdater:
+    """Bootstrap one node: run setup commands, then the start command
+    (reference: _private/updater.py NodeUpdater.do_update)."""
+
+    def __init__(self, runner, setup_commands: List[str],
+                 start_command: str):
+        self.runner = runner
+        self.setup_commands = setup_commands
+        self.start_command = start_command
+
+    def update(self) -> None:
+        for cmd in self.setup_commands:
+            self.runner.run(cmd)
+        if self.start_command:
+            self.runner.run(self.start_command)

@@ -1,0 +1,134 @@
+"""Cluster YAML config: schema validation + defaults.
+
+Reference: python/ray/autoscaler/ray-schema.json (the validated cluster
+launch YAML) and _private/util.py prepare_config/validate_config.  The
+shape mirrors the reference's: provider block, available_node_types with
+per-type resources/min/max, head node, idle timeout, and bootstrap
+commands run through the command runner.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+_PROVIDER_TYPES = ("local_process", "tpu_pod", "fake")
+
+
+class ClusterConfigError(ValueError):
+    pass
+
+
+_TOP_KEYS = {
+    "cluster_name": str,
+    "max_workers": int,
+    "idle_timeout_minutes": (int, float),
+    "provider": dict,
+    "head_node": dict,
+    "available_node_types": dict,
+    "setup_commands": list,
+    "head_setup_commands": list,
+    "worker_setup_commands": list,
+    "head_start_command": str,
+    "worker_start_command": str,
+}
+
+_NODE_TYPE_KEYS = {
+    "resources": dict,
+    "min_workers": int,
+    "max_workers": int,
+    "group_size": int,
+    "node_config": dict,
+}
+
+
+def validate_cluster_config(config: Dict) -> Dict:
+    """Validate and apply defaults; returns a new normalized config."""
+    if not isinstance(config, dict):
+        raise ClusterConfigError("cluster config must be a mapping")
+    for key, value in config.items():
+        expected = _TOP_KEYS.get(key)
+        if expected is None:
+            raise ClusterConfigError(
+                f"unknown cluster config key {key!r}; valid: "
+                f"{sorted(_TOP_KEYS)}")
+        if not isinstance(value, expected):
+            raise ClusterConfigError(
+                f"{key} must be {expected}, got {type(value).__name__}")
+    out = dict(config)
+    out.setdefault("cluster_name", "default")
+    out.setdefault("max_workers", 8)
+    out.setdefault("idle_timeout_minutes", 5)
+    provider = out.get("provider")
+    if not provider or "type" not in provider:
+        raise ClusterConfigError("config needs provider: {type: ...}")
+    if provider["type"] not in _PROVIDER_TYPES:
+        raise ClusterConfigError(
+            f"provider.type {provider['type']!r} not one of "
+            f"{_PROVIDER_TYPES}")
+    node_types = out.get("available_node_types")
+    if not node_types:
+        raise ClusterConfigError("config needs available_node_types")
+    for name, nt in node_types.items():
+        if not isinstance(nt, dict):
+            raise ClusterConfigError(
+                f"available_node_types.{name} must be a mapping")
+        for key, value in nt.items():
+            expected = _NODE_TYPE_KEYS.get(key)
+            if expected is None:
+                raise ClusterConfigError(
+                    f"available_node_types.{name} has unknown key "
+                    f"{key!r}; valid: {sorted(_NODE_TYPE_KEYS)}")
+            if not isinstance(value, expected):
+                raise ClusterConfigError(
+                    f"available_node_types.{name}.{key} must be "
+                    f"{expected}")
+        if "resources" not in nt:
+            raise ClusterConfigError(
+                f"available_node_types.{name} needs resources")
+        nt.setdefault("min_workers", 0)
+        nt.setdefault("max_workers", out["max_workers"])
+        nt.setdefault("group_size", 1)
+    out.setdefault("head_node", {"resources": {"CPU": 1}})
+    out["head_node"].setdefault("resources", {"CPU": 1})
+    out.setdefault("setup_commands", [])
+    return out
+
+
+def load_cluster_config(path: str) -> Dict:
+    import yaml
+    with open(path) as f:
+        return validate_cluster_config(yaml.safe_load(f))
+
+
+def provider_from_config(config: Dict, gcs_addr=None,
+                         session_dir=None):
+    """Instantiate the provider named by the config (the reference's
+    _get_node_provider registry, node_provider.py:_NODE_PROVIDERS)."""
+    ptype = config["provider"]["type"]
+    node_types = config["available_node_types"]
+    if ptype == "local_process":
+        from ray_tpu.autoscaler.node_provider import (
+            LocalProcessNodeProvider)
+        if gcs_addr is None:
+            raise ClusterConfigError(
+                "local_process provider needs the head GCS address")
+        return LocalProcessNodeProvider(node_types, gcs_addr=gcs_addr,
+                                        session_dir=session_dir)
+    if ptype == "tpu_pod":
+        from ray_tpu.autoscaler.tpu_pod_provider import TPUPodProvider
+        return TPUPodProvider(node_types,
+                              config["provider"].get("project", ""),
+                              config["provider"].get("zone", ""),
+                              gcs_addr=gcs_addr)
+    raise ClusterConfigError(
+        f"provider {ptype!r} must be created by the test harness")
+
+
+def min_worker_demands(config: Dict) -> List[Dict]:
+    """Synthetic demand shapes that force min_workers of each type up
+    (reference: ResourceDemandScheduler's min_workers handling)."""
+    demands = []
+    for name, nt in config["available_node_types"].items():
+        for _ in range(nt.get("min_workers", 0)):
+            demands.append(dict(nt["resources"]))
+    return demands

@@ -1,0 +1,169 @@
+"""TPUPodProvider: TPU slices via a GKE/GCE queued-resources-style API.
+
+Reference role: the cloud providers under
+python/ray/autoscaler/_private/{aws,gcp}/node_provider.py — translated
+to the TPU acquisition model (SURVEY §7 phase 9): capacity arrives as
+whole SLICES through a queued-resource request that is pending until
+granted, every host of a slice joins the cluster together, and releasing
+any host releases the slice.  The API client is injected so the provider
+is unit-testable against a mock; a real deployment passes a thin wrapper
+over google-cloud-tpu's QueuedResource RPCs (not importable in this
+image, and deliberately out of tree).
+
+API client contract (duck-typed):
+  create_queued_resource(name, accelerator_type, hosts) -> None
+  get_queued_resource(name) -> {"state": PENDING|ACTIVE|FAILED,
+                                "hosts": [{"id", "ip"}, ...]}
+  delete_queued_resource(name) -> None
+  list_queued_resources() -> [name, ...]
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+PENDING = "PENDING"
+ACTIVE = "ACTIVE"
+FAILED = "FAILED"
+
+
+class TPUPodProvider(NodeProvider):
+    def __init__(self, node_types: Dict[str, Dict], project: str,
+                 zone: str, api=None, gcs_addr=None,
+                 bootstrap_runner_factory=None):
+        """bootstrap_runner_factory(host_ip) -> command runner used to
+        `rt start --address` each granted host (reference: updater+
+        command_runner bootstrap of freshly launched cloud nodes)."""
+        super().__init__(node_types)
+        if api is None:
+            raise ValueError(
+                "TPUPodProvider needs a queued-resources API client "
+                "(inject the google-cloud-tpu wrapper, or a mock)")
+        self.api = api
+        self.project = project
+        self.zone = zone
+        self.gcs_addr = gcs_addr
+        self.bootstrap_runner_factory = bootstrap_runner_factory
+        # queued-resource name -> {"node_type", "group_id", "bootstrapped"}
+        self._slices: Dict[str, Dict] = {}
+
+    # ------------------------------------------------------------ verbs
+    def create_nodes(self, node_type: str, count: int) -> List[str]:
+        spec = self.node_types[node_type]
+        hosts = int(spec.get("group_size", 1))
+        accel = spec.get("node_config", {}).get("accelerator_type",
+                                                "v5litepod-8")
+        created = []
+        for _ in range(count):
+            name = f"rt-{node_type}-{uuid.uuid4().hex[:8]}"
+            self.api.create_queued_resource(name, accel, hosts)
+            self._slices[name] = {"node_type": node_type,
+                                  "group_id": name,
+                                  "bootstrapped": set()}
+            created.append(name)
+        return created
+
+    def non_terminated_nodes(self) -> List[Dict]:
+        """ACTIVE slices' hosts (each host = one cluster node).  PENDING
+        slices are still queued at the provider; FAILED ones are
+        reaped.  Newly ACTIVE hosts get bootstrapped exactly once."""
+        out = []
+        for name, info in list(self._slices.items()):
+            try:
+                qr = self.api.get_queued_resource(name)
+            except KeyError:
+                del self._slices[name]
+                continue
+            if qr["state"] == FAILED:
+                # Grant failed: drop the request so the autoscaler can
+                # re-launch (reference: failed node cleanup).
+                try:
+                    self.api.delete_queued_resource(name)
+                except KeyError:
+                    pass
+                del self._slices[name]
+                continue
+            if qr["state"] != ACTIVE:
+                continue  # still queued: contributes no capacity yet
+            for host in qr["hosts"]:
+                self._maybe_bootstrap(name, info, host)
+                out.append({
+                    "provider_id": f"{name}/{host['id']}",
+                    "node_type": info["node_type"],
+                    "group_id": name,
+                    "host_ip": host.get("ip"),
+                    # Joined raylets report node ids tagged with the
+                    # provider id via RT_NODE_LABEL (idle matching).
+                    "raylet_node_id": host.get("raylet_node_id", ""),
+                })
+        return out
+
+    def _maybe_bootstrap(self, name: str, info: Dict, host: Dict):
+        if (self.bootstrap_runner_factory is None
+                or host["id"] in info["bootstrapped"]):
+            return
+        runner = self.bootstrap_runner_factory(host.get("ip"))
+        if self.gcs_addr is not None:
+            runner.run(f"rt start --address "
+                       f"{self.gcs_addr[0]}:{self.gcs_addr[1]} "
+                       f"--node-ip {host.get('ip')}")
+        info["bootstrapped"].add(host["id"])
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        """Atomic slice release: terminating ANY host deletes the whole
+        queued resource."""
+        name = provider_node_id.split("/", 1)[0]
+        if name in self._slices:
+            try:
+                self.api.delete_queued_resource(name)
+            except KeyError:
+                pass
+            del self._slices[name]
+
+
+class MockQueuedResourceAPI:
+    """Test double simulating the queued-resources lifecycle: requests
+    sit PENDING for `grant_after` polls, then become ACTIVE with one
+    mock host per requested count (or FAILED if exhausted)."""
+
+    def __init__(self, grant_after: int = 2, capacity_slices: int = 100):
+        self.grant_after = grant_after
+        self.capacity = capacity_slices
+        self._requests: Dict[str, Dict] = {}
+
+    def create_queued_resource(self, name, accelerator_type, hosts):
+        if name in self._requests:
+            raise ValueError(f"duplicate queued resource {name}")
+        will_fail = len([r for r in self._requests.values()
+                         if r["state"] != FAILED]) >= self.capacity
+        self._requests[name] = {
+            "accelerator_type": accelerator_type,
+            "hosts_requested": hosts,
+            "polls": 0,
+            "state": FAILED if will_fail else PENDING,
+            "hosts": [],
+        }
+
+    def get_queued_resource(self, name):
+        req = self._requests.get(name)
+        if req is None:
+            raise KeyError(name)
+        if req["state"] == PENDING:
+            req["polls"] += 1
+            if req["polls"] >= self.grant_after:
+                req["state"] = ACTIVE
+                req["hosts"] = [
+                    {"id": f"host-{i}", "ip": f"10.0.0.{i + 1}"}
+                    for i in range(req["hosts_requested"])]
+        return {"state": req["state"], "hosts": list(req["hosts"])}
+
+    def delete_queued_resource(self, name):
+        if name not in self._requests:
+            raise KeyError(name)
+        del self._requests[name]
+
+    def list_queued_resources(self):
+        return list(self._requests)

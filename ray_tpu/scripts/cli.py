@@ -112,45 +112,37 @@ def cmd_start(args):
     print(f"  stop with: rt stop")
 
 
-def cmd_stop(args):
-    """Kill every node process started by `rt start` on this machine.
-    SIGTERM first (the raylet closes its store gracefully, unlinking the
-    /dev/shm arena), SIGKILL stragglers, then sweep any arena files the
-    killed raylets left behind."""
+def _terminate_ray_pids(all_pids, deadline_s: float = 10.0) -> int:
+    """Shared teardown for rt stop / rt down: SIGTERM pids whose cmdline
+    still looks like ours (pid recycling guard), wait only on the ones
+    actually signalled, SIGKILL stragglers, then sweep /dev/shm arenas
+    for EVERY recorded pid (dead raylets leave arenas too).  Returns the
+    number of processes signalled."""
     import glob
     import os
     import signal
     import time
-    entries = _load_started()
-    if not entries:
-        print("no started nodes recorded")
-        return
+
     def _is_ours(pid: int) -> bool:
-        # PIDs recycle (reboot or wraparound); only signal a pid whose
-        # cmdline still looks like one of our node processes.
         try:
             with open(f"/proc/{pid}/cmdline", "rb") as f:
-                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace")
         except OSError:
             return False
         return "ray_tpu" in cmd
 
-    all_pids = [(role, pid) for e in entries
-                for role, pid in e.get("pids", {}).items()]
-    # Only SIGNAL pids that still look like ours (pid recycling); the shm
-    # sweep below still covers arenas left by already-dead raylets.
-    pids = [(role, pid) for role, pid in all_pids if _is_ours(pid)]
+    all_pids = [int(p) for p in all_pids if p]
+    ours = [p for p in all_pids if _is_ours(p)]
     stopped = 0
-    for role, pid in pids:
+    for pid in ours:
         try:
             os.kill(pid, signal.SIGTERM)
             stopped += 1
-        except ProcessLookupError:
+        except (ProcessLookupError, PermissionError):
             pass
-        except Exception as e:
-            print(f"failed to stop {role} pid {pid}: {e}")
-    deadline = time.monotonic() + 10
-    live = {pid for _, pid in pids}
+    deadline = time.monotonic() + deadline_s
+    live = set(ours)
     while live and time.monotonic() < deadline:
         for pid in list(live):
             try:
@@ -163,12 +155,27 @@ def cmd_stop(args):
             os.kill(pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-    for _, pid in all_pids:
+    for pid in all_pids:
         for path in glob.glob(f"/dev/shm/rt_store_*_{pid}"):
             try:
                 os.unlink(path)
             except OSError:
                 pass
+    return stopped
+
+
+def cmd_stop(args):
+    """Kill every node process started by `rt start` on this machine.
+    SIGTERM first (the raylet closes its store gracefully, unlinking the
+    /dev/shm arena), SIGKILL stragglers, then sweep any arena files the
+    killed raylets left behind."""
+    entries = _load_started()
+    if not entries:
+        print("no started nodes recorded")
+        return
+    all_pids = [pid for e in entries
+                for pid in e.get("pids", {}).values()]
+    stopped = _terminate_ray_pids(all_pids)
     _save_started([])
     print(f"stopped {stopped} processes")
 
@@ -253,6 +260,118 @@ def cmd_job(args):
     elif args.job_cmd == "list":
         _print_rows([{k: v for k, v in j.items() if k != "logs"}
                      for j in client.list_jobs()])
+
+
+def _cluster_state_path(name: str) -> str:
+    import os
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    return os.path.join(_STATE_DIR, f"cluster_{name}.json")
+
+
+def cmd_up(args):
+    """Launch a cluster from a YAML config (reference: `ray up`,
+    scripts.py:980 — bootstrap the head, then run the autoscaler
+    monitor against the config's node types)."""
+    import os
+    import subprocess
+    import time
+
+    from ray_tpu._private.node import NodeProcesses, new_session_dir
+    from ray_tpu.autoscaler.command_runner import (NodeUpdater,
+                                                   SubprocessCommandRunner)
+    from ray_tpu.autoscaler.config import load_cluster_config
+
+    config = load_cluster_config(args.config_file)
+    name = config["cluster_name"]
+    if config["provider"]["type"] not in ("local_process", "tpu_pod"):
+        print(f"rt up supports provider types local_process/tpu_pod; "
+              f"{config['provider']['type']!r} is a test-harness "
+              "provider", file=sys.stderr)
+        sys.exit(2)
+    state_path = _cluster_state_path(name)
+    if os.path.exists(state_path):
+        print(f"cluster {name!r} already recorded at {state_path}; "
+              "run `rt down` first")
+        sys.exit(1)
+
+    # 1. Head-node bootstrap commands (reference: updater running
+    # setup_commands then the start command).  The head's node
+    # processes are spawned directly below; head_start_command is an
+    # EXTRA user hook run after they are up.
+    runner = SubprocessCommandRunner()
+    NodeUpdater(runner, config["setup_commands"]
+                + config.get("head_setup_commands", []),
+                start_command="").update()
+
+    head_res = dict(config["head_node"].get("resources", {"CPU": 1}))
+    head = NodeProcesses(
+        session_dir=new_session_dir(), head=True, host=args.node_ip,
+        num_cpus=head_res.pop("CPU", 1), resources=head_res,
+        register_atexit=False).start()
+    gcs = f"{head.gcs_addr[0]}:{head.gcs_addr[1]}"
+    if config.get("head_start_command"):
+        runner = SubprocessCommandRunner(
+            env={"RT_GCS_ADDRESS": gcs})
+        runner.run(config["head_start_command"])
+
+    # 2. Autoscaler monitor (detached): owns the provider, launches
+    # min_workers, scales on demand, persists worker pids for rt down.
+    state = {"cluster_name": name, "gcs_address": gcs,
+             "head_pids": head.pids(),
+             "session_dir": head.session_dir, "worker_pids": []}
+    with open(state_path, "w") as f:
+        json.dump(state, f, indent=2)
+    monitor = subprocess.Popen(
+        [sys.executable, "-m",
+         "ray_tpu.autoscaler._private.monitor_main",
+         os.path.abspath(args.config_file), "--gcs", gcs,
+         "--state-file", state_path],
+        stdout=open(os.path.join(head.session_dir, "logs",
+                                 "monitor.out"), "ab"),
+        stderr=subprocess.STDOUT, start_new_session=True)
+    state["monitor_pid"] = monitor.pid
+    with open(state_path, "w") as f:
+        json.dump(state, f, indent=2)
+    # Give min_workers a moment to register before reporting.
+    time.sleep(1.0)
+    print(f"cluster {name!r} up")
+    print(f"  GCS address: {gcs}")
+    print(f"  connect: ray_tpu.init(address=\"{gcs}\")")
+    print(f"  tear down: rt down {args.config_file}")
+
+
+def cmd_down(args):
+    """Tear down a cluster started by `rt up` (reference: `ray down`,
+    scripts.py:1167)."""
+    import os
+
+    from ray_tpu.autoscaler.config import load_cluster_config
+
+    config = load_cluster_config(args.config_file)
+    state_path = _cluster_state_path(config["cluster_name"])
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except OSError:
+        print(f"no recorded cluster {config['cluster_name']!r}")
+        return
+    except ValueError:
+        # Corrupt/half-written state (rt up killed mid-write): remove
+        # it so the cluster isn't permanently wedged; processes must be
+        # cleaned by rt stop / manually.
+        os.unlink(state_path)
+        print(f"removed corrupt state file {state_path}; use `rt stop` "
+              "to sweep any surviving node processes")
+        return
+
+    # The monitor goes FIRST so it can't relaunch workers mid-teardown.
+    pids = ([state.get("monitor_pid")] if state.get("monitor_pid")
+            else []) + list(state.get("worker_pids", [])) \
+        + list(state.get("head_pids", {}).values())
+    killed = _terminate_ray_pids(pids)
+    os.unlink(state_path)
+    print(f"cluster {config['cluster_name']!r} down "
+          f"({killed} processes signalled)")
 
 
 def cmd_serve(args):
@@ -351,6 +470,15 @@ def main(argv=None):
     dp.add_argument("--port", type=int, default=0)
     dp.add_argument("--block", action="store_true")
     dp.set_defaults(fn=cmd_dashboard)
+
+    up = sub.add_parser("up", help="launch a cluster from a YAML config")
+    up.add_argument("config_file")
+    up.add_argument("--node-ip", default="127.0.0.1")
+    up.set_defaults(fn=cmd_up)
+
+    down = sub.add_parser("down", help="tear down an rt up cluster")
+    down.add_argument("config_file")
+    down.set_defaults(fn=cmd_down)
 
     svp = sub.add_parser("serve", help="declarative serve config verbs")
     svsub = svp.add_subparsers(dest="serve_cmd", required=True)
