@@ -83,13 +83,25 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
             raylet_addr = _head_node.raylet_addr
             store_path = _head_node.raylet.store_path
             store_cap = _head_node.raylet.store_capacity
+            driver_host = "127.0.0.1"
         else:
             host, port = address.split(":")
             gcs_addr = (host, int(port))
             raylet_addr, store_path, store_cap = _discover_local_raylet(
                 loop, gcs_addr)
+            # Advertise the LOCAL RAYLET's address: it registered with
+            # the cluster-reachable --node-ip, so peers can dial the
+            # driver back on it (owner protocol).  Multi-NIC machines
+            # may route to the GCS on a different interface than the
+            # cluster data network, so the route-to-GCS guess is only
+            # the fallback when the raylet is loopback-bound.
+            if raylet_addr[0] not in ("127.0.0.1", "localhost"):
+                driver_host = raylet_addr[0]
+            else:
+                driver_host = _routable_host(gcs_addr[0])
         cw = CoreWorker(MODE_DRIVER, gcs_addr, raylet_addr=raylet_addr,
-                        store_path=store_path, store_cap=store_cap)
+                        store_path=store_path, store_cap=store_cap,
+                        host=driver_host)
         cw.loop = loop
         fut = asyncio.run_coroutine_threadsafe(cw._connect(), loop)
         fut.result(60)
@@ -97,6 +109,20 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
         worker_mod.global_worker = cw
         atexit.register(shutdown)
         return cw
+
+
+def _routable_host(peer_host: str) -> str:
+    """The local interface address that routes to `peer_host` —
+    what this process should ADVERTISE so that host can dial back."""
+    if peer_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    import socket
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((peer_host, 1))  # no packets; just picks a route
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
 
 
 def _discover_local_raylet(loop, gcs_addr):
@@ -112,9 +138,26 @@ def _discover_local_raylet(loop, gcs_addr):
 
     nodes = asyncio.run_coroutine_threadsafe(_find(), loop).result(30)
     import socket
-    local_hosts = {"127.0.0.1", "localhost", socket.gethostname()}
+
+    def _is_local(host: str) -> bool:
+        # An address is local iff this machine can BIND to it — covers
+        # loopback, the hostname, AND routable interface addresses
+        # (multi-host nodes advertise --node-ip, not 127.0.0.1).
+        if host in ("0.0.0.0", "::"):
+            # Wildcards bind anywhere; a node advertising one is
+            # misconfigured, never "local".
+            return False
+        if host in ("127.0.0.1", "localhost", socket.gethostname()):
+            return True
+        try:
+            with socket.socket() as s:
+                s.bind((host, 0))
+            return True
+        except OSError:
+            return False
+
     for n in nodes:
-        if n["alive"] and n["addr"][0] in local_hosts:
+        if n["alive"] and _is_local(n["addr"][0]):
             # store path/capacity arrive in the raylet's register_worker
             # reply (see CoreWorker._connect)
             return tuple(n["addr"]), None, None

@@ -25,6 +25,10 @@ async def _amain():
     gcs_addr = (os.environ["RT_GCS_HOST"], int(os.environ["RT_GCS_PORT"]))
     raylet_addr = (os.environ["RT_RAYLET_HOST"],
                    int(os.environ["RT_RAYLET_PORT"]))
+    # Workers advertise their node's address (the raylet's bind host):
+    # on multi-host clusters, peers dial workers directly for task push
+    # and owner-protocol calls, and loopback would not route.
+    host = raylet_addr[0]
     cw = CoreWorker(
         MODE_WORKER,
         gcs_addr,
@@ -32,6 +36,7 @@ async def _amain():
         store_path=os.environ.get("RT_STORE_PATH"),
         store_cap=int(os.environ.get("RT_STORE_CAP", "0")) or None,
         worker_id=WorkerID.from_hex(os.environ["RT_WORKER_ID"]),
+        host=host,
     )
     worker_mod.global_worker = cw
     await cw.start_worker_async()

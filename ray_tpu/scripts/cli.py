@@ -74,6 +74,11 @@ def cmd_start(args):
                  "--address host:port (join one)")
         print(p_err, file=sys.stderr)
         sys.exit(2)
+    if args.node_ip in ("0.0.0.0", "::"):
+        print("--node-ip must be a routable ADVERTISED address, not a "
+              "wildcard bind address (peers would dial themselves)",
+              file=sys.stderr)
+        sys.exit(2)
     head = args.address is None
     gcs_addr = None
     if not head:
