@@ -51,14 +51,41 @@ from ray_tpu.rllib.algorithms.marwil.marwil import (  # noqa: F401
     MARWIL,
     MARWILConfig,
 )
+from ray_tpu.rllib.algorithms.ars.ars import ARS, ARSConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.crr.crr import CRR, CRRConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.slateq.slateq import (  # noqa: F401
+    SlateQ,
+    SlateQConfig,
+)
+from ray_tpu.rllib.algorithms.qmix.qmix import (  # noqa: F401
+    QMix,
+    QMixConfig,
+)
+from ray_tpu.rllib.algorithms.maddpg.maddpg import (  # noqa: F401
+    MADDPG,
+    MADDPGConfig,
+)
+from ray_tpu.rllib.algorithms.dt.dt import DT, DTConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.r2d2.r2d2 import (  # noqa: F401
+    R2D2,
+    R2D2Config,
+)
+from ray_tpu.rllib.algorithms.alpha_zero.alpha_zero import (  # noqa: F401
+    AlphaZero,
+    AlphaZeroConfig,
+)
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
-           "Algorithm", "AlgorithmConfig", "ApexDQN", "ApexDQNConfig",
+           "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig",
+           "AlphaZero", "AlphaZeroConfig", "ApexDQN", "ApexDQNConfig",
            "BC", "BCConfig", "BanditLinTS", "BanditLinTSConfig",
            "BanditLinUCB", "BanditLinUCBConfig", "CQL", "CQLConfig",
-           "DDPG", "DDPGConfig", "DDPPO", "DDPPOConfig",
-           "DQN", "DQNConfig", "ES", "ESConfig", "Impala",
-           "ImpalaConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
-           "PPO", "PPOConfig", "SAC", "SACConfig", "SampleBatch",
-           "SimpleQ", "SimpleQConfig", "TD3", "TD3Config"]
+           "CRR", "CRRConfig", "DDPG", "DDPGConfig", "DDPPO",
+           "DDPPOConfig", "DQN", "DQNConfig", "DT", "DTConfig", "ES",
+           "ESConfig", "Impala", "ImpalaConfig", "MADDPG",
+           "MADDPGConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
+           "PPO", "PPOConfig", "QMix", "QMixConfig", "R2D2",
+           "R2D2Config", "SAC", "SACConfig", "SampleBatch", "SimpleQ",
+           "SimpleQConfig", "SlateQ", "SlateQConfig", "TD3",
+           "TD3Config"]

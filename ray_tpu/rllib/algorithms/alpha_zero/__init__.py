@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.alpha_zero.alpha_zero import (  # noqa: F401
+    AlphaZero,
+    AlphaZeroConfig,
+)

@@ -1,0 +1,343 @@
+"""AlphaZero (single-player): MCTS-guided policy iteration.
+
+Reference: rllib/algorithms/alpha_zero/alpha_zero.py (+ mcts.py) — a
+policy/value network guides Monte-Carlo tree search over a *cloneable*
+environment (get_state/set_state); self-play episodes record the MCTS
+visit distribution as the policy target and the episode's discounted
+return as the value target.  The reference's single-player variant
+ranks rewards instead of win/loss; ours regresses the normalized return
+directly and min-max normalizes Q inside the UCB rule (the MuZero trick
+for unbounded scores).
+
+Re-derived jax-first: one jitted policy+value forward serves every
+MCTS expansion, and the (cross-entropy + value MSE) training step is a
+single jitted function.  Tree search itself is Python — it's branchy,
+data-dependent control flow that belongs on the host, not in XLA.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.tune.trainable import Trainable
+
+
+class CloneableGymEnv:
+    """gymnasium env + get_state/set_state (reference alpha_zero requires
+    envs expose exactly this pair; here we implement it generically for
+    classic-control envs whose full state is `unwrapped.state`)."""
+
+    def __init__(self, env_name: str, env_config: Dict):
+        import gymnasium as gym
+        self.env = gym.make(env_name, **(env_config or {}))
+
+    def reset(self, seed=None):
+        return self.env.reset(seed=seed)
+
+    def step(self, action):
+        return self.env.step(action)
+
+    def get_state(self):
+        u = self.env.unwrapped
+        elapsed = getattr(self.env, "_elapsed_steps", 0)
+        return (np.array(u.state, np.float64),
+                u.steps_beyond_terminated, elapsed)
+
+    def set_state(self, state):
+        u = self.env.unwrapped
+        arr, beyond, elapsed = state
+        u.state = np.array(arr, np.float64)
+        u.steps_beyond_terminated = beyond
+        if hasattr(self.env, "_elapsed_steps"):
+            self.env._elapsed_steps = elapsed
+        return np.array(arr, np.float32)
+
+    @property
+    def action_space(self):
+        return self.env.action_space
+
+    @property
+    def observation_space(self):
+        return self.env.observation_space
+
+    def close(self):
+        self.env.close()
+
+
+class _PVNet(nn.Module):
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        logits = nn.Dense(self.num_actions)(h)
+        value = nn.sigmoid(nn.Dense(1)(h))[..., 0]  # normalized [0, 1]
+        return logits, value
+
+
+class _Node:
+    __slots__ = ("prior", "visits", "value_sum", "children", "state",
+                 "reward", "terminal")
+
+    def __init__(self, prior: float):
+        self.prior = prior
+        self.visits = 0
+        self.value_sum = 0.0
+        self.children: Dict[int, "_Node"] = {}
+        self.state = None
+        self.reward = 0.0
+        self.terminal = False
+
+    def q(self) -> float:
+        return self.value_sum / self.visits if self.visits else 0.0
+
+
+class AlphaZeroConfig:
+    def __init__(self):
+        self.algo_class = AlphaZero
+        self._config: Dict = {
+            "env": "CartPole-v1",
+            "env_config": {},
+            "lr": 1e-3,
+            "gamma": 0.997,
+            "num_simulations": 25,
+            "c_puct": 1.5,
+            "dirichlet_alpha": 0.3,
+            "dirichlet_frac": 0.25,
+            "temperature_steps": 15,   # sample ~ visits before this ply
+            "episodes_per_iter": 4,
+            "max_episode_steps": 200,
+            "value_scale": 200.0,      # returns normalized by this
+            "replay_capacity": 5000,
+            "train_batch_size": 128,
+            "num_sgd_steps": 30,
+            "fcnet_hiddens": (64, 64),
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "AlphaZeroConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "AlphaZeroConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "AlphaZeroConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "AlphaZero":
+        return AlphaZero(config=self.to_dict())
+
+
+class AlphaZero(Trainable):
+    def setup(self, config: Dict):
+        defaults = AlphaZeroConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        self.env = CloneableGymEnv(self.cfg["env"],
+                                   self.cfg["env_config"])
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.num_actions = int(self.env.action_space.n)
+        self.net = _PVNet(num_actions=self.num_actions,
+                          hiddens=tuple(self.cfg["fcnet_hiddens"]))
+        rng = jax.random.PRNGKey(self.cfg["seed"])
+        self.params = self.net.init(
+            rng, jnp.zeros((1, self.obs_dim), jnp.float32))
+        self.tx = optax.adam(self.cfg["lr"])
+        self.opt_state = self.tx.init(self.params)
+        self._forward = jax.jit(self.net.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+        self._rng = np.random.RandomState(self.cfg["seed"] + 1)
+        self._replay: List[Dict] = []
+        self._iter = 0
+        self._timesteps_total = 0
+        self._episode_rewards: List[float] = []
+
+    # -------------------------------------------------------------- MCTS
+    def _eval_net(self, obs: np.ndarray):
+        logits, value = self._forward(
+            self.params, jnp.asarray(obs, jnp.float32)[None])
+        probs = np.asarray(jax.nn.softmax(logits))[0]
+        return probs, float(np.asarray(value)[0])
+
+    def _search(self, root_obs: np.ndarray, root_state) -> np.ndarray:
+        cfg = self.cfg
+        gamma = cfg["gamma"]
+        root = _Node(prior=1.0)
+        root.state = root_state
+        probs, value = self._eval_net(root_obs)
+        noise = self._rng.dirichlet(
+            [cfg["dirichlet_alpha"]] * self.num_actions)
+        probs = ((1 - cfg["dirichlet_frac"]) * probs
+                 + cfg["dirichlet_frac"] * noise)
+        for a in range(self.num_actions):
+            root.children[a] = _Node(prior=float(probs[a]))
+        root.visits = 1
+        root.value_sum = value
+        q_min, q_max = value, value
+
+        for _ in range(cfg["num_simulations"]):
+            node, path = root, [root]
+            # --- selection down to a leaf.
+            while node.children and not node.terminal:
+                total_n = math.sqrt(sum(c.visits
+                                        for c in node.children.values()))
+                best, best_score = None, -np.inf
+                for a, child in node.children.items():
+                    if child.visits and q_max > q_min:
+                        qn = (child.q() - q_min) / (q_max - q_min)
+                    else:
+                        qn = 0.0
+                    score = qn + cfg["c_puct"] * child.prior \
+                        * total_n / (1 + child.visits)
+                    if score > best_score:
+                        best, best_score = a, score
+                parent = node
+                node = parent.children[best]
+                if node.state is None and not node.terminal:
+                    # --- expansion: materialize by stepping a clone.
+                    self.env.set_state(parent.state)
+                    obs2, reward, term, trunc, _ = self.env.step(best)
+                    node.state = self.env.get_state()
+                    node.reward = float(reward)
+                    node.terminal = bool(term or trunc)
+                    if not node.terminal:
+                        p2, v2 = self._eval_net(np.asarray(obs2,
+                                                           np.float32))
+                        for a in range(self.num_actions):
+                            node.children[a] = _Node(prior=float(p2[a]))
+                        leaf_value = v2
+                    else:
+                        leaf_value = 0.0
+                    path.append(node)
+                    break
+                path.append(node)
+            else:
+                leaf_value = 0.0 if node.terminal else node.q()
+            # --- backup: each node is credited the value of its own
+            # future; the entering-edge reward is added when moving to
+            # the parent.
+            value = leaf_value
+            for n in reversed(path):
+                n.visits += 1
+                n.value_sum += value
+                q_min = min(q_min, n.q())
+                q_max = max(q_max, n.q())
+                value = n.reward / cfg["value_scale"] + gamma * value
+        visits = np.array([root.children[a].visits
+                           for a in range(self.num_actions)], np.float64)
+        return visits / visits.sum()
+
+    # ---------------------------------------------------------- sampling
+    def _self_play_episode(self) -> float:
+        cfg = self.cfg
+        obs, _ = self.env.reset(seed=int(self._rng.randint(2**31)))
+        obs = np.asarray(obs, np.float32)
+        rows = []
+        total = 0.0
+        rewards = []
+        for ply in range(cfg["max_episode_steps"]):
+            state = self.env.get_state()
+            pi = self._search(obs, state)
+            if ply < cfg["temperature_steps"]:
+                a = int(self._rng.choice(self.num_actions, p=pi))
+            else:
+                a = int(pi.argmax())
+            rows.append({"obs": obs, "pi": pi.astype(np.float32)})
+            # Simulations mutated the env through set_state — restore
+            # the real trajectory's state before the actual step.
+            self.env.set_state(state)
+            obs2, reward, term, trunc, _ = self.env.step(a)
+            rewards.append(float(reward))
+            total += float(reward)
+            self._timesteps_total += 1
+            obs = np.asarray(obs2, np.float32)
+            if term or trunc:
+                break
+        # Discounted return-to-go as the value target, normalized.
+        g = 0.0
+        for row, r in zip(reversed(rows), reversed(rewards)):
+            g = r + cfg["gamma"] * g
+            row["z"] = np.float32(
+                np.clip(g / cfg["value_scale"], 0.0, 1.0))
+        self._replay.extend(rows)
+        if len(self._replay) > cfg["replay_capacity"]:
+            self._replay = self._replay[-cfg["replay_capacity"]:]
+        return total
+
+    # ---------------------------------------------------------- learning
+    def _train_step_impl(self, params, opt_state, obs, pi, z):
+        def loss_fn(p):
+            logits, value = self.net.apply(p, obs)
+            policy_loss = -(pi * jax.nn.log_softmax(logits)).sum(-1)
+            value_loss = (value - z) ** 2
+            return (policy_loss + value_loss).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        self._iter += 1
+        rets = [self._self_play_episode()
+                for _ in range(cfg["episodes_per_iter"])]
+        self._episode_rewards += rets
+        loss = np.nan
+        for _ in range(cfg["num_sgd_steps"]):
+            if len(self._replay) < cfg["train_batch_size"]:
+                break
+            idx = self._rng.randint(0, len(self._replay),
+                                    cfg["train_batch_size"])
+            obs = jnp.asarray(np.stack(
+                [self._replay[i]["obs"] for i in idx]))
+            pi = jnp.asarray(np.stack(
+                [self._replay[i]["pi"] for i in idx]))
+            z = jnp.asarray(np.asarray(
+                [self._replay[i]["z"] for i in idx], np.float32))
+            self.params, self.opt_state, jloss = self._train_step(
+                self.params, self.opt_state, obs, pi, z)
+            loss = float(jloss)
+        recent = self._episode_rewards[-20:]
+        return {"episode_reward_mean": float(np.mean(recent)),
+                "episode_reward_this_iter": float(np.mean(rets)),
+                "az_loss": loss,
+                "timesteps_total": self._timesteps_total}
+
+    def save_checkpoint(self) -> Dict:
+        return {"params": jax.tree_util.tree_map(np.asarray,
+                                                 self.params),
+                "iter": self._iter,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.params = jax.tree_util.tree_map(jnp.asarray,
+                                                 data["params"])
+            self._iter = data.get("iter", 0)
+            self._timesteps_total = data.get("timesteps_total", 0)
+
+    def cleanup(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass

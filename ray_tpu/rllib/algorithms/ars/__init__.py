@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.ars.ars import ARS, ARSConfig  # noqa: F401

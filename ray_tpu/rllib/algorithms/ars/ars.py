@@ -1,0 +1,225 @@
+"""ARS: augmented random search — derivative-free linear/MLP policy
+search with the three ARS augmentations over vanilla random search:
+(1) divide the update by the std of the collected returns, (2) use only
+the top-k best perturbation directions, (3) normalize observations with
+running mean/std shared across evaluations.
+
+Reference: rllib/algorithms/ars/ars.py (Workers evaluate mirrored noise
+deltas; ars.py:~train collects top-`num_top` directions and scales the
+step by the return std; observation filtering via MeanStdFilter).
+Re-designed like our ES: evaluations are stateless remote tasks (the
+seed regenerates the noise), and the running obs filter is folded on the
+driver from per-task sufficient statistics instead of a filter actor.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.es.es import (_episode_return, _mlp_shapes,
+                                            _unflatten)
+from ray_tpu.tune.trainable import Trainable
+
+
+class _RunningStat:
+    """Mean/std over all observations seen (reference:
+    utils/filter.py MeanStdFilter sufficient statistics)."""
+
+    def __init__(self, dim: int):
+        self.n = 0
+        self.mean = np.zeros(dim, np.float64)
+        self.m2 = np.zeros(dim, np.float64)
+
+    def merge(self, n: int, mean: np.ndarray, m2: np.ndarray):
+        if n == 0:
+            return
+        delta = mean - self.mean
+        tot = self.n + n
+        self.mean += delta * n / tot
+        self.m2 += m2 + delta * delta * self.n * n / tot
+        self.n = tot
+
+    def std(self) -> np.ndarray:
+        if self.n < 2:
+            return np.ones_like(self.mean)
+        return np.sqrt(np.maximum(self.m2 / (self.n - 1), 1e-8))
+
+
+def _normed_episode(layers, env, max_steps: int, seed: int,
+                    mean: np.ndarray, std: np.ndarray):
+    """Episode with observation normalization; returns (ret, steps,
+    obs-sum, obs-sumsq) so the driver can fold the filter."""
+    obs, _ = env.reset(seed=seed)
+    total, steps = 0.0, 0
+    s = np.zeros_like(mean)
+    ss = np.zeros_like(mean)
+    for _ in range(max_steps):
+        s += obs
+        ss += obs * obs
+        from ray_tpu.rllib.algorithms.es.es import _mlp_act
+        a = _mlp_act(layers, (obs - mean) / std)
+        obs, reward, terminated, truncated, _ = env.step(a)
+        total += float(reward)
+        steps += 1
+        if terminated or truncated:
+            break
+    return total, steps, s, ss
+
+
+def _ars_eval(flat_params: np.ndarray, noise_seed: int, sigma: float,
+              env_name: str, env_config: Dict, shapes,
+              max_steps: int, mean: np.ndarray, std: np.ndarray):
+    """Evaluate one mirrored delta pair under the frozen obs filter;
+    ships back per-direction returns plus obs sufficient stats."""
+    import gymnasium as gym
+    rng = np.random.RandomState(noise_seed)
+    eps = rng.randn(flat_params.size).astype(np.float32)
+    env = gym.make(env_name, **(env_config or {}))
+    rets, steps = [], 0
+    s = np.zeros_like(mean)
+    ss = np.zeros_like(mean)
+    count = 0
+    for sign in (1.0, -1.0):
+        layers = _unflatten(flat_params + sign * sigma * eps, shapes)
+        ret, n, es_, ess = _normed_episode(
+            layers, env, max_steps, seed=noise_seed * 1000 + int(sign),
+            mean=mean, std=std)
+        rets.append(ret)
+        steps += n
+        s += es_
+        ss += ess
+        count += n
+    env.close()
+    return noise_seed, rets[0], rets[1], steps, count, s, ss
+
+
+class ARSConfig:
+    def __init__(self):
+        self.algo_class = ARS
+        self._config: Dict = {
+            "env": "CartPole-v1",
+            "env_config": {},
+            "num_deltas": 16,        # mirrored pairs per iteration
+            "num_top": 8,            # directions kept for the update
+            "sigma": 0.05,
+            "lr": 0.02,
+            "max_episode_steps": 500,
+            "fcnet_hiddens": (),     # ARS default: LINEAR policy
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "ARSConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "ARSConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "ARSConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "ARS":
+        return ARS(config=self.to_dict())
+
+
+class ARS(Trainable):
+    """Each train() = one ARS-V2 step (top directions + return-std
+    scaling + running obs normalization)."""
+
+    def setup(self, config: Dict):
+        defaults = ARSConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        import gymnasium as gym
+        env = gym.make(self.cfg["env"], **self.cfg["env_config"])
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+        self.shapes = _mlp_shapes(obs_dim, num_actions,
+                                  tuple(self.cfg["fcnet_hiddens"]))
+        n = sum(i * o + o for i, o in self.shapes)
+        self.flat_params = np.zeros(n, np.float32)  # ARS: start at 0
+        self.filter = _RunningStat(obs_dim)
+        self._eval_task = ray_tpu.remote(_ars_eval)
+        self._next_seed = self.cfg["seed"] * 100_000 + 1
+        self._timesteps_total = 0
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        seeds = [self._next_seed + i for i in range(cfg["num_deltas"])]
+        self._next_seed += cfg["num_deltas"]
+        mean = self.filter.mean.copy()
+        std = self.filter.std()
+        params_ref = ray_tpu.put(self.flat_params)
+        refs = [self._eval_task.remote(
+            params_ref, s, cfg["sigma"], cfg["env"], cfg["env_config"],
+            self.shapes, cfg["max_episode_steps"], mean, std)
+            for s in seeds]
+        results = ray_tpu.get(refs, timeout=600)
+
+        # Fold obs statistics AFTER the rollouts (the filter used inside
+        # an iteration stays frozen — reference keeps per-iteration
+        # filter sync too).
+        for _, _, _, steps, count, s, ss in results:
+            self._timesteps_total += steps
+            if count:
+                m = s / count
+                self.filter.merge(count, m, ss - count * m * m)
+
+        # Keep only the top `num_top` directions by max(r+, r-).
+        scored = sorted(results,
+                        key=lambda r: max(r[1], r[2]), reverse=True)
+        top = scored[:cfg["num_top"]]
+        used_rets = np.array([[rp, rn] for _, rp, rn, _, _, _, _ in top],
+                             np.float32)
+        sigma_r = max(float(used_rets.std()), 1e-6)
+
+        grad = np.zeros_like(self.flat_params)
+        for (seed, rp, rn, *_rest) in top:
+            rng = np.random.RandomState(seed)
+            eps = rng.randn(self.flat_params.size).astype(np.float32)
+            grad += (rp - rn) * eps
+        self.flat_params = (
+            self.flat_params
+            + cfg["lr"] / (cfg["num_top"] * sigma_r) * grad
+        ).astype(np.float32)
+
+        # Evaluate the unperturbed policy under the updated filter.
+        import gymnasium as gym
+        env = gym.make(cfg["env"], **cfg["env_config"])
+        layers = _unflatten(self.flat_params, self.shapes)
+        eval_ret, _, _, _ = _normed_episode(
+            layers, env, cfg["max_episode_steps"],
+            seed=int(self._next_seed), mean=self.filter.mean.copy(),
+            std=self.filter.std())
+        env.close()
+        return {"episode_reward_mean": eval_ret,
+                "pop_reward_mean": float(
+                    np.mean([[rp, rn] for _, rp, rn, *_ in results])),
+                "return_std_used": sigma_r,
+                "timesteps_total": self._timesteps_total}
+
+    def save_checkpoint(self) -> Dict:
+        return {"flat_params": self.flat_params,
+                "filter": (self.filter.n, self.filter.mean,
+                           self.filter.m2),
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.flat_params = data["flat_params"]
+            n, mean, m2 = data["filter"]
+            self.filter.n, self.filter.mean, self.filter.m2 = n, mean, m2
+            self._timesteps_total = data.get("timesteps_total", 0)

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.crr.crr import CRR, CRRConfig  # noqa: F401

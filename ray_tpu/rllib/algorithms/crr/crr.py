@@ -1,0 +1,81 @@
+"""CRR: critic-regularized regression — offline continuous control by
+advantage-weighted behavior cloning against a TD-learned twin critic.
+
+Reference: rllib/algorithms/crr/crr.py — like CQL an offline algorithm
+(no rollout gang), but instead of penalizing OOD Q values it filters the
+behavior-cloning loss by the critic's advantage so only better-than-
+average dataset actions are imitated.  Loss math in
+policy/jax_crr_policy.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.jax_crr_policy import JaxCRRPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(CRR)
+        self._config.update({
+            "lr": 3e-4,
+            "critic_lr": 3e-4,
+            "tau": 0.995,
+            "crr_weight_type": "bin",   # "bin" (1[A>0]) or "exp"
+            "crr_beta": 1.0,            # exp-weight temperature
+            "crr_n_action_samples": 4,
+            "num_rollout_workers": 0,   # offline: no rollout gang
+            "sgd_batch_size": 256,
+            "num_sgd_steps": 100,
+            "input_data": None,
+            "evaluation_steps": 0,
+        })
+
+    def offline_data(self, input_data) -> "CRRConfig":
+        self._config["input_data"] = input_data
+        return self
+
+
+class CRR(Algorithm):
+    policy_cls = JaxCRRPolicy
+
+    def _extra_defaults(self) -> Dict:
+        return dict(CRRConfig()._config)
+
+    def setup(self, config: Dict):
+        super().setup(config)
+        data = self.algo_config.get("input_data")
+        if data is None:
+            raise ValueError("CRR needs config.offline_data(...) with "
+                             "obs/actions/rewards/dones/new_obs arrays "
+                             "or a path of offline .json files")
+        if isinstance(data, str):
+            from ray_tpu.rllib.offline import read_sample_batches
+            self.offline_batch = read_sample_batches(data)
+        else:
+            self.offline_batch = SampleBatch(
+                {k: np.asarray(v) for k, v in data.items()})
+        self._rng = np.random.RandomState(self.algo_config["seed"])
+
+    def training_step(self) -> Dict:
+        cfg = self.algo_config
+        policy = self.workers.local_worker.policy
+        n = self.offline_batch.count
+        stats: Dict = {}
+        for _ in range(cfg["num_sgd_steps"]):
+            idx = self._rng.randint(0, n,
+                                    size=min(cfg["sgd_batch_size"], n))
+            mb = SampleBatch({k: v[idx]
+                              for k, v in self.offline_batch.items()})
+            stats = policy.learn_on_batch(mb)
+        if cfg["evaluation_steps"]:
+            self.workers.local_worker.sample(cfg["evaluation_steps"])
+        return {"info": {"learner": stats},
+                "num_env_steps_trained": 0,
+                "num_offline_steps_trained":
+                    cfg["num_sgd_steps"] * min(cfg["sgd_batch_size"], n)}

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.dt.dt import DT, DTConfig  # noqa: F401

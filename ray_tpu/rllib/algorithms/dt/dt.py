@@ -1,0 +1,287 @@
+"""DT: Decision Transformer — offline RL as return-conditioned sequence
+modeling (Chen et al. 2021).
+
+Reference: rllib/algorithms/dt/dt.py (+ dt_torch_model.py) — episodes
+become token sequences (return-to-go, observation, action) * K; a causal
+transformer is trained to predict the action at each observation token;
+at evaluation the model is conditioned on a target return and rolled out
+autoregressively, decrementing the return-to-go by observed rewards.
+
+Re-derived jax-first: the model is a tiny pre-LN causal transformer
+whose full training step (sampled-subsequence batch -> cross-entropy ->
+adam) is one jitted function; evaluation reuses the same jitted forward
+with a sliding K-window.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.tune.trainable import Trainable
+
+
+class _Block(nn.Module):
+    dim: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x, mask):
+        h = nn.LayerNorm()(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, qkv_features=self.dim)(h, h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(4 * self.dim)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim)(h)
+        return x + h
+
+
+class _DTModel(nn.Module):
+    """Tokens per timestep: (rtg, obs, action); action predicted from
+    the obs-token stream."""
+
+    obs_dim: int
+    num_actions: int
+    context_len: int
+    dim: int = 64
+    heads: int = 4
+    layers: int = 2
+
+    @nn.compact
+    def __call__(self, rtg, obs, actions):
+        # rtg: (B, K, 1) obs: (B, K, obs_dim) actions: (B, K) int32
+        B, K = rtg.shape[0], rtg.shape[1]
+        t_emb = self.param("time_emb",
+                           nn.initializers.normal(0.02),
+                           (self.context_len, self.dim))[:K]
+        e_r = nn.Dense(self.dim)(rtg) + t_emb
+        e_s = nn.Dense(self.dim)(obs) + t_emb
+        e_a = nn.Embed(self.num_actions + 1, self.dim)(actions) + t_emb
+        # Interleave (r_1, s_1, a_1, r_2, ...) -> (B, 3K, dim).
+        x = jnp.stack([e_r, e_s, e_a], axis=2).reshape(B, 3 * K,
+                                                       self.dim)
+        mask = nn.make_causal_mask(jnp.zeros((B, 3 * K)))
+        for _ in range(self.layers):
+            x = _Block(dim=self.dim, heads=self.heads)(x, mask)
+        x = nn.LayerNorm()(x)
+        # Obs tokens sit at positions 3t+1; their outputs predict a_t.
+        s_out = x.reshape(B, K, 3, self.dim)[:, :, 1, :]
+        return nn.Dense(self.num_actions)(s_out)  # (B, K, A)
+
+
+class DTConfig:
+    def __init__(self):
+        self.algo_class = DT
+        self._config: Dict = {
+            "env": "CartPole-v1",
+            "env_config": {},
+            "context_len": 20,
+            "embed_dim": 64,
+            "num_heads": 4,
+            "num_layers": 2,
+            "lr": 1e-3,
+            "train_batch_size": 64,
+            "num_sgd_steps": 100,
+            "target_return": 200.0,
+            "num_eval_episodes": 5,
+            "max_episode_steps": 500,
+            "input_data": None,   # list of episode dicts (obs, actions,
+                                  # rewards) or offline .json path
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "DTConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "DTConfig":
+        self._config.update(kwargs)
+        return self
+
+    def offline_data(self, input_data) -> "DTConfig":
+        self._config["input_data"] = input_data
+        return self
+
+    def debugging(self, seed=None) -> "DTConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "DT":
+        return DT(config=self.to_dict())
+
+
+class DT(Trainable):
+    def setup(self, config: Dict):
+        defaults = DTConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        import gymnasium as gym
+        env = gym.make(self.cfg["env"], **self.cfg["env_config"])
+        self.obs_dim = int(np.prod(env.observation_space.shape))
+        self.num_actions = int(env.action_space.n)
+        env.close()
+        data = self.cfg["input_data"]
+        if data is None:
+            raise ValueError("DT needs config.offline_data([...]) — a "
+                             "list of {obs, actions, rewards} episodes "
+                             "or an offline .json path")
+        if isinstance(data, str):
+            self.episodes = self._episodes_from_json(data)
+        else:
+            self.episodes = list(data)
+        # Precompute returns-to-go per episode.
+        for ep in self.episodes:
+            r = np.asarray(ep["rewards"], np.float32)
+            ep["rtg"] = np.cumsum(r[::-1])[::-1].copy()
+        K = self.cfg["context_len"]
+        self.model = _DTModel(
+            obs_dim=self.obs_dim, num_actions=self.num_actions,
+            context_len=K, dim=self.cfg["embed_dim"],
+            heads=self.cfg["num_heads"], layers=self.cfg["num_layers"])
+        rng = jax.random.PRNGKey(self.cfg["seed"])
+        self.params = self.model.init(
+            rng, jnp.zeros((1, K, 1)), jnp.zeros((1, K, self.obs_dim)),
+            jnp.zeros((1, K), jnp.int32))
+        self.tx = optax.adam(self.cfg["lr"])
+        self.opt_state = self.tx.init(self.params)
+        self._forward = jax.jit(self.model.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+        self._rng = np.random.RandomState(self.cfg["seed"] + 1)
+        self._iter = 0
+
+    @staticmethod
+    def _episodes_from_json(path: str) -> List[Dict]:
+        """Split offline SampleBatch files into episodes on done flags."""
+        from ray_tpu.rllib.offline import read_sample_batches
+        batch = read_sample_batches(path)
+        eps, start = [], 0
+        dones = np.asarray(batch["dones"])
+        for i, d in enumerate(dones):
+            if d or i == len(dones) - 1:
+                eps.append({
+                    "obs": np.asarray(batch["obs"][start:i + 1],
+                                      np.float32),
+                    "actions": np.asarray(batch["actions"][start:i + 1],
+                                          np.int64),
+                    "rewards": np.asarray(batch["rewards"][start:i + 1],
+                                          np.float32)})
+                start = i + 1
+        return eps
+
+    # ---------------------------------------------------------- training
+    def _sample_batch(self):
+        K = self.cfg["context_len"]
+        B = self.cfg["train_batch_size"]
+        rtg = np.zeros((B, K, 1), np.float32)
+        obs = np.zeros((B, K, self.obs_dim), np.float32)
+        acts = np.full((B, K), self.num_actions, np.int64)  # pad token
+        tgt = np.zeros((B, K), np.int64)
+        mask = np.zeros((B, K), np.float32)
+        # Episodes sampled proportionally to length (reference dt
+        # SegmentationBuffer's weighting).
+        lens = np.asarray([len(e["rewards"]) for e in self.episodes],
+                          np.float64)
+        probs = lens / lens.sum()
+        for b in range(B):
+            ep = self.episodes[self._rng.choice(len(self.episodes),
+                                                p=probs)]
+            T = len(ep["rewards"])
+            end = self._rng.randint(1, T + 1)      # inclusive end index
+            start = max(0, end - K)
+            L = end - start
+            rtg[b, :L, 0] = ep["rtg"][start:end]
+            obs[b, :L] = ep["obs"][start:end]
+            tgt[b, :L] = ep["actions"][start:end]
+            # Input actions are shifted: a_t is PREDICTED at s_t, so the
+            # action token at t feeds step t+1; position t holds a_{t}
+            # for the attention of later tokens (training uses teacher
+            # forcing with the true actions).
+            acts[b, :L] = ep["actions"][start:end]
+            mask[b, :L] = 1.0
+        return (jnp.asarray(rtg), jnp.asarray(obs), jnp.asarray(acts),
+                jnp.asarray(tgt), jnp.asarray(mask))
+
+    def _train_step_impl(self, params, opt_state, rtg, obs, acts, tgt,
+                         mask):
+        def loss_fn(p):
+            logits = self.model.apply(p, rtg, obs, acts)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                       axis=-1)[..., 0]
+            return (nll * mask).sum() / mask.sum()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def step(self) -> Dict:
+        self._iter += 1
+        loss = np.nan
+        for _ in range(self.cfg["num_sgd_steps"]):
+            rtg, obs, acts, tgt, mask = self._sample_batch()
+            self.params, self.opt_state, jloss = self._train_step(
+                self.params, self.opt_state, rtg, obs, acts, tgt, mask)
+            loss = float(jloss)
+        rets = [self.evaluate_episode(self.cfg["target_return"])
+                for _ in range(self.cfg["num_eval_episodes"])]
+        return {"episode_reward_mean": float(np.mean(rets)),
+                "action_nll": loss,
+                "training_iteration_": self._iter}
+
+    # -------------------------------------------------------- evaluation
+    def evaluate_episode(self, target_return: float) -> float:
+        import gymnasium as gym
+        cfg = self.cfg
+        K = cfg["context_len"]
+        env = gym.make(cfg["env"], **cfg["env_config"])
+        obs, _ = env.reset(seed=int(self._rng.randint(2**31)))
+        rtgs, obss, acts = [float(target_return)], [obs], []
+        total = 0.0
+        for _ in range(cfg["max_episode_steps"]):
+            L = min(len(obss), K)
+            rtg_in = np.zeros((1, K, 1), np.float32)
+            obs_in = np.zeros((1, K, self.obs_dim), np.float32)
+            act_in = np.full((1, K), self.num_actions, np.int64)
+            rtg_in[0, :L, 0] = rtgs[-L:]
+            obs_in[0, :L] = np.asarray(obss[-L:], np.float32)
+            if len(acts) > 0:
+                prev = acts[-(L - 1):] if L > 1 else []
+                act_in[0, :len(prev)] = prev
+            logits = self._forward(self.params, jnp.asarray(rtg_in),
+                                   jnp.asarray(obs_in),
+                                   jnp.asarray(act_in))
+            a = int(np.asarray(logits)[0, L - 1].argmax())
+            obs, reward, term, trunc, _ = env.step(a)
+            total += float(reward)
+            acts.append(a)
+            obss.append(obs)
+            rtgs.append(rtgs[-1] - float(reward))
+            if term or trunc:
+                break
+        env.close()
+        return total
+
+    def save_checkpoint(self) -> Dict:
+        return {"params": jax.tree_util.tree_map(np.asarray,
+                                                 self.params),
+                "iter": self._iter}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.params = jax.tree_util.tree_map(jnp.asarray,
+                                                 data["params"])
+            self._iter = data.get("iter", 0)

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.maddpg.maddpg import (  # noqa: F401
+    MADDPG,
+    MADDPGConfig,
+)

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.qmix.qmix import QMix, QMixConfig  # noqa: F401

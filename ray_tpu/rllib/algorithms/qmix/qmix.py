@@ -1,0 +1,278 @@
+"""QMIX: cooperative multi-agent Q-learning with a monotonic mixing
+network.
+
+Reference: rllib/algorithms/qmix/qmix.py (+ qmix_policy.py's QMixer) —
+per-agent utility networks Q_i(o_i, a_i) are combined into a joint
+Q_tot(s, a) by a hypernetwork-generated mixer whose weights are
+constrained non-negative, so argmax decomposes per agent while credit
+assignment uses the centralized state.  Re-derived jax-first: agent
+nets (parameter-shared with an agent-id one-hot, the standard QMIX
+trick) and the mixer train end-to-end in one jitted TD step.
+
+Works on any `MultiAgentEnv` whose team is fixed (all agents act every
+step); the global state is `env.state()` when defined, else the
+concatenation of agent observations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.tune.trainable import Trainable
+
+
+class _AgentQNet(nn.Module):
+    num_actions: int
+    hiddens: tuple = (64,)
+
+    @nn.compact
+    def __call__(self, obs):
+        h = obs
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        return nn.Dense(self.num_actions)(h)
+
+
+class _Mixer(nn.Module):
+    """Monotonic mixer: Q_tot = w2(s)·elu(w1(s)·q + b1(s)) + b2(s) with
+    w1, w2 >= 0 via abs (reference qmix_policy.QMixer)."""
+
+    n_agents: int
+    embed: int = 32
+
+    @nn.compact
+    def __call__(self, qs, state):
+        B = qs.shape[0]
+        w1 = jnp.abs(nn.Dense(self.n_agents * self.embed)(state))
+        w1 = w1.reshape(B, self.n_agents, self.embed)
+        b1 = nn.Dense(self.embed)(state)
+        hidden = nn.elu(jnp.einsum("ba,bae->be", qs, w1) + b1)
+        w2 = jnp.abs(nn.Dense(self.embed)(state))
+        b2 = nn.Dense(1)(nn.relu(nn.Dense(self.embed)(state)))[..., 0]
+        return (hidden * w2).sum(-1) + b2
+
+
+class QMixConfig:
+    def __init__(self):
+        self.algo_class = QMix
+        self._config: Dict = {
+            "env": None,            # MultiAgentEnv subclass or creator
+            "env_config": {},
+            "lr": 5e-4,
+            "gamma": 0.99,
+            "mixing_embed_dim": 32,
+            "buffer_capacity": 5000,
+            "train_batch_size": 32,
+            "num_sgd_steps": 40,
+            "episodes_per_iter": 16,
+            "target_update_freq": 4,
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.05,
+            "epsilon_anneal_iters": 12,
+            "fcnet_hiddens": (64,),
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "QMixConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "QMixConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "QMixConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "QMix":
+        return QMix(config=self.to_dict())
+
+
+class QMix(Trainable):
+    def setup(self, config: Dict):
+        defaults = QMixConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        env_cls = self.cfg["env"]
+        self.env = env_cls(self.cfg["env_config"])
+        self.agents = list(self.env.possible_agents)
+        self.n_agents = len(self.agents)
+        obs_space = self.env.observation_space(self.agents[0])
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.num_actions = int(self.env.action_space(self.agents[0]).n)
+        # Input = obs ++ one-hot agent id (parameter sharing).
+        in_dim = self.obs_dim + self.n_agents
+        self.agent_net = _AgentQNet(
+            num_actions=self.num_actions,
+            hiddens=tuple(self.cfg["fcnet_hiddens"]))
+        self.env.reset(seed=self.cfg["seed"])  # state() needs live env
+        state_dim = (int(np.prod(np.shape(self.env.state())))
+                     if hasattr(self.env, "state")
+                     else self.obs_dim * self.n_agents)
+        self.mixer = _Mixer(n_agents=self.n_agents,
+                            embed=self.cfg["mixing_embed_dim"])
+        rng = jax.random.PRNGKey(self.cfg["seed"])
+        k1, k2 = jax.random.split(rng)
+        self.params = {
+            "agent": self.agent_net.init(
+                k1, jnp.zeros((1, in_dim), jnp.float32)),
+            "mixer": self.mixer.init(
+                k2, jnp.zeros((1, self.n_agents), jnp.float32),
+                jnp.zeros((1, state_dim), jnp.float32)),
+        }
+        self.target_params = self.params
+        self.tx = optax.adam(self.cfg["lr"])
+        self.opt_state = self.tx.init(self.params)
+        self._agent_forward = jax.jit(self.agent_net.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+        self._rng = np.random.RandomState(self.cfg["seed"] + 1)
+        self._eye = np.eye(self.n_agents, dtype=np.float32)
+        self._buffer: List[Dict] = []
+        self._iter = 0
+        self._timesteps_total = 0
+        self._episode_rewards: List[float] = []
+
+    # ---------------------------------------------------------- plumbing
+    def _state(self, obs: Dict[str, np.ndarray]) -> np.ndarray:
+        if hasattr(self.env, "state"):
+            return np.asarray(self.env.state(), np.float32).reshape(-1)
+        return np.concatenate([np.asarray(obs[a], np.float32).reshape(-1)
+                               for a in self.agents])
+
+    def _stack_obs(self, obs: Dict[str, np.ndarray]) -> np.ndarray:
+        """(n_agents, obs_dim + n_agents) with agent-id one-hots."""
+        rows = [np.concatenate([
+            np.asarray(obs[a], np.float32).reshape(-1), self._eye[i]])
+            for i, a in enumerate(self.agents)]
+        return np.stack(rows)
+
+    def _act(self, obs: Dict, eps: float) -> Dict[str, int]:
+        q = np.asarray(self._agent_forward(
+            self.params["agent"], jnp.asarray(self._stack_obs(obs))))
+        actions = {}
+        for i, a in enumerate(self.agents):
+            if self._rng.rand() < eps:
+                actions[a] = int(self._rng.randint(self.num_actions))
+            else:
+                actions[a] = int(q[i].argmax())
+        return actions
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self._iter / max(cfg["epsilon_anneal_iters"], 1))
+        return (cfg["initial_epsilon"]
+                + frac * (cfg["final_epsilon"] - cfg["initial_epsilon"]))
+
+    # ---------------------------------------------------------- sampling
+    def _run_episode(self, eps: float) -> float:
+        obs, _ = self.env.reset(seed=int(self._rng.randint(2**31)))
+        total = 0.0
+        done = False
+        while not done:
+            state = self._state(obs)
+            actions = self._act(obs, eps)
+            obs2, rews, terms, truncs, _ = self.env.step(actions)
+            done = terms.get("__all__", False) or truncs.get("__all__",
+                                                             False)
+            reward = float(sum(rews.values()))  # cooperative team reward
+            self._buffer.append({
+                "obs": self._stack_obs(obs), "state": state,
+                "actions": np.asarray([actions[a] for a in self.agents],
+                                      np.int32),
+                "reward": reward, "done": done,
+                "next_obs": (self._stack_obs(obs2) if obs2
+                             else self._stack_obs(obs)),
+                "next_state": (self._state(obs2) if obs2 else state)})
+            if len(self._buffer) > self.cfg["buffer_capacity"]:
+                self._buffer.pop(0)
+            total += reward
+            self._timesteps_total += 1
+            obs = obs2 if obs2 else obs
+        return total
+
+    # ---------------------------------------------------------- learning
+    def _train_step_impl(self, params, target_params, opt_state, batch):
+        gamma = self.cfg["gamma"]
+
+        def loss_fn(p):
+            B, n, _ = batch["obs"].shape
+            q_all = self.agent_net.apply(
+                p["agent"], batch["obs"].reshape(B * n, -1)
+            ).reshape(B, n, -1)
+            qa = jnp.take_along_axis(
+                q_all, batch["actions"][..., None], axis=-1)[..., 0]
+            q_tot = self.mixer.apply(p["mixer"], qa, batch["state"])
+
+            tq_all = self.agent_net.apply(
+                target_params["agent"],
+                batch["next_obs"].reshape(B * n, -1)).reshape(B, n, -1)
+            # Monotonicity => joint argmax decomposes per agent.
+            tqa = tq_all.max(axis=-1)
+            t_tot = self.mixer.apply(target_params["mixer"], tqa,
+                                     batch["next_state"])
+            target = batch["reward"] + gamma * t_tot * (
+                1.0 - batch["done"].astype(jnp.float32))
+            return ((q_tot - jax.lax.stop_gradient(target)) ** 2).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        self._iter += 1
+        eps = self._epsilon()
+        rets = [self._run_episode(eps)
+                for _ in range(cfg["episodes_per_iter"])]
+        self._episode_rewards += rets
+        loss = np.nan
+        for _ in range(cfg["num_sgd_steps"]):
+            if len(self._buffer) < cfg["train_batch_size"]:
+                break
+            idx = self._rng.randint(0, len(self._buffer),
+                                    cfg["train_batch_size"])
+            cols = {k: jnp.asarray(np.stack(
+                [self._buffer[i][k] for i in idx]))
+                for k in ("obs", "state", "actions", "reward", "done",
+                          "next_obs", "next_state")}
+            self.params, self.opt_state, jloss = self._train_step(
+                self.params, self.target_params, self.opt_state, cols)
+            loss = float(jloss)
+        if self._iter % cfg["target_update_freq"] == 0:
+            self.target_params = self.params
+        recent = self._episode_rewards[-100:]
+        return {"episode_reward_mean": float(np.mean(recent)),
+                "episode_reward_this_iter": float(np.mean(rets)),
+                "td_loss": loss, "epsilon": eps,
+                "timesteps_total": self._timesteps_total}
+
+    def greedy_actions(self, obs: Dict) -> Dict[str, int]:
+        """Deterministic joint action (for tests/eval)."""
+        return self._act(obs, eps=0.0)
+
+    def save_checkpoint(self) -> Dict:
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "iter": self._iter,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.params = jax.tree_util.tree_map(jnp.asarray,
+                                                 data["params"])
+            self.target_params = self.params
+            self._iter = data.get("iter", 0)
+            self._timesteps_total = data.get("timesteps_total", 0)

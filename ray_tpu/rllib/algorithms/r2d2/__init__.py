@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.r2d2.r2d2 import R2D2, R2D2Config  # noqa: F401

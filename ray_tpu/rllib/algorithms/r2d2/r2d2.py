@@ -1,0 +1,290 @@
+"""R2D2: recurrent replay distributed DQN (Kapturowski et al. 2019).
+
+Reference: rllib/algorithms/r2d2/r2d2.py — DQN with an LSTM Q-network
+trained on replayed SEQUENCES: each sampled segment is split into a
+burn-in prefix (unrolled only to warm the recurrent state) and a
+training suffix on which the double-Q TD loss is applied.  This is the
+memory-equipped member of the DQN family — it solves partially
+observable tasks feedforward DQN cannot.
+
+Re-derived jax-first: the LSTM unroll is `nn.scan` inside the network,
+so burn-in + train unroll + masked TD loss + adam compile into one
+jitted step over a (B, T) segment batch; episode collection keeps the
+carry across steps exactly as the deployed policy would.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.tune.trainable import Trainable
+
+
+class _RecurrentQNet(nn.Module):
+    """Dense -> LSTM (scanned over time) -> dueling Q head."""
+
+    num_actions: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, obs_seq, carry):
+        # obs_seq: (B, T, obs_dim); carry: LSTM (c, h) each (B, hidden).
+        x = nn.relu(nn.Dense(self.hidden)(obs_seq))
+        lstm = nn.scan(nn.OptimizedLSTMCell,
+                       variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=1, out_axes=1)(features=self.hidden)
+        carry, h = lstm(carry, x)
+        adv = nn.Dense(self.num_actions)(h)
+        val = nn.Dense(1)(h)
+        q = val + adv - adv.mean(axis=-1, keepdims=True)
+        return q, carry
+
+    @staticmethod
+    def initial_carry(batch: int, hidden: int):
+        zeros = jnp.zeros((batch, hidden), jnp.float32)
+        return (zeros, zeros)
+
+
+class R2D2Config:
+    def __init__(self):
+        self.algo_class = R2D2
+        self._config: Dict = {
+            "env": "CartPole-v1",
+            "env_config": {},
+            "lr": 1e-3,
+            "gamma": 0.997,
+            "lstm_hidden": 64,
+            "burn_in": 8,
+            "train_len": 20,
+            "episodes_per_iter": 8,
+            "max_episode_steps": 500,
+            "buffer_capacity_episodes": 300,
+            "train_batch_size": 32,      # segments per SGD step
+            "num_sgd_steps": 40,
+            "learning_starts_episodes": 16,
+            "target_update_freq": 4,
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.05,
+            "epsilon_anneal_iters": 15,
+            "double_q": True,
+            "obs_mask": None,    # indices of obs dims VISIBLE to the
+                                 # policy (None = all) — partial-obs knob
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "R2D2Config":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "R2D2Config":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "R2D2Config":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "R2D2":
+        return R2D2(config=self.to_dict())
+
+
+class R2D2(Trainable):
+    def setup(self, config: Dict):
+        defaults = R2D2Config().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        import gymnasium as gym
+        self.env = gym.make(self.cfg["env"], **self.cfg["env_config"])
+        full_dim = int(np.prod(self.env.observation_space.shape))
+        self._mask = (np.asarray(self.cfg["obs_mask"], np.int64)
+                      if self.cfg["obs_mask"] is not None else None)
+        self.obs_dim = (len(self._mask) if self._mask is not None
+                        else full_dim)
+        self.num_actions = int(self.env.action_space.n)
+        self.hidden = self.cfg["lstm_hidden"]
+        self.net = _RecurrentQNet(num_actions=self.num_actions,
+                                  hidden=self.hidden)
+        rng = jax.random.PRNGKey(self.cfg["seed"])
+        self.params = self.net.init(
+            rng, jnp.zeros((1, 1, self.obs_dim), jnp.float32),
+            _RecurrentQNet.initial_carry(1, self.hidden))
+        self.target_params = self.params
+        self.tx = optax.adam(self.cfg["lr"])
+        self.opt_state = self.tx.init(self.params)
+        self._forward = jax.jit(self.net.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+        self._rng = np.random.RandomState(self.cfg["seed"] + 1)
+        self._episodes: List[Dict] = []
+        self._iter = 0
+        self._timesteps_total = 0
+        self._episode_rewards: List[float] = []
+
+    def _see(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32).reshape(-1)
+        return obs[self._mask] if self._mask is not None else obs
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self._iter / max(cfg["epsilon_anneal_iters"], 1))
+        return (cfg["initial_epsilon"]
+                + frac * (cfg["final_epsilon"] - cfg["initial_epsilon"]))
+
+    # ---------------------------------------------------------- sampling
+    def _run_episode(self, eps: float) -> float:
+        obs, _ = self.env.reset(seed=int(self._rng.randint(2**31)))
+        obs = self._see(obs)
+        carry = _RecurrentQNet.initial_carry(1, self.hidden)
+        rows = {"obs": [], "actions": [], "rewards": [], "dones": []}
+        total = 0.0
+        for _ in range(self.cfg["max_episode_steps"]):
+            q, carry = self._forward(
+                self.params, jnp.asarray(obs, jnp.float32)[None, None],
+                carry)
+            if self._rng.rand() < eps:
+                a = int(self._rng.randint(self.num_actions))
+            else:
+                a = int(np.asarray(q)[0, 0].argmax())
+            obs2, reward, term, trunc, _ = self.env.step(a)
+            rows["obs"].append(obs)
+            rows["actions"].append(a)
+            rows["rewards"].append(float(reward))
+            rows["dones"].append(bool(term))
+            total += float(reward)
+            self._timesteps_total += 1
+            obs = self._see(obs2)
+            if term or trunc:
+                break
+        rows["obs"].append(obs)  # trailing obs for the last TD target
+        ep = {k: np.asarray(v) for k, v in rows.items()}
+        ep["obs"] = ep["obs"].astype(np.float32)
+        self._episodes.append(ep)
+        if len(self._episodes) > self.cfg["buffer_capacity_episodes"]:
+            self._episodes.pop(0)
+        return total
+
+    # ---------------------------------------------------------- learning
+    def _train_step_impl(self, params, target_params, opt_state, batch):
+        cfg = self.cfg
+        gamma = cfg["gamma"]
+        burn = cfg["burn_in"]
+        B = batch["obs"].shape[0]
+
+        def loss_fn(p):
+            carry0 = _RecurrentQNet.initial_carry(B, self.hidden)
+            # Burn-in: warm the recurrent state without gradients.
+            if burn > 0:
+                _, carry = self.net.apply(
+                    p, batch["obs"][:, :burn], carry0)
+                carry = jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                               carry)
+                _, tcarry = self.net.apply(
+                    target_params, batch["obs"][:, :burn], carry0)
+            else:
+                carry = tcarry = carry0
+            # Train suffix; obs includes one trailing step for targets.
+            seq = batch["obs"][:, burn:]
+            q_all, _ = self.net.apply(p, seq, carry)
+            tq_all, _ = self.net.apply(target_params, seq, tcarry)
+            q = q_all[:, :-1]                       # (B, T, A)
+            qa = jnp.take_along_axis(
+                q, batch["actions"][..., None], axis=-1)[..., 0]
+            if cfg["double_q"]:
+                next_a = q_all[:, 1:].argmax(axis=-1)
+                q_next = jnp.take_along_axis(
+                    tq_all[:, 1:], next_a[..., None], axis=-1)[..., 0]
+            else:
+                q_next = tq_all[:, 1:].max(axis=-1)
+            target = batch["rewards"] + gamma * q_next * (
+                1.0 - batch["dones"].astype(jnp.float32))
+            td = qa - jax.lax.stop_gradient(target)
+            loss = (optax.huber_loss(td) * batch["mask"]).sum() \
+                / jnp.maximum(batch["mask"].sum(), 1.0)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def _sample_segments(self):
+        cfg = self.cfg
+        B = cfg["train_batch_size"]
+        burn, T = cfg["burn_in"], cfg["train_len"]
+        span = burn + T
+        obs = np.zeros((B, span + 1, self.obs_dim), np.float32)
+        acts = np.zeros((B, T), np.int32)
+        rews = np.zeros((B, T), np.float32)
+        dones = np.zeros((B, T), np.bool_)
+        mask = np.zeros((B, T), np.float32)
+        for b in range(B):
+            ep = self._episodes[self._rng.randint(len(self._episodes))]
+            L = len(ep["actions"])
+            start = self._rng.randint(0, max(1, L - burn))
+            seg = min(span, L - start)
+            obs[b, :seg + 1] = ep["obs"][start:start + seg + 1]
+            train_lo = start + burn
+            n = max(0, min(T, L - train_lo))
+            if n > 0:
+                acts[b, :n] = ep["actions"][train_lo:train_lo + n]
+                rews[b, :n] = ep["rewards"][train_lo:train_lo + n]
+                dones[b, :n] = ep["dones"][train_lo:train_lo + n]
+                mask[b, :n] = 1.0
+        return {k: jnp.asarray(v) for k, v in
+                (("obs", obs), ("actions", acts), ("rewards", rews),
+                 ("dones", dones), ("mask", mask))}
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        self._iter += 1
+        eps = self._epsilon()
+        rets = [self._run_episode(eps)
+                for _ in range(cfg["episodes_per_iter"])]
+        self._episode_rewards += rets
+        loss = np.nan
+        if len(self._episodes) >= cfg["learning_starts_episodes"]:
+            for _ in range(cfg["num_sgd_steps"]):
+                batch = self._sample_segments()
+                self.params, self.opt_state, jloss = self._train_step(
+                    self.params, self.target_params, self.opt_state,
+                    batch)
+                loss = float(jloss)
+            if self._iter % cfg["target_update_freq"] == 0:
+                self.target_params = self.params
+        recent = self._episode_rewards[-50:]
+        return {"episode_reward_mean": float(np.mean(recent)),
+                "episode_reward_this_iter": float(np.mean(rets)),
+                "td_loss": loss, "epsilon": eps,
+                "timesteps_total": self._timesteps_total}
+
+    def save_checkpoint(self) -> Dict:
+        return {"params": jax.tree_util.tree_map(np.asarray,
+                                                 self.params),
+                "iter": self._iter,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.params = jax.tree_util.tree_map(jnp.asarray,
+                                                 data["params"])
+            self.target_params = self.params
+            self._iter = data.get("iter", 0)
+            self._timesteps_total = data.get("timesteps_total", 0)
+
+    def cleanup(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass

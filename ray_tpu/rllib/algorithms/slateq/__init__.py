@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.slateq.slateq import (  # noqa: F401
+    SlateQ,
+    SlateQConfig,
+)

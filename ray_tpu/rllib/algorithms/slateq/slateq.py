@@ -1,0 +1,238 @@
+"""SlateQ: slate-based recommendation Q-learning via item-level
+decomposition.
+
+Reference: rllib/algorithms/slateq/slateq.py — the slate Q value
+decomposes into per-item Q values weighted by the user choice model
+(`Q(s, slate) = sum_i P(click i | slate) * Q(s, i)`), so learning stays
+tractable in the item space while slates are built greedily by choice-
+weighted item score.  TD updates use SARSA on the *served* next slate
+(on-policy decomposition, slateq.py "SARSA" learning method).
+
+Re-designed jax-first: the item scorer is a jitted (user, doc) -> Q
+network evaluated on all candidates in one batched forward; the toy
+interest-evolution env lives in env/recsim.py.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.env.recsim import InterestEvolutionRecSimEnv
+from ray_tpu.tune.trainable import Trainable
+
+
+class _ItemQNet(nn.Module):
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, user, doc):
+        h = jnp.concatenate([user, doc], axis=-1)
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        return nn.Dense(1)(h)[..., 0]
+
+
+class SlateQConfig:
+    def __init__(self):
+        self.algo_class = SlateQ
+        self._config: Dict = {
+            "env_config": {},
+            "lr": 1e-3,
+            "gamma": 0.95,
+            "train_batch_size": 32,     # transitions per SGD step
+            "num_sgd_steps": 20,
+            "episodes_per_iter": 8,
+            "buffer_capacity": 10_000,
+            "target_update_freq": 2,
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.05,
+            "epsilon_anneal_iters": 10,
+            "fcnet_hiddens": (64, 64),
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "SlateQConfig":
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "SlateQConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "SlateQConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "SlateQ":
+        return SlateQ(config=self.to_dict())
+
+
+class SlateQ(Trainable):
+    """Self-contained trainer (the slate action space doesn't fit the
+    discrete/Box RolloutWorker row schema, so sampling lives here)."""
+
+    def setup(self, config: Dict):
+        defaults = SlateQConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        self.env = InterestEvolutionRecSimEnv(
+            dict(self.cfg["env_config"], seed=self.cfg["seed"]))
+        self.k = self.env.slate_size
+        self.d = self.env.topic_dim
+        self.n_docs = self.env.num_docs
+        self.qnet = _ItemQNet(hiddens=tuple(self.cfg["fcnet_hiddens"]))
+        rng = jax.random.PRNGKey(self.cfg["seed"])
+        zu = jnp.zeros((1, self.d), jnp.float32)
+        zd = jnp.zeros((1, self.d + 1), jnp.float32)
+        self.params = self.qnet.init(rng, zu, zd)
+        self.target_params = self.params
+        self.tx = optax.adam(self.cfg["lr"])
+        self.opt_state = self.tx.init(self.params)
+        self._forward = jax.jit(self.qnet.apply)
+        self._train_step = jax.jit(self._train_step_impl)
+        self._rng = np.random.RandomState(self.cfg["seed"] + 1)
+        self._buffer: List[Dict] = []
+        self._iter = 0
+        self._timesteps_total = 0
+        self._episode_rewards: List[float] = []
+
+    # ------------------------------------------------- slate construction
+    def _split_obs(self, obs: np.ndarray):
+        user = obs[:self.d]
+        docs = obs[self.d:].reshape(self.n_docs, self.d + 1)
+        return user, docs
+
+    def _item_q(self, params, user, docs) -> np.ndarray:
+        u = jnp.broadcast_to(jnp.asarray(user, jnp.float32),
+                             (self.n_docs, self.d))
+        return np.asarray(self._forward(params, u,
+                                        jnp.asarray(docs, jnp.float32)))
+
+    def _best_slate(self, params, user, docs):
+        """Exact slate maximization of sum_i P(i|slate) Q_i over all
+        C(n, k) slates (reference slateq's optimizer for small n; the
+        toy env keeps n small so exact search is cheap)."""
+        q = self._item_q(params, user, docs)
+        scores = docs[:, :self.d] @ user          # choice-model logits
+        best, best_val = None, -np.inf
+        for slate in combinations(range(self.n_docs), self.k):
+            s = np.asarray(slate)
+            logits = np.append(scores[s], self.env.no_click_logit)
+            e = np.exp(logits - logits.max())
+            p = e / e.sum()
+            val = float((p[:-1] * q[s]).sum())
+            if val > best_val:
+                best, best_val = s, val
+        return best, best_val
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self._iter / max(cfg["epsilon_anneal_iters"], 1))
+        return (cfg["initial_epsilon"]
+                + frac * (cfg["final_epsilon"] - cfg["initial_epsilon"]))
+
+    # ---------------------------------------------------------- sampling
+    def _run_episode(self, eps: float) -> float:
+        obs, _ = self.env.reset(seed=int(self._rng.randint(2**31)))
+        total = 0.0
+        done = False
+        while not done:
+            user, docs = self._split_obs(obs)
+            if self._rng.rand() < eps:
+                slate = self._rng.choice(self.n_docs, self.k,
+                                         replace=False)
+            else:
+                slate, _ = self._best_slate(self.params, user, docs)
+            obs2, reward, done, _, info = self.env.step(slate)
+            self._buffer.append({
+                "user": user, "docs": docs, "slate": np.asarray(slate),
+                "clicked": info["clicked"], "reward": float(reward),
+                "next_obs": obs2, "done": done})
+            if len(self._buffer) > self.cfg["buffer_capacity"]:
+                self._buffer.pop(0)
+            total += reward
+            self._timesteps_total += 1
+            obs = obs2
+        return total
+
+    # ---------------------------------------------------------- learning
+    def _train_step_impl(self, params, opt_state, user, doc, target):
+        def loss_fn(p):
+            q = self.qnet.apply(p, user, doc)
+            return ((q - target) ** 2).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        self._iter += 1
+        eps = self._epsilon()
+        rets = [self._run_episode(eps)
+                for _ in range(cfg["episodes_per_iter"])]
+        self._episode_rewards += rets
+
+        # SARSA-style TD on clicked transitions: target = r + gamma *
+        # slate-value of the next state's best slate under the TARGET
+        # net (the decomposed E[Q] — slateq.py's next-slate value).
+        loss = np.nan
+        clicked = [t for t in self._buffer if t["clicked"] is not None]
+        for _ in range(cfg["num_sgd_steps"]):
+            if len(clicked) < cfg["train_batch_size"]:
+                break
+            idx = self._rng.randint(0, len(clicked),
+                                    cfg["train_batch_size"])
+            users, docs, targets = [], [], []
+            for i in idx:
+                t = clicked[i]
+                doc_row = t["docs"][t["clicked"]]
+                next_v = 0.0
+                if not t["done"]:
+                    nu, nd = self._split_obs(t["next_obs"])
+                    _, next_v = self._best_slate(self.target_params,
+                                                 nu, nd)
+                users.append(t["user"])
+                docs.append(doc_row)
+                targets.append(t["reward"] + cfg["gamma"] * next_v)
+            self.params, self.opt_state, jloss = self._train_step(
+                self.params, self.opt_state,
+                jnp.asarray(np.stack(users)),
+                jnp.asarray(np.stack(docs)),
+                jnp.asarray(np.asarray(targets, np.float32)))
+            loss = float(jloss)
+        if self._iter % cfg["target_update_freq"] == 0:
+            self.target_params = self.params
+
+        recent = self._episode_rewards[-50:]
+        return {"episode_reward_mean": float(np.mean(recent)),
+                "episode_reward_this_iter": float(np.mean(rets)),
+                "td_loss": loss, "epsilon": eps,
+                "buffer_clicked": len(clicked),
+                "timesteps_total": self._timesteps_total}
+
+    def save_checkpoint(self) -> Dict:
+        return {"params": jax.tree_util.tree_map(np.asarray,
+                                                 self.params),
+                "iter": self._iter,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.params = jax.tree_util.tree_map(jnp.asarray,
+                                                 data["params"])
+            self.target_params = self.params
+            self._iter = data.get("iter", 0)
+            self._timesteps_total = data.get("timesteps_total", 0)

@@ -1,0 +1,87 @@
+"""Toy interest-evolution recommender environment for SlateQ.
+
+Reference: rllib/examples/env/recsim_recommender_system_envs.py (RecSim
+"interest evolution" wrapper) — re-built as a dependency-free toy with
+the same structure: per-step candidate documents, slate actions, a
+conditional-logit user choice model (with a no-click option), engagement
+reward on click, and user-interest drift toward consumed content.
+
+Observation = [user_interest (d) | doc features (n_docs * (d+1))] where
+each doc row is (topic vector, quality).  Action = a slate: a tuple of
+`slate_size` doc indices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class InterestEvolutionRecSimEnv:
+    """Session ends when the user's time budget runs out; higher-quality
+    clicks cost less budget, so good recommendations lengthen sessions
+    (the long-term value SlateQ is designed to capture)."""
+
+    def __init__(self, config: Optional[Dict] = None):
+        config = dict(config or {})
+        self.num_docs = int(config.get("num_candidates", 10))
+        self.slate_size = int(config.get("slate_size", 2))
+        self.topic_dim = int(config.get("topic_dim", 4))
+        self.budget0 = float(config.get("time_budget", 20.0))
+        self.no_click_logit = float(config.get("no_click_logit", 1.0))
+        self._rng = np.random.RandomState(config.get("seed", 0))
+        self.observation_dim = (self.topic_dim
+                                + self.num_docs * (self.topic_dim + 1))
+
+    def _sample_docs(self):
+        topics = self._rng.randn(self.num_docs, self.topic_dim)
+        topics /= np.linalg.norm(topics, axis=1, keepdims=True)
+        quality = self._rng.uniform(0.0, 1.0, self.num_docs)
+        return topics.astype(np.float32), quality.astype(np.float32)
+
+    def _obs(self):
+        docs = np.concatenate(
+            [self.doc_topics, self.doc_quality[:, None]], axis=1)
+        return np.concatenate([self.interest,
+                               docs.reshape(-1)]).astype(np.float32)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self.interest = self._rng.randn(self.topic_dim).astype(np.float32)
+        self.interest /= np.linalg.norm(self.interest)
+        self.budget = self.budget0
+        self.doc_topics, self.doc_quality = self._sample_docs()
+        return self._obs(), {}
+
+    def choice_probs(self, slate) -> np.ndarray:
+        """Conditional logit over slate items + no-click (last entry)."""
+        scores = np.array([self.interest @ self.doc_topics[i]
+                           for i in slate] + [self.no_click_logit])
+        e = np.exp(scores - scores.max())
+        return e / e.sum()
+
+    def step(self, slate) -> Tuple[np.ndarray, float, bool, bool, Dict]:
+        slate = list(slate)
+        probs = self.choice_probs(slate)
+        pick = self._rng.choice(len(slate) + 1, p=probs)
+        reward = 0.0
+        info: Dict = {"clicked": None}
+        if pick < len(slate):
+            doc = slate[pick]
+            # Engagement = interest affinity; watching costs budget,
+            # discounted by quality (good docs regenerate attention).
+            affinity = float(self.interest @ self.doc_topics[doc])
+            reward = max(affinity, 0.0) + self.doc_quality[doc]
+            self.budget -= 1.0 - 0.5 * self.doc_quality[doc]
+            # Interest drifts toward consumed topics.
+            self.interest = 0.9 * self.interest \
+                + 0.1 * self.doc_topics[doc]
+            self.interest /= np.linalg.norm(self.interest)
+            info["clicked"] = doc
+        else:
+            self.budget -= 1.0
+        self.doc_topics, self.doc_quality = self._sample_docs()
+        done = self.budget <= 0
+        return self._obs(), reward, done, False, info

@@ -1,0 +1,186 @@
+"""JaxCRRPolicy: critic-regularized regression for offline RL.
+
+Reference: rllib/algorithms/crr/torch/crr_torch_policy.py — a Gaussian
+actor trained by advantage-weighted behavior cloning (weights
+`1[A>0]` binary or `exp(A/beta)` exponential, advantage estimated as
+Q(s,a) - mean_j Q(s, a_j~pi)) and a twin-Q critic trained by TD against
+the target actor.  Re-derived jax-first: critic step, weighted-BC actor
+step, and polyak target updates compile into one jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.policy.jax_ddpg_policy import _CriticNet
+
+
+class _GaussianActor(nn.Module):
+    act_dim: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        mean = nn.Dense(self.act_dim)(h)
+        log_std = self.param("log_std", nn.initializers.constant(-0.5),
+                             (self.act_dim,))
+        return jnp.tanh(mean), jnp.broadcast_to(
+            jnp.clip(log_std, -5.0, 1.0), mean.shape)
+
+
+class JaxCRRPolicy:
+    supports_continuous = True
+
+    def __init__(self, obs_dim: int, act_dim: int, config: Dict):
+        if not config.get("_continuous"):
+            raise TypeError("CRR requires a continuous (Box) action "
+                            "space")
+        self.config = config
+        self.act_dim = act_dim
+        low = np.asarray(config["_act_low"], np.float32)
+        high = np.asarray(config["_act_high"], np.float32)
+        self._scale = (high - low) / 2.0
+        self._center = (high + low) / 2.0
+        hiddens = tuple(config.get("fcnet_hiddens", (64, 64)))
+        self.actor = _GaussianActor(act_dim=act_dim, hiddens=hiddens)
+        self.q = _CriticNet(n_heads=2, hiddens=hiddens)
+        rng = jax.random.PRNGKey(config.get("seed", 0))
+        k1, k2, self._key = jax.random.split(rng, 3)
+        zo = jnp.zeros((1, obs_dim), jnp.float32)
+        za = jnp.zeros((1, act_dim), jnp.float32)
+        self.actor_params = self.actor.init(k1, zo)
+        self.q_params = self.q.init(k2, zo, za)
+        self.target_actor_params = self.actor_params
+        self.target_q_params = self.q_params
+        self.actor_tx = optax.adam(config.get("lr", 3e-4))
+        self.q_tx = optax.adam(config.get("critic_lr",
+                                          config.get("lr", 3e-4)))
+        self.actor_opt = self.actor_tx.init(self.actor_params)
+        self.q_opt = self.q_tx.init(self.q_params)
+        self._forward = jax.jit(self.actor.apply)
+        self._train = jax.jit(self._train_impl)
+
+    # ------------------------------------------------------------ acting
+    def compute_actions(self, obs: np.ndarray):
+        mean, _ = self._forward(self.actor_params,
+                                jnp.asarray(obs, jnp.float32))
+        act = np.asarray(mean) * self._scale + self._center
+        zeros = np.zeros(len(act), np.float32)
+        return act.astype(np.float32), zeros, zeros
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        obs = jnp.asarray(obs, jnp.float32)
+        mean, _ = self._forward(self.actor_params, obs)
+        q1, _ = self.q.apply(self.q_params, obs, mean)
+        return np.asarray(q1)
+
+    # ---------------------------------------------------------- learning
+    def _normalize(self, act):
+        return (act - self._center) / self._scale
+
+    def _train_impl(self, actor_params, q_params, ta_params, tq_params,
+                    actor_opt, q_opt, key, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        tau = cfg.get("tau", 0.995)
+        n_act = cfg.get("crr_n_action_samples", 4)
+        beta = cfg.get("crr_beta", 1.0)
+        binary = cfg.get("crr_weight_type", "bin") == "bin"
+        obs, act = batch["obs"], batch["actions"]
+        key, k_next, k_adv = jax.random.split(key, 3)
+
+        # ---- critic: TD against target nets, next action ~ target pi.
+        def q_loss_fn(qp):
+            next_mean, next_log_std = self.actor.apply(ta_params,
+                                                       batch["new_obs"])
+            eps = jax.random.normal(k_next, next_mean.shape)
+            next_a = jnp.clip(next_mean + eps * jnp.exp(next_log_std),
+                              -1.0, 1.0)
+            tq1, tq2 = self.q.apply(tq_params, batch["new_obs"], next_a)
+            target = batch["rewards"] + gamma * jnp.minimum(tq1, tq2) * (
+                1.0 - batch["dones"].astype(jnp.float32))
+            q1, q2 = self.q.apply(qp, obs, act)
+            t = jax.lax.stop_gradient(target)
+            return ((q1 - t) ** 2 + (q2 - t) ** 2).mean()
+
+        q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_params)
+        q_updates, q_opt = self.q_tx.update(q_grads, q_opt, q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        # ---- advantage: Q(s,a_data) - mean_j Q(s, a_j ~ pi(s)).
+        mean, log_std = self.actor.apply(actor_params, obs)
+        eps = jax.random.normal(
+            k_adv, (n_act,) + mean.shape)
+        sampled = jnp.clip(mean[None] + eps * jnp.exp(log_std)[None],
+                           -1.0, 1.0)
+        q_pi = jnp.stack([
+            jnp.minimum(*self.q.apply(q_params, obs, sampled[j]))
+            for j in range(n_act)]).mean(axis=0)
+        q_data = jnp.minimum(*self.q.apply(q_params, obs, act))
+        adv = jax.lax.stop_gradient(q_data - q_pi)
+        if binary:
+            w = (adv > 0).astype(jnp.float32)
+        else:
+            w = jnp.minimum(jnp.exp(adv / beta), 20.0)
+
+        # ---- actor: advantage-weighted log-likelihood of data actions.
+        def actor_loss_fn(ap):
+            m, ls = self.actor.apply(ap, obs)
+            var = jnp.exp(2 * ls)
+            logp = (-0.5 * ((act - m) ** 2 / var + 2 * ls
+                            + jnp.log(2 * jnp.pi))).sum(axis=-1)
+            return -(w * logp).mean()
+
+        a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(actor_params)
+        a_updates, actor_opt = self.actor_tx.update(a_grads, actor_opt,
+                                                    actor_params)
+        actor_params = optax.apply_updates(actor_params, a_updates)
+
+        # ---- polyak targets.
+        ta_params = jax.tree_util.tree_map(
+            lambda t, p: tau * t + (1 - tau) * p, ta_params, actor_params)
+        tq_params = jax.tree_util.tree_map(
+            lambda t, p: tau * t + (1 - tau) * p, tq_params, q_params)
+        stats = {"q_loss": q_loss, "actor_loss": a_loss,
+                 "mean_advantage": adv.mean(),
+                 "mean_weight": w.mean()}
+        return (actor_params, q_params, ta_params, tq_params, actor_opt,
+                q_opt, key, stats)
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        jb = {"obs": jnp.asarray(batch["obs"], jnp.float32),
+              "actions": self._normalize(
+                  jnp.asarray(batch["actions"], jnp.float32)),
+              "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+              "dones": jnp.asarray(batch["dones"]),
+              "new_obs": jnp.asarray(batch["new_obs"], jnp.float32)}
+        (self.actor_params, self.q_params, self.target_actor_params,
+         self.target_q_params, self.actor_opt, self.q_opt, self._key,
+         stats) = self._train(
+            self.actor_params, self.q_params, self.target_actor_params,
+            self.target_q_params, self.actor_opt, self.q_opt, self._key,
+            jb)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self):
+        pass  # polyak updates run inside the jitted train step
+
+    # ----------------------------------------------------------- weights
+    def get_weights(self):
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa
+        return {"actor": to_np(self.actor_params),
+                "q": to_np(self.q_params)}
+
+    def set_weights(self, weights):
+        to_j = lambda t: jax.tree_util.tree_map(jnp.asarray, t)  # noqa
+        self.actor_params = to_j(weights["actor"])
+        self.q_params = to_j(weights["q"])
