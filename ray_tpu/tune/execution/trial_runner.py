@@ -22,11 +22,12 @@ from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.tune import schedulers as sched_mod
 from ray_tpu.tune.execution.placement_groups import (
     PlacementGroupFactory, resource_dict_to_pg_factory)
-from ray_tpu.tune.schedulers import CONTINUE, STOP
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP
 from ray_tpu.tune.trainable import DONE, TRAINING_ITERATION, Trainable
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 
@@ -266,6 +267,14 @@ class TrialRunner:
         trial.status = RUNNING
         trial.pending_ref = None
 
+    def _notify_trial_error(self, trial: Trial):
+        """A trial died outside the normal result path: BOTH consumers
+        must hear it — the searcher (or it leaks the suggestion slot)
+        and the scheduler (or a synchronous HyperBand bracket waits on
+        the dead member forever)."""
+        self.search_alg.on_trial_complete(trial.trial_id, error=True)
+        self.scheduler.on_trial_complete(trial, None)
+
     def _stop_trial(self, trial: Trial, status: str):
         trial.status = status
         if trial.actor is not None:
@@ -291,22 +300,68 @@ class TrialRunner:
     _exhausted = False
 
     def is_finished(self) -> bool:
-        active = any(t.status in (PENDING, RUNNING) for t in self.trials)
+        active = any(t.status in (PENDING, RUNNING, PAUSED)
+                     for t in self.trials)
         return not active and self._exhausted
+
+    def _apply_scheduler_actions(self):
+        """Drain synchronous-scheduler verdicts (HyperBand brackets):
+        resume promoted PAUSED trials, terminate demoted ones."""
+        pop = getattr(self.scheduler, "pop_actions", None)
+        if pop is None:
+            return
+        resume, stop = pop()
+        for trial in stop:
+            if trial.status in (PAUSED, RUNNING, PENDING):
+                self._stop_trial(trial, TERMINATED)
+                self.search_alg.on_trial_complete(trial.trial_id,
+                                                  trial.last_result)
+        for trial in resume:
+            if trial.status == PAUSED:
+                # Re-enter through the restored-trial path (checkpoint
+                # was taken at pause time).
+                trial.status = PENDING
 
     def run(self, result_callback: Optional[Callable] = None) -> List[Trial]:
         """Drive all trials to completion; returns the trial list."""
+        stuck_since = None
         while True:
+            self._apply_scheduler_actions()
             self._start_restored_trials()
             self._fill_trials()
             running = [t for t in self.trials if t.status == RUNNING]
             if not running:
-                if self._exhausted and not self._staged():
+                paused = [t for t in self.trials if t.status == PAUSED]
+                pending = [t for t in self.trials
+                           if t.status == PENDING]
+                if self._exhausted and not self._staged() \
+                        and not paused and not pending:
                     break
+                if paused and not pending and self._exhausted \
+                        and not self._staged():
+                    # Every live trial is paused and nothing new can
+                    # ever arrive: a synchronous bracket is waiting on
+                    # members that will never come (under-full bracket
+                    # template, or a death it somehow missed).  The
+                    # condition is already stable, so advance NOW — no
+                    # stall — and only fall back to resume-everything
+                    # if the scheduler cannot make progress.
+                    force = getattr(self.scheduler, "force_advance",
+                                    None)
+                    if force is not None and force():
+                        stuck_since = None
+                        continue
+                    if stuck_since is None:
+                        stuck_since = time.monotonic()
+                    elif time.monotonic() - stuck_since > 5.0:
+                        for t in paused:
+                            t.status = PENDING
+                        stuck_since = None
                 # Staged trials are waiting for reservations to land;
                 # don't spin hot while nothing is training.
                 time.sleep(0.2)
                 continue
+            stuck_since = None
             # Submit one train() per running trial without an outstanding
             # future.
             for t in running:
@@ -341,6 +396,7 @@ class TrialRunner:
             except Exception as e:
                 trial.error = e
                 trial.status = ERROR
+                self._notify_trial_error(trial)
 
     def _staged(self) -> List[Trial]:
         return [t for t in self.trials
@@ -385,8 +441,7 @@ class TrialRunner:
                     # The searcher paired a suggestion with this trial id;
                     # it must hear the trial ended or it leaks the slot
                     # (BO searchers never learn the outcome otherwise).
-                    self.search_alg.on_trial_complete(trial.trial_id,
-                                                      error=True)
+                    self._notify_trial_error(trial)
                     if self.failure_config.fail_fast:
                         raise trial.error
                 continue
@@ -398,8 +453,7 @@ class TrialRunner:
             except Exception as e:
                 self._stop_trial(trial, ERROR)
                 trial.error = e
-                self.search_alg.on_trial_complete(trial.trial_id,
-                                                  error=True)
+                self._notify_trial_error(trial)
                 if self.failure_config.fail_fast:
                     raise
         for trial in started:
@@ -408,8 +462,7 @@ class TrialRunner:
             except Exception as e:
                 self._stop_trial(trial, ERROR)
                 trial.error = e
-                self.search_alg.on_trial_complete(trial.trial_id,
-                                                  error=True)
+                self._notify_trial_error(trial)
                 if self.failure_config.fail_fast:
                     raise
 
@@ -452,6 +505,22 @@ class TrialRunner:
             self.search_alg.on_trial_complete(trial.trial_id, result)
             self.scheduler.on_trial_complete(trial, result)
             self._stop_trial(trial, TERMINATED)
+        elif decision == PAUSE:
+            # Synchronous-bracket pause (HyperBand): checkpoint, then
+            # RELEASE the actor + placement group so waiting bracket
+            # peers can use the resources; resume goes through the
+            # restored-trial path.  A failed save means the trial
+            # CANNOT be paused losslessly — route it through the
+            # failure path (retry/ERROR) instead of silently pausing
+            # with a stale checkpoint, which would resume the trial at
+            # the wrong training depth relative to its bracket peers.
+            try:
+                trial.checkpoint = ray_tpu.get(trial.actor.save.remote(),
+                                               timeout=300)
+            except Exception as e:
+                self._handle_failure(trial, e)
+                return
+            self._stop_trial(trial, PAUSED)
         try:
             self._save_experiment_state()
         except Exception:
@@ -471,8 +540,12 @@ class TrialRunner:
                 trial.error = e
         elif self.failure_config.fail_fast:
             self.search_alg.on_trial_complete(trial.trial_id, error=True)
+            self.scheduler.on_trial_complete(trial, None)
             raise err
         self.search_alg.on_trial_complete(trial.trial_id, error=True)
+        # Synchronous schedulers (HyperBand) must hear about the death
+        # or their bracket waits on this trial forever.
+        self.scheduler.on_trial_complete(trial, None)
 
     def _apply_exploits(self):
         pbt = self.scheduler

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+PAUSE = "PAUSE"
 
 
 class TrialScheduler:
@@ -287,6 +288,158 @@ class PB2(PopulationBasedTraining):
         return new
 
 
-class HyperBandScheduler(AsyncHyperBandScheduler):
-    """Synchronous HyperBand approximated by its asynchronous variant (the
-    reference ships both; ASHA dominates in practice)."""
+class HyperBandScheduler(TrialScheduler):
+    """SYNCHRONOUS HyperBand (Li et al. 2018; reference:
+    tune/schedulers/hyperband.py).
+
+    Trials are grouped into brackets; each bracket runs successive-
+    halving ROUNDS in lockstep: every live member trains to the
+    bracket's current milestone and is then PAUSED (checkpointed, actor
+    + placement group released).  When the last member arrives, the top
+    1/eta by the recorded milestone score resume toward the next
+    milestone (eta x longer) and the rest stop.  Unlike ASHA there is
+    no first-arrival bias: promotion decisions always see the whole
+    rung.
+
+    Bracket shapes follow the paper: with s_max = floor(log_eta(max_t /
+    grace)), bracket s holds n_s = ceil((s_max+1)/(s+1) * eta^s) trials
+    starting at milestone r_s = max_t * eta^-s; brackets are filled in
+    s descending order, cycling if more trials arrive.
+
+    Runner protocol: `on_trial_result` returns PAUSE at milestones; the
+    runner checkpoints + tears down the trial (status PAUSED) and each
+    loop iteration drains `pop_actions()` -> (resume, stop) trial
+    lists.
+    """
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 81, grace_period: int = 1,
+                 reduction_factor: int = 3):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.grace = max(1, grace_period)
+        s_max = int(math.floor(
+            math.log(max(max_t / self.grace, 1), self.eta)))
+        self._templates = []
+        for s in range(s_max, -1, -1):
+            n = int(math.ceil((s_max + 1) / (s + 1) * self.eta ** s))
+            r = max(self.grace, int(round(max_t * self.eta ** (-s))))
+            self._templates.append((n, r))
+        self._ti = 0
+        self._brackets: List[Dict] = []
+        self._by_trial: Dict[str, Dict] = {}
+        self._resume: List[object] = []
+        self._stop: List[object] = []
+
+    def _score(self, result):
+        s = result.get(self.metric)
+        if s is None:
+            return None
+        return s if self.mode == "max" else -s
+
+    def on_trial_add(self, trial) -> None:
+        if (not self._brackets
+                or len(self._brackets[-1]["members"])
+                >= self._brackets[-1]["n"]):
+            n, r = self._templates[self._ti % len(self._templates)]
+            self._ti += 1
+            self._brackets.append({"n": n, "r": r, "members": {}})
+        b = self._brackets[-1]
+        b["members"][trial.trial_id] = {
+            "trial": trial, "score": None, "recorded": False,
+            "dead": False}
+        self._by_trial[trial.trial_id] = b
+
+    def on_trial_result(self, trial, result) -> str:
+        b = self._by_trial.get(trial.trial_id)
+        if b is None:
+            return CONTINUE
+        m = b["members"][trial.trial_id]
+        t = result.get("training_iteration", 0)
+        score = self._score(result)
+        if score is not None:
+            # Latest score, NOT a running max: synchronous HyperBand
+            # compares rung members at the rung — a stale early peak
+            # must not outrank a peer whose current score is better
+            # (the recording result IS the at-milestone value).
+            m["score"] = score
+        if t >= self.max_t:
+            m["dead"] = True
+            self._maybe_advance(b)
+            return STOP
+        if t >= b["r"]:
+            m["recorded"] = True
+            self._maybe_advance(b, inline=m)
+            # _maybe_advance may have resolved this trial immediately
+            # (it was the last arrival): a winner never actually pauses
+            # — it just keeps training; a loser stops without the
+            # pause-then-stop dance.
+            if m["dead"]:
+                return STOP
+            if not m["recorded"]:
+                return CONTINUE
+            return PAUSE
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
+        b = self._by_trial.get(trial.trial_id)
+        if b is None:
+            return
+        b["members"][trial.trial_id]["dead"] = True
+        self._maybe_advance(b)
+
+    def _maybe_advance(self, b: Dict, allow_partial: bool = False,
+                       inline=None) -> None:
+        """If every live member of the bracket has recorded the current
+        milestone, promote the top 1/eta and stop the rest.  A bracket
+        only rounds once fully populated (more trials may still arrive
+        for it) unless the runner signals exhaustion via
+        force_advance -> allow_partial.  `inline` is the member whose
+        result triggered the call — if it wins it continues in place
+        (never paused), so it must not enter the resume queue."""
+        if len(b["members"]) < b["n"] and not allow_partial:
+            return
+        live = [m for m in b["members"].values() if not m["dead"]]
+        if not live or not all(m["recorded"] for m in live):
+            return
+        ranked = sorted(live, key=lambda m: (m["score"] is not None,
+                                             m["score"]), reverse=True)
+        keep = max(1, len(live) // self.eta)
+        next_r = min(b["r"] * self.eta, self.max_t)
+        if next_r <= b["r"]:
+            # Final rung already at max_t: everyone stops.
+            winners, losers = [], ranked
+        else:
+            winners, losers = ranked[:keep], ranked[keep:]
+        b["r"] = next_r
+        for m in winners:
+            m["recorded"] = False
+            if m is not inline:
+                self._resume.append(m["trial"])
+        for m in losers:
+            m["dead"] = True
+            self._stop.append(m["trial"])
+
+    def pop_actions(self):
+        """Drain (trials_to_resume, trials_to_stop) — runner hook."""
+        resume, self._resume = self._resume, []
+        stop, self._stop = self._stop, []
+        return resume, stop
+
+    def force_advance(self) -> bool:
+        """Fail-open hook: the runner found only PAUSED trials and no
+        pending work — treat every bracket's missing members as never
+        arriving and advance on what was recorded."""
+        progressed = False
+        for b in self._brackets:
+            live = [m for m in b["members"].values() if not m["dead"]]
+            if not live:
+                continue
+            if all(m["recorded"] for m in live):
+                # Under-full bracket (fewer samples than the template
+                # shape): round on what exists.
+                self._maybe_advance(b, allow_partial=True)
+                progressed = True
+        return progressed

@@ -1,0 +1,124 @@
+"""Synchronous HyperBand: lockstep bracket rounds with pause/resume
+(reference: tune/schedulers/hyperband.py + tests/test_trial_scheduler).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import Tuner, TuneConfig
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP,
+                                     HyperBandScheduler)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class _FakeTrial:
+    def __init__(self, tid):
+        self.trial_id = tid
+        self.status = "RUNNING"
+
+
+def test_hyperband_bracket_promotion_unit():
+    """Pure scheduler-protocol unit test: a 4-trial bracket at rf=2
+    pauses everyone at the milestone, then promotes exactly the top
+    half and stops the bottom half — decisions made only once the whole
+    rung has reported (no ASHA first-arrival bias)."""
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=8,
+                               grace_period=2, reduction_factor=2)
+    # Force a single 4-trial bracket shape for determinism.
+    sched._templates = [(4, 2)]
+    trials = [_FakeTrial(f"t{i}") for i in range(4)]
+    for t in trials:
+        sched.on_trial_add(t)
+
+    # Scores at the milestone: t3 > t2 > t1 > t0.
+    verdicts = {}
+    for i, t in enumerate(trials[:-1]):
+        verdicts[t.trial_id] = sched.on_trial_result(
+            t, {"training_iteration": 2, "score": float(i)})
+    # First three must PAUSE — the rung is not complete yet.
+    assert all(v == PAUSE for v in verdicts.values())
+    resume, stop = sched.pop_actions()
+    assert not resume and not stop
+
+    # Last arrival completes the rung: it is the best, so it continues
+    # inline (never pauses); t2 resumes; t0/t1 stop.
+    v = sched.on_trial_result(
+        trials[3], {"training_iteration": 2, "score": 3.0})
+    assert v == CONTINUE
+    resume, stop = sched.pop_actions()
+    assert {t.trial_id for t in resume} == {"t2"}
+    assert {t.trial_id for t in stop} == {"t0", "t1"}
+
+    # Next milestone doubled to 4; at max_t trials STOP.
+    assert sched.on_trial_result(
+        trials[3], {"training_iteration": 3, "score": 3.0}) == CONTINUE
+    assert sched.on_trial_result(
+        trials[3], {"training_iteration": 8, "score": 3.0}) == STOP
+
+
+def test_hyperband_underfull_bracket_advances(ray_init):
+    """Fewer samples than the bracket template wants (the common case
+    with default max_t): once the searcher is exhausted the runner
+    advances the partial bracket immediately — halving still engages,
+    nothing deadlocks, and the best trial reaches max_t."""
+    def objective(config):
+        for i in range(9):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               grace_period=1, reduction_factor=3)
+    # Template bracket wants 9 trials; only 4 exist.
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=sched),
+        run_config=RunConfig(stop={"training_iteration": 9}),
+    ).fit()
+    best = results.get_best_result()
+    assert best.config["q"] == 4
+    iters = {r.config["q"]: r.metrics.get("training_iteration", 0)
+             for r in results}
+    assert iters[4] == 9                      # winner ran out
+    assert min(iters.values()) < 9            # halving cut someone
+
+
+def test_hyperband_e2e_lockstep(ray_init):
+    """End-to-end through the Tuner: the late-bloomer trial whose score
+    starts LOW but finishes high must survive round 1 — synchronous
+    brackets judge at the full rung, where its milestone score already
+    beats the decayers'."""
+    def objective(config):
+        for i in range(9):
+            if config["kind"] == "bloom":
+                score = (i + 1) ** 2       # 1, 4, 9 .. 81: wins late
+            else:
+                score = 8.0 - i            # 8, 7, 6 ..: decays
+            tune.report({"score": score})
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               grace_period=3, reduction_factor=3)
+    results = Tuner(
+        objective,
+        param_space={"kind": tune.grid_search(
+            ["bloom", "decay", "decay2"])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=sched),
+        run_config=RunConfig(stop={"training_iteration": 9}),
+    ).fit()
+    best = results.get_best_result()
+    assert best.config["kind"] == "bloom"
+    by_kind = {r.config["kind"]: r.metrics.get("training_iteration", 0)
+               for r in results}
+    # The winner ran to max_t; at least one decayer was cut at a rung.
+    assert by_kind["bloom"] == 9
+    assert min(by_kind["decay"], by_kind["decay2"]) <= 4
