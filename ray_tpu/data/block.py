@@ -84,6 +84,23 @@ class BlockAccessor:
         if isinstance(b, dict):
             return np.asarray(b[column]) if column else \
                 {k: np.asarray(v) for k, v in b.items()}
+        try:
+            import pyarrow as pa
+            if isinstance(b, pa.Table):
+                from ray_tpu.air.util.tensor_extensions import (
+                    is_tensor_type, tensor_column_to_numpy)
+
+                def _col(name):
+                    col = b.column(name)
+                    if is_tensor_type(col.type):
+                        return tensor_column_to_numpy(col)
+                    return col.to_numpy(zero_copy_only=False)
+
+                if column:
+                    return _col(column)
+                return {name: _col(name) for name in b.column_names}
+        except ImportError:
+            pass
         if isinstance(b, list):
             if b and isinstance(b[0], dict):
                 keys = b[0].keys()
@@ -107,11 +124,31 @@ class BlockAccessor:
         try:
             import pyarrow as pa
             if isinstance(b, pa.Table):
+                from ray_tpu.air.util.tensor_extensions import (
+                    is_tensor_type, tensor_column_to_numpy)
+                if any(is_tensor_type(f.type) for f in b.schema):
+                    cols = {}
+                    for name in b.column_names:
+                        col = b.column(name)
+                        if is_tensor_type(col.type):
+                            nd = tensor_column_to_numpy(col)
+                            cols[name] = pd.Series(list(nd),
+                                                   dtype=object)
+                        else:
+                            cols[name] = col.to_pandas()
+                    return pd.DataFrame(cols)
                 return b.to_pandas()
         except ImportError:
             pass
         if isinstance(b, dict):
-            return pd.DataFrame({k: np.asarray(v) for k, v in b.items()})
+            cols = {}
+            for k, v in b.items():
+                arr = np.asarray(v)
+                # Tensor columns (ndim > 1) become object Series of
+                # per-row ndarrays in the pandas view.
+                cols[k] = (pd.Series(list(arr), dtype=object)
+                           if arr.ndim > 1 else arr)
+            return pd.DataFrame(cols)
         if b and isinstance(b[0], dict):
             return pd.DataFrame(b)
         return pd.DataFrame({"value": b})
@@ -121,6 +158,19 @@ class BlockAccessor:
         b = self._b
         if isinstance(b, pa.Table):
             return b
+        if isinstance(b, dict):
+            # Multi-dimensional columns become fixed-shape tensor
+            # extension columns (reference: air/util/tensor_extensions/
+            # arrow.py ArrowTensorArray) instead of object-dtype rows.
+            from ray_tpu.air.util.tensor_extensions import (
+                ArrowTensorArray)
+            names, arrays = [], []
+            for k, v in b.items():
+                arr = np.asarray(v)
+                names.append(k)
+                arrays.append(ArrowTensorArray.from_numpy(arr)
+                              if arr.ndim > 1 else pa.array(arr))
+            return pa.Table.from_arrays(arrays, names=names)
         return pa.Table.from_pandas(self.to_pandas(),
                                     preserve_index=False)
 

@@ -128,9 +128,20 @@ class Dataset:
                                         fn_kwargs or {})],
                        stats=self._stats)
 
+    @staticmethod
+    def _fuse(stages):
+        """One callable running the whole stage chain on a block (the
+        reference's stage fusion) — shared by the materializing and
+        streaming executors."""
+        def _fused(block):
+            for fn, _, fn_args, fn_kwargs in stages:
+                block = fn(block, *fn_args, **fn_kwargs)
+            return block
+        return _fused
+
     def _execute(self) -> List:
         """Materialize all stages -> block refs (fused: one task per block
-        runs the whole stage chain — the reference's stage fusion)."""
+        runs the whole stage chain)."""
         if not self._stages:
             return self._block_refs
         import time as _time
@@ -139,11 +150,7 @@ class Dataset:
             getattr(s[0], "__name__", "stage").lstrip("_")
             for s in self._stages)
         stages = self._stages
-
-        def _fused(block):
-            for fn, _, fn_args, fn_kwargs in stages:
-                block = fn(block, *fn_args, **fn_kwargs)
-            return block
+        _fused = self._fuse(stages)
 
         actor_stages = [s for s in stages
                         if isinstance(s[1], ActorPoolStrategy)]
@@ -232,6 +239,28 @@ class Dataset:
     def _blocks(self) -> List:
         """Materialized local blocks."""
         return ray_tpu.get(self._execute(), timeout=_GET_TIMEOUT)
+
+    def _iter_local_blocks(self, max_in_flight: int = 4) -> Iterable:
+        """Streaming block iterator (reference: the streaming executor
+        that replaced bulk execution as Data's default consume path).
+
+        With pending task-compatible stages, blocks are transformed by
+        a bounded sliding window of tasks and yielded in order — peak
+        local memory is O(max_in_flight blocks), and the first batch is
+        ready after one block's latency.  Falls back to materializing
+        for actor-pool stages (the pool amortizes setup over ALL
+        blocks) or when already materialized.  Streaming does not cache
+        stage outputs: re-iterating re-executes the chain.
+        """
+        if self._stages and not any(
+                isinstance(s[1], ActorPoolStrategy) for s in self._stages):
+            from ray_tpu.data.streaming import StreamingExecutor
+            yield from StreamingExecutor(
+                self._block_refs, self._fuse(self._stages),
+                max_in_flight=max_in_flight).iter_blocks()
+            return
+        for ref in self._execute():
+            yield ray_tpu.get(ref, timeout=_GET_TIMEOUT)
 
     # ---------------------------------------------------------- transforms
     def map_batches(self, fn: Callable, *, batch_format: Optional[str] =
@@ -503,14 +532,20 @@ class Dataset:
             BlockAccessor.combine(self._blocks())).to_pandas()
 
     def iter_rows(self) -> Iterable:
-        for b in self._blocks():
+        for b in self._iter_local_blocks():
             yield from BlockAccessor(b).to_pylist()
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: Optional[str] = "numpy",
-                     drop_last: bool = False) -> Iterable:
+                     drop_last: bool = False,
+                     max_in_flight: int = 4) -> Iterable:
+        """Stream batches.  Pending stages execute STREAMING (bounded
+        window of in-flight blocks, no full materialization) and are
+        NOT cached: re-iterating re-executes the chain.  Call
+        .materialize() first (or consume via .repeat(n)) to pay the
+        transform cost once across repeated passes."""
         carry = None
-        for b in self._blocks():
+        for b in self._iter_local_blocks(max_in_flight=max_in_flight):
             if carry is not None:
                 b = BlockAccessor.combine([carry, b])
                 carry = None

@@ -32,6 +32,13 @@ class DatasetPipeline:
 
     def iter_epochs(self) -> Iterable[Dataset]:
         import itertools
+        # Repeated consumption: materialize the BASE dataset's pending
+        # stages once up front, so N epochs don't re-run the transform
+        # chain N times through the streaming iterator (per-window/
+        # per-epoch stages added on the pipeline still run per epoch —
+        # that is their contract, e.g. random_shuffle_each_window).
+        if self._times is None or self._times > 1:
+            self._ds._execute()
         it = (range(self._times) if self._times is not None
               else itertools.count())
         for _ in it:

@@ -95,6 +95,11 @@ def _read_files(paths, reader) -> Dataset:
 def read_parquet(paths, **kw) -> Dataset:
     def _read(f):
         import pyarrow.parquet as pq
+        # Registering the tensor extension in the READING process lets
+        # pyarrow reconstruct ArrowTensorType columns from the file's
+        # field metadata (read tasks run in worker processes that may
+        # not have imported the data layer yet).
+        import ray_tpu.air.util.tensor_extensions  # noqa: F401
         return pq.read_table(f)
     return _read_files(paths, _read)
 

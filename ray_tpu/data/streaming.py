@@ -1,0 +1,70 @@
+"""Streaming execution: bounded-in-flight block processing.
+
+Reference: python/ray/data/_internal/pipeline_executor.py (windowed
+pipeline execution) and the streaming_executor that replaced bulk
+execution as Ray Data's default — instead of materializing every stage
+over the whole dataset before the first batch is readable, the fused
+stage chain runs as a sliding window of per-block tasks: at most
+`max_in_flight` blocks are being transformed or held locally at once,
+and results stream to the consumer in order while later blocks are
+still executing.
+
+Peak driver memory is O(max_in_flight * block size) instead of
+O(dataset size), and time-to-first-batch is one block's latency instead
+of the whole stage graph's.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterable, List
+
+import ray_tpu
+
+_GET_TIMEOUT = 600.0
+
+
+class StreamingExecutor:
+    def __init__(self, block_refs: List, fused_fn: Callable,
+                 max_in_flight: int = 4):
+        self._refs = list(block_refs)
+        self._fused = fused_fn
+        self._window = max(1, int(max_in_flight))
+
+    def iter_blocks(self) -> Iterable:
+        """Yield transformed blocks IN ORDER with a bounded number of
+        outstanding transform tasks."""
+        from ray_tpu.data.dataset import _apply_stage_task
+        task = ray_tpu.remote(_apply_stage_task)
+        src = iter(self._refs)
+        in_flight: deque = deque()
+
+        def _submit_next() -> bool:
+            try:
+                ref = next(src)
+            except StopIteration:
+                return False
+            in_flight.append(task.remote(self._fused, ref, (), {}))
+            return True
+
+        try:
+            for _ in range(self._window):
+                if not _submit_next():
+                    break
+            while in_flight:
+                head = in_flight.popleft()
+                block = ray_tpu.get(head, timeout=_GET_TIMEOUT)
+                # Refill the window BEFORE yielding: the consumer may
+                # hold the batch for a long time (training step) and
+                # the next blocks should be transforming meanwhile.
+                _submit_next()
+                yield block
+        finally:
+            # Consumer abandoned the generator early (break/islice):
+            # cancel the outstanding window so unread transforms don't
+            # burn the cluster.
+            for ref in in_flight:
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
