@@ -1,0 +1,42 @@
+# Native targets for the shared-memory object store.
+#
+# Reference: the reference wires TSAN/ASAN as first-class build configs
+# (.bazelrc:92-111) run in CI (ci/ci.sh:356); here the sanitizer
+# workload is src/shm_store_stress.cc (8 threads of mixed
+# alloc/seal/abort/get/release/delete/evict against one arena).
+#
+#   make store           # the production .so (also built lazily at import)
+#   make store-tsan      # ThreadSanitizer stress run
+#   make store-asan      # AddressSanitizer+UBSan stress run
+#   make sanitize        # both
+
+CXX ?= g++
+CXXFLAGS ?= -std=c++17 -O2
+BUILD := build
+
+.PHONY: store store-tsan store-asan sanitize clean
+
+store: ray_tpu/_private/_shm_store.so
+
+ray_tpu/_private/_shm_store.so: src/shm_store.cc
+	$(CXX) $(CXXFLAGS) -shared -fPIC -o $@ $<
+
+$(BUILD):
+	mkdir -p $(BUILD)
+
+$(BUILD)/store_stress_tsan: src/shm_store_stress.cc src/shm_store.cc | $(BUILD)
+	$(CXX) -std=c++17 -g -O1 -fsanitize=thread -o $@ $< -lpthread
+
+$(BUILD)/store_stress_asan: src/shm_store_stress.cc src/shm_store.cc | $(BUILD)
+	$(CXX) -std=c++17 -g -O1 -fsanitize=address,undefined -o $@ $< -lpthread
+
+store-tsan: $(BUILD)/store_stress_tsan
+	$(BUILD)/store_stress_tsan
+
+store-asan: $(BUILD)/store_stress_asan
+	$(BUILD)/store_stress_asan
+
+sanitize: store-tsan store-asan
+
+clean:
+	rm -rf $(BUILD) ray_tpu/_private/_shm_store.so

@@ -261,12 +261,12 @@ class Raylet:
                 await self._on_worker_dead(w, "worker connection lost")
 
     def _discard_unsealed(self, oid: bytes):
-        """Free an unsealed allocation made by a transfer that died.  The
-        alloc-time creator pin (shm_store.cc Alloc: refcount=1) must be
-        released alongside the delete, or the entry stays pending_delete
-        forever — bytes leaked AND the oid poisoned on this node."""
-        self.store.delete(oid)
-        self.store.release(oid)
+        """Free an unsealed allocation made by a transfer that died —
+        abort() drops the alloc-time creator pin (shm_store.cc Alloc:
+        refcount=1) and frees the extent atomically; release() refuses
+        unsealed entries so a stray release can't free memory under a
+        still-writing creator."""
+        self.store.abort(oid)
 
     def _abort_pushes_from(self, conn):
         """Sender connection died: drop its in-flight push transfers so the
@@ -1149,7 +1149,10 @@ class Raylet:
                     continue
                 offset, sz, sealed = got
                 if not sealed:
-                    self.store.release(oid)
+                    # Get() takes no pin on unsealed objects — nothing
+                    # to release (a release here would have stolen the
+                    # creator's pin and freed the extent under its
+                    # in-progress write; the store now rejects it).
                     continue
                 path = os.path.join(self.spill_dir, oid.hex())
                 data = bytes(self.mapping.slice(offset, sz))

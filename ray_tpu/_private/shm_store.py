@@ -56,6 +56,8 @@ def _load_lib():
                                   ctypes.POINTER(ctypes.c_int)]
         lib.store_release.restype = ctypes.c_int
         lib.store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_abort.restype = ctypes.c_int
+        lib.store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.store_delete.restype = ctypes.c_int
         lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.store_contains.restype = ctypes.c_int
@@ -117,6 +119,14 @@ class StoreServer:
         if not self.handle:
             return False
         return self.lib.store_release(self.handle, object_id) == 0
+
+    def abort(self, object_id: bytes) -> bool:
+        """Drop an UNSEALED creation (creator pin + extent) — the only
+        legal way to free an in-progress allocation; release() refuses
+        unsealed entries (src/shm_store.cc Release: -3)."""
+        if not self.handle:
+            return False
+        return self.lib.store_abort(self.handle, object_id) == 0
 
     def delete(self, object_id: bytes) -> bool:
         if not self.handle:

@@ -137,6 +137,12 @@ class Store {
     auto it = objects_.find(id);
     if (it == objects_.end()) return -1;
     Entry& e = it->second;
+    // Pins on an UNSEALED object belong exclusively to its creator,
+    // who must drop them through Abort() — a stray Release here would
+    // free the extent while the creator is still writing into it (a
+    // use-after-free another allocation then races with; found by the
+    // TSAN stress target, see src/shm_store_stress.cc).
+    if (!e.sealed) return -3;
     if (e.refcount > 0) e.refcount--;
     if (e.refcount == 0) {
       if (e.pending_delete) {
@@ -149,6 +155,19 @@ class Store {
         e.in_lru = true;
       }
     }
+    return 0;
+  }
+
+  // Abort an in-progress creation: drop the creator pin of an UNSEALED
+  // entry and free it (reference: plasma's AbortObject, client.h).
+  // Unsealed entries can hold no reader pins (Get only pins sealed
+  // objects), so the free is immediate.
+  int Abort(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    if (it->second.sealed) return -2;  // sealed: use Delete + Release
+    FreeEntryLocked(it);
     return 0;
   }
 
@@ -358,6 +377,10 @@ int store_get(void* h, const uint8_t* id, uint64_t* offset, uint64_t* size,
 
 int store_release(void* h, const uint8_t* id) {
   return static_cast<Store*>(h)->Release(MakeId(id));
+}
+
+int store_abort(void* h, const uint8_t* id) {
+  return static_cast<Store*>(h)->Abort(MakeId(id));
 }
 
 int store_delete(void* h, const uint8_t* id) {

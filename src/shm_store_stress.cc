@@ -1,0 +1,160 @@
+// Sanitizer stress workload for the shared-memory object store.
+//
+// Reference: the reference runs its gtest suites under TSAN/ASAN bazel
+// configs (.bazelrc:92-111) — the sanitizer IS the assertion; the
+// workload's job is to hit every locking path concurrently.  This
+// harness drives the extern "C" store API (src/shm_store.cc:333-386)
+// from N threads doing mixed alloc/seal/get/release/delete/evict with
+// overlapping object ids, plus writes through the returned offsets into
+// the arena mapping so ASAN sees the actual byte traffic.
+//
+// Build (see Makefile targets store-tsan / store-asan):
+//   g++ -std=c++17 -g -O1 -fsanitize=thread  src/shm_store.cc is NOT
+//   linked separately — this file includes the store implementation so
+//   one translation unit carries the sanitizer instrumentation.
+//
+// Exit code 0 = workload finished; any data race / heap error aborts
+// with a sanitizer report (non-zero).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "shm_store.cc"  // single-TU build: instrument store + driver
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kOpsPerThread = 20000;
+constexpr int kIdSpace = 64;       // ids shared across threads
+constexpr uint64_t kCapacity = 8ull << 20;
+
+void FillId(uint8_t* id, int v) {
+  std::memset(id, 0, 20);
+  std::snprintf(reinterpret_cast<char*>(id), 20, "obj-%04d", v);
+}
+
+void Worker(void* store, uint8_t* arena, int seed,
+            std::atomic<long>* allocs, std::atomic<long>* gets) {
+  std::mt19937 rng(seed);
+  std::uniform_int_distribution<int> id_dist(0, kIdSpace - 1);
+  std::uniform_int_distribution<int> op_dist(0, 99);
+  std::uniform_int_distribution<int> size_dist(64, 64 << 10);
+  uint8_t id[20];
+  for (int i = 0; i < kOpsPerThread; i++) {
+    FillId(id, id_dist(rng));
+    int op = op_dist(rng);
+    if (op < 35) {                       // create (+ seal or abort)
+      uint64_t off = 0;
+      uint64_t size = static_cast<uint64_t>(size_dist(rng));
+      if (store_alloc(store, id, size, &off) == 0) {
+        // Touch the allocation like a real client memcpy would; a
+        // broken allocator handing out overlapping or out-of-range
+        // extents trips ASAN/TSAN here (the arena mapping is exactly
+        // kCapacity bytes, and no other thread may hold this extent
+        // while the creator pin is live).
+        std::memset(arena + off, 0xAB, size);
+        if (op < 32) {
+          // Creator protocol: seal, then drop the creator pin
+          // (raylet.py _seal_release_notify) so the object enters the
+          // LRU and eviction paths get real traffic.
+          store_seal(store, id);
+          store_release(store, id);
+        } else {
+          // Died mid-create: abort (raylet.py _discard_unsealed).
+          store_abort(store, id);
+        }
+        allocs->fetch_add(1, std::memory_order_relaxed);
+      }
+    } else if (op < 70) {                // pinned read
+      uint64_t off = 0, size = 0;
+      int sealed = 0;
+      if (store_get(store, id, &off, &size, &sealed) == 0 && sealed) {
+        // Get() pinned the sealed object: the extent must stay stable
+        // under concurrent delete/evict until our release.
+        volatile uint8_t sink = 0;
+        for (uint64_t j = 0; j < size; j += 4096) sink ^= arena[off + j];
+        (void)sink;
+        store_release(store, id);
+        gets->fetch_add(1, std::memory_order_relaxed);
+      }
+    } else if (op < 85) {                // delete
+      store_delete(store, id);
+    } else if (op < 95) {                // stats polling (raylet loop)
+      uint64_t a, b, c, d, e, f;
+      store_stats(store, &a, &b, &c, &d, &e, &f);
+      store_contains(store, id);
+    } else {                             // LRU eviction pressure
+      store_evict(store, 1 << 20);
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  const char* path = "/tmp/shm_store_stress.arena";
+  std::remove(path);
+  void* store = store_create(path, kCapacity);
+  if (!store) {
+    std::fprintf(stderr, "store_create failed\n");
+    return 2;
+  }
+  // Map the arena the way StoreMapping does so reads/writes go through
+  // real shared memory.
+  FILE* f = std::fopen(path, "r+b");
+  if (!f) return 2;
+  std::vector<uint8_t> shadow;  // fallback if mmap unavailable
+  uint8_t* arena = nullptr;
+#ifdef __linux__
+  arena = static_cast<uint8_t*>(mmap(nullptr, kCapacity,
+                                     PROT_READ | PROT_WRITE, MAP_SHARED,
+                                     fileno(f), 0));
+  if (arena == MAP_FAILED) arena = nullptr;
+#endif
+  if (!arena) {
+    shadow.resize(kCapacity);
+    arena = shadow.data();
+  }
+
+  // Contract check: releasing a pin on an UNSEALED object must be
+  // refused (-3) — a stray release would otherwise free the extent
+  // under the still-writing creator (per-client pin accounting lives
+  // in the raylet; this is the kernel's backstop).
+  {
+    uint8_t id[20];
+    FillId(id, 9999);
+    uint64_t off = 0;
+    if (store_alloc(store, id, 4096, &off) != 0) return 2;
+    if (store_release(store, id) != -3) {
+      std::fprintf(stderr,
+                   "release on unsealed object was not refused\n");
+      return 3;
+    }
+    if (store_abort(store, id) != 0) return 4;
+  }
+
+  std::atomic<long> allocs{0}, gets{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; t++)
+    threads.emplace_back(Worker, store, arena, 1234 + t, &allocs, &gets);
+  for (auto& th : threads) th.join();
+
+  uint64_t used, largest_free, lru_bytes, pinned_bytes, unsealed_bytes,
+      n_objects;
+  store_stats(store, &used, &largest_free, &lru_bytes, &pinned_bytes,
+              &unsealed_bytes, &n_objects);
+  std::printf("stress ok: allocs=%ld gets=%ld used=%llu objects=%llu "
+              "pinned=%llu\n",
+              allocs.load(), gets.load(),
+              static_cast<unsigned long long>(used),
+              static_cast<unsigned long long>(n_objects),
+              static_cast<unsigned long long>(pinned_bytes));
+  store_destroy(store);
+  std::remove(path);
+  return 0;
+}

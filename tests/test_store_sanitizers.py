@@ -1,0 +1,76 @@
+"""C++ store: sanitizer stress targets + the abort/release contract
+(reference: .bazelrc:92-111 TSAN/ASAN configs as CI insurance for
+plasma; here src/shm_store_stress.cc is the workload)."""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sanitizer_available(flag: str) -> bool:
+    """Probe whether g++ can link the sanitizer runtime here."""
+    if shutil.which("g++") is None:
+        return False
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "t.cc")
+        with open(src, "w") as f:
+            f.write("int main(){return 0;}\n")
+        r = subprocess.run(
+            ["g++", "-std=c++17", f"-fsanitize={flag}", "-o",
+             os.path.join(d, "t"), src],
+            capture_output=True)
+        return r.returncode == 0
+
+
+def _run_sanitized(flag: str):
+    with tempfile.TemporaryDirectory() as d:
+        binary = os.path.join(d, "stress")
+        subprocess.check_call(
+            ["g++", "-std=c++17", "-g", "-O1", f"-fsanitize={flag}",
+             "-o", binary,
+             os.path.join(REPO, "src", "shm_store_stress.cc"),
+             "-lpthread"])
+        r = subprocess.run([binary], capture_output=True, text=True,
+                           timeout=300)
+        assert r.returncode == 0, (
+            f"sanitizer ({flag}) flagged the store:\n{r.stdout}\n"
+            f"{r.stderr[-4000:]}")
+        assert "stress ok" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _sanitizer_available("thread"),
+                    reason="no TSAN runtime")
+def test_store_tsan_stress():
+    _run_sanitized("thread")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _sanitizer_available("address"),
+                    reason="no ASAN runtime")
+def test_store_asan_stress():
+    _run_sanitized("address,undefined")
+
+
+def test_store_abort_release_contract(tmp_path):
+    """The kernel backstop: release() refuses unsealed entries (a stray
+    release must not free an extent under its still-writing creator);
+    abort() is the one legal discard of an in-progress creation."""
+    from ray_tpu._private.shm_store import StoreServer
+    store = StoreServer(str(tmp_path / "arena"), 1 << 20)
+    oid = b"o" * 20
+    assert store.alloc(oid, 4096) is not None
+    assert store.release(oid) is False       # unsealed: refused
+    assert store.contains(oid) is False      # not sealed yet
+    assert store.abort(oid) is True          # legal discard
+    # Now the id is reusable and the extent was returned.
+    assert store.alloc(oid, 4096) is not None
+    assert store.seal(oid) is True
+    assert store.release(oid) is True        # creator pin drop: legal
+    assert store.contains(oid) is True
+    store.close()
