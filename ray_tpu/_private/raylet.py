@@ -54,6 +54,40 @@ class WorkerHandle:
         self.last_idle = time.monotonic()
 
 
+class _ContainerProcHandle:
+    """Popen facade for a container worker.  Signals must reach the
+    CONTAINER (`runtime rm -f <name>`), not just the podman/docker
+    client process — SIGKILLing the client detaches the engine-managed
+    container, which keeps running (and `--rm` never fires), leaking
+    the worker and its lease."""
+
+    def __init__(self, proc: subprocess.Popen, runtime: str, name: str):
+        self._proc = proc
+        self._runtime = runtime
+        self._name = name
+        self.pid = proc.pid
+
+    def poll(self):
+        return self._proc.poll()
+
+    def wait(self, timeout=None):
+        return self._proc.wait(timeout)
+
+    def kill(self):
+        try:
+            subprocess.run([self._runtime, "rm", "-f", self._name],
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL, timeout=10)
+        except Exception:
+            pass
+        try:
+            self._proc.kill()
+        except Exception:
+            pass
+
+    terminate = kill
+
+
 class Lease:
     def __init__(self, lease_id, worker, resources, pg_key):
         self.lease_id = lease_id
@@ -477,19 +511,28 @@ class Raylet:
                             f"worker-{worker_id.hex()[:8]}.log")
 
     def _spawn_worker(self, kind: str = "cpu", env_key: str = "",
-                      pip_specs: list | None = None) -> WorkerHandle:
+                      env_spec: dict | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env, unset = self._worker_env_for(worker_id, kind)
         logfile = self._worker_logfile(worker_id)
         if env_key:
-            # pip runtime env: dedicated interpreter from the cached venv
-            # (built asynchronously; the zygote can't serve these — its
-            # warm image is the base interpreter).
+            # Interpreter-environment runtime env (pip venv / conda env /
+            # container image): dedicated worker built asynchronously;
+            # the zygote can't serve these — its warm image is the base
+            # interpreter.
+            spec = env_spec or {}
             w = WorkerHandle(worker_id, None, kind=kind, env_key=env_key)
             self.workers[worker_id] = w
-            asyncio.get_running_loop().create_task(
-                self._spawn_venv_worker(w, env, env_key,
-                                        list(pip_specs or []), logfile))
+            if spec.get("container"):
+                coro = self._spawn_container_worker(
+                    w, env, spec["container"], logfile)
+            elif spec.get("conda"):
+                coro = self._spawn_conda_worker(
+                    w, env, spec["conda"], logfile)
+            else:
+                coro = self._spawn_venv_worker(
+                    w, env, env_key, list(spec.get("pip") or []), logfile)
+            asyncio.get_running_loop().create_task(coro)
             return w
         if self._zygote is not None and self._zygote.ready:
             # proc is attached asynchronously when the fork reply lands;
@@ -499,34 +542,136 @@ class Raylet:
             asyncio.get_running_loop().create_task(
                 self._fork_worker(w, env, unset, logfile))
             return w
-        os.makedirs(os.path.dirname(logfile), exist_ok=True)
-        out = open(logfile, "ab")
-        proc = subprocess.Popen(
+        proc = self._popen_worker(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True)
-        out.close()
+            env, logfile)
         w = WorkerHandle(worker_id, proc, kind=kind)
         self.workers[worker_id] = w
         return w
+
+    @staticmethod
+    def _popen_worker(argv: list, env: dict, logfile: str):
+        """One place for the worker-process launch boilerplate shared by
+        the base, venv, conda, and container spawn paths."""
+        os.makedirs(os.path.dirname(logfile), exist_ok=True)
+        out = open(logfile, "ab")
+        try:
+            return subprocess.Popen(
+                argv, env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        finally:
+            out.close()
 
     async def _spawn_venv_worker(self, w: WorkerHandle, env, env_key,
                                  pip_specs, logfile):
         try:
             py = await asyncio.get_running_loop().run_in_executor(
                 None, self._ensure_venv, env_key, pip_specs)
-            os.makedirs(os.path.dirname(logfile), exist_ok=True)
-            out = open(logfile, "ab")
-            w.proc = subprocess.Popen(
-                [py, "-m", "ray_tpu._private.worker_main"],
-                env=env, stdout=out, stderr=subprocess.STDOUT,
-                start_new_session=True)
-            out.close()
+            w.proc = self._popen_worker(
+                [py, "-m", "ray_tpu._private.worker_main"], env, logfile)
             w.pid = w.proc.pid
         except Exception as e:
             logger.warning("venv worker spawn failed: %s", e)
             await self._on_worker_dead(
                 w, f"pip runtime_env creation failed: {e}")
+
+    async def _spawn_conda_worker(self, w: WorkerHandle, env, conda_spec,
+                                  logfile):
+        """Worker under an EXISTING conda env's interpreter (reference:
+        _private/runtime_env/conda.py get_conda_env_dir — envs are
+        prebuilt; we resolve name -> prefix -> bin/python)."""
+        try:
+            prefix = conda_spec
+            if not os.path.isdir(prefix):
+                prefix = os.path.join(self._conda_root(), "envs",
+                                      conda_spec)
+            py = os.path.join(prefix, "bin", "python")
+            if not os.path.exists(py):
+                raise FileNotFoundError(
+                    f"conda env {conda_spec!r}: no interpreter at {py}")
+            w.proc = self._popen_worker(
+                [py, "-m", "ray_tpu._private.worker_main"], env, logfile)
+            w.pid = w.proc.pid
+        except Exception as e:
+            logger.warning("conda worker spawn failed: %s", e)
+            await self._on_worker_dead(
+                w, f"conda runtime_env creation failed: {e}")
+
+    @staticmethod
+    def _conda_root() -> str:
+        """The conda INSTALL root (holding envs/), not the active env:
+        CONDA_ROOT wins; else derive from CONDA_EXE (<root>/bin/conda);
+        else walk an activated env's CONDA_PREFIX (<root>/envs/<name>)
+        up to the root; else /opt/conda."""
+        root = os.environ.get("CONDA_ROOT")
+        if root:
+            return root
+        exe = os.environ.get("CONDA_EXE")
+        if exe:
+            return os.path.dirname(os.path.dirname(exe))
+        prefix = os.environ.get("CONDA_PREFIX")
+        if prefix:
+            parent = os.path.dirname(prefix)
+            if os.path.basename(parent) == "envs":
+                return os.path.dirname(parent)
+            return prefix  # base env IS the root
+        return "/opt/conda"
+
+    _CONTAINER_ENV_PREFIXES = ("RT_", "JAX_", "XLA_", "PYTHON", "TPU_")
+
+    def _container_command(self, image: str, run_options: list, env: dict,
+                           inner: list) -> list:
+        """Assemble the `podman/docker run` invocation (reference:
+        _private/runtime_env/container.py worker command rewrite).
+        --network=host keeps the raylet RPC loopback reachable; the
+        session dir bind-mount carries the shm-store arena file, so
+        in-container workers mmap the SAME pages (zero-copy object
+        reads survive containerization); the repo mount provides the
+        framework source when the image doesn't bake it in."""
+        import shutil as _shutil
+        runtime = os.environ.get("RT_CONTAINER_RUNTIME")             or _shutil.which("podman") or _shutil.which("docker")
+        if not runtime:
+            raise RuntimeError(
+                "container runtime_env needs podman or docker on PATH "
+                "(or RT_CONTAINER_RUNTIME)")
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        cmd = [runtime, "run", "--rm", "--network=host",
+               "-v", f"{self.session_dir}:{self.session_dir}",
+               "-v", f"{repo_root}:{repo_root}:ro"]
+        # The store arena usually lives OUTSIDE the session dir (in
+        # /dev/shm when writable) — bind-mount the file itself or the
+        # worker's mmap of the shared pages fails at startup.
+        if self.store_path and not self.store_path.startswith(
+                self.session_dir + os.sep):
+            cmd += ["-v", f"{self.store_path}:{self.store_path}"]
+        keep = {k: v for k, v in env.items()
+                if k.startswith(self._CONTAINER_ENV_PREFIXES)}
+        keep["PYTHONPATH"] = repo_root + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        for k, v in sorted(keep.items()):
+            cmd += ["-e", f"{k}={v}"]
+        cmd += list(run_options)
+        cmd.append(image)
+        cmd += inner
+        return cmd
+
+    async def _spawn_container_worker(self, w: WorkerHandle, env,
+                                      container_spec, logfile):
+        try:
+            name = f"rt-worker-{w.worker_id.hex()[:12]}"
+            cmd = self._container_command(
+                container_spec["image"],
+                ["--name", name]
+                + list(container_spec.get("run_options", [])), env,
+                ["python", "-m", "ray_tpu._private.worker_main"])
+            proc = self._popen_worker(cmd, env, logfile)
+            w.proc = _ContainerProcHandle(proc, cmd[0], name)
+            w.pid = proc.pid
+        except Exception as e:
+            logger.warning("container worker spawn failed: %s", e)
+            await self._on_worker_dead(
+                w, f"container runtime_env creation failed: {e}")
 
     async def _fork_worker(self, w: WorkerHandle, env, unset, logfile):
         from ray_tpu._private.zygote import PidHandle
@@ -571,7 +716,7 @@ class Raylet:
 
     async def _get_ready_worker(self, kind: str = "cpu",
                                 env_key: str = "",
-                                pip_specs: list | None = None
+                                env_spec: dict | None = None
                                 ) -> WorkerHandle | None:
         idle = self._idle(kind, env_key)
         while idle:
@@ -596,7 +741,7 @@ class Raylet:
                 if w.conn is not None and not w.conn.closed:
                     return w
             w = self._spawn_worker(kind, env_key=env_key,
-                                   pip_specs=pip_specs)
+                                   env_spec=env_spec)
             if not await self._wait_registered(w):
                 return None
             return w
@@ -757,7 +902,7 @@ class Raylet:
         self.pending_leases.append({"resources": resources, "pg_key": pg_key,
                                     "future": fut,
                                     "env_key": body.get("env_key", ""),
-                                    "pip": body.get("pip") or [],
+                                    "env_spec": body.get("env_spec"),
                                     "request_id": body.get("request_id")})
         self._kick_scheduler()
         granted = await fut
@@ -893,8 +1038,9 @@ class Raylet:
                         w = cand
                         break
                 if w is None:
-                    spec = (kind, env_key, tuple(req.get("pip") or ()))
-                    need_spawn[spec] = need_spawn.get(spec, 0) + 1
+                    cur = need_spawn.setdefault(
+                        (kind, env_key), [0, req.get("env_spec")])
+                    cur[0] += 1
                     continue
                 self._acquire(req["resources"], req["pg_key"])
                 self.pending_leases.remove(req)
@@ -908,9 +1054,9 @@ class Raylet:
                     "worker_id": w.worker_id,
                     "node_id": self.node_id,
                 })
-            for (kind, env_key, pip_specs), n in need_spawn.items():
+            for (kind, env_key), (n, env_spec) in need_spawn.items():
                 self._ensure_spawning(kind, n, env_key=env_key,
-                                      pip_specs=list(pip_specs))
+                                      env_spec=env_spec)
         finally:
             self._scheduling = False
             if self._kick_pending and self.pending_leases:
@@ -921,7 +1067,7 @@ class Raylet:
     _spawns_outstanding = 0
 
     def _ensure_spawning(self, kind: str, demand: int,
-                         env_key: str = "", pip_specs: list | None = None):
+                         env_key: str = "", env_spec: dict | None = None):
         """Keep at most `demand` additional cold starts in flight, bounded by
         the node CPU count and the pool cap (reference: WorkerPool
         maximum_startup_concurrency).  Zygote forks are cheap, so the
@@ -935,7 +1081,7 @@ class Raylet:
         for _ in range(max(0, can_spawn)):
             self._spawns_outstanding += 1
             w = self._spawn_worker(kind, env_key=env_key,
-                                   pip_specs=pip_specs)
+                                   env_spec=env_spec)
             asyncio.get_running_loop().create_task(self._finish_spawn(w))
 
     async def _finish_spawn(self, w: WorkerHandle):
@@ -1004,10 +1150,11 @@ class Raylet:
         self._acquire(resources, pg_key)
         kind = "tpu" if resources.get("TPU") else "cpu"
         renv = (body.get("spec") or {}).get("runtime_env") or {}
-        from ray_tpu.runtime_env import pip_env_key
+        from ray_tpu.runtime_env import env_spec as _env_spec
+        from ray_tpu.runtime_env import worker_env_key
         w = await self._get_ready_worker(kind,
-                                         env_key=pip_env_key(renv),
-                                         pip_specs=renv.get("pip"))
+                                         env_key=worker_env_key(renv),
+                                         env_spec=_env_spec(renv))
         if w is None:
             self._release(resources, pg_key)
             return {"ok": False, "reason": "no worker"}
@@ -1749,6 +1896,17 @@ class Raylet:
 
     async def shutdown(self):
         self._shutdown = True
+        # Announce planned exit BEFORE dropping the GCS connection, so
+        # the control plane records an orderly drain instead of a node
+        # death (which would log errors and churn actor restarts during
+        # every clean shutdown).
+        if self.gcs is not None:
+            try:
+                await self.gcs.request("node_draining",
+                                       {"node_id": self.node_id},
+                                       timeout=2.0)
+            except Exception:
+                pass  # GCS already gone: its disconnect path handles it
         for w in list(self.workers.values()):
             if w.proc is not None:
                 try:

@@ -1085,10 +1085,11 @@ class CoreWorker:
                 "request_id": request_id,
             }
             renv = spec_probe.get("runtime_env") or {}
-            if renv.get("pip"):
-                from ray_tpu.runtime_env import pip_env_key
-                body["env_key"] = pip_env_key(renv)
-                body["pip"] = list(renv["pip"])
+            from ray_tpu.runtime_env import env_spec, worker_env_key
+            espec = env_spec(renv)
+            if espec:
+                body["env_key"] = worker_env_key(renv)
+                body["env_spec"] = espec
             conn = self.raylet
             if spec_probe.get("pg_id") is not None:
                 conn = await self._raylet_for_bundle(

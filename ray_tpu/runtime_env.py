@@ -7,20 +7,25 @@ packaging.py) and materialized on workers; env_vars apply to the
 executing worker; `pip` gives the task a DEDICATED worker running in a
 content-addressed virtualenv (pip-spec hash -> cached venv, reference
 pip.py) so two tasks in one cluster can import different versions of the
-same package.  Scoped: conda/container are out (the fleet runs one
-prebuilt image — flagged unsupported rather than silently ignored).
+same package; `conda` runs the worker under an existing conda env's
+interpreter (reference: _private/runtime_env/conda.py); `container`
+runs the worker INSIDE an OCI image via podman/docker with the session
+dir bind-mounted, so the shm-store mmap stays zero-copy (reference:
+_private/runtime_env/container.py).
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
 import sys
 import zipfile
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+              "container"}
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
 
@@ -28,7 +33,9 @@ class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
-                 pip: Optional[List[str]] = None, **extra):
+                 pip: Optional[List[str]] = None,
+                 conda: Optional[Union[str, Dict]] = None,
+                 container: Optional[Dict] = None, **extra):
         unsupported = set(extra) - _SUPPORTED
         if unsupported:
             raise ValueError(
@@ -43,16 +50,64 @@ class RuntimeEnv(dict):
             self["py_modules"] = list(py_modules)
         if pip:
             self["pip"] = [str(p) for p in pip]
+        if conda:
+            if not isinstance(conda, str):
+                raise ValueError(
+                    "conda runtime_env takes an existing env NAME or "
+                    "prefix path (creating envs from a spec dict is "
+                    "not supported — prebuild the env)")
+            self["conda"] = conda
+        if container:
+            if not isinstance(container, dict) \
+                    or not container.get("image"):
+                raise ValueError(
+                    'container runtime_env needs {"image": ..., '
+                    '"run_options": [...]}')
+            self["container"] = {
+                "image": str(container["image"]),
+                "run_options": [str(o) for o in
+                                container.get("run_options", [])],
+            }
+        exclusive = [k for k in ("pip", "conda", "container") if k in self]
+        if len(exclusive) > 1:
+            raise ValueError(
+                f"runtime_env fields {exclusive} are mutually exclusive "
+                "(each selects the worker's interpreter environment)")
 
 
-def pip_env_key(runtime_env: Optional[dict]) -> str:
-    """Content address of a pip runtime env ('' = the default
-    interpreter).  Workers are pooled per key: a task only ever runs on
-    a worker whose venv matches."""
-    if not runtime_env or not runtime_env.get("pip"):
+def worker_env_key(runtime_env: Optional[dict]) -> str:
+    """Content address of the worker-interpreter environment ('' = the
+    base interpreter).  Workers are pooled per key: a task only ever
+    runs on a worker whose pip venv / conda env / container image
+    matches (reference: the worker-pool runtime-env hash in
+    worker_pool.h PopWorker)."""
+    if not runtime_env:
         return ""
-    h = hashlib.sha1("\n".join(sorted(runtime_env["pip"])).encode())
-    return h.hexdigest()[:16]
+    parts = []
+    if runtime_env.get("pip"):
+        parts.append("pip:" + "\n".join(sorted(runtime_env["pip"])))
+    if runtime_env.get("conda"):
+        parts.append("conda:" + str(runtime_env["conda"]))
+    if runtime_env.get("container"):
+        parts.append("container:" + json.dumps(runtime_env["container"],
+                                               sort_keys=True))
+    if not parts:
+        return ""
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def env_spec(runtime_env: Optional[dict]) -> Optional[dict]:
+    """The interpreter-environment subset of a runtime_env (what a
+    raylet needs to spawn a matching worker)."""
+    if not runtime_env:
+        return None
+    spec = {k: runtime_env[k] for k in ("pip", "conda", "container")
+            if runtime_env.get(k)}
+    return spec or None
+
+
+# Back-compat alias (pre-conda/container name).
+pip_env_key = worker_env_key
 
 
 def _zip_dir(path: str) -> bytes:
