@@ -50,6 +50,7 @@ class NodeInfo:
         self.labels: dict = dict(labels or {})
         self.conn: protocol.Connection = conn
         self.alive = True
+        self.draining = False  # planned shutdown announced (drain RPC)
         self.last_heartbeat = time.monotonic()
         self.load = 0  # queued lease count reported by the raylet
         self.pending_shapes: list = []
@@ -297,12 +298,31 @@ class GcsServer:
         # A raylet died, or a driver exited.
         for node in list(self.nodes.values()):
             if node.conn is conn and node.alive:
-                await self._mark_node_dead(node, "raylet connection lost")
+                if node.draining:
+                    # Planned shutdown (drain RPC preceded the close):
+                    # not a failure — don't page operators with a
+                    # NODE_DEAD error for an orderly exit.
+                    await self._mark_node_dead(
+                        node, "drained (planned shutdown)", planned=True)
+                else:
+                    await self._mark_node_dead(node,
+                                               "raylet connection lost")
         drv = self._drivers.pop(id(conn), None)
         if drv is not None:
             await self._cleanup_job(drv["job_id"])
 
     # ---------------------------------------------------------------- nodes
+    async def rpc_node_draining(self, conn, body):
+        """A raylet announces its own PLANNED shutdown — the subsequent
+        connection close is then an orderly removal, not a death.
+        (Distinct from rpc_drain_node below, the autoscaler-initiated
+        COMMAND telling a raylet to exit.)"""
+        node_id = body["node_id"]
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.draining = True
+        return {"ok": node is not None}
+
     async def rpc_register_node(self, conn, body):
         node_id = body["node_id"]
         info = NodeInfo(node_id, body["addr"], body["resources"],
@@ -373,11 +393,14 @@ class GcsServer:
         node = self.nodes.get(body["node_id"])
         if node is None or not node.alive:
             return {"ok": False}
+        node.draining = True
         try:
             await node.conn.request("shutdown", {})
         except Exception:
             pass
-        await self._mark_node_dead(node, "drained")
+        # Autoscaler downscale is intentional — an orderly drain, not a
+        # node death (no ERROR event, no operator page).
+        await self._mark_node_dead(node, "drained", planned=True)
         return {"ok": True}
 
     async def _liveness_loop(self):
@@ -407,13 +430,21 @@ class GcsServer:
                            body.get("source", "client"))
         return {"ok": True}
 
-    async def _mark_node_dead(self, node: NodeInfo, reason: str):
+    async def _mark_node_dead(self, node: NodeInfo, reason: str,
+                              planned: bool = False):
         if not node.alive:
             return
         node.alive = False
-        logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
-        self._record_event("ERROR", "NODE_DEAD",
-                           f"node {node.node_id.hex()[:8]}: {reason}")
+        if planned:
+            logger.info("node %s removed: %s", node.node_id.hex()[:8],
+                        reason)
+            self._record_event("INFO", "NODE_DRAINED",
+                               f"node {node.node_id.hex()[:8]}: {reason}")
+        else:
+            logger.warning("node %s dead: %s", node.node_id.hex()[:8],
+                           reason)
+            self._record_event("ERROR", "NODE_DEAD",
+                               f"node {node.node_id.hex()[:8]}: {reason}")
         await self._publish("nodes", {"event": "removed",
                                       "node_id": node.node_id,
                                       "reason": reason})
