@@ -3,6 +3,8 @@ slices (reference test style: tests/test_autoscaler_fake_multinode.py)."""
 
 import time
 
+import pytest
+
 import ray_tpu
 from ray_tpu.autoscaler import FakeMultiNodeProvider, StandardAutoscaler
 from ray_tpu.util.placement_group import placement_group
@@ -42,6 +44,7 @@ def test_pending_pg_triggers_scale_up(ray_start_cluster):
     assert len(autoscaler.provider.non_terminated_nodes()) >= 2
 
 
+@pytest.mark.slow
 def test_queued_task_demand_and_idle_drain(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=1, resources={"head": 1})

@@ -1,12 +1,14 @@
 """Chaos: workloads complete while nodes die mid-run (reference:
 python/ray/tests/test_chaos.py + release/nightly_tests/setup_chaos.py)."""
 
+import pytest
 import numpy as np
 
 import ray_tpu
 from ray_tpu._private.test_utils import NodeKiller
 
 
+@pytest.mark.slow
 def test_tasks_survive_node_kill_mid_pipeline(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=2, resources={"head": 1})
@@ -39,6 +41,7 @@ def test_tasks_survive_node_kill_mid_pipeline(ray_start_cluster):
     assert killer.killed, "chaos harness never killed a node"
 
 
+@pytest.mark.slow
 def test_serve_replicas_replaced_after_node_death(ray_start_cluster):
     from ray_tpu import serve
 

@@ -1,6 +1,7 @@
 """Collective group tests across actors (reference model:
 python/ray/util/collective/tests)."""
 
+import pytest
 import numpy as np
 
 import ray_tpu
@@ -55,6 +56,7 @@ def test_allreduce_and_broadcast_across_actors(ray_start_regular):
     np.testing.assert_array_equal(outs[1], np.array([42.0]))
 
 
+@pytest.mark.slow
 def test_ring_allreduce_large_tensor(ray_start_regular):
     """Large tensors ride the ring (object-store chunks); result matches
     the coordinator path bit-for-bit and the perf ratio is recorded."""

@@ -149,6 +149,7 @@ def _driver(ns, script, timeout=300):
                 timeout=timeout, env=_env(), cwd="/root/repo")
 
 
+@pytest.mark.slow
 def test_cross_namespace_tasks_and_objects(cross_host_cluster):
     gcs = cross_host_cluster["gcs"]
     out = _driver(HEAD_NS, f"""
@@ -209,6 +210,7 @@ ray_tpu.shutdown()
     assert "TRAIN_OK" in out.stdout
 
 
+@pytest.mark.slow
 def test_cross_namespace_sigkill_worker_node(cross_host_cluster):
     """SIGKILL the other namespace's raylet mid-run: the head detects
     the remote node's death across the network boundary, the dead

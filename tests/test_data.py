@@ -22,6 +22,7 @@ def test_range_count_take(ray_init):
     assert ds.num_blocks() == 4
 
 
+@pytest.mark.slow
 def test_map_batches_and_filter(ray_init):
     ds = rd.range(32, parallelism=4)
     out = ds.map_batches(lambda b: [x * 2 for x in b],

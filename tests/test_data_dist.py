@@ -40,6 +40,7 @@ def _make_blocks_on(node_resource, n_blocks, rows_per_block, seed):
             for i in range(n_blocks)]
 
 
+@pytest.mark.slow
 def test_cross_node_sort_larger_than_one_store(two_node_cluster):
     """10 blocks x 16MB (160MB total) live split across two raylets whose
     stores are 144MB each — no single node can hold the dataset, so the
@@ -67,6 +68,7 @@ def test_cross_node_sort_larger_than_one_store(two_node_cluster):
     assert total == 10 * rows
 
 
+@pytest.mark.slow
 def test_cross_node_shuffle_preserves_rows(two_node_cluster):
     rows = 20_000
     refs = (_make_blocks_on("n0", 3, rows, seed=7)
@@ -79,6 +81,7 @@ def test_cross_node_shuffle_preserves_rows(two_node_cluster):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_push_shuffle_rounds_overlap_merge():
     """The accumulator for round 0 must be runnable before the last
     round's maps finish: with 4 rounds over 8 blocks there are 4 accum

@@ -36,6 +36,7 @@ def test_submit_poll_logs_over_http_only(dashboard):
                for j in client.list_jobs())
 
 
+@pytest.mark.slow
 def test_streaming_logs_and_stop(dashboard):
     client = JobSubmissionClient(dashboard)
     sid = client.submit_job(

@@ -95,6 +95,7 @@ def test_graft_entry_single_chip():
     assert logits.shape[-1] == 32000
 
 
+@pytest.mark.slow
 def test_vit_sharded_matches_single_device():
     from ray_tpu.models import vit
 
@@ -114,6 +115,7 @@ def test_vit_sharded_matches_single_device():
     assert abs(single - sharded) < 1e-3, (single, sharded)
 
 
+@pytest.mark.slow
 def test_vit_train_step_reduces_loss():
     from ray_tpu.models import vit
 

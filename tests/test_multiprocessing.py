@@ -21,6 +21,7 @@ def _addmul(a, b):
     return a * 10 + b
 
 
+@pytest.mark.slow
 def test_pool_map_and_apply(ray_init):
     with Pool(processes=4) as pool:
         assert pool.map(_sq, range(6)) == [0, 1, 4, 9, 16, 25]

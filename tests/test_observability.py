@@ -78,6 +78,7 @@ def test_metrics_and_prometheus_text(ray_init):
     assert "test_latency_s_count 3" in text
 
 
+@pytest.mark.slow
 def test_timeline_records_task_events(ray_init):
     @ray_tpu.remote
     def traced():
@@ -96,6 +97,7 @@ def test_timeline_records_task_events(ray_init):
                for e in events)
 
 
+@pytest.mark.slow
 def test_job_submission_end_to_end(ray_init):
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
 

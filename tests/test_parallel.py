@@ -31,6 +31,7 @@ def test_ring_attention_matches_dense(causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_matches_dense():
     mesh = _mesh(sp=4)
     rng = np.random.RandomState(1)

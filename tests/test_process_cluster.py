@@ -23,6 +23,7 @@ def proc_cluster():
     c.shutdown()
 
 
+@pytest.mark.slow
 def test_two_process_groups_tasks_and_objects(proc_cluster):
     c = proc_cluster
     c.add_node(num_cpus=2)
@@ -48,6 +49,7 @@ def test_two_process_groups_tasks_and_objects(proc_cluster):
     assert len(ray_tpu.get(ref, timeout=120)) == 2 * 1024 * 1024
 
 
+@pytest.mark.slow
 def test_sigkill_raylet_actor_restarts(proc_cluster):
     c = proc_cluster
     c.add_node(num_cpus=2)  # head: the driver's node, never killed
@@ -88,6 +90,7 @@ def test_sigkill_raylet_actor_restarts(proc_cluster):
     assert ray_tpu.get(a.port.remote(), timeout=120) != first_port
 
 
+@pytest.mark.slow
 def test_sigkill_raylet_lineage_reconstruction(proc_cluster):
     c = proc_cluster
     c.add_node(num_cpus=2)
@@ -119,6 +122,7 @@ def test_sigkill_raylet_lineage_reconstruction(proc_cluster):
     assert arr[0] == 7 and len(arr) == 300_000
 
 
+@pytest.mark.slow
 def test_sigkill_gcs_restart_cluster_survives(proc_cluster):
     c = proc_cluster
     c.add_node(num_cpus=2)
@@ -149,6 +153,7 @@ def test_sigkill_gcs_restart_cluster_survives(proc_cluster):
     assert any(n["Alive"] for n in ray_tpu.nodes())
 
 
+@pytest.mark.slow
 def test_autoscaler_with_real_process_provider(proc_cluster):
     """Elasticity against REAL raylet processes: the autoscaler's
     provider launches OS-process nodes joined to the live GCS

@@ -8,6 +8,7 @@ import pytest
 import ray_tpu
 
 
+@pytest.mark.slow
 def test_reconstruct_lost_task_output(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=1, resources={"head": 1})

@@ -32,6 +32,7 @@ def test_gae_matches_closed_form():
         rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ppo_cartpole_learns(ray_init):
     algo = (PPOConfig()
             .environment("CartPole-v1")
@@ -50,6 +51,7 @@ def test_ppo_cartpole_learns(ray_init):
     assert best >= 150, f"PPO failed to learn (best={best})"
 
 
+@pytest.mark.slow
 def test_impala_stays_throughput_positive(ray_init):
     algo = (ImpalaConfig()
             .environment("CartPole-v1")
@@ -66,6 +68,7 @@ def test_impala_stays_throughput_positive(ray_init):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ddppo_decentralized_learning(ray_init):
     from ray_tpu.rllib import DDPPOConfig
 
@@ -94,6 +97,7 @@ def test_ddppo_decentralized_learning(ray_init):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_dqn_cartpole_improves(ray_init):
     from ray_tpu.rllib import DQNConfig
 

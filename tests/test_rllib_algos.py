@@ -24,6 +24,7 @@ def ray_init():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_a2c_cartpole_improves(ray_init):
     algo = (A2CConfig()
             .environment("CartPole-v1")
@@ -43,6 +44,7 @@ def test_a2c_cartpole_improves(ray_init):
     assert best >= 60, f"A2C failed to improve (best={best})"
 
 
+@pytest.mark.slow
 def test_appo_async_throughput_and_loss(ray_init):
     algo = (APPOConfig()
             .environment("CartPole-v1")
@@ -58,6 +60,7 @@ def test_appo_async_throughput_and_loss(ray_init):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_es_cartpole_improves(ray_init):
     algo = (ESConfig()
             .environment("CartPole-v1")
@@ -99,6 +102,7 @@ def _expert_cartpole_data(n_steps: int, seed: int = 0):
             "dones": np.asarray(rows["dones"], np.bool_)}
 
 
+@pytest.mark.slow
 def test_bc_clones_expert(ray_init):
     data = _expert_cartpole_data(3000)
     algo = (BCConfig()
@@ -149,6 +153,7 @@ def test_sharded_learner_matches_single_chip():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sac_cartpole_improves(ray_init):
     algo = (SACConfig()
             .environment("CartPole-v1")
@@ -171,6 +176,7 @@ def test_sac_cartpole_improves(ray_init):
     assert best >= 40, f"SAC failed to improve (best={best})"
 
 
+@pytest.mark.slow
 def test_sac_continuous_pendulum(ray_init):
     """Continuous-action SAC: tanh-Gaussian policy on Pendulum-v1.
     Asserts mechanics (bounded actions, finite losses, temperature
@@ -222,6 +228,7 @@ def test_marwil_weighted_imitation(ray_init):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_pg_cartpole_improves(ray_init):
     algo = (PGConfig()
             .environment("CartPole-v1")

@@ -16,6 +16,7 @@ def ray_init():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_simple_q_cartpole_improves(ray_init):
     algo = (SimpleQConfig()
             .environment("CartPole-v1")
@@ -36,6 +37,7 @@ def test_simple_q_cartpole_improves(ray_init):
     assert best > 32, f"SimpleQ failed to improve (best={best})"
 
 
+@pytest.mark.slow
 def test_a3c_async_grads_improve_cartpole(ray_init):
     algo = (A3CConfig()
             .environment("CartPole-v1")
@@ -79,6 +81,7 @@ def _pendulum_offline_data(n=3000, seed=0):
             for k, v in rows.items()}
 
 
+@pytest.mark.slow
 def test_cql_conservative_offline(ray_init):
     """CQL mechanics on offline Pendulum data: losses finite, and the
     conservative property holds — after training, Q on dataset actions

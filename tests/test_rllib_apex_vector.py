@@ -17,6 +17,7 @@ def ray_init():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_apex_dqn_distributed_replay_learns(ray_init):
     algo = (ApexDQNConfig()
             .environment("CartPole-v1")
@@ -51,6 +52,7 @@ def test_apex_dqn_distributed_replay_learns(ray_init):
     assert best >= 45, f"Apex-DQN failed to learn (best={best})"
 
 
+@pytest.mark.slow
 def test_vector_env_sampling_ppo(ray_init):
     algo = (PPOConfig()
             .environment("CartPole-v1")

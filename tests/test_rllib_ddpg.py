@@ -67,6 +67,7 @@ def test_ddpg_pendulum_mechanics(ray_init):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ddpg_learns_reach_task(ray_init):
     algo = (DDPGConfig()
             .environment(lambda cfg: ReachEnv())
@@ -88,6 +89,7 @@ def test_ddpg_learns_reach_task(ray_init):
     assert best > -6.0, f"DDPG failed the reach task (best={best})"
 
 
+@pytest.mark.slow
 def test_td3_learns_reach_and_uses_td3_mechanics(ray_init):
     algo = (TD3Config()
             .environment(lambda cfg: ReachEnv())

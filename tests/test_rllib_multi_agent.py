@@ -67,6 +67,7 @@ class TwoTargetEnv(MultiAgentEnv):
         return obs, rewards, terms, truncs, {}
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_two_policies_learn():
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     try:

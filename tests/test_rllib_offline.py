@@ -62,6 +62,7 @@ def test_rollout_output_config_records(ray_init, tmp_path):
     assert read_sample_batches(out).count >= 200
 
 
+@pytest.mark.slow
 def test_collect_then_bc_from_files(ray_init, tmp_path):
     """PPO collects CartPole experience with rollout output=<dir>; BC
     then trains purely from the files (input_data=<path>)."""

@@ -133,6 +133,7 @@ def test_prioritized_beats_uniform_on_sparse_signal():
         f"({uni_err:.3f}) on Blind Cliffwalk")
 
 
+@pytest.mark.slow
 def test_dqn_prioritized_cartpole_improves(ray_init):
     from ray_tpu.rllib import DQNConfig
 

@@ -90,6 +90,7 @@ def _make_pkg(tmpdir, version):
     return root
 
 
+@pytest.mark.slow
 def test_pip_venv_isolation(ray_init, tmp_path):
     """Two tasks in ONE cluster import DIFFERENT versions of the same
     package (reference: _private/runtime_env/pip.py — spec-hashed cached

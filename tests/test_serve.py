@@ -52,6 +52,7 @@ def test_class_deployment_replicas_and_methods(serve_instance):
     assert st["counter"]["status"] == "HEALTHY"
 
 
+@pytest.mark.slow
 def test_http_proxy_end_to_end(serve_instance):
     import requests
 
@@ -221,6 +222,7 @@ def test_route_prefix(serve_instance):
                         ).status_code == 404
 
 
+@pytest.mark.slow
 def test_proxy_per_node(ray_start_cluster):
     import requests
 

@@ -2,6 +2,7 @@
 pressure and restores them on access (reference test style:
 python/ray/tests/test_object_spilling.py)."""
 
+import pytest
 import numpy as np
 
 import ray_tpu
@@ -25,6 +26,7 @@ def test_put_beyond_capacity_spills_and_restores(ray_start_cluster):
         np.testing.assert_array_equal(ray_tpu.get(r, timeout=120), a)
 
 
+@pytest.mark.slow
 def test_spilled_object_served_to_remote_node(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=1, resources={"head": 1},

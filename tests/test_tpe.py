@@ -64,6 +64,7 @@ def test_tpe_log_domain_and_max_mode():
     assert 1e-4 < geo < 1e-2, f"TPE geo-mean lr {geo}"
 
 
+@pytest.mark.slow
 def test_tpe_drives_tuner(ray_init):
     def objective(config):
         from ray_tpu.air import session
@@ -85,6 +86,7 @@ def test_tpe_drives_tuner(ray_init):
     assert best.metrics["loss"] < 0.05
 
 
+@pytest.mark.slow
 def test_gp_search_finds_optimum(ray_init):
     """Native GP-EI searcher (reference role: search/bayesopt adapter)
     beats the random-startup baseline on a smooth 2-D surface."""

@@ -49,6 +49,7 @@ def _linreg_loop(config):
                        checkpoint=Checkpoint.from_pytree({"w": w}))
 
 
+@pytest.mark.slow
 def test_jax_trainer_end_to_end(ray_init):
     trainer = JaxTrainer(
         _linreg_loop,
@@ -71,6 +72,7 @@ def _rank_report_loop(config):
                     "world": session.get_world_size()})
 
 
+@pytest.mark.slow
 def test_worker_group_ranks(ray_init):
     trainer = JaxTrainer(
         _rank_report_loop,
@@ -127,6 +129,7 @@ def _torch_ddp_loop(config):
                     "world": dist.get_world_size()})
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp_gloo(ray_init):
     from ray_tpu.train.torch import TorchTrainer
 
@@ -184,6 +187,7 @@ def _hf_trainer_init(config):
     return Trainer(model=model, args=args, train_dataset=Toy())
 
 
+@pytest.mark.slow
 def test_transformers_trainer(ray_init, tmp_path):
     from ray_tpu.train.huggingface import TransformersTrainer
 

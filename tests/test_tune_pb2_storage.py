@@ -25,6 +25,7 @@ def _quadratic(config):
         tune.report({"score": 10 - (lr - 0.6) ** 2 * 10 + 0.01 * i})
 
 
+@pytest.mark.slow
 def test_pb2_beats_random_on_toy_surface(ray_init):
     # PB2 population: exploits clone top performers and the GP proposes
     # their new lr inside the bounds.
@@ -88,6 +89,7 @@ def _trainable_with_ckpt(config):
         tune.report({"score": config["a"] * (i + 1)})
 
 
+@pytest.mark.slow
 def test_experiment_sync_and_resume_via_storage(ray_init):
     """Run an experiment against mem:// storage, then resume a FRESH
     runner from the synced state alone (the local scratch dir of the

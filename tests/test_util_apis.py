@@ -14,6 +14,7 @@ def ray_init():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_actor_pool_map(ray_init):
     @ray_tpu.remote
     class Worker:
@@ -29,6 +30,7 @@ def test_actor_pool_map(ray_init):
     assert out == [0, 2, 4, 6, 8]
 
 
+@pytest.mark.slow
 def test_queue_across_processes(ray_init):
     q = Queue(maxsize=10)
 

@@ -20,6 +20,7 @@ def wf_env():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_workflow_runs_dag(wf_env):
     @ray_tpu.remote
     def add(a, b):
@@ -37,6 +38,7 @@ def test_workflow_runs_dag(wf_env):
     assert any(w["workflow_id"] == "w1" for w in workflow.list_all())
 
 
+@pytest.mark.slow
 def test_workflow_resume_skips_completed_tasks(wf_env):
     calls_file = os.path.join(tempfile.gettempdir(),
                               f"wf_calls_{os.getpid()}")
