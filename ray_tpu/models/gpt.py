@@ -75,6 +75,13 @@ class GPTConfig:
     # Pallas flash attention for long sequences (TPU only; falls back to
     # the einsum reference off-TPU or on non-tiling shapes).
     use_flash: bool = True
+    # Blockwise LM-head loss: compute the [chunk, vocab] logits + CE a
+    # token-chunk at a time (checkpointed, so backward recomputes one
+    # chunk's logits) instead of materializing the full [B*T, vocab]
+    # f32 logits tensor — at B=8 T=4096 V=32k that tensor alone is
+    # 4.2 GB of HBM.  0 = off.  Single-chip path only; the sharded path
+    # keeps logits materialized under its tp sharding.
+    loss_chunk: int = 0
     # False = bidirectional attention (encoder models, e.g. models/vit).
     causal: bool = True
 
@@ -377,8 +384,8 @@ def _shard_map(f, mesh, in_specs, out_specs):
 # Forward / loss / train step
 
 
-def forward(params: dict, tokens, cfg: GPTConfig, mesh=None):
-    """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
+def hidden_states(params: dict, tokens, cfg: GPTConfig, mesh=None):
+    """tokens: [B, T] int32 -> final-norm hidden states [B, T, d]."""
     B, T = tokens.shape
     dt = cfg.dtype
     x = jnp.take(params["wte"], tokens, axis=0)
@@ -396,7 +403,12 @@ def forward(params: dict, tokens, cfg: GPTConfig, mesh=None):
         x = _shard_map(body, mesh, (_block_in_specs(cfg), x_spec),
                        x_spec)(params["blocks"], x)
 
-    x = _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"])
+
+
+def forward(params: dict, tokens, cfg: GPTConfig, mesh=None):
+    """tokens: [B, T] int32 -> logits [B, T, vocab] (fp32)."""
+    x = hidden_states(params, tokens, cfg, mesh)
     # bf16 operands, f32 accumulation: upcasting the INPUTS would push
     # the lm-head matmul off the fast MXU path (and the [B,T,vocab]
     # logits are produced in f32 either way for a stable softmax).
@@ -413,6 +425,36 @@ def loss_fn(params, tokens, cfg: GPTConfig, mesh=None):
     """Next-token cross entropy; tokens [B, T+1]."""
     import optax
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    chunk = cfg.loss_chunk
+    B, T = inputs.shape
+    if chunk and mesh is None and (B * T) % chunk != 0:
+        # Requested chunk doesn't divide the token count: round DOWN to
+        # the largest divisor <= chunk rather than silently falling back
+        # to the full-logits path the option exists to avoid.
+        chunk = next(c for c in range(min(chunk, B * T), 0, -1)
+                     if (B * T) % c == 0)
+    if chunk and mesh is None:
+        # Blockwise LM head: one token-chunk's [chunk, vocab] logits
+        # live at a time; jax.checkpoint recomputes them in backward
+        # (~3% extra FLOPs) instead of keeping the full f32 logits
+        # resident — the freed HBM buys batch/remat headroom.
+        x = hidden_states(params, inputs, cfg, mesh)
+        xf = x.reshape(B * T, -1).astype(cfg.dtype)
+        tf = targets.reshape(B * T)
+        wlm = params["wlm"].astype(cfg.dtype)
+
+        @jax.checkpoint
+        def _chunk_ce(xc, tc):
+            logits = jnp.einsum("nd,dv->nv", xc, wlm,
+                                preferred_element_type=jnp.float32)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tc)
+
+        n = (B * T) // chunk
+        losses = lax.map(lambda a: _chunk_ce(*a),
+                         (xf.reshape(n, chunk, -1),
+                          tf.reshape(n, chunk)))
+        return losses.mean()
     logits = forward(params, inputs, cfg, mesh)
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     return loss.mean()
