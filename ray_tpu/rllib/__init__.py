@@ -74,16 +74,32 @@ from ray_tpu.rllib.algorithms.alpha_zero.alpha_zero import (  # noqa: F401
     AlphaZero,
     AlphaZeroConfig,
 )
+from ray_tpu.rllib.algorithms.maml.maml import MAML, MAMLConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.mbmpo.mbmpo import (  # noqa: F401
+    MBMPO,
+    MBMPOConfig,
+)
+from ray_tpu.rllib.algorithms.dreamer.dreamer import (  # noqa: F401
+    Dreamer,
+    DreamerConfig,
+)
+from ray_tpu.rllib.algorithms.alpha_star.alpha_star import (  # noqa: F401
+    AlphaStar,
+    AlphaStarConfig,
+)
 from ray_tpu.rllib.policy.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig",
+           "AlphaStar", "AlphaStarConfig",
            "AlphaZero", "AlphaZeroConfig", "ApexDQN", "ApexDQNConfig",
            "BC", "BCConfig", "BanditLinTS", "BanditLinTSConfig",
            "BanditLinUCB", "BanditLinUCBConfig", "CQL", "CQLConfig",
            "CRR", "CRRConfig", "DDPG", "DDPGConfig", "DDPPO",
            "DDPPOConfig", "DQN", "DQNConfig", "DT", "DTConfig", "ES",
-           "ESConfig", "Impala", "ImpalaConfig", "MADDPG",
+           "Dreamer", "DreamerConfig", "ESConfig", "Impala",
+           "ImpalaConfig", "MADDPG", "MAML", "MAMLConfig",
+           "MBMPO", "MBMPOConfig",
            "MADDPGConfig", "MARWIL", "MARWILConfig", "PG", "PGConfig",
            "PPO", "PPOConfig", "QMix", "QMixConfig", "R2D2",
            "R2D2Config", "SAC", "SACConfig", "SampleBatch", "SimpleQ",

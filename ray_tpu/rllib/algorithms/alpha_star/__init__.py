@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.alpha_star.alpha_star import (  # noqa: F401
+    AlphaStar,
+    AlphaStarConfig,
+)

@@ -1,0 +1,202 @@
+"""AlphaStar-style league training (Vinyals et al. 2019), scoped.
+
+Reference: rllib/algorithms/alpha_star/alpha_star.py — the contribution
+over plain self-play is the LEAGUE: a population of frozen snapshots
+plus three live roles — main agents (train against a prioritized
+fictitious self-play mixture of the whole league), main exploiters
+(train only against the current main agent, finding its weaknesses),
+and league exploiters (train against the league mixture) — with
+win-rate-driven PFSP matchmaking and periodic snapshotting.  Plain
+self-play famously CYCLES on games with rock-paper-scissors structure;
+the league converges toward the Nash mixture.
+
+Scoped re-design: the "game" is any symmetric zero-sum matrix game
+(default: rock-paper-scissors), policies are softmax logit vectors
+trained by REINFORCE against sampled opponents, and exploitability
+(max_a E[payoff(a, pi)]) is computed exactly — the property the league
+exists to minimize.  The league MACHINERY (roles, PFSP, snapshots,
+payoff table) is the reference-parity surface; the game is the smallest
+one with the pathology that motivates it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.trainable import Trainable
+
+RPS_PAYOFF = np.array([[0.0, -1.0, 1.0],
+                       [1.0, 0.0, -1.0],
+                       [-1.0, 1.0, 0.0]])
+
+
+def _softmax(x):
+    e = np.exp(x - x.max())
+    return e / e.sum()
+
+
+class _LeagueMember:
+    __slots__ = ("logits", "role", "frozen")
+
+    def __init__(self, logits, role, frozen=False):
+        self.logits = logits.astype(np.float64)
+        self.role = role       # main | main_exploiter | league_exploiter
+        self.frozen = frozen
+
+    def policy(self):
+        return _softmax(self.logits)
+
+
+class AlphaStarConfig:
+    def __init__(self):
+        self.algo_class = AlphaStar
+        self._config: Dict = {
+            "payoff_matrix": RPS_PAYOFF,
+            "lr": 0.3,
+            "games_per_step": 512,
+            "num_main": 1,
+            "num_main_exploiters": 1,
+            "num_league_exploiters": 1,
+            "snapshot_every": 2,     # iterations between league freezes
+            "pfsp_power": 2.0,       # hard-opponent weighting exponent
+            "init_scale": 0.1,       # initial logit spread (big = far
+                                     # from Nash, shows league value)
+            "seed": 0,
+        }
+
+    def training(self, **kwargs) -> "AlphaStarConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "AlphaStarConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "AlphaStar":
+        return AlphaStar(config=self.to_dict())
+
+
+class AlphaStar(Trainable):
+    def setup(self, config: Dict):
+        defaults = AlphaStarConfig().to_dict()
+        defaults.update(config)
+        self.cfg = defaults
+        self.A = np.asarray(self.cfg["payoff_matrix"], np.float64)
+        self.n_actions = self.A.shape[0]
+        self._rng = np.random.RandomState(self.cfg["seed"])
+        self.league: List[_LeagueMember] = []
+        for _ in range(self.cfg["num_main"]):
+            self.league.append(self._spawn("main"))
+        for _ in range(self.cfg["num_main_exploiters"]):
+            self.league.append(self._spawn("main_exploiter"))
+        for _ in range(self.cfg["num_league_exploiters"]):
+            self.league.append(self._spawn("league_exploiter"))
+        self._iter = 0
+
+    def _spawn(self, role) -> _LeagueMember:
+        return _LeagueMember(
+            self._rng.randn(self.n_actions) * self.cfg["init_scale"],
+            role)
+
+    # ------------------------------------------------------ matchmaking
+    def _live(self, role=None):
+        return [m for m in self.league
+                if not m.frozen and (role is None or m.role == role)]
+
+    def _pfsp_opponent(self, agent) -> "_LeagueMember":
+        """Prioritized fictitious self-play (reference: pfsp weighting):
+        main agents face the WHOLE league weighted toward opponents they
+        LOSE to; main exploiters face only the current main agent;
+        league exploiters face the league uniformly."""
+        if agent.role == "main_exploiter":
+            mains = self._live("main")
+            return mains[self._rng.randint(len(mains))]
+        pool = [m for m in self.league if m is not agent]
+        if not pool:
+            return agent  # degenerate league: plain self-play
+        if agent.role == "league_exploiter":
+            return pool[self._rng.randint(len(pool))]
+        # main: PFSP — weight by (1 - winrate vs opponent)^power.
+        w = []
+        p_a = agent.policy()
+        for m in pool:
+            ev = p_a @ self.A @ m.policy()     # expected payoff in [-1,1]
+            winrate = (ev + 1.0) / 2.0
+            w.append((1.0 - winrate) ** self.cfg["pfsp_power"] + 1e-3)
+        w = np.asarray(w)
+        return pool[self._rng.choice(len(pool), p=w / w.sum())]
+
+    # ------------------------------------------------------ learning
+    def _reinforce(self, agent, opponent):
+        """One REINFORCE game batch of agent vs opponent."""
+        n = self.cfg["games_per_step"]
+        p = agent.policy()
+        q = opponent.policy()
+        a = self._rng.choice(self.n_actions, n, p=p)
+        b = self._rng.choice(self.n_actions, n, p=q)
+        payoff = self.A[a, b]
+        baseline = payoff.mean()
+        grad = np.zeros(self.n_actions)
+        for i in range(n):
+            g = np.zeros(self.n_actions)
+            g[a[i]] = 1.0
+            grad += (payoff[i] - baseline) * (g - p)
+        agent.logits += self.cfg["lr"] * grad / n
+        return baseline
+
+    def exploitability(self, member: Optional[_LeagueMember] = None
+                       ) -> float:
+        """max_a E_b~pi [payoff(a, b)] — 0 at the Nash mixture."""
+        m = member or self._live("main")[0]
+        return float((self.A @ m.policy()).max())
+
+    def league_mixture(self) -> np.ndarray:
+        """The league's average policy (main lineage + snapshots) — the
+        fictitious-self-play object that converges to Nash in zero-sum
+        games; single members may cycle forever (the RPS pathology),
+        the MIXTURE is what the league makes strong."""
+        mains = [m for m in self.league
+                 if m.role == "main"]
+        return np.mean([m.policy() for m in mains], axis=0)
+
+    def mixture_exploitability(self) -> float:
+        return float((self.A @ self.league_mixture()).max())
+
+    def step(self) -> Dict:
+        self._iter += 1
+        evs = {}
+        for agent in self._live():
+            opp = self._pfsp_opponent(agent)
+            evs[agent.role] = self._reinforce(agent, opp)
+        if self._iter % self.cfg["snapshot_every"] == 0:
+            # Freeze copies of every live agent into the league
+            # (reference: past-player snapshots the PFSP pool draws on).
+            for agent in list(self._live()):
+                snap = _LeagueMember(agent.logits.copy(), agent.role,
+                                     frozen=True)
+                self.league.append(snap)
+        main = self._live("main")[0]
+        mix_expl = self.mixture_exploitability()
+        return {"exploitability": self.exploitability(main),
+                "mixture_exploitability": mix_expl,
+                "main_policy": main.policy().tolist(),
+                "league_size": len(self.league),
+                "episode_reward_mean": -mix_expl,
+                "training_iteration_": self._iter}
+
+    def save_checkpoint(self) -> Dict:
+        return {"league": [(m.logits, m.role, m.frozen)
+                           for m in self.league],
+                "iter": self._iter}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            self.league = [_LeagueMember(lg, role, frozen)
+                           for lg, role, frozen in data["league"]]
+            self._iter = data.get("iter", 0)

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.dreamer.dreamer import Dreamer, DreamerConfig  # noqa: F401

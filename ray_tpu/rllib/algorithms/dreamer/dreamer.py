@@ -1,0 +1,434 @@
+"""Dreamer: learning behaviors by latent imagination (Hafner et al.
+2020).
+
+Reference: rllib/algorithms/dreamer/dreamer.py — an RSSM world model
+(deterministic GRU path + stochastic latent, with encoder, decoder,
+and reward head) is trained on replayed real sequences; the actor and
+value critic are then trained ENTIRELY inside the model by
+backpropagating lambda-returns through imagined latent rollouts.
+
+Re-designed jax-first and scoped to proprioceptive observations (the
+reference's conv encoder/decoder for pixels becomes an MLP pair): the
+world-model update and the imagination update are each ONE jitted
+function — reparameterized latents make the actor gradient flow
+through the learned dynamics exactly (no likelihood-ratio estimator),
+which is the heart of the algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.tune.trainable import Trainable
+
+
+class _RSSM(nn.Module):
+    """h_t = GRU(h_{t-1}, [z_{t-1}, a_{t-1}]);  prior p(z_t|h_t);
+    posterior q(z_t|h_t, embed_t)."""
+
+    stoch: int = 16
+    deter: int = 64
+    hidden: int = 64
+
+    def setup(self):
+        self.gru = nn.GRUCell(features=self.deter)
+        self.inp = nn.Dense(self.hidden)
+        self.prior_net = nn.Sequential(
+            [nn.Dense(self.hidden), nn.elu, nn.Dense(2 * self.stoch)])
+        self.post_net = nn.Sequential(
+            [nn.Dense(self.hidden), nn.elu, nn.Dense(2 * self.stoch)])
+
+    def _stats(self, net, x):
+        mean, std = jnp.split(net(x), 2, axis=-1)
+        return mean, nn.softplus(std) + 0.1
+
+    def step(self, h, z, a):
+        x = nn.elu(self.inp(jnp.concatenate([z, a], -1)))
+        h, _ = self.gru(h, x)
+        return h
+
+    def prior(self, h):
+        return self._stats(self.prior_net, h)
+
+    def posterior(self, h, embed):
+        return self._stats(self.post_net,
+                           jnp.concatenate([h, embed], -1))
+
+    def __call__(self, h, z, a, embed):
+        # Single init-path call so .init sees every submodule.
+        h = self.step(h, z, a)
+        return self.prior(h), self.posterior(h, embed)
+
+
+class _MLP(nn.Module):
+    out: int
+    hiddens: tuple = (64, 64)
+    final_tanh: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        for width in self.hiddens:
+            x = nn.elu(nn.Dense(width)(x))
+        x = nn.Dense(self.out)(x)
+        return jnp.tanh(x) if self.final_tanh else x
+
+
+class DreamerConfig:
+    def __init__(self):
+        self.algo_class = Dreamer
+        self._config: Dict = {
+            "env": "Pendulum-v1",
+            "env_config": {},
+            "stoch": 16, "deter": 64, "hidden": 64,
+            "model_lr": 3e-4, "actor_lr": 1e-4, "critic_lr": 1e-4,
+            "gamma": 0.99, "lambda": 0.95,
+            "imagine_horizon": 15,
+            "seq_len": 20,
+            "batch_size": 32,
+            "model_train_steps": 40,
+            "behavior_train_steps": 40,
+            "episodes_per_iter": 4,
+            "max_episode_steps": 100,
+            "expl_noise": 0.3,
+            "buffer_capacity_episodes": 200,
+            "free_nats": 1.0,
+            "kl_scale": 1.0,
+            "seed": 0,
+        }
+
+    def environment(self, env=None, env_config=None) -> "DreamerConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def training(self, **kwargs) -> "DreamerConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, seed=None) -> "DreamerConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    def to_dict(self) -> Dict:
+        return dict(self._config)
+
+    def build(self) -> "Dreamer":
+        return Dreamer(config=self.to_dict())
+
+
+class Dreamer(Trainable):
+    def setup(self, config: Dict):
+        defaults = DreamerConfig().to_dict()
+        defaults.update(config)
+        self.cfg = cfg = defaults
+        import gymnasium as gym
+        env = cfg["env"]
+        self.env = (gym.make(env, **cfg["env_config"])
+                    if isinstance(env, str) else env(cfg["env_config"]))
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        space = self.env.action_space
+        self.act_dim = int(np.prod(space.shape))
+        self._act_low = np.asarray(space.low, np.float32).reshape(-1)
+        self._act_high = np.asarray(space.high, np.float32).reshape(-1)
+        self._scale = (self._act_high - self._act_low) / 2.0
+        self._center = (self._act_high + self._act_low) / 2.0
+
+        S, D, H = cfg["stoch"], cfg["deter"], cfg["hidden"]
+        self.rssm = _RSSM(stoch=S, deter=D, hidden=H)
+        self.encoder = _MLP(out=H)
+        self.decoder = _MLP(out=self.obs_dim)
+        self.reward_head = _MLP(out=1)
+        self.actor = _MLP(out=self.act_dim, final_tanh=True)
+        self.critic = _MLP(out=1)
+
+        k = jax.random.split(jax.random.PRNGKey(cfg["seed"]), 6)
+        zh = jnp.zeros((1, D)); zz = jnp.zeros((1, S))
+        za = jnp.zeros((1, self.act_dim)); ze = jnp.zeros((1, H))
+        zf = jnp.zeros((1, D + S)); zo = jnp.zeros((1, self.obs_dim))
+        self.wm_params = {
+            "rssm": self.rssm.init(k[0], zh, zz, za, ze),
+            "enc": self.encoder.init(k[1], zo),
+            "dec": self.decoder.init(k[2], zf),
+            "rew": self.reward_head.init(k[3], zf),
+        }
+        self.actor_params = self.actor.init(k[4], zf)
+        self.critic_params = self.critic.init(k[5], zf)
+        self.wm_tx = optax.adam(cfg["model_lr"])
+        self.actor_tx = optax.adam(cfg["actor_lr"])
+        self.critic_tx = optax.adam(cfg["critic_lr"])
+        self.wm_opt = self.wm_tx.init(self.wm_params)
+        self.actor_opt = self.actor_tx.init(self.actor_params)
+        self.critic_opt = self.critic_tx.init(self.critic_params)
+        self._key = jax.random.PRNGKey(cfg["seed"] + 1)
+        self._rng = np.random.RandomState(cfg["seed"] + 2)
+        self._episodes: List[Dict] = []
+        self._episode_rewards: List[float] = []
+        self._iter = 0
+        self._timesteps_total = 0
+        self._wm_train = jax.jit(self._wm_train_impl)
+        self._behavior_train = jax.jit(self._behavior_train_impl)
+        self._policy_step = jax.jit(self._policy_step_impl)
+        self._observe_jit = jax.jit(self._observe_seq)
+
+    # ------------------------------------------------------- acting
+    def _policy_step_impl(self, wm, actor_params, h, z, a_prev, obs, key):
+        embed = nn.elu(self.encoder.apply(wm["enc"], obs))
+        h = self.rssm.apply(wm["rssm"], h, z, a_prev,
+                            method=_RSSM.step)
+        mean, std = self.rssm.apply(wm["rssm"], h, embed,
+                                    method=_RSSM.posterior)
+        z = mean + std * jax.random.normal(key, mean.shape)
+        feat = jnp.concatenate([h, z], -1)
+        act = self.actor.apply(actor_params, feat)
+        return h, z, act
+
+    def _run_episode(self, noise: float) -> float:
+        cfg = self.cfg
+        obs, _ = self.env.reset(seed=int(self._rng.randint(2**31)))
+        obs = np.asarray(obs, np.float32).reshape(-1)
+        h = jnp.zeros((1, cfg["deter"]))
+        z = jnp.zeros((1, cfg["stoch"]))
+        a_prev = jnp.zeros((1, self.act_dim))
+        rows = {"obs": [], "actions": [], "rewards": []}
+        total = 0.0
+        for _ in range(cfg["max_episode_steps"]):
+            self._key, k = jax.random.split(self._key)
+            h, z, act = self._policy_step(self.wm_params,
+                                          self.actor_params, h, z,
+                                          a_prev, jnp.asarray(obs)[None],
+                                          k)
+            a = np.asarray(act)[0]
+            a = np.clip(a + noise * self._rng.randn(self.act_dim),
+                        -1.0, 1.0).astype(np.float32)
+            env_a = (a * self._scale + self._center).reshape(
+                self.env.action_space.shape)
+            obs2, r, term, trunc, _ = self.env.step(env_a)
+            rows["obs"].append(obs)
+            rows["actions"].append(a)
+            rows["rewards"].append(float(r))
+            total += float(r)
+            self._timesteps_total += 1
+            obs = np.asarray(obs2, np.float32).reshape(-1)
+            a_prev = jnp.asarray(a)[None]
+            if term or trunc:
+                break
+        self._episodes.append(
+            {k2: np.asarray(v, np.float32) for k2, v in rows.items()})
+        if len(self._episodes) > cfg["buffer_capacity_episodes"]:
+            self._episodes.pop(0)
+        return total
+
+    # ------------------------------------------------- world model
+    def _observe_seq(self, wm, obs_seq, act_seq, key):
+        """Roll the posterior through a (B, L, ...) sequence; returns
+        stacked feats + KL terms."""
+        B, L = obs_seq.shape[0], obs_seq.shape[1]
+        embed = nn.elu(self.encoder.apply(
+            wm["enc"], obs_seq.reshape(B * L, -1))).reshape(B, L, -1)
+
+        def step(carry, t):
+            h, z, k = carry
+            a_prev = jnp.where(t > 0, act_seq[:, t - 1], 0.0)
+            h = self.rssm.apply(wm["rssm"], h, z, a_prev,
+                                method=_RSSM.step)
+            pm, ps = self.rssm.apply(wm["rssm"], h, method=_RSSM.prior)
+            qm, qs = self.rssm.apply(wm["rssm"], h, embed[:, t],
+                                     method=_RSSM.posterior)
+            k, sub = jax.random.split(k)
+            z = qm + qs * jax.random.normal(sub, qm.shape)
+            kl = (jnp.log(ps / qs)
+                  + (qs ** 2 + (qm - pm) ** 2) / (2 * ps ** 2)
+                  - 0.5).sum(-1)
+            return (h, z, k), (jnp.concatenate([h, z], -1), kl)
+
+        h0 = jnp.zeros((B, self.cfg["deter"]))
+        z0 = jnp.zeros((B, self.cfg["stoch"]))
+        (_, _, _), (feats, kls) = jax.lax.scan(
+            step, (h0, z0, key), jnp.arange(L))
+        # scan stacked on axis 0 = time; -> (B, L, ...)
+        return feats.swapaxes(0, 1), kls.swapaxes(0, 1)
+
+    def _wm_train_impl(self, wm, opt_state, obs_seq, act_seq, rew_seq,
+                       mask_seq, key):
+        cfg = self.cfg
+
+        def loss_fn(p):
+            feats, kls = self._observe_seq(p, obs_seq, act_seq, key)
+            B, L = obs_seq.shape[0], obs_seq.shape[1]
+            flat = feats.reshape(B * L, -1)
+            recon = self.decoder.apply(p["dec"], flat).reshape(
+                B, L, -1)
+            rew = self.reward_head.apply(p["rew"], flat).reshape(B, L)
+            # Mask zero-padded tails of short episodes: the model must
+            # not fit fabricated post-termination transitions.
+            denom = jnp.maximum(mask_seq.sum(), 1.0)
+            recon_loss = (((recon - obs_seq) ** 2).sum(-1)
+                          * mask_seq).sum() / denom
+            rew_loss = (((rew - rew_seq) ** 2) * mask_seq).sum() / denom
+            kl_loss = jnp.maximum(
+                (kls * mask_seq).sum() / denom, cfg["free_nats"])
+            return (recon_loss + rew_loss
+                    + cfg["kl_scale"] * kl_loss), (recon_loss, rew_loss,
+                                                   kl_loss)
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn,
+                                                has_aux=True)(wm)
+        updates, opt_state = self.wm_tx.update(grads, opt_state, wm)
+        return optax.apply_updates(wm, updates), opt_state, loss, aux
+
+    # -------------------------------------------------- imagination
+    def _imagine(self, wm, actor_params, h, z, key):
+        cfg = self.cfg
+
+        def step(carry, _):
+            h, z, k = carry
+            feat = jnp.concatenate([h, z], -1)
+            a = self.actor.apply(actor_params, feat)
+            h = self.rssm.apply(wm["rssm"], h, z, a, method=_RSSM.step)
+            pm, ps = self.rssm.apply(wm["rssm"], h, method=_RSSM.prior)
+            k, sub = jax.random.split(k)
+            z = pm + ps * jax.random.normal(sub, pm.shape)
+            return (h, z, k), jnp.concatenate([h, z], -1)
+
+        (_, _, _), feats = jax.lax.scan(step, (h, z, key), None,
+                                        length=cfg["imagine_horizon"])
+        return feats  # (H, N, feat)
+
+    def _behavior_train_impl(self, wm, actor_params, critic_params,
+                             actor_opt, critic_opt, start_feats, key):
+        cfg = self.cfg
+        gamma, lam = cfg["gamma"], cfg["lambda"]
+        D = cfg["deter"]
+        h0 = start_feats[:, :D]
+        z0 = start_feats[:, D:]
+
+        def actor_loss_fn(ap):
+            feats = self._imagine(wm, ap, h0, z0, key)
+            rew = self.reward_head.apply(
+                wm["rew"], feats.reshape(-1, feats.shape[-1])
+            ).reshape(feats.shape[0], feats.shape[1])
+            val = self.critic.apply(
+                critic_params, feats.reshape(-1, feats.shape[-1])
+            ).reshape(feats.shape[0], feats.shape[1])
+            # lambda-returns, backward over the imagined horizon.
+            def lam_step(nxt, t):
+                ret = rew[t] + gamma * ((1 - lam) * val[t] + lam * nxt)
+                return ret, ret
+            last = val[-1]
+            _, rets = jax.lax.scan(
+                lam_step, last,
+                jnp.arange(feats.shape[0] - 1, -1, -1))
+            returns = rets[::-1]
+            return -returns.mean(), (feats, returns)
+
+        (a_loss, (feats, returns)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True)(actor_params)
+        a_updates, actor_opt = self.actor_tx.update(a_grads, actor_opt,
+                                                    actor_params)
+        actor_params = optax.apply_updates(actor_params, a_updates)
+
+        feats_sg = jax.lax.stop_gradient(feats)
+        returns_sg = jax.lax.stop_gradient(returns)
+
+        def critic_loss_fn(cp):
+            val = self.critic.apply(
+                cp, feats_sg.reshape(-1, feats_sg.shape[-1])
+            ).reshape(feats_sg.shape[0], feats_sg.shape[1])
+            return ((val - returns_sg) ** 2).mean()
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+            critic_params)
+        c_updates, critic_opt = self.critic_tx.update(
+            c_grads, critic_opt, critic_params)
+        critic_params = optax.apply_updates(critic_params, c_updates)
+        return (actor_params, critic_params, actor_opt, critic_opt,
+                a_loss, c_loss)
+
+    # ----------------------------------------------------- training
+    def _sample_seq_batch(self):
+        cfg = self.cfg
+        B, L = cfg["batch_size"], cfg["seq_len"]
+        obs = np.zeros((B, L, self.obs_dim), np.float32)
+        act = np.zeros((B, L, self.act_dim), np.float32)
+        rew = np.zeros((B, L), np.float32)
+        mask = np.zeros((B, L), np.float32)
+        for b in range(B):
+            ep = self._episodes[self._rng.randint(len(self._episodes))]
+            T = len(ep["rewards"])
+            if T <= L:
+                obs[b, :T] = ep["obs"][:T]
+                act[b, :T] = ep["actions"][:T]
+                rew[b, :T] = ep["rewards"][:T]
+                mask[b, :T] = 1.0
+            else:
+                s = self._rng.randint(0, T - L)
+                obs[b] = ep["obs"][s:s + L]
+                act[b] = ep["actions"][s:s + L]
+                rew[b] = ep["rewards"][s:s + L]
+                mask[b] = 1.0
+        return (jnp.asarray(obs), jnp.asarray(act), jnp.asarray(rew),
+                jnp.asarray(mask))
+
+    def step(self) -> Dict:
+        cfg = self.cfg
+        self._iter += 1
+        noise = max(0.05, cfg["expl_noise"] * (0.9 ** self._iter))
+        rets = [self._run_episode(noise)
+                for _ in range(cfg["episodes_per_iter"])]
+        self._episode_rewards += rets
+        wm_loss = a_loss = c_loss = np.nan
+        for _ in range(cfg["model_train_steps"]):
+            obs, act, rew, mask = self._sample_seq_batch()
+            self._key, k = jax.random.split(self._key)
+            self.wm_params, self.wm_opt, jl, aux = self._wm_train(
+                self.wm_params, self.wm_opt, obs, act, rew, mask, k)
+            wm_loss = float(jl)
+        for _ in range(cfg["behavior_train_steps"]):
+            obs, act, rew, mask = self._sample_seq_batch()
+            self._key, k1 = jax.random.split(self._key)
+            self._key, k2 = jax.random.split(self._key)
+            feats, _ = self._observe_jit(self.wm_params, obs, act, k1)
+            start = jax.lax.stop_gradient(
+                feats.reshape(-1, feats.shape[-1]))
+            (self.actor_params, self.critic_params, self.actor_opt,
+             self.critic_opt, ja, jc) = self._behavior_train(
+                self.wm_params, self.actor_params, self.critic_params,
+                self.actor_opt, self.critic_opt, start, k2)
+            a_loss, c_loss = float(ja), float(jc)
+        recent = self._episode_rewards[-20:]
+        return {"episode_reward_mean": float(np.mean(recent)),
+                "episode_reward_this_iter": float(np.mean(rets)),
+                "world_model_loss": wm_loss,
+                "actor_loss": a_loss, "critic_loss": c_loss,
+                "exploration_noise": noise,
+                "timesteps_total": self._timesteps_total}
+
+    def save_checkpoint(self) -> Dict:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa
+        return {"wm": to_np(self.wm_params),
+                "actor": to_np(self.actor_params),
+                "critic": to_np(self.critic_params),
+                "iter": self._iter}
+
+    def load_checkpoint(self, data) -> None:
+        if data:
+            to_j = lambda t: jax.tree_util.tree_map(jnp.asarray, t)  # noqa
+            self.wm_params = to_j(data["wm"])
+            self.actor_params = to_j(data["actor"])
+            self.critic_params = to_j(data["critic"])
+            self._iter = data.get("iter", 0)
+
+    def cleanup(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass

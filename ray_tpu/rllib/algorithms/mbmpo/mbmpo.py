@@ -1,0 +1,232 @@
+"""MB-MPO: model-based meta-policy optimization (Clavera et al. 2018).
+
+Reference: rllib/algorithms/mbmpo/mbmpo.py — learn an ENSEMBLE of
+dynamics models from real transitions, then treat each model as one
+"task" in a MAML meta-objective: the meta-policy is trained so one
+inner policy-gradient step inside any single model adapts it to that
+model, making the policy robust to model error while training almost
+entirely on imagined (model) rollouts.  Real-env interaction happens
+only to (re)fit the models.
+
+Re-designed jax-first on top of our MAML (algorithms/maml): the inner
+adaptation + outer surrogate reuse MAML's exact grad-through-grad; the
+ensemble members are bootstrap-trained MLP delta-dynamics models whose
+one jitted train step fits all K models in parallel via vmap.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.maml.maml import (MAML, MAMLConfig,
+                                                PointGoalEnv)
+
+
+class _DynamicsNet(nn.Module):
+    obs_dim: int
+    hiddens: tuple = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        h = jnp.concatenate([obs, act], axis=-1)
+        for width in self.hiddens:
+            h = nn.relu(nn.Dense(width)(h))
+        return nn.Dense(self.obs_dim)(h)  # predicts delta s
+
+
+class MBMPOConfig(MAMLConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MBMPO
+        self._config.update({
+            "env_config": {},           # ONE real env (fixed task)
+            "ensemble_size": 5,
+            "model_hiddens": (128, 128),
+            "model_lr": 1e-3,
+            "model_train_steps": 200,
+            "model_batch_size": 256,
+            "real_episodes_per_iter": 8,
+            "buffer_capacity": 20_000,
+            # reward_fn(next_obs: np.ndarray) -> float for IMAGINED
+            # states (the reference assumes a known/replayable reward);
+            # None = derive from the env's `goal` attribute (point-task
+            # family), and FAIL LOUDLY for envs without one.
+            "reward_fn": None,
+            # meta_batch_size is overridden: tasks == ensemble members.
+        })
+
+
+class MBMPO(MAML):
+    """Each train(): collect a little real data -> refit the ensemble ->
+    one MAML meta-step where task k's rollouts are IMAGINED inside
+    model k."""
+
+    def setup(self, config: Dict):
+        defaults = MBMPOConfig().to_dict()
+        defaults.update(config)
+        super().setup(defaults)
+        cfg = self.cfg
+        self.real_env = cfg["env"](dict(cfg.get("env_config") or {},
+                                        horizon=cfg["horizon"]))
+        obs0, _ = self.real_env.reset(seed=0)
+        self._reset_obs = np.asarray(obs0, np.float32)
+        self.model = _DynamicsNet(obs_dim=self.obs_dim,
+                                  hiddens=tuple(cfg["model_hiddens"]))
+        K = cfg["ensemble_size"]
+        keys = jax.random.split(jax.random.PRNGKey(cfg["seed"] + 99), K)
+        zo = jnp.zeros((1, self.obs_dim), jnp.float32)
+        za = jnp.zeros((1, self.act_dim), jnp.float32)
+        self.model_params = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[self.model.init(k, zo, za) for k in keys])
+        self.reward_fn = cfg.get("reward_fn")
+        if self.reward_fn is None:
+            goal = getattr(self.real_env, "goal", None)
+            if goal is None:
+                raise ValueError(
+                    "MBMPO imagines rollouts in learned models and "
+                    "needs the REWARD of imagined states: pass "
+                    "config reward_fn(next_obs)->float (the env has "
+                    "no .goal to derive the point-task default from)")
+            g = np.asarray(goal, np.float32)
+            self.reward_fn = lambda obs2: -float(
+                np.linalg.norm(obs2 - g))
+        self.model_tx = optax.adam(cfg["model_lr"])
+        self.model_opt = self.model_tx.init(self.model_params)
+        self._model_forward = jax.jit(self.model.apply)
+        self._model_train = jax.jit(self._model_train_impl)
+        self._buffer: List[Dict] = []
+
+    # -------------------------------------------------------- real data
+    def _collect_real(self) -> float:
+        cfg = self.cfg
+        total = 0.0
+        for _ in range(cfg["real_episodes_per_iter"]):
+            obs, _ = self.real_env.reset(
+                seed=int(self._rng.randint(2**31)))
+            for _ in range(cfg["horizon"]):
+                a = self._sample_action(self.params, obs)
+                obs2, r, term, trunc, _ = self.real_env.step(a)
+                self._buffer.append({
+                    "obs": np.asarray(obs, np.float32),
+                    "act": np.asarray(a, np.float32),
+                    "delta": np.asarray(obs2, np.float32)
+                    - np.asarray(obs, np.float32),
+                    "reward": float(r)})
+                total += r
+                obs = obs2
+                if term or trunc:
+                    break
+            if len(self._buffer) > cfg["buffer_capacity"]:
+                self._buffer = self._buffer[-cfg["buffer_capacity"]:]
+        return total / cfg["real_episodes_per_iter"]
+
+    # ----------------------------------------------------- model fitting
+    def _model_train_impl(self, params, opt_state, obs, act, delta):
+        # obs/act/delta: (K, B, dim) — bootstrap batch per member.
+        def loss_fn(p):
+            pred = jax.vmap(self.model.apply)(p, obs, act)
+            return ((pred - delta) ** 2).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.model_tx.update(grads, opt_state,
+                                                  params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def _fit_models(self) -> float:
+        cfg = self.cfg
+        K, B = cfg["ensemble_size"], cfg["model_batch_size"]
+        n = len(self._buffer)
+        # Stack the frozen buffer ONCE; each step fancy-indexes the
+        # contiguous arrays instead of re-walking the list of dicts.
+        all_obs = np.stack([t["obs"] for t in self._buffer])
+        all_act = np.stack([t["act"] for t in self._buffer])
+        all_delta = np.stack([t["delta"] for t in self._buffer])
+        loss = np.nan
+        for _ in range(cfg["model_train_steps"]):
+            idx = self._rng.randint(0, n, (K, min(B, n)))  # bootstrap
+            self.model_params, self.model_opt, jloss = \
+                self._model_train(self.model_params, self.model_opt,
+                                  jnp.asarray(all_obs[idx]),
+                                  jnp.asarray(all_act[idx]),
+                                  jnp.asarray(all_delta[idx]))
+            loss = float(jloss)
+        return loss
+
+    # -------------------------------------------------- imagined rollout
+    def _collect_imagined(self, params, member: int) -> Dict:
+        """MAML-style batch rolled out inside ensemble member k, using
+        the REAL env's reward function on imagined states (the
+        reference assumes a known/replayable reward)."""
+        cfg = self.cfg
+        mp = jax.tree_util.tree_map(lambda x: x[member],
+                                    self.model_params)
+        rows = {"obs": [], "actions": [], "rtg": []}
+        total = 0.0
+        for _ in range(cfg["episodes_per_task"]):
+            obs = self._reset_obs.copy()
+            ep_obs, ep_act, ep_rew = [], [], []
+            for _ in range(cfg["horizon"]):
+                a = self._sample_action(params, obs)
+                delta = np.asarray(self._model_forward(
+                    mp, jnp.asarray(obs)[None], jnp.asarray(a)[None]))[0]
+                obs2 = obs + delta
+                r = self.reward_fn(obs2)
+                ep_obs.append(obs)
+                ep_act.append(a)
+                ep_rew.append(r)
+                total += r
+                obs = obs2
+            g = 0.0
+            rtg = []
+            for r in reversed(ep_rew):
+                g = r + cfg["gamma"] * g
+                rtg.append(g)
+            rtg.reverse()
+            rows["obs"] += ep_obs
+            rows["actions"] += ep_act
+            rows["rtg"] += rtg
+        batch = {k: np.asarray(v, np.float32) for k, v in rows.items()}
+        adv = batch["rtg"] - batch["rtg"].mean()
+        batch["adv"] = adv / max(adv.std(), 1e-6)  # match MAML scaling
+        batch["mean_reward"] = total / cfg["episodes_per_task"]
+        return batch
+
+    # ---------------------------------------------------------- training
+    def step(self) -> Dict:
+        cfg = self.cfg
+        self._iter += 1
+        real_reward = self._collect_real()
+        model_loss = self._fit_models()
+        meta_grads = None
+        post = []
+        for k in range(cfg["ensemble_size"]):
+            inner = self._collect_imagined(self.params, k)
+            inner.pop("mean_reward")
+            adapted = self._adapt(
+                self.params,
+                {kk: jnp.asarray(v) for kk, v in inner.items()})
+            outer = self._collect_imagined(adapted, k)
+            post.append(outer.pop("mean_reward"))
+            _, g = self._meta_grad(
+                self.params,
+                {kk: jnp.asarray(v) for kk, v in inner.items()},
+                {kk: jnp.asarray(v) for kk, v in outer.items()})
+            meta_grads = g if meta_grads is None else \
+                jax.tree_util.tree_map(jnp.add, meta_grads, g)
+        meta_grads = jax.tree_util.tree_map(
+            lambda x: x / cfg["ensemble_size"], meta_grads)
+        updates, self.opt_state = self.tx.update(
+            meta_grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        return {"episode_reward_mean": real_reward,
+                "imagined_post_adaptation_reward": float(np.mean(post)),
+                "model_loss": model_loss,
+                "buffer_size": len(self._buffer),
+                "training_iteration_": self._iter}
