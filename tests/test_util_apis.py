@@ -46,3 +46,29 @@ def test_queue_across_processes(ray_init):
     with pytest.raises(Empty):
         q.get_nowait()
     q.shutdown()
+
+
+@pytest.mark.slow
+def test_joblib_backend_runs_on_cluster(ray_init):
+    """sklearn-style joblib workloads fan out as cluster tasks under
+    parallel_backend('ray') (reference: util/joblib/register_ray)."""
+    import os
+
+    joblib = pytest.importorskip("joblib")
+    Parallel = joblib.Parallel
+    delayed = joblib.delayed
+    parallel_backend = joblib.parallel_backend
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+
+    def work(i):
+        import math
+        return i, math.factorial(200) % 1000, os.getpid()
+
+    with parallel_backend("ray"):
+        out = Parallel(n_jobs=4)(delayed(work)(i) for i in range(16))
+    assert [o[0] for o in out] == list(range(16))
+    # The work really left this process.
+    assert any(o[2] != os.getpid() for o in out)
