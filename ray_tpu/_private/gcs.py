@@ -51,6 +51,7 @@ class NodeInfo:
         self.conn: protocol.Connection = conn
         self.alive = True
         self.draining = False  # planned shutdown announced (drain RPC)
+        self.drain_deadline = None  # monotonic expiry of the drain flag
         self.last_heartbeat = time.monotonic()
         self.load = 0  # queued lease count reported by the raylet
         self.pending_shapes: list = []
@@ -298,7 +299,7 @@ class GcsServer:
         # A raylet died, or a driver exited.
         for node in list(self.nodes.values()):
             if node.conn is conn and node.alive:
-                if node.draining:
+                if self._drain_active(node):
                     # Planned shutdown (drain RPC preceded the close):
                     # not a failure — don't page operators with a
                     # NODE_DEAD error for an orderly exit.
@@ -316,12 +317,26 @@ class GcsServer:
         """A raylet announces its own PLANNED shutdown — the subsequent
         connection close is then an orderly removal, not a death.
         (Distinct from rpc_drain_node below, the autoscaler-initiated
-        COMMAND telling a raylet to exit.)"""
+        COMMAND telling a raylet to exit.)  Only the node's OWN
+        connection may announce its drain (a misdirected announcement
+        would permanently downgrade a later genuine crash to an orderly
+        drain), and the flag expires: a node that announces draining
+        but then lingers past the grace window is again reported as an
+        unplanned death if it crashes."""
         node_id = body["node_id"]
         node = self.nodes.get(node_id)
-        if node is not None:
+        ok = node is not None and node.conn is conn
+        if ok:
             node.draining = True
-        return {"ok": node is not None}
+            node.drain_deadline = time.monotonic() + \
+                cfg.heartbeat_timeout_ms / 1000.0 * 2
+        return {"ok": ok}
+
+    @staticmethod
+    def _drain_active(node) -> bool:
+        return node.draining and (
+            node.drain_deadline is None
+            or time.monotonic() < node.drain_deadline)
 
     async def rpc_register_node(self, conn, body):
         node_id = body["node_id"]
@@ -411,7 +426,17 @@ class GcsServer:
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > timeout:
-                    await self._mark_node_dead(node, "heartbeat timeout")
+                    # A node that announced its drain and then stalled
+                    # during teardown is still an orderly exit, not a
+                    # failure to page on — unless the drain window
+                    # expired (then it's a genuine wedge/crash).
+                    if self._drain_active(node):
+                        await self._mark_node_dead(
+                            node, "drain timed out (heartbeat lost "
+                            "while draining)", planned=True)
+                    else:
+                        await self._mark_node_dead(node,
+                                                   "heartbeat timeout")
 
     def _record_event(self, severity: str, label: str, message: str,
                       source: str = "gcs"):

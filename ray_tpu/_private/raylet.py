@@ -61,11 +61,17 @@ class _ContainerProcHandle:
     container, which keeps running (and `--rm` never fires), leaking
     the worker and its lease."""
 
+    # Every in-flight remove-then-kill thread, including those whose
+    # worker was already popped from the raylet's table — shutdown must
+    # join ALL of them or the engine-managed containers leak.
+    _live_kill_threads: "set" = set()
+
     def __init__(self, proc: subprocess.Popen, runtime: str, name: str):
         self._proc = proc
         self._runtime = runtime
         self._name = name
         self.pid = proc.pid
+        self._kill_thread = None
 
     def poll(self):
         return self._proc.poll()
@@ -74,16 +80,59 @@ class _ContainerProcHandle:
         return self._proc.wait(timeout)
 
     def kill(self):
-        try:
-            subprocess.run([self._runtime, "rm", "-f", self._name],
-                           stdout=subprocess.DEVNULL,
-                           stderr=subprocess.DEVNULL, timeout=10)
-        except Exception:
-            pass
-        try:
-            self._proc.kill()
-        except Exception:
-            pass
+        # kill() is invoked from async raylet paths (worker reaping,
+        # shutdown); a blocking `rm -f` with a 10s timeout would stall
+        # lease scheduling and GCS heartbeats, and several serial kills
+        # during drain could exceed the heartbeat timeout and turn an
+        # orderly drain into a NODE_DEAD.  But the ORDER still matters:
+        # the container must be removed before the client is SIGKILLed
+        # (killing the client first detaches the engine-managed
+        # container — see class docstring).  So the wait/retry/kill
+        # sequence runs on a short-lived daemon thread.  Idempotent:
+        # kill() is reached twice on a deliberate kill (rpc_kill_worker
+        # then _on_worker_dead's poll()-is-alive check) — a second
+        # thread would just race the first's `rm -f` and log spurious
+        # failures.
+        import threading
+        if self._kill_thread is not None:
+            return
+
+        def _remove_then_kill():
+            try:
+                for attempt in (1, 2):
+                    try:
+                        rc = subprocess.run(
+                            [self._runtime, "rm", "-f", self._name],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            timeout=10).returncode
+                    except Exception:
+                        rc = -1
+                    if rc == 0:
+                        break
+                    logger.warning(
+                        "container rm -f %s failed (rc=%s, attempt %d)",
+                        self._name, rc, attempt)
+                try:
+                    self._proc.kill()
+                except Exception:
+                    pass
+            finally:
+                type(self)._live_kill_threads.discard(
+                    threading.current_thread())
+
+        self._kill_thread = threading.Thread(
+            target=_remove_then_kill, daemon=True,
+            name=f"container-kill-{self._name}")
+        type(self)._live_kill_threads.add(self._kill_thread)
+        self._kill_thread.start()
+
+    def join_kill(self, timeout: float):
+        """Block until the remove-then-kill sequence finishes (raylet
+        shutdown must not exit before `rm -f` runs — daemon threads die
+        with the interpreter and the containers would leak)."""
+        if self._kill_thread is not None:
+            self._kill_thread.join(timeout)
 
     terminate = kill
 
@@ -597,6 +646,25 @@ class Raylet:
             await self._on_worker_dead(
                 w, f"conda runtime_env creation failed: {e}")
 
+    def _local_env_key(self, env_key: str, env_spec: dict | None) -> str:
+        """Pool key for conda envs is resolved LOCALLY, not trusted from
+        the submitter: the same interpreter must map to one pool no
+        matter how the submitter spelled it (name vs prefix), and two
+        distinct envs sharing a basename must not share a pool.  Only
+        this raylet knows its filesystem, so the driver-computed key is
+        replaced by a hash of the realpath'd prefix (the same
+        resolution _spawn_conda_worker applies)."""
+        if not env_spec or not env_spec.get("conda"):
+            return env_key
+        spec = str(env_spec["conda"])
+        prefix = spec
+        if not os.path.isdir(prefix):
+            prefix = os.path.join(self._conda_root(), "envs", spec)
+        import hashlib
+        return hashlib.sha1(
+            ("conda-local:" + os.path.realpath(prefix)).encode()
+        ).hexdigest()[:16]
+
     @staticmethod
     def _conda_root() -> str:
         """The conda INSTALL root (holding envs/), not the active env:
@@ -756,10 +824,6 @@ class Raylet:
         pool = self._idle(w.kind, w.env_key)
         if w in pool:
             pool.remove(w)
-        if w.lease_id is not None:
-            lease = self.leases.pop(w.lease_id, None)
-            if lease is not None:
-                self._release_resources(lease)
         if w.actor_id is not None and self.gcs is not None:
             try:
                 await self.gcs.request("report_actor_death", {
@@ -771,6 +835,18 @@ class Raylet:
                 w.proc.kill()
             except Exception:
                 pass
+        # Container workers: engine removal runs on a background
+        # thread; hold the dead worker's lease resources until removal
+        # completes so a replacement isn't granted the same TPU /
+        # host-network ports while the old container still holds them.
+        join = getattr(w.proc, "join_kill", None)
+        if join is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, join, 25.0)
+        if w.lease_id is not None:
+            lease = self.leases.pop(w.lease_id, None)
+            if lease is not None:
+                self._release_resources(lease)
         self._kick_scheduler()
 
     async def rpc_kill_worker(self, conn, body):
@@ -901,7 +977,9 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append({"resources": resources, "pg_key": pg_key,
                                     "future": fut,
-                                    "env_key": body.get("env_key", ""),
+                                    "env_key": self._local_env_key(
+                                        body.get("env_key", ""),
+                                        body.get("env_spec")),
                                     "env_spec": body.get("env_spec"),
                                     "request_id": body.get("request_id")})
         self._kick_scheduler()
@@ -1152,9 +1230,11 @@ class Raylet:
         renv = (body.get("spec") or {}).get("runtime_env") or {}
         from ray_tpu.runtime_env import env_spec as _env_spec
         from ray_tpu.runtime_env import worker_env_key
-        w = await self._get_ready_worker(kind,
-                                         env_key=worker_env_key(renv),
-                                         env_spec=_env_spec(renv))
+        espec = _env_spec(renv)
+        w = await self._get_ready_worker(
+            kind,
+            env_key=self._local_env_key(worker_env_key(renv), espec),
+            env_spec=espec)
         if w is None:
             self._release(resources, pg_key)
             return {"ok": False, "reason": "no worker"}
@@ -1913,6 +1993,18 @@ class Raylet:
                     w.proc.kill()
                 except Exception:
                     pass
+        # Container workers: their kill() runs `rm -f` on a daemon
+        # thread — wait for removal before the process exits, or the
+        # engine-managed containers outlive the node.  One shared
+        # deadline >= the thread's 2x10s retry budget, covering threads
+        # whose worker was already popped from self.workers.
+        deadline = time.monotonic() + 22.0
+        for t in list(_ContainerProcHandle._live_kill_threads):
+            t.join(max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                logger.warning(
+                    "container removal %s still running at raylet "
+                    "exit; the container may leak", t.name)
         if self._zygote is not None:
             self._zygote.kill()
             self._zygote = None

@@ -75,6 +75,23 @@ class RuntimeEnv(dict):
                 "(each selects the worker's interpreter environment)")
 
 
+def _canonical_conda(spec) -> str:
+    """Canonicalize a conda spec PURELY SYNTACTICALLY so the pool key
+    is identical on every host: the env given by name ('myenv') and by
+    a standard-layout prefix ('<root>/envs/myenv') resolve to the same
+    interpreter in the raylet (_spawn_conda_worker) and must share one
+    warm-worker pool.  No filesystem or CONDA_* lookups here — the key
+    is computed on both the driver and the raylet, which may not share
+    a conda install; only the raylet resolves name -> interpreter."""
+    spec = str(spec)
+    if os.sep in spec:
+        path = os.path.normpath(spec)
+        if os.path.basename(os.path.dirname(path)) == "envs":
+            return os.path.basename(path)  # <root>/envs/<name> -> name
+        return path  # non-standard prefix: key on the path itself
+    return spec
+
+
 def worker_env_key(runtime_env: Optional[dict]) -> str:
     """Content address of the worker-interpreter environment ('' = the
     base interpreter).  Workers are pooled per key: a task only ever
@@ -87,7 +104,7 @@ def worker_env_key(runtime_env: Optional[dict]) -> str:
     if runtime_env.get("pip"):
         parts.append("pip:" + "\n".join(sorted(runtime_env["pip"])))
     if runtime_env.get("conda"):
-        parts.append("conda:" + str(runtime_env["conda"]))
+        parts.append("conda:" + _canonical_conda(runtime_env["conda"]))
     if runtime_env.get("container"):
         parts.append("container:" + json.dumps(runtime_env["container"],
                                                sort_keys=True))

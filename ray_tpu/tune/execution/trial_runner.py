@@ -325,11 +325,17 @@ class TrialRunner:
     def run(self, result_callback: Optional[Callable] = None) -> List[Trial]:
         """Drive all trials to completion; returns the trial list."""
         stuck_since = None
+        stuck_resumes = 0
         while True:
             self._apply_scheduler_actions()
             self._start_restored_trials()
             self._fill_trials()
             running = [t for t in self.trials if t.status == RUNNING]
+            if running:
+                # Real progress since the last wedge: a later,
+                # independent benign stall deserves the cheap
+                # resume-all again, not immediate termination.
+                stuck_resumes = 0
             if not running:
                 paused = [t for t in self.trials if t.status == PAUSED]
                 pending = [t for t in self.trials
@@ -354,8 +360,31 @@ class TrialRunner:
                     if stuck_since is None:
                         stuck_since = time.monotonic()
                     elif time.monotonic() - stuck_since > 5.0:
-                        for t in paused:
-                            t.status = PENDING
+                        # Bounded: resume-everything at most once.  If
+                        # the resumed trials just re-pause (scheduler
+                        # still can't advance), terminating them is the
+                        # only move that doesn't churn actors and
+                        # placement groups forever.
+                        if stuck_resumes == 0:
+                            stuck_resumes = 1
+                            print("[tune] WARNING: scheduler stuck with "
+                                  f"{len(paused)} paused trials and no "
+                                  "progress; resuming all paused trials "
+                                  "once (will terminate if it recurs)")
+                            for t in paused:
+                                t.status = PENDING
+                        else:
+                            print("[tune] WARNING: scheduler stuck "
+                                  "again after resume-all fallback; "
+                                  f"terminating {len(paused)} paused "
+                                  "trials")
+                            for t in paused:
+                                # Abnormal exit: ERROR (not TERMINATED)
+                                # so the partial last_result is neither
+                                # a searcher observation nor eligible
+                                # as the experiment's best result.
+                                self._stop_trial(t, ERROR)
+                                self._notify_trial_error(t)
                         stuck_since = None
                 # Staged trials are waiting for reservations to land;
                 # don't spin hot while nothing is training.

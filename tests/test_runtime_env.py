@@ -119,3 +119,21 @@ def test_pip_venv_isolation(ray_init, tmp_path):
             return False
 
     assert ray_tpu.get(base_has_pkg.remote(), timeout=60) is False
+
+
+def test_conda_key_canonical():
+    """A conda env named 'myenv' and the same env given by its absolute
+    prefix must hash to ONE worker-pool key (ADVICE r4: duplicate pools
+    for one environment defeat warm-worker reuse).  The key is purely
+    syntactic — no filesystem or CONDA_* lookups — so the driver and
+    every raylet compute the SAME key even when their conda installs
+    live at different roots."""
+    from ray_tpu.runtime_env import worker_env_key
+    by_name = worker_env_key({"conda": "myenv"})
+    by_prefix = worker_env_key({"conda": "/opt/conda/envs/myenv"})
+    by_other_root = worker_env_key({"conda": "/home/u/miniconda3/envs/myenv"})
+    assert by_name == by_prefix == by_other_root
+    assert by_name != worker_env_key({"conda": "otherenv"})
+    # Non-standard prefixes (not <root>/envs/<name>) key on the path.
+    assert worker_env_key({"conda": "/custom/envdir"}) \
+        != worker_env_key({"conda": "/other/envdir"})
