@@ -133,6 +133,13 @@ def main():
     except Exception as e:  # never let the runtime bench sink the metric
         detail["microbench"] = {"error": repr(e)}
 
+    # Serve data-plane numbers (VERDICT r4 missing #7: the one
+    # latency-critical data plane with no perf evidence).
+    try:
+        detail["serve"] = _run_serve_bench()
+    except Exception as e:
+        detail["serve"] = {"error": repr(e)}
+
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 2),
@@ -229,6 +236,35 @@ def _bench_long_seq(peak, ceiling_frac=None):
     return out
 
 
+def _bench_subprocess(module: str, args: list, timeout: int) -> dict:
+    """Run a bench module in a CLEAN subprocess and return its JSON.
+    The TPU session in THIS process keeps tunnel keepalive / dispatch
+    threads alive that steal cycles on a 1-cpu host and deflate
+    control-plane numbers by ~1.5x; a fresh CPU-only interpreter
+    removes that self-contention."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".json") as f:
+        env = dict(os.environ, RT_DISABLE_TPU_DETECTION="1",
+                   JAX_PLATFORMS="cpu")
+        subprocess.run(
+            [sys.executable, "-m", module, *args, "--json-out", f.name],
+            env=env, check=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        with open(f.name) as fh:
+            return json.load(fh)
+
+
+def _run_serve_bench():
+    """Handle-call + HTTP-proxy throughput with a direct-actor floor
+    (clean subprocess, same isolation rationale as _run_microbench)."""
+    return _bench_subprocess("ray_tpu._private.serve_perf", [],
+                             timeout=600)
+
+
 # Concurrency-bound metrics: every client/actor pair is a process needing
 # a core, so ops/s scales with core count and the honest host-independent
 # comparison is per-core (reference host: 64-core m4.16xlarge).
@@ -255,33 +291,47 @@ def _memcpy_gbps():
 
 
 def _run_microbench():
-    import io
+    """Each metric runs 3 independent passes (median + best recorded)
+    with per-pass loadavg and a memcpy contention probe, so a contended
+    host is VISIBLE in the artifact instead of silently deflating the
+    numbers (BENCH r4: every metric collapsed together on a host whose
+    own memcpy had dropped 3.4x, and the single-pass harness couldn't
+    show it)."""
     import os
-    import contextlib
-    os.environ.setdefault("RT_DISABLE_TPU_DETECTION", "1")
-    from ray_tpu._private import ray_perf
-    buf = io.StringIO()
-    with contextlib.redirect_stdout(buf):
-        results = ray_perf.main(quick=True)
+    results = _bench_subprocess("ray_tpu._private.ray_perf",
+                                ["--quick"], timeout=900)
     ncpu = os.cpu_count() or 1
     memcpy = _memcpy_gbps()
+    host = results.pop("_host", {})
     out = {}
-    for name, rate in results.items():
+    for name, rec in results.items():
+        med, best = rec["median"], rec["best"]
         ref = REFERENCE_FLOORS.get(name)
-        out[name] = {"ops_per_s": round(rate, 2)}
+        out[name] = {
+            "ops_per_s": med,          # median of 3 passes
+            "best": best,              # best observed pass
+            "rates": rec["rates"],
+            "load_1m": rec["load_1m"],
+            "memcpy_probe_gbps": rec["memcpy_probe_gbps"],
+        }
         if ref:
-            out[name]["vs_reference_m4_16xl"] = round(rate / ref, 3)
+            out[name]["vs_reference_m4_16xl"] = round(med / ref, 3)
+            out[name]["vs_reference_best"] = round(best / ref, 3)
             if name in _PER_CORE_METRICS:
                 out[name]["vs_reference_per_core"] = round(
-                    (rate / ncpu) / (ref / _REF_CORES), 3)
+                    (med / ncpu) / (ref / _REF_CORES), 3)
         if name == "put_gigabytes":
             # Fraction of this host's own memcpy ceiling the put path
             # achieves — the host-independent measure of copy overhead.
             out[name]["host_memcpy_gbps"] = round(memcpy, 2)
-            out[name]["fraction_of_host_memcpy"] = round(rate / memcpy, 3)
+            out[name]["fraction_of_host_memcpy"] = round(med / memcpy, 3)
+    out["_host"] = host
     out["_note"] = ("reference floors measured on 64-core m4.16xlarge; "
                     "this host: %d cpus, %.1f GB/s memcpy. per_core = "
-                    "(ours/cores) / (ref/64)" % (ncpu, memcpy))
+                    "(ours/cores) / (ref/64). ops_per_s = median of 3 "
+                    "passes; a memcpy_probe_gbps dip vs memcpy_pre_init"
+                    "_gbps = external host contention during that "
+                    "metric" % (ncpu, memcpy))
     return out
 
 

@@ -1,0 +1,132 @@
+"""Serve data-plane microbenchmarks.
+
+Reference: python/ray/serve/benchmarks/microbenchmark.py — the
+reference measures handle-call throughput (sync + batch) and HTTP
+proxy requests/s on a noop deployment; its release suites
+(release/release_tests.yaml serve entries) track the same two planes.
+This harness mirrors that shape and adds the DIRECT actor-call rate of
+the same runtime so the artifact separates "Serve layer overhead" from
+"runtime floor": handle calls ride the router + replica scheduler on
+top of plain actor calls, HTTP adds the aiohttp proxy hop.
+
+Run: `python -m ray_tpu._private.serve_perf [--json-out PATH]`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import ray_perf
+from ray_tpu._private.ray_perf import timeit as _timeit
+
+BATCH = 50
+ray_perf.MIN_SECONDS = 0.5
+
+
+def main() -> dict:
+    results: dict = {}
+    results["_host"] = {"cpus": os.cpu_count() or 1,
+                        "load_pre_init": [round(x, 2)
+                                          for x in os.getloadavg()]}
+    ray_tpu.init(ignore_reinit_error=True)
+
+    # Runtime floor: a plain actor call through the same core runtime.
+    @ray_tpu.remote
+    class Direct:
+        def noop(self, _=None):
+            return b"ok"
+
+    d = Direct.remote()
+    ray_tpu.get(d.noop.remote(), timeout=60)
+    _timeit("direct_actor_calls_per_s",
+            lambda: ray_tpu.get(d.noop.remote(), timeout=60),
+            1, results=results)
+    _timeit("direct_actor_batch_per_s",
+            lambda: ray_tpu.get([d.noop.remote() for _ in range(BATCH)],
+                                timeout=120), BATCH, results=results)
+
+    # Serve handle plane: router + replica scheduler on top.
+    @serve.deployment(name="noop")
+    def noop(req):
+        return b"ok"
+
+    serve.start(_start_proxy=True,
+                http_options={"host": "127.0.0.1", "port": 0,
+                              "access_log": False})
+    handle = noop.deploy()
+    handle.remote(None).result(timeout=60)
+    _timeit("serve_handle_calls_per_s",
+            lambda: handle.remote(None).result(timeout=60),
+            1, results=results)
+
+    def _burst():
+        resps = [handle.remote(None) for _ in range(BATCH)]
+        for r in resps:
+            r.result(timeout=120)
+
+    _timeit("serve_handle_batch_per_s", _burst, BATCH, results=results)
+
+    # HTTP plane: aiohttp proxy -> router -> replica.
+    import requests
+
+    addr = serve.get_proxy_address()
+    base = f"http://{addr['host']}:{addr['port']}/noop"
+    sess = requests.Session()
+    assert sess.get(base, timeout=30).status_code == 200
+    _timeit("serve_http_rps",
+            lambda: sess.get(base, timeout=30), 1, results=results)
+
+    # Concurrent HTTP: a few client threads keep the proxy loop busy
+    # (the reference's microbenchmark drives HTTP with many clients).
+    import concurrent.futures as cf
+
+    pool = cf.ThreadPoolExecutor(4)
+    sessions = [requests.Session() for _ in range(4)]
+    for s in sessions:
+        s.get(base, timeout=30)
+
+    def _client(s):
+        for _ in range(BATCH // 4):
+            assert s.get(base, timeout=60).status_code == 200
+
+    def _http_burst():
+        # One session PER thread — a requests.Session isn't
+        # thread-safe, and sharing one would serialize on its
+        # connection pool instead of exercising proxy concurrency.
+        futs = [pool.submit(_client, s) for s in sessions]
+        for f in futs:
+            f.result()
+
+    _timeit("serve_http_concurrent_rps", _http_burst, BATCH,
+            results=results)
+    pool.shutdown()
+
+    # Overhead decomposition (medians).
+    floor = results["direct_actor_calls_per_s"]["median"]
+    hnd = results["serve_handle_calls_per_s"]["median"]
+    http = results["serve_http_rps"]["median"]
+    results["_overhead_ms"] = {
+        "direct_actor_call": round(1e3 / floor, 3),
+        "handle_call": round(1e3 / hnd, 3),
+        "http_call": round(1e3 / http, 3),
+        "serve_layer_added": round(1e3 / hnd - 1e3 / floor, 3),
+        "proxy_hop_added": round(1e3 / http - 1e3 / hnd, 3),
+    }
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    results["_host"]["load_post_suite"] = [round(x, 2)
+                                           for x in os.getloadavg()]
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+    res = main()
+    if "--json-out" in sys.argv:
+        with open(sys.argv[sys.argv.index("--json-out") + 1], "w") as f:
+            json.dump(res, f)

@@ -84,7 +84,8 @@ class HTTPProxyActor:
     bridge; serves until killed.  One per node in a full deployment
     (reference starts one per node via node-affinity scheduling)."""
 
-    def __init__(self, host: str, port: int, controller_name: str):
+    def __init__(self, host: str, port: int, controller_name: str,
+                 access_log: bool = True):
         import ray_tpu
         self.host = host
         self.port = port
@@ -93,6 +94,11 @@ class HTTPProxyActor:
         self._runner = None
         self._site = None
         self._ready = asyncio.Event()
+        # Per-request INFO lines ride the worker-log pubsub mirror to
+        # the driver — useful in dev, measurable per-request cost on
+        # small hosts; benchmarks turn it off (the reference's serve
+        # microbenchmark also runs without access logging).
+        self._access_log = access_log
 
     async def run(self):
         """Start the aiohttp server on the actor's event loop; returns
@@ -112,7 +118,8 @@ class HTTPProxyActor:
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", _handler)
-        self._runner = web.AppRunner(app)
+        kwargs = {} if self._access_log else {"access_log": None}
+        self._runner = web.AppRunner(app, **kwargs)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
         await self._site.start()

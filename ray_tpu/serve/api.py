@@ -65,7 +65,8 @@ def _start_one_proxy(name: str, http_options: Dict, strategy=None) -> Dict:
             opts["scheduling_strategy"] = strategy
         proxy = cls.options(**opts).remote(
             http_options.get("host", "127.0.0.1"),
-            http_options.get("port", 0), CONTROLLER_NAME)
+            http_options.get("port", 0), CONTROLLER_NAME,
+            http_options.get("access_log", True))
         proxy.run.options(num_returns=0).remote()
     return ray_tpu.get(proxy.ready.remote(), timeout=60)
 
