@@ -35,6 +35,35 @@ def ensure_cpu(n_devices: int | None = None) -> None:
     _FORCED["value"] = ("cpu", n_devices)
 
 
+def cpu_pinned() -> bool:
+    """True when this process's jax is (or will be) on the host CPU
+    platform — robust to list values ('cpu,tpu') and casing."""
+    plats = [p.strip().lower()
+             for p in os.environ.get("JAX_PLATFORMS", "").split(",")]
+    return "cpu" in plats or _FORCED["value"] is not None and \
+        _FORCED["value"][0] == "cpu"
+
+
+def enable_cpu_collectives() -> None:
+    """Select the gloo cross-process collective transport for CPU gangs
+    (jax.distributed federation needs it; on TPU the ICI fabric makes
+    it a no-op).  Must run before this process creates its backend
+    client; a late call raises inside jax, which we surface as a
+    warning because the symptom otherwise appears much later as a
+    hanging collective."""
+    if not cpu_pinned():
+        return
+    try:
+        import jax
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:
+        import logging
+        logging.getLogger(__name__).warning(
+            "could not select gloo CPU collectives (%r); if this gang "
+            "spans processes, cross-process collectives will fail — "
+            "was jax already initialized in this worker?", e)
+
+
 def ensure_accelerator() -> bool:
     """Allow this process to use the real accelerator backend.  Returns True
     if a non-CPU device is visible."""

@@ -28,6 +28,9 @@ def _free_port() -> int:
 def _init_jax_distributed(coordinator: str, num_processes: int,
                           process_id: int):
     import jax
+
+    from ray_tpu._private.jax_utils import enable_cpu_collectives
+    enable_cpu_collectives()
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
