@@ -101,11 +101,9 @@ class AlgorithmConfig:
 
 
 def _default_env_creator(config: Dict):
-    import gymnasium as gym
-    env = config["env"]
-    if isinstance(env, str):
-        return gym.make(env, **config.get("env_config", {}))
-    return env(config.get("env_config", {}))
+    from ray_tpu.rllib.env.registry import resolve_env_creator
+    return resolve_env_creator(config["env"])(
+        config.get("env_config", {}))
 
 
 class Algorithm(Trainable):

@@ -101,7 +101,9 @@ class MADDPG(Trainable):
         defaults = MADDPGConfig().to_dict()
         defaults.update(config)
         self.cfg = defaults
-        self.env = self.cfg["env"](self.cfg["env_config"])
+        from ray_tpu.rllib.env.registry import resolve_env_creator
+        self.env = resolve_env_creator(self.cfg["env"])(
+            self.cfg["env_config"])
         self.agents = list(self.env.possible_agents)
         self.n = len(self.agents)
         space0 = self.env.action_space(self.agents[0])

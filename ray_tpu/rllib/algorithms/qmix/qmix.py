@@ -107,8 +107,9 @@ class QMix(Trainable):
         defaults = QMixConfig().to_dict()
         defaults.update(config)
         self.cfg = defaults
-        env_cls = self.cfg["env"]
-        self.env = env_cls(self.cfg["env_config"])
+        from ray_tpu.rllib.env.registry import resolve_env_creator
+        self.env = resolve_env_creator(self.cfg["env"])(
+            self.cfg["env_config"])
         self.agents = list(self.env.possible_agents)
         self.n_agents = len(self.agents)
         obs_space = self.env.observation_space(self.agents[0])

@@ -1,0 +1,38 @@
+"""Named env registry (reference: ray/tune/registry.py register_env —
+tuned_examples name custom envs by string; the worker-side creator
+resolves the name without shipping the class through the config)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+_ENVS: Dict[str, Callable] = {}
+
+
+def register_env(name: str, creator: Callable) -> None:
+    """creator(env_config) -> env instance."""
+    _ENVS[name] = creator
+
+
+def resolve_env_creator(env) -> Callable:
+    """Uniform env spec resolution: a string resolves through the
+    registry (else gym.make); a class/callable is the creator itself.
+    Returns creator(env_config) -> env instance."""
+    if isinstance(env, str):
+        creator = get_registered_env(env)
+        if creator is not None:
+            return creator
+        import gymnasium as gym
+        return lambda cfg: gym.make(env, **(cfg or {}))
+    return env
+
+
+def get_registered_env(name: str) -> Optional[Callable]:
+    if name not in _ENVS and "." not in name:
+        # Lazy-load the in-tree example envs so tuned_examples resolve
+        # without an explicit import at the call site.
+        try:
+            import ray_tpu.rllib.examples.env  # noqa: F401
+        except ImportError:
+            pass
+    return _ENVS.get(name)

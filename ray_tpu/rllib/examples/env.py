@@ -1,0 +1,174 @@
+"""In-tree example envs used by tuned_examples and tests (reference:
+rllib/examples/env/two_step_game.py, rllib/env/bandit_envs_discrete.py
+SimpleContextualBandit, and the small diagnostic envs the reference's
+tuned examples lean on).  Importing this module registers each env
+under its class name so tuned-example JSON can say "env":
+"TwoStepCoopGame" etc."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.rllib.env.registry import register_env
+
+
+class TwoStepCoopGame(MultiAgentEnv):
+    """The QMIX paper's two-step cooperative matrix game: agent_0's
+    first action picks the payoff matrix; in state 2A every joint
+    action pays 7, in state 2B the joint payoffs are [[0,1],[1,8]].
+    Optimal play (pick B, then both choose action 1) pays 8; greedy
+    independent learners settle for 7."""
+
+    possible_agents = ("agent_0", "agent_1")
+    _B = np.array([[0.0, 1.0], [1.0, 8.0]])
+
+    def __init__(self, config=None):
+        self.stage = 0  # 0 -> choosing, 1 -> matrix A, 2 -> matrix B
+
+    def observation_space(self, agent_id):
+        import gymnasium as gym
+        return gym.spaces.Box(0.0, 1.0, (3,), np.float32)
+
+    def action_space(self, agent_id):
+        import gymnasium as gym
+        return gym.spaces.Discrete(2)
+
+    def _obs(self):
+        o = np.zeros(3, np.float32)
+        o[self.stage] = 1.0
+        return {a: o.copy() for a in self.possible_agents}
+
+    def state(self):
+        s = np.zeros(3, np.float32)
+        s[self.stage] = 1.0
+        return s
+
+    def reset(self, *, seed=None):
+        self.stage = 0
+        return self._obs(), {a: {} for a in self.possible_agents}
+
+    def step(self, action_dict):
+        if self.stage == 0:
+            self.stage = 1 if action_dict["agent_0"] == 0 else 2
+            rews = {a: 0.0 for a in self.possible_agents}
+            dones = {"__all__": False}
+            return self._obs(), rews, dones, {"__all__": False}, {}
+        if self.stage == 1:
+            r = 7.0
+        else:
+            r = float(self._B[action_dict["agent_0"],
+                              action_dict["agent_1"]])
+        rews = {a: r / 2.0 for a in self.possible_agents}
+        return ({}, rews, {"__all__": True}, {"__all__": False}, {})
+
+
+class CoopTargetSumEnv(MultiAgentEnv):
+    """Two agents each emit a scalar in [-1, 1]; the shared reward is
+    -(a_0 + a_1 - target)^2 with the target visible to both.  Solving
+    it requires coordinating the SPLIT of the target — the centralized
+    critic's job."""
+
+    possible_agents = ("agent_0", "agent_1")
+
+    def __init__(self, config=None):
+        self._rng = np.random.RandomState(0)
+        self.horizon = 5
+
+    def observation_space(self, agent_id):
+        import gymnasium as gym
+        return gym.spaces.Box(-1.5, 1.5, (1,), np.float32)
+
+    def action_space(self, agent_id):
+        import gymnasium as gym
+        return gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+
+    def _obs(self):
+        o = np.asarray([self.target], np.float32)
+        return {a: o.copy() for a in self.possible_agents}
+
+    def state(self):
+        return np.asarray([self.target], np.float32)
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self.target = float(self._rng.uniform(-1.2, 1.2))
+        self.t = 0
+        return self._obs(), {a: {} for a in self.possible_agents}
+
+    def step(self, action_dict):
+        s = float(np.sum([np.asarray(a).reshape(-1)[0]
+                          for a in action_dict.values()]))
+        r = -(s - self.target) ** 2
+        self.t += 1
+        done = self.t >= self.horizon
+        self.target = float(self._rng.uniform(-1.2, 1.2))
+        rews = {a: r / 2.0 for a in self.possible_agents}
+        return (self._obs() if not done else {}, rews,
+                {"__all__": done}, {"__all__": False}, {})
+
+
+class SimpleContextualBandit:
+    """2-context, 3-arm bandit (reference:
+    rllib/env/bandit_envs_discrete.py SimpleContextualBandit): best arm
+    depends on the context; regret-free play earns 10 per pull."""
+
+    def __init__(self, config=None):
+        import gymnasium as gym
+        self.observation_space = gym.spaces.Box(-1.0, 1.0, (2,),
+                                                np.float32)
+        self.action_space = gym.spaces.Discrete(3)
+        self._rng = np.random.RandomState((config or {}).get("seed", 0))
+        self.ctx = None
+
+    def reset(self, **kwargs):
+        self.ctx = (np.array([-1.0, 1.0], np.float32)
+                    if self._rng.rand() < 0.5
+                    else np.array([1.0, -1.0], np.float32))
+        return self.ctx, {}
+
+    def step(self, action):
+        rewards_per_arm = ({0: 10.0, 1: 0.0, 2: 5.0}
+                           if self.ctx[0] < 0
+                           else {0: 0.0, 1: 10.0, 2: 5.0})
+        r = rewards_per_arm[int(action)]
+        return self.ctx, r, True, False, {}
+
+
+class ReachEnv:
+    """1-D deterministic reach task: drive x to the origin.  Dense
+    quadratic reward makes it solvable in a few hundred updates — a
+    fast, non-flaky 'does the DPG machinery learn at all' probe."""
+
+    def __init__(self, config=None):
+        import gymnasium as gym
+        config = config or {}
+        self.observation_space = gym.spaces.Box(-2.0, 2.0, (1,),
+                                                np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self._rng = np.random.RandomState(config.get("seed", 0))
+        self.horizon = config.get("horizon", 40)
+
+    def reset(self, **kwargs):
+        self.x = self._rng.uniform(-1.0, 1.0)
+        self.t = 0
+        return np.array([self.x], np.float32), {}
+
+    def step(self, action):
+        self.x = float(np.clip(self.x + 0.2 * float(action[0]),
+                               -2.0, 2.0))
+        self.t += 1
+        reward = -self.x ** 2
+        truncated = self.t >= self.horizon
+        return (np.array([self.x], np.float32), reward, False,
+                truncated, {})
+
+
+# One call convention everywhere: every example env takes the
+# env_config dict positionally (like MultiAgentEnv), so the registered
+# creator and the direct-class path (resolve_env_creator returns the
+# class, called with env_config) construct identically.
+for _cls in (TwoStepCoopGame, CoopTargetSumEnv, SimpleContextualBandit,
+             ReachEnv):
+    register_env(_cls.__name__, (lambda cls: lambda cfg: cls(cfg))(_cls))

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu.rllib.examples.env import SimpleContextualBandit
 from ray_tpu.rllib import (A3CConfig, BanditLinTSConfig,
                            BanditLinUCBConfig, CQLConfig, SimpleQConfig)
 
@@ -113,33 +114,6 @@ def test_cql_conservative_offline(ray_init):
     assert q_data.mean() > q_rand.mean(), (
         f"CQL not conservative: Q(data)={q_data.mean():.2f} <= "
         f"Q(rand)={q_rand.mean():.2f}")
-
-
-class SimpleContextualBandit:
-    """2-context, 3-arm bandit (reference:
-    rllib/env/bandit_envs_discrete.py SimpleContextualBandit): best arm
-    depends on the context; regret-free play earns 10 per pull."""
-
-    def __init__(self, seed=0):
-        import gymnasium as gym
-        self.observation_space = gym.spaces.Box(-1.0, 1.0, (2,),
-                                                np.float32)
-        self.action_space = gym.spaces.Discrete(3)
-        self._rng = np.random.RandomState(seed)
-        self.ctx = None
-
-    def reset(self, **kwargs):
-        self.ctx = (np.array([-1.0, 1.0], np.float32)
-                    if self._rng.rand() < 0.5
-                    else np.array([1.0, -1.0], np.float32))
-        return self.ctx, {}
-
-    def step(self, action):
-        rewards_per_arm = ({0: 10.0, 1: 0.0, 2: 5.0}
-                           if self.ctx[0] < 0
-                           else {0: 0.0, 1: 10.0, 2: 5.0})
-        r = rewards_per_arm[int(action)]
-        return self.ctx, r, True, False, {}
 
 
 @pytest.mark.parametrize("config_cls", [BanditLinUCBConfig,

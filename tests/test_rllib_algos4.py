@@ -6,7 +6,9 @@ import pytest
 
 from ray_tpu.rllib import (AlphaZeroConfig, MADDPGConfig, QMixConfig,
                            R2D2Config)
-from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv  # noqa: F401
+from ray_tpu.rllib.examples.env import (CoopTargetSumEnv,
+                                        TwoStepCoopGame)
 
 
 class _Discrete:
@@ -20,56 +22,6 @@ class _Box:
         self.low = np.full(shape, low, np.float32)
         self.high = np.full(shape, high, np.float32)
         self.shape = shape
-
-
-class TwoStepCoopGame(MultiAgentEnv):
-    """The QMIX paper's two-step cooperative matrix game: agent_0's
-    first action picks the payoff matrix; in state 2A every joint
-    action pays 7, in state 2B the joint payoffs are [[0,1],[1,8]].
-    Optimal play (pick B, then both choose action 1) pays 8; greedy
-    independent learners settle for 7."""
-
-    possible_agents = ("agent_0", "agent_1")
-    _B = np.array([[0.0, 1.0], [1.0, 8.0]])
-
-    def __init__(self, config=None):
-        self.stage = 0  # 0 -> choosing, 1 -> matrix A, 2 -> matrix B
-
-    def observation_space(self, agent_id):
-        import gymnasium as gym
-        return gym.spaces.Box(0.0, 1.0, (3,), np.float32)
-
-    def action_space(self, agent_id):
-        import gymnasium as gym
-        return gym.spaces.Discrete(2)
-
-    def _obs(self):
-        o = np.zeros(3, np.float32)
-        o[self.stage] = 1.0
-        return {a: o.copy() for a in self.possible_agents}
-
-    def state(self):
-        s = np.zeros(3, np.float32)
-        s[self.stage] = 1.0
-        return s
-
-    def reset(self, *, seed=None):
-        self.stage = 0
-        return self._obs(), {a: {} for a in self.possible_agents}
-
-    def step(self, action_dict):
-        if self.stage == 0:
-            self.stage = 1 if action_dict["agent_0"] == 0 else 2
-            rews = {a: 0.0 for a in self.possible_agents}
-            dones = {"__all__": False}
-            return self._obs(), rews, dones, {"__all__": False}, {}
-        if self.stage == 1:
-            r = 7.0
-        else:
-            r = float(self._B[action_dict["agent_0"],
-                              action_dict["agent_1"]])
-        rews = {a: r / 2.0 for a in self.possible_agents}
-        return ({}, rews, {"__all__": True}, {"__all__": False}, {})
 
 
 @pytest.mark.slow
@@ -99,52 +51,6 @@ def test_qmix_solves_two_step_game():
     assert total >= 7.9, (
         f"QMIX should find the optimal coordinated payoff 8 "
         f"(greedy return={total}; uncoordinated optimum is 7)")
-
-
-class CoopTargetSumEnv(MultiAgentEnv):
-    """Two agents each emit a scalar in [-1, 1]; the shared reward is
-    -(a_0 + a_1 - target)^2 with the target visible to both.  Solving
-    it requires coordinating the SPLIT of the target — the centralized
-    critic's job."""
-
-    possible_agents = ("agent_0", "agent_1")
-
-    def __init__(self, config=None):
-        self._rng = np.random.RandomState(0)
-        self.horizon = 5
-
-    def observation_space(self, agent_id):
-        import gymnasium as gym
-        return gym.spaces.Box(-1.5, 1.5, (1,), np.float32)
-
-    def action_space(self, agent_id):
-        import gymnasium as gym
-        return gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
-
-    def _obs(self):
-        o = np.asarray([self.target], np.float32)
-        return {a: o.copy() for a in self.possible_agents}
-
-    def state(self):
-        return np.asarray([self.target], np.float32)
-
-    def reset(self, *, seed=None):
-        if seed is not None:
-            self._rng = np.random.RandomState(seed)
-        self.target = float(self._rng.uniform(-1.2, 1.2))
-        self.t = 0
-        return self._obs(), {a: {} for a in self.possible_agents}
-
-    def step(self, action_dict):
-        s = float(np.sum([np.asarray(a).reshape(-1)[0]
-                          for a in action_dict.values()]))
-        r = -(s - self.target) ** 2
-        self.t += 1
-        done = self.t >= self.horizon
-        self.target = float(self._rng.uniform(-1.2, 1.2))
-        rews = {a: r / 2.0 for a in self.possible_agents}
-        return (self._obs() if not done else {}, rews,
-                {"__all__": done}, {"__all__": False}, {})
 
 
 @pytest.mark.slow

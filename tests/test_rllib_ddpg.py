@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu.rllib.examples.env import ReachEnv
 from ray_tpu.rllib import DDPGConfig, TD3Config
 
 
@@ -13,34 +14,6 @@ def ray_init():
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     yield
     ray_tpu.shutdown()
-
-
-class ReachEnv:
-    """1-D deterministic reach task: drive x to the origin.  Dense
-    quadratic reward makes it solvable in a few hundred updates — a
-    fast, non-flaky 'does the DPG machinery learn at all' probe."""
-
-    def __init__(self, horizon=40, seed=0):
-        import gymnasium as gym
-        self.observation_space = gym.spaces.Box(-2.0, 2.0, (1,),
-                                                np.float32)
-        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
-        self._rng = np.random.RandomState(seed)
-        self.horizon = horizon
-
-    def reset(self, **kwargs):
-        self.x = self._rng.uniform(-1.0, 1.0)
-        self.t = 0
-        return np.array([self.x], np.float32), {}
-
-    def step(self, action):
-        self.x = float(np.clip(self.x + 0.2 * float(action[0]),
-                               -2.0, 2.0))
-        self.t += 1
-        reward = -self.x ** 2
-        truncated = self.t >= self.horizon
-        return (np.array([self.x], np.float32), reward, False,
-                truncated, {})
 
 
 def test_ddpg_pendulum_mechanics(ray_init):
