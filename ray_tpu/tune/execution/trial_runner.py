@@ -20,6 +20,7 @@ import ray_tpu
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search.basic_variant import Searcher
 from ray_tpu.tune.execution.placement_groups import (
     PlacementGroupFactory, resource_dict_to_pg_factory)
 from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP
@@ -171,12 +172,73 @@ class TrialRunner:
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, path)
+        self._publish_to_dashboard()
         if self.storage is not None:
             # Sync up: trial metadata + driver-held checkpoints ride in
             # the state blob, so this one upload makes the experiment
             # resumable from the storage backend alone.
             self.storage.upload_file(
                 path, f"{self._storage_prefix}/experiment_state.pkl")
+
+    @staticmethod
+    def _jsonable(obj):
+        import json
+        try:
+            json.dumps(obj)
+            return obj
+        except (TypeError, ValueError):
+            if isinstance(obj, dict):
+                return {str(k): TrialRunner._jsonable(v)
+                        for k, v in obj.items()}
+            if isinstance(obj, (list, tuple)):
+                return [TrialRunner._jsonable(v) for v in obj]
+            return repr(obj)
+
+    def _publish_to_dashboard(self):
+        """Best-effort experiment summary to the GCS KV ("tune"
+        namespace) so the dashboard's Tune view works cross-host
+        without filesystem access (reference: the reference dashboard's
+        tune module reads experiment state through the head)."""
+        import json
+        import math
+        try:
+            now = time.time()
+            # Throttle: this publish is a blocking GCS round-trip on the
+            # result-processing path; cap it at ~1/2s (the final publish
+            # at experiment end goes through because status changes
+            # force _save_experiment_state anyway).
+            if now - getattr(self, "_last_publish", 0.0) < 2.0 \
+                    and not all(t.status in ("TERMINATED", "ERROR")
+                                for t in self.trials):
+                return
+            self._last_publish = now
+            import ray_tpu
+            w = ray_tpu._private.worker.global_worker
+            if w is None:
+                return
+            trials = []
+            for t in self.trials:
+                # Non-finite floats would serialize as bare NaN/Infinity
+                # tokens (Python's extended JSON), which the browser's
+                # JSON.parse rejects — drop them.
+                last = {k: v for k, v in (t.last_result or {}).items()
+                        if isinstance(v, (int, float, str, bool))
+                        and (not isinstance(v, float) or math.isfinite(v))}
+                trials.append({"trial_id": t.trial_id, "name": t.name,
+                               "status": t.status,
+                               "config": self._jsonable(t.config),
+                               "last_result": last})
+            rec = {"name": self._storage_prefix,
+                   "dir": self.experiment_dir,
+                   "updated_at": time.time(),
+                   "trials": trials}
+            w._run(w._gcs_request(
+                "kv_put", {"ns": "tune",
+                           "key": self._storage_prefix.encode(),
+                           "value": json.dumps(rec).encode(),
+                           "overwrite": True}))
+        except Exception:
+            pass  # observability must never sink the experiment
 
     def restore_experiment_state(self) -> bool:
         """Reload saved trials: TERMINATED ones keep their results;
@@ -216,7 +278,9 @@ class TrialRunner:
         return True
 
     # ---------------------------------------------------------------- setup
-    def _make_trial(self) -> Optional[Trial]:
+    def _make_trial(self) -> "Trial | str | None":
+        # Tri-state: a Trial, None (space exhausted), or
+        # Searcher.DEFER (capacity-limited searcher; retry later).
         # The id handed to the searcher IS the trial's id, so BO-style
         # searchers can pair on_trial_complete results with their
         # suggestions (reference: search/searcher.py contract).
@@ -224,6 +288,13 @@ class TrialRunner:
         cfg = self.search_alg.suggest(tid)
         if cfg is None:
             return None
+        if cfg == Searcher.DEFER:
+            # Concurrency-limited searcher: capacity exists but the
+            # searcher wants results before suggesting more.  NOT
+            # exhaustion — retry next loop pass.
+            self._deferred = True
+            return Searcher.DEFER
+        self._deferred = False
         pgf = self.pg_factory or resource_dict_to_pg_factory(
             cfg.pop("__resources__", None) if isinstance(cfg, dict) else None)
         trial = Trial(self.trainable_name, cfg, pgf, self.experiment_dir,
@@ -298,6 +369,10 @@ class TrialRunner:
 
     # ---------------------------------------------------------------- loop
     _exhausted = False
+    # True while the searcher answers DEFER (capacity exists but it
+    # wants results first) — for stall decisions this is equivalent to
+    # exhaustion: no new trial can arrive until something completes.
+    _deferred = False
 
     def is_finished(self) -> bool:
         active = any(t.status in (PENDING, RUNNING, PAUSED)
@@ -343,7 +418,13 @@ class TrialRunner:
                 if self._exhausted and not self._staged() \
                         and not paused and not pending:
                     break
-                if paused and not pending and self._exhausted \
+                # A deferring searcher can't unblock an all-paused
+                # cluster either (paused trials never complete, so its
+                # in-flight slots never free): treat it like exhaustion
+                # for the stall escape or ConcurrencyLimiter +
+                # synchronous HyperBand deadlock.
+                stalled = self._exhausted or self._deferred
+                if paused and not pending and stalled \
                         and not self._staged():
                     # Every live trial is paused and nothing new can
                     # ever arrive: a synchronous bracket is waiting on
@@ -444,6 +525,8 @@ class TrialRunner:
             trial = self._make_trial()
             if trial is None:
                 self._exhausted = True
+                break
+            if trial == Searcher.DEFER:
                 break
             trial.pg = trial.pg_factory.create(
                 name=f"pg_{trial.trial_id}")

@@ -8,3 +8,10 @@ from ray_tpu.tune.search.basic_variant import (  # noqa: F401
 )
 from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401
 from ray_tpu.tune.search.gp import GPSearch  # noqa: F401
+from ray_tpu.tune.search.adapter import (  # noqa: F401
+    ConcurrencyLimiter, ExternalSearcher, OptunaSearch, Repeater,
+    SkoptLikeGP,
+)
+from ray_tpu.tune.search.bohb import (  # noqa: F401
+    BOHBSearcher, HyperBandForBOHB,
+)

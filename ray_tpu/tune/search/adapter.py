@@ -1,0 +1,306 @@
+"""The pluggable external-optimizer seam + searcher wrappers.
+
+Reference: python/ray/tune/search/searcher.py (the Searcher contract
+third-party algorithms implement), search/concurrency_limiter.py
+(ConcurrencyLimiter), search/repeater.py (Repeater), and the 13
+search/<lib>/ integrations (optuna, hyperopt, skopt, ...) — which all
+reduce to the same ask/tell adaptation this module factors out once:
+
+    optimizer.ask()          -> a config dict to evaluate
+    optimizer.tell(cfg, val) -> observe a MINIMIZED objective value
+
+Anything speaking that protocol drops into Tune via
+``ExternalSearcher(optimizer, metric=..., mode=...)``.  ``OptunaSearch``
+shows the adaptation for a real external library (gated on optuna
+being installed); ``SkoptLikeGP`` is an in-tree ask/tell optimizer
+built on scikit-learn's GP regressor proving the seam end to end with
+a library that ships in this image.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search.basic_variant import Searcher
+from ray_tpu.tune.search.sample import Domain
+
+
+class ExternalSearcher(Searcher):
+    """Adapt any ask/tell optimizer to the Tune Searcher seam.
+
+    The optimizer always MINIMIZES: with mode="max" the reported metric
+    is negated before tell().  Errored trials release their suggestion
+    slot without a tell (the reference's wrappers likewise skip failed
+    trials rather than feeding them fabricated objective values)."""
+
+    def __init__(self, optimizer: Any, metric: str, mode: str = "min",
+                 num_samples: int = 64):
+        assert mode in ("min", "max")
+        if not (callable(getattr(optimizer, "ask", None))
+                and callable(getattr(optimizer, "tell", None))):
+            raise TypeError(
+                f"{optimizer!r} does not speak the ask/tell protocol "
+                "(needs .ask() -> dict and .tell(config, value))")
+        self._opt = optimizer
+        self.metric, self.mode = metric, mode
+        self._budget = num_samples
+        self._suggested: Dict[str, Dict] = {}
+
+    @property
+    def total_trials(self) -> int:
+        return self._budget
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._budget <= 0:
+            return None
+        self._budget -= 1
+        cfg = self._opt.ask()
+        self._suggested[trial_id] = cfg
+        return copy.deepcopy(cfg)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        value = float(value)
+        self._opt.tell(cfg, -value if self.mode == "max" else value)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions of a wrapped searcher (reference:
+    search/concurrency_limiter.py) — BO-style searchers degrade to
+    random sampling when asked for many configs before any result
+    lands; the cap keeps the model in the loop.
+
+    At the cap, suggest() returns ``Searcher.DEFER``: the runner keeps
+    the experiment alive and retries after results arrive (None would
+    mark the search space exhausted)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int = 4):
+        assert max_concurrent >= 1
+        self._searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    @property
+    def total_trials(self):
+        return getattr(self._searcher, "total_trials", None)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self._live) >= self.max_concurrent:
+            return Searcher.DEFER
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is not None and cfg is not Searcher.DEFER:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        self._searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self._searcher.on_trial_complete(trial_id, result, error=error)
+
+
+class Repeater(Searcher):
+    """Evaluate each suggested config ``repeat`` times and report the
+    MEAN metric to the wrapped searcher (reference: search/repeater.py
+    — noisy objectives need averaged observations or the model chases
+    noise)."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        assert repeat >= 1
+        self._searcher = searcher
+        self.repeat = repeat
+        self._group_of: Dict[str, Dict] = {}
+        self._open_group: Optional[Dict] = None
+
+    @property
+    def total_trials(self):
+        inner = getattr(self._searcher, "total_trials", None)
+        return None if inner is None else inner * self.repeat
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        g = self._open_group
+        if g is None or len(g["members"]) >= self.repeat:
+            lead = f"{trial_id}-lead"
+            cfg = self._searcher.suggest(lead)
+            if cfg is None or cfg is Searcher.DEFER:
+                return cfg
+            g = {"lead": lead, "cfg": cfg, "members": [],
+                 "scores": [], "errors": 0}
+            self._open_group = g
+        g["members"].append(trial_id)
+        self._group_of[trial_id] = g
+        return copy.deepcopy(g["cfg"])
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        g = self._group_of.pop(trial_id, None)
+        if g is None:
+            return
+        metric = getattr(self._searcher, "metric", None)
+        if error or not result or (metric is not None
+                                   and result.get(metric) is None):
+            g["errors"] += 1
+        else:
+            g["scores"].append(result)
+        done = len(g["scores"]) + g["errors"]
+        if done < self.repeat:
+            return
+        if not g["scores"]:
+            self._searcher.on_trial_complete(g["lead"], error=True)
+            return
+        # Mean over the numeric metric; last result carries the rest.
+        merged = dict(g["scores"][-1])
+        if metric is not None:
+            vals = [float(r[metric]) for r in g["scores"]]
+            merged[metric] = sum(vals) / len(vals)
+        self._searcher.on_trial_complete(g["lead"], merged)
+
+
+class OptunaSearch(ExternalSearcher):
+    """The optuna integration (reference: search/optuna/optuna_search.py)
+    expressed through the ask/tell seam.  Gated: raises ImportError
+    with guidance when optuna isn't installed."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "min",
+                 num_samples: int = 64, seed: Optional[int] = None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch needs the external 'optuna' package; "
+                "install it or use the native TPESearcher/GPSearch "
+                "(same algorithm family, no dependency)") from e
+
+        sampler = optuna.samplers.TPESampler(seed=seed)
+        study = optuna.create_study(direction="minimize", sampler=sampler)
+        flat = _flatten_space(param_space)
+
+        class _Opt:
+            def __init__(self):
+                self._trials: Dict[int, Any] = {}
+
+            def ask(self):
+                t = study.ask()
+                cfg: Dict = {}
+                for path, domain in flat:
+                    _assign(cfg, path,
+                            _optuna_suggest(t, ".".join(path), domain))
+                cfg["__optuna_trial__"] = t._trial_id
+                self._trials[t._trial_id] = t
+                return cfg
+
+            def tell(self, cfg, value):
+                t = self._trials.pop(cfg.pop("__optuna_trial__"), None)
+                if t is not None:
+                    study.tell(t, value)
+
+        super().__init__(_Opt(), metric, mode, num_samples)
+
+
+def _optuna_suggest(trial, name: str, domain: Domain):
+    """Map a tune sample Domain onto optuna's suggest_* API."""
+    from ray_tpu.tune.search.sample import (Categorical, Float, Integer,
+                                            Quantized)
+    if isinstance(domain, Categorical):
+        return trial.suggest_categorical(name, list(domain.categories))
+    if isinstance(domain, Float):
+        return trial.suggest_float(name, domain.lower, domain.upper,
+                                   log=getattr(domain, "log", False))
+    if isinstance(domain, Integer):
+        return trial.suggest_int(name, domain.lower, domain.upper - 1)
+    if isinstance(domain, Quantized):
+        base = domain.inner
+        if isinstance(base, Integer):
+            return trial.suggest_int(name, base.lower, base.upper - 1,
+                                     step=int(domain.q))
+        return trial.suggest_float(name, base.lower, base.upper,
+                                   step=float(domain.q))
+    raise ValueError(f"unsupported domain for optuna: {domain!r}")
+
+
+def _flatten_space(space: Dict, prefix=()) -> List[Tuple[tuple, Domain]]:
+    out = []
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, Domain):
+            out.append((path, v))
+        elif isinstance(v, dict):
+            out.extend(_flatten_space(v, path))
+    return out
+
+
+def _assign(cfg: Dict, path: tuple, value):
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+class SkoptLikeGP:
+    """An ask/tell Bayesian optimizer on scikit-learn's
+    GaussianProcessRegressor with expected improvement — a REAL external
+    library (sklearn) integrated through the seam, proving a thirdparty
+    optimizer needs zero Tune-internal knowledge.  Continuous Float
+    dimensions only (categorical/int handling is what the native
+    GPSearch provides)."""
+
+    def __init__(self, bounds: Dict[str, Tuple[float, float]],
+                 n_startup: int = 6, n_candidates: int = 256,
+                 seed: Optional[int] = None):
+        self.bounds = dict(bounds)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._x: List[List[float]] = []
+        self._y: List[float] = []
+
+    def _sample(self) -> Dict:
+        return {k: self._rng.uniform(lo, hi)
+                for k, (lo, hi) in self.bounds.items()}
+
+    def ask(self) -> Dict:
+        if len(self._y) < self.n_startup:
+            return self._sample()
+        import numpy as np
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        x = np.array(self._x)
+        y = np.array(self._y)
+        gp = GaussianProcessRegressor(kernel=Matern(nu=2.5),
+                                      normalize_y=True,
+                                      random_state=0)
+        gp.fit(x, y)
+        cand = np.array([[self._rng.uniform(lo, hi)
+                          for lo, hi in self.bounds.values()]
+                         for _ in range(self.n_candidates)])
+        mu, sigma = gp.predict(cand, return_std=True)
+        best = y.min()
+        sigma = np.maximum(sigma, 1e-9)
+        z = (best - mu) / sigma
+        # Expected improvement for minimization.
+        from math import erf, pi, sqrt
+        phi = np.exp(-0.5 * z ** 2) / sqrt(2 * pi)
+        big_phi = 0.5 * (1 + np.vectorize(erf)(z / sqrt(2)))
+        ei = (best - mu) * big_phi + sigma * phi
+        pick = cand[int(ei.argmax())]
+        return {k: float(v) for k, v in zip(self.bounds, pick)}
+
+    def tell(self, config: Dict, value: float) -> None:
+        if not (isinstance(value, float) and math.isfinite(value)):
+            return
+        self._x.append([float(config[k]) for k in self.bounds])
+        self._y.append(float(value))

@@ -17,7 +17,15 @@ class Searcher:
     """Pluggable suggestion algorithm (reference: search/searcher.py).
 
     Subclass and implement suggest/on_trial_complete for BO-style
-    algorithms; BasicVariantGenerator covers grid/random natively."""
+    algorithms; BasicVariantGenerator covers grid/random natively.
+
+    suggest() contract: a config dict starts a trial; ``None`` means
+    the space is exhausted (the experiment winds down); ``DEFER`` means
+    "nothing right now, ask again after results land" (used by
+    ConcurrencyLimiter — the reference expresses the same tri-state
+    with its None vs Searcher.FINISHED sentinel)."""
+
+    DEFER = "__defer__"
 
     def suggest(self, trial_id: str) -> Optional[Dict]:
         raise NotImplementedError

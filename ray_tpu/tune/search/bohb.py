@@ -1,0 +1,185 @@
+"""BOHB: Bayesian-Optimization HyperBand (Falkner et al. 2018).
+
+The one scheduler/searcher PAIR in the reference:
+python/ray/tune/schedulers/hb_bohb.py:14 (HyperBandForBOHB) +
+python/ray/tune/search/bohb/bohb_search.py:50 (TuneBOHB).  HyperBand
+allocates budgets through synchronous successive halving; the searcher
+replaces HyperBand's random config draws with samples from a
+per-budget density model, so later brackets start from configs that
+already look good at the budgets seen so far.
+
+Model (the paper's recipe, on the native TPE estimators from tpe.py):
+keep (config, score) observations keyed by the BUDGET they were
+measured at (training_iteration at the recording milestone); to
+suggest, take the LARGEST budget with >= n_min observations, split
+good/bad by the top-``gamma`` fraction, sample candidates from the
+good density and rank by good/bad density ratio.  A ``random_fraction``
+of suggestions stays uniform for theoretical worst-case parity with
+plain HyperBand.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.schedulers import HyperBandScheduler
+from ray_tpu.tune.search.basic_variant import Searcher, _set_path
+from ray_tpu.tune.search.tpe import (_FloatTPE, _flatten_domains,
+                                     _get_path, _make_estimator)
+
+
+class BOHBSearcher(Searcher):
+    """Model-based config proposals conditioned on observation budget."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "min",
+                 num_samples: int = 64, n_min: Optional[int] = None,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 random_fraction: float = 0.2,
+                 seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self._space = param_space
+        self._domains = _flatten_domains(param_space)
+        self._estimators = {path: _make_estimator(d)
+                            for path, d in self._domains}
+        self.metric, self.mode = metric, mode
+        self._budget_left = num_samples
+        # Paper default: d+1 observations before the model activates.
+        self.n_min = n_min if n_min is not None else len(self._domains) + 1
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.random_fraction = random_fraction
+        self._rng = random.Random(seed)
+        self._suggested: Dict[str, Dict] = {}
+        # budget (training_iteration at record time) -> [(cfg, score)]
+        self._obs: Dict[int, List[Tuple[Dict, float]]] = {}
+        self.model_suggestions = 0  # observability: how often the model fired
+
+    @property
+    def total_trials(self) -> int:
+        return self._budget_left
+
+    # ------------------------------------------------------ observations
+    def observe(self, config: Dict, budget: int, score: float) -> None:
+        """Record a (config, score) pair measured AT ``budget``.  Called
+        by HyperBandForBOHB at every rung record; on_trial_complete
+        also lands here so the searcher works standalone."""
+        self._obs.setdefault(int(budget), []).append(
+            (config, float(score)))
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        cfg = self._suggested.get(trial_id)
+        v = result.get(self.metric)
+        if cfg is not None and v is not None:
+            self.observe(cfg, result.get("training_iteration", 1),
+                         float(v))
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or error or not result:
+            return
+        v = result.get(self.metric)
+        if v is not None:
+            self.observe(cfg, result.get("training_iteration", 1),
+                         float(v))
+
+    # ----------------------------------------------------------- suggest
+    def _model_budget(self) -> Optional[int]:
+        for b in sorted(self._obs, reverse=True):
+            if len(self._obs[b]) >= self.n_min:
+                return b
+        return None
+
+    def _random_config(self) -> Dict:
+        cfg: Dict = {}
+        for path, domain in self._domains:
+            _set_path(cfg, path, domain.sample(self._rng))
+        self._fill_constants(cfg, self._space, ())
+        return cfg
+
+    def _fill_constants(self, cfg, space, prefix):
+        from ray_tpu.tune.search.sample import Domain
+        for k, v in space.items():
+            path = prefix + (k,)
+            if isinstance(v, Domain):
+                continue
+            if isinstance(v, dict):
+                self._fill_constants(cfg, v, path)
+            else:
+                _set_path(cfg, path, v)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._budget_left <= 0:
+            return None
+        self._budget_left -= 1
+        budget = self._model_budget()
+        if budget is None or self._rng.random() < self.random_fraction:
+            cfg = self._random_config()
+            self._suggested[trial_id] = cfg
+            return cfg
+
+        self.model_suggestions += 1
+        hist = self._obs[budget]
+        scores = np.array([s for _, s in hist])
+        if self.mode == "max":
+            scores = -scores
+        n_good = max(1, int(math.ceil(self.gamma * len(scores))))
+        order = np.argsort(scores)
+        good = [hist[i][0] for i in order[:n_good]]
+        bad = [hist[i][0] for i in order[n_good:]] or good
+
+        cfg = {}
+        for path, domain in self._domains:
+            est = self._estimators[path]
+            if isinstance(est, _FloatTPE):
+                g = np.array([est._to_internal(_get_path(c, path))
+                              for c in good])
+                b = np.array([est._to_internal(_get_path(c, path))
+                              for c in bad])
+                cands = [est.sample_from(g, self._rng)
+                         for _ in range(self.n_candidates)]
+                ratios = [est.logpdf(x, g) - est.logpdf(x, b)
+                          for x in cands]
+                _set_path(cfg, path,
+                          est._to_value(cands[int(np.argmax(ratios))]))
+            else:
+                g = [_get_path(c, path) for c in good]
+                b = [_get_path(c, path) for c in bad]
+                cands = [est.sample_from(g, self._rng)
+                         for _ in range(self.n_candidates)]
+                ratios = [est.logpdf(x, g) - est.logpdf(x, b)
+                          for x in cands]
+                _set_path(cfg, path, cands[int(np.argmax(ratios))])
+        self._fill_constants(cfg, self._space, ())
+        self._suggested[trial_id] = cfg
+        return cfg
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """Synchronous HyperBand that feeds rung-record observations to the
+    attached BOHBSearcher AT THE BUDGET they were measured (reference:
+    schedulers/hb_bohb.py — the pair's coupling point).  Without the
+    link the searcher only hears end-of-trial results; with it every
+    PAUSE/record advances the model at the rung's budget."""
+
+    def __init__(self, searcher: Optional[BOHBSearcher] = None, **kw):
+        super().__init__(**kw)
+        self._bohb = searcher
+
+    def attach_searcher(self, searcher: BOHBSearcher) -> None:
+        self._bohb = searcher
+
+    def on_trial_result(self, trial, result) -> str:
+        decision = super().on_trial_result(trial, result)
+        if self._bohb is not None:
+            v = result.get(self.metric)
+            if v is not None:
+                self._bohb.observe(
+                    dict(trial.config),
+                    result.get("training_iteration", 1), float(v))
+        return decision

@@ -1,0 +1,214 @@
+"""Searcher plugin seam, wrapper searchers, and the BOHB pair
+(reference: tune/search/searcher.py, search/concurrency_limiter.py,
+search/repeater.py, schedulers/hb_bohb.py + search/bohb/bohb_search.py).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import Tuner, TuneConfig
+from ray_tpu.tune.search import (BOHBSearcher, ConcurrencyLimiter,
+                                 ExternalSearcher, HyperBandForBOHB,
+                                 Repeater, SkoptLikeGP, Searcher,
+                                 TPESearcher, uniform)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class _CountingOpt:
+    """Minimal ask/tell optimizer: proposes a fixed sweep, records every
+    observation — enough to verify the adapter's contract."""
+
+    def __init__(self, values):
+        self.values = list(values)
+        self.i = 0
+        self.told = []
+
+    def ask(self):
+        cfg = {"x": self.values[self.i % len(self.values)]}
+        self.i += 1
+        return cfg
+
+    def tell(self, config, value):
+        self.told.append((config["x"], value))
+
+
+def test_external_searcher_protocol_unit():
+    """ask() drives suggestions; tell() hears MINIMIZED objectives
+    (mode=max negates); errors release the slot without a tell."""
+    opt = _CountingOpt([0.1, 0.2, 0.3])
+    s = ExternalSearcher(opt, metric="score", mode="max", num_samples=3)
+    c1 = s.suggest("t1")
+    c2 = s.suggest("t2")
+    c3 = s.suggest("t3")
+    assert [c["x"] for c in (c1, c2, c3)] == [0.1, 0.2, 0.3]
+    assert s.suggest("t4") is None  # budget exhausted
+    s.on_trial_complete("t1", {"score": 5.0})
+    s.on_trial_complete("t2", error=True)
+    s.on_trial_complete("t3", {"score": 7.0})
+    assert opt.told == [(0.1, -5.0), (0.3, -7.0)]
+
+
+def test_external_searcher_rejects_non_ask_tell():
+    with pytest.raises(TypeError):
+        ExternalSearcher(object(), metric="score")
+
+
+def test_concurrency_limiter_defers_unit():
+    opt = _CountingOpt([0.5])
+    s = ConcurrencyLimiter(
+        ExternalSearcher(opt, metric="score", num_samples=10),
+        max_concurrent=2)
+    assert s.suggest("a") is not None
+    assert s.suggest("b") is not None
+    # At the cap: DEFER (retry later), NOT None (exhausted).
+    assert s.suggest("c") == Searcher.DEFER
+    s.on_trial_complete("a", {"score": 1.0})
+    assert s.suggest("d") is not None
+
+
+def test_repeater_averages_unit():
+    opt = _CountingOpt([0.1, 0.9])
+    inner = ExternalSearcher(opt, metric="score", num_samples=4)
+    s = Repeater(inner, repeat=3)
+    cfgs = [s.suggest(f"t{i}") for i in range(3)]
+    # One underlying suggestion evaluated three times.
+    assert [c["x"] for c in cfgs] == [0.1, 0.1, 0.1]
+    for i, v in enumerate((1.0, 2.0, 6.0)):
+        s.on_trial_complete(f"t{i}", {"score": v})
+    assert opt.told == [(0.1, 3.0)]  # the MEAN, told once
+    # Next group gets the optimizer's next proposal.
+    assert s.suggest("t3")["x"] == 0.9
+
+
+@pytest.mark.slow
+def test_sklearn_gp_through_seam(ray_init):
+    """A real external library (scikit-learn) integrated purely through
+    the ask/tell seam + ConcurrencyLimiter finds a 1-D optimum."""
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.62) ** 2, "done": True})
+
+    opt = SkoptLikeGP({"x": (0.0, 1.0)}, n_startup=5, seed=3)
+    search = ConcurrencyLimiter(
+        ExternalSearcher(opt, metric="loss", mode="min", num_samples=16),
+        max_concurrent=2)
+    results = Tuner(
+        objective,
+        param_space={"x": uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               search_alg=search),
+    ).fit()
+    assert len(results) == 16
+    assert results.get_best_result().metrics["loss"] < 0.02
+    # Every completed trial was told back to the external optimizer.
+    assert len(opt._y) == 16
+
+
+@pytest.mark.slow
+def test_bohb_pair_budget_allocation(ray_init):
+    """The scheduler/searcher PAIR: HyperBandForBOHB allocates budget by
+    successive halving while feeding rung records to BOHBSearcher,
+    whose model then concentrates proposals near the good region."""
+    def objective(config):
+        # Quality depends on x; separable from budget so rung scores
+        # rank configs consistently at every budget.
+        for i in range(9):
+            tune.report(
+                {"score": (1.0 - abs(config["x"] - 0.7)) * (i + 1)})
+
+    space = {"x": uniform(0.0, 1.0)}
+    searcher = BOHBSearcher(space, metric="score", mode="max",
+                            num_samples=18, n_min=4, random_fraction=0.1,
+                            seed=7)
+    sched = HyperBandForBOHB(searcher=searcher, metric="score",
+                             mode="max", max_t=9, grace_period=1,
+                             reduction_factor=3)
+    results = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(metric="score", mode="max",
+                               search_alg=searcher, scheduler=sched),
+        run_config=RunConfig(stop={"training_iteration": 9}),
+    ).fit()
+    assert len(results) == 18
+    # Budget allocation engaged: someone was halted early, a winner ran
+    # to max_t.
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert max(iters) == 9
+    assert min(iters) < 9
+    # The model fired (observations crossed n_min) and steered: the
+    # best found x is close to the optimum.
+    assert searcher.model_suggestions > 0
+    best = results.get_best_result()
+    assert abs(best.config["x"] - 0.7) < 0.15
+    # Rung-budget observations arrived via the scheduler coupling (not
+    # just end-of-trial): multiple distinct budgets recorded.
+    assert len(searcher._obs) >= 2
+
+
+def test_optuna_search_gated():
+    """Without optuna installed the wrapper raises a clear ImportError
+    pointing at the native equivalents."""
+    try:
+        import optuna  # noqa: F401
+        pytest.skip("optuna installed; gate test n/a")
+    except ImportError:
+        pass
+    from ray_tpu.tune.search import OptunaSearch
+    with pytest.raises(ImportError, match="TPESearcher"):
+        OptunaSearch({"x": uniform(0, 1)}, metric="score")
+
+
+def test_tpe_unaffected_by_seam(ray_init):
+    """Native searchers still drive the runner after the DEFER-sentinel
+    addition (regression guard for the runner change)."""
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.5) ** 2, "done": True})
+
+    space = {"x": uniform(0.0, 1.0)}
+    results = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(
+            metric="loss", mode="min",
+            search_alg=TPESearcher(space, metric="loss", mode="min",
+                                   num_samples=6, n_startup=3, seed=1)),
+    ).fit()
+    assert len(results) == 6
+
+
+@pytest.mark.slow
+def test_limiter_with_hyperband_no_deadlock(ray_init):
+    """Regression: ConcurrencyLimiter's DEFER + synchronous HyperBand.
+    The bracket wants more members than the limiter admits; paused
+    trials never complete, so the limiter defers forever — the runner
+    must treat that like exhaustion and force-advance the under-full
+    bracket instead of hanging."""
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    def objective(config):
+        for i in range(9):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    space = {"x": uniform(0.0, 1.0)}
+    search = ConcurrencyLimiter(
+        TPESearcher(space, metric="score", mode="max", num_samples=6,
+                    n_startup=3, seed=4),
+        max_concurrent=2)
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               grace_period=3, reduction_factor=3)
+    results = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(metric="score", mode="max",
+                               search_alg=search, scheduler=sched),
+        run_config=RunConfig(stop={"training_iteration": 9}),
+    ).fit()
+    assert len(results) == 6
