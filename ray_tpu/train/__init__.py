@@ -14,3 +14,5 @@ from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
     DataParallelTrainer,
 )
 from ray_tpu.train.jax import JaxConfig, JaxTrainer  # noqa: F401
+from ray_tpu.train.gbdt import (  # noqa: F401
+    GBDTBoosterModel, GBDTTrainer, XGBoostTrainer)
