@@ -115,10 +115,34 @@ def provider_from_config(config: Dict, gcs_addr=None,
         return LocalProcessNodeProvider(node_types, gcs_addr=gcs_addr,
                                         session_dir=session_dir)
     if ptype == "tpu_pod":
-        from ray_tpu.autoscaler.tpu_pod_provider import TPUPodProvider
+        from ray_tpu.autoscaler.tpu_pod_provider import (
+            MockQueuedResourceAPI, TPUPodProvider)
+        pconf = config["provider"]
+        api_kind = pconf.get("api", "gke")
+        if api_kind == "mock":
+            api = MockQueuedResourceAPI()
+        else:
+            # The real Cloud TPU v2 REST client; only the transport
+            # would differ in a recorded-response test.
+            import os
+
+            from ray_tpu.autoscaler.gke_tpu_api import (
+                GkeQueuedResourceAPI, requests_transport)
+            # Read per call — GCP access tokens expire (~1h); a
+            # rotation (or a first export after startup) just updates
+            # the env var, so the supplier is unconditional and the
+            # header is simply omitted while the var is empty.
+            api = GkeQueuedResourceAPI(
+                pconf.get("project", ""), pconf.get("zone", ""),
+                requests_transport(),
+                token_supplier=lambda: os.environ.get("RT_GCP_TOKEN", ""),
+                runtime_version=pconf.get("runtime_version",
+                                          "tpu-ubuntu2204-base"),
+                spot=bool(pconf.get("spot", False)))
         return TPUPodProvider(node_types,
-                              config["provider"].get("project", ""),
-                              config["provider"].get("zone", ""),
+                              pconf.get("project", ""),
+                              pconf.get("zone", ""),
+                              api=api,
                               gcs_addr=gcs_addr)
     raise ClusterConfigError(
         f"provider {ptype!r} must be created by the test harness")
