@@ -77,6 +77,11 @@ class DashboardHead:
         @routes.get("/api/objects")
         async def objects(request):
             from ray_tpu.experimental import state
+            return _json(await _call(state.list_objects))
+
+        @routes.get("/api/objects/summary")
+        async def objects_summary(request):
+            from ray_tpu.experimental import state
             return _json(await _call(state.summarize_objects))
 
         @routes.get("/api/placement_groups")
@@ -209,6 +214,28 @@ class DashboardHead:
                 return _json(await _call(serve_mod.status))
             except Exception as e:
                 return _json({"error": repr(e)})
+
+        @routes.get("/api/tune")
+        async def tune_experiments(request):
+            """Experiments published by TrialRunner to the "tune" KV
+            namespace (reference: the dashboard tune module reading
+            experiment state through the head)."""
+            import json as _json_mod
+            w = ray_tpu._private.worker.global_worker
+            keys = (await w._gcs_request(
+                "kv_keys", {"ns": "tune", "prefix": b""}))["keys"]
+            out = []
+            for key in keys:
+                blob = (await w._gcs_request(
+                    "kv_get", {"ns": "tune", "key": key}))["value"]
+                if blob is None:
+                    continue
+                try:
+                    out.append(_json_mod.loads(blob))
+                except Exception:
+                    continue
+            out.sort(key=lambda e: -e.get("updated_at", 0))
+            return _json(out)
 
         @routes.get("/api/events")
         async def events(request):

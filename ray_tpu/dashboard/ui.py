@@ -1,11 +1,11 @@
-"""The dashboard's web UI: one dependency-free HTML page.
+"""The dashboard's web UI: a dependency-free multi-view operator app.
 
-Reference role: the dashboard React client (dashboard/client) — scoped to
-a single self-contained page that polls the head's JSON endpoints
-(/api/nodes, /api/actors, /api/jobs, /api/serve, /api/events) and renders
-cluster resources, per-node hardware utilization, actors, jobs, serve
-applications, and recent events.  No build step, no bundler: the head
-serves this string at "/ui".
+Reference role: the dashboard React client (dashboard/client/src/App.tsx
++ components/) — re-scoped to a single self-contained page with hash
+routing over the head's JSON endpoints.  Views: Overview, Nodes,
+Actors, Tasks, Objects, Placement Groups, Jobs (with per-job detail +
+live log tail), Serve, Tune, Events.  No build step, no bundler: the
+head serves this string at "/ui" (and "/").
 """
 
 INDEX_HTML = """<!DOCTYPE html>
@@ -20,7 +20,13 @@ INDEX_HTML = """<!DOCTYPE html>
            display: flex; align-items: baseline; gap: 16px; }
   header h1 { font-size: 17px; margin: 0; font-weight: 600; }
   header span { color: #9fb2c8; font-size: 12px; }
-  main { padding: 16px 20px; max-width: 1200px; margin: 0 auto; }
+  nav { background: #1d2d40; padding: 0 20px; display: flex; gap: 2px;
+        overflow-x: auto; }
+  nav a { color: #9fb2c8; text-decoration: none; font-size: 13px;
+          padding: 8px 12px; border-bottom: 2px solid transparent;
+          white-space: nowrap; }
+  nav a.active { color: #fff; border-bottom-color: #3d7fd9; }
+  main { padding: 16px 20px; max-width: 1280px; margin: 0 auto; }
   section { background: #fff; border: 1px solid #e3e6ea;
             border-radius: 8px; margin-bottom: 16px; padding: 12px 16px; }
   h2 { font-size: 13px; text-transform: uppercase; letter-spacing: .06em;
@@ -31,35 +37,42 @@ INDEX_HTML = """<!DOCTYPE html>
   td { border-bottom: 1px solid #f0f2f4; padding: 4px 10px 4px 0;
        font-variant-numeric: tabular-nums; }
   .pill { display: inline-block; padding: 1px 8px; border-radius: 10px;
-          font-size: 12px; }
-  .ALIVE, .RUNNING, .SUCCEEDED { background: #e2f4e6; color: #1d7a33; }
-  .DEAD, .FAILED { background: #fbe3e4; color: #b3262e; }
-  .PENDING, .RESTARTING { background: #fdf3d7; color: #8a6d0a; }
+          font-size: 12px; background: #edf0f3; color: #39414d; }
+  .ALIVE, .RUNNING, .SUCCEEDED, .HEALTHY, .TERMINATED, .FINISHED
+    { background: #e2f4e6; color: #1d7a33; }
+  .DEAD, .FAILED, .ERROR, .UNHEALTHY { background: #fbe3e4;
+                                        color: #b3262e; }
+  .PENDING, .RESTARTING, .PAUSED, .UPDATING { background: #fdf3d7;
+                                              color: #8a6d0a; }
   .bar { background: #edf0f3; border-radius: 4px; height: 10px;
          width: 120px; display: inline-block; vertical-align: middle; }
   .bar i { display: block; height: 100%; border-radius: 4px;
            background: #3d7fd9; }
   .muted { color: #8a93a0; }
   code { font-size: 12px; }
+  pre.logs { background: #14202e; color: #d7e3f0; padding: 12px;
+             border-radius: 6px; font-size: 12px; max-height: 480px;
+             overflow: auto; white-space: pre-wrap; }
+  a.rowlink { color: #2b66c2; text-decoration: none; }
 </style>
 </head>
 <body>
-<header><h1>ray_tpu</h1>
-  <span id="summary">connecting…</span></header>
-<main>
-  <section><h2>Nodes</h2><table id="nodes"></table></section>
-  <section><h2>Actors</h2><table id="actors"></table></section>
-  <section><h2>Jobs</h2><table id="jobs"></table></section>
-  <section><h2>Serve</h2><pre id="serve" class="muted"></pre></section>
-  <section><h2>Events</h2><table id="events"></table></section>
-</main>
+<header><h1>ray_tpu</h1><span id="summary">connecting…</span></header>
+<nav id="nav"></nav>
+<main id="view"></main>
 <script>
+const VIEWS = ['overview', 'nodes', 'actors', 'tasks', 'objects', 'pgs',
+               'jobs', 'serve', 'tune', 'events'];
+const TITLES = {overview: 'Overview', nodes: 'Nodes', actors: 'Actors',
+  tasks: 'Tasks', objects: 'Objects', pgs: 'Placement Groups',
+  jobs: 'Jobs', serve: 'Serve', tune: 'Tune', events: 'Events'};
+
 const fmtB = (b) => b >= 1<<30 ? (b/(1<<30)).toFixed(1)+'G'
-  : b >= 1<<20 ? (b/(1<<20)).toFixed(0)+'M' : b + 'B';
+  : b >= 1<<20 ? (b/(1<<20)).toFixed(0)+'M' : (b||0) + 'B';
 const bar = (pct) =>
   `<span class="bar"><i style="width:${Math.min(100, pct||0)}%"></i></span>
    <span class="muted">${(pct||0).toFixed(0)}%</span>`;
-const esc = (s) => String(s).replace(/[&<>"']/g, c => ({'&':'&amp;',
+const esc = (s) => String(s ?? '').replace(/[&<>"']/g, c => ({'&':'&amp;',
   '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
 const pill = (s) => `<span class="pill ${/^[A-Z_]+$/.test(s) ? s : ''}">` +
   `${esc(s)}</span>`;
@@ -67,13 +80,13 @@ const row = (cells) => '<tr>' + cells.map(c => `<td>${c}</td>`).join('') +
   '</tr>';
 const head = (cols) => '<tr>' + cols.map(c => `<th>${c}</th>`).join('') +
   '</tr>';
+const section = (title, body, id) =>
+  `<section id="${id||''}"><h2>${title}</h2>${body}</section>`;
+const sid = (s, n=10) => `<code>${esc(String(s||'').slice(0, n))}</code>`;
 
-async function j(path) {
-  const r = await fetch(path);
-  return r.json();
-}
+async function j(path) { return (await fetch(path)).json(); }
 
-async function refresh() {
+async function summary() {
   try {
     const nodes = await j('/api/nodes');
     const alive = nodes.filter(n => n.state === 'ALIVE').length;
@@ -82,56 +95,198 @@ async function refresh() {
     document.getElementById('summary').textContent =
       `${alive}/${nodes.length} nodes alive · ${cpus} CPUs · ` +
       new Date().toLocaleTimeString();
-    document.getElementById('nodes').innerHTML =
-      head(['node', 'state', 'address', 'cpu', 'mem', 'store',
-            'workers', 'resources']) +
-      nodes.map(n => {
-        const s = n.node_stats || {};
-        const storePct = s.object_store_capacity ?
-          100 * s.object_store_used / s.object_store_capacity : 0;
-        return row([
-          `<code>${esc(n.node_id.slice(0, 10))}</code>`, pill(n.state),
-          esc(`${n.address[0]}:${n.address[1]}`),
-          bar(s.cpu_percent), bar(s.mem_percent), bar(storePct),
-          s.workers ?? '—',
-          `<code>${esc(JSON.stringify(n.resources_total))}</code>`]);
-      }).join('');
-
-    const actors = await j('/api/actors');
-    document.getElementById('actors').innerHTML =
-      head(['actor', 'class', 'state', 'restarts', 'node']) +
-      actors.slice(0, 50).map(a => row([
-        `<code>${esc((a.actor_id||'').slice(0, 10))}</code>`,
-        esc(a.class_name || '—'), pill(a.state || '—'),
-        a.num_restarts ?? 0,
-        `<code>${esc((a.node_id||'').slice(0, 10) || '—')}</code>`]))
-      .join('');
-
-    const jobs = await j('/api/jobs');
-    document.getElementById('jobs').innerHTML =
-      head(['job', 'status', 'entrypoint']) +
-      jobs.slice(0, 20).map(x => row([
-        `<code>${esc(x.submission_id || x.job_id || '')}</code>`,
-        pill(x.status || '—'),
-        `<code>${esc((x.entrypoint||'').slice(0, 80))}</code>`]))
-      .join('');
-
-    const serve = await j('/api/serve');
-    document.getElementById('serve').textContent =
-      JSON.stringify(serve, null, 1).slice(0, 2000);
-
-    const events = await j('/api/events');
-    document.getElementById('events').innerHTML =
-      head(['severity', 'source', 'message']) +
-      events.slice(-25).reverse().map(e => row([
-        pill(e.severity || 'INFO'), esc(e.source || '—'),
-        esc((e.message || '').slice(0, 140))])).join('');
-  } catch (err) {
-    document.getElementById('summary').textContent = 'error: ' + err;
+  } catch (e) {
+    document.getElementById('summary').textContent = 'error: ' + e;
   }
 }
-refresh();
-setInterval(refresh, 3000);
+
+// ------------------------------------------------------------- views
+async function vOverview() {
+  const [nodes, actors, jobs, events] = await Promise.all([
+    j('/api/nodes'), j('/api/actors'), j('/api/jobs'),
+    j('/api/events')]);
+  return section('Nodes', nodesTable(nodes)) +
+    section('Actors (50 newest)', actorsTable(actors.slice(0, 50))) +
+    section('Jobs', jobsTable(jobs.slice(0, 20))) +
+    section('Recent events', eventsTable(events.slice(-15)));
+}
+
+function nodesTable(nodes) {
+  return '<table>' + head(['node', 'state', 'address', 'cpu', 'mem',
+                           'store', 'workers', 'resources']) +
+    nodes.map(n => {
+      const s = n.node_stats || {};
+      const storePct = s.object_store_capacity ?
+        100 * s.object_store_used / s.object_store_capacity : 0;
+      return row([sid(n.node_id), pill(n.state),
+        esc(`${n.address[0]}:${n.address[1]}`),
+        bar(s.cpu_percent), bar(s.mem_percent), bar(storePct),
+        s.workers ?? '—',
+        `<code>${esc(JSON.stringify(n.resources_total))}</code>`]);
+    }).join('') + '</table>';
+}
+async function vNodes() {
+  return section('Nodes', nodesTable(await j('/api/nodes')));
+}
+
+function actorsTable(actors) {
+  return '<table>' + head(['actor', 'name', 'class', 'state',
+                           'restarts', 'node', 'pid']) +
+    actors.map(a => row([sid(a.actor_id), esc(a.name || '—'),
+      esc(a.class_name || '—'), pill(a.state || '—'),
+      a.num_restarts ?? 0, sid(a.node_id || '—'),
+      a.pid ?? '—'])).join('') + '</table>';
+}
+async function vActors() {
+  return section('Actors', actorsTable(await j('/api/actors')));
+}
+
+async function vTasks() {
+  const tasks = await j('/api/tasks');
+  return section('Tasks (200 newest)', '<table>' +
+    head(['task', 'name', 'state', 'node', 'worker']) +
+    tasks.slice(-200).reverse().map(t => row([
+      sid(t.task_id), esc(t.name || t.func_name || '—'),
+      pill(t.state || '—'), sid(t.node_id || '—'),
+      sid(t.worker_id || '—')])).join('') + '</table>');
+}
+
+async function vObjects() {
+  const objs = await j('/api/objects');
+  return section('Objects (200 newest)', '<table>' +
+    head(['object', 'size', 'state', 'node', 'pinned']) +
+    objs.slice(-200).reverse().map(o => row([
+      sid(o.object_id, 14), fmtB(o.size), pill(o.state || '—'),
+      sid(o.node_id || '—'), o.pinned ?? '—'])).join('') + '</table>');
+}
+
+async function vPgs() {
+  const pgs = await j('/api/placement_groups');
+  return section('Placement groups', '<table>' +
+    head(['pg', 'name', 'state', 'strategy', 'bundles']) +
+    pgs.map(p => row([sid(p.pg_id || p.placement_group_id),
+      esc(p.name || '—'), pill(p.state || '—'),
+      esc(p.strategy || '—'),
+      `<code>${esc(JSON.stringify(p.bundles))}</code>`]))
+    .join('') + '</table>');
+}
+
+function jobsTable(jobs) {
+  return '<table>' + head(['job', 'status', 'entrypoint', '']) +
+    jobs.map(x => {
+      const id = x.submission_id || x.job_id || '';
+      const link = x.submission_id ?
+        `<a class="rowlink" href="#/jobs/${esc(id)}">logs →</a>` : '';
+      return row([sid(id, 16), pill(x.status || '—'),
+        `<code>${esc((x.entrypoint||'').slice(0, 80))}</code>`, link]);
+    }).join('') + '</table>';
+}
+async function vJobs(arg) {
+  if (arg) return vJobDetail(arg);
+  return section('Jobs', jobsTable(await j('/api/jobs')));
+}
+
+async function vJobDetail(sid_) {
+  let info = {};
+  try { info = await j('/api/jobs/' + sid_); } catch (e) {}
+  const logs = await (await fetch(
+    '/api/jobs/' + sid_ + '/logs')).text();
+  return section(`Job ${esc(sid_)} — ${esc(info.status || '?')}`,
+    `<p><code>${esc(info.entrypoint || '')}</code></p>` +
+    `<pre class="logs" id="joblogs">${esc(logs)}</pre>` +
+    `<p><a class="rowlink" href="#/jobs">← all jobs</a></p>`);
+}
+
+async function vServe() {
+  const st = await j('/api/serve');
+  if (!Array.isArray(st)) {
+    return section('Serve', `<pre class="muted">` +
+      `${esc(JSON.stringify(st, null, 1))}</pre>`);
+  }
+  return section('Serve deployments', '<table>' +
+    head(['deployment', 'status', 'replicas', 'version', 'detail']) +
+    st.map(d => row([esc(d.name || '—'), pill(d.status || '—'),
+      d.num_replicas ?? d.replicas ?? '—', esc(d.version ?? '—'),
+      `<code>${esc(JSON.stringify(d.message || d.detail || ''))
+        .slice(0, 120)}</code>`])).join('') + '</table>');
+}
+
+async function vTune() {
+  const exps = await j('/api/tune');
+  if (!exps.length) {
+    return section('Tune', '<p class="muted">no experiments</p>');
+  }
+  return exps.map(e => {
+    const counts = {};
+    (e.trials || []).forEach(t => {
+      counts[t.status] = (counts[t.status] || 0) + 1; });
+    const sub = Object.entries(counts)
+      .map(([k, v]) => `${v} ${esc(k)}`).join(' · ');
+    return section(`Experiment ${esc(e.name)} — ${sub}`, '<table>' +
+      head(['trial', 'status', 'config', 'last result']) +
+      (e.trials || []).map(t => row([sid(t.trial_id),
+        pill(t.status || '—'),
+        `<code>${esc(JSON.stringify(t.config)).slice(0, 90)}</code>`,
+        `<code>${esc(JSON.stringify(t.last_result)).slice(0, 110)}` +
+        `</code>`])).join('') + '</table>');
+  }).join('');
+}
+
+function eventsTable(events) {
+  return '<table>' + head(['severity', 'source', 'message']) +
+    events.slice().reverse().map(e => row([
+      pill(e.severity || 'INFO'), esc(e.source || '—'),
+      esc((e.message || '').slice(0, 140))])).join('') + '</table>';
+}
+async function vEvents() {
+  return section('Events', eventsTable((await j('/api/events'))
+    .slice(-100)));
+}
+
+const RENDER = {overview: vOverview, nodes: vNodes, actors: vActors,
+  tasks: vTasks, objects: vObjects, pgs: vPgs, jobs: vJobs,
+  serve: vServe, tune: vTune, events: vEvents};
+
+function route() {
+  const h = (location.hash || '#/overview').replace(/^#\\//, '');
+  const parts = h.split('/');
+  const view = VIEWS.includes(parts[0]) ? parts[0] : 'overview';
+  return {view, arg: parts[1]};
+}
+
+function drawNav() {
+  const {view} = route();
+  document.getElementById('nav').innerHTML = VIEWS.map(v =>
+    `<a href="#/${v}" class="${v === view ? 'active' : ''}">` +
+    `${TITLES[v]}</a>`).join('');
+}
+
+async function refresh(isTick) {
+  const {view, arg} = route();
+  drawNav();
+  // Interval re-renders must not yank the operator's place in a log
+  // they scrolled through; tail-follow only when already at the end.
+  const prev = document.getElementById('joblogs');
+  const keep = isTick === true && prev ? {
+    top: prev.scrollTop,
+    atEnd: prev.scrollTop + prev.clientHeight >= prev.scrollHeight - 4,
+  } : null;
+  try {
+    document.getElementById('view').innerHTML =
+      await RENDER[view](arg);
+  } catch (err) {
+    document.getElementById('view').innerHTML =
+      section('Error', `<pre class="muted">${esc(err)}</pre>`);
+  }
+  const cur = document.getElementById('joblogs');
+  if (keep && cur) {
+    cur.scrollTop = keep.atEnd ? cur.scrollHeight : keep.top;
+  }
+  summary();
+}
+window.addEventListener('hashchange', () => refresh(false));
+refresh(false);
+setInterval(() => refresh(true), 3000);
 </script>
 </body>
 </html>
