@@ -35,11 +35,13 @@ _AXON_VARS = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
 
 @pytest.fixture
 def gang_cluster():
-    saved = {k: os.environ.pop(k, None) for k in _AXON_VARS}
+    saved = {k: os.environ.pop(k, None)
+             for k in _AXON_VARS + ("JAX_PLATFORMS",)}
     os.environ["JAX_PLATFORMS"] = "cpu"
     c = ProcessCluster()
     yield c
     c.shutdown()
+    os.environ.pop("JAX_PLATFORMS", None)
     for k, v in saved.items():
         if v is not None:
             os.environ[k] = v
