@@ -1,13 +1,23 @@
-"""AlphaZero (single-player): MCTS-guided policy iteration.
+"""AlphaZero: MCTS-guided policy iteration — two-player self-play on
+board games, plus a single-player variant for reward-ranked envs.
 
 Reference: rllib/algorithms/alpha_zero/alpha_zero.py (+ mcts.py) — a
 policy/value network guides Monte-Carlo tree search over a *cloneable*
 environment (get_state/set_state); self-play episodes record the MCTS
-visit distribution as the policy target and the episode's discounted
-return as the value target.  The reference's single-player variant
-ranks rewards instead of win/loss; ours regresses the normalized return
-directly and min-max normalizes Q inside the UCB rule (the MuZero trick
-for unbounded scores).
+visit distribution as the policy target and the game outcome as the
+value target.
+
+Two modes, auto-selected from the env:
+- **Two-player** (the reference's actual domain class): alternating-
+  move zero-sum board games (examples/board.py ConnectFour).  Values
+  live in [-1, 1] from the mover's perspective; the UCB rule negates
+  the child Q (the child's value is the opponent's), backup flips sign
+  each ply, priors are masked to legal moves, and the value target is
+  the final game outcome from each mover's seat.  Evaluation plays
+  held-out games against scripted random and 1-ply-tactic opponents.
+- **Single-player**: gym classic-control envs; regresses the
+  normalized discounted return and min-max normalizes Q inside the
+  UCB rule (the MuZero trick for unbounded scores).
 
 Re-derived jax-first: one jitted policy+value forward serves every
 MCTS expansion, and the (cross-entropy + value MSE) training step is a
@@ -74,6 +84,9 @@ class CloneableGymEnv:
 class _PVNet(nn.Module):
     num_actions: int
     hiddens: tuple = (64, 64)
+    # Two-player games bound the value in [-1, 1] (tanh); single-player
+    # normalized returns live in [0, 1] (sigmoid).
+    two_player: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -81,13 +94,14 @@ class _PVNet(nn.Module):
         for width in self.hiddens:
             h = nn.relu(nn.Dense(width)(h))
         logits = nn.Dense(self.num_actions)(h)
-        value = nn.sigmoid(nn.Dense(1)(h))[..., 0]  # normalized [0, 1]
+        raw = nn.Dense(1)(h)[..., 0]
+        value = jnp.tanh(raw) if self.two_player else nn.sigmoid(raw)
         return logits, value
 
 
 class _Node:
     __slots__ = ("prior", "visits", "value_sum", "children", "state",
-                 "reward", "terminal")
+                 "reward", "terminal", "winner")
 
     def __init__(self, prior: float):
         self.prior = prior
@@ -97,6 +111,7 @@ class _Node:
         self.state = None
         self.reward = 0.0
         self.terminal = False
+        self.winner = 0
 
     def q(self) -> float:
         return self.value_sum / self.visits if self.visits else 0.0
@@ -122,6 +137,7 @@ class AlphaZeroConfig:
             "train_batch_size": 128,
             "num_sgd_steps": 30,
             "fcnet_hiddens": (64, 64),
+            "eval_games": 12,          # two-player: per opponent per iter
             "seed": 0,
         }
 
@@ -153,12 +169,22 @@ class AlphaZero(Trainable):
         defaults = AlphaZeroConfig().to_dict()
         defaults.update(config)
         self.cfg = defaults
-        self.env = CloneableGymEnv(self.cfg["env"],
-                                   self.cfg["env_config"])
-        self.obs_dim = int(np.prod(self.env.observation_space.shape))
-        self.num_actions = int(self.env.action_space.n)
+        self._game_ctor = self._resolve_board_game()
+        self.two_player = self._game_ctor is not None
+        if self.two_player:
+            self.game = self._game_ctor()
+            self._eval_game = self._game_ctor()
+            self.env = None
+            self.obs_dim = self.game.obs_dim
+            self.num_actions = self.game.num_actions
+        else:
+            self.env = CloneableGymEnv(self.cfg["env"],
+                                       self.cfg["env_config"])
+            self.obs_dim = int(np.prod(self.env.observation_space.shape))
+            self.num_actions = int(self.env.action_space.n)
         self.net = _PVNet(num_actions=self.num_actions,
-                          hiddens=tuple(self.cfg["fcnet_hiddens"]))
+                          hiddens=tuple(self.cfg["fcnet_hiddens"]),
+                          two_player=self.two_player)
         rng = jax.random.PRNGKey(self.cfg["seed"])
         self.params = self.net.init(
             rng, jnp.zeros((1, self.obs_dim), jnp.float32))
@@ -171,6 +197,39 @@ class AlphaZero(Trainable):
         self._iter = 0
         self._timesteps_total = 0
         self._episode_rewards: List[float] = []
+
+    # Everything two-player self-play/search/eval touches on a game;
+    # a candidate missing any of it takes the single-player gym path
+    # instead of crashing mid-search.
+    _BOARD_PROTOCOL = ("apply", "to_move", "legal_actions",
+                       "canonical_obs", "reset", "get_state",
+                       "set_state", "greedy_move", "random_move",
+                       "num_actions", "obs_dim")
+
+    def _resolve_board_game(self):
+        """Returns a zero-arg constructor when cfg['env'] names an
+        alternating-move board game (examples/board.py protocol, see
+        _BOARD_PROTOCOL), else None — which selects the single-player
+        gym path."""
+        spec = self.cfg["env"]
+        cfg = self.cfg["env_config"]
+        import ray_tpu.rllib.examples.board as board
+
+        def _conforms(obj):
+            return all(hasattr(obj, a) for a in self._BOARD_PROTOCOL)
+
+        if isinstance(spec, str):
+            cls = getattr(board, spec, None)
+            # Probe an instance: protocol attributes like to_move are
+            # set in __init__/reset, not on the class.
+            if isinstance(cls, type) and _conforms(cls(cfg)):
+                return lambda: cls(cfg)
+            return None
+        if callable(spec):
+            probe = spec(cfg)
+            if _conforms(probe):
+                return lambda: spec(cfg)
+        return None
 
     # -------------------------------------------------------------- MCTS
     def _eval_net(self, obs: np.ndarray):
@@ -284,6 +343,178 @@ class AlphaZero(Trainable):
             self._replay = self._replay[-cfg["replay_capacity"]:]
         return total
 
+    # ------------------------------------------- two-player MCTS
+    def _masked_priors(self, obs: np.ndarray, legal: List[int]):
+        """Net forward with illegal moves masked out of the softmax."""
+        logits, value = self._forward(
+            self.params, jnp.asarray(obs, jnp.float32)[None])
+        logits = np.asarray(logits, np.float64)[0]
+        mask = np.full(self.num_actions, -np.inf)
+        mask[legal] = 0.0
+        x = logits + mask
+        x -= x.max()
+        p = np.exp(x)
+        p /= p.sum()
+        return p, float(np.asarray(value)[0])
+
+    def _search2(self, game, add_noise: bool = True) -> np.ndarray:
+        """Two-player MCTS from `game`'s current position.  Values are
+        from the mover-at-node's perspective in [-1, 1]; the UCB rule
+        negates the child Q (the child's mover is the opponent) and
+        backup flips sign each ply.  Restores `game` before returning."""
+        cfg = self.cfg
+        root = _Node(prior=1.0)
+        root.state = game.get_state()
+        legal = game.legal_actions()
+        probs, value = self._masked_priors(game.canonical_obs(), legal)
+        if add_noise:
+            noise = self._rng.dirichlet(
+                [cfg["dirichlet_alpha"]] * len(legal))
+            for i, a in enumerate(legal):
+                probs[a] = ((1 - cfg["dirichlet_frac"]) * probs[a]
+                            + cfg["dirichlet_frac"] * noise[i])
+        for a in legal:
+            root.children[a] = _Node(prior=float(probs[a]))
+        root.visits = 1
+        root.value_sum = value
+
+        for _ in range(cfg["num_simulations"]):
+            node, path = root, [root]
+            leaf_value = 0.0
+            while True:
+                if node.terminal:
+                    # The mover at a decided terminal node is the loser.
+                    leaf_value = 0.0 if node.winner == 0 else -1.0
+                    break
+                sq = math.sqrt(node.visits)
+                best_a, best_score = None, -np.inf
+                for a, ch in node.children.items():
+                    qe = -ch.q() if ch.visits else 0.0
+                    score = qe + cfg["c_puct"] * ch.prior * sq \
+                        / (1 + ch.visits)
+                    if score > best_score:
+                        best_a, best_score = a, score
+                child = node.children[best_a]
+                if child.state is None:
+                    # Materialize by stepping a clone off the parent.
+                    game.set_state(node.state)
+                    _, winner = game.apply(best_a)
+                    child.state = game.get_state()
+                    if game.winner is not None:
+                        child.terminal = True
+                        child.winner = winner
+                        leaf_value = 0.0 if winner == 0 else -1.0
+                    else:
+                        legal2 = game.legal_actions()
+                        p2, v2 = self._masked_priors(
+                            game.canonical_obs(), legal2)
+                        for a2 in legal2:
+                            child.children[a2] = _Node(
+                                prior=float(p2[a2]))
+                        leaf_value = v2
+                    path.append(child)
+                    break
+                node = child
+                path.append(node)
+            value = leaf_value
+            for n in reversed(path):
+                n.visits += 1
+                n.value_sum += value
+                value = -value
+        game.set_state(root.state)
+        visits = np.zeros(self.num_actions, np.float64)
+        for a, ch in root.children.items():
+            visits[a] = ch.visits
+        return visits / visits.sum()
+
+    def _self_play_game(self) -> int:
+        """One self-play game; both seats share the net.  Rows record
+        (canonical obs, visit dist, mover); z is filled with the final
+        outcome from each mover's seat."""
+        cfg = self.cfg
+        g = self.game
+        g.reset()
+        rows = []
+        winner = 0
+        # Ply cap is a safety net only — board games terminate on
+        # their own (full board / win); max_episode_steps needs no
+        # game-specific geometry.
+        for ply in range(self.cfg["max_episode_steps"]):
+            pi = self._search2(g)
+            if ply < cfg["temperature_steps"]:
+                a = int(self._rng.choice(self.num_actions, p=pi))
+            else:
+                a = int(pi.argmax())
+            rows.append({"obs": g.canonical_obs(),
+                         "pi": pi.astype(np.float32),
+                         "mover": g.to_move})
+            term, winner = g.apply(a)
+            self._timesteps_total += 1
+            if term:
+                break
+        for row in rows:
+            row["z"] = np.float32(winner * row["mover"])
+            del row["mover"]
+        self._replay.extend(rows)
+        if len(self._replay) > cfg["replay_capacity"]:
+            self._replay = self._replay[-cfg["replay_capacity"]:]
+        return winner
+
+    def _play_eval_game(self, opponent: str, az_first: bool) -> float:
+        """One held-out game vs a scripted opponent; returns the
+        outcome from AlphaZero's seat (+1 win / 0 draw / -1 loss).
+        No exploration noise; moves are argmax visit counts."""
+        g = self._eval_game
+        g.reset()
+        az_seat = 1 if az_first else -1
+        while True:
+            if g.to_move == az_seat:
+                pi = self._search2(g, add_noise=False)
+                legal = g.legal_actions()
+                a = int(max(legal, key=lambda c: pi[c]))
+            elif opponent == "greedy":
+                a = g.greedy_move(self._rng)
+            else:
+                a = g.random_move(self._rng)
+            term, winner = g.apply(a)
+            if term:
+                return float(winner * az_seat)
+
+    def _step_two_player(self) -> Dict:
+        cfg = self.cfg
+        outcomes = [self._self_play_game()
+                    for _ in range(cfg["episodes_per_iter"])]
+        loss = np.nan
+        for _ in range(cfg["num_sgd_steps"]):
+            if len(self._replay) < cfg["train_batch_size"]:
+                break
+            idx = self._rng.randint(0, len(self._replay),
+                                    cfg["train_batch_size"])
+            obs = jnp.asarray(np.stack(
+                [self._replay[i]["obs"] for i in idx]))
+            pi = jnp.asarray(np.stack(
+                [self._replay[i]["pi"] for i in idx]))
+            z = jnp.asarray(np.asarray(
+                [self._replay[i]["z"] for i in idx], np.float32))
+            self.params, self.opt_state, jloss = self._train_step(
+                self.params, self.opt_state, obs, pi, z)
+            loss = float(jloss)
+        n = cfg["eval_games"]
+        vs_random = [self._play_eval_game("random", i % 2 == 0)
+                     for i in range(n)]
+        vs_greedy = [self._play_eval_game("greedy", i % 2 == 0)
+                     for i in range(n)]
+        win_r = float(np.mean([o > 0 for o in vs_random]))
+        win_g = float(np.mean([o > 0 for o in vs_greedy]))
+        self._episode_rewards += [float(np.mean(vs_random))]
+        return {"episode_reward_mean": float(np.mean(vs_random)),
+                "win_rate_vs_random": win_r,
+                "win_rate_vs_greedy": win_g,
+                "self_play_first_mover_wins": float(
+                    np.mean([o == 1 for o in outcomes])),
+                "az_loss": loss,
+                "timesteps_total": self._timesteps_total}
+
     # ---------------------------------------------------------- learning
     def _train_step_impl(self, params, opt_state, obs, pi, z):
         def loss_fn(p):
@@ -299,6 +530,8 @@ class AlphaZero(Trainable):
     def step(self) -> Dict:
         cfg = self.cfg
         self._iter += 1
+        if self.two_player:
+            return self._step_two_player()
         rets = [self._self_play_episode()
                 for _ in range(cfg["episodes_per_iter"])]
         self._episode_rewards += rets
@@ -338,6 +571,7 @@ class AlphaZero(Trainable):
 
     def cleanup(self):
         try:
-            self.env.close()
+            if self.env is not None:
+                self.env.close()
         except Exception:
             pass

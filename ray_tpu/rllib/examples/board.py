@@ -1,0 +1,140 @@
+"""Two-player zero-sum board games for AlphaZero-style self-play.
+
+Reference: rllib/examples/env/ provides the small diagnostic envs the
+reference's alpha_zero learning tests run on; the reference AlphaZero
+itself (rllib/algorithms/alpha_zero/) is a two-player MCTS self-play
+algorithm over envs exposing get_state/set_state.  ConnectFour here is
+that domain class: perfect-information, alternating-move, zero-sum,
+with a column-drop action space and a connect-K win rule.
+
+The board is kept in *absolute* encoding (+1 = first player, -1 =
+second player, 0 = empty); `canonical_obs()` multiplies by the player
+to move so a network always sees itself as +1 — the standard AlphaZero
+symmetry trick that halves what the net must learn.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class ConnectFour:
+    """Connect-K on an R x C grid (default: the classic 6 x 7, K=4).
+
+    Not a gymnasium env on purpose: alternating-move games need
+    `player_to_move`, `legal_actions`, and clone/restore, which the
+    gym API has no vocabulary for.  AlphaZero drives this interface
+    directly (mirroring the reference's requirement that alpha_zero
+    envs expose get_state/set_state on top of step)."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.rows = int(config.get("rows", 6))
+        self.cols = int(config.get("cols", 7))
+        self.k = int(config.get("connect", 4))
+        self.reset()
+
+    # ------------------------------------------------------------ core
+    def reset(self) -> np.ndarray:
+        self.board = np.zeros((self.rows, self.cols), np.int8)
+        self.to_move = 1  # +1 moves first
+        self.winner: Optional[int] = None  # +1 / -1 / 0 (draw) / None
+        self.moves = 0
+        return self.canonical_obs()
+
+    @property
+    def num_actions(self) -> int:
+        return self.cols
+
+    @property
+    def obs_dim(self) -> int:
+        return self.rows * self.cols
+
+    def legal_actions(self) -> List[int]:
+        return [c for c in range(self.cols) if self.board[0, c] == 0]
+
+    def canonical_obs(self) -> np.ndarray:
+        """Board from the mover's perspective (mover pieces = +1)."""
+        return (self.board * self.to_move).astype(
+            np.float32).reshape(-1)
+
+    def apply(self, action: int) -> Tuple[bool, int]:
+        """Drop a piece for the player to move.  Returns (terminal,
+        winner) with winner in {+1, -1, 0} (0 = draw) once terminal."""
+        col = int(action)
+        if self.board[0, col] != 0 or self.winner is not None:
+            raise ValueError(f"illegal move {col}")
+        row = int(np.max(np.nonzero(
+            np.append(self.board[:, col], 1) == 0)))
+        self.board[row, col] = self.to_move
+        self.moves += 1
+        if self._wins_at(row, col):
+            self.winner = self.to_move
+        elif self.moves == self.rows * self.cols:
+            self.winner = 0
+        self.to_move = -self.to_move
+        return self.winner is not None, (self.winner or 0)
+
+    def _wins_at(self, row: int, col: int) -> bool:
+        me = self.board[row, col]
+        for dr, dc in ((0, 1), (1, 0), (1, 1), (1, -1)):
+            run = 1
+            for sign in (1, -1):
+                r, c = row + sign * dr, col + sign * dc
+                while (0 <= r < self.rows and 0 <= c < self.cols
+                       and self.board[r, c] == me):
+                    run += 1
+                    r += sign * dr
+                    c += sign * dc
+            if run >= self.k:
+                return True
+        return False
+
+    # -------------------------------------------------- clone/restore
+    def get_state(self):
+        return (self.board.copy(), self.to_move, self.winner, self.moves)
+
+    def set_state(self, state) -> None:
+        board, to_move, winner, moves = state
+        self.board = board.copy()
+        self.to_move = to_move
+        self.winner = winner
+        self.moves = moves
+
+    # ------------------------------------------------ scripted players
+    def winning_moves(self, player: int) -> List[int]:
+        """Columns where `player` wins immediately (used by the greedy
+        eval opponent and by tests)."""
+        out = []
+        save = self.get_state()
+        for c in self.legal_actions():
+            self.to_move = player
+            self.winner = None
+            try:
+                _, w = self.apply(c)
+            except ValueError:
+                self.set_state(save)
+                continue
+            if w == player:
+                out.append(c)
+            self.set_state(save)
+        return out
+
+    def greedy_move(self, rng: np.random.RandomState) -> int:
+        """1-ply tactical player: take an immediate win, else block the
+        opponent's immediate win, else random — the eval bar opponent."""
+        me = self.to_move
+        wins = self.winning_moves(me)
+        if wins:
+            return wins[0]
+        blocks = self.winning_moves(-me)
+        if blocks:
+            return blocks[0]
+        legal = self.legal_actions()
+        return int(legal[rng.randint(len(legal))])
+
+    def random_move(self, rng: np.random.RandomState) -> int:
+        legal = self.legal_actions()
+        return int(legal[rng.randint(len(legal))])
