@@ -7,12 +7,15 @@ and reward head) is trained on replayed real sequences; the actor and
 value critic are then trained ENTIRELY inside the model by
 backpropagating lambda-returns through imagined latent rollouts.
 
-Re-designed jax-first and scoped to proprioceptive observations (the
-reference's conv encoder/decoder for pixels becomes an MLP pair): the
-world-model update and the imagination update are each ONE jitted
-function — reparameterized latents make the actor gradient flow
-through the learned dynamics exactly (no likelihood-ratio estimator),
-which is the heart of the algorithm.
+Re-designed jax-first: the world-model update and the imagination
+update are each ONE jitted function — reparameterized latents make the
+actor gradient flow through the learned dynamics exactly (no
+likelihood-ratio estimator), which is the heart of the algorithm.
+Observations select the encoder/decoder pair: 3-D (pixel) obs get the
+reference's conv stack (_ConvEncoder/_ConvDecoder, cf.
+dreamer_model.py:23,71 — e.g. examples/pixel.py PixelPendulum, where
+velocity must be integrated across frames by the RSSM), flat obs get
+an MLP pair.
 """
 
 from __future__ import annotations
@@ -79,6 +82,42 @@ class _MLP(nn.Module):
         return jnp.tanh(x) if self.final_tanh else x
 
 
+class _ConvEncoder(nn.Module):
+    """Pixel encoder (reference: dreamer_model.py:23 ConvEncoder, a
+    strided-conv stack).  Takes FLATTENED frames — the RSSM plumbing
+    is shape-agnostic that way — and reshapes internally."""
+
+    out: int
+    shape: tuple  # (H, W, C)
+
+    @nn.compact
+    def __call__(self, x):
+        img = x.reshape((x.shape[0],) + self.shape)
+        h = nn.relu(nn.Conv(16, (4, 4), strides=2)(img))
+        h = nn.relu(nn.Conv(32, (4, 4), strides=2)(h))
+        h = nn.relu(nn.Conv(32, (3, 3), strides=2)(h))
+        return nn.Dense(self.out)(h.reshape(x.shape[0], -1))
+
+
+class _ConvDecoder(nn.Module):
+    """Latent-to-frame transposed-conv stack (reference:
+    dreamer_model.py:71 ConvDecoder); emits flattened frames so the
+    reconstruction loss is identical to the proprio path."""
+
+    shape: tuple  # (H, W, C) with H == W and H divisible by 8
+
+    @nn.compact
+    def __call__(self, feat):
+        n = feat.shape[0]
+        s = self.shape[0] // 8
+        h = nn.Dense(s * s * 32)(feat).reshape(n, s, s, 32)
+        h = nn.relu(nn.ConvTranspose(32, (3, 3), strides=(2, 2))(h))
+        h = nn.relu(nn.ConvTranspose(16, (4, 4), strides=(2, 2))(h))
+        h = nn.ConvTranspose(self.shape[-1], (4, 4),
+                             strides=(2, 2))(h)
+        return h.reshape(n, -1)
+
+
 class DreamerConfig:
     def __init__(self):
         self.algo_class = Dreamer
@@ -95,7 +134,19 @@ class DreamerConfig:
             "behavior_train_steps": 40,
             "episodes_per_iter": 4,
             "max_episode_steps": 100,
+            # Each policy action is held for this many env steps with
+            # rewards summed (the reference Dreamer's action-repeat
+            # wrapper; standard for pixel control — halves the horizon
+            # the world model must carry).
+            "action_repeat": 1,
+            # Rewards are scaled by this inside the world model and
+            # imagination (metrics stay unscaled).  Dreamer's value
+            # learning assumes roughly unit-scale rewards (the DMC
+            # suite's [0, 1] per step); gym Pendulum's [-16, 0] breaks
+            # that — set ~1/16 there.
+            "reward_scale": 1.0,
             "expl_noise": 0.3,
+            "expl_noise_decay": 0.9,
             "buffer_capacity_episodes": 200,
             "free_nats": 1.0,
             "kl_scale": 1.0,
@@ -132,9 +183,18 @@ class Dreamer(Trainable):
         self.cfg = cfg = defaults
         import gymnasium as gym
         env = cfg["env"]
-        self.env = (gym.make(env, **cfg["env_config"])
-                    if isinstance(env, str) else env(cfg["env_config"]))
-        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        if isinstance(env, str):
+            import ray_tpu.rllib.examples.pixel as _pixel_envs
+            cls = getattr(_pixel_envs, env, None)
+            self.env = (cls(cfg["env_config"]) if cls is not None
+                        else gym.make(env, **cfg["env_config"]))
+        else:
+            self.env = env(cfg["env_config"])
+        obs_shape = self.env.observation_space.shape
+        # 3-D observations select the conv encoder/decoder pair — the
+        # reference Dreamer's pixel domain (dreamer_model.py:23,71).
+        self.pixel_obs = len(obs_shape) == 3
+        self.obs_dim = int(np.prod(obs_shape))
         space = self.env.action_space
         self.act_dim = int(np.prod(space.shape))
         self._act_low = np.asarray(space.low, np.float32).reshape(-1)
@@ -144,8 +204,17 @@ class Dreamer(Trainable):
 
         S, D, H = cfg["stoch"], cfg["deter"], cfg["hidden"]
         self.rssm = _RSSM(stoch=S, deter=D, hidden=H)
-        self.encoder = _MLP(out=H)
-        self.decoder = _MLP(out=self.obs_dim)
+        if self.pixel_obs:
+            if obs_shape[0] != obs_shape[1] or obs_shape[0] % 8:
+                raise ValueError(
+                    f"pixel Dreamer needs square frames with side "
+                    f"divisible by 8 (the decoder upsamples 2x three "
+                    f"times from side/8); got {obs_shape}")
+            self.encoder = _ConvEncoder(out=H, shape=obs_shape)
+            self.decoder = _ConvDecoder(shape=obs_shape)
+        else:
+            self.encoder = _MLP(out=H)
+            self.decoder = _MLP(out=self.obs_dim)
         self.reward_head = _MLP(out=1)
         self.actor = _MLP(out=self.act_dim, final_tanh=True)
         self.critic = _MLP(out=1)
@@ -211,12 +280,18 @@ class Dreamer(Trainable):
                         -1.0, 1.0).astype(np.float32)
             env_a = (a * self._scale + self._center).reshape(
                 self.env.action_space.shape)
-            obs2, r, term, trunc, _ = self.env.step(env_a)
+            r = 0.0
+            term = trunc = False
+            for _ in range(cfg["action_repeat"]):
+                obs2, r1, term, trunc, _ = self.env.step(env_a)
+                r += float(r1)
+                self._timesteps_total += 1
+                if term or trunc:
+                    break
             rows["obs"].append(obs)
             rows["actions"].append(a)
             rows["rewards"].append(float(r))
             total += float(r)
-            self._timesteps_total += 1
             obs = np.asarray(obs2, np.float32).reshape(-1)
             a_prev = jnp.asarray(a)[None]
             if term or trunc:
@@ -375,13 +450,15 @@ class Dreamer(Trainable):
                 act[b] = ep["actions"][s:s + L]
                 rew[b] = ep["rewards"][s:s + L]
                 mask[b] = 1.0
+        rew *= cfg["reward_scale"]
         return (jnp.asarray(obs), jnp.asarray(act), jnp.asarray(rew),
                 jnp.asarray(mask))
 
     def step(self) -> Dict:
         cfg = self.cfg
         self._iter += 1
-        noise = max(0.05, cfg["expl_noise"] * (0.9 ** self._iter))
+        noise = max(0.05, cfg["expl_noise"]
+                    * (cfg["expl_noise_decay"] ** self._iter))
         rets = [self._run_episode(noise)
                 for _ in range(cfg["episodes_per_iter"])]
         self._episode_rewards += rets
