@@ -88,6 +88,37 @@ class _OOBBytes:
         return (self.ctor, (pickle.PickleBuffer(self.payload),))
 
 
+class _Pickler(pickle.Pickler):
+    """Plain pickle, except objects DEFINED in the driver script's
+    ``__main__`` (functions, classes, their instances' classes) ship
+    by value as a nested cloudpickle blob: plain pickle would encode
+    them as a reference to ``__main__``, which no worker can resolve
+    (its __main__ is worker_main).  Handled inline in ONE pass —
+    payloads embedding driver-defined callables are the steady state
+    for graph schedulers (dask-on-ray), so a full dump-then-redo
+    fallback would double every submit's serialization cost.
+
+    Primitive containers and buffer-protocol data never reach
+    ``reducer_override`` (the C pickler's dedicated save paths run
+    first), so the data hot path is unaffected."""
+
+    def reducer_override(self, obj):
+        try:
+            if ((isinstance(obj, type) or callable(obj))
+                    and getattr(obj, "__module__", None) == "__main__"):
+                return (cloudpickle.loads, (cloudpickle.dumps(obj),))
+        except Exception:
+            pass
+        return NotImplemented
+
+
+def _pickle_dumps(target, buffer_callback) -> bytes:
+    import io
+    f = io.BytesIO()
+    _Pickler(f, _PROTO, buffer_callback=buffer_callback).dump(target)
+    return f.getvalue()
+
+
 def serialize(value) -> tuple[SerializedObject, list[ObjectRef]]:
     """Serialize ``value``; returns the payload and any ObjectRefs nested in it."""
     buffers: list = []
@@ -96,8 +127,12 @@ def serialize(value) -> tuple[SerializedObject, list[ObjectRef]]:
         target = _OOBBytes(type(value), value)
     with track_nested_refs() as nested:
         try:
-            inband = pickle.dumps(target, protocol=_PROTO,
-                                  buffer_callback=buffers.append)
+            # _Pickler intercepts every function/class/callable save,
+            # which covers all paths that would emit a __main__ global
+            # reference (the one residual escape — a legacy __reduce__
+            # returning a bare attribute-name string — surfaces as a
+            # clear AttributeError on the worker).
+            inband = _pickle_dumps(target, buffers.append)
         except Exception:
             buffers.clear()
             nested.clear()  # refs tracked during the failed attempt

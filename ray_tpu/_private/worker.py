@@ -1049,8 +1049,14 @@ class CoreWorker:
         backlog = len(pool.queue)
         if backlog == 0 and pool.outstanding:
             self._cancel_outstanding(pool)
-        want = min(backlog, 8) - pool.requests_inflight - len([
-            1 for e in pool.all.values() if e.get("busy")])
+        # One lease wanted per queued task (capped): a busy lease must
+        # NOT count as covering the backlog — its task may run for
+        # hours, and parallelism must never depend on task duration.
+        # (Regression: a lingering warm lease made the pool dispatch
+        # task A onto it and then request nothing for task B, fully
+        # serializing two same-key tasks — caught by the dask-on-ray
+        # rendezvous test.)
+        want = min(backlog, 8) - pool.requests_inflight
         for _ in range(max(0, want)):
             pool.requests_inflight += 1
             self.loop.create_task(self._request_lease(key))
@@ -1115,6 +1121,11 @@ class CoreWorker:
                     continue
                 break
             if reply.get("cancelled"):
+                # A task enqueued during the cancel round trip saw
+                # requests_inflight > 0 and issued no request of its
+                # own — re-pump so it gets one (this exit path must
+                # behave like every other one).
+                self.loop.call_soon(self._pump, key)
                 return
             if "error" in reply:
                 self._fail_queued(key, rexc.RayTpuError(reply["error"]))
@@ -1142,6 +1153,12 @@ class CoreWorker:
         finally:
             pool.requests_inflight -= 1
         self._pump(key)
+        # Granted after the backlog drained (a finishing task absorbed
+        # the queue): without a linger timer this lease would park its
+        # worker forever.
+        if (lease in pool.idle
+                and lease["lease_id"] not in pool.return_timers):
+            self._schedule_lease_return(key, lease)
 
     def _fail_queued(self, key, exc):
         pool = self.lease_pools.get(key)
@@ -1239,17 +1256,28 @@ class CoreWorker:
             pool.idle.append(lease)
             self._pump(key)
         else:
-            # Linger briefly before returning the lease: a tight
-            # submit/get loop re-uses it without a fresh lease round trip.
-            handle = self.loop.call_later(
-                0.02, lambda: self.loop.create_task(
-                    self._return_lease(key, lease)))
-            pool.return_timers[lease["lease_id"]] = handle
+            self._schedule_lease_return(key, lease)
             pool.idle.append(lease)
+
+    def _schedule_lease_return(self, key, lease):
+        """Linger briefly before returning the lease: a tight
+        submit/get loop re-uses it without a fresh lease round trip."""
+        pool = self.lease_pools[key]
+        handle = self.loop.call_later(
+            0.02, lambda: self.loop.create_task(
+                self._return_lease(key, lease)))
+        pool.return_timers[lease["lease_id"]] = handle
 
     async def _return_lease(self, key, lease):
         pool = self.lease_pools.get(key)
         if pool is None:
+            return
+        # The timer may have FIRED before _pump claimed the lease for a
+        # new task (cancel() on a fired handle is a no-op).  _pump pops
+        # return_timers when it claims — if our entry is gone, the lease
+        # is busy again: returning it now would reclaim the worker
+        # mid-push.
+        if lease["lease_id"] not in pool.return_timers:
             return
         if lease in pool.idle:
             pool.idle.remove(lease)

@@ -1,0 +1,36 @@
+"""Dask-on-Ray: execute dask task graphs on the cluster.
+
+Reference: python/ray/util/dask/scheduler.py:83 ``ray_dask_get`` — a
+dask scheduler that ships each top-level graph task to the cluster and
+repacks results into dask collections; ``enable_dask_on_ray`` installs
+it as dask's global scheduler (util/dask/__init__.py).
+
+Re-designed for this runtime rather than translated: the reference
+hijacks dask's thread-pooled ``get_async`` loop, blocking one thread
+per in-flight task to discover readiness.  Here the whole graph is
+submitted in ONE topological pass — every dask task becomes a remote
+task whose dependency arguments are ObjectRefs, and the runtime's own
+submitter-side DependencyResolver gates dispatch, so no thread pool,
+no readiness polling, and downstream tasks are queued cluster-side the
+moment their inputs seal.
+
+The dask *graph protocol* is a plain-dict contract (key -> literal |
+key-reference | task tuple ``(callable, *args)`` with nested lists),
+so this module implements it natively and is fully testable without
+dask installed; ``enable_dask_on_ray`` additionally wires dask's
+config when the real library is present.
+"""
+
+from ray_tpu.util.dask.scheduler import (  # noqa: F401
+    disable_dask_on_ray,
+    enable_dask_on_ray,
+    ray_dask_get,
+    ray_dask_get_sync,
+)
+
+__all__ = [
+    "ray_dask_get",
+    "ray_dask_get_sync",
+    "enable_dask_on_ray",
+    "disable_dask_on_ray",
+]

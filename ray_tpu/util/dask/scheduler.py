@@ -1,0 +1,258 @@
+"""A dask scheduler over this runtime's task graph.
+
+Reference behavior: python/ray/util/dask/scheduler.py:83
+(``ray_dask_get``), :510 (``ray_dask_get_sync``), :32
+(``enable_dask_on_ray``).  See the package docstring for why this
+implementation submits the graph in one pass instead of reusing
+dask's thread-pooled ``get_async``.
+
+Graph protocol (dask's documented spec, implemented natively):
+
+* a *task* is a tuple whose first element is callable: ``(add, 'x', 1)``
+* lists are traversed structurally (may contain tasks / key refs)
+* any other hashable value that is a key of the graph is a reference
+  to that key's computed value; everything else is a literal
+* non-task tuples are NOT traversed — they are either keys
+  (dask uses tuple keys like ``('x', 0)``) or literals
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List, Set
+
+import ray_tpu
+
+# Literal graph values at or above this size are put() once and shared
+# by reference instead of being re-pickled into every dependent task.
+_PUT_THRESHOLD = 64 * 1024
+
+
+def _istask(x: Any) -> bool:
+    return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+def _find_deps(value: Any, keyset: Set[Hashable], out: Set[Hashable]):
+    """Collect graph keys referenced by ``value``.
+
+    Must mirror ``_execute_value`` exactly: what the worker would
+    substitute is what the driver must wire as a dependency.
+    """
+    if _istask(value):
+        for a in value[1:]:
+            _find_deps(a, keyset, out)
+    elif isinstance(value, list):
+        for a in value:
+            _find_deps(a, keyset, out)
+    else:
+        try:
+            if value in keyset:
+                out.add(value)
+        except TypeError:
+            pass  # unhashable literal
+
+
+def _execute_value(value: Any, env: Dict[Hashable, Any]) -> Any:
+    """Evaluate one graph value on the worker: run nested task tuples
+    depth-first, rebuild lists, substitute key references from env."""
+    if _istask(value):
+        fn = value[0]
+        args = [_execute_value(a, env) for a in value[1:]]
+        return fn(*args)
+    if isinstance(value, list):
+        return [_execute_value(a, env) for a in value]
+    try:
+        if value in env:
+            return env[value]
+    except TypeError:
+        pass
+    return value
+
+
+def _dask_task(payload: Any, dep_keys: List[Hashable], *dep_values):
+    """One graph node as a remote task.  ``dep_values`` arrive as plain
+    values — the runtime resolved any ObjectRef arguments before
+    dispatch, which is exactly the readiness gate dask's local
+    scheduler implements with a thread pool."""
+    env = dict(zip(dep_keys, dep_values))
+    return _execute_value(payload, env)
+
+
+def _reject_new_task_spec(dsk: Dict[Hashable, Any]) -> None:
+    """dask >= 2024.12 replaced tuple-tasks with ``dask._task_spec``
+    node objects (Task/Alias/DataNode).  Those would pass through
+    ``_istask`` as literals and silently return unexecuted nodes, so
+    fail loudly instead.  New-spec graphs can be lowered to the tuple
+    protocol with ``dask._task_spec.convert_legacy_graph``'s inverse
+    or by pinning dask < 2024.12; this module targets the documented
+    tuple protocol, which needs no dask at all."""
+    for v in dsk.values():
+        mod = type(v).__module__
+        if mod and mod.startswith("dask._task_spec"):
+            raise NotImplementedError(
+                "this graph uses dask's new task-spec nodes "
+                f"({type(v).__name__}); ray_dask_get executes the "
+                "legacy tuple protocol — materialize the graph with "
+                "dask<2024.12 or convert it to tuple tasks first")
+
+
+def _toposort(deps: Dict[Hashable, Set[Hashable]]) -> List[Hashable]:
+    """Kahn's algorithm; raises on cycles."""
+    pending = {k: set(v) for k, v in deps.items()}
+    dependents: Dict[Hashable, List[Hashable]] = {k: [] for k in deps}
+    for k, ds in deps.items():
+        for d in ds:
+            dependents[d].append(k)
+    ready = [k for k, ds in pending.items() if not ds]
+    order: List[Hashable] = []
+    while ready:
+        k = ready.pop()
+        order.append(k)
+        for dep in dependents[k]:
+            pending[dep].discard(k)
+            if not pending[dep]:
+                ready.append(dep)
+    if len(order) != len(deps):
+        cyclic = sorted(
+            (str(k) for k, ds in pending.items() if ds))[:5]
+        raise ValueError(f"cycle in dask graph involving keys {cyclic}")
+    return order
+
+
+def _sizeof(x: Any) -> int:
+    try:
+        if hasattr(x, "nbytes"):
+            return int(x.nbytes)
+        import sys
+        return sys.getsizeof(x)
+    except Exception:
+        return 0
+
+
+def ray_dask_get(dsk: Dict[Hashable, Any], keys, **kwargs):
+    """Compute ``keys`` (a key or arbitrarily nested lists of keys)
+    from dask graph ``dsk`` on the cluster.
+
+    Pass directly to ``dask.compute(obj, scheduler=ray_dask_get)`` or
+    use on hand-built graph dicts — the graph protocol does not
+    require dask itself.
+
+    Supported kwargs (mirroring the reference's surface):
+      * ``ray_remote_args``: options applied to every graph task
+        (e.g. ``{"num_cpus": 1, "resources": {...}}``).
+      * ``ray_persist``: return ObjectRefs instead of values
+        (the reference's ``ray_persist=True`` used by ``dask.persist``).
+    Other scheduler kwargs dask passes (``num_workers``, ``pool``) are
+    accepted and ignored: submission here is a single non-blocking
+    pass, so there is no submission pool to size.
+    """
+    ray_remote_args = dict(kwargs.pop("ray_remote_args", None) or {})
+    persist = bool(kwargs.pop("ray_persist", False))
+
+    _reject_new_task_spec(dsk)
+    keyset = set(dsk)
+    deps: Dict[Hashable, Set[Hashable]] = {}
+    for k, v in dsk.items():
+        d: Set[Hashable] = set()
+        _find_deps(v, keyset, d)
+        deps[k] = d  # a self-reference stays: toposort reports it as a cycle
+
+    task = ray_tpu.remote(_dask_task)
+    if ray_remote_args:
+        task = task.options(**ray_remote_args)
+
+    refs: Dict[Hashable, Any] = {}    # key -> ObjectRef
+    cache: Dict[Hashable, Any] = {}   # key -> local literal
+    for k in _toposort(deps):
+        v = dsk[k]
+        kdeps = deps[k]
+        if not kdeps and not _istask(v) and not isinstance(v, list):
+            # Plain literal: keep local; share big ones by reference.
+            if _sizeof(v) >= _PUT_THRESHOLD:
+                refs[k] = ray_tpu.put(v)
+            else:
+                cache[k] = v
+            continue
+        is_alias = False
+        try:
+            is_alias = v in keyset
+        except TypeError:
+            pass
+        if is_alias:
+            if v in refs:
+                refs[k] = refs[v]
+            else:
+                cache[k] = cache[v]
+            continue
+        dep_keys = sorted(kdeps, key=str)
+        dep_vals = [refs[d] if d in refs else cache[d]
+                    for d in dep_keys]
+        refs[k] = task.remote(v, dep_keys, *dep_vals)
+
+    def _missing(key):
+        raise KeyError(f"requested key {key!r} not in dask graph")
+
+    if persist:
+        def _pack_ref(ks):
+            if isinstance(ks, list):
+                return [_pack_ref(x) for x in ks]
+            if ks in refs:
+                return refs[ks]
+            if ks in cache:
+                return ray_tpu.put(cache[ks])
+            _missing(ks)
+        return _pack_ref(keys)
+
+    # Gather every needed ref once (deduped), then repack.
+    needed: List[Any] = []
+    seen: Dict[Any, int] = {}
+
+    def _collect(ks):
+        if isinstance(ks, list):
+            for x in ks:
+                _collect(x)
+        elif ks in refs:
+            r = refs[ks]
+            if r not in seen:
+                seen[r] = len(needed)
+                needed.append(r)
+        elif ks not in cache:
+            _missing(ks)
+    _collect(keys)
+    values = ray_tpu.get(needed) if needed else []
+
+    def _pack(ks):
+        if isinstance(ks, list):
+            return [_pack(x) for x in ks]
+        if ks in refs:
+            return values[seen[refs[ks]]]
+        return cache[ks]
+    return _pack(keys)
+
+
+def ray_dask_get_sync(dsk, keys, **kwargs):
+    """Reference parity alias (scheduler.py:510): the reference's sync
+    variant exists to skip its submission thread pool; submission here
+    is already a single synchronous pass, so both entry points share
+    one implementation."""
+    return ray_dask_get(dsk, keys, **kwargs)
+
+
+_saved_dask_config: List[tuple] = []
+
+
+def enable_dask_on_ray(shuffle: str = "tasks") -> None:
+    """Install ``ray_dask_get`` as dask's global default scheduler
+    (reference: scheduler.py:32).  Requires dask itself."""
+    import dask
+    _saved_dask_config.append((dask.config.get("scheduler", None),
+                               dask.config.get("shuffle", None)))
+    dask.config.set(scheduler=ray_dask_get, shuffle=shuffle)
+
+
+def disable_dask_on_ray() -> None:
+    """Restore the scheduler/shuffle config active before
+    ``enable_dask_on_ray``."""
+    import dask
+    prev_sched, prev_shuffle = (_saved_dask_config.pop()
+                                if _saved_dask_config else (None, None))
+    dask.config.set(scheduler=prev_sched, shuffle=prev_shuffle)

@@ -154,3 +154,73 @@ def test_cancel_queued_and_running_tasks(ray_start_regular, tmp_path):
     # No leaked leases: a fresh full-width task still schedules (the
     # cancelled queued task's stale lease request was re-pumped away).
     assert ray_tpu.get(queued.remote(), timeout=60) == "ran"
+
+
+def test_main_module_function_in_payload_serializes_by_value():
+    """A named function defined in a driver script's __main__ and
+    embedded in a task PAYLOAD (not as the remote function itself)
+    must ship by value: plain pickle references __main__, which no
+    worker can resolve (regression: found driving the dask scheduler
+    from a `python script.py` driver)."""
+    import sys
+
+    from ray_tpu._private import serialization
+
+    def myfn(x):
+        return x + 1
+
+    main = sys.modules["__main__"]
+    orig_mod = myfn.__module__
+    myfn.__module__ = "__main__"
+    myfn.__qualname__ = "myfn"
+    setattr(main, "myfn", myfn)
+    try:
+        so, _ = serialization.serialize({"fn": myfn, "arg": 41})
+        # Simulate the worker: __main__ has no such attribute there.
+        delattr(main, "myfn")
+        out = serialization.deserialize(so.to_bytes())
+        assert out["fn"](out["arg"]) == 42
+    finally:
+        myfn.__module__ = orig_mod
+        if hasattr(main, "myfn"):
+            delattr(main, "myfn")
+
+
+def test_same_function_tasks_overlap_after_warm_lease(ray_start_regular):
+    """Two concurrent tasks of one remote function must run in
+    parallel even when a lingering warm lease exists from an earlier
+    call (regression: the lease pool counted busy leases as covering
+    the backlog, so task B waited for task A's lease — parallelism
+    depended on task duration)."""
+    import time as _time
+
+    @ray_tpu.remote
+    class Rendezvous:
+        def __init__(self):
+            self.n = 0
+
+        def arrive(self):
+            self.n += 1
+
+        def count(self):
+            return self.n
+
+    @ray_tpu.remote
+    def meet(rv):
+        if rv is None:
+            return True  # warmup call
+        ray_tpu.get(rv.arrive.remote())
+        deadline = _time.time() + 60
+        while ray_tpu.get(rv.count.remote()) < 2:
+            if _time.time() > deadline:
+                raise TimeoutError("peer never started")
+            _time.sleep(0.05)
+        return True
+
+    # Warm the lease pool FOR THIS scheduling key: the completed call
+    # leaves an idle lease that task A will grab.
+    assert ray_tpu.get(meet.remote(None), timeout=60)
+
+    rv = Rendezvous.remote()
+    assert ray_tpu.get([meet.remote(rv), meet.remote(rv)],
+                       timeout=120) == [True, True]

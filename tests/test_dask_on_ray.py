@@ -1,0 +1,197 @@
+"""Dask-on-Ray scheduler: the dask graph protocol executed as cluster
+tasks (reference: python/ray/util/dask/scheduler.py:83 ray_dask_get,
+util/dask/tests/test_dask_scheduler.py).
+
+The graph protocol is plain dicts + task tuples, so everything here
+runs without dask installed; the last test exercises real dask
+collections when the library is present.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.dask import (
+    disable_dask_on_ray,
+    enable_dask_on_ray,
+    ray_dask_get,
+    ray_dask_get_sync,
+)
+
+
+# Module-scoped on purpose (unlike conftest's per-test
+# ray_start_regular): these 13 tests are all read-only against one
+# 4-CPU cluster, and per-test init/shutdown would add minutes to the
+# fast tier on the 1-core CI host.
+@pytest.fixture(scope="module")
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _add(a, b):
+    return a + b
+
+
+def _inc(a):
+    return a + 1
+
+
+def _double(a):
+    return 2 * a
+
+
+def test_simple_graph(ray_init):
+    dsk = {"x": 1, "y": (_inc, "x"), "z": (_add, "y", 10)}
+    assert ray_dask_get(dsk, "z") == 12
+    assert ray_dask_get_sync(dsk, "z") == 12
+
+
+def test_diamond_and_nested_keys_output(ray_init):
+    dsk = {
+        "a": 2,
+        "l": (_inc, "a"),
+        "r": (_double, "a"),
+        "top": (_add, "l", "r"),
+    }
+    # keys may be nested lists (dask collections pass list-of-lists).
+    out = ray_dask_get(dsk, [["l", "r"], ["top"]])
+    assert out == [[3, 4], [7]]
+
+
+def test_nested_tasks_and_lists(ray_init):
+    # Inner task tuples execute inline on the worker; lists are
+    # traversed structurally.
+    dsk = {
+        "x": 5,
+        "y": (_add, (_inc, "x"), (_double, "x")),   # (5+1) + (2*5)
+        "s": (sum, ["x", "y", (_inc, 0)]),          # 5 + 16 + 1
+    }
+    assert ray_dask_get(dsk, "s") == 22
+
+
+def test_tuple_keys_and_alias(ray_init):
+    # dask uses tuple keys like ('chunk', 0); aliases are bare key refs.
+    dsk = {
+        ("c", 0): 10,
+        ("c", 1): (_inc, ("c", 0)),
+        "alias": ("c", 1),
+        "out": (_add, "alias", ("c", 0)),
+    }
+    assert ray_dask_get(dsk, "out") == 21
+
+
+def test_literal_string_not_matching_key_stays_literal(ray_init):
+    dsk = {"x": (str.upper, "hello")}
+    assert ray_dask_get(dsk, "x") == "HELLO"
+    # ...but a string that IS a key is a reference.
+    dsk2 = {"hello": "world", "x": (str.upper, "hello")}
+    assert ray_dask_get(dsk2, "x") == "WORLD"
+
+
+def test_persist_returns_refs(ray_init):
+    dsk = {"x": 3, "y": (_double, "x")}
+    refs = ray_dask_get(dsk, [["y", "x"]], ray_persist=True)
+    assert isinstance(refs[0][0], ray_tpu.ObjectRef)
+    assert ray_tpu.get(refs[0][0]) == 6
+    assert ray_tpu.get(refs[0][1]) == 3
+
+
+def test_error_propagates(ray_init):
+    def boom(_):
+        raise ValueError("graph task failed")
+
+    dsk = {"x": 1, "y": (boom, "x"), "z": (_inc, "y")}
+    with pytest.raises(ValueError, match="graph task failed"):
+        ray_dask_get(dsk, "z")
+
+
+def test_cycle_detected(ray_init):
+    dsk = {"a": (_inc, "b"), "b": (_inc, "a")}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get(dsk, "a")
+    # Self-cycles too (not silently stripped into a confusing error).
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (_inc, "a")}, "a")
+
+
+def test_missing_key_raises(ray_init):
+    with pytest.raises(KeyError):
+        ray_dask_get({"x": 1}, "nope")
+
+
+def test_independent_tasks_run_in_parallel(ray_init):
+    # Whole-graph submission in one pass: independent tasks must be
+    # in flight simultaneously (the reference needs a thread pool for
+    # this; here the runtime's dependency resolver provides it).
+    # Proven by rendezvous, not wall-clock: each task blocks until it
+    # sees the other arrive — a serializing scheduler would time out.
+    @ray_tpu.remote
+    class Rendezvous:
+        def __init__(self):
+            self.here = set()
+
+        def arrive(self, tag):
+            self.here.add(tag)
+
+        def count(self):
+            return len(self.here)
+
+    rv = Rendezvous.remote()
+
+    def nap(tag):
+        ray_tpu.get(rv.arrive.remote(tag))
+        deadline = time.time() + 120
+        while ray_tpu.get(rv.count.remote()) < 2:
+            if time.time() > deadline:
+                raise TimeoutError(f"{tag}: peer never started")
+            time.sleep(0.05)
+        return tag
+
+    dsk = {
+        "a": (nap, "A"),
+        "b": (nap, "B"),
+        "j": (_add, "a", "b"),
+    }
+    assert ray_dask_get(dsk, "j") == "AB"
+
+
+def test_ray_remote_args_respected(ray_init):
+    # num_cpus=4 serializes tasks on a 4-CPU node — observable via
+    # resource accounting rather than timing: both tasks still finish.
+    def whoami(x):
+        return x * 3
+
+    dsk = {"x": 2, "y": (whoami, "x")}
+    assert ray_dask_get(dsk, "y", ray_remote_args={"num_cpus": 2}) == 6
+
+
+def test_large_literal_shared_by_ref(ray_init):
+    import numpy as np
+    big = np.arange(1 << 16, dtype=np.float64)  # 512 KiB > threshold
+    dsk = {
+        "data": big,
+        "s1": (float, (np.sum, "data")),
+        "s2": (float, (np.max, "data")),
+    }
+    s1, s2 = ray_dask_get(dsk, [["s1", "s2"]])[0]
+    assert s1 == float(big.sum()) and s2 == float(big.max())
+
+
+def test_real_dask_collections_if_installed(ray_init):
+    da = pytest.importorskip("dask.array")
+    import numpy as np
+    enable_dask_on_ray()
+    try:
+        x = da.ones((100, 100), chunks=(25, 25))
+        try:
+            got = (x + x.T).sum().compute()
+        except NotImplementedError as e:
+            # dask >= 2024.12 emits new task-spec graphs, which
+            # ray_dask_get rejects loudly by design.
+            pytest.skip(str(e))
+        assert got == pytest.approx(float(np.ones((100, 100)).sum() * 2))
+    finally:
+        disable_dask_on_ray()
