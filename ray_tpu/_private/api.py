@@ -9,6 +9,7 @@ from __future__ import annotations
 import asyncio
 import atexit
 import inspect
+import os
 import threading
 
 from ray_tpu import exceptions as rexc
@@ -107,6 +108,15 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
         fut.result(60)
         cw.connected = True
         worker_mod.global_worker = cw
+        from ray_tpu._private import usage
+        try:
+            usage.on_init(
+                _head_node.session_dir if _head_node is not None else None,
+                os.path.basename(
+                    _head_node.session_dir) if _head_node is not None
+                else f"client-{os.getpid()}")
+        except Exception:
+            pass  # usage stats must never block init
         atexit.register(shutdown)
         return cw
 
@@ -166,6 +176,8 @@ def _discover_local_raylet(loop, gcs_addr):
 
 def shutdown():
     global _head_node
+    from ray_tpu._private import usage
+    usage.on_shutdown()
     with _state_lock:
         cw = worker_mod.global_worker
         if cw is not None:

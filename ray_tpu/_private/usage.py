@@ -1,0 +1,366 @@
+"""Usage statistics collection (opt-out, local-first).
+
+Reference: python/ray/_private/usage/usage_lib.py — enabledness
+resolved from env var > config file > default, library usages and
+extra tags recorded pre- or post-init (buffered, then flushed into
+the GCS KV under a usage namespace), and a periodic reporter that
+assembles a ``UsageStatsToReport`` snapshot, writes it next to the
+session logs, and optionally POSTs it.
+
+Differences by design:
+
+* The reporter NEVER touches the network unless a report URL is
+  explicitly configured (``RT_USAGE_STATS_REPORT_URL`` or an injected
+  transport) — the reference defaults to its public endpoint; here
+  the default sink is only ``<session_dir>/usage_stats.json``.
+* Collection is cheap enough to run in the driver (one KV sweep and
+  one node-table read per interval); the reference runs it on the
+  dashboard head.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from enum import Enum, auto
+from typing import Callable, Dict, List, Optional
+
+SCHEMA_VERSION = "0.1"
+USAGE_NS = "usage_stats"
+_LIB_PREFIX = b"library_usage:"
+_TAG_PREFIX = b"extra_usage_tag:"
+
+_lock = threading.Lock()
+_pre_init_libraries: set = set()
+_pre_init_tags: Dict[str, str] = {}
+_recorded_libraries: set = set()
+_reporter: Optional["UsageReporter"] = None
+
+# Injectable transport: callable(url, payload_dict) -> None; raising
+# counts the report as failed.  None + no URL => local write only.
+_transport: Optional[Callable[[str, dict], None]] = None
+
+
+class UsageStatsEnabledness(Enum):
+    ENABLED_EXPLICITLY = auto()
+    DISABLED_EXPLICITLY = auto()
+    ENABLED_BY_DEFAULT = auto()
+
+
+@dataclass
+class ClusterStatusToReport:
+    total_num_cpus: Optional[int] = None
+    total_num_tpus: Optional[int] = None
+    total_memory_gb: Optional[float] = None
+    total_num_nodes: Optional[int] = None
+
+
+@dataclass
+class UsageStatsToReport:
+    """One usage report (reference: usage_lib.py:92 UsageStatsToReport)."""
+    schema_version: str
+    source: str
+    session_id: str
+    python_version: str
+    os: str
+    collect_timestamp_ms: int
+    session_start_timestamp_ms: int
+    total_num_cpus: Optional[int] = None
+    total_num_tpus: Optional[int] = None
+    total_memory_gb: Optional[float] = None
+    total_num_nodes: Optional[int] = None
+    total_num_running_jobs: Optional[int] = None
+    library_usages: List[str] = field(default_factory=list)
+    extra_usage_tags: Dict[str, str] = field(default_factory=dict)
+    total_success: int = 0
+    total_failed: int = 0
+    seq_number: int = 0
+
+
+def _config_path() -> str:
+    return os.environ.get(
+        "RT_USAGE_STATS_CONFIG_PATH",
+        os.path.expanduser("~/.ray_tpu/usage_stats.json"))
+
+
+def usage_stats_enabledness() -> UsageStatsEnabledness:
+    """env var > config file > enabled-by-default (reference:
+    usage_lib.py:372 _usage_stats_enabledness)."""
+    env = os.environ.get("RT_USAGE_STATS_ENABLED")
+    if env == "0":
+        return UsageStatsEnabledness.DISABLED_EXPLICITLY
+    if env == "1":
+        return UsageStatsEnabledness.ENABLED_EXPLICITLY
+    if env is not None:
+        raise ValueError(
+            f"RT_USAGE_STATS_ENABLED must be 0 or 1, got {env!r}")
+    try:
+        with open(_config_path()) as f:
+            cfg = json.load(f).get("usage_stats")
+    except Exception:
+        cfg = None
+    if cfg is False:
+        return UsageStatsEnabledness.DISABLED_EXPLICITLY
+    if cfg is True:
+        return UsageStatsEnabledness.ENABLED_EXPLICITLY
+    return UsageStatsEnabledness.ENABLED_BY_DEFAULT
+
+
+def usage_stats_enabled() -> bool:
+    """Never raises: record_* call this at library import time, and a
+    telemetry env-var typo must not break `import ray_tpu.data` — an
+    unparseable value falls back to the default (the explicit `rt
+    usage status` path still surfaces the ValueError)."""
+    try:
+        enabledness = usage_stats_enabledness()
+    except ValueError:
+        enabledness = UsageStatsEnabledness.ENABLED_BY_DEFAULT
+    return enabledness is not UsageStatsEnabledness.DISABLED_EXPLICITLY
+
+
+def set_usage_stats_enabled_via_config(enabled: bool) -> None:
+    """`rt usage enable/disable` (reference: set_usage_stats_enabled_
+    via_config — writes the persistent opt-in/out)."""
+    path = _config_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    try:
+        with open(path) as f:
+            cfg = json.load(f)
+        if not isinstance(cfg, dict):
+            cfg = {}
+    except Exception:
+        cfg = {}
+    cfg["usage_stats"] = enabled
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+
+
+def _gcs():
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.global_worker
+    if w is None or not getattr(w, "connected", False):
+        return None
+    from ray_tpu._private.gcs_client import GcsClient
+    return GcsClient(w)
+
+
+def _kv():
+    gcs = _gcs()
+    return gcs.kv if gcs is not None else None
+
+
+def record_library_usage(library: str) -> None:
+    """Mark a library (tune/serve/...) as used this session; buffered
+    before init, flushed into the GCS KV afterwards (reference:
+    usage_lib.py:300)."""
+    with _lock:
+        if library in _recorded_libraries:
+            return
+        _recorded_libraries.add(library)
+    kv = None
+    if usage_stats_enabled():
+        try:
+            kv = _kv()
+        except Exception:
+            kv = None
+    if kv is None:
+        with _lock:
+            _pre_init_libraries.add(library)
+        return
+    try:
+        kv.put(USAGE_NS, _LIB_PREFIX + library.encode(), b"1")
+    except Exception:
+        pass
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    """Record a k/v usage tag (reference: usage_lib.py:266 — the
+    reference keys by a TagKey enum; a plain lower_snake string keeps
+    the seam open for any library without central registration)."""
+    key = key.lower()
+    kv = None
+    if usage_stats_enabled():
+        try:
+            kv = _kv()
+        except Exception:
+            kv = None
+    if kv is None:
+        with _lock:
+            _pre_init_tags[key] = value
+        return
+    try:
+        kv.put(USAGE_NS, _TAG_PREFIX + key.encode(), value.encode())
+    except Exception:
+        pass
+
+
+def _flush_pre_init_records() -> None:
+    with _lock:
+        libs, tags = set(_pre_init_libraries), dict(_pre_init_tags)
+        _pre_init_libraries.clear()
+        _pre_init_tags.clear()
+    kv = _kv()
+    if kv is None:
+        return
+    for lib in libs:
+        try:
+            kv.put(USAGE_NS, _LIB_PREFIX + lib.encode(), b"1")
+        except Exception:
+            pass
+    for k, v in tags.items():
+        try:
+            kv.put(USAGE_NS, _TAG_PREFIX + k.encode(), v.encode())
+        except Exception:
+            pass
+
+
+def _as_bytes(x) -> bytes:
+    return x if isinstance(x, (bytes, bytearray)) else str(x).encode()
+
+
+def generate_report(session_id: str,
+                    session_start_ms: int,
+                    counters: Dict[str, int]) -> UsageStatsToReport:
+    """Assemble one report from live cluster state."""
+    report = UsageStatsToReport(
+        schema_version=SCHEMA_VERSION,
+        source=os.environ.get("RT_USAGE_STATS_SOURCE", "OSS"),
+        session_id=session_id,
+        python_version=platform.python_version(),
+        os=platform.system().lower(),
+        collect_timestamp_ms=int(time.time() * 1000),
+        session_start_timestamp_ms=session_start_ms,
+        total_success=counters.get("success", 0),
+        total_failed=counters.get("failed", 0),
+        seq_number=counters.get("seq", 0),
+    )
+    gcs = _gcs()
+    kv = gcs.kv if gcs is not None else None
+    if kv is not None:
+        try:
+            for key in kv.keys(USAGE_NS, _LIB_PREFIX):
+                report.library_usages.append(
+                    _as_bytes(key)[len(_LIB_PREFIX):].decode())
+            for key in kv.keys(USAGE_NS, _TAG_PREFIX):
+                val = kv.get(USAGE_NS, key)
+                report.extra_usage_tags[
+                    _as_bytes(key)[len(_TAG_PREFIX):].decode()] = (
+                        _as_bytes(val).decode() if val is not None else "")
+            report.library_usages.sort()
+        except Exception:
+            pass
+    try:
+        import ray_tpu
+        res = ray_tpu.cluster_resources()
+        report.total_num_cpus = int(res.get("CPU", 0))
+        report.total_num_tpus = int(res.get("TPU", 0))
+        report.total_memory_gb = round(
+            res.get("memory", 0) / (1024 ** 3), 2)
+        nodes = gcs.nodes.get_all() if gcs is not None else []
+        report.total_num_nodes = len(
+            [n for n in nodes if n.get("alive")])
+        jobs = gcs.jobs.list() if gcs is not None else []
+        report.total_num_running_jobs = len(
+            [j for j in jobs
+             if (j.get("status") or j.get("state")) in ("RUNNING",
+                                                        "PENDING")])
+    except Exception:
+        pass
+    return report
+
+
+class UsageReporter:
+    """Periodic report loop (reference: dashboard usage_stats_head.py):
+    every interval, write ``usage_stats.json`` beside the session logs
+    and POST through the transport when one is configured."""
+
+    def __init__(self, session_dir: str, session_id: str,
+                 interval_s: Optional[float] = None):
+        self.session_dir = session_dir
+        self.session_id = session_id
+        self.interval_s = interval_s if interval_s is not None else float(
+            os.environ.get("RT_USAGE_STATS_REPORT_INTERVAL_S", "3600"))
+        self.report_url = os.environ.get("RT_USAGE_STATS_REPORT_URL", "")
+        self._start_ms = int(time.time() * 1000)
+        self._counters = {"success": 0, "failed": 0, "seq": 0}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="usage-reporter", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def report_once(self) -> UsageStatsToReport:
+        self._counters["seq"] += 1
+        report = generate_report(self.session_id, self._start_ms,
+                                 self._counters)
+        error = None
+        sent = False
+        transport = _transport or (
+            _default_transport if self.report_url else None)
+        if transport is not None:
+            try:
+                transport(self.report_url, asdict(report))
+                sent = True
+                self._counters["success"] += 1
+            except Exception as e:
+                error = repr(e)
+                self._counters["failed"] += 1
+        try:
+            path = os.path.join(self.session_dir, "usage_stats.json")
+            with open(path, "w") as f:
+                json.dump({"usage_stats": asdict(report),
+                           "success": sent or error is None,
+                           "error": error}, f, indent=2)
+        except Exception:
+            pass
+        return report
+
+    def _loop(self):
+        # First report soon after startup (reference reports at start
+        # then every interval), then steady-state cadence.
+        if self._stop.wait(min(10.0, self.interval_s)):
+            return
+        while True:
+            self.report_once()
+            if self._stop.wait(self.interval_s):
+                return
+
+
+def _default_transport(url: str, payload: dict) -> None:
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+def on_init(session_dir: Optional[str], session_id: str) -> None:
+    """Driver connected: flush buffered records; start the reporter
+    when this driver started the head and stats are enabled."""
+    global _reporter
+    if not usage_stats_enabled():
+        return
+    try:
+        _flush_pre_init_records()
+    except Exception:
+        pass
+    if session_dir and _reporter is None:
+        _reporter = UsageReporter(session_dir, session_id).start()
+
+
+def on_shutdown() -> None:
+    global _reporter
+    if _reporter is not None:
+        _reporter.stop()
+        _reporter = None
+    with _lock:
+        _recorded_libraries.clear()

@@ -20,3 +20,7 @@ from ray_tpu.data.read_api import (  # noqa: F401
 from ray_tpu.data.datasource import (  # noqa: F401
     Datasource, RangeDatasource, ReadTask, read_datasource,
 )
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("data")
+del _rlu

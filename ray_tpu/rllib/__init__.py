@@ -105,3 +105,7 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "R2D2Config", "SAC", "SACConfig", "SampleBatch", "SimpleQ",
            "SimpleQConfig", "SlateQ", "SlateQConfig", "TD3",
            "TD3Config"]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu

@@ -414,6 +414,19 @@ def cmd_dashboard(args):
             time.sleep(3600)
 
 
+def cmd_usage(args):
+    from ray_tpu._private import usage
+    if args.usage_cmd == "status":
+        mode = usage.usage_stats_enabledness().name.lower()
+        print(f"usage stats: {mode} "
+              f"(config: {usage._config_path()})")
+        return
+    enabled = args.usage_cmd == "enable"
+    usage.set_usage_stats_enabled_via_config(enabled)
+    print(f"usage stats {'enabled' if enabled else 'disabled'} "
+          f"(written to {usage._config_path()})")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="rt", description=__doc__)
     p.add_argument("--address", default=None,
@@ -496,6 +509,13 @@ def main(argv=None):
     svb.add_argument("-o", "--output", default=None)
     svsub.add_parser("status")
     svp.set_defaults(fn=cmd_serve)
+
+    usp = sub.add_parser(
+        "usage", help="usage-stats opt in/out (reference: ray "
+        "disable-usage-stats / enable-usage-stats)")
+    usp.add_argument("usage_cmd",
+                     choices=["status", "enable", "disable"])
+    usp.set_defaults(fn=cmd_usage)
 
     args = p.parse_args(argv)
     args.fn(args)

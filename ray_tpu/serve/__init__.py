@@ -33,3 +33,7 @@ __all__ = [
     "batch", "delete", "deployment", "get_deployment_handle",
     "get_proxy_address", "get_proxy_addresses", "run", "shutdown", "start", "status",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("serve")
+del _rlu

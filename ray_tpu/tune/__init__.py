@@ -52,3 +52,7 @@ def run(trainable, *, config=None, num_samples: int = 1, stop=None,
             checkpoint_config=checkpoint_config,
             failure_config=failure_config))
     return tuner.fit()
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("tune")
+del _rlu

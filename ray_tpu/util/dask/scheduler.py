@@ -51,6 +51,15 @@ def _find_deps(value: Any, keyset: Set[Hashable], out: Set[Hashable]):
             pass  # unhashable literal
 
 
+def _has_tasks(value: Any) -> bool:
+    """Does ``value`` contain any task tuple needing execution?"""
+    if _istask(value):
+        return True
+    if isinstance(value, list):
+        return any(_has_tasks(a) for a in value)
+    return False
+
+
 def _execute_value(value: Any, env: Dict[Hashable, Any]) -> Any:
     """Evaluate one graph value on the worker: run nested task tuples
     depth-first, rebuild lists, substitute key references from env."""
@@ -165,8 +174,9 @@ def ray_dask_get(dsk: Dict[Hashable, Any], keys, **kwargs):
     for k in _toposort(deps):
         v = dsk[k]
         kdeps = deps[k]
-        if not kdeps and not _istask(v) and not isinstance(v, list):
-            # Plain literal: keep local; share big ones by reference.
+        if not kdeps and not _has_tasks(v):
+            # Literal (including task-free lists): keep local; share
+            # big ones by reference.
             if _sizeof(v) >= _PUT_THRESHOLD:
                 refs[k] = ray_tpu.put(v)
             else:
@@ -251,8 +261,10 @@ def enable_dask_on_ray(shuffle: str = "tasks") -> None:
 
 def disable_dask_on_ray() -> None:
     """Restore the scheduler/shuffle config active before
-    ``enable_dask_on_ray``."""
+    ``enable_dask_on_ray``; a no-op when there is nothing to undo
+    (an unmatched disable must not wipe the user's own config)."""
+    if not _saved_dask_config:
+        return
     import dask
-    prev_sched, prev_shuffle = (_saved_dask_config.pop()
-                                if _saved_dask_config else (None, None))
+    prev_sched, prev_shuffle = _saved_dask_config.pop()
     dask.config.set(scheduler=prev_sched, shuffle=prev_shuffle)

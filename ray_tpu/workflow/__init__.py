@@ -395,3 +395,7 @@ def continuation(dag: DAGNode) -> DAGNode:
     workflow.continuation).  Returning a DAG from a workflow task already
     continues with it; this exists for API parity and readability."""
     return dag
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu

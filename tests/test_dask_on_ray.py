@@ -195,3 +195,20 @@ def test_real_dask_collections_if_installed(ray_init):
         assert got == pytest.approx(float(np.ones((100, 100)).sum() * 2))
     finally:
         disable_dask_on_ray()
+
+
+def test_task_free_list_is_a_literal(ray_init):
+    # A dep-free, task-free list must take the literal path (no remote
+    # round trip), while lists CONTAINING tasks still execute.
+    dsk = {
+        "xs": [1, 2, 3],
+        "total": (sum, "xs"),
+        "mixed": [(_inc, 10), 5],
+    }
+    out = ray_dask_get(dsk, [["xs", "total", "mixed"]])[0]
+    assert out == [[1, 2, 3], 6, [11, 5]]
+
+
+def test_unmatched_disable_is_noop_without_dask_config():
+    # No enable happened: disable must not touch (or require) dask.
+    disable_dask_on_ray()

@@ -1,0 +1,159 @@
+"""Usage stats: enabledness, recording, report assembly, reporter
+sink (reference: python/ray/tests/test_usage_stats.py over
+usage_lib.py — env var > config > default, library usages flushed
+through the KV, report written beside the session logs, POST only
+through an explicitly configured transport)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import usage
+
+
+@pytest.fixture
+def usage_config(tmp_path, monkeypatch):
+    cfg = tmp_path / "usage_stats.json"
+    monkeypatch.setenv("RT_USAGE_STATS_CONFIG_PATH", str(cfg))
+    monkeypatch.delenv("RT_USAGE_STATS_ENABLED", raising=False)
+    yield cfg
+
+
+def test_enabledness_resolution(usage_config, monkeypatch):
+    E = usage.UsageStatsEnabledness
+    # default
+    assert usage.usage_stats_enabledness() is E.ENABLED_BY_DEFAULT
+    assert usage.usage_stats_enabled()
+    # config file
+    usage.set_usage_stats_enabled_via_config(False)
+    assert usage.usage_stats_enabledness() is E.DISABLED_EXPLICITLY
+    assert not usage.usage_stats_enabled()
+    usage.set_usage_stats_enabled_via_config(True)
+    assert usage.usage_stats_enabledness() is E.ENABLED_EXPLICITLY
+    # env var beats config
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "0")
+    assert usage.usage_stats_enabledness() is E.DISABLED_EXPLICITLY
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "1")
+    assert usage.usage_stats_enabledness() is E.ENABLED_EXPLICITLY
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "2")
+    with pytest.raises(ValueError):
+        usage.usage_stats_enabledness()
+
+
+def test_cli_verbs(usage_config, capsys):
+    from ray_tpu.scripts.cli import main
+    main(["usage", "disable"])
+    assert json.load(open(usage_config))["usage_stats"] is False
+    main(["usage", "status"])
+    assert "disabled_explicitly" in capsys.readouterr().out
+    main(["usage", "enable"])
+    assert json.load(open(usage_config))["usage_stats"] is True
+
+
+def test_report_collects_libraries_tags_and_cluster_state(usage_config):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        usage.record_library_usage("tune")
+        usage.record_library_usage("serve")
+        usage.record_extra_usage_tag("GCS_STORAGE", "memory")
+        report = usage.generate_report("sess-1", 123, {"seq": 1})
+        assert "tune" in report.library_usages
+        assert "serve" in report.library_usages
+        assert report.extra_usage_tags.get("gcs_storage") == "memory"
+        assert report.total_num_cpus == 4
+        assert report.total_num_nodes == 1
+        assert report.schema_version == usage.SCHEMA_VERSION
+        assert report.session_id == "sess-1"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_pre_init_records_flush_on_init(usage_config):
+    usage._recorded_libraries.discard("workflow")
+    usage.record_library_usage("workflow")  # before init: buffered
+    assert "workflow" in usage._pre_init_libraries
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        report = usage.generate_report("s", 0, {})
+        assert "workflow" in report.library_usages
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_reporter_writes_local_file_and_injected_transport(
+        usage_config, tmp_path, monkeypatch):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    posts = []
+    monkeypatch.setattr(usage, "_transport",
+                        lambda url, payload: posts.append((url, payload)))
+    try:
+        rep = usage.UsageReporter(str(tmp_path), "sess-x",
+                                  interval_s=3600)
+        rep.report_once()
+        rep.report_once()
+        out = json.load(open(tmp_path / "usage_stats.json"))
+        assert out["success"] is True
+        stats = out["usage_stats"]
+        assert stats["session_id"] == "sess-x"
+        assert stats["seq_number"] == 2
+        # Counts successes BEFORE this report — a report is assembled
+        # before its own send outcome is known.
+        assert stats["total_success"] == 1
+        assert len(posts) == 2
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_no_transport_means_local_only(usage_config, tmp_path):
+    assert usage._transport is None
+    assert os.environ.get("RT_USAGE_STATS_REPORT_URL") in (None, "")
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        rep = usage.UsageReporter(str(tmp_path), "s", interval_s=3600)
+        rep.report_once()
+        out = json.load(open(tmp_path / "usage_stats.json"))
+        assert out["success"] is True and out["error"] is None
+        assert out["usage_stats"]["total_success"] == 0  # nothing sent
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_disabled_means_no_reporter_and_no_kv(usage_config):
+    usage.set_usage_stats_enabled_via_config(False)
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        assert usage._reporter is None
+        usage._recorded_libraries.discard("data")
+        usage.record_library_usage("data")
+        report = usage.generate_report("s", 0, {})
+        assert "data" not in report.library_usages
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_reporter_started_by_init_when_enabled(usage_config):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        assert usage._reporter is not None
+        session_dir = usage._reporter.session_dir
+        report = usage._reporter.report_once()
+        # >= 1: the reporter's own first scheduled report may also
+        # have fired on a slow host.
+        assert report.seq_number >= 1
+        assert os.path.exists(
+            os.path.join(session_dir, "usage_stats.json"))
+    finally:
+        ray_tpu.shutdown()
+    assert usage._reporter is None
+
+
+def test_bad_env_value_does_not_break_recording(usage_config,
+                                                monkeypatch):
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "true")  # typo'd value
+    usage._recorded_libraries.discard("air")
+    usage.record_library_usage("air")  # must not raise
+    assert usage.usage_stats_enabled()  # falls back to default
+    with pytest.raises(ValueError):
+        usage.usage_stats_enabledness()  # explicit path still surfaces
