@@ -161,12 +161,15 @@ def record_library_usage(library: str) -> None:
         if library in _recorded_libraries:
             return
         _recorded_libraries.add(library)
-    kv = None
-    if usage_stats_enabled():
-        try:
-            kv = _kv()
-        except Exception:
-            kv = None
+    if not usage_stats_enabled():
+        # Explicitly opted out at collection time: don't even buffer —
+        # a later enabled session must not report records the user
+        # opted out of.
+        return
+    try:
+        kv = _kv()
+    except Exception:
+        kv = None
     if kv is None:
         with _lock:
             _pre_init_libraries.add(library)
@@ -182,12 +185,12 @@ def record_extra_usage_tag(key: str, value: str) -> None:
     reference keys by a TagKey enum; a plain lower_snake string keeps
     the seam open for any library without central registration)."""
     key = key.lower()
-    kv = None
-    if usage_stats_enabled():
-        try:
-            kv = _kv()
-        except Exception:
-            kv = None
+    if not usage_stats_enabled():
+        return  # opted out at collection time: no buffering either
+    try:
+        kv = _kv()
+    except Exception:
+        kv = None
     if kv is None:
         with _lock:
             _pre_init_tags[key] = value

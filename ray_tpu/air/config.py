@@ -73,3 +73,7 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     stop: Optional[Any] = None
     verbose: int = 1
+    # tune.logger.Callback instances (JsonLoggerCallback,
+    # CSVLoggerCallback, TBXLoggerCallback, or user-defined) —
+    # reference: air.RunConfig(callbacks=[...]).
+    callbacks: Optional[list] = None

@@ -462,6 +462,99 @@ class Dataset:
             refs.extend(o._execute())
         return Dataset(refs)
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned merge of two same-length datasets (reference:
+        dataset.py zip — columns of both sides per row; a duplicated
+        column name gets a ``_1`` suffix; non-dict rows pair into
+        tuples).  One merge task per left block; only row COUNTS ride
+        the driver — right-side rows move worker-to-worker through
+        the store."""
+        refs_a, refs_b = self._execute(), other._execute()
+        rows_task = ray_tpu.remote(_block_rows)
+        counts = ray_tpu.get(
+            [rows_task.remote(b) for b in refs_a + refs_b],
+            timeout=_GET_TIMEOUT)
+        counts_a, counts_b = counts[:len(refs_a)], counts[len(refs_a):]
+        if sum(counts_a) != sum(counts_b):
+            raise ValueError(
+                f"zip requires equal row counts: {sum(counts_a)} vs "
+                f"{sum(counts_b)}")
+        b_starts = np.cumsum([0] + counts_b)
+        zip_task = ray_tpu.remote(_zip_block)
+        out, start = [], 0
+        for block_a, n in zip(refs_a, counts_a):
+            # Right-side blocks overlapping this left block's rows.
+            picked = [(int(b_starts[j]), refs_b[j])
+                      for j in range(len(refs_b))
+                      if b_starts[j] < start + n
+                      and b_starts[j + 1] > start]
+            starts = [s for s, _ in picked]
+            out.append(zip_task.remote(
+                block_a, start, starts, *[r for _, r in picked]))
+            start += n
+        return Dataset(out)
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli-sample each row with probability ``fraction``
+        (reference: dataset.py random_sample), one task per block with
+        a per-block derived seed so results are reproducible AND
+        blocks stay independent."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+        refs = self._execute()
+        task = ray_tpu.remote(_sample_block)
+        return Dataset([task.remote(b, fraction,
+                                    None if seed is None else seed + i)
+                        for i, b in enumerate(refs)])
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split at global row indices into len(indices)+1 datasets
+        (reference: dataset.py split_at_indices).  Assembly tasks
+        gather each output's row range; blocks never ride the
+        driver."""
+        if any(i < 0 for i in indices) or list(indices) != sorted(indices):
+            raise ValueError(f"indices must be sorted and non-negative: "
+                             f"{indices}")
+        refs = self._execute()
+        rows_task = ray_tpu.remote(_block_rows)
+        counts = ray_tpu.get([rows_task.remote(b) for b in refs],
+                             timeout=_GET_TIMEOUT)
+        total = sum(counts)
+        starts = np.cumsum([0] + counts)
+        bounds = [0] + [min(i, total) for i in indices] + [total]
+        gather = ray_tpu.remote(_gather_rows)
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            picked = [(int(starts[j]), refs[j]) for j in range(len(refs))
+                      if starts[j] < hi and starts[j + 1] > lo]
+            out.append(Dataset([gather.remote(
+                lo, hi - lo, [s for s, _ in picked],
+                *[r for _, r in picked])]))
+        return out
+
+    def train_test_split(self, test_size: float | int, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> tuple["Dataset", "Dataset"]:
+        """(train, test) split (reference: dataset.py
+        train_test_split): float test_size = fraction of rows, int =
+        absolute row count; shuffle=True randomizes rows first."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        if isinstance(test_size, float):
+            if not 0.0 < test_size < 1.0:
+                raise ValueError(
+                    f"float test_size must be in (0, 1): {test_size}")
+            n_test = int(total * test_size)
+        else:
+            if not 0 < test_size < total:
+                raise ValueError(
+                    f"int test_size must be in (0, {total}): {test_size}")
+            n_test = test_size
+        train, test = ds.split_at_indices([total - n_test])
+        return train, test
+
     def limit(self, n: int) -> "Dataset":
         blocks = self._blocks()
         out, left = [], n
@@ -497,7 +590,14 @@ class Dataset:
 
     # ------------------------------------------------------------ consume
     def count(self) -> int:
-        return sum(BlockAccessor(b).num_rows() for b in self._blocks())
+        """Only row COUNTS ride the driver: counting tasks run where
+        the blocks live (a driver-side sum over _blocks() would pull
+        the whole dataset into driver memory just to learn its
+        length)."""
+        refs = self._execute()
+        task = ray_tpu.remote(_block_rows)
+        return sum(ray_tpu.get([task.remote(b) for b in refs],
+                               timeout=_GET_TIMEOUT))
 
     def num_blocks(self) -> int:
         return len(self._block_refs)
@@ -643,6 +743,47 @@ class Dataset:
                 f"pending_stages={len(self._stages)})")
 
     stats = __repr__
+
+
+def _block_rows(block) -> int:
+    return BlockAccessor(block).num_rows()
+
+
+def _gather_rows(start: int, count: int, b_starts: List[int], *blocks):
+    """Assemble global rows [start, start+count) from ``blocks`` whose
+    global start offsets are ``b_starts`` (zip/split_at_indices
+    worker-side helper)."""
+    rows: List = []
+    for bs, block in zip(b_starts, blocks):
+        acc = BlockAccessor(block)
+        lo, hi = max(start, bs), min(start + count, bs + acc.num_rows())
+        if hi > lo:
+            rows.extend(
+                BlockAccessor(acc.slice(lo - bs, hi - bs)).to_pylist())
+    return rows
+
+
+def _zip_block(block_a, start: int, b_starts: List[int], *blocks_b):
+    acc_a = BlockAccessor(block_a)
+    rows_a = acc_a.to_pylist()
+    rows_b = _gather_rows(start, acc_a.num_rows(), b_starts, *blocks_b)
+    out: List = []
+    for ra, rb in zip(rows_a, rows_b):
+        if isinstance(ra, dict) and isinstance(rb, dict):
+            merged = dict(ra)
+            for k, v in rb.items():
+                merged[k if k not in merged else f"{k}_1"] = v
+            out.append(merged)
+        else:
+            out.append((ra, rb))
+    return out
+
+
+def _sample_block(block, fraction: float, seed: Optional[int]):
+    acc = BlockAccessor(block)
+    rng = np.random.default_rng(seed)
+    keep = rng.random(acc.num_rows()) < fraction
+    return [r for r, k in zip(acc.to_pylist(), keep) if k]
 
 
 def _key_values(block, key: Optional[str]) -> np.ndarray:

@@ -21,6 +21,14 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     PopulationBasedTraining,
 )
 from ray_tpu.tune import storage  # noqa: F401
+from ray_tpu.tune import logger  # noqa: F401
+from ray_tpu.tune.logger import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    LoggerCallback,
+    TBXLoggerCallback,
+)
 from ray_tpu.air import session as _session
 
 

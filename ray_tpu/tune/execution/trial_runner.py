@@ -129,6 +129,14 @@ class TrialRunner:
                             or CheckpointConfig())
         self.failure_config = (self.run_config.failure_config
                                or FailureConfig())
+        from ray_tpu.tune.logger import _dispatch as _cb_dispatch
+        self.callbacks = list(self.run_config.callbacks or [])
+        self._cb = lambda hook, *a: _cb_dispatch(self.callbacks, hook, *a)
+        for cb in self.callbacks:
+            try:
+                cb.setup(self)
+            except Exception:
+                pass
         self.pg_factory = pg_factory
         base = self.run_config.storage_path or tempfile.mkdtemp(
             prefix="rt_tune_")
@@ -337,6 +345,7 @@ class TrialRunner:
                         timeout=300)
         trial.status = RUNNING
         trial.pending_ref = None
+        self._cb("on_trial_start", trial)
 
     def _notify_trial_error(self, trial: Trial):
         """A trial died outside the normal result path: BOTH consumers
@@ -348,6 +357,10 @@ class TrialRunner:
 
     def _stop_trial(self, trial: Trial, status: str):
         trial.status = status
+        if status == TERMINATED:
+            self._cb("on_trial_complete", trial)
+        elif status == ERROR:
+            self._cb("on_trial_error", trial)
         if trial.actor is not None:
             try:
                 ray_tpu.get(trial.actor.stop.remote(), timeout=10)
@@ -490,6 +503,7 @@ class TrialRunner:
                     continue
                 self._handle_result(trial, result, result_callback)
             self._apply_exploits()
+        self._cb("on_experiment_end", self.trials)
         return self.trials
 
     def _start_restored_trials(self):
@@ -504,8 +518,10 @@ class TrialRunner:
                 self._start_trial(trial, restore=trial.checkpoint
                                   is not None)
             except Exception as e:
+                # Through _stop_trial like every other error path: it
+                # tears down the actor/PG and fires on_trial_error.
                 trial.error = e
-                trial.status = ERROR
+                self._stop_trial(trial, ERROR)
                 self._notify_trial_error(trial)
 
     def _staged(self) -> List[Trial]:
@@ -546,10 +562,12 @@ class TrialRunner:
                         > 300:
                     # Overdemand guard: the reservation cannot land even
                     # with the cluster idle — the trial is infeasible.
-                    self._stop_trial(trial, ERROR)
+                    # error BEFORE _stop_trial: on_trial_error
+                    # callbacks read it.
                     trial.error = RuntimeError(
                         f"placement group for {trial.name} cannot be "
                         f"scheduled")
+                    self._stop_trial(trial, ERROR)
                     # The searcher paired a suggestion with this trial id;
                     # it must hear the trial ended or it leaks the slot
                     # (BO searchers never learn the outcome otherwise).
@@ -563,8 +581,8 @@ class TrialRunner:
                 self._launch_trial(trial, defer_ping=True)
                 started.append(trial)
             except Exception as e:
-                self._stop_trial(trial, ERROR)
                 trial.error = e
+                self._stop_trial(trial, ERROR)
                 self._notify_trial_error(trial)
                 if self.failure_config.fail_fast:
                     raise
@@ -572,8 +590,8 @@ class TrialRunner:
             try:
                 ray_tpu.get(trial.actor.ping.remote(), timeout=120)
             except Exception as e:
-                self._stop_trial(trial, ERROR)
                 trial.error = e
+                self._stop_trial(trial, ERROR)
                 self._notify_trial_error(trial)
                 if self.failure_config.fail_fast:
                     raise
@@ -582,6 +600,7 @@ class TrialRunner:
                        result_callback: Optional[Callable]):
         # Merge so a bare final/done result doesn't erase reported metrics.
         trial.last_result = {**trial.last_result, **result}
+        self._cb("on_trial_result", trial, result)
         if result_callback is not None:
             result_callback(trial, result)
         self.search_alg.on_trial_result(trial.trial_id, result)
@@ -640,8 +659,8 @@ class TrialRunner:
 
     def _handle_failure(self, trial: Trial, err: Exception):
         trial.num_failures += 1
-        self._stop_trial(trial, ERROR)
         trial.error = err
+        self._stop_trial(trial, ERROR)
         if trial.num_failures <= self.failure_config.max_failures:
             # Restart from the last driver-held checkpoint.
             try:

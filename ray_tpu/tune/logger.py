@@ -1,0 +1,246 @@
+"""Tune experiment callbacks + per-trial loggers.
+
+Reference: python/ray/tune/callback.py (Callback lifecycle hooks
+dispatched by the trial runner) and python/ray/tune/logger/
+(LoggerCallback with log_trial_start/result/end; json.py, csv.py,
+tensorboardx.py writing result.json / progress.csv / TB event files
+into each trial's directory).
+
+Same contract, one simplification: hooks receive (trial, result)
+directly rather than the reference's (iteration, trials, trial, ...)
+tuple — the runner here is single-threaded, so callbacks can read any
+cross-trial state they need from the runner they were handed at
+setup.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import numbers
+import os
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    """Experiment-level lifecycle hooks (reference: tune/callback.py).
+
+    All hooks are optional; exceptions are caught and logged by the
+    dispatcher so a misbehaving callback cannot sink the experiment.
+    """
+
+    def setup(self, runner) -> None:
+        """Called once before the first trial starts."""
+
+    def on_trial_start(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+    def on_trial_error(self, trial) -> None:
+        pass
+
+    def on_experiment_end(self, trials: List) -> None:
+        pass
+
+
+class LoggerCallback(Callback):
+    """Per-trial logger seam (reference: tune/logger/logger.py
+    LoggerCallback): subclasses implement log_trial_* and this base
+    adapts them to the Callback lifecycle, tracking which trials are
+    open so log_trial_start runs once per trial (restarts included)."""
+
+    def __init__(self):
+        self._started: set = set()
+
+    def log_trial_start(self, trial) -> None:
+        pass
+
+    def log_trial_result(self, iteration: int, trial, result: Dict) -> None:
+        pass
+
+    def log_trial_end(self, trial, failed: bool = False) -> None:
+        pass
+
+    # --- Callback adaptation ----------------------------------------
+    def on_trial_start(self, trial) -> None:
+        if trial.trial_id not in self._started:
+            self._started.add(trial.trial_id)
+            self.log_trial_start(trial)
+
+    def on_trial_result(self, trial, result: Dict) -> None:
+        if trial.trial_id not in self._started:
+            self._started.add(trial.trial_id)
+            self.log_trial_start(trial)
+        self.log_trial_result(
+            int(result.get("training_iteration", 0)), trial, result)
+
+    def on_trial_complete(self, trial) -> None:
+        self._started.discard(trial.trial_id)
+        self.log_trial_end(trial, failed=False)
+
+    def on_trial_error(self, trial) -> None:
+        self._started.discard(trial.trial_id)
+        self.log_trial_end(trial, failed=True)
+
+
+def _json_safe(value):
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+class JsonLoggerCallback(LoggerCallback):
+    """result.json: one JSON object per reported result, plus
+    params.json with the trial config (reference: tune/logger/json.py
+    — the format `tune.ExperimentAnalysis` and the reference's own
+    resume tooling read)."""
+
+    def __init__(self):
+        super().__init__()
+        self._files: Dict[str, object] = {}
+
+    def log_trial_start(self, trial) -> None:
+        os.makedirs(trial.trial_dir, exist_ok=True)
+        with open(os.path.join(trial.trial_dir, "params.json"), "w") as f:
+            json.dump({k: _json_safe(v) for k, v in trial.config.items()},
+                      f)
+        self._files[trial.trial_id] = open(
+            os.path.join(trial.trial_dir, "result.json"), "a")
+
+    def log_trial_result(self, iteration, trial, result) -> None:
+        f = self._files.get(trial.trial_id)
+        if f is None:
+            return
+        json.dump({k: _json_safe(v) for k, v in result.items()}, f)
+        f.write("\n")
+        f.flush()
+
+    def log_trial_end(self, trial, failed=False) -> None:
+        f = self._files.pop(trial.trial_id, None)
+        if f is not None:
+            f.close()
+
+
+def _flatten(d: Dict, prefix: str = "") -> Dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+class CSVLoggerCallback(LoggerCallback):
+    """progress.csv with a header fixed at the first result
+    (reference: tune/logger/csv.py — later keys are dropped, matching
+    the reference's DictWriter extrasaction behavior)."""
+
+    def __init__(self):
+        super().__init__()
+        self._writers: Dict[str, csv.DictWriter] = {}
+        self._files: Dict[str, object] = {}
+
+    def log_trial_start(self, trial) -> None:
+        os.makedirs(trial.trial_dir, exist_ok=True)
+        self._files[trial.trial_id] = open(
+            os.path.join(trial.trial_dir, "progress.csv"), "a")
+
+    def log_trial_result(self, iteration, trial, result) -> None:
+        f = self._files.get(trial.trial_id)
+        if f is None:
+            return
+        flat = _flatten(result)
+        writer = self._writers.get(trial.trial_id)
+        if writer is None:
+            writer = csv.DictWriter(f, fieldnames=sorted(flat),
+                                    extrasaction="ignore")
+            self._writers[trial.trial_id] = writer
+            if f.tell() == 0:
+                writer.writeheader()
+        writer.writerow({k: flat.get(k) for k in writer.fieldnames})
+        f.flush()
+
+    def log_trial_end(self, trial, failed=False) -> None:
+        self._writers.pop(trial.trial_id, None)
+        f = self._files.pop(trial.trial_id, None)
+        if f is not None:
+            f.close()
+
+
+class TBXLoggerCallback(LoggerCallback):
+    """TensorBoard event files via tensorboardX (reference:
+    tune/logger/tensorboardx.py TBXLoggerCallback): numeric scalars
+    per result at step=training_iteration, trial config as hparams on
+    trial end."""
+
+    def __init__(self):
+        super().__init__()
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError as e:  # pragma: no cover - baked in here
+            raise RuntimeError(
+                "TBXLoggerCallback requires tensorboardX") from e
+        self._writer_cls = SummaryWriter
+        self._writers: Dict[str, object] = {}
+        self._last: Dict[str, Dict] = {}
+
+    def log_trial_start(self, trial) -> None:
+        os.makedirs(trial.trial_dir, exist_ok=True)
+        self._writers[trial.trial_id] = self._writer_cls(
+            logdir=trial.trial_dir, flush_secs=5)
+
+    def log_trial_result(self, iteration, trial, result) -> None:
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            return
+        step = iteration or int(result.get("training_iteration", 0))
+        for k, v in _flatten(result).items():
+            if isinstance(v, numbers.Number) and not isinstance(v, bool):
+                w.add_scalar(f"ray/tune/{k}", float(v), global_step=step)
+        self._last[trial.trial_id] = result
+        w.flush()
+
+    def log_trial_end(self, trial, failed=False) -> None:
+        w = self._writers.pop(trial.trial_id, None)
+        if w is None:
+            return
+        last = self._last.pop(trial.trial_id, {})
+        hparams = {k: v for k, v in _flatten(trial.config).items()
+                   if isinstance(v, (numbers.Number, str, bool))}
+        metrics = {f"ray/tune/{k}": float(v)
+                   for k, v in _flatten(last).items()
+                   if isinstance(v, numbers.Number)
+                   and not isinstance(v, bool)}
+        if hparams and metrics:
+            try:
+                w.add_hparams(hparams, metrics)
+            except Exception:
+                logger.debug("hparams logging failed", exc_info=True)
+        w.close()
+
+
+DEFAULT_LOGGERS = (JsonLoggerCallback, CSVLoggerCallback,
+                   TBXLoggerCallback)
+
+
+def _dispatch(callbacks: List[Callback], hook: str, *args) -> None:
+    """Run one hook across callbacks; failures are logged, never
+    raised (a logger must not sink the experiment)."""
+    for cb in callbacks or ():
+        try:
+            getattr(cb, hook)(*args)
+        except Exception:
+            logger.warning("tune callback %s.%s failed",
+                           type(cb).__name__, hook, exc_info=True)

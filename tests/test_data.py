@@ -165,3 +165,47 @@ def test_from_huggingface(ray_init):
     ds = rd.from_huggingface(hfds, parallelism=3)
     assert ds.count() == 12
     assert sorted(ds.to_pandas()["x"]) == list(range(12))
+
+
+def test_zip_merges_rows(ray_init):
+    import ray_tpu.data as rd
+    a = rd.from_items([{"x": i} for i in range(20)], parallelism=3)
+    b = rd.from_items([{"y": i * 10} for i in range(20)], parallelism=5)
+    out = a.zip(b).take_all()
+    assert out == [{"x": i, "y": i * 10} for i in range(20)]
+    # conflicting column gets _1 suffix
+    c = rd.from_items([{"x": -i} for i in range(20)], parallelism=2)
+    row0 = a.zip(c).take(1)[0]
+    assert row0 == {"x": 0, "x_1": 0}
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(rd.from_items([{"y": 1}], parallelism=1))
+
+
+def test_random_sample(ray_init):
+    import ray_tpu.data as rd
+    ds = rd.range(1000, parallelism=4)
+    got = ds.random_sample(0.2, seed=7).take_all()
+    assert 100 < len(got) < 320          # ~200 expected
+    assert got == sorted(got)            # order preserved within/between
+    # reproducible with the same seed
+    again = ds.random_sample(0.2, seed=7).take_all()
+    assert got == again
+    assert ds.random_sample(0.0).count() == 0
+    assert ds.random_sample(1.0).count() == 1000
+
+
+def test_split_at_indices_and_train_test_split(ray_init):
+    import ray_tpu.data as rd
+    ds = rd.range(30, parallelism=4)
+    parts = ds.split_at_indices([10, 25])
+    assert [p.count() for p in parts] == [10, 15, 5]
+    assert parts[1].take(3) == [10, 11, 12]
+    with pytest.raises(ValueError, match="sorted"):
+        ds.split_at_indices([25, 10])
+
+    train, test = ds.train_test_split(0.2)
+    assert train.count() == 24 and test.count() == 6
+    assert test.take_all() == list(range(24, 30))
+    train, test = ds.train_test_split(7, shuffle=True, seed=3)
+    assert train.count() == 23 and test.count() == 7
+    assert sorted(train.take_all() + test.take_all()) == list(range(30))

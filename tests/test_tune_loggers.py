@@ -1,0 +1,132 @@
+"""Tune logger callbacks: result.json / progress.csv / TB event files
+per trial + the Callback lifecycle seam (reference:
+python/ray/tune/tests/test_logger.py over tune/logger/{json,csv,
+tensorboardx}.py and tune/callback.py)."""
+
+import csv
+import glob
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _trainable(config):
+    from ray_tpu.air import session
+    for i in range(3):
+        session.report({"score": config["x"] * (i + 1), "depth": i + 1})
+
+
+class _Recorder(Callback):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, runner):
+        self.events.append(("setup", None))
+
+    def on_trial_start(self, trial):
+        self.events.append(("start", trial.trial_id))
+
+    def on_trial_result(self, trial, result):
+        self.events.append(("result", result.get("depth")))
+
+    def on_trial_complete(self, trial):
+        self.events.append(("complete", trial.trial_id))
+
+    def on_experiment_end(self, trials):
+        self.events.append(("end", len(trials)))
+
+
+class _Exploder(Callback):
+    def on_trial_result(self, trial, result):
+        raise RuntimeError("logger bug")
+
+
+def test_loggers_write_files_and_lifecycle_fires(ray_init, tmp_path):
+    pytest.importorskip("tensorboardX")
+    pytest.importorskip(
+        "tensorboard.backend.event_processing.event_accumulator")
+    rec = _Recorder()
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="exp",
+            callbacks=[JsonLoggerCallback(), CSVLoggerCallback(),
+                       TBXLoggerCallback(), rec, _Exploder()]),
+    )
+    results = tuner.fit()
+    assert len(results) == 2 and not results.errors
+
+    trial_dirs = [d for d in glob.glob(str(tmp_path / "exp" / "*"))
+                  if os.path.isdir(d)]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        # params.json + one JSON line per reported result
+        params = json.load(open(os.path.join(d, "params.json")))
+        assert params["x"] in (1.0, 2.0)
+        lines = [json.loads(ln) for ln in
+                 open(os.path.join(d, "result.json"))]
+        reported = [ln for ln in lines if "depth" in ln]
+        assert [r["depth"] for r in reported[:3]] == [1, 2, 3]
+        assert reported[-1]["score"] == pytest.approx(params["x"] * 3)
+
+        # progress.csv: header + rows
+        rows = list(csv.DictReader(open(os.path.join(d, "progress.csv"))))
+        assert len(rows) >= 3
+        assert float(rows[2]["depth"]) == 3.0
+
+        # TB event file exists and parses with real tensorboard
+        events = glob.glob(os.path.join(d, "events.out.tfevents.*"))
+        assert events, f"no event files in {d}"
+        from tensorboard.backend.event_processing.event_accumulator \
+            import EventAccumulator
+        acc = EventAccumulator(d)
+        acc.Reload()
+        tags = acc.Tags()["scalars"]
+        assert "ray/tune/score" in tags
+        scores = [e.value for e in acc.Scalars("ray/tune/score")]
+        assert len(scores) >= 3
+
+    # Lifecycle: setup once, 2 starts, >=6 results, 2 completes, 1 end —
+    # and the exploding callback didn't sink the run.
+    kinds = [k for k, _ in rec.events]
+    assert kinds[0] == "setup"
+    assert kinds.count("start") == 2
+    assert kinds.count("result") >= 6
+    assert kinds.count("complete") == 2
+    assert kinds[-1] == "end"
+
+
+def test_logger_callback_dedups_start_and_closes_on_error(tmp_path):
+    # Unit-level: LoggerCallback adapts the lifecycle without a cluster.
+    class Trial:
+        trial_id = "t1"
+        trial_dir = str(tmp_path)
+        config = {"lr": 0.1}
+
+    cb = JsonLoggerCallback()
+    cb.on_trial_result(Trial, {"a": 1})   # implicit start
+    cb.on_trial_start(Trial)              # deduped
+    cb.on_trial_result(Trial, {"a": 2})
+    cb.on_trial_error(Trial)              # closes the file
+    lines = [json.loads(ln) for ln in open(tmp_path / "result.json")]
+    assert [ln["a"] for ln in lines] == [1, 2]
+    assert cb._files == {}
