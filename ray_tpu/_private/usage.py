@@ -148,9 +148,24 @@ def _gcs():
     return GcsClient(w)
 
 
-def _kv():
-    gcs = _gcs()
-    return gcs.kv if gcs is not None else None
+def _kv_put_nowait(key: bytes, value: bytes) -> bool:
+    """Fire-and-forget KV put.  record_* may run during a module
+    import ON the CoreWorker's event-loop thread (e.g. an async
+    actor's handler importing ray_tpu.serve — the dashboard does
+    exactly this), where a synchronous `_run().result()` deadlocks
+    the loop on itself.  Telemetry needs no reply, so never wait —
+    no synchronous KV path belongs in this module."""
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.global_worker
+    if w is None or not getattr(w, "connected", False) \
+            or getattr(w, "loop", None) is None:
+        return False
+    try:
+        w._call(w._gcs_request(
+            "kv_put", {"ns": USAGE_NS, "key": key, "value": value}))
+        return True
+    except Exception:
+        return False
 
 
 def record_library_usage(library: str) -> None:
@@ -166,18 +181,9 @@ def record_library_usage(library: str) -> None:
         # a later enabled session must not report records the user
         # opted out of.
         return
-    try:
-        kv = _kv()
-    except Exception:
-        kv = None
-    if kv is None:
+    if not _kv_put_nowait(_LIB_PREFIX + library.encode(), b"1"):
         with _lock:
             _pre_init_libraries.add(library)
-        return
-    try:
-        kv.put(USAGE_NS, _LIB_PREFIX + library.encode(), b"1")
-    except Exception:
-        pass
 
 
 def record_extra_usage_tag(key: str, value: str) -> None:
@@ -187,18 +193,9 @@ def record_extra_usage_tag(key: str, value: str) -> None:
     key = key.lower()
     if not usage_stats_enabled():
         return  # opted out at collection time: no buffering either
-    try:
-        kv = _kv()
-    except Exception:
-        kv = None
-    if kv is None:
+    if not _kv_put_nowait(_TAG_PREFIX + key.encode(), value.encode()):
         with _lock:
             _pre_init_tags[key] = value
-        return
-    try:
-        kv.put(USAGE_NS, _TAG_PREFIX + key.encode(), value.encode())
-    except Exception:
-        pass
 
 
 def _flush_pre_init_records() -> None:
@@ -206,19 +203,10 @@ def _flush_pre_init_records() -> None:
         libs, tags = set(_pre_init_libraries), dict(_pre_init_tags)
         _pre_init_libraries.clear()
         _pre_init_tags.clear()
-    kv = _kv()
-    if kv is None:
-        return
     for lib in libs:
-        try:
-            kv.put(USAGE_NS, _LIB_PREFIX + lib.encode(), b"1")
-        except Exception:
-            pass
+        _kv_put_nowait(_LIB_PREFIX + lib.encode(), b"1")
     for k, v in tags.items():
-        try:
-            kv.put(USAGE_NS, _TAG_PREFIX + k.encode(), v.encode())
-        except Exception:
-            pass
+        _kv_put_nowait(_TAG_PREFIX + k.encode(), v.encode())
 
 
 def _as_bytes(x) -> bytes:

@@ -510,28 +510,38 @@ class Dataset:
 
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
         """Split at global row indices into len(indices)+1 datasets
-        (reference: dataset.py split_at_indices).  Assembly tasks
-        gather each output's row range; blocks never ride the
+        (reference: dataset.py split_at_indices).  One slice task per
+        (output, overlapping input block): splits keep the input's
+        block granularity and format, and blocks never ride the
         driver."""
         if any(i < 0 for i in indices) or list(indices) != sorted(indices):
             raise ValueError(f"indices must be sorted and non-negative: "
                              f"{indices}")
-        refs = self._execute()
-        rows_task = ray_tpu.remote(_block_rows)
-        counts = ray_tpu.get([rows_task.remote(b) for b in refs],
-                             timeout=_GET_TIMEOUT)
+        return self._split_at_indices(indices, self._row_counts())
+
+    def _split_at_indices(self, indices: List[int],
+                          counts: List[int]) -> List["Dataset"]:
+        refs = self._block_refs
         total = sum(counts)
         starts = np.cumsum([0] + counts)
         bounds = [0] + [min(i, total) for i in indices] + [total]
-        gather = ray_tpu.remote(_gather_rows)
+        slice_task = ray_tpu.remote(_slice_block)
         out = []
         for lo, hi in zip(bounds[:-1], bounds[1:]):
-            picked = [(int(starts[j]), refs[j]) for j in range(len(refs))
-                      if starts[j] < hi and starts[j + 1] > lo]
-            out.append(Dataset([gather.remote(
-                lo, hi - lo, [s for s, _ in picked],
-                *[r for _, r in picked])]))
+            blocks = []
+            for j, ref in enumerate(refs):
+                s, e = int(starts[j]), int(starts[j + 1])
+                a, b = max(lo, s), min(hi, e)
+                if b > a:
+                    blocks.append(ref if (a, b) == (s, e)
+                                  else slice_task.remote(ref, a - s, b - s))
+            out.append(Dataset(blocks or [ray_tpu.put([])]))
         return out
+
+    def _row_counts(self) -> List[int]:
+        task = ray_tpu.remote(_block_rows)
+        return ray_tpu.get([task.remote(b) for b in self._execute()],
+                           timeout=_GET_TIMEOUT)
 
     def train_test_split(self, test_size: float | int, *,
                          shuffle: bool = False,
@@ -541,7 +551,8 @@ class Dataset:
         train_test_split): float test_size = fraction of rows, int =
         absolute row count; shuffle=True randomizes rows first."""
         ds = self.random_shuffle(seed=seed) if shuffle else self
-        total = ds.count()
+        counts = ds._row_counts()  # one sweep: count + split share it
+        total = sum(counts)
         if isinstance(test_size, float):
             if not 0.0 < test_size < 1.0:
                 raise ValueError(
@@ -552,7 +563,7 @@ class Dataset:
                 raise ValueError(
                     f"int test_size must be in (0, {total}): {test_size}")
             n_test = test_size
-        train, test = ds.split_at_indices([total - n_test])
+        train, test = ds._split_at_indices([total - n_test], counts)
         return train, test
 
     def limit(self, n: int) -> "Dataset":
@@ -594,10 +605,7 @@ class Dataset:
         the blocks live (a driver-side sum over _blocks() would pull
         the whole dataset into driver memory just to learn its
         length)."""
-        refs = self._execute()
-        task = ray_tpu.remote(_block_rows)
-        return sum(ray_tpu.get([task.remote(b) for b in refs],
-                               timeout=_GET_TIMEOUT))
+        return sum(self._row_counts())
 
     def num_blocks(self) -> int:
         return len(self._block_refs)
@@ -777,6 +785,11 @@ def _zip_block(block_a, start: int, b_starts: List[int], *blocks_b):
         else:
             out.append((ra, rb))
     return out
+
+
+def _slice_block(block, start: int, stop: int):
+    """Row-range slice preserving the block's format."""
+    return BlockAccessor(block).slice(start, stop)
 
 
 def _sample_block(block, fraction: float, seed: Optional[int]):

@@ -132,11 +132,7 @@ class TrialRunner:
         from ray_tpu.tune.logger import _dispatch as _cb_dispatch
         self.callbacks = list(self.run_config.callbacks or [])
         self._cb = lambda hook, *a: _cb_dispatch(self.callbacks, hook, *a)
-        for cb in self.callbacks:
-            try:
-                cb.setup(self)
-            except Exception:
-                pass
+        self._cb_setup_done = False
         self.pg_factory = pg_factory
         base = self.run_config.storage_path or tempfile.mkdtemp(
             prefix="rt_tune_")
@@ -412,6 +408,19 @@ class TrialRunner:
 
     def run(self, result_callback: Optional[Callable] = None) -> List[Trial]:
         """Drive all trials to completion; returns the trial list."""
+        if not self._cb_setup_done:
+            # Here, not in __init__: setup() may read experiment_dir /
+            # storage / trials, which don't exist mid-construction.
+            self._cb_setup_done = True
+            self._cb("setup", self)
+        try:
+            return self._run_loop(result_callback)
+        finally:
+            # Fires on fail_fast raises too, so loggers flush/close
+            # even when the experiment aborts.
+            self._cb("on_experiment_end", self.trials)
+
+    def _run_loop(self, result_callback: Optional[Callable]) -> List[Trial]:
         stuck_since = None
         stuck_resumes = 0
         while True:
@@ -503,7 +512,6 @@ class TrialRunner:
                     continue
                 self._handle_result(trial, result, result_callback)
             self._apply_exploits()
-        self._cb("on_experiment_end", self.trials)
         return self.trials
 
     def _start_restored_trials(self):

@@ -117,6 +117,13 @@ class JsonLoggerCallback(LoggerCallback):
         self._files[trial.trial_id] = open(
             os.path.join(trial.trial_dir, "result.json"), "a")
 
+    def on_experiment_end(self, trials) -> None:
+        # Aborted experiments (fail_fast) leave running trials' files
+        # open — close everything.
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
     def log_trial_result(self, iteration, trial, result) -> None:
         f = self._files.get(trial.trial_id)
         if f is None:
@@ -154,8 +161,20 @@ class CSVLoggerCallback(LoggerCallback):
 
     def log_trial_start(self, trial) -> None:
         os.makedirs(trial.trial_dir, exist_ok=True)
-        self._files[trial.trial_id] = open(
-            os.path.join(trial.trial_dir, "progress.csv"), "a")
+        path = os.path.join(trial.trial_dir, "progress.csv")
+        # Reopening after a trial restart: rows must keep matching the
+        # file's EXISTING header, not whatever keys the first
+        # post-restart result happens to carry.
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            with open(path, newline="") as existing:
+                header = next(csv.reader(existing), None)
+            f = open(path, "a")
+            if header:
+                self._writers[trial.trial_id] = csv.DictWriter(
+                    f, fieldnames=header, extrasaction="ignore")
+        else:
+            f = open(path, "a")
+        self._files[trial.trial_id] = f
 
     def log_trial_result(self, iteration, trial, result) -> None:
         f = self._files.get(trial.trial_id)
@@ -167,8 +186,7 @@ class CSVLoggerCallback(LoggerCallback):
             writer = csv.DictWriter(f, fieldnames=sorted(flat),
                                     extrasaction="ignore")
             self._writers[trial.trial_id] = writer
-            if f.tell() == 0:
-                writer.writeheader()
+            writer.writeheader()
         writer.writerow({k: flat.get(k) for k in writer.fieldnames})
         f.flush()
 
@@ -177,6 +195,12 @@ class CSVLoggerCallback(LoggerCallback):
         f = self._files.pop(trial.trial_id, None)
         if f is not None:
             f.close()
+
+    def on_experiment_end(self, trials) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+        self._writers.clear()
 
 
 class TBXLoggerCallback(LoggerCallback):
@@ -211,6 +235,12 @@ class TBXLoggerCallback(LoggerCallback):
                 w.add_scalar(f"ray/tune/{k}", float(v), global_step=step)
         self._last[trial.trial_id] = result
         w.flush()
+
+    def on_experiment_end(self, trials) -> None:
+        for tid in list(self._writers):
+            w = self._writers.pop(tid)
+            self._last.pop(tid, None)
+            w.close()
 
     def log_trial_end(self, trial, failed=False) -> None:
         w = self._writers.pop(trial.trial_id, None)

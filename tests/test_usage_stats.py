@@ -157,3 +157,34 @@ def test_bad_env_value_does_not_break_recording(usage_config,
     assert usage.usage_stats_enabled()  # falls back to default
     with pytest.raises(ValueError):
         usage.usage_stats_enabledness()  # explicit path still surfaces
+
+
+def test_record_from_async_actor_loop_does_not_deadlock(usage_config):
+    """record_library_usage may run during a module import ON an async
+    actor's event-loop thread (the dashboard importing ray_tpu.serve
+    did).  A synchronous KV RPC there deadlocks the loop on itself —
+    recording must be fire-and-forget (regression: every dashboard
+    endpoint hung 120s once usage stats landed)."""
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        class AsyncRecorder:
+            async def record(self):
+                from ray_tpu._private import usage as wusage
+                wusage.record_library_usage("deadlock_probe")
+                return True
+
+        actor = AsyncRecorder.options(max_concurrency=4).remote()
+        assert ray_tpu.get(actor.record.remote(), timeout=60)
+
+        # ...and the record actually lands (fire-and-forget != lost).
+        import time
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            report = usage.generate_report("s", 0, {})
+            if "deadlock_probe" in report.library_usages:
+                break
+            time.sleep(0.5)
+        assert "deadlock_probe" in report.library_usages
+    finally:
+        ray_tpu.shutdown()
