@@ -217,40 +217,44 @@ class ParallelIterator:
                                 f"{self.name}.select_shards({keep})")
 
     # --- gathers -----------------------------------------------------
-    def _open_epoch(self) -> str:
-        epoch = uuid.uuid4().hex
-        ray_tpu.get([a.start_epoch.remote(epoch, list(t))
-                     for a, t in self._shards], timeout=120)
-        return epoch
+    def _open_epoch(self) -> List[Tuple[Any, str]]:
+        """Per-SHARD epoch keys: a union can list the same actor
+        twice with different transform stacks, so one shared key
+        would make the second start_epoch overwrite the first."""
+        base = uuid.uuid4().hex
+        keyed = [(a, f"{base}:{i}")
+                 for i, (a, _) in enumerate(self._shards)]
+        ray_tpu.get([a.start_epoch.remote(key, list(t))
+                     for (a, t), (_, key) in zip(self._shards, keyed)],
+                    timeout=120)
+        return keyed
 
     def gather_sync(self) -> LocalIterator:
         """Round-robin across shards in order: one chunk per shard per
         round (the reference gather_sync's deterministic interleave,
         at chunk granularity)."""
-        shards = list(self._shards)
 
         def gen():
-            epoch = self._open_epoch()
-            live = [a for a, _ in shards]
+            live = self._open_epoch()
             while live:
                 nxt = []
-                for a in live:
-                    chunk = ray_tpu.get(a.next_batch.remote(epoch))
+                for a, key in live:
+                    chunk = ray_tpu.get(a.next_batch.remote(key))
                     if isinstance(chunk, _Done):
                         continue
                     yield from chunk
-                    nxt.append(a)
+                    nxt.append((a, key))
                 live = nxt
         return LocalIterator(gen)
 
     def gather_async(self) -> LocalIterator:
         """One in-flight request per shard; yields whichever shard's
         chunk lands first (reference gather_async(num_async=1))."""
-        shards = list(self._shards)
 
         def gen():
-            epoch = self._open_epoch()
-            pending = {a.next_batch.remote(epoch): a for a, _ in shards}
+            keyed = self._open_epoch()
+            pending = {a.next_batch.remote(key): (a, key)
+                       for a, key in keyed}
             while pending:
                 ready, _ = ray_tpu.wait(list(pending), num_returns=1,
                                         timeout=60)
@@ -265,11 +269,11 @@ class ParallelIterator:
                         pass
                     continue
                 for ref in ready:
-                    actor = pending.pop(ref)
+                    a, key = pending.pop(ref)
                     chunk = ray_tpu.get(ref)
                     if isinstance(chunk, _Done):
                         continue
-                    pending[actor.next_batch.remote(epoch)] = actor
+                    pending[a.next_batch.remote(key)] = (a, key)
                     yield from chunk
         return LocalIterator(gen)
 

@@ -116,3 +116,16 @@ def test_stop_kills_shard_actors(ray_init):
     for actor, _ in it._shards:
         with pytest.raises(Exception):
             ray_tpu.get(actor.next_batch.remote("x"), timeout=30)
+
+
+def test_union_of_branches_over_same_actors(ray_init):
+    # A union may list the SAME shard actor twice with different
+    # transform stacks — per-shard epoch keys keep them apart
+    # (regression: one shared key made the second start_epoch
+    # overwrite the first, silently dropping a whole side).
+    base = from_items(list(range(10)), num_shards=2)
+    evens = base.filter(lambda x: x % 2 == 0).for_each(lambda x: -x)
+    odds = base.filter(lambda x: x % 2 == 1)
+    got = sorted(evens.union(odds).take(100))
+    assert got == sorted([-x for x in range(0, 10, 2)]
+                         + list(range(1, 10, 2))), got
