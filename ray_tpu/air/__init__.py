@@ -15,3 +15,8 @@ from ray_tpu.air.config import (  # noqa: F401
 )
 from ray_tpu.air import session  # noqa: F401
 from ray_tpu.air.result import Result  # noqa: F401
+
+# ray_tpu.air.integrations (W&B/MLflow callbacks) is an explicit
+# on-demand import, like the reference's ray.air.integrations — an
+# eager import here would pull all of ray_tpu.tune into every worker
+# that imports air.session.

@@ -1,0 +1,152 @@
+"""Experiment-tracker integrations: W&B and MLflow logger callbacks.
+
+Reference: python/ray/air/integrations/wandb.py (WandbLoggerCallback —
+one tracker run per trial, metrics on result, config as run config)
+and python/ray/air/integrations/mlflow.py (MLflowLoggerCallback —
+one mlflow run per trial, params at start, metrics per step).
+
+Both ride this repo's ``tune.logger.LoggerCallback`` seam.  The
+tracker client is INJECTABLE (``module=``): tests drive the full
+callback protocol with a fake module, and real ``wandb``/``mlflow``
+are picked up automatically when installed — the callbacks never make
+the libraries a hard dependency (same lazy posture as the
+reference's ``_import_wandb`` guards).
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Dict, Optional
+
+from ray_tpu.tune.logger import LoggerCallback, _flatten
+
+
+def _numeric_only(result: Dict) -> Dict:
+    return {k: float(v) for k, v in _flatten(result).items()
+            if isinstance(v, numbers.Number)
+            and not isinstance(v, bool)}
+
+
+class WandbLoggerCallback(LoggerCallback):
+    """One W&B run per trial (reference: integrations/wandb.py
+    WandbLoggerCallback): trial config -> run config, numeric results
+    -> ``run.log`` at training_iteration steps."""
+
+    def __init__(self, project: Optional[str] = None,
+                 group: Optional[str] = None, module=None, **init_kw):
+        super().__init__()
+        if module is None:
+            try:
+                import wandb as module  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "WandbLoggerCallback requires wandb (or pass "
+                    "module= explicitly)") from e
+        self._wandb = module
+        self._project, self._group = project, group
+        self._init_kw = init_kw
+        self._runs: Dict[str, object] = {}
+
+    def log_trial_start(self, trial) -> None:
+        kw = dict(project=self._project, group=self._group,
+                  name=trial.name, id=trial.trial_id,
+                  config=dict(trial.config), **self._init_kw)
+        try:
+            # wandb >= 0.19: multiple simultaneous runs in one
+            # process.  Plain reinit=True would FINISH the previous
+            # trial's run on each init.
+            run = self._wandb.init(reinit="create_new", **kw)
+        except (TypeError, ValueError):
+            if self._runs:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "this wandb version cannot hold concurrent runs in "
+                    "one process; starting trial %s will end the %d "
+                    "still-open run(s)", trial.trial_id, len(self._runs))
+            run = self._wandb.init(reinit=True, **kw)
+        self._runs[trial.trial_id] = run
+
+    def log_trial_result(self, iteration, trial, result) -> None:
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log(_numeric_only(result), step=iteration)
+
+    def log_trial_end(self, trial, failed: bool = False) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish(exit_code=1 if failed else 0)
+
+    def on_experiment_end(self, trials) -> None:
+        for run in self._runs.values():
+            run.finish()
+        self._runs.clear()
+
+
+class MLflowLoggerCallback(LoggerCallback):
+    """One MLflow run per trial (reference: integrations/mlflow.py
+    MLflowLoggerCallback): config -> params at start, numeric results
+    -> per-step metrics, terminal status on end.  Uses the explicit
+    ``MlflowClient`` interface (like the reference) so concurrently
+    open trial runs never fight over a fluent 'active run'."""
+
+    def __init__(self, tracking_uri: Optional[str] = None,
+                 experiment_name: Optional[str] = None, client=None):
+        super().__init__()
+        if client is None:
+            try:
+                from mlflow.tracking import MlflowClient
+            except ImportError as e:
+                raise RuntimeError(
+                    "MLflowLoggerCallback requires mlflow (or pass "
+                    "client= explicitly)") from e
+            client = MlflowClient(tracking_uri)
+        self._client = client
+        self._experiment_id = "0"
+        if experiment_name:
+            exp = self._client.get_experiment_by_name(experiment_name)
+            self._experiment_id = (
+                exp.experiment_id if exp is not None
+                else self._client.create_experiment(experiment_name))
+        self._runs: Dict[str, str] = {}  # trial_id -> run_id
+
+    def log_trial_start(self, trial) -> None:
+        run = self._client.create_run(
+            self._experiment_id, tags={"trial_name": trial.name})
+        run_id = run.info.run_id
+        self._runs[trial.trial_id] = run_id
+        for k, v in _flatten(trial.config).items():
+            self._client.log_param(run_id, k, v)
+
+    def log_trial_result(self, iteration, trial, result) -> None:
+        run_id = self._runs.get(trial.trial_id)
+        if run_id is None:
+            return
+        flat = _numeric_only(result)
+        # One request, not one per key — N metrics against a remote
+        # tracking server would otherwise cost N round-trips on the
+        # driver's run loop (reference batches for the same reason).
+        if hasattr(self._client, "log_batch"):
+            try:
+                import time
+
+                from mlflow.entities import Metric
+                ts = int(time.time() * 1000)
+                self._client.log_batch(run_id, metrics=[
+                    Metric(k, v, ts, iteration)
+                    for k, v in flat.items()])
+                return
+            except ImportError:
+                pass
+        for k, v in flat.items():
+            self._client.log_metric(run_id, k, v, step=iteration)
+
+    def log_trial_end(self, trial, failed: bool = False) -> None:
+        run_id = self._runs.pop(trial.trial_id, None)
+        if run_id is not None:
+            self._client.set_terminated(
+                run_id, status="FAILED" if failed else "FINISHED")
+
+    def on_experiment_end(self, trials) -> None:
+        for run_id in self._runs.values():
+            self._client.set_terminated(run_id, status="FINISHED")
+        self._runs.clear()

@@ -351,9 +351,12 @@ class TrialRunner:
         self.search_alg.on_trial_complete(trial.trial_id, error=True)
         self.scheduler.on_trial_complete(trial, None)
 
-    def _stop_trial(self, trial: Trial, status: str):
+    def _stop_trial(self, trial: Trial, status: str,
+                    notify_cb: bool = True):
         trial.status = status
-        if status == TERMINATED:
+        if not notify_cb:
+            pass  # caller intends to retry: loggers keep runs open
+        elif status == TERMINATED:
             self._cb("on_trial_complete", trial)
         elif status == ERROR:
             self._cb("on_trial_error", trial)
@@ -668,8 +671,13 @@ class TrialRunner:
     def _handle_failure(self, trial: Trial, err: Exception):
         trial.num_failures += 1
         trial.error = err
-        self._stop_trial(trial, ERROR)
-        if trial.num_failures <= self.failure_config.max_failures:
+        will_retry = (trial.num_failures
+                      <= self.failure_config.max_failures)
+        # A retryable failure is not a trial END: loggers must keep
+        # their tracker runs open (ending a wandb/mlflow run is
+        # permanent — the retried trial could never log again).
+        self._stop_trial(trial, ERROR, notify_cb=not will_retry)
+        if will_retry:
             # Restart from the last driver-held checkpoint.
             try:
                 self._start_trial(trial, restore=True)
@@ -677,6 +685,7 @@ class TrialRunner:
                 return  # restarted: the searcher will hear the real end
             except Exception as e:
                 trial.error = e
+                self._cb("on_trial_error", trial)  # now it IS the end
         elif self.failure_config.fail_fast:
             self.search_alg.on_trial_complete(trial.trial_id, error=True)
             self.scheduler.on_trial_complete(trial, None)

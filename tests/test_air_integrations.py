@@ -1,0 +1,182 @@
+"""W&B / MLflow logger callbacks over injectable tracker clients
+(reference: python/ray/air/tests/test_integration_wandb.py,
+test_integration_mlflow.py — both also test against mocks)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.integrations import (
+    MLflowLoggerCallback,
+    WandbLoggerCallback,
+)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class _FakeWandbRun:
+    def __init__(self, **kw):
+        self.kw = kw
+        self.logged = []
+        self.finished = None
+
+    def log(self, metrics, step=None):
+        self.logged.append((step, metrics))
+
+    def finish(self, exit_code=0):
+        self.finished = exit_code
+
+
+class _FakeWandb:
+    def __init__(self):
+        self.runs = []
+
+    def init(self, **kw):
+        run = _FakeWandbRun(**kw)
+        self.runs.append(run)
+        return run
+
+
+class _FakeMlflowRunInfo:
+    def __init__(self, run_id):
+        self.run_id = run_id
+
+
+class _FakeMlflowRun:
+    def __init__(self, run_id):
+        self.info = _FakeMlflowRunInfo(run_id)
+
+
+class _FakeMlflowClient:
+    def __init__(self):
+        self.params, self.metrics, self.status = {}, {}, {}
+        self._n = 0
+        self.experiments = {}
+
+    def get_experiment_by_name(self, name):
+        return self.experiments.get(name)
+
+    def create_experiment(self, name):
+        self.experiments[name] = type(
+            "E", (), {"experiment_id": f"exp-{name}"})()
+        return f"exp-{name}"
+
+    def create_run(self, experiment_id, tags=None):
+        self._n += 1
+        rid = f"run-{self._n}"
+        self.params[rid], self.metrics[rid] = {}, []
+        return _FakeMlflowRun(rid)
+
+    def log_param(self, run_id, k, v):
+        self.params[run_id][k] = v
+
+    def log_metric(self, run_id, k, v, step=None):
+        self.metrics[run_id].append((step, k, v))
+
+    def set_terminated(self, run_id, status):
+        self.status[run_id] = status
+
+
+def _trainable(config):
+    from ray_tpu.air import session
+    for i in range(2):
+        session.report({"score": config["x"] + i,
+                        "training_iteration": i + 1})
+
+
+def test_wandb_and_mlflow_callbacks(ray_init, tmp_path):
+    wb = _FakeWandb()
+    ml = _FakeMlflowClient()
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 5.0])},
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="exp",
+            callbacks=[
+                WandbLoggerCallback(project="p", group="g", module=wb),
+                MLflowLoggerCallback(experiment_name="e", client=ml),
+            ]))
+    results = tuner.fit()
+    assert len(results) == 2 and not results.errors
+
+    # W&B: one run per trial, config captured, metrics at steps, closed.
+    assert len(wb.runs) == 2
+    xs = sorted(r.kw["config"]["x"] for r in wb.runs)
+    assert xs == [1.0, 5.0]
+    for r in wb.runs:
+        steps = [s for s, _ in r.logged]
+        assert steps[:2] == [1, 2]
+        assert r.logged[0][1]["score"] == r.kw["config"]["x"]
+        assert r.finished == 0
+
+    # MLflow: params at start, per-step metrics, FINISHED status.
+    assert len(ml.params) == 2
+    assert sorted(float(p["x"]) for p in ml.params.values()) == [1.0, 5.0]
+    for rid, metrics in ml.metrics.items():
+        scores = [(s, v) for s, k, v in metrics if k == "score"]
+        assert len(scores) >= 2
+        assert ml.status[rid] == "FINISHED"
+    assert ml.experiments["e"].experiment_id == "exp-e"
+
+
+def test_missing_libraries_raise_clear_errors():
+    try:
+        import wandb  # noqa: F401
+        has_wandb = True
+    except ImportError:
+        has_wandb = False
+    if not has_wandb:
+        with pytest.raises(RuntimeError, match="wandb"):
+            WandbLoggerCallback(project="p")
+    try:
+        import mlflow  # noqa: F401
+        has_mlflow = True
+    except ImportError:
+        has_mlflow = False
+    if not has_mlflow:
+        with pytest.raises(RuntimeError, match="mlflow"):
+            MLflowLoggerCallback()
+
+
+def test_retryable_failure_keeps_tracker_runs_open(ray_init, tmp_path):
+    """A retried trial is not an END: ending a wandb/mlflow run is
+    permanent, so loggers must keep runs open across retries
+    (regression: on_trial_error fired before the retry decision)."""
+    marker = str(tmp_path / "failed_once")
+
+    def flaky(config):
+        import os
+        from ray_tpu.air import session
+        session.report({"score": 1.0, "training_iteration": 1})
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            raise RuntimeError("transient crash")
+        session.report({"score": 2.0, "training_iteration": 2})
+
+    from ray_tpu.air.config import FailureConfig
+    wb = _FakeWandb()
+    ml = _FakeMlflowClient()
+    results = tune.Tuner(
+        flaky, param_space={"x": 0},
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="exp",
+            failure_config=FailureConfig(max_failures=2),
+            callbacks=[WandbLoggerCallback(project="p", module=wb),
+                       MLflowLoggerCallback(client=ml)]),
+    ).fit()
+    assert not results.errors
+    # ONE wandb run, closed cleanly, with results from both attempts.
+    assert len(wb.runs) == 1
+    assert wb.runs[0].finished == 0
+    scores = [m["score"] for _, m in wb.runs[0].logged
+              if "score" in m]
+    assert 2.0 in scores
+    # ONE mlflow run, FINISHED (no spurious FAILED + duplicate).
+    assert len(ml.status) == 1
+    assert list(ml.status.values()) == ["FINISHED"]
