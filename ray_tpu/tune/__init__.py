@@ -9,7 +9,9 @@ Train's `fit()`.
 from ray_tpu.tune.tuner import (  # noqa: F401
     ResultGrid, TuneConfig, Tuner, with_resources,
 )
-from ray_tpu.tune.trainable import Trainable, wrap_function  # noqa: F401
+from ray_tpu.tune.trainable import (  # noqa: F401
+    Trainable, with_parameters, wrap_function)
+from ray_tpu.tune.analysis import ExperimentAnalysis  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator, Searcher, choice, grid_search, loguniform,
     qrandint, quniform, randint, sample_from, uniform,

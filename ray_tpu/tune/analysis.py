@@ -1,0 +1,151 @@
+"""Post-hoc experiment analysis from logged trial files.
+
+Reference: python/ray/tune/analysis/experiment_analysis.py
+(ExperimentAnalysis — reconstructs an experiment from its directory:
+per-trial params.json + result.json written by the JSON logger, best
+trial/config/logdir selection by metric/mode, pandas dataframes).
+
+Works on any experiment run with ``JsonLoggerCallback`` (and on a
+live ``ResultGrid``'s storage directory after ``fit()`` returns).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+
+class ExperimentAnalysis:
+    def __init__(self, experiment_dir: str,
+                 default_metric: Optional[str] = None,
+                 default_mode: str = "max"):
+        if not os.path.isdir(experiment_dir):
+            raise ValueError(f"no such experiment dir: {experiment_dir}")
+        self._dir = experiment_dir
+        self.default_metric = default_metric
+        if default_mode not in ("max", "min"):
+            raise ValueError(f"mode must be max|min: {default_mode}")
+        self.default_mode = default_mode
+        self._trials: Dict[str, Dict] = {}  # trial_dir -> data
+        self._load()
+
+    def _load(self):
+        for d in sorted(glob.glob(os.path.join(self._dir, "*"))):
+            result_file = os.path.join(d, "result.json")
+            if not os.path.isdir(d) or not os.path.exists(result_file):
+                continue
+            results = []
+            with open(result_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            results.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue  # torn tail line of a live run
+            params = {}
+            params_file = os.path.join(d, "params.json")
+            if os.path.exists(params_file):
+                try:
+                    params = json.load(open(params_file))
+                except json.JSONDecodeError:
+                    pass
+            self._trials[d] = {"config": params, "results": results}
+        if not self._trials:
+            raise ValueError(
+                f"{self._dir} has no trial dirs with result.json — was "
+                "the experiment run with JsonLoggerCallback?")
+
+    # --- accessors ---------------------------------------------------
+    @property
+    def trial_dirs(self) -> List[str]:
+        return list(self._trials)
+
+    def trial_dataframes(self) -> Dict[str, "object"]:
+        """trial_dir -> pandas DataFrame of its full result history."""
+        import pandas as pd
+        return {d: pd.DataFrame(t["results"])
+                for d, t in self._trials.items()}
+
+    def dataframe(self, metric: Optional[str] = None,
+                  mode: Optional[str] = None) -> "object":
+        """One row per trial: config (flattened as ``config/<k>``) +
+        its best-or-last result (reference: dataframe(metric, mode) —
+        metric=None takes the last result)."""
+        import pandas as pd
+
+        from ray_tpu.tune.logger import _flatten
+        rows = []
+        for d, t in self._trials.items():
+            row = dict(self._pick(t, metric, mode) or {})
+            for k, v in _flatten(t["config"]).items():
+                row[f"config/{k}"] = v
+            row["logdir"] = d
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    def _pick(self, trial: Dict, metric: Optional[str],
+              mode: Optional[str]) -> Optional[Dict]:
+        results = [r for r in trial["results"]]
+        if not results:
+            return None
+        if metric is None:
+            return results[-1]
+        # NaN-reporting results (diverged trials) are excluded: every
+        # comparison against NaN is False, so a NaN would otherwise
+        # win max() and best-trial selection outright.
+        scored = [r for r in results
+                  if metric in r and r[metric] == r[metric]]
+        if not scored:
+            return None
+        key = lambda r: r[metric]  # noqa: E731
+        return (max if (mode or self.default_mode) == "max"
+                else min)(scored, key=key)
+
+    def _best_trial_dir(self, metric: Optional[str],
+                        mode: Optional[str]) -> str:
+        metric = metric or self.default_metric
+        if metric is None:
+            raise ValueError(
+                "pass metric= (or set default_metric) to rank trials")
+        mode = mode or self.default_mode
+        best_d, best_v = None, None
+        for d, t in self._trials.items():
+            picked = self._pick(t, metric, mode)
+            if picked is None:
+                continue
+            v = picked[metric]
+            better = (best_v is None or
+                      (v > best_v if mode == "max" else v < best_v))
+            if better:
+                best_d, best_v = d, v
+        if best_d is None:
+            raise ValueError(f"no trial ever reported {metric!r}")
+        return best_d
+
+    def get_best_logdir(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> str:
+        return self._best_trial_dir(metric, mode)
+
+    def get_best_config(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Dict:
+        return self._trials[self._best_trial_dir(metric, mode)]["config"]
+
+    @property
+    def best_config(self) -> Dict:
+        return self.get_best_config()
+
+    @property
+    def best_logdir(self) -> str:
+        return self.get_best_logdir()
+
+    def get_best_checkpoint(self, logdir: Optional[str] = None,
+                            metric: Optional[str] = None,
+                            mode: Optional[str] = None):
+        """Latest checkpoint directory under the best (or given)
+        trial dir, if trial checkpoints were materialized to disk."""
+        d = logdir or self._best_trial_dir(metric, mode)
+        ckpts = sorted(glob.glob(os.path.join(d, "checkpoint_*")))
+        return ckpts[-1] if ckpts else None

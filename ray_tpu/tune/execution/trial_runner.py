@@ -684,8 +684,11 @@ class TrialRunner:
                 trial.error = None
                 return  # restarted: the searcher will hear the real end
             except Exception as e:
+                # The failed restart may have created a fresh PG (and
+                # actor): tear them down through _stop_trial — which
+                # also fires on_trial_error, since now it IS the end.
                 trial.error = e
-                self._cb("on_trial_error", trial)  # now it IS the end
+                self._stop_trial(trial, ERROR)
         elif self.failure_config.fail_fast:
             self.search_alg.on_trial_complete(trial.trial_id, error=True)
             self.scheduler.on_trial_complete(trial, None)

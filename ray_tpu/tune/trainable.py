@@ -176,3 +176,31 @@ def wrap_function(train_fn: Callable) -> type:
 
     _Wrapped.__name__ = getattr(train_fn, "__name__", "fn") + "_trainable"
     return _Wrapped
+
+
+def with_parameters(trainable: Callable, **kwargs):
+    """Bind large objects to a trainable WITHOUT baking them into the
+    pickled function (reference: tune/trainable/util.py
+    with_parameters): each value is put() into the object store once,
+    and every trial fetches it zero-copy instead of re-shipping it in
+    the trial spec.
+
+    >>> data = load_big_dataset()
+    >>> tuner = Tuner(with_parameters(train_fn, data=data), ...)
+    ... def train_fn(config, data): ...
+    """
+    import functools
+
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    @functools.wraps(trainable)
+    def _inner(config):
+        resolved = {k: ray_tpu.get(r, timeout=600)
+                    for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    if hasattr(trainable, "__name__"):
+        _inner.__name__ = trainable.__name__ + "_with_parameters"
+    return _inner
