@@ -12,6 +12,7 @@ from ray_tpu.tune.tuner import (  # noqa: F401
 from ray_tpu.tune.trainable import (  # noqa: F401
     Trainable, with_parameters, wrap_function)
 from ray_tpu.tune.analysis import ExperimentAnalysis  # noqa: F401
+from ray_tpu.tune.progress_reporter import CLIReporter  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator, Searcher, choice, grid_search, loguniform,
     qrandint, quniform, randint, sample_from, uniform,

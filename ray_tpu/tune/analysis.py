@@ -147,5 +147,13 @@ class ExperimentAnalysis:
         """Latest checkpoint directory under the best (or given)
         trial dir, if trial checkpoints were materialized to disk."""
         d = logdir or self._best_trial_dir(metric, mode)
-        ckpts = sorted(glob.glob(os.path.join(d, "checkpoint_*")))
+
+        def _index(path: str):
+            tail = os.path.basename(path).rsplit("_", 1)[-1]
+            # Numeric when possible: lexicographic order would rank
+            # checkpoint_9 above checkpoint_12.
+            return (0, int(tail)) if tail.isdigit() else (1, tail)
+
+        ckpts = sorted(glob.glob(os.path.join(d, "checkpoint_*")),
+                       key=_index)
         return ckpts[-1] if ckpts else None

@@ -131,6 +131,11 @@ class TrialRunner:
                                or FailureConfig())
         from ray_tpu.tune.logger import _dispatch as _cb_dispatch
         self.callbacks = list(self.run_config.callbacks or [])
+        if self.run_config.verbose >= 2:
+            from ray_tpu.tune.progress_reporter import CLIReporter
+            if not any(isinstance(cb, CLIReporter)
+                       for cb in self.callbacks):
+                self.callbacks.append(CLIReporter())
         self._cb = lambda hook, *a: _cb_dispatch(self.callbacks, hook, *a)
         self._cb_setup_done = False
         self.pg_factory = pg_factory

@@ -1,0 +1,102 @@
+"""Console progress reporting for tune runs.
+
+Reference: python/ray/tune/progress_reporter.py (CLIReporter — a
+throttled trial-status table printed on results and at experiment
+end; metric columns picked explicitly or auto-detected).
+
+Implemented as a ``tune.logger.Callback`` so it rides the same
+dispatch as every other logger; `RunConfig(verbose=2)` installs one
+automatically when the user supplied no reporter of their own.
+"""
+
+from __future__ import annotations
+
+import numbers
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.logger import Callback, _flatten
+
+_STATUS_ORDER = ("RUNNING", "PENDING", "PAUSED", "TERMINATED", "ERROR")
+_AUTO_METRIC_CAP = 4
+_SKIP_AUTO = {"training_iteration", "done", "timestamp",
+              "time_total_s", "trial_id"}
+
+
+class CLIReporter(Callback):
+    """Throttled trial-status table (reference: CLIReporter —
+    ``max_report_frequency`` seconds between tables, plus a final
+    table at experiment end)."""
+
+    def __init__(self, metric_columns: Optional[List[str]] = None,
+                 max_report_frequency: float = 5.0):
+        self._metric_columns = list(metric_columns or [])
+        self._freq = max_report_frequency
+        self._last = 0.0
+        self._runner = None
+
+    def setup(self, runner) -> None:
+        self._runner = runner
+
+    def on_trial_result(self, trial, result: Dict) -> None:
+        if not self._metric_columns:
+            # Auto-detect: first few numeric keys the experiment
+            # reports (reference auto-populates the same way).
+            for k, v in _flatten(result).items():
+                if (k not in _SKIP_AUTO
+                        and isinstance(v, numbers.Number)
+                        and not isinstance(v, bool)):
+                    self._metric_columns.append(k)
+                    if len(self._metric_columns) >= _AUTO_METRIC_CAP:
+                        break
+        now = time.monotonic()
+        if now - self._last < self._freq:
+            return
+        self._last = now
+        self._print_table()
+
+    def on_trial_complete(self, trial) -> None:
+        self._last = 0.0  # a finished trial always earns a table
+
+    def on_trial_error(self, trial) -> None:
+        self._last = 0.0  # an errored trial is equally final
+
+    def on_experiment_end(self, trials: List) -> None:
+        self._print_table(final=True)
+
+    def _print_table(self, final: bool = False) -> None:
+        trials = self._runner.trials if self._runner is not None else []
+        if not trials:
+            return
+        counts: Dict[str, int] = {}
+        for t in trials:
+            counts[t.status] = counts.get(t.status, 0) + 1
+        status_line = " | ".join(
+            f"{s}: {counts[s]}" for s in _STATUS_ORDER if s in counts)
+        cols = ["trial", "status", "iter"] + self._metric_columns
+        rows = [cols]
+        for t in trials:
+            flat = _flatten(t.last_result or {})
+            rows.append(
+                [t.name, t.status,
+                 str(flat.get("training_iteration", ""))]
+                + [_fmt(flat.get(m)) for m in self._metric_columns])
+        widths = [max(len(r[i]) for r in rows)
+                  for i in range(len(cols))]
+        sep = "+".join("-" * (w + 2) for w in widths)
+        lines = [("== trial progress (final) =="
+                  if final else "== trial progress =="),
+                 status_line, sep]
+        for r in rows:
+            lines.append(" | ".join(v.ljust(w)
+                                    for v, w in zip(r, widths)))
+        lines.append(sep)
+        print("\n".join(lines))
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return f"{v:.5g}"
+    return str(v)

@@ -193,6 +193,12 @@ def with_parameters(trainable: Callable, **kwargs):
 
     import ray_tpu
 
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        raise TypeError(
+            "with_parameters supports function trainables; for a class "
+            "Trainable, put() the objects yourself and pass the refs "
+            "through config (a wrapped class would hide the "
+            "Trainable lifecycle the runner drives)")
     refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
 
     @functools.wraps(trainable)

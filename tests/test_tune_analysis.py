@@ -116,3 +116,27 @@ def test_dataframe_flattens_nested_config(tmp_path):
     df = ExperimentAnalysis(str(tmp_path / "exp")).dataframe()
     assert df["config/model/lr"][0] == 0.1
     assert df["config/model/depth"][0] == 3
+
+
+def test_checkpoint_sort_is_numeric(tmp_path):
+    import json
+    import os
+    d = tmp_path / "exp" / "t1"
+    os.makedirs(d)
+    with open(d / "result.json", "w") as f:
+        f.write(json.dumps({"score": 1.0}) + "\n")
+    for i in (1, 9, 12):
+        os.makedirs(d / f"checkpoint_{i}")
+    ea = ExperimentAnalysis(str(tmp_path / "exp"))
+    best = ea.get_best_checkpoint(logdir=str(d))
+    assert best.endswith("checkpoint_12")
+
+
+def test_with_parameters_rejects_class_trainables():
+    from ray_tpu.tune.trainable import Trainable
+
+    class MyTrainable(Trainable):
+        pass
+
+    with pytest.raises(TypeError, match="function trainables"):
+        with_parameters(MyTrainable, data=[1])

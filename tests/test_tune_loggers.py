@@ -130,3 +130,35 @@ def test_logger_callback_dedups_start_and_closes_on_error(tmp_path):
     lines = [json.loads(ln) for ln in open(tmp_path / "result.json")]
     assert [ln["a"] for ln in lines] == [1, 2]
     assert cb._files == {}
+
+
+def test_cli_reporter_prints_tables(ray_init, tmp_path, capsys):
+    from ray_tpu.tune import CLIReporter
+
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="exp",
+            callbacks=[CLIReporter(metric_columns=["score"],
+                                   max_report_frequency=0.0)]),
+    )
+    results = tuner.fit()
+    assert not results.errors
+    out = capsys.readouterr().out
+    assert "== trial progress ==" in out
+    assert "== trial progress (final) ==" in out
+    # Final table shows all trials terminated with their last score.
+    final = out.rsplit("(final)", 1)[1]
+    assert "TERMINATED: 2" in final
+    assert "score" in final
+
+
+def test_verbose_2_installs_reporter_automatically(ray_init, tmp_path,
+                                                   capsys):
+    tuner = tune.Tuner(
+        _trainable, param_space={"x": 1.0},
+        run_config=RunConfig(storage_path=str(tmp_path), name="v2",
+                             verbose=2))
+    tuner.fit()
+    assert "== trial progress (final) ==" in capsys.readouterr().out
