@@ -1,4 +1,5 @@
-"""Experiment-tracker integrations: W&B and MLflow logger callbacks.
+"""Experiment-tracker integrations: W&B, Comet, and MLflow logger
+callbacks.
 
 Reference: python/ray/air/integrations/wandb.py (WandbLoggerCallback —
 one tracker run per trial, metrics on result, config as run config)
@@ -80,6 +81,50 @@ class WandbLoggerCallback(LoggerCallback):
         for run in self._runs.values():
             run.finish()
         self._runs.clear()
+
+
+class CometLoggerCallback(LoggerCallback):
+    """One Comet experiment per trial (reference:
+    air/integrations/comet.py CometLoggerCallback): trial config ->
+    logged parameters, numeric results -> per-step metrics."""
+
+    def __init__(self, project_name: Optional[str] = None,
+                 workspace: Optional[str] = None, module=None, **kw):
+        super().__init__()
+        if module is None:
+            try:
+                import comet_ml as module  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "CometLoggerCallback requires comet_ml (or pass "
+                    "module= explicitly)") from e
+        self._comet = module
+        self._project, self._workspace = project_name, workspace
+        self._kw = kw
+        self._experiments: Dict[str, object] = {}
+
+    def log_trial_start(self, trial) -> None:
+        exp = self._comet.Experiment(
+            project_name=self._project, workspace=self._workspace,
+            **self._kw)
+        exp.set_name(trial.name)
+        exp.log_parameters(_flatten(trial.config))
+        self._experiments[trial.trial_id] = exp
+
+    def log_trial_result(self, iteration, trial, result) -> None:
+        exp = self._experiments.get(trial.trial_id)
+        if exp is not None:
+            exp.log_metrics(_numeric_only(result), step=iteration)
+
+    def log_trial_end(self, trial, failed: bool = False) -> None:
+        exp = self._experiments.pop(trial.trial_id, None)
+        if exp is not None:
+            exp.end()
+
+    def on_experiment_end(self, trials) -> None:
+        for exp in self._experiments.values():
+            exp.end()
+        self._experiments.clear()
 
 
 class MLflowLoggerCallback(LoggerCallback):

@@ -5,8 +5,10 @@ throttled trial-status table printed on results and at experiment
 end; metric columns picked explicitly or auto-detected).
 
 Implemented as a ``tune.logger.Callback`` so it rides the same
-dispatch as every other logger; `RunConfig(verbose=2)` installs one
-automatically when the user supplied no reporter of their own.
+dispatch as every other logger; ``RunConfig(verbose=2)`` appends one
+automatically unless the callbacks already include a CLIReporter
+(a custom non-CLIReporter progress callback does NOT suppress the
+auto-install — pass verbose<=1 to silence the built-in table).
 """
 
 from __future__ import annotations

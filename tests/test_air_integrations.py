@@ -180,3 +180,53 @@ def test_retryable_failure_keeps_tracker_runs_open(ray_init, tmp_path):
     # ONE mlflow run, FINISHED (no spurious FAILED + duplicate).
     assert len(ml.status) == 1
     assert list(ml.status.values()) == ["FINISHED"]
+
+
+class _FakeCometExperiment:
+    def __init__(self, **kw):
+        self.kw, self.name = kw, None
+        self.params, self.metrics, self.ended = {}, [], False
+
+    def set_name(self, name):
+        self.name = name
+
+    def log_parameters(self, params):
+        self.params.update(params)
+
+    def log_metrics(self, metrics, step=None):
+        self.metrics.append((step, metrics))
+
+    def end(self):
+        self.ended = True
+
+
+class _FakeComet:
+    def __init__(self):
+        self.experiments = []
+
+    def Experiment(self, **kw):
+        e = _FakeCometExperiment(**kw)
+        self.experiments.append(e)
+        return e
+
+
+def test_comet_callback(ray_init, tmp_path):
+    from ray_tpu.air.integrations import CometLoggerCallback
+
+    cm = _FakeComet()
+    results = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 4.0])},
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="exp",
+            callbacks=[CometLoggerCallback(project_name="p",
+                                           module=cm)]),
+    ).fit()
+    assert not results.errors
+    assert len(cm.experiments) == 2
+    xs = sorted(e.params["x"] for e in cm.experiments)
+    assert xs == [1.0, 4.0]
+    for e in cm.experiments:
+        assert e.kw["project_name"] == "p"
+        assert e.ended
+        assert any("score" in m for _, m in e.metrics)
