@@ -48,9 +48,27 @@ def get_checkpoint():
 def run(trainable, *, config=None, num_samples: int = 1, stop=None,
         metric=None, mode: str = "max", search_alg=None, scheduler=None,
         max_concurrent_trials: int = 0, storage_path=None, name=None,
-        checkpoint_config=None, failure_config=None):
-    """Functional entry point (reference: tune/tune.py:129 tune.run)."""
+        checkpoint_config=None, failure_config=None, callbacks=None,
+        verbose: int = 1, resources_per_trial=None, **_legacy):
+    """Functional entry point (reference: tune/tune.py:129 tune.run).
+
+    Unknown legacy kwargs are accepted WITH A WARNING so reference
+    scripts run unmodified where semantics allow; kwargs whose
+    silent omission would change results (resume/restore) are
+    rejected with a pointer to the supported API."""
     from ray_tpu.air.config import RunConfig
+    if _legacy.pop("resume", None) or _legacy.pop("restore", None):
+        raise TypeError(
+            "tune.run(resume=...) is not supported here — use "
+            "Tuner.restore(path, trainable).fit() to continue an "
+            "interrupted experiment")
+    if _legacy:
+        import logging
+        logging.getLogger(__name__).warning(
+            "tune.run: ignoring unsupported legacy kwargs %s",
+            sorted(_legacy))
+    if resources_per_trial:
+        trainable = with_resources(trainable, resources_per_trial)
     tuner = Tuner(
         trainable,
         param_space=config,
@@ -61,7 +79,8 @@ def run(trainable, *, config=None, num_samples: int = 1, stop=None,
         run_config=RunConfig(
             name=name, storage_path=storage_path, stop=stop,
             checkpoint_config=checkpoint_config,
-            failure_config=failure_config))
+            failure_config=failure_config, callbacks=callbacks,
+            verbose=verbose))
     return tuner.fit()
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -122,12 +122,24 @@ class Tuner:
 
 def with_resources(trainable, resources) -> Any:
     """Attach trial resources (reference: tune/trainable/util.py
-    with_resources): dict {"CPU": n} or a PlacementGroupFactory."""
+    with_resources): dict {"CPU": n} or a PlacementGroupFactory.
+
+    Returns a WRAPPED trainable — the caller's object is never
+    mutated, so an earlier with_resources cannot leak its placement
+    factory into later unrelated runs of the same function/class."""
+    import functools
+
     from ray_tpu.tune.execution.placement_groups import (
         PlacementGroupFactory, resource_dict_to_pg_factory)
     if isinstance(resources, PlacementGroupFactory):
         pgf = resources
     else:
         pgf = resource_dict_to_pg_factory(resources)
-    trainable._pg_factory = pgf
-    return trainable
+    if isinstance(trainable, type):
+        wrapped = type(trainable.__name__, (trainable,), {})
+    else:
+        @functools.wraps(trainable)
+        def wrapped(*a, **kw):
+            return trainable(*a, **kw)
+    wrapped._pg_factory = pgf
+    return wrapped

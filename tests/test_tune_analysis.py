@@ -140,3 +140,35 @@ def test_with_parameters_rejects_class_trainables():
 
     with pytest.raises(TypeError, match="function trainables"):
         with_parameters(MyTrainable, data=[1])
+
+
+def test_tune_run_classic_entry_point(ray_init, tmp_path):
+    got = tune.run(
+        _trainable,
+        config={"x": tune.grid_search([1.0, 2.0])},
+        metric="score", mode="max",
+        storage_path=str(tmp_path), name="classic",
+        checkpoint_freq=0,  # legacy kwarg: accepted, ignored
+    )
+    assert len(got) == 2 and not got.errors
+    best = got.get_best_result()
+    assert best.config == {"x": 2.0}
+    assert best.metrics["score"] == 6.0
+
+
+def test_with_resources_does_not_mutate_caller(ray_init, tmp_path):
+    def fn(config):
+        from ray_tpu.air import session
+        session.report({"v": 1.0, "training_iteration": 1})
+
+    wrapped = tune.with_resources(fn, {"CPU": 2})
+    assert getattr(fn, "_pg_factory", None) is None  # caller untouched
+    assert wrapped._pg_factory is not None
+    res = tune.run(fn, config={"x": 1},
+                   storage_path=str(tmp_path), name="clean")
+    assert not res.errors
+
+
+def test_tune_run_rejects_resume_kwarg():
+    with pytest.raises(TypeError, match="Tuner.restore"):
+        tune.run(lambda c: None, resume=True)
