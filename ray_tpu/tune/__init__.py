@@ -56,17 +56,36 @@ def run(trainable, *, config=None, num_samples: int = 1, stop=None,
     scripts run unmodified where semantics allow; kwargs whose
     silent omission would change results (resume/restore) are
     rejected with a pointer to the supported API."""
-    from ray_tpu.air.config import RunConfig
-    if _legacy.pop("resume", None) or _legacy.pop("restore", None):
-        raise TypeError(
-            "tune.run(resume=...) is not supported here — use "
-            "Tuner.restore(path, trainable).fit() to continue an "
-            "interrupted experiment")
+    from ray_tpu.air.config import CheckpointConfig, RunConfig
+    for kw in ("resume", "restore"):
+        if _legacy.pop(kw, None):
+            raise TypeError(
+                f"tune.run({kw}=...) is not supported here — use "
+                "Tuner.restore(path, trainable).fit() to continue an "
+                "interrupted experiment")
+    # Legacy checkpoint kwargs map one-to-one onto CheckpointConfig;
+    # dropping them would silently change results (no checkpoints ->
+    # nothing to restore).
+    freq = _legacy.pop("checkpoint_freq", None)
+    at_end = _legacy.pop("checkpoint_at_end", None)
+    keep = _legacy.pop("keep_checkpoints_num", None)
+    if (freq or at_end or keep) and checkpoint_config is None:
+        checkpoint_config = CheckpointConfig(
+            checkpoint_frequency=freq or 0,
+            checkpoint_at_end=bool(at_end),
+            num_to_keep=keep)
     if _legacy:
         import logging
         logging.getLogger(__name__).warning(
             "tune.run: ignoring unsupported legacy kwargs %s",
             sorted(_legacy))
+    if resources_per_trial and isinstance(resources_per_trial, dict):
+        # Legacy lowercase keys ('cpu'/'gpu') would become custom
+        # resources no node advertises; gpu maps to this framework's
+        # accelerator (same aliasing as init(num_gpus=...)).
+        _alias = {"cpu": "CPU", "gpu": "TPU", "GPU": "TPU"}
+        resources_per_trial = {
+            _alias.get(k, k): v for k, v in resources_per_trial.items()}
     if resources_per_trial:
         trainable = with_resources(trainable, resources_per_trial)
     tuner = Tuner(

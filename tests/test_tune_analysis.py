@@ -172,3 +172,22 @@ def test_with_resources_does_not_mutate_caller(ray_init, tmp_path):
 def test_tune_run_rejects_resume_kwarg():
     with pytest.raises(TypeError, match="Tuner.restore"):
         tune.run(lambda c: None, resume=True)
+
+
+def test_tune_run_legacy_checkpoint_and_resource_kwargs(ray_init,
+                                                        tmp_path):
+    def fn(config):
+        from ray_tpu.air import session
+        for i in range(2):
+            session.report({"v": float(i), "training_iteration": i + 1})
+
+    res = tune.run(
+        fn, config={"x": 1}, storage_path=str(tmp_path), name="legacy",
+        resources_per_trial={"cpu": 1, "gpu": 0},  # lowercase legacy
+        checkpoint_freq=1, checkpoint_at_end=True,
+    )
+    assert not res.errors
+    assert res[0].checkpoint is not None  # freq mapped, not dropped
+
+    with pytest.raises(TypeError, match="restore"):
+        tune.run(fn, restore="/ckpt")
