@@ -20,6 +20,12 @@ from ray_tpu.data.read_api import (  # noqa: F401
 from ray_tpu.data.datasource import (  # noqa: F401
     Datasource, RangeDatasource, ReadTask, read_datasource,
 )
+from ray_tpu.data.aggregate import (  # noqa: F401
+    AbsMax, AggregateFn, Count, Max, Mean, Min, Std, Sum,
+)
+from ray_tpu.data.random_access_dataset import (  # noqa: F401
+    RandomAccessDataset,
+)
 
 from ray_tpu._private.usage import record_library_usage as _rlu
 _rlu("data")

@@ -113,12 +113,16 @@ def _push_shuffle(refs: List, partition_fn: Callable, n_out: int) -> List:
 
 class Dataset:
     def __init__(self, block_refs: List, stages: Optional[List] = None,
-                 stats: Optional[List] = None):
+                 stats: Optional[List] = None,
+                 input_files: Optional[List[str]] = None):
         self._block_refs = list(block_refs)
         self._stages = list(stages or [])
         # Per-stage execution records (reference: data/_internal/stats.py
         # DatasetStats): [{"stage", "blocks", "wall_s"}].
         self._stats = list(stats or [])
+        # Source files, when created by a file reader (reference:
+        # Dataset.input_files over the lazy block list's read tasks).
+        self._input_files = list(input_files or [])
 
     # ---------------------------------------------------------------- plan
     def _with_stage(self, fn: Callable, compute=None, fn_args=(),
@@ -126,7 +130,8 @@ class Dataset:
         return Dataset(self._block_refs,
                        self._stages + [(fn, compute, fn_args,
                                         fn_kwargs or {})],
-                       stats=self._stats)
+                       stats=self._stats,
+                       input_files=self._input_files)
 
     @staticmethod
     def _fuse(stages):
@@ -700,20 +705,224 @@ class Dataset:
             vals.append(np.asarray(arr))
         return np.concatenate(vals) if vals else np.array([])
 
+    def _aggregate_values(self, aggs) -> List:
+        """Distributed accumulate: one task per block folds ALL aggs at
+        once where the block lives; only accumulators ride back to the
+        driver for the merge + finalize (reference: Dataset.aggregate ->
+        _GroupbyOp with an empty key)."""
+        refs = self._execute()
+        task = ray_tpu.remote(_accumulate_aggs)
+        per_block = ray_tpu.get([task.remote(b, aggs) for b in refs],
+                                timeout=_GET_TIMEOUT)
+        out = []
+        for j, agg in enumerate(aggs):
+            acc = agg.init(None)
+            for row in per_block:
+                acc = agg.merge(acc, row[j])
+            out.append(agg.finalize(acc))
+        return out
+
+    def aggregate(self, *aggs):
+        """Apply one or more AggregateFns over the whole dataset
+        (reference: dataset.py:1341).  Returns {name: value}."""
+        if not aggs:
+            raise ValueError("aggregate() needs at least one AggregateFn")
+        vals = self._aggregate_values(aggs)
+        return {agg.name: v for agg, v in zip(aggs, vals)}
+
     def sum(self, on: Optional[str] = None):
-        return self._column(on).sum()
+        from ray_tpu.data.aggregate import Sum
+        return self._aggregate_values([Sum(on)])[0]
 
     def min(self, on: Optional[str] = None):
-        return self._column(on).min()
+        from ray_tpu.data.aggregate import Min
+        return self._aggregate_values([Min(on)])[0]
 
     def max(self, on: Optional[str] = None):
-        return self._column(on).max()
+        from ray_tpu.data.aggregate import Max
+        return self._aggregate_values([Max(on)])[0]
 
     def mean(self, on: Optional[str] = None):
-        return self._column(on).mean()
+        from ray_tpu.data.aggregate import Mean
+        return self._aggregate_values([Mean(on)])[0]
 
-    def std(self, on: Optional[str] = None):
-        return float(self._column(on).std(ddof=1))
+    def std(self, on: Optional[str] = None, ddof: int = 1):
+        from ray_tpu.data.aggregate import Std
+        return self._aggregate_values([Std(on, ddof=ddof)])[0]
+
+    # ----------------------------------------------------- blocks / export
+    def get_internal_block_refs(self) -> List:
+        """Materialize pending stages and return the block ObjectRefs
+        (reference: Dataset.get_internal_block_refs)."""
+        return list(self._execute())
+
+    def size_bytes(self) -> int:
+        """Total materialized byte size, computed where the blocks
+        live (reference: Dataset.size_bytes over BlockMetadata)."""
+        def _size(block):
+            return BlockAccessor(block).size_bytes()
+        task = ray_tpu.remote(_size)
+        return sum(ray_tpu.get([task.remote(b) for b in self._execute()],
+                               timeout=_GET_TIMEOUT))
+
+    def input_files(self) -> List[str]:
+        """Source files for file-reader datasets (reference:
+        Dataset.input_files)."""
+        return list(self._input_files)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        """Shuffle BLOCK order without touching rows (reference:
+        dataset.py:773).  Pure metadata: per-block stages commute with
+        block order, so pending stages are carried over unchanged."""
+        refs = list(self._block_refs)
+        random.Random(seed).shuffle(refs)
+        return Dataset(refs, self._stages, stats=self._stats,
+                       input_files=self._input_files)
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["Dataset"]:
+        """Split by fractions; the final split takes the remainder
+        (reference: dataset.py:1110)."""
+        if not proportions or any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive")
+        if builtins.sum(proportions) >= 1.0:
+            raise ValueError("proportions must sum to < 1")
+        total = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(total * acc))
+        return self.split_at_indices(indices)
+
+    def to_numpy_refs(self, *, column: Optional[str] = None) -> List:
+        """One ObjectRef per block holding its numpy conversion
+        (reference: Dataset.to_numpy_refs)."""
+        def _np(block):
+            return BlockAccessor(block).to_numpy(column)
+        task = ray_tpu.remote(_np)
+        return [task.remote(b) for b in self._execute()]
+
+    def to_pandas_refs(self) -> List:
+        def _pd(block):
+            return BlockAccessor(block).to_pandas()
+        task = ray_tpu.remote(_pd)
+        return [task.remote(b) for b in self._execute()]
+
+    def to_arrow_refs(self) -> List:
+        def _arrow(block):
+            return BlockAccessor(block).to_arrow()
+        task = ray_tpu.remote(_arrow)
+        return [task.remote(b) for b in self._execute()]
+
+    def to_torch(self, *, label_column: Optional[str] = None,
+                 feature_columns: Optional[List[str]] = None,
+                 batch_size: int = 256):
+        """A torch IterableDataset of (features, label) tensor batches
+        (reference: Dataset.to_torch).  Streams through iter_batches —
+        no full materialization on the consumer."""
+        import torch
+
+        ds = self
+
+        class _TorchIterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for batch in ds.iter_batches(batch_size=batch_size,
+                                             batch_format="numpy"):
+                    if not isinstance(batch, dict):
+                        yield torch.as_tensor(batch)
+                        continue
+                    label = (torch.as_tensor(batch[label_column])
+                             if label_column else None)
+                    cols = feature_columns or [
+                        c for c in batch if c != label_column]
+                    feats = torch.stack(
+                        [torch.as_tensor(np.asarray(batch[c],
+                                                    dtype=np.float32))
+                         for c in cols], dim=1)
+                    yield (feats, label) if label is not None else feats
+
+        return _TorchIterable()
+
+    def iter_tf_batches(self, *, batch_size: int = 256):
+        """Tensorflow batches (gated: tf is not in this image; the
+        conversion itself is generic numpy->tf.constant)."""
+        try:
+            import tensorflow as tf  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "tensorflow is not installed in this environment; "
+                "iter_tf_batches requires it") from e
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            yield ({k: tf.constant(v) for k, v in batch.items()}
+                   if isinstance(batch, dict) else tf.constant(batch))
+
+    def to_tf(self, *, label_column: Optional[str] = None,
+              feature_columns: Optional[List[str]] = None,
+              batch_size: int = 256):
+        """A tf.data.Dataset over this dataset (gated on tf presence,
+        reference: Dataset.to_tf)."""
+        try:
+            import tensorflow as tf
+        except ImportError as e:
+            raise ImportError(
+                "tensorflow is not installed in this environment; "
+                "to_tf requires it") from e
+        first = next(self.iter_batches(batch_size=2,
+                                       batch_format="numpy"), None)
+        if not isinstance(first, dict):
+            raise ValueError("to_tf requires a tabular dataset")
+        cols = feature_columns or [c for c in first
+                                   if c != label_column]
+
+        def _gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy"):
+                feats = np.stack([np.asarray(batch[c], dtype=np.float32)
+                                  for c in cols], axis=1)
+                if label_column:
+                    yield feats, np.asarray(batch[label_column])
+                else:
+                    yield feats
+
+        spec = tf.TensorSpec(shape=(None, len(cols)), dtype=tf.float32)
+        if label_column:
+            sig = (spec, tf.TensorSpec(shape=(None,), dtype=tf.as_dtype(
+                np.asarray(first[label_column]).dtype)))
+        else:
+            sig = spec
+        return tf.data.Dataset.from_generator(_gen, output_signature=sig)
+
+    def write_datasource(self, datasource, **write_args) -> None:
+        """Write via a Datasource's do_write seam (reference:
+        Dataset.write_datasource)."""
+        datasource.do_write(self._blocks(), **write_args)
+
+    def to_random_access_dataset(self, key: str, num_workers: int = 2):
+        """Distributed point-lookup index over this dataset (reference:
+        dataset.py:3044 -> RandomAccessDataset)."""
+        from ray_tpu.data.random_access_dataset import RandomAccessDataset
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
+    # ------------------------------------------------------- lazy/eager
+    def lazy(self) -> "Dataset":
+        """Datasets here are lazy by construction (stages accumulate
+        until consumed); provided for reference API compatibility."""
+        return self
+
+    def experimental_lazy(self) -> "Dataset":
+        return self
+
+    def fully_executed(self) -> "Dataset":
+        return self.materialize()
+
+    def is_fully_executed(self) -> bool:
+        return not self._stages
+
+    def copy(self) -> "Dataset":
+        return Dataset(self._block_refs, self._stages, stats=self._stats,
+                       input_files=self._input_files)
 
     # ------------------------------------------------------------- output
     def write_parquet(self, path: str) -> None:
@@ -750,11 +959,15 @@ class Dataset:
         return (f"Dataset(num_blocks={len(self._block_refs)}, "
                 f"pending_stages={len(self._stages)})")
 
-    stats = __repr__
-
 
 def _block_rows(block) -> int:
     return BlockAccessor(block).num_rows()
+
+
+def _accumulate_aggs(block, aggs):
+    """Worker-side: fold every AggregateFn over one block; returns the
+    list of accumulators (small — never rows)."""
+    return [agg.accumulate_block(agg.init(None), block) for agg in aggs]
 
 
 def _gather_rows(start: int, count: int, b_starts: List[int], *blocks):
@@ -911,6 +1124,33 @@ class GroupedData:
 
     def mean(self, on=None):
         return self._agg("mean", on)
+
+    def std(self, on=None):
+        return self._agg("std", on)
+
+    def aggregate(self, *aggs) -> Dataset:
+        """Per-group AggregateFns (reference: GroupedDataset.aggregate).
+        Each hash partition folds every group's rows through each agg's
+        accumulate/finalize where the partition lives; one output row
+        per group keyed by the group value plus one column per agg."""
+        if not aggs:
+            raise ValueError("aggregate() needs at least one AggregateFn")
+        key = self._key
+
+        def _agg_part(*dfs):
+            import pandas as pd
+            df = pd.concat(dfs, ignore_index=True)
+            rows = []
+            for kval, sub in df.groupby(key):
+                row = {key: kval}
+                for agg in aggs:
+                    acc = agg.accumulate_block(agg.init(kval), sub)
+                    row[agg.name] = agg.finalize(acc)
+                rows.append(row)
+            return pd.DataFrame(rows)
+
+        t = ray_tpu.remote(_agg_part)
+        return Dataset([t.remote(*group) for group in self._partitions()])
 
     def map_groups(self, fn: Callable) -> Dataset:
         key = self._key

@@ -1,0 +1,159 @@
+"""Distributed random (point) access over a sorted Dataset.
+
+Reference: python/ray/data/random_access_dataset.py (RandomAccessDataset,
+_RandomAccessWorker): sort by key, record per-block [min, max] bounds,
+spread worker actors each pinning a subset of blocks, route each lookup
+to a worker holding the covering block via binary search on the bounds.
+
+Design notes vs the reference: same architecture (sorted blocks +
+bounds index + worker actors), but lookups inside a worker use numpy
+searchsorted on a cached key column instead of per-row scans, and the
+block→worker assignment is a simple round-robin over the sorted block
+sequence (keeps each worker's blocks contiguous in key space, so batch
+multigets mostly hit one worker).
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_GET_TIMEOUT = 600.0
+
+
+def _block_bounds(block, key: str):
+    from ray_tpu.data.block import BlockAccessor
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return None
+    col = np.asarray(acc.to_numpy(key))
+    return (col[0].item(), col[-1].item())
+
+
+class _RandomAccessWorker:
+    """Holds a subset of sorted blocks; answers point lookups."""
+
+    def __init__(self, key: str):
+        self._key = key
+        self._blocks: Dict[int, Any] = {}
+        self._keys: Dict[int, np.ndarray] = {}
+        self._num_queries = 0
+
+    def assign(self, idxs: List[int], *blocks) -> int:
+        """Blocks arrive as TOP-LEVEL task args (ObjectRefs nested in
+        containers deliberately don't auto-resolve — reference
+        semantics), so the runtime hands this method materialized
+        blocks."""
+        from ray_tpu.data.block import BlockAccessor
+        for i, b in zip(idxs, blocks):
+            self._blocks[i] = b
+            self._keys[i] = np.asarray(BlockAccessor(b).to_numpy(self._key))
+        return len(self._blocks)
+
+    def get(self, block_idx: int, key_val) -> Optional[dict]:
+        self._num_queries += 1
+        keys = self._keys.get(block_idx)
+        if keys is None or keys.size == 0:
+            return None
+        j = int(np.searchsorted(keys, key_val))
+        if j >= keys.size or keys[j] != key_val:
+            return None
+        from ray_tpu.data.block import BlockAccessor
+        return BlockAccessor(
+            BlockAccessor(self._blocks[block_idx]).slice(j, j + 1)
+        ).to_pylist()[0]
+
+    def multiget(self, block_idxs: List[int], key_vals: List[Any]
+                 ) -> List[Optional[dict]]:
+        return [self.get(i, k) for i, k in zip(block_idxs, key_vals)]
+
+    def stats(self) -> dict:
+        return {"blocks": len(self._blocks),
+                "num_queries": self._num_queries}
+
+
+class RandomAccessDataset:
+    def __init__(self, dataset, key: str, num_workers: int = 2):
+        sorted_ds = dataset.sort(key)
+        refs = sorted_ds.get_internal_block_refs()
+        bounds_task = ray_tpu.remote(_block_bounds)
+        bounds = ray_tpu.get([bounds_task.remote(b, key) for b in refs],
+                             timeout=_GET_TIMEOUT)
+        self._key = key
+        self._non_empty: List = []
+        self._upper_bounds: List = []
+        for ref, b in zip(refs, bounds):
+            if b is not None:
+                self._non_empty.append(ref)
+                self._upper_bounds.append(b[1])
+
+        n = max(1, min(num_workers, len(self._non_empty) or 1))
+        worker_cls = ray_tpu.remote(_RandomAccessWorker)
+        self._workers = [worker_cls.remote(key) for _ in range(n)]
+        self._block_to_worker: Dict[int, int] = {}
+        assign: List[Dict[int, Any]] = [{} for _ in range(n)]
+        for i, ref in enumerate(self._non_empty):
+            w = i % n
+            self._block_to_worker[i] = w
+            assign[w][i] = ref
+        ray_tpu.get([w.assign.remote(list(a.keys()), *a.values())
+                     for w, a in zip(self._workers, assign) if a],
+                    timeout=_GET_TIMEOUT)
+
+    def _locate(self, key_val) -> Optional[int]:
+        i = bisect.bisect_left(self._upper_bounds, key_val)
+        return i if i < len(self._non_empty) else None
+
+    def get_async(self, key_val):
+        """ObjectRef resolving to the row with sort-key == key_val, or
+        None if absent."""
+        i = self._locate(key_val)
+        if i is None:
+            return ray_tpu.put(None)
+        w = self._workers[self._block_to_worker[i]]
+        return w.get.remote(i, key_val)
+
+    def multiget(self, keys: List[Any]) -> List[Optional[dict]]:
+        """Batched lookup: keys are grouped per worker so each worker
+        answers its whole batch in one RPC."""
+        per_worker: Dict[int, List] = collections.defaultdict(list)
+        order: List = [None] * len(keys)
+        misses: List[int] = []
+        for pos, k in enumerate(keys):
+            i = self._locate(k)
+            if i is None:
+                misses.append(pos)
+            else:
+                per_worker[self._block_to_worker[i]].append((pos, i, k))
+        futs = {}
+        for widx, triples in per_worker.items():
+            idxs = [t[1] for t in triples]
+            vals = [t[2] for t in triples]
+            futs[widx] = self._workers[widx].multiget.remote(idxs, vals)
+        for widx, triples in per_worker.items():
+            rows = ray_tpu.get(futs[widx], timeout=_GET_TIMEOUT)
+            for (pos, _, _), row in zip(triples, rows):
+                order[pos] = row
+        return order
+
+    def stats(self) -> str:
+        st = ray_tpu.get([w.stats.remote() for w in self._workers],
+                         timeout=_GET_TIMEOUT)
+        lines = ["RandomAccessDataset:"]
+        for i, s in enumerate(st):
+            lines.append(f"  worker {i}: {s['blocks']} blocks, "
+                         f"{s['num_queries']} queries")
+        return "\n".join(lines)
+
+    def __del__(self):
+        try:
+            for w in getattr(self, "_workers", []):
+                ray_tpu.kill(w)
+        except Exception:
+            pass

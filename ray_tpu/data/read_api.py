@@ -89,7 +89,7 @@ def _expand(paths) -> List[str]:
 def _read_files(paths, reader) -> Dataset:
     files = _expand(paths)
     task = ray_tpu.remote(reader)
-    return Dataset([task.remote(f) for f in files])
+    return Dataset([task.remote(f) for f in files], input_files=files)
 
 
 def read_parquet(paths, **kw) -> Dataset:

@@ -1,0 +1,176 @@
+"""Dataset API-surface parity: aggregate/export/split/random-access
+(reference: python/ray/data/dataset.py — aggregate :1341, size_bytes,
+input_files, randomize_block_order :773, split_proportionately :1110,
+to_*_refs, to_torch, to_random_access_dataset :3044)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_aggregate_fns(ray_init):
+    from ray_tpu.data import Count, Max, Mean, Min, Std, Sum
+
+    ds = data.from_items([{"x": float(i)} for i in range(10)],
+                         parallelism=3)
+    out = ds.aggregate(Count(), Sum("x"), Min("x"), Max("x"), Mean("x"),
+                       Std("x"))
+    assert out["count()"] == 10
+    assert out["sum(x)"] == 45.0
+    assert out["min(x)"] == 0.0 and out["max(x)"] == 9.0
+    assert out["mean(x)"] == 4.5
+    assert abs(out["std(x)"] - np.std(np.arange(10.0), ddof=1)) < 1e-9
+
+
+def test_scalar_aggregates_distributed(ray_init):
+    ds = data.range(100, parallelism=5)
+    assert ds.sum() == 4950
+    assert ds.min() == 0 and ds.max() == 99
+    assert ds.mean() == 49.5
+    assert abs(ds.std() - np.std(np.arange(100), ddof=1)) < 1e-9
+
+
+def test_groupby_aggregate_and_std(ray_init):
+    from ray_tpu.data import Mean, Sum
+
+    rows = [{"k": i % 3, "v": float(i)} for i in range(12)]
+    ds = data.from_items(rows, parallelism=4)
+    out = ds.groupby("k").aggregate(Sum("v"), Mean("v")).to_pandas()
+    out = out.sort_values("k").reset_index(drop=True)
+    for k in range(3):
+        vals = [r["v"] for r in rows if r["k"] == k]
+        assert out.loc[k, "sum(v)"] == sum(vals)
+        assert out.loc[k, "mean(v)"] == sum(vals) / len(vals)
+    std = ds.groupby("k").std("v").to_pandas().sort_values("k")
+    assert len(std) == 3
+
+
+def test_size_bytes_and_block_refs(ray_init):
+    ds = data.from_numpy(np.ones((64, 8), np.float64), parallelism=4)
+    assert ds.size_bytes() >= 64 * 8 * 8
+    refs = ds.get_internal_block_refs()
+    assert len(refs) == ds.num_blocks()
+    total = sum(len(ray_tpu.get(r)["data"]) for r in refs)
+    assert total == 64
+
+
+def test_input_files_tracked(ray_init, tmp_path):
+    import pandas as pd
+    for i in range(3):
+        pd.DataFrame({"a": [i]}).to_csv(tmp_path / f"f{i}.csv",
+                                        index=False)
+    ds = data.read_csv(str(tmp_path))
+    files = ds.input_files()
+    assert len(files) == 3 and all(f.endswith(".csv") for f in files)
+    # survives transforms
+    assert ds.map_batches(lambda b: b).input_files() == files
+
+
+def test_randomize_block_order(ray_init):
+    ds = data.range(40, parallelism=8).randomize_block_order(seed=7)
+    assert sorted(ds.take_all()) == list(range(40))
+    first = ds.take(5)
+    assert first != list(range(5))  # order actually changed
+
+
+def test_split_proportionately(ray_init):
+    ds = data.range(100, parallelism=4)
+    a, b, c = ds.split_proportionately([0.2, 0.3])
+    assert a.count() == 20 and b.count() == 30 and c.count() == 50
+    assert sorted(a.take_all() + b.take_all() + c.take_all()) == \
+        list(range(100))
+    with pytest.raises(ValueError):
+        ds.split_proportionately([0.5, 0.6])
+
+
+def test_to_refs_exports(ray_init):
+    import pandas as pd
+    import pyarrow as pa
+
+    ds = data.from_pandas(pd.DataFrame({"a": range(10)}))
+    nps = ray_tpu.get(ds.to_numpy_refs(column="a"))
+    assert np.concatenate([np.asarray(x) for x in nps]).tolist() == \
+        list(range(10))
+    dfs = ray_tpu.get(ds.to_pandas_refs())
+    assert all(isinstance(d, pd.DataFrame) for d in dfs)
+    tbls = ray_tpu.get(ds.to_arrow_refs())
+    assert all(isinstance(t, pa.Table) for t in tbls)
+
+
+def test_to_torch(ray_init):
+    import torch
+
+    rows = [{"x": float(i), "y": 2.0 * i, "label": i % 2}
+            for i in range(32)]
+    ds = data.from_items(rows, parallelism=2)
+    it = ds.to_torch(label_column="label", batch_size=8)
+    feats, labels, n = None, [], 0
+    for f, l in it:  # noqa: E741
+        assert isinstance(f, torch.Tensor) and f.shape[1] == 2
+        n += f.shape[0]
+        labels.append(l)
+    assert n == 32
+    assert torch.cat(labels).sum().item() == 16
+
+
+def test_tf_paths_gated(ray_init):
+    ds = data.range(4)
+    try:
+        import tensorflow  # noqa: F401
+        has_tf = True
+    except ImportError:
+        has_tf = False
+    if not has_tf:
+        with pytest.raises(ImportError):
+            list(ds.iter_tf_batches())
+
+
+def test_lazy_execution_flags(ray_init):
+    ds = data.range(10).map(lambda x: x + 1)
+    assert not ds.is_fully_executed()
+    assert ds.lazy() is ds
+    out = ds.fully_executed()
+    assert out.is_fully_executed()
+    cp = ds.copy()
+    assert cp.take_all() == ds.take_all()
+
+
+def test_write_datasource(ray_init):
+    from ray_tpu.data import Datasource
+
+    captured = []
+
+    class CaptureSink(Datasource):
+        def do_write(self, blocks, **kw):
+            captured.extend(blocks)
+
+    data.range(10, parallelism=2).write_datasource(CaptureSink())
+    assert sum(len(b) for b in captured) == 10
+
+
+def test_random_access_dataset(ray_init):
+    rows = [{"key": i, "val": i * 10} for i in range(50)]
+    ds = data.from_items(rows, parallelism=5)
+    rad = ds.to_random_access_dataset("key", num_workers=2)
+    assert ray_tpu.get(rad.get_async(7))["val"] == 70
+    assert ray_tpu.get(rad.get_async(999)) is None
+    got = rad.multiget([3, 17, 41, 999])
+    assert [None if g is None else g["val"] for g in got] == \
+        [30, 170, 410, None]
+    assert "worker" in rad.stats()
+
+
+def test_stats_reports_stages(ray_init):
+    ds = data.range(10, parallelism=2).map(lambda x: x * 2)
+    ds.take_all()
+    s = ds.stats()
+    assert "blocks" in s and "Stage" in s
