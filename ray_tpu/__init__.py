@@ -17,7 +17,9 @@ from ray_tpu._private.api import (  # noqa: F401
     cluster_resources,
     get,
     get_actor,
+    get_gpu_ids,
     get_runtime_context,
+    get_tpu_ids,
     init,
     is_initialized,
     kill,
@@ -47,6 +49,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel",
     "kill", "get_actor", "nodes", "cluster_resources",
-    "available_resources", "get_runtime_context", "ObjectRef", "method",
+    "available_resources", "get_runtime_context", "get_tpu_ids",
+    "get_gpu_ids", "ObjectRef", "method",
     "exceptions", "__version__",
 ]

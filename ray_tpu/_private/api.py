@@ -333,9 +333,30 @@ class RuntimeContext:
     def get_actor_id(self):
         return self.actor_id.hex() if self.actor_id else None
 
+    def get_tpu_ids(self):
+        return get_tpu_ids()
+
 
 def get_runtime_context() -> RuntimeContext:
     return RuntimeContext(_worker())
+
+
+def get_tpu_ids() -> list:
+    """Chip indices the raylet granted to THIS task/actor's lease
+    (reference: ray.get_gpu_ids over GPU resource instances).  Empty in
+    the driver or for leases without a TPU resource."""
+    w = _worker()
+    ids = list(getattr(w.exec_ctx, "tpu_ids", []) or [])
+    if ids:
+        return ids
+    return list(getattr(w, "_actor_tpu_ids", []) or [])
+
+
+def get_gpu_ids() -> list:
+    """Reference-compatible alias of get_tpu_ids (ray.get_gpu_ids):
+    scripts written against the reference keep working; on this
+    framework the accelerator resource is TPU chips."""
+    return get_tpu_ids()
 
 
 def timeline(filename: str | None = None):

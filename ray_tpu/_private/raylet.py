@@ -144,6 +144,7 @@ class Lease:
         self.resources: dict = resources
         self.pg_key = pg_key  # (pg_id, bundle_index) or None
         self.blocked = False
+        self.tpu_ids: list = []  # device indices granted to this lease
 
 
 class Raylet:
@@ -157,6 +158,11 @@ class Raylet:
         self.node_name = node_name
         self.total_resources = dict(resources)
         self.available = dict(resources)
+        # Per-device TPU accounting: chip index -> fraction in use
+        # (reference: the raylet's GPU-id resource instances backing
+        # ray.get_gpu_ids; fractional leases share one chip).
+        self._tpu_slots: dict[int, float] = {
+            i: 0.0 for i in range(int(resources.get("TPU", 0)))}
         self.labels = labels or {}
         self.server = protocol.RpcServer(self._handle, host=host, name="raylet",
                                          on_disconnect=self._on_conn_lost)
@@ -914,6 +920,50 @@ class Raylet:
         else:
             non_cpu = {k: v for k, v in lease.resources.items() if k != "CPU"}
             self._release(non_cpu, lease.pg_key)
+        self._free_tpu_ids(lease)
+
+    # ----------------------------------------------------- TPU device ids
+    def _alloc_tpu_ids(self, lease: Lease) -> list:
+        """Pin specific chip indices to a lease.  Whole-chip requests
+        take exclusively-free slots; fractional requests bin-pack onto
+        the fullest slot that still fits (so two 0.5 leases share one
+        chip and whole chips stay free for whole-chip leases).  Ids are
+        advisory — allocation failure (fragmentation) grants the lease
+        with no pinned ids rather than blocking it."""
+        amount = float(lease.resources.get("TPU", 0) or 0)
+        if amount <= 0 or not self._tpu_slots:
+            return []
+        ids: list = []
+        if amount >= 1.0 - 1e-9:
+            free = [i for i, used in self._tpu_slots.items() if used == 0.0]
+            k = int(round(amount))
+            if len(free) < k:
+                return []
+            ids = free[:k]
+            for i in ids:
+                self._tpu_slots[i] = 1.0
+        else:
+            cands = [(used, i) for i, used in self._tpu_slots.items()
+                     if used + amount <= 1.0 + 1e-9]
+            if not cands:
+                return []
+            _, best = max(cands)
+            self._tpu_slots[best] += amount
+            ids = [best]
+        lease.tpu_ids = ids
+        return ids
+
+    def _free_tpu_ids(self, lease: Lease):
+        amount = float(lease.resources.get("TPU", 0) or 0)
+        if not lease.tpu_ids:
+            return
+        if amount >= 1.0 - 1e-9:
+            for i in lease.tpu_ids:
+                self._tpu_slots[i] = 0.0
+        else:
+            for i in lease.tpu_ids:
+                self._tpu_slots[i] = max(0.0, self._tpu_slots[i] - amount)
+        lease.tpu_ids = []
 
     # --------------------------------------------------------------- leases
     async def rpc_request_worker_lease(self, conn, body):
@@ -1131,6 +1181,7 @@ class Raylet:
                     "worker_addr": w.addr,
                     "worker_id": w.worker_id,
                     "node_id": self.node_id,
+                    "tpu_ids": self._alloc_tpu_ids(lease),
                 })
             for (kind, env_key), (n, env_spec) in need_spawn.items():
                 self._ensure_spawning(kind, n, env_key=env_key,
@@ -1243,11 +1294,13 @@ class Raylet:
         self.leases[lease_id] = lease
         w.lease_id = lease_id
         w.actor_id = body["actor_id"]
+        tpu_ids = self._alloc_tpu_ids(lease)
         try:
             reply = await w.conn.request("create_actor", {
                 "actor_id": body["actor_id"],
                 "spec": body["spec"],
                 "lease_id": lease_id,
+                "tpu_ids": tpu_ids,
             }, timeout=120.0)
         except Exception as e:
             await self._on_worker_dead(w, f"actor creation failed: {e}")
@@ -1255,6 +1308,7 @@ class Raylet:
         if not reply.get("ok"):
             w.actor_id = None
             self.leases.pop(lease_id, None)
+            self._free_tpu_ids(lease)
             self._release(resources, pg_key)
             w.last_idle = time.monotonic()
             self._idle(w.kind, w.env_key).append(w)

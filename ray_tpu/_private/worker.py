@@ -159,6 +159,7 @@ class ExecutionContext(threading.local):
         self.actor_id = None
         self.lease_id = None
         self.blocked_depth = 0
+        self.tpu_ids: list = []  # chip indices granted to this lease
 
 
 class CoreWorker:
@@ -1143,6 +1144,7 @@ class CoreWorker:
                 "node_id": reply["node_id"],
                 "worker_addr": worker_addr,
                 "busy": False,
+                "tpu_ids": reply.get("tpu_ids") or [],
             }
             pool.all[lease["lease_id"]] = lease
             pool.idle.append(lease)
@@ -1229,7 +1231,8 @@ class CoreWorker:
         self._inflight_tasks[spec["task_id"]] = (lease, spec)
         try:
             reply = await lease["conn"].request("push_task", {
-                "spec": spec, "lease_id": lease["lease_id"]}, timeout=None)
+                "spec": spec, "lease_id": lease["lease_id"],
+                "tpu_ids": lease.get("tpu_ids") or []}, timeout=None)
             self._record_results(spec, reply)
         except Exception as e:
             if spec.get("cancelled"):
@@ -1358,12 +1361,14 @@ class CoreWorker:
         spec = body["spec"]
         lease_id = body.get("lease_id")
         return await self.loop.run_in_executor(
-            self._task_pool, self._execute_task_sync, spec, lease_id)
+            self._task_pool, self._execute_task_sync, spec, lease_id,
+            body.get("tpu_ids") or [])
 
-    def _execute_task_sync(self, spec, lease_id):
+    def _execute_task_sync(self, spec, lease_id, tpu_ids=()):
         ctx = self.exec_ctx
         ctx.task_id = spec["task_id"]
         ctx.lease_id = lease_id
+        ctx.tpu_ids = list(tpu_ids)
         t0 = time.time()
         restore_env = None
         span = self._enter_span(spec.get("trace"))
@@ -1384,6 +1389,7 @@ class CoreWorker:
                 t0, trace=span)
             ctx.task_id = None
             ctx.lease_id = None
+            ctx.tpu_ids = []
 
     @staticmethod
     def _enter_span(trace):
@@ -1488,6 +1494,10 @@ class CoreWorker:
     async def rpc_create_actor(self, conn, body):
         spec = body["spec"]
         self.actor_id = body["actor_id"]
+        # Actor-lifetime device grant: every method call of this actor
+        # sees the same chip indices (reference: actors keep their GPU
+        # ids for their whole lifetime).
+        self._actor_tpu_ids = list(body.get("tpu_ids") or [])
         try:
             result = await self.loop.run_in_executor(
                 self._task_pool, self._create_actor_sync, spec)

@@ -8,15 +8,23 @@ actor.  Reference: python/ray/serve (SURVEY.md §2.3, §3.5).
 
 from ray_tpu.serve.api import (  # noqa: F401
     Deployment,
+    build,
     delete,
     deployment,
+    get_deployment,
     get_deployment_handle,
     get_proxy_address,
     get_proxy_addresses,
+    ingress,
+    list_deployments,
     run,
     shutdown,
     start,
     status,
+)
+from ray_tpu.serve.context import (  # noqa: F401
+    ReplicaContext,
+    get_replica_context,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.config import (  # noqa: F401
@@ -29,9 +37,12 @@ from ray_tpu.serve._private.replica import Request  # noqa: F401
 
 __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "HTTPOptions", "RayServeHandle", "Request",
-    "batch", "delete", "deployment", "get_deployment_handle",
-    "get_proxy_address", "get_proxy_addresses", "run", "shutdown", "start", "status",
+    "DeploymentHandle", "HTTPOptions", "RayServeHandle", "ReplicaContext",
+    "Request",
+    "batch", "build", "delete", "deployment", "get_deployment",
+    "get_deployment_handle", "get_proxy_address", "get_proxy_addresses",
+    "get_replica_context", "ingress", "list_deployments", "run",
+    "shutdown", "start", "status",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -72,6 +72,28 @@ class ServeController:
     async def get_deployment_statuses(self) -> List[Dict]:
         return self._dsm.statuses()
 
+    async def get_deployment_info(self, name: str = None) -> List[Dict]:
+        """Target specs for serve.get_deployment/list_deployments: the
+        serialized body + config + version for each (or one) deployment."""
+        out = []
+        for dname, ds in self._dsm._deployments.items():
+            if name is not None and dname != name:
+                continue
+            if ds.deleting or ds.target_replica_config is None:
+                continue
+            rc = ds.target_replica_config
+            out.append({
+                "name": dname,
+                "config": ds.target_config.to_dict(),
+                "deployment_def": rc.deployment_def,
+                "init_args": rc.init_args,
+                "init_kwargs": rc.init_kwargs,
+                "ray_actor_options": rc.ray_actor_options,
+                "version": ds.target_version,
+                "route_prefix": getattr(ds, "route_prefix", f"/{dname}"),
+            })
+        return out
+
     async def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int]):
         return await self._long_poll.listen(keys_to_snapshot_ids)
 

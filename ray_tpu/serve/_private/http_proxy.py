@@ -69,6 +69,13 @@ class HTTPProxy:
         except Exception as e:
             logger.exception("request to %s failed", deployment)
             return 500, repr(e).encode(), "text/plain"
+        if isinstance(result, dict) and result.get("__http__") is True:
+            # Structured response from an ASGI ingress deployment
+            # (serve.ingress): honor its status/headers/body.
+            return (int(result.get("status", 200)),
+                    bytes(result.get("body", b"")),
+                    result.get("content_type", "text/plain"),
+                    result.get("headers") or {})
         if isinstance(result, (bytes, bytearray)):
             return 200, bytes(result), "application/octet-stream"
         if isinstance(result, str):
@@ -110,11 +117,17 @@ class HTTPProxyActor:
 
         async def _handler(request: "web.Request"):
             body = await request.read()
-            status, payload, ctype = await self._proxy.handle(
+            status, payload, ctype, *rest = await self._proxy.handle(
                 request.method, request.path, dict(request.query), body,
                 dict(request.headers))
+            # ASGI ingress responses carry full headers (Set-Cookie,
+            # Location, ...); content-type/length ride dedicated kwargs.
+            headers = {k: v for k, v in (rest[0] if rest else {}).items()
+                       if k.lower() not in ("content-type",
+                                            "content-length")}
             return web.Response(status=status, body=payload,
-                                content_type=ctype.split(";")[0])
+                                content_type=ctype.split(";")[0],
+                                headers=headers)
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", _handler)

@@ -58,10 +58,18 @@ class RTServeReplica:
         self._sync_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"replica-{replica_tag}")
         body = cloudpickle.loads(serialized_def)
+        # Publish the replica context BEFORE user __init__ runs, so the
+        # constructor itself can call serve.get_replica_context()
+        # (reference: replica.py sets it in create_replica_wrapper).
+        from ray_tpu.serve import context as _serve_ctx
+        _serve_ctx._set_internal_replica_context(
+            deployment_name, replica_tag)
         if inspect.isclass(body):
             self.callable = body(*init_args, **init_kwargs)
         else:
             self.callable = body
+        _serve_ctx._set_internal_replica_context(
+            deployment_name, replica_tag, servable_object=self.callable)
         if user_config is not None:
             self._reconfigure_sync(user_config)
 

@@ -8,6 +8,7 @@ deployments outlive the driver that created them.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -18,6 +19,7 @@ import ray_tpu
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
                                   ReplicaConfig)
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve._private.replica import Request
 from ray_tpu.serve._private.controller import (CONTROLLER_NAME,
                                                ServeController)
 
@@ -249,6 +251,124 @@ def run(target: Deployment, *, host: str = "127.0.0.1", port: int = 0,
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name, _get_or_create_controller())
+
+
+def _deployment_from_info(info: Dict) -> Deployment:
+    return Deployment(
+        cloudpickle.loads(info["deployment_def"]), info["name"],
+        DeploymentConfig.from_dict(info["config"]),
+        init_args=tuple(info["init_args"]),
+        init_kwargs=dict(info["init_kwargs"]),
+        ray_actor_options=dict(info["ray_actor_options"]),
+        version=info["version"], route_prefix=info["route_prefix"])
+
+
+def get_deployment(name: str) -> Deployment:
+    """Fetch a live deployment by name as a re-deployable Deployment
+    object (reference: serve.get_deployment)."""
+    controller = _get_or_create_controller()
+    infos = ray_tpu.get(controller.get_deployment_info.remote(name),
+                        timeout=30)
+    if not infos:
+        raise KeyError(f"no deployment named {name!r}")
+    return _deployment_from_info(infos[0])
+
+
+def list_deployments() -> Dict[str, Deployment]:
+    """All live deployments, by name (reference: serve.list_deployments)."""
+    controller = _get_or_create_controller()
+    infos = ray_tpu.get(controller.get_deployment_info.remote(),
+                        timeout=30)
+    return {i["name"]: _deployment_from_info(i) for i in infos}
+
+
+def build(*import_paths: str) -> Dict:
+    """Emit the declarative config for deployments given by import path
+    ("module:attr"), the programmatic twin of `rt serve build`
+    (reference: serve.build / serve build CLI)."""
+    from ray_tpu.serve.schema import build_config
+    return build_config(list(import_paths))
+
+
+async def _run_asgi(app, request) -> Dict:
+    """Drive one request through an ASGI app (FastAPI/Starlette/raw
+    callable) and capture the response as a structured dict the HTTP
+    proxy unwraps (reference: serve.ingress wrapping a FastAPI app in
+    the replica; here the adapter is dependency-free ASGI)."""
+    from urllib.parse import urlencode
+
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "scheme": "http",
+        "path": request.path,
+        "raw_path": request.path.encode(),
+        "root_path": "",
+        "query_string": urlencode(request.query or {}).encode(),
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in (request.headers or {}).items()],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 0),
+    }
+    body = request.body or b""
+    sent = {"done": False}
+
+    async def receive():
+        if sent["done"]:
+            return {"type": "http.disconnect"}
+        sent["done"] = True
+        return {"type": "http.request", "body": body, "more_body": False}
+
+    out = {"status": 200, "headers": [], "chunks": []}
+
+    async def send(message):
+        if message["type"] == "http.response.start":
+            out["status"] = message["status"]
+            out["headers"] = message.get("headers", [])
+        elif message["type"] == "http.response.body":
+            out["chunks"].append(message.get("body", b""))
+
+    await app(scope, receive, send)
+    headers = {k.decode(): v.decode() for k, v in out["headers"]}
+    return {"__http__": True, "status": out["status"],
+            "content_type": headers.get("content-type", "text/plain"),
+            "headers": headers, "body": b"".join(out["chunks"])}
+
+
+def ingress(app):
+    """Route ALL HTTP traffic of a deployment through an ASGI app
+    (reference: serve.ingress(fastapi_app)).  The decorated class's
+    instance is reachable from route handlers via
+    serve.get_replica_context().servable_object; direct handle calls
+    (`handle.method.remote`) still hit the class's own methods."""
+
+    def decorator(cls):
+        if not inspect.isclass(cls):
+            raise TypeError("@serve.ingress must decorate a class")
+
+        class _ASGIIngress(cls):
+            async def __call__(self, request):  # proxy entry point
+                if not isinstance(request, Request):
+                    # Plain handle call falls through to the user class.
+                    parent = getattr(super(), "__call__", None)
+                    if parent is None:
+                        raise TypeError(
+                            f"{cls.__name__} has no __call__ for "
+                            "non-HTTP invocation")
+                    result = parent(request)
+                    if inspect.iscoroutine(result):
+                        result = await result
+                    return result
+                return await _run_asgi(app, request)
+
+        _ASGIIngress.__name__ = cls.__name__
+        _ASGIIngress.__qualname__ = getattr(cls, "__qualname__",
+                                            cls.__name__)
+        return _ASGIIngress
+
+    return decorator
 
 
 def get_proxy_address() -> Optional[Dict]:

@@ -1,0 +1,36 @@
+"""Replica-side context (reference: python/ray/serve/context.py —
+ReplicaContext + get_replica_context, set by the replica wrapper at
+construction)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass
+class ReplicaContext:
+    deployment: str
+    replica_tag: str
+    servable_object: Optional[Any] = None
+
+
+_INTERNAL_REPLICA_CONTEXT: Optional[ReplicaContext] = None
+
+
+def _set_internal_replica_context(deployment: str, replica_tag: str,
+                                  servable_object: Any = None) -> None:
+    global _INTERNAL_REPLICA_CONTEXT
+    _INTERNAL_REPLICA_CONTEXT = ReplicaContext(
+        deployment=deployment, replica_tag=replica_tag,
+        servable_object=servable_object)
+
+
+def get_replica_context() -> ReplicaContext:
+    """Inside a replica: which deployment/replica this code runs in
+    (reference: serve.get_replica_context)."""
+    if _INTERNAL_REPLICA_CONTEXT is None:
+        raise RuntimeError(
+            "get_replica_context() may only be called from inside a "
+            "Serve replica (there is no replica context in this process)")
+    return _INTERNAL_REPLICA_CONTEXT

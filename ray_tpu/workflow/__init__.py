@@ -34,6 +34,12 @@ STATUS_RUNNING = "RUNNING"
 STATUS_SUCCESSFUL = "SUCCESSFUL"
 STATUS_FAILED = "FAILED"
 STATUS_RESUMABLE = "RESUMABLE"
+STATUS_CANCELED = "CANCELED"
+
+
+class WorkflowCancellationError(RuntimeError):
+    """Raised inside a run when its workflow is canceled (reference:
+    workflow.exceptions.WorkflowCancellationError)."""
 
 
 def init(storage: Optional[str] = None):
@@ -121,6 +127,12 @@ class _DurableExecutor:
             st = _store()
             if st.exists(ckpt):
                 return pickle.loads(st.read_bytes(ckpt))
+            # Durable cancel barrier: a cancel() from ANY process lands
+            # in storage and stops the run before its next task.
+            if _read_meta(self.workflow_id).get("status") == \
+                    STATUS_CANCELED:
+                raise WorkflowCancellationError(
+                    f"workflow {self.workflow_id!r} was canceled")
             # Upstream values were materialized (durability barrier);
             # run this task as a cluster task and persist its output.
             rf = ray_tpu.remote(node._fn)
@@ -151,6 +163,10 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
                 start_ts=time.time())
     try:
         result = _DurableExecutor(workflow_id, args, kwargs).execute(dag)
+    except WorkflowCancellationError:
+        _write_meta(workflow_id, status=STATUS_CANCELED,
+                    end_ts=time.time())
+        raise
     except Exception as e:
         _write_meta(workflow_id, status=STATUS_FAILED, error=repr(e),
                     end_ts=time.time())
@@ -222,6 +238,47 @@ def list_all() -> List[Dict]:
 
 def delete(workflow_id: str):
     _store().delete_prefix(workflow_id)
+
+
+def cancel(workflow_id: str) -> None:
+    """Durably cancel a workflow (reference: workflow.cancel).  The
+    marker lands in storage, so the running driver — even in another
+    process — stops before launching its next task; completed task
+    checkpoints are kept (delete() removes them)."""
+    meta = _read_meta(workflow_id)
+    if not meta:
+        raise KeyError(f"no workflow {workflow_id!r}")
+    if meta.get("status") == STATUS_SUCCESSFUL:
+        raise RuntimeError(
+            f"workflow {workflow_id!r} already finished successfully")
+    _write_meta(workflow_id, status=STATUS_CANCELED, end_ts=time.time())
+
+
+def get_output(workflow_id: str, *, timeout: Optional[float] = None) -> Any:
+    """Block until the workflow reaches a terminal state, then return
+    its stored result (reference: workflow.get_output).
+
+    A RESUMABLE workflow (driver crashed mid-run) is indistinguishable
+    from one still running — status metadata alone can't tell a live
+    driver from a dead one — so this waits; pass `timeout` when the
+    driver may have died, then resume() / re-run() it."""
+    deadline = None if timeout is None else time.time() + timeout
+    while True:
+        status = get_status(workflow_id)
+        if status == STATUS_SUCCESSFUL:
+            return resume(workflow_id)
+        if status in (STATUS_FAILED, STATUS_CANCELED):
+            meta = _read_meta(workflow_id)
+            raise RuntimeError(
+                f"workflow {workflow_id!r} ended {status}: "
+                f"{meta.get('error', '')}")
+        if status is None:
+            raise KeyError(f"no workflow {workflow_id!r}")
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f"workflow {workflow_id!r} still {status} after "
+                f"{timeout}s")
+        time.sleep(0.1)
 
 
 # --------------------------------------------------------- virtual actors

@@ -1,0 +1,211 @@
+"""API-surface parity additions: serve ingress/context/registry,
+workflow cancel/get_output, TPU device-id grants
+(reference: serve/api.py ingress + get_deployment/list_deployments,
+serve/context.py get_replica_context, workflow cancel/get_output,
+ray.get_gpu_ids / GPU resource instances)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_tpu_node():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4},
+                 ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- device ids
+
+def test_task_tpu_ids(ray_tpu_node):
+    @ray_tpu.remote
+    def ids():
+        return ray_tpu.get_tpu_ids(), ray_tpu.get_gpu_ids()
+
+    tids, gids = ray_tpu.get(ids.options(num_tpus=2).remote(), timeout=60)
+    assert len(tids) == 2 and tids == gids
+    assert all(0 <= i < 4 for i in tids)
+    # no-TPU task sees no ids
+    t2, _ = ray_tpu.get(ids.remote(), timeout=60)
+    assert t2 == []
+
+
+def test_actor_tpu_ids_stable_and_disjoint(ray_tpu_node):
+    @ray_tpu.remote
+    class Holder:
+        def ids(self):
+            return ray_tpu.get_tpu_ids()
+
+    a = Holder.options(num_tpus=1).remote()
+    b = Holder.options(num_tpus=1).remote()
+    ia1 = ray_tpu.get(a.ids.remote(), timeout=60)
+    ia2 = ray_tpu.get(a.ids.remote(), timeout=60)
+    ib = ray_tpu.get(b.ids.remote(), timeout=60)
+    assert ia1 == ia2 and len(ia1) == 1 and len(ib) == 1
+    assert ia1[0] != ib[0]  # concurrent leases get different chips
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+
+
+def test_fractional_tpu_shares_one_chip(ray_tpu_node):
+    @ray_tpu.remote
+    class Frac:
+        def ids(self):
+            return ray_tpu.get_tpu_ids()
+
+    actors = [Frac.options(num_tpus=0.5).remote() for _ in range(2)]
+    got = [ray_tpu.get(a.ids.remote(), timeout=60) for a in actors]
+    assert all(len(g) == 1 for g in got)
+    assert got[0] == got[1]  # bin-packed onto the same chip
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_driver_has_no_tpu_ids(ray_tpu_node):
+    assert ray_tpu.get_tpu_ids() == []
+    assert ray_tpu.get_runtime_context().get_tpu_ids() == []
+
+
+# ---------------------------------------------------------------- serve
+
+def test_serve_replica_context_and_registry(ray_tpu_node):
+    from ray_tpu import serve
+
+    @serve.deployment(name="ctxy")
+    class Ctx:
+        def __call__(self):
+            ctx = serve.get_replica_context()
+            return {"deployment": ctx.deployment,
+                    "replica": ctx.replica_tag,
+                    "servable_is_self": ctx.servable_object is self}
+
+    handle = serve.run(Ctx, _start_proxy=False)
+    out = handle.remote().result(timeout=60)
+    assert out["deployment"] == "ctxy"
+    assert out["replica"]
+    assert out["servable_is_self"] is True
+
+    # registry
+    d = serve.get_deployment("ctxy")
+    assert d.name == "ctxy" and d.config.num_replicas == 1
+    all_d = serve.list_deployments()
+    assert "ctxy" in all_d
+    with pytest.raises(KeyError):
+        serve.get_deployment("nope")
+
+    # driver process: no replica context
+    with pytest.raises(RuntimeError):
+        serve.get_replica_context()
+    serve.shutdown()
+
+
+def test_serve_ingress_asgi(ray_tpu_node):
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    # dependency-free ASGI app (the adapter is what's under test; a
+    # FastAPI app is the same callable contract)
+    async def asgi_app(scope, receive, send):
+        assert scope["type"] == "http"
+        msg = await receive()
+        body = msg.get("body", b"")
+        payload = {"path": scope["path"],
+                   "method": scope["method"],
+                   "q": scope["query_string"].decode(),
+                   "len": len(body)}
+        data = json.dumps(payload).encode()
+        await send({"type": "http.response.start", "status": 201,
+                    "headers": [(b"content-type", b"application/json")]})
+        await send({"type": "http.response.body", "body": data})
+
+    @serve.deployment(name="asgi")
+    @serve.ingress(asgi_app)
+    class App:
+        def direct(self):
+            return "direct-call"
+
+    handle = serve.run(App, _start_proxy=True)
+    addr = serve.get_proxy_address()
+    url = (f"http://{addr['host']}:{addr['port']}/asgi/sub"
+           f"?a=1&b=2")
+    req = urllib.request.Request(url, data=b"hello",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 201
+        assert resp.headers["content-type"] == "application/json"
+        out = json.loads(resp.read())
+    assert out == {"path": "/sub", "method": "POST", "q": "a=1&b=2",
+                   "len": 5}
+    # plain handle calls still reach class methods
+    assert handle.direct.remote().result(timeout=30) == "direct-call"
+    serve.shutdown()
+
+
+def test_serve_build_config(ray_tpu_node, tmp_path):
+    import sys
+
+    from ray_tpu import serve
+
+    mod = tmp_path / "served_mod.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment(name='bldr', num_replicas=2)\n"
+        "def f(req):\n"
+        "    return 'ok'\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = serve.build("served_mod:f")
+        apps = cfg["applications"]
+        assert apps[0]["name"] == "bldr"
+        assert apps[0]["num_replicas"] == 2
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+# -------------------------------------------------------------- workflow
+
+def test_workflow_cancel_and_get_output(ray_tpu_node, tmp_path):
+    import ray_tpu.workflow as wf
+
+    wf.init(str(tmp_path / "wf"))
+
+    @ray_tpu.remote
+    def first():
+        return 1
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.4)
+        return x + 1
+
+    # successful workflow: get_output returns the stored result
+    wf.run(slow.bind(first.bind()), workflow_id="ok_wf")
+    assert wf.get_output("ok_wf") == 2
+
+    # cancel-before-next-task: the durable marker stops the run
+    @ray_tpu.remote
+    def then_fail(x):
+        raise AssertionError("must not run after cancel")
+
+    ref = wf.run_async(then_fail.bind(slow.bind(first.bind())),
+                       workflow_id="c_wf")
+    time.sleep(0.15)  # inside slow()
+    wf.cancel("c_wf")
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)
+    assert wf.get_status("c_wf") == wf.STATUS_CANCELED
+    with pytest.raises(RuntimeError):
+        wf.get_output("c_wf")
+
+    # canceling a finished workflow is an error
+    with pytest.raises(RuntimeError):
+        wf.cancel("ok_wf")
+
+    with pytest.raises(KeyError):
+        wf.get_output("never_was")
