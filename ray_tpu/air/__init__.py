@@ -9,6 +9,7 @@ declares TPU mesh axes instead of GPU counts.
 from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig,
+    DatasetConfig,
     FailureConfig,
     RunConfig,
     ScalingConfig,

@@ -51,6 +51,42 @@ class ScalingConfig:
 
 
 @dataclasses.dataclass
+class DatasetConfig:
+    """Per-dataset ingest behavior for DataParallelTrainer (reference:
+    air/config.py DatasetConfig + its fill_defaults: the "train" dataset
+    splits across workers and fits the preprocessor; aux datasets ship
+    whole to every worker).  None fields mean "use the role default"."""
+
+    fit: Optional[bool] = None          # fit the trainer's preprocessor?
+    split: Optional[bool] = None        # shard across workers?
+    required: Optional[bool] = None     # error if absent?
+    transform: Optional[bool] = None    # apply the fitted preprocessor?
+    global_shuffle: bool = False        # random_shuffle before ingest
+
+    @staticmethod
+    def validated(dataset_config: Optional[dict], datasets: dict
+                  ) -> dict:
+        """Merge user overrides onto role defaults for every dataset."""
+        merged = {}
+        for name in datasets:
+            is_train = name == "train"
+            dc = (dataset_config or {}).get(name) or DatasetConfig()
+            merged[name] = DatasetConfig(
+                fit=dc.fit if dc.fit is not None else is_train,
+                split=dc.split if dc.split is not None else is_train,
+                required=bool(dc.required),
+                transform=dc.transform if dc.transform is not None
+                else True,
+                global_shuffle=dc.global_shuffle)
+        for name, dc in (dataset_config or {}).items():
+            if dc and dc.required and name not in datasets:
+                raise ValueError(
+                    f"dataset {name!r} is required but was not passed "
+                    f"to the trainer (got: {sorted(datasets)})")
+        return merged
+
+
+@dataclasses.dataclass
 class FailureConfig:
     max_failures: int = 0
     fail_fast: bool = False

@@ -56,10 +56,18 @@ class _TrainWorker:
             # Deterministic whole-block split: every rank computes the
             # same split and keeps its own shard (reference:
             # data_parallel_trainer dataset sharding to workers).
-            for name, ds in datasets.items():
-                shards = ds.split(self.world_size)
-                self._session.dataset_shards[name] = \
-                    shards[self.world_rank]
+            # DatasetConfig(split=False) datasets arrive whole on every
+            # rank (the trainer sends (ds, split?) pairs; bare datasets
+            # from older callers default to split).
+            for name, entry in datasets.items():
+                ds, do_split = entry if isinstance(entry, tuple) \
+                    else (entry, True)
+                if do_split and self.world_size > 1:
+                    shards = ds.split(self.world_size)
+                    self._session.dataset_shards[name] = \
+                        shards[self.world_rank]
+                else:
+                    self._session.dataset_shards[name] = ds
         self._error = None
 
         def _run():

@@ -27,6 +27,8 @@ class DataParallelTrainer(BaseTrainer):
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict] = None,
+                 dataset_config: Optional[Dict] = None,
+                 preprocessor=None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         super().__init__(scaling_config=scaling_config,
                          run_config=run_config,
@@ -35,6 +37,33 @@ class DataParallelTrainer(BaseTrainer):
         self._train_loop_config = train_loop_config or {}
         self._backend_config = backend_config or self._backend_config_cls()
         self._datasets = datasets or {}
+        self._dataset_config = dataset_config or {}
+        self._preprocessor = preprocessor
+
+    def _prepared_datasets(self) -> Dict:
+        """Apply DatasetConfig roles: fit the preprocessor on fit=True
+        datasets, transform transform=True ones, shuffle global_shuffle
+        ones; returns {name: (dataset, split?)} (reference:
+        data_parallel_trainer dataset ingest + preprocessor fitting in
+        BaseTrainer.preprocess_datasets)."""
+        from ray_tpu.air.config import DatasetConfig
+        merged = DatasetConfig.validated(self._dataset_config,
+                                         self._datasets)
+        out = {}
+        pp = self._preprocessor
+        if pp is not None:
+            for name, ds in self._datasets.items():
+                if merged[name].fit:
+                    pp.fit(ds)
+                    break
+        for name, ds in self._datasets.items():
+            dc = merged[name]
+            if pp is not None and dc.transform:
+                ds = pp.transform(ds)
+            if dc.global_shuffle:
+                ds = ds.random_shuffle()
+            out[name] = (ds, bool(dc.split))
+        return out
 
     def training_loop(self) -> None:
         from ray_tpu.train._internal.backend_executor import (
@@ -49,6 +78,9 @@ class DataParallelTrainer(BaseTrainer):
                                    self.scaling_config)
         latest_ckpt = self.resume_from_checkpoint
         started = restart_pending = False
+        # Fit/transform/shuffle ONCE: gang restarts reuse the prepared
+        # datasets (inputs don't change across restarts).
+        prepared = self._prepared_datasets() if self._datasets else None
         try:
             while True:
                 try:
@@ -59,8 +91,8 @@ class DataParallelTrainer(BaseTrainer):
                         executor.start()
                         started = True
                     config = dict(self._train_loop_config)
-                    if self._datasets:
-                        config["__datasets__"] = dict(self._datasets)
+                    if prepared is not None:
+                        config["__datasets__"] = dict(prepared)
                     executor.start_training(
                         self._train_loop, config, checkpoint=latest_ckpt,
                         trial_name=session.get_trial_name(),

@@ -21,6 +21,8 @@ class JaxTrainer(DataParallelTrainer):
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict] = None,
+                 dataset_config: Optional[Dict] = None,
+                 preprocessor=None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         super().__init__(
             train_loop_per_worker,
@@ -29,6 +31,8 @@ class JaxTrainer(DataParallelTrainer):
             scaling_config=scaling_config,
             run_config=run_config,
             datasets=datasets,
+            dataset_config=dataset_config,
+            preprocessor=preprocessor,
             resume_from_checkpoint=resume_from_checkpoint)
 
     def training_loop(self) -> None:

@@ -32,6 +32,17 @@ from ray_tpu.tune.logger import (  # noqa: F401
     LoggerCallback,
     TBXLoggerCallback,
 )
+from ray_tpu.tune import stopper  # noqa: F401
+from ray_tpu.tune.stopper import (  # noqa: F401
+    CombinedStopper,
+    ExperimentPlateauStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    NoopStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
 from ray_tpu.air import session as _session
 
 

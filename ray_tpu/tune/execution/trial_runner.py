@@ -99,6 +99,8 @@ class Trial:
     def should_stop(self, result: Dict) -> bool:
         if result.get(DONE):
             return True
+        if callable(self.stopping):  # a tune.Stopper
+            return bool(self.stopping(self.trial_id, result))
         for k, v in self.stopping.items():
             if k in result and result[k] >= v:
                 return True
@@ -160,10 +162,17 @@ class TrialRunner:
         os.makedirs(self.experiment_dir, exist_ok=True)
         self.trials: List[Trial] = []
         self._stopping = self._normalize_stop(self.run_config.stop)
+        self._stop_all_requested = False
 
     @staticmethod
     def _normalize_stop(stop):
-        return dict(stop) if isinstance(stop, dict) else (stop or {})
+        """dict stays a dict (cheap per-trial check); Stopper/callable
+        become a shared tune.Stopper whose stop_all() ends the whole
+        experiment (reference: tune/stopper/)."""
+        if stop is None or isinstance(stop, dict):
+            return dict(stop or {})
+        from ray_tpu.tune.stopper import normalize_stopper
+        return normalize_stopper(stop)
 
     # ------------------------------------------- experiment-level resume
     def _save_experiment_state(self):
@@ -432,6 +441,17 @@ class TrialRunner:
         stuck_since = None
         stuck_resumes = 0
         while True:
+            # Poll experiment-level stoppers every pass, not only on
+            # results: TimeoutStopper must fire during long or hung
+            # iterations too.
+            if not self._stop_all_requested and callable(self._stopping) \
+                    and self._stopping.stop_all():
+                self._stop_all_requested = True
+            if self._stop_all_requested:
+                for t in self.trials:
+                    if t.status in (RUNNING, PAUSED, PENDING):
+                        self._stop_trial(t, TERMINATED)
+                break
             self._apply_scheduler_actions()
             self._start_restored_trials()
             self._fill_trials()
@@ -632,6 +652,11 @@ class TrialRunner:
             decision = STOP
         else:
             decision = self.scheduler.on_trial_result(trial, result)
+        if callable(self._stopping) and self._stopping.stop_all():
+            # Experiment-level stop (TimeoutStopper/ExperimentPlateau):
+            # the run loop terminates every live trial on its next pass.
+            self._stop_all_requested = True
+            decision = STOP
         if decision == STOP:
             if self.ckpt_config.checkpoint_at_end and trial.actor:
                 try:

@@ -1,0 +1,179 @@
+"""Stopper family: programmatic stopping criteria for trials and whole
+experiments (reference: python/ray/tune/stopper/ — Stopper base
+stopper.py:7, MaximumIterationStopper, TimeoutStopper, FunctionStopper,
+TrialPlateauStopper, ExperimentPlateauStopper, CombinedStopper).
+
+Contract: `stopper(trial_id, result) -> bool` stops ONE trial;
+`stopper.stop_all() -> bool` ends the whole experiment (checked by the
+TrialRunner after every result)."""
+
+from __future__ import annotations
+
+import abc
+import collections
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+class Stopper(abc.ABC):
+    @abc.abstractmethod
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        """Should this trial stop now?"""
+
+    def stop_all(self) -> bool:
+        """Should the whole experiment stop?"""
+        return False
+
+
+class NoopStopper(Stopper):
+    def __call__(self, trial_id, result):
+        return False
+
+
+class FunctionStopper(Stopper):
+    """Wrap a plain `fn(trial_id, result) -> bool`."""
+
+    def __init__(self, function: Callable[[str, Dict], bool]):
+        self._fn = function
+
+    def __call__(self, trial_id, result):
+        return bool(self._fn(trial_id, result))
+
+    @classmethod
+    def is_valid_function(cls, fn) -> bool:
+        return callable(fn) and not isinstance(fn, Stopper)
+
+
+class MaximumIterationStopper(Stopper):
+    def __init__(self, max_iter: int):
+        self._max_iter = max_iter
+        self._iter: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        self._iter[trial_id] += 1
+        return self._iter[trial_id] >= self._max_iter
+
+
+class TimeoutStopper(Stopper):
+    """Stop the WHOLE experiment after a wall-clock budget."""
+
+    def __init__(self, timeout: float):
+        if hasattr(timeout, "total_seconds"):  # datetime.timedelta
+            timeout = timeout.total_seconds()
+        self._timeout = float(timeout)
+        self._start = time.monotonic()
+
+    def __call__(self, trial_id, result):
+        return False
+
+    def stop_all(self):
+        return time.monotonic() - self._start >= self._timeout
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial when its metric's moving std plateaus (reference:
+    stopper/trial_plateau.py)."""
+
+    def __init__(self, metric: str, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 metric_threshold: Optional[float] = None,
+                 mode: Optional[str] = None):
+        self._metric = metric
+        self._std = std
+        self._num_results = num_results
+        self._grace = grace_period
+        self._threshold = metric_threshold
+        self._mode = mode
+        self._window: Dict[str, collections.deque] = \
+            collections.defaultdict(
+                lambda: collections.deque(maxlen=num_results))
+        self._count: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        if self._metric not in result:
+            return False
+        v = result[self._metric]
+        self._window[trial_id].append(v)
+        self._count[trial_id] += 1
+        if self._count[trial_id] < self._grace:
+            return False
+        if len(self._window[trial_id]) < self._num_results:
+            return False
+        if self._threshold is not None:
+            if self._mode == "min" and v > self._threshold:
+                return False
+            if self._mode == "max" and v < self._threshold:
+                return False
+        return float(np.std(self._window[trial_id])) <= self._std
+
+
+class ExperimentPlateauStopper(Stopper):
+    """Stop EVERYTHING when the best `top` trial scores plateau
+    (reference: stopper/experiment_plateau.py)."""
+
+    def __init__(self, metric: str, std: float = 0.001, top: int = 10,
+                 mode: str = "min", patience: int = 0):
+        self._metric = metric
+        self._std = std
+        self._top = top
+        self._mode = mode
+        self._patience = patience
+        self._scores: list = []
+        self._strikes = 0
+        self._plateau = False
+
+    def __call__(self, trial_id, result):
+        if self._metric not in result:
+            return False
+        self._scores.append(result[self._metric])
+        self._scores.sort(reverse=(self._mode == "max"))
+        del self._scores[self._top:]
+        if len(self._scores) == self._top and \
+                float(np.std(self._scores)) <= self._std:
+            self._strikes += 1
+        else:
+            self._strikes = 0
+        self._plateau = self._strikes > self._patience
+        return self._plateau
+
+    def stop_all(self):
+        return self._plateau
+
+
+class CombinedStopper(Stopper):
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self._stoppers)
+
+    def stop_all(self):
+        return any(s.stop_all() for s in self._stoppers)
+
+
+class _DictStopper(Stopper):
+    """The classic `stop={"metric": bound}` dict as a Stopper."""
+
+    def __init__(self, criteria: Dict):
+        self._criteria = dict(criteria)
+
+    def __call__(self, trial_id, result):
+        return any(k in result and result[k] >= v
+                   for k, v in self._criteria.items())
+
+
+def normalize_stopper(stop) -> Stopper:
+    """dict / callable / Stopper / None -> Stopper (reference: the
+    stop-argument coercion in tune.run)."""
+    if stop is None:
+        return NoopStopper()
+    if isinstance(stop, Stopper):
+        return stop
+    if isinstance(stop, dict):
+        return _DictStopper(stop)
+    if FunctionStopper.is_valid_function(stop):
+        return FunctionStopper(stop)
+    raise TypeError(
+        f"stop must be a dict, callable, or Stopper; got {type(stop)}")

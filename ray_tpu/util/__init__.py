@@ -1,0 +1,7 @@
+"""Utility APIs (reference: python/ray/util/__init__.py — ActorPool,
+inspect_serializability, metrics, placement groups, queue, collective)."""
+
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.check_serialize import inspect_serializability  # noqa: F401
+
+__all__ = ["ActorPool", "inspect_serializability"]

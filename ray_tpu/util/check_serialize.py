@@ -1,0 +1,114 @@
+"""Debug helper: find WHICH captured object makes a closure/instance
+unpicklable (reference: python/ray/util/check_serialize.py
+inspect_serializability:146 — same recursive frame-walk idea, formatted
+without the colorama dependency)."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+import cloudpickle
+
+
+class FailureTuple:
+    """One serialization failure frame: the failing object, the variable
+    name that references it, and the parent holding that reference."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return (f"FailTuple({self.name} "
+                f"[obj={self.obj!r}, parent={self.parent!r}])")
+
+
+def _check(obj) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _inspect_function(fn, depth, parent, failures, log):
+    closure = inspect.getclosurevars(fn)
+    found = False
+    for kind, mapping in (("global", closure.globals),
+                          ("closure-captured", closure.nonlocals)):
+        for name, obj in mapping.items():
+            if _check(obj):
+                continue
+            log.append(f"{'  ' * depth}{kind} variable {name!r} in "
+                       f"{fn.__qualname__} fails serialization")
+            found = True
+            if depth > 0:
+                _walk(obj, name, depth - 1, fn, failures, log)
+            else:
+                failures.add_frame(obj, name, fn)
+    return found
+
+
+def _inspect_object(obj, depth, parent, failures, log):
+    members = getattr(obj, "__dict__", None)
+    found = False
+    if isinstance(members, dict):
+        for name, attr in members.items():
+            if _check(attr):
+                continue
+            log.append(f"{'  ' * depth}attribute {name!r} of "
+                       f"{type(obj).__name__} fails serialization")
+            found = True
+            if depth > 0:
+                _walk(attr, name, depth - 1, obj, failures, log)
+            else:
+                failures.add_frame(attr, name, obj)
+    return found
+
+
+class _Failures:
+    def __init__(self):
+        self.set: Set[FailureTuple] = set()
+        self._seen = set()
+
+    def add_frame(self, obj, name, parent):
+        key = (id(obj), name)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.set.add(FailureTuple(obj, name, parent))
+
+
+def _walk(obj, name, depth, parent, failures, log):
+    if inspect.isfunction(obj):
+        found = _inspect_function(obj, depth, parent, failures, log)
+    else:
+        found = _inspect_object(obj, depth, parent, failures, log)
+    if not found:
+        # The object itself is the leaf cause.
+        failures.add_frame(obj, name, parent)
+
+
+def inspect_serializability(
+        base_obj: Any, name: Optional[str] = None, depth: int = 3,
+        print_file=None) -> Tuple[bool, Set[FailureTuple]]:
+    """Identify what about `base_obj` fails cloudpickle serialization.
+
+    Returns (serializable, failure_frames).  Output mirrors the
+    reference's tree report but to a plain list of lines."""
+    name = name or getattr(base_obj, "__qualname__", repr(base_obj))
+    failures = _Failures()
+    log: list = []
+    ok = _check(base_obj)
+    if not ok:
+        log.insert(0, f"Checking serializability of {name!r}: FAILED")
+        _walk(base_obj, name, depth, None, failures, log)
+    else:
+        log.insert(0, f"Checking serializability of {name!r}: OK")
+    text = "\n".join(log)
+    if print_file is not None:
+        print(text, file=print_file)
+    elif not ok:
+        print(text)
+    return ok, failures.set

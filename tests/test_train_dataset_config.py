@@ -1,0 +1,80 @@
+"""DatasetConfig ingest roles + trainer preprocessor fitting
+(reference: air/config.py DatasetConfig fill_defaults — "train" splits
+and fits the preprocessor, aux datasets ship whole; BaseTrainer
+preprocess_datasets)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.air import DatasetConfig, ScalingConfig, session
+from ray_tpu.data.preprocessors import StandardScaler
+from ray_tpu.train.jax import JaxConfig, JaxTrainer
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _ingest_loop(config):
+    train_n = session.get_dataset_shard("train").count()
+    valid_n = session.get_dataset_shard("valid").count()
+    session.report({"train_rows": train_n, "valid_rows": valid_n,
+                    "rank": session.get_world_rank()})
+
+
+def test_train_splits_valid_ships_whole(ray_init):
+    train = data.from_items([{"x": float(i)} for i in range(40)],
+                            parallelism=4)
+    valid = data.from_items([{"x": float(i)} for i in range(10)],
+                            parallelism=2)
+    trainer = JaxTrainer(
+        _ingest_loop,
+        datasets={"train": train, "valid": valid},
+        jax_config=JaxConfig(use_distributed=False),
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # rank 0's view: train was split in half, valid arrived whole
+    assert result.metrics["train_rows"] == 20
+    assert result.metrics["valid_rows"] == 10
+
+
+def _scaled_loop(config):
+    shard = session.get_dataset_shard("train")
+    col = np.concatenate(
+        [np.asarray(b["x"]) for b in shard.iter_batches(
+            batch_size=64, batch_format="numpy")])
+    session.report({"mean": float(col.mean()), "std": float(col.std())})
+
+
+def test_preprocessor_fit_and_transform(ray_init):
+    rows = [{"x": float(i)} for i in range(100)]
+    train = data.from_items(rows, parallelism=4)
+    trainer = JaxTrainer(
+        _scaled_loop,
+        datasets={"train": train},
+        preprocessor=StandardScaler(columns=["x"]),
+        jax_config=JaxConfig(use_distributed=False),
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert abs(result.metrics["mean"]) < 1e-6
+    assert abs(result.metrics["std"] - 1.0) < 0.05
+
+
+def test_dataset_config_overrides_and_required(ray_init):
+    ds = data.range(16, parallelism=2)
+    merged = DatasetConfig.validated(
+        {"train": DatasetConfig(split=False)}, {"train": ds})
+    assert merged["train"].split is False
+    assert merged["train"].fit is True  # role default survives override
+    with pytest.raises(ValueError):
+        DatasetConfig.validated(
+            {"extra": DatasetConfig(required=True)}, {"train": ds})

@@ -1,0 +1,95 @@
+"""Stopper family (reference: python/ray/tune/stopper/ — per-trial and
+experiment-level programmatic stopping)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig
+from ray_tpu.tune import Tuner, TuneConfig
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _long_objective(config):
+    for i in range(50):
+        tune.report({"score": float(i)})
+
+
+def test_maximum_iteration_stopper(ray_init):
+    results = Tuner(
+        _long_objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=tune.MaximumIterationStopper(4)),
+    ).fit()
+    for r in results:
+        assert r.metrics["training_iteration"] == 4
+
+
+def test_function_stopper_from_callable(ray_init):
+    results = Tuner(
+        _long_objective,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=lambda tid, res: res["score"] >= 5.0),
+    ).fit()
+    assert results[0].metrics["score"] == 5.0
+
+
+def test_trial_plateau_stopper(ray_init):
+    def plateau(config):
+        for i in range(100):
+            tune.report({"score": min(float(i), 6.0)})
+
+    results = Tuner(
+        plateau,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=tune.TrialPlateauStopper(
+            "score", std=1e-6, num_results=3, grace_period=3)),
+    ).fit()
+    it = results[0].metrics["training_iteration"]
+    assert 9 <= it < 100  # stopped at the plateau, not the iter cap
+
+
+def test_timeout_stopper_ends_experiment(ray_init):
+    import time
+
+    def slow(config):
+        for i in range(1000):
+            time.sleep(0.05)
+            tune.report({"score": float(i)})
+
+    t0 = time.monotonic()
+    Tuner(
+        slow,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=tune.TimeoutStopper(2.0)),
+    ).fit()
+    assert time.monotonic() - t0 < 40  # far below the 50s of work/trial
+
+
+def test_combined_stopper_and_dict_equivalent(ray_init):
+    stop = tune.CombinedStopper(
+        tune.MaximumIterationStopper(10),
+        tune.FunctionStopper(lambda tid, res: res["score"] >= 2.0))
+    results = Tuner(
+        _long_objective,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=stop),
+    ).fit()
+    assert results[0].metrics["score"] == 2.0
+
+
+def test_normalize_stopper_rejects_junk():
+    from ray_tpu.tune.stopper import normalize_stopper
+    with pytest.raises(TypeError):
+        normalize_stopper(42)
