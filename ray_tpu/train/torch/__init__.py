@@ -1,5 +1,6 @@
 from ray_tpu.train.torch.torch_trainer import (  # noqa: F401
     TorchConfig,
     TorchTrainer,
+    prepare_data_loader,
     prepare_model,
 )

@@ -94,6 +94,32 @@ def prepare_model(model):
     return model
 
 
+def prepare_data_loader(data_loader, *, add_dist_sampler: bool = True):
+    """Shard a DataLoader across the gang with a DistributedSampler
+    (reference: train/torch/train_loop_utils.py:262
+    prepare_data_loader).  No-op for single-rank groups or loaders that
+    already carry a DistributedSampler."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, SequentialSampler
+    from torch.utils.data.distributed import DistributedSampler
+    if not (dist.is_initialized() and dist.get_world_size() > 1
+            and add_dist_sampler):
+        return data_loader
+    if isinstance(getattr(data_loader, "sampler", None),
+                  DistributedSampler):
+        return data_loader
+    sampler = DistributedSampler(
+        data_loader.dataset, num_replicas=dist.get_world_size(),
+        rank=dist.get_rank(),
+        shuffle=not isinstance(data_loader.sampler, SequentialSampler))
+    return DataLoader(
+        data_loader.dataset, batch_size=data_loader.batch_size,
+        sampler=sampler, num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last)
+
+
 class TorchTrainer(DataParallelTrainer):
     _backend_config_cls = TorchConfig
 

@@ -1,0 +1,37 @@
+"""train.torch loop utils (reference: train/torch/train_loop_utils.py
+prepare_model :49, prepare_data_loader :262)."""
+
+import torch
+from torch.utils.data import DataLoader, TensorDataset
+
+from ray_tpu.train.torch import prepare_data_loader, prepare_model
+
+
+def test_prepare_model_no_group_is_identity():
+    m = torch.nn.Linear(4, 2)
+    assert prepare_model(m) is m
+
+
+def test_prepare_data_loader_no_group_is_identity():
+    ds = TensorDataset(torch.arange(8.0).reshape(8, 1))
+    dl = DataLoader(ds, batch_size=2)
+    assert prepare_data_loader(dl) is dl
+
+
+def test_prepare_data_loader_with_group(monkeypatch):
+    """Fake a 2-rank group: the loader gets a DistributedSampler that
+    yields this rank's half of the dataset."""
+    import torch.distributed as dist
+
+    monkeypatch.setattr(dist, "is_initialized", lambda: True)
+    monkeypatch.setattr(dist, "get_world_size", lambda: 2)
+    monkeypatch.setattr(dist, "get_rank", lambda: 1)
+    ds = TensorDataset(torch.arange(8.0).reshape(8, 1))
+    dl = DataLoader(ds, batch_size=2)
+    out = prepare_data_loader(dl)
+    from torch.utils.data.distributed import DistributedSampler
+    assert isinstance(out.sampler, DistributedSampler)
+    rows = sum(b[0].shape[0] for b in out)
+    assert rows == 4  # half of 8
+    # already-prepared loaders pass through
+    assert prepare_data_loader(out) is out
