@@ -15,3 +15,4 @@ from ray_tpu.models.gpt import (  # noqa: F401
     param_specs,
 )
 from ray_tpu.models.llama import LlamaConfig  # noqa: F401
+from ray_tpu.models import decode  # noqa: F401

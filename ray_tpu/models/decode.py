@@ -1,0 +1,315 @@
+"""Autoregressive decoding with a KV cache for the flagship models.
+
+The reference delegates inference to user frameworks (vLLM/torch); here
+the model layer is ours, so serving-side decode is part of the
+framework.  TPU-native design constraints drive the shape of this
+module:
+
+  * static shapes everywhere — the cache is a fixed [L, B, max_seq,
+    Hkv, Dh] buffer updated with lax.dynamic_update_slice, and the
+    per-step attention masks positions > pos instead of slicing, so one
+    XLA compilation serves the whole generation;
+  * the decode loop is a lax.scan (one dispatch for the whole
+    generation, not one per token — dispatch latency dominates
+    single-token steps through a tunneled chip);
+  * GQA caches stay at Hkv size (the memory saving is the point of
+    GQA); query-head groups are expanded at the attention einsum.
+
+Single-device path (serve replicas own one chip); the training-side
+mesh machinery (models/gpt.py) is unchanged.  Supports GPT (learned
+positions, fused QKV) and LLaMA (RoPE, GQA, SwiGLU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models import llama as llama_mod
+from ray_tpu.models.gpt import _rmsnorm
+
+
+# ---------------------------------------------------------------------------
+# Arch adapters: how each model family embeds tokens and builds q/k/v/ffn.
+
+
+def _is_llama(cfg) -> bool:
+    return isinstance(cfg, llama_mod.LlamaConfig)
+
+
+def _kv_heads(cfg) -> int:
+    return cfg.n_kv_heads if _is_llama(cfg) else cfg.n_heads
+
+
+def _rope_at(x, positions, theta: float):
+    """RoPE with PER-ROW positions [B, t] (left-padded batches put the
+    same logical position at different columns per row; llama.py's
+    _rope takes one scalar offset for the whole batch)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = positions.astype(jnp.float32)[:, :, None] * freqs[None, None]
+    cos = jnp.cos(pos)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(pos)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def _embed(params, tokens, positions, cfg):
+    """tokens [B, t] at per-row logical positions [B, t]."""
+    x = jnp.take(params["wte"], tokens, axis=0)
+    if not _is_llama(cfg):
+        x = x + jnp.take(params["wpe"], positions, axis=0)
+    return x.astype(cfg.dtype)
+
+
+def _qkv(lp, h, positions, cfg):
+    """h [B, t, D] -> q [B,t,H,Dh], k/v [B,t,Hkv,Dh] (RoPE applied at
+    per-row logical positions for llama)."""
+    dt = cfg.dtype
+    if _is_llama(cfg):
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
+        kv = jnp.einsum("btd,dchk->btchk", h, lp["wkv"].astype(dt))
+        k, v = kv[:, :, 0], kv[:, :, 1]
+        q = _rope_at(q, positions, cfg.rope_theta)
+        k = _rope_at(k, positions, cfg.rope_theta)
+        return q, k, v
+    qkv = jnp.einsum("btd,dchk->btchk", h, lp["wqkv"].astype(dt))
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _ffn(lp, x, cfg):
+    dt = cfg.dtype
+    h = _rmsnorm(x, lp["ln2"])
+    if _is_llama(cfg):
+        g = jax.nn.silu(jnp.einsum("btd,df->btf", h,
+                                   lp["w_gate"].astype(dt)))
+        u = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dt))
+        return x + jnp.einsum("btf,fd->btd", g * u,
+                              lp["w_down"].astype(dt))
+    hh = jax.nn.gelu(jnp.einsum("btd,df->btf", h, lp["w1"].astype(dt)))
+    return x + jnp.einsum("btf,fd->btd", hh, lp["w2"].astype(dt))
+
+
+def _attn_out(lp, out, cfg):
+    return jnp.einsum("bthk,hkd->btd", out, lp["wo"].astype(cfg.dtype))
+
+
+def _final_logits(params, x, cfg):
+    x = _rmsnorm(x, params["ln_f"])
+    return jnp.einsum("btd,dv->btv", x.astype(cfg.dtype),
+                      params["wlm"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Cache
+
+
+def init_cache(cfg, batch: int, max_seq: Optional[int] = None) -> Dict:
+    """Fixed-shape KV cache: k/v [L, B, S, Hkv, Dh] in cfg.dtype."""
+    S = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, S, _kv_heads(cfg), cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_attention(q, ck, cv, pos, pad_lo, cfg):
+    """q [B,1,H,Dh] against the cache's first pos+1 positions (static
+    shape: positions > pos are masked, not sliced; columns < pad_lo[b]
+    are left-padding and masked too).  GQA stays at Hkv width: q is
+    folded to [B,1,Hkv,rep,Dh] and contracted against the Hkv-sized
+    cache — no repeated cache copy per step."""
+    B, S, Hkv, Dh = ck.shape
+    rep = q.shape[2] // Hkv
+    qg = q.reshape(B, 1, Hkv, rep, Dh)
+    scale = cfg.head_dim ** -0.5
+    scores = jnp.einsum("bqgrk,bsgk->bgrqs", qg.astype(jnp.float32),
+                        ck.astype(jnp.float32)) * scale
+    cols = jnp.arange(S)
+    mask = (cols <= pos)[None, :] & (cols[None, :] >= pad_lo[:, None])
+    scores = jnp.where(mask[:, None, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqs,bsgk->bqgrk", probs.astype(cv.dtype), cv)
+    return out.reshape(B, 1, Hkv * rep, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Prefill + single-step decode
+
+
+def prefill(params: Dict, tokens, cfg, cache: Dict, prompt_lens=None
+            ) -> Tuple[Any, Dict]:
+    """Run the prompt [B, T] through the model, filling cache[:, :, :T].
+
+    With `prompt_lens` [B], rows are treated as LEFT-padded to width T:
+    row b's real tokens occupy columns T-len..T-1, get logical
+    positions 0..len-1, and its padding columns are masked out of every
+    attention (they contribute nothing to any real token).
+
+    Returns (logits [B, T, V] fp32, cache)."""
+    B, T = tokens.shape
+    cols = jnp.arange(T)
+    if prompt_lens is None:
+        pad_lo = jnp.zeros((B,), jnp.int32)       # first real column
+        positions = jnp.broadcast_to(cols, (B, T))
+    else:
+        pad_lo = (T - jnp.asarray(prompt_lens, jnp.int32))
+        positions = jnp.maximum(cols[None, :] - pad_lo[:, None], 0)
+    x = _embed(params, tokens, positions, cfg)
+    # causal AND not-padding: [B, q, k].  Pad queries additionally
+    # attend to THEMSELVES: a query with zero valid keys softmaxes an
+    # all--inf row into NaNs, and those NaNs reach real columns through
+    # 0-weight * NaN-value products in the next layer's value einsum —
+    # self-attention keeps pad lanes finite (their outputs are garbage
+    # but masked out of every real token's view).
+    mask = (cols[None, None, :] <= cols[None, :, None]) \
+        & ((cols[None, None, :] >= pad_lo[:, None, None])
+           | (cols[None, None, :] == cols[None, :, None]))
+
+    def layer(x, inputs):
+        lp, ck_l, cv_l = inputs
+        h = _rmsnorm(x, lp["ln1"])
+        q, k, v = _qkv(lp, h, positions, cfg)
+        ck_l = lax.dynamic_update_slice(
+            ck_l, k.astype(ck_l.dtype), (0, 0, 0, 0))
+        cv_l = lax.dynamic_update_slice(
+            cv_l, v.astype(cv_l.dtype), (0, 0, 0, 0))
+        rep = q.shape[2] // k.shape[2]
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scores = jnp.einsum("bqhk,bshk->bhqs", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) \
+            * cfg.head_dim ** -0.5
+        scores = jnp.where(mask[:, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqs,bshk->bqhk", probs.astype(v.dtype), v)
+        x = x + _attn_out(lp, out, cfg)
+        x = _ffn(lp, x, cfg)
+        return x, (ck_l, cv_l)
+
+    x, (ck, cv) = lax.scan(layer, x,
+                           (params["blocks"], cache["k"], cache["v"]))
+    return _final_logits(params, x, cfg), {"k": ck, "v": cv}
+
+
+def decode_step(params: Dict, token, pos, cache: Dict, cfg,
+                pad_lo=None) -> Tuple[Any, Dict]:
+    """One token [B] at cache column pos (scalar int) -> (logits [B, V],
+    updated cache).  pad_lo [B] marks each row's first real cache
+    column (0 without left-padding).  Jit once; every step reuses the
+    compilation."""
+    B = token.shape[0]
+    if pad_lo is None:
+        pad_lo = jnp.zeros((B,), jnp.int32)
+    positions = (pos - pad_lo)[:, None]  # logical position per row
+    x = _embed(params, token[:, None], positions, cfg)
+
+    def layer(x, inputs):
+        lp, ck_l, cv_l = inputs
+        h = _rmsnorm(x, lp["ln1"])
+        q, k, v = _qkv(lp, h, positions, cfg)
+        ck_l = lax.dynamic_update_slice(
+            ck_l, k.astype(ck_l.dtype), (0, pos, 0, 0))
+        cv_l = lax.dynamic_update_slice(
+            cv_l, v.astype(cv_l.dtype), (0, pos, 0, 0))
+        out = _cached_attention(q, ck_l, cv_l, pos, pad_lo, cfg)
+        x = x + _attn_out(lp, out, cfg)
+        x = _ffn(lp, x, cfg)
+        return x, (ck_l, cv_l)
+
+    x, (ck, cv) = lax.scan(layer, x,
+                           (params["blocks"], cache["k"], cache["v"]))
+    return _final_logits(params, x, cfg)[:, 0], {"k": ck, "v": cv}
+
+
+# ---------------------------------------------------------------------------
+# Generation
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
+                                             "temperature", "top_k"))
+def _generate_jit(params, prompt, prompt_lens, cfg, max_new_tokens,
+                  temperature, top_k, key):
+    B, T = prompt.shape
+    S = T + max_new_tokens
+    cache = init_cache(cfg, B, max_seq=S)
+    pad_lo = T - prompt_lens
+    logits, cache = prefill(params, prompt, cfg, cache,
+                            prompt_lens=prompt_lens)
+    key, sub = jax.random.split(key)
+    first = _sample(logits[:, -1], sub, temperature, top_k)
+
+    def step(carry, _):
+        token, pos, cache, key = carry
+        logits, cache = decode_step(params, token, pos, cache, cfg,
+                                    pad_lo=pad_lo)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, temperature, top_k)
+        return (nxt, pos + 1, cache, key), token
+
+    (last, _, _, _), toks = lax.scan(
+        step, (first, jnp.int32(T), cache, key), None,
+        length=max_new_tokens - 1)
+    toks = jnp.moveaxis(toks, 0, 1)  # [B, max_new-1]
+    return jnp.concatenate([toks, last[:, None]], axis=1)
+
+
+def generate(params: Dict, prompt, cfg, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0,
+             key=None, eos_token: Optional[int] = None,
+             prompt_lens=None):
+    """prompt [B, T] -> generated tokens [B, max_new_tokens].
+
+    temperature 0 = greedy; top_k > 0 restricts sampling.  One jit
+    compilation per (shape, cfg, knobs); the whole loop runs on device
+    as a single dispatch.  Mixed-length batches: LEFT-pad each row to a
+    common width and pass `prompt_lens` [B] — pad columns are masked
+    out of attention and logical positions start at each row's first
+    real token, so results match per-row unbatched generation.  With
+    eos_token, each row is truncated at its first EOS (host-side; the
+    device loop stays static-shape)."""
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError("decode supports dense models (MoE "
+                                  "routing caches are not implemented)")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
+    B, T = prompt.shape
+    S = T + max_new_tokens
+    if not _is_llama(cfg) and S > cfg.max_seq:
+        raise ValueError(f"prompt + max_new_tokens = {S} exceeds "
+                         f"max_seq={cfg.max_seq} (learned positions)")
+    key = key if key is not None else jax.random.PRNGKey(0)
+    if prompt_lens is None:
+        prompt_lens = jnp.full((B,), T, jnp.int32)
+    else:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    out = _generate_jit(params, jnp.asarray(prompt, jnp.int32),
+                        prompt_lens, cfg, max_new_tokens,
+                        float(temperature), int(top_k), key)
+    if eos_token is None:
+        return out
+    import numpy as np
+    arr = np.asarray(out)
+    rows = []
+    for row in arr:
+        hits = np.where(row == eos_token)[0]
+        rows.append(row[:hits[0]] if hits.size else row)
+    return rows

@@ -94,30 +94,71 @@ def prepare_model(model):
     return model
 
 
+class _EpochAdvancingLoader:
+    """DataLoader wrapper that bumps the DistributedSampler epoch on
+    every __iter__ — without it, the sampler permutes from (seed, 0)
+    forever and every epoch sees the same order (reference:
+    train_loop_utils.py _WrappedDataLoader's set_epoch handling)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
 def prepare_data_loader(data_loader, *, add_dist_sampler: bool = True):
     """Shard a DataLoader across the gang with a DistributedSampler
     (reference: train/torch/train_loop_utils.py:262
     prepare_data_loader).  No-op for single-rank groups or loaders that
-    already carry a DistributedSampler."""
+    already carry a DistributedSampler.  The returned loader advances
+    the sampler epoch on every __iter__ so shuffle order differs per
+    epoch."""
     import torch.distributed as dist
     from torch.utils.data import DataLoader, SequentialSampler
     from torch.utils.data.distributed import DistributedSampler
     if not (dist.is_initialized() and dist.get_world_size() > 1
             and add_dist_sampler):
         return data_loader
-    if isinstance(getattr(data_loader, "sampler", None),
-                  DistributedSampler):
+    sampler = getattr(data_loader, "sampler", None)
+    if isinstance(sampler, DistributedSampler):
         return data_loader
-    sampler = DistributedSampler(
+    if data_loader.batch_size is None:
+        # batch_sampler loaders report batch_size=None; rebuilding one
+        # with a plain sampler would silently yield UNBATCHED samples.
+        raise ValueError(
+            "prepare_data_loader cannot shard a DataLoader built with "
+            "batch_sampler= (its batching logic cannot be transplanted "
+            "onto a DistributedSampler); construct the per-rank loader "
+            "yourself, e.g. over a DistributedSampler of your dataset")
+    dist_sampler = DistributedSampler(
         data_loader.dataset, num_replicas=dist.get_world_size(),
         rank=dist.get_rank(),
-        shuffle=not isinstance(data_loader.sampler, SequentialSampler))
-    return DataLoader(
-        data_loader.dataset, batch_size=data_loader.batch_size,
-        sampler=sampler, num_workers=data_loader.num_workers,
+        shuffle=not isinstance(sampler, SequentialSampler))
+    kwargs = dict(
+        batch_size=data_loader.batch_size, sampler=dist_sampler,
+        num_workers=data_loader.num_workers,
         collate_fn=data_loader.collate_fn,
         pin_memory=data_loader.pin_memory,
-        drop_last=data_loader.drop_last)
+        drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
+        worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+        persistent_workers=data_loader.persistent_workers)
+    if data_loader.num_workers > 0:
+        kwargs["prefetch_factor"] = data_loader.prefetch_factor
+    return _EpochAdvancingLoader(DataLoader(data_loader.dataset,
+                                            **kwargs), dist_sampler)
 
 
 class TorchTrainer(DataParallelTrainer):

@@ -1,0 +1,147 @@
+"""KV-cache decoding vs the full-forward oracle (models/decode.py).
+
+The contract under test: prefill+decode_step with a static-shape cache
+produce exactly the same next-token logits as running the whole growing
+sequence through forward() — for GPT (learned positions) and LLaMA
+(RoPE + GQA, cache kept at Hkv size)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import decode, gpt, llama
+
+GPT_CFG = gpt.GPTConfig(vocab_size=97, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32, remat=False, use_flash=False)
+LLAMA_CFG = llama.LlamaConfig(vocab_size=97, d_model=32, n_heads=4,
+                              n_kv_heads=2, n_layers=2, d_ff=48,
+                              max_seq=64, dtype=jnp.float32,
+                              remat=False, use_flash=False)
+
+
+def _params(cfg):
+    mod = llama if isinstance(cfg, llama.LlamaConfig) else gpt
+    return mod.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _fwd(cfg):
+    mod = llama if isinstance(cfg, llama.LlamaConfig) else gpt
+    return mod.forward
+
+
+@pytest.mark.parametrize("cfg", [GPT_CFG, LLAMA_CFG],
+                         ids=["gpt", "llama"])
+def test_prefill_matches_forward(cfg):
+    params = _params(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+    cache = decode.init_cache(cfg, 2, max_seq=16)
+    logits, cache = decode.prefill(params, tokens, cfg, cache)
+    oracle = _fwd(cfg)(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(oracle),
+                               rtol=2e-4, atol=2e-4)
+    # cache holds T entries, the rest untouched zeros
+    assert cache["k"].shape[2] == 16
+    assert np.abs(np.asarray(cache["k"][:, :, 9:])).max() == 0.0
+
+
+@pytest.mark.parametrize("cfg", [GPT_CFG, LLAMA_CFG],
+                         ids=["gpt", "llama"])
+def test_decode_step_matches_growing_forward(cfg):
+    params = _params(cfg)
+    B, T, new = 2, 5, 4
+    seq = jax.random.randint(jax.random.PRNGKey(2), (B, T + new), 0,
+                             cfg.vocab_size)
+    cache = decode.init_cache(cfg, B, max_seq=T + new)
+    _, cache = decode.prefill(params, seq[:, :T], cfg, cache)
+    for i in range(new):
+        pos = T + i
+        logits, cache = decode.decode_step(
+            params, seq[:, pos], jnp.int32(pos), cache, cfg)
+        oracle = _fwd(cfg)(params, seq[:, :pos + 1], cfg)[:, -1]
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(oracle),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("cfg", [GPT_CFG, LLAMA_CFG],
+                         ids=["gpt", "llama"])
+def test_greedy_generate_matches_no_cache_argmax(cfg):
+    params = _params(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0,
+                                cfg.vocab_size)
+    out = decode.generate(params, prompt, cfg, max_new_tokens=5)
+    assert out.shape == (2, 5)
+    # oracle: grow the sequence one argmax at a time, full forward each
+    seq = prompt
+    fwd = _fwd(cfg)
+    for _ in range(5):
+        nxt = jnp.argmax(fwd(params, seq, cfg)[:, -1], -1)
+        seq = jnp.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(seq[:, 6:]))
+
+
+def test_sampling_and_eos():
+    params = _params(GPT_CFG)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    a = decode.generate(params, prompt, GPT_CFG, max_new_tokens=6,
+                        temperature=1.0, top_k=8,
+                        key=jax.random.PRNGKey(7))
+    b = decode.generate(params, prompt, GPT_CFG, max_new_tokens=6,
+                        temperature=1.0, top_k=8,
+                        key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # seeded
+    c = decode.generate(params, prompt, GPT_CFG, max_new_tokens=6,
+                        temperature=1.0, top_k=8,
+                        key=jax.random.PRNGKey(9))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    # eos truncation (host-side): force a row to contain the token
+    greedy = decode.generate(params, prompt, GPT_CFG, max_new_tokens=6)
+    eos = int(np.asarray(greedy)[0, 2])
+    rows = decode.generate(params, prompt, GPT_CFG, max_new_tokens=6,
+                           eos_token=eos)
+    assert len(rows[0]) == 2  # cut before the first eos
+
+
+@pytest.mark.parametrize("cfg", [GPT_CFG, LLAMA_CFG],
+                         ids=["gpt", "llama"])
+def test_left_padded_batch_matches_unbatched(cfg):
+    """The serving-critical property: mixed-length prompts left-padded
+    into one batch generate EXACTLY what each row generates alone."""
+    params = _params(cfg)
+    k = jax.random.PRNGKey(5)
+    p_short = jax.random.randint(k, (1, 4), 1, cfg.vocab_size)
+    p_long = jax.random.randint(jax.random.PRNGKey(6), (1, 9), 1,
+                                cfg.vocab_size)
+    solo_short = decode.generate(params, p_short, cfg, max_new_tokens=4)
+    solo_long = decode.generate(params, p_long, cfg, max_new_tokens=4)
+    padded = jnp.concatenate(
+        [jnp.concatenate([jnp.zeros((1, 5), p_short.dtype), p_short], 1),
+         p_long], axis=0)
+    out = decode.generate(params, padded, cfg, max_new_tokens=4,
+                          prompt_lens=jnp.asarray([4, 9]))
+    np.testing.assert_array_equal(np.asarray(out[0]),
+                                  np.asarray(solo_short[0]))
+    np.testing.assert_array_equal(np.asarray(out[1]),
+                                  np.asarray(solo_long[0]))
+
+
+def test_generate_bounds_checked():
+    params = _params(GPT_CFG)
+    prompt = jnp.zeros((1, 60), jnp.int32)
+    with pytest.raises(ValueError):
+        decode.generate(params, prompt, GPT_CFG, max_new_tokens=10)
+    moe_cfg = gpt.GPTConfig(vocab_size=32, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32, max_seq=32,
+                            n_experts=2, dtype=jnp.float32, remat=False)
+    with pytest.raises(NotImplementedError):
+        decode.generate(gpt.init_params(moe_cfg, jax.random.PRNGKey(0)),
+                        jnp.zeros((1, 4), jnp.int32), moe_cfg,
+                        max_new_tokens=2)
+    with pytest.raises(ValueError):
+        decode.generate(params, jnp.zeros((1, 4), jnp.int32), GPT_CFG,
+                        max_new_tokens=0)

@@ -61,3 +61,9 @@ def test_data_to_train():
 def test_rllib_ppo():
     out = _run("rllib_ppo.py", timeout=480)
     assert "episode_reward_mean" in out
+
+
+@pytest.mark.slow
+def test_serve_llm():
+    out = _run("serve_llm.py", timeout=360)
+    assert "generated:" in out

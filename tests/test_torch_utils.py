@@ -21,17 +21,37 @@ def test_prepare_data_loader_no_group_is_identity():
 def test_prepare_data_loader_with_group(monkeypatch):
     """Fake a 2-rank group: the loader gets a DistributedSampler that
     yields this rank's half of the dataset."""
+    import pytest
     import torch.distributed as dist
 
     monkeypatch.setattr(dist, "is_initialized", lambda: True)
     monkeypatch.setattr(dist, "get_world_size", lambda: 2)
     monkeypatch.setattr(dist, "get_rank", lambda: 1)
     ds = TensorDataset(torch.arange(8.0).reshape(8, 1))
-    dl = DataLoader(ds, batch_size=2)
+    dl = DataLoader(ds, batch_size=2, shuffle=True)
     out = prepare_data_loader(dl)
     from torch.utils.data.distributed import DistributedSampler
     assert isinstance(out.sampler, DistributedSampler)
     rows = sum(b[0].shape[0] for b in out)
     assert rows == 4  # half of 8
+    # epoch advances per pass: shuffled order differs between epochs
+    # (the per-rank SUBSET also changes: the sampler shuffles globally
+    # then strides, so only count and inequality are stable)
+    e1 = torch.cat([b[0] for b in out]).flatten().tolist()
+    e2 = torch.cat([b[0] for b in out]).flatten().tolist()
+    assert len(e1) == len(e2) == 4
+    assert e1 != e2
     # already-prepared loaders pass through
     assert prepare_data_loader(out) is out
+    # batch_sampler loaders are rejected loudly, not silently unbatched
+    from torch.utils.data import BatchSampler, SequentialSampler
+    bs_loader = DataLoader(ds, batch_sampler=BatchSampler(
+        SequentialSampler(ds), batch_size=2, drop_last=False))
+    with pytest.raises(ValueError, match="batch_sampler"):
+        prepare_data_loader(bs_loader)
+    # loader extras survive the rebuild
+    def winit(_):
+        pass
+    dl2 = DataLoader(ds, batch_size=2, worker_init_fn=winit)
+    out2 = prepare_data_loader(dl2)
+    assert out2.worker_init_fn is winit
