@@ -132,6 +132,15 @@ def main():
             except Exception as e:
                 detail[key_ls] = {"error": repr(e)}
 
+    # KV-cache decode throughput on the flagship model (serving path;
+    # each step re-reads every parameter, so the ceiling is HBM
+    # bandwidth / param-bytes, recorded alongside).
+    if on_accel:
+        try:
+            detail["decode"] = _bench_decode()
+        except Exception as e:
+            detail["decode"] = {"error": repr(e)}
+
     # Core-runtime microbenchmarks vs the reference's measured floors
     # (BASELINE.md / release_logs/1.13.0/microbenchmark.json) — the
     # orchestration-overhead story the model number doesn't cover.
@@ -266,6 +275,45 @@ def _bench_long_seq(peak, ceiling_frac=None, seq=4096, batch=8,
             out["mfu_executed_vs_measured_ceiling"] = round(
                 out["mfu_hw_remat_adjusted"] / ceiling_frac, 4)
     return out
+
+
+def _bench_decode(batch=8, prompt_len=128, new_tokens=128):
+    """Autoregressive generation on the flagship GPT (737M bf16):
+    tokens/s across the batch + per-step latency + fraction of the
+    decode bandwidth ceiling (HBM bytes/param-read bound)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import decode, gpt
+    cfg = gpt.GPTConfig(vocab_size=32000, d_model=2048, n_heads=16,
+                        n_layers=12, d_ff=8192, max_seq=1024,
+                        dtype=jnp.bfloat16, remat=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), params)
+    n_params = _param_count(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch, prompt_len), 0, cfg.vocab_size)
+    out = decode.generate(params, prompt, cfg,
+                          max_new_tokens=new_tokens)  # compile+warm
+    jax.device_get(out[0, -1])
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = decode.generate(params, prompt, cfg,
+                              max_new_tokens=new_tokens)
+        jax.device_get(out[0, -1])
+        best = max(best, batch * new_tokens
+                   / (time.perf_counter() - t0))
+    steps_per_s = best / batch
+    # v5e HBM ~819 GB/s; each step streams the full bf16 param set.
+    bw_ceiling_steps = 819e9 / (2 * n_params)
+    return {"tokens_per_sec": round(best, 1),
+            "batch": batch, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "step_ms": round(1e3 / steps_per_s, 2),
+            "params": n_params,
+            "fraction_of_hbm_ceiling": round(
+                steps_per_s / bw_ceiling_steps, 4)}
 
 
 def _bench_subprocess(module: str, args: list, timeout: int) -> dict:
