@@ -229,6 +229,54 @@ def decode_step(params: Dict, token, pos, cache: Dict, cfg,
     return _final_logits(params, x, cfg)[:, 0], {"k": ck, "v": cv}
 
 
+def chunk_step(params: Dict, tokens, pos, cache: Dict, cfg,
+               pad_lo=None) -> Tuple[Any, Dict]:
+    """Decode a CHUNK of t tokens [B, t] starting at cache column pos
+    (scalar) in one forward: used by speculative verification, where
+    the draft's t tokens are scored together instead of one dispatch
+    per token.  Returns (logits [B, t, V], cache with the chunk's K/V
+    written at pos..pos+t-1)."""
+    B, t = tokens.shape
+    if pad_lo is None:
+        pad_lo = jnp.zeros((B,), jnp.int32)
+    offs = jnp.arange(t)
+    positions = (pos + offs)[None, :] - pad_lo[:, None]
+    x = _embed(params, tokens, positions, cfg)
+
+    def layer(x, inputs):
+        lp, ck_l, cv_l = inputs
+        h = _rmsnorm(x, lp["ln1"])
+        q, k, v = _qkv(lp, h, positions, cfg)
+        ck_l = lax.dynamic_update_slice(
+            ck_l, k.astype(ck_l.dtype), (0, pos, 0, 0))
+        cv_l = lax.dynamic_update_slice(
+            cv_l, v.astype(cv_l.dtype), (0, pos, 0, 0))
+        # q col i (global pos+i) sees cache cols in [pad_lo, pos+i].
+        S = ck_l.shape[1]
+        Hkv = ck_l.shape[2]
+        rep = q.shape[2] // Hkv
+        qg = q.reshape(B, t, Hkv, rep, -1)
+        scores = jnp.einsum("bqgrk,bsgk->bgrqs",
+                            qg.astype(jnp.float32),
+                            ck_l.astype(jnp.float32)) \
+            * cfg.head_dim ** -0.5
+        cols = jnp.arange(S)
+        mask = (cols[None, None, :] <= (pos + offs)[None, :, None]) \
+            & (cols[None, None, :] >= pad_lo[:, None, None])
+        scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bgrqs,bsgk->bqgrk", probs.astype(cv_l.dtype),
+                         cv_l)
+        out = out.reshape(B, t, q.shape[2], -1)
+        x = x + _attn_out(lp, out, cfg)
+        x = _ffn(lp, x, cfg)
+        return x, (ck_l, cv_l)
+
+    x, (ck, cv) = lax.scan(layer, x,
+                           (params["blocks"], cache["k"], cache["v"]))
+    return _final_logits(params, x, cfg), {"k": ck, "v": cv}
+
+
 # ---------------------------------------------------------------------------
 # Generation
 
@@ -271,10 +319,97 @@ def _generate_jit(params, prompt, prompt_lens, cfg, max_new_tokens,
     return jnp.concatenate([toks, last[:, None]], axis=1)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
+                                             "ngram", "k"))
+def _generate_speculative_jit(params, prompt, prompt_lens, cfg,
+                              max_new_tokens, ngram, k):
+    """Greedy prompt-lookup speculative decoding (the draft model is
+    the context itself: the k tokens that followed the most recent
+    earlier occurrence of the current n-gram).  One chunk_step scores
+    all k drafts + the bonus token per iteration; the acceptance rule
+    (keep the longest prefix where draft == argmax) makes the output
+    IDENTICAL to plain greedy decode — speculation changes dispatch
+    count, never results.  Stale cache/buffer entries past the accept
+    point sit at columns > pos and are invisible to the masked
+    attention until overwritten."""
+    B, T = prompt.shape
+    S = T + max_new_tokens + k + 1  # slack for the last chunk's writes
+    cache = init_cache(cfg, B, max_seq=S)
+    pad_lo = T - prompt_lens
+    logits, cache = prefill(params, prompt, cfg, cache,
+                            prompt_lens=prompt_lens)
+    first = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    buf = jnp.concatenate(
+        [prompt.astype(jnp.int32),
+         jnp.zeros((B, S - T), jnp.int32)], axis=1)
+    buf = lax.dynamic_update_slice(buf, first[:, None], (0, T))
+    end = T + max_new_tokens
+
+    def lookup(buf, pos):
+        """Per row: tokens following the latest earlier occurrence of
+        buf[pos-ngram+1 .. pos] (the n-gram ENDING at the pending
+        token); zeros when no match."""
+        key = lax.dynamic_slice(
+            buf, (0, pos - (ngram - 1)), (B, ngram))
+        # windows starting at j cover buf[j .. j+ngram-1]
+        idx = jnp.arange(S - ngram + 1)[:, None] + jnp.arange(ngram)
+        wins = buf[:, idx]                       # [B, S-n+1, n]
+        hit = jnp.all(wins == key[:, None, :], axis=-1)
+        starts = jnp.arange(S - ngram + 1)
+        # candidate must END before pos and leave room to read k tokens
+        ok = (starts + ngram - 1 < pos) & hit
+        j = jnp.max(jnp.where(ok, starts, -1), axis=1)  # latest match
+        has = j >= 0
+        draft_start = jnp.where(has, j + ngram, 0)
+        gather = draft_start[:, None] + jnp.arange(k)[None]
+        draft = jnp.take_along_axis(buf, gather, axis=1)
+        return jnp.where(has[:, None], draft, 0)
+
+    def cond(carry):
+        _, pos, _, _, _ = carry
+        return pos < end
+
+    def body(carry):
+        token, pos, cache, buf, iters = carry
+        draft = lookup(buf, pos)                       # [B, k]
+        chunk = jnp.concatenate([token[:, None], draft], axis=1)
+        logits, cache = chunk_step(params, chunk, pos, cache, cfg,
+                                   pad_lo=pad_lo)
+        preds = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k+1]
+        # accepted[i] = all drafts before i matched the model
+        match = preds[:, :-1] == draft                 # [B, k]
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        m = jnp.sum(acc, axis=1)                       # 0..k per row
+        # lockstep batch: advance by the batch MINIMUM (every row's
+        # cache write head must stay identical for the shared pos)
+        m_min = jnp.minimum(jnp.min(m), end - 1 - pos)
+        # outputs: accepted drafts then the bonus prediction at m_min
+        out_chunk = jnp.concatenate([draft, jnp.zeros((B, 1),
+                                                      jnp.int32)], 1)
+        bonus = jnp.take_along_axis(preds, m_min[None].repeat(B)[:,
+                                                                 None],
+                                    axis=1)[:, 0]
+        out_chunk = jnp.where(
+            jnp.arange(k + 1)[None, :] == m_min, bonus[:, None],
+            out_chunk)
+        keep = jnp.arange(k + 1)[None, :] <= m_min
+        cur = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
+        buf = lax.dynamic_update_slice(
+            buf, jnp.where(keep, out_chunk, cur), (0, pos + 1))
+        token = bonus
+        return token, pos + m_min + 1, cache, buf, iters + 1
+
+    token0 = first
+    carry = (token0, jnp.int32(T), cache, buf, jnp.int32(0))
+    _, _, _, buf, iters = lax.while_loop(cond, body, carry)
+    return lax.dynamic_slice(buf, (0, T), (B, max_new_tokens)), iters
+
+
 def generate(params: Dict, prompt, cfg, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
              key=None, eos_token: Optional[int] = None,
-             prompt_lens=None):
+             prompt_lens=None, speculate_ngram: int = 0,
+             speculate_k: int = 0, return_stats: bool = False):
     """prompt [B, T] -> generated tokens [B, max_new_tokens].
 
     temperature 0 = greedy; top_k > 0 restricts sampling.  One jit
@@ -292,24 +427,45 @@ def generate(params: Dict, prompt, cfg, *, max_new_tokens: int,
         raise ValueError(f"max_new_tokens must be >= 1, "
                          f"got {max_new_tokens}")
     B, T = prompt.shape
-    S = T + max_new_tokens
+    S = T + max_new_tokens + (speculate_k + 1 if speculate_k else 0)
     if not _is_llama(cfg) and S > cfg.max_seq:
-        raise ValueError(f"prompt + max_new_tokens = {S} exceeds "
-                         f"max_seq={cfg.max_seq} (learned positions)")
+        raise ValueError(f"prompt + max_new_tokens (+ speculative "
+                         f"slack) = {S} exceeds max_seq={cfg.max_seq} "
+                         f"(learned positions)")
     key = key if key is not None else jax.random.PRNGKey(0)
     if prompt_lens is None:
         prompt_lens = jnp.full((B,), T, jnp.int32)
     else:
         prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
-    out = _generate_jit(params, jnp.asarray(prompt, jnp.int32),
-                        prompt_lens, cfg, max_new_tokens,
-                        float(temperature), int(top_k), key)
-    if eos_token is None:
-        return out
-    import numpy as np
-    arr = np.asarray(out)
-    rows = []
-    for row in arr:
-        hits = np.where(row == eos_token)[0]
-        rows.append(row[:hits[0]] if hits.size else row)
-    return rows
+    stats = None
+    if speculate_k > 0:
+        # Prompt-lookup speculation: greedy-only (sampled acceptance
+        # needs rejection sampling; out of scope) — the output is
+        # bit-identical to plain greedy decode, only faster.
+        if temperature > 0.0:
+            raise ValueError("speculative decoding is greedy-only "
+                             "(temperature must be 0)")
+        if speculate_ngram < 1:
+            raise ValueError("speculate_ngram must be >= 1 when "
+                             "speculate_k is set")
+        if T < speculate_ngram:
+            raise ValueError(f"prompt length {T} shorter than "
+                             f"speculate_ngram={speculate_ngram}")
+        out, iters = _generate_speculative_jit(
+            params, jnp.asarray(prompt, jnp.int32), prompt_lens, cfg,
+            max_new_tokens, int(speculate_ngram), int(speculate_k))
+        stats = {"verify_steps": int(iters),
+                 "tokens_per_step": max_new_tokens / max(1, int(iters))}
+    else:
+        out = _generate_jit(params, jnp.asarray(prompt, jnp.int32),
+                            prompt_lens, cfg, max_new_tokens,
+                            float(temperature), int(top_k), key)
+    if eos_token is not None:
+        import numpy as np
+        arr = np.asarray(out)
+        rows = []
+        for row in arr:
+            hits = np.where(row == eos_token)[0]
+            rows.append(row[:hits[0]] if hits.size else row)
+        out = rows
+    return (out, stats) if return_stats else out

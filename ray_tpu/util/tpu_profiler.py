@@ -1,0 +1,84 @@
+"""XLA/TPU device profiling (the accelerator-side complement of
+`ray_tpu.timeline()`'s host-side chrome trace).
+
+The reference's `ray timeline` shows task/actor scheduling; what it
+cannot show is where the chip time goes inside a jitted step.  This
+wraps `jax.profiler` so a trace lands in the session directory (or any
+dir) and can be opened in TensorBoard/Perfetto, and works inside remote
+tasks/actors — each process writes to its own subdirectory, so a gang
+profile is one directory tree.
+
+    from ray_tpu.util import tpu_profiler
+
+    with tpu_profiler.trace():          # session-dir default
+        state, m = step(state, tokens)
+
+    tpu_profiler.start(); ...; path = tpu_profiler.stop()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+_active_dir: Optional[str] = None
+
+
+def default_trace_dir() -> str:
+    """<session_dir>/tpu_profile/<pid> when a runtime session exists,
+    else /tmp/ray_tpu/tpu_profile/<pid>."""
+    base = os.environ.get("RT_SESSION_DIR", "/tmp/ray_tpu")
+    try:
+        from ray_tpu._private import api as _api
+        node = getattr(_api, "_head_node", None)
+        if node is not None and getattr(node, "session_dir", None):
+            base = node.session_dir
+    except Exception:
+        pass
+    return os.path.join(base, "tpu_profile",
+                        f"{int(time.time())}-{os.getpid()}")
+
+
+def start(trace_dir: Optional[str] = None) -> str:
+    """Begin capturing a device trace; returns the trace directory."""
+    global _active_dir
+    if _active_dir is not None:
+        raise RuntimeError(f"a trace is already active: {_active_dir}")
+    import jax
+    d = trace_dir or default_trace_dir()
+    os.makedirs(d, exist_ok=True)
+    jax.profiler.start_trace(d)
+    _active_dir = d
+    return d
+
+
+def stop() -> str:
+    """Finish the capture; returns the directory holding the trace
+    (open with `tensorboard --logdir <dir>` or upload the contained
+    .trace.json.gz to Perfetto)."""
+    global _active_dir
+    if _active_dir is None:
+        raise RuntimeError("no active trace (call start() first)")
+    import jax
+    jax.profiler.stop_trace()
+    d, _active_dir = _active_dir, None
+    return d
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str] = None):
+    """Context manager: profile the enclosed device work."""
+    d = start(trace_dir)
+    try:
+        yield d
+    finally:
+        stop()
+
+
+def annotate(name: str):
+    """Label a region so it shows up named in the trace (wraps
+    jax.profiler.TraceAnnotation)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,33 @@
+"""Device-trace capture (util/tpu_profiler.py) — works on the CPU
+backend too; the artifact contract is a TensorBoard/Perfetto-loadable
+trace directory."""
+
+import glob
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.util import tpu_profiler
+
+
+def test_trace_context_produces_artifacts(tmp_path):
+    d = str(tmp_path / "prof")
+    with tpu_profiler.trace(d) as got:
+        assert got == d
+        with tpu_profiler.annotate("matmul-region"):
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(f.endswith(".trace.json.gz") or ".xplane." in f
+               for f in files), files
+
+
+def test_start_stop_guards(tmp_path):
+    with pytest.raises(RuntimeError):
+        tpu_profiler.stop()
+    d = tpu_profiler.start(str(tmp_path / "p2"))
+    with pytest.raises(RuntimeError):
+        tpu_profiler.start(str(tmp_path / "p3"))
+    out = tpu_profiler.stop()
+    assert out == d
