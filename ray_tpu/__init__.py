@@ -31,7 +31,10 @@ from ray_tpu._private.api import (  # noqa: F401
     wait,
     wait_placement_group_ready,
 )
-from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu._private.object_ref import (  # noqa: F401
+    ObjectRef,
+    ObjectRefGenerator,
+)
 from ray_tpu.actor import method  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 
@@ -50,6 +53,6 @@ __all__ = [
     "cancel",
     "kill", "get_actor", "nodes", "cluster_resources",
     "available_resources", "get_runtime_context", "get_tpu_ids",
-    "get_gpu_ids", "ObjectRef", "method",
+    "get_gpu_ids", "ObjectRef", "ObjectRefGenerator", "method",
     "exceptions", "__version__",
 ]

@@ -64,6 +64,29 @@ class ObjectRef:
         return _w.global_worker.get_future(self)
 
 
+class ObjectRefGenerator:
+    """Result of a `num_returns="dynamic"` task: the ObjectRefs of the
+    values the generator yielded, in order (reference:
+    DynamicObjectRefGenerator — `ray.get` the outer ref, then iterate).
+    The yielded objects are owned by the task's caller and live for the
+    owner's lifetime."""
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
 import contextvars
 
 _SER_CTX: contextvars.ContextVar[list | None] = contextvars.ContextVar(

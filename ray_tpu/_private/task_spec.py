@@ -59,7 +59,10 @@ class TaskSpec(dict):
         missing = [k for k in self.REQUIRED if k not in self]
         if missing:
             raise ValueError(f"TaskSpec missing fields {missing}")
-        if len(self["return_ids"]) != self["num_returns"]:
+        expected = self["num_returns"]
+        if expected == -1:
+            expected = 1  # dynamic: one visible ObjectRefGenerator ref
+        if len(self["return_ids"]) != expected:
             raise ValueError("return_ids/num_returns mismatch")
         return self
 

@@ -829,6 +829,12 @@ class CoreWorker:
     def submit_task(self, fn_id: bytes, args, kwargs, opts: dict):
         task_id = TaskID.from_random()
         num_returns = opts.get("num_returns", 1)
+        # "dynamic": one visible return (the ObjectRefGenerator); the
+        # per-yield objects get ids for_task_return(task_id, 1..N) on
+        # the executing side and register with the owner on reply.
+        dynamic = num_returns == "dynamic"
+        if dynamic:
+            num_returns = 1
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
@@ -842,7 +848,7 @@ class CoreWorker:
             task_id=task_id,
             fn_id=fn_id,
             args_blob=args_blob,
-            num_returns=num_returns,
+            num_returns=-1 if dynamic else num_returns,
             owner_addr=self.addr,
             return_ids=[r.id for r in refs],
             resources=_normalize_resources(opts),
@@ -1327,6 +1333,36 @@ class CoreWorker:
                 entry.blob = result[1]
                 entry.size = len(result[1])
                 entry.state = INLINE  # last: lock-free readers order on it
+            elif kind == "dynamic":
+                # Generator task: register each yielded object as owned
+                # HERE (the caller is the owner, as for static returns),
+                # then resolve the visible ref to an ObjectRefGenerator.
+                sub_refs = []
+                for rec in result[1]:
+                    sub_oid = ObjectID(rec[0])
+                    sub = OwnedObject()
+                    sub.local_refs = 1  # pinned for the owner's lifetime
+                    if rec[1] == "inline":
+                        sub.blob = rec[2]
+                        sub.size = len(rec[2])
+                        sub.state = INLINE
+                    else:  # (oid, "store", node_id, size)
+                        sub.location = rec[2]
+                        sub.size = rec[3]
+                        sub.state = IN_STORE
+                    self.owned[sub_oid] = sub
+                    sub.set_ready()
+                    # _track=False: the permanent local_refs=1 pin above
+                    # IS the ownership stake — a tracked temp here would
+                    # decrement it to zero on GC and drop the entry.
+                    sub_refs.append(ObjectRef(sub_oid,
+                                              owner_addr=self.addr))
+                from ray_tpu._private.object_ref import ObjectRefGenerator
+                blob, _ = serialization.serialize(
+                    ObjectRefGenerator(sub_refs))
+                entry.blob = blob.to_bytes()
+                entry.size = len(entry.blob)
+                entry.state = INLINE
             else:  # ("store", node_id, size)
                 entry.location = result[1]
                 entry.size = result[2]
@@ -1468,6 +1504,19 @@ class CoreWorker:
         num_returns = spec["num_returns"]
         if num_returns == 0:
             return {"results": []}
+        if num_returns == -1:  # num_returns="dynamic": generator task
+            import inspect as _inspect
+            if not (_inspect.isgenerator(result)
+                    or hasattr(result, "__iter__")):
+                raise TypeError(
+                    'num_returns="dynamic" tasks must return an '
+                    f"iterable/generator, got {type(result).__name__}")
+            task_id = spec["task_id"]
+            dyn = []
+            for i, value in enumerate(result):
+                oid = ObjectID.for_task_return(task_id, i + 1)
+                dyn.append((oid.binary(),) + self._pack_one(oid, value))
+            return {"results": [("dynamic", dyn)]}
         if num_returns == 1:
             values = [result]
         else:
@@ -1478,17 +1527,20 @@ class CoreWorker:
                     f"{len(values)} values")
         out = []
         for oid, value in zip(spec["return_ids"], values):
-            blob, _ = serialization.serialize(value)
-            size = blob.total_size()
-            if size <= cfg.max_direct_call_object_size or self.raylet is None:
-                out.append(("inline", blob.to_bytes()))
-            else:
-                offset = self._run(self._store_create(oid.binary(), size))
-                blob.write_into(self.mapping.slice(offset, size))
-                self._run(self.raylet.request("os_seal",
-                                              {"oid": oid.binary()}))
-                out.append(("store", self.node_id, size))
+            out.append(self._pack_one(oid, value))
         return {"results": out}
+
+    def _pack_one(self, oid, value):
+        """Serialize one return: inline for small values, sealed into
+        the local store otherwise."""
+        blob, _ = serialization.serialize(value)
+        size = blob.total_size()
+        if size <= cfg.max_direct_call_object_size or self.raylet is None:
+            return ("inline", blob.to_bytes())
+        offset = self._run(self._store_create(oid.binary(), size))
+        blob.write_into(self.mapping.slice(offset, size))
+        self._run(self.raylet.request("os_seal", {"oid": oid.binary()}))
+        return ("store", self.node_id, size)
 
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, conn, body):

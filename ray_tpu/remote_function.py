@@ -45,7 +45,10 @@ class RemoteFunction:
             self._exported_by = w
         num_returns = opts.get("num_returns", 1)
         refs = w.submit_task(self._fn_id, args, kwargs, dict(opts))
-        if num_returns == 1:
+        if num_returns == 1 or num_returns == "dynamic":
+            # "dynamic": ray_tpu.get(ref) yields an ObjectRefGenerator
+            # over the task generator's per-item refs (reference:
+            # num_returns="dynamic" tasks).
             return refs[0]
         return refs
 

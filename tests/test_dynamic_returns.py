@@ -1,0 +1,79 @@
+"""Generator tasks: num_returns="dynamic" (reference: dynamic generator
+returns — one visible ref resolving to an ObjectRefGenerator of the
+yielded values' refs, owned by the caller)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_dynamic_generator_basic(ray_init):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    ref = gen.options(num_returns="dynamic").remote(5)
+    out = ray_tpu.get(ref, timeout=60)
+    assert isinstance(out, ray_tpu.ObjectRefGenerator)
+    assert len(out) == 5
+    vals = [ray_tpu.get(r, timeout=60) for r in out]
+    assert vals == [0, 1, 4, 9, 16]
+    # indexing works too
+    assert ray_tpu.get(out[2], timeout=60) == 4
+
+
+def test_dynamic_generator_large_values_ride_the_store(ray_init):
+    @ray_tpu.remote
+    def chunks():
+        for i in range(3):
+            yield np.full((256, 256), i, np.float64)  # ~0.5MB each
+
+    out = ray_tpu.get(chunks.options(num_returns="dynamic").remote(),
+                      timeout=60)
+    arrs = [ray_tpu.get(r, timeout=60) for r in out]
+    assert [int(a[0, 0]) for a in arrs] == [0, 1, 2]
+    assert all(a.shape == (256, 256) for a in arrs)
+
+
+def test_dynamic_generator_empty_and_nongenerator(ray_init):
+    @ray_tpu.remote
+    def empty():
+        return iter(())
+
+    out = ray_tpu.get(empty.options(num_returns="dynamic").remote(),
+                      timeout=60)
+    assert len(out) == 0
+
+    @ray_tpu.remote
+    def notgen():
+        return 42
+
+    with pytest.raises(Exception):
+        ray_tpu.get(notgen.options(num_returns="dynamic").remote(),
+                    timeout=60)
+
+
+def test_dynamic_refs_cross_task_boundaries(ray_init):
+    """Refs from the generator can be passed to other tasks."""
+    @ray_tpu.remote
+    def gen():
+        yield 10
+        yield 20
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    g = ray_tpu.get(gen.options(num_returns="dynamic").remote(),
+                    timeout=60)
+    total = ray_tpu.get(add.remote(g[0], g[1]), timeout=60)
+    assert total == 30
