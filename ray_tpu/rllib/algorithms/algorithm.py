@@ -88,6 +88,25 @@ class AlgorithmConfig:
         self._config["policy_server_port"] = server_port
         return self
 
+    def evaluation(self, evaluation_interval=None,
+                   evaluation_duration=None,
+                   evaluation_config=None,
+                   evaluation_max_steps=None) -> "AlgorithmConfig":
+        """Periodic greedy evaluation (reference: algorithm_config.py
+        evaluation() + Algorithm.evaluate): every
+        `evaluation_interval` train() calls, run
+        `evaluation_duration` episodes with exploration off and report
+        under result["evaluation"]."""
+        if evaluation_interval is not None:
+            self._config["evaluation_interval"] = evaluation_interval
+        if evaluation_duration is not None:
+            self._config["evaluation_duration"] = evaluation_duration
+        if evaluation_config is not None:
+            self._config["evaluation_config"] = dict(evaluation_config)
+        if evaluation_max_steps is not None:
+            self._config["evaluation_max_steps"] = evaluation_max_steps
+        return self
+
     def debugging(self, seed=None) -> "AlgorithmConfig":
         if seed is not None:
             self._config["seed"] = seed
@@ -167,8 +186,65 @@ class Algorithm(Trainable):
                           float(np.mean(recent)) if recent else np.nan)
         result["episodes_total"] = len(self._episode_rewards)
         result["timesteps_total"] = self._timesteps_total
+        self._train_iters = getattr(self, "_train_iters", 0) + 1
+        interval = self.algo_config.get("evaluation_interval")
+        if interval and self._train_iters % interval == 0:
+            result.update(self.evaluate())
         result["time_this_iter_s"] = time.time() - t0
         return result
+
+    # -------------------------------------------------------- evaluation
+    def compute_single_action(self, obs, explore: bool = False):
+        """One action for one observation (reference:
+        Algorithm.compute_single_action).  explore=False is greedy
+        (argmax over the policy's logits when it exposes them)."""
+        pol = self.workers.local_worker.policy
+        obs_b = np.asarray(obs, np.float32)[None]
+        if not explore and hasattr(pol, "_forward") \
+                and getattr(self.workers.local_worker, "_discrete", True):
+            import jax.numpy as jnp
+            logits, _ = pol._forward(pol.params, jnp.asarray(obs_b))
+            return int(np.argmax(np.asarray(logits)[0]))
+        action = pol.compute_actions(obs_b)[0]
+        a = np.asarray(action)[0]
+        return int(a) if a.ndim == 0 else a
+
+    def evaluate(self) -> Dict:
+        """Run evaluation_duration episodes with exploration off on a
+        fresh env (reference: Algorithm.evaluate + the separate
+        evaluation worker config); returns {"evaluation": {...}}."""
+        cfg = dict(self.algo_config)
+        cfg.update(cfg.get("evaluation_config") or {})
+        n = int(cfg.get("evaluation_duration", 10))
+        max_steps = int(cfg.get("evaluation_max_steps", 1000))
+        env = _default_env_creator(cfg)
+        lw = self.workers.local_worker
+        rewards, lens = [], []
+        for ep in range(n):
+            obs, _ = env.reset(seed=cfg.get("seed", 0) + 10_000 + ep)
+            total, steps, done = 0.0, 0, False
+            while not done and steps < max_steps:
+                a = self.compute_single_action(
+                    lw._obs_pipe(obs),
+                    explore=bool(cfg.get("evaluation_explore", False)))
+                a = lw._act_pipe(a)
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                steps += 1
+                done = bool(term) or bool(trunc)
+            rewards.append(total)
+            lens.append(steps)
+        try:
+            env.close()
+        except Exception:
+            pass
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+            "episode_len_mean": float(np.mean(lens)),
+            "episodes_this_eval": n,
+        }}
 
     def save_checkpoint(self) -> Dict:
         return {"weights": self.workers.local_worker.get_weights(),

@@ -1,0 +1,57 @@
+"""Algorithm.evaluate / compute_single_action / evaluation_interval
+(reference: rllib Algorithm.evaluate + algorithm_config evaluation())."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_compute_single_action_and_evaluate(ray_init):
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1,
+                      rollout_fragment_length=100)
+            .training(train_batch_size=200, num_sgd_iter=2)).build()
+    try:
+        import gymnasium as gym
+        obs, _ = gym.make("CartPole-v1").reset(seed=0)
+        a = algo.compute_single_action(obs)
+        assert a in (0, 1)
+        # greedy is deterministic
+        assert all(algo.compute_single_action(obs) == a
+                   for _ in range(3))
+        out = algo.evaluate()
+        ev = out["evaluation"]
+        assert ev["episodes_this_eval"] == 10
+        assert ev["episode_reward_min"] <= ev["episode_reward_mean"] \
+            <= ev["episode_reward_max"]
+        assert ev["episode_len_mean"] >= 1
+    finally:
+        algo.stop()
+
+
+def test_evaluation_interval_in_step(ray_init):
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1,
+                      rollout_fragment_length=100)
+            .training(train_batch_size=200, num_sgd_iter=2)
+            .evaluation(evaluation_interval=2, evaluation_duration=2,
+                        evaluation_max_steps=50)).build()
+    try:
+        r1 = algo.train()
+        assert "evaluation" not in r1
+        r2 = algo.train()
+        assert "evaluation" in r2
+        assert r2["evaluation"]["episodes_this_eval"] == 2
+    finally:
+        algo.stop()
