@@ -68,8 +68,12 @@ class ObjectRefGenerator:
     """Result of a `num_returns="dynamic"` task: the ObjectRefs of the
     values the generator yielded, in order (reference:
     DynamicObjectRefGenerator — `ray.get` the outer ref, then iterate).
-    The yielded objects are owned by the task's caller and live for the
-    owner's lifetime."""
+
+    Lifetime: each deserialized generator adds a local-refcount stake
+    for every yielded object in the owner process (released when the
+    generator's refs are GC'd), and the outer task ref holds the
+    initial registration pin — so the yields live while EITHER the
+    outer ref or any fetched generator is alive."""
 
     def __init__(self, refs):
         self._refs = list(refs)
@@ -85,6 +89,28 @@ class ObjectRefGenerator:
 
     def __repr__(self):
         return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+    def __reduce__(self):
+        return (_rebuild_ref_generator,
+                (tuple((r.id, r.owner_addr) for r in self._refs),))
+
+
+def _rebuild_ref_generator(states):
+    """Unpickle hook: reconstruct the generator with TRACKED refs that
+    acquire a stake in the owner's refcount table (no-op in borrower
+    processes, whose owned table doesn't hold these ids)."""
+    from ray_tpu._private import worker as _w
+    w = _w.global_worker
+    refs = []
+    for oid, addr in states:
+        ref = ObjectRef(oid, addr, _track=True)
+        if w is not None:
+            try:
+                w.add_local_ref(ref)
+            except Exception:
+                pass
+        refs.append(ref)
+    return ObjectRefGenerator(refs)
 
 
 import contextvars

@@ -106,7 +106,8 @@ class _RefArg:
 
 class OwnedObject:
     __slots__ = ("state", "blob", "location", "size", "event", "local_refs",
-                 "submitted_task", "reconstructions", "cf_waiters")
+                 "submitted_task", "reconstructions", "cf_waiters",
+                 "dynamic_children")
 
     def __init__(self):
         self.state = PENDING
@@ -120,6 +121,9 @@ class OwnedObject:
         # ObjectRecoveryManager::RecoverObject object_recovery_manager.h:90).
         self.submitted_task = None
         self.reconstructions = 0
+        # Sub-object ids of a num_returns="dynamic" task's yields; freed
+        # when this (main) entry is released.
+        self.dynamic_children = None
         # concurrent.futures waiters from sync get() fast paths on other
         # threads; fired (on the loop thread) the moment the entry lands.
         self.cf_waiters = None
@@ -757,6 +761,22 @@ class CoreWorker:
                 e.location = None
                 e.event = asyncio.Event()
                 reexecutions.append(rid)
+            if oid not in spec["return_ids"]:
+                # A dynamic-returns sub-object: not listed in the spec's
+                # return ids, so reset it here — re-execution re-enters
+                # the dynamic branch and fires THIS entry's fresh event.
+                if entry.reconstructions >= \
+                        cfg.max_object_reconstructions:
+                    raise rexc.ObjectLostError(
+                        oid.hex(),
+                        f"exceeded {cfg.max_object_reconstructions} "
+                        "reconstruction attempts")
+                entry.reconstructions += 1
+                entry.state = PENDING
+                entry.blob = None
+                entry.location = None
+                entry.event = asyncio.Event()
+                reexecutions.append(oid)
             logger.warning(
                 "reconstructing %d object(s) by re-executing task %s",
                 len(reexecutions), task_id.hex()[:8])
@@ -795,6 +815,12 @@ class CoreWorker:
         entry.local_refs -= 1
         if entry.local_refs <= 0 and entry.ready():
             self.owned.pop(ref.id, None)
+            # A dynamic-returns main entry carries its yields' pins:
+            # release them with it (their untracked refs in the
+            # ObjectRefGenerator share the outer ref's lifetime).
+            for child in entry.dynamic_children or ():
+                self.remove_local_ref(ObjectRef(child,
+                                                owner_addr=self.addr))
             if entry.state == IN_STORE and self.loop is not None:
                 try:
                     self._call(self._delete_store_object(ref.id, entry))
@@ -1337,14 +1363,24 @@ class CoreWorker:
                 # Generator task: register each yielded object as owned
                 # HERE (the caller is the owner, as for static returns),
                 # then resolve the visible ref to an ObjectRefGenerator.
+                # Lineage: subs carry the creating task's spec, so a
+                # lost store-resident yield re-executes the generator
+                # (recovery re-enters this branch and updates the SAME
+                # entry objects in place — waiters' events fire).
                 sub_refs = []
+                children = []
                 for rec in result[1]:
                     sub_oid = ObjectID(rec[0])
-                    sub = OwnedObject()
-                    sub.local_refs = 1  # pinned for the owner's lifetime
+                    sub = self.owned.get(sub_oid) or OwnedObject()
+                    if sub.local_refs == 0:
+                        # First registration: the pin lives until the
+                        # MAIN entry is released (dynamic_children).
+                        sub.local_refs = 1
+                    sub.submitted_task = entry.submitted_task
                     if rec[1] == "inline":
                         sub.blob = rec[2]
                         sub.size = len(rec[2])
+                        sub.location = None
                         sub.state = INLINE
                     else:  # (oid, "store", node_id, size)
                         sub.location = rec[2]
@@ -1352,11 +1388,13 @@ class CoreWorker:
                         sub.state = IN_STORE
                     self.owned[sub_oid] = sub
                     sub.set_ready()
-                    # _track=False: the permanent local_refs=1 pin above
-                    # IS the ownership stake — a tracked temp here would
-                    # decrement it to zero on GC and drop the entry.
+                    children.append(sub_oid)
+                    # _track=False: the pin above IS the ownership
+                    # stake — a tracked temp here would decrement it to
+                    # zero on GC and drop the entry.
                     sub_refs.append(ObjectRef(sub_oid,
                                               owner_addr=self.addr))
+                entry.dynamic_children = children
                 from ray_tpu._private.object_ref import ObjectRefGenerator
                 blob, _ = serialization.serialize(
                     ObjectRefGenerator(sub_refs))
@@ -1506,11 +1544,14 @@ class CoreWorker:
             return {"results": []}
         if num_returns == -1:  # num_returns="dynamic": generator task
             import inspect as _inspect
+            # Require an actual generator/iterator — a returned str or
+            # ndarray is iterable but exploding it into per-element
+            # refs is never what the caller meant.
             if not (_inspect.isgenerator(result)
-                    or hasattr(result, "__iter__")):
+                    or hasattr(result, "__next__")):
                 raise TypeError(
-                    'num_returns="dynamic" tasks must return an '
-                    f"iterable/generator, got {type(result).__name__}")
+                    'num_returns="dynamic" tasks must return a '
+                    f"generator/iterator, got {type(result).__name__}")
             task_id = spec["task_id"]
             dyn = []
             for i, value in enumerate(result):

@@ -189,22 +189,36 @@ class Algorithm(Trainable):
         self._train_iters = getattr(self, "_train_iters", 0) + 1
         interval = self.algo_config.get("evaluation_interval")
         if interval and self._train_iters % interval == 0:
-            result.update(self.evaluate())
+            if self.is_multi_agent:
+                if not getattr(self, "_warned_ma_eval", False):
+                    self._warned_ma_eval = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "evaluation_interval is single-agent only; "
+                        "skipping periodic evaluation for this "
+                        "multi-agent algorithm")
+            else:
+                result.update(self.evaluate())
         result["time_this_iter_s"] = time.time() - t0
         return result
 
     # -------------------------------------------------------- evaluation
     def compute_single_action(self, obs, explore: bool = False):
         """One action for one observation (reference:
-        Algorithm.compute_single_action).  explore=False is greedy
-        (argmax over the policy's logits when it exposes them)."""
+        Algorithm.compute_single_action).  explore=False uses the
+        policy's deterministic_actions path (argmax for logits
+        policies, noise-free actor for DDPG/TD3); policies without one
+        fall back to their sampling compute_actions."""
+        if self.is_multi_agent:
+            raise NotImplementedError(
+                "compute_single_action is single-agent; call the "
+                "per-policy compute_actions via "
+                "workers.local_worker.policies[policy_id]")
         pol = self.workers.local_worker.policy
         obs_b = np.asarray(obs, np.float32)[None]
-        if not explore and hasattr(pol, "_forward") \
-                and getattr(self.workers.local_worker, "_discrete", True):
-            import jax.numpy as jnp
-            logits, _ = pol._forward(pol.params, jnp.asarray(obs_b))
-            return int(np.argmax(np.asarray(logits)[0]))
+        if not explore and hasattr(pol, "deterministic_actions"):
+            a = np.asarray(pol.deterministic_actions(obs_b))[0]
+            return int(a) if a.ndim == 0 else a
         action = pol.compute_actions(obs_b)[0]
         a = np.asarray(action)[0]
         return int(a) if a.ndim == 0 else a
@@ -212,7 +226,12 @@ class Algorithm(Trainable):
     def evaluate(self) -> Dict:
         """Run evaluation_duration episodes with exploration off on a
         fresh env (reference: Algorithm.evaluate + the separate
-        evaluation worker config); returns {"evaluation": {...}}."""
+        evaluation worker config); returns {"evaluation": {...}}.
+        Single-agent only (multi-agent envs return per-agent obs dicts
+        this loop doesn't speak)."""
+        if self.is_multi_agent:
+            raise NotImplementedError(
+                "evaluate() is single-agent only in this framework")
         cfg = dict(self.algo_config)
         cfg.update(cfg.get("evaluation_config") or {})
         n = int(cfg.get("evaluation_duration", 10))

@@ -121,6 +121,14 @@ class JaxDDPGPolicy:
         zeros = np.zeros(len(obs), np.float32)
         return a, zeros, zeros
 
+    def deterministic_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Noise-free actor output (evaluation path —
+        Algorithm.compute_single_action(explore=False))."""
+        a = np.asarray(self._forward(self.actor_params,
+                                     jnp.asarray(obs, jnp.float32)))
+        a = np.clip(a, -1.0, 1.0)
+        return np.asarray(self._rescale(jnp.asarray(a)), np.float32)
+
     def value(self, obs: np.ndarray) -> np.ndarray:
         return np.zeros(len(obs), np.float32)
 

@@ -79,6 +79,12 @@ class JaxPolicy:
         _, v = self._forward(self.params, jnp.asarray(obs, jnp.float32))
         return np.asarray(v)
 
+    def deterministic_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Greedy (argmax) actions — the evaluation path."""
+        logits, _ = self._forward(self.params,
+                                  jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
     # ---------------------------------------------------------- learning
     def _loss(self, params, batch):
         """PPO clip objective, or IMPALA's importance-clipped policy

@@ -62,8 +62,10 @@ def stop() -> str:
     if _active_dir is None:
         raise RuntimeError("no active trace (call start() first)")
     import jax
-    jax.profiler.stop_trace()
+    # Clear the guard FIRST: a failing stop_trace must not wedge every
+    # later start() with "a trace is already active".
     d, _active_dir = _active_dir, None
+    jax.profiler.stop_trace()
     return d
 
 

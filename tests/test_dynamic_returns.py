@@ -62,6 +62,43 @@ def test_dynamic_generator_empty_and_nongenerator(ray_init):
                     timeout=60)
 
 
+def test_dynamic_sub_objects_freed_with_outer_ref(ray_init):
+    """Dropping the outer ref releases the yields' pins — no permanent
+    owner-table growth across repeated dynamic calls."""
+    import gc
+
+    from ray_tpu._private import worker as wm
+
+    @ray_tpu.remote
+    def gen():
+        for i in range(4):
+            yield i
+
+    w = wm.global_worker
+    ref = gen.options(num_returns="dynamic").remote()
+    out = ray_tpu.get(ref, timeout=60)
+    sub_ids = [r.id for r in out]
+    assert all(s in w.owned for s in sub_ids)
+    del ref, out
+    gc.collect()
+    import time
+    deadline = time.time() + 10
+    while time.time() < deadline and any(s in w.owned
+                                         for s in sub_ids):
+        time.sleep(0.1)
+    assert not any(s in w.owned for s in sub_ids)
+
+
+def test_dynamic_rejects_plain_iterables(ray_init):
+    @ray_tpu.remote
+    def as_string():
+        return "done"
+
+    with pytest.raises(Exception):
+        ray_tpu.get(as_string.options(num_returns="dynamic").remote(),
+                    timeout=60)
+
+
 def test_dynamic_refs_cross_task_boundaries(ray_init):
     """Refs from the generator can be passed to other tasks."""
     @ray_tpu.remote
