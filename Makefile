@@ -13,8 +13,29 @@
 CXX ?= g++
 CXXFLAGS ?= -std=c++17 -O2
 BUILD := build
+PY ?= python
+# verify's recipe uses pipefail, which POSIX sh (dash) rejects.
+SHELL := /bin/bash
 
-.PHONY: store store-tsan store-asan sanitize clean
+.PHONY: store store-tsan store-asan sanitize clean lint verify check
+
+# --- static + dynamic correctness gates -------------------------------
+# lint: the AST-based distributed-correctness self-check (RTL001-008)
+# over our own tree; fails on any finding NOT in .rtlint-baseline.json.
+# verify: the tier-1 test command from ROADMAP.md.  check: both.
+
+lint:
+	$(PY) -m ray_tpu.lint ray_tpu examples tests \
+		--baseline .rtlint-baseline.json
+
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+		| tee /tmp/_t1.log
+
+check: lint verify
 
 store: ray_tpu/_private/_shm_store.so
 

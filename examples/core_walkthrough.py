@@ -36,7 +36,9 @@ def main():
             return self.n
 
     c = Counter.remote()
-    print("counter:", [ray_tpu.get(c.incr.remote()) for _ in range(3)])
+    # Submit all three first — actor tasks run in submission order, so
+    # one batched get returns [1, 2, 3] without three round trips.
+    print("counter:", ray_tpu.get([c.incr.remote() for _ in range(3)]))
 
     # --- placement group: reserve a resource bundle, run inside it
     from ray_tpu.util.placement_group import (placement_group,

@@ -107,12 +107,13 @@ class Client:
         self.peer = peer
 
     def batch_calls(self, n):
-        ray_tpu.get([self.peer.noop.remote() for _ in range(n)],
+        # Nested get is the scenario being measured (driver-in-an-actor).
+        ray_tpu.get([self.peer.noop.remote() for _ in range(n)],  # noqa: RTL004
                     timeout=120)
         return n
 
     def batch_tasks(self, n):
-        ray_tpu.get([noop.remote() for _ in range(n)], timeout=120)
+        ray_tpu.get([noop.remote() for _ in range(n)], timeout=120)  # noqa: RTL004
         return n
 
 
@@ -170,7 +171,8 @@ def _settle(max_wait: float = 40.0):
         t0 = time.perf_counter()
         n = 0
         while time.perf_counter() - t0 < 0.25:
-            ray_tpu.get(noop.remote(), timeout=60)
+            # The serialized round trip IS the measured quantity.
+            ray_tpu.get(noop.remote(), timeout=60)  # noqa: RTL001,RTL004
             n += 1
         rate = n / (time.perf_counter() - t0)
         if prev and abs(rate - prev) / max(rate, prev) < 0.10:

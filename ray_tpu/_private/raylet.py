@@ -935,7 +935,8 @@ class Raylet:
             return []
         ids: list = []
         if amount >= 1.0 - 1e-9:
-            free = [i for i, used in self._tpu_slots.items() if used == 0.0]
+            free = [i for i, used in self._tpu_slots.items()
+                    if used <= 1e-9]
             k = int(round(amount))
             if len(free) < k:
                 return []
@@ -962,7 +963,12 @@ class Raylet:
                 self._tpu_slots[i] = 0.0
         else:
             for i in lease.tpu_ids:
-                self._tpu_slots[i] = max(0.0, self._tpu_slots[i] - amount)
+                left = self._tpu_slots[i] - amount
+                # Snap float residue to exactly 0.0: non-binary
+                # fractions (three 0.3 leases, say) otherwise leave
+                # ~1e-17 occupancy that blocks whole-chip grants on
+                # this slot forever.
+                self._tpu_slots[i] = 0.0 if left < 1e-9 else left
         lease.tpu_ids = []
 
     # --------------------------------------------------------------- leases

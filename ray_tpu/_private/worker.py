@@ -627,7 +627,21 @@ class CoreWorker:
             except Exception:
                 pass
 
-        return memoryview(_PinView(mv, _release))
+        pv = _PinView(mv, _release)
+        try:
+            # Zero-copy: the returned view keeps the read-pin alive via
+            # _PinView.__buffer__ (PEP 688, Python >= 3.12).
+            return memoryview(pv)
+        except TypeError:
+            # Python < 3.12 ignores __buffer__ — memoryview() refuses
+            # the wrapper.  Disarm pv FIRST (its __del__ must not
+            # release the pin out from under the copy), copy under the
+            # pin, then release exactly once; one copy per store fetch
+            # beats every remote get() crashing.
+            pv._cb = None
+            data = bytes(mv)
+            _release()
+            return data
 
     @staticmethod
     def _remain(deadline):
@@ -1352,9 +1366,18 @@ class CoreWorker:
             return
         for oid, result in zip(spec["return_ids"], reply["results"]):
             entry = self.owned.get(oid)
-            if entry is None:
-                continue
             kind = result[0]
+            if entry is None:
+                if kind == "dynamic":
+                    # The visible generator ref was released but
+                    # deserialized sub-refs keep their own stakes: a
+                    # reconstruction get() may be parked on one of
+                    # them.  Refresh the surviving sub entries so those
+                    # waiters unblock (skipping this was a permanent
+                    # hang: the re-executed generator's results were
+                    # dropped here and the PENDING subs never fired).
+                    self._record_dynamic_children(result[1], entry=None)
+                continue
             if kind == "inline":
                 entry.blob = result[1]
                 entry.size = len(result[1])
@@ -1367,33 +1390,8 @@ class CoreWorker:
                 # lost store-resident yield re-executes the generator
                 # (recovery re-enters this branch and updates the SAME
                 # entry objects in place — waiters' events fire).
-                sub_refs = []
-                children = []
-                for rec in result[1]:
-                    sub_oid = ObjectID(rec[0])
-                    sub = self.owned.get(sub_oid) or OwnedObject()
-                    if sub.local_refs == 0:
-                        # First registration: the pin lives until the
-                        # MAIN entry is released (dynamic_children).
-                        sub.local_refs = 1
-                    sub.submitted_task = entry.submitted_task
-                    if rec[1] == "inline":
-                        sub.blob = rec[2]
-                        sub.size = len(rec[2])
-                        sub.location = None
-                        sub.state = INLINE
-                    else:  # (oid, "store", node_id, size)
-                        sub.location = rec[2]
-                        sub.size = rec[3]
-                        sub.state = IN_STORE
-                    self.owned[sub_oid] = sub
-                    sub.set_ready()
-                    children.append(sub_oid)
-                    # _track=False: the pin above IS the ownership
-                    # stake — a tracked temp here would decrement it to
-                    # zero on GC and drop the entry.
-                    sub_refs.append(ObjectRef(sub_oid,
-                                              owner_addr=self.addr))
+                sub_refs, children = self._record_dynamic_children(
+                    result[1], entry=entry)
                 entry.dynamic_children = children
                 from ray_tpu._private.object_ref import ObjectRefGenerator
                 blob, _ = serialization.serialize(
@@ -1406,6 +1404,47 @@ class CoreWorker:
                 entry.size = result[2]
                 entry.state = IN_STORE
             entry.set_ready()
+
+    def _record_dynamic_children(self, records, entry):
+        """Register/refresh the per-yield objects of a dynamic-returns
+        task.  With `entry` (the task's main owned entry) present this
+        is first registration: unknown subs are created and pinned for
+        the main entry's lifetime.  With `entry=None` (re-execution
+        after the outer ref was released) only subs somebody still owns
+        are updated in place — their fresh events fire and parked
+        recovery get()s resume."""
+        sub_refs = []
+        children = []
+        for rec in records:
+            sub_oid = ObjectID(rec[0])
+            sub = self.owned.get(sub_oid)
+            if sub is None:
+                if entry is None:
+                    continue  # released sub of a released generator
+                sub = OwnedObject()
+            if entry is not None:
+                if sub.local_refs == 0:
+                    # First registration: the pin lives until the
+                    # MAIN entry is released (dynamic_children).
+                    sub.local_refs = 1
+                sub.submitted_task = entry.submitted_task
+            if rec[1] == "inline":
+                sub.blob = rec[2]
+                sub.size = len(rec[2])
+                sub.location = None
+                sub.state = INLINE
+            else:  # (oid, "store", node_id, size)
+                sub.location = rec[2]
+                sub.size = rec[3]
+                sub.state = IN_STORE
+            self.owned[sub_oid] = sub
+            sub.set_ready()
+            children.append(sub_oid)
+            # _track=False: the pin above IS the ownership
+            # stake — a tracked temp here would decrement it to
+            # zero on GC and drop the entry.
+            sub_refs.append(ObjectRef(sub_oid, owner_addr=self.addr))
+        return sub_refs, children
 
     # ------------------------------------------------- blocked notifications
     def _notify_blocked(self):

@@ -53,6 +53,12 @@ class ActorHandle:
         return ActorMethod(self, name, num_returns)
 
     def _invoke(self, method, args, kwargs, num_returns, opts):
+        if num_returns == "dynamic":
+            # Keep this loud: without the check it surfaces as an
+            # obscure TypeError from range() deep in the submitter.
+            raise ValueError(
+                'num_returns="dynamic" is only supported for task '
+                "returns, not actor methods")
         w = worker_mod.global_worker
         opts = dict(opts)
         opts.setdefault("max_task_retries", self._max_task_retries)

@@ -7,10 +7,10 @@ to a worker holding the covering block via binary search on the bounds.
 
 Design notes vs the reference: same architecture (sorted blocks +
 bounds index + worker actors), but lookups inside a worker use numpy
-searchsorted on a cached key column instead of per-row scans, and the
-block→worker assignment is a simple round-robin over the sorted block
-sequence (keeps each worker's blocks contiguous in key space, so batch
-multigets mostly hit one worker).
+searchsorted on a cached key column instead of per-row scans, and each
+worker gets a CONTIGUOUS chunk of the sorted block list
+(np.array_split sizing) so its blocks are adjacent in key space and
+batch multigets over nearby keys mostly hit one worker.
 """
 
 from __future__ import annotations
@@ -98,10 +98,14 @@ class RandomAccessDataset:
         self._workers = [worker_cls.remote(key) for _ in range(n)]
         self._block_to_worker: Dict[int, int] = {}
         assign: List[Dict[int, Any]] = [{} for _ in range(n)]
-        for i, ref in enumerate(self._non_empty):
-            w = i % n
-            self._block_to_worker[i] = w
-            assign[w][i] = ref
+        # Contiguous chunk per worker (round-robin would interleave the
+        # sorted sequence and scatter adjacent keys across workers).
+        for w, idxs in enumerate(
+                np.array_split(np.arange(len(self._non_empty)), n)):
+            for i in idxs:
+                i = int(i)
+                self._block_to_worker[i] = w
+                assign[w][i] = self._non_empty[i]
         ray_tpu.get([w.assign.remote(list(a.keys()), *a.values())
                      for w, a in zip(self._workers, assign) if a],
                     timeout=_GET_TIMEOUT)
@@ -131,14 +135,15 @@ class RandomAccessDataset:
                 misses.append(pos)
             else:
                 per_worker[self._block_to_worker[i]].append((pos, i, k))
-        futs = {}
-        for widx, triples in per_worker.items():
-            idxs = [t[1] for t in triples]
-            vals = [t[2] for t in triples]
-            futs[widx] = self._workers[widx].multiget.remote(idxs, vals)
-        for widx, triples in per_worker.items():
-            rows = ray_tpu.get(futs[widx], timeout=_GET_TIMEOUT)
-            for (pos, _, _), row in zip(triples, rows):
+        widxs = list(per_worker)
+        futs = [self._workers[w].multiget.remote(
+                    [t[1] for t in per_worker[w]],
+                    [t[2] for t in per_worker[w]]) for w in widxs]
+        # One batched get: fetching inside the loop would serialize on
+        # the slowest earlier worker (our own lint rule RTL001).
+        for widx, rows in zip(widxs,
+                              ray_tpu.get(futs, timeout=_GET_TIMEOUT)):
+            for (pos, _, _), row in zip(per_worker[widx], rows):
                 order[pos] = row
         return order
 

@@ -1,0 +1,412 @@
+"""ray_tpu.lint: an AST-based distributed-correctness linter.
+
+Ray-style programs fail in ways no general-purpose linter sees:
+serialized `get()` loops, leaked ObjectRefs, closures that drag a
+module-level array (or a lock) into every task, blocking `get()` inside
+a worker that deadlocks a fixed-size pool.  `util/check_serialize.py`
+catches one of these classes at runtime; this package catches them
+*statically*, before anything runs on a TPU slice.
+
+Usage:
+
+    python -m ray_tpu.lint ray_tpu examples tests
+    rt lint ray_tpu examples tests          # CLI alias
+
+Suppression: a `# noqa` or `# noqa: RTL004` comment on the flagged line.
+Incremental adoption: a JSON baseline file (`--write-baseline`) records
+current per-file/per-code counts; only findings beyond the baseline
+fail the run.
+
+Rule codes (see ray_tpu/lint/rules.py for the implementations):
+
+    RTL001  get() inside a loop on refs produced in that loop
+    RTL002  .remote() result discarded
+    RTL003  large module-level np/jnp array captured by a remote closure
+    RTL004  blocking get()/wait() inside a remote function/actor method
+    RTL005  actor method called without .remote()
+    RTL006  statically-unserializable capture (locks, files, generators)
+    RTL007  jax/jnp compute in a task that requests no TPU resources
+    RTL008  wait() misuse (wrong unpack, get(wait(...)), timeout=0 spin)
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+__all__ = [
+    "Finding", "Rule", "ModuleContext", "register_rule", "all_rules",
+    "lint_source", "lint_file", "lint_paths", "load_baseline",
+    "write_baseline", "apply_baseline", "baseline_key",
+]
+
+# The names ray_tpu exports that the rules care about.  Aliased imports
+# (`import ray_tpu as ray`, `from ray_tpu import get as fetch`) are
+# resolved per-module by ModuleContext.
+_API_BLOCKING = {"get", "wait"}
+_API_NAMES = _API_BLOCKING | {"put", "remote", "kill", "get_actor", "init"}
+_MODULE_NAMES = {"ray_tpu", "ray"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit, pointing at a source location."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+    severity: str = "error"  # "error" | "warning"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.code} [{self.severity}] {self.message}")
+
+
+class Rule:
+    """Base class: subclasses set the class attrs and implement check().
+
+    Registration is explicit via @register_rule so importing the rules
+    module is what populates the registry (no metaclass magic)."""
+
+    code: str = ""
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "ModuleContext", node: ast.AST,
+                message: str) -> Finding:
+        return Finding(code=self.code, message=message, path=ctx.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       severity=self.severity)
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.code:
+        raise ValueError(f"rule {cls.__name__} has no code")
+    if cls.code in _REGISTRY:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    from ray_tpu.lint import rules  # noqa: F401  (populates registry)
+    return dict(_REGISTRY)
+
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z][A-Z0-9 ,]*))?",
+                      re.IGNORECASE)
+
+
+class ModuleContext:
+    """Everything the rules need about one parsed module: the tree with
+    parent links, which local names alias the ray_tpu module/API, which
+    defs are remote, and per-line noqa suppressions."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.lines = source.splitlines()
+        self.noqa: Dict[int, Optional[set]] = self._scan_noqa()
+        # child -> parent links for scope walks.
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # Local aliases of the ray_tpu module and its API functions.
+        self.module_aliases: set = set()
+        self.api_aliases: Dict[str, str] = {}  # local name -> api name
+        self.jax_aliases: set = set()
+        self.np_aliases: set = set()
+        self._scan_imports()
+        # Remote defs: FunctionDef/ClassDef carrying @remote (any
+        # spelling), name -> (node, options dict of decorator kwargs).
+        self.remote_functions: Dict[str, Tuple[ast.AST, dict]] = {}
+        self.remote_classes: Dict[str, Tuple[ast.AST, dict]] = {}
+        self._scan_remote_defs()
+
+    # ------------------------------------------------------------ noqa
+    def _scan_noqa(self) -> Dict[int, Optional[set]]:
+        out: Dict[int, Optional[set]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _NOQA_RE.search(line)
+            if not m:
+                continue
+            codes = m.group("codes")
+            if codes:
+                out[i] = {c.strip().upper()
+                          for c in codes.split(",") if c.strip()}
+            else:
+                out[i] = None  # bare noqa: suppress everything
+        return out
+
+    def suppressed(self, f: Finding) -> bool:
+        if f.line not in self.noqa:
+            return False
+        codes = self.noqa[f.line]
+        return codes is None or f.code in codes
+
+    # --------------------------------------------------------- imports
+    def _scan_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    bound = alias.asname or root
+                    if root in _MODULE_NAMES:
+                        self.module_aliases.add(bound)
+                    elif root == "jax":
+                        self.jax_aliases.add(bound)
+                    elif root == "numpy":
+                        self.np_aliases.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and node.module.split(".")[0] \
+                        in _MODULE_NAMES:
+                    for alias in node.names:
+                        if alias.name in _API_NAMES:
+                            self.api_aliases[alias.asname or alias.name] \
+                                = alias.name
+                elif node.module == "jax":
+                    for alias in node.names:
+                        if alias.name == "numpy":
+                            self.jax_aliases.add(alias.asname or "numpy")
+
+    # ----------------------------------------------------- api matching
+    def api_call_name(self, call: ast.Call) -> Optional[str]:
+        """'get' if `call` invokes ray_tpu.get under any alias, etc.;
+        None for non-API calls."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in self.module_aliases and \
+                fn.attr in _API_NAMES:
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return self.api_aliases.get(fn.id)
+        return None
+
+    def is_remote_call(self, call: ast.Call) -> bool:
+        """True for any `<something>.remote(...)` invocation."""
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "remote")
+
+    def jax_rooted(self, node: ast.AST) -> bool:
+        """True when `node` is an attribute chain rooted at a jax/jnp
+        alias (jnp.dot, jax.jit, jax.numpy.sum, ...)."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in self.jax_aliases
+
+    # ------------------------------------------------------ remote defs
+    def _decorator_remote_opts(self, dec: ast.AST) -> Optional[dict]:
+        """Options when `dec` is some spelling of the remote decorator:
+        @ray_tpu.remote, @remote (imported), @ray_tpu.remote(k=v).
+        Returns the kwarg dict ({} for the bare form), else None."""
+        call_kwargs = None
+        target = dec
+        if isinstance(dec, ast.Call):
+            target = dec.func
+            call_kwargs = {kw.arg: kw.value for kw in dec.keywords
+                           if kw.arg is not None}
+        if isinstance(target, ast.Attribute):
+            if isinstance(target.value, ast.Name) and \
+                    target.value.id in self.module_aliases and \
+                    target.attr == "remote":
+                return call_kwargs or {}
+        elif isinstance(target, ast.Name):
+            if self.api_aliases.get(target.id) == "remote":
+                return call_kwargs or {}
+        return None
+
+    def _scan_remote_defs(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for dec in node.decorator_list:
+                    opts = self._decorator_remote_opts(dec)
+                    if opts is not None:
+                        if isinstance(node, ast.ClassDef):
+                            self.remote_classes[node.name] = (node, opts)
+                        else:
+                            self.remote_functions[node.name] = (node, opts)
+                        break
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                # f = ray_tpu.remote(g) / Actor = ray_tpu.remote(Cls)
+                if self.api_call_name(node.value) == "remote" and \
+                        len(node.value.args) == 1:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            opts = {kw.arg: kw.value
+                                    for kw in node.value.keywords
+                                    if kw.arg is not None}
+                            arg = node.value.args[0]
+                            # Class arg (by convention: capitalized name
+                            # or a known local class) -> actor class.
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id[:1].isupper():
+                                self.remote_classes[tgt.id] = (node, opts)
+                            else:
+                                self.remote_functions[tgt.id] = (node,
+                                                                 opts)
+
+    # ------------------------------------------------------- scope walk
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of def nodes containing `node`."""
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def in_remote_context(self, node: ast.AST) -> bool:
+        """True when `node` executes inside a remote function body or an
+        actor-class method (i.e. on a worker, not the driver)."""
+        remote_fn_nodes = {n for n, _ in self.remote_functions.values()}
+        remote_cls_nodes = {n for n, _ in self.remote_classes.values()}
+        cur = self.parents.get(node)
+        while cur is not None:
+            if cur in remote_fn_nodes:
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner = self.parents.get(cur)
+                if owner in remote_cls_nodes:
+                    return True
+            cur = self.parents.get(cur)
+        return False
+
+
+# ================================================================ engine
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[set] = None) -> List[Finding]:
+    """Lint one module's source; returns findings with noqa applied."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(code="RTL000",
+                        message=f"syntax error: {e.msg}", path=path,
+                        line=e.lineno or 1, col=e.offset or 0)]
+    ctx = ModuleContext(tree, source, path)
+    findings: List[Finding] = []
+    for code, cls in sorted(all_rules().items()):
+        if select and code not in select:
+            continue
+        findings.extend(cls().check(ctx))
+    findings = [f for f in findings if not ctx.suppressed(f)]
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings
+
+
+def lint_file(path: str, select: Optional[set] = None) -> List[Finding]:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+    except OSError as e:
+        return [Finding(code="RTL000", message=f"cannot read: {e}",
+                        path=path, line=1, col=0)]
+    return lint_source(source, path, select=select)
+
+
+_SKIP_DIRS = {".git", "__pycache__", "build", ".eggs", "node_modules"}
+
+
+def iter_python_files(paths: Sequence[str]) -> Tuple[List[str],
+                                                     List[str]]:
+    """(python files under `paths`, paths that don't exist).  Missing
+    paths are reported, not skipped — a typo'd target must not turn
+    the lint gate vacuously green."""
+    out: List[str] = []
+    missing: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        out.append(os.path.join(root, fname))
+        else:
+            missing.append(p)
+    return out, missing
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[set] = None) -> List[Finding]:
+    files, missing = iter_python_files(paths)
+    findings: List[Finding] = [
+        Finding(code="RTL000", message="path does not exist",
+                path=p, line=1, col=0) for p in missing]
+    for fpath in files:
+        findings.extend(lint_file(fpath, select=select))
+    return findings
+
+
+# ============================================================== baseline
+# The baseline maps "relpath::CODE" -> count.  Keys are line-independent
+# so unrelated edits don't churn it; a file may carry at most its
+# recorded number of findings per code, anything beyond is NEW.
+
+def baseline_key(f: Finding, root: str = ".") -> str:
+    rel = os.path.relpath(f.path, root)
+    return f"{rel.replace(os.sep, '/')}::{f.code}"
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    counts = data.get("counts", data)
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def write_baseline(findings: Iterable[Finding], path: str,
+                   root: str = ".",
+                   preserve: Optional[Dict[str, int]] = None
+                   ) -> Dict[str, int]:
+    counts: Dict[str, int] = dict(preserve or {})
+    for f in findings:
+        k = baseline_key(f, root)
+        counts[k] = counts.get(k, 0) + 1
+    payload = {
+        "comment": "ray_tpu.lint baseline: pre-existing finding counts "
+                   "per file::code; regenerate with --write-baseline",
+        "counts": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return counts
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, int],
+                   root: str = ".") -> List[Finding]:
+    """Findings NOT covered by the baseline (per-key overflow keeps the
+    highest-line hits, so the report points at the newest code)."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        k = baseline_key(f, root)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    return new

@@ -1,0 +1,135 @@
+"""`python -m ray_tpu.lint <paths>` — run the distributed-correctness
+linter and exit non-zero on NEW (non-baselined) findings.
+
+The default baseline is `.rtlint-baseline.json` in the current
+directory when present; `--no-baseline` ignores it, `--write-baseline`
+regenerates it from the current findings (the adoption workflow:
+baseline the backlog once, keep every new finding at zero).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ray_tpu.lint import (all_rules, apply_baseline, lint_paths,
+                          load_baseline, write_baseline)
+
+DEFAULT_BASELINE = ".rtlint-baseline.json"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.lint",
+        description="AST-based distributed-correctness linter for "
+                    "ray_tpu programs")
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="files or directories to lint")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                        "in the current directory, when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report all findings, ignoring any baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings as the baseline "
+                        "and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule codes to run "
+                        "(default: all)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for code, cls in sorted(all_rules().items()):
+            print(f"{code}  {cls.severity:7s} {cls.name}: "
+                  f"{cls.description}")
+        return 0
+
+    select = ({c.strip().upper() for c in args.select.split(",")}
+              if args.select else None)
+    findings = lint_paths(args.paths, select=select)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        if select:
+            print("error: --write-baseline with --select would drop "
+                  "every other rule's baselined findings; rerun "
+                  "without --select", file=sys.stderr)
+            return 2
+        broken = [f for f in findings if f.code == "RTL000"]
+        if broken:
+            # Baselining a missing-path/unreadable finding would make
+            # a typo'd lint target permanently green.
+            for f in broken:
+                print(f.format(), file=sys.stderr)
+            print("error: refusing to write a baseline over "
+                  "missing/unreadable paths", file=sys.stderr)
+            return 2
+        path = args.baseline or DEFAULT_BASELINE
+        # Regenerate counts only for files under the scanned paths;
+        # keys outside the scan scope are preserved, so a narrowed
+        # invocation can't silently gut the checked-in baseline.
+        preserve = {}
+        if os.path.exists(path):
+            try:
+                old = load_baseline(path)
+            except (OSError, ValueError):
+                old = {}
+            roots = [os.path.relpath(p).replace(os.sep, "/").rstrip("/")
+                     for p in args.paths]
+
+            def in_scope(key: str) -> bool:
+                rel = key.split("::", 1)[0]
+                # A root of "." scans the whole tree (keys are
+                # cwd-relative, never "./"-prefixed).
+                return any(r == "." or rel == r
+                           or rel.startswith(r + "/") for r in roots)
+
+            preserve = {k: v for k, v in old.items()
+                        if not in_scope(k)}
+        counts = write_baseline(findings, path, preserve=preserve)
+        print(f"wrote {sum(counts.values())} baselined finding(s) "
+              f"across {len(counts)} file/code key(s) to {path}"
+              + (f" (preserved {len(preserve)} out-of-scope key(s))"
+                 if preserve else ""))
+        return 0
+
+    baselined = 0
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        total = len(findings)
+        findings = apply_baseline(findings, baseline)
+        baselined = total - len(findings)
+
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        tail = (f" ({baselined} baselined finding(s) suppressed)"
+                if baselined else "")
+        if findings:
+            print(f"{n_err} error(s), {n_warn} warning(s){tail}")
+        else:
+            print(f"clean: no new findings{tail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head/a pager that exits
+        sys.exit(0)

@@ -1,0 +1,569 @@
+"""The RTL rule set: distributed anti-patterns over ray_tpu's API.
+
+Each rule is a small AST pass over one ModuleContext.  The rules target
+the surface users actually write against — `@ray_tpu.remote`,
+`.remote()`, `ray_tpu.get/wait/put`, actor handles — under any import
+alias the module declares.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.lint import Finding, ModuleContext, Rule, register_rule
+from ray_tpu.util.check_serialize import KNOWN_UNSERIALIZABLE_CONSTRUCTORS
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_COMPS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _loop_ancestors(ctx: ModuleContext, node: ast.AST) -> List[ast.AST]:
+    """Loop/comprehension nodes containing `node`, innermost first."""
+    out = []
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, _LOOPS + _COMPS):
+            out.append(cur)
+        cur = ctx.parents.get(cur)
+    return out
+
+
+def _contains_remote_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and ctx.is_remote_call(n)
+               for n in ast.walk(node))
+
+
+@register_rule
+class GetInLoop(Rule):
+    code = "RTL001"
+    name = "get-in-loop"
+    severity = "warning"
+    description = ("get() inside a loop on refs produced in that loop "
+                   "serializes the fetches; collect the refs and issue "
+                   "one get([...]) instead")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.api_call_name(node) == "get"):
+                continue
+            loops = _loop_ancestors(ctx, node)
+            if not loops or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, (ast.List, ast.Tuple) + _COMPS):
+                # Batched get([...]): the aggregation is the fix, even
+                # when the surrounding code loops for other reasons.
+                continue
+            if isinstance(arg, ast.Call) and \
+                    _contains_remote_call(ctx, arg):
+                yield self.finding(
+                    ctx, node,
+                    "get() of a .remote() call inside a loop fetches "
+                    "results one at a time; submit all tasks first, "
+                    "then get() the list of refs")
+                continue
+            if isinstance(arg, ast.Name):
+                loop = loops[0]
+                for sub in ast.walk(loop):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Call) and \
+                            _contains_remote_call(ctx, sub.value) and \
+                            any(isinstance(t, ast.Name)
+                                and t.id == arg.id
+                                for t in sub.targets):
+                        yield self.finding(
+                            ctx, node,
+                            f"get({arg.id}) fetches a ref produced in "
+                            "the same loop iteration; submit all tasks "
+                            "first, then get() the list of refs")
+                        break
+
+
+def _options_chain_kwargs(call: ast.Call) -> Dict[str, ast.AST]:
+    """kwargs of every .options(...) call in `x.options(...).remote()`
+    style chains, merged."""
+    out: Dict[str, ast.AST] = {}
+    cur: ast.AST = call.func
+    while isinstance(cur, ast.Attribute):
+        cur = cur.value
+        if isinstance(cur, ast.Call) and \
+                isinstance(cur.func, ast.Attribute) and \
+                cur.func.attr == "options":
+            for kw in cur.keywords:
+                if kw.arg is not None:
+                    out.setdefault(kw.arg, kw.value)
+            cur = cur.func
+    return out
+
+
+def _remote_call_base_name(call: ast.Call) -> Optional[str]:
+    """The root Name a `.remote()` chain dispatches on: 'f' for
+    f.remote() and A.options(...).remote(), None for deeper chains
+    (handle.method.remote(), obj.attr.remote())."""
+    cur: ast.AST = call.func
+    if not (isinstance(cur, ast.Attribute) and cur.attr == "remote"):
+        return None
+    cur = cur.value
+    while isinstance(cur, ast.Call) and \
+            isinstance(cur.func, ast.Attribute) and \
+            cur.func.attr == "options":
+        cur = cur.func.value
+    return cur.id if isinstance(cur, ast.Name) else None
+
+
+@register_rule
+class DiscardedRemoteResult(Rule):
+    code = "RTL002"
+    name = "discarded-remote-result"
+    severity = "error"
+    description = ("a .remote() call's ObjectRef is discarded: task "
+                   "errors are silently lost and the result may be "
+                   "GC'd before it runs")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and ctx.is_remote_call(node.value)):
+                continue
+            # Exemption opts come from the .options() chain AND the
+            # target's own @remote(...) decorator kwargs (options win).
+            opts = _options_chain_kwargs(node.value)
+            base = _remote_call_base_name(node.value)
+            if base is not None:
+                dec = (ctx.remote_functions.get(base)
+                       or ctx.remote_classes.get(base))
+                if dec is not None:
+                    for k, v in dec[1].items():
+                        opts.setdefault(k, v)
+            lifetime = opts.get("lifetime")
+            if isinstance(lifetime, ast.Constant) and \
+                    lifetime.value == "detached":
+                # Detached actors are re-fetched via get_actor(); the
+                # dropped handle is the documented pattern.
+                continue
+            nr = opts.get("num_returns")
+            if isinstance(nr, ast.Constant) and nr.value == 0:
+                # num_returns=0 is EXPLICIT fire-and-forget: there is
+                # no ObjectRef to lose.
+                continue
+            yield self.finding(
+                ctx, node,
+                ".remote() result discarded — the ObjectRef is the "
+                "only way to observe the task's error or output; "
+                "assign it (and eventually get()/wait() it)")
+
+
+_ARRAY_CTORS = {"zeros", "ones", "empty", "full", "arange", "linspace",
+                "eye", "array", "asarray", "rand", "randn", "random",
+                "uniform", "normal", "standard_normal"}
+# Below this many elements a closure capture is cheap enough to ignore.
+_LARGE_ELEMS = 16384
+
+
+def _literal_elems(call: ast.Call) -> Optional[int]:
+    """Element-count estimate from literal shape args; None=unknown."""
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else None
+    dims: List[int] = []
+
+    def shape_of(node) -> Optional[List[int]]:
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, int) for e in node.elts):
+            return [e.value for e in node.elts]
+        return None
+
+    if name in ("zeros", "ones", "empty", "full", "rand", "randn",
+                "standard_normal"):
+        if name in ("rand", "randn"):
+            for a in call.args:
+                s = shape_of(a)
+                if s is None:
+                    return None
+                dims.extend(s)
+        elif call.args:
+            s = shape_of(call.args[0])
+            if s is None:
+                return None
+            dims = s
+    elif name == "arange" and call.args:
+        s = shape_of(call.args[-1] if len(call.args) < 3
+                     else call.args[1])
+        if s is None:
+            return None
+        dims = s
+    elif name == "linspace" and len(call.args) >= 3:
+        s = shape_of(call.args[2])
+        if s is None:
+            return None
+        dims = s
+    elif name in ("array", "asarray") and call.args:
+        if isinstance(call.args[0], (ast.List, ast.Tuple)):
+            dims = [len(call.args[0].elts)]
+        else:
+            return None
+    else:
+        return None
+    n = 1
+    for d in dims:
+        n *= max(1, d)
+    return n
+
+
+@register_rule
+class ModuleArrayCapture(Rule):
+    code = "RTL003"
+    name = "module-array-closure-capture"
+    severity = "warning"
+    description = ("a large module-level np/jnp array referenced inside "
+                   "a remote function is pickled into EVERY task "
+                   "submission; put() it once and pass the ref")
+
+    def _module_arrays(self, ctx: ModuleContext) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for stmt in ctx.tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            call = stmt.value
+            fn = call.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _ARRAY_CTORS):
+                continue
+            root = fn
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if not (isinstance(root, ast.Name) and
+                    root.id in (ctx.np_aliases | ctx.jax_aliases)):
+                continue
+            n = _literal_elems(call)
+            if n is not None and n < _LARGE_ELEMS:
+                continue  # provably small: capture is harmless
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = stmt
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        arrays = self._module_arrays(ctx)
+        if not arrays:
+            return
+        for _, (node, _opts) in list(ctx.remote_functions.items()) + \
+                list(ctx.remote_classes.items()):
+            if not isinstance(node, _DEFS + (ast.ClassDef,)):
+                continue
+            bound = _locally_bound_names(node)
+            reported = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id in arrays and sub.id not in bound and \
+                        sub.id not in reported:
+                    reported.add(sub.id)
+                    yield self.finding(
+                        ctx, sub,
+                        f"module-level array {sub.id!r} is captured by "
+                        "this remote closure and reserialized on every "
+                        "submission; store it once with ray_tpu.put() "
+                        "and pass the ObjectRef as an argument")
+
+
+def _locally_bound_names(def_node: ast.AST) -> set:
+    """Names bound anywhere inside `def_node` (params, assignments,
+    imports, loop targets, nested defs): loads of these are NOT free
+    captures."""
+    bound = set()
+    for sub in ast.walk(def_node):
+        if isinstance(sub, _DEFS):
+            a = sub.args
+            for p in (a.args + a.posonlyargs + a.kwonlyargs
+                      + ([a.vararg] if a.vararg else [])
+                      + ([a.kwarg] if a.kwarg else [])):
+                bound.add(p.arg)
+            bound.add(sub.name)
+        elif isinstance(sub, ast.Name) and \
+                isinstance(sub.ctx, (ast.Store, ast.Del)):
+            bound.add(sub.id)
+        elif isinstance(sub, ast.ClassDef):
+            bound.add(sub.name)
+        elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+            for alias in sub.names:
+                bound.add(alias.asname or alias.name.split(".")[0])
+    return bound
+
+
+@register_rule
+class BlockingGetInTask(Rule):
+    code = "RTL004"
+    name = "blocking-get-in-task"
+    severity = "error"
+    description = ("get()/wait() inside a remote function or actor "
+                   "method blocks a worker slot while it waits on other "
+                   "tasks — with a fixed-size pool this deadlocks")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            api = ctx.api_call_name(node)
+            if api not in ("get", "wait"):
+                continue
+            if ctx.in_remote_context(node):
+                yield self.finding(
+                    ctx, node,
+                    f"blocking {api}() inside a remote function/actor "
+                    "method holds its worker slot while waiting on "
+                    "other tasks (nested-get deadlock with a bounded "
+                    "pool); pass the refs as task args so the runtime "
+                    "resolves them, or restructure onto the driver")
+
+
+@register_rule
+class ActorMethodWithoutRemote(Rule):
+    code = "RTL005"
+    name = "actor-call-missing-remote"
+    severity = "error"
+    description = ("calling handle.method(...) invokes nothing — actor "
+                   "methods are only dispatched via "
+                   "handle.method.remote(...)")
+
+    def _handle_names(self, ctx: ModuleContext) -> set:
+        handles = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            is_handle = False
+            if ctx.api_call_name(call) == "get_actor":
+                is_handle = True
+            elif isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "remote":
+                base = call.func.value
+                # Cls.remote() or Cls.options(...).remote()
+                if isinstance(base, ast.Call) and \
+                        isinstance(base.func, ast.Attribute) and \
+                        base.func.attr == "options":
+                    base = base.func.value
+                if isinstance(base, ast.Name) and \
+                        base.id in ctx.remote_classes:
+                    is_handle = True
+            if is_handle:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        handles.add(tgt.id)
+        return handles
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        handles = self._handle_names(ctx)
+        if not handles:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in handles):
+                continue
+            if node.func.attr in ("remote", "options"):
+                continue
+            if node.func.attr.startswith("_"):
+                # Handle __getattr__ rejects private names, so a direct
+                # private call is framework-internal plumbing on a real
+                # handle object, not a missed dispatch.
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{node.func.value.id}.{node.func.attr}(...) calls an "
+                "actor method without .remote() — nothing is "
+                f"dispatched; use {node.func.value.id}."
+                f"{node.func.attr}.remote(...)")
+
+
+@register_rule
+class UnserializableCapture(Rule):
+    code = "RTL006"
+    name = "unserializable-capture"
+    severity = "error"
+    description = ("a remote closure captures a value (lock, file "
+                   "handle, generator, ...) that can never survive "
+                   "serialization to a worker")
+
+    def _unserializable_bindings(
+            self, ctx: ModuleContext) -> Dict[str, Tuple[ast.AST, str]]:
+        # Local aliases for the modules named in the shared table.
+        table_modules = {m for m, _ in KNOWN_UNSERIALIZABLE_CONSTRUCTORS
+                         if m}
+        mod_alias: Dict[str, str] = {}
+        from_alias: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root in table_modules:
+                        mod_alias[alias.asname or root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                root = node.module.split(".")[0]
+                if root in table_modules:
+                    for alias in node.names:
+                        from_alias[alias.asname or alias.name] = \
+                            (root, alias.name)
+
+        out: Dict[str, Tuple[ast.AST, str]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            reason = None
+            if isinstance(val, ast.GeneratorExp):
+                reason = "generators capture a paused stack frame"
+            elif isinstance(val, ast.Call):
+                fn = val.func
+                if isinstance(fn, ast.Name):
+                    key = from_alias.get(fn.id)
+                    if key is None and fn.id == "open":
+                        key = (None, "open")
+                    if key is not None:
+                        reason = KNOWN_UNSERIALIZABLE_CONSTRUCTORS.get(
+                            key)
+                elif isinstance(fn, ast.Attribute) and \
+                        isinstance(fn.value, ast.Name):
+                    mod = mod_alias.get(fn.value.id)
+                    if mod is not None:
+                        reason = KNOWN_UNSERIALIZABLE_CONSTRUCTORS.get(
+                            (mod, fn.attr))
+            if reason is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = (node, reason)
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        bindings = self._unserializable_bindings(ctx)
+        if not bindings:
+            return
+        for _, (node, _opts) in list(ctx.remote_functions.items()) + \
+                list(ctx.remote_classes.items()):
+            if not isinstance(node, _DEFS + (ast.ClassDef,)):
+                continue
+            bound = _locally_bound_names(node)
+            reported = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id in bindings and sub.id not in bound and \
+                        sub.id not in reported:
+                    reported.add(sub.id)
+                    _, reason = bindings[sub.id]
+                    yield self.finding(
+                        ctx, sub,
+                        f"remote closure captures {sub.id!r}, which "
+                        f"cannot be serialized to a worker ({reason}); "
+                        "create it inside the task, or pass "
+                        "serializable state instead")
+
+
+def _requests_tpu(opts: Dict[str, ast.AST]) -> bool:
+    for key in ("num_tpus", "num_gpus"):
+        val = opts.get(key)
+        if val is not None and not (isinstance(val, ast.Constant)
+                                    and not val.value):
+            return True
+    res = opts.get("resources")
+    if isinstance(res, ast.Dict):
+        for k in res.keys:
+            if isinstance(k, ast.Constant) and k.value == "TPU":
+                return True
+    elif res is not None:
+        return True  # non-literal resources: assume the caller knows
+    return False
+
+
+@register_rule
+class JaxWithoutTpuResources(Rule):
+    code = "RTL007"
+    name = "jax-task-without-tpu"
+    severity = "warning"
+    description = ("a remote function running jax/jnp compute but "
+                   "requesting no TPU lands on CPU workers and "
+                   "silently bypasses the accelerator")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.jax_aliases:
+            return
+        for name, (node, opts) in \
+                list(ctx.remote_functions.items()) + \
+                list(ctx.remote_classes.items()):
+            if not isinstance(node, _DEFS + (ast.ClassDef,)):
+                continue
+            if _requests_tpu(opts):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        ctx.jax_rooted(sub.func):
+                    yield self.finding(
+                        ctx, node,
+                        f"remote {'class' if isinstance(node, ast.ClassDef) else 'function'} "  # noqa: E501
+                        f"{name!r} calls jax/jnp ops but its decorator "
+                        "requests no TPU (num_tpus=... or "
+                        'resources={"TPU": ...}); it will run the '
+                        "compute on CPU workers")
+                    break
+
+
+@register_rule
+class WaitMisuse(Rule):
+    code = "RTL008"
+    name = "wait-misuse"
+    severity = "error"
+    description = ("wait() returns (ready, pending); unpacking it any "
+                   "other way, get()ing it directly, or polling with "
+                   "timeout=0 in a loop is a bug")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.api_call_name(node) == "wait"):
+                continue
+            parent = ctx.parents.get(node)
+            if isinstance(parent, ast.Assign) and \
+                    len(parent.targets) == 1 and \
+                    isinstance(parent.targets[0],
+                               (ast.Tuple, ast.List)) and \
+                    len(parent.targets[0].elts) != 2:
+                yield self.finding(
+                    ctx, node,
+                    "wait() returns exactly (ready_refs, pending_refs) "
+                    f"— unpacking into {len(parent.targets[0].elts)} "
+                    "targets will not do what you want")
+            if isinstance(parent, ast.Call) and \
+                    ctx.api_call_name(parent) == "get":
+                yield self.finding(
+                    ctx, node,
+                    "get(wait(...)) fetches the (ready, pending) TUPLE, "
+                    "not the ready values; unpack first and get() the "
+                    "ready list")
+            if isinstance(parent, (ast.For, ast.AsyncFor)) and \
+                    parent.iter is node:
+                yield self.finding(
+                    ctx, node,
+                    "iterating wait() yields the two lists (ready, "
+                    "pending), not individual refs; unpack it")
+            for kw in node.keywords:
+                if kw.arg == "timeout" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value == 0 and \
+                        any(isinstance(a, _LOOPS)
+                            for a in _loop_ancestors(ctx, node)):
+                    yield self.finding(
+                        ctx, node,
+                        "wait(timeout=0) in a loop busy-spins the "
+                        "driver; use a positive timeout (or None) and "
+                        "let wait() block")

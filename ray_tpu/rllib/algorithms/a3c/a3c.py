@@ -64,7 +64,9 @@ class A3C(Algorithm):
         if idle:
             wref = ray_tpu.put(self.workers.local_worker.get_weights())
             for w in idle:
-                w.set_weights.remote(wref)
+                # Fire-and-forget broadcast: the sample get() behind it
+                # observes actor failure.
+                w.set_weights.remote(wref)  # noqa: RTL002
                 self._inflight[w.sample_with_grads.remote(frag)] = w
         applied = 0
         while applied < cfg["grads_per_step"]:
@@ -78,7 +80,7 @@ class A3C(Algorithm):
             policy.apply_grads(grads)
             applied += 1
             trained += count
-            w.set_weights.remote(
+            w.set_weights.remote(  # noqa: RTL002 (next sample observes)
                 ray_tpu.put(self.workers.local_worker.get_weights()))
             self._inflight[w.sample_with_grads.remote(frag)] = w
         self._timesteps_total += trained

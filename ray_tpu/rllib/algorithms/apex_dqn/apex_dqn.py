@@ -148,7 +148,8 @@ class ApexDQN(Algorithm):
             for i, w in enumerate(workers):
                 wcopy = dict(weights)
                 wcopy["epsilon"] = eps[i]
-                w.set_weights.remote(ray_tpu.put(wcopy))
+                # Ordered before sample below; its get() observes errors.
+                w.set_weights.remote(ray_tpu.put(wcopy))  # noqa: RTL002
                 fresh.append(w.sample.remote(per_worker))
         else:
             self.workers.local_worker.policy.epsilon = self._base_epsilon()
@@ -204,7 +205,7 @@ class ApexDQN(Algorithm):
                 if prioritized and "batch_indexes" in batch:
                     # Fire-and-forget priority feedback to the shard the
                     # rows came from; the learner never blocks on it.
-                    shard.update_priorities.remote(
+                    shard.update_priorities.remote(  # noqa: RTL002
                         batch["batch_indexes"], policy.last_td_errors)
                 trained += batch.count
             ray_tpu.get(pending_batch, timeout=120)

@@ -63,7 +63,8 @@ class Impala(Algorithm):
                 if self._since_broadcast >= cfg["broadcast_interval"]:
                     self._since_broadcast = 0
                     wref = ray_tpu.put(self.learner.get_weights())
-                    worker.set_weights.remote(wref)
+                    # Ordered before the next sample dispatch below.
+                    worker.set_weights.remote(wref)  # noqa: RTL002
                 self._inflight[worker.sample.remote(frag)] = worker
         return {"info": {"learner": dict(self.learner.stats),
                          "learner_queue_size": self.learner.inqueue.qsize(),

@@ -51,9 +51,18 @@ class WorkerSet:
         return {"episode_rewards": rewards, "episode_lens": lens}
 
     def stop(self):
+        # All stop() calls in flight before draining: a get() per
+        # worker inside the submit loop serializes the shutdowns.
+        stops = []
         for w in self.remote_workers:
             try:
-                ray_tpu.get(w.stop.remote(), timeout=10)
+                stops.append((w, w.stop.remote()))
+            except Exception:
+                stops.append((w, None))
+        for w, ref in stops:
+            try:
+                if ref is not None:
+                    ray_tpu.get(ref, timeout=10)
                 ray_tpu.kill(w)
             except Exception:
                 pass

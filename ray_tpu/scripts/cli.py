@@ -13,6 +13,7 @@ Commands:
   job logs <submission_id>
   job stop <submission_id>
   dashboard [--port N]        start the dashboard head, print its URL
+  lint <paths>                static distributed-correctness linter
 """
 
 from __future__ import annotations
@@ -428,6 +429,20 @@ def cmd_usage(args):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # Delegate `rt [--address X] lint ...` wholesale to
+    # `python -m ray_tpu.lint` (shared flags + exit codes); bypasses
+    # argparse.REMAINDER's refusal to capture leading --flags.
+    # --address is rt's only global flag and lint needs no cluster.
+    rest = argv
+    if rest and rest[0].startswith("--address"):
+        rest = rest[1:] if "=" in rest[0] else rest[2:]
+    if rest[:1] == ["lint"]:
+        from ray_tpu.lint.__main__ import main as lint_main
+        try:
+            sys.exit(lint_main(rest[1:]))
+        except BrokenPipeError:  # piped into head/a pager that exited
+            sys.exit(0)
     p = argparse.ArgumentParser(prog="rt", description=__doc__)
     p.add_argument("--address", default=None,
                    help="GCS address host:port (default: local cluster)")
@@ -509,6 +524,19 @@ def main(argv=None):
     svb.add_argument("-o", "--output", default=None)
     svsub.add_parser("status")
     svp.set_defaults(fn=cmd_serve)
+
+    lintp = sub.add_parser(
+        "lint", help="AST-based distributed-correctness linter "
+        "(RTL001-RTL008); same flags as python -m ray_tpu.lint")
+    # Normally short-circuited by the delegation above; kept complete
+    # so any argparse-reached path still lints with the user's args.
+    lintp.add_argument("lint_args", nargs=argparse.REMAINDER)
+
+    def _run_lint(args):
+        from ray_tpu.lint.__main__ import main as lint_main
+        sys.exit(lint_main(args.lint_args))
+
+    lintp.set_defaults(fn=_run_lint)
 
     usp = sub.add_parser(
         "usage", help="usage-stats opt in/out (reference: ray "

@@ -71,11 +71,16 @@ class HTTPProxy:
             return 500, repr(e).encode(), "text/plain"
         if isinstance(result, dict) and result.get("__http__") is True:
             # Structured response from an ASGI ingress deployment
-            # (serve.ingress): honor its status/headers/body.
+            # (serve.ingress): honor its status/headers/body.  Headers
+            # travel as a (name, value) pair LIST so repeats
+            # (Set-Cookie) survive; dict-shaped replicas still work.
+            raw = result.get("headers") or []
+            pairs = list(raw.items()) if isinstance(raw, dict) \
+                else [tuple(p) for p in raw]
             return (int(result.get("status", 200)),
                     bytes(result.get("body", b"")),
                     result.get("content_type", "text/plain"),
-                    result.get("headers") or {})
+                    pairs)
         if isinstance(result, (bytes, bytearray)):
             return 200, bytes(result), "application/octet-stream"
         if isinstance(result, str):
@@ -122,9 +127,13 @@ class HTTPProxyActor:
                 dict(request.headers))
             # ASGI ingress responses carry full headers (Set-Cookie,
             # Location, ...); content-type/length ride dedicated kwargs.
-            headers = {k: v for k, v in (rest[0] if rest else {}).items()
+            # A pair list (not a dict) feeds the CIMultiDict so
+            # repeated names all reach the wire.
+            raw = rest[0] if rest else []
+            pairs = raw.items() if isinstance(raw, dict) else raw
+            headers = [(k, v) for k, v in pairs
                        if k.lower() not in ("content-type",
-                                            "content-length")}
+                                            "content-length")]
             return web.Response(status=status, body=payload,
                                 content_type=ctype.split(";")[0],
                                 headers=headers)

@@ -331,9 +331,14 @@ async def _run_asgi(app, request) -> Dict:
             out["chunks"].append(message.get("body", b""))
 
     await app(scope, receive, send)
-    headers = {k.decode(): v.decode() for k, v in out["headers"]}
+    # Keep headers as an ordered (name, value) pair list: collapsing to
+    # a dict would drop repeats, and Set-Cookie legitimately repeats.
+    headers = [(k.decode("latin-1"), v.decode("latin-1"))
+               for k, v in out["headers"]]
+    content_type = next((v for k, v in headers
+                         if k.lower() == "content-type"), "text/plain")
     return {"__http__": True, "status": out["status"],
-            "content_type": headers.get("content-type", "text/plain"),
+            "content_type": content_type,
             "headers": headers, "body": b"".join(out["chunks"])}
 
 

@@ -83,13 +83,15 @@ class BackendExecutor:
             sc.num_workers, sc._resources, self._placement_group)
         try:
             # Rank/world env everywhere (reference: rank env wiring in
-            # backend_executor._setup_gang).
-            for rank, w in enumerate(self.worker_group.workers):
-                ray_tpu.get(w.set_env.remote({
+            # backend_executor._setup_gang).  All workers in flight at
+            # once; a per-worker get() would serialize N round trips.
+            ray_tpu.get(
+                [w.set_env.remote({
                     "RT_TRAIN_WORLD_RANK": rank,
                     "RT_TRAIN_WORLD_SIZE": sc.num_workers,
                     "RT_TRAIN_LOCAL_RANK": rank,
-                }), timeout=120)
+                }) for rank, w in enumerate(self.worker_group.workers)],
+                timeout=120)
             self.backend.on_start(self.worker_group, self.backend_config)
         except Exception as e:
             if _is_worker_death(e):
@@ -149,9 +151,18 @@ class BackendExecutor:
 
     def finish_training(self):
         if self.worker_group is not None:
+            # Submit every shutdown first so they overlap; then drain
+            # one by one to keep the per-worker exception isolation
+            # (submission itself can raise during driver teardown).
+            refs = []
             for w in self.worker_group.workers:
                 try:
-                    ray_tpu.get(w.shutdown_training.remote(), timeout=30)
+                    refs.append(w.shutdown_training.remote())
+                except Exception:
+                    pass
+            for ref in refs:
+                try:
+                    ray_tpu.get(ref, timeout=30)
                 except Exception:
                     pass
 

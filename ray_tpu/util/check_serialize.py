@@ -10,6 +10,61 @@ from typing import Any, Optional, Set, Tuple
 
 import cloudpickle
 
+# Constructors whose results can essentially never survive cloudpickle:
+# they wrap OS handles or interpreter-internal state.  Keyed by
+# (module, callable); module None means the builtin namespace.  Shared
+# by the static linter (ray_tpu.lint rule RTL006, which flags remote
+# closures capturing a value built by one of these) and by the dynamic
+# inspector below (which uses it to explain WHY a leaf failed).
+KNOWN_UNSERIALIZABLE_CONSTRUCTORS = {
+    ("threading", "Lock"): "thread locks wrap an OS mutex",
+    ("threading", "RLock"): "thread locks wrap an OS mutex",
+    ("threading", "Condition"): "condition variables wrap an OS mutex",
+    ("threading", "Semaphore"): "semaphores wrap an OS mutex",
+    ("threading", "BoundedSemaphore"): "semaphores wrap an OS mutex",
+    ("threading", "Event"): "events wrap an OS mutex",
+    ("threading", "Thread"): "thread objects wrap an OS thread",
+    ("threading", "local"): "thread-local storage is per-interpreter",
+    ("multiprocessing", "Lock"): "process locks wrap an OS semaphore",
+    ("multiprocessing", "RLock"): "process locks wrap an OS semaphore",
+    ("multiprocessing", "Queue"): "mp queues hold pipes + feeder threads",
+    ("multiprocessing", "Pool"): "process pools hold live child processes",
+    (None, "open"): "file objects hold an OS file descriptor",
+    ("io", "open"): "file objects hold an OS file descriptor",
+    ("socket", "socket"): "sockets hold an OS file descriptor",
+    ("socket", "create_connection"): "sockets hold an OS file descriptor",
+    ("sqlite3", "connect"): "database connections hold an OS handle",
+    ("subprocess", "Popen"): "process handles wrap a live child process",
+    ("asyncio", "get_event_loop"): "event loops hold OS selectors",
+    ("asyncio", "new_event_loop"): "event loops hold OS selectors",
+}
+
+# Runtime type names the dynamic path recognizes without pickling:
+# maps (type module, type name) -> reason.
+_KNOWN_UNSERIALIZABLE_TYPES = {
+    ("_thread", "lock"): "thread locks wrap an OS mutex",
+    ("_thread", "RLock"): "thread locks wrap an OS mutex",
+    ("_thread", "_local"): "thread-local storage is per-interpreter",
+    ("_io", "TextIOWrapper"): "file objects hold an OS file descriptor",
+    ("_io", "BufferedReader"): "file objects hold an OS file descriptor",
+    ("_io", "BufferedWriter"): "file objects hold an OS file descriptor",
+    ("_io", "FileIO"): "file objects hold an OS file descriptor",
+    ("socket", "socket"): "sockets hold an OS file descriptor",
+    ("sqlite3", "Connection"): "database connections hold an OS handle",
+    ("subprocess", "Popen"): "process handles wrap a live child process",
+    ("builtins", "generator"): "generators capture a paused stack frame",
+    ("builtins", "coroutine"): "coroutines capture a paused stack frame",
+}
+
+
+def describe_unserializable(obj: Any) -> Optional[str]:
+    """A human reason when `obj` is a KNOWN-unserializable kind (lock,
+    file handle, generator, ...); None when we have nothing special to
+    say and the generic pickling error stands on its own."""
+    t = type(obj)
+    return _KNOWN_UNSERIALIZABLE_TYPES.get(
+        (getattr(t, "__module__", ""), t.__name__))
+
 
 class FailureTuple:
     """One serialization failure frame: the failing object, the variable
@@ -41,8 +96,10 @@ def _inspect_function(fn, depth, parent, failures, log):
         for name, obj in mapping.items():
             if _check(obj):
                 continue
+            reason = describe_unserializable(obj)
             log.append(f"{'  ' * depth}{kind} variable {name!r} in "
-                       f"{fn.__qualname__} fails serialization")
+                       f"{fn.__qualname__} fails serialization"
+                       + (f" ({reason})" if reason else ""))
             found = True
             if depth > 0:
                 _walk(obj, name, depth - 1, fn, failures, log)
@@ -58,8 +115,10 @@ def _inspect_object(obj, depth, parent, failures, log):
         for name, attr in members.items():
             if _check(attr):
                 continue
+            reason = describe_unserializable(attr)
             log.append(f"{'  ' * depth}attribute {name!r} of "
-                       f"{type(obj).__name__} fails serialization")
+                       f"{type(obj).__name__} fails serialization"
+                       + (f" ({reason})" if reason else ""))
             found = True
             if depth > 0:
                 _walk(attr, name, depth - 1, obj, failures, log)

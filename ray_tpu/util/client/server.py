@@ -19,7 +19,7 @@ from typing import Dict
 
 import ray_tpu
 from ray_tpu._private import protocol
-from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.actor import ActorHandle
 from ray_tpu.util.client.common import dumps_with, loads_with
 
@@ -44,6 +44,15 @@ class ClientServer:
             with self._lock:
                 self._actors.setdefault(obj._actor_id.hex(), obj)
             return ("actor", obj._actor_id.hex(), obj._class_name)
+        if isinstance(obj, ObjectRefGenerator):
+            # num_returns="dynamic": the generator's pickle hook would
+            # rebuild REAL ObjectRefs client-side (useless stubs there),
+            # so externalize it as its sub-ids, tracked like any
+            # outbound ref so the client can get() each one.
+            with self._lock:
+                for r in obj:
+                    self._refs.setdefault(r.hex(), r)
+            return ("refgen", tuple(r.hex() for r in obj))
         return None
 
     def _load(self, pid):
@@ -61,6 +70,9 @@ class ClientServer:
             if handle is None:
                 raise KeyError(f"client actor {pid[1]} unknown")
             return handle
+        if kind == "refgen":
+            return ObjectRefGenerator(
+                [self._load(("ref", h)) for h in pid[1]])
         raise ValueError(f"bad persistent id {pid!r}")
 
     def _track(self, refs):

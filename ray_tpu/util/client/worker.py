@@ -145,6 +145,11 @@ class ClientAPI:
             return ("ref", obj.id)
         if isinstance(obj, ClientActorHandle):
             return ("actor", obj._actor_id)
+        from ray_tpu._private.object_ref import ObjectRefGenerator
+        if isinstance(obj, ObjectRefGenerator):
+            # A generator fetched through this client wraps stub refs;
+            # send the ids, the server rebinds them to its real refs.
+            return ("refgen", tuple(r.hex() for r in obj))
         return None
 
     def _load(self, pid):
@@ -153,6 +158,13 @@ class ClientAPI:
             return ClientObjectRef(pid[1], self)
         if pid[0] == "actor":
             return ClientActorHandle(pid[1], pid[2], {}, self)
+        if pid[0] == "refgen":
+            # num_returns="dynamic" parity: the generator arrives as
+            # its sub-object ids; rebuild it over client stubs so
+            # iteration/len/indexing behave like the in-process API.
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(
+                [ClientObjectRef(h, self) for h in pid[1]])
         raise ValueError(f"bad persistent id {pid!r}")
 
     def _release(self, ref_id: str):
@@ -214,14 +226,21 @@ class ClientAPI:
                                  info["method_meta"], self)
 
     def _actor_call(self, handle, method, args, kwargs, opts):
-        blob = dumps_with((args, kwargs), self._persist)
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "dynamic":
+            # Parity with the in-process API (actor.py _invoke): reject
+            # client-side rather than shipping a call the server will
+            # refuse with a less local error.
+            raise ValueError(
+                'num_returns="dynamic" is only supported for task '
+                "returns, not actor methods")
+        blob = dumps_with((args, kwargs), self._persist)
         hexes = self._req("actor_call",
                           {"actor": handle._actor_id, "method": method,
                            "blob": blob, "opts": opts,
                            "num_returns": num_returns})
         refs = [ClientObjectRef(h, self) for h in hexes]
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if len(refs) == 1 else refs
 
     def get_actor(self, name: str,
                   namespace: str = "default") -> ClientActorHandle:

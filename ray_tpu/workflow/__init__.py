@@ -368,7 +368,7 @@ class VirtualActorHandle:
             return result
         for _ in range(16):
             snap = self._load()
-            new_state, result = ray_tpu.get(
+            new_state, result = ray_tpu.get(  # noqa: RTL001 (each retry depends on persisted state)
                 step.remote(cls_blob, snap["state"], method_name, args,
                             kwargs), timeout=3600)
             # Persist state BEFORE surfacing the result: a crash after

@@ -67,6 +67,39 @@ def test_client_named_actor_and_wait(client_api):
     client_api.kill(got)
 
 
+def test_client_dynamic_task_returns_generator_of_stubs(client_api):
+    """num_returns='dynamic' parity: one visible ref client-side, whose
+    get() yields an ObjectRefGenerator of client stubs — mirroring the
+    in-process refs[0] behavior."""
+    import ray_tpu as rt
+
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    f = client_api.remote(gen).options(num_returns="dynamic")
+    ref = f.remote(4)
+    assert isinstance(ref, rt_client.ClientObjectRef)  # not a list
+    out = client_api.get(ref)
+    assert isinstance(out, rt.ObjectRefGenerator)
+    assert len(out) == 4
+    assert [client_api.get(r) for r in out] == [0, 1, 4, 9]
+    # The generator's stubs round-trip BACK to the server as args.
+    add = client_api.remote(lambda a, b: a + b)
+    assert client_api.get(add.remote(out[1], out[2])) == 5
+
+
+def test_client_actor_dynamic_rejected_loudly(client_api):
+    class A:
+        def gen(self):
+            yield 1
+
+    actor = client_api.remote(A).remote()
+    with pytest.raises(ValueError, match="dynamic"):
+        actor.gen.options(num_returns="dynamic").remote()  # noqa: RTL002
+    client_api.kill(actor)
+
+
 def test_client_cluster_info(client_api):
     nodes = client_api.nodes()
     assert len(nodes) >= 1

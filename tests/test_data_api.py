@@ -169,6 +169,31 @@ def test_random_access_dataset(ray_init):
     assert "worker" in rad.stats()
 
 
+def test_random_access_block_assignment_is_contiguous(ray_init):
+    """Each worker must own a CONTIGUOUS chunk of the sorted block
+    list (the docstring's key-locality claim): round-robin would
+    interleave adjacent keys across workers."""
+    rows = [{"key": i} for i in range(60)]
+    ds = data.from_items(rows, parallelism=6)
+    rad = ds.to_random_access_dataset("key", num_workers=3)
+    by_worker = {}
+    for block_idx, w in rad._block_to_worker.items():
+        by_worker.setdefault(w, []).append(block_idx)
+    assert sum(len(v) for v in by_worker.values()) == 6
+    for w, idxs in by_worker.items():
+        idxs = sorted(idxs)
+        assert idxs == list(range(idxs[0], idxs[-1] + 1)), \
+            f"worker {w} got non-contiguous blocks {idxs}"
+    # Workers cover increasing, non-overlapping ranges in order.
+    spans = sorted((min(v), max(v)) for v in by_worker.values())
+    for (_, hi), (lo, _) in zip(spans, spans[1:]):
+        assert lo == hi + 1
+    # Lookups still resolve correctly under the new assignment.
+    got = rad.multiget(list(range(0, 60, 7)) + [999])
+    assert [None if g is None else g["key"] for g in got] == \
+        list(range(0, 60, 7)) + [None]
+
+
 def test_stats_reports_stages(ray_init):
     ds = data.range(10, parallelism=2).map(lambda x: x * 2)
     ds.take_all()

@@ -99,6 +99,20 @@ def test_dynamic_rejects_plain_iterables(ray_init):
                     timeout=60)
 
 
+def test_dynamic_rejected_for_actor_methods(ray_init):
+    """Actor methods don't support num_returns='dynamic'; the refusal
+    must be a clear ValueError, not a TypeError from range() deep in
+    the submitter (client mode mirrors this, see test_client)."""
+    @ray_tpu.remote
+    class A:
+        def gen(self):
+            yield 1
+
+    a = A.remote()
+    with pytest.raises(ValueError, match="dynamic"):
+        a.gen.options(num_returns="dynamic").remote()  # noqa: RTL002
+
+
 def test_dynamic_refs_cross_task_boundaries(ray_init):
     """Refs from the generator can be passed to other tasks."""
     @ray_tpu.remote

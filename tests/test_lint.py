@@ -1,0 +1,472 @@
+"""ray_tpu.lint: one flagging and one non-flagging fixture per RTL
+rule, plus noqa suppression and baseline-file behavior."""
+
+import json
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import (apply_baseline, lint_paths, lint_source,
+                          load_baseline, write_baseline)
+from ray_tpu.lint.__main__ import main as lint_main
+
+
+def codes(src: str):
+    return [f.code for f in lint_source(textwrap.dedent(src), "t.py")]
+
+
+# ------------------------------------------------------------- RTL001
+def test_rtl001_flags_get_of_remote_in_loop():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    def run():
+        out = []
+        for i in range(10):
+            out.append(ray_tpu.get(f.remote(i)))
+        return out
+    """
+    assert "RTL001" in codes(src)
+
+
+def test_rtl001_flags_get_of_loop_local_ref_and_comprehension():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    def run():
+        while True:
+            r = f.remote(1)
+            v = ray_tpu.get(r)
+        vals = [ray_tpu.get(f.remote(i)) for i in range(4)]
+    """
+    assert codes(src).count("RTL001") == 2
+
+
+def test_rtl001_clean_on_batched_get():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    def run():
+        refs = [f.remote(i) for i in range(10)]
+        vals = ray_tpu.get(refs)
+        for r in refs:
+            one_by_one = ray_tpu.get(r)  # refs made OUTSIDE the loop
+        return vals
+    """
+    assert "RTL001" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL002
+def test_rtl002_flags_discarded_remote():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    def run():
+        f.remote()
+    """
+    assert "RTL002" in codes(src)
+
+
+def test_rtl002_honors_decorator_level_exemptions():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns=0)
+    def fire():
+        pass
+
+    @ray_tpu.remote(lifetime="detached")
+    class Daemon:
+        pass
+
+    def run():
+        fire.remote()
+        Daemon.options(name="d").remote()
+    """
+    assert "RTL002" not in codes(src)
+
+
+def test_rtl002_clean_when_bound_detached_or_num_returns_zero():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def run(self):
+            pass
+
+    def run():
+        ref = f.remote()
+        A.options(name="x", lifetime="detached").remote()
+        a = A.remote()
+        a.run.options(num_returns=0).remote()
+        return ray_tpu.get(ref)
+    """
+    assert "RTL002" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL003
+def test_rtl003_flags_large_module_array_capture():
+    src = """
+    import ray_tpu
+    import numpy as np
+
+    WEIGHTS = np.zeros((4096, 4096))
+
+    @ray_tpu.remote
+    def apply(x):
+        return WEIGHTS @ x
+    """
+    assert "RTL003" in codes(src)
+
+
+def test_rtl003_clean_for_small_arrays_params_and_put():
+    src = """
+    import ray_tpu
+    import numpy as np
+
+    SMALL = np.zeros(8)
+    BIG = np.zeros((4096, 4096))
+
+    @ray_tpu.remote
+    def ok(weights, x):
+        return weights @ (x + SMALL)
+
+    def run(x):
+        wref = ray_tpu.put(BIG)
+        return ok.remote(wref, x)
+    """
+    assert "RTL003" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL004
+def test_rtl004_flags_get_in_remote_fn_and_actor_method():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def outer(refs):
+        return ray_tpu.get(refs)
+
+    @ray_tpu.remote
+    class A:
+        def poll(self, refs):
+            done, rest = ray_tpu.wait(refs)
+            return done
+    """
+    assert codes(src).count("RTL004") == 2
+
+
+def test_rtl004_clean_on_driver_get():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    def driver():
+        return ray_tpu.get(f.remote())
+    """
+    assert "RTL004" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL005
+def test_rtl005_flags_actor_method_without_remote():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Counter:
+        def incr(self):
+            return 1
+
+    def run():
+        c = Counter.remote()
+        c.incr()
+    """
+    assert "RTL005" in codes(src)
+
+
+def test_rtl005_clean_with_remote_and_private_calls():
+    src = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Counter:
+        def incr(self):
+            return 1
+
+    def run():
+        c = Counter.remote()
+        ref = c.incr.remote()
+        h = ray_tpu.get_actor("n")
+        h._invoke("incr", (), {}, 1, {})  # framework-internal is fine
+        return ray_tpu.get(ref)
+    """
+    assert "RTL005" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL006
+def test_rtl006_flags_lock_file_and_generator_captures():
+    src = """
+    import ray_tpu
+    import threading
+
+    LOCK = threading.Lock()
+    LOG = open("/tmp/x.log", "a")
+    GEN = (i * i for i in range(10))
+
+    @ray_tpu.remote
+    def f():
+        with LOCK:
+            LOG.write("hi")
+        return next(GEN)
+    """
+    assert codes(src).count("RTL006") == 3
+
+
+def test_rtl006_clean_when_created_inside_the_task():
+    src = """
+    import ray_tpu
+    import threading
+
+    @ray_tpu.remote
+    def f(path):
+        lock = threading.Lock()
+        with lock, open(path) as fh:
+            return fh.read()
+    """
+    assert "RTL006" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL007
+def test_rtl007_flags_jax_task_without_tpu():
+    src = """
+    import ray_tpu
+    import jax.numpy as jnp
+
+    @ray_tpu.remote
+    def matmul(a, b):
+        return jnp.dot(a, b)
+    """
+    assert "RTL007" in codes(src)
+
+
+def test_rtl007_clean_with_tpu_request_or_no_jax():
+    src = """
+    import ray_tpu
+    import jax.numpy as jnp
+    import numpy as np
+
+    @ray_tpu.remote(num_tpus=1)
+    def matmul(a, b):
+        return jnp.dot(a, b)
+
+    @ray_tpu.remote(resources={"TPU": 0.5})
+    def matmul2(a, b):
+        return jnp.dot(a, b)
+
+    @ray_tpu.remote
+    def cpu_ok(a, b):
+        return np.dot(a, b)
+    """
+    assert "RTL007" not in codes(src)
+
+
+# ------------------------------------------------------------- RTL008
+def test_rtl008_flags_bad_unpack_get_wait_and_spin():
+    src = """
+    import ray_tpu
+
+    def run(refs):
+        a, b, c = ray_tpu.wait(refs)
+        vals = ray_tpu.get(ray_tpu.wait(refs))
+        for r in ray_tpu.wait(refs):
+            pass
+        while refs:
+            done, refs = ray_tpu.wait(refs, timeout=0)
+    """
+    assert codes(src).count("RTL008") == 4
+
+
+def test_rtl008_clean_on_correct_wait():
+    src = """
+    import ray_tpu
+
+    def run(refs):
+        ready, pending = ray_tpu.wait(refs, num_returns=2, timeout=5.0)
+        return ray_tpu.get(ready)
+    """
+    assert "RTL008" not in codes(src)
+
+
+# ------------------------------------------------- aliases and noqa
+def test_aliased_imports_are_resolved():
+    src = """
+    import ray_tpu as ray
+    from ray_tpu import get as fetch
+
+    @ray.remote
+    def f(x):
+        return x
+
+    def run():
+        for i in range(3):
+            v = fetch(f.remote(i))
+    """
+    assert "RTL001" in codes(src)
+
+
+def test_noqa_suppresses_specific_and_bare():
+    base = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    def run():
+        f.remote(){noqa}
+    """
+    assert "RTL002" in codes(base.format(noqa=""))
+    assert "RTL002" not in codes(base.format(noqa="  # noqa"))
+    assert "RTL002" not in codes(base.format(noqa="  # noqa: RTL002"))
+    # noqa for a DIFFERENT code does not suppress
+    assert "RTL002" in codes(base.format(noqa="  # noqa: RTL001"))
+
+
+def test_syntax_error_reports_rtl000():
+    assert codes("def broken(:\n    pass") == ["RTL000"]
+
+
+# ------------------------------------------------- baseline behavior
+_FLAGGED = textwrap.dedent("""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    def run():
+        f.remote()
+""")
+
+
+def test_baseline_roundtrip_suppresses_known_findings(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(_FLAGGED)
+    findings = lint_paths([str(mod)])
+    assert [f.code for f in findings] == ["RTL002"]
+
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl_path), root=str(tmp_path))
+    baseline = load_baseline(str(bl_path))
+    assert baseline == {"m.py::RTL002": 1}
+    assert apply_baseline(findings, baseline, root=str(tmp_path)) == []
+
+    # A SECOND finding of the same kind overflows the baseline.
+    mod.write_text(_FLAGGED + "\n\ndef run2():\n    f.remote()\n")
+    more = lint_paths([str(mod)])
+    assert len(more) == 2
+    new = apply_baseline(more, baseline, root=str(tmp_path))
+    assert len(new) == 1 and new[0].code == "RTL002"
+
+
+def test_cli_exit_codes_and_write_baseline(tmp_path, monkeypatch, capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text(_FLAGGED)
+    monkeypatch.chdir(tmp_path)
+
+    assert lint_main([str(mod), "--no-baseline"]) == 1
+    assert lint_main([str(mod), "--write-baseline"]) == 0
+    # Default baseline (.rtlint-baseline.json in cwd) now absorbs it.
+    assert lint_main([str(mod)]) == 0
+    assert lint_main([str(mod), "--no-baseline"]) == 1
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert lint_main([str(clean), "--no-baseline"]) == 0
+
+    out = json.loads((tmp_path / ".rtlint-baseline.json").read_text())
+    assert sum(out["counts"].values()) == 1
+    capsys.readouterr()
+
+
+def test_write_baseline_preserves_out_of_scope_keys(tmp_path,
+                                                    monkeypatch):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "m.py").write_text(_FLAGGED)
+    (tmp_path / "b" / "m.py").write_text(_FLAGGED)
+    monkeypatch.chdir(tmp_path)
+
+    assert lint_main(["a", "b", "--write-baseline"]) == 0
+    full = load_baseline(".rtlint-baseline.json")
+    assert set(full) == {"a/m.py::RTL002", "b/m.py::RTL002"}
+
+    # Fix a's finding, regenerate over `a` ONLY: b's key must survive.
+    (tmp_path / "a" / "m.py").write_text("x = 1\n")
+    assert lint_main(["a", "--write-baseline"]) == 0
+    merged = load_baseline(".rtlint-baseline.json")
+    assert merged == {"b/m.py::RTL002": 1}
+    assert lint_main(["a", "b"]) == 0
+
+    # --select + --write-baseline would gut other rules: refused.
+    assert lint_main(["a", "b", "--select", "RTL001",
+                      "--write-baseline"]) == 2
+
+    # Rewriting with the default "." scope must NOT double counts by
+    # misclassifying in-scope keys as preserved.
+    assert lint_main(["--write-baseline"]) == 0
+    again = load_baseline(".rtlint-baseline.json")
+    assert again == {"b/m.py::RTL002": 1}
+
+
+def test_nonexistent_path_fails_instead_of_green(tmp_path, monkeypatch,
+                                                 capsys):
+    missing = str(tmp_path / "no_such_dir")
+    findings = lint_paths([missing])
+    assert [f.code for f in findings] == ["RTL000"]
+    assert lint_main([missing, "--no-baseline"]) == 1
+    # And a missing path can never be baselined away.
+    monkeypatch.chdir(tmp_path)
+    assert lint_main([missing, "--write-baseline"]) == 2
+    import os
+    assert not os.path.exists(".rtlint-baseline.json")
+    capsys.readouterr()
+
+
+@pytest.mark.slow  # subprocess lint over ~400 files; `make lint` is the gate
+def test_self_check_is_clean_with_checked_in_baseline():
+    """The acceptance gate: our own tree lints clean (possibly via the
+    checked-in baseline) from the repo root."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "ray_tpu", "examples",
+         "tests"],
+        cwd=root, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

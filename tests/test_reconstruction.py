@@ -57,6 +57,49 @@ def test_reconstruct_chain_through_dependent_task(ray_start_cluster):
     assert out == 400 * 400
 
 
+@pytest.mark.slow
+def test_dynamic_sub_objects_reconstruct_after_outer_ref_release(
+        ray_start_cluster):
+    """Regression: a re-executed generator whose MAIN owned entry was
+    released (user kept only yielded sub-refs) must still re-register
+    its sub-objects — pending get()s used to hang forever because
+    _record_results dropped the whole reply when the main entry was
+    gone."""
+    import gc
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"head": 1})
+    worker_node = cluster.add_node(num_cpus=1, resources={"spot": 1})
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"spot": 1})
+    def gen():
+        for i in range(3):
+            yield np.full((400, 400), i, np.float64)  # store-resident
+
+    outer = gen.options(num_returns="dynamic").remote()
+    sub_refs = list(ray_tpu.get(outer, timeout=120))
+    assert len(sub_refs) == 3
+    first = ray_tpu.get(sub_refs[1], timeout=120)
+
+    # Drop the visible generator ref: the main owned entry goes away,
+    # the deserialized sub-refs keep their own stakes.
+    del outer
+    gc.collect()
+
+    cluster.remove_node(worker_node)
+    cluster.add_node(num_cpus=1, resources={"spot": 1})
+    # sub_refs[2] was NEVER fetched, so its only copy died with the
+    # node (sub_refs[1] may survive as a local transfer copy): this
+    # get() must re-execute the generator and unblock even though the
+    # main entry is gone.
+    fresh = ray_tpu.get(sub_refs[2], timeout=120)
+    assert int(fresh[0, 0]) == 2 and fresh.shape == (400, 400)
+    again = ray_tpu.get(sub_refs[1], timeout=120)
+    np.testing.assert_array_equal(first, again)
+
+
 def test_put_objects_are_not_reconstructable(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=1, resources={"head": 1})

@@ -71,6 +71,61 @@ def test_http_proxy_end_to_end(serve_instance):
     assert r.status_code == 404
 
 
+def test_asgi_repeated_headers_survive_to_the_wire(serve_instance):
+    """Multiple Set-Cookie headers from an ASGI app must all reach the
+    HTTP client — carrying headers as a dict anywhere in the path
+    collapses repeats."""
+    import requests
+
+    async def app(scope, receive, send):
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"text/plain"),
+                                (b"set-cookie", b"a=1; Path=/"),
+                                (b"set-cookie", b"b=2; Path=/"),
+                                (b"x-marker", b"yes")]})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    @serve.deployment(name="cookies")
+    @serve.ingress(app)
+    class Cookies:
+        pass
+
+    serve.run(Cookies, _start_proxy=True)
+    addr = serve.get_proxy_address()
+    base = f"http://{addr['host']}:{addr['port']}"
+    r = requests.get(f"{base}/cookies", timeout=30)
+    assert r.status_code == 200 and r.text == "ok"
+    assert r.headers["x-marker"] == "yes"
+    cookies = [v for k, v in r.raw.headers.items()
+               if k.lower() == "set-cookie"]
+    assert cookies == ["a=1; Path=/", "b=2; Path=/"]
+    assert r.cookies["a"] == "1" and r.cookies["b"] == "2"
+
+
+def test_run_asgi_returns_header_pairs():
+    """_run_asgi itself must hand back (name, value) PAIRS, preserving
+    order and repeats."""
+    import asyncio
+
+    from ray_tpu.serve._private.replica import Request
+    from ray_tpu.serve.api import _run_asgi
+
+    async def app(scope, receive, send):
+        await send({"type": "http.response.start", "status": 201,
+                    "headers": [(b"set-cookie", b"x=1"),
+                                (b"set-cookie", b"y=2"),
+                                (b"content-type", b"application/json")]})
+        await send({"type": "http.response.body", "body": b"{}"})
+
+    req = Request(method="GET", path="/", query={}, body=b"",
+                  headers={})
+    out = asyncio.new_event_loop().run_until_complete(_run_asgi(app, req))
+    assert out["status"] == 201
+    assert out["content_type"] == "application/json"
+    assert out["headers"] == [("set-cookie", "x=1"), ("set-cookie", "y=2"),
+                              ("content-type", "application/json")]
+
+
 def test_rolling_update_zero_downtime(serve_instance):
     @serve.deployment(name="ver", num_replicas=2, version="1")
     def ver(req):

@@ -1,0 +1,47 @@
+"""Raylet TPU chip-slot accounting: fractional leases must not leave
+float residue that blocks whole-chip grants (unit-level, no cluster)."""
+
+from ray_tpu._private.raylet import Lease, Raylet
+
+
+def _bare_raylet(num_chips: int) -> Raylet:
+    r = Raylet.__new__(Raylet)
+    r._tpu_slots = {i: 0.0 for i in range(num_chips)}
+    return r
+
+
+def _lease(amount: float) -> Lease:
+    return Lease("l", None, {"TPU": amount}, None)
+
+
+def test_nonbinary_fraction_release_snaps_to_zero():
+    r = _bare_raylet(1)
+    leases = [_lease(0.3) for _ in range(3)]
+    for lease in leases:
+        assert r._alloc_tpu_ids(lease) == [0]
+    # 0.3 + 0.3 + 0.3 != 0.9 exactly in floats; after releasing all
+    # three the slot must read exactly 0.0 again.
+    for lease in leases:
+        r._free_tpu_ids(lease)
+    assert r._tpu_slots[0] == 0.0
+
+
+def test_whole_chip_grant_after_fractional_churn():
+    r = _bare_raylet(2)
+    # Churn chip 0 with non-binary fractions, then demand both chips.
+    for _ in range(5):
+        fr = _lease(0.3)
+        assert r._alloc_tpu_ids(fr), "fractional grant failed"
+        r._free_tpu_ids(fr)
+    whole = _lease(2.0)
+    assert sorted(r._alloc_tpu_ids(whole)) == [0, 1]
+    r._free_tpu_ids(whole)
+    assert all(v == 0.0 for v in r._tpu_slots.values())
+
+
+def test_fractions_binpack_and_keep_whole_chips_free():
+    r = _bare_raylet(2)
+    a, b = _lease(0.5), _lease(0.5)
+    assert r._alloc_tpu_ids(a) == r._alloc_tpu_ids(b)  # share one chip
+    whole = _lease(1.0)
+    assert len(r._alloc_tpu_ids(whole)) == 1  # other chip still whole
