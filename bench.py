@@ -8,6 +8,13 @@ the per-chip throughput of an A100 running the same model, where the A100
 figure is the standard analytic estimate (312 bf16 TFLOP/s at 40% MFU,
 step cost ~ 6 * params * tokens FLOPs).  vs_baseline >= 1.0 means the bar
 is met.
+
+Suites (--suite):
+  train      (default) the flagship train-step benchmark above
+  serve_llm  continuous-batching serving (ray_tpu.serve.llm) vs a serial
+             per-request generate() baseline under staggered arrivals:
+             offline tokens/sec, TTFT, inter-token latency.  Writes
+             BENCH_serve_llm.json (the checked-in artifact).
 """
 
 import json
@@ -415,5 +422,175 @@ def _run_microbench():
     return out
 
 
+def _serve_llm_cfg():
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import gpt
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        # Serving-sized model: big enough that the decode step is
+        # compute/bandwidth bound, small enough to share a chip with
+        # its KV pool.
+        return gpt.GPTConfig(vocab_size=32000, d_model=1024, n_heads=16,
+                             n_layers=8, d_ff=4096, max_seq=512,
+                             dtype=jnp.bfloat16, remat=False)
+    # CPU sizing: large enough that a decode step's matmuls dominate
+    # the per-tick Python dispatch (a toy model would benchmark the
+    # interpreter, not the scheduler).
+    return gpt.GPTConfig(vocab_size=1024, d_model=256, n_heads=8,
+                         n_layers=4, d_ff=1024, max_seq=160,
+                         dtype=jnp.float32, remat=False)
+
+
+def _pct(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def serve_llm_main(json_out=None, n_requests=16, concurrency=8,
+                   prompt_len=32, max_new=64, stagger_s=0.05):
+    """Continuous batching (GenerationEngine) vs serial generate() on
+    the SAME staggered arrival schedule.  The serial baseline is the
+    strongest honest one: the whole-generation fused lax.scan of
+    decode.generate, one request at a time, tokens delivered at
+    completion (that is what a non-streaming, non-batching replica
+    does).  The engine streams, so its TTFT is prefill-bound while the
+    serial TTFT is queue-bound."""
+    import asyncio
+
+    import jax
+    import numpy as np
+    from ray_tpu.models import decode, gpt  # noqa: F401
+    from ray_tpu.serve.llm import GenerationEngine
+
+    cfg = _serve_llm_cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    if cfg.dtype != np.float32:
+        import jax.numpy as jnp
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params)
+    prompts = [
+        [int(t) for t in np.asarray(jax.random.randint(
+            jax.random.PRNGKey(100 + i), (prompt_len,), 1,
+            cfg.vocab_size))]
+        for i in range(n_requests)]
+    total_tokens = n_requests * max_new
+
+    # ---- serial baseline -------------------------------------------------
+    import jax.numpy as jnp
+
+    def _one(prompt):
+        out = decode.generate(params, jnp.asarray([prompt]), cfg,
+                              max_new_tokens=max_new)
+        jax.device_get(out[0, -1])
+        return out
+
+    _one(prompts[0])  # compile + warm
+    t0 = time.perf_counter()
+    arrivals = [t0 + i * stagger_s for i in range(n_requests)]
+    serial_ttft = []
+    for i, p in enumerate(prompts):
+        now = time.perf_counter()
+        if now < arrivals[i]:
+            time.sleep(arrivals[i] - now)
+        _one(p)
+        serial_ttft.append(time.perf_counter() - arrivals[i])
+    serial_wall = time.perf_counter() - t0
+    serial_tps = total_tokens / serial_wall
+
+    # ---- continuous batching --------------------------------------------
+    eng = GenerationEngine(
+        params, cfg, num_slots=concurrency,
+        max_seq=prompt_len + max_new, prefill_chunk=prompt_len,
+        max_queue_len=max(64, n_requests), name="bench")
+    eng.start()
+    # Warm every compiled path (chunk prefill, fused tick, insert,
+    # reset) outside the timed window.
+    asyncio.run(eng.generate(prompts[0], max_new_tokens=max_new))
+
+    async def run_engine():
+        t0 = time.perf_counter()
+        arrivals = [i * stagger_s for i in range(n_requests)]
+        ttfts, itls, done_t = [], [], []
+
+        async def one(i):
+            await asyncio.sleep(arrivals[i])
+            arrival = time.perf_counter()
+            stream = eng.submit(prompts[i], max_new_tokens=max_new)
+            prev = None
+            async for _tok in stream:
+                now = time.perf_counter()
+                if prev is None:
+                    ttfts.append(now - arrival)
+                else:
+                    itls.append(now - prev)
+                prev = now
+            done_t.append(time.perf_counter())
+
+        await asyncio.gather(*[one(i) for i in range(n_requests)])
+        return time.perf_counter() - t0, ttfts, itls
+
+    engine_wall, ttfts, itls = asyncio.run(run_engine())
+    eng.stop()
+    engine_tps = total_tokens / engine_wall
+
+    result = {
+        "metric": "serve_llm_tokens_per_sec",
+        "value": round(engine_tps, 1),
+        "unit": "tokens/sec",
+        "vs_serial_baseline": round(engine_tps / serial_tps, 3),
+        "detail": {
+            "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                      "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+                      "vocab": cfg.vocab_size,
+                      "dtype": str(cfg.dtype.__name__
+                                   if hasattr(cfg.dtype, "__name__")
+                                   else cfg.dtype)},
+            "workload": {"n_requests": n_requests,
+                         "concurrency_slots": concurrency,
+                         "prompt_len": prompt_len, "max_new": max_new,
+                         "arrival_stagger_s": stagger_s},
+            "continuous_batching": {
+                "tokens_per_sec": round(engine_tps, 1),
+                "wall_s": round(engine_wall, 3),
+                "ttft_mean_s": round(float(np.mean(ttfts)), 4),
+                "ttft_p50_s": round(_pct(ttfts, 0.5), 4),
+                "ttft_p99_s": round(_pct(ttfts, 0.99), 4),
+                "itl_mean_s": round(float(np.mean(itls)), 5),
+                "itl_p50_s": round(_pct(itls, 0.5), 5),
+                "itl_p99_s": round(_pct(itls, 0.99), 5),
+            },
+            "serial_generate_baseline": {
+                "tokens_per_sec": round(serial_tps, 1),
+                "wall_s": round(serial_wall, 3),
+                # serial = non-streaming: first token == completion
+                "ttft_mean_s": round(float(np.mean(serial_ttft)), 4),
+                "ttft_p99_s": round(_pct(serial_ttft, 0.99), 4),
+            },
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    line = json.dumps(result)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", default="train",
+                    choices=["train", "serve_llm"])
+    ap.add_argument("--json-out", default=None,
+                    help="also write the JSON line to this path "
+                         "(serve_llm defaults to BENCH_serve_llm.json)")
+    cli = ap.parse_args()
+    if cli.suite == "serve_llm":
+        serve_llm_main(cli.json_out or "BENCH_serve_llm.json")
+    else:
+        main()

@@ -119,12 +119,42 @@ def init_cache(cfg, batch: int, max_seq: Optional[int] = None) -> Dict:
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def reset_cache_slot(cache: Dict, slot) -> Dict:
+    """Zero one batch row of the cache (slot recycling: when the
+    continuous-batching engine evicts a finished request, its slot is
+    wiped so the next occupant starts from the documented all-zeros
+    state).  `slot` is a traced scalar — one compilation serves every
+    slot index."""
+    L, B, S, H, D = cache["k"].shape
+    z = jnp.zeros((L, 1, S, H, D), cache["k"].dtype)
+    return {"k": lax.dynamic_update_slice(
+                cache["k"], z, (0, slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                cache["v"], z, (0, slot, 0, 0, 0))}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def insert_cache_slot(cache: Dict, row_cache: Dict, slot) -> Dict:
+    """Copy batch row 0 of `row_cache` (a batch-1 cache filled by
+    prefill/chunk_step) into batch row `slot` of `cache` — continuous-
+    batching admission: a request prefilled off to the side joins the
+    decode batch without touching any other row.  Sequence widths must
+    match; `slot` is a traced scalar (single compilation)."""
+    return {"k": lax.dynamic_update_slice(
+                cache["k"], row_cache["k"][:, :1], (0, slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                cache["v"], row_cache["v"][:, :1], (0, slot, 0, 0, 0))}
+
+
 def _cached_attention(q, ck, cv, pos, pad_lo, cfg):
     """q [B,1,H,Dh] against the cache's first pos+1 positions (static
     shape: positions > pos are masked, not sliced; columns < pad_lo[b]
-    are left-padding and masked too).  GQA stays at Hkv width: q is
-    folded to [B,1,Hkv,rep,Dh] and contracted against the Hkv-sized
-    cache — no repeated cache copy per step."""
+    are left-padding and masked too).  `pos` is a scalar (whole batch at
+    one column — the lockstep generate() path) or a [B] vector (each
+    row at its own depth — the continuous-batching engine).  GQA stays
+    at Hkv width: q is folded to [B,1,Hkv,rep,Dh] and contracted
+    against the Hkv-sized cache — no repeated cache copy per step."""
     B, S, Hkv, Dh = ck.shape
     rep = q.shape[2] // Hkv
     qg = q.reshape(B, 1, Hkv, rep, Dh)
@@ -132,7 +162,9 @@ def _cached_attention(q, ck, cv, pos, pad_lo, cfg):
     scores = jnp.einsum("bqgrk,bsgk->bgrqs", qg.astype(jnp.float32),
                         ck.astype(jnp.float32)) * scale
     cols = jnp.arange(S)
-    mask = (cols <= pos)[None, :] & (cols[None, :] >= pad_lo[:, None])
+    pos_col = jnp.reshape(jnp.asarray(pos), (-1, 1))  # [1,1] or [B,1]
+    mask = (cols[None, :] <= pos_col) \
+        & (cols[None, :] >= pad_lo[:, None])
     scores = jnp.where(mask[:, None, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqs,bsgk->bqgrk", probs.astype(cv.dtype), cv)
@@ -201,24 +233,35 @@ def prefill(params: Dict, tokens, cfg, cache: Dict, prompt_lens=None
 
 def decode_step(params: Dict, token, pos, cache: Dict, cfg,
                 pad_lo=None) -> Tuple[Any, Dict]:
-    """One token [B] at cache column pos (scalar int) -> (logits [B, V],
-    updated cache).  pad_lo [B] marks each row's first real cache
-    column (0 without left-padding).  Jit once; every step reuses the
-    compilation."""
+    """One token [B] at cache column pos -> (logits [B, V], updated
+    cache).  `pos` is a scalar int (every row writes the same column —
+    whole-batch generate()) or a [B] int vector (each row writes its OWN
+    column — continuous batching, where slots are mid-generation at
+    different depths; writes become a per-row scatter).  pad_lo [B]
+    marks each row's first real cache column (0 without left-padding).
+    Jit once per shape; every step reuses the compilation."""
     B = token.shape[0]
+    pos = jnp.asarray(pos, jnp.int32)
+    per_row = pos.ndim == 1
     if pad_lo is None:
         pad_lo = jnp.zeros((B,), jnp.int32)
     positions = (pos - pad_lo)[:, None]  # logical position per row
+    rows = jnp.arange(B)
+
     x = _embed(params, token[:, None], positions, cfg)
 
     def layer(x, inputs):
         lp, ck_l, cv_l = inputs
         h = _rmsnorm(x, lp["ln1"])
         q, k, v = _qkv(lp, h, positions, cfg)
-        ck_l = lax.dynamic_update_slice(
-            ck_l, k.astype(ck_l.dtype), (0, pos, 0, 0))
-        cv_l = lax.dynamic_update_slice(
-            cv_l, v.astype(cv_l.dtype), (0, pos, 0, 0))
+        if per_row:
+            ck_l = ck_l.at[rows, pos].set(k[:, 0].astype(ck_l.dtype))
+            cv_l = cv_l.at[rows, pos].set(v[:, 0].astype(cv_l.dtype))
+        else:
+            ck_l = lax.dynamic_update_slice(
+                ck_l, k.astype(ck_l.dtype), (0, pos, 0, 0))
+            cv_l = lax.dynamic_update_slice(
+                cv_l, v.astype(cv_l.dtype), (0, pos, 0, 0))
         out = _cached_attention(q, ck_l, cv_l, pos, pad_lo, cfg)
         x = x + _attn_out(lp, out, cfg)
         x = _ffn(lp, x, cfg)
@@ -417,9 +460,12 @@ def generate(params: Dict, prompt, cfg, *, max_new_tokens: int,
     as a single dispatch.  Mixed-length batches: LEFT-pad each row to a
     common width and pass `prompt_lens` [B] — pad columns are masked
     out of attention and logical positions start at each row's first
-    real token, so results match per-row unbatched generation.  With
-    eos_token, each row is truncated at its first EOS (host-side; the
-    device loop stays static-shape)."""
+    real token, so results match per-row unbatched generation.
+
+    Return type depends on eos_token: WITHOUT it, a [B, max_new_tokens]
+    array; WITH it, a ragged LIST of per-row 1-D arrays, each truncated
+    before its first EOS (truncation is host-side so the device loop
+    stays static-shape)."""
     if getattr(cfg, "n_experts", 0):
         raise NotImplementedError("decode supports dense models (MoE "
                                   "routing caches are not implemented)")
@@ -463,9 +509,9 @@ def generate(params: Dict, prompt, cfg, *, max_new_tokens: int,
     if eos_token is not None:
         import numpy as np
         arr = np.asarray(out)
-        rows = []
-        for row in arr:
-            hits = np.where(row == eos_token)[0]
-            rows.append(row[:hits[0]] if hits.size else row)
-        out = rows
+        # one vectorized argmax over the hit mask, not an O(B) host
+        # loop of np.where: rows without an EOS keep their full width.
+        hit = arr == eos_token
+        cut = np.where(hit.any(axis=1), hit.argmax(axis=1), arr.shape[1])
+        out = [row[:n] for row, n in zip(arr, cut)]
     return (out, stats) if return_stats else out

@@ -32,13 +32,17 @@ from ray_tpu.serve.config import (  # noqa: F401
     DeploymentConfig,
     HTTPOptions,
 )
-from ray_tpu.serve.handle import DeploymentHandle, RayServeHandle  # noqa: F401
+from ray_tpu.serve.handle import (  # noqa: F401
+    DeploymentHandle,
+    RayServeHandle,
+    ServeResponseStream,
+)
 from ray_tpu.serve._private.replica import Request  # noqa: F401
 
 __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "HTTPOptions", "RayServeHandle", "ReplicaContext",
-    "Request",
+    "Request", "ServeResponseStream",
     "batch", "build", "delete", "deployment", "get_deployment",
     "get_deployment_handle", "get_proxy_address", "get_proxy_addresses",
     "get_replica_context", "ingress", "list_deployments", "run",

@@ -45,35 +45,70 @@ class HTTPProxy:
                     {f"replicas::{deployment}": rs.update_replicas},
                     loop=self._loop)
 
-    async def handle(self, method: str, path: str, query: Dict[str, str],
-                     body: bytes, headers: Dict[str, str]):
-        """Longest-route_prefix match -> replica call (reference:
-        http_proxy.py route matching)."""
-        if path in ("", "/"):
-            return 200, _json.dumps(
-                {"routes": sorted(self.routes)}).encode(), "application/json"
+    def _match_route(self, path: str):
+        """Longest-route_prefix match -> (ReplicaSet, sub-path) or None."""
         match = None
         for prefix in self.routes:
             if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
                 if match is None or len(prefix) > len(match):
                     match = prefix
         if match is None:
-            return 404, f"no route for {path!r}".encode(), "text/plain"
+            return None
         deployment = self.routes[match]
-        rs = self._replica_sets[deployment]
         rest = path[len(match.rstrip("/")):] or "/"
+        return self._replica_sets[deployment], rest
+
+    @staticmethod
+    def wants_stream(query: Dict[str, str],
+                     headers: Dict[str, str]) -> bool:
+        """A request opts into SSE with Accept: text/event-stream or
+        ?stream=1 (mirrored by streaming deployments, e.g.
+        serve.llm.api._wants_stream — the proxy must pick the streaming
+        transport BEFORE the replica sees the request)."""
+        accept = next((v for k, v in (headers or {}).items()
+                       if k.lower() == "accept"), "") or ""
+        if "text/event-stream" in accept:
+            return True
+        return str((query or {}).get("stream", "")).lower() \
+            in ("1", "true", "yes")
+
+    async def handle_stream(self, method: str, path: str,
+                            query: Dict[str, str], body: bytes,
+                            headers: Dict[str, str]):
+        """Start a streaming (SSE) request: returns (status, payload,
+        content_type) on routing/startup failure, or (200, aiter, None)
+        where `aiter` yields the deployment's items to be framed as SSE
+        events by the server layer.  unary_fallback is on: a deployment
+        that answers with a plain value (or a structured error like an
+        overload 503) yields one _UnaryResult, which _handle_sse turns
+        back into a normal response — streaming intent in the request
+        must not break non-streaming deployments or error statuses."""
+        matched = self._match_route(path)
+        if matched is None:
+            return 404, f"no route for {path!r}".encode(), "text/plain"
+        rs, rest = matched
         req = Request(method=method, path=rest,
                       query=query, body=body, headers=headers)
         try:
-            result = await rs.assign_replica("", (req,), {})
+            aiter = await rs.assign_replica_stream(
+                "", (req,), {}, unary_fallback=True)
         except Exception as e:
-            logger.exception("request to %s failed", deployment)
+            logger.exception("stream request to %s failed",
+                             rs.deployment_name)
             return 500, repr(e).encode(), "text/plain"
+        return 200, aiter, None
+
+    @staticmethod
+    def format_result(result):
+        """Replica result -> (status, body, content_type, header_pairs):
+        the single formatting rule shared by the unary path and the
+        streaming path's unary fallback."""
         if isinstance(result, dict) and result.get("__http__") is True:
             # Structured response from an ASGI ingress deployment
-            # (serve.ingress): honor its status/headers/body.  Headers
-            # travel as a (name, value) pair LIST so repeats
-            # (Set-Cookie) survive; dict-shaped replicas still work.
+            # (serve.ingress) or a status-bearing deployment: honor its
+            # status/headers/body.  Headers travel as a (name, value)
+            # pair LIST so repeats (Set-Cookie) survive; dict-shaped
+            # replicas still work.
             raw = result.get("headers") or []
             pairs = list(raw.items()) if isinstance(raw, dict) \
                 else [tuple(p) for p in raw]
@@ -82,13 +117,34 @@ class HTTPProxy:
                     result.get("content_type", "text/plain"),
                     pairs)
         if isinstance(result, (bytes, bytearray)):
-            return 200, bytes(result), "application/octet-stream"
+            return 200, bytes(result), "application/octet-stream", []
         if isinstance(result, str):
-            return 200, result.encode(), "text/plain"
+            return 200, result.encode(), "text/plain", []
         try:
-            return 200, _json.dumps(result).encode(), "application/json"
+            return 200, _json.dumps(result).encode(), \
+                "application/json", []
         except TypeError:
-            return 200, repr(result).encode(), "text/plain"
+            return 200, repr(result).encode(), "text/plain", []
+
+    async def handle(self, method: str, path: str, query: Dict[str, str],
+                     body: bytes, headers: Dict[str, str]):
+        """Longest-route_prefix match -> replica call (reference:
+        http_proxy.py route matching)."""
+        if path in ("", "/"):
+            return 200, _json.dumps(
+                {"routes": sorted(self.routes)}).encode(), "application/json"
+        matched = self._match_route(path)
+        if matched is None:
+            return 404, f"no route for {path!r}".encode(), "text/plain"
+        rs, rest = matched
+        req = Request(method=method, path=rest,
+                      query=query, body=body, headers=headers)
+        try:
+            result = await rs.assign_replica("", (req,), {})
+        except Exception as e:
+            logger.exception("request to %s failed", rs.deployment_name)
+            return 500, repr(e).encode(), "text/plain"
+        return self.format_result(result)
 
 
 class HTTPProxyActor:
@@ -122,9 +178,17 @@ class HTTPProxyActor:
 
         async def _handler(request: "web.Request"):
             body = await request.read()
+            query = dict(request.query)
+            headers_in = dict(request.headers)
+            # Root stays the routes listing whatever the Accept header
+            # says — only routed paths can stream.
+            if request.path not in ("", "/") \
+                    and HTTPProxy.wants_stream(query, headers_in):
+                return await self._handle_sse(request, body, query,
+                                              headers_in)
             status, payload, ctype, *rest = await self._proxy.handle(
-                request.method, request.path, dict(request.query), body,
-                dict(request.headers))
+                request.method, request.path, query, body,
+                headers_in)
             # ASGI ingress responses carry full headers (Set-Cookie,
             # Location, ...); content-type/length ride dedicated kwargs.
             # A pair list (not a dict) feeds the CIMultiDict so
@@ -151,6 +215,84 @@ class HTTPProxyActor:
             break
         self._ready.set()
         return {"host": self.host, "port": self.port}
+
+    async def _handle_sse(self, request, body: bytes,
+                          query: Dict[str, str],
+                          headers_in: Dict[str, str]):
+        """Server-sent events: each item the deployment yields becomes
+        one `data: <json>` event, flushed immediately (chunked transfer,
+        no buffering) so the first token reaches the client while the
+        rest are still being generated.  The stream ends with
+        `data: [DONE]`; a mid-stream failure emits an `event: error`.
+
+        The FIRST item is pulled before the response status is
+        committed: a deployment that answers unary (not a generator —
+        including structured errors like an overload 503) degrades to a
+        plain response with its real status code, and a failure to even
+        start the stream is a real 500, not a 200 with an error event."""
+        from aiohttp import web
+
+        from ray_tpu.serve._private.router import _UnaryResult
+        status, payload, ctype = await self._proxy.handle_stream(
+            request.method, request.path, query, body, headers_in)
+        if status != 200:
+            return web.Response(status=status, body=payload,
+                                content_type=ctype.split(";")[0])
+        aiter = payload
+        _empty = object()  # distinguishes "no items" from a None item
+        try:
+            first = await aiter.__anext__()
+        except StopAsyncIteration:
+            first = _empty
+        except Exception as e:
+            logger.exception("stream failed before first item")
+            await aiter.aclose()
+            return web.Response(status=500, body=repr(e).encode(),
+                                content_type="text/plain")
+        if isinstance(first, _UnaryResult):
+            await aiter.aclose()
+            status, payload, ctype, pairs = HTTPProxy.format_result(
+                first.value)
+            headers = [(k, v) for k, v in pairs
+                       if k.lower() not in ("content-type",
+                                            "content-length")]
+            return web.Response(status=status, body=payload,
+                                content_type=ctype.split(";")[0],
+                                headers=headers)
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "X-Accel-Buffering": "no"})
+        await resp.prepare(request)
+        try:
+            if first is not _empty:
+                await resp.write(b"data: "
+                                 + _json.dumps(first,
+                                               default=repr).encode()
+                                 + b"\n\n")
+                async for item in aiter:
+                    data = _json.dumps(item, default=repr)
+                    await resp.write(f"data: {data}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except ConnectionResetError:
+            # Client went away: closing the iterator cancels the
+            # replica-side stream (and frees its engine slot).
+            pass
+        except Exception as e:
+            try:
+                await resp.write(
+                    b"event: error\ndata: "
+                    + _json.dumps(repr(e)).encode() + b"\n\n")
+            except Exception:
+                pass
+        finally:
+            await aiter.aclose()
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return resp
 
     async def ready(self) -> Dict:
         await self._ready.wait()

@@ -54,6 +54,8 @@ class RTServeReplica:
         self.version = version
         self._num_ongoing = 0
         self._num_processed = 0
+        self._streams: Dict[str, Dict[str, Any]] = {}
+        self._stream_seq = 0
         from concurrent.futures import ThreadPoolExecutor
         self._sync_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"replica-{replica_tag}")
@@ -99,30 +101,201 @@ class RTServeReplica:
         (function deployment or __call__)."""
         self._num_ongoing += 1
         try:
-            target = self.callable
-            if method_name:
-                target = getattr(self.callable, method_name)
-            elif not callable(target):
-                target = self.callable.__call__
-            if inspect.iscoroutinefunction(target) or (
-                    not inspect.isfunction(target)
-                    and not inspect.ismethod(target)
-                    and inspect.iscoroutinefunction(
-                        getattr(target, "__call__", None))):
-                result = await target(*args, **kwargs)
-            else:
-                # Sync user code must not block the replica's event loop:
-                # health checks, metrics, and concurrent queries (up to
-                # max_concurrent_queries) ride the same loop.
-                loop = asyncio.get_running_loop()
-                result = await loop.run_in_executor(
-                    self._sync_pool, lambda: target(*args, **kwargs))
-                if inspect.iscoroutine(result):
-                    result = await result
-            return result
+            target = self._resolve_target(method_name)
+            return await self._call_target(target, args, kwargs)
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
+
+    async def _call_target(self, target, args, kwargs):
+        """Invoke a resolved target with the loop-protection rule shared
+        by the unary and streaming paths: sync user code must not block
+        the replica's event loop (health checks, metrics, and concurrent
+        queries up to max_concurrent_queries ride the same loop)."""
+        if inspect.iscoroutinefunction(target) or (
+                not inspect.isfunction(target)
+                and not inspect.ismethod(target)
+                and inspect.iscoroutinefunction(
+                    getattr(target, "__call__", None))):
+            return await target(*args, **kwargs)
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(
+            self._sync_pool, lambda: target(*args, **kwargs))
+        if inspect.iscoroutine(result):
+            result = await result
+        return result
+
+    # -- streaming calls ------------------------------------------------
+    #
+    # Async generators can't ride a single actor-call result, so a
+    # streaming request is split into (1) handle_request_streaming,
+    # which starts the generator, pumps it into a buffer, and returns a
+    # stream id, then (2) a cursor-based stream_next long-poll that
+    # drains NEW items as soon as any exist.  One long-poll returns
+    # every item produced since the last poll, so a fast producer is
+    # amortized (many tokens per RPC) while a slow one still delivers
+    # each token the moment it appears.
+
+    def _resolve_target(self, method_name: str):
+        target = self.callable
+        if method_name:
+            target = getattr(self.callable, method_name)
+        elif not callable(target):
+            target = self.callable.__call__
+        return target
+
+    async def handle_request_streaming(self, method_name: str,
+                                       args: tuple, kwargs: dict) -> Dict:
+        """Start a streaming query.  If the target produces an async
+        generator (an `async def ... yield` method, or a coroutine
+        returning an async iterable) -> {"stream_id": sid} to poll with
+        stream_next.  Otherwise the call has ALREADY run to completion
+        and its value rides back as {"unary": result} — one invocation
+        either way, so the caller (proxy) can fall back to a normal
+        response without re-running side effects."""
+        self._sweep_stale_streams()
+        target = self._resolve_target(method_name)
+        if inspect.isasyncgenfunction(target):
+            ait = target(*args, **kwargs)
+        else:
+            self._num_ongoing += 1
+            try:
+                result = await self._call_target(target, args, kwargs)
+            finally:
+                self._num_ongoing -= 1
+            if inspect.isgenerator(result):
+                # Plain `def ... yield` deployment: drive it from the
+                # sync pool so a blocking body can't stall the
+                # replica's event loop (and a generator must never be
+                # pickled into a unary reply).
+                result = self._drive_sync_generator(result)
+            if not hasattr(result, "__aiter__"):
+                self._num_processed += 1
+                return {"unary": result}
+            ait = result
+        self._stream_seq += 1
+        stream_id = f"{self.replica_tag}:{self._stream_seq}"
+        state = {"buf": [], "done": False, "error": None,
+                 "event": asyncio.Event(), "task": None,
+                 "last_poll": time.monotonic()}
+        self._streams[stream_id] = state
+        self._num_ongoing += 1  # the slot stays held while streaming
+        state["task"] = asyncio.get_running_loop().create_task(
+            self._pump_stream(stream_id, ait.__aiter__()))
+        return {"stream_id": stream_id}
+
+    # A consumer that vanishes (handle process killed, or a cancel RPC
+    # lost in flight) stops polling without ever sending stream_cancel;
+    # its buffered tokens would otherwise sit in _streams forever.  Any
+    # stream unpolled for this long is torn down at the next streaming
+    # admission.
+    STREAM_IDLE_TTL_S = 300.0
+
+    def _sweep_stale_streams(self):
+        now = time.monotonic()
+        stale = [sid for sid, st in self._streams.items()
+                 if now - st["last_poll"] > self.STREAM_IDLE_TTL_S]
+        for sid in stale:
+            state = self._streams.pop(sid, None)
+            if state is None:
+                continue
+            task = state["task"]
+            if task is not None and not task.done():
+                task.cancel()
+
+    async def _drive_sync_generator(self, gen):
+        """Adapt a sync generator to async: each next() runs on the
+        replica's sync pool."""
+        sentinel = object()
+        cfut = None
+        try:
+            while True:
+                cfut = self._sync_pool.submit(
+                    lambda: next(gen, sentinel))
+                item = await asyncio.wrap_future(cfut)
+                cfut = None  # consumed; safe to close directly
+                if item is sentinel:
+                    return
+                yield item
+        finally:
+            # On cancellation the pool thread may still be INSIDE
+            # next(gen) — closing a generator mid-execution raises
+            # "generator already executing" and skips its cleanup.
+            # Chain the close behind the in-flight call instead.
+            def _close():
+                try:
+                    gen.close()
+                except Exception:
+                    pass
+            if cfut is not None and not cfut.done():
+                cfut.add_done_callback(
+                    lambda _f: self._sync_pool.submit(_close))
+            else:
+                _close()
+
+    async def _pump_stream(self, stream_id: str, ait):
+        state = self._streams[stream_id]
+        try:
+            async for item in ait:
+                state["buf"].append(item)
+                state["event"].set()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            state["error"] = e
+        finally:
+            state["done"] = True
+            state["event"].set()
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
+    async def stream_next(self, stream_id: str, cursor: int,
+                          timeout_s: float = 10.0) -> Dict:
+        """Long-poll items[cursor:]: returns as soon as at least one new
+        item exists (or the stream ends / timeout_s elapses).  The
+        cursor makes polls idempotent — a retried RPC re-reads instead
+        of skipping.  {"items": [...], "done": bool, "error": exc|None};
+        the terminal poll (done=True with all items consumed) drops the
+        server-side state."""
+        state = self._streams.get(stream_id)
+        if state is None:
+            raise KeyError(f"unknown stream {stream_id!r} (already "
+                           "finished, cancelled, or never started)")
+        state["last_poll"] = time.monotonic()
+        deadline = time.monotonic() + timeout_s
+        while len(state["buf"]) <= cursor and not state["done"]:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return {"items": [], "done": False, "error": None}
+            state["event"].clear()
+            try:
+                await asyncio.wait_for(state["event"].wait(),
+                                       timeout=remain)
+            except asyncio.TimeoutError:
+                return {"items": [], "done": False, "error": None}
+        items = state["buf"][cursor:]
+        done = state["done"]
+        out = {"items": items, "done": done,
+               "error": state["error"] if done else None}
+        if done:
+            self._streams.pop(stream_id, None)
+        return out
+
+    async def stream_cancel(self, stream_id: str) -> bool:
+        """Tear a stream down early (client disconnected): cancels the
+        pump task, which closes the user generator (its finally blocks
+        run — e.g. the engine frees the request's slot)."""
+        state = self._streams.pop(stream_id, None)
+        if state is None:
+            return False
+        task = state["task"]
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        return True
 
     def get_metadata(self) -> Dict:
         return {"deployment": self.deployment_name,

@@ -4,6 +4,11 @@ Reference: python/ray/serve/_private/router.py — Router (:262) +
 ReplicaSet.assign_replica (:222): pick a replica with a free slot
 (in-flight < max_concurrent_queries); if all are saturated, queue the
 query until one frees.  Replica membership arrives via long poll.
+
+Saturation is observable: queue depth and in-flight counts are exported
+as util.metrics gauges (serve_router_queue_depth / serve_router_in_flight
+/ serve_replica_in_flight) so a saturated deployment shows up next to
+the engine metrics instead of manifesting only as latency.
 """
 
 from __future__ import annotations
@@ -11,11 +16,37 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-from typing import Any, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional
 
 from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
+
+QUEUE_DEPTH_GAUGE = _metrics.Gauge(
+    "serve_router_queue_depth",
+    "Queries waiting in this process's router for a free replica slot",
+    tag_keys=("deployment",))
+IN_FLIGHT_GAUGE = _metrics.Gauge(
+    "serve_router_in_flight",
+    "Queries this process's router has in flight across all replicas",
+    tag_keys=("deployment",))
+REPLICA_IN_FLIGHT_GAUGE = _metrics.Gauge(
+    "serve_replica_in_flight",
+    "Queries this process's router has in flight per replica",
+    tag_keys=("deployment", "replica"))
+
+
+class _UnaryResult:
+    """Wrapper yielded (once) by assign_replica_stream(unary_fallback=
+    True) when the target turned out not to stream: the deployment ran
+    exactly once and this is its whole answer — the proxy formats it as
+    a plain HTTP response instead of SSE."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
 
 
 class ReplicaSet:
@@ -32,19 +63,38 @@ class ReplicaSet:
     def update_replicas(self, infos: List[Dict]):
         self._replicas = list(infos)
         tags = {i["replica_tag"] for i in infos}
+        for gone in set(self._in_flight) - tags:
+            # Zero the departed replica's series: its finally-block
+            # decrement is skipped once the tag is dropped, and a
+            # stale nonzero gauge would misreport saturation forever.
+            REPLICA_IN_FLIGHT_GAUGE.set(
+                0, tags={"deployment": self.deployment_name,
+                         "replica": gone})
         self._in_flight = {t: self._in_flight.get(t, 0) for t in tags}
+        IN_FLIGHT_GAUGE.set(sum(self._in_flight.values()),
+                            tags={"deployment": self.deployment_name})
         self._slot_freed.set()  # membership change may free capacity
 
-    async def assign_replica(self, method_name: str, args: tuple,
-                             kwargs: dict,
-                             timeout_s: float = 120.0) -> Any:
-        """Pick a replica (power-of-two-choices among free ones), send the
-        query, and release the slot when it completes.  Bounded: a request
-        that can't be assigned within timeout_s (no replicas — deployment
-        deleted or all crashed) errors instead of hanging forever."""
+    def _set_queued(self, delta: int):
+        self.num_queued += delta
+        QUEUE_DEPTH_GAUGE.set(self.num_queued,
+                              tags={"deployment": self.deployment_name})
+
+    def _track_in_flight(self, tag: str, delta: int):
+        self._in_flight[tag] = self._in_flight.get(tag, 0) + delta
+        IN_FLIGHT_GAUGE.set(sum(self._in_flight.values()),
+                            tags={"deployment": self.deployment_name})
+        REPLICA_IN_FLIGHT_GAUGE.set(
+            self._in_flight[tag],
+            tags={"deployment": self.deployment_name, "replica": tag})
+
+    async def _acquire(self, timeout_s: float) -> Dict:
+        """Wait (bounded) for a replica with a free slot; the caller owns
+        one in-flight unit on the returned replica and must release it
+        via _track_in_flight(tag, -1)."""
         import time as _time
         deadline = _time.monotonic() + timeout_s
-        self.num_queued += 1
+        self._set_queued(+1)
         try:
             while True:
                 choice = self._pick()
@@ -62,9 +112,19 @@ class ReplicaSet:
                 except asyncio.TimeoutError:
                     pass  # re-check membership; maybe replicas arrived
         finally:
-            self.num_queued -= 1
+            self._set_queued(-1)
+        self._track_in_flight(choice["replica_tag"], +1)
+        return choice
+
+    async def assign_replica(self, method_name: str, args: tuple,
+                             kwargs: dict,
+                             timeout_s: float = 120.0) -> Any:
+        """Pick a replica (power-of-two-choices among free ones), send the
+        query, and release the slot when it completes.  Bounded: a request
+        that can't be assigned within timeout_s (no replicas — deployment
+        deleted or all crashed) errors instead of hanging forever."""
+        choice = await self._acquire(timeout_s)
         tag = choice["replica_tag"]
-        self._in_flight[tag] = self._in_flight.get(tag, 0) + 1
         try:
             actor = choice["actor"]
             ref = actor.handle_request.remote(method_name, args, kwargs)
@@ -73,8 +133,77 @@ class ReplicaSet:
             return await asyncio.wrap_future(ref.future())
         finally:
             if tag in self._in_flight:
-                self._in_flight[tag] -= 1
+                self._track_in_flight(tag, -1)
             self._slot_freed.set()
+
+    async def assign_replica_stream(self, method_name: str, args: tuple,
+                                    kwargs: dict,
+                                    timeout_s: float = 120.0,
+                                    unary_fallback: bool = False
+                                    ) -> AsyncIterator:
+        """Streaming twin of assign_replica: starts a generator-valued
+        call on one replica and returns an async iterator over its
+        items.  The replica's in-flight slot is held for the LIFETIME of
+        the stream (a generating request occupies engine capacity, so it
+        must count against max_concurrent_queries the whole time);
+        closing the iterator early cancels the remote stream.
+
+        A target that turns out NOT to stream ran exactly once on the
+        replica; with unary_fallback the iterator yields its value
+        wrapped in _UnaryResult (proxy path — degrade to a plain
+        response), otherwise it raises TypeError (handle.stream() on a
+        unary method is caller error)."""
+
+        async def _gen():
+            # Everything — INCLUDING slot acquisition — happens inside
+            # the generator body: a stream that is closed (or dropped)
+            # before its first iteration never starts this body, and an
+            # unstarted generator's finally never runs, so acquiring
+            # out here would leak the in-flight slot forever.
+            choice = await self._acquire(timeout_s)
+            tag = choice["replica_tag"]
+            actor = choice["actor"]
+            finished = False
+            stream_id = None
+            try:
+                started = await asyncio.wrap_future(
+                    actor.handle_request_streaming.remote(
+                        method_name, args, kwargs).future())
+                if "stream_id" not in started:
+                    finished = True
+                    if not unary_fallback:
+                        raise TypeError(
+                            f"{self.deployment_name}."
+                            f"{method_name or '__call__'} returned a "
+                            "non-streaming result; use handle.remote() "
+                            "for unary calls")
+                    yield _UnaryResult(started["unary"])
+                    return
+                stream_id = started["stream_id"]
+                cursor = 0
+                while True:
+                    out = await asyncio.wrap_future(
+                        actor.stream_next.remote(stream_id,
+                                                 cursor).future())
+                    for item in out["items"]:
+                        yield item
+                    cursor += len(out["items"])
+                    if out["done"]:
+                        finished = True
+                        if out.get("error") is not None:
+                            raise out["error"]
+                        return
+            finally:
+                if stream_id is not None and not finished:
+                    # Early close / client gone: free the replica-side
+                    # stream (and whatever slot it holds in an engine).
+                    actor.stream_cancel.options(num_returns=0).remote(
+                        stream_id)
+                if tag in self._in_flight:
+                    self._track_in_flight(tag, -1)
+                self._slot_freed.set()
+
+        return _gen()
 
     def _pick(self) -> Optional[Dict]:
         free = [r for r in self._replicas
@@ -112,6 +241,11 @@ class Router:
     async def assign_request(self, method_name: str, args: tuple,
                              kwargs: dict):
         return await self.replica_set.assign_replica(
+            method_name, args, kwargs)
+
+    async def assign_request_stream(self, method_name: str, args: tuple,
+                                    kwargs: dict):
+        return await self.replica_set.assign_replica_stream(
             method_name, args, kwargs)
 
     def stop(self):

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import weakref
 from typing import Any, Callable, List, Optional
 
 
@@ -23,9 +24,14 @@ class _BatchQueue:
         self._timeout = batch_wait_timeout_s
         self._pending: List[tuple] = []   # (arg, future)
         self._flusher: Optional[asyncio.TimerHandle] = None
+        # Captured at submit() time: _flush may run from a timer
+        # callback, where asyncio.get_event_loop() is deprecated (and
+        # wrong if the instance migrated loops between batches).
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     async def submit(self, instance, arg) -> Any:
         loop = asyncio.get_running_loop()
+        self._loop = loop
         fut = loop.create_future()
         self._pending.append((arg, fut))
         if len(self._pending) >= self._max:
@@ -44,7 +50,7 @@ class _BatchQueue:
             return
         args = [a for a, _ in batch]
         futs = [f for _, f in batch]
-        loop = asyncio.get_event_loop()
+        loop = self._loop
 
         async def _run():
             try:
@@ -75,7 +81,43 @@ def batch(_fn=None, *, max_batch_size: int = 10,
     def _decorate(fn):
         if not asyncio.iscoroutinefunction(fn):
             raise TypeError("@serve.batch requires an async function")
-        queues: dict = {}  # per-instance (or one for free functions)
+        # Registry: id(instance) -> (weakref-to-instance, _BatchQueue).
+        # The weakref serves two jobs: its death callback evicts the
+        # entry (a plain id-keyed dict outlives every replica restart —
+        # a leak), and the `wr() is instance` check catches id() reuse
+        # (a NEW object allocated at a dead object's address must not
+        # inherit the dead object's queue).
+        queues: dict = {}
+
+        def _queue_for(instance):
+            key = id(instance)
+            entry = queues.get(key)
+            if entry is not None:
+                wr, q = entry
+                if wr is None or wr() is instance:
+                    return q
+                del queues[key]  # id reused by a different object
+            q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+            if instance is None:
+                wr = None  # free function: one immortal queue
+            else:
+                def _on_death(ref, _key=key):
+                    # GC can defer this callback (reference cycles)
+                    # until AFTER the key was reused by a successor
+                    # instance: only evict the entry if it is still
+                    # OURS.
+                    cur = queues.get(_key)
+                    if cur is not None and cur[0] is ref:
+                        queues.pop(_key, None)
+                try:
+                    wr = weakref.ref(instance, _on_death)
+                except TypeError:
+                    # Non-weakrefable instance: pin it (a strong-ref
+                    # closure) so its id can never be reused — the old
+                    # leak, but only for exotic classes.
+                    wr = (lambda obj: (lambda: obj))(instance)
+            queues[key] = (wr, q)
+            return q
 
         @functools.wraps(fn)
         async def wrapper(*args):
@@ -85,13 +127,10 @@ def batch(_fn=None, *, max_batch_size: int = 10,
                 instance, item = None, args[0]
             else:
                 raise TypeError("@serve.batch methods take one argument")
-            q = queues.get(id(instance))
-            if q is None:
-                q = queues[id(instance)] = _BatchQueue(
-                    fn, max_batch_size, batch_wait_timeout_s)
-            return await q.submit(instance, item)
+            return await _queue_for(instance).submit(instance, item)
 
         wrapper._rt_serve_batch = True
+        wrapper._rt_batch_queues = queues  # introspection for tests
         return wrapper
 
     if _fn is not None:

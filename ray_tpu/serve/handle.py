@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import threading
+import time
 from typing import Any, Optional
 
 _router_loop: Optional[asyncio.AbstractEventLoop] = None
@@ -43,6 +44,155 @@ class ServeResponse:
 
     def __await__(self):
         return asyncio.wrap_future(self._fut).__await__()
+
+
+class ServeResponseStream:
+    """Streaming result of handle.stream(): iterate items as the replica
+    yields them (`async for item in stream` from any event loop, or a
+    plain `for item in stream` from sync code — but never a sync `for`
+    ON the router loop's own thread, which would deadlock).
+
+    The underlying async generator lives on the shared router loop;
+    every step is scheduled there, so consumers on other loops/threads
+    only ever wait on a local future.  Items are pulled one at a time —
+    interleave two consumers and they'll steal from each other, so
+    don't share a stream."""
+
+    def __init__(self, agen_fut: concurrent.futures.Future, loop):
+        self._agen_fut = agen_fut   # resolves to the async generator
+        self._agen = None
+        self._loop = loop
+        self._closed = False
+        self._pending: Optional[concurrent.futures.Future] = None
+        self._partial: list = []  # result()'s drained-so-far stash
+
+    def _step(self) -> concurrent.futures.Future:
+        # A step abandoned by a timed-out/cancelled wait — even one
+        # that COMPLETED right after the timeout fired — holds an
+        # unconsumed item; hand it back instead of starting a second
+        # concurrent __anext__ on the same generator (which would raise
+        # "already running" — or silently drop that item).  _pending is
+        # cleared only at consumption sites, never on wait timeout.
+        if self._pending is not None:
+            return self._pending
+
+        async def _next():
+            if self._agen is None:
+                self._agen = await asyncio.wrap_future(self._agen_fut)
+            return await self._agen.__anext__()
+
+        self._pending = asyncio.run_coroutine_threadsafe(
+            _next(), self._loop)
+        return self._pending
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> Any:
+        if self._closed:
+            raise StopAsyncIteration
+        # StopAsyncIteration propagates through the wrapped future and
+        # terminates the caller's `async for` naturally.
+        fut = self._step()
+        try:
+            val = await asyncio.wrap_future(fut)
+        except asyncio.CancelledError:
+            raise  # the WAIT was cancelled; the step may still deliver
+        except BaseException:
+            self._pending = None  # the step itself ended/failed
+            raise
+        self._pending = None
+        return val
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        fut = self._step()
+        try:
+            val = fut.result()
+        except StopAsyncIteration:
+            self._pending = None
+            raise StopIteration from None
+        except BaseException:
+            self._pending = None
+            raise
+        self._pending = None
+        return val
+
+    async def collect(self) -> list:
+        """Drain the stream into a list (async)."""
+        return [item async for item in self]
+
+    def result(self, timeout: Optional[float] = None) -> list:
+        """Drain the stream into a list (sync).  `timeout` bounds the
+        WHOLE drain; on timeout NOTHING is lost — the in-flight step
+        AND the items drained so far are kept, and a later result()
+        call returns the complete list from the start."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = self._partial  # resume an earlier timed-out drain
+        while True:
+            remain = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            fut = self._step()
+            try:
+                val = fut.result(remain)
+            except StopAsyncIteration:
+                self._pending = None
+                self._partial = []
+                return list(out)
+            except concurrent.futures.TimeoutError:
+                raise TimeoutError(
+                    f"stream still producing after {timeout}s "
+                    f"({len(out)} items so far; call result() again "
+                    "to resume)") from None  # _pending kept
+            except BaseException:
+                self._pending = None
+                raise
+            self._pending = None
+            out.append(val)
+
+    def close(self):
+        """Stop consuming and cancel the remote stream (frees the
+        replica's engine slot); idempotent."""
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._aclose_inner(), self._loop).result(timeout=30)
+        except Exception:
+            pass
+
+    async def aclose(self):
+        """Async close() for use inside event-loop code."""
+        await asyncio.wrap_future(
+            asyncio.run_coroutine_threadsafe(self._aclose_inner(),
+                                             self._loop))
+
+    async def _aclose_inner(self):
+        """The one teardown path (close() and aclose() both land here,
+        on the router loop).  A step left in flight by a timed-out
+        result() keeps the generator suspended inside __anext__ — and
+        aclose() on a RUNNING async generator raises instead of
+        closing — so the pending step is cancelled first, which unwinds
+        the generator (its finally cancels the remote stream and
+        releases the in-flight slot)."""
+        if self._closed:
+            return
+        self._closed = True
+        pending, self._pending = self._pending, None
+        if pending is not None and not pending.done():
+            pending.cancel()
+            try:
+                await asyncio.wrap_future(pending)
+            except BaseException:
+                pass
+        if self._agen is None:
+            try:
+                self._agen = await asyncio.wrap_future(self._agen_fut)
+            except Exception:
+                return
+        await self._agen.aclose()
 
 
 class DeploymentHandle:
@@ -81,6 +231,25 @@ class DeploymentHandle:
         fut = asyncio.run_coroutine_threadsafe(
             router.assign_request(self._method_name, args, kwargs), loop)
         return ServeResponse(fut)
+
+    def stream(self, *args, **kwargs) -> ServeResponseStream:
+        """Call a generator-valued deployment method and stream its
+        items as they are produced (vs .remote(), which returns one
+        value when the call completes):
+
+            async for token in handle.tokens.stream(prompt): ...
+            for token in handle.options("tokens").stream(prompt): ...
+
+        The method addressed by this handle (via attribute access or
+        .options()) must be an async generator on the deployment.  A
+        deployment method that itself is named "stream" shadows against
+        this real method — address it with handle.options("stream")."""
+        router = self._ensure_router()
+        loop = _get_router_loop()
+        fut = asyncio.run_coroutine_threadsafe(
+            router.assign_request_stream(self._method_name, args,
+                                         kwargs), loop)
+        return ServeResponseStream(fut, loop)
 
     def options(self, method_name: str = "") -> "DeploymentHandle":
         return DeploymentHandle(self.deployment_name, self._controller,

@@ -1,0 +1,29 @@
+"""ray_tpu.serve.llm: continuous-batching LLM inference.
+
+Iteration-level scheduling (Orca) over the static-shape KV caches of
+models/decode.py: a fixed pool of cache slots, chunked prefill so
+admission never stalls decoding for more than one chunk, one fused
+decode_step per tick across every occupied slot, and per-request token
+streams.  vLLM's slot-recycling insight without paging — TPU-native
+static shapes make whole-slot recycling the natural unit.
+
+    engine.py     GenerationEngine + TokenStream (the device loop)
+    scheduler.py  FCFS admission queue with backpressure
+    api.py        LLMServer deployment: generate()/stream()/HTTP+SSE
+"""
+
+from ray_tpu.serve.llm.engine import (  # noqa: F401
+    EngineStats,
+    GenerationEngine,
+    TokenStream,
+)
+from ray_tpu.serve.llm.scheduler import (  # noqa: F401
+    EngineOverloadedError,
+    FCFSScheduler,
+)
+from ray_tpu.serve.llm.api import LLMServer, llm_deployment  # noqa: F401
+
+__all__ = [
+    "EngineOverloadedError", "EngineStats", "FCFSScheduler",
+    "GenerationEngine", "LLMServer", "TokenStream", "llm_deployment",
+]

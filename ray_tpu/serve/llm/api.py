@@ -1,0 +1,170 @@
+"""LLMServer: the serve deployment wrapping a GenerationEngine.
+
+One replica = one engine = one chip's KV-slot pool.  Three surfaces:
+
+  * handle.generate.remote(tokens, ...)          -> full token list
+  * handle.options("stream").stream(tokens, ...) -> ServeResponseStream
+    (token at a time, through the replica streaming transport; the
+    options() spelling is needed because the method is literally named
+    "stream", which shadows DeploymentHandle.stream)
+  * HTTP POST {route}/  body {"tokens": [...], ...}  -> JSON; with
+    Accept: text/event-stream (or "stream": true) the proxy emits SSE
+    events, one token per event, as they are generated.
+
+Engine overload surfaces as EngineOverloadedError on handles and as
+HTTP 503 with Retry-After through the proxy (backpressure, not
+buffering).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.serve.llm.engine import GenerationEngine
+from ray_tpu.serve.llm.scheduler import EngineOverloadedError
+
+_GEN_KEYS = ("max_new_tokens", "temperature", "top_k", "eos_token",
+             "seed")
+
+
+class LLMServer:
+    """Deployment class hosting one continuous-batching engine.
+
+    `model_loader` is a zero-arg callable returning (params, cfg) —
+    a callable (not the weights) so the deployment pickles small and
+    the params are materialized inside the replica process, resident
+    next to its chip.  `engine_config` feeds GenerationEngine knobs
+    (num_slots, max_seq, prefill_chunk, max_queue_len, ...)."""
+
+    def __init__(self, model_loader, engine_config: Optional[Dict] = None,
+                 default_generation: Optional[Dict] = None):
+        params, cfg = model_loader()
+        self._defaults = dict(default_generation or {})
+        self.engine = GenerationEngine(params, cfg,
+                                       **(engine_config or {}))
+        self.engine.start()
+
+    def _gen_kwargs(self, overrides: Dict[str, Any]) -> Dict[str, Any]:
+        kw = dict(self._defaults)
+        kw.update({k: v for k, v in overrides.items() if k in _GEN_KEYS})
+        unknown = set(overrides) - set(_GEN_KEYS)
+        if unknown:
+            raise TypeError(f"unknown generation options: {sorted(unknown)}")
+        return kw
+
+    async def generate(self, tokens: Sequence[int], **overrides
+                       ) -> List[int]:
+        """Full generation for one prompt (continuous-batched under the
+        hood with every other in-flight request)."""
+        return await self.engine.generate(
+            tokens, **self._gen_kwargs(overrides))
+
+    async def stream(self, tokens: Sequence[int], **overrides):
+        """Token-streaming generation: an async generator, consumed
+        through the serve streaming transport
+        (handle.options("stream").stream(...) client-side, SSE over
+        HTTP)."""
+        stream = self.engine.submit(tokens, **self._gen_kwargs(overrides))
+        try:
+            async for tok in stream:
+                yield int(tok)
+        finally:
+            # Early close (client cancelled / disconnected): free the
+            # engine slot instead of generating into a dead buffer.
+            stream.cancel()
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats().to_dict()
+
+    def check_health(self):
+        if not self.engine.running:
+            raise RuntimeError("generation engine worker is not running")
+
+    def __del__(self):
+        try:
+            self.engine.stop(timeout=5.0)
+        except Exception:
+            pass
+
+    # -- HTTP entry point (proxy) --------------------------------------
+
+    async def __call__(self, request):
+        """POST JSON {"tokens": [ints], "max_new_tokens"?, "temperature"?,
+        "top_k"?, "eos_token"?, "seed"?}.
+
+        Plain: {"tokens": [...]} JSON in one shot.  With
+        `Accept: text/event-stream` or `?stream=1` the PROXY routes the
+        call through the streaming transport and this returns an async
+        generator — one `data: {"token": t}` SSE event per generated
+        token (the detection rule here must mirror the proxy's, which
+        decides before the replica is ever called)."""
+        try:
+            body = request.json()
+        except Exception:
+            return _http_error(400, "body must be JSON")
+        if not isinstance(body, dict) or "tokens" not in body:
+            return _http_error(400, 'body must be {"tokens": [...]}')
+        wants_sse = _wants_stream(request)
+        overrides = {k: body[k] for k in _GEN_KEYS if k in body}
+        try:
+            kw = self._gen_kwargs(overrides)
+            if wants_sse:
+                stream = self.engine.submit(body["tokens"], **kw)
+                return self._sse_events(stream)
+            out = await self.engine.generate(body["tokens"], **kw)
+        except EngineOverloadedError as e:
+            return _http_error(503, str(e),
+                               headers=[("Retry-After", "1")])
+        except (TypeError, ValueError) as e:
+            return _http_error(400, str(e))
+        return {"tokens": out}
+
+    async def _sse_events(self, stream):
+        try:
+            async for tok in stream:
+                yield {"token": int(tok)}
+        finally:
+            stream.cancel()  # client went away mid-generation: free the slot
+
+
+def _wants_stream(request) -> bool:
+    """THE streaming-detection predicate — literally the proxy's own
+    (HTTPProxy.wants_stream), so the replica's choice of generator vs
+    unary can never drift from the transport the proxy picked."""
+    from ray_tpu.serve._private.http_proxy import HTTPProxy
+    return HTTPProxy.wants_stream(getattr(request, "query", None) or {},
+                                  getattr(request, "headers", None) or {})
+
+
+def _http_error(status: int, message: str, headers=None) -> Dict:
+    """Structured response the HTTP proxy unwraps (same contract as the
+    ASGI ingress path)."""
+    return {"__http__": True, "status": status,
+            "content_type": "application/json",
+            "headers": list(headers or []),
+            "body": json.dumps({"error": message}).encode()}
+
+
+def llm_deployment(model_loader, *, name: str = "llm",
+                   num_replicas: int = 1,
+                   engine_config: Optional[Dict] = None,
+                   default_generation: Optional[Dict] = None,
+                   route_prefix: Optional[str] = None,
+                   max_concurrent_queries: int = 256,
+                   ray_actor_options: Optional[Dict] = None):
+    """Build a ready-to-deploy LLMServer Deployment.
+
+        handle = llm_deployment(loader, engine_config={"num_slots": 8}
+                                ).deploy()
+        tokens = handle.generate.remote([1, 2, 3]).result()
+        for tok in handle.options("stream").stream([1, 2, 3]):
+            ...
+    """
+    from ray_tpu.serve.api import deployment
+    dep = deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        max_concurrent_queries=max_concurrent_queries,
+        ray_actor_options=ray_actor_options, route_prefix=route_prefix)
+    return dep.options(init_args=(model_loader, engine_config,
+                                  default_generation))

@@ -1,0 +1,679 @@
+"""GenerationEngine: iteration-level scheduling over a slot-pool KV cache.
+
+The decode loop of models/decode.py serves one batch from arrival to
+completion; here the batch dimension becomes a POOL OF SLOTS that
+requests flow through independently (Orca's continuous batching, vLLM's
+slot recycling without paging — whole static-shape cache rows are the
+recycling unit, which is the TPU-native choice):
+
+  * a fixed [L, num_slots, max_seq, Hkv, Dh] cache is allocated once;
+  * arriving requests wait in an FCFS queue (scheduler.py) and are
+    prefilled ONE CHUNK PER TICK into a batch-1 scratch cache
+    (chunk_step), so admission never stalls decoding for more than one
+    chunk of prefill compute;
+  * a finished prefill is spliced into its reserved slot
+    (decode.insert_cache_slot) and the row joins the fused decode batch;
+  * every tick runs ONE decode_step across all slots with a per-row
+    position vector — rows at different depths share the dispatch;
+  * each sampled token is pushed to that request's TokenStream
+    immediately (streaming TTFT = prefill time, not batch time);
+  * rows hitting EOS / max_new_tokens are evicted, their slot zeroed
+    (decode.reset_cache_slot) and reused by the next admission.
+
+The device loop runs on a dedicated worker thread: jax dispatch blocks,
+and the replica's asyncio loop must stay free to serve stream polls.
+Greedy sampling stays on device (argmax); temperature>0 rows sample
+host-side from the row's logits with a per-request seeded RNG.
+
+Parity contract (tested): with temperature=0 the tokens a request
+streams are bit-identical to decode.generate() run on that prompt
+alone — chunked prefill, slot insertion, and per-row decode are pure
+scheduling transforms, never result transforms.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import decode
+from ray_tpu.serve.llm.scheduler import EngineOverloadedError, FCFSScheduler
+from ray_tpu.util import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+# Latency boundaries tuned for token-scale events (the default metric
+# buckets start at 5ms and top out at 10s — fine for TTFT, too coarse
+# for inter-token gaps on a fast chip).
+_LATENCY_BOUNDARIES = [
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+    5, 10, 30]
+
+TTFT_HISTOGRAM = _metrics.Histogram(
+    "serve_llm_ttft_seconds",
+    "Time from submit() to the first streamed token",
+    boundaries=_LATENCY_BOUNDARIES, tag_keys=("engine",))
+ITL_HISTOGRAM = _metrics.Histogram(
+    "serve_llm_inter_token_seconds",
+    "Gap between consecutive streamed tokens of one request",
+    boundaries=_LATENCY_BOUNDARIES, tag_keys=("engine",))
+TOKENS_COUNTER = _metrics.Counter(
+    "serve_llm_tokens_generated_total",
+    "Tokens streamed to clients", tag_keys=("engine",))
+REQUESTS_COUNTER = _metrics.Counter(
+    "serve_llm_requests_total",
+    "Requests by terminal status",
+    tag_keys=("engine", "status"))
+QUEUE_GAUGE = _metrics.Gauge(
+    "serve_llm_queue_depth",
+    "Requests waiting for a slot (admission queue)",
+    tag_keys=("engine",))
+OCCUPANCY_GAUGE = _metrics.Gauge(
+    "serve_llm_slot_occupancy",
+    "Fraction of KV-cache slots mid-generation", tag_keys=("engine",))
+THROUGHPUT_GAUGE = _metrics.Gauge(
+    "serve_llm_tokens_per_sec",
+    "Streamed tokens/sec over the last measurement window",
+    tag_keys=("engine",))
+
+class TokenStream:
+    """Per-request stream of generated token ids.
+
+    Producer is the engine's worker thread; consumers may be sync
+    (`for tok in stream`, `stream.result()`) or async
+    (`async for tok in stream`, `await stream.collect()`) on any event
+    loop — waiters are woken through loop.call_soon_threadsafe, so no
+    consumer loop ever blocks on the device."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._buf: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._wakeups: List = []   # zero-arg callables, fired once each
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._cancel = threading.Event()
+        self._partial: List[int] = []  # result()'s drained-so-far stash
+
+    # -- producer side (engine worker thread) --
+
+    def _push(self, token: int):
+        with self._lock:
+            self._buf.append(token)
+            wakeups, self._wakeups = self._wakeups, []
+        self._fire(wakeups)
+
+    def _finish(self, error: Optional[BaseException] = None):
+        with self._lock:
+            self._done = True
+            self._error = error
+            wakeups, self._wakeups = self._wakeups, []
+        self._fire(wakeups)
+
+    @staticmethod
+    def _fire(wakeups):
+        for w in wakeups:
+            try:
+                w()
+            except RuntimeError:
+                # A consumer abandoned its wait and closed its event
+                # loop; its wakeup is moot and must not poison the
+                # engine's worker thread.
+                pass
+
+    # -- consumer side --
+
+    def cancel(self):
+        """Ask the engine to stop this request; the stream finishes
+        with whatever tokens were already generated."""
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def _pop_or_register(self, wakeup):
+        """Pop a buffered item, or register a wakeup and return _DONE /
+        None.  Returns (kind, value): ('tok', t) | ('end', err) |
+        ('wait', None)."""
+        with self._lock:
+            if self._buf:
+                return "tok", self._buf.popleft()
+            if self._done:
+                return "end", self._error
+            self._wakeups.append(wakeup)
+            return "wait", None
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+        while True:
+            loop = asyncio.get_running_loop()
+            ev = asyncio.Event()
+            kind, val = self._pop_or_register(
+                lambda: loop.call_soon_threadsafe(ev.set))
+            if kind == "tok":
+                return val
+            if kind == "end":
+                if val is not None:
+                    raise val
+                raise StopAsyncIteration
+            await ev.wait()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            ev = threading.Event()
+            kind, val = self._pop_or_register(ev.set)
+            if kind == "tok":
+                return val
+            if kind == "end":
+                if val is not None:
+                    raise val
+                raise StopIteration
+            ev.wait()
+
+    async def collect(self) -> List[int]:
+        """Await the full generation as a token list."""
+        return [t async for t in self]
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block (sync) for the full generation.  On TimeoutError no
+        tokens are lost: whatever was drained is kept and a later
+        result() call returns the COMPLETE list from the start."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = self._partial  # resume whatever an earlier timeout drained
+        while True:
+            ev = threading.Event()
+            kind, val = self._pop_or_register(ev.set)
+            if kind == "tok":
+                out.append(val)
+            elif kind == "end":
+                if val is not None:
+                    raise val
+                self._partial = []
+                return list(out)
+            else:
+                remain = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remain is not None and remain <= 0:
+                    raise TimeoutError(
+                        f"request {self.request_id} still generating "
+                        f"after {timeout}s")
+                ev.wait(remain)
+
+
+@dataclasses.dataclass
+class EngineStats:
+    queue_depth: int
+    active_slots: int
+    num_slots: int
+    tokens_generated: int
+    requests_completed: int
+    requests_rejected: int
+    requests_cancelled: int
+    tokens_per_sec: float
+    uptime_s: float
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class _Request:
+    __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "eos_token", "rng", "stream", "submit_t",
+                 "first_token_t", "last_token_t", "emitted")
+
+    def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
+                 eos_token, seed):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_token = eos_token
+        self.rng = np.random.default_rng(seed) if temperature > 0 else None
+        self.stream = TokenStream(rid)
+        self.submit_t = time.monotonic()
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.emitted = 0
+
+
+class _PrefillState:
+    __slots__ = ("req", "slot", "next_start")
+
+    def __init__(self, req: _Request, slot: int):
+        self.req = req
+        self.slot = slot
+        self.next_start = 0
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "with_logits"),
+                   donate_argnames=("cache",))
+def _fused_tick(params, token, pos, cache, cfg, with_logits):
+    """One decode_step across every slot (per-row positions) + on-device
+    greedy argmax; logits ride back to host only when a sampled-mode
+    request is active."""
+    logits, cache = decode.decode_step(params, token, pos, cache, cfg)
+    sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return sampled, (logits if with_logits else None), cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
+def _prefill_chunk(params, tokens, pos, cache, cfg):
+    return decode.chunk_step(params, tokens, pos, cache, cfg)
+
+
+def _host_sample(row_logits: np.ndarray, temperature: float, top_k: int,
+                 rng: np.random.Generator) -> int:
+    """Temperature/top-k sampling on host from one row's fp32 logits."""
+    logits = row_logits.astype(np.float64) / max(temperature, 1e-6)
+    top_k = min(top_k, len(logits))  # a huge k means "no restriction"
+    if top_k > 0:
+        kth = np.sort(logits)[-top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    logits -= logits.max()
+    probs = np.exp(logits)
+    probs /= probs.sum()
+    return int(rng.choice(len(probs), p=probs))
+
+
+class GenerationEngine:
+    """Continuous-batching generation over a fixed pool of cache slots.
+
+    Knobs:
+      num_slots        decode batch width B (slots recycled on finish)
+      max_seq          cache width S; prompt + max_new_tokens <= S
+      prefill_chunk    tokens of prompt prefilled per engine tick
+      max_queue_len    admission-queue cap; past it submit() raises
+                       EngineOverloadedError (backpressure)
+      name             metrics tag value
+
+    `submit()` may be called from any thread / event loop; the returned
+    TokenStream is consumable sync or async.  `start()` is implicit on
+    first submit; `stop()` fails outstanding work and joins the worker.
+    """
+
+    def __init__(self, params, cfg, *, num_slots: int = 4,
+                 max_seq: Optional[int] = None, prefill_chunk: int = 32,
+                 max_queue_len: int = 64,
+                 default_max_new_tokens: int = 64,
+                 name: str = "default"):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if getattr(cfg, "n_experts", 0):
+            raise NotImplementedError(
+                "continuous batching supports dense models only "
+                "(decode has no MoE routing cache)")
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_seq = int(max_seq or cfg.max_seq)
+        self.prefill_chunk = min(prefill_chunk, self.max_seq)
+        self.default_max_new_tokens = default_max_new_tokens
+        self.name = name
+
+        self._scheduler = FCFSScheduler(max_queue_len)
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._started_t = time.monotonic()
+
+        # Device state (worker-thread-owned after start).
+        self._cache = decode.init_cache(cfg, num_slots,
+                                        max_seq=self.max_seq)
+        self._scratch = decode.init_cache(cfg, 1, max_seq=self.max_seq)
+        self._pos = np.zeros((num_slots,), np.int32)
+        self._tok = np.zeros((num_slots,), np.int32)
+        self._slots: List[Optional[_Request]] = [None] * num_slots
+        self._prefill: Optional[_PrefillState] = None
+
+        # Counters (worker thread writes; stats() reads).
+        self._tokens_generated = 0
+        self._completed = 0
+        self._rejected = 0
+        self._cancelled = 0
+        self._win_t = time.monotonic()
+        self._win_tokens = 0
+
+        self._tags = {"engine": name}
+        QUEUE_GAUGE.set(0, tags=self._tags)
+        OCCUPANCY_GAUGE.set(0.0, tags=self._tags)
+
+    # ------------------------------------------------------------------
+    # Public API
+
+    def start(self):
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, name=f"llm-engine-{self.name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0):
+        """Stop the worker; outstanding requests fail with
+        RuntimeError("engine stopped")."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        err = RuntimeError("engine stopped")
+        with self._cond:
+            leftovers = self._scheduler.drain()
+            if self._prefill is not None:
+                leftovers.append(self._prefill.req)
+                self._prefill = None
+            QUEUE_GAUGE.set(0, tags=self._tags)
+        for req in leftovers:
+            req.stream._finish(err)
+        for s, req in enumerate(self._slots):
+            if req is not None:
+                req.stream._finish(err)
+                self._slots[s] = None
+        OCCUPANCY_GAUGE.set(0.0, tags=self._tags)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_token: Optional[int] = None, seed: int = 0,
+               request_id: Optional[str] = None) -> TokenStream:
+        """Queue one prompt; returns its TokenStream immediately.
+
+        Raises EngineOverloadedError when the admission queue is full
+        and ValueError for prompts the cache can never hold."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        max_new = int(self.default_max_new_tokens
+                      if max_new_tokens is None else max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if len(prompt) + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the engine's max_seq={self.max_seq}")
+        # Sampling knobs are validated HERE, the single entry point: a
+        # bad value surfacing later, inside the worker tick, would fail
+        # every co-resident request (_fail_all), not just this one.
+        temperature = float(temperature)
+        top_k = int(top_k)
+        if not np.isfinite(temperature) or temperature < 0:
+            raise ValueError(f"temperature must be finite and >= 0, "
+                             f"got {temperature}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        req = _Request(request_id or uuid.uuid4().hex[:12], prompt,
+                       max_new, temperature, top_k, eos_token, seed)
+        with self._cond:
+            try:
+                self._scheduler.enqueue(req)
+            except EngineOverloadedError:
+                self._rejected += 1
+                REQUESTS_COUNTER.inc(tags={**self._tags,
+                                           "status": "rejected"})
+                raise
+            QUEUE_GAUGE.set(self._scheduler.depth, tags=self._tags)
+            self._cond.notify_all()
+        self.start()
+        return req.stream
+
+    async def generate(self, prompt: Sequence[int], **kw) -> List[int]:
+        """submit() + collect(): the whole generation as a list."""
+        return await self.submit(prompt, **kw).collect()
+
+    def stats(self) -> EngineStats:
+        now = time.monotonic()
+        win = now - self._win_t
+        tps = self._win_tokens / win if win > 0.2 else 0.0
+        return EngineStats(
+            queue_depth=self._scheduler.depth
+            + (1 if self._prefill is not None else 0),
+            active_slots=sum(r is not None for r in self._slots),
+            num_slots=self.num_slots,
+            tokens_generated=self._tokens_generated,
+            requests_completed=self._completed,
+            requests_rejected=self._rejected,
+            requests_cancelled=self._cancelled,
+            tokens_per_sec=round(tps, 2),
+            uptime_s=round(now - self._started_t, 3))
+
+    # ------------------------------------------------------------------
+    # Worker thread
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._stop and not self._has_work_locked():
+                    self._cond.wait(timeout=0.1)
+                if self._stop:
+                    return
+            try:
+                self._admit_one_chunk()
+                self._decode_tick()
+            except Exception as e:  # engine-level fault: fail fast,
+                logger.exception("engine %s tick failed", self.name)
+                self._fail_all(e)
+
+    def _has_work_locked(self) -> bool:
+        return (self._scheduler.depth > 0 or self._prefill is not None
+                or any(r is not None for r in self._slots))
+
+    def _free_slot(self) -> Optional[int]:
+        reserved = self._prefill.slot if self._prefill else -1
+        for s, r in enumerate(self._slots):
+            if r is None and s != reserved:
+                return s
+        return None
+
+    def _admit_one_chunk(self):
+        """Advance admission by AT MOST one prefill chunk (the bound on
+        how long a tick's decode can be delayed by an arrival)."""
+        if self._prefill is None:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            with self._cond:
+                req = self._scheduler.next_request()
+                QUEUE_GAUGE.set(self._scheduler.depth, tags=self._tags)
+            while req is not None and req.stream.cancelled:
+                self._finish_request(req, "cancelled")
+                with self._cond:
+                    req = self._scheduler.next_request()
+                    QUEUE_GAUGE.set(self._scheduler.depth,
+                                    tags=self._tags)
+            if req is None:
+                return
+            # The slot is reserved now so the insert at the end of
+            # prefill can never find the pool full.
+            self._scratch = decode.reset_cache_slot(
+                self._scratch, jnp.int32(0))
+            self._prefill = _PrefillState(req, slot)
+
+        st = self._prefill
+        req = st.req
+        if req.stream.cancelled:
+            self._prefill = None
+            self._finish_request(req, "cancelled")
+            return
+        L = len(req.prompt)
+        start = st.next_start
+        width = min(self.prefill_chunk, self.max_seq - start)
+        real = req.prompt[start:start + width]
+        chunk = np.zeros((1, width), np.int32)
+        chunk[0, :len(real)] = real
+        logits, self._scratch = _prefill_chunk(
+            self.params, jnp.asarray(chunk), jnp.int32(start),
+            self._scratch, self.cfg)
+        st.next_start = start + width
+        if st.next_start < L:
+            return  # more chunks to go; decode proceeds meanwhile
+
+        # Prefill complete: sample the first token from the last REAL
+        # column of the final chunk (pad columns carry garbage).
+        self._prefill = None
+        row = np.asarray(logits[0, len(real) - 1])
+        first = self._sample_host(row, req)
+        now = time.monotonic()
+        if req.eos_token is not None and first == req.eos_token:
+            self._finish_request(req, "completed")
+            return
+        if req.max_new_tokens == 1:
+            # Nothing left to decode: never joins the batch.
+            self._emit(req, first, now)
+            self._finish_request(req, "completed")
+            return
+        # Join the decode batch BEFORE the token is emitted: a consumer
+        # woken by its first token must observe the request as an
+        # active slot, not a phantom.
+        self._cache = decode.insert_cache_slot(
+            self._cache, self._scratch, jnp.int32(st.slot))
+        self._pos[st.slot] = L
+        self._tok[st.slot] = first
+        self._slots[st.slot] = req
+        self._update_occupancy()
+        self._emit(req, first, now)
+
+    def _decode_tick(self):
+        actives = [s for s in range(self.num_slots)
+                   if self._slots[s] is not None]
+        if not actives:
+            return
+        sample_rows = [s for s in actives
+                       if self._slots[s].temperature > 0]
+        sampled, logits, self._cache = _fused_tick(
+            self.params, jnp.asarray(self._tok), jnp.asarray(self._pos),
+            self._cache, self.cfg, with_logits=bool(sample_rows))
+        sampled = np.asarray(sampled)
+        if sample_rows:
+            # Host transfer scales with the SAMPLING rows, not the
+            # whole pool: one temperature>0 request must not ship
+            # [num_slots, vocab] off-device every tick.
+            logits_np = np.asarray(
+                logits[jnp.asarray(np.asarray(sample_rows, np.int32))])
+            row_of = {s: i for i, s in enumerate(sample_rows)}
+        now = time.monotonic()
+        for s in actives:
+            req = self._slots[s]
+            if req.stream.cancelled:
+                self._evict(s, "cancelled")
+                continue
+            if req.temperature > 0:
+                t = _host_sample(logits_np[row_of[s]], req.temperature,
+                                 req.top_k, req.rng)
+            else:
+                t = int(sampled[s])
+            self._tok[s] = t
+            self._pos[s] += 1
+            if req.eos_token is not None and t == req.eos_token:
+                self._evict(s, "completed")
+                continue
+            self._emit(req, t, now)
+            if req.emitted >= req.max_new_tokens:
+                self._evict(s, "completed")
+
+    def _sample_host(self, row_logits: np.ndarray, req: _Request) -> int:
+        if req.temperature > 0:
+            return _host_sample(row_logits, req.temperature, req.top_k,
+                                req.rng)
+        return int(row_logits.argmax())
+
+    def _emit(self, req: _Request, token: int, now: float):
+        req.emitted += 1
+        if req.first_token_t is None:
+            req.first_token_t = now
+            TTFT_HISTOGRAM.observe(now - req.submit_t, tags=self._tags)
+        else:
+            ITL_HISTOGRAM.observe(now - req.last_token_t,
+                                  tags=self._tags)
+        req.last_token_t = now
+        self._tokens_generated += 1
+        self._win_tokens += 1
+        TOKENS_COUNTER.inc(tags=self._tags)
+        if now - self._win_t >= 0.5:
+            THROUGHPUT_GAUGE.set(
+                self._win_tokens / (now - self._win_t),
+                tags=self._tags)
+            self._win_t = now
+            self._win_tokens = 0
+        req.stream._push(token)
+
+    def _evict(self, slot: int, status: str):
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._cache = decode.reset_cache_slot(
+            self._cache, jnp.int32(slot))
+        self._update_occupancy()
+        self._finish_request(req, status)
+
+    def _finish_request(self, req: _Request, status: str):
+        if status == "cancelled":
+            self._cancelled += 1
+        else:
+            self._completed += 1
+        REQUESTS_COUNTER.inc(tags={**self._tags, "status": status})
+        req.stream._finish()
+
+    def _update_occupancy(self):
+        OCCUPANCY_GAUGE.set(
+            sum(r is not None for r in self._slots) / self.num_slots,
+            tags=self._tags)
+
+    def _fail_all(self, err: BaseException):
+        if self._prefill is not None:
+            self._prefill.req.stream._finish(err)
+            self._prefill = None
+        with self._cond:
+            leftovers = self._scheduler.drain()
+            QUEUE_GAUGE.set(0, tags=self._tags)
+        for req in leftovers:
+            req.stream._finish(err)
+        for s in range(self.num_slots):
+            req = self._slots[s]
+            if req is not None:
+                self._slots[s] = None
+                req.stream._finish(err)
+        self._pos[:] = 0
+        self._tok[:] = 0
+        # Rebuild device state: the donated cache may be mid-flight.
+        self._cache = decode.init_cache(
+            self.cfg, self.num_slots, max_seq=self.max_seq)
+        self._scratch = decode.init_cache(
+            self.cfg, 1, max_seq=self.max_seq)
+        self._update_occupancy()

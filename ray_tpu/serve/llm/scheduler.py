@@ -1,0 +1,70 @@
+"""Admission control for the continuous-batching engine.
+
+FCFS (Orca's baseline policy): requests join a bounded queue and enter
+the slot pool strictly in arrival order — no reordering, so a request's
+TTFT is bounded by the work ahead of it, never by work behind it.  The
+queue depth cap is the backpressure surface: past it, submit() fails
+fast with EngineOverloadedError instead of buffering unboundedly inside
+the replica (the router/autoscaler see the error and route or scale).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Optional
+
+
+class EngineOverloadedError(RuntimeError):
+    """The engine's admission queue is full; retry later or scale out.
+
+    Raised by GenerationEngine.submit()/LLMServer when queued requests
+    exceed max_queue_len.  Deliberately a RuntimeError subclass so
+    generic handlers keep working; serve surfaces it as HTTP 503."""
+
+
+class FCFSScheduler:
+    """Bounded first-come-first-served admission queue.
+
+    Single-owner discipline: enqueue() is called from submitter tasks
+    (under the engine's lock), next_request()/requeue_head() only from
+    the engine's worker thread.  Depth counts WAITING requests only;
+    the engine adds the one mid-prefill when it reports stats.
+    """
+
+    def __init__(self, max_queue_len: int = 64):
+        if max_queue_len < 1:
+            raise ValueError("max_queue_len must be >= 1")
+        self.max_queue_len = max_queue_len
+        self._queue: Deque = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def enqueue(self, request) -> None:
+        """Admit to the wait queue or raise EngineOverloadedError."""
+        if len(self._queue) >= self.max_queue_len:
+            raise EngineOverloadedError(
+                f"admission queue full ({len(self._queue)}/"
+                f"{self.max_queue_len} requests waiting); retry later")
+        self._queue.append(request)
+
+    def next_request(self) -> Optional[object]:
+        """Pop the oldest waiting request (None when empty)."""
+        if not self._queue:
+            return None
+        return self._queue.popleft()
+
+    def requeue_head(self, request) -> None:
+        """Put a request back at the FRONT (admission aborted — e.g. the
+        engine is stopping mid-prefill); preserves FCFS order."""
+        self._queue.appendleft(request)
+
+    def drain(self):
+        """Remove and return every waiting request (engine shutdown)."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out

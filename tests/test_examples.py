@@ -67,3 +67,10 @@ def test_rllib_ppo():
 def test_serve_llm():
     out = _run("serve_llm.py", timeout=360)
     assert "generated:" in out
+
+
+@pytest.mark.slow
+def test_llm_serving_continuous_batching():
+    out = _run("llm_serving.py", timeout=360)
+    assert "llm serving example done" in out
+    assert "[DONE]" in out  # SSE stream reached its terminator

@@ -218,6 +218,104 @@ def test_serve_batch(serve_instance):
     assert max(sizes) > 1  # concurrent calls actually batched
 
 
+def test_batch_queue_registry_evicts_dead_instances():
+    """The per-instance @serve.batch queue registry must not leak dead
+    instances (replica restarts) nor cross-wire two instances whose
+    id() collides after reuse."""
+    import asyncio
+    import gc
+
+    from ray_tpu import serve as serve_mod
+
+    class Doubler:
+        @serve_mod.batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+        async def call(self, xs):
+            return [x * 2 for x in xs]
+
+    registry = Doubler.call._rt_batch_queues
+
+    async def use(obj):
+        return await obj.call(21)
+
+    a = Doubler()
+    assert asyncio.run(use(a)) == 42
+    assert len(registry) == 1
+    del a
+    gc.collect()
+    assert len(registry) == 0, "dead instance leaked its batch queue"
+
+    # id-reuse guard: an entry claiming a key must be ignored when the
+    # weakref no longer points at the CALLING instance.
+    b = Doubler()
+    other = Doubler()
+    import weakref
+    sentinel = object()
+    registry[id(b)] = (weakref.ref(other), sentinel)
+    assert asyncio.run(use(b)) == 42
+    wr, q = registry[id(b)]
+    assert q is not sentinel and wr() is b
+
+    # ...and from the WRITE side: a GC-deferred death callback firing
+    # after its key was reused must not evict the successor's entry.
+    c1 = Doubler()
+    assert asyncio.run(use(c1)) == 42
+    key = id(c1)
+    successor = Doubler()
+    sentinel2 = object()
+    registry[key] = (weakref.ref(successor), sentinel2)
+    del c1
+    gc.collect()  # fires c1's callback; entry is no longer c1's
+    assert registry[key][1] is sentinel2, \
+        "deferred death callback evicted the successor's queue"
+
+
+def test_batch_flush_uses_submit_loop():
+    """_flush must run the batch on the loop that accepted the submits
+    (not asyncio.get_event_loop() at flush time): drive submits from a
+    non-main thread's loop, where get_event_loop() would fail/misfire."""
+    import asyncio
+
+    from ray_tpu import serve as serve_mod
+
+    @serve_mod.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+    async def doubler(x):
+        return [v * 2 for v in x]
+
+    results = []
+
+    def run_in_thread():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(None)  # no ambient loop for _flush to grab
+
+        async def go():
+            # fewer than max_batch_size: the timer path must flush
+            return await asyncio.gather(doubler(1), doubler(2))
+
+        results.extend(loop.run_until_complete(go()))
+        loop.close()
+
+    t = threading.Thread(target=run_in_thread)
+    t.start()
+    t.join(timeout=30)
+    assert results == [2, 4]
+
+
+def test_router_saturation_gauges(serve_instance):
+    """ReplicaSet queue depth / in-flight counts surface as metrics
+    gauges in the handle-holding process."""
+    @serve.deployment(name="gauged")
+    def gauged(x):
+        return x + 1
+
+    handle = gauged.deploy()
+    assert handle.remote(1).result(timeout=60) == 2
+    from ray_tpu.util.metrics import prometheus_text, registry_snapshot
+    text = prometheus_text(registry_snapshot())
+    assert 'serve_router_in_flight{deployment="gauged"}' in text
+    assert 'serve_router_queue_depth{deployment="gauged"}' in text
+    assert "serve_replica_in_flight" in text
+
+
 def test_model_composition_child_deployments(serve_instance):
     @serve.deployment(name="preprocess")
     def preprocess(x):
