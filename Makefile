@@ -17,12 +17,16 @@ PY ?= python
 # verify's recipe uses pipefail, which POSIX sh (dash) rejects.
 SHELL := /bin/bash
 
-.PHONY: store store-tsan store-asan sanitize clean lint verify check
+.PHONY: store store-tsan store-asan sanitize clean lint verify check \
+	bench-quick
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
 # over our own tree; fails on any finding NOT in .rtlint-baseline.json.
-# verify: the tier-1 test command from ROADMAP.md.  check: both.
+# verify: the tier-1 test command from ROADMAP.md.
+# bench-quick: <60 s hot-path probe — ray_perf --quick on the RPC
+# hot-path metrics + the serve overhead probe — so a submission/dispatch
+# regression surfaces before a full bench round.  check: all three.
 
 lint:
 	$(PY) -m ray_tpu.lint ray_tpu examples tests \
@@ -35,7 +39,14 @@ verify:
 		-p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
 		| tee /tmp/_t1.log
 
-check: lint verify
+bench-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) -m ray_tpu._private.ray_perf --quick \
+		--only single_client_tasks_sync,actor_calls_1_1,put_small_1kb
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) -m ray_tpu._private.serve_perf --probe
+
+check: lint verify bench-quick
 
 store: ray_tpu/_private/_shm_store.so
 

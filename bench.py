@@ -170,6 +170,46 @@ def main():
         "vs_baseline": round(tok_per_sec / baseline, 4),
         "detail": detail,
     }))
+    # LAST line, always: the driver's artifact tail keeps only the final
+    # ~2000 bytes, which truncates every headline number out of the one
+    # giant JSON line above.  Keep this short and keep it last.
+    print(_headline_line(round(tok_per_sec, 2), detail))
+
+
+def _fmt_headline(v, nd=1):
+    if v is None:
+        return "n/a"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _headline_line(tokens_per_sec, detail):
+    """One compact human-readable summary of every headline metric."""
+    def dig(d, *keys):
+        for k in keys:
+            d = d.get(k) if isinstance(d, dict) else None
+        return d
+
+    mb = detail.get("microbench") or {}
+    sv = detail.get("serve") or {}
+    ov = sv.get("_overhead_ms") or {}
+    parts = [
+        "tokens/s=" + _fmt_headline(tokens_per_sec),
+        "mfu=" + _fmt_headline(detail.get("mfu"), 4),
+        "sync_tasks/s=" + _fmt_headline(
+            dig(mb, "single_client_tasks_sync", "ops_per_s")),
+        "actor_calls/s=" + _fmt_headline(
+            dig(mb, "actor_calls_1_1_sync", "ops_per_s")),
+        "direct_actor_calls/s=" + _fmt_headline(
+            dig(sv, "direct_actor_calls_per_s", "median")),
+        "serve_handle_calls/s=" + _fmt_headline(
+            dig(sv, "serve_handle_calls_per_s", "median")),
+        "serve_overhead_ms=" + _fmt_headline(
+            ov.get("serve_layer_added"), 3),
+        "proxy_hop_ms=" + _fmt_headline(ov.get("proxy_hop_added"), 3),
+    ]
+    return "HEADLINE " + " ".join(parts)
 
 
 REFERENCE_FLOORS = {
@@ -401,6 +441,8 @@ def _run_microbench():
             "load_1m": rec["load_1m"],
             "memcpy_probe_gbps": rec["memcpy_probe_gbps"],
         }
+        if "lat_ms" in rec:            # per-invocation tail latency
+            out[name]["lat_ms"] = rec["lat_ms"]
         if ref:
             out[name]["vs_reference_m4_16xl"] = round(med / ref, 3)
             out[name]["vs_reference_best"] = round(best / ref, 3)
@@ -578,6 +620,12 @@ def serve_llm_main(json_out=None, n_requests=16, concurrency=8,
     if json_out:
         with open(json_out, "w") as f:
             f.write(line + "\n")
+    # Compact summary LAST (same artifact-tail rationale as main()).
+    cb = result["detail"]["continuous_batching"]
+    print("HEADLINE serve_llm_tokens/s=" + _fmt_headline(result["value"])
+          + " vs_serial=" + _fmt_headline(result["vs_serial_baseline"], 3)
+          + " ttft_p50_s=" + _fmt_headline(cb["ttft_p50_s"], 4)
+          + " itl_p50_s=" + _fmt_headline(cb["itl_p50_s"], 5))
     return result
 
 

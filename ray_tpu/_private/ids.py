@@ -9,6 +9,7 @@ so lineage can be recovered from the id alone.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 
@@ -84,6 +85,18 @@ class FunctionID(BaseID):
 class TaskID(BaseID):
     _counter_lock = threading.Lock()
     _counter = 0
+    # Submission fast path: one urandom syscall per PROCESS, not per
+    # task (urandom is expensive on syscall-filtered hosts).  The
+    # 12-byte prefix ObjectID.for_task_return keeps must stay unique
+    # per task: 6 random base bytes + 6-byte counter fill it exactly.
+    _submit_base = os.urandom(6)
+    _submit_next = itertools.count(1).__next__
+
+    @classmethod
+    def for_submit(cls) -> "TaskID":
+        return cls(cls._submit_base
+                   + cls._submit_next().to_bytes(6, "little")
+                   + b"\x00\x00\x00\x00")
 
     @classmethod
     def for_fake_task(cls):
@@ -93,16 +106,33 @@ class TaskID(BaseID):
 class ObjectID(BaseID):
     """Object id = 12 random bytes (task id prefix) + 4-byte return index."""
 
+    _put_base = os.urandom(10)
+    _put_next = itertools.count(1).__next__
+
     @classmethod
     def for_task_return(cls, task_id: "TaskID", index: int):
         return cls(task_id.binary()[:12] + index.to_bytes(4, "little"))
 
     @classmethod
     def for_put(cls):
-        return cls.from_random()
+        # Same per-process base + counter scheme as TaskID.for_submit.
+        return cls(cls._put_base + cls._put_next().to_bytes(6, "little"))
 
     def return_index(self) -> int:
         return int.from_bytes(self._bin[12:], "little")
 
+
+def _reseed_id_bases():
+    """Fresh per-process bases + counters.  Registered as an at-fork
+    hook: zygote-forked workers must NOT share the parent's id stream —
+    a shared base + counter would mint colliding task/object ids in
+    different processes."""
+    TaskID._submit_base = os.urandom(6)
+    TaskID._submit_next = itertools.count(1).__next__
+    ObjectID._put_base = os.urandom(10)
+    ObjectID._put_next = itertools.count(1).__next__
+
+
+os.register_at_fork(after_in_child=_reseed_id_bases)
 
 ObjectRefID = ObjectID

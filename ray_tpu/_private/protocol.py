@@ -11,6 +11,26 @@ pickle).  Messages:
 
   REQ(id, method, body) -> REP(id, result) | ERR(id, exception)
   PUSH(method, body)                       (one-way notification)
+  BATCH(frames)                            (coalesced burst of the above)
+
+Hot-path design (the RPC fast path, see README "RPC fast path"):
+
+* REQ/PUSH payloads carry the method name OUT OF BAND as a 2-byte
+  length-prefixed utf-8 string ahead of the pickled body, so the
+  envelope tuple ``(method, body)`` is never pickled: the per-method
+  prefix is encoded once and cached (`_envelope_prefix`), and the
+  receive side interns the decoded name — hot methods
+  (``push_actor_task``, ``push_task``, object-plane calls) pay zero
+  envelope encode/decode after the first call.
+* Inbound REQ/PUSH frames are dispatched INLINE on the read loop: the
+  handler coroutine is stepped once synchronously, and only a handler
+  that actually suspends (awaits something unfinished) is handed to a
+  task (`_Resume` replays the pending yield into the Task protocol).
+  Handlers that complete without awaiting — the common case for
+  replies, acks, and table lookups — never allocate a Task.
+* KIND_BATCH coalesces a burst of small requests to one peer into one
+  frame (one header read + one write syscall for the whole burst);
+  the worker's per-actor send queue uses it for pipelined submission.
 
 All payloads are pickled with protocol 5; large buffers never travel this
 plane (they go through the shared-memory object store, see shm_store.py).
@@ -33,8 +53,32 @@ KIND_REQ = 0
 KIND_REP = 1
 KIND_ERR = 2
 KIND_PUSH = 3
+KIND_BATCH = 4
+
+_MLEN = struct.Struct("<H")  # method-name length (REQ/PUSH payload prefix)
 
 _PICKLE_PROTO = 5
+
+# method name -> encoded `<len><utf8>` payload prefix (sender side), and
+# raw method bytes -> interned str (receiver side).  Both are tiny,
+# append-only, and process-lifetime: method names are a closed set.
+_ENV_PREFIX: dict[str, bytes] = {}
+_METHOD_INTERN: dict[bytes, str] = {}
+
+
+def _envelope_prefix(method: str) -> bytes:
+    pre = _ENV_PREFIX.get(method)
+    if pre is None:
+        mb = method.encode("utf-8")
+        pre = _ENV_PREFIX[method] = _MLEN.pack(len(mb)) + mb
+    return pre
+
+
+def _intern_method(raw: bytes) -> str:
+    m = _METHOD_INTERN.get(raw)
+    if m is None:
+        m = _METHOD_INTERN[raw] = raw.decode("utf-8")
+    return m
 
 
 class RpcError(Exception):
@@ -99,6 +143,38 @@ class ConnectionLost(RpcError):
     pass
 
 
+class _Resume:
+    """Awaitable adopting a handler coroutine that was stepped inline on
+    the read loop and suspended: replays the pending yield (the future
+    the coroutine is waiting on, `_asyncio_future_blocking` flag intact)
+    to the driving Task, then delegates the rest like ``yield from``.
+    This is what lets inline dispatch fall back to a task ONLY for
+    handlers that actually await, without re-running any side effects."""
+
+    __slots__ = ("coro", "first")
+
+    def __init__(self, coro, first):
+        self.coro = coro
+        self.first = first
+
+    def __await__(self):
+        coro = self.coro
+        pending = self.first
+        while True:
+            try:
+                value = yield pending
+            except BaseException as e:
+                try:
+                    pending = coro.throw(e)
+                except StopIteration as si:
+                    return si.value
+                continue
+            try:
+                pending = coro.send(value)
+            except StopIteration as si:
+                return si.value
+
+
 def dumps(obj) -> bytes:
     return pickle.dumps(obj, protocol=_PICKLE_PROTO)
 
@@ -125,9 +201,17 @@ class Connection:
         self._pending: dict[int, asyncio.Future] = {}
         self._closed = False
         self._write_lock = asyncio.Lock()
+        self._drain_task: asyncio.Task | None = None
+        self.close_reason: str | None = None
+        self._loop = asyncio.get_running_loop()
+        # Outbound frame coalescing: frames buffered within one loop
+        # iteration ride ONE socket write (call_soon flushes before the
+        # loop can block in the selector, so latency is unaffected).
+        self._wbuf: list = []
+        self._wflush_scheduled = False
         # Last: under an eager task factory this may start reading (and
         # serving) immediately, so every attribute must already exist.
-        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._reader_task = self._loop.create_task(self._read_loop())
 
     @classmethod
     async def connect(cls, host: str, port: int, handler=None, name: str = "?",
@@ -151,8 +235,7 @@ class Connection:
                 plen, kind, msg_id = _HDR.unpack(hdr)
                 payload = await self.reader.readexactly(plen) if plen else b""
                 if kind == KIND_REQ:
-                    asyncio.get_running_loop().create_task(
-                        self._serve(msg_id, payload))
+                    self._dispatch_frame(msg_id, payload, False)
                 elif kind == KIND_REP:
                     fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
@@ -163,77 +246,255 @@ class Connection:
                         cause_repr, tb = loads(payload)
                         fut.set_exception(RemoteError(cause_repr, tb))
                 elif kind == KIND_PUSH:
-                    asyncio.get_running_loop().create_task(
-                        self._serve(0, payload, push=True))
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
-            pass
+                    self._dispatch_frame(0, payload, True)
+                elif kind == KIND_BATCH:
+                    self._dispatch_batch(payload)
+        except asyncio.IncompleteReadError:
+            self.close_reason = self.close_reason or "peer closed connection"
+        except (ConnectionResetError, OSError) as e:
+            self.close_reason = self.close_reason or (
+                f"{type(e).__name__}: {e}")
         except asyncio.CancelledError:
+            self.close_reason = self.close_reason or "closed locally"
             return
-        except Exception:
+        except Exception as e:
+            self.close_reason = self.close_reason or (
+                f"read loop error: {e!r}")
             logger.exception("rpc read loop error on %s", self.name)
         finally:
             await self._do_close()
 
-    async def _serve(self, msg_id: int, payload: bytes, push: bool = False):
+    def _dispatch_batch(self, payload: bytes):
+        """Unpack a KIND_BATCH frame and dispatch each sub-frame in
+        order (sub-frames reuse the outer header layout)."""
+        view = memoryview(payload)
+        off, size, end = 0, _HDR.size, len(payload)
+        while off + size <= end:
+            plen, kind, msg_id = _HDR.unpack_from(payload, off)
+            off += size
+            sub = view[off:off + plen]
+            off += plen
+            if kind == KIND_REQ:
+                self._dispatch_frame(msg_id, sub, False)
+            elif kind == KIND_PUSH:
+                self._dispatch_frame(0, sub, True)
+            else:
+                logger.error("unexpected kind %d inside batch on %s",
+                             kind, self.name)
+
+    def _dispatch_frame(self, msg_id: int, payload, push: bool):
+        """Serve one inbound REQ/PUSH.  The handler coroutine is stepped
+        inline on the read loop; only a handler that truly suspends is
+        handed to a task.  Inline-dispatch rule: a handler may run on
+        the read loop iff its synchronous prefix is non-blocking — all
+        rpc_* handlers satisfy this (blocking work rides executors,
+        which is itself an await and thus moves to the task path)."""
         try:
-            method, body = loads(payload)
+            mlen, = _MLEN.unpack_from(payload, 0)
+            method = _intern_method(bytes(payload[2:2 + mlen]))
+            body = loads(memoryview(payload)[2 + mlen:])
         except Exception:
             logger.exception("bad rpc payload on %s", self.name)
             return
-        try:
-            if self.handler is None:
-                raise RpcError(f"connection {self.name} has no handler")
-            _t0 = time.perf_counter()
-            try:
-                result = await self.handler(self, method, body)
-            finally:
-                # Failing handlers count too — they are exactly the calls
-                # these stats exist to surface.
-                _record_handler(method, time.perf_counter() - _t0)
+        if self.handler is None:
             if not push:
-                await self._send(KIND_REP, msg_id, dumps(result))
-        except Exception as e:
-            if push:
-                logger.exception("push handler %s failed on %s", method, self.name)
-            else:
-                try:
-                    await self._send(KIND_ERR, msg_id,
-                                     dumps((repr(e), traceback.format_exc())))
-                except Exception:
-                    pass
-
-    async def _send(self, kind: int, msg_id: int, payload: bytes):
-        if self._closed:
-            raise ConnectionLost(f"connection {self.name} closed")
-        # Buffered writes, no lock: StreamWriter.write is synchronous and
-        # there is no await between the two calls, so header+payload can't
-        # interleave with another sender (and skipping concatenation
-        # avoids copying large payloads).  drain() (an await + lock-step
-        # with the transport) only matters for backpressure — apply it
-        # once the send buffer is actually deep.
+                self._reply_error(msg_id, RpcError(
+                    f"connection {self.name} has no handler"), "")
+            return
+        t0 = time.perf_counter()
         try:
-            self.writer.write(_HDR.pack(len(payload), kind, msg_id))
-            self.writer.write(payload)
-        except (ConnectionResetError, OSError) as e:
-            raise ConnectionLost(str(e)) from e
+            coro = self.handler(self, method, body)
+            first = coro.send(None)
+        except StopIteration as si:
+            # Completed without awaiting: reply inline, no task.
+            _record_handler(method, time.perf_counter() - t0)
+            if not push:
+                self._reply_result(msg_id, method, si.value)
+            return
+        except Exception as e:
+            # Failing handlers count too — they are exactly the calls
+            # these stats exist to surface.
+            _record_handler(method, time.perf_counter() - t0)
+            if push:
+                logger.exception("push handler %s failed on %s",
+                                 method, self.name)
+            else:
+                self._reply_error(msg_id, e, traceback.format_exc())
+            return
+        asyncio.get_running_loop().create_task(
+            self._serve_rest(coro, first, msg_id, method, push, t0))
+
+    async def _serve_rest(self, coro, first, msg_id: int, method: str,
+                          push: bool, t0: float):
+        """Finish a handler that suspended during inline dispatch."""
+        try:
+            result = await _Resume(coro, first)
+        except Exception as e:
+            _record_handler(method, time.perf_counter() - t0)
+            if push:
+                logger.exception("push handler %s failed on %s",
+                                 method, self.name)
+            else:
+                self._reply_error(msg_id, e, traceback.format_exc())
+            return
+        _record_handler(method, time.perf_counter() - t0)
+        if not push:
+            self._reply_result(msg_id, method, result)
+
+    def _reply_result(self, msg_id: int, method: str, result):
+        try:
+            payload = dumps(result)
+        except Exception as e:
+            self._reply_error(msg_id, e, traceback.format_exc())
+            return
+        try:
+            self._send_nowait(KIND_REP, msg_id, payload)
+        except ConnectionLost:
+            pass
+
+    def _reply_error(self, msg_id: int, exc: Exception, tb: str):
+        try:
+            self._send_nowait(KIND_ERR, msg_id, dumps((repr(exc), tb)))
+        except Exception:
+            pass
+
+    # Payloads at least this large skip the coalescing buffer (joining
+    # would copy them); the pending small frames are flushed first so
+    # wire order is preserved.
+    _COALESCE_MAX = 1 << 16
+
+    def _send_nowait(self, kind: int, msg_id: int, payload,
+                     prefix: bytes = b""):
+        """Queue one frame for the coalesced flush (or write it through
+        for large payloads).  Loop-thread only; frames queued within one
+        loop iteration ride one syscall.  No lock: nothing yields
+        between the appends, so header+prefix+payload can't interleave
+        with another sender.  drain() only matters for backpressure —
+        once the send buffer is deep a background drain is scheduled."""
+        if self._closed:
+            raise ConnectionLost(
+                f"connection {self.name} closed"
+                + (f" ({self.close_reason})" if self.close_reason else ""))
+        wbuf = self._wbuf
+        wbuf.append(_HDR.pack(len(prefix) + len(payload), kind, msg_id))
+        if prefix:
+            wbuf.append(prefix)
+        if len(payload) >= self._COALESCE_MAX:
+            self._flush_wbuf()  # pending smalls first, keep order
+            try:
+                self.writer.write(payload)
+            except (ConnectionResetError, OSError) as e:
+                self.close_reason = self.close_reason or (
+                    f"{type(e).__name__}: {e}")
+                raise ConnectionLost(str(e)) from e
+        else:
+            wbuf.append(payload)
+            if not self._wflush_scheduled:
+                self._wflush_scheduled = True
+                self._loop.call_soon(self._flush_wbuf)
         transport = self.writer.transport
         if (transport is not None
                 and transport.get_write_buffer_size() > 1 << 20):
-            async with self._write_lock:
-                try:
-                    await self.writer.drain()
-                except (ConnectionResetError, OSError) as e:
-                    raise ConnectionLost(str(e)) from e
+            self._ensure_drain()
+
+    def _flush_wbuf(self):
+        self._wflush_scheduled = False
+        if not self._wbuf:
+            return
+        buf, self._wbuf = self._wbuf, []
+        if self._closed:
+            return
+        try:
+            self.writer.write(buf[0] if len(buf) == 1 else b"".join(buf))
+        except (ConnectionResetError, OSError) as e:
+            # Senders already returned; the read loop notices the dead
+            # socket and fails all in-flight futures via _do_close.
+            self.close_reason = self.close_reason or (
+                f"{type(e).__name__}: {e}")
+
+    def _ensure_drain(self):
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._drain())
+            # Backpressure awaiters observe the failure through
+            # backpressure(); without an awaiter the exception must
+            # still be consumed (the read loop reports the dead socket).
+            self._drain_task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
+
+    async def _drain(self):
+        async with self._write_lock:
+            try:
+                await self.writer.drain()
+            except (ConnectionResetError, OSError) as e:
+                raise ConnectionLost(str(e)) from e
+
+    async def backpressure(self):
+        """Block while the send buffer is past the high-water mark (a
+        drain is in flight).  Senders on the nowait paths call this
+        between bursts so a stalled peer throttles them at ~1 MiB of
+        buffered frames instead of growing the transport buffer without
+        bound."""
+        t = self._drain_task
+        if t is not None and not t.done():
+            await asyncio.shield(t)
+
+    async def _send(self, kind: int, msg_id: int, payload,
+                    prefix: bytes = b""):
+        self._send_nowait(kind, msg_id, payload, prefix)
+        await self.backpressure()
+
+    def request_send_nowait(self, method: str, body=None):
+        """Put a request on the wire synchronously and return the reply
+        future.  Loop-thread only.  Wire order == call order (nothing
+        yields), which is what the actor send queue needs for sequence
+        numbering."""
+        msg_id = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            self._send_nowait(KIND_REQ, msg_id, dumps(body),
+                              prefix=_envelope_prefix(method))
+        except BaseException:
+            self._pending.pop(msg_id, None)
+            raise
+        return fut
+
+    def request_send_many_nowait(self, method: str, bodies) -> list:
+        """Send a burst of requests for ONE method as a single
+        KIND_BATCH frame (one write, one syscall) and return their reply
+        futures in order.  All-or-nothing: a write failure leaves no
+        request registered."""
+        prefix = _envelope_prefix(method)
+        loop = asyncio.get_running_loop()
+        buf = bytearray()
+        futs, ids = [], []
+        for body in bodies:
+            msg_id = self._next_id
+            self._next_id += 1
+            payload = dumps(body)
+            buf += _HDR.pack(len(prefix) + len(payload), KIND_REQ, msg_id)
+            buf += prefix
+            buf += payload
+            ids.append(msg_id)
+            futs.append(loop.create_future())
+        for msg_id, fut in zip(ids, futs):
+            self._pending[msg_id] = fut
+        try:
+            self._send_nowait(KIND_BATCH, 0, buf)
+        except BaseException:
+            for msg_id in ids:
+                self._pending.pop(msg_id, None)
+            raise
+        return futs
 
     async def request_send(self, method: str, body=None):
         """Send a request and return the reply future WITHOUT awaiting it.
         Used where wire-order must be controlled by the caller (e.g. actor
         task sequence numbers) while replies are awaited concurrently."""
-        msg_id = self._next_id
-        self._next_id += 1
-        fut = asyncio.get_running_loop().create_future()
-        self._pending[msg_id] = fut
-        await self._send(KIND_REQ, msg_id, dumps((method, body)))
+        fut = self.request_send_nowait(method, body)
+        await self.backpressure()
         return fut
 
     async def request(self, method: str, body=None, timeout: float | None = None):
@@ -241,7 +502,13 @@ class Connection:
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        await self._send(KIND_REQ, msg_id, dumps((method, body)))
+        try:
+            self._send_nowait(KIND_REQ, msg_id, dumps(body),
+                              prefix=_envelope_prefix(method))
+        except BaseException:
+            self._pending.pop(msg_id, None)
+            raise
+        await self.backpressure()
         if timeout is not None:
             try:
                 return await asyncio.wait_for(fut, timeout)
@@ -250,15 +517,24 @@ class Connection:
         return await fut
 
     async def push(self, method: str, body=None):
-        await self._send(KIND_PUSH, 0, dumps((method, body)))
+        await self._send(KIND_PUSH, 0, dumps(body),
+                         prefix=_envelope_prefix(method))
 
     async def _do_close(self):
         if self._closed:
             return
+        try:
+            self._flush_wbuf()  # last replies out before the FIN
+        except Exception:
+            pass
         self._closed = True
+        reason = self.close_reason or "connection lost"
+        exc = ConnectionLost(
+            f"connection to {self.name} lost ({reason}); "
+            "in-flight request failed")
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+                fut.set_exception(exc)
         self._pending.clear()
         try:
             self.writer.close()
@@ -273,6 +549,7 @@ class Connection:
                 logger.exception("on_close for %s failed", self.name)
 
     async def close(self):
+        self.close_reason = self.close_reason or "closed locally"
         self._reader_task.cancel()
         await self._do_close()
 

@@ -38,37 +38,63 @@ def probe_memcpy_gbps(mb: int = 16, reps: int = 2) -> float:
     return reps * len(_PROBE_SRC) / (time.perf_counter() - t0) / 1e9
 
 
+def _pct(sorted_xs, q):
+    if not sorted_xs:
+        return None
+    i = min(len(sorted_xs) - 1, int(round(q * (len(sorted_xs) - 1))))
+    return sorted_xs[i]
+
+
 def timeit(name, fn, multiplier=1, results=None, repeats=3):
     """Time `fn` in `repeats` independent passes and record ALL of
     them plus per-pass load evidence (BENCH r4 lesson: a single pass on
     a contended host can neither confirm nor refute a latency claim).
-    Returns the median rate; the full record keeps the best pass and
-    the loadavg/memcpy context needed to judge whether the host or the
-    runtime was the limiter."""
+    Returns the median rate; the full record keeps the best pass, the
+    per-invocation latency tail (p50/p95/p99 — a pipelined hot path
+    must not buy its median with a worse tail), and the loadavg/memcpy
+    context needed to judge whether the host or the runtime was the
+    limiter."""
     # Warmup.
     fn()
     memcpy_before = probe_memcpy_gbps()
-    rates, loads = [], []
+    rates, loads, lats = [], [], []
     for _ in range(repeats):
         loads.append(round(os.getloadavg()[0], 2))
         start = time.perf_counter()
         count = 0
-        while time.perf_counter() - start < MIN_SECONDS:
+        prev = start
+        while True:
             fn()
+            now = time.perf_counter()
+            lats.append(now - prev)
+            prev = now
             count += 1
-        dt = time.perf_counter() - start
-        rates.append(count * multiplier / dt)
+            if now - start >= MIN_SECONDS:
+                break
+        rates.append(count * multiplier / (prev - start))
     med = statistics.median(rates)
+    lats.sort()
     print(f"{name}: {med:.2f} /s (best {max(rates):.2f}, "
           f"n={repeats}, load {loads[0]})")
     if results is not None:
-        results[name] = {
+        rec = results[name] = {
             "median": round(med, 2),
             "best": round(max(rates), 2),
             "rates": [round(r, 2) for r in rates],
             "load_1m": loads,
             "load_after": round(os.getloadavg()[0], 2),
             "memcpy_probe_gbps": round(memcpy_before, 2),
+        }
+        # Latency of ONE timed invocation (for multiplier > 1 that is
+        # one whole batch/burst, labeled so nobody divides by accident).
+        rec["lat_ms"] = {
+            "p50": round(1e3 * _pct(lats, 0.50), 3),
+            "p95": round(1e3 * _pct(lats, 0.95), 3),
+            "p99": round(1e3 * _pct(lats, 0.99), 3),
+            "max": round(1e3 * lats[-1], 3),
+            "n": len(lats),
+            "per": ("call" if multiplier == 1
+                    else f"invocation(x{multiplier})"),
         }
     return med
 
@@ -181,10 +207,17 @@ def _settle(max_wait: float = 40.0):
         time.sleep(0.25)
 
 
-def main(quick: bool = False):
+def main(quick: bool = False, only=None):
+    """`only`: optional list of substrings — run just the matching
+    metrics (and skip their setup cost).  Used by `make bench-quick` to
+    probe the hot-path metrics inside a CI time budget."""
     global MIN_SECONDS
     if quick:
         MIN_SECONDS = 0.5
+
+    def sel(name: str) -> bool:
+        return only is None or any(s in name for s in only)
+
     results: dict = {}
     # Host context BEFORE the cluster exists: the pre-init loadavg and
     # memcpy are pure external-contention evidence (nothing of ours is
@@ -201,50 +234,65 @@ def main(quick: bool = False):
     # same at start; the helper scribbles zeros, so it must never run
     # after objects exist.
     prefault_store()
-    _settle()
+    # A filtered run (make bench-quick) trades some settling for wall
+    # clock: it's a regression probe, not the artifact of record.
+    _settle(max_wait=10.0 if only else 40.0)
 
     # --- tasks ----------------------------------------------------------
-    timeit("single_client_tasks_sync",
-           lambda: ray_tpu.get(noop.remote(), timeout=60), 1, results)
-    timeit("single_client_tasks_async",
-           lambda: ray_tpu.get([noop.remote() for _ in range(BATCH)],
-                               timeout=120), BATCH, results)
+    if sel("single_client_tasks_sync"):
+        timeit("single_client_tasks_sync",
+               lambda: ray_tpu.get(noop.remote(), timeout=60), 1, results)
+    if sel("single_client_tasks_async"):
+        timeit("single_client_tasks_async",
+               lambda: ray_tpu.get([noop.remote() for _ in range(BATCH)],
+                                   timeout=120), BATCH, results)
 
     # --- actors ---------------------------------------------------------
-    a = Actor.remote()
-    ray_tpu.get(a.noop.remote(), timeout=60)
-    timeit("actor_calls_1_1_sync",
-           lambda: ray_tpu.get(a.noop.remote(), timeout=60), 1, results)
-    timeit("actor_calls_1_1_async",
-           lambda: ray_tpu.get([a.noop.remote() for _ in range(BATCH)],
-                               timeout=120), BATCH, results)
-    aa = AsyncActor.remote()
-    ray_tpu.get(aa.noop.remote(), timeout=60)
-    timeit("async_actor_calls_1_1",
-           lambda: ray_tpu.get([aa.noop.remote() for _ in range(BATCH)],
-                               timeout=120), BATCH, results)
+    if sel("actor_calls_1_1_sync") or sel("actor_calls_1_1_async"):
+        a = Actor.remote()
+        ray_tpu.get(a.noop.remote(), timeout=60)
+        if sel("actor_calls_1_1_sync"):
+            timeit("actor_calls_1_1_sync",
+                   lambda: ray_tpu.get(a.noop.remote(), timeout=60),
+                   1, results)
+        if sel("actor_calls_1_1_async"):
+            timeit("actor_calls_1_1_async",
+                   lambda: ray_tpu.get(
+                       [a.noop.remote() for _ in range(BATCH)],
+                       timeout=120), BATCH, results)
+    if sel("async_actor_calls_1_1"):
+        aa = AsyncActor.remote()
+        ray_tpu.get(aa.noop.remote(), timeout=60)
+        timeit("async_actor_calls_1_1",
+               lambda: ray_tpu.get([aa.noop.remote() for _ in range(BATCH)],
+                                   timeout=120), BATCH, results)
 
     # 1:n — one driver, n actors.
     n = 4
-    actors = [Actor.remote() for _ in range(n)]
-    ray_tpu.get([x.noop.remote() for x in actors], timeout=120)
-    timeit("actor_calls_1_n_async",
-           lambda: ray_tpu.get(
-               [x.noop.remote() for x in actors for _ in range(BATCH // n)],
-               timeout=120), BATCH, results)
+    if sel("actor_calls_1_n_async"):
+        actors = [Actor.remote() for _ in range(n)]
+        ray_tpu.get([x.noop.remote() for x in actors], timeout=120)
+        timeit("actor_calls_1_n_async",
+               lambda: ray_tpu.get(
+                   [x.noop.remote() for x in actors
+                    for _ in range(BATCH // n)],
+                   timeout=120), BATCH, results)
 
     # n:n — n driver-actors each hammering its own peer actor.
-    peers = [Actor.remote() for _ in range(n)]
-    clients = [Client.remote(p) for p in peers]
-    ray_tpu.get([c.batch_calls.remote(1) for c in clients], timeout=120)
-    timeit("actor_calls_n_n_async",
-           lambda: ray_tpu.get(
-               [c.batch_calls.remote(BATCH) for c in clients],
-               timeout=120), BATCH * n, results)
-    timeit("multi_client_tasks_async",
-           lambda: ray_tpu.get(
-               [c.batch_tasks.remote(BATCH) for c in clients],
-               timeout=120), BATCH * n, results)
+    if sel("actor_calls_n_n_async") or sel("multi_client_tasks_async"):
+        peers = [Actor.remote() for _ in range(n)]
+        clients = [Client.remote(p) for p in peers]
+        ray_tpu.get([c.batch_calls.remote(1) for c in clients], timeout=120)
+        if sel("actor_calls_n_n_async"):
+            timeit("actor_calls_n_n_async",
+                   lambda: ray_tpu.get(
+                       [c.batch_calls.remote(BATCH) for c in clients],
+                       timeout=120), BATCH * n, results)
+        if sel("multi_client_tasks_async"):
+            timeit("multi_client_tasks_async",
+                   lambda: ray_tpu.get(
+                       [c.batch_tasks.remote(BATCH) for c in clients],
+                       timeout=120), BATCH * n, results)
 
     # --- lifecycle throughput (BASELINE: 321.7 actors/s, 15.4 PGs/s on
     # a distributed cluster) --------------------------------------------
@@ -255,7 +303,8 @@ def main(quick: bool = False):
             ray_tpu.kill(a)
         return n
 
-    timeit("actor_launch_per_s", lambda: _launch_actors(), 8, results)
+    if sel("actor_launch_per_s"):
+        timeit("actor_launch_per_s", lambda: _launch_actors(), 8, results)
 
     def _create_pgs(n=4):
         from ray_tpu.util.placement_group import (placement_group,
@@ -267,18 +316,23 @@ def main(quick: bool = False):
             remove_placement_group(pg)
         return n
 
-    timeit("placement_group_per_s", lambda: _create_pgs(), 4, results)
+    if sel("placement_group_per_s"):
+        timeit("placement_group_per_s", lambda: _create_pgs(), 4, results)
 
     # --- object store ---------------------------------------------------
-    small_obj = b"x" * 1024
-    timeit("put_small_1kb",
-           lambda: ray_tpu.put(small_obj), 1, results)
-    big = np.random.bytes(100 * 1024 * 1024)  # 100 MB
-    r = timeit("put_gigabytes",
-               lambda: ray_tpu.put(big), 0.1, results)  # GB per put
-    big_ref = ray_tpu.put(np.frombuffer(big, dtype=np.uint8))
-    timeit("get_gigabytes",
-           lambda: ray_tpu.get(big_ref, timeout=60), 0.1, results)
+    if sel("put_small_1kb"):
+        small_obj = b"x" * 1024
+        timeit("put_small_1kb",
+               lambda: ray_tpu.put(small_obj), 1, results)
+    if sel("put_gigabytes") or sel("get_gigabytes"):
+        big = np.random.bytes(100 * 1024 * 1024)  # 100 MB
+        if sel("put_gigabytes"):
+            timeit("put_gigabytes",
+                   lambda: ray_tpu.put(big), 0.1, results)  # GB per put
+        if sel("get_gigabytes"):
+            big_ref = ray_tpu.put(np.frombuffer(big, dtype=np.uint8))
+            timeit("get_gigabytes",
+                   lambda: ray_tpu.get(big_ref, timeout=60), 0.1, results)
 
     ray_tpu.shutdown()
     results["_host"]["load_post_suite"] = [round(x, 2)
@@ -291,7 +345,10 @@ def main(quick: bool = False):
 
 if __name__ == "__main__":
     import sys
-    res = main(quick="--quick" in sys.argv)
+    only = None
+    if "--only" in sys.argv:
+        only = sys.argv[sys.argv.index("--only") + 1].split(",")
+    res = main(quick="--quick" in sys.argv, only=only)
     if "--json-out" in sys.argv:
         path = sys.argv[sys.argv.index("--json-out") + 1]
         with open(path, "w") as f:

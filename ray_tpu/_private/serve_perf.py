@@ -9,7 +9,10 @@ the same runtime so the artifact separates "Serve layer overhead" from
 "runtime floor": handle calls ride the router + replica scheduler on
 top of plain actor calls, HTTP adds the aiohttp proxy hop.
 
-Run: `python -m ray_tpu._private.serve_perf [--json-out PATH]`.
+Run: `python -m ray_tpu._private.serve_perf [--json-out PATH] [--probe]`.
+`--probe` is the <60 s hot-path regression probe used by
+`make bench-quick`: direct actor call + serve handle call + the serve
+overhead decomposition, skipping the HTTP plane.
 """
 
 from __future__ import annotations
@@ -26,7 +29,9 @@ BATCH = 50
 ray_perf.MIN_SECONDS = 0.5
 
 
-def main() -> dict:
+def main(probe: bool = False) -> dict:
+    if probe:
+        ray_perf.MIN_SECONDS = 0.4
     results: dict = {}
     results["_host"] = {"cpus": os.cpu_count() or 1,
                         "load_pre_init": [round(x, 2)
@@ -44,16 +49,17 @@ def main() -> dict:
     _timeit("direct_actor_calls_per_s",
             lambda: ray_tpu.get(d.noop.remote(), timeout=60),
             1, results=results)
-    _timeit("direct_actor_batch_per_s",
-            lambda: ray_tpu.get([d.noop.remote() for _ in range(BATCH)],
-                                timeout=120), BATCH, results=results)
+    if not probe:
+        _timeit("direct_actor_batch_per_s",
+                lambda: ray_tpu.get([d.noop.remote() for _ in range(BATCH)],
+                                    timeout=120), BATCH, results=results)
 
     # Serve handle plane: router + replica scheduler on top.
     @serve.deployment(name="noop")
     def noop(req):
         return b"ok"
 
-    serve.start(_start_proxy=True,
+    serve.start(_start_proxy=not probe,
                 http_options={"host": "127.0.0.1", "port": 0,
                               "access_log": False})
     handle = noop.deploy()
@@ -61,6 +67,23 @@ def main() -> dict:
     _timeit("serve_handle_calls_per_s",
             lambda: handle.remote(None).result(timeout=60),
             1, results=results)
+
+    if probe:
+        # Overhead decomposition only (the probe's whole point): a
+        # handle-call regression shows up here before a full bench run.
+        floor = results["direct_actor_calls_per_s"]["median"]
+        hnd = results["serve_handle_calls_per_s"]["median"]
+        results["_overhead_ms"] = {
+            "direct_actor_call": round(1e3 / floor, 3),
+            "handle_call": round(1e3 / hnd, 3),
+            "serve_layer_added": round(1e3 / hnd - 1e3 / floor, 3),
+        }
+        serve.shutdown()
+        ray_tpu.shutdown()
+        results["_host"]["load_post_suite"] = [
+            round(x, 2) for x in os.getloadavg()]
+        print(json.dumps(results))
+        return results
 
     def _burst():
         resps = [handle.remote(None) for _ in range(BATCH)]
@@ -126,7 +149,7 @@ def main() -> dict:
 
 if __name__ == "__main__":
     import sys
-    res = main()
+    res = main(probe="--probe" in sys.argv)
     if "--json-out" in sys.argv:
         with open(sys.argv[sys.argv.index("--json-out") + 1], "w") as f:
             json.dump(res, f)

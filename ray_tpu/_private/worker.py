@@ -20,13 +20,17 @@ pool while the asyncio loop stays responsive for the data plane.
 from __future__ import annotations
 
 import asyncio
+import functools
 import heapq
+import itertools
 import logging
 import os
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import Future as CFuture, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as CFTimeoutError
 
 from ray_tpu import exceptions as rexc
 from ray_tpu._private import protocol, serialization
@@ -51,13 +55,78 @@ import contextvars  # noqa: E402
 _TRACE: contextvars.ContextVar = contextvars.ContextVar(
     "rt_trace", default=None)  # (trace_id, span_id) | None
 
+# Fresh-trace ids: a per-process random base + counter instead of one
+# os.urandom syscall per submission (urandom is painfully expensive on
+# syscall-filtered hosts; uniqueness only needs process entropy once).
+_TRACE_BASE = os.urandom(5).hex()
+_trace_counter = itertools.count(1).__next__
+
+
+def _reseed_trace_base():
+    """At-fork hook: zygote-forked workers must not mint the parent's
+    trace-id stream (same rationale as ids._reseed_id_bases)."""
+    global _TRACE_BASE, _trace_counter
+    _TRACE_BASE = os.urandom(5).hex()
+    _trace_counter = itertools.count(1).__next__
+
+
+os.register_at_fork(after_in_child=_reseed_trace_base)
+
 
 def _trace_for_submit():
     """Current (or fresh) trace context to stamp on an outgoing task."""
     ctx = _TRACE.get()
     if ctx is None:
-        return {"trace_id": os.urandom(8).hex(), "parent_id": None}
+        return {"trace_id": f"{_TRACE_BASE}{_trace_counter():06x}",
+                "parent_id": None}
     return {"trace_id": ctx[0], "parent_id": ctx[1]}
+
+
+# Serializes cross-thread attachment of concurrent.futures waiters to
+# owned entries against the loop-side ready flip (OwnedObject.set_ready):
+# a sync get() attaches its waiter directly under this lock — no
+# call_soon_threadsafe hop (and thus no self-pipe syscall) per get.
+_CF_LOCK = threading.Lock()
+
+
+class _Latch:
+    """Countdown waiter attached (via per-entry _LatchRef wrappers) to
+    SEVERAL owned entries' cf_waiters: trips a threading.Event when
+    every entry has fired — or IMMEDIATELY when any entry completes
+    ERRORED, preserving the fail-fast semantics of the asyncio.gather
+    path this replaces.  Backs the list-get fast path: one wake for N
+    objects."""
+
+    __slots__ = ("_n", "event", "errored")
+
+    def __init__(self, n: int):
+        self._n = n
+        self.event = threading.Event()
+        self.errored = False
+
+
+class _LatchRef:
+    """One entry's stake in a _Latch; duck-types the CFuture surface
+    set_ready() touches (done / set_result)."""
+
+    __slots__ = ("latch", "entry")
+
+    def __init__(self, latch: _Latch, entry: "OwnedObject"):
+        self.latch = latch
+        self.entry = entry
+
+    def done(self) -> bool:
+        return self.latch.event.is_set()
+
+    def set_result(self, _value):  # loop thread only (set_ready)
+        latch = self.latch
+        if self.entry.state == ERRORED:
+            latch.errored = True
+            latch.event.set()  # fail fast: don't wait for the rest
+            return
+        latch._n -= 1
+        if latch._n <= 0:
+            latch.event.set()
 
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
@@ -133,11 +202,14 @@ class OwnedObject:
 
     def set_ready(self):
         """Mark ready: wake loop-side awaiters and cross-thread waiters.
-        Loop-thread only."""
+        Loop-thread only.  The waiter list is taken under _CF_LOCK so
+        sync get()s on other threads can attach directly (lock-ordered
+        against the ready flip) instead of paying a loop hop."""
         self.event.set()
-        waiters = self.cf_waiters
-        if waiters:
+        with _CF_LOCK:
+            waiters = self.cf_waiters
             self.cf_waiters = None
+        if waiters:
             for f in waiters:
                 if not f.done():
                     f.set_result(None)
@@ -155,6 +227,24 @@ class LeasePool:
         self.return_timers: dict[bytes, asyncio.TimerHandle] = {}
         # request_id -> raylet conn the request is queued at (for cancel)
         self.outstanding: dict[bytes, object] = {}
+
+
+class _ActorSendQueue:
+    """Per-actor submission queue drained by ONE long-lived pump task
+    (reference: the direct actor submitter's per-actor send queue,
+    direct_actor_task_submitter.h:67).  A submission costs one loop hop
+    (the cross-thread enqueue); sequence numbers are assigned at
+    DEQUEUE, on the loop, so the unacked-window/reconnect-replay
+    semantics are identical to the per-call submitter this replaces —
+    and bursts to one actor coalesce into a single KIND_BATCH frame."""
+
+    __slots__ = ("pending", "waiter", "pump", "addr_hint")
+
+    def __init__(self):
+        self.pending: deque = deque()
+        self.waiter: asyncio.Future | None = None
+        self.pump: asyncio.Task | None = None
+        self.addr_hint: tuple | None = None
 
 
 class ExecutionContext(threading.local):
@@ -221,6 +311,13 @@ class CoreWorker:
         # direct_actor_task_submitter.h:67 resend of the unacked window).
         self._actor_unacked: dict[ActorID, dict[int, dict]] = {}
         self._actor_recovering: dict[ActorID, asyncio.Future] = {}
+        # Pipelined submission state: one send queue + pump per actor,
+        # return-oid -> queued entry (for cancel of unsent calls), and
+        # the per-(actor, method) spec templates of the zero-alloc
+        # dispatch fast path.
+        self._actor_queues: dict[ActorID, _ActorSendQueue] = {}
+        self._actor_queued_refs: dict[ObjectID, dict] = {}
+        self._actor_spec_templates: dict[tuple, dict] = {}
         # actor-executor state
         self.actor_instance = None
         self.actor_id: ActorID | None = None
@@ -231,9 +328,16 @@ class CoreWorker:
         self._caller_buffer: dict[bytes, list] = {}
         self._task_pool = ThreadPoolExecutor(max_workers=1,
                                              thread_name_prefix="exec")
+        # Drain-batched dispatch state for single-thread executor pools
+        # (see _exec_on_serial_pool), keyed by id(pool).
+        self._exec_states: dict[int, dict] = {}
         self.exec_ctx = ExecutionContext()
         self.connected = False
         self._shutdown = False
+        # MPSC thread->loop post queue (see _post).
+        self._post_q: deque = deque()
+        self._post_armed = False
+        self._loop_ident: int | None = None
         self._pubsub_handlers: dict[str, object] = {}
         self._gcs_reconnect_lock: asyncio.Lock | None = None
         # chrome-trace profile events for ray_tpu.timeline()
@@ -253,6 +357,7 @@ class CoreWorker:
         self.loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self.loop)
         protocol.enable_eager_tasks(self.loop)
+        self._loop_ident = threading.get_ident()
         self._loop_ready.set()
         self.loop.run_forever()
 
@@ -260,6 +365,7 @@ class CoreWorker:
         """Worker mode: called from the worker process's own loop."""
         self.loop = asyncio.get_running_loop()
         protocol.enable_eager_tasks(self.loop)
+        self._loop_ident = threading.get_ident()
         await self._connect()
         self.connected = True
 
@@ -343,6 +449,35 @@ class CoreWorker:
     def _call(self, coro) -> CFuture:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
+    # Coalesced thread->loop posting: call_soon_threadsafe writes to the
+    # loop's self-pipe on EVERY call, so a burst of N submissions costs
+    # N syscalls.  This MPSC queue arms at most one wake per drain: a
+    # burst rides one self-pipe write, and posts from the loop thread
+    # itself never pay a syscall at all.
+    def _post(self, fn, *args):
+        self._post_q.append((fn, args))
+        if not self._post_armed:
+            self._post_armed = True
+            if threading.get_ident() == self._loop_ident:
+                self.loop.call_soon(self._drain_posts)
+            else:
+                self.loop.call_soon_threadsafe(self._drain_posts)
+
+    def _drain_posts(self):
+        # Reset the arm flag FIRST: a producer appending after the reset
+        # re-arms (worst case an extra no-op wake, never a lost item).
+        self._post_armed = False
+        q = self._post_q
+        while q:
+            try:
+                fn, args = q.popleft()
+            except IndexError:
+                break
+            try:
+                fn(*args)
+            except Exception:
+                logger.exception("posted callback %s failed", fn)
+
     def _run(self, coro, timeout=None):
         """Run coro on the loop from a non-loop thread and wait."""
         return self._call(coro).result(timeout)
@@ -361,6 +496,9 @@ class CoreWorker:
         self.connected = False
 
     async def _shutdown_async(self):
+        for q in self._actor_queues.values():
+            if q.pump is not None:
+                q.pump.cancel()
         await self.server.stop()
         for conn in list(self._worker_conns.values()) + \
                 list(self._owner_conns.values()) + \
@@ -473,53 +611,58 @@ class CoreWorker:
     def get(self, refs, timeout=None):
         if isinstance(refs, ObjectRef):
             return self._get_sync_single(refs, timeout)
-        self._notify_blocked()
-        try:
-            values = self._run(self._get_async_list(refs, timeout))
-        finally:
-            self._notify_unblocked()
-        return values
+        return self._get_sync_list(refs, timeout)
+
+    @staticmethod
+    def _attach_waiter(entry, waiter) -> bool:
+        """Attach `waiter` to a pending entry under _CF_LOCK; False if
+        the entry is already ready (nothing attached)."""
+        with _CF_LOCK:
+            if entry.ready():
+                return False
+            if entry.cf_waiters is None:
+                entry.cf_waiters = []
+            entry.cf_waiters.append(waiter)
+            return True
+
+    @staticmethod
+    def _detach_waiter(entry, waiter):
+        with _CF_LOCK:
+            if entry.cf_waiters is not None:
+                try:
+                    entry.cf_waiters.remove(waiter)
+                except ValueError:
+                    pass
 
     def _get_sync_single(self, ref, timeout):
-        """Sync-get fast path for one OWNED ref: wait on a plain
-        concurrent future fired straight from the reply handler, then
-        deserialize on the calling thread — no coroutine, no loop-side
-        gather, and the loop never spends time deserializing.  Borrowed
-        refs, in-store objects, and recovery fall back to the full async
-        path with whatever remains of the ONE timeout budget."""
+        """Sync-get fast path for one OWNED ref: attach a plain
+        concurrent future directly (lock-ordered against set_ready — no
+        loop hop, no self-pipe syscall), wait, then deserialize on the
+        calling thread; the loop never spends time deserializing.
+        Borrowed refs, in-store objects, and recovery fall back to the
+        full async path with whatever remains of the ONE timeout
+        budget."""
         deadline = None if timeout is None else time.monotonic() + timeout
         entry = self.owned.get(ref.id)
         if entry is not None and not entry.ready():
             waiter = CFuture()
-
-            def _attach():
-                if entry.ready():
-                    if not waiter.done():
-                        waiter.set_result(None)
-                else:
-                    if entry.cf_waiters is None:
-                        entry.cf_waiters = []
-                    entry.cf_waiters.append(waiter)
-
-            def _detach():
-                if entry.cf_waiters is not None:
-                    try:
-                        entry.cf_waiters.remove(waiter)
-                    except ValueError:
-                        pass
-
-            self.loop.call_soon_threadsafe(_attach)
-            self._notify_blocked()
-            try:
-                waiter.result(timeout)
-            except TimeoutError:
-                # Prune the dead waiter: a caller polling with short
-                # timeouts must not grow entry.cf_waiters unboundedly.
-                self.loop.call_soon_threadsafe(_detach)
-                raise rexc.GetTimeoutError(
-                    f"timed out waiting for object {ref.id.hex()}")
-            finally:
-                self._notify_unblocked()
+            if self._attach_waiter(entry, waiter):
+                self._notify_blocked()
+                try:
+                    waiter.result(timeout)
+                except (TimeoutError, CFTimeoutError):
+                    # CFTimeoutError: on py<3.11 concurrent.futures
+                    # raises its OWN TimeoutError, which is NOT the
+                    # builtin — the builtin-only clause let the timeout
+                    # escape as a raw futures error instead of
+                    # GetTimeoutError.  Prune the dead waiter: a caller
+                    # polling with short timeouts must not grow
+                    # entry.cf_waiters unboundedly.
+                    self._detach_waiter(entry, waiter)
+                    raise rexc.GetTimeoutError(
+                        f"timed out waiting for object {ref.id.hex()}")
+                finally:
+                    self._notify_unblocked()
         if (entry is not None
                 and (entry.state == INLINE or entry.state == ERRORED)):
             value = serialization.deserialize(entry.blob)
@@ -534,8 +677,119 @@ class CoreWorker:
         finally:
             self._notify_unblocked()
 
+    def _get_sync_list(self, refs, timeout):
+        """List-get fast path for OWNED refs: ONE countdown latch rides
+        every pending entry's waiter list, so a burst of N replies costs
+        one thread wake, and all deserialization happens on the calling
+        thread.  Any borrowed ref sends the whole call to the async
+        path; in-store values resolve through it afterwards with the
+        remaining budget."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        entries = [self.owned.get(r.id) for r in refs]
+        if any(e is None for e in entries):
+            self._notify_blocked()
+            try:
+                return self._run(self._get_async_list(refs, timeout))
+            finally:
+                self._notify_unblocked()
+        # Fail fast on errors already in hand, like the gather path did.
+        for e in entries:
+            if e.ready() and e.state == ERRORED:
+                value = serialization.deserialize(e.blob)
+                if isinstance(value, _SerializedError):
+                    raise value.to_exception()
+        latch = _Latch(0)
+        wrappers = []
+        with _CF_LOCK:
+            # One lock region for the whole attach: set_ready can only
+            # observe the latch after we release, so the count is final
+            # before the first fire.
+            for e in entries:
+                if not e.ready():
+                    if e.cf_waiters is None:
+                        e.cf_waiters = []
+                    w = _LatchRef(latch, e)
+                    e.cf_waiters.append(w)
+                    wrappers.append(w)
+            latch._n = len(wrappers)
+        if wrappers:
+            self._notify_blocked()
+            try:
+                if not latch.event.wait(timeout):
+                    for w in wrappers:
+                        self._detach_waiter(w.entry, w)
+                    raise rexc.GetTimeoutError(
+                        f"timed out waiting for {len(refs)} objects")
+            finally:
+                self._notify_unblocked()
+            if latch.errored:
+                # A task failed while others may still be running: raise
+                # its error NOW (fail-fast), detaching our stakes from
+                # the stragglers first.
+                for w in wrappers:
+                    self._detach_waiter(w.entry, w)
+                for e in entries:
+                    if e.ready() and e.state == ERRORED:
+                        value = serialization.deserialize(e.blob)
+                        if isinstance(value, _SerializedError):
+                            raise value.to_exception()
+        values = []
+        slow_idx = []
+        for i, e in enumerate(entries):
+            if e.state == INLINE or e.state == ERRORED:
+                value = serialization.deserialize(e.blob)
+                if isinstance(value, _SerializedError):
+                    raise value.to_exception()
+                values.append(value)
+            else:
+                values.append(None)
+                slow_idx.append(i)
+        if slow_idx:
+            # In-store (or recovering) objects: async path, shared
+            # remaining budget.
+            remaining = self._remain(deadline)
+            self._notify_blocked()
+            try:
+                slow_values = self._run(self._get_async_list(
+                    [refs[i] for i in slow_idx], remaining))
+            finally:
+                self._notify_unblocked()
+            for i, v in zip(slow_idx, slow_values):
+                values[i] = v
+        return values
+
     def get_future(self, ref: ObjectRef) -> CFuture:
         return self._call(self._get_one(ref))
+
+    def ready_future(self, ref: ObjectRef) -> CFuture:
+        """Thread-safe future firing (with None) when an OWNED ref's
+        entry becomes ready; fires immediately for already-ready and
+        borrowed refs.  Pairs with try_take_local_value for the serve
+        router's unary fast path: no coroutine is spawned per call and
+        the value is deserialized on the CALLER's thread, keeping the
+        CoreWorker IO loop out of the reply data path."""
+        fut = CFuture()
+        entry = self.owned.get(ref.id)
+        if entry is None or entry.ready() \
+                or not self._attach_waiter(entry, fut):
+            fut.set_result(None)
+        return fut
+
+    def try_take_local_value(self, ref: ObjectRef):
+        """(True, value) for a ready owned INLINE entry — deserialized
+        on the calling thread (the carried exception is raised for
+        ERRORED entries); (False, None) when the full get() path is
+        needed (borrowed refs or in-store objects)."""
+        entry = self.owned.get(ref.id)
+        if entry is None or not entry.ready():
+            return False, None
+        state = entry.state
+        if state != INLINE and state != ERRORED:
+            return False, None
+        value = serialization.deserialize(entry.blob)
+        if isinstance(value, _SerializedError):
+            raise value.to_exception()
+        return True, value
 
     async def get_async(self, ref: ObjectRef):
         return await self._get_one(ref)
@@ -867,7 +1121,7 @@ class CoreWorker:
         return fn_id
 
     def submit_task(self, fn_id: bytes, args, kwargs, opts: dict):
-        task_id = TaskID.from_random()
+        task_id = TaskID.for_submit()
         num_returns = opts.get("num_returns", 1)
         # "dynamic": one visible return (the ObjectRefGenerator); the
         # per-yield objects get ids for_task_return(task_id, 1..N) on
@@ -918,9 +1172,10 @@ class CoreWorker:
             self._call(self._submit(spec))
         else:
             # No ObjectRef args -> nothing to await before dispatch; a
-            # plain callback skips run_coroutine_threadsafe's coroutine +
-            # future-chaining overhead (~25us on the sync hot path).
-            self.loop.call_soon_threadsafe(self._enqueue_spec, spec)
+            # coalesced post skips run_coroutine_threadsafe's coroutine +
+            # future-chaining overhead AND shares one loop wake across a
+            # submission burst.
+            self._post(self._enqueue_spec, spec)
         return refs
 
     def cancel_task(self, ref, force: bool = False) -> bool:
@@ -931,11 +1186,16 @@ class CoreWorker:
         entry = self.owned.get(ref.id)
         spec = entry.submitted_task if entry is not None else None
         if spec is None:
+            # Actor tasks: cancellable only while still queued in the
+            # per-actor send queue (not yet on the wire).
+            if self._run(self._cancel_queued_actor(ref.id)):
+                return True
             raise ValueError(
-                "ray_tpu.cancel only applies to normal-task returns: "
-                "puts have no task, completed-and-released tasks are "
-                "gone, and actor-task cancellation is not supported "
-                "(kill the actor instead)")
+                "ray_tpu.cancel only applies to normal-task returns "
+                "and queued-but-unsent actor tasks: puts have no task, "
+                "completed-and-released tasks are gone, and an actor "
+                "task already on the wire cannot be cancelled (kill "
+                "the actor instead)")
         return self._run(self._cancel(spec, force))
 
     async def _cancel(self, spec, force: bool) -> bool:
@@ -1470,10 +1730,60 @@ class CoreWorker:
                 pass
 
     # ======================================================== EXECUTION SIDE
+    async def _exec_on_serial_pool(self, pool, fn, *args):
+        """run_in_executor replacement for SINGLE-thread pools: a burst
+        of queued calls is drained by ONE pool submission (one futex
+        wake instead of one per call), and results return to the loop
+        through the coalesced _post queue (one self-pipe wake per
+        drain).  Execution order on the pool thread == dispatch order —
+        the property the serial pools exist for."""
+        st = self._exec_states.get(id(pool))
+        if st is None:
+            st = self._exec_states[id(pool)] = {
+                "q": deque(), "armed": False, "pool": pool}
+        fut = self.loop.create_future()
+        st["q"].append((fn, args, fut))
+        if not st["armed"]:
+            st["armed"] = True
+            pool.submit(self._exec_drain, st)
+        return await fut
+
+    def _exec_drain(self, st):  # pool thread
+        q = st["q"]
+        while True:
+            try:
+                fn, args, fut = q.popleft()
+            except IndexError:
+                # Disarm FIRST, then re-check: an append racing the
+                # disarm either sees armed and leaves the item to us, or
+                # arms a fresh drain — never a stranded item.
+                st["armed"] = False
+                if q and not st["armed"]:
+                    st["armed"] = True
+                    continue
+                return
+            try:
+                result, err = fn(*args), None
+            except BaseException as e:
+                # BaseException: SystemExit/_ActorExit must reach the
+                # loop-side awaiter exactly as run_in_executor delivered
+                # them (they terminate the worker there).
+                result, err = None, e
+            self._post(self._finish_serial_exec, fut, result, err)
+
+    @staticmethod
+    def _finish_serial_exec(fut, result, err):  # loop thread
+        if fut.done():
+            return
+        if err is not None:
+            fut.set_exception(err)
+        else:
+            fut.set_result(result)
+
     async def rpc_push_task(self, conn, body):
         spec = body["spec"]
         lease_id = body.get("lease_id")
-        return await self.loop.run_in_executor(
+        return await self._exec_on_serial_pool(
             self._task_pool, self._execute_task_sync, spec, lease_id,
             body.get("tpu_ids") or [])
 
@@ -1684,6 +1994,12 @@ class CoreWorker:
         self._caller_seq[caller] = body["seq"] + 1
         # Release any buffered next-in-line tasks.
         buf = self._caller_buffer.get(caller)
+        if not buf:
+            # Nothing buffered (the overwhelmingly common case): await
+            # the dispatch directly — no Task allocation.  A successor
+            # arriving mid-dispatch sees the advanced seq and dispatches
+            # itself; only out-of-order arrivals need the buffer path.
+            return await self._dispatch_actor_task(body)
         dispatch_coro = self._dispatch_actor_task(body)
         task = self.loop.create_task(dispatch_coro)
         while buf and buf[0][0] == self._caller_seq[caller]:
@@ -1730,6 +2046,11 @@ class CoreWorker:
                 except Exception as e:
                     return {"error": _error_blob(e, traceback.format_exc())}
         pool = self._actor_pools.get(group) or self._actor_pools["_default"]
+        if pool._max_workers == 1:
+            # The common sync-actor shape: drain-batched serial dispatch
+            # (order-preserving; see _exec_on_serial_pool).
+            return await self._exec_on_serial_pool(
+                pool, self._execute_actor_method_sync, method, body, spec)
         return await self.loop.run_in_executor(
             pool, self._execute_actor_method_sync, method, body, spec)
 
@@ -1751,39 +2072,243 @@ class CoreWorker:
     # --------------------------------------------------- actor-caller side
     def submit_actor_task(self, actor_id: ActorID, actor_addr, method: str,
                           args, kwargs, num_returns=1, opts=None):
+        """Hot path: build the spec from a cached per-(actor, method)
+        template — only task id / args / return ids / trace / seq vary
+        per call — and hand it to the actor's send queue with ONE loop
+        hop.  Sequencing, wire writes, and reply handling all live on
+        the loop side (_actor_pump / _on_actor_reply)."""
         opts = opts or {}
-        task_id = TaskID.from_random()
+        task_id = TaskID.for_submit()
         refs = []
+        return_ids = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
             entry = OwnedObject()
             entry.local_refs = 1
             self.owned[oid] = entry
+            return_ids.append(oid)
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
         self._pin_args(task_id, args, kwargs)
-        body = ActorTaskSpec.new(
-            task_id=task_id,
-            method=method,
-            args_blob=args_blob,
-            trace=_trace_for_submit(),
-            num_returns=num_returns,
-            return_ids=[r.id for r in refs],
-            caller_id=self.worker_id.binary(),
-            concurrency_group=opts.get("concurrency_group"),
-            owner_addr=self.addr,
-        )
-        self.loop.call_soon_threadsafe(
-            self._spawn_actor_submit, actor_id, actor_addr, body,
-            opts.get("max_task_retries", 0))
+        tkey = (actor_id, method, num_returns, opts.get("concurrency_group"))
+        tmpl = self._actor_spec_templates.get(tkey)
+        if tmpl is None:
+            tmpl = self._actor_spec_templates[tkey] = ActorTaskSpec.new(
+                task_id=None,
+                method=method,
+                args_blob=None,
+                trace=None,
+                num_returns=num_returns,
+                return_ids=None,
+                caller_id=self.worker_id.binary(),
+                concurrency_group=opts.get("concurrency_group"),
+                owner_addr=self.addr,
+            )
+        body = ActorTaskSpec(tmpl)
+        body["task_id"] = task_id
+        body["args"] = args_blob
+        body["return_ids"] = return_ids
+        body["trace"] = _trace_for_submit()
+        entry = {"body": body, "retries": opts.get("max_task_retries", 0),
+                 "attempts": 0, "fut": None, "seq": None, "conn": None,
+                 "failed": None, "cancelled": False, "driver": False}
+        self._post(self._actor_enqueue, actor_id, actor_addr, entry)
         return refs
 
-    def _spawn_actor_submit(self, actor_id, actor_addr, body, retries):
+    def _actor_enqueue(self, actor_id, actor_addr, entry):
+        """Loop side of submit_actor_task: append to the actor's send
+        queue (creating queue + pump on first use) and wake the pump."""
+        q = self._actor_queues.get(actor_id)
+        if q is None:
+            q = self._actor_queues[actor_id] = _ActorSendQueue()
+            q.pump = self.loop.create_task(self._actor_pump(actor_id, q))
+            q.pump.add_done_callback(lambda t: t.cancelled() or t.exception())
+        if actor_addr is not None and q.addr_hint is None:
+            q.addr_hint = actor_addr
+        q.pending.append(entry)
+        for oid in entry["body"]["return_ids"]:
+            self._actor_queued_refs[oid] = entry
+        w = q.waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    _ACTOR_SEND_BURST = 32
+
+    async def _actor_pump(self, actor_id, q: _ActorSendQueue):
+        """The one sender for this actor: drains the queue FIFO, assigns
+        sequence numbers at dequeue, and writes bursts as one KIND_BATCH
+        frame.  Between the seq assignment and the wire write nothing
+        yields, so wire order always equals sequence order — the
+        per-call lock of the old submitter is unnecessary here."""
+        while not self._shutdown:
+            if not q.pending:
+                q.waiter = self.loop.create_future()
+                try:
+                    await q.waiter
+                finally:
+                    q.waiter = None
+                continue
+            # Never interleave fresh sends with an in-flight window
+            # replay: replayed entries were submitted first and must
+            # keep their place in the sequence stream.
+            rec = self._actor_recovering.get(actor_id)
+            if rec is not None:
+                try:
+                    await asyncio.shield(rec)
+                except Exception:
+                    pass
+                continue
+            conn = self._actor_conns.get(actor_id)
+            if conn is None or conn.closed:
+                # A (re)connect means a possibly new incarnation: replay
+                # the unacked window FIRST so newer queued calls keep
+                # their place behind it (submission order across
+                # restart).  Entries stay IN the queue — and therefore
+                # cancellable — until a live connection is in hand.
+                if self._actor_unacked.get(actor_id):
+                    try:
+                        await self._actor_recover(actor_id, conn)
+                    except Exception:
+                        pass
+                try:
+                    conn = await self._actor_conn(actor_id, q.addr_hint)
+                except Exception as e:
+                    # No reachable incarnation: hand every queued entry
+                    # to the retry/recovery slow path (each applies its
+                    # own budget and terminal-death handling).
+                    while q.pending:
+                        entry = q.pending.popleft()
+                        for oid in entry["body"]["return_ids"]:
+                            self._actor_queued_refs.pop(oid, None)
+                        if not entry["cancelled"]:
+                            self._spawn_actor_entry_driver(actor_id,
+                                                           entry, e)
+                    continue
+                continue  # re-check recovery state before sending
+            batch = []
+            while q.pending and len(batch) < self._ACTOR_SEND_BURST:
+                entry = q.pending.popleft()
+                for oid in entry["body"]["return_ids"]:
+                    self._actor_queued_refs.pop(oid, None)
+                if entry["cancelled"]:
+                    continue  # returns already completed by cancel
+                batch.append(entry)
+            if not batch:
+                continue
+            try:
+                una = self._actor_unacked.setdefault(actor_id, {})
+                base = self._actor_seq.get(actor_id, 0)
+                if len(batch) == 1:
+                    batch[0]["body"]["seq"] = base
+                    futs = [conn.request_send_nowait("push_actor_task",
+                                                     batch[0]["body"])]
+                else:
+                    for i, entry in enumerate(batch):
+                        entry["body"]["seq"] = base + i
+                    futs = conn.request_send_many_nowait(
+                        "push_actor_task", [e["body"] for e in batch])
+                self._actor_seq[actor_id] = base + len(batch)
+                for entry, fut in zip(batch, futs):
+                    entry["seq"] = entry["body"]["seq"]
+                    entry["conn"] = conn
+                    entry["fut"] = fut
+                    una[entry["seq"]] = entry
+                    fut.add_done_callback(functools.partial(
+                        self._on_actor_reply, actor_id, entry))
+            except Exception as e:
+                # The write never hit the wire (the nowait senders are
+                # all-or-nothing) and the seq stream was not committed:
+                # run each entry through the retry/recovery slow path.
+                for entry in batch:
+                    entry["fut"] = None
+                    entry["conn"] = None
+                    entry["seq"] = None
+                    entry["body"].pop("seq", None)
+                    self._spawn_actor_entry_driver(actor_id, entry, e)
+                continue
+            try:
+                # Throttle at the transport's high-water mark: a stalled
+                # actor must not let this queue buffer frames unbounded.
+                # (The batch is already on the wire/window — a failure
+                # here surfaces through the reply futures, not by
+                # re-driving the entries.)
+                await conn.backpressure()
+            except Exception:
+                pass
+
+    def _maybe_evict_actor_queue(self, actor_id):
+        """Drop the actor's send machinery (parked pump task + queue +
+        spec templates) once nothing is queued or unacked — an
+        actor-churn workload (launch/kill loops) must not park one task
+        per dead actor forever.  Safe for live actors: the next call
+        recreates the queue, and the seq stream / unacked window live in
+        their own tables, which this does NOT touch."""
+        if self._shutdown:
+            return
+        if self._actor_unacked.get(actor_id):
+            return
+        q = self._actor_queues.get(actor_id)
+        if q is not None:
+            if q.pending:
+                return
+            self._actor_queues.pop(actor_id, None)
+            if q.pump is not None:
+                q.pump.cancel()
+        for key in [k for k in self._actor_spec_templates
+                    if k[0] == actor_id]:
+            self._actor_spec_templates.pop(key, None)
+
+    def _on_actor_conn_close(self, actor_id, conn):
+        self._maybe_evict_actor_queue(actor_id)
+
+    def _on_actor_reply(self, actor_id, entry, fut):
+        """Reply-future callback for queue-sent actor tasks (loop
+        thread).  Success is recorded inline — no per-call task ever
+        existed; any failure hands the entry to a driver task that owns
+        the retry/recovery loop."""
+        if entry["driver"] or entry["fut"] is not fut:
+            return  # a driver task or a recovery resend owns this entry
+        if not fut.cancelled() and fut.exception() is None:
+            self._actor_unacked.get(actor_id, {}).pop(entry["seq"], None)
+            body = entry["body"]
+            self._record_results({"task_id": body["task_id"],
+                                  "return_ids": body["return_ids"]},
+                                 fut.result())
+            return
+        self._spawn_actor_entry_driver(actor_id, entry, None)
+
+    def _spawn_actor_entry_driver(self, actor_id, entry, pre_error):
+        entry["driver"] = True
         t = self.loop.create_task(
-            self._submit_actor_task(actor_id, actor_addr, body, retries))
-        # The submitter reports failures through the return entries; retrieve
-        # any stray exception so task GC doesn't log it.
+            self._drive_actor_entry(actor_id, entry, pre_error))
+        # Failures surface through the return entries; retrieve any stray
+        # exception so task GC doesn't log it.
         t.add_done_callback(lambda t: t.cancelled() or t.exception())
+
+    async def _cancel_queued_actor(self, oid) -> bool:
+        """Cancel an actor task still waiting in its send queue: the
+        entry is marked (the pump skips it at dequeue) and its returns
+        complete with TaskCancelledError immediately.  Returns False if
+        the call already reached the wire."""
+        entry = self._actor_queued_refs.get(oid)
+        if entry is None:
+            return False
+        if entry["cancelled"]:
+            return True
+        entry["cancelled"] = True
+        body = entry["body"]
+        self._unpin_args(body["task_id"])
+        blob = _error_blob(rexc.TaskCancelledError(
+            f"actor task {body['task_id'].hex()[:8]} cancelled before "
+            "it was sent"))
+        for roid in body["return_ids"]:
+            self._actor_queued_refs.pop(roid, None)
+            oentry = self.owned.get(roid)
+            if oentry is not None:
+                oentry.blob = blob
+                oentry.state = ERRORED  # last: lock-free readers
+                oentry.set_ready()
+        return True
 
     async def _actor_send(self, actor_id, actor_addr, entry):
         """Connect (or reuse), assign the next sequence number, put the
@@ -1813,23 +2338,27 @@ class CoreWorker:
                 raise
             self._actor_unacked.setdefault(actor_id, {})[seq] = entry
 
-    async def _submit_actor_task(self, actor_id, actor_addr, body, retries):
-        """Submit through the per-actor unacked window.  On a connection
-        loss the whole window is held, the next incarnation is awaited
-        (patiently — a restart under load may take minutes), and every
-        entry with retry budget left is resent IN ORIGINAL ORDER by one
-        shared recovery pass; entries out of budget fail with
-        ActorDiedError.  -1 retries = unbounded while the actor keeps
-        restarting.  Reference: direct_actor_task_submitter.h:67."""
-        entry = {"body": body, "retries": retries, "attempts": 0,
-                 "fut": None, "seq": None, "conn": None, "failed": None}
-        first_error = None
-        addr = actor_addr
+    async def _drive_actor_entry(self, actor_id, entry, pre_error=None):
+        """Slow-path driver for one entry after a failure: retry through
+        the per-actor unacked window.  On a connection loss the whole
+        window is held, the next incarnation is awaited (patiently — a
+        restart under load may take minutes), and every entry with retry
+        budget left is resent IN ORIGINAL ORDER by one shared recovery
+        pass; entries out of budget fail with ActorDiedError.  -1
+        retries = unbounded while the actor keeps restarting.
+        Reference: direct_actor_task_submitter.h:67.
+
+        Entered with entry["fut"] set to the failed reply future (a sent
+        call whose connection died), or None (the pump could not reach
+        the actor at all, `pre_error` carries why)."""
+        body = entry["body"]
+        retries = entry["retries"]
+        first_error = pre_error
+        addr = None
         while True:
             if entry["fut"] is None and entry["failed"] is None:
-                # Not on a wire (initial submit, or a send that failed
-                # before reaching the socket): send on the current
-                # incarnation.
+                # Not on a wire (pump send failed, or a resend is due):
+                # send on the current incarnation.
                 if retries != -1 and entry["attempts"] > max(retries, 0):
                     break
                 try:
@@ -1891,6 +2420,12 @@ class CoreWorker:
                 self._actor_unacked.get(actor_id, {}).pop(entry["seq"], None)
                 entry["fut"] = None
                 entry["attempts"] += 1
+        await self._finalize_actor_entry(actor_id, entry, first_error)
+
+    async def _finalize_actor_entry(self, actor_id, entry, first_error):
+        """Terminal failure: complete the entry's returns with
+        ActorDiedError carrying the best-known cause."""
+        body = entry["body"]
         self._actor_unacked.get(actor_id, {}).pop(entry.get("seq"), None)
         view = await self._wait_actor_alive(actor_id, overall_timeout=1.0)
         cause = (entry["failed"]
@@ -1907,6 +2442,9 @@ class CoreWorker:
                 oentry.blob = blob
                 oentry.state = ERRORED  # last: lock-free readers
                 oentry.set_ready()
+        # Terminal failures usually mean a dead actor: reap its parked
+        # send machinery once the last entry settles.
+        self._maybe_evict_actor_queue(actor_id)
 
     async def _actor_recover(self, actor_id, failed_conn):
         """Single-flight per actor: wait for the next ALIVE incarnation,
@@ -1954,6 +2492,15 @@ class CoreWorker:
                         ent["failed"] = ("task was submitted to a previous "
                                          "incarnation and is out of retries")
                         ent["fut"] = None
+                        if not ent.get("driver"):
+                            # No driver task is watching this entry (it
+                            # was queue-sent and its reply callback
+                            # already fired): complete its returns here.
+                            t = self.loop.create_task(
+                                self._finalize_actor_entry(
+                                    actor_id, ent, None))
+                            t.add_done_callback(
+                                lambda t: t.cancelled() or t.exception())
                         continue
                     seq = self._actor_seq.get(actor_id, 0)
                     self._actor_seq[actor_id] = seq + 1
@@ -1962,6 +2509,9 @@ class CoreWorker:
                     ent["fut"] = await conn.request_send("push_actor_task",
                                                          ent["body"])
                     unacked[seq] = ent
+                    if not ent.get("driver"):
+                        ent["fut"].add_done_callback(functools.partial(
+                            self._on_actor_reply, actor_id, ent))
             rec.set_result(None)
         except Exception as e:
             rec.set_exception(e)
@@ -2012,7 +2562,9 @@ class CoreWorker:
             self._actor_seq[actor_id] = 0  # new incarnation, new stream
         conn = await protocol.Connection.connect(
             actor_addr[0], actor_addr[1], handler=self._handle,
-            name="cw->actor", timeout=cfg.connect_timeout_s)
+            name="cw->actor", timeout=cfg.connect_timeout_s,
+            on_close=functools.partial(self._on_actor_conn_close,
+                                       actor_id))
         self._actor_conns[actor_id] = conn
         self._actor_addr_cache[actor_id] = tuple(actor_addr)
         return conn

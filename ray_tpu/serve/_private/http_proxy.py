@@ -205,7 +205,18 @@ class HTTPProxyActor:
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", _handler)
         kwargs = {} if self._access_log else {"access_log": None}
-        self._runner = web.AppRunner(app, **kwargs)
+        # Keep-alive tuning for the proxy hop: hold client connections
+        # well past the default 75 s so steady low-QPS clients never pay
+        # reconnect + slow-start inside a measurement window, and keep
+        # TCP keep-alive probes on so dead peers are still reaped.
+        # (NODELAY is aiohttp's default on accepted sockets; the replica
+        # leg already sets it in protocol.Connection.)
+        kwargs["keepalive_timeout"] = 300.0
+        try:
+            self._runner = web.AppRunner(app, **kwargs)
+        except TypeError:  # older aiohttp without keepalive_timeout
+            kwargs.pop("keepalive_timeout", None)
+            self._runner = web.AppRunner(app, **kwargs)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
         await self._site.start()

@@ -56,6 +56,10 @@ class RTServeReplica:
         self._num_processed = 0
         self._streams: Dict[str, Dict[str, Any]] = {}
         self._stream_seq = 0
+        # method name -> (target, is_async): the per-request getattr +
+        # inspect.iscoroutinefunction probes are paid once per method,
+        # not once per call (the unary fast path).
+        self._target_cache: Dict[str, tuple] = {}
         from concurrent.futures import ThreadPoolExecutor
         self._sync_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"replica-{replica_tag}")
@@ -87,6 +91,7 @@ class RTServeReplica:
         if user_config is not None:
             self._reconfigure_sync(user_config)
         self.version = version
+        self._target_cache.clear()
         return True
 
     def check_health(self):
@@ -95,14 +100,30 @@ class RTServeReplica:
             hc()
         return True
 
+    def _resolve_cached(self, method_name: str) -> tuple:
+        """(target, is_async) with the inspect probes paid once per
+        method name instead of once per call."""
+        hit = self._target_cache.get(method_name)
+        if hit is None:
+            target = self._resolve_target(method_name)
+            is_async = inspect.iscoroutinefunction(target) or (
+                not inspect.isfunction(target)
+                and not inspect.ismethod(target)
+                and inspect.iscoroutinefunction(
+                    getattr(target, "__call__", None)))
+            hit = self._target_cache[method_name] = (target, is_async)
+        return hit
+
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict):
         """One query.  `method_name` '' means call the deployment itself
         (function deployment or __call__)."""
         self._num_ongoing += 1
         try:
-            target = self._resolve_target(method_name)
-            return await self._call_target(target, args, kwargs)
+            target, is_async = self._resolve_cached(method_name)
+            if is_async:
+                return await target(*args, **kwargs)
+            return await self._call_sync_target(target, args, kwargs)
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
@@ -118,6 +139,9 @@ class RTServeReplica:
                 and inspect.iscoroutinefunction(
                     getattr(target, "__call__", None))):
             return await target(*args, **kwargs)
+        return await self._call_sync_target(target, args, kwargs)
+
+    async def _call_sync_target(self, target, args, kwargs):
         loop = asyncio.get_running_loop()
         result = await loop.run_in_executor(
             self._sync_pool, lambda: target(*args, **kwargs))

@@ -23,6 +23,18 @@ from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
+_worker_mod = None
+
+
+def _core_worker():
+    """The process's CoreWorker, with the module resolved once (lazy to
+    dodge import cycles, cached to keep it off the per-request path)."""
+    global _worker_mod
+    if _worker_mod is None:
+        from ray_tpu._private import worker as worker_mod
+        _worker_mod = worker_mod
+    return _worker_mod.global_worker
+
 QUEUE_DEPTH_GAUGE = _metrics.Gauge(
     "serve_router_queue_depth",
     "Queries waiting in this process's router for a free replica slot",
@@ -50,7 +62,14 @@ class _UnaryResult:
 
 
 class ReplicaSet:
-    """The live replicas of one deployment, with in-flight accounting."""
+    """The live replicas of one deployment, with in-flight accounting.
+
+    Hot-path detail: the saturation gauges are written through
+    pre-resolved series handles (`Metric.series`) — one dict store per
+    update instead of a tag merge + lock per call — and the unary call
+    path resolves replica replies via the CoreWorker's ready-future
+    fast path (no per-call coroutine on the IO loop, reply deserialized
+    on this router's own thread)."""
 
     def __init__(self, deployment_name: str, loop):
         self.deployment_name = deployment_name
@@ -59,6 +78,19 @@ class ReplicaSet:
         self._in_flight: Dict[str, int] = {}
         self._slot_freed = asyncio.Event()
         self.num_queued = 0
+        self._g_queued = QUEUE_DEPTH_GAUGE.series(
+            {"deployment": deployment_name})
+        self._g_in_flight = IN_FLIGHT_GAUGE.series(
+            {"deployment": deployment_name})
+        self._g_replica: Dict[str, object] = {}
+        self._num_in_flight = 0
+
+    def _replica_series(self, tag: str):
+        s = self._g_replica.get(tag)
+        if s is None:
+            s = self._g_replica[tag] = REPLICA_IN_FLIGHT_GAUGE.series(
+                {"deployment": self.deployment_name, "replica": tag})
+        return s
 
     def update_replicas(self, infos: List[Dict]):
         self._replicas = list(infos)
@@ -67,26 +99,22 @@ class ReplicaSet:
             # Zero the departed replica's series: its finally-block
             # decrement is skipped once the tag is dropped, and a
             # stale nonzero gauge would misreport saturation forever.
-            REPLICA_IN_FLIGHT_GAUGE.set(
-                0, tags={"deployment": self.deployment_name,
-                         "replica": gone})
+            self._replica_series(gone).set(0)
+            self._g_replica.pop(gone, None)
         self._in_flight = {t: self._in_flight.get(t, 0) for t in tags}
-        IN_FLIGHT_GAUGE.set(sum(self._in_flight.values()),
-                            tags={"deployment": self.deployment_name})
+        self._num_in_flight = sum(self._in_flight.values())
+        self._g_in_flight.set(self._num_in_flight)
         self._slot_freed.set()  # membership change may free capacity
 
     def _set_queued(self, delta: int):
         self.num_queued += delta
-        QUEUE_DEPTH_GAUGE.set(self.num_queued,
-                              tags={"deployment": self.deployment_name})
+        self._g_queued.set(self.num_queued)
 
     def _track_in_flight(self, tag: str, delta: int):
-        self._in_flight[tag] = self._in_flight.get(tag, 0) + delta
-        IN_FLIGHT_GAUGE.set(sum(self._in_flight.values()),
-                            tags={"deployment": self.deployment_name})
-        REPLICA_IN_FLIGHT_GAUGE.set(
-            self._in_flight[tag],
-            tags={"deployment": self.deployment_name, "replica": tag})
+        n = self._in_flight[tag] = self._in_flight.get(tag, 0) + delta
+        self._num_in_flight += delta
+        self._g_in_flight.set(self._num_in_flight)
+        self._replica_series(tag).set(n)
 
     async def _acquire(self, timeout_s: float) -> Dict:
         """Wait (bounded) for a replica with a free slot; the caller owns
@@ -128,8 +156,22 @@ class ReplicaSet:
         try:
             actor = choice["actor"]
             ref = actor.handle_request.remote(method_name, args, kwargs)
-            # ref.future() rides the CoreWorker IO loop, so this await is
-            # safe on any loop (the router often runs on its own thread).
+            # Fast path: wait on the owned entry's ready-future (fired
+            # straight from the reply handler — no per-call coroutine on
+            # the CoreWorker loop) and deserialize HERE, on the router's
+            # thread.  In-store/borrowed replies fall back to the full
+            # get() path, which also rides the IO loop safely from any
+            # thread (the router often runs on its own loop).
+            w = _core_worker()
+            ready_future = getattr(w, "ready_future", None)
+            if ready_future is None:  # e.g. local-mode worker
+                return await asyncio.wrap_future(ref.future())
+            fut = ready_future(ref)
+            if not fut.done():
+                await asyncio.wrap_future(fut)
+            ok, value = w.try_take_local_value(ref)
+            if ok:
+                return value
             return await asyncio.wrap_future(ref.future())
         finally:
             if tag in self._in_flight:

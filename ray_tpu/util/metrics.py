@@ -57,6 +57,39 @@ class Metric:
                     "values": dict(self._values),
                     "ts": time.time()}
 
+    def series(self, tags: Optional[Dict[str, str]] = None) -> "_Series":
+        """Pre-resolved handle for ONE label combination: set()/inc()
+        without the per-call tag merge/validation (hot paths — e.g. the
+        serve router updates its gauges on every request).  The handle
+        registers the series eagerly so it appears in snapshots even
+        before the first write."""
+        key = self._label_values(tags)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+        return _Series(self._values, key, self._lock)
+
+
+class _Series:
+    """Single-series view of a metric.  set() is one dict store on a
+    pre-existing key — atomic under the GIL, so it takes no lock (the
+    snapshot path copies the dict, which is likewise GIL-atomic).
+    inc() is a read-modify-write and DOES take the metric's lock."""
+
+    __slots__ = ("_values", "_key", "_lock")
+
+    def __init__(self, values: Dict[tuple, float], key: tuple, lock):
+        self._values = values
+        self._key = key
+        self._lock = lock
+
+    def set(self, value: float):
+        self._values[self._key] = float(value)
+
+    def inc(self, value: float = 1.0):
+        with self._lock:
+            self._values[self._key] = \
+                self._values.get(self._key, 0.0) + value
+
 
 class Counter(Metric):
     _kind = "counter"
