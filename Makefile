@@ -18,7 +18,7 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
-	bench-quick
+	bench-quick bench-transfer
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -45,6 +45,12 @@ bench-quick:
 		--only single_client_tasks_sync,actor_calls_1_1,put_small_1kb
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) -m ray_tpu._private.serve_perf --probe
+
+# Object transfer plane GB/s (pull/push, striped, vs stop-and-wait
+# baseline); refreshes the checked-in BENCH_transfer.json artifact.
+bench-transfer:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite transfer --json-out BENCH_transfer.json
 
 check: lint verify bench-quick
 

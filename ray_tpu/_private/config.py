@@ -41,6 +41,33 @@ class _Config:
     # queue + object_store_full_delay semantics).
     create_retry_timeout_s = _def("create_retry_timeout_s", float, 120.0)
 
+    # --- object transfer plane (node-to-node pulls/pushes) ---
+    # Sliding window of in-flight chunks per transfer in BOTH directions
+    # (reference: pull_manager.h keeps several chunk requests outstanding
+    # so throughput is wire-bound, not RTT-bound).
+    transfer_window_chunks = _def("transfer_window_chunks", int, 4)
+    # Admission cap on bytes in flight to/from any single peer across
+    # ALL transfers, so many concurrent pulls can't buffer-bloat or OOM
+    # a receiver.
+    transfer_inflight_bytes_per_peer = _def(
+        "transfer_inflight_bytes_per_peer", int, 64 * 1024**2)
+    # Objects at least this large stripe chunk ranges across multiple
+    # sealed locations when the GCS object directory knows of 2+.
+    transfer_stripe_min_bytes = _def("transfer_stripe_min_bytes",
+                                     int, 32 * 1024**2)
+    # Most peers one striped pull will read from.
+    transfer_max_sources = _def("transfer_max_sources", int, 4)
+    # Same-host zero-copy fast path: when a source raylet's arena file
+    # is reachable on this host, pin the object remotely and memcpy
+    # straight out of a read-only mmap of the peer arena instead of
+    # chunking it through the socket (the plasma model — one shared
+    # store per node — recovered across co-located raylets).
+    transfer_same_host_mmap = _def("transfer_same_host_mmap", bool, True)
+    # Push-receive transfers with no chunk activity for this long are
+    # swept (sender died mid-stream); also bounds the idle lifetime of
+    # cached spill-file read fds.
+    push_stale_sweep_s = _def("push_stale_sweep_s", float, 120.0)
+
     # --- scheduling ---
     max_workers_per_node = _def("max_workers_per_node", int, 64)
     # Fork-server worker spawn (zygote.py): pay the interpreter+import cost

@@ -145,6 +145,11 @@ class GcsServer:
         self.named_actors: dict[tuple[str, str], ActorID] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.kv: dict[str, dict[bytes, bytes]] = {}
+        # Object directory: oid -> node ids reporting a sealed copy
+        # (reference: gcs object location table backing the pull
+        # manager's source selection).  Fed by best-effort raylet
+        # reports; consumers stat-verify, so staleness is tolerated.
+        self.object_locations: dict[bytes, set] = {}
         self.subscribers: dict[str, set[protocol.Connection]] = {}
         self.jobs: dict = {}
         self._pending_actor_creations: dict[ActorID, asyncio.Task] = {}
@@ -485,6 +490,42 @@ class GcsServer:
             if node.node_id in pg.bundle_nodes and pg.state == "CREATED":
                 pg.state = "RESCHEDULING"
                 asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        # Drop the dead node from the object directory so striped pulls
+        # stop selecting it as a source.
+        for oid in [o for o, locs in self.object_locations.items()
+                    if node.node_id in locs]:
+            locs = self.object_locations[oid]
+            locs.discard(node.node_id)
+            if not locs:
+                del self.object_locations[oid]
+
+    # ----------------------------------------------------- object directory
+    async def rpc_object_locations_added(self, conn, body):
+        node_id = body["node_id"]
+        for oid in body["oids"]:
+            self.object_locations.setdefault(oid, set()).add(node_id)
+        return {"ok": True}
+
+    async def rpc_object_locations_removed(self, conn, body):
+        node_id = body["node_id"]
+        for oid in body["oids"]:
+            locs = self.object_locations.get(oid)
+            if locs is not None:
+                locs.discard(node_id)
+                if not locs:
+                    self.object_locations.pop(oid, None)
+        return {"ok": True}
+
+    async def rpc_get_object_locations(self, conn, body):
+        """Alive nodes believed to hold a sealed copy of oid (striped
+        pulls fan chunk ranges across these)."""
+        locs = self.object_locations.get(body["oid"], ())
+        alive = []
+        for nid in locs:
+            info = self.nodes.get(nid)
+            if info is not None and info.alive:
+                alive.append(nid)
+        return {"locations": alive}
 
     # ------------------------------------------------------------------- kv
     async def rpc_kv_put(self, conn, body):

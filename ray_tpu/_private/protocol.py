@@ -12,6 +12,8 @@ pickle).  Messages:
   REQ(id, method, body) -> REP(id, result) | ERR(id, exception)
   PUSH(method, body)                       (one-way notification)
   BATCH(frames)                            (coalesced burst of the above)
+  BLOB(id, method, header, raw bytes)      (request/notify w/ raw payload)
+  BLOB_REP(id, header, raw bytes)          (reply w/ raw payload)
 
 Hot-path design (the RPC fast path, see README "RPC fast path"):
 
@@ -32,8 +34,15 @@ Hot-path design (the RPC fast path, see README "RPC fast path"):
   frame (one header read + one write syscall for the whole burst);
   the worker's per-actor send queue uses it for pipelined submission.
 
-All payloads are pickled with protocol 5; large buffers never travel this
-plane (they go through the shared-memory object store, see shm_store.py).
+Raw-buffer frames (the object transfer plane, see README "Object
+transfer plane"): KIND_BLOB / KIND_BLOB_REP carry ``(method, small
+pickled header, raw payload)`` where the payload NEVER touches pickle —
+the sender hands the transport a single ``memoryview`` (e.g. an object
+store arena slice) and the receiver copies socket bytes straight into a
+destination buffer resolved BEFORE the body is read (a pre-registered
+reply sink, or the connection's ``blob_provider`` for inbound pushes).
+Cross-node object chunks ride these frames; everything else is pickled
+with protocol 5.
 """
 
 from __future__ import annotations
@@ -54,8 +63,16 @@ KIND_REP = 1
 KIND_ERR = 2
 KIND_PUSH = 3
 KIND_BATCH = 4
+KIND_BLOB = 5       # method + pickled header + raw payload (msg_id 0 = one-way)
+KIND_BLOB_REP = 6   # pickled header + raw payload into a registered sink
 
 _MLEN = struct.Struct("<H")  # method-name length (REQ/PUSH payload prefix)
+_HLEN = struct.Struct("<I")  # pickled-header length (BLOB/BLOB_REP prefix)
+
+# Raw blob bodies are consumed from the stream in bounded slices: one
+# memcpy from the socket buffer into the destination view, never a
+# whole-object intermediate allocation.
+_BLOB_IO_CHUNK = 1 << 20
 
 _PICKLE_PROTO = 5
 
@@ -183,6 +200,45 @@ def loads(data):
     return pickle.loads(data)
 
 
+class Blob:
+    """Handler return value carrying a raw payload: the reply rides a
+    KIND_BLOB_REP frame instead of a pickled KIND_REP, so ``data`` (any
+    buffer, typically an arena memoryview) is handed to the transport
+    as-is — no pickle, no staging copy.  ``on_sent`` fires once the
+    transport no longer references the buffer (used to drop object
+    store read pins)."""
+
+    __slots__ = ("header", "data", "on_sent")
+
+    def __init__(self, header, data, on_sent=None):
+        self.header = header
+        self.data = data
+        self.on_sent = on_sent
+
+    def release(self):
+        cb, self.on_sent = self.on_sent, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("blob on_sent callback failed")
+
+
+class BlobFrame:
+    """Inbound KIND_BLOB body handed to the handler.  ``data`` is the
+    raw payload bytes, or None when the connection's blob_provider
+    already routed the payload into its destination buffer (the
+    zero-staging-copy receive path); ``size`` is the raw byte count
+    either way."""
+
+    __slots__ = ("header", "data", "size")
+
+    def __init__(self, header, data, size):
+        self.header = header
+        self.data = data
+        self.size = size
+
+
 class Connection:
     """One bidirectional RPC connection.
 
@@ -191,14 +247,30 @@ class Connection:
     """
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
-                 handler=None, name: str = "?", on_close=None):
+                 handler=None, name: str = "?", on_close=None,
+                 blob_provider=None):
         self.reader = reader
         self.writer = writer
         self.handler = handler
         self.name = name
         self.on_close = on_close
+        # Synchronous (conn, method, header, nbytes) -> writable
+        # memoryview | None, consulted on the read loop BEFORE an
+        # inbound KIND_BLOB body is consumed so payload bytes land
+        # straight in their destination (e.g. the store arena).
+        self.blob_provider = blob_provider
         self._next_id = 1
         self._pending: dict[int, asyncio.Future] = {}
+        # msg_id -> writable memoryview awaiting a KIND_BLOB_REP; a
+        # timed-out/cancelled request MUST unregister its sink (a late
+        # reply would otherwise scribble on a recycled buffer) — late
+        # frames with no sink are drained and discarded.
+        self._blob_sinks: dict[int, memoryview] = {}
+        # Count of blob bodies CURRENTLY being read into a sink someone
+        # else owns (arena extents).  A transfer that aborts must wait
+        # for this to quiesce before freeing its extent, or the read
+        # loop could scribble on recycled memory (drain_sink_reads).
+        self._sink_reads = 0
         self._closed = False
         self._write_lock = asyncio.Lock()
         self._drain_task: asyncio.Task | None = None
@@ -215,14 +287,16 @@ class Connection:
 
     @classmethod
     async def connect(cls, host: str, port: int, handler=None, name: str = "?",
-                      on_close=None, timeout: float = 30.0):
+                      on_close=None, timeout: float = 30.0,
+                      blob_provider=None):
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port), timeout)
         sock = writer.get_extra_info("socket")
         if sock is not None:
             import socket as _s
             sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
-        return cls(reader, writer, handler=handler, name=name, on_close=on_close)
+        return cls(reader, writer, handler=handler, name=name,
+                   on_close=on_close, blob_provider=blob_provider)
 
     @property
     def closed(self):
@@ -233,6 +307,15 @@ class Connection:
             while True:
                 hdr = await self.reader.readexactly(_HDR.size)
                 plen, kind, msg_id = _HDR.unpack(hdr)
+                if kind == KIND_BLOB:
+                    # Raw-payload frames stream their body into a
+                    # resolved destination instead of materializing the
+                    # whole payload.
+                    await self._recv_blob(plen, msg_id)
+                    continue
+                if kind == KIND_BLOB_REP:
+                    await self._recv_blob_rep(plen, msg_id)
+                    continue
                 payload = await self.reader.readexactly(plen) if plen else b""
                 if kind == KIND_REQ:
                     self._dispatch_frame(msg_id, payload, False)
@@ -282,13 +365,100 @@ class Connection:
                 logger.error("unexpected kind %d inside batch on %s",
                              kind, self.name)
 
+    async def _read_into(self, sink, n: int):
+        """Consume n raw bytes off the stream into a writable view —
+        bounded slices, one memcpy each, no whole-body allocation."""
+        pos = 0
+        while pos < n:
+            data = await self.reader.readexactly(
+                min(n - pos, _BLOB_IO_CHUNK))
+            sink[pos:pos + len(data)] = data
+            pos += len(data)
+
+    async def _read_discard(self, n: int):
+        while n > 0:
+            data = await self.reader.readexactly(min(n, _BLOB_IO_CHUNK))
+            n -= len(data)
+
+    # Connections that carry blob traffic read the socket in 4 MiB
+    # slices instead of the transport's 256 KiB default — ~2x fewer
+    # loop iterations per transferred GB.  Only blob-carrying
+    # connections pay the bigger recv buffer, so small-RPC latency on
+    # the control plane is untouched.
+    _BLOB_READ_SIZE = 4 * 1024 * 1024
+
+    def _boost_read_size(self):
+        transport = getattr(self.reader, "_transport", None)
+        if transport is not None and hasattr(transport, "max_size") \
+                and transport.max_size < self._BLOB_READ_SIZE:
+            transport.max_size = self._BLOB_READ_SIZE
+
+    async def _recv_blob(self, plen: int, msg_id: int):
+        """Inbound KIND_BLOB: parse the method + pickled header, then
+        route the raw body straight into the buffer the blob_provider
+        resolves (or a scratch bytes when it declines), and dispatch
+        the handler with a BlobFrame body."""
+        self._boost_read_size()
+        r = self.reader
+        mlen, = _MLEN.unpack(await r.readexactly(_MLEN.size))
+        method = _intern_method(bytes(await r.readexactly(mlen)))
+        hlen, = _HLEN.unpack(await r.readexactly(_HLEN.size))
+        header = loads(await r.readexactly(hlen)) if hlen else None
+        nraw = plen - _MLEN.size - mlen - _HLEN.size - hlen
+        sink = None
+        if self.blob_provider is not None:
+            try:
+                sink = self.blob_provider(self, method, header, nraw)
+            except Exception:
+                logger.exception("blob_provider failed on %s", self.name)
+                sink = None
+        if sink is not None:
+            self._sink_reads += 1
+            try:
+                await self._read_into(sink, nraw)
+            finally:
+                self._sink_reads -= 1
+            data = None
+        elif nraw:
+            data = await r.readexactly(nraw)
+        else:
+            data = b""
+        self._dispatch_body(msg_id, method, BlobFrame(header, data, nraw),
+                            push=(msg_id == 0))
+
+    async def _recv_blob_rep(self, plen: int, msg_id: int):
+        """Inbound KIND_BLOB_REP: raw body goes into the sink the
+        requester registered (request_blob); replies whose sink is gone
+        (timed out, cancelled) are drained and dropped."""
+        self._boost_read_size()
+        r = self.reader
+        hlen, = _HLEN.unpack(await r.readexactly(_HLEN.size))
+        header = loads(await r.readexactly(hlen)) if hlen else None
+        nraw = plen - _HLEN.size - hlen
+        sink = self._blob_sinks.pop(msg_id, None)
+        fut = self._pending.pop(msg_id, None)
+        delivered = False
+        if nraw == 0:
+            delivered = True
+        elif sink is not None and fut is not None \
+                and not fut.done() and nraw <= len(sink):
+            self._sink_reads += 1
+            try:
+                await self._read_into(sink, nraw)
+            finally:
+                self._sink_reads -= 1
+            delivered = True
+        else:
+            await self._read_discard(nraw)
+        if fut is not None and not fut.done():
+            if delivered:
+                fut.set_result(header)
+            else:
+                fut.set_exception(RpcError(
+                    f"blob reply of {nraw} bytes had no usable sink"))
+
     def _dispatch_frame(self, msg_id: int, payload, push: bool):
-        """Serve one inbound REQ/PUSH.  The handler coroutine is stepped
-        inline on the read loop; only a handler that truly suspends is
-        handed to a task.  Inline-dispatch rule: a handler may run on
-        the read loop iff its synchronous prefix is non-blocking — all
-        rpc_* handlers satisfy this (blocking work rides executors,
-        which is itself an await and thus moves to the task path)."""
+        """Parse one inbound REQ/PUSH envelope and dispatch it."""
         try:
             mlen, = _MLEN.unpack_from(payload, 0)
             method = _intern_method(bytes(payload[2:2 + mlen]))
@@ -296,6 +466,15 @@ class Connection:
         except Exception:
             logger.exception("bad rpc payload on %s", self.name)
             return
+        self._dispatch_body(msg_id, method, body, push)
+
+    def _dispatch_body(self, msg_id: int, method: str, body, push: bool):
+        """Serve one inbound REQ/PUSH.  The handler coroutine is stepped
+        inline on the read loop; only a handler that truly suspends is
+        handed to a task.  Inline-dispatch rule: a handler may run on
+        the read loop iff its synchronous prefix is non-blocking — all
+        rpc_* handlers satisfy this (blocking work rides executors,
+        which is itself an await and thus moves to the task path)."""
         if self.handler is None:
             if not push:
                 self._reply_error(msg_id, RpcError(
@@ -342,6 +521,18 @@ class Connection:
             self._reply_result(msg_id, method, result)
 
     def _reply_result(self, msg_id: int, method: str, result):
+        if isinstance(result, Blob):
+            # _send_blob_nowait takes ownership of on_sent: it runs the
+            # callback (immediately, deferred, or on failure) exactly
+            # once in every path.
+            cb, result.on_sent = result.on_sent, None
+            try:
+                self._send_blob_nowait(KIND_BLOB_REP, msg_id, None,
+                                       result.header, result.data,
+                                       on_sent=cb)
+            except ConnectionLost:
+                pass
+            return
         try:
             payload = dumps(result)
         except Exception as e:
@@ -396,6 +587,76 @@ class Connection:
         if (transport is not None
                 and transport.get_write_buffer_size() > 1 << 20):
             self._ensure_drain()
+
+    def _send_blob_nowait(self, kind: int, msg_id: int, method: str | None,
+                          header, data, on_sent=None):
+        """Put one raw-payload frame on the wire.  The small parts
+        (frame header, method, pickled header) ride the coalescing
+        buffer; ``data`` is handed to the transport as ONE buffer — a
+        memoryview over the arena goes out without ever being copied
+        into a Python bytes.  Loop-thread only, same ordering rules as
+        _send_nowait."""
+        if self._closed:
+            if on_sent is not None:
+                on_sent()
+            raise ConnectionLost(
+                f"connection {self.name} closed"
+                + (f" ({self.close_reason})" if self.close_reason else ""))
+        try:
+            hp = dumps(header)
+        except Exception:
+            if on_sent is not None:
+                on_sent()
+            raise
+        pre = _envelope_prefix(method) if method is not None else b""
+        plen = len(pre) + _HLEN.size + len(hp) + len(data)
+        wbuf = self._wbuf
+        wbuf.append(_HDR.pack(plen, kind, msg_id))
+        if pre:
+            wbuf.append(pre)
+        wbuf.append(_HLEN.pack(len(hp)))
+        wbuf.append(hp)
+        self._flush_wbuf()  # everything queued before the raw body first
+        try:
+            self.writer.write(data)
+        except (ConnectionResetError, OSError) as e:
+            if on_sent is not None:
+                on_sent()
+            self.close_reason = self.close_reason or (
+                f"{type(e).__name__}: {e}")
+            raise ConnectionLost(str(e)) from e
+        transport = self.writer.transport
+        if on_sent is not None:
+            # py>=3.12 transports keep a REFERENCE to unsent buffers
+            # (no copy); the pin behind `data` may only drop once the
+            # transport no longer holds it.
+            if transport is None or transport.get_write_buffer_size() == 0:
+                on_sent()
+            else:
+                t = self._loop.create_task(self._call_when_flushed(on_sent))
+                t.add_done_callback(lambda t: t.cancelled() or t.exception())
+        if (transport is not None
+                and transport.get_write_buffer_size() > 1 << 20):
+            self._ensure_drain()
+
+    async def _call_when_flushed(self, cb):
+        """Run cb once the transport's write buffer has fully drained
+        (or the connection died — buffers are gone either way)."""
+        try:
+            while not self._closed:
+                transport = self.writer.transport
+                if transport is None \
+                        or transport.get_write_buffer_size() == 0:
+                    break
+                try:
+                    await self._drain()
+                except RpcError:
+                    break
+                if transport.get_write_buffer_size() == 0:
+                    break
+                await asyncio.sleep(0.005)
+        finally:
+            cb()
 
     def _flush_wbuf(self):
         self._wflush_scheduled = False
@@ -516,6 +777,73 @@ class Connection:
                 self._pending.pop(msg_id, None)
         return await fut
 
+    async def request_blob(self, method: str, body, sink,
+                           timeout: float | None = None):
+        """Send a pickled request whose reply arrives as a raw
+        KIND_BLOB_REP written DIRECTLY into ``sink`` (a writable
+        memoryview, e.g. an arena slice).  Returns the reply's small
+        pickled header; a handler that answers with a plain value (an
+        error dict) resolves the same future via the normal REP path.
+        On timeout/cancel the sink is unregistered before re-raising so
+        a late frame can never scribble on a recycled buffer."""
+        msg_id = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        self._blob_sinks[msg_id] = sink
+        try:
+            self._send_nowait(KIND_REQ, msg_id, dumps(body),
+                              prefix=_envelope_prefix(method))
+        except BaseException:
+            self._pending.pop(msg_id, None)
+            self._blob_sinks.pop(msg_id, None)
+            raise
+        await self.backpressure()
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(msg_id, None)
+            self._blob_sinks.pop(msg_id, None)
+
+    async def blob_request(self, method: str, header, data,
+                           timeout: float | None = None):
+        """Send a raw-payload request (KIND_BLOB) — ``data`` rides the
+        wire as one memoryview handoff, never pickled — and await the
+        handler's (small, pickled) reply."""
+        msg_id = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            self._send_blob_nowait(KIND_BLOB, msg_id, method, header, data)
+        except BaseException:
+            self._pending.pop(msg_id, None)
+            raise
+        await self.backpressure()
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def blob_push(self, method: str, header, data):
+        """One-way raw-payload frame (no reply expected)."""
+        self._send_blob_nowait(KIND_BLOB, 0, method, header, data)
+        await self.backpressure()
+
+    async def drain_sink_reads(self, timeout: float = 30.0):
+        """Wait until no blob body is mid-read into a caller-owned sink
+        on this connection.  An aborting transfer calls this BEFORE
+        freeing its destination extent; bounded because a read either
+        progresses or the connection dies."""
+        deadline = time.monotonic() + timeout
+        while self._sink_reads and not self._closed \
+                and time.monotonic() < deadline:
+            await asyncio.sleep(0.002)
+
     async def push(self, method: str, body=None):
         await self._send(KIND_PUSH, 0, dumps(body),
                          prefix=_envelope_prefix(method))
@@ -536,6 +864,7 @@ class Connection:
             if not fut.done():
                 fut.set_exception(exc)
         self._pending.clear()
+        self._blob_sinks.clear()
         try:
             self.writer.close()
         except Exception:
@@ -558,12 +887,13 @@ class RpcServer:
     """Listens for connections; each served by ``handler(conn, method, body)``."""
 
     def __init__(self, handler, host: str = "127.0.0.1", name: str = "server",
-                 on_connect=None, on_disconnect=None):
+                 on_connect=None, on_disconnect=None, blob_provider=None):
         self.handler = handler
         self.host = host
         self.name = name
         self.on_connect = on_connect
         self.on_disconnect = on_disconnect
+        self.blob_provider = blob_provider
         self.port: int | None = None
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
@@ -579,7 +909,8 @@ class RpcServer:
             import socket as _s
             sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
         conn = Connection(reader, writer, handler=self.handler,
-                          name=f"{self.name}-peer", on_close=self._on_conn_close)
+                          name=f"{self.name}-peer", on_close=self._on_conn_close,
+                          blob_provider=self.blob_provider)
         self.connections.add(conn)
         if self.on_connect is not None:
             res = self.on_connect(conn)

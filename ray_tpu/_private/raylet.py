@@ -34,6 +34,7 @@ from ray_tpu._private import protocol
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.shm_store import StoreServer, StoreMapping, default_store_path
+from ray_tpu._private.transfer import TransferManager, _remain
 
 logger = logging.getLogger(__name__)
 
@@ -165,7 +166,8 @@ class Raylet:
             i: 0.0 for i in range(int(resources.get("TPU", 0)))}
         self.labels = labels or {}
         self.server = protocol.RpcServer(self._handle, host=host, name="raylet",
-                                         on_disconnect=self._on_conn_lost)
+                                         on_disconnect=self._on_conn_lost,
+                                         blob_provider=self._blob_sink)
         self.gcs: protocol.Connection | None = None
         self.port = None
         store_capacity = store_capacity or cfg.object_store_memory_bytes
@@ -207,9 +209,20 @@ class Raylet:
         # per-instance pull dedup (a class attribute would be shared across
         # the in-process multi-raylet test Cluster)
         self._pulls_inflight: dict = {}
-        # In-flight push receives: oid -> {"off": arena offset,
-        # "sender": id(sender conn), "last": last-chunk ts, "received": bytes}
+        # In-flight push receives: oid -> {"off": arena offset, "size",
+        # "sender": id(sender conn), "gen": transfer generation,
+        # "last": last-chunk ts, "received": bytes}
         self._push_recv: dict = {}
+        self._push_gen = 0  # generation minted per os_push_begin
+        # Windowed pull/push engine (admission, striping, retries).
+        self.transfers = TransferManager(self)
+        # Spill-file read fds kept open across a transfer's chunks:
+        # oid -> [fd, last_used, inflight_reads, eof_seen]
+        self._spill_read_fds: dict[bytes, list] = {}
+        # Oids this node has reported to the GCS object directory, so
+        # removal reports fire only for entries that actually exist
+        # there (sub-stripe objects are never reported at all).
+        self._reported_locs: set[bytes] = set()
         # pins held on behalf of each client conn: id(conn) -> {oid: count}
         self._client_pins: dict[int, dict[bytes, int]] = {}
         # unsealed creates per client conn (freed if the client dies
@@ -306,6 +319,7 @@ class Raylet:
                     self._respill_pending(view)
                 elif msg["event"] == "removed":
                     self.cluster_nodes.pop(msg["node_id"], None)
+                    self.transfers.drop_peer(msg["node_id"])
                     conn2 = self.peer_conns.pop(msg["node_id"], None)
                     if conn2 is not None:
                         await conn2.close()
@@ -1515,13 +1529,51 @@ class Raylet:
         for fut in self.seal_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(None)
+        self._report_sealed(oid)
         return {"ok": True}
+
+    def _report_sealed(self, oid: bytes):
+        """Report a fresh sealed copy to the GCS object directory —
+        only when it is big enough to ever stripe: the directory's sole
+        consumer is multi-source pull selection, and sub-threshold
+        objects would just accrete entries the C store can LRU-evict
+        without telling anyone."""
+        got = self.store.get(oid)
+        if got is None:
+            return
+        self.store.release(oid)
+        if got[1] >= cfg.transfer_stripe_min_bytes:
+            self._reported_locs.add(oid)
+            self._report_locations([oid], added=True)
+
+    def _report_locations(self, oids, added: bool):
+        """Fire-and-forget report of sealed copies appearing/vanishing
+        on this node to the GCS object directory (the striped-pull
+        source list).  Best-effort: a lost report only costs a pull its
+        extra sources, and stat-at-pull filters stale entries."""
+        if self.gcs is None or self.gcs.closed or self._shutdown:
+            return
+        method = ("object_locations_added" if added
+                  else "object_locations_removed")
+        try:
+            task = asyncio.get_running_loop().create_task(
+                self.gcs.push(method, {"node_id": self.node_id,
+                                       "oids": list(oids)}))
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
+        except Exception:
+            pass
 
     async def rpc_os_get(self, conn, body):
         """Resolve objects to (offset, size) in the local arena, pulling from
-        remote nodes when needed (locations provided by owners)."""
+        remote nodes when needed (locations provided by owners).  The
+        client's timeout becomes ONE deadline for the whole resolution —
+        every wait and every pulled chunk draws from the same budget
+        (previously each chunk request was re-granted the full timeout,
+        so a transfer could legally take timeout x n_chunks)."""
         oid = body["oid"]
         timeout = body.get("timeout", 60.0)
+        deadline = time.monotonic() + timeout
         location = body.get("location")  # NodeID where the object lives
         if oid in self.spilled and not self.store.contains(oid):
             await self._restore_spilled(oid)
@@ -1531,7 +1583,7 @@ class Raylet:
             if sealed:
                 self._track_pin(conn, oid)
                 return {"offset": offset, "size": size}
-            await self._wait_sealed(oid, timeout)
+            await self._wait_sealed(oid, self._remaining(deadline))
             got = self.store.get(oid)
             if got and got[2]:
                 # Keep the re-get's pin and track it: the client's later
@@ -1539,22 +1591,37 @@ class Raylet:
                 # the creator's.
                 self._track_pin(conn, oid)
                 return {"offset": got[0], "size": got[1]}
-            return {"error": "timeout waiting for object seal"}
+            # "timeout": the caller's budget ran out, the object still
+            # exists — the worker maps this to GetTimeoutError, never to
+            # an ObjectLostError that would trigger reconstruction.
+            return {"error": "timeout waiting for object seal",
+                    "timeout": True}
         if location is not None and location != self.node_id:
-            ok = await self._pull_object(oid, location, timeout)
+            ok = await self._pull_object(oid, location, deadline)
             if not ok:
+                if time.monotonic() >= deadline:
+                    return {"error": f"pull deadline exceeded fetching "
+                                     f"{oid.hex()}", "timeout": True}
                 return {"error": f"failed to pull {oid.hex()} from "
                                  f"{location.hex()[:8]}"}
             got = self.store.get(oid)
             if got and got[2]:
                 self._track_pin(conn, oid)
                 return {"offset": got[0], "size": got[1]}
-        await self._wait_sealed(oid, timeout)
+        await self._wait_sealed(oid, self._remaining(deadline))
         got = self.store.get(oid)
         if got and got[2]:
             self._track_pin(conn, oid)
             return {"offset": got[0], "size": got[1]}
+        # NOT flagged as a timeout even when the budget is spent: with no
+        # pullable location and nothing sealed locally the object may be
+        # genuinely gone, and ObjectLostError is what lets the owner fall
+        # back to lineage reconstruction.
         return {"error": f"object {oid.hex()} not found"}
+
+    # One deadline clamp for the whole transfer plane (shared with
+    # TransferManager so the floor/None semantics can't diverge).
+    _remaining = staticmethod(_remain)
 
     async def _wait_sealed(self, oid, timeout):
         fut = asyncio.get_running_loop().create_future()
@@ -1578,23 +1645,25 @@ class Raylet:
         try:
             conn = await protocol.Connection.connect(
                 view["addr"][0], view["addr"][1], handler=self._handle,
-                name="raylet-peer", timeout=cfg.connect_timeout_s)
+                name="raylet-peer", timeout=cfg.connect_timeout_s,
+                blob_provider=self._blob_sink)
         except Exception:
             return None
         self.peer_conns[node_id] = conn
         return conn
 
-    async def _pull_object(self, oid, location, timeout) -> bool:
+    async def _pull_object(self, oid, location, deadline) -> bool:
         if oid in self._pulls_inflight:
             try:
                 return await asyncio.wait_for(
-                    asyncio.shield(self._pulls_inflight[oid]), timeout)
+                    asyncio.shield(self._pulls_inflight[oid]),
+                    self._remaining(deadline))
             except asyncio.TimeoutError:
                 return False
         fut = asyncio.get_running_loop().create_future()
         self._pulls_inflight[oid] = fut
         try:
-            ok = await self._do_pull(oid, location, timeout)
+            ok = await self._do_pull(oid, location, deadline)
             if not fut.done():
                 fut.set_result(ok)
             return ok
@@ -1606,57 +1675,24 @@ class Raylet:
         finally:
             self._pulls_inflight.pop(oid, None)
 
-    async def _do_pull(self, oid, location, timeout) -> bool:
+    async def _do_pull(self, oid, location, deadline) -> bool:
         if oid in self._push_recv:
             # A push of this object is already streaming in: wait for its
             # seal instead of double-allocating.  If the pushing sender
             # dies, _abort_pushes_from (conn loss) or the stale sweep
             # cleans the transfer and wakes us to fall through to a pull.
-            await self._wait_sealed(oid, timeout)
+            await self._wait_sealed(oid, self._remaining(deadline))
             got = self.store.get(oid)
             if got is not None and got[2]:
                 self.store.release(oid)  # get() pinned the sealed copy
                 return True
             if oid in self._push_recv:
-                # Push stream still live after the full timeout: it owns
+                # Push stream still live after the full deadline: it owns
                 # the allocation, so a pull can't proceed.
                 return False
-        peer = await self._peer(location)
-        if peer is None:
-            return False
-        meta = await peer.request("os_stat", {"oid": oid}, timeout=timeout)
-        if meta.get("error"):
-            return False
-        size = meta["size"]
-        try:
-            off = await self._alloc_with_spill(oid, size)
-        except KeyError:
-            # oid already has an allocation: a concurrent pull/push sealed
-            # (or is sealing) it.  Only a SEALED copy counts as success —
-            # an unsealed residue means the transfer died and this pull
-            # cannot recover it (the owner will retry).
-            got = self.store.get(oid)
-            if got is not None and got[2]:
-                self.store.release(oid)
-                return True
-            return False
-        if off is None:
-            return False
-        dest = self.mapping.slice(off, size)
-        chunk = cfg.fetch_chunk_bytes
-        pos = 0
-        while pos < size:
-            n = min(chunk, size - pos)
-            data = await peer.request("os_read_chunk",
-                                      {"oid": oid, "offset": pos, "len": n},
-                                      timeout=timeout)
-            if data.get("error"):
-                self._discard_unsealed(oid)
-                return False
-            dest[pos:pos + n] = data["data"]
-            pos += n
-        self._seal_release_notify(oid)
-        return True
+        # Windowed, possibly striped transfer (TransferManager resolves
+        # extra sealed sources via the GCS object directory).
+        return await self.transfers.pull(oid, location, deadline)
 
     async def rpc_os_stat(self, conn, body):
         oid = body["oid"]
@@ -1665,36 +1701,125 @@ class Raylet:
             spilled = self.spilled.get(oid)
             if spilled is not None:
                 return {"size": spilled[1]}
+            if oid in self._reported_locs:
+                # Directory self-heal: this node once advertised a copy
+                # the C store has since LRU-evicted (eviction has no
+                # Python hook).  The first wasted stat prunes the stale
+                # entry so later pulls stop selecting this node.
+                self._reported_locs.discard(oid)
+                self._report_locations([oid], added=False)
             return {"error": "not here"}
         self.store.release(oid)
         return {"size": got[1]}
 
-    async def rpc_os_read_chunk(self, conn, body):
+    async def rpc_os_map(self, conn, body):
+        """Same-host zero-copy pull support: pin the sealed object and
+        expose its arena location so a co-located raylet can mmap this
+        node's arena file read-only and memcpy the bytes directly
+        (reference: plasma clients share the store mmap; here each
+        raylet owns an arena, so cross-raylet same-host reads map the
+        peer's file).  The caller MUST os_release when the copy is done
+        (conn loss releases tracked pins as usual)."""
         oid = body["oid"]
+        got = self.store.get(oid)
+        if got is None or not got[2]:
+            return {"error": "not here"}  # spilled/unsealed: wire path
+        offset, size, _ = got
+        self._track_pin(conn, oid)
+        return {"offset": offset, "size": size,
+                "store_path": self.store_path,
+                "capacity": self.store_capacity}
+
+    async def rpc_os_read_chunk(self, conn, body):
+        """Serve one chunk of a sealed (or spilled) object.  The reply
+        rides a raw KIND_BLOB_REP frame: the arena slice goes to the
+        transport as ONE memoryview (the read pin is dropped once the
+        transport no longer references it) — chunk bytes never touch
+        pickle.  ``body["pickle"]`` selects the legacy pickled-dict
+        reply for old-style sequential readers (and the bench's
+        stop-and-wait baseline)."""
+        oid = body["oid"]
+        legacy = body.get("pickle", False)
         got = self.store.get(oid)
         if got is None or not got[2]:
             spilled = self.spilled.get(oid)
             if spilled is not None:
                 # Serve peer pulls straight from the spill file — no need
-                # to churn the arena for a pass-through transfer.
+                # to churn the arena for a pass-through transfer.  One fd
+                # per in-progress transfer, positional reads (pread), so
+                # concurrent windowed chunks don't reopen the file or
+                # race a shared seek offset.
                 path, size = spilled
                 start = body["offset"]
                 n = min(body["len"], size - start)
-                loop = asyncio.get_running_loop()
-
-                def _read():
-                    with open(path, "rb") as f:
-                        f.seek(start)
-                        return f.read(n)
-
-                return {"data": await loop.run_in_executor(None, _read)}
+                ent = self._spill_fd_acquire(oid, path)
+                if ent is None:
+                    return {"error": "spill file unavailable"}
+                try:
+                    data = await asyncio.get_running_loop().run_in_executor(
+                        None, os.pread, ent[0], n, start)
+                except OSError as e:
+                    return {"error": f"spill read failed: {e}"}
+                finally:
+                    self._spill_fd_release(oid, ent,
+                                           eof=start + n >= size)
+                if legacy:
+                    return {"data": data}
+                return protocol.Blob({"len": len(data)}, data)
             return {"error": "not here"}
         offset, size, _ = got
         start = body["offset"]
         n = min(body["len"], size - start)
-        data = bytes(self.mapping.slice(offset + start, n))
-        self.store.release(oid)
-        return {"data": data}
+        if legacy:
+            data = bytes(self.mapping.slice(offset + start, n))
+            self.store.release(oid)
+            return {"data": data}
+        return protocol.Blob(
+            {"len": n}, self.mapping.slice(offset + start, n),
+            on_sent=lambda: self.store.release(oid))
+
+    # One open fd serves every chunk of an in-progress spilled-object
+    # transfer (the old path reopened the file PER CHUNK); closed when
+    # the last chunk has been read out or by the stale sweep.
+    def _spill_fd_acquire(self, oid: bytes, path: str):
+        ent = self._spill_read_fds.get(oid)
+        if ent is None:
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except OSError:
+                return None
+            ent = self._spill_read_fds[oid] = \
+                [fd, time.monotonic(), 0, False]
+        ent[1] = time.monotonic()
+        ent[2] += 1
+        return ent
+
+    def _spill_fd_release(self, oid: bytes, ent, eof: bool):
+        ent[2] -= 1
+        if eof:
+            ent[3] = True
+        if ent[3] and ent[2] <= 0 \
+                and self._spill_read_fds.get(oid) is ent:
+            self._close_spill_fd(oid)
+
+    def _retire_spill_fd(self, oid: bytes):
+        """Close the cached spill fd — unless executor-thread preads are
+        still in flight, in which case mark it close-on-last-read:
+        closing under a reader would let a reused fd number serve bytes
+        of some unrelated file as chunk data."""
+        ent = self._spill_read_fds.get(oid)
+        if ent is not None and ent[2] > 0:
+            ent[3] = True  # the final _spill_fd_release closes it
+        else:
+            self._close_spill_fd(oid)
+
+    def _close_spill_fd(self, oid: bytes):
+        ent = self._spill_read_fds.pop(oid, None)
+        if ent is not None:
+            try:
+                os.close(ent[0])
+            except OSError:
+                pass
 
     def _track_pin(self, conn, oid: bytes):
         pins = self._client_pins.setdefault(id(conn), {})
@@ -1734,12 +1859,19 @@ class Raylet:
             # deferred forever and a put/delete loop leaks the arena dry.
             self.store.release(oid)
         self._created_sizes.pop(oid, None)
+        self._retire_spill_fd(oid)
         spilled = self.spilled.pop(oid, None)
         if spilled is not None:
             try:
                 os.remove(spilled[0])
             except OSError:
                 pass
+        # Only objects actually in the directory need a removal report —
+        # the common sub-stripe object was never added, and a push per
+        # GC'd oid would tax the hot release path for nothing.
+        if oid in self._reported_locs:
+            self._reported_locs.discard(oid)
+            self._report_locations([oid], added=False)
         return {"ok": True}
 
     async def rpc_os_contains(self, conn, body):
@@ -1754,12 +1886,14 @@ class Raylet:
     def _seal_release_notify(self, oid):
         """Seal a transferred-in copy, drop the creator pin, and wake
         seal waiters (shared by the pull, restore, and push receive
-        paths)."""
+        paths).  The new sealed copy is reported to the GCS object
+        directory so later pulls can stripe across it."""
         self.store.seal(oid)
         self.store.release(oid)
         for fut in self.seal_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(None)
+        self._report_sealed(oid)
 
     async def rpc_os_push_to(self, conn, body):
         """Replicate a local sealed object to peer raylets (targets are
@@ -1767,117 +1901,138 @@ class Raylet:
         serialize the broadcast."""
         oid = body["oid"]
         results = await asyncio.gather(
-            *(self._push_object(oid, node_id)
+            *(self.transfers.push(oid, node_id)
               for node_id in body["targets"]))
         pushed, failed = [], []
         for node_id, ok in zip(body["targets"], results):
             (pushed if ok else failed).append(node_id.hex())
         return {"pushed": pushed, "failed": failed}
 
-    async def _push_object(self, oid, target_node_id) -> bool:
-        got = self.store.get(oid)  # pins while we stream
-        if got is None:
-            # Spilled locally? Restore, then stream (the pull path
-            # serves spilled objects too).
-            if oid in self.spilled and await self._restore_spilled(oid):
-                got = self.store.get(oid)
-            if got is None:
-                return False
-        offset, size, sealed = got
-        if not sealed:
-            self.store.release(oid)
-            return False
-        try:
-            peer = await self._peer(target_node_id)
-            if peer is None:
-                return False
-            chunk = cfg.fetch_chunk_bytes
-            pos = 0
-            while pos < size:
-                n = min(chunk, size - pos)
-                data = bytes(self.mapping.slice(offset + pos, n))
-                reply = await peer.request(
-                    "os_push", {"oid": oid, "size": size,
-                                "offset": pos, "data": data},
-                    timeout=60)
-                if reply.get("skip"):
-                    return True  # receiver already has/is getting it
-                if reply.get("error"):
-                    return False
-                pos += n
-            return True
-        except Exception as e:
-            logger.warning("push %s to %s failed: %s", oid.hex()[:8],
-                           target_node_id, e)
-            return False
-        finally:
-            self.store.release(oid)
-
     def _sweep_stale_pushes(self, now):
-        """Drop transfers with no chunk activity for >120s (sender died
-        mid-stream) so their unsealed allocations don't leak the arena.
-        Staleness is measured from the LAST chunk, so a legitimately slow
-        large push is never swept while it is still making progress.  Waiters
-        are woken (they re-check the store and fall back to a pull or a
-        timeout error instead of hanging out their full timeout)."""
+        """Drop transfers with no chunk activity for more than
+        cfg.push_stale_sweep_s (sender died mid-stream) so their
+        unsealed allocations don't leak the arena, and close spill-read
+        fds idle past the same threshold.  Staleness is measured from
+        the LAST chunk, so a legitimately slow large push is never swept
+        while it is still making progress.  Waiters are woken (they
+        re-check the store and fall back to a pull or a timeout error
+        instead of hanging out their full timeout)."""
+        stale_s = cfg.push_stale_sweep_s
         for stale, ent in list(self._push_recv.items()):
-            if now - ent["last"] > 120:
+            if now - ent["last"] > stale_s:
+                conn = ent.get("conn")
+                if conn is not None and conn._sink_reads:
+                    # A chunk body is mid-read into this extent right
+                    # now: not stale, and freeing it would corrupt the
+                    # write.  Fresh grace period.
+                    ent["last"] = now
+                    continue
                 self._push_recv.pop(stale, None)
                 self._discard_unsealed(stale)
                 for fut in self.seal_waiters.pop(stale, []):
                     if not fut.done():
                         fut.set_result(None)
+        for oid, fent in list(self._spill_read_fds.items()):
+            if fent[2] <= 0 and now - fent[1] > stale_s:
+                self._close_spill_fd(oid)
 
-    async def rpc_os_push(self, conn, body):
-        """Receive one pushed chunk: allocate on the first, seal once every
-        byte has arrived.  Each transfer is owned by the sender connection
-        that opened it — a concurrent push of the same oid from a second
-        sender is answered {skip} rather than clobbering the live transfer
-        (reference: PushManager dedups pushes per (object, node))."""
+    async def rpc_os_push_begin(self, conn, body):
+        """Open one inbound push transfer: allocate the destination
+        extent and register the transfer under the sender connection.
+        Subsequent os_push chunk frames from that connection land
+        straight in the allocation (see _blob_sink).  A concurrent push
+        of the same oid from a second sender is answered {skip} rather
+        than clobbering the live transfer (reference: PushManager dedups
+        pushes per (object, node))."""
         oid, size = body["oid"], body["size"]
         now = time.monotonic()
         sender = id(conn)
-        if body["offset"] == 0:
-            self._sweep_stale_pushes(now)
-            ent = self._push_recv.get(oid)
-            if ent is not None:
-                if ent["sender"] != sender:
-                    # A live transfer from another sender owns this oid.
-                    return {"skip": True}
-                # Same sender restarting its own stream: start clean.
-                self._push_recv.pop(oid, None)
-                self._discard_unsealed(oid)
-            elif self.store.contains(oid) \
-                    or oid in self._pulls_inflight:
-                return {"skip": True}
-            try:
-                off = await self._alloc_with_spill(oid, size)
-            except KeyError:
-                return {"skip": True}  # concurrent pull/push won
-            if off is None:
-                return {"error": "object store OOM receiving push"}
-            self._push_recv[oid] = {"off": off, "sender": sender,
-                                    "last": now, "received": 0}
-            ent = self._push_recv[oid]
-        else:
-            ent = self._push_recv.get(oid)
-            if ent is None:
-                return {"error": "push chunk without a first chunk"}
+        self._sweep_stale_pushes(now)
+        ent = self._push_recv.get(oid)
+        if ent is not None:
             if ent["sender"] != sender:
-                return {"skip": True}  # not this transfer's owner
-            ent["last"] = now
-        off = ent["off"]
-        data = body["data"]
-        dest = self.mapping.slice(off, size)
-        dest[body["offset"]:body["offset"] + len(data)] = data
-        ent["received"] += len(data)
-        if ent["received"] >= size:
+                # A live transfer from another sender owns this oid.
+                return {"skip": True}
+            # Same sender restarting its own stream: start clean.
+            self._push_recv.pop(oid, None)
+            self._discard_unsealed(oid)
+        elif self.store.contains(oid) or oid in self._pulls_inflight:
+            return {"skip": True}
+        try:
+            off = await self._alloc_with_spill(oid, size)
+        except KeyError:
+            return {"skip": True}  # concurrent pull/push won
+        if off is None:
+            return {"error": "object store OOM receiving push"}
+        # Each transfer gets its own generation, echoed back in every
+        # chunk header: a same-sender restart pops the old entry, but
+        # its already-in-flight chunks must NOT count toward the new
+        # transfer's "received" (they may duplicate offsets the new
+        # stream will resend, sealing an object with unwritten holes).
+        self._push_gen += 1
+        gen = self._push_gen
+        self._push_recv[oid] = {"off": off, "size": size, "sender": sender,
+                                "gen": gen, "conn": conn, "last": now,
+                                "received": 0}
+        return {"ok": True, "gen": gen}
+
+    def _blob_sink(self, conn, method, header, nbytes):
+        """Blob-frame sink resolution (runs synchronously on the read
+        loop BEFORE the payload is consumed): inbound os_push chunk
+        bytes are written straight into the arena extent their transfer
+        allocated in os_push_begin — no staging buffer, no pickle.
+        Returns None (frame buffered normally) for anything that isn't
+        a live, in-range chunk of a transfer owned by this sender."""
+        if method != "os_push" or not isinstance(header, dict):
+            return None
+        ent = self._push_recv.get(header.get("oid"))
+        if ent is None or ent["sender"] != id(conn) \
+                or ent["gen"] != header.get("gen"):
+            return None
+        pos, n = header.get("offset", -1), header.get("len", -1)
+        if n != nbytes or pos < 0 or pos + n > ent["size"]:
+            return None
+        return self.mapping.writable(ent["off"] + pos, n)
+
+    async def rpc_os_push(self, conn, body):
+        """Account one pushed chunk (its bytes were already routed into
+        the arena by _blob_sink while the frame was being read); seal
+        once every byte has arrived.  ``body`` is a protocol.BlobFrame —
+        body.data is None on the fast path, or carries the raw bytes
+        when the sink was declined (transfer swept/superseded between
+        frames, or an out-of-range header)."""
+        hdr = body.header
+        oid = hdr["oid"]
+        ent = self._push_recv.get(oid)
+        if ent is None or ent["sender"] != id(conn) \
+                or ent["gen"] != hdr.get("gen"):
+            # Transfer swept as stale, superseded by a restart, or never
+            # opened: these bytes were NOT kept.  An explicit error (not
+            # a silent ok/skip) so the sender doesn't report a replica
+            # on a node that discarded the data.
+            return {"error": "push transfer not live"}
+        ent["last"] = time.monotonic()
+        if body.data is not None:
+            # Declined sink with a live entry: validate and fall back to
+            # an explicit copy into the extent.
+            pos, n = hdr.get("offset", -1), hdr.get("len", -1)
+            if n != len(body.data) or pos < 0 or pos + n > ent["size"]:
+                return {"error": "push chunk out of range"}
+            dest = self.mapping.writable(ent["off"], ent["size"])
+            dest[pos:pos + n] = body.data
+        ent["received"] += hdr["len"]
+        if ent["received"] >= ent["size"]:
             self._push_recv.pop(oid, None)
             self._seal_release_notify(oid)
         return {"ok": True}
 
     async def rpc_os_used(self, conn, body):
         return {"used": self.store.used(), "capacity": self.store_capacity}
+
+    async def rpc_transfer_stats(self, conn, body):
+        """Transfer-plane counters (pull/push volumes, striping,
+        retries) for tests and observability."""
+        return dict(self.transfers.stats)
 
     # ------------------------------------------------------ state API feeds
     async def rpc_pool_stats(self, conn, body):
@@ -1939,9 +2094,19 @@ class Raylet:
         self._last_hw_report = 0.0
         self._sync_version = 0
         self._gcs_acked_version = -1
+        last_sweep = 0.0
         while not self._shutdown:
             await asyncio.sleep(report_period)
             try:
+                # Periodic transfer-plane sweep: a node that only SERVES
+                # pulls never receives os_push_begin (the other sweep
+                # trigger), so without this tick its aborted transfers'
+                # cached spill-read fds and stale push extents would
+                # leak until shutdown.
+                tick = time.monotonic()
+                if tick - last_sweep >= min(30.0, cfg.push_stale_sweep_s):
+                    last_sweep = tick
+                    self._sweep_stale_pushes(tick)
                 report = (dict(self.available), self._load(),
                           [dict(p["resources"])
                            for p in self.pending_leases[:32]])
@@ -2071,6 +2236,9 @@ class Raylet:
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()
+        for oid in list(self._spill_read_fds):
+            self._close_spill_fd(oid)
+        self.transfers.close()
         self.mapping.close()
         self.store.close()
 

@@ -167,16 +167,30 @@ class StoreServer:
 
 
 class StoreMapping:
-    """Client-side mmap of the node's arena file (zero-copy data plane)."""
+    """Client-side mmap of the node's arena file (zero-copy data plane).
 
-    def __init__(self, path: str, capacity: int):
+    ``readonly=True`` maps a PEER raylet's arena for the same-host
+    zero-copy pull fast path — reads only, the peer stays the metadata
+    authority and the reader must hold a remote pin for the duration."""
+
+    def __init__(self, path: str, capacity: int, readonly: bool = False):
         self.path = path
         self.capacity = capacity
-        self._fd = os.open(path, os.O_RDWR)
-        self._mmap = mmap.mmap(self._fd, capacity)
+        self._fd = os.open(path, os.O_RDONLY if readonly else os.O_RDWR)
+        self._mmap = mmap.mmap(
+            self._fd, capacity,
+            access=mmap.ACCESS_READ if readonly else mmap.ACCESS_WRITE)
         self.view = memoryview(self._mmap)
 
     def slice(self, offset: int, size: int) -> memoryview:
+        return self.view[offset:offset + size]
+
+    def writable(self, offset: int, size: int) -> memoryview:
+        """Writable view of an UNSEALED allocation for in-place receive:
+        the transfer plane copies socket bytes straight into this view
+        (protocol blob frames), relying on the alloc-time creator pin to
+        keep the extent stable until seal/abort.  Never hand one out for
+        a sealed object — readers may hold zero-copy views of it."""
         return self.view[offset:offset + size]
 
     def close(self):

@@ -1,0 +1,505 @@
+"""Node-to-node object transfer plane: windowed, multi-source, zero-pickle.
+
+The data-plane twin of the RPC fast path (reference:
+src/ray/object_manager/pull_manager.h and push_manager.h — the object
+manager keeps a sliding window of chunk requests in flight per transfer
+and admits transfers against byte budgets).  ``TransferManager`` owns
+admission, retries, and cancellation for both directions:
+
+* **Pulls** — ``pull()`` resolves candidate sources (the owner's hinted
+  location plus any sealed copies the GCS object directory knows of),
+  allocates the destination extent once, then keeps
+  ``cfg.transfer_window_chunks`` chunk requests in flight.  Chunk bytes
+  ride raw KIND_BLOB_REP frames straight into the arena mapping
+  (protocol.request_blob) — no pickle, no staging copy.  With 2+ sealed
+  sources and a large enough object, chunk ranges stripe round-robin
+  across peers; a peer that dies or errors mid-transfer is dropped and
+  its chunks are reissued to the survivors.
+* **Pushes** — ``push()`` opens the transfer with ``os_push_begin``
+  (receiver allocates; dedup against live transfers/pulls), then
+  streams chunks as KIND_BLOB frames from the arena mapping — one
+  memoryview handoff per chunk — with the same window.
+* **Admission** — a per-peer in-flight byte cap
+  (``cfg.transfer_inflight_bytes_per_peer``) across ALL transfers in
+  both directions, so N concurrent pulls can't buffer-bloat one
+  receiver.  At least one chunk per peer is always admitted so a chunk
+  larger than the cap still makes progress.
+
+Deadline semantics: a pull gets ONE deadline for the whole transfer
+(plumbed down from the caller's ``ray.get`` timeout) — not a fresh
+timeout per chunk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+logger = logging.getLogger(__name__)
+
+
+def _remain(deadline):
+    if deadline is None:
+        return None
+    return max(0.001, deadline - time.monotonic())
+
+
+def _stepped_copy(dest, src, size, step=8 * 1024 * 1024):
+    for pos in range(0, size, step):
+        n = min(step, size - pos)
+        dest[pos:pos + n] = src[pos:pos + n]
+
+
+class TransferManager:
+    """Windowed object transfers for one raylet (both directions)."""
+
+    def __init__(self, raylet):
+        self.raylet = raylet
+        # Per-peer bytes currently on the wire (both directions), plus
+        # FIFO waiters blocked on the cap.
+        self._peer_inflight: dict = {}
+        self._peer_waiters: dict = {}
+        # Read-only mmaps of co-located peers' arena files (the
+        # same-host zero-copy fast path); dropped with the peer.
+        self._peer_arenas: dict = {}
+        # Peers probed and found NOT co-located: skip the os_map RPC
+        # (plus its remote pin churn) on every later pull.  Cleared
+        # with drop_peer — a node id never moves hosts while alive.
+        self._peer_no_arena: set = set()
+        self.stats = {"pulls": 0, "pull_bytes": 0, "pull_chunks": 0,
+                      "striped_pulls": 0, "chunk_retries": 0,
+                      "mmap_pulls": 0, "pushes": 0, "push_bytes": 0}
+
+    def drop_peer(self, node_id):
+        arena = self._peer_arenas.pop(node_id, None)
+        if arena is not None:
+            arena.close()
+        self._peer_no_arena.discard(node_id)
+
+    def close(self):
+        for node_id in list(self._peer_arenas):
+            self.drop_peer(node_id)
+
+    # ------------------------------------------------------------ admission
+    async def _acquire_peer(self, node_id, n: int, deadline):
+        """Block until n more bytes may be in flight to/from node_id.
+        Always admits when the peer is idle, so one chunk larger than
+        the cap can still move."""
+        cap = max(1, cfg.transfer_inflight_bytes_per_peer)
+        while self._peer_inflight.get(node_id, 0) > 0 \
+                and self._peer_inflight.get(node_id, 0) + n > cap:
+            fut = asyncio.get_running_loop().create_future()
+            self._peer_waiters.setdefault(node_id, deque()).append(fut)
+            try:
+                remain = _remain(deadline)
+                if remain is None:
+                    await fut
+                else:
+                    await asyncio.wait_for(fut, remain)
+            except asyncio.TimeoutError:
+                q = self._peer_waiters.get(node_id)
+                if q is not None:
+                    try:
+                        q.remove(fut)
+                    except ValueError:
+                        pass
+                raise
+            except asyncio.CancelledError:
+                q = self._peer_waiters.get(node_id)
+                if q is not None:
+                    try:
+                        q.remove(fut)
+                    except ValueError:
+                        pass
+                raise
+        self._peer_inflight[node_id] = \
+            self._peer_inflight.get(node_id, 0) + n
+
+    def _release_peer(self, node_id, n: int):
+        left = self._peer_inflight.get(node_id, 0) - n
+        if left <= 0:
+            self._peer_inflight.pop(node_id, None)
+        else:
+            self._peer_inflight[node_id] = left
+        q = self._peer_waiters.get(node_id)
+        while q:
+            fut = q.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                break
+        if q is not None and not q:
+            self._peer_waiters.pop(node_id, None)
+
+    # ----------------------------------------------------------- pull side
+    async def pull(self, oid: bytes, location, deadline) -> bool:
+        """Pull oid into the local arena under ONE deadline.  Returns
+        True once a sealed local copy exists."""
+        r = self.raylet
+        sources, size = await self._stat_sources(oid, location, deadline)
+        if not sources:
+            return False
+        try:
+            off = await r._alloc_with_spill(oid, size)
+        except KeyError:
+            # Concurrent pull/push already owns an allocation for this
+            # oid; only a SEALED copy counts as success.
+            got = r.store.get(oid)
+            if got is not None and got[2]:
+                r.store.release(oid)
+                return True
+            return False
+        if off is None:
+            return False
+        dest = r.mapping.writable(off, size)
+        self.stats["pulls"] += 1
+        try:
+            ok = False
+            if cfg.transfer_same_host_mmap:
+                ok = await self._mmap_pull(oid, size, dest, sources,
+                                           deadline)
+            if not ok:
+                if len(sources) > 1:
+                    self.stats["striped_pulls"] += 1
+                ok = await self._windowed_fetch(oid, size, dest, sources,
+                                                deadline)
+        except BaseException:
+            await self._quiesce_and_discard(oid, sources)
+            raise
+        if not ok:
+            # Before freeing the extent, wait out any blob body the
+            # read loops are still copying into it (a timed-out chunk's
+            # reply may be mid-read) — freeing under the write would
+            # corrupt whatever reuses the memory.
+            await self._quiesce_and_discard(oid, sources)
+            return False
+        r._seal_release_notify(oid)
+        self.stats["pull_bytes"] += size
+        return True
+
+    # ------------------------------------------- same-host zero-copy path
+    async def _mmap_pull(self, oid, size, dest, sources, deadline) -> bool:
+        """Try each source as a co-located raylet: pin the object there
+        (os_map), mmap its arena file read-only, and memcpy the extent
+        straight across — no socket, no chunking.  Arena paths embed
+        the node id, so a remote peer's path simply doesn't exist here
+        and we fall back to the wire path."""
+        import os as _os
+        r = self.raylet
+        loop = asyncio.get_running_loop()
+        for nid, peer in sources:
+            if nid in self._peer_no_arena:
+                continue
+            arena = self._peer_arenas.get(nid)
+            if arena is None:
+                probe = await peer.request("os_map", {"oid": oid},
+                                           timeout=_remain(deadline))
+                if probe.get("error"):
+                    continue
+                try:
+                    if not _os.path.exists(probe["store_path"]):
+                        raise OSError("peer arena not on this host")
+                    from ray_tpu._private.shm_store import StoreMapping
+                    arena = StoreMapping(probe["store_path"],
+                                         probe["capacity"], readonly=True)
+                    self._peer_arenas[nid] = arena
+                except OSError:
+                    self._peer_no_arena.add(nid)
+                    self._release_remote_pin(peer, oid)
+                    continue
+                meta = probe
+            else:
+                meta = await peer.request("os_map", {"oid": oid},
+                                          timeout=_remain(deadline))
+                if meta.get("error"):
+                    continue
+            try:
+                src = arena.slice(meta["offset"], meta["size"])
+                # Copy on an executor thread, in 8 MiB steps: each step
+                # is one C-level memcpy (GIL held ~ms), and the loop
+                # keeps serving RPCs between steps.
+                await loop.run_in_executor(
+                    None, _stepped_copy, dest, src, size)
+                self.stats["mmap_pulls"] += 1
+                return True
+            except Exception as e:
+                logger.warning("same-host mmap pull of %s from %s "
+                               "failed: %s", oid.hex()[:8], nid, e)
+                continue
+            finally:
+                self._release_remote_pin(peer, oid)
+        return False
+
+    def _release_remote_pin(self, peer, oid):
+        try:
+            task = asyncio.get_running_loop().create_task(
+                peer.request("os_release", {"oid": oid}, timeout=30))
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
+        except Exception:
+            pass
+
+    async def _quiesce_and_discard(self, oid: bytes, sources):
+        for _nid, peer in sources:
+            try:
+                await peer.drain_sink_reads()
+            except Exception:
+                pass
+        self.raylet._discard_unsealed(oid)
+
+    async def _stat_sources(self, oid: bytes, location, deadline):
+        """Candidate source nodes holding a sealed copy, stat-verified,
+        hinted location first.  Striping only kicks in past
+        cfg.transfer_stripe_min_bytes — and a live hinted source below
+        that threshold answers alone, WITHOUT a GCS directory round
+        trip or extra peer stats (the common small-object pull stays
+        one os_stat, as before the striping engine existed)."""
+        r = self.raylet
+
+        async def _stat(nid):
+            peer = await r._peer(nid)
+            if peer is None:
+                return None
+            try:
+                meta = await peer.request("os_stat", {"oid": oid},
+                                          timeout=_remain(deadline))
+            except Exception:
+                return None
+            if meta.get("error"):
+                return None
+            return nid, peer, meta["size"]
+
+        hinted = None
+        if location is not None and location != r.node_id:
+            hinted = await _stat(location)
+            if hinted is not None \
+                    and hinted[2] < cfg.transfer_stripe_min_bytes:
+                return [(hinted[0], hinted[1])], hinted[2]
+        # Hint missing/dead, or the object is big enough to stripe:
+        # consult the directory for more sealed copies.
+        candidates = []
+        if r.gcs is not None and not r.gcs.closed:
+            try:
+                remain = _remain(deadline)
+                reply = await r.gcs.request(
+                    "get_object_locations", {"oid": oid},
+                    timeout=min(5.0, remain) if remain else 5.0)
+                for nid in reply.get("locations", []):
+                    if nid != r.node_id and nid not in candidates \
+                            and (hinted is None or nid != hinted[0]):
+                        candidates.append(nid)
+            except Exception:
+                pass  # directory is an optimization, not a dependency
+        have = 1 if hinted is not None else 0
+        candidates = candidates[:max(1, cfg.transfer_max_sources) - have]
+        stats = await asyncio.gather(*[_stat(n) for n in candidates])
+        sources = ([hinted] if hinted is not None else []) \
+            + [s for s in stats if s is not None]
+        if not sources:
+            return [], None
+        size = sources[0][2]
+        sources = [(nid, peer) for nid, peer, sz in sources if sz == size]
+        if size < cfg.transfer_stripe_min_bytes:
+            sources = sources[:1]
+        return sources, size
+
+    async def _windowed_fetch(self, oid: bytes, size: int, dest,
+                              sources, deadline) -> bool:
+        """Keep up to cfg.transfer_window_chunks chunk requests in
+        flight, striped round-robin across sources; chunks from a
+        failed source requeue onto survivors."""
+        chunk = max(1, cfg.fetch_chunk_bytes)
+        todo = deque([pos, min(chunk, size - pos), set()]
+                     for pos in range(0, size, chunk))
+        total = len(todo)
+        live = dict(sources)  # node_id -> peer conn
+        window = max(1, cfg.transfer_window_chunks)
+        pending: dict = {}  # task -> (entry, node_id)
+        order = list(live)
+        rr = 0
+        done = 0
+        while done < total:
+            while todo and len(pending) < window:
+                ent = todo.popleft()
+                nid = None
+                for i in range(len(order)):
+                    cand = order[(rr + i) % len(order)]
+                    if cand in live and cand not in ent[2]:
+                        nid = cand
+                        rr = (rr + i + 1) % len(order)
+                        break
+                if nid is None:
+                    # Every live source already failed this chunk.
+                    await self._fail_pending(pending)
+                    logger.warning(
+                        "pull %s failed: no live source for chunk @%d "
+                        "(%d/%d chunks done)", oid.hex()[:8], ent[0],
+                        done, total)
+                    return False
+                task = asyncio.get_running_loop().create_task(
+                    self._fetch_chunk(live[nid], nid, oid, ent, dest,
+                                      deadline))
+                pending[task] = (ent, nid)
+            if not pending:
+                if todo:
+                    return False
+                break
+            remain = _remain(deadline)
+            finished, _ = await asyncio.wait(
+                pending, timeout=remain,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not finished:
+                await self._fail_pending(pending)
+                logger.warning(
+                    "pull %s deadline exceeded after %d/%d chunks",
+                    oid.hex()[:8], done, total)
+                return False
+            for task in finished:
+                ent, nid = pending.pop(task)
+                err = task.result()
+                if err is None:
+                    done += 1
+                    self.stats["pull_chunks"] += 1
+                    continue
+                # Source failed mid-transfer: drop it, reissue the
+                # chunk to a surviving source.
+                live.pop(nid, None)
+                ent[2].add(nid)
+                self.stats["chunk_retries"] += 1
+                logger.info("pull %s chunk @%d from %s failed (%s); "
+                            "%d source(s) left", oid.hex()[:8], ent[0],
+                            getattr(nid, "hex", lambda: str(nid))()[:8],
+                            err, len(live))
+                todo.appendleft(ent)
+        return True
+
+    async def _fail_pending(self, pending):
+        """Cancel in-flight chunk tasks AND wait for the cancellations
+        to be delivered: request_blob's finally is what unregisters the
+        reply sink, so returning before it runs would let a late frame
+        write through the still-registered sink into memory the caller
+        is about to free."""
+        tasks = list(pending)
+        pending.clear()
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _fetch_chunk(self, peer, nid, oid, ent, dest, deadline):
+        """Fetch one chunk into its arena slice.  Returns None on
+        success, an error string otherwise (the chunk is then rerouted
+        by the caller)."""
+        pos, n, _tried = ent
+        try:
+            await self._acquire_peer(nid, n, deadline)
+        except asyncio.TimeoutError:
+            return "peer admission timed out"
+        try:
+            reply = await peer.request_blob(
+                "os_read_chunk", {"oid": oid, "offset": pos, "len": n},
+                dest[pos:pos + n], timeout=_remain(deadline))
+            if isinstance(reply, dict) and reply.get("error"):
+                return str(reply["error"])
+            # A short delivery (truncated spill file, short pread) fills
+            # only a prefix of the slice: counting it done would seal
+            # silent garbage in the tail.  The header's len is what the
+            # source actually sent (the transport wrote exactly that).
+            got = reply.get("len") if isinstance(reply, dict) else None
+            if got != n:
+                return f"short chunk: {got} of {n} bytes"
+            return None
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            return f"{type(e).__name__}: {e}"
+        finally:
+            self._release_peer(nid, n)
+
+    # ----------------------------------------------------------- push side
+    async def push(self, oid: bytes, target_node_id) -> bool:
+        """Stream a local sealed object to one peer: os_push_begin
+        (receiver allocates / dedups), then windowed raw chunk frames
+        out of the arena mapping."""
+        r = self.raylet
+        got = r.store.get(oid)  # pins while we stream
+        if got is None:
+            # Spilled locally? Restore, then stream.
+            if oid in r.spilled and await r._restore_spilled(oid):
+                got = r.store.get(oid)
+            if got is None:
+                return False
+        offset, size, sealed = got
+        if not sealed:
+            r.store.release(oid)
+            return False
+        try:
+            peer = await r._peer(target_node_id)
+            if peer is None:
+                return False
+            begin = await peer.request(
+                "os_push_begin", {"oid": oid, "size": size}, timeout=30)
+            if begin.get("skip"):
+                return True  # receiver already has / is getting it
+            if begin.get("error"):
+                return False
+            gen = begin.get("gen")
+            chunk = max(1, cfg.fetch_chunk_bytes)
+            window = max(1, cfg.transfer_window_chunks)
+            pending: set = set()
+            pos = 0
+            ok = True
+            while (pos < size or pending) and ok:
+                while pos < size and len(pending) < window:
+                    n = min(chunk, size - pos)
+                    pending.add(asyncio.get_running_loop().create_task(
+                        self._push_chunk(peer, target_node_id, oid, gen,
+                                         offset, pos, n)))
+                    pos += n
+                finished, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for task in finished:
+                    rep = task.result()
+                    if rep.get("error"):
+                        logger.warning("push %s to %s failed: %s",
+                                       oid.hex()[:8], target_node_id,
+                                       rep["error"])
+                        await self._fail_pending(pending)
+                        ok = False
+                        break
+            if ok:
+                self.stats["pushes"] += 1
+                self.stats["push_bytes"] += size
+            return ok
+        except Exception as e:
+            logger.warning("push %s to %s failed: %s", oid.hex()[:8],
+                           target_node_id, e)
+            return False
+        finally:
+            r.store.release(oid)
+
+    async def _push_chunk(self, peer, nid, oid, gen, offset, pos, n):
+        """One outbound chunk: arena memoryview -> KIND_BLOB frame.
+        Never raises; failures come back as {"error": ...}.  ``gen`` is
+        the receiver's transfer generation from os_push_begin — echoed
+        in every chunk header so a restarted transfer's stale in-flight
+        chunks can't be double-counted into the new one."""
+        try:
+            await self._acquire_peer(nid, n, time.monotonic() + 60)
+        except asyncio.TimeoutError:
+            return {"error": "peer admission timed out"}
+        try:
+            mv = self.raylet.mapping.slice(offset + pos, n)
+            return await peer.blob_request(
+                "os_push", {"oid": oid, "gen": gen, "offset": pos,
+                            "len": n}, mv,
+                timeout=60)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            self._release_peer(nid, n)

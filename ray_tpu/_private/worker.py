@@ -860,11 +860,24 @@ class CoreWorker:
     async def _fetch_from_store(self, oid: ObjectID, location, deadline=None):
         if self.raylet is None:
             raise rexc.ObjectLostError(oid.hex(), "no raylet (local mode)")
+        # The remaining budget travels as ONE deadline: the raylet
+        # charges every wait and every pulled chunk against it (a
+        # stop-and-wait transfer used to re-grant the full timeout per
+        # chunk).  The RPC timeout is slightly larger so the raylet's
+        # own deadline error wins the race and keeps its detail.
+        budget = self._remain(deadline) or 60.0
         reply = await self.raylet.request("os_get", {
             "oid": oid.binary(), "location": location,
-            "timeout": self._remain(deadline) or 60.0,
-        }, timeout=(self._remain(deadline) or 60.0) + 5.0)
+            "timeout": budget,
+        }, timeout=budget + 5.0)
         if "error" in reply:
+            if reply.get("timeout"):
+                # The resolution ran out of the caller's budget — that
+                # is a timeout, not a lost object: reconstruction would
+                # re-execute the producing task for an object that still
+                # exists on its node.
+                raise rexc.GetTimeoutError(
+                    f"object {oid.hex()}: {reply['error']}")
             raise rexc.ObjectLostError(oid.hex(), reply["error"])
         binary = oid.binary()
         self._pinned.add(binary)

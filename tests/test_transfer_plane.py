@@ -1,0 +1,502 @@
+"""Object transfer plane: windowed zero-pickle pulls, multi-source
+striping, per-peer admission, push/pull races (reference test style:
+python/ray/tests/test_object_manager.py)."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.transfer import TransferManager
+
+
+def _run(cluster, coro, timeout=120):
+    return asyncio.run_coroutine_threadsafe(coro, cluster.loop).result(timeout)
+
+
+def _store_bytes(cluster, node, oid):
+    """Read an object's sealed bytes out of a node's arena."""
+    async def _read():
+        got = node.raylet.store.get(oid)
+        assert got is not None and got[2], "object not sealed here"
+        off, size, _ = got
+        data = bytes(node.raylet.mapping.slice(off, size))
+        node.raylet.store.release(oid)
+        return data
+    return _run(cluster, _read())
+
+
+def _put_blob(nbytes, seed=0):
+    return np.random.RandomState(seed).bytes(nbytes)
+
+
+def _deadline(s):
+    return time.monotonic() + s
+
+
+def test_windowed_pull_parity_one_chunk_window(ray_start_cluster,
+                                               monkeypatch):
+    """A 1-chunk window degenerates to stop-and-wait and must still move
+    every byte correctly (the windowed engine's base case)."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "transfer_window_chunks", 1)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 256 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(2 * 1024 * 1024 + 12345)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    ok = _run(cluster, b.raylet._pull_object(
+        oid, a.raylet.node_id, _deadline(60)))
+    assert ok
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+    stats = _run(cluster, b.raylet.rpc_transfer_stats(None, {}))
+    assert stats["pulls"] == 1
+    assert stats["pull_chunks"] >= 8  # 2MB+ / 256KB
+
+
+def test_pull_stripes_and_falls_back_when_source_dies(ray_start_cluster,
+                                                      monkeypatch):
+    """With two sealed locations in the GCS object directory, a pull
+    stripes chunks across both; when one source starts failing
+    mid-transfer its chunks are reissued to the survivor."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 512 * 1024)
+    monkeypatch.setattr(cfg, "transfer_stripe_min_bytes", 1024 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    c = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(3)
+    cluster.connect()
+
+    blob = _put_blob(6 * 1024 * 1024, seed=1)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    # Replicate to C, then wait for C's sealed copy to reach the
+    # object directory (reports are fire-and-forget).
+    assert _run(cluster, a.raylet.transfers.push(oid, c.raylet.node_id))
+    gcs = cluster.head.gcs_server
+    for _ in range(100):
+        if c.raylet.node_id in gcs.object_locations.get(oid, ()):
+            break
+        time.sleep(0.05)
+    assert c.raylet.node_id in gcs.object_locations.get(oid, ())
+
+    # C serves one chunk then dies (from the transfer's point of view).
+    served = {"n": 0}
+    real = c.raylet.rpc_os_read_chunk
+
+    async def flaky(conn, body):
+        served["n"] += 1
+        if served["n"] > 1:
+            return {"error": "injected mid-transfer failure"}
+        return await real(conn, body)
+
+    monkeypatch.setattr(c.raylet, "rpc_os_read_chunk", flaky)
+
+    ok = _run(cluster, b.raylet._pull_object(
+        oid, a.raylet.node_id, _deadline(60)))
+    assert ok
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+    stats = _run(cluster, b.raylet.rpc_transfer_stats(None, {}))
+    assert stats["striped_pulls"] >= 1
+    assert stats["chunk_retries"] >= 1
+    assert served["n"] >= 2  # C really was in the stripe set
+
+
+def test_per_peer_byte_cap_admission(monkeypatch):
+    """The per-peer in-flight byte cap blocks a second chunk until the
+    first releases, but always admits a lone oversized chunk."""
+    monkeypatch.setattr(cfg, "transfer_inflight_bytes_per_peer",
+                        1024 * 1024)
+    tm = TransferManager(raylet=None)
+    peer = "node-x"
+
+    async def scenario():
+        # An idle peer admits even a chunk bigger than the cap.
+        await tm._acquire_peer(peer, 4 * 1024 * 1024, None)
+        tm._release_peer(peer, 4 * 1024 * 1024)
+        assert tm._peer_inflight == {}
+
+        await tm._acquire_peer(peer, 800 * 1024, None)
+        second = asyncio.ensure_future(
+            tm._acquire_peer(peer, 800 * 1024, None))
+        await asyncio.sleep(0.05)
+        assert not second.done()  # cap holds it back
+        tm._release_peer(peer, 800 * 1024)
+        await asyncio.wait_for(second, 5)
+        tm._release_peer(peer, 800 * 1024)
+        assert tm._peer_inflight == {}
+        assert tm._peer_waiters == {}
+
+        # Deadline-bounded admission times out instead of hanging.
+        await tm._acquire_peer(peer, 900 * 1024, None)
+        with pytest.raises(asyncio.TimeoutError):
+            await tm._acquire_peer(peer, 900 * 1024,
+                                   time.monotonic() + 0.1)
+        tm._release_peer(peer, 900 * 1024)
+
+    asyncio.run(scenario())
+
+
+def test_concurrent_pull_and_push_single_sealed_copy(ray_start_cluster,
+                                                     monkeypatch):
+    """A push A->B racing a pull on B of the same oid must end with
+    exactly one sealed copy on B and no unsealed residue."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 256 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(3 * 1024 * 1024, seed=2)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    async def race():
+        return await asyncio.gather(
+            a.raylet.transfers.push(oid, b.raylet.node_id),
+            b.raylet._pull_object(oid, a.raylet.node_id, _deadline(60)))
+
+    pushed, pulled = _run(cluster, race())
+    assert pushed or pulled
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+
+    async def residue():
+        st = b.raylet.store.stats()
+        return st["unsealed_bytes"], len(b.raylet._push_recv)
+    unsealed, open_pushes = _run(cluster, residue())
+    assert unsealed == 0
+    assert open_pushes == 0
+
+
+def test_pull_dedup_shielded_under_timeout(ray_start_cluster, monkeypatch):
+    """A second pull of an in-flight oid waits on the SAME transfer
+    (shielded): its own short deadline returns False without killing
+    the first pull, which still completes."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 256 * 1024)
+    monkeypatch.setattr(cfg, "transfer_window_chunks", 1)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(1024 * 1024, seed=3)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    real = a.raylet.rpc_os_read_chunk
+    stats = {"chunks": 0}
+
+    async def slow(conn, body):
+        stats["chunks"] += 1
+        await asyncio.sleep(0.25)
+        return await real(conn, body)
+
+    monkeypatch.setattr(a.raylet, "rpc_os_read_chunk", slow)
+
+    async def scenario():
+        first = asyncio.ensure_future(b.raylet._pull_object(
+            oid, a.raylet.node_id, _deadline(30)))
+        await asyncio.sleep(0.1)
+        assert oid in b.raylet._pulls_inflight
+        second = await b.raylet._pull_object(
+            oid, a.raylet.node_id, _deadline(0.2))
+        first_ok = await first
+        return first_ok, second
+
+    first_ok, second = _run(cluster, scenario())
+    assert first_ok
+    assert second is False
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+    # The chunks were fetched ONCE (serialized 1MiB blob = 5 chunks at
+    # 256KiB): the second pull piggybacked instead of re-pulling.
+    assert stats["chunks"] <= 5
+
+
+def test_transfer_path_never_pickles_chunk_bodies(ray_start_cluster,
+                                                  monkeypatch):
+    """Acceptance guard: chunk payloads bypass pickle in BOTH directions
+    — nothing chunk-sized goes through protocol.dumps during a pull
+    (A->B) or a push (B->C)."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 512 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    c = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(3)
+    cluster.connect()
+
+    blob = _put_blob(4 * 1024 * 1024, seed=4)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    sizes = []
+    real_dumps = protocol.dumps
+
+    def spying_dumps(obj):
+        out = real_dumps(obj)
+        sizes.append(len(out))
+        return out
+
+    monkeypatch.setattr(protocol, "dumps", spying_dumps)
+    try:
+        ok = _run(cluster, b.raylet._pull_object(
+            oid, a.raylet.node_id, _deadline(60)))
+        assert ok
+        # Push direction: stream B's fresh copy to C (which lacks it).
+        assert _run(cluster, b.raylet.transfers.push(
+            oid, c.raylet.node_id))
+    finally:
+        monkeypatch.setattr(protocol, "dumps", real_dumps)
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+    assert _store_bytes(cluster, c, oid) == _store_bytes(cluster, a, oid)
+    assert sizes, "expected control-plane pickles"
+    # Every pickled body is control-plane small; chunk bodies (512KiB)
+    # never touch pickle.
+    assert max(sizes) < 64 * 1024, \
+        f"chunk-sized body went through pickle ({max(sizes)} bytes)"
+
+
+def test_spill_read_fd_cached_across_chunks(ray_start_cluster,
+                                            monkeypatch):
+    """Serving a spilled object to a peer opens the spill file ONCE per
+    transfer (positional reads), and the fd is closed on completion."""
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 1024 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(8 * 1024 * 1024, seed=5)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    async def force_spill():
+        await a.raylet._spill_bytes(10**9)
+        return oid in a.raylet.spilled
+    assert _run(cluster, force_spill())
+
+    spill_dir = a.raylet.spill_dir
+    opens = {"n": 0}
+    real_open = os.open
+
+    def counting_open(path, *args, **kwargs):
+        if isinstance(path, str) and path.startswith(spill_dir) \
+                and not path.endswith(".tmp"):
+            opens["n"] += 1
+        return real_open(path, *args, **kwargs)
+
+    monkeypatch.setattr(os, "open", counting_open)
+    try:
+        ok = _run(cluster, b.raylet._pull_object(
+            oid, a.raylet.node_id, _deadline(60)))
+    finally:
+        monkeypatch.setattr(os, "open", real_open)
+    assert ok
+    assert opens["n"] == 1  # 8 chunks, one open
+    assert a.raylet._spill_read_fds == {}  # closed on completion
+    # The pulled copy deserializes back to the original value.
+    from ray_tpu._private import serialization
+    assert serialization.deserialize(_store_bytes(cluster, b, oid)) == blob
+
+
+def test_transfer_knobs_env_overridable(monkeypatch):
+    """transfer_window_chunks / fetch_chunk_bytes / push_stale_sweep_s
+    ride the same RT_* env override path as every other config knob."""
+    from ray_tpu._private.config import _Config
+    monkeypatch.setenv("RT_TRANSFER_WINDOW_CHUNKS", "9")
+    monkeypatch.setenv("RT_FETCH_CHUNK_BYTES", "123456")
+    monkeypatch.setenv("RT_PUSH_STALE_SWEEP_S", "7.5")
+    monkeypatch.setenv("RT_TRANSFER_INFLIGHT_BYTES_PER_PEER", "1048576")
+    c = _Config()
+    assert c.transfer_window_chunks == 9
+    assert c.fetch_chunk_bytes == 123456
+    assert c.push_stale_sweep_s == 7.5
+    assert c.transfer_inflight_bytes_per_peer == 1048576
+
+
+def test_pull_deadline_is_whole_transfer(ray_start_cluster, monkeypatch):
+    """The pull budget is ONE deadline across all chunks — a transfer
+    whose chunks are individually fast but collectively slow fails with
+    the deadline-exceeded warning instead of taking timeout x chunks."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 128 * 1024)
+    monkeypatch.setattr(cfg, "transfer_window_chunks", 1)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(2 * 1024 * 1024, seed=6)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    real = a.raylet.rpc_os_read_chunk
+
+    async def slow(conn, body):
+        await asyncio.sleep(0.3)  # each chunk well under 1s...
+        return await real(conn, body)
+
+    monkeypatch.setattr(a.raylet, "rpc_os_read_chunk", slow)
+    t0 = time.monotonic()
+    # ...but 16 chunks x 0.3s >> the 1s budget.
+    ok = _run(cluster, b.raylet._pull_object(oid, a.raylet.node_id,
+                                             _deadline(1.0)))
+    elapsed = time.monotonic() - t0
+    assert ok is False
+    assert elapsed < 5.0  # nowhere near timeout x n_chunks
+    # The failed transfer left no unsealed residue behind.
+    async def residue():
+        return b.raylet.store.stats()["unsealed_bytes"]
+    assert _run(cluster, residue()) == 0
+
+
+def test_same_host_mmap_pull_zero_copy(ray_start_cluster):
+    """Co-located raylets skip the socket entirely: the puller pins the
+    object remotely (os_map), mmaps the peer arena read-only, and
+    memcpys the extent; the remote pin is released afterwards."""
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(4 * 1024 * 1024, seed=7)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    ok = _run(cluster, b.raylet._pull_object(
+        oid, a.raylet.node_id, _deadline(60)))
+    assert ok
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+    stats = _run(cluster, b.raylet.rpc_transfer_stats(None, {}))
+    assert stats["mmap_pulls"] == 1
+    assert stats["pull_chunks"] == 0  # no chunk ever crossed the socket
+    assert b.raylet.node_id in b.raylet.transfers._peer_arenas or \
+        a.raylet.node_id in b.raylet.transfers._peer_arenas
+
+    # The os_map pin on A is dropped once the copy completes (the
+    # release rides a fire-and-forget RPC, so poll briefly).
+    async def pins_left():
+        return sum(p.get(oid, 0)
+                   for p in a.raylet._client_pins.values())
+    for _ in range(100):
+        if _run(cluster, pins_left()) == 0:
+            break
+        time.sleep(0.02)
+    assert _run(cluster, pins_left()) == 0
+
+
+def test_push_restart_gen_guard(ray_start_cluster):
+    """A same-sender push restart mints a new transfer generation:
+    stale in-flight chunks from the superseded stream are rejected
+    (explicit error, never counted), so the restarted transfer can't
+    seal with unwritten holes."""
+    cluster = ray_start_cluster
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(1)
+    cluster.connect()
+
+    oid = b"gen-guard-test-oid"
+    size = 256 * 1024
+    payload = _put_blob(size, seed=8)
+
+    class FakeConn:
+        _sink_reads = 0
+    conn = FakeConn()
+
+    async def scenario():
+        r = b.raylet
+        first = await r.rpc_os_push_begin(conn, {"oid": oid, "size": size})
+        assert first.get("ok") and "gen" in first
+        # Same sender restarts before any chunk lands.
+        second = await r.rpc_os_push_begin(conn, {"oid": oid, "size": size})
+        assert second.get("ok")
+        assert second["gen"] != first["gen"]
+        # A chunk from the OLD stream arrives late: must be refused,
+        # not double-counted into the new transfer.
+        half = size // 2
+        stale = await r.rpc_os_push(conn, protocol.BlobFrame(
+            {"oid": oid, "gen": first["gen"], "offset": 0, "len": half},
+            payload[:half], half))
+        assert stale.get("error")
+        assert r._push_recv[oid]["received"] == 0
+        # The sink resolver refuses the stale generation too.
+        assert r._blob_sink(conn, "os_push",
+                            {"oid": oid, "gen": first["gen"],
+                             "offset": 0, "len": half}, half) is None
+        # The live generation streams both halves and seals cleanly.
+        for pos in (0, half):
+            rep = await r.rpc_os_push(conn, protocol.BlobFrame(
+                {"oid": oid, "gen": second["gen"], "offset": pos,
+                 "len": half}, payload[pos:pos + half], half))
+            assert rep.get("ok"), rep
+        got = r.store.get(oid)
+        assert got is not None and got[2]
+        r.store.release(oid)
+        # A chunk after completion gets an error (transfer gone), so a
+        # sender whose transfer was swept never mistakes it for success.
+        late = await r.rpc_os_push(conn, protocol.BlobFrame(
+            {"oid": oid, "gen": second["gen"], "offset": 0, "len": half},
+            payload[:half], half))
+        assert late.get("error")
+    _run(cluster, scenario())
+    assert _store_bytes(cluster, b, oid) == payload
+
+
+def test_short_chunk_reply_fails_pull(ray_start_cluster, monkeypatch):
+    """A source delivering fewer bytes than requested (truncated spill
+    file, short pread) must fail the chunk — never seal an object whose
+    tail was left unwritten."""
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 256 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(1024 * 1024, seed=9)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    real = a.raylet.rpc_os_read_chunk
+
+    async def truncating(conn, body):
+        rep = await real(conn, body)
+        if isinstance(rep, protocol.Blob) and rep.header["len"] > 16:
+            short = rep.header["len"] - 16
+            return protocol.Blob({"len": short}, rep.data[:short],
+                                 on_sent=rep.on_sent)
+        return rep
+
+    monkeypatch.setattr(a.raylet, "rpc_os_read_chunk", truncating)
+    ok = _run(cluster, b.raylet._pull_object(
+        oid, a.raylet.node_id, _deadline(10)))
+    assert ok is False  # sole source dropped; no garbage sealed
+    async def state():
+        st = b.raylet.store.stats()
+        return st["unsealed_bytes"], b.raylet.store.contains(oid)
+    unsealed, present = _run(cluster, state())
+    assert unsealed == 0
+    assert not present
