@@ -18,7 +18,7 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
-	bench-quick bench-transfer
+	bench-quick bench-transfer chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -52,7 +52,43 @@ bench-transfer:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
 		$(PY) bench.py --suite transfer --json-out BENCH_transfer.json
 
-check: lint verify bench-quick
+# --- chaos battery ----------------------------------------------------
+# Seeded, deterministic message-level fault injection
+# (tests/test_failpoints.py + the dup-dedup satellites).  Every run
+# prints its seed up front and again on failure, so any red run
+# replays EXACTLY with:  make chaos CHAOS_SEED=<printed seed>
+# Simply-expanded (:=) behind an origin guard: `?=` stays recursive,
+# so every recipe line would re-roll $RANDOM and the banner seed
+# would not be the seed the tests actually ran with.
+ifeq ($(origin CHAOS_SEED),undefined)
+CHAOS_SEED := $(shell bash -c 'echo $$RANDOM')
+endif
+
+chaos:
+	@echo "== chaos battery: RT_CHAOS_SEED=$(CHAOS_SEED) =="
+	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) timeout -k 10 600 \
+		$(PY) -m pytest -q -m 'not slow' -p no:cacheprovider \
+		tests/test_failpoints.py \
+		tests/test_rpc_fastpath.py::test_duplicated_actor_task_frames_deduped_by_seq \
+		tests/test_transfer_plane.py::test_duplicated_push_chunks_deduped_by_offset \
+	|| { echo "CHAOS BATTERY FAILED — replay with:" \
+	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
+
+# <30 s smoke slice for make check: registry determinism + one fault
+# path per runtime layer (protocol keepalive, transfer partition, GCS
+# reconnect).
+chaos-smoke:
+	@echo "== chaos smoke: RT_CHAOS_SEED=$(CHAOS_SEED) =="
+	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) timeout -k 10 300 \
+		$(PY) -m pytest -q -p no:cacheprovider \
+		tests/test_failpoints.py::test_same_seed_identical_schedule \
+		tests/test_failpoints.py::test_half_open_detected_by_keepalive \
+		tests/test_failpoints.py::test_one_way_partition_multi_source_pull \
+		tests/test_failpoints.py::test_gcs_reconnect_bounded_with_terminal_error \
+	|| { echo "CHAOS SMOKE FAILED — replay with:" \
+	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
+
+check: lint verify chaos-smoke bench-quick
 
 store: ray_tpu/_private/_shm_store.so
 

@@ -28,6 +28,32 @@ class _Config:
     resource_report_period_ms = _def("resource_report_period_ms", int, 100)
     worker_register_timeout_s = _def("worker_register_timeout_s", float, 60.0)
     connect_timeout_s = _def("connect_timeout_s", float, 30.0)
+    # Default deadline for Connection.request() when the caller gives
+    # none: no RPC may wait unbounded by accident (a hung peer must
+    # surface as an error, not a wedged future).  Call sites that WANT
+    # an unbounded wait (push_task on a long task, infeasible lease
+    # requests parked as autoscaler demand) pass timeout=None
+    # explicitly.  <= 0 disables the default.
+    rpc_request_timeout_s = _def("rpc_request_timeout_s", float, 300.0)
+    # Idle keepalive on the RPC plane: a connection with in-flight
+    # requests but no inbound traffic for idle_s sends a PING; no
+    # traffic for another timeout_s after that fails the connection
+    # (half-open links — one direction dead — otherwise hang their
+    # futures forever).  idle_s <= 0 disables.
+    rpc_keepalive_idle_s = _def("rpc_keepalive_idle_s", float, 20.0)
+    rpc_keepalive_timeout_s = _def("rpc_keepalive_timeout_s", float, 20.0)
+    # Core-worker GCS reconnect: bounded attempts with full-jitter
+    # backoff (was: reconnect exactly once per connection loss).
+    gcs_reconnect_attempts = _def("gcs_reconnect_attempts", int, 8)
+    gcs_reconnect_base_s = _def("gcs_reconnect_base_s", float, 0.25)
+    gcs_reconnect_cap_s = _def("gcs_reconnect_cap_s", float, 5.0)
+    # When a raylet's GCS connection drops WITHOUT a drain announcement,
+    # the GCS probes the raylet's server before declaring it dead:
+    # connection refused proves the process is gone (fast crash
+    # detection), while an unreachable-but-maybe-alive node (partition,
+    # suspect half-open link the raylet failed on purpose) keeps its
+    # heartbeat-timeout grace window.
+    node_probe_timeout_s = _def("node_probe_timeout_s", float, 2.0)
 
     # --- object store ---
     object_store_memory_bytes = _def("object_store_memory_bytes", int, 2 * 1024**3)

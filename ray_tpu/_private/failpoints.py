@@ -1,0 +1,377 @@
+"""Deterministic, seeded failpoint registry — the fault-injection plane.
+
+Reference: FoundationDB's simulation testing (Zhou et al., SIGMOD '21)
+showed that fault injection is only useful when it is *deterministic and
+seeded* — a red run must be replayable — and the ownership paper behind
+Ray (Wang et al., NSDI '21) argues recovery must be exercised at the
+*message* level, not just by killing whole processes.  This module
+supplies both: named failpoints compiled into the runtime's code paths
+(protocol frames, transfer chunks, GCS reconnects, heartbeats) plus
+connection-level fault rules (partitions, half-open links, slow links)
+that the chaos battery drives.
+
+A *failpoint* is a named hook a runtime code path consults::
+
+    if failpoints.ACTIVE:
+        act = failpoints.check("transfer.pull_chunk", peer=nid_tag)
+        if act is not None and act.kind == "error":
+            ...
+
+With nothing configured the cost is one module-attribute truthiness
+test — the hot path pays nothing measurable (see the `make bench-quick`
+acceptance gate).
+
+Spec grammar (``RT_FAILPOINTS`` env var, :func:`configure`, or the
+``set_failpoints`` RPC served by the GCS, raylet, and core worker)::
+
+    specs  ::= spec (";" spec)*
+    spec   ::= name "=" action ["(" arg ")"] ("|" mod)*
+    action ::= error | delay | drop | dup | disconnect | kill | off
+    mod    ::= "p=" FLOAT          probability per eligible hit
+             | "hits=" N["-" M]    fire only on hits N..M (1-based)
+             | "times=" N          fire at most N times total
+             | "peer=" SUBSTR      only when the site's peer matches
+
+Examples::
+
+    RT_FAILPOINTS="protocol.recv=drop|p=0.1"
+    RT_FAILPOINTS="transfer.pull_chunk=error|peer=ab12cd34;raylet.heartbeat=delay(500)|hits=3-6"
+
+Named failpoints wired into the runtime:
+
+    ``protocol.send`` / ``protocol.recv``   (peer = connection name)
+    ``transfer.pull_chunk`` / ``transfer.push_chunk``  (peer = node tag)
+    ``raylet.serve_chunk``                  (peer = serving node tag)
+    ``raylet.heartbeat``                    (peer = node tag)
+    ``worker.gcs_request``                  (peer = RPC method)
+    ``worker.gcs_reconnect``
+
+Determinism: every failpoint owns a hit counter and an RNG stream seeded
+from ``(RT_CHAOS_SEED, name)``, so the decision for hit #k of a
+failpoint depends only on the seed — never on interleaving with other
+failpoints.  Two runs with the same seed and the same per-site call
+sequence inject the identical schedule; :data:`LOG` records every
+decision so tests can assert it.
+
+Connection rules (partitions / slow links) are separate from named
+failpoints: a rule matches connection *names* by substring and installs
+per-connection flags (``drop_tx``/``drop_rx``/``delay_tx_s``/
+``delay_rx_s``) consulted by the protocol layer.  ``test_utils.py``
+builds ``cluster.partition()/heal()/slow_link()`` on top of these.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import zlib
+
+logger = logging.getLogger(__name__)
+
+# name -> [Failpoint, ...].  Truthiness of this dict is THE hot-path
+# gate: empty means the fault plane is compiled out.
+ACTIVE: dict = {}
+
+SEED: int = int(os.environ.get("RT_CHAOS_SEED", "0") or "0")
+
+# Decision log: (name, hit_index, fired, action_kind).  Bounded; reset
+# by configure().  The determinism battery asserts two same-seed runs
+# produce identical logs.
+LOG: list = []
+_LOG_CAP = 20000
+
+_ACTIONS = ("error", "delay", "drop", "dup", "disconnect", "kill", "off")
+
+
+class Action:
+    """What a fired failpoint asks the call site to do."""
+
+    __slots__ = ("kind", "arg")
+
+    def __init__(self, kind: str, arg=None):
+        self.kind = kind
+        self.arg = arg
+
+    def __repr__(self):
+        return f"Action({self.kind!r}, {self.arg!r})"
+
+    @property
+    def delay_s(self) -> float:
+        """delay actions carry milliseconds; convert once here."""
+        return float(self.arg or 0.0) / 1000.0
+
+
+class Failpoint:
+    __slots__ = ("name", "action", "prob", "first", "last", "times",
+                 "peer", "hits", "fired", "_rng")
+
+    def __init__(self, name: str, action: Action, prob: float = 1.0,
+                 first: int = 1, last=None, times=None, peer=None):
+        self.name = name
+        self.action = action
+        self.prob = prob
+        self.first = first
+        self.last = last
+        self.times = times
+        self.peer = peer
+        self.hits = 0
+        self.fired = 0
+        self._rng = random.Random()
+        self.reseed(SEED)
+
+    def reseed(self, seed: int):
+        # Per-failpoint stream: hit #k's probability draw depends only
+        # on (seed, name, k), never on other failpoints' draws.
+        self._rng.seed(zlib.crc32(self.name.encode()) ^ seed)
+        self.hits = 0
+        self.fired = 0
+
+    def check(self, peer=None):
+        if self.peer is not None and (
+                peer is None or self.peer not in str(peer)):
+            return None
+        self.hits += 1
+        h = self.hits
+        if h < self.first or (self.last is not None and h > self.last):
+            return None
+        if self.times is not None and self.fired >= self.times:
+            return None
+        if self.prob < 1.0 and self._rng.random() >= self.prob:
+            _log(self.name, h, False, self.action.kind)
+            return None
+        self.fired += 1
+        _log(self.name, h, True, self.action.kind)
+        return self.action
+
+    def describe(self) -> dict:
+        return {"name": self.name, "action": self.action.kind,
+                "arg": self.action.arg, "prob": self.prob,
+                "hits_window": (self.first, self.last),
+                "times": self.times, "peer": self.peer,
+                "hits": self.hits, "fired": self.fired}
+
+
+def _log(name, hit, fired, kind):
+    if len(LOG) < _LOG_CAP:
+        LOG.append((name, hit, fired, kind))
+
+
+def _parse_one(spec: str) -> Failpoint:
+    if "=" not in spec:
+        raise ValueError(f"failpoint spec missing '=': {spec!r}")
+    name, rest = spec.split("=", 1)
+    name = name.strip()
+    if not name:
+        raise ValueError(f"failpoint spec missing name: {spec!r}")
+    parts = [p.strip() for p in rest.split("|")]
+    act = parts[0]
+    arg = None
+    if "(" in act:
+        if not act.endswith(")"):
+            raise ValueError(f"unbalanced action arg in {spec!r}")
+        act, arg = act[:-1].split("(", 1)
+    act = act.strip()
+    if act not in _ACTIONS:
+        raise ValueError(f"unknown failpoint action {act!r} in {spec!r} "
+                         f"(expected one of {_ACTIONS})")
+    if act == "delay":
+        arg = float(arg if arg is not None else 0.0)
+    prob, first, last, times, peer = 1.0, 1, None, None, None
+    for mod in parts[1:]:
+        if not mod:
+            continue
+        if mod.startswith("p="):
+            prob = float(mod[2:])
+        elif mod.startswith("hits="):
+            win = mod[5:]
+            if "-" in win:
+                a, b = win.split("-", 1)
+                first, last = int(a), int(b)
+            else:
+                first = last = int(win)
+        elif mod.startswith("times="):
+            times = int(mod[6:])
+        elif mod.startswith("peer="):
+            peer = mod[5:]
+        else:
+            raise ValueError(f"unknown failpoint modifier {mod!r} "
+                             f"in {spec!r}")
+    return Failpoint(name, Action(act, arg), prob=prob, first=first,
+                     last=last, times=times, peer=peer)
+
+
+def parse(specs: str) -> list:
+    return [_parse_one(s) for s in (specs or "").split(";") if s.strip()]
+
+
+def configure(specs: str, seed=None) -> dict:
+    """Replace the active failpoint set (empty string clears it) and
+    reset counters + the decision log.  ``seed`` overrides the global
+    chaos seed for the new set."""
+    global SEED
+    if seed is not None:
+        SEED = int(seed)
+    table: dict = {}
+    for fp in parse(specs):
+        if fp.action.kind == "off":
+            continue
+        fp.reseed(SEED)
+        table.setdefault(fp.name, []).append(fp)
+    ACTIVE.clear()
+    ACTIVE.update(table)
+    del LOG[:]
+    if table:
+        logger.info("failpoints active (seed=%d): %s", SEED,
+                    "; ".join(sorted(table)))
+    return table
+
+
+def set_failpoint(spec: str):
+    """Add/replace ONE failpoint (by name) without disturbing others."""
+    fp = _parse_one(spec)
+    if fp.action.kind == "off":
+        ACTIVE.pop(fp.name, None)
+        return None
+    fp.reseed(SEED)
+    ACTIVE[fp.name] = [fp]
+    return fp
+
+
+def clear(name=None):
+    if name is None:
+        ACTIVE.clear()
+    else:
+        ACTIVE.pop(name, None)
+
+
+def check(name: str, peer=None):
+    """Consult failpoint ``name``; returns the Action to apply or None.
+    Call sites guard with ``if failpoints.ACTIVE:`` first."""
+    fps = ACTIVE.get(name)
+    if not fps:
+        return None
+    for fp in fps:
+        act = fp.check(peer)
+        if act is not None:
+            return act
+    return None
+
+
+def describe() -> list:
+    return [fp.describe() for fps in ACTIVE.values() for fp in fps]
+
+
+def apply_rpc(body: dict) -> dict:
+    """Handler body for the ``set_failpoints`` RPC served by the GCS,
+    raylet, and core worker — tests flip faults on a LIVE process
+    mid-run.  Accepted keys (all optional):
+
+        specs      full replacement spec string ("" clears everything)
+        add        one spec added/replaced without disturbing the rest
+        seed       new chaos seed (with specs: applied to the new set)
+        conn_rules [[match_substrings, flags], ...] partition/slow-link
+                   rules (replaces the rule set; [] heals)
+
+    Returns the live state so tests can assert what's armed."""
+    body = body or {}
+    if body.get("specs") is not None:
+        configure(body["specs"], seed=body.get("seed"))
+    elif body.get("seed") is not None:
+        global SEED
+        SEED = int(body["seed"])
+        for fps in ACTIVE.values():
+            for fp in fps:
+                fp.reseed(SEED)
+        del LOG[:]
+    if body.get("add"):
+        set_failpoint(body["add"])
+    if body.get("conn_rules") is not None:
+        set_conn_rules(body["conn_rules"])
+    return {"ok": True, "seed": SEED, "active": describe(),
+            "conn_rules": [[list(m), dict(f)] for m, f in CONN_RULES],
+            "log_len": len(LOG)}
+
+
+# ------------------------------------------------------- connection rules
+# Partition / slow-link flags matched against Connection names.  A rule
+# is (match, flags): every substring in ``match`` must appear in the
+# connection's name.  Flags merge across matching rules.
+
+class ConnFault:
+    __slots__ = ("drop_tx", "drop_rx", "delay_tx_s", "delay_rx_s")
+
+    def __init__(self, drop_tx=False, drop_rx=False,
+                 delay_tx_s=0.0, delay_rx_s=0.0):
+        self.drop_tx = drop_tx
+        self.drop_rx = drop_rx
+        self.delay_tx_s = delay_tx_s
+        self.delay_rx_s = delay_rx_s
+
+    def __repr__(self):
+        return (f"ConnFault(drop_tx={self.drop_tx}, drop_rx={self.drop_rx},"
+                f" delay_tx_s={self.delay_tx_s},"
+                f" delay_rx_s={self.delay_rx_s})")
+
+
+CONN_RULES: list = []  # [(match: tuple[str, ...], flags: dict), ...]
+
+
+def conn_fault_for(name: str):
+    """Merged ConnFault for a connection name, or None."""
+    if not CONN_RULES:
+        return None
+    flags: dict = {}
+    for match, f in CONN_RULES:
+        if all(m in name for m in match):
+            for k, v in f.items():
+                if isinstance(v, bool):
+                    flags[k] = flags.get(k, False) or v
+                else:
+                    flags[k] = max(flags.get(k, 0.0), float(v))
+    if not any(flags.values()):
+        return None
+    return ConnFault(**flags)
+
+
+def set_conn_rules(rules):
+    """Replace the connection-rule set and re-resolve the fault flags of
+    every LIVE connection in this process (new connections resolve at
+    creation).  Loop-thread callers only touch attribute assignment, so
+    cross-thread use from test helpers is safe."""
+    CONN_RULES[:] = [(tuple(m), dict(f)) for m, f in rules]
+    _sweep_live_conns()
+
+
+def add_conn_rule(match, **flags):
+    CONN_RULES.append((tuple(match), dict(flags)))
+    _sweep_live_conns()
+
+
+def clear_conn_rules():
+    del CONN_RULES[:]
+    _sweep_live_conns()
+
+
+def _sweep_live_conns():
+    # Late import: protocol imports this module at load time.
+    try:
+        from ray_tpu._private import protocol
+    except Exception:  # pragma: no cover - import cycle during teardown
+        return
+    for conn in list(protocol._LIVE_CONNS):
+        try:
+            conn._fault = conn_fault_for(conn.name)
+        except Exception:
+            pass
+
+
+# Env activation: a process started with RT_FAILPOINTS in its
+# environment arms the plane at import (workers inherit the env from
+# their raylet, so one env var arms a whole node).
+_env_spec = os.environ.get("RT_FAILPOINTS")
+if _env_spec:
+    try:
+        configure(_env_spec)
+    except ValueError as e:  # pragma: no cover - operator typo
+        logger.error("ignoring malformed RT_FAILPOINTS: %s", e)

@@ -155,6 +155,7 @@ class GcsServer:
         self._pending_actor_creations: dict[ActorID, asyncio.Task] = {}
         self._actor_waiters: dict[ActorID, list[asyncio.Future]] = {}
         self._node_waiters: list[asyncio.Future] = []
+        self._probing: set = set()  # node ids with a death probe in flight
         self._drivers: dict[int, dict] = {}  # conn-id -> {job_id}
         self._start_time = time.time()
         # Persistence (reference: gcs/store_client/redis_store_client.h:28 —
@@ -311,11 +312,57 @@ class GcsServer:
                     await self._mark_node_dead(
                         node, "drained (planned shutdown)", planned=True)
                 else:
-                    await self._mark_node_dead(node,
-                                               "raylet connection lost")
+                    # An UNANNOUNCED connection loss is not proof of
+                    # death: the raylet may have failed a suspect
+                    # half-open link on purpose (keepalive) or be
+                    # partitioned from us while healthy.  Probe its
+                    # server: refusal proves the process is gone; an
+                    # unreachable node keeps the heartbeat-timeout
+                    # grace window (_liveness_loop is the backstop).
+                    asyncio.get_running_loop().create_task(
+                        self._probe_suspect_node(node))
         drv = self._drivers.pop(id(conn), None)
         if drv is not None:
             await self._cleanup_job(drv["job_id"])
+
+    async def _probe_suspect_node(self, node: NodeInfo):
+        if node.node_id in self._probing or not node.alive:
+            return
+        self._probing.add(node.node_id)
+        tag = node.node_id.hex()[:8]
+        try:
+            probe = await protocol.Connection.connect(
+                node.addr[0], node.addr[1],
+                name=f"gcs->raylet:{tag}",
+                timeout=cfg.node_probe_timeout_s)
+            try:
+                await probe.request("ping", {},
+                                    timeout=cfg.node_probe_timeout_s)
+            finally:
+                try:
+                    await probe.close()
+                except Exception:
+                    pass
+            logger.info(
+                "node %s dropped its GCS connection but answers pings; "
+                "keeping it alive pending re-register", tag)
+        except (ConnectionRefusedError, ConnectionResetError) as e:
+            # Nothing is listening on the raylet's port: the process is
+            # gone — declare death NOW (reconstruction, actor restarts
+            # and directory pruning must not wait a full grace window).
+            if node.alive:
+                await self._mark_node_dead(
+                    node, f"raylet connection lost (probe: "
+                          f"{type(e).__name__})")
+        except Exception as e:
+            # Unreachable (timeout / partition / injected fault): NOT
+            # proof of death.  The node stays alive until its heartbeat
+            # grace window expires or it re-registers.
+            logger.info(
+                "node %s unreachable after connection loss (%s); "
+                "liveness grace window decides", tag, e)
+        finally:
+            self._probing.discard(node.node_id)
 
     # ---------------------------------------------------------------- nodes
     async def rpc_node_draining(self, conn, body):
@@ -459,6 +506,12 @@ class GcsServer:
                            body.get("message", ""),
                            body.get("source", "client"))
         return {"ok": True}
+
+    async def rpc_set_failpoints(self, conn, body):
+        """Runtime fault-plane toggle: tests flip failpoints / partition
+        rules on a live GCS mid-run (see failpoints.apply_rpc)."""
+        from ray_tpu._private import failpoints
+        return failpoints.apply_rpc(body)
 
     async def _mark_node_dead(self, node: NodeInfo, reason: str,
                               planned: bool = False):

@@ -50,10 +50,15 @@ from __future__ import annotations
 import asyncio
 import io
 import logging
+import os
 import pickle
 import struct
 import time
 import traceback
+import weakref
+
+from ray_tpu._private import failpoints
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 
 logger = logging.getLogger(__name__)
 
@@ -65,6 +70,31 @@ KIND_PUSH = 3
 KIND_BATCH = 4
 KIND_BLOB = 5       # method + pickled header + raw payload (msg_id 0 = one-way)
 KIND_BLOB_REP = 6   # pickled header + raw payload into a registered sink
+KIND_PING = 7       # keepalive probe (no payload; answered with PONG)
+KIND_PONG = 8       # keepalive answer (any inbound frame proves liveness)
+
+# Every live Connection in this process, for the fault-injection plane:
+# when failpoints.set_conn_rules changes partition/slow-link rules, the
+# flags of existing connections are re-resolved through this set.
+_LIVE_CONNS: "weakref.WeakSet" = weakref.WeakSet()
+
+# Sentinel distinguishing "caller gave no timeout" (-> the config
+# default deadline applies) from an EXPLICIT timeout=None (the caller
+# wants an unbounded wait: push_task on a long task, lease requests
+# parked as autoscaler demand).
+_DEFAULT_TIMEOUT = object()
+
+
+def _default_timeout(timeout):
+    if timeout is _DEFAULT_TIMEOUT:
+        t = cfg.rpc_request_timeout_s
+        return t if t and t > 0 else None
+    return timeout
+
+
+class _InjectedDisconnect(ConnectionError):
+    """Raised inside the read loop by a 'disconnect' failpoint; the
+    loop's OSError handling turns it into a normal connection loss."""
 
 _MLEN = struct.Struct("<H")  # method-name length (REQ/PUSH payload prefix)
 _HLEN = struct.Struct("<I")  # pickled-header length (BLOB/BLOB_REP prefix)
@@ -281,6 +311,24 @@ class Connection:
         # loop can block in the selector, so latency is unaffected).
         self._wbuf: list = []
         self._wflush_scheduled = False
+        self._wflush_delayed = False
+        # Bumped by every direct _flush_wbuf so a stale scheduled flush
+        # callback (call_soon/call_later already queued on the loop)
+        # can't flush frames admitted after it — without this, a frame
+        # owed an injected delay would ride an earlier frame's pending
+        # call_soon and ship undelayed.
+        self._wflush_gen = 0
+        # Fault-injection flags (partitions / slow links); None when the
+        # fault plane is idle, so the hot path pays one attribute test.
+        self._fault = (failpoints.conn_fault_for(name)
+                       if failpoints.CONN_RULES else None)
+        _LIVE_CONNS.add(self)
+        self._last_rx = time.monotonic()
+        self._ka_task: asyncio.Task | None = None
+        if cfg.rpc_keepalive_idle_s > 0:
+            self._ka_task = self._loop.create_task(self._keepalive_loop())
+            self._ka_task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
         # Last: under an eager task factory this may start reading (and
         # serving) immediately, so every attribute must already exist.
         self._reader_task = self._loop.create_task(self._read_loop())
@@ -307,6 +355,15 @@ class Connection:
             while True:
                 hdr = await self.reader.readexactly(_HDR.size)
                 plen, kind, msg_id = _HDR.unpack(hdr)
+                fate = None
+                if self._fault is not None or failpoints.ACTIVE:
+                    fate = await self._apply_recv_fault(plen, kind)
+                    if fate == "drop":
+                        # A dropped frame never "arrived": _last_rx stays
+                        # stale so keepalive reads a partitioned link as
+                        # silence (half-open detection).
+                        continue
+                self._last_rx = time.monotonic()
                 if kind == KIND_BLOB:
                     # Raw-payload frames stream their body into a
                     # resolved destination instead of materializing the
@@ -319,6 +376,8 @@ class Connection:
                 payload = await self.reader.readexactly(plen) if plen else b""
                 if kind == KIND_REQ:
                     self._dispatch_frame(msg_id, payload, False)
+                    if fate == "dup":
+                        self._dispatch_frame(msg_id, payload, False)
                 elif kind == KIND_REP:
                     fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
@@ -330,8 +389,19 @@ class Connection:
                         fut.set_exception(RemoteError(cause_repr, tb))
                 elif kind == KIND_PUSH:
                     self._dispatch_frame(0, payload, True)
+                    if fate == "dup":
+                        self._dispatch_frame(0, payload, True)
                 elif kind == KIND_BATCH:
                     self._dispatch_batch(payload)
+                    if fate == "dup":
+                        self._dispatch_batch(payload)
+                elif kind == KIND_PING:
+                    try:
+                        self._send_nowait(KIND_PONG, 0, b"")
+                    except ConnectionLost:
+                        pass
+                elif kind == KIND_PONG:
+                    pass  # _last_rx above is the whole point
         except asyncio.IncompleteReadError:
             self.close_reason = self.close_reason or "peer closed connection"
         except (ConnectionResetError, OSError) as e:
@@ -365,17 +435,129 @@ class Connection:
                 logger.error("unexpected kind %d inside batch on %s",
                              kind, self.name)
 
+    # ------------------------------------------------- fault injection
+    async def _apply_recv_fault(self, plen: int, kind: int):
+        """Consult partition flags + the protocol.recv failpoint for one
+        inbound frame.  Returns "drop" (body consumed and discarded),
+        "dup" (dispatch the frame twice), or None; may sleep (delay /
+        slow link) or raise (injected disconnect)."""
+        f = self._fault
+        if f is not None:
+            if f.drop_rx:
+                await self._read_discard(plen)
+                return "drop"
+            if f.delay_rx_s:
+                await asyncio.sleep(f.delay_rx_s)
+        if failpoints.ACTIVE:
+            act = failpoints.check("protocol.recv", peer=self.name)
+            if act is not None:
+                if act.kind == "drop":
+                    await self._read_discard(plen)
+                    return "drop"
+                if act.kind == "delay":
+                    await asyncio.sleep(act.delay_s)
+                elif act.kind == "dup":
+                    if kind in (KIND_REQ, KIND_PUSH, KIND_BATCH):
+                        return "dup"
+                elif act.kind in ("disconnect", "error"):
+                    self.close_reason = (
+                        f"failpoint: injected {act.kind}"
+                        + (f" ({act.arg})" if act.arg else ""))
+                    raise _InjectedDisconnect(self.close_reason)
+                elif act.kind == "kill":
+                    os._exit(int(act.arg or 1))
+        return None
+
+    def _send_faulted(self, kind: int):
+        """Outbound fault filter.  Returns ``(fate, delay_s)``: fate
+        "drop" means the frame must be silently discarded (partition /
+        drop action), "dup" means it goes on the wire twice; delay_s is
+        injected outbound latency for this frame (slow-link rule and/or
+        a delay action — senders are sync, so the delay is applied by
+        deferring the flush, not by sleeping here).  error/disconnect
+        actions raise ConnectionLost like a real dead socket would."""
+        delay_s = 0.0
+        f = self._fault
+        if f is not None:
+            if f.drop_tx:
+                return "drop", 0.0
+            delay_s = f.delay_tx_s
+        if failpoints.ACTIVE:
+            act = failpoints.check("protocol.send", peer=self.name)
+            if act is not None:
+                if act.kind == "drop":
+                    return "drop", 0.0
+                if act.kind == "dup":
+                    return "dup", delay_s
+                if act.kind == "delay":
+                    delay_s = max(delay_s, act.delay_s)
+                elif act.kind == "error":
+                    raise ConnectionLost(
+                        f"failpoint: injected send error on {self.name}"
+                        + (f" ({act.arg})" if act.arg else ""))
+                elif act.kind == "disconnect":
+                    self.close_reason = "failpoint: injected disconnect"
+                    self._reader_task.cancel()
+                    raise ConnectionLost(
+                        f"failpoint: injected disconnect on {self.name}")
+                elif act.kind == "kill":
+                    os._exit(int(act.arg or 1))
+        return None, delay_s
+
+    async def _keepalive_loop(self):
+        """Probe an idle connection that has work in flight: no inbound
+        traffic for idle_s -> PING; still nothing for timeout_s after
+        the probe -> the link is half-open (or the peer wedged), so fail
+        it NOW — every in-flight future gets ConnectionLost instead of
+        hanging forever.  Config is re-read each cycle so tests can
+        tighten it on live connections."""
+        while not self._closed:
+            idle = cfg.rpc_keepalive_idle_s
+            if idle <= 0:
+                return
+            await asyncio.sleep(idle)
+            if self._closed:
+                return
+            if not self._pending and not self._blob_sinks:
+                continue
+            if time.monotonic() - self._last_rx < idle:
+                continue
+            probe_t = time.monotonic()
+            try:
+                self._send_nowait(KIND_PING, 0, b"")
+            except Exception:
+                return  # closed (or injected-closed) under us
+            await asyncio.sleep(max(0.001, cfg.rpc_keepalive_timeout_s))
+            if self._closed:
+                return
+            if self._last_rx < probe_t:
+                silent = time.monotonic() - self._last_rx
+                self.close_reason = (
+                    f"keepalive timeout: no traffic for {silent:.1f}s "
+                    f"with {len(self._pending)} in-flight request(s) "
+                    "(half-open connection?)")
+                self._reader_task.cancel()
+                return
+
     async def _read_into(self, sink, n: int):
         """Consume n raw bytes off the stream into a writable view —
-        bounded slices, one memcpy each, no whole-body allocation."""
+        bounded slices, one memcpy each, no whole-body allocation.
+        Each slice refreshes _last_rx: a large body trickling over a
+        slow-but-live link is PROGRESS, and keepalive (which only sees
+        frame headers otherwise) must not read the long body read as
+        half-open silence and kill a transfer that is advancing."""
         pos = 0
         while pos < n:
             data = await self.reader.readexactly(
                 min(n - pos, _BLOB_IO_CHUNK))
+            self._last_rx = time.monotonic()
             sink[pos:pos + len(data)] = data
             pos += len(data)
 
     async def _read_discard(self, n: int):
+        # NO _last_rx refresh here: discarded bodies belong to DROPPED
+        # frames (partition rules), and a partitioned link must read as
+        # silence to keepalive even while bytes still hit the socket.
         while n > 0:
             data = await self.reader.readexactly(min(n, _BLOB_IO_CHUNK))
             n -= len(data)
@@ -566,23 +748,51 @@ class Connection:
             raise ConnectionLost(
                 f"connection {self.name} closed"
                 + (f" ({self.close_reason})" if self.close_reason else ""))
+        delay_tx = 0.0
+        repeat = 1
+        if self._fault is not None or failpoints.ACTIVE:
+            fate, delay_tx = self._send_faulted(kind)
+            if fate == "drop":
+                return
+            if fate == "dup":
+                repeat = 2
+        if (delay_tx and self._wflush_scheduled
+                and not self._wflush_delayed):
+            # Frames already queued this tick were admitted WITHOUT the
+            # delay; ship them now so the deferred flush below actually
+            # defers THIS frame instead of it riding their call_soon
+            # (the stale callback no-ops via the generation guard).  A
+            # pending DELAYED flush is left alone — this frame joins its
+            # late batch, preserving both the delay and frame order.
+            self._flush_wbuf()
         wbuf = self._wbuf
-        wbuf.append(_HDR.pack(len(prefix) + len(payload), kind, msg_id))
-        if prefix:
-            wbuf.append(prefix)
-        if len(payload) >= self._COALESCE_MAX:
-            self._flush_wbuf()  # pending smalls first, keep order
-            try:
-                self.writer.write(payload)
-            except (ConnectionResetError, OSError) as e:
-                self.close_reason = self.close_reason or (
-                    f"{type(e).__name__}: {e}")
-                raise ConnectionLost(str(e)) from e
-        else:
-            wbuf.append(payload)
-            if not self._wflush_scheduled:
-                self._wflush_scheduled = True
-                self._loop.call_soon(self._flush_wbuf)
+        hdr = _HDR.pack(len(prefix) + len(payload), kind, msg_id)
+        for _ in range(repeat):
+            wbuf.append(hdr)
+            if prefix:
+                wbuf.append(prefix)
+            if len(payload) >= self._COALESCE_MAX and not delay_tx:
+                self._flush_wbuf()  # pending smalls first, keep order
+                try:
+                    self.writer.write(payload)
+                except (ConnectionResetError, OSError) as e:
+                    self.close_reason = self.close_reason or (
+                        f"{type(e).__name__}: {e}")
+                    raise ConnectionLost(str(e)) from e
+            else:
+                wbuf.append(payload)
+                if not self._wflush_scheduled:
+                    self._wflush_scheduled = True
+                    self._wflush_delayed = bool(delay_tx)
+                    if delay_tx:
+                        # Slow link: the whole buffered batch ships
+                        # late, preserving frame order.
+                        self._loop.call_later(delay_tx,
+                                              self._scheduled_flush,
+                                              self._wflush_gen)
+                    else:
+                        self._loop.call_soon(self._scheduled_flush,
+                                             self._wflush_gen)
         transport = self.writer.transport
         if (transport is not None
                 and transport.get_write_buffer_size() > 1 << 20):
@@ -602,6 +812,43 @@ class Connection:
             raise ConnectionLost(
                 f"connection {self.name} closed"
                 + (f" ({self.close_reason})" if self.close_reason else ""))
+        if self._fault is not None or failpoints.ACTIVE:
+            try:
+                # "dup" is a no-op here: raw-body frames are not
+                # duplicated at the transport (the transfer plane dups
+                # whole chunks instead — see TransferManager).
+                fate, delay_s = self._send_faulted(kind)
+                if fate == "drop":
+                    if on_sent is not None:
+                        on_sent()
+                    return
+            except ConnectionLost:
+                if on_sent is not None:
+                    on_sent()
+                raise
+            if delay_s:
+                # Slow link: defer the WHOLE frame (header + body), so
+                # blob traffic honors injected latency like every other
+                # frame.  Equal-delay call_later callbacks fire in
+                # scheduling order, so successive chunks keep their
+                # order; a send error after the delay can only surface
+                # via the connection dying (the caller is long gone).
+                def _late():
+                    if self._closed:
+                        if on_sent is not None:
+                            on_sent()
+                        return
+                    try:
+                        self._send_blob_now(kind, msg_id, method, header,
+                                            data, on_sent)
+                    except ConnectionLost:
+                        pass
+                self._loop.call_later(delay_s, _late)
+                return
+        self._send_blob_now(kind, msg_id, method, header, data, on_sent)
+
+    def _send_blob_now(self, kind: int, msg_id: int, method: str | None,
+                       header, data, on_sent=None):
         try:
             hp = dumps(header)
         except Exception:
@@ -658,8 +905,14 @@ class Connection:
         finally:
             cb()
 
+    def _scheduled_flush(self, gen: int):
+        if gen == self._wflush_gen:
+            self._flush_wbuf()
+
     def _flush_wbuf(self):
         self._wflush_scheduled = False
+        self._wflush_delayed = False
+        self._wflush_gen += 1
         if not self._wbuf:
             return
         buf, self._wbuf = self._wbuf, []
@@ -758,7 +1011,13 @@ class Connection:
         await self.backpressure()
         return fut
 
-    async def request(self, method: str, body=None, timeout: float | None = None):
+    async def request(self, method: str, body=None,
+                      timeout=_DEFAULT_TIMEOUT):
+        """Round-trip RPC.  An unspecified ``timeout`` gets the config
+        default deadline (cfg.rpc_request_timeout_s) so no request path
+        can wait unbounded by accident; pass ``timeout=None`` explicitly
+        to opt into an unbounded wait."""
+        timeout = _default_timeout(timeout)
         msg_id = self._next_id
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
@@ -778,7 +1037,7 @@ class Connection:
         return await fut
 
     async def request_blob(self, method: str, body, sink,
-                           timeout: float | None = None):
+                           timeout=_DEFAULT_TIMEOUT):
         """Send a pickled request whose reply arrives as a raw
         KIND_BLOB_REP written DIRECTLY into ``sink`` (a writable
         memoryview, e.g. an arena slice).  Returns the reply's small
@@ -786,6 +1045,7 @@ class Connection:
         error dict) resolves the same future via the normal REP path.
         On timeout/cancel the sink is unregistered before re-raising so
         a late frame can never scribble on a recycled buffer."""
+        timeout = _default_timeout(timeout)
         msg_id = self._next_id
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
@@ -808,10 +1068,11 @@ class Connection:
             self._blob_sinks.pop(msg_id, None)
 
     async def blob_request(self, method: str, header, data,
-                           timeout: float | None = None):
+                           timeout=_DEFAULT_TIMEOUT):
         """Send a raw-payload request (KIND_BLOB) — ``data`` rides the
         wire as one memoryview handoff, never pickled — and await the
         handler's (small, pickled) reply."""
+        timeout = _default_timeout(timeout)
         msg_id = self._next_id
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
@@ -856,6 +1117,8 @@ class Connection:
         except Exception:
             pass
         self._closed = True
+        if self._ka_task is not None:
+            self._ka_task.cancel()
         reason = self.close_reason or "connection lost"
         exc = ConnectionLost(
             f"connection to {self.name} lost ({reason}); "

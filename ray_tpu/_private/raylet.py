@@ -30,7 +30,7 @@ import subprocess
 import sys
 import time
 
-from ray_tpu._private import protocol
+from ray_tpu._private import failpoints, protocol, retry
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.shm_store import StoreServer, StoreMapping, default_store_path
@@ -234,9 +234,12 @@ class Raylet:
     # -------------------------------------------------------------- startup
     async def start(self, port=0):
         self.port = await self.server.start(port)
+        # The node tag in the connection name is what the fault plane's
+        # partition/slow-link rules match on (test_utils.partition).
         self.gcs = await protocol.Connection.connect(
             self.gcs_addr[0], self.gcs_addr[1], handler=self._handle_gcs_push,
-            name="raylet->gcs", timeout=cfg.connect_timeout_s)
+            name=f"raylet:{self.node_id.hex()[:8]}->gcs",
+            timeout=cfg.connect_timeout_s)
         reply = await self.gcs.request("register_node", {
             "node_id": self.node_id,
             "addr": (self.host, self.port),
@@ -453,7 +456,9 @@ class Raylet:
                 if _time.monotonic() > deadline:
                     raise RuntimeError(
                         f"timed out waiting for venv build lock {lock}")
-                _time.sleep(0.5)
+                # Jittered so a gang of workers racing one build lock
+                # don't all re-poll (and re-stat the marker) in phase.
+                _time.sleep(retry.jittered(0.5))
         try:
             if not os.path.exists(done_marker):
                 # We hold the lock: safe to clear any half-built root left
@@ -884,7 +889,9 @@ class Raylet:
 
     async def _reap_loop(self):
         while not self._shutdown:
-            await asyncio.sleep(0.2)
+            # Jittered: N raylets in one test process (or container)
+            # must not wake and sweep their worker tables in phase.
+            await asyncio.sleep(retry.jittered(0.2))
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_dead(
@@ -1372,21 +1379,48 @@ class Raylet:
             return {"error": f"object of {size} bytes exceeds the "
                              f"object store capacity "
                              f"({self.store_capacity} bytes)"}
-        off = await self._alloc_with_spill(oid, size)
-        if off is None:
-            # Memory is transiently pinned by running tasks' zero-copy
-            # args: QUEUE the create instead of failing (reference: the
-            # plasma store's create-request queue blocks until eviction
-            # frees room).  Pins drop as tasks finish; only a working
-            # set that can never fit should error.
-            deadline = (asyncio.get_running_loop().time()
-                        + cfg.create_retry_timeout_s)
-            while off is None and not self._shutdown and \
-                    asyncio.get_running_loop().time() < deadline:
-                await asyncio.sleep(0.2)
-                if self._shutdown:
-                    return {"error": "raylet shutting down"}
+        # One bounded converge loop for BOTH transient obstacles:
+        #  - memory pinned by running tasks' zero-copy args: QUEUE the
+        #    create instead of failing (reference: the plasma store's
+        #    create-request queue blocks until eviction frees room) —
+        #    pins drop as tasks finish, backoff re-probes ever more
+        #    gently after a nearly-free first retry;
+        #  - an UNSEALED in-flight creation of the same oid (inbound
+        #    pull/push, another worker): alloc raises KeyError but
+        #    contains() is sealed-only, so {exists} would make the
+        #    client skip its write while trusting a transfer that may
+        #    yet abort (leaving the object permanently unsealed).  Wait
+        #    it out: the seal turns the NEXT iteration's contains()
+        #    into {exists}; an abort frees the entry and our alloc
+        #    wins.
+        deadline = (asyncio.get_running_loop().time()
+                    + cfg.create_retry_timeout_s)
+        backoff = retry.ExpBackoff(0.02, 0.5)
+        off = None
+        inflight = False
+        while True:
+            if self.store.contains(oid):
+                # Idempotent create: a reconstruction re-executing the
+                # producing task on a node that still holds a SEALED
+                # copy must not error — the client skips its
+                # write+seal and the existing copy stands.
+                return {"exists": True}
+            try:
                 off = await self._alloc_with_spill(oid, size)
+                inflight = False
+            except KeyError:
+                off, inflight = None, True
+            if off is not None or self._shutdown or \
+                    asyncio.get_running_loop().time() >= deadline:
+                break
+            await asyncio.sleep(backoff.next())
+        if self._shutdown:
+            return {"error": "raylet shutting down"}
+        if inflight:
+            return {"error": f"creation of {oid.hex()} raced an "
+                             f"in-flight transfer that neither sealed "
+                             f"nor aborted within "
+                             f"{cfg.create_retry_timeout_s:.0f}s"}
         if off is None:
             try:
                 holders = {}
@@ -1597,13 +1631,27 @@ class Raylet:
             return {"error": "timeout waiting for object seal",
                     "timeout": True}
         if location is not None and location != self.node_id:
-            ok = await self._pull_object(oid, location, deadline)
-            if not ok:
+            # A failed pull is only "lost" if the control plane agrees no
+            # copy-holding node is alive; an unreachable-but-alive source
+            # (partition, restart, half-open link) is transient, so the
+            # pull retries under the caller's budget.  Reporting a merely
+            # partitioned object as lost would re-execute its creating
+            # task even though the copy still exists.
+            backoff = retry.ExpBackoff(0.05, 1.0)
+            ok = False
+            while True:
+                ok = await self._pull_object(oid, location, deadline)
+                if ok:
+                    break
                 if time.monotonic() >= deadline:
                     return {"error": f"pull deadline exceeded fetching "
                                      f"{oid.hex()}", "timeout": True}
-                return {"error": f"failed to pull {oid.hex()} from "
-                                 f"{location.hex()[:8]}"}
+                if not await self._object_source_alive(oid, location):
+                    return {"error": f"failed to pull {oid.hex()} from "
+                                     f"{location.hex()[:8]}: no live "
+                                     f"source"}
+                rem = self._remaining(deadline)
+                await asyncio.sleep(min(backoff.next(), rem or 0.001))
             got = self.store.get(oid)
             if got and got[2]:
                 self._track_pin(conn, oid)
@@ -1622,6 +1670,30 @@ class Raylet:
     # One deadline clamp for the whole transfer plane (shared with
     # TransferManager so the floor/None semantics can't diverge).
     _remaining = staticmethod(_remain)
+
+    async def _object_source_alive(self, oid, location) -> bool:
+        """Is ANY node believed to hold a copy of ``oid`` still alive
+        per the control plane?  Decides pull-retry (alive: the failure
+        is transient) vs ObjectLost/reconstruction (dead).  Liveness
+        is answered from the pubsub-synced local node view — this runs
+        once per failed pull attempt, and re-dumping the whole node
+        table from the GCS on every retry across many degraded pulls
+        would stampede the very service the jittered retries protect —
+        with one cheap directory RPC for extra copy-holders.  An
+        unreachable GCS cannot prove death, so it answers alive."""
+        candidates = {location}
+        if self.gcs is not None and not self.gcs.closed:
+            try:
+                reply = await self.gcs.request(
+                    "get_object_locations", {"oid": oid}, timeout=5.0)
+                candidates.update(reply.get("locations", []))
+            except Exception:
+                return True  # partitioned from the GCS: inconclusive
+        for nid in candidates:
+            view = self.cluster_nodes.get(nid)
+            if view is not None and view.get("alive", True):
+                return True
+        return False
 
     async def _wait_sealed(self, oid, timeout):
         fut = asyncio.get_running_loop().create_future()
@@ -1645,7 +1717,9 @@ class Raylet:
         try:
             conn = await protocol.Connection.connect(
                 view["addr"][0], view["addr"][1], handler=self._handle,
-                name="raylet-peer", timeout=cfg.connect_timeout_s,
+                name=f"raylet:{self.node_id.hex()[:8]}"
+                     f"->raylet:{node_id.hex()[:8]}",
+                timeout=cfg.connect_timeout_s,
                 blob_provider=self._blob_sink)
         except Exception:
             return None
@@ -1740,6 +1814,20 @@ class Raylet:
         stop-and-wait baseline)."""
         oid = body["oid"]
         legacy = body.get("pickle", False)
+        if failpoints.ACTIVE:
+            act = failpoints.check("raylet.serve_chunk",
+                                   peer=self.node_id.hex()[:8])
+            if act is not None:
+                if act.kind == "error":
+                    return {"error": "failpoint: injected serve error"}
+                if act.kind == "delay":
+                    await asyncio.sleep(act.delay_s)
+                elif act.kind == "drop":
+                    # A lost reply: stall past any sane chunk deadline
+                    # so the puller times out / reroutes, exactly as if
+                    # the frame had vanished on the wire.
+                    await asyncio.sleep(act.delay_s or 60.0)
+                    return {"error": "failpoint: chunk reply dropped"}
         got = self.store.get(oid)
         if got is None or not got[2]:
             spilled = self.spilled.get(oid)
@@ -1971,9 +2059,13 @@ class Raylet:
         # stream will resend, sealing an object with unwritten holes).
         self._push_gen += 1
         gen = self._push_gen
+        # "chunks" records the starting offset of every chunk already
+        # counted: a duplicated frame (retry, network dup, chaos dup
+        # action) must be idempotent, never double-counted — a byte
+        # counter alone would seal the object early with holes.
         self._push_recv[oid] = {"off": off, "size": size, "sender": sender,
                                 "gen": gen, "conn": conn, "last": now,
-                                "received": 0}
+                                "received": 0, "chunks": set()}
         return {"ok": True, "gen": gen}
 
     def _blob_sink(self, conn, method, header, nbytes):
@@ -2020,6 +2112,12 @@ class Raylet:
                 return {"error": "push chunk out of range"}
             dest = self.mapping.writable(ent["off"], ent["size"])
             dest[pos:pos + n] = body.data
+        if hdr["offset"] in ent["chunks"]:
+            # Duplicate delivery of a chunk this transfer already
+            # counted: the (re)write above was byte-identical, so just
+            # ack without advancing "received".
+            return {"ok": True, "duplicate": True}
+        ent["chunks"].add(hdr["offset"])
         ent["received"] += hdr["len"]
         if ent["received"] >= ent["size"]:
             self._push_recv.pop(oid, None)
@@ -2143,7 +2241,24 @@ class Raylet:
                         # ResourceLoad feeding LoadMetrics).
                         "pending_shapes": report[2],
                     })
-                reply = await self.gcs.request("heartbeat", body)
+                if failpoints.ACTIVE:
+                    act = failpoints.check("raylet.heartbeat",
+                                           peer=self.node_id.hex()[:8])
+                    if act is not None:
+                        if act.kind == "drop":
+                            continue  # this beat never leaves the node
+                        if act.kind == "delay":
+                            await asyncio.sleep(act.delay_s)
+                        elif act.kind in ("error", "disconnect"):
+                            raise protocol.ConnectionLost(
+                                "failpoint: injected heartbeat "
+                                f"{act.kind}")
+                # Bounded wait: during a partition this request must
+                # fail fast enough that the loop keeps beating through
+                # the reconnect path instead of wedging on one RPC.
+                reply = await self.gcs.request(
+                    "heartbeat", body,
+                    timeout=max(2.0, cfg.heartbeat_period_ms / 250.0))
                 if reply.get("ok"):
                     self._gcs_acked_version = reply.get(
                         "acked_version", self._gcs_acked_version)
@@ -2168,29 +2283,44 @@ class Raylet:
         }
 
     async def _reconnect_gcs(self):
-        """Reconnect + re-register after a GCS restart, with backoff."""
+        """Reconnect + re-register after a GCS restart/partition.  A
+        raylet retries forever (it is useless without a control plane)
+        but with full-jitter backoff, so a thousand raylets losing one
+        GCS don't stampede its recovery in lockstep.  Bounded per-RPC
+        timeouts keep a half-open link from wedging an attempt."""
+        backoff = retry.ExpBackoff(cfg.gcs_reconnect_base_s,
+                                   cfg.gcs_reconnect_cap_s)
         while not self._shutdown:
             try:
                 conn = await protocol.Connection.connect(
                     self.gcs_addr[0], self.gcs_addr[1],
-                    handler=self._handle_gcs_push, name="raylet->gcs",
+                    handler=self._handle_gcs_push,
+                    name=f"raylet:{self.node_id.hex()[:8]}->gcs",
                     timeout=5.0)
-                reply = await conn.request("register_node",
-                                           self._register_body())
-                old, self.gcs = self.gcs, conn
-                if old is not None and not old.closed:
-                    try:
-                        await old.close()
-                    except Exception:
-                        pass
-                for view in reply.get("cluster_nodes", []):
-                    self.cluster_nodes[view["node_id"]] = view
-                await self.gcs.request("subscribe", {"channels": ["nodes"]})
+                try:
+                    reply = await conn.request("register_node",
+                                               self._register_body(),
+                                               timeout=10.0)
+                    old, self.gcs = self.gcs, conn
+                    if old is not None and not old.closed:
+                        try:
+                            await old.close()
+                        except Exception:
+                            pass
+                    for view in reply.get("cluster_nodes", []):
+                        self.cluster_nodes[view["node_id"]] = view
+                    await self.gcs.request("subscribe",
+                                           {"channels": ["nodes"]},
+                                           timeout=10.0)
+                except BaseException:
+                    if self.gcs is not conn:
+                        await conn.close()
+                    raise
                 logger.info("raylet %s re-registered with GCS",
                             self.node_id.hex()[:8])
                 return
             except Exception:
-                await asyncio.sleep(0.5)
+                await asyncio.sleep(backoff.next())
 
     async def rpc_shutdown(self, conn, body):
         asyncio.get_running_loop().create_task(self.shutdown())
@@ -2198,6 +2328,11 @@ class Raylet:
 
     async def rpc_ping(self, conn, body):
         return {"ok": True, "node_id": self.node_id}
+
+    async def rpc_set_failpoints(self, conn, body):
+        """Runtime fault-plane toggle: tests flip failpoints / partition
+        rules on a live raylet mid-run (see failpoints.apply_rpc)."""
+        return failpoints.apply_rpc(body)
 
     async def shutdown(self):
         self._shutdown = True

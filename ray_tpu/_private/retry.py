@@ -1,0 +1,64 @@
+"""Shared retry/backoff policies: exponential backoff with full jitter.
+
+Reference: the reference scatters fixed-interval retry sleeps through
+its node/worker paths; at cluster scale those synchronize — every
+raylet that lost the GCS retries on the same cadence and the recovered
+GCS absorbs a thundering herd.  The fix is the standard full-jitter
+exponential backoff (delay #n drawn uniformly from (0, min(cap,
+base*mult^n))), which both spreads the herd and caps the tail.
+
+Two primitives, used by worker.py / raylet.py in place of their old
+fixed sleeps:
+
+* :class:`ExpBackoff` — per-retry-loop policy object; ``next()`` yields
+  the next jittered delay, ``reset()`` rewinds after a success.
+* :func:`jittered` — one-shot +/-``frac`` jitter for *periodic* loops
+  (telemetry pushes, reap ticks, lock polls) so identical loops across
+  a large cluster drift apart instead of beating in phase.
+
+Determinism: when ``RT_CHAOS_SEED`` is set (the chaos battery), the
+module RNG is seeded from it so a replayed run sleeps the same
+schedule; without it, delays are process-random as production wants.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+_seed_env = os.environ.get("RT_CHAOS_SEED")
+_rng = random.Random(int(_seed_env)) if _seed_env else random.Random()
+
+
+def jittered(period: float, frac: float = 0.25, rng=None) -> float:
+    """``period`` +/- ``frac`` uniform jitter — for periodic loops."""
+    r = rng if rng is not None else _rng
+    return period * (1.0 - frac + 2.0 * frac * r.random())
+
+
+class ExpBackoff:
+    """Full-jitter exponential backoff.
+
+    ``next()`` returns a delay drawn uniformly from (0, ceiling] where
+    the ceiling doubles (by ``mult``) each attempt up to ``cap``.  A
+    1 ms floor keeps a zero draw from turning a retry loop into a hot
+    spin.
+    """
+
+    __slots__ = ("base", "cap", "mult", "attempt", "_rng")
+
+    def __init__(self, base: float, cap: float, mult: float = 2.0,
+                 rng=None):
+        self.base = base
+        self.cap = cap
+        self.mult = mult
+        self.attempt = 0
+        self._rng = rng if rng is not None else _rng
+
+    def next(self) -> float:
+        ceiling = min(self.cap, self.base * (self.mult ** self.attempt))
+        self.attempt += 1
+        return max(0.001, self._rng.uniform(0.0, ceiling))
+
+    def reset(self):
+        self.attempt = 0

@@ -4,6 +4,16 @@ Reference: python/ray/_private/test_utils.py:1098 (NodeKillerActor) and
 release/nightly_tests/setup_chaos.py — kill nodes on a cadence while a
 real workload runs, asserting the job still completes.  Here the killer
 drives the in-process Cluster fixture directly.
+
+Beyond whole-process kills, :func:`partition` / :func:`heal` /
+:func:`slow_link` drive the message-level fault plane
+(ray_tpu._private.failpoints): they install connection rules matched
+against the node tags embedded in connection names ("raylet:<id8>->gcs",
+"raylet:<id8>->raylet:<id8>"), so a link between two IN-PROCESS cluster
+members can be cut, made one-way (half-open), or slowed without killing
+anything.  Every TCP link has exactly one client end, and the client end
+carries both endpoint tags in its name — so filtering only client-end
+connections controls both directions of every link.
 """
 
 from __future__ import annotations
@@ -12,6 +22,55 @@ import random
 import threading
 import time
 from typing import Callable, List, Optional
+
+from ray_tpu._private import failpoints
+
+
+def node_tag(node) -> str:
+    """The fault-plane tag of a cluster member: ``"gcs"`` for the head
+    control plane (or the literal string), else ``"raylet:<id8>"``.
+    Accepts an InProcessNode, a Raylet, a NodeID/bytes, or a tag."""
+    if isinstance(node, str):
+        return node
+    raylet = getattr(node, "raylet", None)
+    if raylet is not None:
+        node = raylet
+    nid = getattr(node, "node_id", node)
+    h = getattr(nid, "hex", None)
+    return f"raylet:{h()[:8]}" if callable(h) else str(nid)
+
+
+def partition(a, b, one_way: bool = False):
+    """Cut the link between cluster members ``a`` and ``b`` (either may
+    be ``"gcs"``).  ``one_way=True`` drops only a→b traffic — the
+    half-open case: b still reaches a, a's frames to b vanish.  Frames
+    are dropped at the fault filter, so from both runtimes' point of
+    view the link is silently black-holing — exactly what keepalive
+    probes and request deadlines exist to detect."""
+    ta, tb = node_tag(a), node_tag(b)
+    # Client conns a→b carry "ta->…tb": a's outbound frames drop there.
+    failpoints.add_conn_rule((f"{ta}->", f"->{tb}"), drop_tx=True,
+                             **({} if one_way else {"drop_rx": True}))
+    # a→b traffic arriving over b-initiated conns is b's INBOUND side.
+    failpoints.add_conn_rule((f"{tb}->", f"->{ta}"), drop_rx=True,
+                             **({} if one_way else {"drop_tx": True}))
+
+
+def slow_link(a, b, delay_s: float):
+    """Add ``delay_s`` of one-way latency on every frame between ``a``
+    and ``b`` (both directions), preserving frame order."""
+    ta, tb = node_tag(a), node_tag(b)
+    failpoints.add_conn_rule((f"{ta}->", f"->{tb}"),
+                             delay_tx_s=delay_s, delay_rx_s=delay_s)
+    failpoints.add_conn_rule((f"{tb}->", f"->{ta}"),
+                             delay_tx_s=delay_s, delay_rx_s=delay_s)
+
+
+def heal():
+    """Remove every partition / slow-link rule installed in this
+    process (named failpoints are untouched — clear those with
+    failpoints.configure(""))."""
+    failpoints.clear_conn_rules()
 
 
 class NodeKiller:

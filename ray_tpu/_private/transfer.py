@@ -37,6 +37,7 @@ import logging
 import time
 from collections import deque
 
+from ray_tpu._private import failpoints
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 
 logger = logging.getLogger(__name__)
@@ -46,6 +47,12 @@ def _remain(deadline):
     if deadline is None:
         return None
     return max(0.001, deadline - time.monotonic())
+
+
+def _node_tag(nid) -> str:
+    """Short node tag for failpoint peer-scoping (NodeID or bytes)."""
+    h = getattr(nid, "hex", None)
+    return h()[:8] if callable(h) else str(nid)[:8]
 
 
 def _stepped_copy(dest, src, size, step=8 * 1024 * 1024):
@@ -394,6 +401,21 @@ class TransferManager:
         success, an error string otherwise (the chunk is then rerouted
         by the caller)."""
         pos, n, _tried = ent
+        if failpoints.ACTIVE:
+            act = failpoints.check("transfer.pull_chunk",
+                                   peer=_node_tag(nid))
+            if act is not None:
+                if act.kind == "error":
+                    return "failpoint: injected pull-chunk error"
+                if act.kind == "delay":
+                    await asyncio.sleep(act.delay_s)
+                elif act.kind == "drop":
+                    # A lost chunk request: nothing comes back until
+                    # the transfer deadline charges it.
+                    rem = _remain(deadline)
+                    await asyncio.sleep(min(rem if rem is not None
+                                            else 60.0, 60.0))
+                    return "failpoint: chunk request dropped"
         try:
             await self._acquire_peer(nid, n, deadline)
         except asyncio.TimeoutError:
@@ -487,16 +509,45 @@ class TransferManager:
         the receiver's transfer generation from os_push_begin — echoed
         in every chunk header so a restarted transfer's stale in-flight
         chunks can't be double-counted into the new one."""
+        dup = False
+        if failpoints.ACTIVE:
+            act = failpoints.check("transfer.push_chunk",
+                                   peer=_node_tag(nid))
+            if act is not None:
+                if act.kind == "error":
+                    return {"error": "failpoint: injected "
+                                     "push-chunk error"}
+                if act.kind == "drop":
+                    return {"error": "failpoint: push chunk dropped"}
+                if act.kind == "delay":
+                    await asyncio.sleep(act.delay_s)
+                elif act.kind == "dup":
+                    dup = True
         try:
             await self._acquire_peer(nid, n, time.monotonic() + 60)
         except asyncio.TimeoutError:
             return {"error": "peer admission timed out"}
         try:
             mv = self.raylet.mapping.slice(offset + pos, n)
-            return await peer.blob_request(
+            reply = await peer.blob_request(
                 "os_push", {"oid": oid, "gen": gen, "offset": pos,
                             "len": n}, mv,
                 timeout=60)
+            if dup:
+                # Duplicate delivery of the SAME chunk: the receiver
+                # must dedupe by offset, not double-count it toward the
+                # seal.  The dup's reply AND any transport error it hits
+                # are ignored — the chunk already landed and was acked.
+                try:
+                    await peer.blob_request(
+                        "os_push", {"oid": oid, "gen": gen, "offset": pos,
+                                    "len": n}, mv,
+                        timeout=60)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
+            return reply
         except asyncio.CancelledError:
             raise
         except Exception as e:

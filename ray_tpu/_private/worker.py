@@ -33,7 +33,7 @@ from concurrent.futures import Future as CFuture, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as CFTimeoutError
 
 from ray_tpu import exceptions as rexc
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import failpoints, protocol, retry, serialization
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import (ActorID, FunctionID, JobID, NodeID, ObjectID,
                                   TaskID, WorkerID)
@@ -326,6 +326,11 @@ class CoreWorker:
         self._actor_async_sems: dict[str, asyncio.Semaphore] = {}
         self._caller_seq: dict[bytes, int] = {}
         self._caller_buffer: dict[bytes, list] = {}
+        # Wire-duplicate defense (chaos dup action / retransmits): seqs
+        # whose dispatch is still running, and reply waiters parked by
+        # duplicate frames of those seqs (see rpc_push_actor_task).
+        self._caller_running: dict[bytes, set] = {}
+        self._dup_waiters: dict = {}
         self._task_pool = ThreadPoolExecutor(max_workers=1,
                                              thread_name_prefix="exec")
         # Drain-batched dispatch state for single-thread executor pools
@@ -369,33 +374,103 @@ class CoreWorker:
         await self._connect()
         self.connected = True
 
-    async def _gcs_request(self, method, body, timeout=None):
-        """GCS RPC surviving a GCS restart: reconnect once on conn loss
-        (reference: workers re-resolve the GCS after failover,
-        NotifyGCSRestart node_manager.proto:343).  Reconnects are
-        serialized so concurrent failures share one new connection rather
-        than stampeding (and leaking the losers)."""
-        try:
-            return await self.gcs.request(method, body, timeout=timeout)
-        except (protocol.ConnectionLost, ConnectionError, OSError):
-            if self._shutdown:
+    async def _gcs_request(self, method, body,
+                           timeout=protocol._DEFAULT_TIMEOUT):
+        """GCS RPC surviving a GCS restart/partition: bounded reconnect
+        attempts with full-jitter backoff (reference: workers re-resolve
+        the GCS after failover, NotifyGCSRestart node_manager.proto:343;
+        was reconnect-exactly-once, which one flaky reconnect turned
+        into a caller-visible error while the GCS was still coming
+        back).  Reconnects are serialized so concurrent failures share
+        one new connection rather than stampeding (and leaking the
+        losers); when every attempt is exhausted the terminal error
+        names the GCS address so the operator knows what was
+        unreachable."""
+        inject = None
+        if failpoints.ACTIVE:
+            act = failpoints.check("worker.gcs_request", peer=method)
+            if act is not None:
+                if act.kind == "delay":
+                    await asyncio.sleep(act.delay_s)
+                elif act.kind in ("error", "drop", "disconnect"):
+                    # Raised INSIDE the try: an injected request fault
+                    # must exercise the reconnect machinery, exactly
+                    # like a real conn loss would.
+                    inject = protocol.ConnectionLost(
+                        f"failpoint: injected gcs_request {act.kind} "
+                        f"({method})")
+        attempts = max(1, cfg.gcs_reconnect_attempts)
+        backoff = retry.ExpBackoff(cfg.gcs_reconnect_base_s,
+                                   cfg.gcs_reconnect_cap_s)
+        last_error: Exception | None = None
+        failed = None
+        # Attempt 0 is the request on the existing connection; attempts
+        # 1..N reconnect first.  One loop, one classification of what
+        # retries vs what surfaces.
+        for attempt in range(attempts + 1):
+            try:
+                if attempt > 0:
+                    if self._gcs_reconnect_lock is None:
+                        self._gcs_reconnect_lock = asyncio.Lock()
+                    async with self._gcs_reconnect_lock:
+                        if self.gcs is failed or self.gcs.closed:
+                            if failpoints.ACTIVE:
+                                act = failpoints.check(
+                                    "worker.gcs_reconnect")
+                                if act is not None:
+                                    if act.kind == "delay":
+                                        await asyncio.sleep(act.delay_s)
+                                    elif act.kind != "off":
+                                        raise protocol.ConnectionLost(
+                                            "failpoint: injected "
+                                            f"gcs_reconnect {act.kind}")
+                            old = self.gcs
+                            try:
+                                self.gcs = (
+                                    await protocol.Connection.connect(
+                                        self.gcs_addr[0],
+                                        self.gcs_addr[1],
+                                        handler=self._handle,
+                                        name="cw->gcs",
+                                        timeout=cfg.connect_timeout_s))
+                            except asyncio.TimeoutError as e:
+                                # Connect timeout = failed reconnect
+                                # ATTEMPT (SYN black-holed partition) —
+                                # classify as conn failure so the
+                                # bounded retry keeps going.
+                                raise ConnectionError(
+                                    "connect timed out after "
+                                    f"{cfg.connect_timeout_s}s") from e
+                            if old is not None and not old.closed:
+                                try:
+                                    await old.close()
+                                except Exception:
+                                    pass
+                if inject is not None:
+                    e, inject = inject, None
+                    raise e
+                return await self.gcs.request(method, body,
+                                              timeout=timeout)
+            except asyncio.TimeoutError:
+                # Request deadline with the connection still healthy
+                # (the keepalive would have failed it otherwise): the
+                # GCS may already be executing this RPC, so neither
+                # tear down the shared connection nor re-send — surface
+                # the deadline.  Caught before the conn-loss clause: on
+                # py3.11+ TimeoutError is an OSError subclass.
                 raise
-            failed = self.gcs
-            if self._gcs_reconnect_lock is None:
-                self._gcs_reconnect_lock = asyncio.Lock()
-            async with self._gcs_reconnect_lock:
-                if self.gcs is failed or self.gcs.closed:
-                    old = self.gcs
-                    self.gcs = await protocol.Connection.connect(
-                        self.gcs_addr[0], self.gcs_addr[1],
-                        handler=self._handle, name="cw->gcs",
-                        timeout=cfg.connect_timeout_s)
-                    if old is not None and not old.closed:
-                        try:
-                            await old.close()
-                        except Exception:
-                            pass
-            return await self.gcs.request(method, body, timeout=timeout)
+            except (protocol.ConnectionLost, ConnectionError,
+                    OSError) as e:
+                if self._shutdown:
+                    raise
+                last_error = e
+                failed = self.gcs
+                if attempt < attempts:
+                    await asyncio.sleep(backoff.next())
+        raise ConnectionError(
+            f"GCS at {self.gcs_addr[0]}:{self.gcs_addr[1]} unreachable "
+            f"after {attempts} reconnect attempt(s); last error: "
+            f"{last_error}") from last_error
 
     async def _connect(self):
         self.addr = (self.host, await self.server.start(0))
@@ -537,9 +612,12 @@ class CoreWorker:
             pass
         while not self._shutdown:
             t0 = time.monotonic()
-            await asyncio.sleep(2.0)
+            # Jittered: thousands of workers pushing telemetry must not
+            # beat against the GCS KV in phase.
+            tick = retry.jittered(2.0)
+            await asyncio.sleep(tick)
             if lag_gauge is not None:
-                lag = max(0.0, (time.monotonic() - t0 - 2.0) * 1000)
+                lag = max(0.0, (time.monotonic() - t0 - tick) * 1000)
                 try:
                     lag_gauge.set(round(lag, 2), tags={"mode": self.mode})
                 except Exception:
@@ -593,19 +671,26 @@ class CoreWorker:
             entry.state = INLINE
         else:
             offset = await self._store_create(oid.binary(), size)
-            blob.write_into(self.mapping.slice(offset, size))
-            await self.raylet.request("os_seal", {"oid": oid.binary()})
+            if offset is not None:
+                blob.write_into(self.mapping.slice(offset, size))
+                await self.raylet.request("os_seal", {"oid": oid.binary()})
             entry.location = self.node_id
             entry.size = size
             entry.state = IN_STORE
         entry.set_ready()
         return ObjectRef(oid, owner_addr=self.addr, _track=True)
 
-    async def _store_create(self, oid_bin: bytes, size: int) -> int:
+    async def _store_create(self, oid_bin: bytes, size: int):
+        """Allocate ``oid`` in the local store; returns the arena offset,
+        or None when a copy already exists there (idempotent create —
+        reconstruction re-ran the producing task on a node that never
+        lost the object; the caller skips its write+seal)."""
         reply = await self.raylet.request("os_create",
                                           {"oid": oid_bin, "size": size})
         if "error" in reply:
             raise rexc.ObjectLostError(oid_bin.hex(), reply["error"])
+        if reply.get("exists"):
+            return None
         return reply["offset"]
 
     def get(self, refs, timeout=None):
@@ -1422,11 +1507,13 @@ class CoreWorker:
                     spec_probe["pg_id"], spec_probe.get("bundle_index"))
             for _hop in range(4):
                 pool.outstanding[request_id] = conn
-                # No RPC timeout: a cluster-wide-infeasible request stays
+                # Explicit timeout=None (NOT the config default
+                # deadline): a cluster-wide-infeasible request stays
                 # queued at the raylet as autoscaler demand (reference:
                 # infeasible tasks wait for scale-up, they don't error).
-                # Conn loss / explicit cancellation still wake this.
-                reply = await conn.request("request_worker_lease", body)
+                # Conn loss / keepalive / cancellation still wake this.
+                reply = await conn.request("request_worker_lease", body,
+                                           timeout=None)
                 pool.outstanding.pop(request_id, None)
                 if "spillback" in reply:
                     addr = tuple(reply["spillback"])
@@ -1941,8 +2028,10 @@ class CoreWorker:
         if size <= cfg.max_direct_call_object_size or self.raylet is None:
             return ("inline", blob.to_bytes())
         offset = self._run(self._store_create(oid.binary(), size))
-        blob.write_into(self.mapping.slice(offset, size))
-        self._run(self.raylet.request("os_seal", {"oid": oid.binary()}))
+        if offset is not None:
+            blob.write_into(self.mapping.slice(offset, size))
+            self._run(self.raylet.request("os_seal",
+                                          {"oid": oid.binary()}))
         return ("store", self.node_id, size)
 
     # --------------------------------------------------------------- actors
@@ -1996,6 +2085,24 @@ class CoreWorker:
         caller = body["caller_id"]
         seq = body["seq"]
         expected = self._caller_seq.get(caller, 0)
+        if seq < expected:
+            # Wire-level duplicate of a frame this stream already
+            # consumed (dup'd frame, retransmit): NEVER re-execute.
+            # Replays after an actor restart are not this case —
+            # recovery re-mints fresh seqs for the unacked window, so
+            # they arrive in-stream and run normally.  If the original
+            # dispatch is still running we must ride its result: both
+            # replies share the duplicated frame's msg_id, so a bare
+            # ack could reach the caller FIRST and the real reply
+            # (carrying the task's results) would then be dropped as a
+            # stale msg_id — the results would be lost, not just the
+            # frame deduped.  Once the original has completed, its
+            # reply is already on the wire ahead of ours (same conn,
+            # FIFO), so a generic ack is safe.
+            w = self._dup_waiter(caller, seq)
+            if w is not None:
+                return await w
+            return {"ok": True, "duplicate": True}
         if seq != expected:
             fut = self.loop.create_future()
             heapq.heappush(self._caller_buffer.setdefault(caller, []),
@@ -2003,8 +2110,51 @@ class CoreWorker:
             return await fut
         return await self._run_actor_task_in_order(caller, body)
 
+    def _dup_waiter(self, caller, seq):
+        """A future riding the still-running original dispatch of
+        ``seq``, or None when that dispatch already completed (its
+        reply is then already ahead of any ack on the wire)."""
+        running = self._caller_running.get(caller)
+        if not running or seq not in running:
+            return None
+        w = self.loop.create_future()
+        self._dup_waiters.setdefault((caller, seq), []).append(w)
+        return w
+
+    def _finish_caller_task(self, caller, seq, result, exc):
+        """Retire a tracked dispatch and resolve any duplicate-frame
+        waiters with the same outcome.  The hot path (no duplicates
+        anywhere) pays one set.discard and one empty-dict truth test."""
+        running = self._caller_running.get(caller)
+        if running is not None:
+            running.discard(seq)
+            if not running:
+                self._caller_running.pop(caller, None)
+        if self._dup_waiters:
+            for w in self._dup_waiters.pop((caller, seq), ()):
+                if w.cancelled():
+                    continue
+                if exc is not None:
+                    w.set_exception(exc)
+                else:
+                    w.set_result(result)
+
+    async def _run_tracked(self, caller, body):
+        """_dispatch_actor_task plus duplicate-frame bookkeeping (the
+        seq must already be in _caller_running)."""
+        seq = body["seq"]
+        try:
+            result = await self._dispatch_actor_task(body)
+        except BaseException as e:
+            self._finish_caller_task(caller, seq, None, e)
+            raise
+        self._finish_caller_task(caller, seq, result, None)
+        return result
+
     async def _run_actor_task_in_order(self, caller, body):
-        self._caller_seq[caller] = body["seq"] + 1
+        seq = body["seq"]
+        self._caller_seq[caller] = seq + 1
+        self._caller_running.setdefault(caller, set()).add(seq)
         # Release any buffered next-in-line tasks.
         buf = self._caller_buffer.get(caller)
         if not buf:
@@ -2012,13 +2162,48 @@ class CoreWorker:
             # the dispatch directly — no Task allocation.  A successor
             # arriving mid-dispatch sees the advanced seq and dispatches
             # itself; only out-of-order arrivals need the buffer path.
-            return await self._dispatch_actor_task(body)
-        dispatch_coro = self._dispatch_actor_task(body)
-        task = self.loop.create_task(dispatch_coro)
-        while buf and buf[0][0] == self._caller_seq[caller]:
+            # (Tracking is inlined too: no wrapper coroutine here.)
+            try:
+                result = await self._dispatch_actor_task(body)
+            except BaseException as e:
+                self._finish_caller_task(caller, seq, None, e)
+                raise
+            self._finish_caller_task(caller, seq, result, None)
+            return result
+        task = self.loop.create_task(self._run_tracked(caller, body))
+        # ONE release loop for both cases, because they interleave: a
+        # buffered duplicate of a seq released *by this very loop*
+        # surfaces at the heap front between releases, and two split
+        # loops would neither ack it nor reach the entries behind it
+        # (stranding the caller's whole stream).  Duplicates (< seq)
+        # are never dispatched: they ride the original's still-running
+        # result or get a generic ack; next-in-line entries dispatch
+        # and advance the stream.
+        while buf:
+            expected = self._caller_seq[caller]
+            if buf[0][0] < expected:
+                _seq, _tie, fut, _dup = heapq.heappop(buf)
+                if fut.cancelled():
+                    continue
+                w = self._dup_waiter(caller, _seq)
+                if w is None:
+                    fut.set_result({"ok": True, "duplicate": True})
+                else:
+                    def _ride(t, f=fut):
+                        if f.cancelled():
+                            return
+                        if t.exception() is not None:
+                            f.set_exception(t.exception())
+                        else:
+                            f.set_result(t.result())
+                    w.add_done_callback(_ride)
+                continue
+            if buf[0][0] != expected:
+                break
             _seq, _tie, fut, nxt = heapq.heappop(buf)
             self._caller_seq[caller] = nxt["seq"] + 1
-            nxt_task = self.loop.create_task(self._dispatch_actor_task(nxt))
+            self._caller_running.setdefault(caller, set()).add(nxt["seq"])
+            nxt_task = self.loop.create_task(self._run_tracked(caller, nxt))
 
             def _transfer(t, f=fut):
                 if f.cancelled():
@@ -2616,6 +2801,11 @@ class CoreWorker:
     # ------------------------------------------------------------ misc rpc
     async def rpc_ping(self, conn, body):
         return {"ok": True, "mode": self.mode}
+
+    async def rpc_set_failpoints(self, conn, body):
+        """Runtime fault-plane toggle: tests flip failpoints / partition
+        rules on a live worker mid-run (see failpoints.apply_rpc)."""
+        return failpoints.apply_rpc(body)
 
     async def rpc_exit(self, conn, body):
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)

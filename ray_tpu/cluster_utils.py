@@ -87,6 +87,26 @@ class Cluster:
         return asyncio.run_coroutine_threadsafe(
             _wait(), self.loop).result(timeout + 10)
 
+    # ------------------------------------------------ fault injection
+    # Message-level faults (ray_tpu._private.failpoints): all cluster
+    # members live in THIS process, so installing connection rules here
+    # re-resolves every live link immediately.
+    def partition(self, a, b, one_way: bool = False):
+        """Cut the link between two members (either may be "gcs").
+        one_way=True drops only a→b traffic (half-open link)."""
+        from ray_tpu._private.test_utils import partition
+        partition(a, b, one_way=one_way)
+
+    def slow_link(self, a, b, delay_s: float):
+        """Add delay_s of one-way latency between two members."""
+        from ray_tpu._private.test_utils import slow_link
+        slow_link(a, b, delay_s)
+
+    def heal(self):
+        """Remove every partition / slow-link rule."""
+        from ray_tpu._private.test_utils import heal
+        heal()
+
     def restart_gcs(self):
         """Kill and restart the head GCS on the same port, reloading state
         from its snapshot (reference: GCS failover with Redis persistence,

@@ -1,0 +1,596 @@
+"""Chaos battery: deterministic, seeded message-level fault injection
+(ray_tpu._private.failpoints) and the runtime hardening it exercises —
+keepalive half-open detection, bounded request deadlines, jittered GCS
+reconnects, partition/heal survival, duplicate-frame dedup.
+
+Reference: FoundationDB's deterministic simulation (Zhou et al., SIGMOD
+'21) — every red run replays from its seed (`make chaos
+CHAOS_SEED=<printed seed>`); the Ray ownership paper (Wang et al., NSDI
+'21) — recovery exercised at the message level, not just by killing
+processes.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints, protocol, retry
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.test_utils import node_tag
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    """No test may leak an armed failpoint or partition rule."""
+    yield
+    failpoints.configure("")
+    failpoints.clear_conn_rules()
+
+
+def _run_async(coro):
+    return asyncio.run(coro)
+
+
+def _run(cluster, coro, timeout=120):
+    return asyncio.run_coroutine_threadsafe(coro, cluster.loop).result(timeout)
+
+
+# ------------------------------------------------- spec grammar + registry
+
+
+def test_spec_parsing_grammar():
+    fps = failpoints.parse(
+        "a.b=error;c.d=delay(250)|p=0.5|hits=3-6;e.f=drop|times=2|peer=n1")
+    assert [fp.name for fp in fps] == ["a.b", "c.d", "e.f"]
+    assert fps[0].action.kind == "error"
+    assert fps[1].action.kind == "delay"
+    assert fps[1].action.delay_s == 0.25
+    assert fps[1].prob == 0.5 and (fps[1].first, fps[1].last) == (3, 6)
+    assert fps[2].times == 2 and fps[2].peer == "n1"
+
+
+@pytest.mark.parametrize("bad", [
+    "noequals", "=error", "a.b=frobnicate", "a.b=delay(5",
+    "a.b=drop|wat=1",
+])
+def test_spec_parse_errors(bad):
+    with pytest.raises(ValueError):
+        failpoints.parse(bad)
+
+
+def test_off_action_clears_and_configure_replaces():
+    failpoints.configure("a.b=error")
+    assert failpoints.check("a.b") is not None
+    failpoints.set_failpoint("a.b=off")
+    assert failpoints.check("a.b") is None
+    failpoints.configure("c.d=drop")
+    assert failpoints.check("a.b") is None
+    assert failpoints.check("c.d").kind == "drop"
+    failpoints.configure("")
+    assert not failpoints.ACTIVE
+
+
+def test_hits_window_times_and_peer_modifiers():
+    failpoints.configure("w.x=drop|hits=3-5")
+    fired = [failpoints.check("w.x") is not None for _ in range(8)]
+    assert fired == [False, False, True, True, True, False, False, False]
+
+    failpoints.configure("w.x=drop|times=2")
+    fired = [failpoints.check("w.x") is not None for _ in range(5)]
+    assert fired == [True, True, False, False, False]
+
+    failpoints.configure("w.x=drop|peer=nodeA")
+    assert failpoints.check("w.x", peer="raylet:nodeA->gcs") is not None
+    assert failpoints.check("w.x", peer="raylet:nodeB->gcs") is None
+    assert failpoints.check("w.x") is None  # no peer given -> no match
+
+
+def test_same_seed_identical_schedule():
+    """The acceptance gate: two runs with the same RT_CHAOS_SEED inject
+    the identical fault schedule (decision log equality)."""
+    failpoints.configure("x.y=drop|p=0.4", seed=1234)
+    sched1 = [failpoints.check("x.y") is not None for _ in range(300)]
+    log1 = list(failpoints.LOG)
+    failpoints.configure("x.y=drop|p=0.4", seed=1234)
+    sched2 = [failpoints.check("x.y") is not None for _ in range(300)]
+    assert sched1 == sched2
+    assert log1 == list(failpoints.LOG)
+    assert any(sched1) and not all(sched1)  # p=0.4 really sampled
+    failpoints.configure("x.y=drop|p=0.4", seed=4321)
+    sched3 = [failpoints.check("x.y") is not None for _ in range(300)]
+    assert sched3 != sched1  # a different seed is a different schedule
+
+
+def test_streams_independent_of_interleaving():
+    """Failpoint streams are per-name: hit #k of one failpoint draws
+    the same decision no matter how other failpoints interleave."""
+    spec = "a.a=drop|p=0.5;b.b=drop|p=0.5"
+    failpoints.configure(spec, seed=7)
+    alone = [failpoints.check("a.a") is not None for _ in range(60)]
+    failpoints.configure(spec, seed=7)
+    interleaved = []
+    for _ in range(60):
+        interleaved.append(failpoints.check("a.a") is not None)
+        failpoints.check("b.b")  # extra draws on ANOTHER stream
+    assert alone == interleaved
+
+
+def test_apply_rpc_body_semantics():
+    out = failpoints.apply_rpc({"specs": "m.n=error|times=1", "seed": 9})
+    assert out["seed"] == 9
+    assert [d["name"] for d in out["active"]] == ["m.n"]
+    out = failpoints.apply_rpc({"add": "p.q=drop"})
+    assert sorted(d["name"] for d in out["active"]) == ["m.n", "p.q"]
+    out = failpoints.apply_rpc(
+        {"conn_rules": [[["x->", "->y"], {"drop_tx": True}]]})
+    assert out["conn_rules"] == [[["x->", "->y"], {"drop_tx": True}]]
+    f = failpoints.conn_fault_for("x->somewhere->y")
+    assert f is not None and f.drop_tx and not f.drop_rx
+    assert failpoints.conn_fault_for("y->x") is None  # AND-match
+    out = failpoints.apply_rpc({"specs": "", "conn_rules": []})
+    assert out["active"] == [] and out["conn_rules"] == []
+
+
+def test_backoff_full_jitter_bounded():
+    b = retry.ExpBackoff(0.1, 1.0, rng=__import__("random").Random(3))
+    delays = [b.next() for _ in range(10)]
+    caps = [min(1.0, 0.1 * 2 ** i) for i in range(10)]
+    assert all(0.001 <= d <= c for d, c in zip(delays, caps))
+    b.reset()
+    assert b.attempt == 0
+    assert 1.5 <= retry.jittered(2.0, frac=0.25) <= 2.5
+
+
+# ------------------------------------------------------ protocol plane
+
+
+def test_recv_drop_then_recover():
+    """A dropped request frame surfaces as a deadline, not a hang, and
+    the connection keeps working once the hits window passes."""
+
+    async def scenario():
+        async def handler(conn, method, body):
+            return body
+
+        srv = protocol.RpcServer(handler, name="fp-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="fp-cli")
+        try:
+            failpoints.configure("protocol.recv=drop|peer=fp-srv-peer"
+                                 "|hits=1")
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.request("echo", 1, timeout=0.4)
+            assert await conn.request("echo", 2, timeout=5) == 2
+        finally:
+            failpoints.configure("")
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+def test_recv_delay_injects_latency():
+    async def scenario():
+        async def handler(conn, method, body):
+            return body
+
+        srv = protocol.RpcServer(handler, name="fp-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="fp-cli")
+        try:
+            failpoints.configure("protocol.recv=delay(300)"
+                                 "|peer=fp-srv-peer|times=1")
+            t0 = time.monotonic()
+            assert await conn.request("echo", 5, timeout=10) == 5
+            assert time.monotonic() - t0 >= 0.28
+        finally:
+            failpoints.configure("")
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+def test_injected_disconnect_fails_inflight():
+    async def scenario():
+        async def handler(conn, method, body):
+            return body
+
+        srv = protocol.RpcServer(handler, name="fp-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="fp-cli")
+        try:
+            failpoints.configure(
+                "protocol.recv=disconnect|peer=fp-srv-peer|times=1")
+            with pytest.raises(protocol.ConnectionLost):
+                await conn.request("echo", 1, timeout=10)
+        finally:
+            failpoints.configure("")
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+def test_dup_push_frame_dispatched_twice():
+    """The dup action really duplicates delivery (the runtime's dedup
+    layers are tested separately on top of this primitive)."""
+
+    async def scenario():
+        hits = []
+
+        async def handler(conn, method, body):
+            hits.append((method, body))
+
+        srv = protocol.RpcServer(handler, name="fp-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="fp-cli")
+        try:
+            failpoints.configure("protocol.recv=dup|peer=fp-srv-peer")
+            await conn.push("bump", 7)
+            for _ in range(100):
+                if len(hits) >= 2:
+                    break
+                await asyncio.sleep(0.01)
+            assert hits == [("bump", 7), ("bump", 7)]
+        finally:
+            failpoints.configure("")
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+def test_default_request_deadline(monkeypatch):
+    """An unspecified timeout gets the config deadline (no accidental
+    unbounded wait); an explicit timeout=None still opts out."""
+
+    async def scenario():
+        async def handler(conn, method, body):
+            await asyncio.sleep(0.6)
+            return body
+
+        srv = protocol.RpcServer(handler, name="ddl-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="ddl-cli")
+        try:
+            monkeypatch.setattr(cfg, "rpc_request_timeout_s", 0.25)
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.request("slow", 1)
+            assert await conn.request("slow", 2, timeout=None) == 2
+        finally:
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+def test_half_open_detected_by_keepalive(monkeypatch):
+    """One direction of a link dies (replies and PONGs black-hole): the
+    keepalive probe detects the silence and fails the in-flight future
+    with ConnectionLost instead of letting it hang forever.  An idle
+    connection with nothing in flight is NOT probed to death."""
+    monkeypatch.setattr(cfg, "rpc_keepalive_idle_s", 0.3)
+    monkeypatch.setattr(cfg, "rpc_keepalive_timeout_s", 0.3)
+
+    async def scenario():
+        async def handler(conn, method, body):
+            return body
+
+        srv = protocol.RpcServer(handler, name="ka-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="ka-cli")
+        try:
+            # Idle + healthy: several keepalive cycles pass, no kill.
+            await asyncio.sleep(1.0)
+            assert not conn.closed
+            assert await conn.request("echo", 1, timeout=5) == 1
+
+            # Go half-open: everything the server sends back (replies,
+            # PONGs) is dropped on the client's inbound side.
+            failpoints.add_conn_rule(("ka-cli",), drop_rx=True)
+            t0 = time.monotonic()
+            with pytest.raises(protocol.ConnectionLost) as ei:
+                await conn.request("echo", 2, timeout=None)
+            assert time.monotonic() - t0 < 5.0  # detected, not hung
+            assert "keepalive" in str(ei.value)
+        finally:
+            failpoints.clear_conn_rules()
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+def test_one_way_conn_rule_and_heal():
+    """drop_tx black-holes outbound frames on a live connection (the
+    rule is installed AFTER the conn exists — the live-conn sweep must
+    re-resolve it), and heal() restores service."""
+
+    async def scenario():
+        async def handler(conn, method, body):
+            return body
+
+        srv = protocol.RpcServer(handler, name="ow-srv")
+        port = await srv.start(0)
+        conn = await protocol.Connection.connect("127.0.0.1", port,
+                                                 name="ow-cli")
+        try:
+            assert await conn.request("echo", 1, timeout=5) == 1
+            failpoints.add_conn_rule(("ow-cli",), drop_tx=True)
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.request("echo", 2, timeout=0.4)
+            failpoints.clear_conn_rules()
+            assert await conn.request("echo", 3, timeout=5) == 3
+        finally:
+            failpoints.clear_conn_rules()
+            await conn.close()
+            await srv.stop()
+
+    _run_async(scenario())
+
+
+# ------------------------------------------------------- cluster plane
+
+
+def test_one_way_partition_multi_source_pull(ray_start_cluster,
+                                             monkeypatch):
+    """Acceptance: a one-way partition during a multi-source transfer
+    pull — the black-holed source's chunks reissue to the surviving
+    source (keepalive turns the silent link into ConnectionLost, the
+    windowed pull reroutes) and the transfer completes.  Never hangs."""
+    monkeypatch.setattr(cfg, "rpc_keepalive_idle_s", 0.4)
+    monkeypatch.setattr(cfg, "rpc_keepalive_timeout_s", 0.4)
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 512 * 1024)
+    monkeypatch.setattr(cfg, "transfer_stripe_min_bytes", 1024 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    c = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(3)
+    cluster.connect()
+
+    import numpy as np
+    blob = np.random.RandomState(11).bytes(6 * 1024 * 1024)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    # Second sealed copy on C, visible in the GCS object directory so
+    # B's pull stripes across {A, C}.
+    assert _run(cluster, a.raylet.transfers.push(oid, c.raylet.node_id))
+    gcs = cluster.head.gcs_server
+    for _ in range(100):
+        if c.raylet.node_id in gcs.object_locations.get(oid, ()):
+            break
+        time.sleep(0.05)
+    assert c.raylet.node_id in gcs.object_locations.get(oid, ())
+
+    def _bytes(node):
+        async def _read():
+            got = node.raylet.store.get(oid)
+            assert got is not None and got[2]
+            off, size, _ = got
+            data = bytes(node.raylet.mapping.slice(off, size))
+            node.raylet.store.release(oid)
+            return data
+        return _run(cluster, _read())
+
+    # Source of truth: A's sealed store bytes (the object's serialized
+    # form, not the raw blob — put() pickles).
+    expected = _bytes(a)
+
+    # Slow each chunk fetch so the windowed pull is still striping when
+    # the partition lands — "partition DURING transfer", deterministically
+    # (12 chunks x >=150ms each across a window of 4 keeps the pull in
+    # flight for ~450ms+; we cut the link right after chunk #1 seals).
+    failpoints.set_failpoint("transfer.pull_chunk=delay(150)")
+    base_retries = b.raylet.transfers.stats["chunk_retries"]
+
+    t0 = time.monotonic()
+    fut = asyncio.run_coroutine_threadsafe(
+        b.raylet._pull_object(oid, a.raylet.node_id,
+                              time.monotonic() + 60), cluster.loop)
+    for _ in range(2000):
+        if b.raylet.transfers.stats["pull_chunks"] >= 1:
+            break
+        time.sleep(0.005)
+    assert b.raylet.transfers.stats["pull_chunks"] >= 1, \
+        "pull never issued its first chunk"
+
+    # One-way partition mid-pull: B's frames toward C vanish (chunk
+    # requests black-hole); C->B stays up.  Exactly the half-open case —
+    # B's keepalive probe goes unanswered, the link fails with
+    # ConnectionLost, and C's chunks reissue to A.
+    cluster.partition(b, c, one_way=True)
+
+    ok = fut.result(timeout=90)
+    assert ok, "pull must complete via the surviving source"
+    assert time.monotonic() - t0 < 60
+
+    failpoints.clear("transfer.pull_chunk")
+    assert _bytes(b) == expected
+    stats = _run(cluster, b.raylet.rpc_transfer_stats(None, {}))
+    assert stats["chunk_retries"] > base_retries, \
+        "partitioned source's chunks must have been reissued"
+    assert stats["striped_pulls"] >= 1, \
+        "the pull must have striped across both sources"
+    cluster.heal()
+
+
+def test_fully_partitioned_single_source_times_out(ray_start_cluster,
+                                                   monkeypatch):
+    """With the ONLY source partitioned away, a driver get() surfaces
+    GetTimeoutError — never a hang."""
+    monkeypatch.setattr(cfg, "rpc_keepalive_idle_s", 0.4)
+    monkeypatch.setattr(cfg, "rpc_keepalive_timeout_s", 0.4)
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1, resources={"a": 1})
+    b = cluster.add_node(num_cpus=1, resources={"b": 1})
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    import numpy as np
+
+    @ray_tpu.remote(resources={"a": 1})
+    def make():
+        return np.arange(200_000)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def consume(x):
+        return int(x[0])
+
+    ref = make.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 0
+
+    @ray_tpu.remote(resources={"a": 1})
+    def big():
+        return np.random.RandomState(5).bytes(2 * 1024 * 1024)
+
+    ref2 = big.remote()
+    ray_tpu.wait([ref2], timeout=60)
+    cluster.partition(a, b)
+    try:
+        with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+            ray_tpu.get(consume.remote(ref2), timeout=8)
+    finally:
+        cluster.heal()
+
+
+def test_gcs_partition_and_heal_scheduling_throughout(ray_start_cluster,
+                                                      monkeypatch):
+    """Acceptance: partition a worker node from the GCS, heal inside
+    the liveness grace window.  The rest of the cluster schedules
+    throughout, the partitioned node is never falsely killed, and it
+    resumes serving after the heal."""
+    monkeypatch.setattr(cfg, "heartbeat_period_ms", 300)
+    monkeypatch.setattr(cfg, "heartbeat_timeout_ms", 20000)
+    monkeypatch.setattr(cfg, "rpc_keepalive_idle_s", 0.5)
+    monkeypatch.setattr(cfg, "rpc_keepalive_timeout_s", 0.5)
+    monkeypatch.setattr(cfg, "gcs_reconnect_base_s", 0.1)
+    monkeypatch.setattr(cfg, "gcs_reconnect_cap_s", 0.5)
+    cluster = ray_start_cluster
+    head = cluster.add_node(num_cpus=2, resources={"head": 1})
+    b = cluster.add_node(num_cpus=1, resources={"spot": 1})
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"head": 0.1})
+    def on_head(x):
+        return x + 1
+
+    @ray_tpu.remote(resources={"spot": 0.1})
+    def on_spot(x):
+        return x * 2
+
+    assert ray_tpu.get(on_spot.remote(3), timeout=60) == 6
+
+    cluster.partition(b, "gcs")
+    t_end = time.monotonic() + 2.5
+    n = 0
+    while time.monotonic() < t_end:
+        # The control-plane partition of ONE node must not stall
+        # scheduling elsewhere.
+        assert ray_tpu.get(on_head.remote(n), timeout=30) == n + 1
+        n += 1
+    assert n >= 3
+    cluster.heal()
+
+    # B re-registers (jittered bounded retries) and serves again.
+    deadline = time.monotonic() + 30
+    out = None
+    while time.monotonic() < deadline:
+        try:
+            out = ray_tpu.get(on_spot.remote(5), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.25)
+    assert out == 10, "partitioned node never came back after heal"
+
+    # Within the grace window the whole time: never marked dead.
+    gcs = cluster.head.gcs_server
+    info = gcs.nodes.get(b.raylet.node_id)
+    assert info is not None and info.alive
+    b_tag = b.raylet.node_id.hex()[:8]
+    deaths = [e for e in gcs.events
+              if e["label"] == "NODE_DEAD" and b_tag in e["message"]]
+    assert deaths == [], f"node falsely declared dead: {deaths}"
+
+
+def test_delayed_heartbeats_within_grace_not_killed(ray_start_cluster,
+                                                    monkeypatch):
+    """Acceptance: heartbeats delayed (via the failpoint armed OVER THE
+    set_failpoints RPC, mid-run) still land inside the liveness grace
+    window — the node must not be declared dead."""
+    monkeypatch.setattr(cfg, "heartbeat_period_ms", 300)
+    monkeypatch.setattr(cfg, "heartbeat_timeout_ms", 2500)
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+
+    b_tag = node_tag(b)
+
+    async def _toggle(body):
+        conn = await protocol.Connection.connect(
+            cluster.head.gcs_addr[0], cluster.head.gcs_addr[1],
+            name="chaos-ctl")
+        try:
+            return await conn.request("set_failpoints", body, timeout=10)
+        finally:
+            await conn.close()
+
+    # Arm mid-run over RPC (in-process cluster: the GCS shares the
+    # failpoint registry with the raylets under test).
+    out = _run(cluster, _toggle(
+        {"add": f"raylet.heartbeat=delay(400)|peer={b_tag[-8:]}"}))
+    assert any(d["name"] == "raylet.heartbeat" for d in out["active"])
+
+    time.sleep(2.5)  # several delayed-but-delivered beats
+
+    gcs = cluster.head.gcs_server
+    info = gcs.nodes.get(b.raylet.node_id)
+    assert info is not None and info.alive, \
+        "delayed heartbeats within grace must not kill the node"
+    assert any(name == "raylet.heartbeat" and fired
+               for name, _hit, fired, _kind in failpoints.LOG), \
+        "the delay failpoint never fired"
+
+    out = _run(cluster, _toggle({"specs": ""}))
+    assert out["active"] == []
+
+
+def test_gcs_reconnect_bounded_with_terminal_error(ray_start_cluster,
+                                                   monkeypatch):
+    """Satellite: the core-worker GCS path retries with backoff and,
+    when the GCS stays unreachable, fails with a terminal error naming
+    the GCS address (was: reconnect exactly once)."""
+    monkeypatch.setattr(cfg, "gcs_reconnect_attempts", 3)
+    monkeypatch.setattr(cfg, "gcs_reconnect_base_s", 0.05)
+    monkeypatch.setattr(cfg, "gcs_reconnect_cap_s", 0.1)
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(1)
+    cw = cluster.connect()
+
+    failpoints.configure("worker.gcs_request=error;"
+                         "worker.gcs_reconnect=error")
+    try:
+        with pytest.raises(ConnectionError) as ei:
+            _run(cluster, cw._gcs_request("get_nodes", {}))
+        msg = str(ei.value)
+        host, port = cluster.head.gcs_addr
+        assert f"{host}:{port}" in msg and "3 reconnect attempt" in msg
+    finally:
+        failpoints.configure("")
+    # And with the fault plane cleared the same path works again.
+    assert _run(cluster, cw._gcs_request("get_nodes", {})) is not None

@@ -401,3 +401,96 @@ def test_ready_future_fast_path(ray_start_regular):
     w.ready_future(big_ref).result(timeout=60)
     taken, _ = w.try_take_local_value(big_ref)
     assert not taken
+
+
+# ------------------------------------------------- duplicate-frame dedup
+
+
+def test_duplicated_actor_task_frames_deduped_by_seq(ray_start_regular):
+    """Chaos `dup` action on the actor submission conn: every frame the
+    driver sends to the actor's worker goes on the wire TWICE.  The
+    executor's per-caller seq stream must treat the second copy as a
+    wire-level duplicate — acked, never re-executed — so a stateful
+    actor sees each call exactly once (satellite: duplicate
+    push_actor_task delivery)."""
+    from ray_tpu._private import failpoints
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def total(self):
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1  # conn warm
+
+    fp = failpoints.set_failpoint("protocol.send=dup|peer=cw->actor")
+    try:
+        got = ray_tpu.get([c.incr.remote() for _ in range(10)],
+                          timeout=60)
+        # Submissions coalesce into KIND_BATCH frames, so one fire can
+        # duplicate many tasks at once — what matters is that at least
+        # one frame carrying tasks really went out twice.
+        assert fp.fired >= 1, "dup failpoint never matched the conn"
+    finally:
+        failpoints.configure("")
+    # In-order, each exactly once: 2..11, not double-bumped.
+    assert got == list(range(2, 12))
+    assert ray_tpu.get(c.total.remote(), timeout=60) == 11
+
+
+def test_buffered_duplicate_does_not_wedge_seq_stream():
+    """A duplicate frame that lands in the out-of-order BUFFER (its seq
+    not yet released) must be acked when its seq releases — and must
+    not stop the release loop from reaching the genuine next-in-line
+    entries behind it (regression: two split release loops stranded
+    the stream forever)."""
+    from ray_tpu._private.worker import CoreWorker
+
+    class Stub:
+        pass
+
+    executed = []
+
+    async def scenario():
+        w = Stub()
+        w.loop = asyncio.get_running_loop()
+        w._caller_seq = {}
+        w._caller_buffer = {}
+        w._caller_running = {}
+        w._dup_waiters = {}
+        for name in ("rpc_push_actor_task", "_run_actor_task_in_order",
+                     "_run_tracked", "_dup_waiter", "_finish_caller_task"):
+            setattr(w, name, getattr(CoreWorker, name).__get__(w))
+
+        async def dispatch(body):
+            executed.append(body["seq"])
+            return {"ok": True, "seq": body["seq"]}
+
+        w._dispatch_actor_task = dispatch
+
+        def frame(seq):
+            return {"caller_id": "c1", "seq": seq, "method": "m"}
+
+        # Out-of-order arrivals: seqs 1, dup-of-1, 2 all buffer ahead
+        # of seq 0.  Releasing 0 must dispatch 1 exactly once, ack the
+        # duplicate, and still reach 2.
+        later = [asyncio.ensure_future(w.rpc_push_actor_task(None, frame(s)))
+                 for s in (1, 1, 2)]
+        await asyncio.sleep(0)  # all three parked in the buffer
+        first = await w.rpc_push_actor_task(None, frame(0))
+        assert first == {"ok": True, "seq": 0}
+        replies = await asyncio.wait_for(asyncio.gather(*later), timeout=5)
+        assert executed == [0, 1, 2], "each seq exactly once, in order"
+        # One of the two seq-1 replies is the dispatch result, the
+        # other a duplicate ack (or rode the original's result).
+        assert {"ok": True, "seq": 1} in replies[:2]
+        assert replies[2] == {"ok": True, "seq": 2}
+
+    _run_async(scenario())

@@ -500,3 +500,43 @@ def test_short_chunk_reply_fails_pull(ray_start_cluster, monkeypatch):
     unsealed, present = _run(cluster, state())
     assert unsealed == 0
     assert not present
+
+
+def test_duplicated_push_chunks_deduped_by_offset(ray_start_cluster,
+                                                  monkeypatch):
+    """Chaos `dup` on transfer.push_chunk: every chunk of a push is
+    delivered twice.  The receiver's per-offset chunk set (plus the
+    transfer generation) must count each offset once — the object seals
+    only when every DISTINCT chunk arrived, with no double-counted
+    bytes and byte-exact content (satellite: duplicate transfer-chunk
+    delivery)."""
+    from ray_tpu._private import failpoints
+
+    monkeypatch.setattr(cfg, "transfer_same_host_mmap", False)
+    monkeypatch.setattr(cfg, "fetch_chunk_bytes", 256 * 1024)
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=1)
+    b = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect()
+
+    blob = _put_blob(2 * 1024 * 1024 + 777, seed=21)
+    ref = ray_tpu.put(blob)
+    oid = ref.id.binary()
+
+    fp = failpoints.set_failpoint("transfer.push_chunk=dup")
+    try:
+        ok = _run(cluster, a.raylet.transfers.push(oid, b.raylet.node_id))
+        assert ok, "push must succeed under duplicate chunk delivery"
+        assert fp.fired >= 9, "every chunk should have been duplicated"
+    finally:
+        failpoints.configure("")
+
+    assert _store_bytes(cluster, b, oid) == _store_bytes(cluster, a, oid)
+    # Nothing half-open left behind on the receiver.
+    async def state():
+        return (dict(b.raylet._push_recv),
+                b.raylet.store.stats()["unsealed_bytes"])
+    push_recv, unsealed = _run(cluster, state())
+    assert oid not in push_recv
+    assert unsealed == 0
