@@ -18,7 +18,7 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
-	bench-quick bench-transfer chaos chaos-smoke
+	bench-quick bench-llm-quick bench-transfer chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -26,7 +26,8 @@ SHELL := /bin/bash
 # verify: the tier-1 test command from ROADMAP.md.
 # bench-quick: <60 s hot-path probe — ray_perf --quick on the RPC
 # hot-path metrics + the serve overhead probe — so a submission/dispatch
-# regression surfaces before a full bench round.  check: all three.
+# regression surfaces before a full bench round.  bench-llm-quick: the
+# serve.llm twin (paged vs slot smoke).  check: all of them.
 
 lint:
 	$(PY) -m ray_tpu.lint ray_tpu examples tests \
@@ -45,6 +46,14 @@ bench-quick:
 		--only single_client_tasks_sync,actor_calls_1_1,put_small_1kb
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) -m ray_tpu._private.serve_perf --probe
+
+# <60 s paged-vs-slot serve.llm smoke (smoke sizing; HEADLINE line
+# last): catches a paged-attention / prefix-cache / speculation
+# regression in the serving hot path before a full bench round.  Does
+# NOT touch the checked-in BENCH_serve_llm.json.
+bench-llm-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite serve_llm --quick
 
 # Object transfer plane GB/s (pull/push, striped, vs stop-and-wait
 # baseline); refreshes the checked-in BENCH_transfer.json artifact.
@@ -88,7 +97,7 @@ chaos-smoke:
 	|| { echo "CHAOS SMOKE FAILED — replay with:" \
 	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
-check: lint verify chaos-smoke bench-quick
+check: lint verify chaos-smoke bench-quick bench-llm-quick
 
 store: ray_tpu/_private/_shm_store.so
 

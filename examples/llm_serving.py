@@ -39,7 +39,12 @@ def main():
     serve.start()
     handle = llm_deployment(
         load_model,
-        engine_config={"num_slots": 4, "max_seq": 64,
+        # KV memory is a PAGED pool: admission is bounded by free pages
+        # (kv_pages * page_size tokens), identical prompt prefixes share
+        # pages through the radix cache, and speculate_k fuses
+        # prompt-lookup speculation into the batched decode tick.
+        engine_config={"num_slots": 4, "max_seq": 64, "page_size": 8,
+                       "kv_pages": 32, "speculate_k": 3,
                        "prefill_chunk": 16, "max_queue_len": 32},
         default_generation={"max_new_tokens": 12},
     ).deploy()

@@ -147,6 +147,98 @@ def insert_cache_slot(cache: Dict, row_cache: Dict, slot) -> Dict:
                 cache["v"], row_cache["v"][:, :1], (0, slot, 0, 0, 0))}
 
 
+def init_paged_cache(cfg, num_pages: int, page_size: int) -> Dict:
+    """Paged KV pool: k/v [L, P, page_size, Hkv, Dh] in cfg.dtype.
+
+    Rows of a batch don't own contiguous cache rows here — each row owns
+    a BLOCK TABLE of page ids, and attention gathers its keys/values
+    through the table (vLLM's PagedAttention layout, expressed in the
+    same masked static-shape style as the contiguous cache: gather to a
+    fixed virtual width, mask columns past the row's position).  The
+    serve engine reserves page 0 as a trash page for inactive rows'
+    writes; this initializer doesn't care."""
+    shape = (cfg.n_layers, num_pages, page_size, _kv_heads(cfg),
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def paged_chunk_step(params: Dict, tokens, pos, cache: Dict,
+                     block_tables, cfg, pad_lo=None
+                     ) -> Tuple[Any, Dict]:
+    """Decode a chunk of t tokens [B, t] through a PAGED cache.
+
+    `block_tables` [B, nblk] maps each row's virtual cache columns to
+    pages of the pool: virtual column c lives at
+    (block_tables[b, c // page], c % page).  `pos` is a scalar (one
+    shared start column — single-row prefill) or a [B] vector (each row
+    chunked at its own depth — the fused speculative verify).  Row b's
+    chunk K/V is scattered at columns pos[b]..pos[b]+t-1 through its
+    table, then attention gathers the row's pages back to a
+    [B, nblk*page] virtual buffer and masks columns > pos[b]+i exactly
+    like the contiguous chunk_step — unmasked columns hold bit-identical
+    values to a contiguous cache, so paging is invisible to results.
+
+    Callers must keep pos+t within nblk*page (writes past the table
+    would clip into the last block).  Returns (logits [B, t, V] fp32,
+    updated cache)."""
+    B, t = tokens.shape
+    psz = cache["k"].shape[2]
+    nblk = block_tables.shape[1]
+    S = nblk * psz
+    pos = jnp.asarray(pos, jnp.int32)
+    offs = jnp.arange(t)
+    cols = jnp.broadcast_to(jnp.reshape(pos, (-1, 1)) + offs[None, :],
+                            (B, t))                    # global columns
+    if pad_lo is None:
+        pad_lo = jnp.zeros((B,), jnp.int32)
+    positions = cols - pad_lo[:, None]
+    x = _embed(params, tokens, positions, cfg)
+    w_pages = jnp.take_along_axis(block_tables, cols // psz, axis=1)
+    w_offs = cols % psz
+    kcols = jnp.arange(S)
+    mask = (kcols[None, None, :] <= cols[:, :, None]) \
+        & (kcols[None, None, :] >= pad_lo[:, None, None])
+
+    def layer(x, inputs):
+        lp, ck_l, cv_l = inputs                  # [P, psz, Hkv, Dh]
+        h = _rmsnorm(x, lp["ln1"])
+        q, k, v = _qkv(lp, h, positions, cfg)
+        ck_l = ck_l.at[w_pages, w_offs].set(k.astype(ck_l.dtype))
+        cv_l = cv_l.at[w_pages, w_offs].set(v.astype(cv_l.dtype))
+        Hkv, Dh = ck_l.shape[2], ck_l.shape[3]
+        ck = ck_l[block_tables].reshape(B, S, Hkv, Dh)
+        cv = cv_l[block_tables].reshape(B, S, Hkv, Dh)
+        rep = q.shape[2] // Hkv
+        qg = q.reshape(B, t, Hkv, rep, Dh)
+        scores = jnp.einsum("bqgrk,bsgk->bgrqs", qg.astype(jnp.float32),
+                            ck.astype(jnp.float32)) \
+            * cfg.head_dim ** -0.5
+        scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bgrqs,bsgk->bqgrk", probs.astype(cv.dtype), cv)
+        out = out.reshape(B, t, q.shape[2], Dh)
+        x = x + _attn_out(lp, out, cfg)
+        x = _ffn(lp, x, cfg)
+        return x, (ck_l, cv_l)
+
+    x, (ck, cv) = lax.scan(layer, x,
+                           (params["blocks"], cache["k"], cache["v"]))
+    return _final_logits(params, x, cfg), {"k": ck, "v": cv}
+
+
+def paged_decode_step(params: Dict, token, pos, cache: Dict,
+                      block_tables, cfg, pad_lo=None
+                      ) -> Tuple[Any, Dict]:
+    """One token [B] at per-row cache columns pos [B] through a paged
+    cache — the continuous-batching tick.  A t=1 paged_chunk_step (the
+    SAME kernel the speculative verify runs, so a speculation-free tick
+    and a verify tick can never drift numerically)."""
+    logits, cache = paged_chunk_step(params, token[:, None], pos, cache,
+                                     block_tables, cfg, pad_lo=pad_lo)
+    return logits[:, 0], cache
+
+
 def _cached_attention(q, ck, cv, pos, pad_lo, cfg):
     """q [B,1,H,Dh] against the cache's first pos+1 positions (static
     shape: positions > pos are masked, not sliced; columns < pad_lo[b]

@@ -1,14 +1,20 @@
 """ray_tpu.serve.llm: continuous-batching LLM inference.
 
-Iteration-level scheduling (Orca) over the static-shape KV caches of
-models/decode.py: a fixed pool of cache slots, chunked prefill so
-admission never stalls decoding for more than one chunk, one fused
-decode_step per tick across every occupied slot, and per-request token
-streams.  vLLM's slot-recycling insight without paging — TPU-native
-static shapes make whole-slot recycling the natural unit.
+Iteration-level scheduling (Orca) over a PAGED KV cache
+(vLLM's PagedAttention expressed in models/decode.py's masked
+static-shape style): requests reserve fixed-size pages from a shared
+pool and address them through per-row block tables, a radix prefix
+cache (SGLang's RadixAttention at page granularity) shares full prompt
+pages between requests so repeated system prompts prefill once, and
+prompt-lookup speculative decoding is fused into the batched tick —
+greedy rows verify their drafts in the same dispatch every other row
+decodes in.  Admission is free-page-bounded, chunked prefill never
+stalls decoding for more than one chunk, and with temperature=0 every
+request's tokens are bit-identical to decode.generate() run alone.
 
     engine.py     GenerationEngine + TokenStream (the device loop)
-    scheduler.py  FCFS admission queue with backpressure
+    paging.py     BlockAllocator + RadixPrefixCache (page bookkeeping)
+    scheduler.py  FCFS admission queue with structured backpressure
     api.py        LLMServer deployment: generate()/stream()/HTTP+SSE
 """
 
@@ -17,6 +23,10 @@ from ray_tpu.serve.llm.engine import (  # noqa: F401
     GenerationEngine,
     TokenStream,
 )
+from ray_tpu.serve.llm.paging import (  # noqa: F401
+    BlockAllocator,
+    RadixPrefixCache,
+)
 from ray_tpu.serve.llm.scheduler import (  # noqa: F401
     EngineOverloadedError,
     FCFSScheduler,
@@ -24,6 +34,7 @@ from ray_tpu.serve.llm.scheduler import (  # noqa: F401
 from ray_tpu.serve.llm.api import LLMServer, llm_deployment  # noqa: F401
 
 __all__ = [
-    "EngineOverloadedError", "EngineStats", "FCFSScheduler",
-    "GenerationEngine", "LLMServer", "TokenStream", "llm_deployment",
+    "BlockAllocator", "EngineOverloadedError", "EngineStats",
+    "FCFSScheduler", "GenerationEngine", "LLMServer",
+    "RadixPrefixCache", "TokenStream", "llm_deployment",
 ]

@@ -114,8 +114,12 @@ class LLMServer:
                 return self._sse_events(stream)
             out = await self.engine.generate(body["tokens"], **kw)
         except EngineOverloadedError as e:
+            # Retry-After tracks WHAT saturated: a full waiting line
+            # drains at admission speed (short), an exhausted KV pool
+            # drains at generation speed (longer).
+            retry = str(max(1, int(getattr(e, "retry_after_s", 1.0))))
             return _http_error(503, str(e),
-                               headers=[("Retry-After", "1")])
+                               headers=[("Retry-After", retry)])
         except (TypeError, ValueError) as e:
             return _http_error(400, str(e))
         return {"tokens": out}

@@ -1,24 +1,41 @@
-"""GenerationEngine: iteration-level scheduling over a slot-pool KV cache.
+"""GenerationEngine: iteration-level scheduling over a PAGED KV cache.
 
 The decode loop of models/decode.py serves one batch from arrival to
-completion; here the batch dimension becomes a POOL OF SLOTS that
-requests flow through independently (Orca's continuous batching, vLLM's
-slot recycling without paging — whole static-shape cache rows are the
-recycling unit, which is the TPU-native choice):
+completion; here the batch dimension becomes a pool of rows that
+requests flow through independently (Orca's continuous batching), and
+the KV memory behind those rows is a pool of fixed-size PAGES addressed
+through per-row block tables (vLLM's PagedAttention) with a radix
+prefix cache sharing pages between requests (SGLang's RadixAttention at
+page granularity):
 
-  * a fixed [L, num_slots, max_seq, Hkv, Dh] cache is allocated once;
+  * one [L, num_pages, page_size, Hkv, Dh] page pool is allocated once;
+    page 0 is a TRASH page — inactive batch rows' block tables point at
+    it, so the fused tick's static-shape scatter always has somewhere
+    harmless to write;
+  * a request reserves ceil((prompt + max_new + spec slack)/page) pages
+    at admission (all-or-nothing, so a resident request can never be
+    starved mid-generation) — admission is FREE-PAGE-bounded, not
+    row-bounded: mixed-length workloads pack by what they actually
+    need instead of every request pinning max_seq;
+  * the radix prefix cache maps full-page token prefixes to pages with
+    live K/V: a prompt that hits skips prefill for the shared pages
+    (refcounted — evicting one sharer never frees a page another still
+    gathers) and goes straight to chunked prefill of the tail;
   * arriving requests wait in an FCFS queue (scheduler.py) and are
-    prefilled ONE CHUNK PER TICK into a batch-1 scratch cache
-    (chunk_step), so admission never stalls decoding for more than one
-    chunk of prefill compute;
-  * a finished prefill is spliced into its reserved slot
-    (decode.insert_cache_slot) and the row joins the fused decode batch;
-  * every tick runs ONE decode_step across all slots with a per-row
-    position vector — rows at different depths share the dispatch;
+    prefilled ONE CHUNK PER TICK directly into their own pages through
+    their block table (no scratch cache, no slot splice), so admission
+    never stalls decoding for more than one chunk of prefill compute;
+  * every tick runs ONE fused paged_decode_step across all rows with a
+    per-row position vector; when speculation is on and any greedy row
+    has a prompt-lookup draft, the tick is instead ONE fused
+    paged_chunk_step verifying (pending token + k drafts) per row —
+    per-row acceptance (not the lockstep batch-minimum of standalone
+    generate()), so one row's miss never throttles another's streak;
   * each sampled token is pushed to that request's TokenStream
-    immediately (streaming TTFT = prefill time, not batch time);
-  * rows hitting EOS / max_new_tokens are evicted, their slot zeroed
-    (decode.reset_cache_slot) and reused by the next admission.
+    immediately; rows hitting EOS/max-tokens are evicted by FREEING
+    their pages (host-side accounting only — stale K/V in a recycled
+    page is overwritten before any unmasked read, so there is no
+    zeroing pass on the device).
 
 The device loop runs on a dedicated worker thread: jax dispatch blocks,
 and the replica's asyncio loop must stay free to serve stream polls.
@@ -27,8 +44,8 @@ host-side from the row's logits with a per-request seeded RNG.
 
 Parity contract (tested): with temperature=0 the tokens a request
 streams are bit-identical to decode.generate() run on that prompt
-alone — chunked prefill, slot insertion, and per-row decode are pure
-scheduling transforms, never result transforms.
+alone — chunked prefill, paging, prefix-cache hits, and speculative
+verification are pure scheduling transforms, never result transforms.
 """
 
 from __future__ import annotations
@@ -47,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models import decode
+from ray_tpu.serve.llm.paging import BlockAllocator, RadixPrefixCache
 from ray_tpu.serve.llm.scheduler import EngineOverloadedError, FCFSScheduler
 from ray_tpu.util import metrics as _metrics
 
@@ -76,15 +94,35 @@ REQUESTS_COUNTER = _metrics.Counter(
     tag_keys=("engine", "status"))
 QUEUE_GAUGE = _metrics.Gauge(
     "serve_llm_queue_depth",
-    "Requests waiting for a slot (admission queue)",
+    "Requests waiting for admission (excludes the one mid-prefill; "
+    "EngineStats.queue_depth adds it)",
     tag_keys=("engine",))
 OCCUPANCY_GAUGE = _metrics.Gauge(
     "serve_llm_slot_occupancy",
-    "Fraction of KV-cache slots mid-generation", tag_keys=("engine",))
+    "Fraction of decode batch rows mid-generation", tag_keys=("engine",))
 THROUGHPUT_GAUGE = _metrics.Gauge(
     "serve_llm_tokens_per_sec",
     "Streamed tokens/sec over the last measurement window",
     tag_keys=("engine",))
+KV_BLOCKS_TOTAL_GAUGE = _metrics.Gauge(
+    "serve_llm_kv_blocks_total",
+    "Allocatable KV pages in the pool (excludes the trash page)",
+    tag_keys=("engine",))
+KV_BLOCKS_FREE_GAUGE = _metrics.Gauge(
+    "serve_llm_kv_blocks_free",
+    "KV pages currently on the free list", tag_keys=("engine",))
+PREFIX_HITS_COUNTER = _metrics.Counter(
+    "serve_llm_prefix_cache_hits_total",
+    "Admissions whose prompt hit >=1 cached prefix page",
+    tag_keys=("engine",))
+PREFIX_MISSES_COUNTER = _metrics.Counter(
+    "serve_llm_prefix_cache_misses_total",
+    "Admissions with no cached prefix page", tag_keys=("engine",))
+SPEC_ACCEPTED_COUNTER = _metrics.Counter(
+    "serve_llm_spec_accepted_tokens_total",
+    "Draft tokens accepted by speculative verification",
+    tag_keys=("engine",))
+
 
 class TokenStream:
     """Per-request stream of generated token ids.
@@ -228,6 +266,14 @@ class EngineStats:
     requests_cancelled: int
     tokens_per_sec: float
     uptime_s: float
+    page_size: int = 0
+    kv_blocks_total: int = 0
+    kv_blocks_free: int = 0
+    prefix_cache_hits: int = 0
+    prefix_cache_misses: int = 0
+    prefix_hit_tokens: int = 0
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -236,10 +282,12 @@ class EngineStats:
 class _Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
                  "top_k", "eos_token", "rng", "stream", "submit_t",
-                 "first_token_t", "last_token_t", "emitted")
+                 "first_token_t", "last_token_t", "emitted", "n_blocks",
+                 "pages", "tokens", "prefix_hit_tokens", "ngram_map",
+                 "ngram_upto")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
-                 eos_token, seed):
+                 eos_token, seed, n_blocks):
         self.id = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -252,32 +300,90 @@ class _Request:
         self.first_token_t: Optional[float] = None
         self.last_token_t: Optional[float] = None
         self.emitted = 0
+        self.n_blocks = n_blocks     # worst-case page reservation
+        self.pages: List[int] = []   # held pages (shared prefix + own)
+        self.tokens: List[int] = []  # prompt + produced (draft source)
+        self.prefix_hit_tokens = 0
+        self.ngram_map: Dict = {}    # trailing-ngram -> latest end pos
+        self.ngram_upto = 0          # positions indexed so far
 
 
 class _PrefillState:
-    __slots__ = ("req", "slot", "next_start")
+    __slots__ = ("req", "slot", "next_start", "bt_row")
 
-    def __init__(self, req: _Request, slot: int):
+    def __init__(self, req: _Request, slot: int, start: int, bt_row):
         self.req = req
         self.slot = slot
-        self.next_start = 0
+        self.next_start = start
+        # The row's block table stays PRIVATE until activation: the
+        # fused tick scatters a garbage write for every inactive batch
+        # row, and the engine-wide table must keep pointing those rows
+        # at the trash page — never at this request's (possibly shared)
+        # pages.
+        self.bt_row = bt_row
+
+
+def _lookup_draft(req: "_Request", ngram: int, k: int) -> List[int]:
+    """Prompt-lookup draft (host twin of decode's speculative lookup):
+    the tokens that followed the most recent EARLIER occurrence of the
+    trailing n-gram, which ends at the pending token.  Returns up to k
+    tokens ([] when no earlier occurrence exists — a wrong or short
+    draft costs a little verify compute, never correctness).
+
+    The request carries an incrementally maintained ngram -> latest-end
+    -position map, so a tick's lookup only indexes the tokens appended
+    since the last tick (amortized O(1) per generated token) instead of
+    rescanning the whole history — the no-match case on non-repetitive
+    text is the common one, and it sits on the tick hot path."""
+    tokens = req.tokens
+    n = len(tokens)
+    if n < ngram + 1:
+        return []
+    # Index windows ENDING at positions [ngram-1, n-2]: the window at
+    # n-1 ends at the pending token and must stay out of the map (a
+    # draft may only come from a strictly earlier occurrence).
+    for p in range(max(req.ngram_upto, ngram - 1), n - 1):
+        req.ngram_map[tuple(tokens[p - ngram + 1:p + 1])] = p
+    req.ngram_upto = n - 1
+    j = req.ngram_map.get(tuple(tokens[n - ngram:]))
+    if j is None:
+        return []
+    return tokens[j + 1:j + 1 + k]
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "with_logits"),
                    donate_argnames=("cache",))
-def _fused_tick(params, token, pos, cache, cfg, with_logits):
-    """One decode_step across every slot (per-row positions) + on-device
-    greedy argmax; logits ride back to host only when a sampled-mode
-    request is active."""
-    logits, cache = decode.decode_step(params, token, pos, cache, cfg)
+def _paged_tick(params, token, pos, cache, block_tables, cfg,
+                with_logits):
+    """One paged decode_step across every row (per-row positions) +
+    on-device greedy argmax; logits ride back to host only when a
+    sampled-mode request is active."""
+    logits, cache = decode.paged_decode_step(params, token, pos, cache,
+                                             block_tables, cfg)
     sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return sampled, (logits if with_logits else None), cache
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "with_logits"),
+                   donate_argnames=("cache",))
+def _paged_verify(params, chunk, pos, cache, block_tables, cfg,
+                  with_logits):
+    """Fused speculative tick: each row's (pending token + k draft
+    tokens) scored in one paged_chunk_step.  preds[b, i] is the greedy
+    next token after row b's chunk prefix 0..i; sampling rows read only
+    their position-0 logits (their draft columns are dead weight,
+    overwritten before any unmasked read)."""
+    logits, cache = decode.paged_chunk_step(params, chunk, pos, cache,
+                                            block_tables, cfg)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return preds, (logits[:, 0] if with_logits else None), cache
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache",))
-def _prefill_chunk(params, tokens, pos, cache, cfg):
-    return decode.chunk_step(params, tokens, pos, cache, cfg)
+def _prefill_chunk(params, tokens, pos, cache, block_table, cfg):
+    return decode.paged_chunk_step(params, tokens, pos, cache,
+                                   block_table, cfg)
 
 
 def _host_sample(row_logits: np.ndarray, temperature: float, top_k: int,
@@ -295,14 +401,29 @@ def _host_sample(row_logits: np.ndarray, temperature: float, top_k: int,
 
 
 class GenerationEngine:
-    """Continuous-batching generation over a fixed pool of cache slots.
+    """Continuous-batching generation over a paged KV pool.
 
     Knobs:
-      num_slots        decode batch width B (slots recycled on finish)
-      max_seq          cache width S; prompt + max_new_tokens <= S
+      num_slots        decode batch width B (rows recycled on finish)
+      max_seq          per-request bound: prompt + max_new_tokens <= it
+      page_size        KV page width in tokens (page_size >= max_seq
+                       degenerates to the old one-slot-per-request
+                       layout — the bench's "slot mode" baseline)
+      kv_pages         allocatable pages in the pool (default:
+                       num_slots * ceil(max_seq / page_size) — equal
+                       memory to the old contiguous slot pool)
+      enable_prefix_cache  share full prompt pages between requests via
+                       the radix cache (prefill skipped for shared pages)
+      speculate_k / speculate_ngram
+                       >0 enables in-engine prompt-lookup speculative
+                       decoding for greedy rows (fused verify tick)
       prefill_chunk    tokens of prompt prefilled per engine tick
       max_queue_len    admission-queue cap; past it submit() raises
-                       EngineOverloadedError (backpressure)
+                       EngineOverloadedError(reason="queue_full")
+      kv_commit_factor submit() bounds OUTSTANDING worst-case page
+                       demand (waiting + resident) at factor*kv_pages;
+                       past it submit() raises
+                       EngineOverloadedError(reason="kv_exhausted")
       name             metrics tag value
 
     `submit()` may be called from any thread / event loop; the returned
@@ -314,11 +435,22 @@ class GenerationEngine:
                  max_seq: Optional[int] = None, prefill_chunk: int = 32,
                  max_queue_len: int = 64,
                  default_max_new_tokens: int = 64,
-                 name: str = "default"):
+                 name: str = "default",
+                 page_size: int = 16, kv_pages: Optional[int] = None,
+                 enable_prefix_cache: bool = True,
+                 speculate_k: int = 0, speculate_ngram: int = 3,
+                 kv_commit_factor: float = 4.0):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if speculate_k < 0:
+            raise ValueError("speculate_k must be >= 0")
+        if speculate_k and speculate_ngram < 1:
+            raise ValueError("speculate_ngram must be >= 1 when "
+                             "speculate_k is set")
         if getattr(cfg, "n_experts", 0):
             raise NotImplementedError(
                 "continuous batching supports dense models only "
@@ -327,9 +459,30 @@ class GenerationEngine:
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_seq = int(max_seq or cfg.max_seq)
-        self.prefill_chunk = min(prefill_chunk, self.max_seq)
+        self.page_size = int(page_size)
+        self.speculate_k = int(speculate_k)
+        self.speculate_ngram = int(speculate_ngram)
+        # Speculation writes up to k tokens past a row's position before
+        # acceptance is known; the reservation slack keeps those writes
+        # inside the row's own pages (a write clipped to the trash page
+        # would LOSE accepted K/V).  +1 mirrors generate()'s slack.
+        self._slack = self.speculate_k + 1 if self.speculate_k else 0
+        self._max_blocks = -(-(self.max_seq + self._slack)
+                             // self.page_size)
+        self._s_virt = self._max_blocks * self.page_size
+        # Default sizing includes the speculation slack: every request
+        # the max_seq check admits must also fit the pool (a max-length
+        # request reserves _max_blocks pages).
+        self.kv_pages = int(kv_pages if kv_pages is not None
+                            else num_slots * self._max_blocks)
+        if self.kv_pages < 1:
+            raise ValueError("kv_pages must be >= 1")
+        self.prefill_chunk = min(prefill_chunk, self._s_virt)
         self.default_max_new_tokens = default_max_new_tokens
         self.name = name
+        # With kv_commit_factor >= 1 a lone request always fits the cap
+        # (its n_blocks is bounded by kv_pages via the submit check).
+        self._commit_cap = max(1, int(kv_commit_factor * self.kv_pages))
 
         self._scheduler = FCFSScheduler(max_queue_len)
         self._cond = threading.Condition()
@@ -337,10 +490,16 @@ class GenerationEngine:
         self._stop = False
         self._started_t = time.monotonic()
 
-        # Device state (worker-thread-owned after start).
-        self._cache = decode.init_cache(cfg, num_slots,
-                                        max_seq=self.max_seq)
-        self._scratch = decode.init_cache(cfg, 1, max_seq=self.max_seq)
+        # Device + paging state (worker-thread-owned after start).
+        # Page 0 is the trash page: every inactive row's block table
+        # points at it, so the fused tick's scatter writes land there.
+        self._cache = decode.init_paged_cache(
+            cfg, self.kv_pages + 1, self.page_size)
+        self._alloc = BlockAllocator(self.kv_pages, first_page=1)
+        self._prefix = (RadixPrefixCache(self.page_size, self._alloc)
+                        if enable_prefix_cache else None)
+        self._block_tables = np.zeros((num_slots, self._max_blocks),
+                                      np.int32)
         self._pos = np.zeros((num_slots,), np.int32)
         self._tok = np.zeros((num_slots,), np.int32)
         self._slots: List[Optional[_Request]] = [None] * num_slots
@@ -351,12 +510,20 @@ class GenerationEngine:
         self._completed = 0
         self._rejected = 0
         self._cancelled = 0
+        self._committed_blocks = 0   # outstanding worst-case demand
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_hit_tokens = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._win_t = time.monotonic()
         self._win_tokens = 0
 
         self._tags = {"engine": name}
         QUEUE_GAUGE.set(0, tags=self._tags)
         OCCUPANCY_GAUGE.set(0.0, tags=self._tags)
+        KV_BLOCKS_TOTAL_GAUGE.set(self.kv_pages, tags=self._tags)
+        KV_BLOCKS_FREE_GAUGE.set(self.kv_pages, tags=self._tags)
 
     # ------------------------------------------------------------------
     # Public API
@@ -387,6 +554,7 @@ class GenerationEngine:
             if self._prefill is not None:
                 leftovers.append(self._prefill.req)
                 self._prefill = None
+            self._committed_blocks = 0
             QUEUE_GAUGE.set(0, tags=self._tags)
         for req in leftovers:
             req.stream._finish(err)
@@ -394,6 +562,7 @@ class GenerationEngine:
             if req is not None:
                 req.stream._finish(err)
                 self._slots[s] = None
+        self._reset_paging()
         OCCUPANCY_GAUGE.set(0.0, tags=self._tags)
 
     def __enter__(self):
@@ -407,6 +576,9 @@ class GenerationEngine:
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    def _blocks_for(self, prompt_len: int, max_new: int) -> int:
+        return -(-(prompt_len + max_new + self._slack) // self.page_size)
+
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
@@ -414,8 +586,11 @@ class GenerationEngine:
                request_id: Optional[str] = None) -> TokenStream:
         """Queue one prompt; returns its TokenStream immediately.
 
-        Raises EngineOverloadedError when the admission queue is full
-        and ValueError for prompts the cache can never hold."""
+        Raises EngineOverloadedError when admission is saturated —
+        reason "queue_full" (waiting line at max_queue_len) or
+        "kv_exhausted" (outstanding worst-case KV page demand past the
+        commit cap) — and ValueError for prompts the pool can never
+        hold."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -427,6 +602,11 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
                 f"exceeds the engine's max_seq={self.max_seq}")
+        n_blocks = self._blocks_for(len(prompt), max_new)
+        if n_blocks > self.kv_pages:
+            raise ValueError(
+                f"request needs {n_blocks} KV pages of {self.page_size} "
+                f"tokens; the pool only has {self.kv_pages}")
         # Sampling knobs are validated HERE, the single entry point: a
         # bad value surfacing later, inside the worker tick, would fail
         # every co-resident request (_fail_all), not just this one.
@@ -438,8 +618,20 @@ class GenerationEngine:
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         req = _Request(request_id or uuid.uuid4().hex[:12], prompt,
-                       max_new, temperature, top_k, eos_token, seed)
+                       max_new, temperature, top_k, eos_token, seed,
+                       n_blocks)
         with self._cond:
+            if self._committed_blocks + n_blocks > self._commit_cap:
+                self._rejected += 1
+                REQUESTS_COUNTER.inc(tags={**self._tags,
+                                           "status": "rejected"})
+                raise EngineOverloadedError(
+                    f"KV pool exhausted: {self._committed_blocks} pages "
+                    f"of worst-case demand outstanding + {n_blocks} "
+                    f"needed exceeds the commit cap "
+                    f"({self._commit_cap} = factor * {self.kv_pages} "
+                    f"pages); retry later",
+                    reason="kv_exhausted", retry_after_s=5.0)
             try:
                 self._scheduler.enqueue(req)
             except EngineOverloadedError:
@@ -447,6 +639,7 @@ class GenerationEngine:
                 REQUESTS_COUNTER.inc(tags={**self._tags,
                                            "status": "rejected"})
                 raise
+            self._committed_blocks += n_blocks
             QUEUE_GAUGE.set(self._scheduler.depth, tags=self._tags)
             self._cond.notify_all()
         self.start()
@@ -470,12 +663,25 @@ class GenerationEngine:
             requests_rejected=self._rejected,
             requests_cancelled=self._cancelled,
             tokens_per_sec=round(tps, 2),
-            uptime_s=round(now - self._started_t, 3))
+            uptime_s=round(now - self._started_t, 3),
+            page_size=self.page_size,
+            kv_blocks_total=self.kv_pages,
+            kv_blocks_free=self._alloc.free_pages,
+            prefix_cache_hits=self._prefix_hits,
+            prefix_cache_misses=self._prefix_misses,
+            prefix_hit_tokens=self._prefix_hit_tokens,
+            spec_drafted_tokens=self._spec_drafted,
+            spec_accepted_tokens=self._spec_accepted)
 
     # ------------------------------------------------------------------
     # Worker thread
 
     def _run(self):
+        try:
+            self._warm_kernels()
+        except Exception as e:
+            logger.exception("engine %s kernel warmup failed", self.name)
+            self._fail_all(e)
         while True:
             with self._cond:
                 while not self._stop and not self._has_work_locked():
@@ -489,6 +695,32 @@ class GenerationEngine:
                 logger.exception("engine %s tick failed", self.name)
                 self._fail_all(e)
 
+    def _warm_kernels(self):
+        """Compile the fused tick kernels at worker startup, against the
+        engine's own (still empty) state: every write lands in the trash
+        page, so this is free of side effects — and the first real
+        request never pays XLA compilation of the decode tick, nor does
+        the first DRAFT pay the verify kernel's (it would otherwise land
+        mid-generation, a latency spike the bench used to misreport as
+        speculation overhead)."""
+        tok = jnp.zeros((self.num_slots,), jnp.int32)
+        pos = jnp.zeros((self.num_slots,), jnp.int32)
+        bt = jnp.asarray(self._block_tables)
+        _, _, self._cache = _paged_tick(
+            self.params, tok, pos, self._cache, bt, self.cfg,
+            with_logits=False)
+        if self.speculate_k:
+            chunk = jnp.zeros((self.num_slots, 1 + self.speculate_k),
+                              jnp.int32)
+            _, _, self._cache = _paged_verify(
+                self.params, chunk, pos, self._cache, bt, self.cfg,
+                with_logits=False)
+        # ...and the standard-width prefill chunk (row 0's table is all
+        # trash while nothing is admitted).
+        _, self._cache = _prefill_chunk(
+            self.params, jnp.zeros((1, self.prefill_chunk), jnp.int32),
+            jnp.int32(0), self._cache, bt[:1], self.cfg)
+
     def _has_work_locked(self) -> bool:
         return (self._scheduler.depth > 0 or self._prefill is not None
                 or any(r is not None for r in self._slots))
@@ -499,6 +731,57 @@ class GenerationEngine:
             if r is None and s != reserved:
                 return s
         return None
+
+    def _release_pages(self, req: _Request):
+        for p in req.pages:
+            self._alloc.decref(p)
+        req.pages = []
+        self._update_kv_gauges()
+
+    def _try_reserve(self, req: _Request):
+        """Prefix-match + page reservation for one request.  Returns
+        (pages, matched_tokens) or None when the pool can't cover the
+        request right now (caller requeues and retries after evictions
+        free pages)."""
+        L = len(req.prompt)
+        matched_pages: List[int] = []
+        matched_tok = 0
+        if self._prefix is not None:
+            # Cap at L-1: at least one prompt token must run through
+            # tail prefill — logits come from computation, not cache.
+            matched_pages, matched_tok = self._prefix.match(
+                req.prompt, max_tokens=L - 1)
+            # Hold the matched pages BEFORE any eviction can run:
+            # evict() may drop their tree nodes, and only our refs keep
+            # the pages from being recycled under us.
+            for p in matched_pages:
+                self._alloc.incref(p)
+        need = req.n_blocks - len(matched_pages)
+        got = self._alloc.alloc(need)
+        if got is None and self._prefix is not None \
+                and self._alloc.free_pages + self._prefix.releasable() \
+                >= need:
+            # Evict only when reclaim can actually cover the request —
+            # an unsatisfiable reservation must not wipe the prefix
+            # cache for nothing (the request waits for resident rows to
+            # finish instead).
+            self._prefix.evict(need)
+            got = self._alloc.alloc(need)
+        if got is None:
+            for p in matched_pages:
+                self._alloc.decref(p)
+            return None
+        if matched_tok > 0:
+            self._prefix_hits += 1
+            self._prefix_hit_tokens += matched_tok
+            PREFIX_HITS_COUNTER.inc(tags=self._tags)
+        else:
+            self._prefix_misses += 1
+            PREFIX_MISSES_COUNTER.inc(tags=self._tags)
+        req.pages = matched_pages + got
+        req.prefix_hit_tokens = matched_tok
+        self._update_kv_gauges()
+        return req.pages, matched_tok
 
     def _admit_one_chunk(self):
         """Advance admission by AT MOST one prefill chunk (the bound on
@@ -518,27 +801,37 @@ class GenerationEngine:
                                     tags=self._tags)
             if req is None:
                 return
-            # The slot is reserved now so the insert at the end of
-            # prefill can never find the pool full.
-            self._scratch = decode.reset_cache_slot(
-                self._scratch, jnp.int32(0))
-            self._prefill = _PrefillState(req, slot)
+            reserved = self._try_reserve(req)
+            if reserved is None:
+                # KV-starved: requests resident in the pool will finish
+                # and free pages; FCFS order is preserved by putting
+                # the head back.
+                with self._cond:
+                    self._scheduler.requeue_head(req)
+                    QUEUE_GAUGE.set(self._scheduler.depth,
+                                    tags=self._tags)
+                return
+            pages, matched_tok = reserved
+            bt_row = np.zeros((self._max_blocks,), np.int32)
+            bt_row[:len(pages)] = pages
+            self._prefill = _PrefillState(req, slot, matched_tok, bt_row)
 
         st = self._prefill
         req = st.req
         if req.stream.cancelled:
             self._prefill = None
+            self._release_pages(req)
             self._finish_request(req, "cancelled")
             return
         L = len(req.prompt)
         start = st.next_start
-        width = min(self.prefill_chunk, self.max_seq - start)
+        width = min(self.prefill_chunk, self._s_virt - start)
         real = req.prompt[start:start + width]
         chunk = np.zeros((1, width), np.int32)
         chunk[0, :len(real)] = real
-        logits, self._scratch = _prefill_chunk(
+        logits, self._cache = _prefill_chunk(
             self.params, jnp.asarray(chunk), jnp.int32(start),
-            self._scratch, self.cfg)
+            self._cache, jnp.asarray(st.bt_row[None, :]), self.cfg)
         st.next_start = start + width
         if st.next_start < L:
             return  # more chunks to go; decode proceeds meanwhile
@@ -546,24 +839,35 @@ class GenerationEngine:
         # Prefill complete: sample the first token from the last REAL
         # column of the final chunk (pad columns carry garbage).
         self._prefill = None
+        if self._prefix is not None:
+            # The request's FULL prompt pages now hold final K/V (decode
+            # writes start at column L, outside any full prompt page) —
+            # publish them for future prompts to share.  Already-cached
+            # chunks are no-ops; this request's duplicates stay private.
+            self._prefix.insert(req.prompt,
+                                req.pages[:L // self.page_size])
         row = np.asarray(logits[0, len(real) - 1])
         first = self._sample_host(row, req)
         now = time.monotonic()
         if req.eos_token is not None and first == req.eos_token:
+            self._release_pages(req)
             self._finish_request(req, "completed")
             return
         if req.max_new_tokens == 1:
             # Nothing left to decode: never joins the batch.
             self._emit(req, first, now)
+            self._release_pages(req)
             self._finish_request(req, "completed")
             return
         # Join the decode batch BEFORE the token is emitted: a consumer
         # woken by its first token must observe the request as an
-        # active slot, not a phantom.
-        self._cache = decode.insert_cache_slot(
-            self._cache, self._scratch, jnp.int32(st.slot))
+        # active slot, not a phantom.  Publishing the block-table row is
+        # the activation — from the next tick on, the fused scatter
+        # writes into this request's pages instead of the trash page.
+        self._block_tables[st.slot] = st.bt_row
         self._pos[st.slot] = L
         self._tok[st.slot] = first
+        req.tokens = list(req.prompt) + [first]
         self._slots[st.slot] = req
         self._update_occupancy()
         self._emit(req, first, now)
@@ -573,19 +877,29 @@ class GenerationEngine:
                    if self._slots[s] is not None]
         if not actives:
             return
+        spec_drafts: Dict[int, List[int]] = {}
+        if self.speculate_k:
+            for s in actives:
+                req = self._slots[s]
+                if req.temperature == 0 and not req.stream.cancelled:
+                    d = _lookup_draft(req, self.speculate_ngram,
+                                      self.speculate_k)
+                    if d:
+                        spec_drafts[s] = d
+        if spec_drafts:
+            self._verify_tick(actives, spec_drafts)
+        else:
+            self._plain_tick(actives)
+
+    def _plain_tick(self, actives):
         sample_rows = [s for s in actives
                        if self._slots[s].temperature > 0]
-        sampled, logits, self._cache = _fused_tick(
+        sampled, logits, self._cache = _paged_tick(
             self.params, jnp.asarray(self._tok), jnp.asarray(self._pos),
-            self._cache, self.cfg, with_logits=bool(sample_rows))
+            self._cache, jnp.asarray(self._block_tables), self.cfg,
+            with_logits=bool(sample_rows))
         sampled = np.asarray(sampled)
-        if sample_rows:
-            # Host transfer scales with the SAMPLING rows, not the
-            # whole pool: one temperature>0 request must not ship
-            # [num_slots, vocab] off-device every tick.
-            logits_np = np.asarray(
-                logits[jnp.asarray(np.asarray(sample_rows, np.int32))])
-            row_of = {s: i for i, s in enumerate(sample_rows)}
+        logits_np, row_of = self._ship_sample_logits(logits, sample_rows)
         now = time.monotonic()
         for s in actives:
             req = self._slots[s]
@@ -597,14 +911,78 @@ class GenerationEngine:
                                  req.top_k, req.rng)
             else:
                 t = int(sampled[s])
-            self._tok[s] = t
-            self._pos[s] += 1
-            if req.eos_token is not None and t == req.eos_token:
-                self._evict(s, "completed")
+            self._advance(s, req, [t], now)
+
+    def _verify_tick(self, actives, spec_drafts):
+        """One fused paged_chunk_step verifying every row's pending
+        token + drafts; per-row longest-matching-prefix acceptance turns
+        idle verify bandwidth into extra tokens without ever changing
+        the greedy output (accepted drafts EQUAL the argmax chain by
+        construction)."""
+        k = self.speculate_k
+        chunk = np.zeros((self.num_slots, 1 + k), np.int32)
+        chunk[:, 0] = self._tok
+        for s, d in spec_drafts.items():
+            chunk[s, 1:1 + len(d)] = d
+        sample_rows = [s for s in actives
+                       if self._slots[s].temperature > 0]
+        preds, logits0, self._cache = _paged_verify(
+            self.params, jnp.asarray(chunk), jnp.asarray(self._pos),
+            self._cache, jnp.asarray(self._block_tables), self.cfg,
+            with_logits=bool(sample_rows))
+        preds = np.asarray(preds)
+        logits_np, row_of = self._ship_sample_logits(logits0, sample_rows)
+        now = time.monotonic()
+        for s in actives:
+            req = self._slots[s]
+            if req.stream.cancelled:
+                self._evict(s, "cancelled")
                 continue
+            if req.temperature > 0:
+                t = _host_sample(logits_np[row_of[s]], req.temperature,
+                                 req.top_k, req.rng)
+                self._advance(s, req, [t], now)
+                continue
+            d = spec_drafts.get(s, [])
+            m = 0
+            while m < len(d) and preds[s, m] == d[m]:
+                m += 1
+            # The bonus prediction always rides along, so produced
+            # length is m+1; cap so the row never exceeds max_new.
+            m = min(m, req.max_new_tokens - req.emitted - 1)
+            self._spec_drafted += len(d)
+            self._spec_accepted += m
+            if m:
+                SPEC_ACCEPTED_COUNTER.inc(m, tags=self._tags)
+            self._advance(s, req, list(d[:m]) + [int(preds[s, m])], now)
+
+    def _ship_sample_logits(self, logits, sample_rows):
+        """Host transfer scales with the SAMPLING rows, not the whole
+        pool: one temperature>0 request must not ship
+        [num_slots, vocab] off-device every tick."""
+        if not sample_rows:
+            return None, None
+        logits_np = np.asarray(
+            logits[jnp.asarray(np.asarray(sample_rows, np.int32))])
+        return logits_np, {s: i for i, s in enumerate(sample_rows)}
+
+    def _advance(self, slot: int, req: _Request, produced: List[int],
+                 now: float):
+        """Commit one row's tick outcome: len(produced) tokens (1
+        normally; accepted drafts + bonus under speculation), emitted in
+        order with EOS / max_new eviction exactly as if they had been
+        produced one tick at a time."""
+        self._pos[slot] += len(produced)
+        self._tok[slot] = produced[-1]
+        req.tokens.extend(produced)
+        for t in produced:
+            if req.eos_token is not None and t == req.eos_token:
+                self._evict(slot, "completed")
+                return
             self._emit(req, t, now)
             if req.emitted >= req.max_new_tokens:
-                self._evict(s, "completed")
+                self._evict(slot, "completed")
+                return
 
     def _sample_host(self, row_logits: np.ndarray, req: _Request) -> int:
         if req.temperature > 0:
@@ -633,12 +1011,18 @@ class GenerationEngine:
         req.stream._push(token)
 
     def _evict(self, slot: int, status: str):
+        """Eviction is pure accounting: point the row back at the trash
+        page and decref its pages.  No device work — stale K/V in a
+        recycled page is always overwritten before an unmasked read
+        (prefill covers the tail from its start column; decode writes a
+        column before attending to it), which is what makes page
+        recycling free compared to the old whole-row zeroing pass."""
         req = self._slots[slot]
         self._slots[slot] = None
         self._pos[slot] = 0
         self._tok[slot] = 0
-        self._cache = decode.reset_cache_slot(
-            self._cache, jnp.int32(slot))
+        self._block_tables[slot, :] = 0
+        self._release_pages(req)
         self._update_occupancy()
         self._finish_request(req, status)
 
@@ -647,6 +1031,9 @@ class GenerationEngine:
             self._cancelled += 1
         else:
             self._completed += 1
+        with self._cond:
+            self._committed_blocks = max(
+                0, self._committed_blocks - req.n_blocks)
         REQUESTS_COUNTER.inc(tags={**self._tags, "status": status})
         req.stream._finish()
 
@@ -655,12 +1042,23 @@ class GenerationEngine:
             sum(r is not None for r in self._slots) / self.num_slots,
             tags=self._tags)
 
+    def _update_kv_gauges(self):
+        KV_BLOCKS_FREE_GAUGE.set(self._alloc.free_pages, tags=self._tags)
+
+    def _reset_paging(self):
+        self._alloc = BlockAllocator(self.kv_pages, first_page=1)
+        if self._prefix is not None:
+            self._prefix = RadixPrefixCache(self.page_size, self._alloc)
+        self._block_tables[:] = 0
+        self._update_kv_gauges()
+
     def _fail_all(self, err: BaseException):
         if self._prefill is not None:
             self._prefill.req.stream._finish(err)
             self._prefill = None
         with self._cond:
             leftovers = self._scheduler.drain()
+            self._committed_blocks = 0
             QUEUE_GAUGE.set(0, tags=self._tags)
         for req in leftovers:
             req.stream._finish(err)
@@ -672,8 +1070,7 @@ class GenerationEngine:
         self._pos[:] = 0
         self._tok[:] = 0
         # Rebuild device state: the donated cache may be mid-flight.
-        self._cache = decode.init_cache(
-            self.cfg, self.num_slots, max_seq=self.max_seq)
-        self._scratch = decode.init_cache(
-            self.cfg, 1, max_seq=self.max_seq)
+        self._cache = decode.init_paged_cache(
+            self.cfg, self.kv_pages + 1, self.page_size)
+        self._reset_paging()
         self._update_occupancy()

@@ -1,0 +1,242 @@
+"""KV page accounting for the paged continuous-batching engine.
+
+Host-side bookkeeping only — the device never sees these objects.  The
+engine's KV memory is a pool of fixed-size pages (decode.init_paged_cache);
+what lives here is who owns which page:
+
+  * BlockAllocator — refcounted free-list over page ids.  A page is
+    held by every block table that references it PLUS the radix tree if
+    a prefix node points at it; it returns to the free list only when
+    the last holder drops it.  Refcounts are what make prefix sharing
+    safe: evicting one sharer can never free a page another request's
+    attention still gathers through.
+  * RadixPrefixCache — a radix/trie over token prefixes at PAGE
+    granularity (SGLang's RadixAttention at block granularity, the same
+    choice vLLM's prefix caching makes): each node is one FULL page of
+    `page_size` prompt tokens and owns one allocator reference on the
+    page holding that chunk's K/V.  match() walks the longest cached
+    prefix; insert() adds nodes for pages not yet present; evict()
+    drops least-recently-used LEAVES until enough pages are free
+    (dropping a leaf only decrefs — sharers keep the page alive).
+
+Single-owner discipline: every method is called from the engine's
+worker thread (admission/eviction), never concurrently.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class BlockAllocator:
+    """Refcounted allocator over page ids [first_page, first_page+num).
+
+    The engine reserves page id 0 as the TRASH page (inactive batch
+    rows scatter their garbage writes there), so it allocates ids
+    starting at 1 — hence `first_page`.
+    """
+
+    def __init__(self, num_pages: int, first_page: int = 1):
+        if num_pages < 1:
+            raise ValueError("num_pages must be >= 1")
+        self.num_pages = num_pages
+        self.first_page = first_page
+        # LIFO free list: recently freed pages are re-handed first (their
+        # stale K/V is overwritten before any unmasked read — see the
+        # engine's no-zeroing note).
+        self._free: List[int] = list(
+            range(first_page + num_pages - 1, first_page - 1, -1))
+        self._refs: Dict[int, int] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages (refcount 1 each) or None — all or nothing,
+        so a half-admitted request can never strand pages."""
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def incref(self, page: int) -> None:
+        self._refs[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; True when this freed the page."""
+        r = self._refs.get(page)
+        if r is None:
+            raise ValueError(f"decref of unheld page {page}")
+        r -= 1
+        if r == 0:
+            del self._refs[page]
+            self._free.append(page)
+            return True
+        self._refs[page] = r
+        return False
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+
+class _RadixNode:
+    __slots__ = ("children", "page", "parent", "key", "last_used")
+
+    def __init__(self, key, page, parent):
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Page-granularity prefix trie with LRU leaf eviction.
+
+    Keys are tuples of `page_size` token ids; a path root->node spells a
+    prompt prefix and node.page holds that chunk's K/V.  Only FULL pages
+    are shareable — a partially filled page is private to its request
+    (decode writes land in it).
+    """
+
+    def __init__(self, page_size: int, allocator: BlockAllocator):
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.page_size = page_size
+        self._alloc = allocator
+        self._root = _RadixNode(None, None, None)
+        self._clock = 0
+        self.nodes = 0
+
+    def match(self, tokens: Sequence[int], max_tokens: Optional[int] = None
+              ) -> Tuple[List[int], int]:
+        """Longest cached prefix of `tokens` in full pages.
+
+        Returns (pages, matched_token_count).  `max_tokens` caps the
+        match (the engine passes len(prompt)-1: at least one prompt
+        token must run through tail prefill to produce the logits the
+        first sampled token comes from — a pure cache hit yields K/V,
+        never logits).  Matched nodes are touched for LRU; the CALLER
+        must incref the returned pages before relying on them (a later
+        evict() may drop the nodes)."""
+        psz = self.page_size
+        limit = len(tokens) if max_tokens is None else min(
+            max_tokens, len(tokens))
+        self._clock += 1
+        node = self._root
+        pages: List[int] = []
+        for i in range(limit // psz):
+            child = node.children.get(tuple(tokens[i * psz:(i + 1) * psz]))
+            if child is None:
+                break
+            child.last_used = self._clock
+            pages.append(child.page)
+            node = child
+        return pages, len(pages) * psz
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Record that pages[i] holds the K/V of tokens[i*psz:(i+1)*psz].
+
+        Walks/creates the path; each NEW node increfs its page.  Where a
+        node already exists (another request cached the same chunk
+        first) the existing page is kept and the duplicate is ignored —
+        the caller keeps its own reference on the duplicate and frees it
+        with the request.  Returns the number of new nodes."""
+        psz = self.page_size
+        self._clock += 1
+        node = self._root
+        added = 0
+        for i, page in enumerate(pages):
+            key = tuple(tokens[i * psz:(i + 1) * psz])
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(key, page, node)
+                node.children[key] = child
+                self._alloc.incref(page)
+                self.nodes += 1
+                added += 1
+            child.last_used = self._clock
+            node = child
+        return added
+
+    def releasable(self) -> int:
+        """Pages the tree could actually FREE by evicting everything:
+        nodes whose page has no holder besides the tree itself.  The
+        engine checks this before evicting — when even a full wipe
+        cannot cover a reservation, destroying the cache buys nothing
+        (the request waits for residents to finish instead, and future
+        prefix hits survive)."""
+        count, stack = 0, list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if self._alloc.refcount(n.page) == 1:
+                count += 1
+        return count
+
+    def _leaves(self) -> List[_RadixNode]:
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def evict(self, need_free: int) -> int:
+        """Drop LRU leaves until the allocator has `need_free` free
+        pages or nothing is evictable.  Dropping a leaf decrefs its
+        page — shared pages survive until their sharers finish.  Returns
+        the number of nodes dropped.
+
+        One DFS seeds a heap of leaves; a drop that exposes its parent
+        pushes the parent, so a whole cold branch unwinds in O(log n)
+        per node instead of rescanning the trie per freed page.  A
+        parent touched AFTER its leaf (heap entries are stale snapshots)
+        re-enters the heap with its CURRENT last_used, so recency is
+        honored at pop time."""
+        import heapq
+        if self._alloc.free_pages >= need_free:
+            return 0
+        heap = [(n.last_used, i, n)
+                for i, n in enumerate(self._leaves())]
+        heapq.heapify(heap)
+        tick = len(heap)
+        dropped = 0
+        while self._alloc.free_pages < need_free and heap:
+            seen, _, victim = heapq.heappop(heap)
+            if victim.children \
+                    or victim.parent.children.get(victim.key) is not victim:
+                continue  # stale entry (no longer a leaf / already gone)
+            if victim.last_used != seen:
+                tick += 1
+                heapq.heappush(heap, (victim.last_used, tick, victim))
+                continue  # touched since snapshot: re-sort by recency
+            parent = victim.parent
+            del parent.children[victim.key]
+            self._alloc.decref(victim.page)
+            self.nodes -= 1
+            dropped += 1
+            if parent is not self._root and not parent.children:
+                tick += 1
+                heapq.heappush(heap, (parent.last_used, tick, parent))
+        return dropped
+
+    def clear(self) -> None:
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self._alloc.decref(node.page)
+        self._root.children.clear()
+        self.nodes = 0

@@ -15,11 +15,22 @@ from typing import Deque, Optional
 
 
 class EngineOverloadedError(RuntimeError):
-    """The engine's admission queue is full; retry later or scale out.
+    """Admission is saturated; retry later or scale out.
 
-    Raised by GenerationEngine.submit()/LLMServer when queued requests
-    exceed max_queue_len.  Deliberately a RuntimeError subclass so
-    generic handlers keep working; serve surfaces it as HTTP 503."""
+    Structured: `reason` distinguishes WHICH resource saturated —
+    "queue_full" (the waiting line hit max_queue_len; drains at
+    admission speed, retry soon) vs "kv_exhausted" (outstanding
+    worst-case KV page demand passed the commit cap; drains at
+    GENERATION speed, retry later) — and `retry_after_s` is the
+    matching client hint (serve surfaces it as HTTP 503 +
+    Retry-After).  Deliberately a RuntimeError subclass so generic
+    handlers keep working."""
+
+    def __init__(self, message: str, *, reason: str = "queue_full",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class FCFSScheduler:
@@ -49,7 +60,8 @@ class FCFSScheduler:
         if len(self._queue) >= self.max_queue_len:
             raise EngineOverloadedError(
                 f"admission queue full ({len(self._queue)}/"
-                f"{self.max_queue_len} requests waiting); retry later")
+                f"{self.max_queue_len} requests waiting); retry later",
+                reason="queue_full", retry_after_s=1.0)
         self._queue.append(request)
 
     def next_request(self) -> Optional[object]:

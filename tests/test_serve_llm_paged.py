@@ -1,0 +1,548 @@
+"""Paged KV cache, radix prefix reuse, and in-engine speculation.
+
+Three layers under test:
+
+  * paging.py bookkeeping — refcounted BlockAllocator + RadixPrefixCache
+    (the load-bearing invariant: evicting one sharer of a prefix page
+    must never free a page another request still gathers through);
+  * decode.paged_chunk_step — block-table attention must match the
+    contiguous cache kernels for any page permutation;
+  * the engine — THE acceptance property is parity: random arrival
+    schedules x {prefix full hit, partial hit, miss} x {speculation
+    on/off} must all stream tokens bit-identical to per-prompt greedy
+    decode.generate(), plus free-page-bounded admission and the
+    structured queue_full / kv_exhausted backpressure split.
+"""
+
+import asyncio
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import decode, gpt, llama
+from ray_tpu.serve.llm import (BlockAllocator, EngineOverloadedError,
+                               GenerationEngine, RadixPrefixCache)
+
+GPT_CFG = gpt.GPTConfig(vocab_size=97, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32, remat=False, use_flash=False)
+LLAMA_CFG = llama.LlamaConfig(vocab_size=97, d_model=32, n_heads=4,
+                              n_kv_heads=2, n_layers=2, d_ff=48,
+                              max_seq=64, dtype=jnp.float32,
+                              remat=False, use_flash=False)
+
+
+def _params(cfg):
+    mod = llama if isinstance(cfg, llama.LlamaConfig) else gpt
+    return mod.init_params(cfg, jax.random.PRNGKey(0))
+
+
+GPT_PARAMS = _params(GPT_CFG)
+
+# One shared shape vocabulary so jit compilations are reused across
+# tests: 3 rows, page 4, max_seq 48, chunk-5 prefill.
+PAGED_KW = dict(num_slots=3, max_seq=48, prefill_chunk=5, page_size=4,
+                kv_pages=40)
+
+
+def _prompt(seed, n, cfg=GPT_CFG):
+    return [int(t) for t in np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 1, cfg.vocab_size))]
+
+
+def _oracle(params, cfg, prompt, max_new, eos_token=None):
+    out = decode.generate(params, jnp.asarray([prompt]), cfg,
+                          max_new_tokens=max_new, eos_token=eos_token)
+    return np.asarray(out[0])
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator
+
+
+def test_block_allocator_refcounted_alloc_free():
+    a = BlockAllocator(4, first_page=1)
+    assert a.free_pages == 4
+    pages = a.alloc(3)
+    assert sorted(pages) == [1, 2, 3] and a.free_pages == 1
+    # all-or-nothing: a too-big request leaves the free list untouched
+    assert a.alloc(2) is None
+    assert a.free_pages == 1
+    # shared page: the second holder keeps it alive
+    a.incref(pages[0])
+    assert not a.decref(pages[0])          # one ref left
+    assert a.refcount(pages[0]) == 1
+    assert a.free_pages == 1
+    assert a.decref(pages[0])              # last ref frees
+    assert a.free_pages == 2
+    with pytest.raises(ValueError):
+        a.decref(pages[0])                 # double free is loud
+    for p in pages[1:]:
+        a.decref(p)
+    assert a.free_pages == 4
+
+
+def test_radix_cache_match_insert_evict():
+    a = BlockAllocator(8, first_page=1)
+    rc = RadixPrefixCache(2, a)
+    toks = [5, 6, 7, 8, 9, 10]
+    pages = a.alloc(3)
+    rc.insert(toks, pages)                 # tree now holds 3 refs
+    assert rc.nodes == 3
+    # full-page match only; max_tokens caps the walk
+    got, n = rc.match(toks)
+    assert got == pages and n == 6
+    got, n = rc.match(toks, max_tokens=5)  # cap at 5 -> 2 full pages
+    assert got == pages[:2] and n == 4
+    got, n = rc.match([5, 6, 7, 99])       # diverges in page 2
+    assert got == pages[:1] and n == 2
+    assert rc.match([1, 2]) == ([], 0)
+    # releasing the requester's own refs leaves pages tree-held
+    for p in pages:
+        a.decref(p)
+    assert a.free_pages == 5
+    # evicting one sharer's node must not free a page a live holder
+    # still reads: hold page[2] as a "request", then evict everything
+    a.incref(pages[2])
+    rc.evict(8)                            # wants all 8 free
+    assert rc.nodes == 0
+    assert a.free_pages == 7               # pages[2] survives its node
+    assert a.refcount(pages[2]) == 1
+    a.decref(pages[2])
+    assert a.free_pages == 8
+
+
+def test_radix_releasable_counts_tree_only_pages():
+    """releasable() is the engine's evict-worthiness pre-check: pages a
+    full wipe could actually free (tree-only holders).  A reservation
+    that even a full wipe cannot cover must not destroy the cache."""
+    a = BlockAllocator(6, first_page=1)
+    rc = RadixPrefixCache(2, a)
+    pages = a.alloc(3)
+    rc.insert([1, 2, 3, 4, 5, 6], pages)
+    # requester still holds all 3 -> nothing is releasable yet
+    assert rc.releasable() == 0
+    a.decref(pages[0])
+    a.decref(pages[1])
+    assert rc.releasable() == 2              # two tree-only pages now
+    # free=3, releasable=2: a 6-page ask is unsatisfiable — the engine
+    # skips evict() in that case; a 5-page ask is coverable
+    assert a.free_pages + rc.releasable() < 6
+    assert a.free_pages + rc.releasable() >= 5
+    rc.evict(5)
+    assert a.free_pages == 5
+    # the shared leaf's NODE went (it blocked the interior pages) but
+    # its page survives on the requester's ref
+    assert rc.nodes == 0
+    assert a.refcount(pages[2]) == 1
+    a.decref(pages[2])
+    assert a.free_pages == 6
+
+
+def test_radix_cache_lru_eviction_order():
+    a = BlockAllocator(4, first_page=1)
+    rc = RadixPrefixCache(2, a)
+    p1 = a.alloc(1)
+    p2 = a.alloc(1)
+    rc.insert([1, 2], p1)
+    rc.insert([3, 4], p2)
+    for p in p1 + p2:
+        a.decref(p)
+    rc.match([1, 2])                       # touch branch 1 -> MRU
+    rc.evict(3)                            # need one page back
+    assert rc.nodes == 1
+    assert rc.match([1, 2])[1] == 2        # MRU branch survived
+    assert rc.match([3, 4])[1] == 0        # LRU branch evicted
+
+
+def test_radix_insert_dedups_existing_chunks():
+    a = BlockAllocator(8, first_page=1)
+    rc = RadixPrefixCache(2, a)
+    first = a.alloc(2)
+    rc.insert([1, 2, 3, 4], first)
+    dup = a.alloc(2)
+    added = rc.insert([1, 2, 3, 4, 5, 6], dup + a.alloc(1))
+    assert added == 1                      # only the NEW third chunk
+    got, n = rc.match([1, 2, 3, 4, 5, 6])
+    assert n == 6
+    assert got[:2] == first                # original pages kept
+
+
+# ---------------------------------------------------------------------------
+# Paged decode kernels
+
+
+@pytest.mark.parametrize(
+    "cfg", [GPT_CFG,
+            pytest.param(LLAMA_CFG, marks=pytest.mark.slow)],
+    ids=["gpt", "llama"])
+def test_paged_chunk_step_matches_contiguous(cfg):
+    """Block-table attention with SCRAMBLED page order must produce the
+    same logits as the contiguous-cache kernels, chunked prefill and
+    per-row-depth decode alike."""
+    params = _params(cfg)
+    psz, nblk = 4, 6                       # virtual width 24
+    lens = [5, 9]
+    seqs = [jax.random.randint(jax.random.PRNGKey(40 + i), (1, n), 1,
+                               cfg.vocab_size) for i, n in enumerate(lens)]
+    # contiguous oracle: per-request caches
+    solo = []
+    for i, (seq, n) in enumerate(zip(seqs, lens)):
+        c = decode.init_cache(cfg, 1, max_seq=nblk * psz)
+        _, c = decode.prefill(params, seq, cfg, c)
+        tok = jnp.asarray([7 + i], jnp.int32)
+        lg, c = decode.decode_step(params, tok, jnp.int32(n), c, cfg)
+        solo.append((lg, c))
+    # paged: one pool, rows own interleaved non-contiguous pages
+    # (page 0 deliberately unused, mirroring the engine's trash page)
+    pool = decode.init_paged_cache(cfg, 2 * nblk + 1, psz)
+    tables = np.asarray([[2, 4, 6, 8, 10, 12],
+                         [11, 3, 9, 1, 7, 5]], np.int32)
+    for i, (seq, n) in enumerate(zip(seqs, lens)):
+        lg, pool = decode.paged_chunk_step(
+            params, seq, jnp.int32(0), pool,
+            jnp.asarray(tables[i:i + 1]), cfg)
+        np.testing.assert_allclose(
+            np.asarray(lg[0, n - 1]),
+            np.asarray(decode.prefill(
+                params, seq, cfg,
+                decode.init_cache(cfg, 1, max_seq=nblk * psz))[0][0, n - 1]),
+            rtol=1e-6, atol=1e-7)
+    toks = jnp.asarray([7, 8], jnp.int32)
+    pos = jnp.asarray(lens, jnp.int32)
+    logits, pool = decode.paged_decode_step(params, toks, pos, pool,
+                                            jnp.asarray(tables), cfg)
+    for i in range(2):
+        np.testing.assert_allclose(np.asarray(logits[i]),
+                                   np.asarray(solo[i][0][0]),
+                                   rtol=1e-6, atol=1e-7)
+        # gathered pages hold exactly the contiguous cache's content
+        pk = np.asarray(pool["k"])[:, tables[i]].reshape(
+            cfg.n_layers, nblk * psz, -1)
+        sk = np.asarray(solo[i][1]["k"])[:, 0].reshape(
+            cfg.n_layers, nblk * psz, -1)
+        cols = lens[i] + 1                 # written columns so far
+        np.testing.assert_allclose(pk[:, :cols], sk[:, :cols],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_paged_writes_touch_only_own_pages():
+    """A row's scatter writes must land only in ITS block table's pages
+    — the page-pool twin of the old touch-only-their-row test."""
+    cfg, params = GPT_CFG, GPT_PARAMS
+    psz = 4
+    pool = decode.init_paged_cache(cfg, 7, psz)
+    t1 = np.asarray([[1, 2, 3]], np.int32)
+    t2 = np.asarray([[4, 5, 6]], np.int32)
+    seq = jax.random.randint(jax.random.PRNGKey(50), (1, 8), 1,
+                             cfg.vocab_size)
+    _, pool = decode.paged_chunk_step(params, seq, jnp.int32(0), pool,
+                                      jnp.asarray(t1), cfg)
+    before = np.asarray(pool["k"])
+    assert np.abs(before[:, [1, 2, 3]]).max() > 0
+    assert np.abs(before[:, [4, 5, 6]]).max() == 0
+    _, pool = decode.paged_chunk_step(params, seq, jnp.int32(0), pool,
+                                      jnp.asarray(t2), cfg)
+    after = np.asarray(pool["k"])
+    np.testing.assert_array_equal(after[:, [1, 2, 3]],
+                                  before[:, [1, 2, 3]])
+    assert np.abs(after[:, [4, 5, 6]]).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# Engine: the parity property sweep
+
+
+@pytest.mark.parametrize("speculate", [0, 3], ids=["spec_off", "spec_on"])
+def test_paged_parity_sweep_prefix_hits_and_speculation(speculate):
+    """THE acceptance property: random arrival schedules x {full prefix
+    hit, partial hit, miss, hit+extension, repetitive} — with and
+    without in-engine speculation — all bit-identical to per-prompt
+    greedy generate().  The warm request populates the radix cache, so
+    later identical prompts take the shared-page path."""
+    base = _prompt(123, 12)                # 3 full pages at page_size 4
+    prompts = {
+        "warm_miss": list(base),
+        "full_hit": list(base),
+        "partial_hit": base[:8] + _prompt(5, 4),
+        "miss": _prompt(9, 10),
+        "hit_extension": base + _prompt(11, 5),
+        "repetitive": [5, 6, 7] * 4,       # prompt-lookup drafts fire
+    }
+    max_new = 8
+    oracles = {k: _oracle(GPT_PARAMS, GPT_CFG, p, max_new)
+               for k, p in prompts.items()}
+    rng = random.Random(speculate)
+
+    async def run():
+        # ngram=1 so drafts actually FIRE against the real model (its
+        # greedy chains repeat tokens within a few steps); most drafts
+        # are then rejected by verification, which is exactly the hard
+        # half of the parity property.
+        eng = GenerationEngine(GPT_PARAMS, GPT_CFG, speculate_k=speculate,
+                               speculate_ngram=1, **PAGED_KW)
+        with eng:
+            warm = eng.submit(prompts["warm_miss"], max_new_tokens=max_new)
+            outs = {"warm_miss": [t async for t in warm]}
+            order = [k for k in prompts if k != "warm_miss"]
+            rng.shuffle(order)
+            streams = {}
+            for k in order:                # staggered random arrivals
+                streams[k] = eng.submit(prompts[k], max_new_tokens=max_new)
+                await asyncio.sleep(rng.random() * 0.05)
+            for k in order:
+                outs[k] = await streams[k].collect()
+            st = eng.stats()
+        return outs, st
+
+    outs, st = asyncio.run(run())
+    for k, want in oracles.items():
+        np.testing.assert_array_equal(
+            np.asarray(outs[k]), want,
+            err_msg=f"case {k} diverged (speculate_k={speculate})")
+    # full_hit, partial_hit, and hit_extension all matched cached pages
+    assert st.prefix_cache_hits >= 3, st
+    assert st.prefix_hit_tokens >= 8 + 8 + 12, st
+    assert st.requests_completed == len(prompts)
+    if speculate:
+        assert st.spec_drafted_tokens > 0, st
+
+
+def test_engine_admission_bounded_by_free_pages_not_rows():
+    """num_slots rows available but a pool too small for all of them:
+    admission must wait for pages, peak concurrency is page-bounded,
+    and everything still completes with parity."""
+    prompts = [_prompt(60 + i, 6) for i in range(4)]
+    oracles = [_oracle(GPT_PARAMS, GPT_CFG, p, 6) for p in prompts]
+
+    async def run():
+        # 6+6 tokens -> 3 pages of 4 each; 6 usable pages -> 2 resident
+        eng = GenerationEngine(GPT_PARAMS, GPT_CFG, num_slots=3,
+                               max_seq=48, prefill_chunk=5, page_size=4,
+                               kv_pages=6, enable_prefix_cache=False)
+        peak = 0
+        with eng:
+            streams = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs = []
+            for s in streams:
+                outs.append(await s.collect())
+                peak = max(peak, eng.stats().active_slots)
+            end = eng.stats()
+        return outs, peak, end
+
+    outs, peak, end = asyncio.run(run())
+    for got, want in zip(outs, oracles):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    assert peak <= 2, peak                 # pages bind before rows
+    assert end.requests_completed == 4
+    assert end.kv_blocks_free == end.kv_blocks_total  # prefix cache off
+
+
+def test_evicting_one_sharer_keeps_shared_pages_alive():
+    """Two requests share prefix pages through the radix cache; the
+    first finishing (and a forced cache eviction) must not corrupt the
+    second mid-generation — the allocator refcount is what stands
+    between them."""
+    base = _prompt(77, 12)
+
+    async def run():
+        eng = GenerationEngine(GPT_PARAMS, GPT_CFG, **PAGED_KW)
+        with eng:
+            await eng.generate(base, max_new_tokens=4)  # warm the cache
+            a = eng.submit(base, max_new_tokens=20)
+            first = await a.__anext__()    # A resident, holding shares
+            b = eng.submit(base, max_new_tokens=6)
+            got_b = await b.collect()      # B shares A's prefix pages
+            # force the tree to drop every node NOW; A must keep going
+            # on its refcounted hold alone
+            eng._prefix.evict(eng.kv_pages)
+            got_a = [first] + [t async for t in a]
+        return got_a, got_b
+
+    got_a, got_b = asyncio.run(run())
+    np.testing.assert_array_equal(
+        np.asarray(got_a), _oracle(GPT_PARAMS, GPT_CFG, base, 20))
+    np.testing.assert_array_equal(
+        np.asarray(got_b), _oracle(GPT_PARAMS, GPT_CFG, base, 6))
+
+
+def test_speculation_accepts_on_predictable_continuation():
+    """A zero-weight model generates token 0 forever, so every
+    prompt-lookup draft comes true: the engine's fused verify must
+    accept drafts (counter > 0) while emitting the exact greedy
+    output."""
+    zero = jax.tree_util.tree_map(jnp.zeros_like, GPT_PARAMS)
+    zero["ln_f"] = jnp.ones_like(zero["ln_f"])
+    prompt = [0] * 8
+    want = _oracle(zero, GPT_CFG, prompt, 16)
+
+    async def run():
+        eng = GenerationEngine(zero, GPT_CFG, speculate_k=3,
+                               speculate_ngram=2, **PAGED_KW)
+        with eng:
+            out = await eng.generate(prompt, max_new_tokens=16)
+            st = eng.stats()
+        return out, st
+
+    out, st = asyncio.run(run())
+    np.testing.assert_array_equal(np.asarray(out), want)
+    assert st.spec_accepted_tokens > 0, st
+    assert st.spec_drafted_tokens >= st.spec_accepted_tokens
+
+
+# ---------------------------------------------------------------------------
+# Structured backpressure
+
+
+def _parked_engine(**kw):
+    """An engine whose worker is parked so admission state is
+    deterministic (same trick as the HTTP 503 test)."""
+    eng = GenerationEngine(GPT_PARAMS, GPT_CFG, **kw)
+    eng.stop()
+    eng.start = lambda: eng
+    return eng
+
+
+def test_submit_distinguishes_queue_full_from_kv_exhausted():
+    # kv_exhausted: commit cap = 1.0 * 6 pages; each request wants
+    # 3 pages (6+6 tokens at page 4) -> the third submit overflows the
+    # cap long before the 50-deep queue fills.
+    eng = _parked_engine(num_slots=2, max_seq=48, prefill_chunk=5,
+                         page_size=4, kv_pages=6, max_queue_len=50,
+                         kv_commit_factor=1.0)
+    eng.submit(_prompt(1, 6), max_new_tokens=6)
+    eng.submit(_prompt(2, 6), max_new_tokens=6)
+    with pytest.raises(EngineOverloadedError) as ei:
+        eng.submit(_prompt(3, 6), max_new_tokens=6)
+    assert ei.value.reason == "kv_exhausted"
+    assert ei.value.retry_after_s > 1.0
+    assert eng.stats().requests_rejected == 1
+
+    # queue_full: huge commit headroom, 1-deep queue.
+    eng2 = _parked_engine(num_slots=2, max_seq=48, prefill_chunk=5,
+                          page_size=4, kv_pages=40, max_queue_len=1,
+                          kv_commit_factor=100.0)
+    eng2.submit(_prompt(4, 6), max_new_tokens=6)
+    with pytest.raises(EngineOverloadedError) as ei:
+        eng2.submit(_prompt(5, 6), max_new_tokens=6)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s == 1.0
+
+    # a request the pool can NEVER hold is a caller error, not overload
+    with pytest.raises(ValueError, match="KV pages"):
+        eng.submit(_prompt(6, 20), max_new_tokens=20)
+
+
+def test_commit_cap_releases_as_requests_finish():
+    async def run():
+        # identical shapes to the admission-bounded test above, so the
+        # two share every jit compilation
+        eng = GenerationEngine(GPT_PARAMS, GPT_CFG, num_slots=3,
+                               max_seq=48, prefill_chunk=5, page_size=4,
+                               kv_pages=6, max_queue_len=50,
+                               kv_commit_factor=1.0,
+                               enable_prefix_cache=False)
+        with eng:
+            await eng.generate(_prompt(1, 6), max_new_tokens=6)
+            await eng.generate(_prompt(2, 6), max_new_tokens=6)
+            # both finished -> demand released -> admission open again
+            out = await eng.generate(_prompt(3, 6), max_new_tokens=6)
+        return out
+
+    assert len(asyncio.run(run())) == 6
+
+
+def test_http_retry_after_tracks_overload_reason():
+    """api.py maps queue_full -> Retry-After 1 and kv_exhausted -> a
+    longer hint, both as structured 503s."""
+    import json
+
+    from ray_tpu.serve._private.replica import Request
+    from ray_tpu.serve.llm.api import LLMServer
+
+    def _call(srv):
+        async def go():
+            req = Request(method="POST", path="/", body=json.dumps(
+                {"tokens": _prompt(7, 6), "max_new_tokens": 6}).encode())
+            return await srv(req)
+        return asyncio.run(go())
+
+    srv = LLMServer(lambda: (GPT_PARAMS, GPT_CFG), engine_config=dict(
+        num_slots=2, max_seq=48, prefill_chunk=5, page_size=4,
+        kv_pages=6, max_queue_len=50, kv_commit_factor=1.0))
+    try:
+        srv.engine.stop()
+        srv.engine.start = lambda: srv.engine
+        srv.engine.submit(_prompt(1, 6), max_new_tokens=6)
+        srv.engine.submit(_prompt(2, 6), max_new_tokens=6)
+        out = _call(srv)
+        assert out["__http__"] is True and out["status"] == 503
+        assert ("Retry-After", "5") in out["headers"], out["headers"]
+    finally:
+        srv.engine.stop()
+
+    srv2 = LLMServer(lambda: (GPT_PARAMS, GPT_CFG), engine_config=dict(
+        num_slots=2, max_seq=48, prefill_chunk=5, page_size=4,
+        kv_pages=40, max_queue_len=1))
+    try:
+        srv2.engine.stop()
+        srv2.engine.start = lambda: srv2.engine
+        srv2.engine.submit(_prompt(1, 6), max_new_tokens=6)
+        out = _call(srv2)
+        assert out["__http__"] is True and out["status"] == 503
+        assert ("Retry-After", "1") in out["headers"], out["headers"]
+    finally:
+        srv2.engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability
+
+
+def test_paged_metrics_exported_via_prometheus():
+    async def run():
+        eng = GenerationEngine(GPT_PARAMS, GPT_CFG, name="pagedprom",
+                               speculate_k=3, speculate_ngram=2,
+                               **PAGED_KW)
+        with eng:
+            await eng.generate(_prompt(99, 9), max_new_tokens=6)
+            await eng.generate(_prompt(99, 9), max_new_tokens=6)
+            st = eng.stats()
+        return st
+
+    st = asyncio.run(run())
+    assert st.prefix_cache_hits >= 1 and st.prefix_cache_misses >= 1
+    assert st.kv_blocks_total == PAGED_KW["kv_pages"]
+    # completed requests release their holds; only radix-held prompt
+    # pages stay out of the free list
+    tree_held = 2 * (9 // PAGED_KW["page_size"])  # two cached prompts..
+    assert st.kv_blocks_free >= st.kv_blocks_total - tree_held
+
+    from ray_tpu.util.metrics import prometheus_text, registry_snapshot
+    text = prometheus_text(registry_snapshot())
+    for needle in ("serve_llm_kv_blocks_total",
+                   "serve_llm_kv_blocks_free",
+                   "serve_llm_prefix_cache_hits_total",
+                   "serve_llm_prefix_cache_misses_total",
+                   "serve_llm_spec_accepted_tokens_total"):
+        assert needle in text, needle
+    assert 'engine="pagedprom"' in text
+
+
+def test_stats_surface_paging_fields_through_server():
+    from ray_tpu.serve.llm.api import LLMServer
+    srv = LLMServer(lambda: (GPT_PARAMS, GPT_CFG),
+                    engine_config=dict(PAGED_KW))
+    try:
+        st = srv.stats()
+        for key in ("kv_blocks_total", "kv_blocks_free", "page_size",
+                    "prefix_cache_hits", "prefix_cache_misses",
+                    "spec_accepted_tokens"):
+            assert key in st, key
+        assert st["page_size"] == PAGED_KW["page_size"]
+    finally:
+        srv.engine.stop()
