@@ -18,7 +18,8 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
-	bench-quick bench-llm-quick bench-transfer chaos chaos-smoke
+	bench-quick bench-llm-quick bench-transfer bench-collective \
+	bench-collective-quick chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -61,6 +62,22 @@ bench-transfer:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
 		$(PY) bench.py --suite transfer --json-out BENCH_transfer.json
 
+# Host collectives on the transfer plane: world-4 allreduce bus GB/s
+# per data plane (one-sided/scratch/wire vs the legacy put/get store
+# ring baseline), bucket fusion, small-tensor latency, cross-plane
+# bit-parity.  Refreshes the checked-in BENCH_collective.json.
+bench-collective:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite collective \
+		--json-out BENCH_collective.json
+
+# <60 s collective smoke (small sizes, fast vs store only; HEADLINE
+# last): catches a collective fast-path regression before a full bench
+# round.  Does NOT touch the checked-in BENCH_collective.json.
+bench-collective-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite collective --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -73,13 +90,18 @@ ifeq ($(origin CHAOS_SEED),undefined)
 CHAOS_SEED := $(shell bash -c 'echo $$RANDOM')
 endif
 
+# ('not nightly', not 'not slow': the collective member-kill/destroy
+# scenarios are slow-marked to keep tier-1 inside its budget, but they
+# ARE the chaos battery's collective coverage.)
 chaos:
 	@echo "== chaos battery: RT_CHAOS_SEED=$(CHAOS_SEED) =="
 	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) timeout -k 10 600 \
-		$(PY) -m pytest -q -m 'not slow' -p no:cacheprovider \
+		$(PY) -m pytest -q -m 'not nightly' -p no:cacheprovider \
 		tests/test_failpoints.py \
 		tests/test_rpc_fastpath.py::test_duplicated_actor_task_frames_deduped_by_seq \
 		tests/test_transfer_plane.py::test_duplicated_push_chunks_deduped_by_offset \
+		tests/test_collective.py::test_member_death_mid_allreduce_fails_survivors_fast \
+		tests/test_collective.py::test_destroy_mid_op_fails_blocked_members_fast \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -97,7 +119,8 @@ chaos-smoke:
 	|| { echo "CHAOS SMOKE FAILED — replay with:" \
 	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
-check: lint verify chaos-smoke bench-quick bench-llm-quick
+check: lint verify chaos-smoke bench-quick bench-llm-quick \
+	bench-collective-quick
 
 store: ray_tpu/_private/_shm_store.so
 

@@ -1005,11 +1005,267 @@ def _stat_size(node, oid):
     return _s()
 
 
+class _CollMember:
+    """Collective bench member: pins the data plane in-process and runs
+    barrier-paced measurements (per-rep wall times returned raw; the
+    driver takes max-across-ranks per rep = op completion time)."""
+
+    def _rt_init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+        col.init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+    def set_plane(self, mode, pvm=True):
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.util.collective import collective as cimpl
+        cfg.collective_data_plane = mode
+        cfg.collective_pvm_reads = pvm
+        for g in cimpl._groups.values():
+            g._plane = None  # re-rendezvous under the new mode
+        return True
+
+    def allreduce_timed(self, nbytes, reps, group, warmups=2):
+        import numpy as np
+        from ray_tpu.util import collective as col
+        arr = np.arange(nbytes // 4, dtype=np.float32)
+        for _ in range(warmups):
+            col.allreduce(arr, group_name=group)
+        ts = []
+        for _ in range(reps):
+            col.barrier(group_name=group)
+            t0 = time.perf_counter()
+            col.allreduce(arr, group_name=group)
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+    def allreduce_value(self, nbytes, group, seed):
+        """Deterministic op for the cross-plane parity check."""
+        import numpy as np
+        from ray_tpu.util import collective as col
+        rank = col.get_group_handle(group).rank
+        arr = np.random.RandomState(seed + rank) \
+            .randn(nbytes // 4).astype(np.float32)
+        return col.allreduce(arr, group_name=group).tobytes()
+
+    def small_latency(self, nbytes, iters, group):
+        import numpy as np
+        from ray_tpu.util import collective as col
+        arr = np.ones(max(1, nbytes // 4), np.float32)
+        col.allreduce(arr, group_name=group)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            col.allreduce(arr, group_name=group)
+        return (time.perf_counter() - t0) / iters
+
+    def bucketed(self, n_tensors, tensor_bytes, reps, group, fused):
+        import numpy as np
+        from ray_tpu.util import collective as col
+        tensors = [np.full(tensor_bytes // 4, float(i), np.float32)
+                   for i in range(n_tensors)]
+        def once():
+            if fused:
+                col.allreduce_coalesced(tensors, group_name=group)
+            else:
+                for t in tensors:
+                    col.allreduce(t, group_name=group)
+        once()  # warmup
+        ts = []
+        for _ in range(reps):
+            col.barrier(group_name=group)
+            t0 = time.perf_counter()
+            once()
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+
+def collective_main(json_out=None, quick=False):
+    """Host collectives on the transfer plane: world-4 same-host
+    allreduce bus bandwidth per data plane —
+
+      * fast (one-sided process_vm_readv reads / scratch-arena memcpys,
+        descriptor-only coordination),
+      * wire (raw KIND_BLOB frames through the windowed chunk pump —
+        what cross-host members run, here over loopback),
+      * store (the pre-rewrite object-store put/get ring: every chunk
+        pays pickle + store seal + mailbox RPCs — the BASELINE),
+      * coord (whole tensors through the coordinator actor),
+
+    plus bucket fusion vs per-tensor sync, small-tensor latency vs
+    world size, and a cross-plane bit-parity check.  bus GB/s =
+    2*(W-1)/W * bytes / wall — the NCCL bus-bandwidth convention, so
+    numbers compare across world sizes."""
+    import numpy as np
+    import ray_tpu
+    from ray_tpu.util import collective as col
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+    world = 4
+    sizes = [1 << 20, 4 << 20] if quick else [8 << 20, 64 << 20]
+    reps = 2 if quick else 3
+    planes = [("fast", ("auto", True)),
+              ("fast_scratch", ("auto", False)),
+              ("wire", ("wire", True)),
+              ("store", ("store", True)),
+              ("coord", ("coord", True))]
+    if quick:
+        planes = [("fast", ("auto", True)), ("store", ("store", True))]
+
+    ray_tpu.init(num_cpus=4)
+    Member = ray_tpu.remote(_CollMember)
+    try:
+        members = [Member.options(num_cpus=0.5).remote()
+                   for _ in range(world)]
+        col.create_collective_group(members, world, list(range(world)),
+                                    group_name="bench")
+
+        def run_all(fn_name, *args, timeout=900):
+            refs = [getattr(m, fn_name).remote(*args) for m in members]
+            return ray_tpu.get(refs, timeout=timeout)
+
+        def set_plane(mode, pvm):
+            run_all("set_plane", mode, pvm, timeout=60)
+
+        def busbw(nbytes, wall):
+            return 2 * (world - 1) / world * nbytes / wall / 1e9
+
+        results = {}
+        for size in sizes:
+            rec = {}
+            for label, (mode, pvm) in planes:
+                set_plane(mode, pvm)
+                outs = run_all("allreduce_timed", size, reps, "bench")
+                per_rep = [max(o[i] for o in outs) for i in range(reps)]
+                wall = min(per_rep)
+                rec[label] = {
+                    "wall_s": round(wall, 4),
+                    "algbw_gbps": round(size / wall / 1e9, 3),
+                    "busbw_gbps": round(busbw(size, wall), 3),
+                }
+            rec["fast_vs_store"] = round(
+                rec["fast"]["busbw_gbps"]
+                / max(1e-9, rec["store"]["busbw_gbps"]), 2)
+            results[f"{size >> 20}MiB"] = rec
+
+        # Cross-plane numerical parity (float32 SUM): the fast plane
+        # must be BIT-identical to the coordinator fold.
+        parity = None
+        if not quick:
+            set_plane("coord", True)
+            base = run_all("allreduce_value", 1 << 20, "bench", 11)
+            set_plane("auto", True)
+            fast = run_all("allreduce_value", 1 << 20, "bench", 11)
+            parity = all(a == b for a, b in zip(base, fast))
+            assert parity, "fast plane diverged from coordinator fold"
+
+        # Bucket fusion: 64 x 256KiB gradients, fused vs one-by-one.
+        set_plane("auto", True)
+        nt, tb = (16, 64 << 10) if quick else (64, 256 << 10)
+        fused = run_all("bucketed", nt, tb, reps, "bench", True)
+        unfused = run_all("bucketed", nt, tb, reps, "bench", False)
+        f_wall = min(max(o[i] for o in fused) for i in range(reps))
+        u_wall = min(max(o[i] for o in unfused) for i in range(reps))
+        bucket_rec = {
+            "tensors": nt, "tensor_bytes": tb,
+            "fused_wall_s": round(f_wall, 4),
+            "unfused_wall_s": round(u_wall, 4),
+            "fusion_speedup": round(u_wall / max(1e-9, f_wall), 2),
+        }
+
+        # Small-tensor latency (coordinator path) vs world size.
+        set_plane("auto", True)
+        lat = {}
+        iters = 10 if quick else 25
+        lat["w4_4KiB_ms"] = round(1000 * max(
+            run_all("small_latency", 4 << 10, iters, "bench")), 3)
+        sub = members[:2]
+        col.create_collective_group(sub, 2, [0, 1], group_name="lat2")
+        outs = ray_tpu.get(
+            [m.small_latency.remote(4 << 10, iters, "lat2")
+             for m in sub], timeout=300)
+        lat["w2_4KiB_ms"] = round(1000 * max(outs), 3)
+
+        stats = {
+            "world_size": world,
+            "config": {
+                "collective_fastpath_min_bytes":
+                    cfg.collective_fastpath_min_bytes,
+                "collective_chunk_bytes": cfg.collective_chunk_bytes,
+                "collective_bucket_bytes": cfg.collective_bucket_bytes,
+                "transfer_window_chunks": cfg.transfer_window_chunks,
+            },
+        }
+    finally:
+        ray_tpu.shutdown()
+
+    # Reference point: the transfer plane's same-host single-stream
+    # pull bandwidth from the checked-in artifact.
+    transfer_ref = None
+    try:
+        with open("BENCH_transfer.json") as f:
+            tr = json.load(f)
+        transfer_ref = tr["detail"]["sizes"]["64MiB"][
+            "pull_same_host_mmap_gbps"]
+    except Exception:
+        pass
+
+    key = list(results)[-1]
+    head = results[key]
+    aggregate_gbps = round(
+        world * 2 * (world - 1) / world * (int(key[:-3]) << 20)
+        / head["fast"]["wall_s"] / 1e9, 3)
+    result = {
+        "metric": "collective_allreduce_busbw_gbps",
+        "value": head["fast"]["busbw_gbps"],
+        "unit": "GB/s",
+        "vs_baseline": head["fast_vs_store"],
+        "detail": {
+            "sizes": results,
+            "bucket_fusion": bucket_rec,
+            "small_tensor_latency": lat,
+            "parity_fast_vs_coord_bit_identical": parity,
+            "transfer_plane_same_host_ref_gbps": transfer_ref,
+            "aggregate_moved_gbps": aggregate_gbps,
+            **stats,
+            "_note": (
+                "busbw = 2*(W-1)/W * tensor_bytes / wall (NCCL "
+                "convention), wall = slowest member, best of "
+                f"{reps} barrier-paced reps, all {world} members on "
+                "ONE host.  vs_baseline = fast busbw / the legacy "
+                "put/get object-store ring at the same size.  "
+                "aggregate_moved_gbps sums all members' moved bytes — "
+                "the number comparable to the transfer plane's "
+                "single-stream pull_same_host_mmap_gbps reference "
+                "(one reader, no concurrency): a W-way collective "
+                "splits the same machine bandwidth across W "
+                "concurrent member processes."),
+        },
+    }
+    line = json.dumps(result)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+    print("HEADLINE collective_allreduce_busbw_gbps="
+          + _fmt_headline(head["fast"]["busbw_gbps"], 3)
+          + " vs_store_ring=" + _fmt_headline(head["fast_vs_store"], 2)
+          + " aggregate_gbps=" + _fmt_headline(aggregate_gbps, 2)
+          + " wire_gbps=" + _fmt_headline(
+              head.get("wire", {}).get("busbw_gbps"), 3)
+          + " store_gbps=" + _fmt_headline(
+              head["store"]["busbw_gbps"], 3)
+          + " fusion_speedup=" + _fmt_headline(
+              bucket_rec["fusion_speedup"], 2)
+          + " parity=" + ("bit-identical" if parity
+                          else "unchecked" if parity is None else "FAIL"))
+    return result
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="train",
-                    choices=["train", "serve_llm", "transfer"])
+                    choices=["train", "serve_llm", "transfer",
+                             "collective"])
     ap.add_argument("--json-out", default=None,
                     help="also write the JSON line to this path "
                          "(serve_llm/transfer default to their "
@@ -1025,5 +1281,9 @@ if __name__ == "__main__":
                        quick=cli.quick)
     elif cli.suite == "transfer":
         transfer_main(cli.json_out or "BENCH_transfer.json")
+    elif cli.suite == "collective":
+        collective_main(cli.json_out if cli.quick
+                        else (cli.json_out or "BENCH_collective.json"),
+                        quick=cli.quick)
     else:
         main()

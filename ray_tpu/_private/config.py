@@ -94,6 +94,40 @@ class _Config:
     # cached spill-file read fds.
     push_stale_sweep_s = _def("push_stale_sweep_s", float, 120.0)
 
+    # --- host collectives (util/collective) ---
+    # One deadline for EVERY collective wait: coordinator rounds,
+    # mailbox send/recv, group creation, and data-plane chunk waits
+    # (was: collect honored RT_COLLECTIVE_TIMEOUT_S while send/recv and
+    # create_collective_group hardcoded 300 s).
+    collective_timeout_s = _def("collective_timeout_s", float, 3600.0)
+    # Tensors at/above this ride the peer-to-peer transfer-plane path
+    # (direct reduce-scatter/allgather chunks as raw blob frames /
+    # same-host scratch memcpys); below it the coordinator reduces in
+    # one round trip, which is cheaper for small tensors.
+    collective_fastpath_min_bytes = _def("collective_fastpath_min_bytes",
+                                         int, 256 * 1024)
+    # Wire-path chunk size and scratch arena capacity for the
+    # collective data plane.  The scratch file is sparse (/dev/shm);
+    # pages materialize only when written.
+    collective_chunk_bytes = _def("collective_chunk_bytes", int, 8 * 1024**2)
+    collective_scratch_bytes = _def("collective_scratch_bytes", int, 1 << 30)
+    # Bucket-fusion target: fuse_buckets coalesces small tensors into
+    # flat buffers of about this many bytes so many tiny gradients ride
+    # one rendezvous + one chunk exchange.
+    collective_bucket_bytes = _def("collective_bucket_bytes",
+                                   int, 32 * 1024**2)
+    # Data-plane selection: "auto" (same-host one-sided reads /
+    # scratch memcpy when the peer is reachable, raw blob frames
+    # otherwise), "wire" (force blob frames even same-host), "store"
+    # (the legacy object-store put/get ring — kept as the bench
+    # baseline), "coord" (everything through the coordinator actor).
+    collective_data_plane = _def("collective_data_plane", str, "auto")
+    # Same-host one-sided reads (process_vm_readv straight out of the
+    # sender's buffer — zero staging).  Probed at rendezvous and
+    # auto-disabled where the kernel forbids it; set false to force
+    # the scratch-arena memcpy path.
+    collective_pvm_reads = _def("collective_pvm_reads", bool, True)
+
     # --- scheduling ---
     max_workers_per_node = _def("max_workers_per_node", int, 64)
     # Fork-server worker spawn (zygote.py): pay the interpreter+import cost

@@ -863,6 +863,23 @@ class Connection:
             wbuf.append(pre)
         wbuf.append(_HLEN.pack(len(hp)))
         wbuf.append(hp)
+        if len(data) < self._COALESCE_MAX and on_sent is None:
+            # Small raw bodies (bucketed collective tails, tiny chunks)
+            # ride the coalescing buffer like any other frame — one
+            # write syscall per loop iteration instead of a forced
+            # flush + dedicated write per blob.  Copied into a bytes
+            # NOW so the caller may reuse its buffer immediately (the
+            # zero-copy discipline only pays off for large payloads).
+            wbuf.append(bytes(data))
+            if not self._wflush_scheduled:
+                self._wflush_scheduled = True
+                self._loop.call_soon(self._scheduled_flush,
+                                     self._wflush_gen)
+            transport = self.writer.transport
+            if (transport is not None
+                    and transport.get_write_buffer_size() > 1 << 20):
+                self._ensure_drain()
+            return
         self._flush_wbuf()  # everything queued before the raw body first
         try:
             self.writer.write(data)

@@ -61,6 +61,48 @@ def _stepped_copy(dest, src, size, step=8 * 1024 * 1024):
         dest[pos:pos + n] = src[pos:pos + n]
 
 
+async def run_windowed(makers, window: int):
+    """Drive coroutine factories keeping at most ``window`` in flight —
+    the transfer plane's sliding-window discipline, factored out so the
+    push path and the collective bulk-data plane share one pump.
+
+    ``makers`` yields zero-arg callables returning awaitables; they are
+    started in order as slots free up.  Fail-fast: the first exception
+    cancels everything in flight AND waits for the cancellations to be
+    delivered (the same rule as _fail_pending — a cancelled chunk's
+    cleanup is what unregisters its reply sink) before re-raising."""
+    window = max(1, window)
+    pending: set = set()
+    it = iter(makers)
+    exhausted = False
+    try:
+        while True:
+            while not exhausted and len(pending) < window:
+                try:
+                    maker = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.add(asyncio.ensure_future(maker()))
+            if not pending:
+                return
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for task in done:
+                task.result()  # re-raises the first failure
+    except BaseException:
+        for task in pending:
+            task.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        raise
+
+
+class _PushChunkFailed(Exception):
+    """A push chunk's receiver reported an error (run_windowed turns it
+    into fail-fast cancellation of the rest of the window)."""
+
+
 class TransferManager:
     """Windowed object transfers for one raylet (both directions)."""
 
@@ -470,32 +512,25 @@ class TransferManager:
                 return False
             gen = begin.get("gen")
             chunk = max(1, cfg.fetch_chunk_bytes)
-            window = max(1, cfg.transfer_window_chunks)
-            pending: set = set()
-            pos = 0
-            ok = True
-            while (pos < size or pending) and ok:
-                while pos < size and len(pending) < window:
-                    n = min(chunk, size - pos)
-                    pending.add(asyncio.get_running_loop().create_task(
-                        self._push_chunk(peer, target_node_id, oid, gen,
-                                         offset, pos, n)))
-                    pos += n
-                finished, pending = await asyncio.wait(
-                    pending, return_when=asyncio.FIRST_COMPLETED)
-                for task in finished:
-                    rep = task.result()
-                    if rep.get("error"):
-                        logger.warning("push %s to %s failed: %s",
-                                       oid.hex()[:8], target_node_id,
-                                       rep["error"])
-                        await self._fail_pending(pending)
-                        ok = False
-                        break
-            if ok:
-                self.stats["pushes"] += 1
-                self.stats["push_bytes"] += size
-            return ok
+
+            async def _one(pos: int, n: int):
+                rep = await self._push_chunk(peer, target_node_id, oid,
+                                             gen, offset, pos, n)
+                if rep.get("error"):
+                    raise _PushChunkFailed(str(rep["error"]))
+
+            try:
+                await run_windowed(
+                    (lambda pos=pos, n=min(chunk, size - pos):
+                     _one(pos, n) for pos in range(0, size, chunk)),
+                    cfg.transfer_window_chunks)
+            except _PushChunkFailed as e:
+                logger.warning("push %s to %s failed: %s",
+                               oid.hex()[:8], target_node_id, e)
+                return False
+            self.stats["pushes"] += 1
+            self.stats["push_bytes"] += size
+            return True
         except Exception as e:
             logger.warning("push %s to %s failed: %s", oid.hex()[:8],
                            target_node_id, e)

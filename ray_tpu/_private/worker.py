@@ -270,8 +270,17 @@ class CoreWorker:
         self.store_path = store_path
         self.store_cap = store_cap
         self.mapping: StoreMapping | None = None
+        # Pluggable worker-to-worker RPC surface: subsystems living in
+        # the worker process (the collective transport) register async
+        # handlers and per-method blob sinks here instead of growing
+        # rpc_* methods on CoreWorker.  blob_providers lets an inbound
+        # KIND_BLOB body land straight in a subsystem-owned buffer.
+        self.ext_rpc: dict[str, object] = {}
+        self.blob_providers: dict[str, object] = {}
+        self._collective_transport = None
         self.server = protocol.RpcServer(self._handle, host=host,
-                                         name=f"cw-{mode}")
+                                         name=f"cw-{mode}",
+                                         blob_provider=self._blob_provider)
         self.addr: tuple[str, int] | None = None
         self.gcs: protocol.Connection | None = None
         self.raylet: protocol.Connection | None = None
@@ -574,6 +583,11 @@ class CoreWorker:
         for q in self._actor_queues.values():
             if q.pump is not None:
                 q.pump.cancel()
+        if self._collective_transport is not None:
+            try:
+                self._collective_transport.close()
+            except Exception:
+                pass
         await self.server.stop()
         for conn in list(self._worker_conns.values()) + \
                 list(self._owner_conns.values()) + \
@@ -590,8 +604,19 @@ class CoreWorker:
     async def _handle(self, conn, method, body):
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
+            ext = self.ext_rpc.get(method)
+            if ext is not None:
+                return await ext(conn, body)
             raise protocol.RpcError(f"core worker: no method {method}")
         return await fn(conn, body)
+
+    def _blob_provider(self, conn, method, header, nraw):
+        """Route an inbound raw-payload frame to the subsystem that
+        registered the method (returns a writable sink or None)."""
+        p = self.blob_providers.get(method)
+        if p is None:
+            return None
+        return p(conn, header, nraw)
 
     async def _telemetry_loop(self):
         """Push metric snapshots + profile events to the GCS KV every few

@@ -101,6 +101,16 @@ def get_trial_id() -> str:
     return _require().trial_id
 
 
+def get_collective_group() -> Optional[str]:
+    """Name of the host collective group the BackendExecutor created
+    across this training gang (every rank is already a member), or
+    None for single-worker runs / externally-managed gangs.  Use it
+    with ray_tpu.util.collective (or train.allreduce_gradients) for
+    data-parallel gradient / statistics sync on the transfer plane."""
+    import os
+    return os.environ.get("RT_TRAIN_COLLECTIVE_GROUP") or None
+
+
 def get_dataset_shard(name: str = "train"):
     """This rank's shard of a Dataset passed to the trainer via
     `datasets=` (reference: air/session.py get_dataset_shard — the

@@ -36,6 +36,13 @@ class TrainingWorkerError(TrainingFailedError):
 def _is_worker_death(e: BaseException) -> bool:
     from ray_tpu._private import protocol
     from ray_tpu import exceptions as rexc
+    from ray_tpu.util.collective.types import CollectiveGroupError
+    if isinstance(e, CollectiveGroupError):
+        # A surviving rank's collective op failed because the GANG
+        # broke (member death aborts the group) — restartable, exactly
+        # like observing the dead actor directly.  Checked before the
+        # TaskError clause: remote errors multi-inherit both.
+        return True
     if isinstance(e, rexc.TaskError):
         # A USER exception re-raised from the train loop (remote errors
         # multi-inherit TaskError + the original type) — even if the
@@ -54,6 +61,7 @@ class BackendExecutor:
         self.scaling_config = scaling_config
         self.worker_group: Optional[WorkerGroup] = None
         self._pg = None
+        self._collective_group: Optional[str] = None
 
     _placement_group = None
 
@@ -78,25 +86,53 @@ class BackendExecutor:
         self._start_workers()
 
     def _start_workers(self):
+        import os
         sc = self.scaling_config
+        self._destroy_collective_group()
         self.worker_group = WorkerGroup(
             sc.num_workers, sc._resources, self._placement_group)
+        # A gang-wide host collective group for data-parallel gradient
+        # / histogram sync (util.collective on the transfer plane).
+        # Named per incarnation so a gang restart gets a fresh
+        # coordinator instead of colliding with the dead one's name.
+        group = None
+        if sc.num_workers > 1:
+            group = f"train_dp_{os.urandom(4).hex()}"
         try:
             # Rank/world env everywhere (reference: rank env wiring in
             # backend_executor._setup_gang).  All workers in flight at
             # once; a per-worker get() would serialize N round trips.
+            env = {
+                "RT_TRAIN_WORLD_SIZE": sc.num_workers,
+            }
+            if group is not None:
+                env["RT_TRAIN_COLLECTIVE_GROUP"] = group
             ray_tpu.get(
-                [w.set_env.remote({
-                    "RT_TRAIN_WORLD_RANK": rank,
-                    "RT_TRAIN_WORLD_SIZE": sc.num_workers,
-                    "RT_TRAIN_LOCAL_RANK": rank,
-                }) for rank, w in enumerate(self.worker_group.workers)],
+                [w.set_env.remote(dict(env, RT_TRAIN_WORLD_RANK=rank,
+                                       RT_TRAIN_LOCAL_RANK=rank))
+                 for rank, w in enumerate(self.worker_group.workers)],
                 timeout=120)
+            if group is not None:
+                from ray_tpu.util import collective as col
+                col.create_collective_group(
+                    self.worker_group.workers, sc.num_workers,
+                    list(range(sc.num_workers)), group_name=group)
+                self._collective_group = group
             self.backend.on_start(self.worker_group, self.backend_config)
         except Exception as e:
             if _is_worker_death(e):
                 raise TrainingWorkerError(str(e)) from e
             raise
+
+    def _destroy_collective_group(self):
+        if self._collective_group is None:
+            return
+        try:
+            from ray_tpu.util import collective as col
+            col.destroy_collective_group(self._collective_group)
+        except Exception:
+            pass
+        self._collective_group = None
 
     def restart(self):
         """Gang-level fault recovery: tear the (partially dead) gang down
@@ -171,6 +207,7 @@ class BackendExecutor:
             self.backend.on_shutdown(self.worker_group, self.backend_config)
         except Exception:
             pass
+        self._destroy_collective_group()
         if self.worker_group is not None:
             self.worker_group.shutdown()
             self.worker_group = None

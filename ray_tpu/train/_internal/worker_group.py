@@ -14,10 +14,13 @@ from typing import Any, Callable, List, Optional
 
 import ray_tpu
 from ray_tpu.air import session as air_session
+from ray_tpu.util.collective import CollectiveMixin
 
 
-class _TrainWorker:
-    """Actor hosting one rank of the gang."""
+class _TrainWorker(CollectiveMixin):
+    """Actor hosting one rank of the gang.  CollectiveMixin lets the
+    BackendExecutor wire the gang into a host collective group at start
+    (data-parallel gradient / histogram sync on the transfer plane)."""
 
     def __init__(self, world_rank: int, world_size: int, local_rank: int):
         self.world_rank = world_rank

@@ -1,0 +1,71 @@
+"""Data-parallel gradient synchronization on the collective fast plane.
+
+The host-side twin of in-graph XLA gradient reduction (which
+ray_tpu.parallel compiles over ICI): when gradients live on host —
+numpy optim states, GBDT statistics, CPU reference training — this
+module buckets them (``util.collective.fuse_buckets``) and allreduces
+the buckets asynchronously over the peer-to-peer transfer plane, so
+many small tensors ride a handful of fused exchanges and communication
+overlaps the caller's unpacking work.
+
+Works with the gang collective group the BackendExecutor creates
+automatically (``session.get_collective_group()``); pass ``group_name``
+to use an explicitly-managed group instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def allreduce_gradients(grads, *, group_name: Optional[str] = None,
+                        average: bool = True,
+                        bucket_bytes: Optional[int] = None):
+    """Sum (and by default average) gradients across the training gang.
+
+    ``grads`` may be a dict (synced in sorted-key order so every rank
+    fuses identically), a list/tuple, or a single array; the reduced
+    values are written back in place where possible and returned in the
+    input's shape.  Single-worker runs (no gang group) return the input
+    unchanged (averaging by world size 1)."""
+    from ray_tpu.air import session
+    from ray_tpu.util import collective as col
+
+    if group_name is None:
+        try:
+            group_name = session.get_collective_group()
+        except Exception:
+            group_name = None
+    if group_name is None:
+        return grads
+
+    if isinstance(grads, dict):
+        keys = sorted(grads)
+        tensors = [np.ascontiguousarray(grads[k]) for k in keys]
+    elif isinstance(grads, (list, tuple)):
+        keys = None
+        tensors = [np.ascontiguousarray(g) for g in grads]
+    else:
+        keys = None
+        tensors = [np.ascontiguousarray(grads)]
+
+    reduced = col.allreduce_coalesced(tensors, group_name=group_name,
+                                      bucket_bytes=bucket_bytes)
+    if average:
+        world = col.get_group_handle(group_name).world_size
+        if world > 1:
+            for t in reduced:
+                # Integer tensors (counts, histograms-as-ints) stay
+                # SUMMED — true division can't land in an int output.
+                if np.issubdtype(t.dtype, np.inexact):
+                    np.divide(t, world, out=t)
+
+    if isinstance(grads, dict):
+        return {k: t for k, t in zip(keys, reduced)}
+    if isinstance(grads, tuple):
+        return tuple(reduced)
+    if isinstance(grads, list):
+        return reduced
+    return reduced[0]

@@ -201,14 +201,22 @@ def _gbdt_train_loop(config: Dict):
     label_col = config["label_column"]
     rank = session.get_world_rank()
     world = session.get_world_size()
-    group = f"gbdt_{session.get_trial_id() or 'default'}"
 
     df = session.get_dataset_shard("train").to_pandas()
     y = df[label_col].to_numpy(np.float64)
     x = df.drop(columns=[label_col]).to_numpy(np.float64)
 
+    own_group = False
     if world > 1:
-        col.init_collective_group(world, rank, group_name=group)
+        # Ride the gang-wide group the BackendExecutor prepared (every
+        # rank is already a member, death-watch armed); standalone use
+        # outside a train gang self-organizes one.  Histograms are MiB
+        # class, so sync rides the peer-to-peer collective fast plane.
+        group = session.get_collective_group()
+        if group is None:
+            group = f"gbdt_{session.get_trial_id() or 'default'}"
+            col.init_collective_group(world, rank, group_name=group)
+            own_group = True
 
         def allreduce(arr):
             return col.allreduce(np.ascontiguousarray(arr),
@@ -258,7 +266,7 @@ def _gbdt_train_loop(config: Dict):
                 "params": params,
                 "label_column": label_col,
             }))
-    if world > 1:
+    if world > 1 and own_group:
         try:
             col.destroy_collective_group(group)
         except Exception:

@@ -1,15 +1,42 @@
+"""Host-side collectives on the transfer plane.
+
+Rendezvous, op sequencing, barriers, and small tensors go through a
+named **coordinator actor** per group; bulk tensors move **peer to
+peer** — same-host members exchange chunks through token-stamped
+/dev/shm scratch arenas (one memcpy each side), cross-host members
+through raw ``KIND_BLOB`` frames with the transfer plane's sliding
+window.  Round ids are coordinator-issued, so a desynced member raises
+a structured :class:`CollectiveGroupError` at the exact diverging round
+instead of deadlocking; a member death or ``destroy_collective_group``
+mid-op fails every blocked peer fast the same way.  ``fuse_buckets`` /
+``allreduce_async`` give DDP-style bucket fusion with
+compute/communication overlap.  Knobs: ``RT_COLLECTIVE_TIMEOUT_S``,
+``RT_COLLECTIVE_FASTPATH_MIN_BYTES``, ``RT_COLLECTIVE_DATA_PLANE``
+(auto|wire|store|coord), ``RT_COLLECTIVE_CHUNK_BYTES``,
+``RT_COLLECTIVE_SCRATCH_BYTES``, ``RT_COLLECTIVE_BUCKET_BYTES`` — see
+README "Collectives on the transfer plane"."""
+
 from ray_tpu.util.collective.collective import (  # noqa: F401
+    CollectiveBucket,
     CollectiveMixin,
+    CollectiveWork,
     allgather,
     allreduce,
+    allreduce_async,
+    allreduce_coalesced,
     barrier,
     broadcast,
+    create_collective_gang,
     create_collective_group,
     destroy_collective_group,
+    fuse_buckets,
     get_group_handle,
     init_collective_group,
     recv,
     reducescatter,
     send,
 )
-from ray_tpu.util.collective.types import ReduceOp  # noqa: F401
+from ray_tpu.util.collective.types import (  # noqa: F401
+    CollectiveGroupError,
+    ReduceOp,
+)

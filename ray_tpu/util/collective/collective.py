@@ -9,22 +9,63 @@ collective_group/nccl_collective_group.py:127, gloo via pygloo).
 On TPU the *tensor* plane never goes through host collectives: gradient
 allreduce etc. compile to XLA collectives over ICI inside jit/pjit (see
 ray_tpu.parallel).  What remains for the framework plane — rendezvous,
-barriers, CPU-side state sync (e.g. RL rollout weights), cross-host
-control — is served here by a coordinator actor per group (the reference's
-gloo/NCCL rendezvous also rides a named store actor).  Members address the
-group by name; the coordinator performs reductions on host numpy.
+barriers, CPU-side state sync (RL rollout weights, GBDT histograms,
+data-parallel host gradients) — is served here with the same split the
+reference's NCCL group uses: a **coordinator actor** per group for
+rendezvous, barriers, op sequencing, and small-tensor reductions, and a
+**peer-to-peer data plane** (transport.py) that moves bulk tensors
+member-to-member as raw blob frames / same-host scratch memcpys.
+
+Design points (see README "Collectives on the transfer plane"):
+
+* **Coordinator-issued rounds.** Every synchronized op consumes one
+  server-side per-rank op index at the coordinator; the round's mode is
+  fixed by the first arrival and any member presenting a different op
+  at the same index fails the WHOLE group with a structured
+  :class:`CollectiveGroupError` (op mismatch) instead of deadlocking on
+  desynced client-side counters.
+* **Direct chunked exchange, rank-order fold.** Large allreduce =
+  reduce-scatter (every pair exchanges its chunk concurrently — the
+  same 2·(W−1)/W per-member bytes as a ring, without W−1 serialized
+  latency steps) + direct allgather.  Contributions are folded in rank
+  order, which makes the result BIT-IDENTICAL to the coordinator's
+  left-fold reduction — the parity contract train/gbdt.py relies on.
+* **Bucket fusion + async handles.** ``fuse_buckets`` coalesces many
+  small tensors into flat buffers that ride one rendezvous;
+  ``allreduce_async`` returns a :class:`CollectiveWork` handle so
+  communication overlaps compute on the caller's thread.
+* **Gang failure semantics.** The coordinator watches member actors
+  through the GCS actor-event channel and aborts every pending round
+  AND pushes abort frames at member data planes when one dies; a
+  destroyed group fails blocked peers the same way.  Waits are bounded
+  by ``cfg.collective_timeout_s`` (RT_COLLECTIVE_TIMEOUT_S) everywhere.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
 import numpy as np
 
 import ray_tpu
-from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.util.collective.types import CollectiveGroupError, ReduceOp
+
+logger = logging.getLogger(__name__)
 
 _groups: dict[str, "GroupMember"] = {}
 
 _COORD_PREFIX = "_rt_collective_coord::"
+
+_UNSET = object()
+
+# Back-compat alias (pre-rewrite name): tensors at/above this size leave
+# the coordinator and ride the peer-to-peer data plane.
+RING_THRESHOLD_BYTES = cfg.collective_fastpath_min_bytes
 
 
 def _reduce(arrays, op: ReduceOp):
@@ -41,35 +82,93 @@ def _reduce(arrays, op: ReduceOp):
     return out
 
 
+def _reduce_into(acc, contrib, op: ReduceOp):
+    """One fold step, elementwise-identical to _reduce's fold (same
+    ufuncs, same order) so the data-plane result is bit-identical to
+    the coordinator path."""
+    if op == ReduceOp.SUM:
+        np.add(acc, contrib, out=acc)
+    elif op == ReduceOp.PRODUCT:
+        np.multiply(acc, contrib, out=acc)
+    elif op == ReduceOp.MIN:
+        np.minimum(acc, contrib, out=acc)
+    else:
+        np.maximum(acc, contrib, out=acc)
+
+
 class _Coordinator:
-    """Async actor implementing barrier-synchronized group ops.  One per
-    collective group, named, owned by whichever member created it first.
+    """Async actor: rendezvous, op sequencing, barriers, small-tensor
+    reductions, and the group's failure authority.  One per collective
+    group, named, owned by whichever member created it first.
 
-    Reductions happen ONCE here and only the result travels to each member
-    (O(world) transfer per op, not O(world^2))."""
+    Round ids are SERVER-ISSUED: each ``collect`` consumes the calling
+    rank's next op index, so a member that slips an extra op in no
+    longer silently desyncs every later round — the mismatch surfaces
+    as a CollectiveGroupError at the exact round where the sequences
+    diverged."""
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, group_name: str = "default"):
         import asyncio
         self.world_size = world_size
-        self._rounds: dict = {}
-        self._results: dict = {}
+        self.group_name = group_name
+        self._next_op: dict = {}      # rank -> next op index
+        self._rounds: dict = {}       # op index -> round state
         self._cond = asyncio.Condition()
-        self._mailbox: dict = {}
+        self._mail: dict = {}
+        self._mail_cond = asyncio.Condition()
+        self._seq = 0                 # data-plane rendezvous sequence
+        self._members: dict = {}      # rank -> endpoint info
+        self._member_actors: dict = {}  # actor_id hex -> rank
+        self._reg_cond = asyncio.Condition()
+        self._dead: str | None = None
+        self._watch_started = False
 
-    async def collect(self, mode, round_id, rank, data):
-        """mode: "reduce:<op>" | "gather" | "src:<rank>" | "barrier"."""
-        key = (mode, round_id)
+    def _err(self) -> CollectiveGroupError:
+        return CollectiveGroupError(self.group_name, self._dead or "dead")
+
+    async def collect(self, mode, rank, data):
+        import asyncio
         async with self._cond:
-            slot = self._rounds.setdefault(key, {})
-            slot[rank] = data
+            if self._dead is not None:
+                raise self._err()
+            idx = self._next_op.get(rank, 0)
+            self._next_op[rank] = idx + 1
+            rnd = self._rounds.get(idx)
+            if rnd is None:
+                rnd = self._rounds[idx] = {"mode": mode, "data": {},
+                                           "result": _UNSET, "reads": set()}
+                if mode.startswith("rdv:"):
+                    self._seq += 1
+                    rnd["seq"] = self._seq
+            if mode != rnd["mode"]:
+                # The group's op sequences diverged: fail EVERYONE now
+                # (the old client-counter scheme deadlocked here).
+                self._dead = (
+                    f"op mismatch at round {idx}: rank {rank} called "
+                    f"{mode!r} but the round opened as {rnd['mode']!r} "
+                    "— members issued different op sequences")
+                self._cond.notify_all()
+                asyncio.get_running_loop().create_task(
+                    self._after_death())
+                raise self._err()
+            rnd["data"][rank] = data
             self._cond.notify_all()
-            while len(self._rounds.get(key, slot)) < self.world_size and \
-                    key not in self._results:
+            while (self._dead is None and rnd["result"] is _UNSET
+                   and len(rnd["data"]) < self.world_size):
                 await self._cond.wait()
-            if key not in self._results:
-                full = self._rounds[key]
-                if mode.startswith("reduce:"):
-                    op = ReduceOp(mode.split(":", 1)[1])
+            if self._dead is not None and rnd["result"] is _UNSET:
+                raise self._err()
+            if rnd["result"] is _UNSET:
+                full = rnd["data"]
+                if mode.startswith("rdv:"):
+                    # Data-plane rendezvous: the round doubles as a
+                    # descriptor exchange (tiny per-rank payloads, e.g.
+                    # one-sided read addresses) so a whole bulk phase
+                    # needs no further coordination.
+                    result = {"seq": rnd["seq"],
+                              "gathered": dict(full)}
+                elif mode.startswith("reduce:"):
+                    op = ReduceOp(mode.split(":", 2)[1])
                     result = _reduce([full[r] for r in sorted(full)], op)
                 elif mode == "gather":
                     result = [full[r] for r in sorted(full)]
@@ -77,32 +176,140 @@ class _Coordinator:
                     result = full[int(mode.split(":", 1)[1])]
                 else:
                     result = True
-                self._results[key] = result
+                rnd["result"] = result
+            rnd["reads"].add(rank)
+            result = rnd["result"]
             # Last reader cleans the round up.
-            reads = self._rounds.setdefault(("_reads",) + key, set())
-            reads.add(rank)
-            result = self._results[key]
-            if len(reads) == self.world_size:
-                self._rounds.pop(key, None)
-                self._rounds.pop(("_reads",) + key, None)
-                self._results.pop(key, None)
+            if len(rnd["reads"]) == self.world_size:
+                self._rounds.pop(idx, None)
             return result
 
     async def put_mail(self, tag, data):
-        import asyncio
-        box = self._mailbox.setdefault(tag, asyncio.Queue())
-        await box.put(data)
+        async with self._mail_cond:
+            if self._dead is not None:
+                raise self._err()
+            self._mail.setdefault(tag, deque()).append(data)
+            self._mail_cond.notify_all()
         return True
 
     async def get_mail(self, tag):
+        async with self._mail_cond:
+            while True:
+                if self._dead is not None:
+                    raise self._err()
+                q = self._mail.get(tag)
+                if q:
+                    item = q.popleft()
+                    # Tags are single-use and globally unique: drop
+                    # drained queues or a long run leaks millions.
+                    if not q:
+                        self._mail.pop(tag, None)
+                    return item
+                await self._mail_cond.wait()
+
+    async def register(self, rank, info):
+        """Data-plane rendezvous: blocks until every member published
+        its endpoint, returns the full table.  Also arms the actor
+        death watch for self-registered members."""
+        self._start_watch()
+        async with self._reg_cond:
+            if self._dead is not None:
+                raise self._err()
+            self._members[rank] = info
+            aid = info.get("actor_id")
+            if aid:
+                self._member_actors[aid] = rank
+            self._reg_cond.notify_all()
+            while self._dead is None \
+                    and len(self._members) < self.world_size:
+                await self._reg_cond.wait()
+            if self._dead is not None:
+                raise self._err()
+            return dict(self._members)
+
+    async def watch(self, actor_ranks: dict):
+        """Arm the death watch for the given {actor_id hex: rank}
+        mapping (called by create_collective_group from the driver, so
+        gang death is detected even before first data-plane use)."""
+        self._member_actors.update(actor_ranks)
+        self._start_watch()
+        return True
+
+    async def abort(self, reason: str = "group destroyed"):
+        """Fail every pending and future group op NOW (destroy while
+        ops are in flight, member death)."""
+        await self._die(reason or "group destroyed")
+        return True
+
+    async def _die(self, reason: str):
+        if self._dead is not None:
+            return
+        self._dead = reason
+        await self._after_death()
+
+    async def _after_death(self):
+        async with self._cond:
+            self._cond.notify_all()
+        async with self._mail_cond:
+            self._mail_cond.notify_all()
+        async with self._reg_cond:
+            self._reg_cond.notify_all()
+        await self._push_aborts(self._dead or "dead")
+
+    async def _push_aborts(self, reason: str):
+        """Best-effort abort frames at every registered member's data
+        plane so a member blocked on a peer CHUNK (not on us) also
+        fails fast instead of riding out the full timeout."""
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.global_worker
+        if w is None:
+            return
+        for _rank, info in list(self._members.items()):
+            try:
+                conn = await w._worker_conn(tuple(info["addr"]))
+                await conn.push("coll_ctl", {
+                    "op": "abort", "group": self.group_name,
+                    "reason": reason})
+            except Exception:
+                pass
+
+    def _start_watch(self):
+        """Subscribe (once) to GCS actor events through the hosting
+        CoreWorker; a DEAD/RESTARTING member actor kills the group."""
+        if self._watch_started:
+            return
+        self._watch_started = True
         import asyncio
-        box = self._mailbox.setdefault(tag, asyncio.Queue())
-        item = await box.get()
-        # Ring tags are single-use and globally unique: drop drained
-        # queues or a long training run leaks millions of them.
-        if box.empty():
-            self._mailbox.pop(tag, None)
-        return item
+        try:
+            from ray_tpu._private import worker as worker_mod
+            w = worker_mod.global_worker
+            if w is None or w.gcs is None:
+                return
+        except Exception:
+            return
+
+        def _on_actor_event(msg):
+            try:
+                if not msg or msg.get("event") not in ("dead",
+                                                       "restarting"):
+                    return
+                actor = msg.get("actor") or {}
+                aid = actor.get("actor_id")
+                aid = aid.hex() if hasattr(aid, "hex") else aid
+                rank = self._member_actors.get(aid)
+                if rank is None:
+                    return
+                cause = actor.get("death_cause") or msg["event"]
+                reason = (f"member rank {rank} (actor "
+                          f"{str(aid)[:12]}) {msg['event']}: {cause}")
+                asyncio.get_running_loop().create_task(self._die(reason))
+            except Exception:
+                logger.exception("collective death watch handler failed")
+
+        w._pubsub_handlers["actors"] = _on_actor_event
+        t = asyncio.get_running_loop().create_task(
+            w.gcs.request("subscribe", {"channels": ["actors"]}))
+        t.add_done_callback(lambda t: t.cancelled() or t.exception())
 
 
 class GroupMember:
@@ -110,7 +317,15 @@ class GroupMember:
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
-        self._round = 0
+        self._plane = None  # (transport, {rank: Endpoint}) after rendezvous
+        self._executor: ThreadPoolExecutor | None = None
+        self._exec_lock = threading.Lock()
+        # Reusable op-local work buffers (accumulators, wire staging),
+        # keyed by stream tag.  First-touch page faults on fresh memory
+        # are expensive under hardened kernels; steady-state gradient
+        # sync must run fault-free, so work buffers are warm and
+        # recycled (ops within a group are serialized by run_op).
+        self._bufs: dict = {}
         coord_name = _COORD_PREFIX + group_name
         try:
             self.coord = ray_tpu.get_actor(coord_name)
@@ -118,28 +333,102 @@ class GroupMember:
             try:
                 coord_cls = ray_tpu.remote(_Coordinator)
                 self.coord = coord_cls.options(
-                    name=coord_name, num_cpus=0).remote(world_size)
+                    name=coord_name, num_cpus=0).remote(world_size,
+                                                        group_name)
             except ValueError:
                 self.coord = ray_tpu.get_actor(coord_name)
+        # Eagerly attach the data-plane transport (registers the
+        # coll_ctl/coll_chunk handlers, clears any stale abort mark
+        # from an earlier group of the same name).
+        try:
+            from ray_tpu.util.collective import transport as _tp
+            _tp.get_transport().forget_group(group_name)
+        except Exception:
+            pass
 
-    def _next_round(self):
-        self._round += 1
-        return self._round
+    def _timeout(self) -> float:
+        return max(0.1, cfg.collective_timeout_s)
+
+    def _coord_get(self, ref):
+        try:
+            return ray_tpu.get(ref, timeout=self._timeout())
+        except CollectiveGroupError:
+            raise
+        except Exception as e:
+            if isinstance(e, CollectiveGroupError):
+                raise
+            raise CollectiveGroupError(
+                self.group_name,
+                f"coordinator call failed: {type(e).__name__}: {e}") from e
 
     def collect(self, mode, value):
-        import os
-        rid = self._next_round()
-        timeout = float(os.environ.get("RT_COLLECTIVE_TIMEOUT_S", "3600"))
-        return ray_tpu.get(
-            self.coord.collect.remote(mode, rid, self.rank, value),
-            timeout=timeout)
+        return self._coord_get(
+            self.coord.collect.remote(mode, self.rank, value))
 
-    def put_mail(self, tag, data, timeout=300.0):
-        ray_tpu.get(self.coord.put_mail.remote(tag, data), timeout=timeout)
+    def put_mail(self, tag, data, timeout=None):
+        self._coord_get(self.coord.put_mail.remote(tag, data))
 
-    def get_mail(self, tag, timeout=300.0):
-        return ray_tpu.get(self.coord.get_mail.remote(tag),
-                           timeout=timeout)
+    def get_mail(self, tag, timeout=None):
+        return self._coord_get(self.coord.get_mail.remote(tag))
+
+    def run_op(self, fn):
+        """Submit a synchronized group op to this member's serial op
+        executor.  ALL round-consuming ops ride it, so the member's op
+        order (and thus its coordinator op indexes) is its submission
+        order even when sync and async ops interleave."""
+        with self._exec_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    1, thread_name_prefix=f"coll-{self.group_name}")
+            return self._executor.submit(fn)
+
+    def fast_plane(self):
+        """Rendezvous the data plane once: publish this member's
+        endpoint, collect everyone's, probe same-host reachability.
+        Returns (transport, {rank: Endpoint}) or None when this process
+        cannot host the transport."""
+        if self._plane is False:
+            return None
+        if self._plane is None:
+            tr = None
+            try:
+                from ray_tpu.util.collective import transport as _tp
+                tr = _tp.get_transport()
+                info = tr.endpoint_info(self.rank)
+            except Exception as e:
+                logger.warning(
+                    "collective data plane unavailable (%s); group '%s' "
+                    "falls back to the coordinator", e, self.group_name)
+                # STILL register (with a no-plane marker): the fallback
+                # must be a GROUP decision — peers blocked in register
+                # while we silently took the coordinator path would
+                # hang to the full timeout.
+                info = {"rank": self.rank, "no_plane": True}
+            table = self._coord_get(
+                self.coord.register.remote(self.rank, info))
+            infos = {int(r): i for r, i in table.items()}
+            if tr is None or any(i.get("no_plane")
+                                 for i in infos.values()):
+                self._plane = False
+                return None
+            from ray_tpu.util.collective.transport import Endpoint
+            eps = {r: Endpoint(i) for r, i in infos.items()}
+            eps.pop(self.rank, None)
+            tr.prepare_group(self.group_name, eps, infos)
+            self._plane = (tr, eps)
+        return self._plane
+
+    def buf(self, tag: str, size: int, dtype) -> np.ndarray:
+        """Warm reusable work buffer for one op-local stream."""
+        b = self._bufs.get(tag)
+        if b is None or b.size < size or b.dtype != dtype:
+            b = self._bufs[tag] = np.empty(size, dtype)
+        return b[:size]
+
+    def shutdown(self):
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+        self._bufs.clear()
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -156,7 +445,10 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
                             backend: str = "tcp",
                             group_name: str = "default"):
     """Declare a group across actor handles from the driver (reference:
-    collective.py declare_collective_group): calls init on each member."""
+    collective.py declare_collective_group): calls init on each member
+    and arms the coordinator's death watch with their actor ids, so a
+    member dying mid-op fails the group fast instead of hanging peers
+    to the collective timeout."""
     if len(actors) != len(ranks):
         raise ValueError(
             f"{len(actors)} actors but {len(ranks)} ranks")
@@ -167,16 +459,85 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
     for actor, rank in zip(actors, ranks):
         refs.append(actor._rt_init_collective.remote(
             world_size, rank, backend, group_name))
-    ray_tpu.get(refs, timeout=300)
+    ray_tpu.get(refs, timeout=max(0.1, cfg.collective_timeout_s))
+    mapping = {}
+    for actor, rank in zip(actors, ranks):
+        aid = getattr(actor, "_actor_id", None)
+        if aid is not None:
+            mapping[aid.hex()] = rank
+    if mapping:
+        try:
+            coord = ray_tpu.get_actor(_COORD_PREFIX + group_name)
+            ray_tpu.get(coord.watch.remote(mapping), timeout=60)
+        except Exception:
+            logger.warning("could not arm death watch for group '%s'",
+                           group_name, exc_info=True)
+
+
+def create_collective_gang(actor_cls, world_size: int, *,
+                           group_name: str = "default",
+                           strategy: str = "PACK",
+                           actor_options: dict | None = None,
+                           actor_args: tuple = (),
+                           actor_kwargs: dict | None = None):
+    """Gang-schedule a collective group: reserve a placement group with
+    one bundle per rank, create the member actors inside it (bundle i =
+    rank i), and wire them into ``group_name`` with the death watch
+    armed.  Returns ``(actors, placement_group)``; the caller owns both
+    (``destroy_collective_group`` + ``remove_placement_group``)."""
+    from ray_tpu.util.placement_group import placement_group
+    opts = dict(actor_options or {})
+    # Bundles must mirror EVERY requested resource: a bundle-pinned
+    # actor draws from its bundle's own pool, so a CPU-only bundle
+    # would leave GPU/TPU/custom-resource members pending forever.
+    bundle = {"CPU": opts.get("num_cpus", 1)}
+    if opts.get("num_gpus"):
+        bundle["GPU"] = opts["num_gpus"]
+    if opts.get("num_tpus"):
+        bundle["TPU"] = opts["num_tpus"]
+    bundle.update(opts.get("resources") or {})
+    bundles = [dict(bundle) for _ in range(world_size)]
+    pg = placement_group(bundles, strategy=strategy)
+    if not pg.wait(min(120.0, max(1.0, cfg.collective_timeout_s))):
+        raise CollectiveGroupError(group_name,
+                                   "gang placement group never became "
+                                   f"ready ({world_size} bundles)")
+    actors = []
+    for rank in range(world_size):
+        o = dict(opts)
+        o["placement_group"] = pg
+        o["placement_group_bundle_index"] = rank
+        actors.append(actor_cls.options(**o).remote(
+            *actor_args, **(actor_kwargs or {})))
+    create_collective_group(actors, world_size, list(range(world_size)),
+                            group_name=group_name)
+    return actors, pg
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    """Tear down the group's coordinator actor so the name can be reused
-    with a different world size.  Works from any member OR from the driver
-    that called create_collective_group."""
-    _groups.pop(group_name, None)
+    """Tear down the group: pending ops on EVERY member fail fast with
+    CollectiveGroupError naming the group (coordinator abort + data
+    plane abort frames), then the coordinator actor dies so the name
+    can be reused with a different world size."""
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        g.shutdown()
+    try:
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.global_worker
+        if w is not None and w._collective_transport is not None:
+            w._collective_transport.forget_group(group_name)
+    except Exception:
+        pass
     try:
         coord = ray_tpu.get_actor(_COORD_PREFIX + group_name)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(coord.abort.remote("group destroyed"), timeout=30)
+    except Exception:
+        pass
+    try:
         ray_tpu.kill(coord)
     except Exception:
         pass
@@ -195,54 +556,304 @@ def _as_numpy(tensor):
     return np.asarray(tensor)
 
 
-# Tensors at/above this size take the ring path (object-store
-# peer-to-peer chunks) instead of moving whole through the coordinator.
-import os as _os
-RING_THRESHOLD_BYTES = int(_os.environ.get("RT_RING_THRESHOLD_BYTES",
-                                           1 << 22))
-
-
-def allreduce(tensor, group_name: str = "default",
-              op: ReduceOp = ReduceOp.SUM):
-    """In-place allreduce of a host tensor across the group (reference:
-    collective.py:258).  Device tensors are fetched to host; for on-device
-    gradient reduction use XLA collectives via ray_tpu.parallel instead.
-
-    Large tensors use a ring reduce-scatter + allgather whose chunks move
-    member-to-member through the shared-memory object store — the
-    coordinator relays only ObjectRefs, so no process ever handles
-    O(world * bytes) (reference architecture: the NCCL ring in
-    collective_group/nccl_collective_group.py:127; ours rides the
-    framework's own data plane)."""
-    g = get_group_handle(group_name)
-    arr = _as_numpy(tensor)
-    if arr.nbytes >= RING_THRESHOLD_BYTES and g.world_size > 2:
-        out = _ring_allreduce(g, arr, op)
-    else:
-        out = g.collect(f"reduce:{op.value}", arr)
+def _writeback(tensor, out):
+    if isinstance(tensor, np.ndarray) and isinstance(out, np.ndarray) \
+            and out.base is not None and np.shares_memory(tensor, out):
+        return tensor  # in-place fast path already wrote the result
     try:
         tensor[...] = out
         return tensor
-    except TypeError:
-        return out
+    except (TypeError, ValueError):
+        # Non-writable tensor: never hand back a cached work buffer
+        # (the next op would overwrite it under the caller).
+        return np.array(out, copy=True) if isinstance(out, np.ndarray) \
+            else out
 
 
-def _reduce_pair(a, b, op: ReduceOp):
-    if op == ReduceOp.SUM:
-        return a + b
-    if op == ReduceOp.PRODUCT:
-        return a * b
-    if op == ReduceOp.MIN:
-        return np.minimum(a, b)
-    return np.maximum(a, b)
+def _plane_for(g: GroupMember, nbytes: int) -> str:
+    """Pick the data plane for one op: "coord" (coordinator round
+    trip), "store" (legacy object-store ring, kept as the bench
+    baseline), or "fast" (peer-to-peer transfer plane)."""
+    mode = cfg.collective_data_plane
+    if g.world_size <= 1 or mode == "coord":
+        return "coord"
+    if nbytes < cfg.collective_fastpath_min_bytes:
+        return "coord"
+    if mode == "store":
+        return "store"
+    if g.fast_plane() is None:
+        return "coord"
+    return "fast"
 
 
-def _ring_allreduce(g: "GroupMember", arr: np.ndarray, op: ReduceOp):
-    """Ring allreduce: W-1 reduce-scatter steps + W-1 allgather steps.
-    Per-member traffic 2*(W-1)/W of the tensor, fully parallel across the
-    ring; after reduce-scatter rank r owns complete chunk (r+1) % W."""
+def _chunk_slices(n: int, w: int) -> list[slice]:
+    q, r = divmod(n, w)
+    out, pos = [], 0
+    for i in range(w):
+        ln = q + (1 if i < r else 0)
+        out.append(slice(pos, pos + ln))
+        pos += ln
+    return out
+
+
+def _wait_sends(g: GroupMember, futs, deadline):
+    for f in futs:
+        remain = max(0.1, deadline - time.monotonic()) + 10.0
+        try:
+            f.result(remain)
+        except CollectiveGroupError:
+            raise
+        except Exception as e:
+            raise CollectiveGroupError(
+                g.group_name,
+                f"chunk send failed: {type(e).__name__}: {e}") from e
+
+
+# --------------------------------------------------------------- allreduce
+def allreduce(tensor, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    """In-place allreduce of a host tensor across the group (reference:
+    collective.py:258).  Device tensors are fetched to host; for
+    on-device gradient reduction use XLA collectives via
+    ray_tpu.parallel instead.
+
+    Large tensors ride the peer-to-peer data plane (direct chunked
+    reduce-scatter + allgather over same-host scratch memcpys / raw
+    blob frames); the result is bit-identical to the coordinator path
+    (rank-order fold)."""
+    g = get_group_handle(group_name)
+    arr = _as_numpy(tensor)
+    out = g.run_op(lambda: _allreduce_impl(g, arr, op)).result(
+        g._timeout() + 60)
+    return _writeback(tensor, out)
+
+
+def allreduce_async(tensor, group_name: str = "default",
+                    op: ReduceOp = ReduceOp.SUM) -> "CollectiveWork":
+    """Start an allreduce and return a :class:`CollectiveWork` handle;
+    ``wait()`` writes the result back into ``tensor`` (when writable)
+    and returns it.  Ops submitted to one group run in submission order
+    on the member's op executor, so async and sync ops compose as long
+    as every member submits the same sequence."""
+    g = get_group_handle(group_name)
+    arr = _as_numpy(tensor)
+    fut = g.run_op(lambda: _allreduce_impl(g, arr, op))
+    return CollectiveWork(fut, g,
+                          finalize=lambda out: _writeback(tensor, out))
+
+
+def _allreduce_impl(g: GroupMember, arr: np.ndarray, op: ReduceOp):
+    plane = _plane_for(g, arr.nbytes)
+    if plane == "fast":
+        return _fast_allreduce(g, arr, op)
+    if plane == "store":
+        return _store_ring_allreduce(g, arr, op)
+    return g.collect(f"reduce:{op.value}", arr)
+
+
+def _all_onesided(eps: dict) -> bool:
+    return bool(eps) and all(ep.pvm for ep in eps.values())
+
+
+def _pvm_fp(g: GroupMember, rank: int):
+    """Failpoint hook for the one-sided read path (collective.chunk:
+    error/kill against peer r<rank>)."""
+    from ray_tpu._private import failpoints
+    if failpoints.ACTIVE:
+        act = failpoints.check("collective.chunk", peer=f"r{rank}")
+        if act is not None:
+            if act.kind == "error":
+                raise CollectiveGroupError(
+                    g.group_name, "failpoint: injected collective "
+                    f"chunk error to rank {rank}")
+            if act.kind == "delay":
+                time.sleep(act.delay_s)
+            elif act.kind == "kill":
+                import os
+                os._exit(int(act.arg or 1))
+
+
+def _pvm_read(g: GroupMember, desc, dst: np.ndarray, off: int, n: int,
+              rank: int):
+    """One chunk straight out of a peer's address space into ``dst``."""
+    from ray_tpu.util.collective import transport as _tp
+    _pvm_fp(g, rank)
+    try:
+        _tp.pvm_read_into(desc["pid"], desc["addr"] + off,
+                          dst.ctypes.data, n)
+    except OSError as e:
+        raise CollectiveGroupError(
+            g.group_name, f"one-sided read from rank {rank} "
+            f"(pid {desc['pid']}) failed — peer dead?: {e}") from e
+
+
+def _onesided_allreduce(g: GroupMember, arr: np.ndarray,
+                        flat: np.ndarray, op: ReduceOp):
+    """All-same-host allreduce as pure one-sided reads: the rendezvous
+    round exchanges (pid, address) descriptors for everyone's input,
+    the fold reads peer chunks STRAIGHT out of their processes (no
+    staging writes, no per-chunk messages), a second descriptor round
+    publishes the reduced chunks, and the gather reads those.  The two
+    extra coordinator rounds are the ONLY coordination — barriers that
+    double as buffer-release acks."""
     w, r = g.world_size, g.rank
-    rid = g._next_round()
+    sig = f"{op.value}:{arr.dtype.str}:{arr.nbytes}"
+    rep = g.collect(f"rdv:allreduce:{sig}",
+                    {"pid": _os_getpid(), "addr": int(flat.ctypes.data)})
+    descs = rep["gathered"]
+    sl = _chunk_slices(flat.size, w)
+    esz = flat.dtype.itemsize
+    my = flat[sl[r]]
+    acc = g.buf("acc", my.size, flat.dtype)
+    stag = g.buf("stag", my.size, flat.dtype)
+    if my.size:
+        first = True
+        for p in range(w):  # rank order == coordinator fold order
+            if p == r:
+                contrib = my
+            else:
+                _pvm_read(g, descs[p], stag, sl[r].start * esz,
+                          my.nbytes, p)
+                contrib = stag
+            if first:
+                np.copyto(acc, contrib)
+                first = False
+            else:
+                _reduce_into(acc, contrib, op)
+    # Fold-done barrier doubling as the reduced-chunk publication; it
+    # also guarantees every peer finished reading OUR input, so the
+    # gather below may overwrite `flat` in place.
+    rep2 = g.collect(f"rdv:allreduce-ag:{sig}",
+                     {"pid": _os_getpid(), "addr": int(acc.ctypes.data)})
+    accs = rep2["gathered"]
+    # In place when writable; otherwise a FRESH buffer — results may
+    # outlive this op (async handles defer the write-back), so they
+    # must never alias a recycled work buffer.
+    out = flat if flat.flags.writeable else np.empty_like(flat)
+    for p in range(w):
+        if p == r:
+            continue
+        n = (sl[p].stop - sl[p].start) * esz
+        if n:
+            _pvm_read(g, accs[p], out[sl[p]], 0, n, p)
+    out[sl[r]] = acc
+    # No release round needed: peers read `acc` only during THEIR
+    # gather, and we next mutate it after a future op's rendezvous —
+    # which cannot complete until every peer left this gather.  (The
+    # op-mismatch guard keeps this airtight: every synchronized op
+    # opens with a collect round.)
+    return out.reshape(arr.shape)
+
+
+def _os_getpid() -> int:
+    import os
+    return os.getpid()
+
+
+def _fast_allreduce(g: GroupMember, arr: np.ndarray, op: ReduceOp):
+    """Direct reduce-scatter + allgather on the transfer plane.
+
+    When every peer is same-host (all exchanges are scratch memcpys,
+    acked synchronously on send), the op runs IN PLACE on the input
+    buffer: the result lands where the caller's tensor already lives
+    and no fresh output pages are faulted.  Wire peers hold references
+    to in-flight chunk views until acked, so a mixed/wire group uses a
+    warm cached output buffer instead."""
+    tr, eps = g.fast_plane()
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    if _all_onesided(eps):
+        return _onesided_allreduce(g, arr, flat, op)
+    rep = g.collect(
+        f"rdv:allreduce:{op.value}:{arr.dtype.str}:{arr.nbytes}", None)
+    seq = rep["seq"]
+    deadline = time.monotonic() + g._timeout()
+    grp, w, r = g.group_name, g.world_size, g.rank
+    all_shm = all(ep.same_host for ep in eps.values())
+    if all_shm and flat.flags.writeable:
+        out = flat  # in place: sends copy chunks to scratch eagerly
+    else:
+        # Fresh, not a cached work buffer: the result may be consumed
+        # after later ops ran (async handles defer the write-back).
+        out = np.empty_like(flat)
+    sl = _chunk_slices(flat.size, w)
+    esz = flat.dtype.itemsize
+    sends: list = []
+    handles: dict = {}
+    try:
+        # ---- reduce-scatter: everyone exchanges chunks pairwise ----
+        my = flat[sl[r]]
+        for p, ep in eps.items():
+            cp = flat[sl[p]]
+            if cp.size:
+                sends.append(tr.send(ep, (grp, seq, 0, r, p), cp,
+                                     deadline, slot=f"rs{p}"))
+        acc = g.buf("acc", my.size, flat.dtype)
+        if my.size:
+            for p, ep in eps.items():
+                # Warm per-peer staging: one-sided reads and wire bytes
+                # land here (scratch-arena peers return a direct view
+                # of their arena instead and ignore the sink).
+                stag = g.buf(f"stag{p}", my.size, flat.dtype)
+                handles[(0, p)] = tr.recv(ep, (grp, seq, 0, p, r),
+                                          my.nbytes, deadline, sink=stag)
+            first = True
+            for p in range(w):  # rank order == coordinator fold order
+                if p == r:
+                    contrib = my
+                else:
+                    contrib = handles[(0, p)].wait_array(flat.dtype)
+                if first:
+                    np.copyto(acc, contrib)
+                    first = False
+                else:
+                    _reduce_into(acc, contrib, op)
+                if p != r:
+                    handles.pop((0, p)).release()
+        if out is flat:
+            # In-place output: peers may still be consuming our
+            # reduce-scatter chunks; their acks must land before the
+            # gather phase overwrites `flat`.
+            _wait_sends(g, sends, deadline)
+            sends = []
+        # ---- allgather: each rank multicasts its reduced chunk ----
+        for p, ep in eps.items():
+            n = (sl[p].stop - sl[p].start) * esz
+            if n:
+                handles[(1, p)] = tr.recv(ep, (grp, seq, 1, p, r), n,
+                                          deadline, sink=out[sl[p]])
+        if acc.size:
+            sends += tr.multicast(
+                [(ep, (grp, seq, 1, r, p)) for p, ep in eps.items()],
+                acc, deadline, slot="ag")
+        out[sl[r]] = acc
+        for p in list(eps):
+            h = handles.pop((1, p), None)
+            if h is None:
+                continue
+            a = h.wait_array(flat.dtype)
+            if not h.delivered_in_place:
+                np.copyto(out[sl[p]], a)
+            h.release()
+        _wait_sends(g, sends, deadline)
+    finally:
+        for h in handles.values():
+            try:
+                h.release()
+            except Exception:
+                pass
+    return out.reshape(arr.shape)
+
+
+def _store_ring_allreduce(g: GroupMember, arr: np.ndarray, op: ReduceOp):
+    """The pre-rewrite object-store ring (every chunk through
+    ray_tpu.put/get plus a coordinator mailbox hop) — kept as the bench
+    baseline and as a fallback plane (RT_COLLECTIVE_DATA_PLANE=store).
+    Round ids now come from the coordinator rendezvous, so this path
+    can no longer desync the group."""
+    rep = g.collect(
+        f"rdv:ringstore:{op.value}:{arr.dtype.str}:{arr.nbytes}", None)
+    rid = rep["seq"]
+    w, r = g.world_size, g.rank
     flat = arr.reshape(-1)
     n = flat.size
     pad = (-n) % w
@@ -252,6 +863,16 @@ def _ring_allreduce(g: "GroupMember", arr: np.ndarray, op: ReduceOp):
     nxt, prv = (r + 1) % w, (r - 1) % w
     sent_refs = []  # keep owned until the ring drains (receivers borrow)
 
+    def _pair(a, b):
+        if op == ReduceOp.SUM:
+            return a + b
+        if op == ReduceOp.PRODUCT:
+            return a * b
+        if op == ReduceOp.MIN:
+            return np.minimum(a, b)
+        return np.maximum(a, b)
+
+    timeout = g._timeout()
     for s in range(w - 1):
         send_idx = (r - s) % w
         recv_idx = (r - s - 1) % w
@@ -262,8 +883,8 @@ def _ring_allreduce(g: "GroupMember", arr: np.ndarray, op: ReduceOp):
         # refs pass through, so only the tiny ref crosses the coordinator.
         g.put_mail(f"rs:{rid}:{s}:{r}->{nxt}", (ref,))
         got = g.get_mail(f"rs:{rid}:{s}:{prv}->{r}")[0]
-        chunks[recv_idx] = _reduce_pair(
-            chunks[recv_idx], ray_tpu.get(got, timeout=300), op)
+        chunks[recv_idx] = _pair(chunks[recv_idx],
+                                 ray_tpu.get(got, timeout=timeout))
     for s in range(w - 1):
         send_idx = (r + 1 - s) % w
         recv_idx = (r - s) % w
@@ -271,7 +892,7 @@ def _ring_allreduce(g: "GroupMember", arr: np.ndarray, op: ReduceOp):
         sent_refs.append(ref)
         g.put_mail(f"ag:{rid}:{s}:{r}->{nxt}", (ref,))
         got = g.get_mail(f"ag:{rid}:{s}:{prv}->{r}")[0]
-        chunks[recv_idx] = np.asarray(ray_tpu.get(got, timeout=300))
+        chunks[recv_idx] = np.asarray(ray_tpu.get(got, timeout=timeout))
     # Everyone has fetched everything once all members reach this point;
     # only then may the owned chunk refs be released.
     g.collect("barrier", None)
@@ -282,66 +903,393 @@ def _ring_allreduce(g: "GroupMember", arr: np.ndarray, op: ReduceOp):
     return out.reshape(arr.shape)
 
 
+# ------------------------------------------------- bucket fusion / handles
+class CollectiveWork:
+    """Handle for an in-flight collective op (``allreduce_async``,
+    ``CollectiveBucket.allreduce_async``).  ``wait()`` blocks until the
+    op finished, applies the write-back/unpack, and returns the result;
+    exceptions (CollectiveGroupError included) re-raise there."""
+
+    def __init__(self, fut, group: GroupMember, finalize=None):
+        self._fut = fut
+        self._group = group
+        self._finalize = finalize
+        self._done_result = _UNSET
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def wait(self, timeout: float | None = None):
+        if self._done_result is not _UNSET:
+            return self._done_result
+        out = self._fut.result(
+            timeout if timeout is not None
+            else self._group._timeout() + 60)
+        if self._finalize is not None:
+            out = self._finalize(out)
+        self._done_result = out
+        return out
+
+
+class CollectiveBucket:
+    """Coalesces small same-dtype tensors into ONE flat buffer so they
+    ride a single rendezvous + chunk exchange (bucket fusion — the
+    DDP-style gradient bucketing).  ``indices`` remembers each tensor's
+    position in the caller's original list so fused results can be
+    reassembled in order."""
+
+    def __init__(self, tensors, indices=None):
+        tensors = [_as_numpy(t) for t in tensors]
+        if not tensors:
+            raise ValueError("empty bucket")
+        dt = tensors[0].dtype
+        for t in tensors:
+            if t.dtype != dt:
+                raise ValueError(
+                    f"bucket mixes dtypes {dt} and {t.dtype}; "
+                    "fuse_buckets partitions by dtype")
+        self.tensors = tensors
+        self.indices = list(indices) if indices is not None \
+            else list(range(len(tensors)))
+        self._shapes = [t.shape for t in tensors]
+        self._sizes = [int(t.size) for t in tensors]
+        self.flat = np.empty(sum(self._sizes), dtype=dt)
+        pos = 0
+        for t, n in zip(tensors, self._sizes):
+            np.copyto(self.flat[pos:pos + n], t.reshape(-1))
+            pos += n
+
+    @property
+    def nbytes(self) -> int:
+        return self.flat.nbytes
+
+    def unpack(self, reduced: np.ndarray) -> list:
+        """Scatter the fused result back into the original tensors
+        (in place when writable); returns them in bucket order."""
+        outs, pos = [], 0
+        for t, shape, n in zip(self.tensors, self._shapes, self._sizes):
+            piece = reduced[pos:pos + n].reshape(shape)
+            outs.append(_writeback(t, piece))
+            pos += n
+        return outs
+
+    def allreduce_async(self, group_name: str = "default",
+                        op: ReduceOp = ReduceOp.SUM) -> CollectiveWork:
+        g = get_group_handle(group_name)
+        fut = g.run_op(lambda: _allreduce_impl(g, self.flat, op))
+        return CollectiveWork(fut, g, finalize=self.unpack)
+
+    def allreduce(self, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM) -> list:
+        return self.allreduce_async(group_name, op).wait()
+
+
+def fuse_buckets(tensors, bucket_bytes: int | None = None
+                 ) -> list[CollectiveBucket]:
+    """Partition ``tensors`` into dtype-homogeneous buckets of about
+    ``bucket_bytes`` (cfg.collective_bucket_bytes) each, preserving
+    order within a dtype.  Every member must fuse the SAME tensor list
+    in the same order — buckets consume group rounds like any op."""
+    bb = max(1, bucket_bytes or cfg.collective_bucket_bytes)
+    by_dtype: dict = {}
+    for i, t in enumerate(tensors):
+        a = _as_numpy(t)
+        by_dtype.setdefault(a.dtype.str, []).append((i, t))
+    buckets = []
+    for _dt, entries in sorted(by_dtype.items()):
+        cur, cur_idx, cur_bytes = [], [], 0
+        for i, t in entries:
+            nb = _as_numpy(t).nbytes
+            if cur and cur_bytes + nb > bb:
+                buckets.append(CollectiveBucket(cur, cur_idx))
+                cur, cur_idx, cur_bytes = [], [], 0
+            cur.append(t)
+            cur_idx.append(i)
+            cur_bytes += nb
+        if cur:
+            buckets.append(CollectiveBucket(cur, cur_idx))
+    return buckets
+
+
+def allreduce_coalesced(tensors, group_name: str = "default",
+                        op: ReduceOp = ReduceOp.SUM,
+                        bucket_bytes: int | None = None) -> list:
+    """Allreduce many tensors through fused buckets with async overlap:
+    all buckets are submitted before any is waited on, so bucket k+1's
+    communication overlaps bucket k's unpack.  Returns the reduced
+    tensors in input order (in place when writable)."""
+    tensors = list(tensors)  # may be an iterator; consumed twice below
+    buckets = fuse_buckets(tensors, bucket_bytes)
+    works = [(b, b.allreduce_async(group_name, op)) for b in buckets]
+    out = [None] * len(tensors)
+    for b, wk in works:
+        for idx, t in zip(b.indices, wk.wait()):
+            out[idx] = t
+    return out
+
+
+# ------------------------------------------------------------- other ops
 def allgather(tensor_list: list, tensor, group_name: str = "default"):
-    """Gather each rank's tensor into tensor_list (reference: :423)."""
+    """Gather each rank's tensor into tensor_list (reference: :423).
+    Large tensors move peer-to-peer (each rank multicasts its tensor),
+    so no process ever funnels O(world x bytes).
+
+    Contract (reference semantics): every rank contributes the SAME
+    shape and dtype.  The rendezvous signature pins them, so a
+    mismatched contribution fails the group with a structured op
+    mismatch error instead of silently corrupting the gather."""
     g = get_group_handle(group_name)
-    gathered = g.collect("gather", _as_numpy(tensor))
+    arr = _as_numpy(tensor)
+    gathered = g.run_op(lambda: _allgather_impl(g, arr)).result(
+        g._timeout() + 60)
     if tensor_list is not None:
         tensor_list.clear()
         tensor_list.extend(gathered)
     return gathered
 
 
+def _allgather_impl(g: GroupMember, arr: np.ndarray):
+    if _plane_for(g, arr.nbytes) != "fast":
+        return g.collect("gather", arr)
+    tr, eps = g.fast_plane()
+    if _all_onesided(eps):
+        w, r = g.world_size, g.rank
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        rep = g.collect(
+            f"rdv:allgather:{arr.dtype.str}:{arr.nbytes}:{arr.shape}",
+            {"pid": _os_getpid(), "addr": int(flat.ctypes.data)})
+        descs = rep["gathered"]
+        outs = [None] * w
+        outs[r] = np.array(arr, copy=True)
+        for p in range(w):
+            if p == r:
+                continue
+            dst = np.empty(flat.size, flat.dtype)
+            if flat.nbytes:
+                _pvm_read(g, descs[p], dst, 0, flat.nbytes, p)
+            outs[p] = dst.reshape(arr.shape)
+        g.collect("barrier", None)  # release: all inputs fully read
+        return outs
+    rep = g.collect(
+        f"rdv:allgather:{arr.dtype.str}:{arr.nbytes}:{arr.shape}", None)
+    seq = rep["seq"]
+    deadline = time.monotonic() + g._timeout()
+    grp, w, r = g.group_name, g.world_size, g.rank
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    outs: list = [None] * w
+    outs[r] = np.array(arr, copy=True)
+    handles = {}
+    sends: list = []
+    try:
+        for p, ep in eps.items():
+            dst = np.empty(flat.size, flat.dtype)
+            outs[p] = dst
+            handles[p] = tr.recv(ep, (grp, seq, 0, p, r), flat.nbytes,
+                                 deadline, sink=dst)
+        if flat.size:
+            sends = tr.multicast(
+                [(ep, (grp, seq, 0, r, p)) for p, ep in eps.items()],
+                flat, deadline, slot="ga")
+        for p in list(eps):
+            h = handles.pop(p)
+            a = h.wait_array(flat.dtype)
+            if not h.delivered_in_place:
+                np.copyto(outs[p], a)
+            h.release()
+            outs[p] = outs[p].reshape(arr.shape)
+        _wait_sends(g, sends, deadline)
+    finally:
+        for h in handles.values():
+            try:
+                h.release()
+            except Exception:
+                pass
+    return outs
+
+
 def reducescatter(tensor, tensor_list: list, group_name: str = "default",
                   op: ReduceOp = ReduceOp.SUM):
     """Reduce the per-rank lists elementwise; each rank keeps its slice
-    (reference: :472)."""
+    (reference: :472).  Large entries move peer-to-peer: rank r sends
+    tensor_list[p] straight to rank p and folds the w-1 contributions
+    it receives in rank order (bit-identical to the coordinator's
+    stacked fold)."""
     g = get_group_handle(group_name)
-    reduced = g.collect(f"reduce:{op.value}",
-                        np.stack([_as_numpy(t) for t in tensor_list]))
-    out = reduced[g.rank]
+    arrs = [_as_numpy(t) for t in tensor_list]
+    out = g.run_op(lambda: _reducescatter_impl(g, arrs, op)).result(
+        g._timeout() + 60)
+    return _writeback(tensor, out)
+
+
+def _reducescatter_impl(g: GroupMember, arrs: list, op: ReduceOp):
+    if len(arrs) != g.world_size:
+        raise ValueError(
+            f"reducescatter needs one tensor per rank "
+            f"({len(arrs)} != world size {g.world_size})")
+    per = arrs[0].nbytes if arrs else 0
+    if _plane_for(g, per) != "fast":
+        reduced = g.collect(f"reduce:{op.value}", np.stack(arrs))
+        return reduced[g.rank]
+    tr, eps = g.fast_plane()
+    a0 = arrs[0]
+    if _all_onesided(eps):
+        w, r = g.world_size, g.rank
+        flats = [np.ascontiguousarray(a).reshape(-1) for a in arrs]
+        mine = flats[r]
+        rep = g.collect(
+            f"rdv:reducescatter:{op.value}:{a0.dtype.str}:{a0.nbytes}:"
+            f"{a0.shape}",
+            {"pid": _os_getpid(),
+             "addrs": [int(f.ctypes.data) for f in flats]})
+        descs = rep["gathered"]
+        acc = g.buf("acc", mine.size, mine.dtype)
+        stag = g.buf("stag", mine.size, mine.dtype)
+        if mine.size:
+            first = True
+            for p in range(w):  # rank order == coordinator fold order
+                if p == r:
+                    contrib = mine
+                else:
+                    d = descs[p]
+                    _pvm_read(g, {"pid": d["pid"], "addr": d["addrs"][r]},
+                              stag, 0, mine.nbytes, p)
+                    contrib = stag
+                if first:
+                    np.copyto(acc, contrib)
+                    first = False
+                else:
+                    _reduce_into(acc, contrib, op)
+        g.collect("barrier", None)  # release: all inputs fully read
+        return np.array(acc, copy=True).reshape(arrs[0].shape)
+    rep = g.collect(
+        f"rdv:reducescatter:{op.value}:{a0.dtype.str}:{a0.nbytes}:"
+        f"{a0.shape}", None)
+    seq = rep["seq"]
+    deadline = time.monotonic() + g._timeout()
+    grp, w, r = g.group_name, g.world_size, g.rank
+    flats = [np.ascontiguousarray(a).reshape(-1) for a in arrs]
+    mine = flats[r]
+    handles = {}
+    sends = []
     try:
-        tensor[...] = out
-        return tensor
-    except TypeError:
-        return out
+        for p, ep in eps.items():
+            stag = g.buf(f"stag{p}", mine.size, mine.dtype)
+            handles[p] = tr.recv(ep, (grp, seq, 0, p, r), mine.nbytes,
+                                 deadline, sink=stag)
+            if flats[p].size:
+                sends.append(tr.send(ep, (grp, seq, 0, r, p), flats[p],
+                                     deadline, slot=f"rc{p}"))
+        acc = None
+        for p in range(w):  # rank order == coordinator fold order
+            contrib = mine if p == r \
+                else handles[p].wait_array(mine.dtype)
+            if acc is None:
+                acc = np.array(contrib, copy=True)
+            else:
+                _reduce_into(acc, contrib, op)
+            if p != r:
+                handles.pop(p).release()
+        _wait_sends(g, sends, deadline)
+    finally:
+        for h in handles.values():
+            try:
+                h.release()
+            except Exception:
+                pass
+    # Copy out of the cached accumulator: the caller's view must
+    # survive later ops recycling the work buffers.
+    return np.array(acc, copy=True).reshape(arrs[0].shape)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    """Broadcast from src_rank (reference: :373)."""
+    """Broadcast from src_rank (reference: :373).  Large tensors ride a
+    binomial TREE on the data plane (log2(world) hops of the full
+    tensor, each peer-to-peer); small ones take the coordinator."""
     g = get_group_handle(group_name)
-    payload = _as_numpy(tensor) if g.rank == src_rank else None
-    out = g.collect(f"src:{src_rank}", payload)
-    try:
-        tensor[...] = out
-        return tensor
-    except TypeError:
-        return out
+    arr = _as_numpy(tensor)
+    out = g.run_op(lambda: _broadcast_impl(g, arr, src_rank)).result(
+        g._timeout() + 60)
+    return _writeback(tensor, out)
+
+
+def _broadcast_impl(g: GroupMember, arr: np.ndarray, src: int):
+    if _plane_for(g, arr.nbytes) != "fast":
+        payload = arr if g.rank == src else None
+        return g.collect(f"src:{src}", payload)
+    tr, eps = g.fast_plane()
+    if _all_onesided(eps):
+        r = g.rank
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        desc = {"pid": _os_getpid(), "addr": int(flat.ctypes.data)} \
+            if r == src else None
+        rep = g.collect(
+            f"rdv:broadcast:{src}:{arr.dtype.str}:{arr.nbytes}", desc)
+        if r != src and flat.nbytes:
+            buf = flat if flat.flags.writeable \
+                else np.empty_like(flat)
+            _pvm_read(g, rep["gathered"][src], buf, 0, flat.nbytes, src)
+            flat = buf
+        g.collect("barrier", None)  # release: source fully read by all
+        return flat.reshape(arr.shape)
+    rep = g.collect(
+        f"rdv:broadcast:{src}:{arr.dtype.str}:{arr.nbytes}", None)
+    seq = rep["seq"]
+    deadline = time.monotonic() + g._timeout()
+    grp, w, r = g.group_name, g.world_size, g.rank
+    v = (r - src) % w  # virtual rank in the tree, root = 0
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    if r != src:
+        # Receive straight into the caller's tensor when writable
+        # (broadcast overwrites it anyway) — no fresh pages.
+        buf = flat if flat.flags.writeable \
+            else np.empty_like(flat)
+        k = v.bit_length() - 1
+        sender = ((v - (1 << k)) + src) % w
+        h = tr.recv(eps[sender], (grp, seq, 0, sender, r), buf.nbytes,
+                    deadline, sink=buf)
+        a = h.wait_array(flat.dtype)
+        if not h.delivered_in_place:
+            np.copyto(buf, a)
+        h.release()
+    else:
+        buf = flat
+    targets = []
+    k = v.bit_length()
+    while True:
+        step = 1 << k
+        if step >= w:
+            break
+        dstv = v + step
+        if dstv < w:
+            dst = (dstv + src) % w
+            targets.append((eps[dst], (grp, seq, 0, r, dst)))
+        k += 1
+    sends = tr.multicast(targets, buf, deadline, slot="bc") \
+        if targets else []
+    _wait_sends(g, sends, deadline)
+    return buf.reshape(arr.shape)
 
 
 def barrier(group_name: str = "default"):
     """Block until every member arrives (reference: :298)."""
     g = get_group_handle(group_name)
-    g.collect("barrier", None)
+    g.run_op(lambda: g.collect("barrier", None)).result(g._timeout() + 60)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    """Point-to-point send (reference: :531)."""
+    """Point-to-point send (reference: :531).  Bounded by
+    cfg.collective_timeout_s like every other collective wait."""
     g = get_group_handle(group_name)
     tag = f"{group_name}:{g.rank}->{dst_rank}"
-    ray_tpu.get(g.coord.put_mail.remote(tag, _as_numpy(tensor)), timeout=300)
+    g.put_mail(tag, _as_numpy(tensor))
 
 
 def recv(tensor, src_rank: int, group_name: str = "default"):
     """Point-to-point recv (reference: :594)."""
     g = get_group_handle(group_name)
     tag = f"{group_name}:{src_rank}->{g.rank}"
-    out = ray_tpu.get(g.coord.get_mail.remote(tag), timeout=300)
-    try:
-        tensor[...] = out
-        return tensor
-    except TypeError:
-        return out
+    out = g.get_mail(tag)
+    return _writeback(tensor, out)
 
 
 class CollectiveMixin:

@@ -1,0 +1,785 @@
+"""Peer-to-peer bulk-data plane for host collectives.
+
+The coordinator actor (collective.py) is rendezvous + small-tensor
+reductions ONLY; every bulk tensor chunk moves member-to-member through
+this transport, which lives inside each member's CoreWorker process and
+speaks the runtime's own data-plane idioms (reference architecture: the
+NCCL collective group's dedicated comm plane in
+collective_group/nccl_collective_group.py:127 — rendezvous through a
+named store actor, data through its own channel):
+
+* **Same-host path** — every member owns a sparse scratch arena in
+  /dev/shm (token-stamped so a path collision on another host can never
+  be mistaken for shared memory).  A chunk send is ONE memcpy into the
+  sender's arena plus a tiny ``coll_ctl`` descriptor RPC; the receiver
+  maps the peer arena read-only and reduces/copies STRAIGHT OUT of it
+  (``np.frombuffer`` over the mapping — no socket, no staging buffer).
+  The ctl reply doubles as the slot ack: it is sent only after the
+  receiver consumed the bytes, so the sender's scratch region can be
+  recycled the moment the request resolves.
+* **Wire path** — chunks ride raw ``KIND_BLOB`` frames worker-to-worker
+  (``coll_chunk``), payload handed to the transport as one memoryview
+  and landed by the receiver's blob provider DIRECTLY in the
+  destination tensor when the receive was posted first (the same
+  zero-staging-copy receive as the object transfer plane).  Chunks
+  larger than ``cfg.collective_chunk_bytes`` are split and pumped
+  through the transfer plane's shared sliding window
+  (``transfer.run_windowed``, ``cfg.transfer_window_chunks`` in
+  flight).
+* **Failure plane** — the coordinator pushes ``coll_ctl abort`` frames
+  at member endpoints when the group dies (member death, destroy while
+  ops are in flight); the transport fails every pending receive with a
+  structured :class:`CollectiveGroupError` instead of letting peers
+  hang to the collective timeout.  A dead peer's closed connection
+  fails in-flight sends the same way.
+
+Threading: collective ops run on a per-group op thread (collective.py);
+the transport bridges to the CoreWorker IO loop with
+``run_coroutine_threadsafe``.  Chunk payload memcpys and reductions
+happen on the op thread — the IO loop only moves descriptors and socket
+bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import mmap
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from ray_tpu._private import failpoints, protocol, transfer
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.util.collective.types import CollectiveGroupError
+
+logger = logging.getLogger(__name__)
+
+_TOKEN_LEN = 16
+_HEADER = 64  # scratch arena bytes reserved for the token stamp
+_ALIGN = 64
+
+# Bounded memory of aborted groups (late frames for them are refused,
+# not silently restashed); oldest marks age out.
+_MAX_ABORT_MARKS = 64
+
+
+def _remain(deadline):
+    if deadline is None:
+        return None
+    return max(0.001, deadline - time.monotonic())
+
+
+def _scratch_dir() -> str:
+    shm = "/dev/shm"
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        return shm
+    import tempfile
+    return tempfile.gettempdir()
+
+
+# ---------------------------------------------------------- one-sided reads
+# process_vm_readv: copy bytes STRAIGHT out of a same-host peer's address
+# space (same uid) — the chunk is never staged anywhere, the sender does
+# zero work per byte, and none of the shared-mapping page-fault/TLB
+# pathologies of a shared arena apply (hardened kernels charge ~100x an
+# anon fault for first touches of shared file pages).  Gated by a probe
+# at rendezvous (Yama ptrace_scope et al. can forbid it), with the
+# scratch-arena path as the fallback.
+_libc = None
+
+
+def _get_libc():
+    global _libc
+    if _libc is None:
+        import ctypes
+        lib = ctypes.CDLL(None, use_errno=True)
+        lib.process_vm_readv.restype = ctypes.c_ssize_t
+        _libc = lib
+    return _libc
+
+
+def pvm_read_into(pid: int, remote_addr: int, dest_addr: int, n: int):
+    """Read n bytes from (pid, remote_addr) into local dest_addr.
+    Raises OSError when the kernel forbids or the peer is gone."""
+    import ctypes
+
+    class _IOVec(ctypes.Structure):
+        _fields_ = [("iov_base", ctypes.c_void_p),
+                    ("iov_len", ctypes.c_size_t)]
+
+    lib = _get_libc()
+    pos = 0
+    while pos < n:
+        liov = _IOVec(dest_addr + pos, n - pos)
+        riov = _IOVec(remote_addr + pos, n - pos)
+        got = lib.process_vm_readv(pid, ctypes.byref(liov), 1,
+                                   ctypes.byref(riov), 1, 0)
+        if got <= 0:
+            err = ctypes.get_errno()
+            raise OSError(err, f"process_vm_readv(pid={pid}): "
+                               f"{os.strerror(err)}")
+        pos += got
+
+
+class Endpoint:
+    """One member's data-plane address, as exchanged at rendezvous."""
+
+    __slots__ = ("rank", "addr", "node_id", "scratch_path",
+                 "scratch_token", "pid", "actor_id", "same_host", "pvm",
+                 "pvm_addr")
+
+    def __init__(self, info: dict):
+        self.rank = info["rank"]
+        self.addr = tuple(info["addr"])
+        self.node_id = info.get("node_id")
+        self.scratch_path = info.get("scratch_path")
+        self.scratch_token = info.get("scratch_token")
+        self.pid = info.get("pid")
+        self.actor_id = info.get("actor_id")
+        self.pvm_addr = info.get("pvm_addr")
+        self.same_host = False  # filled in by prepare_group
+        self.pvm = False        # one-sided reads allowed (prepare_group)
+
+
+class ScratchArena:
+    """Sender-side shared scratch: one sparse token-stamped mmap file
+    per member process.  A first-fit free list hands out chunk slots;
+    ``alloc`` blocks (bounded) when concurrent ops have the arena full,
+    because slots recycle as soon as receivers ack."""
+
+    def __init__(self, path: str, capacity: int):
+        self.path = path
+        self.capacity = max(capacity, _HEADER + _ALIGN)
+        self.token = os.urandom(_TOKEN_LEN)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, self.capacity)
+            self._mm = mmap.mmap(fd, self.capacity)
+        finally:
+            os.close(fd)
+        self._mm[0:_TOKEN_LEN] = self.token
+        self._free = [(_HEADER, self.capacity - _HEADER)]
+        self._cond = threading.Condition()
+
+    @property
+    def token_hex(self) -> str:
+        return self.token.hex()
+
+    def alloc(self, n: int, deadline) -> int:
+        n = max(_ALIGN, (n + _ALIGN - 1) // _ALIGN * _ALIGN)
+        with self._cond:
+            while True:
+                for i, (off, sz) in enumerate(self._free):
+                    if sz >= n:
+                        if sz == n:
+                            self._free.pop(i)
+                        else:
+                            self._free[i] = (off + n, sz - n)
+                        return off
+                remain = _remain(deadline)
+                if remain is not None and remain <= 0.002:
+                    raise CollectiveGroupError(
+                        "?", "collective scratch arena exhausted "
+                        f"({self.capacity} bytes; raise "
+                        "RT_COLLECTIVE_SCRATCH_BYTES or shrink buckets)")
+                if not self._cond.wait(
+                        min(remain, 1.0) if remain is not None else 1.0):
+                    continue
+
+    def free(self, off: int, n: int):
+        n = max(_ALIGN, (n + _ALIGN - 1) // _ALIGN * _ALIGN)
+        with self._cond:
+            self._free.append((off, n))
+            self._free.sort()
+            merged = []
+            for o, s in self._free:
+                if merged and merged[-1][0] + merged[-1][1] == o:
+                    merged[-1] = (merged[-1][0], merged[-1][1] + s)
+                else:
+                    merged.append((o, s))
+            self._free = [tuple(m) for m in merged]
+            self._cond.notify_all()
+
+    def write(self, off: int, mv):
+        self._mm[off:off + len(mv)] = mv
+
+    def close(self):
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class _PeerScratch:
+    """Read-only mapping of a co-located peer's scratch arena."""
+
+    def __init__(self, path: str, token_hex: str):
+        size = os.path.getsize(path)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            self._mm = mmap.mmap(fd, size, access=mmap.ACCESS_READ)
+        finally:
+            os.close(fd)
+        if bytes(self._mm[0:_TOKEN_LEN]) != bytes.fromhex(token_hex):
+            self._mm.close()
+            raise OSError(f"scratch token mismatch at {path}")
+        self.view = memoryview(self._mm)
+
+    def close(self):
+        try:
+            self.view.release()
+            self._mm.close()
+        except Exception:
+            pass
+
+
+class RecvHandle:
+    """One posted chunk receive.  ``wait_array`` blocks the op thread
+    until the chunk landed and returns it as a numpy view — into the
+    caller's own sink (wire fast path), into the PEER's scratch arena
+    (same-host; read-only), or over a staged bytes (late-registration
+    race).  ``release`` MUST be called after the bytes are consumed: it
+    is what lets a same-host sender recycle its scratch slot."""
+
+    def __init__(self, tr: "CollectiveTransport", key, nbytes: int,
+                 deadline, cfut, sink_arr):
+        self._tr = tr
+        self._key = key
+        self._nbytes = nbytes
+        self._deadline = deadline
+        self._cfut = cfut
+        self._sink_arr = sink_arr
+        self._payload = None
+        self.delivered_in_place = False
+
+    def wait_array(self, dtype) -> np.ndarray:
+        grace = _remain(self._deadline)
+        try:
+            payload = self._cfut.result(
+                None if grace is None else grace + 10.0)
+        except CollectiveGroupError:
+            raise
+        except Exception as e:
+            raise CollectiveGroupError(
+                self._key[0], f"chunk receive failed for {self._key}: "
+                f"{type(e).__name__}: {e}") from e
+        self._payload = payload
+        mode = payload[0]
+        if mode == "sink":
+            self.delivered_in_place = True
+            return self._sink_arr
+        if mode == "bytes":
+            buf = payload[1]
+            if len(buf) != self._nbytes:
+                raise CollectiveGroupError(
+                    self._key[0], f"short chunk for {self._key}: "
+                    f"{len(buf)} of {self._nbytes} bytes")
+            return np.frombuffer(buf, dtype=dtype)
+        if mode == "pvm":
+            # One-sided read: copy the chunk STRAIGHT out of the
+            # sender's address space into the caller's sink (or a fresh
+            # buffer), on the op thread.
+            _, pid, addr, n, _x, _evt = payload
+            if n != self._nbytes:
+                raise CollectiveGroupError(
+                    self._key[0], f"short pvm chunk for {self._key}: "
+                    f"{n} of {self._nbytes} bytes")
+            dst = self._sink_arr
+            if dst is None:
+                dst = np.empty(n // np.dtype(dtype).itemsize, dtype)
+            else:
+                self.delivered_in_place = True
+            try:
+                pvm_read_into(pid, addr, dst.ctypes.data, n)
+            except OSError as e:
+                raise CollectiveGroupError(
+                    self._key[0], f"one-sided read from pid {pid} "
+                    f"failed (peer died?): {e}") from e
+            return dst if dst.dtype == np.dtype(dtype) \
+                else dst.view(dtype)
+        # ("shm", path, tok, off, n, evt)
+        _, path, tok, off, n, _evt = payload
+        if n != self._nbytes:
+            raise CollectiveGroupError(
+                self._key[0], f"short shm chunk for {self._key}: "
+                f"{n} of {self._nbytes} bytes")
+        view = self._tr.peer_view(path, tok, off, n)
+        return np.frombuffer(view, dtype=dtype)
+
+    def release(self):
+        payload, self._payload = self._payload, None
+        if payload is not None and payload[0] in ("shm", "pvm"):
+            # The ctl handler is awaiting this event; setting it sends
+            # the reply that acks the sender's buffer/slot.
+            self._tr.signal_done(payload[5])
+        self._sink_arr = None
+
+
+def _new_entry(group):
+    return {"group": group, "fut": None, "sink": None, "via": None,
+            "buf": None, "got": 0, "payload": None}
+
+
+class CollectiveTransport:
+    """Per-process data plane shared by every collective group member
+    living in this CoreWorker."""
+
+    def __init__(self, w):
+        self.w = w
+        self.scratch: ScratchArena | None = None
+        self._peer_maps: dict[str, _PeerScratch] = {}
+        self._entries: dict = {}         # key -> recv entry (loop-confined)
+        self._aborted: "OrderedDict[str, str]" = OrderedDict()
+        self._scratch_lock = threading.Lock()
+        # Sticky scratch slots, keyed (group, stream tag): each logical
+        # send stream (e.g. "this group's reduce-scatter chunk to rank
+        # p") keeps ONE stable arena offset across ops.  Page-fault
+        # economics demand this: a first touch of a shared mapping
+        # costs ~100x an anon fault under hardened/paravirt kernels, so
+        # per-op alloc/free (drifting offsets) would re-fault every op
+        # while sticky slots fault once and stay warm.  Safe because
+        # ops within a group are serialized and every send is acked
+        # before its op completes.
+        self._sticky: dict = {}
+        # Probe buffer for one-sided reads: peers validate that they
+        # can process_vm_readv THIS process (and that pid+address refer
+        # to who they think) by reading these 16 bytes and comparing
+        # with the token from the endpoint table.
+        self._pvm_token = os.urandom(_TOKEN_LEN)
+        self._pvm_probe = np.frombuffer(bytearray(self._pvm_token),
+                                        dtype=np.uint8)
+        w.ext_rpc["coll_ctl"] = self._rpc_ctl
+        w.ext_rpc["coll_chunk"] = self._rpc_chunk
+        w.blob_providers["coll_chunk"] = self._blob_sink
+
+    # ------------------------------------------------------------ endpoints
+    def endpoint_info(self, rank: int) -> dict:
+        self._ensure_scratch()
+        w = self.w
+        nid = getattr(w.node_id, "hex", None)
+        aid = getattr(w.actor_id, "hex", None)
+        return {
+            "rank": rank,
+            "addr": list(w.addr),
+            "node_id": nid() if callable(nid) else None,
+            "scratch_path": self.scratch.path,
+            "scratch_token": self.scratch.token_hex,
+            "pid": os.getpid(),
+            "actor_id": aid() if callable(aid) else None,
+            "pvm_addr": int(self._pvm_probe.ctypes.data),
+            "pvm_token": self._pvm_token.hex(),
+        }
+
+    def _ensure_scratch(self):
+        with self._scratch_lock:
+            if self.scratch is None:
+                path = os.path.join(
+                    _scratch_dir(),
+                    f"rt_coll_{self.w.worker_id.hex()[:12]}_{os.getpid()}")
+                self.scratch = ScratchArena(
+                    path, max(1 << 20, cfg.collective_scratch_bytes))
+        return self.scratch
+
+    def prepare_group(self, group: str, endpoints: dict[int, Endpoint],
+                      infos: dict | None = None):
+        """Probe each peer's same-host reachability: first one-sided
+        reads (process_vm_readv of the peer's 16-byte probe token — a
+        pid recycled on another host can never match), then the scratch
+        arena file (token-stamped), else the wire."""
+        self.forget_group(group)
+        force_wire = cfg.collective_data_plane == "wire"
+        for ep in endpoints.values():
+            if force_wire:
+                continue
+            if cfg.collective_pvm_reads:
+                ep.pvm = self._probe_pvm(ep, (infos or {}).get(ep.rank))
+            ep.same_host = ep.pvm or self._probe_scratch(ep)
+
+    def _probe_pvm(self, ep: Endpoint, info: dict | None) -> bool:
+        tok = (info or {}).get("pvm_token")
+        if not tok or not ep.pvm_addr or not ep.pid:
+            return False
+        try:
+            got = np.empty(_TOKEN_LEN, np.uint8)
+            pvm_read_into(ep.pid, ep.pvm_addr, got.ctypes.data,
+                          _TOKEN_LEN)
+            return got.tobytes() == bytes.fromhex(tok)
+        except OSError:
+            return False
+
+    def _probe_scratch(self, ep: Endpoint) -> bool:
+        if not ep.scratch_path or not ep.scratch_token:
+            return False
+        try:
+            with open(ep.scratch_path, "rb") as f:
+                return f.read(_TOKEN_LEN) == bytes.fromhex(ep.scratch_token)
+        except OSError:
+            return False
+
+    def peer_view(self, path: str, token_hex: str, off: int,
+                  n: int) -> memoryview:
+        ps = self._peer_maps.get(path)
+        if ps is None:
+            ps = self._peer_maps[path] = _PeerScratch(path, token_hex)
+        return ps.view[off:off + n]
+
+    # ----------------------------------------------------------- send side
+    def send(self, ep: Endpoint, key, arr, deadline, slot=None):
+        """Queue one chunk for ``ep``; returns a concurrent future that
+        resolves once the receiver consumed it (slot ack)."""
+        return self.multicast([(ep, key)], arr, deadline, slot=slot)[0]
+
+    def _sticky_slot(self, group: str, slot: str, n: int, deadline) -> int:
+        """Stable arena offset for one (group, stream) send slot; grows
+        (power-of-two classes) when an op outsizes the current slot."""
+        scratch = self._ensure_scratch()
+        key = (group, slot)
+        cur = self._sticky.get(key)
+        if cur is not None and cur[1] >= n:
+            return cur[0]
+        want = max(_ALIGN, 1 << (max(1, n) - 1).bit_length())
+        off = scratch.alloc(want, deadline)
+        if cur is not None:
+            scratch.free(cur[0], cur[1])
+        self._sticky[key] = (off, want)
+        return off
+
+    def multicast(self, targets, arr, deadline, slot: str | None = None):
+        """Send one buffer to many peers.  pvm-capable peers get a tiny
+        descriptor naming (pid, address, len) and read the buffer out
+        of THIS process themselves — zero sender-side bytes moved.
+        Scratch-only peers share ONE arena region (written once; with a
+        ``slot`` stream tag it is sticky across ops so its pages stay
+        warm).  Wire peers each get a windowed raw-frame stream of the
+        same memoryview.  Returns one concurrent future per target; the
+        source buffer must stay alive and unmutated until they resolve
+        (one-sided readers read it in place)."""
+        arr_c = np.ascontiguousarray(arr)
+        mv = memoryview(arr_c).cast("B")
+        loop = self.w.loop
+        futs = []
+        pvm = [(ep, key) for ep, key in targets if ep.pvm]
+        shm = [(ep, key) for ep, key in targets
+               if ep.same_host and not ep.pvm]
+        wire = [(ep, key) for ep, key in targets if not ep.same_host]
+        for ep, key in pvm:
+            hdr = {"op": "pvm", "k": key, "pid": os.getpid(),
+                   "addr": int(arr_c.ctypes.data), "n": len(mv)}
+            futs.append(asyncio.run_coroutine_threadsafe(
+                self._ctl_send(ep, key, hdr, deadline, keep=arr_c),
+                loop))
+        if shm:
+            scratch = self._ensure_scratch()
+            n = len(mv)
+            _slot_done = None
+            if slot is not None:
+                off = self._sticky_slot(shm[0][1][0], slot, n, deadline)
+            else:
+                off = scratch.alloc(n, deadline)
+                remaining = [len(shm)]
+                rlock = threading.Lock()
+
+                def _slot_done(_f):
+                    with rlock:
+                        remaining[0] -= 1
+                        last = remaining[0] == 0
+                    if last:
+                        scratch.free(off, n)
+
+            scratch.write(off, mv)  # op-thread memcpy, loop untouched
+            for ep, key in shm:
+                hdr = {"op": "shm", "k": key, "path": scratch.path,
+                       "tok": scratch.token_hex, "off": off, "n": n}
+                f = asyncio.run_coroutine_threadsafe(
+                    self._ctl_send(ep, key, hdr, deadline), loop)
+                if _slot_done is not None:
+                    f.add_done_callback(_slot_done)
+                futs.append(f)
+        for ep, key in wire:
+            futs.append(asyncio.run_coroutine_threadsafe(
+                self._wire_send(ep, key, mv, deadline), loop))
+        return futs
+
+    async def _fp(self, ep: Endpoint, group):
+        if failpoints.ACTIVE:
+            act = failpoints.check("collective.chunk", peer=f"r{ep.rank}")
+            if act is not None:
+                if act.kind == "error":
+                    raise CollectiveGroupError(
+                        group, "failpoint: injected collective chunk "
+                        f"error to rank {ep.rank}")
+                if act.kind == "delay":
+                    await asyncio.sleep(act.delay_s)
+                elif act.kind == "drop":
+                    return True  # chunk vanishes; receiver times out
+                elif act.kind == "kill":
+                    os._exit(int(act.arg or 1))
+        return False
+
+    async def _conn(self, ep: Endpoint):
+        return await self.w._worker_conn(tuple(ep.addr))
+
+    async def _ctl_send(self, ep: Endpoint, key, hdr, deadline,
+                        keep=None):
+        # ``keep`` pins the source buffer for one-sided readers: the
+        # peer reads our memory until the reply arrives.
+        group = key[0]
+        try:
+            if await self._fp(ep, group):
+                return
+            conn = await self._conn(ep)
+            rep = await conn.request("coll_ctl", hdr,
+                                     timeout=_remain(deadline))
+        except CollectiveGroupError:
+            raise
+        except (protocol.RpcError, ConnectionError, OSError) as e:
+            raise CollectiveGroupError(
+                group, f"lost rank {ep.rank} mid-op: "
+                f"{type(e).__name__}: {e}") from e
+        except asyncio.TimeoutError as e:
+            raise CollectiveGroupError(
+                group, f"timed out waiting for rank {ep.rank} to consume "
+                f"chunk {key}") from e
+        self._check_rep(group, ep, rep)
+
+    async def _wire_send(self, ep: Endpoint, key, mv, deadline):
+        group = key[0]
+        n = len(mv)
+        csz = max(1, cfg.collective_chunk_bytes)
+        try:
+            if await self._fp(ep, group):
+                return
+            conn = await self._conn(ep)
+            if n <= csz:
+                rep = await conn.blob_request(
+                    "coll_chunk", {"k": key, "o": 0, "n": n, "t": n}, mv,
+                    timeout=_remain(deadline))
+                self._check_rep(group, ep, rep)
+                return
+
+            async def _sub(o, ln):
+                rep = await conn.blob_request(
+                    "coll_chunk", {"k": key, "o": o, "n": ln, "t": n},
+                    mv[o:o + ln], timeout=_remain(deadline))
+                self._check_rep(group, ep, rep)
+
+            await transfer.run_windowed(
+                (lambda o=o, ln=min(csz, n - o): _sub(o, ln)
+                 for o in range(0, n, csz)),
+                cfg.transfer_window_chunks)
+        except CollectiveGroupError:
+            raise
+        except (protocol.RpcError, ConnectionError, OSError) as e:
+            raise CollectiveGroupError(
+                group, f"lost rank {ep.rank} mid-op: "
+                f"{type(e).__name__}: {e}") from e
+        except asyncio.TimeoutError as e:
+            raise CollectiveGroupError(
+                group, f"timed out sending chunk {key} to "
+                f"rank {ep.rank}") from e
+
+    def _check_rep(self, group, ep, rep):
+        if isinstance(rep, dict) and rep.get("error"):
+            raise CollectiveGroupError(
+                group, f"rank {ep.rank} refused chunk: {rep['error']}")
+
+    # ----------------------------------------------------------- recv side
+    def recv(self, ep: Endpoint, key, nbytes: int, deadline,
+             sink: np.ndarray | None = None) -> RecvHandle:
+        """Post a chunk receive.  ``sink`` (a writable C-contiguous
+        array) lets wire-path bytes land directly in the destination
+        tensor when the receive wins the registration race."""
+        sink_mv = None
+        if sink is not None and not ep.same_host:
+            sink_mv = memoryview(sink).cast("B")
+        cfut = asyncio.run_coroutine_threadsafe(
+            self._recv_async(key, nbytes, sink_mv, deadline), self.w.loop)
+        return RecvHandle(self, key, nbytes, deadline, cfut, sink)
+
+    async def _recv_async(self, key, nbytes, sink_mv, deadline):
+        group = key[0]
+        reason = self._aborted.get(group)
+        if reason is not None:
+            raise CollectiveGroupError(group, reason)
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = self._entries[key] = _new_entry(group)
+        if entry["payload"] is not None:
+            self._entries.pop(key, None)
+            return entry["payload"]
+        entry["sink"] = sink_mv
+        fut = entry["fut"] = asyncio.get_running_loop().create_future()
+        try:
+            remain = _remain(deadline)
+            if remain is None:
+                return await fut
+            return await asyncio.wait_for(fut, remain)
+        except asyncio.TimeoutError as e:
+            raise CollectiveGroupError(
+                group, f"timed out waiting for chunk {key} "
+                f"({entry['got']} of {nbytes} bytes arrived)") from e
+        finally:
+            cur = self._entries.get(key)
+            if cur is entry:
+                self._entries.pop(key, None)
+
+    def _deliver(self, key, payload):
+        """Complete (or stash) one fully-arrived chunk."""
+        entry = self._entries.get(key)
+        if entry is not None and entry["fut"] is not None \
+                and not entry["fut"].done():
+            self._entries.pop(key, None)
+            entry["fut"].set_result(payload)
+        else:
+            if entry is None:
+                entry = self._entries[key] = _new_entry(key[0])
+            entry["payload"] = payload
+
+    # -------------------------------------------------------- rpc handlers
+    def _blob_sink(self, conn, header, nraw):
+        """Blob provider for coll_chunk: land the raw body straight in
+        the posted receive's sink.  First arrival fixes the delivery
+        mode — a chunk that beat its recv registration stays on the
+        staged-bytes path for all its sub-chunks."""
+        try:
+            key = tuple(header["k"])
+            o, t = header["o"], header["t"]
+        except Exception:
+            return None
+        if key[0] in self._aborted:
+            return None
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = self._entries[key] = _new_entry(key[0])
+        if entry["via"] is None:
+            entry["via"] = ("sink" if entry["sink"] is not None
+                            and t <= len(entry["sink"]) else "buf")
+        if entry["via"] == "sink" and o + nraw <= len(entry["sink"]):
+            return entry["sink"][o:o + nraw]
+        return None
+
+    async def _rpc_chunk(self, conn, frame):
+        hdr = frame.header
+        key = tuple(hdr["k"])
+        group = key[0]
+        o, n, t = hdr["o"], hdr["n"], hdr["t"]
+        reason = self._aborted.get(group)
+        if reason is not None:
+            return {"error": f"group aborted: {reason}"}
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = self._entries[key] = _new_entry(group)
+        if frame.data is not None:
+            if entry["via"] is None:
+                entry["via"] = "buf"
+            if entry["buf"] is None:
+                entry["buf"] = bytearray(t)
+            entry["buf"][o:o + n] = frame.data
+        entry["got"] += n
+        if entry["got"] >= t:
+            if entry["via"] == "sink":
+                self._deliver(key, ("sink",))
+            else:
+                self._deliver(key, ("bytes",
+                                    entry["buf"] if entry["buf"] is not None
+                                    else b""))
+        return {"ok": 1}
+
+    async def _rpc_ctl(self, conn, body):
+        op = body.get("op")
+        if op == "abort":
+            self._abort_group(body.get("group", "?"),
+                              body.get("reason", "group aborted"))
+            return {"ok": 1}
+        if op == "ping":
+            return {"ok": 1}
+        if op not in ("shm", "pvm"):
+            return {"error": f"unknown coll_ctl op {op!r}"}
+        key = tuple(body["k"])
+        group = key[0]
+        reason = self._aborted.get(group)
+        if reason is not None:
+            return {"error": f"group aborted: {reason}"}
+        evt = asyncio.Event()
+        if op == "pvm":
+            self._deliver(key, ("pvm", body["pid"], body["addr"],
+                                body["n"], None, evt))
+        else:
+            self._deliver(key, ("shm", body["path"], body["tok"],
+                                body["off"], body["n"], evt))
+        try:
+            await asyncio.wait_for(evt.wait(),
+                                   max(1.0, cfg.collective_timeout_s))
+        except asyncio.TimeoutError:
+            return {"error": f"receiver never consumed shm chunk {key}"}
+        reason = self._aborted.get(group)
+        if reason is not None:
+            return {"error": f"group aborted: {reason}"}
+        return {"ok": 1}
+
+    def signal_done(self, evt: asyncio.Event):
+        self.w.loop.call_soon_threadsafe(evt.set)
+
+    # ----------------------------------------------------------- lifecycle
+    def abort_group(self, group: str, reason: str):
+        """Thread-safe entry point (coordinator death watch, destroy)."""
+        self.w.loop.call_soon_threadsafe(self._abort_group, group, reason)
+
+    def _abort_group(self, group: str, reason: str):
+        if group in self._aborted:
+            return
+        self._aborted[group] = reason
+        while len(self._aborted) > _MAX_ABORT_MARKS:
+            self._aborted.popitem(last=False)
+        err = CollectiveGroupError(group, reason)
+        for key in [k for k in self._entries if k[0] == group]:
+            entry = self._entries.pop(key)
+            fut = entry.get("fut")
+            if fut is not None and not fut.done():
+                fut.set_exception(err)
+            payload = entry.get("payload")
+            if payload is not None and payload[0] in ("shm", "pvm"):
+                payload[5].set()  # unblock the parked ctl handler
+
+    def forget_group(self, group: str):
+        """Clear abort marks/state and release the group's sticky
+        scratch slots so a destroyed group's name can be reused."""
+        for key in [k for k in self._sticky if k[0] == group]:
+            off, sz = self._sticky.pop(key)
+            if self.scratch is not None:
+                self.scratch.free(off, sz)
+
+        def _clear():
+            self._aborted.pop(group, None)
+            for key in [k for k in self._entries if k[0] == group]:
+                self._entries.pop(key, None)
+        if self.w.loop is not None:
+            self.w.loop.call_soon_threadsafe(_clear)
+
+    def close(self):
+        for ps in self._peer_maps.values():
+            ps.close()
+        self._peer_maps.clear()
+        if self.scratch is not None:
+            self.scratch.close()
+            self.scratch = None
+
+
+def get_transport() -> CollectiveTransport:
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.global_worker
+    if w is None or not w.connected or w.loop is None:
+        raise RuntimeError(
+            "collective transport requires a connected ray_tpu worker "
+            "(call ray_tpu.init first)")
+    if w._collective_transport is None:
+        w._collective_transport = CollectiveTransport(w)
+    return w._collective_transport

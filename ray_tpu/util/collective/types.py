@@ -20,3 +20,19 @@ class Backend:
     TCP = "tcp"
     XLA = "xla"
     NIL = "nil"
+
+
+class CollectiveGroupError(RuntimeError):
+    """A collective group op cannot complete: a member died, the group
+    was destroyed mid-op, the members desynchronized (op mismatch at a
+    round), or the data plane lost a peer.  Structured so gang
+    schedulers can tell a broken GANG (restartable) from a user error:
+    ``group`` names the group, ``reason`` says what broke it."""
+
+    def __init__(self, group: str = "?", reason: str = ""):
+        self.group = group
+        self.reason = reason
+        super().__init__(f"collective group '{group}': {reason}")
+
+    def __reduce__(self):
+        return (CollectiveGroupError, (self.group, self.reason))

@@ -1,11 +1,16 @@
 """Collective group tests across actors (reference model:
-python/ray/util/collective/tests)."""
+python/ray/util/collective/tests) — coordinator rounds, the
+peer-to-peer transfer-plane data path, bucket fusion, and the group
+failure semantics (op mismatch, destroy mid-op, member death)."""
+
+import time
 
 import pytest
 import numpy as np
 
 import ray_tpu
 from ray_tpu.util import collective as col
+from ray_tpu.util.collective import CollectiveGroupError
 
 
 def test_allreduce_and_broadcast_across_actors(ray_start_regular):
@@ -58,34 +63,425 @@ def test_allreduce_and_broadcast_across_actors(ray_start_regular):
 
 @pytest.mark.slow
 def test_ring_allreduce_large_tensor(ray_start_regular):
-    """Large tensors ride the ring (object-store chunks); result matches
+    """Large tensors ride the peer-to-peer fast plane; result matches
     the coordinator path bit-for-bit and the perf ratio is recorded."""
-    import time
-
-    import numpy as np
-
-    import ray_tpu
-    from ray_tpu.util import collective
     from ray_tpu.util.collective import collective as cimpl
 
     @ray_tpu.remote
-    class Member(collective.CollectiveMixin):
+    class Member(col.CollectiveMixin):
         def ring(self, n_bytes):
-            rank = collective.get_group_handle("ring").rank
+            rank = col.get_group_handle("ring").rank
             arr = np.full(n_bytes // 8, float(rank + 1))
             t0 = time.perf_counter()
-            out = collective.allreduce(arr, group_name="ring")
+            out = col.allreduce(arr, group_name="ring")
             return time.perf_counter() - t0, float(out[0]), float(out[-1])
 
     world = 4
     members = [Member.options(num_cpus=0.5).remote() for _ in range(world)]
-    collective.create_collective_group(
+    col.create_collective_group(
         members, world, list(range(world)), group_name="ring")
-    n = 32 * 1024 * 1024  # 32MB >= RING_THRESHOLD_BYTES
+    n = 32 * 1024 * 1024  # 32MB >= the fast-path threshold
     assert n >= cimpl.RING_THRESHOLD_BYTES
     outs = ray_tpu.get([m.ring.remote(n) for m in members], timeout=600)
     expected = float(sum(range(1, world + 1)))
     for dt, first, last in outs:
         assert first == expected and last == expected
     print("ring allreduce times:", [round(o[0], 3) for o in outs])
-    collective.destroy_collective_group("ring")
+    col.destroy_collective_group("ring")
+
+
+class _PlaneMember(col.CollectiveMixin):
+    """Member that can pin the data plane and run ops for parity
+    checks.  Seeded inputs so every plane sees identical data."""
+
+    def set_plane(self, mode, pvm=True):
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.util.collective import collective as cimpl
+        cfg.collective_data_plane = mode
+        cfg.collective_pvm_reads = pvm
+        # Force a fresh rendezvous so the probe honors the new mode.
+        for g in cimpl._groups.values():
+            g._plane = None
+        return True
+
+    def ops(self, group, seed, nbytes):
+        rng = np.random.RandomState(seed)
+        rank = col.get_group_handle(group).rank
+        world = col.get_group_handle(group).world_size
+        n = nbytes // 4
+        # Per-rank deterministic data: rank r uses stream seed+r.
+        arr = np.random.RandomState(seed + rank).randn(n) \
+            .astype(np.float32)
+        red = col.allreduce(arr.copy(), group_name=group)
+        bcast = col.broadcast(
+            arr.copy() if rank == 1 else np.zeros(n, np.float32),
+            src_rank=1, group_name=group)
+        gathered = col.allgather(None, arr.copy(), group_name=group)
+        lists = [np.random.RandomState(seed + 100 + p).randn(n // 2)
+                 .astype(np.float32) for p in range(world)]
+        rs = col.reducescatter(np.zeros(n // 2, np.float32), lists,
+                               group_name=group)
+        del rng
+        return (red.tobytes(), bcast.tobytes(),
+                [a.tobytes() for a in gathered], rs.tobytes())
+
+
+def test_fast_plane_parity_smoke(ray_start_regular):
+    """Tier-1 slice of the parity bar: fast-plane float32 SUM is
+    bit-identical to the coordinator fold (full cross-plane x cross-op
+    sweep in test_fast_plane_bit_identical_to_coordinator)."""
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def ar(self, mode):
+            from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+            from ray_tpu.util.collective import collective as cimpl
+            cfg.collective_data_plane = mode
+            for g in cimpl._groups.values():
+                g._plane = None
+            rank = col.get_group_handle("ps").rank
+            arr = np.random.RandomState(3 + rank) \
+                .randn(1 << 18).astype(np.float32)  # 1MiB
+            return col.allreduce(arr, group_name="ps").tobytes()
+
+    members = [Member.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="ps")
+    base = ray_tpu.get([m.ar.remote("coord") for m in members],
+                       timeout=300)
+    fast = ray_tpu.get([m.ar.remote("auto") for m in members],
+                       timeout=300)
+    assert base == fast
+    col.destroy_collective_group("ps")
+
+
+@pytest.mark.slow
+def test_fast_plane_bit_identical_to_coordinator(ray_start_regular):
+    """The acceptance bar: float32 SUM over the peer-to-peer data plane
+    (one-sided / scratch / wire) is BIT-identical to the coordinator's
+    rank-order fold, for allreduce, broadcast, allgather and
+    reducescatter."""
+    world = 3
+    Member = ray_tpu.remote(_PlaneMember)
+    members = [Member.options(num_cpus=0.5).remote()
+               for _ in range(world)]
+    col.create_collective_group(members, world, list(range(world)),
+                                group_name="par")
+    nbytes = 1 << 20  # 1MiB >= fast-path threshold
+
+    results = {}
+    for mode, pvm in [("coord", True), ("auto", True), ("auto", False),
+                      ("wire", True), ("store", True)]:
+        ray_tpu.get([m.set_plane.remote(mode, pvm) for m in members],
+                    timeout=60)
+        results[(mode, pvm)] = ray_tpu.get(
+            [m.ops.remote("par", 7, nbytes) for m in members],
+            timeout=300)
+    base = results[("coord", True)]
+    for key, got in results.items():
+        if key == ("coord", True):
+            continue
+        for rank in range(world):
+            if key[0] == "store":
+                # The legacy object-store ring folds in rotated ring
+                # order — numerically equivalent, not bit-identical
+                # (that's one of the reasons it is the BASELINE).
+                np.testing.assert_allclose(
+                    np.frombuffer(got[rank][0], np.float32),
+                    np.frombuffer(base[rank][0], np.float32),
+                    rtol=1e-5, atol=1e-6)
+            else:
+                assert got[rank][0] == base[rank][0], \
+                    f"allreduce parity broken on {key} rank {rank}"
+            assert got[rank][1] == base[rank][1], \
+                f"broadcast parity broken on {key} rank {rank}"
+            assert got[rank][2] == base[rank][2], \
+                f"allgather parity broken on {key} rank {rank}"
+            assert got[rank][3] == base[rank][3], \
+                f"reducescatter parity broken on {key} rank {rank}"
+    col.destroy_collective_group("par")
+
+
+def test_op_mismatch_raises_instead_of_deadlock(ray_start_regular):
+    """Regression for the round-id lockstep fragility: a member that
+    slips an EXTRA group op in no longer silently desyncs every later
+    tag (deadlock until the 3600s timeout) — the coordinator-issued
+    round detects the mode mismatch and fails the whole group with a
+    structured error."""
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def desynced_op(self, extra):
+            try:
+                if extra:
+                    # The extra op that used to silently shift every
+                    # later client-side round id.
+                    col.barrier(group_name="mm")
+                col.allreduce(np.ones(4), group_name="mm")
+                return "ok"
+            except CollectiveGroupError as e:
+                return f"error: {e}"
+
+    members = [Member.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="mm")
+    t0 = time.monotonic()
+    outs = ray_tpu.get(
+        [m.desynced_op.remote(i == 0) for i, m in enumerate(members)],
+        timeout=120)
+    assert time.monotonic() - t0 < 60
+    assert any("mismatch" in o for o in outs), outs
+    assert all(o.startswith("error") for o in outs), outs
+    col.destroy_collective_group("mm")
+
+
+@pytest.mark.slow
+def test_destroy_mid_op_fails_blocked_members_fast(ray_start_regular):
+    """destroy_collective_group while an op is in flight must fail the
+    blocked peers with CollectiveGroupError naming the group — not
+    leave them hanging to the full collective timeout."""
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def lonely_barrier(self):
+            t0 = time.monotonic()
+            try:
+                col.barrier(group_name="dd")  # world=2, peer never joins
+                return None
+            except CollectiveGroupError as e:
+                return time.monotonic() - t0, str(e)
+
+    members = [Member.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="dd")
+    ref = members[0].lonely_barrier.remote()
+    time.sleep(1.0)
+    col.destroy_collective_group("dd")
+    elapsed, msg = ray_tpu.get(ref, timeout=90)
+    assert elapsed < 45, f"blocked member took {elapsed}s to fail"
+    assert "dd" in msg and "destroy" in msg, msg
+
+
+@pytest.mark.slow
+def test_member_death_mid_allreduce_fails_survivors_fast(
+        ray_start_regular):
+    """Chaos case (PR 5 failpoints): a member is killed mid-allreduce
+    on the fast plane; survivors get a fast structured error instead of
+    hanging to the 3600s timeout (coordinator death watch + data-plane
+    abort frames)."""
+    @ray_tpu.remote(max_restarts=0)
+    class Member(col.CollectiveMixin):
+        def arm_kill(self):
+            from ray_tpu._private import failpoints
+            # Die on the first data-plane chunk op of the next
+            # collective — mid-op by construction.
+            failpoints.configure("collective.chunk=kill")
+            return True
+
+        def op(self):
+            t0 = time.monotonic()
+            arr = np.ones(1 << 19, np.float32)  # 2MiB -> fast plane
+            try:
+                col.allreduce(arr, group_name="ch")
+                return None
+            except CollectiveGroupError as e:
+                return time.monotonic() - t0, str(e)
+
+    world = 3
+    members = [Member.options(num_cpus=0.5).remote()
+               for _ in range(world)]
+    col.create_collective_group(members, world, list(range(world)),
+                                group_name="ch")
+    ray_tpu.get(members[1].arm_kill.remote(), timeout=30)
+    refs = [m.op.remote() for m in members]
+    survivors = []
+    for i, ref in enumerate(refs):
+        try:
+            survivors.append((i, ray_tpu.get(ref, timeout=120)))
+        except Exception:
+            assert i == 1  # the killed member's call fails outright
+    assert len(survivors) == 2, "expected both survivors to return"
+    for i, out in survivors:
+        assert out is not None, f"rank {i} completed against a dead peer?"
+        elapsed, msg = out
+        assert elapsed < 60, f"rank {i} took {elapsed}s to fail"
+        assert "ch" in msg, msg
+    col.destroy_collective_group("ch")
+
+
+@pytest.mark.slow
+def test_bucket_fusion_and_async_handles(ray_start_regular):
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def fused(self, rank):
+            tensors = [np.full(64, float(rank + 1) * (i + 1),
+                               np.float32) for i in range(8)]
+            tensors.append(np.arange(10, dtype=np.float64) * (rank + 1))
+            out = col.allreduce_coalesced(tensors, group_name="bk",
+                                          bucket_bytes=1024)
+            return [o.tobytes() for o in out], [str(o.dtype) for o in out]
+
+        def async_pair(self, rank):
+            a = np.full(16, float(rank + 1), np.float32)
+            b = np.full(16, float(10 * (rank + 1)), np.float32)
+            wa = col.allreduce_async(a, group_name="bk")
+            wb = col.allreduce_async(b, group_name="bk")
+            ra = wa.wait()
+            rb = wb.wait()
+            # in-place write-back
+            return float(a[0]), float(b[0]), float(ra[0]), float(rb[0])
+
+    members = [Member.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="bk")
+
+    buckets = col.fuse_buckets(
+        [np.zeros(64, np.float32)] * 8 + [np.zeros(10, np.float64)],
+        bucket_bytes=1024)
+    # 8 x 256B f4 tensors -> 2 buckets of 4 (1024B cap), f8 separate.
+    assert [len(b.tensors) for b in buckets] == [4, 4, 1]
+
+    outs = ray_tpu.get([m.fused.remote(i) for i, m in
+                        enumerate(members)], timeout=300)
+    for blobs, dtypes in outs:
+        assert dtypes == ["float32"] * 8 + ["float64"]
+        for i in range(8):
+            np.testing.assert_array_equal(
+                np.frombuffer(blobs[i], np.float32),
+                np.full(64, 3.0 * (i + 1), np.float32))
+        np.testing.assert_array_equal(
+            np.frombuffer(blobs[8], np.float64),
+            np.arange(10, dtype=np.float64) * 3)
+
+    outs = ray_tpu.get([m.async_pair.remote(i) for i, m in
+                        enumerate(members)], timeout=300)
+    for a0, b0, ra0, rb0 in outs:
+        assert a0 == ra0 == 3.0
+        assert b0 == rb0 == 30.0
+    col.destroy_collective_group("bk")
+
+
+@pytest.mark.slow
+def test_create_collective_gang(ray_start_regular):
+    """Gang scheduling: create_collective_gang reserves a placement
+    group, creates the members inside it, and arms the death watch."""
+    from ray_tpu.util.placement_group import remove_placement_group
+
+    class Member(col.CollectiveMixin):
+        def red(self):
+            g = col.get_group_handle("gg")
+            out = col.allreduce(np.full(8, float(g.rank + 1)),
+                                group_name="gg")
+            return float(out[0])
+
+        def failing_op(self):
+            t0 = time.monotonic()
+            try:
+                col.allreduce(np.ones(1 << 19, np.float32),
+                              group_name="gg")
+                return None
+            except CollectiveGroupError:
+                return time.monotonic() - t0
+
+    actors, pg = col.create_collective_gang(
+        ray_tpu.remote(Member), 2, group_name="gg",
+        actor_options={"num_cpus": 1})
+    assert ray_tpu.get([a.red.remote() for a in actors],
+                       timeout=120) == [3.0, 3.0]
+    # Death watch: killing one member fails the other's next op fast.
+    ref = actors[0].failing_op.remote()
+    time.sleep(0.5)
+    ray_tpu.kill(actors[1])
+    elapsed = ray_tpu.get(ref, timeout=120)
+    assert elapsed is not None and elapsed < 60
+    col.destroy_collective_group("gg")
+    remove_placement_group(pg)
+
+
+@pytest.mark.slow
+def test_timeouts_honor_config_knob(ray_start_regular):
+    """send/recv/collect all honor cfg.collective_timeout_s (the
+    RT_COLLECTIVE_TIMEOUT_S knob) instead of hardcoded 300s waits."""
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def recv_nobody(self):
+            from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+            cfg.collective_timeout_s = 2.0
+            t0 = time.monotonic()
+            try:
+                col.recv(np.zeros(1), src_rank=1, group_name="to")
+                return None
+            except CollectiveGroupError:
+                return time.monotonic() - t0
+
+    members = [Member.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="to")
+    elapsed = ray_tpu.get(members[0].recv_nobody.remote(), timeout=60)
+    assert elapsed is not None and elapsed < 30
+    col.destroy_collective_group("to")
+
+
+def test_run_windowed_fail_fast():
+    """The shared transfer-plane window pump: keeps <= window in
+    flight, and the first failure cancels the rest."""
+    import asyncio
+    from ray_tpu._private.transfer import run_windowed
+
+    async def main():
+        running = [0]
+        peak = [0]
+        done = []
+
+        async def task(i):
+            running[0] += 1
+            peak[0] = max(peak[0], running[0])
+            try:
+                await asyncio.sleep(0.01)
+                done.append(i)
+            finally:
+                running[0] -= 1
+
+        await run_windowed((lambda i=i: task(i) for i in range(10)), 3)
+        assert len(done) == 10
+        assert peak[0] <= 3
+
+        cancelled = []
+
+        async def boom():
+            raise RuntimeError("boom")
+
+        async def slow(i):
+            try:
+                await asyncio.sleep(5)
+            except asyncio.CancelledError:
+                cancelled.append(i)
+                raise
+
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="boom"):
+            await run_windowed(
+                [lambda: slow(0), lambda: slow(1), lambda: boom()], 3)
+        assert time.monotonic() - t0 < 2
+        assert sorted(cancelled) == [0, 1]
+
+    asyncio.run(main())
+
+
+def test_scratch_arena_alloc_free():
+    from ray_tpu.util.collective.transport import ScratchArena
+    import os
+    import tempfile
+
+    path = os.path.join(tempfile.gettempdir(), f"rt_tst_{os.getpid()}")
+    a = ScratchArena(path, 1 << 20)
+    try:
+        deadline = time.monotonic() + 5
+        o1 = a.alloc(1000, deadline)
+        o2 = a.alloc(2000, deadline)
+        assert o2 >= o1 + 1024  # aligned, disjoint
+        a.free(o1, 1000)
+        o3 = a.alloc(500, deadline)
+        assert o3 == o1  # freed block reused (first fit)
+        a.free(o2, 2000)
+        a.free(o3, 500)
+        # Coalesced back: a full-capacity-minus-header alloc fits.
+        big = a.alloc((1 << 20) - 128, deadline)
+        a.free(big, (1 << 20) - 128)
+        with pytest.raises(Exception):
+            a.alloc(1 << 21, time.monotonic() + 0.2)  # oversized
+    finally:
+        a.close()
+    assert not os.path.exists(path)

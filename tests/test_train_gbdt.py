@@ -89,6 +89,46 @@ def test_gbdt_binary_logistic_single_worker(ray_init):
     assert acc > 0.93
 
 
+@pytest.mark.slow
+def test_gbdt_fast_plane_matches_coordinator_path(ray_init):
+    """Histogram sync on the peer-to-peer collective fast plane grows
+    EXACTLY the same trees as the coordinator path (the bit-parity
+    contract of the rank-order fold)."""
+    from ray_tpu.train import GBDTTrainer
+    from ray_tpu.train.gbdt import _gbdt_train_loop
+
+    ds, _x, _y = _make_dataset(n=500, seed=3)
+    params = {"num_boost_round": 8, "max_depth": 3, "eta": 0.3}
+
+    def _loop_with_plane(plane):
+        def _loop(config):
+            from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+            cfg.collective_data_plane = plane
+            # Histograms are ~100KiB here; drop the threshold so the
+            # fast plane actually engages at this toy size.
+            cfg.collective_fastpath_min_bytes = 1024
+            _gbdt_train_loop(config)
+        return _loop
+
+    models = {}
+    for plane in ("coord", "auto"):
+        trainer = GBDTTrainer(
+            label_column="y", params=params,
+            train_loop_per_worker=_loop_with_plane(plane),
+            datasets={"train": ds},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 1}))
+        result = trainer.fit()
+        state = result.checkpoint.to_dict()
+        models[plane] = (state["trees"], np.asarray(state["edges"]))
+
+    trees_c, edges_c = models["coord"]
+    trees_f, edges_f = models["auto"]
+    np.testing.assert_array_equal(edges_c, edges_f)
+    assert trees_c == trees_f, \
+        "fast-plane GBDT grew different trees than the coordinator path"
+
+
 def test_xgboost_trainer_gated():
     try:
         import xgboost  # noqa: F401
