@@ -19,7 +19,8 @@ SHELL := /bin/bash
 
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
 	bench-quick bench-llm-quick bench-transfer bench-collective \
-	bench-collective-quick chaos chaos-smoke
+	bench-collective-quick bench-control bench-control-quick \
+	chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -78,6 +79,23 @@ bench-collective-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite collective --quick
 
+# Control-plane scaling curves: coalesced-vs-legacy pubsub broadcast
+# throughput over subscriber counts, indexed-vs-rescan scheduling
+# decisions over simulated node counts, actor creations/sec + lease
+# grant latency at queue depth, node-view convergence after churn.
+# Refreshes the checked-in BENCH_control_plane.json.
+bench-control:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite control_plane \
+		--json-out BENCH_control_plane.json
+
+# <60 s control-plane smoke (smaller sub/node counts; HEADLINE last):
+# catches a pubsub-coalescing or scheduling-index regression before a
+# full bench round.  Does NOT touch the checked-in artifact.
+bench-control-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite control_plane --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -102,6 +120,8 @@ chaos:
 		tests/test_transfer_plane.py::test_duplicated_push_chunks_deduped_by_offset \
 		tests/test_collective.py::test_member_death_mid_allreduce_fails_survivors_fast \
 		tests/test_collective.py::test_destroy_mid_op_fails_blocked_members_fast \
+		tests/test_control_plane.py::test_sigkill_gcs_restart_from_snapshot_mid_churn \
+		tests/test_control_plane.py::test_gcs_restart_mid_churn_recovers_from_snapshot \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -120,7 +140,7 @@ chaos-smoke:
 	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
-	bench-collective-quick
+	bench-collective-quick bench-control-quick
 
 store: ray_tpu/_private/_shm_store.so
 

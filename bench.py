@@ -23,6 +23,15 @@ Suites (--suite):
              striped) vs the stop-and-wait pickled-chunk baseline, with
              the host memcpy floor annotation.  Writes
              BENCH_transfer.json.
+  control_plane
+             GCS + scheduling at simulated cluster scale: coalesced vs
+             legacy pubsub broadcast (events/sec, delivery latency,
+             scaling over subscriber counts), indexed vs full-rescan
+             scheduling decisions (scaling over node counts), actor
+             creations/sec + lease grant latency at queue depth, and
+             node-view convergence after membership churn.  Writes
+             BENCH_control_plane.json; --quick is the <60s smoke wired
+             into make check.
 """
 
 import json
@@ -1260,12 +1269,391 @@ def collective_main(json_out=None, quick=False):
     return result
 
 
+def control_plane_main(json_out=None, quick=False):
+    """Control-plane scale bench: one REAL GcsServer plus N simulated
+    raylets (real duplex connections that register, heartbeat, answer
+    actor-lease RPCs instantly, and track node views — no workers, no
+    object store), so every number isolates control-plane cost:
+
+      * pubsub broadcast: events/sec fully delivered to N subscribers
+        and mean event->delivery latency, coalesced (per-subscriber
+        queues + batch frames) vs the legacy serialized per-push path
+        (RT_GCS_PUBSUB_COALESCE=0) — scaling curve over subscriber
+        counts;
+      * scheduling decision cost: spillback/hybrid/spread picks/sec on
+        the indexed cluster view vs the full-rescan scan policy, with a
+        heartbeat-rate delta stream interleaved — scaling curve over
+        simulated node counts (the O(1)-ish vs O(N) story);
+      * actor creations/sec + lease grant latency (submit->ALIVE
+        p50/p95) at queue depth, end-to-end through GCS scheduling,
+        the lease RPC, and the actor-event publish;
+      * node-view convergence: kill + add a batch of members mid-run,
+        time until every surviving member's view reflects the final
+        membership."""
+    import asyncio
+    import random
+
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.ids import ActorID, NodeID
+
+    sub_counts = [10, 50] if quick else [25, 100, 400]
+    node_counts = [100, 1000] if quick else [100, 1000, 5000]
+    n_events = 200 if quick else 500
+    actor_depths = [32, 128] if quick else [32, 128, 512]
+    sim_cluster = 20 if quick else 100
+    churn_nodes = 30 if quick else 100
+
+    # ---------------------------------------------------------- pubsub
+    class _Sub:
+        """One subscriber connection counting deliveries."""
+
+        def __init__(self):
+            self.got = 0
+            self.lat_sum = 0.0
+            self.done = asyncio.Event()
+            self.want = 0
+            self.conn = None
+
+        async def connect(self, port, channel):
+            async def handler(conn, method, body):
+                now = time.perf_counter()
+                if method == "pubsub":
+                    msgs = (body["message"],)
+                elif method == "pubsub_batch":
+                    msgs = protocol.pubsub_batch_messages(body)
+                else:
+                    return None
+                for m in msgs:
+                    self.lat_sum += now - m["t"]
+                self.got += len(msgs)
+                if self.got >= self.want:
+                    self.done.set()
+                return None
+
+            self.conn = await protocol.Connection.connect(
+                "127.0.0.1", port, handler=handler, name="bench-sub")
+            await self.conn.request("subscribe", {"channels": [channel]})
+
+    async def bench_pubsub(n_subs, coalesce, passes=1 if quick else 3):
+        """Best-of-``passes`` (same discipline as the transfer suite:
+        throughput benches on a shared 1-core host keep the best pass,
+        scheduling noise only ever subtracts)."""
+        prior = cfg.gcs_pubsub_coalesce
+        cfg.gcs_pubsub_coalesce = coalesce
+        gcs = GcsServer()
+        best = None
+        try:
+            port = await gcs.start(0)
+            subs = [_Sub() for _ in range(n_subs)]
+            for s in subs:
+                await s.connect(port, "bench")
+            for _ in range(passes):
+                for s in subs:
+                    s.got = 0
+                    s.lat_sum = 0.0
+                    s.want = n_events
+                    s.done = asyncio.Event()
+                # Per-pass counter deltas (the stats accumulate on the
+                # shared GcsServer across passes).
+                pre = dict(gcs.pubsub_stats)
+                t0 = time.perf_counter()
+                for i in range(n_events):
+                    await gcs._publish("bench",
+                                       {"i": i, "t": time.perf_counter()})
+                await asyncio.gather(*(asyncio.wait_for(s.done.wait(),
+                                                        120)
+                                       for s in subs))
+                wall = time.perf_counter() - t0
+                delivered = sum(s.got for s in subs)
+                lat = sum(s.lat_sum for s in subs) / max(1, delivered)
+                stats = dict(gcs.pubsub_stats)
+                rec = {"subscribers": n_subs, "events": n_events,
+                       "events_per_s": round(n_events / wall, 1),
+                       "deliveries_per_s": round(delivered / wall, 1),
+                       "mean_delivery_latency_ms": round(lat * 1e3, 3),
+                       "batches": stats["batches"] - pre["batches"],
+                       "batched_msgs": (stats["batched_msgs"]
+                                        - pre["batched_msgs"]),
+                       "max_batch": stats["max_batch"]}
+                if best is None or rec["deliveries_per_s"] \
+                        > best["deliveries_per_s"]:
+                    best = rec
+            for s in subs:
+                await s.conn.close()
+            return best
+        finally:
+            cfg.gcs_pubsub_coalesce = prior
+            await gcs.stop()
+
+    # ------------------------------------------------ scheduling picks
+    def bench_sched(n_nodes):
+        from ray_tpu._private.sched_policy import SchedulingPolicies
+        rng = random.Random(7)
+        views = []
+        for i in range(n_nodes):
+            total = {"CPU": rng.choice([4, 8, 16])}
+            if rng.random() < 0.3:
+                total["TPU"] = 4
+            views.append({
+                "node_id": NodeID.from_random(),
+                "addr": (f"10.{i >> 8}.{i & 255}.1", 7000),
+                "resources": total,
+                "available": {k: rng.uniform(0, v)
+                              for k, v in total.items()},
+                "load": rng.randrange(8)})
+        shapes = [{"CPU": 1}, {"CPU": 4}, {"CPU": 2, "TPU": 1}]
+        n_picks = 2000 if quick else 5000
+        out = {"nodes": n_nodes}
+        for label, use_index in (("indexed", True), ("scan", False)):
+            pol = SchedulingPolicies(use_index=use_index)
+            for v in views:
+                pol.index.upsert(v)
+            for shape in shapes:   # warm shape indexes
+                pol.pick_hybrid(shape)
+            t0 = time.perf_counter()
+            for j in range(n_picks):
+                # Heartbeat-rate delta stream: one node delta per 8
+                # decisions (a busy cluster's update:decision ratio).
+                if j % 8 == 0:
+                    v = views[rng.randrange(n_nodes)]
+                    pol.index.update(
+                        v["node_id"],
+                        available={k: rng.uniform(0, c)
+                                   for k, c in v["resources"].items()},
+                        load=rng.randrange(8))
+                shape = shapes[j % len(shapes)]
+                pol.pick_hybrid(shape)
+                pol.pick_spread(shape, 4)
+                pol.pick_spillback(shape)
+            wall = time.perf_counter() - t0
+            out[label + "_decisions_per_s"] = round(3 * n_picks / wall, 1)
+            out[label + "_us_per_decision"] = round(
+                wall / (3 * n_picks) * 1e6, 2)
+        out["indexed_vs_scan"] = round(
+            out["indexed_decisions_per_s"] / out["scan_decisions_per_s"],
+            2)
+        return out
+
+    # ------------------------------------------- simulated raylet plane
+    class SimRaylet:
+        """Registers a node over a real duplex conn, answers actor
+        leases instantly, and mirrors "nodes" pubsub into a local view
+        (what a real raylet's scheduling cache does)."""
+
+        def __init__(self, idx):
+            self.node_id = NodeID.from_random()
+            # Unused loopback port: the GCS death probe gets an instant
+            # refusal, so a killed sim node is declared dead fast.
+            self.addr = ("127.0.0.1", 1)
+            self.idx = idx
+            self.view = {}
+            self.conn = None
+
+        async def _handle(self, conn, method, body):
+            if method == "pubsub":
+                self._apply(body["message"])
+                return None
+            if method == "pubsub_batch":
+                for m in protocol.pubsub_batch_messages(body):
+                    self._apply(m)
+                return None
+            if method == "lease_worker_for_actor":
+                return {"ok": True, "worker_addr": self.addr,
+                        "worker_id": b"w%d" % self.idx, "pid": 0}
+            if method == "kill_worker":
+                return {"ok": True}
+            return None
+
+        def _apply(self, msg):
+            if msg["event"] == "added":
+                self.view[msg["node"]["node_id"]] = msg["node"]
+            elif msg["event"] == "removed":
+                self.view.pop(msg["node_id"], None)
+            elif msg["event"] == "updated":
+                v = self.view.get(msg["node_id"])
+                if v is not None:
+                    v.update({k: msg[k] for k in
+                              ("available", "load", "draining")
+                              if k in msg})
+
+        async def start(self, port):
+            self.conn = await protocol.Connection.connect(
+                "127.0.0.1", port, handler=self._handle,
+                name=f"raylet:sim{self.idx}->gcs")
+            reply = await self.conn.request("register_node", {
+                "node_id": self.node_id, "addr": self.addr,
+                "resources": {"CPU": 8}})
+            for v in reply.get("cluster_nodes", []):
+                self.view[v["node_id"]] = v
+            await self.conn.request("subscribe", {"channels": ["nodes"]})
+
+        async def heartbeat(self, avail, load=0, version=1):
+            await self.conn.request("heartbeat", {
+                "node_id": self.node_id, "available": avail,
+                "load": load, "version": version})
+
+    async def bench_actors(n_nodes, depth):
+        gcs = GcsServer()
+        port = await gcs.start(0)
+        sims = [SimRaylet(i) for i in range(n_nodes)]
+        try:
+            for s in sims:
+                await s.start(port)
+            driver = await protocol.Connection.connect(
+                "127.0.0.1", port, name="bench-driver")
+            lat = []
+            t0 = time.perf_counter()
+
+            async def create_one(i):
+                aid = ActorID.from_random()
+                ts = time.perf_counter()
+                await driver.request("create_actor", {
+                    "actor_id": aid, "job_id": b"bench",
+                    "spec": {"class_name": "Sim",
+                             "resources": {"CPU": 1},
+                             "max_restarts": 0}})
+                await driver.request("wait_actor_alive",
+                                     {"actor_id": aid, "timeout": 120})
+                lat.append(time.perf_counter() - ts)
+
+            await asyncio.gather(*(create_one(i) for i in range(depth)))
+            wall = time.perf_counter() - t0
+            lat.sort()
+            await driver.close()
+            return {"nodes": n_nodes, "queue_depth": depth,
+                    "creations_per_s": round(depth / wall, 1),
+                    "grant_latency_p50_ms": round(
+                        lat[len(lat) // 2] * 1e3, 2),
+                    "grant_latency_p95_ms": round(
+                        lat[int(len(lat) * 0.95) - 1] * 1e3, 2)}
+        finally:
+            for s in sims:
+                if s.conn is not None:
+                    await s.conn.close()
+            await gcs.stop()
+
+    async def bench_convergence(n_nodes):
+        """Membership churn: abruptly close K members' conns and join K
+        fresh ones; convergence = every survivor's view holds exactly
+        the final membership (dead removed AND joiners added)."""
+        gcs = GcsServer()
+        port = await gcs.start(0)
+        sims = [SimRaylet(i) for i in range(n_nodes)]
+        try:
+            for s in sims:
+                await s.start(port)
+            k = max(2, n_nodes // 10)
+            victims, survivors = sims[:k], sims[k:]
+            t0 = time.perf_counter()
+            for v in victims:
+                await v.conn.close()   # unannounced: probe declares dead
+            joiners = [SimRaylet(n_nodes + i) for i in range(k)]
+            for s in joiners:
+                await s.start(port)
+            expect = {s.node_id for s in survivors + joiners}
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if all(set(s.view) == expect for s in survivors):
+                    break
+                await asyncio.sleep(0.01)
+            wall = time.perf_counter() - t0
+            converged = all(set(s.view) == expect for s in survivors)
+            for s in survivors + joiners:
+                await s.conn.close()
+            return {"nodes": n_nodes, "killed": k, "joined": k,
+                    "converged": converged,
+                    "convergence_ms": round(wall * 1e3, 1)}
+        finally:
+            await gcs.stop()
+
+    async def run_all():
+        res = {"pubsub": [], "scheduling": [], "actors": [],
+               "convergence": None}
+        for n in sub_counts:
+            co = await bench_pubsub(n, True)
+            le = await bench_pubsub(n, False)
+            res["pubsub"].append({
+                "subscribers": n,
+                "coalesced": co, "legacy": le,
+                "throughput_speedup": round(
+                    co["deliveries_per_s"]
+                    / max(1e-9, le["deliveries_per_s"]), 2),
+                "latency_ratio": round(
+                    le["mean_delivery_latency_ms"]
+                    / max(1e-9, co["mean_delivery_latency_ms"]), 2)})
+        for n in actor_depths:
+            res["actors"].append(await bench_actors(sim_cluster, n))
+        res["convergence"] = await bench_convergence(churn_nodes)
+        return res
+
+    res = asyncio.run(run_all())
+    for n in node_counts:
+        res["scheduling"].append(bench_sched(n))
+
+    top_pub = res["pubsub"][-1]
+    top_sched = res["scheduling"][-1]
+    result = {
+        "metric": "control_plane_pubsub_deliveries_per_s",
+        "value": top_pub["coalesced"]["deliveries_per_s"],
+        "unit": "deliveries/sec",
+        "vs_baseline": top_pub["throughput_speedup"],
+        "detail": {
+            **res,
+            "config": {
+                "gcs_pubsub_queue_max": cfg.gcs_pubsub_queue_max,
+                "gcs_pubsub_batch_max": cfg.gcs_pubsub_batch_max,
+                "heartbeat_period_ms": cfg.heartbeat_period_ms,
+                "gcs_snapshot_period_s": cfg.gcs_snapshot_period_s,
+                "quick": quick,
+            },
+            "_note": (
+                "One process, one loop: GCS + N real subscriber/"
+                "sim-raylet conns over loopback.  pubsub rows = full "
+                "delivery to ALL subscribers (deliveries/sec = events x "
+                "subscribers / wall), coalesced vs the legacy "
+                "serialized per-push path at equal workload.  "
+                "scheduling rows = spillback+hybrid+spread decisions/"
+                "sec on the indexed view vs the full-rescan scan "
+                "policy with a 1:8 delta:decision stream; "
+                "indexed_us_per_decision ~flat vs node count is the "
+                "no-full-rescan evidence.  actors rows = end-to-end "
+                "create->ALIVE through GCS scheduling + instant sim "
+                "leases at the given concurrent depth.  vs_baseline = "
+                "coalesced/legacy delivery throughput at the largest "
+                "subscriber count."),
+        },
+    }
+    line = json.dumps(result)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+    print("HEADLINE control_plane pubsub_deliveries/s="
+          + _fmt_headline(top_pub["coalesced"]["deliveries_per_s"], 1)
+          + " vs_legacy=" + _fmt_headline(
+              top_pub["throughput_speedup"], 2)
+          + "x@" + str(top_pub["subscribers"]) + "subs"
+          + " sched_indexed_us=" + _fmt_headline(
+              top_sched["indexed_us_per_decision"], 2)
+          + " vs_scan=" + _fmt_headline(top_sched["indexed_vs_scan"], 1)
+          + "x@" + str(top_sched["nodes"]) + "nodes"
+          + " actor_creates/s=" + _fmt_headline(
+              res["actors"][-1]["creations_per_s"], 1)
+          + " grant_p95_ms=" + _fmt_headline(
+              res["actors"][-1]["grant_latency_p95_ms"], 2)
+          + " convergence_ms=" + _fmt_headline(
+              res["convergence"]["convergence_ms"], 1))
+    return result
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="train",
                     choices=["train", "serve_llm", "transfer",
-                             "collective"])
+                             "collective", "control_plane"])
     ap.add_argument("--json-out", default=None,
                     help="also write the JSON line to this path "
                          "(serve_llm/transfer default to their "
@@ -1285,5 +1673,10 @@ if __name__ == "__main__":
         collective_main(cli.json_out if cli.quick
                         else (cli.json_out or "BENCH_collective.json"),
                         quick=cli.quick)
+    elif cli.suite == "control_plane":
+        control_plane_main(cli.json_out if cli.quick
+                           else (cli.json_out
+                                 or "BENCH_control_plane.json"),
+                           quick=cli.quick)
     else:
         main()

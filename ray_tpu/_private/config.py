@@ -128,8 +128,44 @@ class _Config:
     # the scratch-arena memcpy path.
     collective_pvm_reads = _def("collective_pvm_reads", bool, True)
 
+    # --- control plane (GCS pubsub / snapshots / events) ---
+    # Coalesced pubsub: every subscriber gets a bounded outbound queue
+    # drained by a pump that batches same-channel messages into one
+    # frame (KIND_BATCH), so an event burst costs O(events) enqueues
+    # instead of O(events x subscribers) serialized awaits, and one
+    # stalled subscriber can never head-of-line-block the broadcast.
+    # Set false to restore the legacy per-event serialized push path
+    # (kept as the bench baseline).
+    gcs_pubsub_coalesce = _def("gcs_pubsub_coalesce", bool, True)
+    # Per-subscriber outbound queue bound.  A subscriber that falls
+    # this far behind starts losing its OLDEST queued events (drops are
+    # counted and exported); pubsub is a best-effort notification
+    # plane, so consumers must tolerate gaps (node views re-seed on
+    # reconnect, actor waiters re-poll).
+    gcs_pubsub_queue_max = _def("gcs_pubsub_queue_max", int, 10000)
+    # Most messages one pump drain folds into a single batch frame.
+    gcs_pubsub_batch_max = _def("gcs_pubsub_batch_max", int, 512)
+    # Publish per-node resource/load deltas on the "nodes" channel when
+    # a heartbeat payload changes them (raylets keep their spillback /
+    # spread / hybrid views fresh instead of frozen at registration).
+    gcs_publish_resource_updates = _def("gcs_publish_resource_updates",
+                                        bool, True)
+    # Durable-state snapshot cadence (when a persist path is set) and
+    # how many trailing cluster events ride each snapshot, so a
+    # restarted GCS keeps recent history instead of replaying the world.
+    gcs_snapshot_period_s = _def("gcs_snapshot_period_s", float, 0.5)
+    gcs_snapshot_events_tail = _def("gcs_snapshot_events_tail", int, 256)
+    # Bounded cluster-event ring (drops are counted and exported).
+    gcs_events_max = _def("gcs_events_max", int, 1000)
+
     # --- scheduling ---
     max_workers_per_node = _def("max_workers_per_node", int, 64)
+    # Indexed cluster view for spillback/spread/hybrid picks: per-shape
+    # candidate sets + score heaps updated incrementally from node
+    # deltas, so a lease decision costs O(candidates-inspected) instead
+    # of a full rescan of every node view.  Set false to force the
+    # plain full-scan policy path (parity/debug escape hatch).
+    sched_indexed_view = _def("sched_indexed_view", bool, True)
     # Fork-server worker spawn (zygote.py): pay the interpreter+import cost
     # once per node, fork workers in ~10ms after that.
     worker_zygote_enabled = _def("worker_zygote_enabled", bool, True)

@@ -24,6 +24,7 @@ import asyncio
 import logging
 import os
 import time
+from collections import deque
 
 from ray_tpu._private import protocol
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
@@ -69,6 +70,7 @@ class NodeInfo:
             "available": self.available_resources,
             "labels": self.labels,
             "alive": self.alive,
+            "draining": self.draining,
             "load": self.load,
             # Versioned-sync introspection (beats = all heartbeats,
             # payloads = beats that carried a resource snapshot).
@@ -135,6 +137,29 @@ class PlacementGroupInfo:
         }
 
 
+class _Subscriber:
+    """Per-subscriber outbound pubsub state: a bounded FIFO of
+    (channel, message) drained by one pump task.  The pump folds a
+    backlog into batch frames, so a slow subscriber throttles only its
+    own queue (and starts losing its OLDEST events past the bound)
+    instead of head-of-line-blocking every other subscriber's
+    broadcast."""
+
+    __slots__ = ("conn", "queue", "wake", "task", "dropped", "gapped")
+
+    def __init__(self, conn):
+        self.conn = conn
+        self.queue = deque()
+        self.wake = asyncio.Event()
+        self.task = None
+        self.dropped = 0
+        # Channels whose events this subscriber LOST to the queue
+        # bound; the pump follows up with a pubsub_gap notification so
+        # the consumer can re-seed authoritatively instead of running
+        # on a silently-holed view forever.
+        self.gapped: set = set()
+
+
 class GcsServer:
     def __init__(self, host="127.0.0.1", persist_path: str | None = None):
         self.host = host
@@ -150,7 +175,21 @@ class GcsServer:
         # manager's source selection).  Fed by best-effort raylet
         # reports; consumers stat-verify, so staleness is tolerated.
         self.object_locations: dict[bytes, set] = {}
+        self._dir_writes = 0  # object-directory mutation counter
         self.subscribers: dict[str, set[protocol.Connection]] = {}
+        # Coalesced pubsub (id(conn) -> _Subscriber) + broadcast stats.
+        self._subs: dict[int, _Subscriber] = {}
+        self.pubsub_stats = {"published": 0, "sent_msgs": 0,
+                             "sent_frames": 0, "batches": 0,
+                             "batched_msgs": 0, "max_batch": 0,
+                             "dropped": 0, "evicted": 0}
+        # Incremental cluster-resource aggregation: totals/availability
+        # maintained from registration + heartbeat deltas so
+        # cluster_resources / autoscaler demand polls don't rescan every
+        # node view (the per-heartbeat-period full-rescan hot spot).
+        self._agg_total: dict = {}
+        self._agg_avail: dict = {}
+        self._demand_nodes: set = set()  # nodes with queued lease shapes
         self.jobs: dict = {}
         self._pending_actor_creations: dict[ActorID, asyncio.Task] = {}
         self._actor_waiters: dict[ActorID, list[asyncio.Future]] = {}
@@ -171,10 +210,18 @@ class GcsServer:
             self._store_client = get_store_client(persist_path)
         self._kv_writes = 0
         # Structured cluster events (reference: src/ray/util/event.h:102
-        # EventManager + dashboard/modules/event): bounded ring, surfaced
-        # via the state API and dashboard.
-        from collections import deque
-        self.events = deque(maxlen=1000)
+        # EventManager + dashboard/modules/event): bounded ring
+        # (RT_GCS_EVENTS_MAX) with an explicit drop count, surfaced via
+        # the state API and dashboard.
+        self.events = deque(maxlen=max(1, cfg.gcs_events_max))
+        self.events_dropped = 0
+        self._events_seq = 0
+        # Snapshot bookkeeping (age/size exported as metrics).
+        self.restored_from_snapshot = False
+        self._last_snapshot_ts = None
+        self._last_snapshot_bytes = 0
+        self._snapshot_count = 0
+        self._metrics = None
         if persist_path:
             self._load_snapshot()
 
@@ -192,6 +239,10 @@ class GcsServer:
     async def stop(self):
         for t in getattr(self, "_bg_tasks", []):
             t.cancel()
+        for sub in list(self._subs.values()):
+            if sub.task is not None:
+                sub.task.cancel()
+        self._subs.clear()
         await self.server.stop()
 
     # ----------------------------------------------------------- persistence
@@ -208,6 +259,28 @@ class GcsServer:
                    if ns not in self._EPHEMERAL_KV_NS},
             "named_actors": dict(self.named_actors),
             "jobs": dict(self.jobs),
+            # Node table: a restarted GCS seeds these as alive-pending-
+            # re-register entries with a fresh heartbeat grace window,
+            # so mid-restart churn never produces a false NODE_DEAD and
+            # actors keep their placements while raylets reconnect.
+            "nodes": [
+                {"node_id": n.node_id, "addr": n.addr,
+                 "resources": dict(n.total_resources),
+                 "available": dict(n.available_resources),
+                 "labels": dict(n.labels), "load": n.load,
+                 "draining": n.draining}
+                for n in self.nodes.values() if n.alive
+            ],
+            # Object directory (stripe-size objects only, so compact):
+            # restored entries are stat-verified by consumers, making
+            # staleness harmless.
+            "object_locations": {oid: list(locs) for oid, locs
+                                 in self.object_locations.items()},
+            # Event-log tail: recent history survives the restart
+            # instead of being replayed from scratch (or lost).
+            "events": (list(self.events)[-cfg.gcs_snapshot_events_tail:]
+                       if cfg.gcs_snapshot_events_tail > 0 else []),
+            "events_dropped": self.events_dropped,
             "actors": [
                 {"actor_id": a.actor_id, "spec": dict(a.spec),
                  "state": a.state, "addr": a.addr, "node_id": a.node_id,
@@ -226,7 +299,11 @@ class GcsServer:
 
     def _write_snapshot(self, state: dict):
         import pickle
-        self._store_client.write(pickle.dumps(state))
+        blob = pickle.dumps(state)
+        self._store_client.write(blob)
+        self._last_snapshot_ts = time.monotonic()
+        self._last_snapshot_bytes = len(blob)
+        self._snapshot_count += 1
 
     def _load_snapshot(self):
         import pickle
@@ -241,6 +318,32 @@ class GcsServer:
         self.kv = snap.get("kv", {})
         self.named_actors = dict(snap.get("named_actors", {}))
         self.jobs = dict(snap.get("jobs", {}))
+        for nv in snap.get("nodes", []):
+            # Restored as alive with conn=None ("recovering"): the
+            # raylet's reconnect loop re-registers within a heartbeat,
+            # and until then the fresh last_heartbeat grants the full
+            # grace window — a restart mid-churn must not flip healthy
+            # nodes to NODE_DEAD (nor orphan the actors placed there).
+            info = NodeInfo(nv["node_id"], nv["addr"], nv["resources"],
+                            nv.get("labels"), None)
+            info.available_resources = dict(
+                nv.get("available", nv["resources"]))
+            info.load = nv.get("load", 0)
+            info.draining = bool(nv.get("draining", False))
+            if info.draining:
+                # Restored drain flags get a fresh bounded window — a
+                # deadline-less flag would never expire (and so never
+                # un-exclude a node that lingers instead of exiting).
+                info.drain_deadline = time.monotonic() + \
+                    cfg.heartbeat_timeout_ms / 1000.0 * 2
+            self.nodes[info.node_id] = info
+            self._agg_add(self._agg_total, info.total_resources)
+            self._agg_add(self._agg_avail, info.available_resources)
+        for oid, locs in snap.get("object_locations", {}).items():
+            self.object_locations[oid] = set(locs)
+        for ev in snap.get("events", []):
+            self.events.append(ev)
+        self.events_dropped = snap.get("events_dropped", 0)
         for a in snap.get("actors", []):
             info = ActorInfo(a["actor_id"], a["spec"], None, a["job_id"])
             info.state = a["state"]
@@ -256,8 +359,15 @@ class GcsServer:
             info.state = p["state"]
             info.bundle_nodes = p["bundle_nodes"]
             self.placement_groups[info.pg_id] = info
-        logger.info("GCS restored %d actors / %d PGs / %d kv namespaces "
-                    "from %s", len(self.actors), len(self.placement_groups),
+        self.restored_from_snapshot = True
+        self._record_event(
+            "INFO", "GCS_RESTORED",
+            f"restored {len(self.nodes)} nodes / {len(self.actors)} "
+            f"actors / {len(self.placement_groups)} PGs / "
+            f"{len(self.kv)} kv namespaces from snapshot")
+        logger.info("GCS restored %d nodes / %d actors / %d PGs / %d kv "
+                    "namespaces from %s", len(self.nodes),
+                    len(self.actors), len(self.placement_groups),
                     len(self.kv), self._persist_path)
 
     def _state_fingerprint(self):
@@ -275,14 +385,18 @@ class GcsServer:
         jobs = tuple(sorted((bytes(k) if isinstance(k, bytes) else str(k),
                              str(v.get("state")))
                             for k, v in self.jobs.items()))
-        return hash((kv_sizes, actors, pgs, jobs,
+        nodes = tuple(sorted(
+            (n.node_id.binary(), n.draining) for n in self.nodes.values()
+            if n.alive))
+        return hash((kv_sizes, actors, pgs, jobs, nodes,
+                     self._dir_writes, self._events_seq,
                      len(self.named_actors)))
 
     async def _snapshot_loop(self):
         loop = asyncio.get_running_loop()
         last_fp = None
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(max(0.05, cfg.gcs_snapshot_period_s))
             try:
                 fp = self._state_fingerprint()
                 if fp == last_fp:
@@ -303,6 +417,7 @@ class GcsServer:
 
     async def _on_disconnect(self, conn):
         # A raylet died, or a driver exited.
+        self._evict_subscriber(conn)
         for node in list(self.nodes.values()):
             if node.conn is conn and node.alive:
                 if self._drain_active(node):
@@ -382,6 +497,11 @@ class GcsServer:
             node.draining = True
             node.drain_deadline = time.monotonic() + \
                 cfg.heartbeat_timeout_ms / 1000.0 * 2
+            # Tell the schedulers: spillback/spread targets must stop
+            # selecting a node that announced its exit.
+            await self._publish("nodes", {
+                "event": "updated", "node_id": node.node_id,
+                "draining": True})
         return {"ok": ok}
 
     @staticmethod
@@ -390,17 +510,59 @@ class GcsServer:
             node.drain_deadline is None
             or time.monotonic() < node.drain_deadline)
 
+    @staticmethod
+    def _agg_add(agg: dict, d: dict):
+        for k, v in d.items():
+            agg[k] = agg.get(k, 0) + v
+
+    @staticmethod
+    def _agg_sub(agg: dict, d: dict):
+        for k, v in d.items():
+            left = agg.get(k, 0) - v
+            if -1e-9 < left < 1e-9:
+                agg.pop(k, None)
+            else:
+                agg[k] = left
+
+    def _agg_drop_node(self, node: "NodeInfo"):
+        """Remove a node's contribution from the incremental cluster
+        aggregates (death / re-registration replacing a live entry)."""
+        self._agg_sub(self._agg_total, node.total_resources)
+        self._agg_sub(self._agg_avail, node.available_resources)
+        self._demand_nodes.discard(node.node_id)
+
     async def rpc_register_node(self, conn, body):
         node_id = body["node_id"]
+        prev = self.nodes.get(node_id)
+        if prev is not None and prev.alive:
+            # Re-registration (GCS restart / reconnect): replace the
+            # entry's aggregate contribution instead of double-counting.
+            self._agg_drop_node(prev)
         info = NodeInfo(node_id, body["addr"], body["resources"],
                         body.get("labels"), conn)
         self.nodes[node_id] = info
+        self._agg_add(self._agg_total, info.total_resources)
+        self._agg_add(self._agg_avail, info.available_resources)
+        # Implicit "nodes" subscription BEFORE the reply snapshot is
+        # built: a node registering between this reply and an explicit
+        # subscribe RPC would otherwise be missed forever (the reply
+        # and the event stream must be atomic for the event-fed
+        # scheduling views).  The raylet's explicit subscribe stays
+        # idempotent.
+        if cfg.gcs_pubsub_coalesce:
+            self._ensure_subscriber(conn)
+        self.subscribers.setdefault("nodes", set()).add(conn)
         await self._publish("nodes", {"event": "added", "node": info.view()})
         for fut in self._node_waiters:
             if not fut.done():
                 fut.set_result(None)
         self._node_waiters.clear()
-        return {"ok": True, "cluster_nodes": [n.view() for n in self.nodes.values()]}
+        # Seed view: ALIVE nodes only — a dead node will never emit the
+        # "removed" event that would prune it from the joiner's
+        # scheduling view, so it must not be handed out in the first
+        # place.
+        return {"ok": True, "cluster_nodes": [
+            n.view() for n in self.nodes.values() if n.alive]}
 
     async def rpc_heartbeat(self, conn, body):
         """Liveness + versioned resource sync: payload-free beats just
@@ -410,13 +572,41 @@ class GcsServer:
         node = self.nodes.get(body["node_id"])
         if node is None:
             return {"ok": False, "reason": "unknown node (gcs restarted?)"}
+        if not node.alive:
+            # Late heartbeat from a node we already declared dead (or a
+            # zombie that outlived its timeout): it must NOT leak into
+            # the demand set or re-advertise the node to schedulers —
+            # tell it to re-register instead ("unknown node" is the
+            # phrase the raylet's re-register path matches on).
+            return {"ok": False,
+                    "reason": "unknown node (marked dead; re-register)"}
         node.last_heartbeat = time.monotonic()
         if "available" in body:
-            node.available_resources = body["available"]
-            node.load = body.get("load", node.load)
+            avail = body["available"]
+            load = body.get("load", node.load)
+            changed = (avail != node.available_resources
+                       or load != node.load)
+            # Incremental aggregate maintenance: swap this node's
+            # availability contribution in place of a full rescan (the
+            # node is alive — dead nodes were bounced above).
+            self._agg_sub(self._agg_avail, node.available_resources)
+            self._agg_add(self._agg_avail, avail)
+            node.available_resources = avail
+            node.load = load
             node.pending_shapes = body.get("pending_shapes", [])
+            if node.pending_shapes:
+                self._demand_nodes.add(node.node_id)
+            else:
+                self._demand_nodes.discard(node.node_id)
             node.sync_version = body.get("version", 0)
             node.sync_payloads += 1
+            if changed and cfg.gcs_publish_resource_updates:
+                # Delta broadcast keeping raylet-side scheduling views
+                # (spillback/spread/hybrid indexes) fresh; coalesced
+                # pubsub folds these into batch frames.
+                await self._publish("nodes", {
+                    "event": "updated", "node_id": node.node_id,
+                    "available": avail, "load": load})
         if "node_stats" in body:
             # Hardware utilization relayed by the node's reporter
             # (reference: reporter_agent stats feeding the dashboard).
@@ -429,9 +619,13 @@ class GcsServer:
         every raylet + unplaced placement-group bundles (reference:
         LoadMetrics + pending PG demand in autoscaler.py:346)."""
         shapes = []
-        for n in self.nodes.values():
-            if n.alive:
-                shapes.extend(getattr(n, "pending_shapes", []))
+        # Only nodes that reported queued shapes are visited (the
+        # _demand_nodes set is maintained from heartbeat deltas) — the
+        # autoscaler poll no longer rescans every node view.
+        for nid in self._demand_nodes:
+            n = self.nodes.get(nid)
+            if n is not None and n.alive:
+                shapes.extend(n.pending_shapes)
         pending_pgs = []
         for pg in self.placement_groups.values():
             if pg.state in ("PENDING", "INFEASIBLE", "RESCHEDULING"):
@@ -475,8 +669,25 @@ class GcsServer:
         timeout = cfg.heartbeat_timeout_ms / 1000.0
         while True:
             await asyncio.sleep(period)
+            try:
+                self._update_metrics()
+            except Exception:
+                pass
             now = time.monotonic()
             for node in list(self.nodes.values()):
+                if node.alive and node.draining \
+                        and not self._drain_active(node):
+                    # The announced drain expired but the node lingers
+                    # alive: clear the flag AND broadcast it — the
+                    # draining=True update permanently excluded the
+                    # node from every raylet's spillback/spread/hybrid
+                    # targeting, and nothing else would ever publish
+                    # the reversal.
+                    node.draining = False
+                    node.drain_deadline = None
+                    await self._publish("nodes", {
+                        "event": "updated", "node_id": node.node_id,
+                        "draining": False})
                 if node.alive and now - node.last_heartbeat > timeout:
                     # A node that announced its drain and then stalled
                     # during teardown is still an orderly exit, not a
@@ -492,13 +703,22 @@ class GcsServer:
 
     def _record_event(self, severity: str, label: str, message: str,
                       source: str = "gcs"):
+        if self.events.maxlen and len(self.events) >= self.events.maxlen:
+            # The ring is about to shed its oldest entry: count it so
+            # operators can see history was lost (and how much).
+            self.events_dropped += 1
+        self._events_seq += 1
         self.events.append({"ts": time.time(), "severity": severity,
                             "label": label, "message": message,
                             "source": source})
 
     async def rpc_list_events(self, conn, body):
         limit = body.get("limit", 200)
-        return list(self.events)[-limit:]
+        events = list(self.events)[-limit:]
+        if body.get("with_stats"):
+            return {"events": events, "dropped": self.events_dropped,
+                    "cap": self.events.maxlen}
+        return events
 
     async def rpc_record_event(self, conn, body):
         self._record_event(body.get("severity", "INFO"),
@@ -518,6 +738,7 @@ class GcsServer:
         if not node.alive:
             return
         node.alive = False
+        self._agg_drop_node(node)
         if planned:
             logger.info("node %s removed: %s", node.node_id.hex()[:8],
                         reason)
@@ -545,28 +766,39 @@ class GcsServer:
                 asyncio.get_running_loop().create_task(self._schedule_pg(pg))
         # Drop the dead node from the object directory so striped pulls
         # stop selecting it as a source.
-        for oid in [o for o, locs in self.object_locations.items()
-                    if node.node_id in locs]:
+        held = [o for o, locs in self.object_locations.items()
+                if node.node_id in locs]
+        for oid in held:
             locs = self.object_locations[oid]
             locs.discard(node.node_id)
             if not locs:
                 del self.object_locations[oid]
+        if held:
+            self._dir_writes += 1
 
     # ----------------------------------------------------- object directory
     async def rpc_object_locations_added(self, conn, body):
         node_id = body["node_id"]
         for oid in body["oids"]:
-            self.object_locations.setdefault(oid, set()).add(node_id)
+            locs = self.object_locations.setdefault(oid, set())
+            if node_id not in locs:
+                locs.add(node_id)
+                # Only real mutations dirty the snapshot fingerprint:
+                # raylet location reports are idempotent best-effort,
+                # and a no-op report must not trigger a full-state
+                # snapshot rewrite every period.
+                self._dir_writes += 1
         return {"ok": True}
 
     async def rpc_object_locations_removed(self, conn, body):
         node_id = body["node_id"]
         for oid in body["oids"]:
             locs = self.object_locations.get(oid)
-            if locs is not None:
+            if locs is not None and node_id in locs:
                 locs.discard(node_id)
                 if not locs:
                     self.object_locations.pop(oid, None)
+                self._dir_writes += 1
         return {"ok": True}
 
     async def rpc_get_object_locations(self, conn, body):
@@ -612,7 +844,17 @@ class GcsServer:
         return {"keys": [k for k in ns if k.startswith(prefix)]}
 
     # --------------------------------------------------------------- pubsub
+    # Coalesced broadcast (reference: src/ray/pubsub/publisher.h — the
+    # publisher buffers per-subscriber mailboxes and ships batches; here
+    # the mailbox is a bounded deque drained by a per-subscriber pump).
+    # _publish is O(#subscribers) dict appends; the pumps fold bursts
+    # into KIND_BATCH frames (one write per drain) and same-channel runs
+    # into ONE pubsub_batch message, so an actor-event storm costs
+    # O(events) instead of O(events x subscribers) serialized awaits.
+
     async def rpc_subscribe(self, conn, body):
+        if cfg.gcs_pubsub_coalesce:
+            self._ensure_subscriber(conn)
         for channel in body["channels"]:
             self.subscribers.setdefault(channel, set()).add(conn)
         return {"ok": True}
@@ -621,10 +863,65 @@ class GcsServer:
         await self._publish(body["channel"], body["message"])
         return {"ok": True}
 
+    def _ensure_subscriber(self, conn) -> _Subscriber:
+        sub = self._subs.get(id(conn))
+        if sub is None:
+            sub = _Subscriber(conn)
+            self._subs[id(conn)] = sub
+            sub.task = asyncio.get_running_loop().create_task(
+                self._sub_pump(sub))
+        return sub
+
+    def _evict_subscriber(self, conn):
+        sub = self._subs.pop(id(conn), None)
+        for members in self.subscribers.values():
+            members.discard(conn)
+        if sub is not None:
+            self.pubsub_stats["evicted"] += 1
+            if sub.task is not None \
+                    and sub.task is not asyncio.current_task():
+                sub.task.cancel()
+
     async def _publish(self, channel: str, message):
         subs = self.subscribers.get(channel)
         if not subs:
             return
+        self.pubsub_stats["published"] += 1
+        if not cfg.gcs_pubsub_coalesce:
+            await self._publish_legacy(channel, subs, message)
+            return
+        qmax = cfg.gcs_pubsub_queue_max
+        # ONE shared cell per event: every subscriber queue holds the
+        # same [channel, message, blob] list, so when a pump needs the
+        # pickled form for a batch frame it serializes ONCE and every
+        # other pump reuses it — fan-out serialization is O(events),
+        # not O(events x subscribers).
+        cell = [channel, message, None]
+        dead = None
+        for conn in subs:
+            if conn.closed:
+                dead = dead or []
+                dead.append(conn)
+                continue
+            sub = self._ensure_subscriber(conn)
+            if len(sub.queue) >= qmax:
+                # Slow-subscriber bound: shed the OLDEST queued event
+                # and remember its channel — the pump tells the
+                # subscriber about the gap so it can re-seed instead
+                # of running on a silently-holed view.
+                shed = sub.queue.popleft()
+                sub.gapped.add(shed[0])
+                sub.dropped += 1
+                self.pubsub_stats["dropped"] += 1
+            sub.queue.append(cell)
+            sub.wake.set()
+        for conn in dead or ():
+            self._evict_subscriber(conn)
+
+    async def _publish_legacy(self, channel: str, subs, message):
+        """Pre-coalescing path (one awaited push per subscriber per
+        event) — kept as the bench baseline and the
+        RT_GCS_PUBSUB_COALESCE=0 escape hatch."""
         dead = []
         # Snapshot: the awaits below yield, and concurrent
         # subscribe/disconnect handlers mutate the live set.
@@ -633,11 +930,88 @@ class GcsServer:
                 dead.append(conn)
                 continue
             try:
-                await conn.push("pubsub", {"channel": channel, "message": message})
+                await conn.push("pubsub",
+                                {"channel": channel, "message": message})
+                self.pubsub_stats["sent_msgs"] += 1
+                self.pubsub_stats["sent_frames"] += 1
             except Exception:
                 dead.append(conn)
         for conn in dead:
             subs.discard(conn)
+
+    async def _sub_pump(self, sub: _Subscriber):
+        """Drain one subscriber's queue: each pass ships everything
+        queued (bounded by gcs_pubsub_batch_max) as one KIND_BATCH
+        frame, with consecutive same-channel messages folded into a
+        single pubsub_batch push carrying PRE-PICKLED message blobs
+        (serialized once per event in the shared cell, reused by every
+        subscriber's pump).  Within a channel, delivery order ==
+        publish order (the queue is FIFO and runs preserve it)."""
+        conn = sub.conn
+        st = self.pubsub_stats
+        try:
+            while not conn.closed:
+                if not sub.queue:
+                    sub.wake.clear()
+                    if not sub.queue:
+                        await sub.wake.wait()
+                    continue
+                batch_max = max(1, cfg.gcs_pubsub_batch_max)
+                drained = []
+                q = sub.queue
+                while q and len(drained) < batch_max:
+                    drained.append(q.popleft())
+                st["sent_msgs"] += len(drained)
+                # Every run — singletons included — ships the shared
+                # pre-pickled blob: interleaved channels must not
+                # degrade fan-out serialization back to
+                # O(events x subscribers).
+                items = []
+                i = 0
+                while i < len(drained):
+                    ch = drained[i][0]
+                    j = i
+                    while j < len(drained) and drained[j][0] == ch:
+                        j += 1
+                    run = drained[i:j]
+                    for c in run:
+                        if c[2] is None:
+                            c[2] = protocol.dumps(c[1])
+                    items.append(("pubsub_batch",
+                                  {"channel": ch,
+                                   "raw": [c[2] for c in run]}))
+                    i = j
+                st["batches"] += 1
+                st["batched_msgs"] += len(drained)
+                if len(drained) > st["max_batch"]:
+                    st["max_batch"] = len(drained)
+                if sub.gapped and not q:
+                    # Only once the queue is FULLY drained: everything
+                    # queued at shed time predates the gap notice, so
+                    # the consumer's authoritative re-seed can never be
+                    # overwritten by a stale event still in flight
+                    # behind it.  (A backlog deeper than one
+                    # batch_max drain keeps the flag for a later pass.)
+                    items.append(("pubsub_gap",
+                                  {"channels": sorted(sub.gapped)}))
+                    sub.gapped.clear()
+                st["sent_frames"] += len(items)
+                conn.push_send_many_nowait(items)
+                await conn.backpressure()
+        except asyncio.CancelledError:
+            return
+        except Exception as e:
+            # Evicting a subscriber whose conn still looks healthy
+            # must leave a trace: it silently stops ALL its event
+            # delivery (unlike queue overflow, which sends a gap
+            # notice), so a swallowed pump bug would present as a
+            # permanently stale consumer with zero diagnostics.
+            logger.warning("pubsub pump for %s failed (%s: %s); "
+                           "evicting subscriber",
+                           getattr(conn, "name", conn),
+                           type(e).__name__, e)
+        finally:
+            self._evict_subscriber(conn)
 
     # ----------------------------------------------------------------- jobs
     async def rpc_register_driver(self, conn, body):
@@ -679,6 +1053,11 @@ class GcsServer:
         self.actors[actor_id] = actor
         task = asyncio.get_running_loop().create_task(self._schedule_actor(actor))
         self._pending_actor_creations[actor_id] = task
+        # Completed schedules must not accumulate (one dead Task per
+        # actor EVER created is a control-plane leak at scale).
+        task.add_done_callback(
+            lambda _t, aid=actor_id:
+            self._pending_actor_creations.pop(aid, None))
         return {"ok": True}
 
     async def _schedule_actor(self, actor: ActorInfo):
@@ -745,9 +1124,13 @@ class GcsServer:
                 node = self.nodes.get(nid)
                 return node if node and node.alive else None
             candidates = [self.nodes[n] for n in pg.bundle_nodes
-                          if n in self.nodes and self.nodes[n].alive]
+                          if n in self.nodes and self.nodes[n].alive
+                          and self.nodes[n].conn is not None]
         else:
-            candidates = [n for n in self.nodes.values() if n.alive]
+            # conn None = snapshot-restored node still reconnecting:
+            # alive for liveness purposes, but not leasable yet.
+            candidates = [n for n in self.nodes.values()
+                          if n.alive and n.conn is not None]
         if strategy and strategy.get("type") == "node_affinity":
             nid = strategy["node_id"]
             node = self.nodes.get(nid)
@@ -755,7 +1138,8 @@ class GcsServer:
                 # Callers commonly pass the hex form from ray_tpu.nodes().
                 node = next((n for k, n in self.nodes.items()
                              if k.hex() == nid), None)
-            if node and node.alive and self._fits(node, resources):
+            if node and node.alive and node.conn is not None \
+                    and self._fits(node, resources):
                 return node
             if not strategy.get("soft", False):
                 return None
@@ -898,7 +1282,8 @@ class GcsServer:
         raylet/scheduling/policy/bundle_scheduling_policy.h)."""
         deadline = time.monotonic() + 120.0
         while time.monotonic() < deadline:
-            alive = [n for n in self.nodes.values() if n.alive]
+            alive = [n for n in self.nodes.values()
+                     if n.alive and n.conn is not None]
             try:
                 assignment = choose_nodes_for_bundles(
                     pg.bundles, pg.strategy, alive)
@@ -989,19 +1374,128 @@ class GcsServer:
 
     # ------------------------------------------------------------ stats/etc
     async def rpc_cluster_resources(self, conn, body):
-        total: dict = {}
-        avail: dict = {}
-        for n in self.nodes.values():
-            if not n.alive:
-                continue
-            for k, v in n.total_resources.items():
-                total[k] = total.get(k, 0) + v
-            for k, v in n.available_resources.items():
-                avail[k] = avail.get(k, 0) + v
-        return {"total": total, "available": avail}
+        # Served from the incrementally-maintained aggregates (swapped
+        # in/out on register / heartbeat delta / death) — O(resource
+        # kinds), not O(nodes).
+        return {"total": dict(self._agg_total),
+                "available": dict(self._agg_avail)}
 
     async def rpc_ping(self, conn, body):
         return {"ok": True, "uptime": time.time() - self._start_time}
+
+    # -------------------------------------------------------------- metrics
+    def _ensure_metrics(self):
+        """GCS control-plane gauges/counters on the shared
+        ray_tpu.util.metrics registry (in-process clusters see them in
+        the driver's registry; the standalone GCS process self-exports
+        them through the telemetry KV below)."""
+        if self._metrics is not None:
+            return self._metrics
+        from ray_tpu.util.metrics import Counter, Gauge
+        self._metrics = {
+            "queue_depth": Gauge(
+                "gcs_pubsub_queue_depth",
+                "deepest per-subscriber outbound pubsub queue"),
+            "subscribers": Gauge(
+                "gcs_pubsub_subscribers", "live pubsub subscriber conns"),
+            "batch_avg": Gauge(
+                "gcs_pubsub_batch_size_avg",
+                "mean messages folded per coalesced batch frame"),
+            "dropped": Counter(
+                "gcs_pubsub_dropped_total",
+                "pubsub events shed by slow-subscriber queue bounds"),
+            "pending_actors": Gauge(
+                "gcs_pending_actor_creations",
+                "actor creations awaiting scheduling"),
+            "events_dropped": Counter(
+                "gcs_events_dropped_total",
+                "cluster events shed by the bounded event ring"),
+            "snapshot_age": Gauge(
+                "gcs_snapshot_age_seconds",
+                "seconds since the last durable snapshot write"),
+            "snapshot_bytes": Gauge(
+                "gcs_snapshot_bytes", "size of the last snapshot blob"),
+        }
+        # Counters exported as monotonic totals: remember last values.
+        self._metric_last = {"dropped": 0, "events_dropped": 0}
+        return self._metrics
+
+    def _update_metrics(self):
+        try:
+            m = self._ensure_metrics()
+        except Exception:
+            return
+        st = self.pubsub_stats
+        depth = max((len(s.queue) for s in self._subs.values()),
+                    default=0)
+        m["queue_depth"].set(depth)
+        m["subscribers"].set(len(self._subs))
+        m["batch_avg"].set(
+            round(st["batched_msgs"] / st["batches"], 2)
+            if st["batches"] else 0.0)
+
+        def export_counter(key, metric, current):
+            delta = current - self._metric_last[key]
+            if delta > 0:
+                metric.inc(delta)
+                self._metric_last[key] = current
+
+        export_counter("dropped", m["dropped"], st["dropped"])
+        export_counter("events_dropped", m["events_dropped"],
+                       self.events_dropped)
+        m["pending_actors"].set(len(self._pending_actor_creations))
+        if self._last_snapshot_ts is not None:
+            m["snapshot_age"].set(
+                round(time.monotonic() - self._last_snapshot_ts, 3))
+            m["snapshot_bytes"].set(self._last_snapshot_bytes)
+        # Standalone GCS process: self-publish the gcs_* series into the
+        # telemetry KV so the dashboard head aggregates them like any
+        # worker's.  In-process clusters skip this (the driver's own
+        # telemetry loop already exports the shared registry).
+        try:
+            from ray_tpu._private import worker as worker_mod
+            if worker_mod.global_worker is None:
+                import pickle
+                from ray_tpu.util.metrics import registry_snapshot
+                snaps = [s for s in registry_snapshot()
+                         if s["name"].startswith("gcs_")]
+                self.kv.setdefault("telemetry", {})[b"__gcs__"] = \
+                    pickle.dumps({"snapshots": snaps,
+                                  "profile": [],
+                                  "pid": os.getpid(), "mode": "gcs"})
+        except Exception:
+            pass
+
+    async def rpc_control_plane_stats(self, conn, body):
+        """Raw control-plane instrumentation (bench + tests): pubsub
+        queue/batch/drop counters, event-ring stats, snapshot age/size,
+        scheduling table sizes."""
+        self._update_metrics()
+        return {
+            "pubsub": {
+                **self.pubsub_stats,
+                "subscribers": len(self._subs),
+                "queue_depth": max(
+                    (len(s.queue) for s in self._subs.values()),
+                    default=0),
+            },
+            "events": {"len": len(self.events),
+                       "cap": self.events.maxlen,
+                       "dropped": self.events_dropped},
+            "snapshot": {
+                "count": self._snapshot_count,
+                "bytes": self._last_snapshot_bytes,
+                "age_s": (round(time.monotonic() - self._last_snapshot_ts,
+                                3)
+                          if self._last_snapshot_ts is not None else None),
+                "restored": self.restored_from_snapshot,
+            },
+            "nodes": {"alive": sum(1 for n in self.nodes.values()
+                                   if n.alive),
+                      "total": len(self.nodes),
+                      "demand_nodes": len(self._demand_nodes)},
+            "pending_actor_creations": len(self._pending_actor_creations),
+        }
 
 
 def main():

@@ -108,6 +108,11 @@ class EventAccessor(_Accessor):
     def list(self, **filters) -> List[Dict]:
         return self._call("list_events", filters)
 
+    def list_with_stats(self, limit: int = 200) -> Dict:
+        """Events plus ring accounting: {"events", "dropped", "cap"}."""
+        return self._call("list_events", {"limit": limit,
+                                          "with_stats": True})
+
     def record(self, event: Dict) -> Dict:
         return self._call("record_event", event)
 
@@ -126,6 +131,13 @@ class GcsClient:
 
     def ping(self) -> Dict:
         return self._w._run(self._w._gcs_request("ping", {}))
+
+    def control_plane_stats(self) -> Dict:
+        """Pubsub queue/batch/drop counters, event-ring stats, snapshot
+        age/size, node/demand table sizes (see GcsServer
+        rpc_control_plane_stats)."""
+        return self._w._run(self._w._gcs_request("control_plane_stats",
+                                                 {}))
 
 
 def global_gcs_client() -> GcsClient:

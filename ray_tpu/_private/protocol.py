@@ -226,6 +226,16 @@ def dumps(obj) -> bytes:
     return pickle.dumps(obj, protocol=_PICKLE_PROTO)
 
 
+def pubsub_batch_messages(body) -> list:
+    """Decode one coalesced ``pubsub_batch`` push body: either plain
+    ``messages`` or ``raw`` (per-message blobs the GCS pickled ONCE and
+    fanned out to every subscriber)."""
+    msgs = body.get("messages")
+    if msgs is not None:
+        return msgs
+    return [loads(b) for b in body.get("raw", ())]
+
+
 def loads(data):
     return pickle.loads(data)
 
@@ -1019,6 +1029,23 @@ class Connection:
                 self._pending.pop(msg_id, None)
             raise
         return futs
+
+    def push_send_many_nowait(self, items) -> None:
+        """Send a burst of one-way pushes — ``items`` is a sequence of
+        ``(method, body)`` — as a single KIND_BATCH frame (one write,
+        one header read on the peer).  Sub-frames are ordinary
+        KIND_PUSH frames, so receivers need no new handling beyond the
+        batch unpack that request bursts already use.  The GCS pubsub
+        pump rides this to fold a multi-channel drain into one
+        syscall."""
+        buf = bytearray()
+        for method, body in items:
+            prefix = _envelope_prefix(method)
+            payload = dumps(body)
+            buf += _HDR.pack(len(prefix) + len(payload), KIND_PUSH, 0)
+            buf += prefix
+            buf += payload
+        self._send_nowait(KIND_BATCH, 0, buf)
 
     async def request_send(self, method: str, body=None):
         """Send a request and return the reply future WITHOUT awaiting it.

@@ -138,6 +138,20 @@ class _ContainerProcHandle:
     terminate = kill
 
 
+_PENDING_GAUGE = None
+
+
+def _pending_leases_gauge():
+    global _PENDING_GAUGE
+    if _PENDING_GAUGE is None:
+        from ray_tpu.util.metrics import Gauge
+        _PENDING_GAUGE = Gauge(
+            "raylet_pending_leases",
+            "queued (ungranted) worker-lease requests",
+            tag_keys=("node",))
+    return _PENDING_GAUGE
+
+
 class Lease:
     def __init__(self, lease_id, worker, resources, pg_key):
         self.lease_id = lease_id
@@ -198,8 +212,20 @@ class Raylet:
         self.spilled: dict[bytes, tuple[str, int]] = {}  # oid -> (path, size)
         self._spilling: set[bytes] = set()
         self._restores_inflight: dict[bytes, asyncio.Future] = {}
-        # cached cluster node table (from GCS pubsub)
+        # cached cluster node table (from GCS pubsub), plus the indexed
+        # scheduling view: per-shape candidate sets / score heaps
+        # updated incrementally from "nodes" added/removed/updated
+        # events, so spillback/spread/hybrid picks don't rescan every
+        # node view per lease decision (see sched_policy.ClusterIndex).
         self.cluster_nodes: dict[NodeID, dict] = {}
+        from ray_tpu._private.sched_policy import SchedulingPolicies
+        self.sched = SchedulingPolicies()
+        # Monotonic counter of applied "nodes" pubsub events + the
+        # counter value at which each node was last touched by one:
+        # _sync_node_views must not let a STALE snapshot override
+        # events applied inline while the snapshot was in flight.
+        self._node_event_seq = 0
+        self._node_touched: dict = {}
         self.peer_conns: dict[NodeID, protocol.Connection] = {}
         self._next_lease = 0
         self._shutdown = False
@@ -230,6 +256,14 @@ class Raylet:
         self._creating: dict[int, set[bytes]] = {}
         # resource shapes already warned about as infeasible (event dedup)
         self._infeasible_warned: set[tuple] = set()
+        # Pending-lease queue depth gauge (updated from the heartbeat
+        # loop; one process-wide metric, one series per node so the
+        # in-process multi-raylet cluster doesn't shadow itself).
+        try:
+            self._pending_gauge = _pending_leases_gauge().series(
+                {"node": self.node_id.hex()[:8]})
+        except Exception:
+            self._pending_gauge = None
 
     # -------------------------------------------------------------- startup
     async def start(self, port=0):
@@ -247,7 +281,7 @@ class Raylet:
             "labels": self.labels,
         })
         for view in reply.get("cluster_nodes", []):
-            self.cluster_nodes[view["node_id"]] = view
+            self._observe_node_view(view)
         await self.gcs.request("subscribe", {"channels": ["nodes"]})
         loop = asyncio.get_running_loop()
         loop.create_task(self._heartbeat_loop())
@@ -315,19 +349,127 @@ class Raylet:
         prepare/commit) arrive here."""
         if method == "pubsub":
             if body["channel"] == "nodes":
-                msg = body["message"]
-                if msg["event"] == "added":
-                    view = msg["node"]
-                    self.cluster_nodes[view["node_id"]] = view
-                    self._respill_pending(view)
-                elif msg["event"] == "removed":
-                    self.cluster_nodes.pop(msg["node_id"], None)
-                    self.transfers.drop_peer(msg["node_id"])
-                    conn2 = self.peer_conns.pop(msg["node_id"], None)
-                    if conn2 is not None:
-                        await conn2.close()
+                await self._on_node_event(body["message"])
+            return None
+        if method == "pubsub_batch":
+            # Coalesced broadcast: one frame carrying a same-channel
+            # run of messages, delivered in publish order.
+            if body["channel"] == "nodes":
+                for msg in protocol.pubsub_batch_messages(body):
+                    await self._on_node_event(msg)
+            return None
+        if method == "pubsub_gap":
+            # The GCS shed events we never saw (slow-subscriber
+            # bound): the node view may now have silent holes — heal
+            # by re-seeding authoritatively instead of waiting for a
+            # reconnect that may never come.
+            if "nodes" in body.get("channels", ()):
+                asyncio.get_running_loop().create_task(
+                    self._reseed_node_views())
             return None
         return await self._handle(conn, method, body)
+
+    async def _sync_node_views(self, views, hard_prune: bool,
+                               cutoff: int):
+        """Resync cluster_nodes + the scheduling index against an
+        authoritative view list.  ``hard_prune`` additionally tears
+        down data-plane state (peer conns, transfers) for absent nodes
+        — only safe when the list is known COMPLETE (get_nodes over
+        the full table).  A register reply after a non-persistent GCS
+        restart is NOT complete (it holds only nodes re-registered so
+        far), so that path soft-prunes: absent nodes stop being
+        scheduling targets, but live peer connections and in-flight
+        transfers — which don't depend on the GCS — survive until the
+        peers re-register and their views return.
+
+        ``cutoff`` is the local node-event counter captured BEFORE the
+        snapshot was requested: any node touched by a pubsub event
+        applied after that point has NEWER state than the snapshot
+        (e.g. an 'added' dispatched inline while the reply was in
+        flight) and is left alone entirely — the snapshot must never
+        prune or overwrite it."""
+        fresh = {v["node_id"] for v in views if v.get("alive", True)}
+        for nid in [n for n in self.cluster_nodes
+                    if n not in fresh and n != self.node_id
+                    and self._node_touched.get(n, 0) <= cutoff]:
+            if hard_prune:
+                await self._on_node_event({"event": "removed",
+                                           "node_id": nid})
+            else:
+                self.cluster_nodes.pop(nid, None)
+                self.sched.index.remove(nid)
+        for v in views:
+            if self._node_touched.get(v["node_id"], 0) <= cutoff:
+                self._observe_node_view(v)
+        # Entries at/below the cutoff have served their purpose.
+        self._node_touched = {k: s for k, s in self._node_touched.items()
+                              if s > cutoff}
+
+    async def _reseed_node_views(self):
+        """Authoritative node-view refresh (gap heal / post-shed):
+        fetch the FULL table, prune cached nodes no longer alive in
+        it, re-observe the rest."""
+        if self.gcs is None or self.gcs.closed:
+            return
+        cutoff = self._node_event_seq
+        try:
+            views = await self.gcs.request("get_nodes", {}, timeout=30.0)
+        except Exception:
+            return
+        await self._sync_node_views(views, hard_prune=True,
+                                    cutoff=cutoff)
+
+    def _observe_node_view(self, view: dict):
+        """Seed/replace one full node view (registration reply, added
+        event, post-reconnect re-seed) in both the legacy dict and the
+        indexed scheduling view (which never tracks this node itself).
+        Non-alive views are rejected outright: a dead node never emits
+        the "removed" event that would prune it later, so admitting it
+        would make it a permanent phantom scheduling target."""
+        if not view.get("alive", True):
+            self.cluster_nodes.pop(view["node_id"], None)
+            self.sched.index.remove(view["node_id"])
+            return
+        self.cluster_nodes[view["node_id"]] = view
+        if view["node_id"] != self.node_id:
+            self.sched.index.upsert(view)
+
+    async def _on_node_event(self, msg: dict):
+        event = msg["event"]
+        nid = msg["node"]["node_id"] if event == "added" \
+            else msg["node_id"]
+        self._node_event_seq += 1
+        self._node_touched[nid] = self._node_event_seq
+        if event == "added":
+            view = msg["node"]
+            self._observe_node_view(view)
+            self._respill_pending(view)
+        elif event == "removed":
+            self.cluster_nodes.pop(msg["node_id"], None)
+            self.sched.index.remove(msg["node_id"])
+            self.transfers.drop_peer(msg["node_id"])
+            conn2 = self.peer_conns.pop(msg["node_id"], None)
+            if conn2 is not None:
+                await conn2.close()
+        elif event == "updated":
+            # Heartbeat-delta broadcast: refresh availability/load (and
+            # the draining flag) incrementally — this is what keeps
+            # spillback/spread/hybrid decisions off stale registration
+            # snapshots without any rescan.
+            nid = msg["node_id"]
+            view = self.cluster_nodes.get(nid)
+            if view is not None:
+                if "available" in msg:
+                    view["available"] = msg["available"]
+                if "load" in msg:
+                    view["load"] = msg["load"]
+                if "draining" in msg:
+                    view["draining"] = msg["draining"]
+            if nid != self.node_id:
+                self.sched.index.update(
+                    nid, available=msg.get("available"),
+                    load=msg.get("load"),
+                    draining=msg.get("draining"))
 
     def _respill_pending(self, new_node_view):
         """A node joined: queued requests this node can NEVER satisfy but
@@ -1089,54 +1231,27 @@ class Raylet:
                 return key
         return None
 
+    # Spillback / spread / hybrid targeting now rides the composable
+    # policy chain over the incrementally-indexed cluster view
+    # (sched_policy.py): same scoring semantics as the old inline scans
+    # (parity-tested in tests/test_sched_policy.py), but a decision
+    # costs O(candidates-inspected) instead of a rescan of every node
+    # view, and spillback rotates among eligible targets instead of
+    # pile-driving the first total-fit node in view order.
+
     def _pick_spillback(self, resources):
-        for view in self.cluster_nodes.values():
-            if view["node_id"] == self.node_id:
-                continue
-            total = view.get("resources", {})
-            if all(total.get(k, 0) >= v for k, v in resources.items()):
-                return tuple(view["addr"])
-        return None
+        return self.sched.pick_spillback(resources, exclude=self.node_id)
 
     def _pick_hybrid_target(self, resources):
         """Least-utilized node with the request's resources AVAILABLE
         right now; None keeps the task queued locally."""
-        best = None
-        best_score = None
-        for view in self.cluster_nodes.values():
-            if view["node_id"] == self.node_id:
-                continue
-            avail = view.get("available", {})
-            total = view.get("resources", {})
-            if not all(avail.get(k, 0) >= v for k, v in resources.items()):
-                continue
-            # Critical-resource utilization after placing the request.
-            score = 0.0
-            for k, cap in total.items():
-                if cap <= 0:
-                    continue
-                used = cap - avail.get(k, 0) + resources.get(k, 0)
-                score = max(score, used / cap)
-            score += 0.01 * view.get("load", 0)  # backlog tiebreak
-            if best_score is None or score < best_score:
-                best, best_score = tuple(view["addr"]), score
-        return best
+        return self.sched.pick_hybrid(resources, exclude=self.node_id)
 
     def _pick_spread_target(self, resources):
         """SPREAD strategy: redirect to the least-loaded feasible node
         (reference: scheduling/policy/spread_scheduling_policy)."""
-        best = None
-        best_load = self._load()
-        for view in self.cluster_nodes.values():
-            if view["node_id"] == self.node_id:
-                continue
-            avail = view.get("available", {})
-            if not all(avail.get(k, 0) >= v for k, v in resources.items()):
-                continue
-            load = view.get("load", 0)
-            if load < best_load:
-                best, best_load = tuple(view["addr"]), load
-        return best
+        return self.sched.pick_spread(resources, self._load(),
+                                      exclude=self.node_id)
 
     def _load(self):
         return len(self.pending_leases)
@@ -1709,8 +1824,12 @@ class Raylet:
             return conn
         view = self.cluster_nodes.get(node_id)
         if view is None and self.gcs is not None:
+            # Routed through _observe_node_view: the scheduling index
+            # must learn anything this fallback discovers, and dead
+            # (alive=False) views must stay rejected — get_nodes
+            # returns the full table including the departed.
             for v in await self.gcs.request("get_nodes", {}):
-                self.cluster_nodes[v["node_id"]] = v
+                self._observe_node_view(v)
             view = self.cluster_nodes.get(node_id)
         if view is None:
             return None
@@ -2208,6 +2327,8 @@ class Raylet:
                 report = (dict(self.available), self._load(),
                           [dict(p["resources"])
                            for p in self.pending_leases[:32]])
+                if self._pending_gauge is not None:
+                    self._pending_gauge.set(len(self.pending_leases))
                 if report != last_report:
                     self._sync_version += 1
                     last_report = report
@@ -2298,6 +2419,11 @@ class Raylet:
                     name=f"raylet:{self.node_id.hex()[:8]}->gcs",
                     timeout=5.0)
                 try:
+                    # Events applied after this point are newer than
+                    # the register reply's snapshot (the implicit
+                    # subscription starts with registration) — the
+                    # sync below must not override them.
+                    cutoff = self._node_event_seq
                     reply = await conn.request("register_node",
                                                self._register_body(),
                                                timeout=10.0)
@@ -2307,8 +2433,15 @@ class Raylet:
                             await old.close()
                         except Exception:
                             pass
-                    for view in reply.get("cluster_nodes", []):
-                        self.cluster_nodes[view["node_id"]] = view
+                    # Events missed while disconnected are gone, so
+                    # cached nodes absent from the reply must stop
+                    # being scheduling targets (soft prune: the reply
+                    # may be INCOMPLETE after a non-persistent GCS
+                    # restart, so live peer conns are not torn down —
+                    # see _sync_node_views).
+                    await self._sync_node_views(
+                        reply.get("cluster_nodes", []),
+                        hard_prune=False, cutoff=cutoff)
                     await self.gcs.request("subscribe",
                                            {"channels": ["nodes"]},
                                            timeout=10.0)

@@ -1,0 +1,413 @@
+"""Composable scheduling policies over an incrementally indexed cluster view.
+
+Reference: the raylet's pluggable scheduling policies
+(src/ray/raylet/scheduling/policy/ — hybrid_scheduling_policy.h:48,
+spread_scheduling_policy, node_affinity) and the cluster resource
+manager they score against (cluster_resource_manager.h), which keeps
+per-node views updated from resource-usage broadcasts instead of
+rescanning the world per decision.
+
+Two layers:
+
+* ``ScanPolicy`` — a filter chain plus an optional scorer evaluated by
+  a full scan in node-registration order.  This is the DEFINITIONAL
+  semantics (bit-compatible with the legacy inline ``_pick_*`` loops in
+  raylet.py: earliest-registered strictly-smallest score wins), kept as
+  the parity reference and as the ``cfg.sched_indexed_view=False``
+  escape hatch.
+
+* ``ClusterIndex`` — the incremental twin.  For every resource shape a
+  decision has asked about it maintains
+
+    - ``total_fits``: node-ids whose TOTAL capacity can ever hold the
+      shape (changes only on membership / capacity change),
+    - a hybrid-score min-heap and a load min-heap of ``(score, seq,
+      node_id, ver)`` entries, pushed whenever a node delta leaves the
+      shape available-feasible on that node.
+
+  Entries are validated lazily at pick time: an entry is live iff the
+  node still exists and its version matches, and a live entry's score
+  is by construction current (scores derive only from versioned state).
+  A pick therefore pops only entries invalidated since the last pick —
+  amortized O(log n) per node delta and O(1) per decision, instead of a
+  full O(nodes) rescan per lease request.  Because the heaps order by
+  ``(score, seq)`` and a stale entry can never shadow a live one, the
+  indexed pick returns exactly the ScanPolicy answer.
+
+``SchedulingPolicies`` is the facade the raylet holds: feed it node
+views/deltas, ask it for spillback / hybrid / spread targets.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+
+def shape_key(resources: dict | None) -> tuple:
+    """Canonical hashable key for a resource demand shape."""
+    return tuple(sorted((k, float(v))
+                        for k, v in (resources or {}).items() if v))
+
+
+def fits(pool: dict, shape: tuple) -> bool:
+    return all(pool.get(k, 0) >= v for k, v in shape)
+
+
+def hybrid_score(entry: "NodeEntry", shape_map: dict) -> float:
+    """Critical-resource utilization after placing the request, plus a
+    small backlog tiebreak — identical arithmetic to the legacy
+    ``_pick_hybrid_target`` so indexed and scan picks agree bitwise."""
+    score = 0.0
+    avail = entry.avail
+    for k, cap in entry.total.items():
+        if cap <= 0:
+            continue
+        used = cap - avail.get(k, 0) + shape_map.get(k, 0)
+        s = used / cap
+        if s > score:
+            score = s
+    return score + 0.01 * entry.load
+
+
+class NodeEntry:
+    __slots__ = ("node_id", "addr", "total", "avail", "load",
+                 "draining", "seq", "ver")
+
+    def __init__(self, node_id, seq):
+        self.node_id = node_id
+        self.addr = None
+        self.total: dict = {}
+        self.avail: dict = {}
+        self.load = 0
+        self.draining = False
+        self.seq = seq   # registration order (legacy iteration order)
+        self.ver = 0     # bumped on every state change
+
+
+# --------------------------------------------------------------- filters
+# A filter is ``f(ctx, entry) -> bool``; chains are plain tuples so a
+# policy is data, not a subclass forest.
+
+def not_excluded(ctx, e):
+    return e.node_id != ctx.exclude
+
+
+def not_draining(ctx, e):
+    return not e.draining
+
+
+def fits_total(ctx, e):
+    return fits(e.total, ctx.shape)
+
+
+def fits_avail(ctx, e):
+    return fits(e.avail, ctx.shape)
+
+
+class PolicyContext:
+    __slots__ = ("shape", "shape_map", "exclude", "bound")
+
+    def __init__(self, resources, exclude=None, bound=None):
+        self.shape = shape_key(resources)
+        self.shape_map = dict(self.shape)
+        self.exclude = exclude
+        # Initial score bound: a candidate must score strictly below it
+        # (spread seeds this with the local load).
+        self.bound = bound
+
+
+class ScanPolicy:
+    """Full-scan reference policy: apply the filter chain in node
+    registration order; with a scorer, the earliest strictly-smallest
+    scoring node wins (legacy semantics), else first admitted wins."""
+
+    def __init__(self, filters, scorer=None):
+        self.filters = tuple(filters)
+        self.scorer = scorer
+
+    def pick(self, entries, ctx: PolicyContext):
+        best = None
+        best_score = ctx.bound
+        for e in entries:
+            if not all(f(ctx, e) for f in self.filters):
+                continue
+            if self.scorer is None:
+                return e
+            s = self.scorer(e, ctx.shape_map)
+            if best_score is None or s < best_score:
+                best, best_score = e, s
+        return best
+
+
+HYBRID_POLICY = ScanPolicy(
+    (not_excluded, not_draining, fits_avail),
+    scorer=hybrid_score)
+SPREAD_POLICY = ScanPolicy(
+    (not_excluded, not_draining, fits_avail),
+    scorer=lambda e, shape_map: e.load)
+# Legacy spillback admitted any total-fitting node; the chain adds the
+# dead/draining skip (the raylet's index never holds dead nodes) and
+# selection is rotated by SchedulingPolicies.pick_spillback below.
+SPILLBACK_FILTERS = (not_excluded, not_draining, fits_total)
+
+
+class _ShapeIndex:
+    __slots__ = ("shape", "shape_map", "total_fits", "hyb", "spr",
+                 "rotation", "_order")
+
+    def __init__(self, shape):
+        self.shape = shape
+        self.shape_map = dict(shape)
+        self.total_fits: dict = {}   # node_id -> seq
+        self.hyb: list = []          # (score, seq, ver, node_id)
+        self.spr: list = []          # (load,  seq, ver, node_id)
+        self.rotation = 0            # spillback round-robin cursor
+        self._order = None           # cached seq-sorted total_fits ids
+
+    def order(self):
+        if self._order is None:
+            self._order = tuple(sorted(self.total_fits,
+                                       key=self.total_fits.get))
+        return self._order
+
+
+class ClusterIndex:
+    """Incrementally-maintained per-shape candidate sets and score heaps
+    over the remote-node views (see module docstring)."""
+
+    MAX_SHAPES = 128
+
+    def __init__(self):
+        self.nodes: dict = {}               # node_id -> NodeEntry
+        self._shapes: OrderedDict = OrderedDict()  # LRU of _ShapeIndex
+        self._seq = 0
+        # Globally monotonic version stamps: a node that de-registers
+        # and comes back must never reuse a version, or a stale heap
+        # entry from its previous life could validate against it.
+        self._ver = 0
+        self.stats = {"updates": 0, "picks": 0, "scanned": 0,
+                      "heap_pushes": 0, "rebuilds": 0}
+
+    # ------------------------------------------------------------ feeding
+    def upsert(self, view: dict):
+        """Full node view (registration / re-seed after reconnect)."""
+        nid = view["node_id"]
+        e = self.nodes.get(nid)
+        if e is None:
+            e = NodeEntry(nid, self._seq)
+            self._seq += 1
+            self.nodes[nid] = e
+        e.addr = tuple(view["addr"])
+        e.total = dict(view.get("resources") or {})
+        e.avail = dict(view.get("available") or e.total)
+        e.load = view.get("load", 0)
+        e.draining = bool(view.get("draining", False))
+        self._ver += 1
+        e.ver = self._ver
+        self._reindex(e, membership=True)
+
+    def update(self, nid, available=None, load=None, draining=None):
+        """Heartbeat-delta update: only what changed travels."""
+        e = self.nodes.get(nid)
+        if e is None:
+            return False
+        if available is not None:
+            e.avail = dict(available)
+        if load is not None:
+            e.load = load
+        if draining is not None:
+            e.draining = bool(draining)
+        self._ver += 1
+        e.ver = self._ver
+        self._reindex(e, membership=False)
+        return True
+
+    def remove(self, nid):
+        e = self.nodes.pop(nid, None)
+        if e is None:
+            return
+        for si in self._shapes.values():
+            if si.total_fits.pop(nid, None) is not None:
+                si._order = None
+        # Heap entries die lazily (node lookup misses at pick time).
+
+    def entries(self):
+        """Registration-order iteration (dict insertion order == seq
+        order; removals don't disturb it) — the scan path's input."""
+        return self.nodes.values()
+
+    # ----------------------------------------------------------- indexing
+    def _reindex(self, e, membership):
+        self.stats["updates"] += 1
+        for si in self._shapes.values():
+            self._index_into(si, e, membership)
+
+    def _index_into(self, si: _ShapeIndex, e: NodeEntry, membership):
+        if membership:
+            if fits(e.total, si.shape):
+                if e.node_id not in si.total_fits:
+                    si.total_fits[e.node_id] = e.seq
+                    si._order = None
+            elif si.total_fits.pop(e.node_id, None) is not None:
+                si._order = None
+        if not e.draining and fits(e.avail, si.shape):
+            # ver (globally unique) breaks (score, seq) ties so the
+            # comparison never reaches the node-id payload.
+            heapq.heappush(si.hyb, (hybrid_score(e, si.shape_map),
+                                    e.seq, e.ver, e.node_id))
+            heapq.heappush(si.spr, (e.load, e.seq, e.ver, e.node_id))
+            self.stats["heap_pushes"] += 2
+            if len(si.hyb) > max(64, 4 * len(self.nodes)):
+                self._rebuild(si)
+
+    def _rebuild(self, si: _ShapeIndex):
+        """Compact a heap bloated by stale entries (bounded amortized
+        cost: triggered once per O(nodes) pushes)."""
+        self.stats["rebuilds"] += 1
+        si.hyb = [(hybrid_score(e, si.shape_map), e.seq, e.ver, e.node_id)
+                  for e in self.nodes.values()
+                  if not e.draining and fits(e.avail, si.shape)]
+        heapq.heapify(si.hyb)
+        si.spr = [(e.load, e.seq, e.ver, e.node_id)
+                  for e in self.nodes.values()
+                  if not e.draining and fits(e.avail, si.shape)]
+        heapq.heapify(si.spr)
+
+    def shape_index(self, resources) -> _ShapeIndex:
+        key = shape_key(resources)
+        si = self._shapes.get(key)
+        if si is None:
+            si = _ShapeIndex(key)
+            self._shapes[key] = si
+            for e in self.nodes.values():
+                self._index_into(si, e, membership=True)
+            while len(self._shapes) > self.MAX_SHAPES:
+                self._shapes.popitem(last=False)
+        else:
+            self._shapes.move_to_end(key)
+        return si
+
+    # -------------------------------------------------------------- picks
+    def _pop_best(self, heap, exclude, bound=None):
+        """Smallest live heap entry (strictly below ``bound`` if given).
+        Stale entries (version mismatch / departed node) are discarded;
+        a live entry for the excluded node is held out and re-pushed —
+        at most one live entry per node exists (one push per version)."""
+        self.stats["picks"] += 1
+        held = None
+        best = None
+        while heap:
+            score, seq, ver, nid = heap[0]
+            self.stats["scanned"] += 1
+            e = self.nodes.get(nid)
+            if e is None or e.ver != ver:
+                heapq.heappop(heap)
+                continue
+            if nid == exclude:
+                held = heapq.heappop(heap)
+                continue
+            if bound is None or score < bound:
+                best = e
+            break
+        if held is not None:
+            heapq.heappush(heap, held)
+        return best
+
+    def pick_hybrid(self, resources, exclude=None):
+        return self._pop_best(self.shape_index(resources).hyb, exclude)
+
+    def pick_spread(self, resources, bound, exclude=None):
+        return self._pop_best(self.shape_index(resources).spr, exclude,
+                              bound=bound)
+
+    def pick_spillback(self, resources, exclude=None):
+        """Rotate among nodes that can EVER hold the shape, preferring
+        one where it fits right now — so a burst of infeasible-locally
+        requests fans across eligible targets instead of piling onto
+        the first node in view order (and never lands on a draining
+        node)."""
+        si = self.shape_index(resources)
+        order = si.order()
+        n = len(order)
+        if not n:
+            return None
+        self.stats["picks"] += 1
+        start = si.rotation % n
+        chosen = None
+        fallback = None
+        for i in range(n):
+            nid = order[(start + i) % n]
+            e = self.nodes.get(nid)
+            self.stats["scanned"] += 1
+            if e is None or e.node_id == exclude or e.draining:
+                continue
+            if fallback is None:
+                fallback = (e, i)
+            if fits(e.avail, si.shape):
+                chosen = (e, i)
+                break
+        e, i = chosen or fallback or (None, 0)
+        if e is not None:
+            si.rotation = (start + i + 1) % n
+        return e
+
+
+class SchedulingPolicies:
+    """The raylet's spillback / spread / hybrid decisions.  Holds one
+    ClusterIndex fed from GCS node events; ``use_index=False`` (or
+    cfg.sched_indexed_view=False) routes picks through the full-scan
+    reference policies over the same entries instead."""
+
+    def __init__(self, index: ClusterIndex | None = None, use_index=None):
+        self.index = index or ClusterIndex()
+        self._use_index = use_index
+        # Scan-mode spillback rotation cursors (shape -> position), so
+        # the escape hatch keeps the rotate-among-eligible semantics
+        # without touching the index's shape tables.
+        self._scan_rotation: dict = {}
+
+    def _indexed(self) -> bool:
+        if self._use_index is not None:
+            return self._use_index
+        return cfg.sched_indexed_view
+
+    @staticmethod
+    def _addr(e):
+        return tuple(e.addr) if e is not None else None
+
+    def pick_hybrid(self, resources, exclude=None):
+        if self._indexed():
+            return self._addr(self.index.pick_hybrid(resources, exclude))
+        ctx = PolicyContext(resources, exclude=exclude)
+        return self._addr(HYBRID_POLICY.pick(self.index.entries(), ctx))
+
+    def pick_spread(self, resources, local_load, exclude=None):
+        if self._indexed():
+            return self._addr(self.index.pick_spread(
+                resources, bound=local_load, exclude=exclude))
+        ctx = PolicyContext(resources, exclude=exclude, bound=local_load)
+        return self._addr(SPREAD_POLICY.pick(self.index.entries(), ctx))
+
+    def pick_spillback(self, resources, exclude=None):
+        if self._indexed():
+            return self._addr(self.index.pick_spillback(resources,
+                                                        exclude))
+        # Full-scan reference path: same eligibility chain (skip
+        # excluded/draining, total must fit) and the same contract —
+        # prefer a target where the shape fits NOW, rotate among
+        # eligible — evaluated by one pass in registration order.
+        ctx = PolicyContext(resources, exclude=exclude)
+        eligible = [e for e in self.index.entries()
+                    if all(f(ctx, e) for f in SPILLBACK_FILTERS)]
+        if not eligible:
+            return None
+        start = self._scan_rotation.get(ctx.shape, 0) % len(eligible)
+        order = eligible[start:] + eligible[:start]
+        chosen = next((e for e in order if fits(e.avail, ctx.shape)),
+                      order[0])
+        self._scan_rotation[ctx.shape] = \
+            (start + order.index(chosen) + 1) % len(eligible)
+        return self._addr(chosen)

@@ -676,6 +676,26 @@ class CoreWorker:
                 pass
         return None
 
+    async def rpc_pubsub_gap(self, conn, body):
+        """The GCS shed some of this subscriber's events (slow-consumer
+        bound).  Driver-side channels (logs, actor events) are
+        best-effort streams with their own backstops, so the gap is
+        tolerated silently."""
+        return None
+
+    async def rpc_pubsub_batch(self, conn, body):
+        """Coalesced GCS pubsub: one frame carrying a same-channel run
+        of messages (publish order preserved) — fanned out to the same
+        per-channel handler as single pushes."""
+        handler = self._pubsub_handlers.get(body.get("channel"))
+        if handler is not None:
+            for message in protocol.pubsub_batch_messages(body):
+                try:
+                    handler(message)
+                except Exception:
+                    pass
+        return None
+
     # ======================================================= OWNER-SIDE API
     def put(self, value, _owner_ref=None) -> ObjectRef:
         blob, _nested = serialization.serialize(value)
