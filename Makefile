@@ -20,7 +20,7 @@ SHELL := /bin/bash
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
 	bench-quick bench-llm-quick bench-transfer bench-collective \
 	bench-collective-quick bench-control bench-control-quick \
-	chaos chaos-smoke
+	bench-serve-scale bench-serve-scale-quick chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -96,6 +96,26 @@ bench-control-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite control_plane --quick
 
+# Multi-replica serving chaos-soak: concurrent greedy streams across N
+# real replicas, then the same soak with CHAOS ARMED (replica kill
+# mid-stream, slow/faulted stream RPCs, GCS black-hole window) and a
+# per-tenant QoS leg (hot tenant floods, cold tenant stays fast).
+# Asserts zero hung streams, greedy parity across failovers, exact shed
+# accounting, and cold-tenant p99 TTFT within 2x of chaos-off.
+# Refreshes the checked-in BENCH_serve_scale.json.
+bench-serve-scale:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite serve_scale \
+		--json-out BENCH_serve_scale.json
+
+# <60 s serve-scale smoke (2 replicas, smaller soak; HEADLINE last):
+# the same hung-stream / failover-parity / shed-accounting assertions
+# as the full soak, so a serving-robustness regression fails make
+# check.  Does NOT touch the checked-in artifact.
+bench-serve-scale-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite serve_scale --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -122,6 +142,9 @@ chaos:
 		tests/test_collective.py::test_destroy_mid_op_fails_blocked_members_fast \
 		tests/test_control_plane.py::test_sigkill_gcs_restart_from_snapshot_mid_churn \
 		tests/test_control_plane.py::test_gcs_restart_mid_churn_recovers_from_snapshot \
+		tests/test_serve_scale.py::test_replica_kill_mid_stream_failover_token_identical \
+		tests/test_serve_scale.py::test_stream_interrupted_structured_when_failover_disabled \
+		tests/test_serve_scale.py::test_gcs_faults_during_serve_streams \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -140,7 +163,7 @@ chaos-smoke:
 	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
-	bench-collective-quick bench-control-quick
+	bench-collective-quick bench-control-quick bench-serve-scale-quick
 
 store: ray_tpu/_private/_shm_store.so
 

@@ -1648,12 +1648,351 @@ def control_plane_main(json_out=None, quick=False):
     return result
 
 
+def serve_scale_main(json_out=None, quick=False):
+    """Multi-replica LLM serving chaos-soak (the PR-10 acceptance run).
+
+    Drives concurrent greedy token streams through real serve replicas
+    (controller + router + replica actors + engines) and measures
+    tokens/sec and TTFT/ITL p50/p99 vs replica count; then re-runs the
+    top replica count with CHAOS ARMED — a replica killed mid-soak,
+    slow/faulted streaming RPCs (serve.stream_next failpoint), and a
+    black-holed GCS window (worker.gcs_request failpoint) — asserting
+    ZERO hung streams (every stream finishes, sheds, or interrupts
+    structured within its deadline) and greedy parity for every stream
+    that reports success.  A per-tenant QoS leg floods a hot tenant
+    against a paced cold tenant, chaos off and on, and checks the shed
+    accounting is exact and the cold tenant's p99 TTFT stays within 2x
+    of its chaos-off value.  Deterministic under RT_CHAOS_SEED (the
+    failpoint schedule replays; kill timing is load-driven)."""
+    import asyncio
+    import os
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import failpoints
+    from ray_tpu.models import decode, gpt
+    from ray_tpu.serve.exceptions import (StreamInterrupted,
+                                          TenantThrottled)
+    from ray_tpu.serve.llm.api import llm_deployment
+    from ray_tpu.serve._private.qos import (TENANT_SHED_COUNTER,
+                                            TenantQoS)
+    from ray_tpu.serve._private import router as router_mod
+
+    cfg = gpt.GPTConfig(vocab_size=97, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32, remat=False, use_flash=False)
+
+    def loader():
+        return gpt.init_params(cfg, jax.random.PRNGKey(0)), cfg
+
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    engine_kw = dict(num_slots=4, max_seq=48, prefill_chunk=8,
+                     max_queue_len=256, kv_commit_factor=16.0)
+    replica_counts = [1, 2] if quick else [1, 2, 4]
+    max_new = 12 if quick else 20
+    streams_per_replica = 24 if quick else 64
+    window_per_replica = 12   # concurrently active streams per replica
+    stream_deadline_s = 90 if quick else 180
+
+    prompts = {s: [int(t) for t in np.asarray(jax.random.randint(
+        jax.random.PRNGKey(s), (6 + s,), 1, cfg.vocab_size))]
+        for s in range(4)}
+    oracles = {s: [int(t) for t in np.asarray(decode.generate(
+        params, jnp.asarray([p]), cfg, max_new_tokens=max_new)[0])]
+        for s, p in prompts.items()}
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    controller = serve.start()
+
+    # One private asyncio loop hosts every driver-side router (same
+    # shape as DeploymentHandle's shared router loop).
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, name="bench-router",
+                     daemon=True).start()
+
+    def on_loop(coro, timeout=600):
+        import concurrent.futures
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(
+            timeout)
+
+    def make_router(name, qos=None):
+        async def _make():
+            return router_mod.Router(controller, name, loop=loop,
+                                     qos=qos)
+        return on_loop(_make())
+
+    def counter_total(counter):
+        return sum(counter.snapshot()["values"].values())
+
+    async def drive(rset, n_streams, window, tenant=None, paced_s=0.0,
+                    kill_when=None):
+        """Run n_streams streams (<= window concurrently active);
+        returns per-stream records.  kill_when=(frac, fn) fires fn once
+        after frac*n_streams streams have seen first tokens."""
+        sem = asyncio.Semaphore(window)
+        first_tokens = [0]
+        records = []
+
+        async def one(i):
+            sid = i % len(prompts)
+            rec = {"seed": sid, "ttft": None, "itl": [], "tokens": [],
+                   "outcome": "ok"}
+            t0 = time.monotonic()
+            try:
+                async def consume():
+                    ait = await rset.assign_replica_stream(
+                        "stream", (prompts[sid],),
+                        {"max_new_tokens": max_new}, tenant=tenant)
+                    last = t0
+                    async for tok in ait:
+                        now = time.monotonic()
+                        if rec["ttft"] is None:
+                            rec["ttft"] = now - t0
+                            first_tokens[0] += 1
+                        else:
+                            rec["itl"].append(now - last)
+                        last = now
+                        rec["tokens"].append(int(tok))
+                await asyncio.wait_for(consume(), stream_deadline_s)
+            except asyncio.TimeoutError:
+                rec["outcome"] = "hung"
+            except StreamInterrupted:
+                rec["outcome"] = "interrupted"
+            except TenantThrottled:
+                rec["outcome"] = "shed"
+            except Exception as e:
+                rec["outcome"] = f"error:{type(e).__name__}"
+            return rec
+
+        async def gated(i):
+            async with sem:
+                if paced_s:
+                    await asyncio.sleep(paced_s)
+                return await one(i)
+
+        tasks = [asyncio.ensure_future(gated(i))
+                 for i in range(n_streams)]
+        if kill_when is not None:
+            frac, fn = kill_when
+            while first_tokens[0] < frac * n_streams \
+                    and not all(t.done() for t in tasks):
+                await asyncio.sleep(0.02)
+            await asyncio.get_running_loop().run_in_executor(None, fn)
+        records.extend(await asyncio.gather(*tasks))
+        return records
+
+    def summarize(records, wall_s):
+        ok = [r for r in records if r["outcome"] == "ok"]
+        ttfts = [r["ttft"] for r in ok if r["ttft"] is not None]
+        itls = [x for r in ok for x in r["itl"]]
+        toks = sum(len(r["tokens"]) for r in records)
+        outcomes = {}
+        for r in records:
+            outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+        parity_ok = all(r["tokens"] == oracles[r["seed"]] for r in ok)
+        prefix_ok = all(
+            r["tokens"] == oracles[r["seed"]][:len(r["tokens"])]
+            for r in records if r["outcome"] != "ok")
+        return {"streams": len(records), "outcomes": outcomes,
+                "tokens_per_sec": round(toks / max(wall_s, 1e-9), 1),
+                "ttft_p50_s": round(_pct(ttfts, 0.5) or 0, 4),
+                "ttft_p99_s": round(_pct(ttfts, 0.99) or 0, 4),
+                "itl_p50_s": round(_pct(itls, 0.5) or 0, 4),
+                "itl_p99_s": round(_pct(itls, 0.99) or 0, 4),
+                "greedy_parity_ok": parity_ok,
+                "interrupted_prefix_ok": prefix_ok,
+                "wall_s": round(wall_s, 2)}
+
+    detail = {"model": {"d_model": cfg.d_model,
+                        "n_layers": cfg.n_layers,
+                        "vocab": cfg.vocab_size},
+              "engine": engine_kw, "max_new_tokens": max_new,
+              "chaos_seed": int(os.environ.get("RT_CHAOS_SEED", "0")
+                                or 0),
+              "quick": bool(quick), "scaling": [],
+              "note": ("replica scaling is CPU-core-bound on this "
+                       "container (all replica engines share the "
+                       "host's few cores), so tokens/sec is ~flat vs "
+                       "replica count; the soak's subject is the "
+                       "ROBUSTNESS contract — zero hung streams, "
+                       "greedy parity across failovers, exact shed "
+                       "accounting, bounded cold-tenant p99")}
+
+    # ---- Leg 1: clean scaling curve over replica counts -------------
+    routers = {}
+    for nrep in replica_counts:
+        name = f"soak{nrep}"
+        llm_deployment(loader, name=name, num_replicas=nrep,
+                       engine_config=dict(engine_kw)).deploy()
+        routers[name] = make_router(name)
+        n = streams_per_replica * nrep
+        t0 = time.monotonic()
+        recs = on_loop(drive(routers[name].replica_set, n,
+                             window_per_replica * nrep))
+        s = summarize(recs, time.monotonic() - t0)
+        s["replicas"] = nrep
+        assert s["outcomes"].get("hung", 0) == 0, s
+        assert s["greedy_parity_ok"], "clean-run parity violated"
+        detail["scaling"].append(s)
+        print(f"  replicas={nrep}: {s['tokens_per_sec']} tok/s "
+              f"ttft p50/p99 {s['ttft_p50_s']}/{s['ttft_p99_s']}s "
+              f"outcomes={s['outcomes']}")
+        if nrep != replica_counts[-1]:
+            routers[name].stop()
+            serve.delete(name)
+
+    # ---- Leg 2: the chaos soak at the top replica count -------------
+    top = replica_counts[-1]
+    name = f"soak{top}"
+    rset = routers[name].replica_set
+
+    def chaos_kill():
+        # Kill the busiest replica mid-soak (controller will replace
+        # it; in-flight streams must fail over).
+        infos = sorted(rset._replicas,
+                       key=lambda r: -rset._in_flight.get(
+                           r["replica_tag"], 0))
+        if infos:
+            ray_tpu.kill(infos[0]["actor"])
+
+    fo0 = counter_total(router_mod.FAILOVER_COUNTER)
+    int0 = counter_total(router_mod.INTERRUPTED_COUNTER)
+    failpoints.configure(
+        # slow links on the streaming RPC leg + a flaky tail, and a
+        # GCS black-hole window (bounded; heals mid-soak).
+        "serve.stream_next=delay(40)|p=0.08;"
+        "serve.stream_next=disconnect|p=0.01;"
+        "worker.gcs_request=error|times=40")
+    try:
+        n = streams_per_replica * top
+        t0 = time.monotonic()
+        recs = on_loop(drive(rset, n, window_per_replica * top,
+                             kill_when=(0.25, chaos_kill)))
+        chaos = summarize(recs, time.monotonic() - t0)
+    finally:
+        failpoints.configure("")
+    chaos["replicas"] = top
+    chaos["failovers"] = int(counter_total(
+        router_mod.FAILOVER_COUNTER) - fo0)
+    chaos["interruptions"] = int(counter_total(
+        router_mod.INTERRUPTED_COUNTER) - int0)
+    clean_top = detail["scaling"][-1]
+    chaos["ttft_p99_vs_clean"] = round(
+        chaos["ttft_p99_s"] / max(clean_top["ttft_p99_s"], 1e-9), 2)
+    assert chaos["outcomes"].get("hung", 0) == 0, \
+        f"chaos soak hung streams: {chaos}"
+    assert chaos["greedy_parity_ok"], \
+        "chaos-run parity violated on successful streams"
+    assert chaos["interrupted_prefix_ok"], \
+        "an interrupted stream delivered non-prefix tokens"
+    detail["chaos"] = chaos
+    print(f"  chaos@{top}r: {chaos['tokens_per_sec']} tok/s "
+          f"failovers={chaos['failovers']} "
+          f"outcomes={chaos['outcomes']}")
+
+    # ---- Leg 3: per-tenant QoS — hot floods, cold stays fast --------
+    def qos_leg(label, with_chaos):
+        qos = TenantQoS(rate=30.0, burst=6.0, max_queued=12,
+                        weights={"cold": 4.0, "hot": 1.0})
+        qr = make_router(name, qos=qos)
+        shed_metric0 = counter_total(TENANT_SHED_COUNTER)
+        if with_chaos:
+            failpoints.configure("serve.stream_next=delay(40)|p=0.08")
+        try:
+            async def both():
+                hot_n = 40 if quick else 96
+                hot = asyncio.ensure_future(drive(
+                    qr.replica_set, hot_n, hot_n, tenant="hot"))
+                cold = asyncio.ensure_future(drive(
+                    qr.replica_set, 10, 1, tenant="cold",
+                    paced_s=0.25))
+                if with_chaos:
+                    await asyncio.sleep(0.5)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, chaos_kill)
+                return await hot, await cold
+            t0 = time.monotonic()
+            hot_recs, cold_recs = on_loop(both())
+            wall = time.monotonic() - t0
+        finally:
+            if with_chaos:
+                failpoints.configure("")
+            qr.stop()
+        sheds = sum(r["outcome"] == "shed" for r in hot_recs
+                    ) + sum(r["outcome"] == "shed" for r in cold_recs)
+        out = {"hot": summarize(hot_recs, wall),
+               "cold": summarize(cold_recs, wall),
+               "sheds_observed": sheds,
+               "sheds_counted": qos.shed_total,
+               "shed_metric_delta": int(
+                   counter_total(TENANT_SHED_COUNTER) - shed_metric0)}
+        assert out["cold"]["outcomes"].get("shed", 0) == 0, \
+            f"cold tenant was shed: {out['cold']}"
+        assert sheds == qos.shed_total == out["shed_metric_delta"], out
+        assert out["hot"]["outcomes"].get("hung", 0) == 0
+        assert out["cold"]["outcomes"].get("hung", 0) == 0
+        print(f"  qos[{label}]: hot sheds={sheds} cold ttft p99="
+              f"{out['cold']['ttft_p99_s']}s")
+        return out
+
+    qos_off = qos_leg("chaos_off", False)
+    qos_on = qos_leg("chaos_on", True)
+    # Ratio over a 50 ms floor: the chaos-off cold p99 on this tiny
+    # model is single-digit ms, below the armed slow-link jitter
+    # itself — without the floor one injected 40 ms delay reads as a
+    # "6x regression".  Queue-scale degradation (the thing tenant
+    # isolation must prevent) still trips the 2x bound.
+    _floor = 0.05
+    ratio = (max(qos_on["cold"]["ttft_p99_s"], _floor)
+             / max(qos_off["cold"]["ttft_p99_s"], _floor))
+    detail["qos"] = {"chaos_off": qos_off, "chaos_on": qos_on,
+                     "cold_ttft_p99_floor_s": _floor,
+                     "cold_ttft_p99_ratio_chaos": round(ratio, 2)}
+    assert ratio <= 2.0, \
+        f"cold-tenant p99 TTFT degraded {ratio:.2f}x under chaos (>2x)"
+    assert qos_on["cold"]["ttft_p99_s"] < 2.0, \
+        "cold-tenant p99 TTFT not bounded under chaos"
+
+    routers[name].stop()
+    serve.delete(name)
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    top_clean = detail["scaling"][-1]
+    result = {"metric": "serve_scale_tokens_per_sec",
+              "value": top_clean["tokens_per_sec"],
+              "unit": "tokens/sec", "detail": detail}
+    line = json.dumps(result)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+    # Compact summary LAST (same artifact-tail rationale as main()).
+    print("HEADLINE serve_scale tokens/s="
+          + _fmt_headline(top_clean["tokens_per_sec"])
+          + f"@{top_clean['replicas']}r"
+          + " ttft_p99_s=" + _fmt_headline(top_clean["ttft_p99_s"], 3)
+          + " chaos_tokens/s=" + _fmt_headline(
+              detail["chaos"]["tokens_per_sec"])
+          + " failovers=" + _fmt_headline(detail["chaos"]["failovers"])
+          + " hung=0"
+          + " cold_p99_ratio=" + _fmt_headline(
+              detail["qos"]["cold_ttft_p99_ratio_chaos"], 2))
+    return result
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="train",
                     choices=["train", "serve_llm", "transfer",
-                             "collective", "control_plane"])
+                             "collective", "control_plane",
+                             "serve_scale"])
     ap.add_argument("--json-out", default=None,
                     help="also write the JSON line to this path "
                          "(serve_llm/transfer default to their "
@@ -1678,5 +2017,10 @@ if __name__ == "__main__":
                            else (cli.json_out
                                  or "BENCH_control_plane.json"),
                            quick=cli.quick)
+    elif cli.suite == "serve_scale":
+        serve_scale_main(cli.json_out if cli.quick
+                         else (cli.json_out
+                               or "BENCH_serve_scale.json"),
+                         quick=cli.quick)
     else:
         main()

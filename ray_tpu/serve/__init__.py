@@ -37,16 +37,22 @@ from ray_tpu.serve.handle import (  # noqa: F401
     RayServeHandle,
     ServeResponseStream,
 )
+from ray_tpu.serve.exceptions import (  # noqa: F401
+    StreamInterrupted,
+    TenantThrottled,
+    resumable,
+)
 from ray_tpu.serve._private.replica import Request  # noqa: F401
 
 __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "HTTPOptions", "RayServeHandle", "ReplicaContext",
-    "Request", "ServeResponseStream",
+    "Request", "ServeResponseStream", "StreamInterrupted",
+    "TenantThrottled",
     "batch", "build", "delete", "deployment", "get_deployment",
     "get_deployment_handle", "get_proxy_address", "get_proxy_addresses",
-    "get_replica_context", "ingress", "list_deployments", "run",
-    "shutdown", "start", "status",
+    "get_replica_context", "ingress", "list_deployments", "resumable",
+    "run", "shutdown", "start", "status",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

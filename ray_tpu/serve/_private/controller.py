@@ -30,6 +30,34 @@ class _AutoscaleState:
     def __init__(self):
         self.over_since: Optional[float] = None
         self.under_since: Optional[float] = None
+        self.ewma: Optional[float] = None
+        self.last_decision_t: float = -1e18
+
+
+def _replica_load(metrics: Dict, target_per_replica: float) -> float:
+    """One replica's demand in units of 'replicas worth of work'.
+
+    The base signal is ongoing/target (the reference autoscaling
+    policy); engine-backed replicas publish REAL saturation gauges and
+    the max over them wins, so a replica whose slot pool or KV pool is
+    the binding constraint holds its share of capacity even when the
+    raw request count looks tame:
+
+      * (active_slots + queue_depth) / num_slots — decode-slot pressure
+        including the engine's own waiting line;
+      * 1 - kv_blocks_free/kv_blocks_total — KV page pressure.
+    """
+    load = metrics.get("ongoing", 0) / max(target_per_replica, 1e-9)
+    num_slots = metrics.get("num_slots") or 0
+    if num_slots > 0:
+        load = max(load, (metrics.get("active_slots", 0)
+                          + metrics.get("queue_depth", 0)) / num_slots)
+    kv_total = metrics.get("kv_blocks_total") or 0
+    if kv_total > 0:
+        load = max(load,
+                   1.0 - metrics.get("kv_blocks_free", kv_total)
+                   / kv_total)
+    return load
 
 
 class ServeController:
@@ -165,6 +193,12 @@ class ServeController:
             await asyncio.sleep(CONTROL_LOOP_PERIOD_S)
 
     def _autoscale_tick(self):
+        """Scale targets from the replicas' REAL saturation gauges
+        (ongoing requests always; engine queue depth / slot occupancy /
+        KV free pages where published), with three layers of flap
+        suppression so chaos-induced gauge noise cannot thrash replica
+        counts: an EWMA over the load signal, the sustained
+        over/under delays, and a post-decision cooldown window."""
         now = time.monotonic()
         for status in self._dsm.statuses():
             name = status["name"]
@@ -177,34 +211,49 @@ class ServeController:
             running = [r for r in ds.replicas if r.state == RUNNING]
             if not running:
                 continue
-            total = 0
+            total_load = 0.0
+            samples = 0
             for r in running:
-                n = r.num_ongoing()
-                if n is not None:
-                    total += n
-            desired = math.ceil(
-                total / max(ac.target_num_ongoing_requests_per_replica,
-                            1e-9) * ac.smoothing_factor)
-            desired = min(max(desired, ac.min_replicas), ac.max_replicas)
+                m = r.poll_load(now)  # non-blocking, cached
+                if m is None:
+                    continue
+                samples += 1
+                total_load += _replica_load(
+                    m, ac.target_num_ongoing_requests_per_replica)
+            if samples == 0:
+                continue  # no gauge data yet; never scale blind
             st = self._autoscale.setdefault(name, _AutoscaleState())
+            alpha = min(max(ac.load_ewma_alpha, 0.0), 1.0)
+            if st.ewma is None or alpha >= 1.0:
+                st.ewma = total_load
+            else:
+                st.ewma = alpha * total_load + (1 - alpha) * st.ewma
+            desired = math.ceil(st.ewma * ac.smoothing_factor)
+            desired = min(max(desired, ac.min_replicas), ac.max_replicas)
             cur = ds.target_num_replicas
+            in_cooldown = (now - st.last_decision_t
+                           < ac.decision_cooldown_s)
             if desired > cur:
                 st.under_since = None
                 if st.over_since is None:
                     st.over_since = now
-                if now - st.over_since >= ac.upscale_delay_s:
-                    logger.info("autoscale %s: %d -> %d (ongoing=%d)",
-                                name, cur, desired, total)
+                if now - st.over_since >= ac.upscale_delay_s \
+                        and not in_cooldown:
+                    logger.info("autoscale %s: %d -> %d (load=%.2f)",
+                                name, cur, desired, st.ewma)
                     ds.set_target_num_replicas(desired)
                     st.over_since = None
+                    st.last_decision_t = now
             elif desired < cur:
                 st.over_since = None
                 if st.under_since is None:
                     st.under_since = now
-                if now - st.under_since >= ac.downscale_delay_s:
-                    logger.info("autoscale %s: %d -> %d (ongoing=%d)",
-                                name, cur, desired, total)
+                if now - st.under_since >= ac.downscale_delay_s \
+                        and not in_cooldown:
+                    logger.info("autoscale %s: %d -> %d (load=%.2f)",
+                                name, cur, desired, st.ewma)
                     ds.set_target_num_replicas(desired)
                     st.under_since = None
+                    st.last_decision_t = now
             else:
                 st.over_since = st.under_since = None

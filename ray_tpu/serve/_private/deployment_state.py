@@ -17,12 +17,20 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
+from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
 STARTING = "STARTING"
 RUNNING = "RUNNING"
+DRAINING = "DRAINING"
 STOPPING = "STOPPING"
+
+DRAINING_GAUGE = _metrics.Gauge(
+    "serve_replica_draining",
+    "Replicas draining (no new admissions, finishing in-flight work "
+    "before retirement)",
+    tag_keys=("deployment",))
 
 
 class ReplicaWrapper:
@@ -111,6 +119,73 @@ class ReplicaWrapper:
                                timeout=2)
         except Exception:
             return None
+
+    _load_ref = None
+    _load_sent_at = 0.0
+    last_load: Optional[Dict] = None
+
+    def poll_load(self, now: float) -> Optional[Dict]:
+        """Non-blocking load tracking (the autoscaler's input): fire a
+        get_autoscale_metrics probe, collect it on a later tick, and
+        always answer from the cached last sample — one hung replica
+        must never stall the control loop the way a blocking get
+        would."""
+        if self._actor is None:
+            return self.last_load
+        if self._load_ref is None:
+            self._load_ref = \
+                self._actor.get_autoscale_metrics.remote()
+            self._load_sent_at = now
+            return self.last_load
+        done, _ = ray_tpu.wait([self._load_ref], num_returns=1,
+                               timeout=0)
+        if done:
+            try:
+                self.last_load = ray_tpu.get(self._load_ref, timeout=1)
+            except Exception:
+                pass  # keep the previous sample; health checks judge
+            self._load_ref = None
+        elif now - self._load_sent_at > 10.0:
+            self._load_ref = None  # probe lost; re-fire next tick
+        return self.last_load
+
+    _drain_deadline = 0.0
+    _drain_started = 0.0
+
+    def begin_drain(self, now: float, timeout_s: float):
+        """Scale-down path: stop admitting (the reconciler's broadcast
+        only carries RUNNING replicas, so routers drop this one on the
+        next long-poll) and let in-flight work — including long-lived
+        streams — finish before the actor is retired."""
+        self.state = DRAINING
+        self._drain_started = now
+        self._drain_deadline = now + timeout_s
+        # Demand a FRESH ongoing sample before declaring the drain
+        # complete: the pre-drain cached value predates the routers
+        # learning this replica left the broadcast — and an in-flight
+        # probe fired pre-drain would repopulate it, so drop that too.
+        self.last_load = None
+        self._load_ref = None
+
+    def confirmed_idle(self, now: float) -> bool:
+        """A FRESH post-drain sample confirms zero in-flight work.  The
+        ≥1s age floor covers the window in which a router that has not
+        yet seen the membership change can still assign work — the ONE
+        idle-confirmation rule, shared by drain completion and the
+        un-drain gate (both would oversubscribe on a stale sample)."""
+        load = self.poll_load(now)
+        return (now - self._drain_started >= 1.0
+                and load is not None and load.get("ongoing", 1) == 0)
+
+    def drain_complete(self, now: float) -> bool:
+        """True once the replica reports zero in-flight requests (or
+        the drain deadline passed — a wedged stream must not pin a
+        retired replica forever)."""
+        if now >= self._drain_deadline:
+            logger.warning("replica %s drain timed out; stopping with "
+                           "work in flight", self.replica_tag)
+            return True
+        return self.confirmed_idle(now)
 
     _health_ref = None
     _health_sent_at = 0.0
@@ -210,6 +285,13 @@ class DeploymentState:
             elif r.state == STOPPING:
                 if r.check_stopped():
                     self.replicas.remove(r)
+            elif r.state == DRAINING:
+                # A delete arriving mid-drain downgrades the drain to a
+                # plain graceful stop — teardown must not wait out the
+                # (much longer) drain window.
+                if self.deleting \
+                        or r.drain_complete(time.monotonic()):
+                    r.begin_stop(cfg.graceful_shutdown_timeout_s)
 
         running = [r for r in self.replicas if r.state == RUNNING]
         starting = [r for r in self.replicas if r.state == STARTING]
@@ -220,9 +302,29 @@ class DeploymentState:
         stale = [r for r in running if r.version != self.target_version]
         fresh = [r for r in running + starting
                  if r.version == self.target_version]
-        # Start new-version replicas up to the target count.
+        # Start new-version replicas up to the target count — but first
+        # UN-DRAIN: a same-version replica mid-drain still has a warm
+        # model resident; re-admitting it is strictly cheaper than
+        # paying a cold start because the autoscaler flapped.
         want_new = 0 if self.deploy_failed \
             else self.target_num_replicas - len(fresh)
+        if want_new > 0:
+            now_ud = time.monotonic()
+            for r in self.replicas:
+                if want_new <= 0:
+                    break
+                if r.state == DRAINING \
+                        and r.version == self.target_version:
+                    # Only un-drain a replica CONFIRMED idle: routers
+                    # reset a re-broadcast replica's in-flight count to
+                    # zero, so re-admitting one with live streams would
+                    # oversubscribe it past max_concurrent_queries.
+                    if not r.confirmed_idle(now_ud):
+                        continue
+                    logger.info("un-draining replica %s (target rose "
+                                "back)", r.replica_tag)
+                    r.state = RUNNING
+                    want_new -= 1
         for _ in range(max(0, want_new)):
             r = ReplicaWrapper(self.name, self.target_version, cfg,
                                self.target_replica_config)
@@ -238,19 +340,39 @@ class DeploymentState:
         for r in stale[:allow_stop]:
             r.begin_stop(cfg.graceful_shutdown_timeout_s)
 
-        # 3. Scale down surplus same-version replicas.
+        # 3. Scale down surplus same-version replicas: DRAIN, don't
+        # kill — the replica leaves the router broadcast immediately
+        # (no new admissions) but finishes its in-flight requests and
+        # streams before retirement.  Least-loaded replicas drain
+        # first so the fewest streams ride out a drain window.
+        now = time.monotonic()
         fresh_running = [r for r in self.replicas
                          if r.state == RUNNING
                          and r.version == self.target_version]
         excess = len(fresh_running) - self.target_num_replicas
-        for r in fresh_running[:max(0, excess)]:
-            r.begin_stop(cfg.graceful_shutdown_timeout_s)
+        if excess > 0:
+            if self.deleting:
+                # Deployment deletion: the owner asked for it to go —
+                # graceful stop (bounded by graceful_shutdown_timeout_s)
+                # rather than a long admission-less drain.
+                for r in fresh_running[:excess]:
+                    r.begin_stop(cfg.graceful_shutdown_timeout_s)
+            else:
+                def _load_key(r):
+                    load = r.poll_load(now)
+                    return load.get("ongoing", 0) if load else 0
+                for r in sorted(fresh_running, key=_load_key)[:excess]:
+                    r.begin_drain(now, cfg.drain_timeout_s)
 
         # 4. Health checks on running replicas (periodic, non-blocking).
         now = time.monotonic()
         if now - self._last_health_check > cfg.health_check_period_s:
             self._last_health_check = now
-            for r in [x for x in self.replicas if x.state == RUNNING]:
+            # DRAINING replicas are health-checked too: one that DIES
+            # mid-drain must be reaped now, not after the full drain
+            # timeout expires against a corpse.
+            for r in [x for x in self.replicas
+                      if x.state in (RUNNING, DRAINING)]:
                 if not r.poll_health(now):
                     logger.warning("replica %s unhealthy; replacing",
                                    r.replica_tag)
@@ -259,7 +381,11 @@ class DeploymentState:
                     if r in self.replicas:
                         self.replicas.remove(r)
 
-        # 5. Broadcast the running-replica set on change.
+        # 5. Broadcast the running-replica set on change (a DRAINING
+        # replica's exclusion here IS the "stop admitting" edge).
+        DRAINING_GAUGE.set(
+            sum(r.state == DRAINING for r in self.replicas),
+            tags={"deployment": self.name})
         infos = [r.running_info() for r in self.replicas
                  if r.state == RUNNING]
         fingerprint = sorted((i["replica_tag"], i["version"])
@@ -282,6 +408,7 @@ class DeploymentState:
         healthy = (not self.deleting
                    and by_state.get(RUNNING, 0) == self.target_num_replicas
                    and by_state.get(STARTING, 0) == 0
+                   and by_state.get(DRAINING, 0) == 0
                    and by_state.get(STOPPING, 0) == 0)
         status = "HEALTHY" if healthy else \
             ("DELETING" if self.deleting else "UPDATING")

@@ -17,8 +17,19 @@ from typing import Dict, Optional
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve._private.replica import Request
 from ray_tpu.serve._private.router import ReplicaSet
+from ray_tpu.serve.exceptions import StreamInterrupted, TenantThrottled
 
 logger = logging.getLogger(__name__)
+
+
+def _throttle_response(e: TenantThrottled):
+    """TenantThrottled -> structured 429: overload is an immediate,
+    retryable signal at the wire (Retry-After from the token bucket),
+    never queue inflation."""
+    retry = str(max(1, int(e.retry_after_s + 0.999)))
+    body = _json.dumps({"error": str(e), "tenant": e.tenant,
+                        "reason": e.reason}).encode()
+    return 429, body, "application/json", [("Retry-After", retry)]
 
 
 class HTTPProxy:
@@ -59,6 +70,19 @@ class HTTPProxy:
         return self._replica_sets[deployment], rest
 
     @staticmethod
+    def tenant_of(query: Dict[str, str],
+                  headers: Dict[str, str]) -> Optional[str]:
+        """Tenant key for QoS admission: the `x-tenant` header or the
+        `tenant` query param; None (→ the "default" bucket) when the
+        client names neither."""
+        t = next((v for k, v in (headers or {}).items()
+                  if k.lower() == "x-tenant"), None)
+        if t:
+            return str(t)
+        t = (query or {}).get("tenant")
+        return str(t) if t else None
+
+    @staticmethod
     def wants_stream(query: Dict[str, str],
                      headers: Dict[str, str]) -> bool:
         """A request opts into SSE with Accept: text/event-stream or
@@ -85,18 +109,22 @@ class HTTPProxy:
         must not break non-streaming deployments or error statuses."""
         matched = self._match_route(path)
         if matched is None:
-            return 404, f"no route for {path!r}".encode(), "text/plain"
+            return (404, f"no route for {path!r}".encode(),
+                    "text/plain", [])
         rs, rest = matched
         req = Request(method=method, path=rest,
                       query=query, body=body, headers=headers)
         try:
             aiter = await rs.assign_replica_stream(
-                "", (req,), {}, unary_fallback=True)
+                "", (req,), {}, unary_fallback=True,
+                tenant=self.tenant_of(query, headers))
+        except TenantThrottled as e:
+            return _throttle_response(e)
         except Exception as e:
             logger.exception("stream request to %s failed",
                              rs.deployment_name)
-            return 500, repr(e).encode(), "text/plain"
-        return 200, aiter, None
+            return 500, repr(e).encode(), "text/plain", []
+        return 200, aiter, None, []
 
     @staticmethod
     def format_result(result):
@@ -140,7 +168,10 @@ class HTTPProxy:
         req = Request(method=method, path=rest,
                       query=query, body=body, headers=headers)
         try:
-            result = await rs.assign_replica("", (req,), {})
+            result = await rs.assign_replica(
+                "", (req,), {}, tenant=self.tenant_of(query, headers))
+        except TenantThrottled as e:
+            return _throttle_response(e)
         except Exception as e:
             logger.exception("request to %s failed", rs.deployment_name)
             return 500, repr(e).encode(), "text/plain"
@@ -244,17 +275,38 @@ class HTTPProxyActor:
         from aiohttp import web
 
         from ray_tpu.serve._private.router import _UnaryResult
-        status, payload, ctype = await self._proxy.handle_stream(
+        status, payload, ctype, hdrs = await self._proxy.handle_stream(
             request.method, request.path, query, body, headers_in)
         if status != 200:
             return web.Response(status=status, body=payload,
-                                content_type=ctype.split(";")[0])
+                                content_type=ctype.split(";")[0],
+                                headers=hdrs or [])
         aiter = payload
         _empty = object()  # distinguishes "no items" from a None item
         try:
             first = await aiter.__anext__()
         except StopAsyncIteration:
             first = _empty
+        except TenantThrottled as e:
+            # QoS admission runs at slot acquisition (inside the
+            # stream's first step): a shed BEFORE any item is a real
+            # 429 at the wire, not a 200 with an error event.
+            await aiter.aclose()
+            status, payload, ctype, hdrs = _throttle_response(e)
+            return web.Response(status=status, body=payload,
+                                content_type=ctype.split(";")[0],
+                                headers=hdrs)
+        except StreamInterrupted as e:
+            # Zero items were delivered and failover could not place
+            # the stream: retryable server-side failure.
+            await aiter.aclose()
+            return web.Response(
+                status=503,
+                body=_json.dumps({"error": str(e),
+                                  "resume_cursor": e.resume_cursor}
+                                 ).encode(),
+                content_type="application/json",
+                headers=[("Retry-After", "1")])
         except Exception as e:
             logger.exception("stream failed before first item")
             await aiter.aclose()
@@ -290,6 +342,21 @@ class HTTPProxyActor:
             # Client went away: closing the iterator cancels the
             # replica-side stream (and frees its engine slot).
             pass
+        except StreamInterrupted as e:
+            # Mid-stream interruption after failover ran out: the
+            # response status is already committed, so the contract is
+            # a STRUCTURED terminal error event carrying the resume
+            # cursor (delivered-item count) — the client knows exactly
+            # what it has and can re-submit the remainder.
+            try:
+                await resp.write(
+                    b"event: error\ndata: "
+                    + _json.dumps({"error": "stream_interrupted",
+                                   "message": str(e),
+                                   "resume_cursor": e.resume_cursor}
+                                  ).encode() + b"\n\n")
+            except Exception:
+                pass
         except Exception as e:
             try:
                 await resp.write(

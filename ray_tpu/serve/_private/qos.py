@@ -1,0 +1,187 @@
+"""Per-tenant QoS at the router: token-bucket admission, weighted fair
+queueing, and load shedding.
+
+Reference posture (PAPER.md L10 serve controller/router): one hot tenant
+must not inflate every other tenant's p99.  Three mechanisms, all at the
+admission edge (BEFORE a request occupies replica capacity):
+
+  * token bucket per tenant — sustained rate `rate` tokens/s with
+    `burst` headroom; an empty bucket sheds the request immediately
+    with :class:`TenantThrottled` ("rate_limited") + a Retry-After
+    hint, instead of letting it queue;
+  * per-tenant queue cap — a tenant may hold at most `max_queued`
+    waiters in the router's line; past that, "queue_full" shed (the
+    hot tenant's own backlog, not a shared one);
+  * weighted fair queueing — when replicas saturate, waiting requests
+    are dispatched by start-time fair queueing over per-tenant virtual
+    finish tags, so a tenant with weight w gets ~w/(Σweights) of the
+    freed slots no matter how deep any single tenant's backlog is.
+
+Shedding is accounted in `serve_tenant_shed_total` so the soak bench
+can assert sheds == rejections observed at the client.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from ray_tpu.serve.exceptions import TenantThrottled
+from ray_tpu.util import metrics as _metrics
+
+TENANT_SHED_COUNTER = _metrics.Counter(
+    "serve_tenant_shed_total",
+    "Requests shed by per-tenant QoS admission (rate_limited|queue_full)",
+    tag_keys=("deployment", "tenant", "reason"))
+
+DEFAULT_TENANT = "default"
+
+
+class _Bucket:
+    __slots__ = ("tokens", "last")
+
+    def __init__(self, burst: float, now: float):
+        self.tokens = burst
+        self.last = now
+
+
+class TenantQoS:
+    """Admission policy state for ONE deployment's router.
+
+    Single-owner discipline: every method runs on the owning router's
+    event loop (admission, WFQ tags, dispatch accounting), so no lock
+    is needed.  `rate == 0` disables the token bucket while keeping
+    WFQ + queue caps active."""
+
+    def __init__(self, *, rate: float = 0.0,
+                 burst: Optional[float] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 max_queued: int = 128,
+                 default_weight: float = 1.0):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None
+                           else max(1.0, self.rate))
+        self.weights = dict(weights or {})
+        self.max_queued = int(max_queued)
+        self.default_weight = float(default_weight)
+        self._buckets: Dict[str, _Bucket] = {}
+        # Start-time fair queueing state: a global virtual clock plus
+        # each tenant's last-issued finish tag.
+        self._vclock = 0.0
+        self._finish: Dict[str, float] = {}
+        self.shed_total = 0  # local tally (bench cross-checks the metric)
+
+    @classmethod
+    def from_env(cls) -> Optional["TenantQoS"]:
+        """Build the process-default QoS policy from RT_SERVE_* env
+        knobs; returns None (QoS off — the router keeps its legacy
+        admission path) unless explicitly enabled via RT_SERVE_QOS=1 or
+        implied by a nonzero RT_SERVE_TENANT_RATE / a weight table."""
+        if os.environ.get("RT_SERVE_QOS", "") == "0":
+            return None
+        rate = float(os.environ.get("RT_SERVE_TENANT_RATE", "0") or 0)
+        weights_spec = os.environ.get("RT_SERVE_TENANT_WEIGHTS", "")
+        enabled = (os.environ.get("RT_SERVE_QOS", "") == "1"
+                   or rate > 0 or bool(weights_spec))
+        if not enabled:
+            return None
+        weights: Dict[str, float] = {}
+        for part in weights_spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition(":")
+            try:
+                weights[name.strip()] = float(w)
+            except ValueError:
+                continue
+        burst_env = os.environ.get("RT_SERVE_TENANT_BURST", "")
+        return cls(
+            rate=rate,
+            burst=float(burst_env) if burst_env else None,
+            weights=weights,
+            max_queued=int(os.environ.get(
+                "RT_SERVE_TENANT_MAX_QUEUED", "128")))
+
+    def weight(self, tenant: str) -> float:
+        w = self.weights.get(tenant, self.default_weight)
+        return w if w > 0 else self.default_weight
+
+    # The tenant key is CLIENT-SUPPLIED (x-tenant header), so per-tenant
+    # state must not grow without bound under unique-key abuse: past
+    # this size, admit() opportunistically drops entries idle long
+    # enough that rebuilding them is lossless (a full bucket refills to
+    # full; an idle finish tag re-enters at the virtual clock anyway).
+    PRUNE_ABOVE = 1024
+    PRUNE_IDLE_S = 60.0
+
+    def _maybe_prune(self, now: float):
+        if len(self._buckets) > self.PRUNE_ABOVE:
+            # Prune only entries whose TRUE refill has already reached
+            # full burst — recreating those at full is lossless.  An
+            # idle-but-still-refilling bucket (low rate, high burst)
+            # must be kept, or eviction would hand the tenant its full
+            # burst back early.
+            self._buckets = {
+                t: b for t, b in self._buckets.items()
+                if now - b.last < self.PRUNE_IDLE_S
+                or b.tokens + (now - b.last) * self.rate < self.burst}
+        if len(self._finish) > self.PRUNE_ABOVE:
+            self._finish = {t: f for t, f in self._finish.items()
+                            if f > self._vclock}
+
+    # ------------------------------------------------------- admission
+    def admit(self, deployment: str, tenant: str, queued_now: int):
+        """Gate one request at the router's edge; raises
+        :class:`TenantThrottled` (after counting the shed) instead of
+        letting an over-budget tenant join the line."""
+        self._maybe_prune(time.monotonic())
+        if queued_now >= self.max_queued:
+            self._shed(deployment, tenant, "queue_full")
+            raise TenantThrottled(
+                f"tenant {tenant!r} has {queued_now} requests waiting "
+                f"(cap {self.max_queued}); shedding instead of queueing",
+                tenant=tenant, reason="queue_full",
+                retry_after_s=1.0)
+        if self.rate <= 0:
+            return
+        now = time.monotonic()
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = _Bucket(self.burst, now)
+        b.tokens = min(self.burst, b.tokens + (now - b.last) * self.rate)
+        b.last = now
+        if b.tokens >= 1.0:
+            b.tokens -= 1.0
+            return
+        retry = (1.0 - b.tokens) / self.rate
+        self._shed(deployment, tenant, "rate_limited")
+        raise TenantThrottled(
+            f"tenant {tenant!r} over its {self.rate:g} req/s budget "
+            f"(burst {self.burst:g}); retry in {retry:.2f}s",
+            tenant=tenant, reason="rate_limited",
+            retry_after_s=retry)
+
+    def _shed(self, deployment: str, tenant: str, reason: str):
+        self.shed_total += 1
+        TENANT_SHED_COUNTER.inc(tags={"deployment": deployment,
+                                      "tenant": tenant,
+                                      "reason": reason})
+
+    # ---------------------------------------------- weighted fairness
+    def start_tag(self, tenant: str) -> float:
+        """Finish tag for a newly queued waiter: tenants are serviced
+        in ascending tag order, and a tenant's tags advance 1/weight
+        per request — the start-time fair queueing discipline."""
+        f = max(self._vclock, self._finish.get(tenant, 0.0)) \
+            + 1.0 / self.weight(tenant)
+        self._finish[tenant] = f
+        return f
+
+    def dispatched(self, tag: float):
+        """Advance the virtual clock past the dispatched waiter's tag
+        (idle tenants re-enter at the current clock, not at zero, so
+        sleeping does not bank unbounded credit)."""
+        if tag > self._vclock:
+            self._vclock = tag

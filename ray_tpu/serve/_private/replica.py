@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import os
 import pickle
 import time
 from typing import Any, Dict, Optional
@@ -169,16 +170,38 @@ class RTServeReplica:
         return target
 
     async def handle_request_streaming(self, method_name: str,
-                                       args: tuple, kwargs: dict) -> Dict:
+                                       args: tuple, kwargs: dict,
+                                       resume: Optional[Dict] = None
+                                       ) -> Dict:
         """Start a streaming query.  If the target produces an async
         generator (an `async def ... yield` method, or a coroutine
-        returning an async iterable) -> {"stream_id": sid} to poll with
-        stream_next.  Otherwise the call has ALREADY run to completion
-        and its value rides back as {"unary": result} — one invocation
-        either way, so the caller (proxy) can fall back to a normal
-        response without re-running side effects."""
+        returning an async iterable) -> {"stream_id": sid, "resumable":
+        bool} to poll with stream_next.  Otherwise the call has ALREADY
+        run to completion and its value rides back as {"unary": result}
+        — one invocation either way, so the caller (proxy) can fall
+        back to a normal response without re-running side effects.
+
+        `resume` is the router's failover cursor ({"delivered": n,
+        "items": [...]}): targets marked serve.resumable receive it as
+        the `_resume` keyword and must yield only what comes AFTER the
+        delivered prefix."""
         self._sweep_stale_streams()
+        self._ensure_stream_sweeper()
         target = self._resolve_target(method_name)
+        resumable = bool(getattr(target, "__serve_resumable__", False))
+        if not resumable:
+            # Proxy path resolves a callable INSTANCE (method_name ""),
+            # so the marker lives on its __call__, not on the instance.
+            resumable = bool(getattr(
+                getattr(target, "__call__", None),
+                "__serve_resumable__", False))
+        if resume is not None:
+            if not resumable:
+                raise TypeError(
+                    f"{self.deployment_name}.{method_name or '__call__'}"
+                    " is not resumable (mark it with @serve.resumable "
+                    "to accept a failover cursor)")
+            kwargs = {**kwargs, "_resume": resume}
         if inspect.isasyncgenfunction(target):
             ait = target(*args, **kwargs)
         else:
@@ -206,14 +229,36 @@ class RTServeReplica:
         self._num_ongoing += 1  # the slot stays held while streaming
         state["task"] = asyncio.get_running_loop().create_task(
             self._pump_stream(stream_id, ait.__aiter__()))
-        return {"stream_id": stream_id}
+        return {"stream_id": stream_id, "resumable": resumable}
 
     # A consumer that vanishes (handle process killed, or a cancel RPC
     # lost in flight) stops polling without ever sending stream_cancel;
-    # its buffered tokens would otherwise sit in _streams forever.  Any
-    # stream unpolled for this long is torn down at the next streaming
-    # admission.
-    STREAM_IDLE_TTL_S = 300.0
+    # its buffered tokens would otherwise sit in _streams forever — and,
+    # worse, the underlying generator would keep producing into a dead
+    # buffer (an engine request burning KV pages and decode slots).
+    # Any stream unpolled for this long is torn down, both at the next
+    # streaming admission and by a periodic sweeper, and the teardown
+    # AWAITS the pump task so the generator's finally runs (the engine
+    # request is cancelled, its pages/slots reclaimed).
+    STREAM_IDLE_TTL_S = float(os.environ.get("RT_SERVE_STREAM_TTL_S",
+                                             "300"))
+    STREAM_SWEEP_PERIOD_S = float(os.environ.get(
+        "RT_SERVE_STREAM_SWEEP_S", "30"))
+
+    _sweep_task = None
+
+    def _ensure_stream_sweeper(self):
+        """Periodic sweep: a replica whose streaming consumers all
+        vanished sees no further admissions, so sweeping only on
+        admission would leak the abandoned engine requests forever."""
+        if self._sweep_task is None or self._sweep_task.done():
+            self._sweep_task = asyncio.get_running_loop().create_task(
+                self._sweep_loop())
+
+    async def _sweep_loop(self):
+        while True:
+            await asyncio.sleep(self.STREAM_SWEEP_PERIOD_S)
+            self._sweep_stale_streams()
 
     def _sweep_stale_streams(self):
         now = time.monotonic()
@@ -226,6 +271,18 @@ class RTServeReplica:
             task = state["task"]
             if task is not None and not task.done():
                 task.cancel()
+                # Reap in the background: awaiting confirms the user
+                # generator unwound (its finally cancels the engine
+                # request, freeing KV pages + the decode slot) instead
+                # of trusting a fire-and-forget cancel.
+                asyncio.get_running_loop().create_task(self._reap(task))
+
+    @staticmethod
+    async def _reap(task):
+        try:
+            await task
+        except BaseException:
+            pass
 
     async def _drive_sync_generator(self, gen):
         """Adapt a sync generator to async: each next() runs on the
@@ -328,6 +385,24 @@ class RTServeReplica:
 
     def num_ongoing_requests(self) -> int:
         return self._num_ongoing
+
+    def get_autoscale_metrics(self) -> Dict:
+        """Load signals for the controller's autoscaler: the in-flight
+        count always, plus whatever the deployment itself publishes via
+        an `autoscale_metrics()` method (the LLM engine exposes queue
+        depth, slot occupancy, and KV free pages this way) — the
+        controller scales on REAL saturation gauges, not just the
+        request count."""
+        out: Dict[str, Any] = {"ongoing": self._num_ongoing}
+        am = getattr(self.callable, "autoscale_metrics", None)
+        if am is not None:
+            try:
+                extra = am()
+                if isinstance(extra, dict):
+                    out.update(extra)
+            except Exception:
+                pass  # a broken gauge must not break autoscaling
+        return out
 
     async def prepare_for_shutdown(self, timeout_s: float = 10.0):
         """Drain: wait for in-flight requests to finish (reference:

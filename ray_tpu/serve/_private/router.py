@@ -5,25 +5,53 @@ ReplicaSet.assign_replica (:222): pick a replica with a free slot
 (in-flight < max_concurrent_queries); if all are saturated, queue the
 query until one frees.  Replica membership arrives via long poll.
 
+Robustness layer (the multi-replica serving contract):
+
+  * STREAM FAILOVER — every stream records resumable state (deployment,
+    args, items delivered).  When the serving replica dies mid-stream
+    the router re-submits on a healthy replica: resumable deployments
+    (serve.resumable) get the delivered prefix passed back so only the
+    REMAINING items are produced (greedy parity preserved; the prefix
+    cache makes re-prefill cheap), non-resumable streams restart only
+    if zero items were delivered.  Anything else fails fast with a
+    structured StreamInterrupted carrying a resume cursor — never a
+    silent hang (every stream RPC is deadline-bounded).
+  * UNARY RETRY — a replica that dies before its first response is
+    retried once on a DIFFERENT replica (zero bytes were delivered, so
+    the retry is prefix-safe) instead of surfacing a raw
+    ActorDiedError.
+  * PER-TENANT QoS — with a TenantQoS policy installed, admission runs
+    a per-tenant token bucket + queue cap (overload sheds with
+    TenantThrottled → HTTP 429) and saturated-capacity waiting is
+    weighted-fair across tenants instead of a free-for-all.
+
 Saturation is observable: queue depth and in-flight counts are exported
 as util.metrics gauges (serve_router_queue_depth / serve_router_in_flight
 / serve_replica_in_flight) so a saturated deployment shows up next to
-the engine metrics instead of manifesting only as latency.
+the engine metrics instead of manifesting only as latency; failovers and
+interruptions count in serve_stream_failovers_total /
+serve_stream_interrupted_total.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
+import os
 import random
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Deque, Dict, List, Optional
 
+from ray_tpu._private import failpoints
 from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.serve._private.qos import DEFAULT_TENANT, TenantQoS
+from ray_tpu.serve.exceptions import StreamInterrupted
 from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
 _worker_mod = None
+_death_errs = None
 
 
 def _core_worker():
@@ -34,6 +62,20 @@ def _core_worker():
         from ray_tpu._private import worker as worker_mod
         _worker_mod = worker_mod
     return _worker_mod.global_worker
+
+
+def _death_errors() -> tuple:
+    """Exception types that mean THE REPLICA is gone (vs the request
+    failing inside healthy user code): actor death/unavailability and
+    transport loss.  Resolved lazily to dodge import cycles."""
+    global _death_errs
+    if _death_errs is None:
+        from ray_tpu import exceptions as rexc
+        from ray_tpu._private import protocol
+        _death_errs = (rexc.ActorDiedError, rexc.ActorUnavailableError,
+                       protocol.ConnectionLost)
+    return _death_errs
+
 
 QUEUE_DEPTH_GAUGE = _metrics.Gauge(
     "serve_router_queue_depth",
@@ -47,6 +89,22 @@ REPLICA_IN_FLIGHT_GAUGE = _metrics.Gauge(
     "serve_replica_in_flight",
     "Queries this process's router has in flight per replica",
     tag_keys=("deployment", "replica"))
+FAILOVER_COUNTER = _metrics.Counter(
+    "serve_stream_failovers_total",
+    "Streams re-submitted on a healthy replica after their replica died",
+    tag_keys=("deployment",))
+INTERRUPTED_COUNTER = _metrics.Counter(
+    "serve_stream_interrupted_total",
+    "Streams that failed structured (StreamInterrupted) after replica "
+    "death with failover unavailable",
+    tag_keys=("deployment",))
+UNARY_RETRY_COUNTER = _metrics.Counter(
+    "serve_unary_retries_total",
+    "Unary calls retried on a different replica after actor death "
+    "before first response",
+    tag_keys=("deployment",))
+
+_QOS_FROM_ENV = "__env__"
 
 
 class _UnaryResult:
@@ -61,6 +119,19 @@ class _UnaryResult:
         self.value = value
 
 
+class _Waiter:
+    """One queued acquisition under QoS: resolved with the chosen
+    replica info dict by the WFQ dispatcher."""
+
+    __slots__ = ("fut", "tenant", "exclude", "tag")
+
+    def __init__(self, fut, tenant: str, exclude: tuple, tag: float):
+        self.fut = fut
+        self.tenant = tenant
+        self.exclude = exclude
+        self.tag = tag
+
+
 class ReplicaSet:
     """The live replicas of one deployment, with in-flight accounting.
 
@@ -71,7 +142,8 @@ class ReplicaSet:
     fast path (no per-call coroutine on the IO loop, reply deserialized
     on this router's own thread)."""
 
-    def __init__(self, deployment_name: str, loop):
+    def __init__(self, deployment_name: str, loop,
+                 qos: Any = _QOS_FROM_ENV):
         self.deployment_name = deployment_name
         self._loop = loop
         self._replicas: List[Dict] = []
@@ -84,6 +156,20 @@ class ReplicaSet:
             {"deployment": deployment_name})
         self._g_replica: Dict[str, object] = {}
         self._num_in_flight = 0
+        self._qos: Optional[TenantQoS] = (
+            TenantQoS.from_env() if qos is _QOS_FROM_ENV else qos)
+        self._waiters: Dict[str, Deque[_Waiter]] = {}
+        env = os.environ.get
+        self._stream_failover = env("RT_SERVE_STREAM_FAILOVER",
+                                    "1") != "0"
+        self._max_failovers = int(env("RT_SERVE_STREAM_MAX_FAILOVERS",
+                                      "2"))
+        self._unary_retry = env("RT_SERVE_UNARY_RETRY", "1") != "0"
+        self._stream_poll_timeout = float(
+            env("RT_SERVE_STREAM_POLL_TIMEOUT_S", "60"))
+        self._suppress_ttl = float(
+            env("RT_SERVE_REPLICA_SUPPRESS_S", "10"))
+        self._suppressed: Dict[str, float] = {}
 
     def _replica_series(self, tag: str):
         s = self._g_replica.get(tag)
@@ -105,6 +191,25 @@ class ReplicaSet:
         self._num_in_flight = sum(self._in_flight.values())
         self._g_in_flight.set(self._num_in_flight)
         self._slot_freed.set()  # membership change may free capacity
+        self._dispatch_waiters()
+
+    def _drop_replica(self, tag: str):
+        """Suppress a replica the router just observed dying so no new
+        work lands on it during the window before the controller's
+        membership broadcast confirms the death.  Suppression is a
+        bounded TTL, not removal: the long-poll only re-delivers
+        membership when the controller's fingerprint CHANGES, so
+        removing a replica the controller still considers RUNNING
+        (death mis-classified — a transient stall or injected fault)
+        would shrink this router's capacity forever.  A really-dead
+        replica leaves the broadcast within the health-check period,
+        well inside the TTL renewal from its next failed call."""
+        self._suppressed[tag] = \
+            asyncio.get_event_loop().time() + self._suppress_ttl
+        logger.warning(
+            "replica %s of %s suppressed in local view for %.0fs "
+            "(died mid-call); awaiting controller broadcast",
+            tag, self.deployment_name, self._suppress_ttl)
 
     def _set_queued(self, delta: int):
         self.num_queued += delta
@@ -116,16 +221,38 @@ class ReplicaSet:
         self._g_in_flight.set(self._num_in_flight)
         self._replica_series(tag).set(n)
 
-    async def _acquire(self, timeout_s: float) -> Dict:
+    def _release(self, tag: str):
+        """Give back one in-flight unit and wake whoever is waiting for
+        capacity (the legacy event loop AND the QoS dispatcher).
+        Floor at zero: a replica that left and re-entered the broadcast
+        (drain -> un-drain) had its count reset while old streams still
+        held slots; their releases must not drive the count negative
+        and mint phantom capacity forever."""
+        if self._in_flight.get(tag, 0) > 0:
+            self._track_in_flight(tag, -1)
+        self._slot_freed.set()
+        self._dispatch_waiters()
+
+    # -------------------------------------------------- slot acquisition
+    async def _acquire(self, timeout_s: float, tenant: str = None,
+                       exclude: tuple = (), admit: bool = True) -> Dict:
         """Wait (bounded) for a replica with a free slot; the caller owns
         one in-flight unit on the returned replica and must release it
-        via _track_in_flight(tag, -1)."""
+        via _release(tag).  With a QoS policy installed, admission runs
+        the per-tenant token bucket + queue cap and waiting is
+        weighted-fair across tenants.  `admit=False` skips the
+        admission gate (WFQ ordering still applies): retries and
+        failovers of an ALREADY-ADMITTED request must neither burn a
+        second bucket token nor convert a replica death into a 429."""
+        if self._qos is not None:
+            return await self._acquire_qos(timeout_s, tenant, exclude,
+                                           admit)
         import time as _time
         deadline = _time.monotonic() + timeout_s
         self._set_queued(+1)
         try:
             while True:
-                choice = self._pick()
+                choice = self._pick(exclude)
                 if choice is not None:
                     break
                 remain = deadline - _time.monotonic()
@@ -144,44 +271,213 @@ class ReplicaSet:
         self._track_in_flight(choice["replica_tag"], +1)
         return choice
 
+    async def _acquire_qos(self, timeout_s: float, tenant: str,
+                           exclude: tuple, admit: bool = True) -> Dict:
+        tenant = tenant or DEFAULT_TENANT
+        dq = self._waiters.get(tenant)
+        if dq:
+            while dq and dq[0].fut.done():
+                dq.popleft()
+        if admit:
+            # Count only LIVE waiters toward the cap: a timed-out/
+            # cancelled waiter stranded mid-deque (behind a live head)
+            # must not shed new requests with a phantom queue_full.
+            queued_now = sum(1 for x in dq
+                             if not x.fut.done()) if dq else 0
+            self._qos.admit(self.deployment_name, tenant, queued_now)
+        loop = asyncio.get_running_loop()
+        w = _Waiter(loop.create_future(), tenant, tuple(exclude or ()),
+                    self._qos.start_tag(tenant))
+        self._waiters.setdefault(
+            tenant, collections.deque()).append(w)
+        self._set_queued(+1)
+        loop_time = loop.time
+        deadline = loop_time() + timeout_s
+        try:
+            self._dispatch_waiters()
+            while True:
+                remain = deadline - loop_time()
+                if remain <= 0:
+                    self._abandon_waiter(w)
+                    raise RuntimeError(
+                        f"no available replica for deployment "
+                        f"{self.deployment_name!r} within {timeout_s}s")
+                try:
+                    # Shielded sub-waits (<=5s): the periodic wake
+                    # re-runs the dispatcher because capacity can
+                    # reappear WITHOUT any release/broadcast event —
+                    # e.g. a replica's death-suppression TTL expiring.
+                    return await asyncio.wait_for(
+                        asyncio.shield(w.fut), min(remain, 5.0))
+                except asyncio.TimeoutError:
+                    self._dispatch_waiters()
+                except BaseException:
+                    # Caller cancelled / generator closed (GeneratorExit
+                    # reaches here too) — propagate, but never leave a
+                    # live waiter behind for the dispatcher to hand a
+                    # slot nobody will consume, and never leak a slot
+                    # assigned in the race.
+                    self._abandon_waiter(w)
+                    raise
+        finally:
+            self._set_queued(-1)
+
+    def _abandon_waiter(self, w: "_Waiter"):
+        """A waiter whose wait died (deadline, cancellation, generator
+        close) may ALREADY have been handed a slot by the dispatcher in
+        the same loop tick — hand it straight back instead of leaking
+        it against max_concurrent_queries forever.  A still-pending
+        waiter is cancelled so the dispatcher prunes it instead of
+        assigning a slot nobody will consume."""
+        if w.fut.done() and not w.fut.cancelled() \
+                and w.fut.exception() is None:
+            self._release(w.fut.result()["replica_tag"])
+        elif not w.fut.done():
+            w.fut.cancel()
+
+    def _dispatch_waiters(self):
+        """Match queued waiters to free replica slots in WFQ order
+        (smallest virtual finish tag first).  Runs on the router loop
+        whenever capacity may have appeared."""
+        if self._qos is None or not self._waiters:
+            return
+        while True:
+            heads: List[_Waiter] = []
+            for tenant in list(self._waiters):
+                dq = self._waiters[tenant]
+                while dq and dq[0].fut.done():
+                    dq.popleft()
+                if not dq:
+                    del self._waiters[tenant]
+                else:
+                    heads.append(dq[0])
+            if not heads:
+                return
+            heads.sort(key=lambda x: x.tag)
+            placed = False
+            for w in heads:
+                choice = self._pick(w.exclude)
+                if choice is None:
+                    continue  # only excluded replicas free; try others
+                dq = self._waiters.get(w.tenant)
+                dq.popleft()
+                if not dq:
+                    del self._waiters[w.tenant]
+                self._qos.dispatched(w.tag)
+                self._track_in_flight(choice["replica_tag"], +1)
+                w.fut.set_result(choice)
+                placed = True
+                break
+            if not placed:
+                return
+
+    # ------------------------------------------------------- stream RPCs
+    async def _stream_rpc(self, ref):
+        """Await one streaming-transport RPC with a bounded deadline:
+        a reply that outlives the bound (replica wedged behind a
+        partition the keepalive hasn't condemned yet) is classified as
+        replica unavailability, so the stream fails over or interrupts
+        structured instead of hanging."""
+        fut = asyncio.wrap_future(ref.future())
+        if self._stream_poll_timeout <= 0:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut,
+                                          self._stream_poll_timeout)
+        except asyncio.TimeoutError:
+            from ray_tpu import exceptions as rexc
+            raise rexc.ActorUnavailableError(
+                None, f"stream RPC gave no reply within "
+                      f"{self._stream_poll_timeout}s") from None
+
+    @staticmethod
+    def _check_stream_failpoint():
+        """`serve.stream_next` failpoint: deterministic chaos on the
+        router→replica streaming leg (delay = slow link; error/
+        disconnect = transport loss, which exercises the failover
+        path)."""
+        if not failpoints.ACTIVE:
+            return None
+        act = failpoints.check("serve.stream_next")
+        if act is None:
+            return None
+        if act.kind == "delay":
+            return act.delay_s
+        from ray_tpu import exceptions as rexc
+        raise rexc.ActorUnavailableError(
+            None, f"failpoint: injected stream_next {act.kind}")
+
     async def assign_replica(self, method_name: str, args: tuple,
                              kwargs: dict,
-                             timeout_s: float = 120.0) -> Any:
+                             timeout_s: float = 120.0,
+                             tenant: str = None) -> Any:
         """Pick a replica (power-of-two-choices among free ones), send the
         query, and release the slot when it completes.  Bounded: a request
         that can't be assigned within timeout_s (no replicas — deployment
-        deleted or all crashed) errors instead of hanging forever."""
-        choice = await self._acquire(timeout_s)
-        tag = choice["replica_tag"]
-        try:
-            actor = choice["actor"]
-            ref = actor.handle_request.remote(method_name, args, kwargs)
-            # Fast path: wait on the owned entry's ready-future (fired
-            # straight from the reply handler — no per-call coroutine on
-            # the CoreWorker loop) and deserialize HERE, on the router's
-            # thread.  In-store/borrowed replies fall back to the full
-            # get() path, which also rides the IO loop safely from any
-            # thread (the router often runs on its own loop).
-            w = _core_worker()
-            ready_future = getattr(w, "ready_future", None)
-            if ready_future is None:  # e.g. local-mode worker
-                return await asyncio.wrap_future(ref.future())
-            fut = ready_future(ref)
-            if not fut.done():
-                await asyncio.wrap_future(fut)
-            ok, value = w.try_take_local_value(ref)
-            if ok:
-                return value
+        deleted or all crashed) errors instead of hanging forever.  A
+        replica that dies before its first response is retried ONCE on a
+        different replica (zero bytes were delivered, so re-running is
+        prefix-safe) instead of leaking a raw ActorDiedError.  NB this
+        makes unary serve calls at-least-once across replica death —
+        the replica may have executed before the connection died (same
+        trade the task layer makes across restarts); deployments with
+        non-idempotent side effects can opt out via
+        RT_SERVE_UNARY_RETRY=0."""
+        exclude: tuple = ()
+        attempt = 0
+        while True:
+            choice = await self._acquire(timeout_s, tenant=tenant,
+                                         exclude=exclude,
+                                         admit=attempt == 0)
+            tag = choice["replica_tag"]
+            try:
+                try:
+                    return await self._call_unary(choice, method_name,
+                                                  args, kwargs)
+                except _death_errors() as e:
+                    self._drop_replica(tag)
+                    if attempt == 0 and self._unary_retry:
+                        attempt = 1
+                        exclude = (tag,)
+                        UNARY_RETRY_COUNTER.inc(
+                            tags={"deployment": self.deployment_name})
+                        logger.warning(
+                            "replica %s died before replying to %s.%s; "
+                            "retrying once on a different replica (%s)",
+                            tag, self.deployment_name,
+                            method_name or "__call__", e)
+                        continue
+                    raise
+            finally:
+                self._release(tag)
+
+    async def _call_unary(self, choice: Dict, method_name: str,
+                          args: tuple, kwargs: dict) -> Any:
+        actor = choice["actor"]
+        ref = actor.handle_request.remote(method_name, args, kwargs)
+        # Fast path: wait on the owned entry's ready-future (fired
+        # straight from the reply handler — no per-call coroutine on
+        # the CoreWorker loop) and deserialize HERE, on the router's
+        # thread.  In-store/borrowed replies fall back to the full
+        # get() path, which also rides the IO loop safely from any
+        # thread (the router often runs on its own loop).
+        w = _core_worker()
+        ready_future = getattr(w, "ready_future", None)
+        if ready_future is None:  # e.g. local-mode worker
             return await asyncio.wrap_future(ref.future())
-        finally:
-            if tag in self._in_flight:
-                self._track_in_flight(tag, -1)
-            self._slot_freed.set()
+        fut = ready_future(ref)
+        if not fut.done():
+            await asyncio.wrap_future(fut)
+        ok, value = w.try_take_local_value(ref)
+        if ok:
+            return value
+        return await asyncio.wrap_future(ref.future())
 
     async def assign_replica_stream(self, method_name: str, args: tuple,
                                     kwargs: dict,
                                     timeout_s: float = 120.0,
-                                    unary_fallback: bool = False
+                                    unary_fallback: bool = False,
+                                    tenant: str = None
                                     ) -> AsyncIterator:
         """Streaming twin of assign_replica: starts a generator-valued
         call on one replica and returns an async iterator over its
@@ -189,6 +485,15 @@ class ReplicaSet:
         the stream (a generating request occupies engine capacity, so it
         must count against max_concurrent_queries the whole time);
         closing the iterator early cancels the remote stream.
+
+        Failure contract: if the serving replica dies mid-stream the
+        router fails the stream OVER to a healthy replica — resumable
+        targets (serve.resumable) receive the delivered prefix and
+        continue from the cursor; non-resumable targets restart only if
+        nothing was delivered yet.  When failover is off/exhausted/
+        unsafe the consumer gets a structured StreamInterrupted with
+        the resume cursor, within the stream-RPC deadline — never a
+        silent hang, and never a duplicated item.
 
         A target that turns out NOT to stream ran exactly once on the
         replica; with unary_fallback the iterator yields its value
@@ -202,54 +507,157 @@ class ReplicaSet:
             # before its first iteration never starts this body, and an
             # unstarted generator's finally never runs, so acquiring
             # out here would leak the in-flight slot forever.
-            choice = await self._acquire(timeout_s)
-            tag = choice["replica_tag"]
-            actor = choice["actor"]
-            finished = False
-            stream_id = None
-            try:
-                started = await asyncio.wrap_future(
-                    actor.handle_request_streaming.remote(
-                        method_name, args, kwargs).future())
-                if "stream_id" not in started:
-                    finished = True
-                    if not unary_fallback:
-                        raise TypeError(
-                            f"{self.deployment_name}."
-                            f"{method_name or '__call__'} returned a "
-                            "non-streaming result; use handle.remote() "
-                            "for unary calls")
-                    yield _UnaryResult(started["unary"])
-                    return
-                stream_id = started["stream_id"]
-                cursor = 0
-                while True:
-                    out = await asyncio.wrap_future(
-                        actor.stream_next.remote(stream_id,
-                                                 cursor).future())
-                    for item in out["items"]:
-                        yield item
-                    cursor += len(out["items"])
-                    if out["done"]:
-                        finished = True
-                        if out.get("error") is not None:
-                            raise out["error"]
-                        return
-            finally:
-                if stream_id is not None and not finished:
-                    # Early close / client gone: free the replica-side
-                    # stream (and whatever slot it holds in an engine).
-                    actor.stream_cancel.options(num_returns=0).remote(
-                        stream_id)
-                if tag in self._in_flight:
-                    self._track_in_flight(tag, -1)
-                self._slot_freed.set()
+            delivered_n = 0
+            # Items retained ONLY while a resume could still replay
+            # them (resumable target, failover budget left) — a
+            # long-lived non-resumable SSE stream must not mirror hours
+            # of items in router memory for nothing.
+            delivered: List[Any] = []
+            exclude: tuple = ()
+            failovers = 0
+            resumable = False
+            while True:
+                try:
+                    choice = await self._acquire(timeout_s,
+                                                 tenant=tenant,
+                                                 exclude=exclude,
+                                                 admit=failovers == 0)
+                except Exception as e:
+                    if failovers == 0:
+                        raise
+                    # Failover could not even PLACE the stream (no
+                    # replica within the deadline): the contract is
+                    # still a structured cursor, not a raw assignment
+                    # error.
+                    INTERRUPTED_COUNTER.inc(
+                        tags={"deployment": self.deployment_name})
+                    raise StreamInterrupted(
+                        f"stream on {self.deployment_name}."
+                        f"{method_name or '__call__'} interrupted "
+                        f"after {delivered_n} items (failover could "
+                        f"not place the stream: {e})",
+                        deployment=self.deployment_name,
+                        method=method_name, delivered=delivered_n,
+                        resumable=resumable, cause=repr(e)) from e
+                tag = choice["replica_tag"]
+                actor = choice["actor"]
+                finished = False
+                stream_id = None
+                try:
+                    try:
+                        resume_state = None
+                        if delivered_n:
+                            resume_state = {"delivered": delivered_n,
+                                            "items": list(delivered)}
+                        started = await self._stream_rpc(
+                            actor.handle_request_streaming.remote(
+                                method_name, args, kwargs,
+                                resume_state))
+                        if "stream_id" not in started:
+                            finished = True
+                            if not unary_fallback:
+                                raise TypeError(
+                                    f"{self.deployment_name}."
+                                    f"{method_name or '__call__'} "
+                                    "returned a non-streaming result; "
+                                    "use handle.remote() for unary "
+                                    "calls")
+                            yield _UnaryResult(started["unary"])
+                            return
+                        stream_id = started["stream_id"]
+                        resumable = bool(started.get("resumable"))
+                        keep_prefix = (self._stream_failover
+                                       and resumable
+                                       and failovers
+                                       < self._max_failovers)
+                        if not keep_prefix:
+                            delivered = []
+                        cursor = 0
+                        while True:
+                            delay = self._check_stream_failpoint()
+                            if delay:
+                                await asyncio.sleep(delay)
+                            out = await self._stream_rpc(
+                                actor.stream_next.remote(stream_id,
+                                                         cursor))
+                            for item in out["items"]:
+                                delivered_n += 1
+                                if keep_prefix:
+                                    delivered.append(item)
+                                yield item
+                            cursor += len(out["items"])
+                            if out["done"]:
+                                finished = True
+                                if out.get("error") is not None:
+                                    raise out["error"]
+                                return
+                    except _death_errors() as e:
+                        # Leave `finished` False: if the failure was a
+                        # transport/injected fault and the replica is
+                        # actually alive, the finally's fire-and-forget
+                        # stream_cancel stops it generating into a
+                        # stream nobody will poll again (a truly dead
+                        # actor just drops the cancel).
+                        self._drop_replica(tag)
+                        can_failover = (
+                            self._stream_failover
+                            and failovers < self._max_failovers
+                            and (resumable or not delivered_n))
+                        if can_failover:
+                            failovers += 1
+                            # Accumulate: this stream must NEVER retry
+                            # a replica it watched die, even after the
+                            # local-view suppression TTL expires (a
+                            # slow controller must not cost a second
+                            # failover against the same corpse).
+                            exclude = tuple(set(exclude) | {tag})
+                            FAILOVER_COUNTER.inc(
+                                tags={"deployment":
+                                      self.deployment_name})
+                            logger.warning(
+                                "stream on replica %s of %s died after "
+                                "%d items (%s); %s on a healthy "
+                                "replica (failover %d/%d)",
+                                tag, self.deployment_name,
+                                delivered_n, e,
+                                "resuming" if delivered_n
+                                else "restarting",
+                                failovers, self._max_failovers)
+                            continue
+                        INTERRUPTED_COUNTER.inc(
+                            tags={"deployment": self.deployment_name})
+                        raise StreamInterrupted(
+                            f"stream on {self.deployment_name}."
+                            f"{method_name or '__call__'} interrupted "
+                            f"after {delivered_n} items "
+                            f"(replica {tag} died; failover "
+                            f"{'exhausted' if failovers else 'unavailable'}): {e}",
+                            deployment=self.deployment_name,
+                            method=method_name,
+                            delivered=delivered_n,
+                            resumable=resumable,
+                            cause=repr(e)) from e
+                finally:
+                    if stream_id is not None and not finished:
+                        # Early close / client gone: free the replica-
+                        # side stream (and whatever slot it holds in an
+                        # engine).
+                        actor.stream_cancel.options(
+                            num_returns=0).remote(stream_id)
+                    self._release(tag)
 
         return _gen()
 
-    def _pick(self) -> Optional[Dict]:
+    def _pick(self, exclude: tuple = ()) -> Optional[Dict]:
+        if self._suppressed:
+            now = asyncio.get_event_loop().time()
+            for t, dl in list(self._suppressed.items()):
+                if dl <= now:
+                    del self._suppressed[t]
         free = [r for r in self._replicas
-                if self._in_flight.get(r["replica_tag"], 0)
+                if r["replica_tag"] not in exclude
+                and r["replica_tag"] not in self._suppressed
+                and self._in_flight.get(r["replica_tag"], 0)
                 < r["max_concurrent_queries"]]
         if not free:
             return None
@@ -270,10 +678,11 @@ class Router:
     """One per handle-holding process (proxy, driver, or other actor)."""
 
     def __init__(self, controller_handle, deployment_name: str,
-                 loop: Optional[asyncio.AbstractEventLoop] = None):
+                 loop: Optional[asyncio.AbstractEventLoop] = None,
+                 qos: Any = _QOS_FROM_ENV):
         loop = loop or asyncio.get_event_loop()
         self.deployment_name = deployment_name
-        self.replica_set = ReplicaSet(deployment_name, loop)
+        self.replica_set = ReplicaSet(deployment_name, loop, qos=qos)
         self._long_poll = LongPollClient(
             controller_handle,
             {f"replicas::{deployment_name}":
@@ -281,14 +690,14 @@ class Router:
             loop=loop)
 
     async def assign_request(self, method_name: str, args: tuple,
-                             kwargs: dict):
+                             kwargs: dict, tenant: str = None):
         return await self.replica_set.assign_replica(
-            method_name, args, kwargs)
+            method_name, args, kwargs, tenant=tenant)
 
     async def assign_request_stream(self, method_name: str, args: tuple,
-                                    kwargs: dict):
+                                    kwargs: dict, tenant: str = None):
         return await self.replica_set.assign_replica_stream(
-            method_name, args, kwargs)
+            method_name, args, kwargs, tenant=tenant)
 
     def stop(self):
         self._long_poll.stop()

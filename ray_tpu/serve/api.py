@@ -131,7 +131,7 @@ class Deployment:
                          kwargs.pop("route_prefix", self.route_prefix))
         for k in ("num_replicas", "max_concurrent_queries", "user_config",
                   "graceful_shutdown_timeout_s", "health_check_period_s",
-                  "health_check_timeout_s"):
+                  "health_check_timeout_s", "drain_timeout_s"):
             if k in kwargs:
                 setattr(new.config, k, kwargs.pop(k))
         if "autoscaling_config" in kwargs:

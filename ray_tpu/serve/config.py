@@ -14,7 +14,13 @@ from typing import Any, Dict, Optional
 @dataclass
 class AutoscalingConfig:
     """Reference: serve/config.py AutoscalingConfig + the policy inputs in
-    serve/_private/autoscaling_policy.py."""
+    serve/_private/autoscaling_policy.py.
+
+    Flap suppression for noisy gauges (chaos, bursty traffic): the
+    scaler acts on an EWMA of the cluster-wide load signal
+    (`load_ewma_alpha`; 1.0 = raw samples) and, after any decision,
+    holds fire for `decision_cooldown_s` — so replica counts change at
+    most once per cooldown window however hard the gauges shake."""
     min_replicas: int = 1
     max_replicas: int = 4
     target_num_ongoing_requests_per_replica: float = 2.0
@@ -22,6 +28,8 @@ class AutoscalingConfig:
     downscale_delay_s: float = 30.0
     metrics_interval_s: float = 1.0
     smoothing_factor: float = 1.0
+    decision_cooldown_s: float = 0.0
+    load_ewma_alpha: float = 1.0
 
 
 @dataclass
@@ -35,6 +43,11 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = 10.0
     health_check_period_s: float = 5.0
     health_check_timeout_s: float = 30.0
+    # Scale-down drains: a surplus replica first stops admitting (left
+    # out of the router broadcast) and finishes its in-flight requests
+    # — including long-lived streams — before it is retired; only past
+    # this bound is it stopped with work still in flight.
+    drain_timeout_s: float = 60.0
 
     def to_dict(self) -> Dict:
         d = dict(self.__dict__)

@@ -1,0 +1,102 @@
+"""Serve-layer structured errors and the resumable-stream marker.
+
+Robustness contract (reference: serve's replica fault tolerance,
+PAPER.md L10): a replica death mid-request must surface as one of a
+small set of STRUCTURED outcomes — a transparent retry/failover, a
+:class:`StreamInterrupted` carrying a resume cursor, or a
+:class:`TenantThrottled` shed — never as a raw ActorDiedError leaking
+to an HTTP client and never as a silent hang.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class StreamInterrupted(RuntimeError):
+    """A streaming request died mid-flight and could not (or was not
+    allowed to) fail over to another replica.
+
+    Carries a RESUME CURSOR: the consumer knows exactly how many items
+    were delivered before the interruption, so a client that holds the
+    original request can re-submit with the delivered prefix appended
+    (for resumable deployments this is what the router does
+    automatically when failover is enabled).  Delivered items are never
+    re-sent — the stream either continues past the cursor or stops
+    here, so the consumer's view is always a prefix of the true
+    stream."""
+
+    def __init__(self, message: str, *, deployment: str = "",
+                 method: str = "", delivered: int = 0,
+                 resumable: bool = False,
+                 cause: Optional[str] = None):
+        super().__init__(message)
+        self.deployment = deployment
+        self.method = method
+        self.delivered = delivered
+        self.resumable = resumable
+        self.cause = cause
+
+    @property
+    def resume_cursor(self) -> Dict[str, Any]:
+        """Everything a holder of the original (method, args, kwargs)
+        needs to resume: where the stream stopped and whether the
+        deployment supports server-side resumption."""
+        return {"deployment": self.deployment, "method": self.method,
+                "delivered": self.delivered, "resumable": self.resumable}
+
+    def __reduce__(self):
+        return (_rebuild_stream_interrupted,
+                (self.args[0] if self.args else "", self.deployment,
+                 self.method, self.delivered, self.resumable, self.cause))
+
+
+def _rebuild_stream_interrupted(msg, deployment, method, delivered,
+                                resumable, cause):
+    return StreamInterrupted(msg, deployment=deployment, method=method,
+                             delivered=delivered, resumable=resumable,
+                             cause=cause)
+
+
+class TenantThrottled(RuntimeError):
+    """Per-tenant admission refused the request (token bucket empty or
+    the tenant's waiting line is full).  Overload becomes an immediate,
+    retryable signal — HTTP 429 + Retry-After at the proxy — instead of
+    queue inflation that bleeds into every other tenant's p99.
+
+    `reason` is "rate_limited" (bucket empty; retry after the bucket
+    refills one token) or "queue_full" (too many queued waiters for
+    this tenant; retry after the line drains)."""
+
+    def __init__(self, message: str, *, tenant: str = "default",
+                 reason: str = "rate_limited",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+    def __reduce__(self):
+        return (_rebuild_tenant_throttled,
+                (self.args[0] if self.args else "", self.tenant,
+                 self.reason, self.retry_after_s))
+
+
+def _rebuild_tenant_throttled(msg, tenant, reason, retry_after_s):
+    return TenantThrottled(msg, tenant=tenant, reason=reason,
+                           retry_after_s=retry_after_s)
+
+
+def resumable(fn):
+    """Mark a streaming deployment method as RESUMABLE: it accepts a
+    ``_resume`` keyword ({"delivered": n, "items": [...]} — the items
+    already handed to the consumer) and yields only what comes AFTER
+    that prefix.  The router re-submits interrupted streams of marked
+    methods on a healthy replica instead of raising StreamInterrupted.
+
+        class LLM:
+            @serve.resumable
+            async def stream(self, tokens, _resume=None, **kw): ...
+    """
+    fn.__serve_resumable__ = True
+    return fn

@@ -21,11 +21,21 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
+from ray_tpu.serve.exceptions import resumable
 from ray_tpu.serve.llm.engine import GenerationEngine
 from ray_tpu.serve.llm.scheduler import EngineOverloadedError
 
 _GEN_KEYS = ("max_new_tokens", "temperature", "top_k", "eos_token",
              "seed")
+
+
+def _resume_tokens(items) -> List[int]:
+    """Delivered items from a failover cursor -> token ints (handle
+    streams yield bare ints, the SSE path yields {"token": t} events)."""
+    out = []
+    for it in items or []:
+        out.append(int(it["token"]) if isinstance(it, dict) else int(it))
+    return out
 
 
 class LLMServer:
@@ -60,12 +70,46 @@ class LLMServer:
         return await self.engine.generate(
             tokens, **self._gen_kwargs(overrides))
 
-    async def stream(self, tokens: Sequence[int], **overrides):
+    def _trim_for_resume(self, tokens: Sequence[int], kw: Dict,
+                         _resume: Optional[Dict]):
+        """Failover resume: re-anchor the prompt at the cursor — prompt
+        becomes original + delivered tokens (the prefix cache makes the
+        re-prefill cheap) and the token budget shrinks by what was
+        already delivered, so a greedy resumed stream yields EXACTLY
+        the remaining tokens of the uninterrupted stream.  Returns
+        (tokens, remaining_budget); remaining <= 0 means the stream was
+        already complete at the cursor."""
+        delivered = _resume_tokens((_resume or {}).get("items"))
+        if not delivered:
+            return list(tokens), 1
+        max_new = kw.get("max_new_tokens")
+        if max_new is None:
+            max_new = self.engine.default_max_new_tokens
+        remaining = int(max_new) - len(delivered)
+        eos = kw.get("eos_token")
+        if eos is not None and delivered[-1] == int(eos):
+            remaining = 0  # the stream had already hit EOS
+        kw["max_new_tokens"] = max(1, remaining)
+        return list(tokens) + delivered, remaining
+
+    @resumable
+    async def stream(self, tokens: Sequence[int], _resume=None,
+                     **overrides):
         """Token-streaming generation: an async generator, consumed
         through the serve streaming transport
         (handle.options("stream").stream(...) client-side, SSE over
-        HTTP)."""
-        stream = self.engine.submit(tokens, **self._gen_kwargs(overrides))
+        HTTP).
+
+        Resumable (`_resume` carries the router's failover cursor):
+        after a replica death the stream continues on a healthy replica
+        with only the undelivered suffix — bit-identical for greedy
+        (temperature=0) requests; sampled requests resume on a fresh
+        RNG stream past the cursor (documented parity caveat)."""
+        kw = self._gen_kwargs(overrides)
+        tokens, remaining = self._trim_for_resume(tokens, kw, _resume)
+        if remaining <= 0:
+            return
+        stream = self.engine.submit(tokens, **kw)
         try:
             async for tok in stream:
                 yield int(tok)
@@ -76,6 +120,14 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats().to_dict()
+
+    def autoscale_metrics(self) -> Dict[str, Any]:
+        """Saturation gauges for the serve controller's autoscaler
+        (picked up via the replica's get_autoscale_metrics): decode
+        queue depth, slot occupancy, and KV page headroom — so scaling
+        tracks what the ENGINE is actually short of, not just the
+        request count."""
+        return self.engine.load_info()
 
     def check_health(self):
         if not self.engine.running:
@@ -89,7 +141,8 @@ class LLMServer:
 
     # -- HTTP entry point (proxy) --------------------------------------
 
-    async def __call__(self, request):
+    @resumable
+    async def __call__(self, request, _resume=None):
         """POST JSON {"tokens": [ints], "max_new_tokens"?, "temperature"?,
         "top_k"?, "eos_token"?, "seed"?}.
 
@@ -98,7 +151,10 @@ class LLMServer:
         call through the streaming transport and this returns an async
         generator — one `data: {"token": t}` SSE event per generated
         token (the detection rule here must mirror the proxy's, which
-        decides before the replica is ever called)."""
+        decides before the replica is ever called).  SSE requests are
+        resumable: on replica death the proxy's router re-submits here
+        with the delivered-token cursor and only the remaining events
+        are produced."""
         try:
             body = request.json()
         except Exception:
@@ -110,7 +166,11 @@ class LLMServer:
         try:
             kw = self._gen_kwargs(overrides)
             if wants_sse:
-                stream = self.engine.submit(body["tokens"], **kw)
+                toks, remaining = self._trim_for_resume(
+                    body["tokens"], kw, _resume)
+                if remaining <= 0:
+                    return self._no_events()
+                stream = self.engine.submit(toks, **kw)
                 return self._sse_events(stream)
             out = await self.engine.generate(body["tokens"], **kw)
         except EngineOverloadedError as e:
@@ -130,6 +190,12 @@ class LLMServer:
                 yield {"token": int(tok)}
         finally:
             stream.cancel()  # client went away mid-generation: free the slot
+
+    async def _no_events(self):
+        """A resumed stream whose cursor already covers the whole
+        generation: stream transport, zero remaining events."""
+        return
+        yield  # pragma: no cover — marks this as a generator function
 
 
 def _wants_stream(request) -> bool:

@@ -649,6 +649,17 @@ class GenerationEngine:
         """submit() + collect(): the whole generation as a list."""
         return await self.submit(prompt, **kw).collect()
 
+    def load_info(self) -> Dict[str, int]:
+        """The autoscaler's saturation gauges, as plain field reads —
+        polled every control-loop tick, so no EngineStats construction
+        and no rate-window math on this path."""
+        return {"queue_depth": self._scheduler.depth
+                + (1 if self._prefill is not None else 0),
+                "active_slots": sum(r is not None for r in self._slots),
+                "num_slots": self.num_slots,
+                "kv_blocks_total": self.kv_pages,
+                "kv_blocks_free": self._alloc.free_pages}
+
     def stats(self) -> EngineStats:
         now = time.monotonic()
         win = now - self._win_t
