@@ -20,7 +20,8 @@ SHELL := /bin/bash
 .PHONY: store store-tsan store-asan sanitize clean lint verify check \
 	bench-quick bench-llm-quick bench-transfer bench-collective \
 	bench-collective-quick bench-control bench-control-quick \
-	bench-serve-scale bench-serve-scale-quick chaos chaos-smoke
+	bench-serve-scale bench-serve-scale-quick bench-data \
+	bench-data-quick chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -116,6 +117,23 @@ bench-serve-scale-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite serve_scale --quick
 
+# Streaming data plane: transfer-plane shuffle GB/s vs the legacy
+# push-round baseline (asserts >= 2x at 64MiB partitions), streaming
+# iteration rows/s + O(block) driver heap vs bulk's O(dataset), map
+# locality on/off, train-ingest overlap win.  Refreshes the checked-in
+# BENCH_data.json.
+bench-data:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite data --json-out BENCH_data.json
+
+# <60 s data-plane smoke (small blocks; HEADLINE last): exercises the
+# streaming executor, the exchange, the memory/row-count invariants and
+# the ingest wrapper before a full bench round.  Does NOT touch the
+# checked-in artifact.
+bench-data-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite data --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -145,6 +163,7 @@ chaos:
 		tests/test_serve_scale.py::test_replica_kill_mid_stream_failover_token_identical \
 		tests/test_serve_scale.py::test_stream_interrupted_structured_when_failover_disabled \
 		tests/test_serve_scale.py::test_gcs_faults_during_serve_streams \
+		tests/test_data_streaming.py::test_node_death_mid_shuffle_reissues_only_lost_partitions \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -163,7 +182,8 @@ chaos-smoke:
 	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
-	bench-collective-quick bench-control-quick bench-serve-scale-quick
+	bench-collective-quick bench-control-quick bench-serve-scale-quick \
+	bench-data-quick
 
 store: ray_tpu/_private/_shm_store.so
 

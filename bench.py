@@ -32,6 +32,12 @@ Suites (--suite):
              node-view convergence after membership churn.  Writes
              BENCH_control_plane.json; --quick is the <60s smoke wired
              into make check.
+  data       streaming data plane: transfer-plane shuffle GB/s vs the
+             legacy push-round baseline at 64MiB partitions, streaming
+             iteration rows/s + O(block) driver heap vs bulk's
+             O(dataset), map locality on/off, train-ingest overlap.
+             Writes BENCH_data.json; --quick is the <60s smoke wired
+             into make check.
 """
 
 import json
@@ -1002,6 +1008,419 @@ def transfer_main(json_out=None, sizes=None, passes=3):
           + _fmt_headline(r["pull_striped_2src_wire_gbps"], 3)
           + " push_gbps=" + _fmt_headline(r["push_windowed_gbps"], 3)
           + " host_memcpy_gbps=" + _fmt_headline(memcpy, 1))
+    return result
+
+
+def _vmrss_mb():
+    """This process's resident set in MiB (peak tracking is sampled —
+    driver-side growth is what the streaming budget bounds)."""
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def _data_block_producer(i, n):
+    import numpy as np
+    return {"data": np.random.default_rng(i).random(n)}
+
+
+def data_main(json_out=None, quick=False):
+    """Streaming data plane (--suite data): the operator-graph executor
+    + transfer-plane shuffle vs the legacy bulk/push-round baselines.
+
+      * shuffle GB/s at 64MiB output partitions: transfer-plane
+        exchange (partitions move ONCE, windowed, locality-placed
+        reduces) vs the legacy push-round graph (each round re-fetches,
+        re-combines and re-serializes the running accumulators);
+      * streaming iteration: rows/s + peak driver RSS growth while
+        consuming a transformed dataset through the budgeted executor
+        vs bulk materialize-and-fetch (RSS grows with the dataset);
+      * locality on/off: fused map wall over store-resident blocks with
+        input-location placement hints vs without;
+      * train-ingest overlap: per-epoch reshuffled streaming ingest
+        (train/ingest.py, next epoch primed during the current one) vs
+        materialize-then-train, with a fixed simulated step cost.
+
+    Writes BENCH_data.json; --quick is the <60 s smoke (asserting the
+    same invariants at small sizes, artifact untouched by default)."""
+    import gc
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data._internal.streaming_executor import StreamingExecutor
+
+    n_blocks = 4 if quick else 6
+    block_mb = 8 if quick else 64
+    rows_per_block = block_mb * 1024 * 1024 // 8
+    total_bytes = n_blocks * rows_per_block * 8
+
+    cluster = Cluster()
+    for _ in range(2 if quick else 3):
+        # Generous arenas: the suite churns several dataset-sized
+        # generations of blocks and must measure the engines, not
+        # allocation stalls against pending async deletions.
+        cluster.add_node(num_cpus=2,
+                         object_store_memory=6 * 1024**3)
+    cluster.wait_for_nodes(2 if quick else 3)
+    cluster.connect()
+
+    prod = ray_tpu.remote(_data_block_producer).options(
+        scheduling_strategy="SPREAD")
+
+    def build(nb=n_blocks, rows=rows_per_block):
+        refs = [prod.remote(i, rows) for i in range(nb)]
+        ray_tpu.wait(refs, num_returns=nb, timeout=600,
+                     fetch_local=False)
+        return rd.Dataset(refs)
+
+    streaming_prior = cfg.data_streaming
+    detail = {"n_blocks": n_blocks, "block_mb": block_mb,
+              "dataset_mb": round(total_bytes / 1024**2)}
+    try:
+        # ---- leg 1a: the shuffle ENGINE at 64MiB output partitions --
+        # Apples-to-apples movement story: both engines run IDENTICAL
+        # row-range partition work (n_out even slices per block), so
+        # the delta is pure data plane — the exchange writes every
+        # partition byte ONCE and reduce pulls ride TransferManager,
+        # while the legacy push-round graph re-fetches, re-combines and
+        # re-pickles the running accumulators every round.  Passes
+        # interleave (exchange, push, exchange, ...) and the metric is
+        # the ratio of SUMMED walls across the measured pairs — one
+        # long paired measurement: on this shared 1-vCPU host absolute
+        # walls (and individual pair ratios) swing with scheduler
+        # jitter, the aggregate is the stable statistic.
+        from ray_tpu.data.dataset import _push_shuffle, _repartition_op
+        from ray_tpu.data._internal.shuffle import exchange_bulk
+        eng_blocks = n_blocks if quick else 12
+        eng_bytes = eng_blocks * rows_per_block * 8
+        eng_refs = build(eng_blocks)._block_refs
+        n_out = eng_blocks
+
+        def _slice_partition(block, idx):
+            arr = np.asarray(block["data"])
+            bounds = np.linspace(0, len(arr),
+                                 n_out + 1).astype(np.int64)
+            return [{"data": arr[bounds[j]:bounds[j + 1]]}
+                    for j in range(n_out)]
+
+        pairs = []
+        ex_walls, push_walls = [], []
+        # Pair 0 is a discarded WARMUP (worker spawn, function export,
+        # first-touch arena pages land there); each pass deletes its
+        # outputs and settles briefly so one pass's async deletion
+        # churn doesn't bleed into the next pass's wall.
+        n_pairs = 1 if quick else 4
+
+        def _settle():
+            gc.collect()
+            if not quick:
+                time.sleep(2)
+
+        for p in range(n_pairs):
+            cfg.data_streaming = True
+            t0 = time.perf_counter()
+            out = exchange_bulk(eng_refs, _repartition_op(n_out))
+            ray_tpu.wait(out, num_returns=len(out), timeout=600,
+                         fetch_local=False)
+            ex = time.perf_counter() - t0
+            del out
+            _settle()
+            t0 = time.perf_counter()
+            out = _push_shuffle(eng_refs, _slice_partition, n_out)
+            ray_tpu.wait(out, num_returns=len(out), timeout=600,
+                         fetch_local=False)
+            push = time.perf_counter() - t0
+            del out
+            _settle()
+            if p == 0 and not quick:
+                continue  # warmup pair
+            ex_walls.append(ex)
+            push_walls.append(push)
+            pairs.append(push / ex)
+        del eng_refs
+        _settle()
+        # Aggregate over the measured pairs = ONE long interleaved
+        # measurement (per-pair ratios swing 1.3-3x with the 1-vCPU
+        # scheduler jitter; the sums are stable).
+        engine = {
+            "n_blocks": eng_blocks,
+            "partition_mb": block_mb,
+            "dataset_mb": round(eng_bytes / 1024**2),
+            "exchange_wall_s": [round(w, 2) for w in ex_walls],
+            "push_rounds_wall_s": [round(w, 2) for w in push_walls],
+            "exchange_gbps": round(
+                eng_bytes * len(ex_walls) / sum(ex_walls) / 1e9, 4),
+            "push_rounds_gbps": round(
+                eng_bytes * len(push_walls) / sum(push_walls) / 1e9, 4),
+            "pair_ratios": [round(p, 2) for p in pairs],
+            "speedup": round(sum(push_walls) / sum(ex_walls), 2),
+        }
+        detail["shuffle_engine"] = engine
+        if not quick:
+            # Regression GATE at 1.5x: the measured aggregate on this
+            # 1-vCPU box ranges ~1.6-2.8x (centered ~2.2-2.5x — the
+            # checked-in artifact records a representative >=2x run);
+            # the gate needs headroom for the scheduler jitter that
+            # occasionally eats a whole pass, while still catching a
+            # real engine regression (parity would read ~1.0).
+            assert engine["speedup"] >= 1.5, (
+                f"transfer-plane exchange only {engine['speedup']}x the "
+                f"legacy put/get push-round engine (regression gate: "
+                f"1.5x; pairs={engine['pair_ratios']})")
+
+        # ---- leg 1b: end-to-end seeded random_shuffle ---------------
+        # Includes the (identical) row-permutation compute, which
+        # dominates on one core — recorded honestly, not asserted.
+        shuffle = {}
+        for mode in ("streaming", "legacy"):
+            cfg.data_streaming = mode == "streaming"
+            ds = build()
+            t0 = time.perf_counter()
+            out = ds.random_shuffle(seed=3)
+            refs = out.get_internal_block_refs()
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=600,
+                         fetch_local=False)
+            dt = time.perf_counter() - t0
+            shuffle[mode] = {"wall_s": round(dt, 2),
+                             "gbps": round(total_bytes / dt / 1e9, 4)}
+            del ds, out, refs
+            gc.collect()
+        shuffle["speedup"] = round(
+            shuffle["streaming"]["gbps"]
+            / max(shuffle["legacy"]["gbps"], 1e-9), 2)
+        detail["shuffle"] = shuffle
+
+        # ---- leg 2: streaming iteration rows/s + driver memory ------
+        # Driver-HELD bytes are measured with tracemalloc (numpy
+        # allocations are traced): in this in-process bench cluster the
+        # head raylet's arena is mapped into the driver process, so raw
+        # RSS also counts store pages that pulled blocks touch — the
+        # heap number is what the consume path actually holds.
+        import tracemalloc
+        iteration = {}
+        for mode in ("streaming", "bulk"):
+            cfg.data_streaming = True
+            ds = build().map_batches(
+                lambda b: {"data": np.asarray(b["data"]) * 2.0})
+            gc.collect()
+            rss0 = _vmrss_mb()
+            tracemalloc.start()
+            rows_seen = 0
+            t0 = time.perf_counter()
+            if mode == "streaming":
+                for batch in ds.iter_batches(
+                        batch_size=rows_per_block // 2):
+                    rows_seen += len(batch["data"])
+            else:
+                # Bulk: materialize every block and hold it on the
+                # driver (the pre-executor consume path).
+                blocks = [ray_tpu.get(r, timeout=600)
+                          for r in ds.get_internal_block_refs()]
+                for b in blocks:
+                    rows_seen += len(b["data"])
+                del blocks
+            dt = time.perf_counter() - t0
+            heap_peak = tracemalloc.get_traced_memory()[1]
+            tracemalloc.stop()
+            iteration[mode] = {
+                "rows_per_s": round(rows_seen / dt),
+                "heap_peak_mb": round(heap_peak / 1024**2, 1),
+                "rss_growth_mb": round(_vmrss_mb() - rss0, 1),
+                "wall_s": round(dt, 2)}
+            assert rows_seen == n_blocks * rows_per_block
+            del ds
+            gc.collect()
+        detail["iteration"] = iteration
+        if not quick:
+            # O(blocks-in-flight) vs O(dataset): the streaming consume
+            # path holds a few blocks (current + carry + batch), the
+            # bulk path holds every block at once.
+            assert iteration["streaming"]["heap_peak_mb"] \
+                <= 4 * block_mb + 64, (
+                f"streaming driver heap peaked at "
+                f"{iteration['streaming']['heap_peak_mb']}MB — not "
+                f"O(block) for {block_mb}MB blocks")
+            assert iteration["bulk"]["heap_peak_mb"] \
+                >= 0.9 * detail["dataset_mb"], (
+                "bulk baseline no longer holds the dataset — "
+                "the comparison is vacuous")
+
+        # ---- leg 3: locality-aware placement on/off -----------------
+        # The load-bearing metric is BYTES NOT MOVED: a locality hit
+        # runs the map where its input block lives, so the input is
+        # never pulled at all.  (Wall times are recorded best-of-2 but
+        # are contention noise on this 1-vCPU container — every
+        # "node" shares one core, and a same-host miss costs only a
+        # ~4 GB/s arena memcpy; cross-host a miss is a wire hop.)
+        locality = {}
+
+        def _cluster_pull_bytes():
+            return sum(n.raylet.transfers.stats["pull_bytes"]
+                       for n in cluster.nodes)
+
+        for on in (True, False):
+            cfg.data_streaming = True
+            best = None
+            pulled = None
+            for _ in range(2):
+                ds = build()
+                stages = ds.map_batches(
+                    lambda b: {"data": np.sqrt(np.asarray(b["data"]))}) \
+                    ._stages
+                pulled0 = _cluster_pull_bytes()
+                t0 = time.perf_counter()
+                ex = StreamingExecutor(ds._block_refs, stages,
+                                       locality=on)
+                n = sum(1 for _ in ex.iter_handles())
+                dt = time.perf_counter() - t0
+                assert n == n_blocks
+                best = dt if best is None else min(best, dt)
+                got = _cluster_pull_bytes() - pulled0
+                pulled = got if pulled is None else min(pulled, got)
+                del ds, ex
+                gc.collect()
+            locality["on" if on else "off"] = {
+                "wall_s": round(best, 2),
+                "input_bytes_pulled_mb": round(pulled / 1024**2, 1)}
+        locality["note"] = (
+            "a locality hit moves ZERO input bytes (the map runs where "
+            "the block lives); wall_s is contention-bound on this "
+            "1-vCPU container — all raylets share one core and a miss "
+            "here is a same-host arena memcpy, not a wire hop")
+        detail["locality"] = locality
+        if not quick:
+            assert locality["on"]["input_bytes_pulled_mb"] \
+                < 0.5 * max(locality["off"]["input_bytes_pulled_mb"],
+                            1e-9), (
+                "locality placement did not reduce input pull traffic: "
+                f"{locality}")
+
+        # ---- leg 4: train ingest overlap ----------------------------
+        from ray_tpu.train.ingest import StreamingDatasetShard
+        nb_i = n_blocks
+        rows_i = rows_per_block // 8
+        epochs = 2
+        step_s = 0.05
+        n_batches = nb_i * 2  # batch_size = rows_i // 2
+
+        def _steps(batches):
+            seen = 0
+            for b in batches:
+                seen += len(b["data"])
+                time.sleep(step_s)  # the simulated train step
+            return seen
+
+        # Interleaved pairs + aggregate, like the engine leg: these
+        # walls are a few seconds each and the 1-vCPU scheduler jitter
+        # would otherwise decide the "win" single-handedly.
+        stream_walls, mat_walls = [], []
+        for _ in range(1 if quick else 2):
+            gc.collect()
+            if not quick:
+                time.sleep(2)
+            cfg.data_streaming = True
+            base = build(nb_i, rows_i)
+            shard = StreamingDatasetShard(base, shuffle_each_epoch=True,
+                                          shuffle_seed=11)
+            t0 = time.perf_counter()
+            # iter_epochs skips the final epoch's next-epoch prime —
+            # close() would otherwise join a whole wasted reshuffle
+            # inside the measured wall.
+            for it in shard.iter_epochs(epochs,
+                                        batch_size=rows_i // 2):
+                assert _steps(it) == nb_i * rows_i
+            shard.close()
+            stream_walls.append(time.perf_counter() - t0)
+            del base, shard
+            gc.collect()
+            if not quick:
+                time.sleep(2)
+            cfg.data_streaming = False
+            base = build(nb_i, rows_i)
+            t0 = time.perf_counter()
+            for e in range(epochs):
+                shuffled = base.random_shuffle(seed=11 + e).materialize()
+                assert _steps(shuffled.iter_batches(
+                    batch_size=rows_i // 2)) == nb_i * rows_i
+                del shuffled
+            mat_walls.append(time.perf_counter() - t0)
+            del base
+            gc.collect()
+        ingest = {
+            "streaming_wall_s": [round(w, 2) for w in stream_walls],
+            "materialize_wall_s": [round(w, 2) for w in mat_walls],
+            "win": round(sum(mat_walls) / max(sum(stream_walls), 1e-9),
+                         2),
+            "epochs": epochs, "step_s": step_s,
+            "steps_per_epoch": n_batches,
+        }
+        detail["ingest"] = ingest
+    finally:
+        cfg.data_streaming = streaming_prior
+        cluster.shutdown()
+
+    detail["config"] = {
+        "data_op_budget_bytes": cfg.data_op_budget_bytes,
+        "data_shuffle_parallelism": cfg.data_shuffle_parallelism,
+        "data_get_timeout_s": cfg.data_get_timeout_s,
+        "fetch_chunk_bytes": cfg.fetch_chunk_bytes,
+    }
+    detail["_note"] = (
+        "shuffle_engine = the acceptance comparison: both engines run "
+        "IDENTICAL row-slice partition work at 64MiB output "
+        "partitions, so the ratio isolates the movement story "
+        "(exchange moves every byte once over TransferManager; the "
+        "push-round engine re-fetches/re-pickles accumulators every "
+        "round); speedup = sum(push walls)/sum(exchange walls) over "
+        "interleaved measured pairs — one long paired measurement "
+        "(individual walls and pair ratios swing with the 1-vCPU "
+        "scheduler jitter; pair_ratios records the spread).  "
+        "shuffle = end-to-end seeded "
+        "random_shuffle incl. the (identical) permutation compute "
+        "that dominates on one core — recorded, not asserted.  All "
+        "raylets in one process on one host; ingest win = "
+        "materialize-then-train wall / streaming-overlapped wall at a "
+        "fixed simulated step cost.")
+    result = {
+        "metric": "data_shuffle_exchange_gbps",
+        "value": detail["shuffle_engine"]["exchange_gbps"],
+        "unit": "GB/s",
+        "vs_baseline": detail["shuffle_engine"]["speedup"],
+        "detail": detail,
+    }
+    line = json.dumps(result)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+    print("HEADLINE data_exchange_gbps="
+          + _fmt_headline(detail["shuffle_engine"]["exchange_gbps"], 4)
+          + " vs_push_round_engine="
+          + _fmt_headline(detail["shuffle_engine"]["speedup"], 2)
+          + " e2e_shuffle_gbps="
+          + _fmt_headline(detail["shuffle"]["streaming"]["gbps"], 4)
+          + " e2e_vs_legacy="
+          + _fmt_headline(detail["shuffle"]["speedup"], 2)
+          + " stream_rows/s="
+          + _fmt_headline(detail["iteration"]["streaming"]["rows_per_s"])
+          + " stream_heap_mb="
+          + _fmt_headline(detail["iteration"]["streaming"]
+                          ["heap_peak_mb"], 1)
+          + " bulk_heap_mb="
+          + _fmt_headline(detail["iteration"]["bulk"]["heap_peak_mb"], 1)
+          + " locality_pull_mb="
+          + _fmt_headline(detail["locality"]["on"]
+                          ["input_bytes_pulled_mb"], 1)
+          + "/" + _fmt_headline(detail["locality"]["off"]
+                                ["input_bytes_pulled_mb"], 1)
+          + " ingest_overlap_win="
+          + _fmt_headline(detail["ingest"]["win"], 2))
     return result
 
 
@@ -1992,7 +2411,7 @@ if __name__ == "__main__":
     ap.add_argument("--suite", default="train",
                     choices=["train", "serve_llm", "transfer",
                              "collective", "control_plane",
-                             "serve_scale"])
+                             "serve_scale", "data"])
     ap.add_argument("--json-out", default=None,
                     help="also write the JSON line to this path "
                          "(serve_llm/transfer default to their "
@@ -2022,5 +2441,9 @@ if __name__ == "__main__":
                          else (cli.json_out
                                or "BENCH_serve_scale.json"),
                          quick=cli.quick)
+    elif cli.suite == "data":
+        data_main(cli.json_out if cli.quick
+                  else (cli.json_out or "BENCH_data.json"),
+                  quick=cli.quick)
     else:
         main()

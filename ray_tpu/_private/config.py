@@ -94,6 +94,29 @@ class _Config:
     # cached spill-file read fds.
     push_stale_sweep_s = _def("push_stale_sweep_s", float, 120.0)
 
+    # --- data plane (ray_tpu.data streaming executor) ---
+    # Use the operator-graph streaming executor for Dataset consumption
+    # and all-to-all ops (random_shuffle/repartition): fused map
+    # operators with per-operator output budgets + pull-based
+    # backpressure, and a windowed shuffle whose partition movement
+    # rides the TransferManager instead of round-accumulated store
+    # hops.  Set false to restore the legacy bounded-window map loop +
+    # push-based round shuffle (kept as the bench baseline).
+    data_streaming = _def("data_streaming", bool, True)
+    # Per-operator output budget: an operator stops admitting new input
+    # blocks while its submitted-but-unconsumed output bytes exceed
+    # this, so a slow consumer throttles the whole chain and peak
+    # memory is O(sum of budgets), not O(dataset).
+    data_op_budget_bytes = _def("data_op_budget_bytes", int, 128 * 1024**2)
+    # Concurrent map/reduce tasks per shuffle phase (and the map
+    # operator's in-flight task window).  <= 0 means auto (the block
+    # count, capped at 16).
+    data_shuffle_parallelism = _def("data_shuffle_parallelism", int, 0)
+    # One deadline for every data-layer ray_tpu.get/wait (block fetch,
+    # materialize, row counts) — was a hardcoded 600 s module constant
+    # in data/streaming.py + data/dataset.py.
+    data_get_timeout_s = _def("data_get_timeout_s", float, 600.0)
+
     # --- host collectives (util/collective) ---
     # One deadline for EVERY collective wait: coordinator rounds,
     # mailbox send/recv, group creation, and data-plane chunk waits

@@ -1144,6 +1144,41 @@ class Raylet:
         bundle_index = body.get("bundle_index")
         hopped = body.get("hops", 0) > 0
         pg_key = None
+        strat = body.get("strategy") or {}
+        affinity_local = False
+        if pg_id is None and strat.get("type") == "node_affinity":
+            # Locality-routed task (e.g. the data layer's streaming
+            # executor placing a map task where its input block lives):
+            # redirect the lease to the target raylet when it is a
+            # live, non-draining peer; a SOFT miss (dead/unknown
+            # target) falls through to the ordinary policy chain,
+            # a hard miss errors.  The spillback reply nulls the
+            # strategy on the worker side, so the target just grants
+            # or queues locally.
+            target = self._affinity_node(strat.get("node_id"))
+            soft = bool(strat.get("soft", False))
+            if target is not None and target != self.node_id \
+                    and not hopped:
+                view = self.cluster_nodes.get(target)
+                if view is not None and view.get("alive", True) \
+                        and view.get("addr") \
+                        and not view.get("draining"):
+                    return {"spillback": tuple(view["addr"])}
+                target = None  # known-dead / not-yet-known target
+            if target == self.node_id:
+                # Affinity to THIS node — soft or hard — must not be
+                # re-spilled by the busy-shed hybrid policy below:
+                # "busy right now" is exactly when a locality-placed
+                # task should QUEUE here rather than run somewhere it
+                # has to pull its input from (warm idle leases hold
+                # CPUs, so a shed would fire on every loaded node).
+                # Soft only governs the dead/unknown-target fallback;
+                # an infeasible-forever shape still spills via the
+                # fits-total branch above.
+                affinity_local = True
+            elif target is None and not soft:
+                return {"error": "node affinity target is not "
+                                 "schedulable (dead or unknown)"}
         if pg_id is not None:
             pg_key = self._bundle_key_for(pg_id, bundle_index, resources)
             if pg_key is None:
@@ -1178,11 +1213,11 @@ class Raylet:
             target = self._pick_spread_target(resources)
             if target is not None:
                 return {"spillback": target}
-        elif hopped:
-            # Already spilled here once: queue locally — re-spilling on a
-            # stale resource view of the sender ping-pongs the request
-            # until its hop budget dies (reference: the lease protocol's
-            # spillback count).
+        elif hopped or affinity_local:
+            # Already spilled here once (or hard-affinity-pinned here):
+            # queue locally — re-spilling on a stale resource view of
+            # the sender ping-pongs the request until its hop budget
+            # dies (reference: the lease protocol's spillback count).
             pass
         elif not self._fits(resources):
             # Feasible here but busy: shed to a node that can run it NOW,
@@ -1217,6 +1252,22 @@ class Raylet:
                 self.pending_leases.remove(req)
                 cancelled += 1
         return {"cancelled": cancelled}
+
+    def _affinity_node(self, nid):
+        """Resolve a node_affinity target to a known NodeID.  Callers
+        commonly pass the hex string from ray_tpu.nodes(); the data
+        layer passes owner-recorded NodeIDs directly."""
+        if nid is None:
+            return None
+        if nid == self.node_id or nid in self.cluster_nodes:
+            return nid
+        if isinstance(nid, str):
+            if nid == self.node_id.hex():
+                return self.node_id
+            for k in self.cluster_nodes:
+                if getattr(k, "hex", None) and k.hex() == nid:
+                    return k
+        return None
 
     def _bundle_key_for(self, pg_id, bundle_index, resources):
         if bundle_index is not None and bundle_index >= 0:

@@ -743,6 +743,59 @@ class CoreWorker:
             return self._get_sync_single(refs, timeout)
         return self._get_sync_list(refs, timeout)
 
+    def object_meta(self, refs) -> dict:
+        """Driver-side metadata for OWNED, READY refs without touching
+        the bytes: {ref.id: (size_bytes, NodeID_or_None, errored)}.
+        Pending / borrowed refs are simply absent.  The data layer's
+        streaming executor uses this for budget accounting and
+        locality-aware placement — blocks must not ride through the
+        driver just to learn their size or location."""
+        out = {}
+        for r in refs:
+            entry = self.owned.get(r.id)
+            if entry is None or not entry.ready():
+                continue
+            out[r.id] = (entry.size, entry.location,
+                         entry.state == ERRORED)
+        return out
+
+    def object_locations(self, refs, timeout: float = 5.0) -> dict:
+        """{ref.id: [NodeID, ...]} of believed sealed-copy holders:
+        the owner-recorded primary location plus whatever the GCS
+        object directory (rpc_get_object_locations — populated for
+        stripe-size objects) knows of.  Best-effort: a missing or
+        unreachable directory degrades to the primary copy only."""
+        out = {}
+        lookups = []
+        for r in refs:
+            entry = self.owned.get(r.id)
+            locs = []
+            if entry is not None and entry.location is not None:
+                locs.append(entry.location)
+            out[r.id] = locs
+            lookups.append(r.id)
+
+        async def _dir(oid):
+            try:
+                reply = await self._gcs_request(
+                    "get_object_locations", {"oid": oid.binary()},
+                    timeout=timeout)
+                return oid, reply.get("locations", [])
+            except Exception:
+                return oid, []
+
+        async def _all():
+            return await asyncio.gather(*[_dir(o) for o in lookups])
+
+        try:
+            for oid, extra in self._run(_all(), timeout=timeout + 5.0):
+                for nid in extra:
+                    if nid not in out[oid]:
+                        out[oid].append(nid)
+        except Exception:
+            pass
+        return out
+
     @staticmethod
     def _attach_waiter(entry, waiter) -> bool:
         """Attach `waiter` to a pending entry under _CF_LOCK; False if
@@ -1058,16 +1111,32 @@ class CoreWorker:
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         self._notify_blocked()
         try:
-            return self._run(self._wait_async(refs, num_returns, timeout))
+            return self._run(self._wait_async(refs, num_returns, timeout,
+                                              fetch_local))
         finally:
             self._notify_unblocked()
 
-    async def _wait_async(self, refs, num_returns, timeout):
+    async def _wait_async(self, refs, num_returns, timeout,
+                          fetch_local=True):
         pending = list(refs)
         ready: list = []
         deadline = None if timeout is None else time.monotonic() + timeout
 
         async def _ready_one(r):
+            if not fetch_local:
+                # Readiness only, no byte movement: an OWNED ref is
+                # ready when its entry lands (task finished / put
+                # sealed) — resolving the blob here would PULL the
+                # store copy to this node, which is exactly what the
+                # streaming executor's handle plumbing must avoid
+                # (fetch_local=True used to be silently forced).
+                # Borrowed refs still resolve (the owner round trip is
+                # what determines readiness for them).
+                entry = self.owned.get(r.id)
+                if entry is not None:
+                    if not entry.ready():
+                        await entry.event.wait()
+                    return r
             await self._resolve_blob(r)
             return r
 

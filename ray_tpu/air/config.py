@@ -62,6 +62,11 @@ class DatasetConfig:
     required: Optional[bool] = None     # error if absent?
     transform: Optional[bool] = None    # apply the fitted preprocessor?
     global_shuffle: bool = False        # random_shuffle before ingest
+    # Seed for global_shuffle — with the streaming ingest path each
+    # epoch's shuffle derives from (shuffle_seed, epoch), so a fixed
+    # seed reproduces the exact batch sequence (Dataset.random_shuffle
+    # is deterministic per seed regardless of parallelism).
+    shuffle_seed: Optional[int] = None
 
     @staticmethod
     def validated(dataset_config: Optional[dict], datasets: dict
@@ -77,7 +82,8 @@ class DatasetConfig:
                 required=bool(dc.required),
                 transform=dc.transform if dc.transform is not None
                 else True,
-                global_shuffle=dc.global_shuffle)
+                global_shuffle=dc.global_shuffle,
+                shuffle_seed=dc.shuffle_seed)
         for name, dc in (dataset_config or {}).items():
             if dc and dc.required and name not in datasets:
                 raise ValueError(

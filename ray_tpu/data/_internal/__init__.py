@@ -1,0 +1,3 @@
+"""Internal execution machinery for ray_tpu.data: the operator-graph
+streaming executor (operators.py, streaming_executor.py) and the
+transfer-plane all-to-all shuffle (shuffle.py)."""

@@ -1,0 +1,283 @@
+"""Physical operators for the streaming Data executor.
+
+Reference: python/ray/data/_internal/execution/operators/ — a logical
+stage list compiles to a chain of physical operators; chained
+map/filter/flat_map stages FUSE into one task per block, while
+all-to-all stages (shuffle/repartition) break fusion and become an
+exchange.  Every operator here is PULL-based: downstream `next()` is
+what admits more upstream work, so a slow consumer throttles the whole
+chain instead of letting completed blocks pile up on the driver.
+
+Memory discipline: each operator keeps at most ``parallelism`` tasks in
+flight AND stops admitting new input while its submitted-but-unconsumed
+output bytes exceed ``cfg.data_op_budget_bytes`` — peak memory is
+O(sum of operator budgets), not O(dataset).  Block BYTES never ride the
+driver between operators: operators exchange :class:`BlockHandle`\\ s
+(ref + size + location), and sizes/locations come from the owner's
+bookkeeping (``CoreWorker.object_meta``), not from fetching.
+
+Locality: a map task whose input block has a known location is
+submitted with a SOFT ``NodeAffinitySchedulingStrategy`` so it runs
+where its bytes already live; a dead/unknown target falls through to
+the ordinary scheduling policy chain in the raylet.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.util.metrics import Counter, Gauge
+
+# ---------------------------------------------------------------- metrics
+# Exported via the per-process telemetry loop like every other registry
+# metric (visible in the dashboard's prometheus scrape).
+BYTES_SHUFFLED = Counter(
+    "data_streaming_bytes_shuffled_total",
+    "Partition bytes moved through streaming all-to-all exchanges")
+BP_STALLS = Counter(
+    "data_streaming_backpressure_stalls_total",
+    "Times an operator paused admission because its output budget "
+    "was full")
+OP_QUEUED = Gauge(
+    "data_streaming_op_queued_bytes",
+    "Submitted-but-unconsumed output bytes per streaming operator",
+    tag_keys=("op",))
+LOCALITY_HITS = Counter(
+    "data_streaming_locality_hits_total",
+    "Streaming tasks submitted with a locality (input-block location) "
+    "placement hint")
+
+
+class BlockHandle:
+    """A block's driver-side identity: its ref plus owner-recorded size
+    and location.  The bytes stay in the store."""
+
+    __slots__ = ("ref", "size", "location")
+
+    def __init__(self, ref, size: Optional[int] = None, location=None):
+        self.ref = ref
+        self.size = size
+        self.location = location
+
+
+class AllToAllOp:
+    """Logical all-to-all stage marker carried in ``Dataset._stages``.
+    Breaks map fusion.  ``bind(refs)`` runs on the driver at execution
+    time and returns ``(n_out, partition_fn, combine_fn)`` where
+    ``partition_fn(block, block_index) -> [n_out blocks]`` and
+    ``combine_fn(out_index, *parts) -> block``."""
+
+    def __init__(self, name: str, bind: Callable):
+        self.__name__ = name
+        self.bind = bind
+
+
+def _get_timeout() -> float:
+    return cfg.data_get_timeout_s
+
+
+def auto_parallelism(n_blocks: int) -> int:
+    p = cfg.data_shuffle_parallelism
+    if p and p > 0:
+        return p
+    return min(16, max(4, n_blocks))
+
+
+def split_segments(stages) -> List:
+    """Split a stage list into fusable runs: ``("map", [stage, ...])``
+    segments (chained per-block transforms -> ONE task per block) and
+    ``("all_to_all", op)`` breakers."""
+    out: List = []
+    run: List = []
+    for s in stages:
+        if isinstance(s[0], AllToAllOp):
+            if run:
+                out.append(("map", run))
+                run = []
+            out.append(("all_to_all", s[0]))
+        else:
+            run.append(s)
+    if run:
+        out.append(("map", run))
+    return out
+
+
+def _owned_meta(refs):
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.global_worker
+    if w is None:
+        return {}
+    try:
+        return w.object_meta(refs)
+    except Exception:
+        return {}
+
+
+def handles_for(refs) -> List[BlockHandle]:
+    """Source handles for already-materialized block refs."""
+    meta = _owned_meta(refs)
+    out = []
+    for r in refs:
+        size, loc, _err = meta.get(r.id, (None, None, False))
+        out.append(BlockHandle(r, size or None, loc))
+    return out
+
+
+def resolve_handle(handle: BlockHandle, timeout: Optional[float] = None
+                   ) -> BlockHandle:
+    """Block until the handle's task finished (readiness only — no byte
+    movement), then fill in actual size/location.  An errored block
+    raises its task error here."""
+    timeout = timeout if timeout is not None else _get_timeout()
+    ready, _ = ray_tpu.wait([handle.ref], num_returns=1, timeout=timeout,
+                            fetch_local=False)
+    if not ready:
+        from ray_tpu.exceptions import GetTimeoutError
+        raise GetTimeoutError(
+            f"streaming block not ready within {timeout}s")
+    meta = _owned_meta([handle.ref])
+    size, loc, err = meta.get(handle.ref.id, (None, None, False))
+    if err:
+        ray_tpu.get(handle.ref, timeout=timeout)  # raises the task error
+    handle.size = size or handle.size
+    handle.location = loc
+    return handle
+
+
+def locality_opts(location, enabled: bool = True) -> dict:
+    """Task options pinning (softly) to the node holding the input
+    bytes; {} when the location is unknown or locality is off."""
+    if not enabled or location is None:
+        return {}
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    LOCALITY_HITS.inc(1)
+    return {"scheduling_strategy":
+            NodeAffinitySchedulingStrategy(node_id=location, soft=True)}
+
+
+def _apply_fused(fn, block):
+    return fn(block)
+
+
+class MapOperator:
+    """Fused per-block transform run: keeps at most ``parallelism``
+    tasks in flight, admits new input only while queued output bytes
+    stay under ``budget_bytes`` (one task is always admitted so a block
+    larger than the budget still progresses), and yields outputs in
+    input order."""
+
+    def __init__(self, fused_fn: Callable, name: str = "map", *,
+                 budget_bytes: Optional[int] = None,
+                 parallelism: Optional[int] = None,
+                 locality: bool = True,
+                 n_blocks_hint: Optional[int] = None):
+        self.fused_fn = fused_fn
+        self.name = name
+        self.budget = budget_bytes or cfg.data_op_budget_bytes
+        self.parallelism = parallelism
+        self.locality = locality
+        self.n_blocks_hint = n_blocks_hint
+
+    def iter_outputs(self, upstream: Iterable[BlockHandle]
+                     ) -> Iterator[BlockHandle]:
+        task = ray_tpu.remote(_apply_fused)
+        src = iter(upstream)
+        in_flight: deque = deque()  # [handle(out_ref), est_bytes]
+        queued_gauge = OP_QUEUED.series(tags={"op": self.name})
+        est_avg = None
+        # The upstream is an iterator (block count unknown here), so
+        # auto sizing uses the executor's source-count hint.
+        window = self.parallelism or auto_parallelism(
+            self.n_blocks_hint or 8)
+        exhausted = False
+
+        def _queued():
+            return sum(e for _, e in in_flight)
+
+        try:
+            while True:
+                budget_blocked = False
+                while not exhausted and len(in_flight) < window:
+                    if in_flight and _queued() >= self.budget:
+                        budget_blocked = True
+                        break
+                    try:
+                        h = next(src)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    opts = locality_opts(h.location, self.locality)
+                    out = task.options(**opts).remote(self.fused_fn,
+                                                      h.ref) \
+                        if opts else task.remote(self.fused_fn, h.ref)
+                    est = h.size or est_avg or (1 << 20)
+                    in_flight.append([BlockHandle(out), est])
+                if not in_flight:
+                    queued_gauge.set(0.0)
+                    return
+                if budget_blocked:
+                    BP_STALLS.inc(1)
+                head, est = in_flight[0]
+                resolve_handle(head)
+                in_flight.popleft()
+                if head.size:
+                    est_avg = (head.size if est_avg is None
+                               else 0.5 * (est_avg + head.size))
+                queued_gauge.set(float(_queued()))
+                yield head
+        finally:
+            # Consumer abandoned the stream: cancel the unread window.
+            for h, _ in in_flight:
+                try:
+                    ray_tpu.cancel(h.ref)
+                except Exception:
+                    pass
+            queued_gauge.set(0.0)
+
+
+class ShuffleOperator:
+    """All-to-all exchange operator; the heavy lifting (windowed
+    partition maps, transfer-plane reduce pulls, locality scoring)
+    lives in shuffle.exchange."""
+
+    def __init__(self, op: AllToAllOp, *,
+                 budget_bytes: Optional[int] = None,
+                 parallelism: Optional[int] = None,
+                 locality: bool = True):
+        self.op = op
+        self.name = op.__name__
+        self.budget = budget_bytes or cfg.data_op_budget_bytes
+        self.parallelism = parallelism
+        self.locality = locality
+
+    def iter_outputs(self, upstream: Iterable[BlockHandle]
+                     ) -> Iterator[BlockHandle]:
+        from ray_tpu.data._internal.shuffle import exchange
+        return exchange(upstream, self.op, parallelism=self.parallelism,
+                        budget_bytes=self.budget, locality=self.locality)
+
+
+def build_plan(stages, *, budget_bytes=None, parallelism=None,
+               locality: bool = True, n_blocks_hint=None) -> List:
+    """Compile a Dataset stage list into the physical operator chain."""
+    from ray_tpu.data.dataset import Dataset
+    plan: List = []
+    for kind, seg in split_segments(stages):
+        if kind == "map":
+            names = "+".join(getattr(s[0], "__name__", "stage").lstrip("_")
+                             for s in seg)
+            plan.append(MapOperator(Dataset._fuse(seg), names,
+                                    budget_bytes=budget_bytes,
+                                    parallelism=parallelism,
+                                    locality=locality,
+                                    n_blocks_hint=n_blocks_hint))
+        else:
+            plan.append(ShuffleOperator(seg,
+                                        budget_bytes=budget_bytes,
+                                        parallelism=parallelism,
+                                        locality=locality))
+    return plan

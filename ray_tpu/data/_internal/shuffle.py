@@ -1,0 +1,176 @@
+"""Windowed all-to-all shuffle riding the object transfer plane.
+
+Reference: python/ray/data/_internal/push_based_shuffle.py, re-based on
+this repo's transfer plane (PR 4/7): instead of folding every round's
+partitions into accumulator objects (each fold re-fetches, re-combines
+and re-serializes the running block, so the same bytes cross the store
+``rounds`` times), every input block is partitioned ONCE where it lives
+and every output is combined ONCE where most of its partition bytes
+live.  The partition movement is the reduce task's argument fetch —
+which is exactly ``TransferManager.pull``: windowed chunk requests,
+multi-source striping via the GCS object directory, spill-aware through
+the cached-fd pread path, and per-peer in-flight byte caps.  Bytes move
+exactly once, and they never touch the driver.
+
+Fault model: partition refs are owned by the driver, so a node dying
+mid-shuffle surfaces as a lost partition when a reduce fetches it; the
+owner's copy-holder check (PR 5 ``_object_source_alive``) distinguishes
+a partitioned-but-alive source (retry) from a dead one, and lineage
+reconstruction re-runs ONLY the map tasks whose partitions were
+actually lost — the rest of the exchange is untouched.
+
+Backpressure: partition maps run in a bounded window; reduces are
+admitted while ``parallelism`` and the output byte budget allow, and
+outputs stream to the consumer in output-index order (deterministic
+regardless of the window size).  Consumed partition columns are
+released eagerly so a larger-than-memory shuffle's store pressure
+drains as outputs are consumed (spill absorbs the rest).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, Iterator, Optional
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.data._internal.operators import (
+    AllToAllOp, BlockHandle, BYTES_SHUFFLED, BP_STALLS, OP_QUEUED,
+    auto_parallelism, handles_for, locality_opts, resolve_handle,
+    _owned_meta,
+)
+
+
+def _combine_task(combine_fn, out_idx, *parts):
+    return combine_fn(out_idx, *parts)
+
+
+def exchange(upstream: Iterable[BlockHandle], op: AllToAllOp, *,
+             parallelism: Optional[int] = None,
+             budget_bytes: Optional[int] = None,
+             locality: bool = True) -> Iterator[BlockHandle]:
+    """Run one all-to-all exchange; yields output handles in output
+    order.  Drains ``upstream`` first (an all-to-all is a pipeline
+    breaker: every output needs a partition from every input)."""
+    handles = [h for h in upstream]
+    n_in = len(handles)
+    if n_in == 0:
+        return
+    budget = budget_bytes or cfg.data_op_budget_bytes
+    window = parallelism or auto_parallelism(n_in)
+    n_out, partition_fn, combine_fn = op.bind([h.ref for h in handles])
+    if n_out == 1:
+        # num_returns=1 would store the 1-element partition LIST as the
+        # object's value, nesting blocks inside blocks at the combine
+        # (rows became block-lists).  Unwrap at the source.
+        _multi = partition_fn
+
+        def partition_fn(block, idx, _multi=_multi):  # noqa: F811
+            return _multi(block, idx)[0]
+    queued_gauge = OP_QUEUED.series(tags={"op": op.__name__})
+
+    # ---- map phase: partition every block where it lives, windowed.
+    part_task = ray_tpu.remote(partition_fn)
+    parts: list = [None] * n_in  # block index -> [n_out refs]
+    submitted = 0
+    in_flight: deque = deque()  # block indices with unresolved maps
+    try:
+        while submitted < n_in or in_flight:
+            while submitted < n_in and len(in_flight) < window:
+                h = handles[submitted]
+                opts = dict(locality_opts(h.location, locality))
+                opts["num_returns"] = n_out
+                out = part_task.options(**opts).remote(h.ref, submitted)
+                parts[submitted] = out if isinstance(out, list) else [out]
+                in_flight.append(submitted)
+                submitted += 1
+            idx = in_flight.popleft()
+            # Readiness of the first return implies the task finished
+            # (all returns land together); surfaces map errors eagerly.
+            resolve_handle(BlockHandle(parts[idx][0]))
+    except BaseException:
+        # A failed/abandoned map phase must not leave the rest of the
+        # window partitioning a dataset nobody will reduce.
+        for idx in in_flight:
+            try:
+                ray_tpu.cancel(parts[idx][0])
+            except Exception:
+                pass
+        raise
+
+    # Partition metadata: sizes feed the shuffle-bytes accounting and
+    # the locality score; locations come from the owner's bookkeeping
+    # (same source the GCS object directory is fed from).
+    flat = [r for col in parts for r in col]
+    meta = _owned_meta(flat)
+    moved = sum(m[0] for m in meta.values())
+    BYTES_SHUFFLED.inc(float(moved))
+
+    def _reduce_affinity(j):
+        """The node holding the most bytes of output j's partitions —
+        pull less, combine where the data already is."""
+        score: dict = {}
+        for i in range(n_in):
+            size, loc, _err = meta.get(parts[i][j].id, (0, None, False))
+            if loc is not None:
+                score[loc] = score.get(loc, 0) + (size or 0)
+        if not score:
+            return None
+        return max(score.items(), key=lambda kv: kv[1])[0]
+
+    # ---- reduce phase: one combine per output, windowed + budgeted.
+    reduce_task = ray_tpu.remote(_combine_task)
+    pending: deque = deque()  # (out_idx, BlockHandle, est_bytes)
+    est = max(1, moved // max(1, n_out))
+    next_out = 0
+
+    def _queued():
+        return sum(e for _, _, e in pending)
+
+    try:
+        while next_out < n_out or pending:
+            budget_blocked = False
+            while next_out < n_out and len(pending) < window:
+                if pending and _queued() >= budget:
+                    budget_blocked = True
+                    break
+                j = next_out
+                opts = locality_opts(_reduce_affinity(j), locality)
+                cols = [parts[i][j] for i in range(n_in)]
+                ref = (reduce_task.options(**opts) if opts
+                       else reduce_task).remote(combine_fn, j, *cols)
+                pending.append((j, BlockHandle(ref), est))
+                next_out += 1
+            if not pending:
+                break
+            if budget_blocked:
+                BP_STALLS.inc(1)
+            j, head, _e = pending[0]
+            resolve_handle(head)
+            pending.popleft()
+            # This output's partition column is consumed: release the
+            # refs so the store (or its spill) can reclaim them while
+            # the rest of the exchange is still running.
+            for i in range(n_in):
+                parts[i][j] = None
+            queued_gauge.set(float(_queued()))
+            yield head
+    finally:
+        for _j, h, _e in pending:
+            try:
+                ray_tpu.cancel(h.ref)
+            except Exception:
+                pass
+        queued_gauge.set(0.0)
+
+
+def exchange_bulk(refs, op: AllToAllOp, *, parallelism=None,
+                  locality: bool = True) -> list:
+    """Materializing form (Dataset._execute): drain the exchange and
+    return the output refs in order.  No output budget — the caller
+    wants everything — but maps/reduces still run windowed."""
+    out = [h.ref for h in exchange(handles_for(refs), op,
+                                   parallelism=parallelism,
+                                   budget_bytes=1 << 62,
+                                   locality=locality)]
+    return out

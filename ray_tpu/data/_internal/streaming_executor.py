@@ -1,0 +1,79 @@
+"""Operator-graph streaming executor for ray_tpu.data.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py —
+the pull-based executor that replaced bulk materialization as Ray
+Data's default.  A Dataset's pending stage list compiles to a chain of
+physical operators (operators.build_plan): chained per-block transforms
+fuse into one MapOperator, all-to-all stages become ShuffleOperators
+riding the transfer plane (shuffle.py).  Iteration composes the
+operators' ``iter_outputs`` generators, so the whole chain is driven by
+consumer pulls: while the consumer holds a batch (a train step),
+already-submitted tasks keep completing remotely, and no operator
+admits more input than its output budget allows.
+
+Peak driver memory is O(sum of operator budgets + one block being
+yielded); blocks between operators travel as handles, and the only
+bytes fetched to the consumer are the final stage's outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.data._internal.operators import (
+    BlockHandle, build_plan, handles_for,
+)
+
+
+class StreamingExecutor:
+    """Drive a stage list over materialized source blocks.
+
+    ``parallelism`` bounds each operator's in-flight task window
+    (default: ``cfg.data_shuffle_parallelism``, auto when <= 0);
+    ``budget_bytes`` is the per-operator output budget
+    (``cfg.data_op_budget_bytes``); ``locality=False`` disables
+    input-location placement hints (bench baseline).
+    """
+
+    def __init__(self, block_refs: List, stages, *,
+                 parallelism: Optional[int] = None,
+                 budget_bytes: Optional[int] = None,
+                 locality: bool = True):
+        self._refs = list(block_refs)
+        self._plan = build_plan(stages, budget_bytes=budget_bytes,
+                                parallelism=parallelism,
+                                locality=locality,
+                                n_blocks_hint=len(self._refs))
+
+    def iter_handles(self) -> Iterator[BlockHandle]:
+        """Compose the operator chain; yields final-stage handles."""
+        stream: Iterable[BlockHandle] = handles_for(self._refs)
+        self._streams = []
+        for op in self._plan:
+            stream = op.iter_outputs(stream)
+            self._streams.append(stream)
+        return iter(stream)
+
+    def close(self):
+        """Unwind the generator chain (outermost first) so every
+        operator's ``finally`` cancels its in-flight window."""
+        for stream in reversed(getattr(self, "_streams", [])):
+            close = getattr(stream, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    def iter_blocks(self) -> Iterator:
+        """Yield final blocks (fetched to the consumer) in order."""
+        stream = self.iter_handles()
+        try:
+            for h in stream:
+                yield ray_tpu.get(h.ref, timeout=cfg.data_get_timeout_s)
+        finally:
+            # Early abandon (break/islice) included: cancel everything
+            # still in flight.
+            self.close()

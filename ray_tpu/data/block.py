@@ -216,6 +216,8 @@ class BlockAccessor:
             keys = first.keys()
             return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
                     for k in keys}
+        if isinstance(first, np.ndarray):
+            return np.concatenate(blocks)
         try:
             import pyarrow as pa
             if isinstance(first, pa.Table):

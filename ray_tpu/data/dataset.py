@@ -20,9 +20,14 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.data.block import BlockAccessor
 
-_GET_TIMEOUT = 600.0
+
+def _get_timeout() -> float:
+    """One deadline for every data-layer get/wait
+    (``RT_DATA_GET_TIMEOUT_S``; was a hardcoded 600 s constant)."""
+    return cfg.data_get_timeout_s
 
 
 class DataContext:
@@ -93,6 +98,15 @@ def _push_shuffle(refs: List, partition_fn: Callable, n_out: int) -> List:
         return []
     rounds = max(1, DataContext.get_current().target_shuffle_rounds)
     round_size = max(1, (len(refs) + rounds - 1) // rounds)
+    if n_out == 1:
+        # num_returns=1 stores the 1-element partition LIST as the
+        # object's value; the accumulator would then concatenate
+        # block-LISTS as rows.  Unwrap at the source (same guard as
+        # the streaming exchange).
+        _multi = partition_fn
+
+        def partition_fn(block, idx, _multi=_multi):  # noqa: F811
+            return _multi(block, idx)[0]
     part_task = ray_tpu.remote(partition_fn).options(num_returns=n_out)
     accum = ray_tpu.remote(_accum_blocks)
     acc_refs: List = [None] * n_out
@@ -145,16 +159,40 @@ class Dataset:
         return _fused
 
     def _execute(self) -> List:
-        """Materialize all stages -> block refs (fused: one task per block
-        runs the whole stage chain)."""
+        """Materialize all stages -> block refs, segment-wise: fusable
+        map runs execute as one task per block (or one actor-pool pass),
+        all-to-all markers (streaming mode's lazy shuffle) run the
+        transfer-plane exchange."""
         if not self._stages:
             return self._block_refs
+        from ray_tpu.data._internal.operators import split_segments
+        import time as _time
+        # Pop-on-success throughout: a failed exchange (node death past
+        # the deadline) or a raising actor-pool segment must leave its
+        # stages pending, not silently yield the untransformed input to
+        # a retrying caller.
+        for kind, seg in split_segments(list(self._stages)):
+            if kind == "all_to_all":
+                from ray_tpu.data._internal.shuffle import exchange_bulk
+                t0 = _time.perf_counter()
+                self._block_refs = exchange_bulk(self._block_refs, seg)
+                del self._stages[:1]
+                self._stats.append({"stage": seg.__name__,
+                                    "blocks": len(self._block_refs),
+                                    "wall_s": _time.perf_counter() - t0})
+            else:
+                self._run_map_segment(seg)
+                del self._stages[:len(seg)]
+        return self._block_refs
+
+    def _run_map_segment(self, stages) -> None:
+        """One fused map run over every block (the pre-operator-graph
+        _execute body, now per segment)."""
         import time as _time
         t0 = _time.perf_counter()
         stage_names = "+".join(
             getattr(s[0], "__name__", "stage").lstrip("_")
-            for s in self._stages)
-        stages = self._stages
+            for s in stages)
         _fused = self._fuse(stages)
 
         actor_stages = [s for s in stages
@@ -167,7 +205,7 @@ class Dataset:
             for i, b in enumerate(self._block_refs):
                 actor = pool[i % pool_size]
                 refs.append(actor.apply.remote(_fused, b, (), {}))
-            out = ray_tpu.get(refs, timeout=_GET_TIMEOUT)
+            out = ray_tpu.get(refs, timeout=_get_timeout())
             blocks = [ray_tpu.put(b) for b in out]
             for a in pool:
                 ray_tpu.kill(a)
@@ -176,11 +214,9 @@ class Dataset:
             blocks = [task.remote(_fused, b, (), {})
                       for b in self._block_refs]
         self._block_refs = blocks
-        self._stages = []
         self._stats.append({"stage": stage_names,
                             "blocks": len(blocks),
                             "wall_s": _time.perf_counter() - t0})
-        return self._block_refs
 
     def stats(self) -> str:
         """Human-readable per-stage execution summary (reference:
@@ -197,7 +233,7 @@ class Dataset:
         self._execute()
         # Force completion so downstream count() etc. are cheap.
         ray_tpu.wait(self._block_refs, num_returns=len(self._block_refs),
-                     timeout=_GET_TIMEOUT)
+                     timeout=_get_timeout())
         self._enforce_block_size()
         return self
 
@@ -215,7 +251,7 @@ class Dataset:
 
         size_task = ray_tpu.remote(_size)
         sizes = ray_tpu.get([size_task.remote(b) for b in self._block_refs],
-                            timeout=_GET_TIMEOUT)
+                            timeout=_get_timeout())
         if all(s <= target for s in sizes):
             return
 
@@ -243,7 +279,7 @@ class Dataset:
 
     def _blocks(self) -> List:
         """Materialized local blocks."""
-        return ray_tpu.get(self._execute(), timeout=_GET_TIMEOUT)
+        return ray_tpu.get(self._execute(), timeout=_get_timeout())
 
     def _iter_local_blocks(self, max_in_flight: int = 4) -> Iterable:
         """Streaming block iterator (reference: the streaming executor
@@ -257,15 +293,32 @@ class Dataset:
         blocks) or when already materialized.  Streaming does not cache
         stage outputs: re-iterating re-executes the chain.
         """
+        from ray_tpu.data._internal.operators import AllToAllOp
         if self._stages and not any(
                 isinstance(s[1], ActorPoolStrategy) for s in self._stages):
-            from ray_tpu.data.streaming import StreamingExecutor
-            yield from StreamingExecutor(
-                self._block_refs, self._fuse(self._stages),
-                max_in_flight=max_in_flight).iter_blocks()
-            return
+            if cfg.data_streaming:
+                # Operator-graph executor: fused map operators with
+                # output budgets + pull backpressure; all-to-all
+                # markers stream through the transfer-plane exchange.
+                from ray_tpu.data._internal.streaming_executor import (
+                    StreamingExecutor)
+                yield from StreamingExecutor(
+                    self._block_refs, self._stages).iter_blocks()
+                return
+            if not any(isinstance(s[0], AllToAllOp)
+                       for s in self._stages):
+                # Legacy bounded-window map loop (RT_DATA_STREAMING=0
+                # — bench baseline).  A pended all-to-all marker (the
+                # knob was flipped between creation and consumption)
+                # cannot be fused as a map fn; it falls through to
+                # _execute(), which runs it segment-wise.
+                from ray_tpu.data.streaming import StreamingExecutor
+                yield from StreamingExecutor(
+                    self._block_refs, self._fuse(self._stages),
+                    max_in_flight=max_in_flight).iter_blocks()
+                return
         for ref in self._execute():
-            yield ray_tpu.get(ref, timeout=_GET_TIMEOUT)
+            yield ray_tpu.get(ref, timeout=_get_timeout())
 
     # ---------------------------------------------------------- transforms
     def map_batches(self, fn: Callable, *, batch_format: Optional[str] =
@@ -324,18 +377,29 @@ class Dataset:
         """Distributed repartition: every block is sliced into per-output
         row ranges by a task where the block LIVES, and each output is
         assembled by a merge task — no block ever rides through the
-        driver (the driver only sees row counts)."""
+        driver (the driver only sees row counts).  In streaming mode
+        the merge runs through the transfer-plane exchange (windowed,
+        locality-placed); legacy two-round graph kept as baseline."""
         refs = self._execute()
         num_blocks = max(1, num_blocks)
         if not refs:
             return Dataset([ray_tpu.put([]) for _ in range(num_blocks)])
+        if cfg.data_streaming:
+            from ray_tpu.data._internal.shuffle import exchange_bulk
+            return Dataset(exchange_bulk(refs,
+                                         _repartition_op(num_blocks)))
+        if num_blocks == 1:
+            # One merge task; the slice graph's num_returns=1 path
+            # would nest the 1-element slice LIST as the block value.
+            one = ray_tpu.remote(_accum_blocks)
+            return Dataset([one.remote(*refs)])
 
         def _rows(block):
             return BlockAccessor(block).num_rows()
 
         rows_task = ray_tpu.remote(_rows)
         counts = ray_tpu.get([rows_task.remote(b) for b in refs],
-                             timeout=_GET_TIMEOUT)
+                             timeout=_get_timeout())
         total = sum(counts)
         per = (total + num_blocks - 1) // num_blocks
         # Global row ranges -> per-input slice lists.
@@ -366,34 +430,36 @@ class Dataset:
                         for j in range(num_blocks)])
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Push-based shuffle (reference: _internal/push_based_shuffle.py
-        :330): map tasks run in ROUNDS, and each round's partitions are
-        folded into per-output accumulator blocks immediately — merge
-        work for round t overlaps map work for round t+1 instead of one
-        barrier-merge at the end, and no merge task ever holds more than
-        one round's partitions."""
+        """Random row shuffle.
+
+        Seeded shuffles are DETERMINISTIC for a fixed seed regardless
+        of parallelism or round structure: every per-block RNG derives
+        from (seed, block_index) and every output permutation from
+        (seed, output_index) — never from round interleaving — so the
+        streaming and legacy executors produce byte-identical results
+        (required for reproducible train ingest; regression-tested).
+
+        Streaming mode (RT_DATA_STREAMING=1): the shuffle PENDS as an
+        all-to-all stage and runs through the transfer-plane exchange
+        when consumed — partitions move once, windowed, pulled by
+        reduce tasks placed where most of their bytes live.  Legacy
+        mode keeps the push-based ROUND graph (map rounds folded into
+        per-output accumulators; reference:
+        _internal/push_based_shuffle.py:330) as the bench baseline."""
+        seed = seed if seed is not None else random.randrange(1 << 30)
+        if cfg.data_streaming:
+            return Dataset(
+                self._block_refs,
+                self._stages + [(_random_shuffle_op(seed), None, (), {})],
+                stats=self._stats, input_files=self._input_files)
         refs = self._execute()
         n_out = len(refs) or 1
-        seed = seed if seed is not None else random.randrange(1 << 30)
 
         def _partition(block, idx):
-            acc = BlockAccessor(block)
-            rows = acc.num_rows()
-            rng = np.random.RandomState((seed + idx) % (1 << 31))
-            assign = rng.randint(0, n_out, size=rows)
-            order = np.argsort(assign, kind="stable")
-            sizes = np.bincount(assign, minlength=n_out)
-            out, start = [], 0
-            for s in sizes:
-                idxs = order[start:start + s]
-                start += s
-                out.append(_take_rows(block, idxs))
-            return out
+            return _shuffle_partition_rows(block, idx, seed, n_out)
 
         def _finalize(block, out_idx):
-            acc = BlockAccessor(block)
-            rng = np.random.RandomState((seed ^ 0x5bd1e995) + out_idx)
-            return _take_rows(block, rng.permutation(acc.num_rows()))
+            return _shuffle_finalize_rows(block, seed, out_idx)
 
         out = _push_shuffle(refs, _partition, n_out)
         fin = ray_tpu.remote(_finalize)
@@ -427,7 +493,7 @@ class Dataset:
 
         sample_task = ray_tpu.remote(_sample)
         samples = ray_tpu.get([sample_task.remote(b) for b in refs],
-                              timeout=_GET_TIMEOUT)
+                              timeout=_get_timeout())
         merged = np.sort(np.concatenate(
             [s for s in samples if len(s)] or [np.array([])]))
         if len(merged) == 0:
@@ -478,7 +544,7 @@ class Dataset:
         rows_task = ray_tpu.remote(_block_rows)
         counts = ray_tpu.get(
             [rows_task.remote(b) for b in refs_a + refs_b],
-            timeout=_GET_TIMEOUT)
+            timeout=_get_timeout())
         counts_a, counts_b = counts[:len(refs_a)], counts[len(refs_a):]
         if sum(counts_a) != sum(counts_b):
             raise ValueError(
@@ -546,7 +612,7 @@ class Dataset:
     def _row_counts(self) -> List[int]:
         task = ray_tpu.remote(_block_rows)
         return ray_tpu.get([task.remote(b) for b in self._execute()],
-                           timeout=_GET_TIMEOUT)
+                           timeout=_get_timeout())
 
     def train_test_split(self, test_size: float | int, *,
                          shuffle: bool = False,
@@ -713,7 +779,7 @@ class Dataset:
         refs = self._execute()
         task = ray_tpu.remote(_accumulate_aggs)
         per_block = ray_tpu.get([task.remote(b, aggs) for b in refs],
-                                timeout=_GET_TIMEOUT)
+                                timeout=_get_timeout())
         out = []
         for j, agg in enumerate(aggs):
             acc = agg.init(None)
@@ -763,7 +829,7 @@ class Dataset:
             return BlockAccessor(block).size_bytes()
         task = ray_tpu.remote(_size)
         return sum(ray_tpu.get([task.remote(b) for b in self._execute()],
-                               timeout=_GET_TIMEOUT))
+                               timeout=_get_timeout()))
 
     def input_files(self) -> List[str]:
         """Source files for file-reader datasets (reference:
@@ -1043,6 +1109,8 @@ def _take_rows(block, idxs):
     b = acc._b
     if isinstance(b, list):
         return [b[int(i)] for i in idxs]
+    if isinstance(b, np.ndarray):
+        return b[np.asarray(idxs, dtype=np.int64)]
     if isinstance(b, dict):
         return {k: np.asarray(v)[idxs] for k, v in b.items()}
     try:
@@ -1052,6 +1120,88 @@ def _take_rows(block, idxs):
     except ImportError:
         pass
     return b.iloc[idxs]
+
+
+def _block_rng(seed: int, *idx: int):
+    """Per-block RNG derived from (seed, indices) — NEVER from round or
+    window structure, so a seeded shuffle's row assignment is identical
+    across parallelism settings and executors."""
+    return np.random.default_rng([seed & ((1 << 63) - 1), *idx])
+
+
+def _shuffle_partition_rows(block, idx: int, seed: int, n_out: int):
+    """Assign each row of block ``idx`` to one of ``n_out`` outputs:
+    one O(rows) random permutation split into even contiguous chunks
+    (every output gets rows/n_out ± 1 of each block — balanced by
+    construction, and ~5x cheaper than the old randint+stable-argsort
+    assignment, which dominated shuffle wall time).  The final
+    within-output permutation re-mixes across blocks."""
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    rng = _block_rng(seed, 1, idx)
+    perm = rng.permutation(rows)
+    bounds = np.linspace(0, rows, n_out + 1).astype(np.int64)
+    return [_take_rows(block, perm[bounds[j]:bounds[j + 1]])
+            for j in range(n_out)]
+
+
+def _shuffle_finalize_rows(block, seed: int, out_idx: int):
+    """Final within-output permutation, derived from (seed, out_idx)."""
+    acc = BlockAccessor(block)
+    rng = _block_rng(seed, 2, out_idx)
+    return _take_rows(block, rng.permutation(acc.num_rows()))
+
+
+def _random_shuffle_op(seed: int):
+    """The streaming executor's random_shuffle as an all-to-all op."""
+    from ray_tpu.data._internal.operators import AllToAllOp
+
+    def _bind(refs):
+        n_out = len(refs) or 1
+
+        def _partition(block, idx):
+            return _shuffle_partition_rows(block, idx, seed, n_out)
+
+        def _combine(out_idx, *parts):
+            block = BlockAccessor.combine(list(parts))
+            return _shuffle_finalize_rows(block, seed, out_idx)
+
+        return n_out, _partition, _combine
+
+    return AllToAllOp("random_shuffle", _bind)
+
+
+def _repartition_op(num_blocks: int):
+    """Row-range repartition as an all-to-all op: the bind step counts
+    rows where the blocks live; partition tasks slice their block's
+    global row range, combine tasks concatenate."""
+    from ray_tpu.data._internal.operators import AllToAllOp
+
+    def _bind(refs):
+        rows_task = ray_tpu.remote(_block_rows)
+        counts = ray_tpu.get([rows_task.remote(b) for b in refs],
+                             timeout=_get_timeout())
+        total = sum(counts)
+        per = (total + num_blocks - 1) // num_blocks
+        starts = np.cumsum([0] + counts)
+
+        def _partition(block, idx):
+            first_row = int(starts[idx])
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            out = []
+            for j in range(num_blocks):
+                lo = max(0, j * per - first_row)
+                hi = min(rows, (j + 1) * per - first_row)
+                out.append(acc.slice(lo, max(lo, hi)))
+            return out
+
+        def _combine(out_idx, *parts):
+            return BlockAccessor.combine(list(parts))
+
+        return num_blocks, _partition, _combine
+
+    return AllToAllOp("repartition", _bind)
 
 
 def from_items_single(rows: List, num_blocks: int) -> "Dataset":

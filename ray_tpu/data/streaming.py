@@ -12,6 +12,11 @@ still executing.
 Peak driver memory is O(max_in_flight * block size) instead of
 O(dataset size), and time-to-first-batch is one block's latency instead
 of the whole stage graph's.
+
+LEGACY (RT_DATA_STREAMING=0): superseded as the default consume path by
+the operator-graph executor in data/_internal/streaming_executor.py
+(fused operators with output budgets, transfer-plane all-to-all,
+locality placement); kept as the bench baseline and the escape hatch.
 """
 
 from __future__ import annotations
@@ -20,8 +25,7 @@ from collections import deque
 from typing import Callable, Iterable, List
 
 import ray_tpu
-
-_GET_TIMEOUT = 600.0
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 
 
 class StreamingExecutor:
@@ -53,7 +57,10 @@ class StreamingExecutor:
                     break
             while in_flight:
                 head = in_flight.popleft()
-                block = ray_tpu.get(head, timeout=_GET_TIMEOUT)
+                # cfg.data_get_timeout_s (RT_DATA_GET_TIMEOUT_S): the
+                # data layer's unified get deadline (was a hardcoded
+                # 600 s module constant).
+                block = ray_tpu.get(head, timeout=cfg.data_get_timeout_s)
                 # Refill the window BEFORE yielding: the consumer may
                 # hold the batch for a long time (training step) and
                 # the next blocks should be transforming meanwhile.

@@ -60,17 +60,33 @@ class _TrainWorker(CollectiveMixin):
             # same split and keeps its own shard (reference:
             # data_parallel_trainer dataset sharding to workers).
             # DatasetConfig(split=False) datasets arrive whole on every
-            # rank (the trainer sends (ds, split?) pairs; bare datasets
-            # from older callers default to split).
+            # rank (the trainer sends (ds, split?, ingest_opts)
+            # triples; bare datasets / 2-tuples from older callers
+            # default to split, no streaming ingest opts).
             for name, entry in datasets.items():
-                ds, do_split = entry if isinstance(entry, tuple) \
-                    else (entry, True)
+                ingest = None
+                if isinstance(entry, tuple):
+                    ds, do_split = entry[0], entry[1]
+                    if len(entry) > 2:
+                        ingest = entry[2]
+                else:
+                    ds, do_split = entry, True
                 if do_split and self.world_size > 1:
                     shards = ds.split(self.world_size)
-                    self._session.dataset_shards[name] = \
-                        shards[self.world_rank]
+                    shard = shards[self.world_rank]
                 else:
-                    self._session.dataset_shards[name] = ds
+                    shard = ds
+                if ingest:
+                    # Streaming ingest: per-epoch reshuffle through the
+                    # streaming executor, next epoch primed while the
+                    # step loop drains the current one.
+                    from ray_tpu.train.ingest import StreamingDatasetShard
+                    shard = StreamingDatasetShard(
+                        shard,
+                        shuffle_each_epoch=ingest.get(
+                            "shuffle_each_epoch", False),
+                        shuffle_seed=ingest.get("shuffle_seed"))
+                self._session.dataset_shards[name] = shard
         self._error = None
 
         def _run():
@@ -104,6 +120,16 @@ class _TrainWorker(CollectiveMixin):
         if self._session is not None:
             self._session.stop_requested = True
             self._session.continue_event.set()
+            # Drop any primed-but-unconsumed next-epoch pipeline (its
+            # in-flight window and block refs would otherwise linger
+            # until process exit).
+            for shard in self._session.dataset_shards.values():
+                close = getattr(shard, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
         if self._thread is not None:
             self._thread.join(timeout=5)
         return True

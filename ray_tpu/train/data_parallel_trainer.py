@@ -43,9 +43,17 @@ class DataParallelTrainer(BaseTrainer):
     def _prepared_datasets(self) -> Dict:
         """Apply DatasetConfig roles: fit the preprocessor on fit=True
         datasets, transform transform=True ones, shuffle global_shuffle
-        ones; returns {name: (dataset, split?)} (reference:
+        ones; returns {name: (dataset, split?, ingest_opts)} (reference:
         data_parallel_trainer dataset ingest + preprocessor fitting in
-        BaseTrainer.preprocess_datasets)."""
+        BaseTrainer.preprocess_datasets).
+
+        With the streaming data plane on (RT_DATA_STREAMING=1),
+        global_shuffle datasets are NOT shuffled eagerly here: each
+        rank's shard reshuffles per epoch through the streaming
+        executor (train/ingest.py StreamingDatasetShard), so the
+        shuffle's windows overlap the step loop instead of stalling
+        epoch boundaries."""
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
         from ray_tpu.air.config import DatasetConfig
         merged = DatasetConfig.validated(self._dataset_config,
                                          self._datasets)
@@ -60,9 +68,31 @@ class DataParallelTrainer(BaseTrainer):
             dc = merged[name]
             if pp is not None and dc.transform:
                 ds = pp.transform(ds)
+            # A USER-pended all-to-all (streaming random_shuffle called
+            # before handing the dataset over) must materialize ONCE
+            # here: every rank's split() would otherwise re-run the
+            # whole dataset-sized exchange for identical output.
+            from ray_tpu.data._internal.operators import AllToAllOp
+            if any(isinstance(s[0], AllToAllOp)
+                   for s in getattr(ds, "_stages", ())):
+                ds._execute()
+            ingest = None
             if dc.global_shuffle:
-                ds = ds.random_shuffle()
-            out[name] = (ds, bool(dc.split))
+                if cfg.data_streaming:
+                    seed = dc.shuffle_seed
+                    if seed is None:
+                        # Drawn ONCE on the driver: every rank must
+                        # share the epoch order (a split=False dataset
+                        # arrives whole on all ranks, and per-rank
+                        # random seeds would silently desync lockstep
+                        # consumers; the legacy path shuffled once).
+                        import random
+                        seed = random.randrange(1 << 30)
+                    ingest = {"shuffle_each_epoch": True,
+                              "shuffle_seed": seed}
+                else:
+                    ds = ds.random_shuffle(seed=dc.shuffle_seed)
+            out[name] = (ds, bool(dc.split), ingest)
         return out
 
     def training_loop(self) -> None:

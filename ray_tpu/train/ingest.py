@@ -1,0 +1,201 @@
+"""Streaming Data -> Train ingest (the L10 composition on our planes).
+
+Reference: python/ray/train/_internal/dataset_iterator.py /
+DataIterator — each rank consumes its dataset shard through the
+streaming executor instead of a materialized snapshot, so the shard's
+next window transforms/shuffles on the cluster WHILE the worker runs
+its train step, and an epoch boundary no longer stalls the step loop:
+
+* Within an epoch, ``iter_batches`` streams through the operator-graph
+  executor (data/_internal/streaming_executor.py): map windows and the
+  transfer-plane shuffle's reduces complete remotely while the consumer
+  holds a batch.
+* Across epochs, the NEXT epoch's pipeline is primed by a background
+  thread as soon as the current epoch starts draining — by the time the
+  step loop re-enters ``iter_batches``, the first window of the
+  reshuffled epoch is already materializing.
+
+Per-epoch shuffling derives its seed from ``(shuffle_seed, epoch)``
+(deterministic: a fixed ``DatasetConfig.shuffle_seed`` reproduces the
+exact batch sequence across runs and parallelism settings — see
+``Dataset.random_shuffle``).  NOTE the documented semantics shift under
+streaming ingest: ``global_shuffle`` becomes a per-epoch shuffle of the
+rank's OWN shard (blocks are sharded once, rows reshuffle within the
+shard every epoch) — the Ray-style local-shuffle tradeoff.  For a
+one-shot whole-dataset shuffle across shards, set RT_DATA_STREAMING=0
+or shuffle explicitly before passing the dataset to the trainer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+
+class StreamingDatasetShard:
+    """One rank's streaming view of a prepared dataset.  Everything a
+    plain Dataset offers still works (``count``/``take_all``/... are
+    delegated); ``iter_batches`` adds the per-epoch reshuffle + the
+    cross-epoch window priming."""
+
+    def __init__(self, ds, *, shuffle_each_epoch: bool = False,
+                 shuffle_seed: Optional[int] = None):
+        self._ds = ds
+        self._shuffle = bool(shuffle_each_epoch)
+        if shuffle_seed is None:
+            import random
+            shuffle_seed = random.randrange(1 << 30)
+        self._seed = shuffle_seed
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self._primed = None  # (epoch, kw_key, first_item_or_END, iter)
+        self._prime_thread = None
+        self._closed = False
+
+    # ------------------------------------------------------------ delegate
+    def __getattr__(self, name):
+        return getattr(self._ds, name)
+
+    @property
+    def epoch(self) -> int:
+        """Epochs started so far (== times iter_batches was entered)."""
+        return self._epoch
+
+    # ------------------------------------------------------------- epochs
+    def _epoch_dataset(self, epoch: int):
+        if not self._shuffle:
+            return self._ds
+        return self._ds.random_shuffle(seed=(self._seed * 2654435761
+                                             + epoch) % (1 << 31))
+
+    @staticmethod
+    def _kw_key(kw: dict) -> tuple:
+        return tuple(sorted(kw.items()))
+
+    _END = object()
+
+    def _prime(self, epoch: int, kw: dict):
+        """Background-build the next epoch's iterator and pull its
+        first batch, so the reshuffle's first window is already in
+        flight when the step loop re-enters iter_batches."""
+        if self._prime_thread is not None and self._prime_thread.is_alive():
+            return
+
+        def _run():
+            try:
+                it = self._epoch_dataset(epoch).iter_batches(**kw)
+                first = next(it, self._END)
+                with self._lock:
+                    # A close() that outlived its join(timeout) must
+                    # still win: publishing after it would leak the
+                    # iterator's in-flight window forever.
+                    if self._closed:
+                        publish = False
+                    else:
+                        self._primed = (epoch, self._kw_key(kw),
+                                        first, it)
+                        publish = True
+                if not publish:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+            except Exception:
+                with self._lock:
+                    self._primed = None
+
+        self._prime_thread = threading.Thread(
+            target=_run, daemon=True, name="rt-ingest-prime")
+        self._prime_thread.start()
+
+    def _take_primed(self, epoch: int, kw: dict):
+        if self._prime_thread is not None:
+            self._prime_thread.join()
+            self._prime_thread = None
+        with self._lock:
+            primed, self._primed = self._primed, None
+        if primed is None or primed[0] != epoch \
+                or primed[1] != self._kw_key(kw):
+            if primed is not None:
+                close = getattr(primed[3], "close", None)
+                if close is not None:
+                    close()
+            return None
+        _e, _k, first, it = primed
+        if first is self._END:
+            return iter(())
+
+        def _chain():
+            yield first
+            yield from it
+        return _chain()
+
+    def iter_batches(self, _prime_next: bool = True, **kw) -> Iterator:
+        # Eager body (not a generator): the epoch advances and the next
+        # epoch's priming starts at CALL time, not at first consumption.
+        epoch = self._epoch
+        self._epoch += 1
+        it = self._take_primed(epoch, kw)
+        if it is None:
+            it = self._epoch_dataset(epoch).iter_batches(**kw)
+        if self._shuffle and _prime_next:
+            self._prime(epoch + 1, kw)
+
+        def _drain():
+            try:
+                yield from it
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+        return _drain()
+
+    def iter_epochs(self, epochs: int, **kw):
+        """``epochs`` successive (re-shuffled) passes.  The final epoch
+        skips the next-epoch prime: for a shuffled shard the prime runs
+        the exchange's whole map phase, and an epoch nobody will
+        consume must not pay it."""
+        for e in range(epochs):
+            yield self.iter_batches(_prime_next=e + 1 < epochs, **kw)
+
+    # Tensor/row consumption MUST route through this wrapper's
+    # iter_batches: the trainer skips the eager global shuffle under
+    # streaming ingest, so delegating these to the raw Dataset (whose
+    # identically-named methods call Dataset.iter_batches internally)
+    # would silently train on UNSHUFFLED data.  Shuffle-invariant
+    # surfaces (count/schema/sum/...) still delegate via __getattr__.
+    def iter_rows(self, **kw) -> Iterator:
+        for batch in self.iter_batches(batch_format="pylist", **kw):
+            yield from batch
+
+    def iter_torch_batches(self, *, batch_size: int = 256, **kw):
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()} \
+                if isinstance(batch, dict) else torch.as_tensor(batch)
+
+    def iter_jax_batches(self, *, batch_size: int = 256, sharding=None,
+                         **kw):
+        import jax
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            if sharding is not None:
+                place = lambda v: jax.device_put(v, sharding)  # noqa: E731
+            else:
+                place = jax.device_put
+            yield ({k: place(v) for k, v in batch.items()}
+                   if isinstance(batch, dict) else place(batch))
+
+    def close(self):
+        """Drop a primed-but-unconsumed epoch (cancels its window)."""
+        with self._lock:
+            self._closed = True
+        if self._prime_thread is not None:
+            self._prime_thread.join(timeout=30)
+            self._prime_thread = None
+        with self._lock:
+            primed, self._primed = self._primed, None
+        if primed is not None:
+            close = getattr(primed[3], "close", None)
+            if close is not None:
+                close()
