@@ -21,7 +21,7 @@ SHELL := /bin/bash
 	bench-quick bench-llm-quick bench-transfer bench-collective \
 	bench-collective-quick bench-control bench-control-quick \
 	bench-serve-scale bench-serve-scale-quick bench-data \
-	bench-data-quick chaos chaos-smoke
+	bench-data-quick bench-trace bench-trace-quick chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -134,6 +134,19 @@ bench-data-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite data --quick
 
+# Always-on tracing overhead A/B (record() ns, RPC hot path, serve
+# streaming soak; paired on/off windows, median statistic).  ASSERTS
+# overhead <= 5% on both system legs.  Refreshes BENCH_trace.json.
+bench-trace:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite trace --json-out BENCH_trace.json
+
+# <60 s tracing-overhead gate for make check: same paired A/B at smoke
+# sizing, same <= 5% assertion.  Does NOT touch the checked-in artifact.
+bench-trace-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite trace --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -164,6 +177,8 @@ chaos:
 		tests/test_serve_scale.py::test_stream_interrupted_structured_when_failover_disabled \
 		tests/test_serve_scale.py::test_gcs_faults_during_serve_streams \
 		tests/test_data_streaming.py::test_node_death_mid_shuffle_reissues_only_lost_partitions \
+		tests/test_tracing.py::test_serve_failover_stream_keeps_one_trace_id \
+		tests/test_tracing.py::test_http_sse_trace_header_links_client_proxy_replica \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -183,7 +198,7 @@ chaos-smoke:
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
 	bench-collective-quick bench-control-quick bench-serve-scale-quick \
-	bench-data-quick
+	bench-data-quick bench-trace-quick
 
 store: ray_tpu/_private/_shm_store.so
 

@@ -15,7 +15,9 @@ from ray_tpu._private.api import (  # noqa: F401
     available_resources,
     cancel,
     cluster_resources,
+    cluster_trace,
     get,
+    get_trace,
     get_actor,
     get_gpu_ids,
     get_runtime_context,

@@ -363,9 +363,18 @@ def timeline(filename: str | None = None):
     """Chrome-trace events for every process in the cluster (reference:
     `ray timeline`, python/ray/_private/state.py chrome_tracing_dump —
     events aggregated from the per-process telemetry pushed to the GCS
-    KV)."""
+    KV).
+
+    STALE CONVENIENCE VIEW: each process's KV push carries only the
+    freshest ring tail and lags by the push period; the authoritative
+    path is ``cluster_trace()`` (the ``dump_trace`` RPC pull, whole
+    rings on demand).  Truncation is self-describing: every process
+    contributes a ``trace.ring_meta`` instant event recording its drop
+    count and ring coverage window."""
     import json
     import pickle
+
+    from ray_tpu._private import tracing as _tracing
     w = _worker()
     keys = w._run(w._gcs_request("kv_keys",
                                  {"ns": "telemetry", "prefix": b""}))["keys"]
@@ -377,13 +386,124 @@ def timeline(filename: str | None = None):
         if blob is None:
             continue
         try:
-            events.extend(pickle.loads(blob).get("profile", []))
+            payload = pickle.loads(blob)
+            events.extend(payload.get("profile", []))
+            stats = payload.get("trace_stats")
+            if stats is not None:
+                stats = dict(stats, pid=payload.get("pid"))
+                events.append(_tracing.meta_event(stats))
         except Exception:
             continue
     # The driver's own events never round-trip through the KV push delay.
     events.extend(w._profile_events)
+    events.append(_tracing.meta_event())
     events.sort(key=lambda e: e.get("ts", 0))
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+def cluster_trace(stats_only: bool = False,
+                  filename: str | None = None):
+    """Pull every process's span ring NOW (the authoritative trace
+    path): the driver's own ring, the GCS's, and — via one
+    ``dump_trace`` RPC per raylet, fanned out to its registered
+    workers — every node process.  Returns
+    ``{"processes": [per-process dump], "events": merged chrome-trace
+    list}`` (events omitted with stats_only); each process contributes
+    a ``trace.ring_meta`` event so truncation is visible.  Backs
+    ``rt timeline --cluster`` and ``rt trace <id>``."""
+    import asyncio
+    import json
+
+    from ray_tpu._private import protocol
+    from ray_tpu._private import tracing as _tracing
+    w = _worker()
+
+    async def _collect():
+        procs = []
+        try:
+            d = await w._gcs_request("dump_trace",
+                                     {"stats_only": stats_only})
+            procs.append(d)
+        except Exception as e:
+            procs.append({"role": "gcs",
+                          "error": f"{type(e).__name__}: {e}"})
+        nodes = await w._gcs_request("get_nodes", {})
+
+        async def _one(view):
+            try:
+                conn = await protocol.Connection.connect(
+                    view["addr"][0], view["addr"][1],
+                    name="trace-pull", timeout=10)
+                try:
+                    return await conn.request(
+                        "dump_trace", {"stats_only": stats_only,
+                                       "include_workers": True},
+                        timeout=30.0)
+                finally:
+                    await conn.close()
+            except Exception as e:
+                return {"role": "raylet",
+                        "node_id": view["node_id"].hex(),
+                        "error": f"{type(e).__name__}: {e}"}
+
+        replies = await asyncio.gather(
+            *[_one(v) for v in nodes if v.get("alive")])
+        for r in replies:
+            if "processes" in r:
+                procs.extend(r["processes"])
+            else:
+                procs.append(r)
+        return procs
+
+    procs = w._run(_collect())
+    procs.append(dict(_tracing.dump(stats_only=stats_only),
+                      role="driver"))
+    # One ring can be reached through several doors (the GCS, every
+    # in-process raylet, and the driver itself may SHARE a process in
+    # test clusters): keep one dump per ring — the largest, so a
+    # stats_only stub never shadows a full dump.  The key is the ring's
+    # per-process random id, NOT the bare OS pid: two containerized
+    # nodes routinely hold workers with the same pid, and deduping on
+    # pid would silently discard one node's whole ring.
+    by_ring: dict = {}
+    for p in procs:
+        # Error stubs carry no ring_id; their worker/node id is still
+        # unique cluster-wide, unlike a containerized pid.
+        key = (p.get("ring_id") or p.get("worker_id")
+               or p.get("node_id") or p.get("pid"))
+        if key is None:
+            by_ring[object()] = p
+            continue
+        cur = by_ring.get(key)
+        if cur is None or len(p.get("events", ())) > \
+                len(cur.get("events", ())):
+            by_ring[key] = p
+    procs = list(by_ring.values())
+    out = {"processes": [
+        {k: v for k, v in p.items() if k != "events"} for p in procs]}
+    if not stats_only:
+        events = []
+        for p in procs:
+            events.extend(p.get("events", ()))
+            if "depth" in p:
+                events.append(_tracing.meta_event(p))
+        events.sort(key=lambda e: e.get("ts", 0))
+        out["events"] = events
+        if filename:
+            with open(filename, "w") as f:
+                json.dump(events, f)
+    return out
+
+
+def get_trace(trace_id: str):
+    """Assemble ONE request's span tree from a cluster-wide ring pull:
+    ``cluster_trace()`` merged events filtered to ``trace_id``, linked
+    parent→child (cross-process via the propagated span ids), with the
+    derived per-stage latency breakdown (TTFT decomposition when the
+    serve/engine taxonomy is present).  Backs ``rt trace <id>``."""
+    from ray_tpu._private import tracing as _tracing
+    events = cluster_trace()["events"]
+    return _tracing.assemble(events, trace_id)

@@ -208,6 +208,29 @@ class _Config:
     actor_restart_wait_s = _def("actor_restart_wait_s", float, 300.0)
     task_queue_warn_len = _def("task_queue_warn_len", int, 100000)
 
+    # --- tracing (the cross-plane span runtime, _private/tracing.py) ---
+    # Always-on per-process span ring; set false to hard-disable every
+    # record (the fast path is one bool check — measured by
+    # `bench.py --suite trace` and gated <=5% in make bench-trace-quick).
+    trace_enabled = _def("trace_enabled", bool, True)
+    # Bounded ring capacity (drop-oldest; drops counted and exported as
+    # tracing_events_dropped_total).
+    trace_ring_capacity = _def("trace_ring_capacity", int, 8192)
+    # Complete events WITHOUT span linkage shorter than this are not
+    # recorded (perf-only noise gate); linked spans always record —
+    # dropping them would hole the request tree.
+    trace_min_dur_us = _def("trace_min_dur_us", float, 0.0)
+    # RPC handlers slower than this record an rpc.slow span (0 disables).
+    trace_rpc_slow_ms = _def("trace_rpc_slow_ms", float, 50.0)
+    # Sample 1/N engine decode ticks as engine.decode_tick spans (the
+    # tick runs thousands of times per second; 0 disables tick spans).
+    trace_decode_tick_sample = _def("trace_decode_tick_sample", int, 64)
+    # Byte cap on the pickled telemetry KV push (the stale convenience
+    # view).  The push must stay control-plane-sized: anything
+    # chunk-sized belongs on raw transfer frames, and the authoritative
+    # trace path is the dump_trace pull, which has no such cap.
+    trace_kv_push_budget = _def("trace_kv_push_budget", int, 48 * 1024)
+
     # --- logging ---
     log_to_driver = _def("log_to_driver", bool, True)
 

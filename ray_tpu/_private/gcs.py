@@ -27,6 +27,7 @@ import time
 from collections import deque
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 from ray_tpu._private.placement import (choose_nodes_for_bundles,
@@ -959,6 +960,7 @@ class GcsServer:
                 batch_max = max(1, cfg.gcs_pubsub_batch_max)
                 drained = []
                 q = sub.queue
+                t_flush = time.time()
                 while q and len(drained) < batch_max:
                     drained.append(q.popleft())
                 st["sent_msgs"] += len(drained)
@@ -997,6 +999,17 @@ class GcsServer:
                     sub.gapped.clear()
                 st["sent_frames"] += len(items)
                 conn.push_send_many_nowait(items)
+                # Batch flushes (2+ coalesced events) land in the span
+                # ring: the timeline shows WHEN fan-out bursts happened
+                # and how much one frame folded.  Singleton pushes are
+                # steady-state noise and stay out of the ring.
+                if len(drained) > 1:
+                    _tracing.record(
+                        "gcs", "gcs.pubsub_flush", t_flush,
+                        time.time() - t_flush,
+                        args={"events": len(drained),
+                              "frames": len(items),
+                              "subscriber": getattr(conn, "name", "?")})
                 await conn.backpressure()
         except asyncio.CancelledError:
             return
@@ -1064,7 +1077,10 @@ class GcsServer:
         resources = dict(actor.spec.get("resources") or {})
         strategy = actor.spec.get("scheduling_strategy")
         deadline = time.monotonic() + 120.0
+        t_sched = time.time()
+        attempts = 0
         while time.monotonic() < deadline:
+            attempts += 1
             node = self._pick_node(resources, strategy, actor.pg_id,
                                    actor.spec.get("bundle_index"))
             if node is None:
@@ -1103,6 +1119,14 @@ class GcsServer:
             actor.worker_id = reply.get("worker_id")
             actor.spec["pid"] = reply.get("pid")
             actor.state = ALIVE
+            # Scheduling-decision span: queue-to-ALIVE latency with the
+            # chosen node and how many pick/lease rounds it took.
+            _tracing.record(
+                "gcs", "gcs.schedule_actor", t_sched,
+                time.time() - t_sched,
+                args={"actor_id": actor.actor_id.hex()[:12],
+                      "node": node.node_id.hex()[:12],
+                      "attempts": attempts})
             await self._publish("actors", {"event": "alive",
                                            "actor": actor.view()})
             self._wake_actor_waiters(actor)
@@ -1496,6 +1520,15 @@ class GcsServer:
                       "demand_nodes": len(self._demand_nodes)},
             "pending_actor_creations": len(self._pending_actor_creations),
         }
+
+    async def rpc_dump_trace(self, conn, body):
+        """Pull-path trace dump: the GCS process's span ring
+        (scheduling decisions, pubsub batch flushes, slow RPC
+        handlers) for rt timeline --cluster / rt trace."""
+        body = body or {}
+        return dict(_tracing.dump(stats_only=bool(body.get("stats_only")),
+                                  clear=bool(body.get("clear"))),
+                    role="gcs")
 
 
 def main():

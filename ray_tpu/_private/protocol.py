@@ -154,7 +154,7 @@ def enable_eager_tasks(loop=None) -> None:
 HANDLER_STATS: dict = {}
 
 
-def _record_handler(method: str, dt: float) -> None:
+def _record_handler(method: str, dt: float, inline: bool = False) -> None:
     s = HANDLER_STATS.get(method)
     if s is None:
         s = HANDLER_STATS[method] = [0, 0.0, 0.0]
@@ -162,6 +162,24 @@ def _record_handler(method: str, dt: float) -> None:
     s[1] += dt
     if dt > s[2]:
         s[2] = dt
+    # Slow INLINE handlers land in the span ring (rpc.slow): one float
+    # compare on the hot path; the import resolves once.  A wedged IO
+    # plane then shows WHICH handler ate the loop, with timestamps,
+    # instead of only the aggregate mean in handler_stats.  Only the
+    # inline path qualifies — a handler that suspended was awaiting
+    # (long-polls, task execution), not blocking the loop, and flagging
+    # those would flood the ring with healthy calls.
+    slow_ms = cfg.trace_rpc_slow_ms
+    if inline and slow_ms > 0 and dt * 1000.0 >= slow_ms:
+        global _tracing
+        if _tracing is None:
+            from ray_tpu._private import tracing as _tracing_mod
+            _tracing = _tracing_mod
+        _tracing.record("rpc", "rpc.slow", time.time() - dt, dt,
+                        args={"method": method})
+
+
+_tracing = None  # lazily bound by _record_handler's slow path
 
 
 def handler_stats_snapshot() -> dict:
@@ -678,14 +696,14 @@ class Connection:
             first = coro.send(None)
         except StopIteration as si:
             # Completed without awaiting: reply inline, no task.
-            _record_handler(method, time.perf_counter() - t0)
+            _record_handler(method, time.perf_counter() - t0, inline=True)
             if not push:
                 self._reply_result(msg_id, method, si.value)
             return
         except Exception as e:
             # Failing handlers count too — they are exactly the calls
             # these stats exist to surface.
-            _record_handler(method, time.perf_counter() - t0)
+            _record_handler(method, time.perf_counter() - t0, inline=True)
             if push:
                 logger.exception("push handler %s failed on %s",
                                  method, self.name)

@@ -31,6 +31,7 @@ import sys
 import time
 
 from ray_tpu._private import failpoints, protocol, retry
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.shm_store import StoreServer, StoreMapping, default_store_path
@@ -1775,6 +1776,15 @@ class Raylet:
         timeout = body.get("timeout", 60.0)
         deadline = time.monotonic() + timeout
         location = body.get("location")  # NodeID where the object lives
+        # Caller's span context (worker-side get): a pull recorded here
+        # links into the task's trace, crossing worker -> raylet.  The
+        # flow edge closes HERE, not inside TransferManager.pull: the
+        # resolution may be served without a fresh pull (already local,
+        # joined an in-flight pull or push), and the worker's flow-start
+        # must not dangle in those cases.
+        trace = body.get("trace")
+        if trace and trace.get("flow"):
+            _tracing.flow_end(trace["flow"], "transfer")
         if oid in self.spilled and not self.store.contains(oid):
             await self._restore_spilled(oid)
         got = self.store.get(oid)
@@ -1806,7 +1816,8 @@ class Raylet:
             backoff = retry.ExpBackoff(0.05, 1.0)
             ok = False
             while True:
-                ok = await self._pull_object(oid, location, deadline)
+                ok = await self._pull_object(oid, location, deadline,
+                                             trace)
                 if ok:
                     break
                 if time.monotonic() >= deadline:
@@ -1896,7 +1907,8 @@ class Raylet:
         self.peer_conns[node_id] = conn
         return conn
 
-    async def _pull_object(self, oid, location, deadline) -> bool:
+    async def _pull_object(self, oid, location, deadline,
+                           trace=None) -> bool:
         if oid in self._pulls_inflight:
             try:
                 return await asyncio.wait_for(
@@ -1907,7 +1919,7 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self._pulls_inflight[oid] = fut
         try:
-            ok = await self._do_pull(oid, location, deadline)
+            ok = await self._do_pull(oid, location, deadline, trace)
             if not fut.done():
                 fut.set_result(ok)
             return ok
@@ -1919,7 +1931,7 @@ class Raylet:
         finally:
             self._pulls_inflight.pop(oid, None)
 
-    async def _do_pull(self, oid, location, deadline) -> bool:
+    async def _do_pull(self, oid, location, deadline, trace=None) -> bool:
         if oid in self._push_recv:
             # A push of this object is already streaming in: wait for its
             # seal instead of double-allocating.  If the pushing sender
@@ -1936,7 +1948,8 @@ class Raylet:
                 return False
         # Windowed, possibly striped transfer (TransferManager resolves
         # extra sealed sources via the GCS object directory).
-        return await self.transfers.pull(oid, location, deadline)
+        return await self.transfers.pull(oid, location, deadline,
+                                         trace=trace)
 
     async def rpc_os_stat(self, conn, body):
         oid = body["oid"]
@@ -2301,6 +2314,39 @@ class Raylet:
         """Transfer-plane counters (pull/push volumes, striping,
         retries) for tests and observability."""
         return dict(self.transfers.stats)
+
+    async def rpc_dump_trace(self, conn, body):
+        """Pull-path trace dump for this node: the raylet's own span
+        ring plus — with include_workers (default on) — every
+        registered worker's ring, fanned out concurrently.  Returns
+        {"processes": [per-process dump...]}; a worker that fails to
+        answer contributes an {"error": ...} stub instead of failing
+        the node dump."""
+        body = body or {}
+        stats_only = bool(body.get("stats_only"))
+        clear = bool(body.get("clear"))
+        procs = [dict(_tracing.dump(stats_only=stats_only, clear=clear),
+                      role="raylet", node_id=self.node_id.hex())]
+        if body.get("include_workers", True):
+            targets = [w for w in list(self.workers.values())
+                       if w.conn is not None and not w.conn.closed]
+
+            async def _one(w):
+                try:
+                    d = await w.conn.request(
+                        "dump_trace", {"stats_only": stats_only,
+                                       "clear": clear}, timeout=10.0)
+                    d["role"] = "worker"
+                    d["worker_id"] = w.worker_id.hex()
+                    return d
+                except Exception as e:
+                    return {"role": "worker", "pid": w.pid,
+                            "worker_id": w.worker_id.hex(),
+                            "error": f"{type(e).__name__}: {e}"}
+
+            procs.extend(await asyncio.gather(*[_one(w)
+                                                for w in targets]))
+        return {"processes": procs, "node_id": self.node_id.hex()}
 
     # ------------------------------------------------------ state API feeds
     async def rpc_pool_stats(self, conn, body):

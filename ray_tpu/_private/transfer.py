@@ -38,6 +38,7 @@ import time
 from collections import deque
 
 from ray_tpu._private import failpoints
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 
 logger = logging.getLogger(__name__)
@@ -184,13 +185,38 @@ class TransferManager:
             self._peer_waiters.pop(node_id, None)
 
     # ----------------------------------------------------------- pull side
-    async def pull(self, oid: bytes, location, deadline) -> bool:
+    async def pull(self, oid: bytes, location, deadline,
+                   trace=None) -> bool:
         """Pull oid into the local arena under ONE deadline.  Returns
-        True once a sealed local copy exists."""
+        True once a sealed local copy exists.
+
+        ``trace`` is the requesting worker's span context (riding the
+        os_get body): the transfer span links as a child of the task
+        span — a task-graph trace crosses into its transfer pulls.
+        (The worker→raylet flow edge is closed by rpc_os_get, which
+        reaches here only when a fresh pull actually runs.)"""
+        token = None
+        if trace is not None:
+            token = _tracing.set_current(trace["trace_id"],
+                                         trace.get("parent_id"))
+        try:
+            with _tracing.span("transfer", "transfer.pull",
+                               args={"oid": oid.hex()[:12]}) as h:
+                ok = await self._pull_impl(oid, location, deadline, h)
+                h.args["ok"] = ok
+                return ok
+        finally:
+            if token is not None:
+                _tracing.reset_current(token)
+
+    async def _pull_impl(self, oid: bytes, location, deadline, h) -> bool:
         r = self.raylet
         sources, size = await self._stat_sources(oid, location, deadline)
         if not sources:
+            h.args["no_source"] = True
             return False
+        h.args["size"] = size
+        h.args["sources"] = len(sources)
         try:
             off = await r._alloc_with_spill(oid, size)
         except KeyError:
@@ -210,9 +236,12 @@ class TransferManager:
             if cfg.transfer_same_host_mmap:
                 ok = await self._mmap_pull(oid, size, dest, sources,
                                            deadline)
+                if ok:
+                    h.args["mmap"] = True
             if not ok:
                 if len(sources) > 1:
                     self.stats["striped_pulls"] += 1
+                    h.args["striped"] = True
                 ok = await self._windowed_fetch(oid, size, dest, sources,
                                                 deadline)
         except BaseException:
@@ -359,11 +388,24 @@ class TransferManager:
                               sources, deadline) -> bool:
         """Keep up to cfg.transfer_window_chunks chunk requests in
         flight, striped round-robin across sources; chunks from a
-        failed source requeue onto survivors."""
+        failed source requeue onto survivors.  The window records a
+        child span under the transfer.pull span (chunk counts, retries,
+        sources lost) with instant events marking each source death."""
+        with _tracing.span("transfer", "transfer.window") as _h:
+            ok = await self._windowed_fetch_impl(oid, size, dest,
+                                                 sources, deadline, _h)
+            _h.args["ok"] = ok
+            return ok
+
+    async def _windowed_fetch_impl(self, oid: bytes, size: int, dest,
+                                   sources, deadline, _h) -> bool:
         chunk = max(1, cfg.fetch_chunk_bytes)
         todo = deque([pos, min(chunk, size - pos), set()]
                      for pos in range(0, size, chunk))
         total = len(todo)
+        _h.args["chunks"] = total
+        _h.args["sources"] = len(sources)
+        retries = 0
         live = dict(sources)  # node_id -> peer conn
         window = max(1, cfg.transfer_window_chunks)
         pending: dict = {}  # task -> (entry, node_id)
@@ -418,6 +460,13 @@ class TransferManager:
                 live.pop(nid, None)
                 ent[2].add(nid)
                 self.stats["chunk_retries"] += 1
+                retries += 1
+                _h.args["retries"] = retries
+                _tracing.event(
+                    "transfer", "transfer.source_dead",
+                    args={"oid": oid.hex()[:12],
+                          "source": _node_tag(nid), "chunk_at": ent[0],
+                          "survivors": len(live), "err": str(err)})
                 logger.info("pull %s chunk @%d from %s failed (%s); "
                             "%d source(s) left", oid.hex()[:8], ent[0],
                             getattr(nid, "hex", lambda: str(nid))()[:8],
@@ -488,6 +537,15 @@ class TransferManager:
         """Stream a local sealed object to one peer: os_push_begin
         (receiver allocates / dedups), then windowed raw chunk frames
         out of the arena mapping."""
+        with _tracing.span("transfer", "transfer.push",
+                           args={"oid": oid.hex()[:12],
+                                 "target": _node_tag(target_node_id)}) \
+                as h:
+            ok = await self._push_impl(oid, target_node_id, h)
+            h.args["ok"] = ok
+            return ok
+
+    async def _push_impl(self, oid: bytes, target_node_id, h) -> bool:
         r = self.raylet
         got = r.store.get(oid)  # pins while we stream
         if got is None:
@@ -500,6 +558,7 @@ class TransferManager:
         if not sealed:
             r.store.release(oid)
             return False
+        h.args["size"] = size
         try:
             peer = await r._peer(target_node_id)
             if peer is None:

@@ -22,7 +22,6 @@ from __future__ import annotations
 import asyncio
 import functools
 import heapq
-import itertools
 import logging
 import os
 import threading
@@ -41,45 +40,19 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.shm_store import StoreMapping
 from ray_tpu._private.task_spec import (ActorCreationSpec, ActorTaskSpec,
                                         TaskSpec)
-from ray_tpu.util import tracing as _tracing
+from ray_tpu._private import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
 global_worker: "CoreWorker | None" = None
 
 # Distributed trace context, propagated inside task specs (reference:
-# util/tracing/tracing_helper.py — otel context rides the TaskSpec; here
-# the span tree lands in ray_tpu.timeline() chrome-trace args).
-import contextvars  # noqa: E402
-
-_TRACE: contextvars.ContextVar = contextvars.ContextVar(
-    "rt_trace", default=None)  # (trace_id, span_id) | None
-
-# Fresh-trace ids: a per-process random base + counter instead of one
-# os.urandom syscall per submission (urandom is painfully expensive on
-# syscall-filtered hosts; uniqueness only needs process entropy once).
-_TRACE_BASE = os.urandom(5).hex()
-_trace_counter = itertools.count(1).__next__
-
-
-def _reseed_trace_base():
-    """At-fork hook: zygote-forked workers must not mint the parent's
-    trace-id stream (same rationale as ids._reseed_id_bases)."""
-    global _TRACE_BASE, _trace_counter
-    _TRACE_BASE = os.urandom(5).hex()
-    _trace_counter = itertools.count(1).__next__
-
-
-os.register_at_fork(after_in_child=_reseed_trace_base)
-
-
-def _trace_for_submit():
-    """Current (or fresh) trace context to stamp on an outgoing task."""
-    ctx = _TRACE.get()
-    if ctx is None:
-        return {"trace_id": f"{_TRACE_BASE}{_trace_counter():06x}",
-                "parent_id": None}
-    return {"trace_id": ctx[0], "parent_id": ctx[1]}
+# util/tracing/tracing_helper.py — otel context rides the TaskSpec).
+# The contextvar, id minting, and the per-process span ring all live in
+# _private/tracing.py now that every plane records spans, not just
+# task/actor execution here.
+_TRACE = _tracing._TRACE
+_trace_for_submit = _tracing.trace_for_submit
 
 
 # Serializes cross-thread attachment of concurrent.futures waiters to
@@ -354,8 +327,16 @@ class CoreWorker:
         self._loop_ident: int | None = None
         self._pubsub_handlers: dict[str, object] = {}
         self._gcs_reconnect_lock: asyncio.Lock | None = None
-        # chrome-trace profile events for ray_tpu.timeline()
-        self._profile_events: list[dict] = []
+        # Chrome-trace profile events for ray_tpu.timeline(): the
+        # process-wide span ring (_private/tracing.py) — bounded,
+        # drop-oldest, drained authoritatively by the dump_trace RPC.
+        self._trace_ring = _tracing.ring()
+
+    @property
+    def _profile_events(self) -> list:
+        """Snapshot view of this process's span ring (compat surface
+        for ray_tpu.timeline()'s driver-side merge)."""
+        return self._trace_ring.snapshot()
 
     # ------------------------------------------------------------ lifecycle
     def start_driver(self):
@@ -625,7 +606,6 @@ class CoreWorker:
         ray_tpu.timeline()).  Also measures this process's event-loop lag
         (reference: the instrumented asio event loop, event_stats.h) —
         sustained lag means a handler is blocking the IO plane."""
-        import pickle
         lag_gauge = None
         try:
             from ray_tpu.util.metrics import Gauge
@@ -649,20 +629,62 @@ class CoreWorker:
                     pass
             try:
                 from ray_tpu.util import metrics as metrics_mod
+                # Ring health rides the metrics push: the drop counter
+                # (tracing_events_dropped_total) reaches prometheus, so
+                # an overflowing ring is visible without a trace pull.
+                _tracing.export_metrics()
                 snaps = metrics_mod.registry_snapshot()
-                events = self._profile_events[-2000:]
-                if not snaps and not events:
+                # STALE CONVENIENCE VIEW: the KV push truncates to the
+                # freshest ring tail and lags by the push period.  The
+                # authoritative path is the dump_trace RPC pull
+                # (ray_tpu.cluster_trace / rt timeline --cluster),
+                # which drains the whole ring on demand.
+                payload = self._telemetry_payload(snaps)
+                if payload is None:
                     continue
                 await self._gcs_request("kv_put", {
                     "ns": "telemetry", "key": self.worker_id.binary(),
-                    "value": pickle.dumps({
-                        "snapshots": snaps, "profile": events,
-                        "rpc_handlers":
-                            protocol.handler_stats_snapshot(),
-                        "pid": os.getpid(), "mode": self.mode})})
+                    "value": payload})
             except Exception:
                 if self._shutdown:
                     return
+
+    def _telemetry_payload(self, snaps):
+        """Build one telemetry KV push, capped at
+        cfg.trace_kv_push_budget bytes (the profile tail halves until it
+        fits).  The push must stay control-plane-sized: a full ring tail
+        pickles to hundreds of KiB, which belongs on the dump_trace
+        pull, not the heartbeat.  Returns None when there is nothing to
+        push."""
+        import pickle
+        events = self._trace_ring.tail(2000)
+        if not snaps and not events:
+            return None
+
+        def _dumps(evs):
+            return pickle.dumps({
+                "snapshots": snaps, "profile": evs,
+                # Ring coverage + drop counts: timeline() synthesizes a
+                # trace.ring_meta event per process, so a truncated
+                # trace says WHAT it could not retain.
+                "trace_stats": self._trace_ring.stats(),
+                "rpc_handlers": protocol.handler_stats_snapshot(),
+                "pid": os.getpid(), "mode": self.mode})
+
+        payload = _dumps(events)
+        budget = cfg.trace_kv_push_budget
+        while len(payload) > budget and events:
+            events = events[-(len(events) // 2):] if len(events) > 1 else []
+            payload = _dumps(events)
+        # Degenerate guard: high-cardinality metric snapshots (per-tenant
+        # counters etc.) can pickle past the budget with NO events at
+        # all.  The push must never ship a chunk-sized pickle onto the
+        # control plane, so halve the snapshot list too — prometheus is
+        # a best-effort view; the next push re-snapshots everything.
+        while len(payload) > budget and len(snaps) > 1:
+            snaps = snaps[:len(snaps) // 2]
+            payload = _dumps(events)
+        return payload
 
     async def rpc_pubsub(self, conn, body):
         """GCS pubsub push (driver-side: mirrored worker logs, error
@@ -856,7 +878,8 @@ class CoreWorker:
         remaining = self._remain(deadline)
         self._notify_blocked()
         try:
-            return self._run(self._get_async_list([ref], remaining))[0]
+            return self._run(self._get_async_list(
+                [ref], remaining, trace=_tracing.current_dict()))[0]
         finally:
             self._notify_unblocked()
 
@@ -872,7 +895,8 @@ class CoreWorker:
         if any(e is None for e in entries):
             self._notify_blocked()
             try:
-                return self._run(self._get_async_list(refs, timeout))
+                return self._run(self._get_async_list(
+                    refs, timeout, trace=_tracing.current_dict()))
             finally:
                 self._notify_unblocked()
         # Fail fast on errors already in hand, like the gather path did.
@@ -934,7 +958,8 @@ class CoreWorker:
             self._notify_blocked()
             try:
                 slow_values = self._run(self._get_async_list(
-                    [refs[i] for i in slow_idx], remaining))
+                    [refs[i] for i in slow_idx], remaining,
+                    trace=_tracing.current_dict()))
             finally:
                 self._notify_unblocked()
             for i, v in zip(slow_idx, slow_values):
@@ -977,19 +1002,25 @@ class CoreWorker:
     async def get_async(self, ref: ObjectRef):
         return await self._get_one(ref)
 
-    async def _get_async_list(self, refs, timeout=None):
+    async def _get_async_list(self, refs, timeout=None, trace=None):
+        """``trace`` is the CALLER THREAD's span context: the sync get
+        paths capture it before hopping to the IO loop (contextvars do
+        not cross run_coroutine_threadsafe), so a store fetch that
+        escalates into a transfer-plane pull stays in the task's
+        trace."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        coros = [self._get_one(r, deadline) for r in refs]
+        coros = [self._get_one(r, deadline, trace) for r in refs]
         return list(await asyncio.gather(*coros))
 
-    async def _get_one(self, ref: ObjectRef, deadline=None):
-        blob = await self._resolve_blob(ref, deadline)
+    async def _get_one(self, ref: ObjectRef, deadline=None, trace=None):
+        blob = await self._resolve_blob(ref, deadline, trace)
         value = serialization.deserialize(blob)
         if isinstance(value, _SerializedError):
             raise value.to_exception()
         return value
 
-    async def _resolve_blob(self, ref: ObjectRef, deadline=None):
+    async def _resolve_blob(self, ref: ObjectRef, deadline=None,
+                            trace=None):
         entry = self.owned.get(ref.id)
         if entry is not None:
             if not entry.ready():
@@ -1001,7 +1032,7 @@ class CoreWorker:
                 return entry.blob
             try:
                 return await self._fetch_from_store(ref.id, entry.location,
-                                                    deadline)
+                                                    deadline, trace)
             except rexc.ObjectLostError:
                 # The node holding the primary copy died: reconstruct by
                 # re-executing the creating task, then re-resolve.
@@ -1009,7 +1040,7 @@ class CoreWorker:
                 if entry.state in (INLINE, ERRORED):
                     return entry.blob
                 return await self._fetch_from_store(ref.id, entry.location,
-                                                    deadline)
+                                                    deadline, trace)
         # Borrowed ref: ask the owner.
         cached = self._borrow_cache.get(ref.id)
         if cached is not None:
@@ -1026,7 +1057,7 @@ class CoreWorker:
             return status["blob"]
         try:
             return await self._fetch_from_store(ref.id, status["location"],
-                                                deadline)
+                                                deadline, trace)
         except rexc.ObjectLostError:
             # Report the loss to the owner, who recovers via lineage and
             # tells us where the object lives now.
@@ -1038,9 +1069,10 @@ class CoreWorker:
                 self._borrow_cache[ref.id] = status["blob"]
                 return status["blob"]
             return await self._fetch_from_store(
-                ref.id, status["location"], deadline)
+                ref.id, status["location"], deadline, trace)
 
-    async def _fetch_from_store(self, oid: ObjectID, location, deadline=None):
+    async def _fetch_from_store(self, oid: ObjectID, location,
+                                deadline=None, trace=None):
         if self.raylet is None:
             raise rexc.ObjectLostError(oid.hex(), "no raylet (local mode)")
         # The remaining budget travels as ONE deadline: the raylet
@@ -1049,10 +1081,21 @@ class CoreWorker:
         # chunk).  The RPC timeout is slightly larger so the raylet's
         # own deadline error wins the race and keeps its detail.
         budget = self._remain(deadline) or 60.0
-        reply = await self.raylet.request("os_get", {
-            "oid": oid.binary(), "location": location,
-            "timeout": budget,
-        }, timeout=budget + 5.0)
+        body = {"oid": oid.binary(), "location": location,
+                "timeout": budget}
+        if trace is None:
+            # Async callers (actor coroutines) still carry the context
+            # in THIS task; sync callers captured it pre-hop.
+            trace = _tracing.current_dict()
+        if trace is not None and location is not None:
+            # The trace crosses into the raylet only when a remote pull
+            # may run (a local sealed copy records nothing): flow-start
+            # here, flow-finish inside TransferManager.pull.
+            trace = dict(trace, flow=_tracing.fresh_id())
+            _tracing.flow_start(trace["flow"], "transfer")
+            body["trace"] = trace
+        reply = await self.raylet.request("os_get", body,
+                                          timeout=budget + 5.0)
         if "error" in reply:
             if reply.get("timeout"):
                 # The resolution ran out of the caller's budget — that
@@ -1350,6 +1393,13 @@ class CoreWorker:
             refs.append(ObjectRef(oid, owner_addr=self.addr, _track=True))
         args_blob = self._pack_args(args, kwargs)
         pg = opts.get("placement_group")
+        trace = _trace_for_submit()
+        # Submit-side flow start: the execution span (possibly another
+        # process) closes the edge, connecting the waterfall.  No flow
+        # id = un-spanned submit (nothing to connect from; keeps the
+        # ambient per-call cost at one ring event).
+        if "flow" in trace:
+            _tracing.flow_start(trace["flow"])
         spec = TaskSpec.new(
             task_id=task_id,
             fn_id=fn_id,
@@ -1363,7 +1413,7 @@ class CoreWorker:
                                  cfg.max_task_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
             name=opts.get("name", ""),
-            trace=_trace_for_submit(),
+            trace=trace,
             runtime_env=(self._pack_runtime_env(opts["runtime_env"])
                          if opts.get("runtime_env") else None),
             pg_id=pg.id if pg is not None else None,
@@ -2029,37 +2079,29 @@ class CoreWorker:
             ctx.tpu_ids = []
 
     @staticmethod
-    def _enter_span(trace):
+    def _enter_span(trace, cat: str = "task"):
         """Adopt the submitter's trace context with a fresh span id so
-        tasks submitted from here link as children."""
-        if not trace:
-            return None
-        span = {"trace_id": trace["trace_id"],
-                "span_id": os.urandom(4).hex(),
-                "parent_id": trace.get("parent_id")}
-        _TRACE.set((span["trace_id"], span["span_id"]))
-        return span
+        tasks submitted from here link as children; closes the
+        submit-side flow edge (chrome ph "s"/"f" pair)."""
+        return _tracing.adopt(trace, cat)
 
     def _record_profile_event(self, cat: str, name: str, t0: float,
                               trace=None):
         """Chrome-trace complete event (reference: core worker profiling
-        events, src/ray/core_worker/profiling.h; dumped by
-        ray_tpu.timeline()).  Trace args link spans across processes."""
-        event = {
-            "cat": cat, "name": name, "ph": "X",
-            "pid": os.getpid(),
-            "tid": threading.get_ident() & 0xFFFF,
-            "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6,
-        }
-        if trace:
-            event["args"] = trace
-        self._profile_events.append(event)
-        if len(self._profile_events) > 10000:
-            del self._profile_events[:5000]
-        # Optional live span export (no-op unless this process called
-        # tracing.enable_tracing — reference: tracing_helper's lazily
-        # enabled otel spans).
-        _tracing.maybe_export(event)
+        events, src/ray/core_worker/profiling.h) into the bounded
+        process ring — drop-oldest with a counted, exported drop total
+        (was: a bare list that silently deleted half its buffer at
+        10k).  Trace args link spans across processes."""
+        _tracing.record(cat, name, t0, time.time() - t0, trace=trace)
+
+    async def rpc_dump_trace(self, conn, body):
+        """Pull-path trace dump: drain (or stat) this process's span
+        ring on demand — the authoritative source for rt timeline
+        --cluster / rt trace (the telemetry KV push is a truncated,
+        lagging convenience view)."""
+        body = body or {}
+        return _tracing.dump(stats_only=bool(body.get("stats_only")),
+                             clear=bool(body.get("clear")))
 
     def _load_function(self, fn_id: bytes):
         fn = self._fn_cache.get(fn_id)
@@ -2088,7 +2130,8 @@ class CoreWorker:
         the releasing path — a fetch truly waiting on an unscheduled
         producer still frees its CPU and the pool keeps moving."""
         try:
-            return self._run(self._get_async_list([ref], 2.0))[0]
+            return self._run(self._get_async_list(
+                [ref], 2.0, trace=_tracing.current_dict()))[0]
         except Exception:
             pass
         return self.get(ref)
@@ -2349,6 +2392,14 @@ class CoreWorker:
                      if group != "_default" else None) or self._max_concurrency
                 sem = self._actor_async_sems[group] = asyncio.Semaphore(n)
             async with sem:
+                # Async actor methods adopt the caller's trace context
+                # too (was: only the sync-pool paths recorded spans, so
+                # every async actor call — serve replicas included —
+                # was a tracing hole and broke trace continuity).
+                t0 = time.time()
+                # Default "task" cat: the submit-side flow_start used it,
+                # and chrome matches flow pairs by (cat, name, id).
+                span = self._enter_span(body.get("trace"))
                 try:
                     args, kwargs = await self.loop.run_in_executor(
                         None, self._unpack_args, body["args"])
@@ -2357,6 +2408,9 @@ class CoreWorker:
                         None, self._pack_results, result, spec)
                 except Exception as e:
                     return {"error": _error_blob(e, traceback.format_exc())}
+                finally:
+                    self._record_profile_event(
+                        "actor_task", body["method"], t0, trace=span)
         pool = self._actor_pools.get(group) or self._actor_pools["_default"]
         if pool._max_workers == 1:
             # The common sync-actor shape: drain-batched serial dispatch
@@ -2421,6 +2475,8 @@ class CoreWorker:
         body["args"] = args_blob
         body["return_ids"] = return_ids
         body["trace"] = _trace_for_submit()
+        if "flow" in body["trace"]:
+            _tracing.flow_start(body["trace"]["flow"])
         entry = {"body": body, "retries": opts.get("max_task_retries", 0),
                  "attempts": 0, "fut": None, "seq": None, "conn": None,
                  "failed": None, "cancelled": False, "driver": False}

@@ -29,10 +29,12 @@ drains as outputs are consumed (spill absorbs the rest).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Iterable, Iterator, Optional
 
 import ray_tpu
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.data._internal.operators import (
     AllToAllOp, BlockHandle, BYTES_SHUFFLED, BP_STALLS, OP_QUEUED,
@@ -70,6 +72,7 @@ def exchange(upstream: Iterable[BlockHandle], op: AllToAllOp, *,
     queued_gauge = OP_QUEUED.series(tags={"op": op.__name__})
 
     # ---- map phase: partition every block where it lives, windowed.
+    t_map = time.time()
     part_task = ray_tpu.remote(partition_fn)
     parts: list = [None] * n_in  # block index -> [n_out refs]
     submitted = 0
@@ -105,6 +108,15 @@ def exchange(upstream: Iterable[BlockHandle], op: AllToAllOp, *,
     meta = _owned_meta(flat)
     moved = sum(m[0] for m in meta.values())
     BYTES_SHUFFLED.inc(float(moved))
+    # Exchange map-phase span (driver side): the per-task execution and
+    # transfer-pull spans live in worker/raylet rings; this records the
+    # phase envelope + byte accounting in the request's trace.
+    _tracing.record("data", "data.shuffle_map", t_map,
+                    time.time() - t_map,
+                    trace=_tracing.child_span(),
+                    args={"op": op.__name__, "blocks": n_in,
+                          "partitions": n_out, "bytes": moved})
+    t_reduce = time.time()
 
     def _reduce_affinity(j):
         """The node holding the most bytes of output j's partitions —
@@ -162,6 +174,11 @@ def exchange(upstream: Iterable[BlockHandle], op: AllToAllOp, *,
             except Exception:
                 pass
         queued_gauge.set(0.0)
+        _tracing.record("data", "data.shuffle_reduce", t_reduce,
+                        time.time() - t_reduce,
+                        trace=_tracing.child_span(),
+                        args={"op": op.__name__, "outputs": n_out,
+                              "abandoned": len(pending)})
 
 
 def exchange_bulk(refs, op: AllToAllOp, *, parallelism=None,

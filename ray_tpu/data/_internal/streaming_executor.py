@@ -18,9 +18,11 @@ bytes fetched to the consumer are the final stage's outputs.
 
 from __future__ import annotations
 
+import time
 from typing import Iterable, Iterator, List, Optional
 
 import ray_tpu
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.data._internal.operators import (
     BlockHandle, build_plan, handles_for,
@@ -70,10 +72,22 @@ class StreamingExecutor:
     def iter_blocks(self) -> Iterator:
         """Yield final blocks (fetched to the consumer) in order."""
         stream = self.iter_handles()
+        t0 = time.time()
+        n = 0
         try:
             for h in stream:
                 yield ray_tpu.get(h.ref, timeout=cfg.data_get_timeout_s)
+                n += 1
         finally:
             # Early abandon (break/islice) included: cancel everything
             # still in flight.
             self.close()
+            # Execution-envelope span (consumer wall-clock included —
+            # backpressure IS the story); operator tasks and their
+            # transfer pulls record in worker/raylet rings under the
+            # same trace.
+            _tracing.record("data", "data.execute", t0,
+                            time.time() - t0,
+                            trace=_tracing.child_span(),
+                            args={"operators": len(self._plan),
+                                  "blocks_out": n})

@@ -5,10 +5,18 @@ and experimental/state/state_cli.py (`ray list actors/tasks/...`).
 Usage: python -m ray_tpu.scripts.cli <command> [...] --address host:port
 
 Commands:
-  status                      cluster resources + nodes
+  status                      cluster resources + nodes + trace rings
   list {nodes,actors,tasks,objects,placement-groups,jobs,events}
   summary {tasks,objects}
-  timeline [--output FILE]    chrome-trace dump
+  timeline [--output FILE]    chrome-trace dump (KV-push convenience
+                              view; lags by the push period)
+  timeline --cluster          authoritative pull: drain every process's
+                              span ring NOW via the dump_trace RPC
+  trace [TRACE_ID]            assemble one request's span tree across
+                              processes, with per-stage latency
+                              breakdown (TTFT decomposition for serve
+                              requests); without an id, list recent
+                              trace ids
   job submit -- <entrypoint>  supervised job; streams status
   job logs <submission_id>
   job stop <submission_id>
@@ -199,6 +207,19 @@ def cmd_status(args):
         n["utilization"] = format_utilization(stats) or "(pending)"
         rows.append(n)
     _print_rows(rows)
+    # Trace-ring health per process: depth/capacity and — the signal —
+    # the drop counter (nonzero = the ring overflowed; pull traces more
+    # often or raise RT_TRACE_RING_CAPACITY).
+    print("trace rings:")
+    trows = []
+    for p in ray_tpu.cluster_trace(stats_only=True)["processes"]:
+        trows.append({
+            "role": p.get("role", "?"), "pid": p.get("pid", ""),
+            "depth": p.get("depth", ""),
+            "capacity": p.get("capacity", ""),
+            "dropped": p.get("dropped", ""),
+            "error": p.get("error", "")})
+    _print_rows(trows)
 
 
 def cmd_list(args):
@@ -231,13 +252,56 @@ def cmd_summary(args):
 def cmd_timeline(args):
     import ray_tpu
     _connect(args.address)
-    events = ray_tpu.timeline(filename=args.output)
+    if args.cluster:
+        # Authoritative pull: one dump_trace RPC per process, whole
+        # rings, NOW — vs the default KV-push view that truncates to
+        # each ring's tail and lags by the push period.
+        out = ray_tpu.cluster_trace(filename=args.output)
+        events = out["events"]
+        dropped = sum(p.get("dropped", 0) or 0
+                      for p in out["processes"])
+        print(f"pulled {len(events)} events from "
+              f"{len(out['processes'])} process(es); "
+              f"{dropped} dropped ring-side")
+    else:
+        events = ray_tpu.timeline(filename=args.output)
     if args.output:
         print(f"wrote {len(events)} events to {args.output}")
-    else:
+    elif not args.cluster:
         print(json.dumps(events[:50], indent=2))
         if len(events) > 50:
             print(f"... {len(events) - 50} more (use --output FILE)")
+
+
+def cmd_trace(args):
+    """Assemble one request's span tree (serve request or task graph)
+    across every process it touched; without an id, list the trace ids
+    seen in the cluster's rings, newest first."""
+    import ray_tpu
+    from ray_tpu._private import tracing
+    _connect(args.address)
+    if not args.trace_id:
+        events = ray_tpu.cluster_trace()["events"]
+        ids = tracing.trace_ids(events)
+        rows = [{"trace_id": tid, "events": n,
+                 "root": name or "?",
+                 "age_s": round(max(0.0, (events[-1].get("ts", 0)
+                                          - (ts or 0)) / 1e6), 1)
+                 if events else ""}
+                for tid, (n, ts, name) in sorted(
+                    ids.items(), key=lambda kv: -(kv[1][1] or 0))[:25]]
+        _print_rows(rows)
+        print("rt trace <trace_id> for the span tree")
+        return
+    tree = ray_tpu.get_trace(args.trace_id)
+    if not tree["spans"] and not tree["annotations"]:
+        print(f"no events for trace {args.trace_id!r} (already "
+              "rotated out of the rings, or wrong id)")
+        return
+    if args.format == "json":
+        print(json.dumps(tree, indent=2, default=repr))
+    else:
+        print(tracing.format_trace(tree))
 
 
 def cmd_job(args):
@@ -482,9 +546,23 @@ def main(argv=None):
     sp.add_argument("entity", choices=["tasks", "objects"])
     sp.set_defaults(fn=cmd_summary)
 
-    tp = sub.add_parser("timeline")
+    tp = sub.add_parser(
+        "timeline", help="chrome-trace dump (default: KV-push view; "
+        "--cluster drains every process's span ring now)")
     tp.add_argument("--output", default=None)
+    tp.add_argument("--cluster", action="store_true",
+                    help="authoritative pull via the dump_trace RPC "
+                         "(merges GCS, raylets, and every worker)")
     tp.set_defaults(fn=cmd_timeline)
+
+    trp = sub.add_parser(
+        "trace", help="assemble one request's cross-process span tree "
+        "with a per-stage latency breakdown (TTFT decomposition for "
+        "serve requests); no id lists recent traces")
+    trp.add_argument("trace_id", nargs="?", default=None)
+    trp.add_argument("--format", choices=["tree", "json"],
+                     default="tree")
+    trp.set_defaults(fn=cmd_trace)
 
     jp = sub.add_parser("job")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)

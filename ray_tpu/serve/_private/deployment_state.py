@@ -16,6 +16,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
 from ray_tpu.util import metrics as _metrics
 
@@ -160,6 +161,11 @@ class ReplicaWrapper:
         self.state = DRAINING
         self._drain_started = now
         self._drain_deadline = now + timeout_s
+        # Timeline annotation: scale-downs show up against the serve
+        # spans they displace (controller process ring).
+        _tracing.event("serve", "serve.drain",
+                       args={"replica": self.replica_tag,
+                             "timeout_s": timeout_s})
         # Demand a FRESH ongoing sample before declaring the drain
         # complete: the pre-drain cached value predates the routers
         # learning this replica left the broadcast — and an in-flight
@@ -321,6 +327,8 @@ class DeploymentState:
                     # oversubscribe it past max_concurrent_queries.
                     if not r.confirmed_idle(now_ud):
                         continue
+                    _tracing.event("serve", "serve.undrain",
+                                   args={"replica": r.replica_tag})
                     logger.info("un-draining replica %s (target rose "
                                 "back)", r.replica_tag)
                     r.state = RUNNING

@@ -14,12 +14,29 @@ import json as _json
 import logging
 from typing import Dict, Optional
 
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve._private.replica import Request
 from ray_tpu.serve._private.router import ReplicaSet
 from ray_tpu.serve.exceptions import StreamInterrupted, TenantThrottled
 
 logger = logging.getLogger(__name__)
+
+
+def _adopt_trace_header(headers: Dict[str, str]):
+    """Adopt a client-side trace context riding the `x-rt-trace`
+    header ("trace_id:parent_span_id") — a driver that spans its HTTP
+    call sees the proxy/replica/engine spans land in the SAME trace.
+    Returns the contextvar reset token, or None."""
+    hdr = next((v for k, v in (headers or {}).items()
+                if k.lower() == "x-rt-trace"), None)
+    if not hdr:
+        return None
+    try:
+        tid, pid = hdr.split(":", 1)
+    except ValueError:
+        return None
+    return _tracing.set_current(tid.strip(), pid.strip() or None)
 
 
 def _throttle_response(e: TenantThrottled):
@@ -211,27 +228,43 @@ class HTTPProxyActor:
             body = await request.read()
             query = dict(request.query)
             headers_in = dict(request.headers)
-            # Root stays the routes listing whatever the Accept header
-            # says — only routed paths can stream.
-            if request.path not in ("", "/") \
-                    and HTTPProxy.wants_stream(query, headers_in):
-                return await self._handle_sse(request, body, query,
-                                              headers_in)
-            status, payload, ctype, *rest = await self._proxy.handle(
-                request.method, request.path, query, body,
-                headers_in)
-            # ASGI ingress responses carry full headers (Set-Cookie,
-            # Location, ...); content-type/length ride dedicated kwargs.
-            # A pair list (not a dict) feeds the CIMultiDict so
-            # repeated names all reach the wire.
-            raw = rest[0] if rest else []
-            pairs = raw.items() if isinstance(raw, dict) else raw
-            headers = [(k, v) for k, v in pairs
-                       if k.lower() not in ("content-type",
-                                            "content-length")]
-            return web.Response(status=status, body=payload,
-                                content_type=ctype.split(";")[0],
-                                headers=headers)
+            # Inbound trace context (x-rt-trace) makes the proxy span a
+            # child of the CLIENT's span; otherwise serve.request roots
+            # a fresh trace.  Either way the trace id is echoed back as
+            # x-rt-trace-id so the client can `rt trace <id>` it.
+            token = _adopt_trace_header(headers_in)
+            try:
+                # Root stays the routes listing whatever the Accept
+                # header says — only routed paths can stream.
+                if request.path not in ("", "/") \
+                        and HTTPProxy.wants_stream(query, headers_in):
+                    return await self._handle_sse(
+                        request, body, query, headers_in,
+                        fresh_root=token is None)
+                with _tracing.span("serve", "serve.request",
+                                   args={"method": request.method,
+                                         "path": request.path},
+                                   root=token is None) as h:
+                    status, payload, ctype, *rest = \
+                        await self._proxy.handle(
+                            request.method, request.path, query, body,
+                            headers_in)
+                # ASGI ingress responses carry full headers (Set-Cookie,
+                # Location, ...); content-type/length ride dedicated
+                # kwargs.  A pair list (not a dict) feeds the
+                # CIMultiDict so repeated names all reach the wire.
+                raw = rest[0] if rest else []
+                pairs = raw.items() if isinstance(raw, dict) else raw
+                headers = [(k, v) for k, v in pairs
+                           if k.lower() not in ("content-type",
+                                                "content-length")]
+                headers.append(("x-rt-trace-id", h.trace_id))
+                return web.Response(status=status, body=payload,
+                                    content_type=ctype.split(";")[0],
+                                    headers=headers)
+            finally:
+                if token is not None:
+                    _tracing.reset_current(token)
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", _handler)
@@ -260,7 +293,21 @@ class HTTPProxyActor:
 
     async def _handle_sse(self, request, body: bytes,
                           query: Dict[str, str],
-                          headers_in: Dict[str, str]):
+                          headers_in: Dict[str, str],
+                          fresh_root: bool = True):
+        """serve.request span wrapper for the SSE path: the span covers
+        accept → stream complete, so failovers and the token loop land
+        inside it; the trace id rides back on x-rt-trace-id."""
+        with _tracing.span("serve", "serve.request",
+                           args={"method": request.method,
+                                 "path": request.path, "sse": True},
+                           root=fresh_root) as h:
+            return await self._handle_sse_impl(request, body, query,
+                                               headers_in, h)
+
+    async def _handle_sse_impl(self, request, body: bytes,
+                               query: Dict[str, str],
+                               headers_in: Dict[str, str], span):
         """Server-sent events: each item the deployment yields becomes
         one `data: <json>` event, flushed immediately (chunked transfer,
         no buffering) so the first token reaches the client while the
@@ -275,12 +322,13 @@ class HTTPProxyActor:
         from aiohttp import web
 
         from ray_tpu.serve._private.router import _UnaryResult
+        tid_hdr = ("x-rt-trace-id", span.trace_id)
         status, payload, ctype, hdrs = await self._proxy.handle_stream(
             request.method, request.path, query, body, headers_in)
         if status != 200:
             return web.Response(status=status, body=payload,
                                 content_type=ctype.split(";")[0],
-                                headers=hdrs or [])
+                                headers=list(hdrs or []) + [tid_hdr])
         aiter = payload
         _empty = object()  # distinguishes "no items" from a None item
         try:
@@ -295,7 +343,7 @@ class HTTPProxyActor:
             status, payload, ctype, hdrs = _throttle_response(e)
             return web.Response(status=status, body=payload,
                                 content_type=ctype.split(";")[0],
-                                headers=hdrs)
+                                headers=hdrs + [tid_hdr])
         except StreamInterrupted as e:
             # Zero items were delivered and failover could not place
             # the stream: retryable server-side failure.
@@ -306,12 +354,13 @@ class HTTPProxyActor:
                                   "resume_cursor": e.resume_cursor}
                                  ).encode(),
                 content_type="application/json",
-                headers=[("Retry-After", "1")])
+                headers=[("Retry-After", "1"), tid_hdr])
         except Exception as e:
             logger.exception("stream failed before first item")
             await aiter.aclose()
             return web.Response(status=500, body=repr(e).encode(),
-                                content_type="text/plain")
+                                content_type="text/plain",
+                                headers=[tid_hdr])
         if isinstance(first, _UnaryResult):
             await aiter.aclose()
             status, payload, ctype, pairs = HTTPProxy.format_result(
@@ -321,12 +370,13 @@ class HTTPProxyActor:
                                             "content-length")]
             return web.Response(status=status, body=payload,
                                 content_type=ctype.split(";")[0],
-                                headers=headers)
+                                headers=headers + [tid_hdr])
         resp = web.StreamResponse(
             status=200,
             headers={"Content-Type": "text/event-stream",
                      "Cache-Control": "no-cache",
-                     "X-Accel-Buffering": "no"})
+                     "X-Accel-Buffering": "no",
+                     "X-RT-Trace-Id": span.trace_id})
         await resp.prepare(request)
         try:
             if first is not _empty:

@@ -19,6 +19,8 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from ray_tpu._private import tracing as _tracing
+
 
 class Request:
     """Minimal HTTP-ish request container handed to deployments reached
@@ -316,10 +318,13 @@ class RTServeReplica:
 
     async def _pump_stream(self, stream_id: str, ait):
         state = self._streams[stream_id]
+        t0 = time.time()
+        n = 0
         try:
             async for item in ait:
                 state["buf"].append(item)
                 state["event"].set()
+                n += 1
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -329,6 +334,14 @@ class RTServeReplica:
             state["event"].set()
             self._num_ongoing -= 1
             self._num_processed += 1
+            # Stream-lifetime span in the REPLICA process: the pump
+            # task inherited the actor-task trace context, so engine
+            # stage spans and this one land in the request's trace.
+            _tracing.record("serve", "serve.replica_stream", t0,
+                            time.time() - t0,
+                            trace=_tracing.child_span(),
+                            args={"stream_id": stream_id, "items": n,
+                                  "deployment": self.deployment_name})
 
     async def stream_next(self, stream_id: str, cursor: int,
                           timeout_s: float = 10.0) -> Dict:

@@ -40,9 +40,11 @@ import collections
 import logging
 import os
 import random
+import time
 from typing import Any, AsyncIterator, Deque, Dict, List, Optional
 
 from ray_tpu._private import failpoints
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve._private.qos import DEFAULT_TENANT, TenantQoS
 from ray_tpu.serve.exceptions import StreamInterrupted
@@ -244,18 +246,20 @@ class ReplicaSet:
         admission gate (WFQ ordering still applies): retries and
         failovers of an ALREADY-ADMITTED request must neither burn a
         second bucket token nor convert a replica death into a 429."""
+        t0 = time.time()
         if self._qos is not None:
-            return await self._acquire_qos(timeout_s, tenant, exclude,
-                                           admit)
-        import time as _time
-        deadline = _time.monotonic() + timeout_s
+            choice = await self._acquire_qos(timeout_s, tenant, exclude,
+                                             admit)
+            self._record_wait(t0, time.time(), tenant, choice)
+            return choice
+        deadline = time.monotonic() + timeout_s
         self._set_queued(+1)
         try:
             while True:
                 choice = self._pick(exclude)
                 if choice is not None:
                     break
-                remain = deadline - _time.monotonic()
+                remain = deadline - time.monotonic()
                 if remain <= 0:
                     raise RuntimeError(
                         f"no available replica for deployment "
@@ -269,7 +273,19 @@ class ReplicaSet:
         finally:
             self._set_queued(-1)
         self._track_in_flight(choice["replica_tag"], +1)
+        self._record_wait(t0, time.time(), tenant, choice)
         return choice
+
+    def _record_wait(self, t0: float, t1: float, tenant, choice):
+        """serve.qos_wait span: time a request spent waiting for a
+        replica slot (QoS admission + WFQ, or the legacy capacity
+        wait).  Linked under the caller's span (the proxy's
+        serve.request or a handle caller's context)."""
+        _tracing.record("serve", "serve.qos_wait", t0, t1 - t0,
+                        trace=_tracing.child_span(),
+                        args={"deployment": self.deployment_name,
+                              "tenant": tenant or "default",
+                              "replica": choice["replica_tag"]})
 
     async def _acquire_qos(self, timeout_s: float, tenant: str,
                            exclude: tuple, admit: bool = True) -> Dict:
@@ -432,8 +448,12 @@ class ReplicaSet:
             tag = choice["replica_tag"]
             try:
                 try:
-                    return await self._call_unary(choice, method_name,
-                                                  args, kwargs)
+                    with _tracing.span(
+                            "serve", "serve.assign",
+                            args={"deployment": self.deployment_name,
+                                  "replica": tag, "attempt": attempt}):
+                        return await self._call_unary(
+                            choice, method_name, args, kwargs)
                 except _death_errors() as e:
                     self._drop_replica(tag)
                     if attempt == 0 and self._unary_retry:
@@ -549,10 +569,21 @@ class ReplicaSet:
                         if delivered_n:
                             resume_state = {"delivered": delivered_n,
                                             "items": list(delivered)}
+                        t_assign = time.time()
                         started = await self._stream_rpc(
                             actor.handle_request_streaming.remote(
                                 method_name, args, kwargs,
                                 resume_state))
+                        # serve.assign: replica chosen → stream started
+                        # (the replica-side admission RPC round trip).
+                        _tracing.record(
+                            "serve", "serve.assign", t_assign,
+                            time.time() - t_assign,
+                            trace=_tracing.child_span(),
+                            args={"deployment": self.deployment_name,
+                                  "replica": tag,
+                                  "failover": failovers,
+                                  "resumed": delivered_n})
                         if "stream_id" not in started:
                             finished = True
                             if not unary_fallback:
@@ -605,6 +636,18 @@ class ReplicaSet:
                             and (resumable or not delivered_n))
                         if can_failover:
                             failovers += 1
+                            # Annotation in the request's trace: the
+                            # resumed stream keeps the SAME trace id,
+                            # so the waterfall shows one request whose
+                            # spans hop replicas at this marker.
+                            _tracing.event(
+                                "serve", "serve.failover",
+                                args={"deployment":
+                                      self.deployment_name,
+                                      "replica_died": tag,
+                                      "delivered": delivered_n,
+                                      "failover": failovers,
+                                      "resumable": resumable})
                             # Accumulate: this stream must NEVER retry
                             # a replica it watched die, even after the
                             # local-view suppression TTL expires (a
@@ -626,6 +669,11 @@ class ReplicaSet:
                             continue
                         INTERRUPTED_COUNTER.inc(
                             tags={"deployment": self.deployment_name})
+                        _tracing.event(
+                            "serve", "serve.stream_interrupted",
+                            args={"deployment": self.deployment_name,
+                                  "replica_died": tag,
+                                  "delivered": delivered_n})
                         raise StreamInterrupted(
                             f"stream on {self.deployment_name}."
                             f"{method_name or '__call__'} interrupted "
@@ -646,7 +694,14 @@ class ReplicaSet:
                             num_returns=0).remote(stream_id)
                     self._release(tag)
 
-        return _gen()
+        # Bind the CREATOR's trace context to every step: the consumer
+        # may drive this generator from another task/loop (handle
+        # streams), where the ambient context is empty — the replica
+        # calls (and failover re-submissions) must keep linking under
+        # the caller's span, one trace id for the stream's whole life.
+        ctx = _tracing.current()
+        gen = _gen()
+        return _tracing.bind_agen(gen, ctx) if ctx is not None else gen
 
     def _pick(self, exclude: tuple = ()) -> Optional[Dict]:
         if self._suppressed:

@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Any, Optional
 
+from ray_tpu._private import tracing as _tracing
+
 _router_loop: Optional[asyncio.AbstractEventLoop] = None
 _router_loop_lock = threading.Lock()
 
@@ -225,11 +227,31 @@ class DeploymentHandle:
         return DeploymentHandle(self.deployment_name, self._controller,
                                 method_name=name)
 
+    @staticmethod
+    def _with_caller_trace(coro_fn):
+        """The router loop is another thread — contextvars don't cross
+        run_coroutine_threadsafe, so the CALLER's trace context is
+        captured here and re-installed around the routed call: a driver
+        span stays the parent of the replica's spans."""
+        ctx = _tracing.current()
+        if ctx is None:
+            return coro_fn()
+
+        async def _call():
+            token = _tracing.set_current(*ctx)
+            try:
+                return await coro_fn()
+            finally:
+                _tracing.reset_current(token)
+        return _call()
+
     def remote(self, *args, **kwargs) -> ServeResponse:
         router = self._ensure_router()
         loop = _get_router_loop()
         fut = asyncio.run_coroutine_threadsafe(
-            router.assign_request(self._method_name, args, kwargs), loop)
+            self._with_caller_trace(
+                lambda: router.assign_request(self._method_name, args,
+                                              kwargs)), loop)
         return ServeResponse(fut)
 
     def stream(self, *args, **kwargs) -> ServeResponseStream:
@@ -247,8 +269,9 @@ class DeploymentHandle:
         router = self._ensure_router()
         loop = _get_router_loop()
         fut = asyncio.run_coroutine_threadsafe(
-            router.assign_request_stream(self._method_name, args,
-                                         kwargs), loop)
+            self._with_caller_trace(
+                lambda: router.assign_request_stream(
+                    self._method_name, args, kwargs)), loop)
         return ServeResponseStream(fut, loop)
 
     def options(self, method_name: str = "") -> "DeploymentHandle":

@@ -63,6 +63,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu.models import decode
 from ray_tpu.serve.llm.paging import BlockAllocator, RadixPrefixCache
 from ray_tpu.serve.llm.scheduler import EngineOverloadedError, FCFSScheduler
@@ -279,12 +281,31 @@ class EngineStats:
         return dataclasses.asdict(self)
 
 
+def _span_for(req: "_Request", name: str, t0_mono: float,
+              dur_s: float, args: Optional[Dict] = None) -> None:
+    """One engine-stage span linked into the REQUEST's trace (captured
+    at submit() — the engine worker thread has no contextvar context of
+    its own).  t0 is monotonic (the engine's clock); re-anchored to the
+    epoch so the span aligns with every other process's events."""
+    if not _tracing.enabled():
+        return
+    tr = req.trace
+    link = None
+    if tr is not None:
+        link = {"trace_id": tr["trace_id"],
+                "span_id": _tracing.fresh_id(),
+                "parent_id": tr.get("parent_id")}
+    _tracing.record("engine", name,
+                    time.time() - (time.monotonic() - t0_mono),
+                    dur_s, trace=link, args=args)
+
+
 class _Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
                  "top_k", "eos_token", "rng", "stream", "submit_t",
                  "first_token_t", "last_token_t", "emitted", "n_blocks",
                  "pages", "tokens", "prefix_hit_tokens", "ngram_map",
-                 "ngram_upto")
+                 "ngram_upto", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
                  eos_token, seed, n_blocks):
@@ -306,15 +327,21 @@ class _Request:
         self.prefix_hit_tokens = 0
         self.ngram_map: Dict = {}    # trailing-ngram -> latest end pos
         self.ngram_upto = 0          # positions indexed so far
+        # The submitter's span context: TTFT-stage spans (queue /
+        # prefill / first_tick) recorded on the engine worker thread
+        # link under the serve request's trace.
+        self.trace = _tracing.current_dict()
 
 
 class _PrefillState:
-    __slots__ = ("req", "slot", "next_start", "bt_row")
+    __slots__ = ("req", "slot", "next_start", "bt_row", "t0", "chunks")
 
     def __init__(self, req: _Request, slot: int, start: int, bt_row):
         self.req = req
         self.slot = slot
         self.next_start = start
+        self.t0 = time.monotonic()   # prefill-stage span start
+        self.chunks = 0
         # The row's block table stays PRIVATE until activation: the
         # fused tick scatters a garbage write for every inactive batch
         # row, and the engine-wide table must keep pointing those rows
@@ -510,6 +537,7 @@ class GenerationEngine:
         self._completed = 0
         self._rejected = 0
         self._cancelled = 0
+        self._tick_seq = 0  # decode-tick span sampling counter
         self._committed_blocks = 0   # outstanding worst-case demand
         self._prefix_hits = 0
         self._prefix_misses = 0
@@ -826,6 +854,12 @@ class GenerationEngine:
             bt_row = np.zeros((self._max_blocks,), np.int32)
             bt_row[:len(pages)] = pages
             self._prefill = _PrefillState(req, slot, matched_tok, bt_row)
+            # TTFT stage 1 of 3 — queue: submit() to admission (pages
+            # reserved, prefill about to start).
+            _span_for(req, "engine.queue", req.submit_t,
+                      time.monotonic() - req.submit_t,
+                      args={"request_id": req.id,
+                            "prefix_hit_tokens": matched_tok})
 
         st = self._prefill
         req = st.req
@@ -844,12 +878,21 @@ class GenerationEngine:
             self.params, jnp.asarray(chunk), jnp.int32(start),
             self._cache, jnp.asarray(st.bt_row[None, :]), self.cfg)
         st.next_start = start + width
+        st.chunks += 1
         if st.next_start < L:
             return  # more chunks to go; decode proceeds meanwhile
 
         # Prefill complete: sample the first token from the last REAL
         # column of the final chunk (pad columns carry garbage).
         self._prefill = None
+        t_fc = time.monotonic()
+        # TTFT stage 2 of 3 — prefill: admission to the last chunk's
+        # dispatch (chunk count makes chunked-prefill interleaving
+        # visible against concurrent decode ticks).
+        _span_for(req, "engine.prefill", st.t0, t_fc - st.t0,
+                  args={"request_id": req.id, "chunks": st.chunks,
+                        "prompt_tokens": L,
+                        "prefix_hit_tokens": req.prefix_hit_tokens})
         if self._prefix is not None:
             # The request's FULL prompt pages now hold final K/V (decode
             # writes start at column L, outside any full prompt page) —
@@ -860,6 +903,12 @@ class GenerationEngine:
         row = np.asarray(logits[0, len(real) - 1])
         first = self._sample_host(row, req)
         now = time.monotonic()
+        # TTFT stage 3 of 3 — first tick: forcing the prefill logits
+        # off-device + sampling the first token.  queue + prefill +
+        # first_tick sums to submit→first-token, so `rt trace` derives
+        # the TTFT breakdown instead of guessing.
+        _span_for(req, "engine.first_tick", t_fc, now - t_fc,
+                  args={"request_id": req.id})
         if req.eos_token is not None and first == req.eos_token:
             self._release_pages(req)
             self._finish_request(req, "completed")
@@ -888,6 +937,25 @@ class GenerationEngine:
                    if self._slots[s] is not None]
         if not actives:
             return
+        # Sample 1/N ticks as engine.decode_tick spans: the tick runs
+        # thousands of times per second, so recording every one would
+        # be pure ring churn; a sampled span still shows batch width
+        # and tick latency against prefill/transfer activity.  Batch-
+        # level, so no single request's trace claims it.
+        sample = _cfg.trace_decode_tick_sample
+        self._tick_seq += 1
+        t_tick = (time.monotonic()
+                  if sample > 0 and self._tick_seq % sample == 0
+                  and _tracing.enabled() else None)
+        self._decode_tick_inner(actives)
+        if t_tick is not None:
+            _tracing.record("engine", "engine.decode_tick",
+                            time.time() - (time.monotonic() - t_tick),
+                            time.monotonic() - t_tick,
+                            args={"batch": len(actives),
+                                  "sampled_1_in": sample})
+
+    def _decode_tick_inner(self, actives):
         spec_drafts: Dict[int, List[int]] = {}
         if self.speculate_k:
             for s in actives:

@@ -52,6 +52,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.util.collective.types import CollectiveGroupError, ReduceOp
 
@@ -371,11 +372,39 @@ class GroupMember:
     def get_mail(self, tag, timeout=None):
         return self._coord_get(self.coord.get_mail.remote(tag))
 
-    def run_op(self, fn):
+    def run_op(self, fn, op_name: str | None = None,
+               nbytes: int | None = None):
         """Submit a synchronized group op to this member's serial op
         executor.  ALL round-consuming ops ride it, so the member's op
         order (and thus its coordinator op indexes) is its submission
-        order even when sync and async ops interleave."""
+        order even when sync and async ops interleave.
+
+        ``op_name`` makes the op a collective.<op_name> span in the
+        trace ring, linked under the SUBMITTER's span context (captured
+        here — the op executor is a different thread, contextvars don't
+        cross it) — so comm shows up against compute in the timeline
+        and the phase sub-spans (rendezvous/bulk/fold) nest under it."""
+        if op_name is not None:
+            ctx = _tracing.current()
+            inner = fn
+            group, world, rank = self.group_name, self.world_size, \
+                self.rank
+
+            def fn():  # noqa: F811 — traced wrapper of the op body
+                token = _tracing.set_current(*ctx) if ctx else None
+                try:
+                    with _tracing.span(
+                            "collective", f"collective.{op_name}",
+                            args={"group": group, "world": world,
+                                  "rank": rank,
+                                  "bytes": nbytes or 0}) as h:
+                        out = inner()
+                        h.args.setdefault(
+                            "plane", _plane_for(self, nbytes or 0))
+                        return out
+                finally:
+                    if token is not None:
+                        _tracing.reset_current(token)
         with self._exec_lock:
             if self._executor is None:
                 self._executor = ThreadPoolExecutor(
@@ -623,7 +652,8 @@ def allreduce(tensor, group_name: str = "default",
     (rank-order fold)."""
     g = get_group_handle(group_name)
     arr = _as_numpy(tensor)
-    out = g.run_op(lambda: _allreduce_impl(g, arr, op)).result(
+    out = g.run_op(lambda: _allreduce_impl(g, arr, op),
+                   "allreduce", arr.nbytes).result(
         g._timeout() + 60)
     return _writeback(tensor, out)
 
@@ -637,7 +667,8 @@ def allreduce_async(tensor, group_name: str = "default",
     as every member submits the same sequence."""
     g = get_group_handle(group_name)
     arr = _as_numpy(tensor)
-    fut = g.run_op(lambda: _allreduce_impl(g, arr, op))
+    fut = g.run_op(lambda: _allreduce_impl(g, arr, op),
+                   "allreduce", arr.nbytes)
     return CollectiveWork(fut, g,
                           finalize=lambda out: _writeback(tensor, out))
 
@@ -698,8 +729,13 @@ def _onesided_allreduce(g: GroupMember, arr: np.ndarray,
     double as buffer-release acks."""
     w, r = g.world_size, g.rank
     sig = f"{op.value}:{arr.dtype.str}:{arr.nbytes}"
+    t_rdv = time.time()
     rep = g.collect(f"rdv:allreduce:{sig}",
                     {"pid": _os_getpid(), "addr": int(flat.ctypes.data)})
+    _tracing.record("collective", "collective.rendezvous", t_rdv,
+                    time.time() - t_rdv,
+                    trace=_tracing.current_dict())
+    t_fold = time.time()
     descs = rep["gathered"]
     sl = _chunk_slices(flat.size, w)
     esz = flat.dtype.itemsize
@@ -720,9 +756,13 @@ def _onesided_allreduce(g: GroupMember, arr: np.ndarray,
                 first = False
             else:
                 _reduce_into(acc, contrib, op)
+    _tracing.record("collective", "collective.fold", t_fold,
+                    time.time() - t_fold,
+                    trace=_tracing.current_dict())
     # Fold-done barrier doubling as the reduced-chunk publication; it
     # also guarantees every peer finished reading OUR input, so the
     # gather below may overwrite `flat` in place.
+    t_gather = time.time()
     rep2 = g.collect(f"rdv:allreduce-ag:{sig}",
                      {"pid": _os_getpid(), "addr": int(acc.ctypes.data)})
     accs = rep2["gathered"]
@@ -742,6 +782,9 @@ def _onesided_allreduce(g: GroupMember, arr: np.ndarray,
     # which cannot complete until every peer left this gather.  (The
     # op-mismatch guard keeps this airtight: every synchronized op
     # opens with a collect round.)
+    _tracing.record("collective", "collective.gather", t_gather,
+                    time.time() - t_gather,
+                    trace=_tracing.current_dict())
     return out.reshape(arr.shape)
 
 
@@ -763,8 +806,12 @@ def _fast_allreduce(g: GroupMember, arr: np.ndarray, op: ReduceOp):
     flat = np.ascontiguousarray(arr).reshape(-1)
     if _all_onesided(eps):
         return _onesided_allreduce(g, arr, flat, op)
+    t_rdv = time.time()
     rep = g.collect(
         f"rdv:allreduce:{op.value}:{arr.dtype.str}:{arr.nbytes}", None)
+    _tracing.record("collective", "collective.rendezvous", t_rdv,
+                    time.time() - t_rdv,
+                    trace=_tracing.current_dict())
     seq = rep["seq"]
     deadline = time.monotonic() + g._timeout()
     grp, w, r = g.group_name, g.world_size, g.rank
@@ -781,6 +828,7 @@ def _fast_allreduce(g: GroupMember, arr: np.ndarray, op: ReduceOp):
     handles: dict = {}
     try:
         # ---- reduce-scatter: everyone exchanges chunks pairwise ----
+        t_rs = time.time()
         my = flat[sl[r]]
         for p, ep in eps.items():
             cp = flat[sl[p]]
@@ -815,7 +863,11 @@ def _fast_allreduce(g: GroupMember, arr: np.ndarray, op: ReduceOp):
             # gather phase overwrites `flat`.
             _wait_sends(g, sends, deadline)
             sends = []
+        _tracing.record("collective", "collective.reduce_scatter",
+                        t_rs, time.time() - t_rs,
+                        trace=_tracing.current_dict())
         # ---- allgather: each rank multicasts its reduced chunk ----
+        t_ag = time.time()
         for p, ep in eps.items():
             n = (sl[p].stop - sl[p].start) * esz
             if n:
@@ -835,6 +887,9 @@ def _fast_allreduce(g: GroupMember, arr: np.ndarray, op: ReduceOp):
                 np.copyto(out[sl[p]], a)
             h.release()
         _wait_sends(g, sends, deadline)
+        _tracing.record("collective", "collective.allgather", t_ag,
+                        time.time() - t_ag,
+                        trace=_tracing.current_dict())
     finally:
         for h in handles.values():
             try:
@@ -976,7 +1031,10 @@ class CollectiveBucket:
     def allreduce_async(self, group_name: str = "default",
                         op: ReduceOp = ReduceOp.SUM) -> CollectiveWork:
         g = get_group_handle(group_name)
-        fut = g.run_op(lambda: _allreduce_impl(g, self.flat, op))
+        # Per-bucket child span: fused buckets show up individually,
+        # so comm/compute overlap is visible bucket by bucket.
+        fut = g.run_op(lambda: _allreduce_impl(g, self.flat, op),
+                       "allreduce_bucket", self.flat.nbytes)
         return CollectiveWork(fut, g, finalize=self.unpack)
 
     def allreduce(self, group_name: str = "default",
@@ -1040,7 +1098,8 @@ def allgather(tensor_list: list, tensor, group_name: str = "default"):
     mismatch error instead of silently corrupting the gather."""
     g = get_group_handle(group_name)
     arr = _as_numpy(tensor)
-    gathered = g.run_op(lambda: _allgather_impl(g, arr)).result(
+    gathered = g.run_op(lambda: _allgather_impl(g, arr),
+                        "allgather", arr.nbytes).result(
         g._timeout() + 60)
     if tensor_list is not None:
         tensor_list.clear()
@@ -1116,7 +1175,9 @@ def reducescatter(tensor, tensor_list: list, group_name: str = "default",
     stacked fold)."""
     g = get_group_handle(group_name)
     arrs = [_as_numpy(t) for t in tensor_list]
-    out = g.run_op(lambda: _reducescatter_impl(g, arrs, op)).result(
+    out = g.run_op(lambda: _reducescatter_impl(g, arrs, op),
+                   "reducescatter",
+                   sum(a.nbytes for a in arrs)).result(
         g._timeout() + 60)
     return _writeback(tensor, out)
 
@@ -1207,7 +1268,8 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     tensor, each peer-to-peer); small ones take the coordinator."""
     g = get_group_handle(group_name)
     arr = _as_numpy(tensor)
-    out = g.run_op(lambda: _broadcast_impl(g, arr, src_rank)).result(
+    out = g.run_op(lambda: _broadcast_impl(g, arr, src_rank),
+                   "broadcast", arr.nbytes).result(
         g._timeout() + 60)
     return _writeback(tensor, out)
 

@@ -37,35 +37,84 @@ def enable_tracing(tracer: Optional[Any] = None) -> None:
         from opentelemetry import trace as ot  # optional dependency
         tracer = ot.get_tracer("ray_tpu")
     _tracer = tracer
+    _poke_span_runtime(tracer)
 
 
 def disable_tracing() -> None:
     global _tracer
     _tracer = None
+    _poke_span_runtime(None)
+
+
+def _poke_span_runtime(tracer) -> None:
+    """Tell the span ring (_private/tracing.py) whether a live exporter
+    exists: its record() hot path then pays one identity check instead
+    of a per-event module probe."""
+    try:
+        from ray_tpu._private import tracing as _rt
+        _rt._LIVE_EXPORT = tracer
+    except Exception:
+        pass
 
 
 def is_enabled() -> bool:
     return _tracer is not None
 
 
+def _otel_links(args: Dict):
+    """Parent/trace linkage as REAL otel links (SpanContext built from
+    the propagated hex ids) instead of only string attributes — a
+    backend that understands links renders the cross-process tree.
+    Returns None when otel is absent or the event carries no parent."""
+    parent = args.get("parent_id")
+    tid = args.get("trace_id")
+    if not parent or not tid:
+        return None
+    try:
+        from opentelemetry import trace as ot
+        ctx = ot.SpanContext(
+            trace_id=int(tid, 16), span_id=int(parent, 16),
+            is_remote=True,
+            trace_flags=ot.TraceFlags(ot.TraceFlags.SAMPLED))
+        return [ot.Link(ctx)]
+    except Exception:
+        return None
+
+
 def maybe_export(event: Dict) -> None:
     """Export one chrome-trace complete event ({ts,dur} in us; args
-    carry trace_id/span_id/parent_id) as a span.  No-op unless
-    enable_tracing() ran in this process; never raises into the
-    runtime."""
+    carry trace_id/span_id/parent_id) as a span — every plane's spans
+    flow through here (_private/tracing.py record() calls this bridge
+    for each ring append).  No-op unless enable_tracing() ran in this
+    process; never raises into the runtime.
+
+    Span linkage: when the real opentelemetry package is importable the
+    parent/trace ids become an otel Link on the exported span; the
+    string attributes remain for tracer-shaped test doubles and
+    backends that ignore links."""
     t = _tracer
     if t is None:
         return
     try:
         start_ns = int(event["ts"] * 1e3)
         end_ns = int((event["ts"] + event["dur"]) * 1e3)
+        args = event.get("args") or {}
         attrs = {"ray_tpu.category": event.get("cat", "")}
         for k in ("trace_id", "span_id", "parent_id"):
-            v = (event.get("args") or {}).get(k)
+            v = args.get(k)
             if v:
                 attrs[f"ray_tpu.{k}"] = v
-        span = t.start_span(event["name"], attributes=attrs,
-                            start_time=start_ns)
+        links = _otel_links(args)
+        span = None
+        if links is not None:
+            try:
+                span = t.start_span(event["name"], attributes=attrs,
+                                    links=links, start_time=start_ns)
+            except TypeError:
+                span = None  # tracer contract without links kwarg
+        if span is None:
+            span = t.start_span(event["name"], attributes=attrs,
+                                start_time=start_ns)
         span.end(end_time=end_ns)
     except Exception:
         pass
