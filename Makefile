@@ -21,7 +21,8 @@ SHELL := /bin/bash
 	bench-quick bench-llm-quick bench-transfer bench-collective \
 	bench-collective-quick bench-control bench-control-quick \
 	bench-serve-scale bench-serve-scale-quick bench-data \
-	bench-data-quick bench-trace bench-trace-quick chaos chaos-smoke
+	bench-data-quick bench-trace bench-trace-quick bench-train \
+	bench-train-quick chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008)
@@ -147,6 +148,25 @@ bench-trace-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite trace --quick
 
+# End-to-end train plane: gradient-hook overlap (GradientSynchronizer
+# vs post-backward allreduce vs compute-only at 64MiB fp32 gradients;
+# asserts the overlapped step <= 1.15x compute-only) and elastic
+# member-death recovery wall time vs the cold checkpoint-restart
+# baseline, with the metric-series continuity record.  Refreshes the
+# checked-in BENCH_train_e2e.json.
+bench-train:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite train_e2e \
+		--json-out BENCH_train_e2e.json
+
+# <60 s train-plane smoke (16MiB gradients, shorter chaos leg; same
+# overlap and never-reset-to-zero assertions at smoke bounds): catches
+# a gradient-overlap or elastic-recovery regression before a full
+# bench round.  Does NOT touch the checked-in artifact.
+bench-train-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite train_e2e --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -179,6 +199,8 @@ chaos:
 		tests/test_data_streaming.py::test_node_death_mid_shuffle_reissues_only_lost_partitions \
 		tests/test_tracing.py::test_serve_failover_stream_keeps_one_trace_id \
 		tests/test_tracing.py::test_http_sse_trace_header_links_client_proxy_replica \
+		tests/test_train_elastic.py::test_elastic_sigkill_resumes_in_place \
+		tests/test_train_elastic.py::test_reshard_death_falls_back_to_checkpoint \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -198,7 +220,7 @@ chaos-smoke:
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
 	bench-collective-quick bench-control-quick bench-serve-scale-quick \
-	bench-data-quick bench-trace-quick
+	bench-data-quick bench-trace-quick bench-train-quick
 
 store: ray_tpu/_private/_shm_store.so
 

@@ -151,6 +151,31 @@ class _Config:
     # the scratch-arena memcpy path.
     collective_pvm_reads = _def("collective_pvm_reads", bool, True)
 
+    # --- train (gang lifecycle + elastic recovery) ---
+    # Gang RPC deadline: the start_training fan-out and
+    # WorkerGroup.execute/execute_single (was hardcoded 600 s in
+    # train/_internal/worker_group.py).
+    train_start_timeout_s = _def("train_start_timeout_s", float, 600.0)
+    # One report round: how long the driver waits for every rank's
+    # next_result before declaring the round lost (was hardcoded
+    # 3600 s in backend_executor.get_next_results).
+    train_result_timeout_s = _def("train_result_timeout_s", float, 3600.0)
+    # shutdown_training's join on the user loop thread (was hardcoded
+    # 5 s).  The thread is a daemon; the join only bounds how long a
+    # graceful stop waits for an unresponsive loop.
+    train_worker_join_s = _def("train_worker_join_s", float, 5.0)
+    # Elastic re-formation deadline: survivors (and joiners) must
+    # report to the elastic coordinator AND finish the re-shard within
+    # this bound or the driver falls back to a cold checkpoint
+    # restart.  Jitter is added per recovery so many gangs recovering
+    # at once don't stampede the control plane in lockstep.
+    train_reform_timeout_s = _def("train_reform_timeout_s", float, 30.0)
+    train_reform_jitter_s = _def("train_reform_jitter_s", float, 2.0)
+    # Quorum: an elastic gang re-forms only while at least this many
+    # members survive; below it the driver cold-restarts from the last
+    # checkpoint (ScalingConfig.elastic_min_workers overrides).
+    train_elastic_min_workers = _def("train_elastic_min_workers", int, 1)
+
     # --- control plane (GCS pubsub / snapshots / events) ---
     # Coalesced pubsub: every subscriber gets a bounded outbound queue
     # drained by a pump that batches same-channel messages into one

@@ -28,6 +28,16 @@ class ScalingConfig:
     pp: int = 1
     sp: int = 1
     ep: int = 1
+    # Elastic data-parallel recovery (train/elastic.py): on member
+    # death (or a granted resize) the gang re-forms at the new world
+    # size and re-shards in-memory state over the collective plane
+    # instead of cold-restarting the trial from the last checkpoint.
+    # elastic_min_workers is the survivor quorum below which recovery
+    # falls back to the cold restart (None: RT_TRAIN_ELASTIC_MIN_WORKERS,
+    # default 1).  In-place recoveries do NOT consume
+    # FailureConfig.max_failures — that budget counts cold restarts.
+    elastic: bool = False
+    elastic_min_workers: Optional[int] = None
 
     @property
     def _resources(self) -> Dict[str, float]:

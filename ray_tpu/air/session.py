@@ -35,6 +35,15 @@ class _Session:
         self.continue_event = threading.Event()
         self.stop_requested = False
         self.iteration = 0
+        # Elastic-training state (train/elastic.py): the re-form
+        # generation this session last joined, the generation a pending
+        # recovery targets (set by the worker's agent thread to unwind
+        # a report-blocked loop), the user's in-memory resume stash,
+        # and how many in-place resizes this worker survived.
+        self.elastic_gen = 0
+        self.reform_pending_gen = 0
+        self._elastic_state: Optional[dict] = None
+        self.elastic_resizes = 0
 
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
         self.iteration += 1
@@ -44,6 +53,13 @@ class _Session:
         # train/_internal/session.py pause-on-report semantics).
         self.continue_event.wait()
         self.continue_event.clear()
+        if self.reform_pending_gen > self.elastic_gen:
+            # The gang is re-forming and this loop was parked in report
+            # (not in a collective op, where the group abort would have
+            # reached it) — unwind into the elastic rejoin path.
+            from ray_tpu.train.elastic import ElasticReset
+            raise ElasticReset("gang re-forming (recovery generation "
+                               f"{self.reform_pending_gen})")
         if self.stop_requested:
             raise StopIteration("session stopped")
 
@@ -109,6 +125,28 @@ def get_collective_group() -> Optional[str]:
     data-parallel gradient / statistics sync on the transfer plane."""
     import os
     return os.environ.get("RT_TRAIN_COLLECTIVE_GROUP") or None
+
+
+def stash_elastic_state(state: dict) -> None:
+    """Stash this rank's in-memory resume state (model/optimizer
+    arrays, step counter, RNG...) for elastic recovery.  Call it once
+    per step AFTER the optimizer update: when the gang re-forms at a
+    new world size, the authoritative survivor's stash is broadcast
+    over the collective data plane and every rank resumes from it —
+    no checkpoint round trip.  Include a ``"step"`` key: the recovery
+    rolls the gang back to the LOWEST stashed step (the only state
+    every rank is guaranteed to have contributed to).  Loops that
+    never stash still recover elastically, but re-enter from the last
+    checkpoint instead."""
+    _require()._elastic_state = dict(state)
+
+
+def get_elastic_state() -> Optional[dict]:
+    """The resume stash adopted during the last elastic re-form (or
+    this rank's own most recent stash), None on a fresh start.  A
+    re-entered train loop should prefer this over ``get_checkpoint()``
+    and resume at ``state["step"] + 1``."""
+    return _require()._elastic_state
 
 
 def get_dataset_shard(name: str = "train"):

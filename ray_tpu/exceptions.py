@@ -44,6 +44,11 @@ def _wrap_cause(cause: Exception, tb: str):
             "__init__": lambda self, *a: None,
         })
         exc = derived()
+        # Carry the cause's structured attributes (e.g.
+        # CollectiveGroupError.group) so callers that dispatch on them
+        # see the same shape whether the error was raised locally or
+        # re-raised at get().
+        exc.__dict__.update(cause.__dict__)
         exc.cause = cause
         exc.cause_repr = repr(cause)
         exc.traceback_str = tb

@@ -16,7 +16,10 @@ from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
 from ray_tpu.train.jax import JaxConfig, JaxTrainer  # noqa: F401
 from ray_tpu.train.gbdt import (  # noqa: F401
     GBDTBoosterModel, GBDTTrainer, XGBoostTrainer)
-from ray_tpu.train.collective import allreduce_gradients  # noqa: F401
+from ray_tpu.train.collective import (  # noqa: F401
+    GradientSynchronizer, allreduce_gradients,
+)
+from ray_tpu.train.elastic import ElasticReset  # noqa: F401
 
 from ray_tpu._private.usage import record_library_usage as _rlu
 _rlu("train")

@@ -3,17 +3,46 @@
 Reference: python/ray/train/_internal/backend_executor.py:42 (start :92,
 start_training :274) — create the gang, run Backend setup hooks, launch
 the user loop everywhere, then stream per-round results back.
+
+Elastic mode (ScalingConfig.elastic): a member death observed here (or
+a resize request) triggers an IN-PLACE re-formation through
+train/elastic.py — survivors rendezvous a fresh collective group at
+the new world size, re-shard in-memory state over the collective data
+plane, and the result pump resumes against the re-formed gang.  A cold
+gang restart (``restart``) remains the fallback when survivors drop
+below quorum or the re-shard itself fails; only cold restarts consume
+FailureConfig.max_failures.
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import random
+import time
 from typing import Callable, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import ScalingConfig
 from ray_tpu.train.backend import BackendConfig
 from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.util.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
+# Elastic in-place recoveries vs cold gang restarts: distinct budgets,
+# distinct counters (satellite: FailureConfig.max_failures counts only
+# the cold path).
+ELASTIC_RESIZES = Counter(
+    "train_elastic_resizes_total",
+    "Successful in-place elastic gang re-formations (member death "
+    "absorbed or resize grant applied without a trial restart)")
+GANG_RESTARTS = Counter(
+    "train_gang_restarts_total",
+    "Cold gang restarts from the last checkpoint (worker death without "
+    "elastic mode, quorum loss, or a failed re-shard)")
 
 
 class TrainingResult:
@@ -31,6 +60,10 @@ class TrainingWorkerError(TrainingFailedError):
     user-code exception — the gang can be restarted from the last
     checkpoint (reference: backend_executor.py:274 catching
     RayActorError into TrainingWorkerError for the retry loop)."""
+
+
+class _ResizeRequested(Exception):
+    """Internal: an elastic resize grant interrupted the result pump."""
 
 
 def _is_worker_death(e: BaseException) -> bool:
@@ -62,6 +95,19 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
         self._pg = None
         self._collective_group: Optional[str] = None
+        self._elastic = bool(getattr(scaling_config, "elastic", False)) \
+            and scaling_config.num_workers > 1
+        self._elastic_coord = None
+        self._elastic_coord_name: Optional[str] = None
+        self._gen = 0
+        # Per-worker in-flight next_result refs: elasticity needs the
+        # pump to know exactly which refs are outstanding so a
+        # recovery can discard the interrupted round (a re-issued ref
+        # would double-consume a survivor's report queue).
+        self._pending: Optional[List[Tuple[object, object]]] = None
+        self._joiners: List[Tuple[str, object, int]] = []
+        self._resize_target: Optional[int] = None
+        self._train_args: Optional[tuple] = None
 
     _placement_group = None
 
@@ -86,9 +132,15 @@ class BackendExecutor:
         self._start_workers()
 
     def _start_workers(self):
-        import os
+        from ray_tpu.train import elastic as _elastic
         sc = self.scaling_config
         self._destroy_collective_group()
+        _elastic.kill_elastic_coordinator(self._elastic_coord_name)
+        self._elastic_coord = self._elastic_coord_name = None
+        self._gen = 0
+        self._pending = None
+        self._joiners = []
+        self._resize_target = None
         self.worker_group = WorkerGroup(
             sc.num_workers, sc._resources, self._placement_group)
         # A gang-wide host collective group for data-parallel gradient
@@ -107,6 +159,11 @@ class BackendExecutor:
             }
             if group is not None:
                 env["RT_TRAIN_COLLECTIVE_GROUP"] = group
+            if self._elastic:
+                name, coord = _elastic.create_elastic_coordinator()
+                self._elastic_coord_name, self._elastic_coord = \
+                    name, coord
+                env["RT_TRAIN_ELASTIC_COORD"] = name
             ray_tpu.get(
                 [w.set_env.remote(dict(env, RT_TRAIN_WORLD_RANK=rank,
                                        RT_TRAIN_LOCAL_RANK=rank))
@@ -135,13 +192,16 @@ class BackendExecutor:
         self._collective_group = None
 
     def restart(self):
-        """Gang-level fault recovery: tear the (partially dead) gang down
-        and start a fresh one in the same placement group.  The backend's
-        on_start runs again on the new incarnation, so the jax
-        coordination service re-initializes with a fresh coordinator
-        (SURVEY hard-part #4: collective rendezvous lifecycle tied to
-        actor restarts).  Reference: backend_executor start/shutdown
-        around worker failures."""
+        """Gang-level COLD fault recovery: tear the (partially dead)
+        gang down and start a fresh one in the same placement group.
+        The backend's on_start runs again on the new incarnation, so
+        the jax coordination service re-initializes with a fresh
+        coordinator (SURVEY hard-part #4: collective rendezvous
+        lifecycle tied to actor restarts).  Reference: backend_executor
+        start/shutdown around worker failures.  This is the path that
+        consumes FailureConfig.max_failures; elastic re-forms do not
+        pass through here."""
+        GANG_RESTARTS.inc()
         if self.worker_group is not None:
             self.worker_group.shutdown()
             self.worker_group = None
@@ -153,6 +213,10 @@ class BackendExecutor:
         self.backend.on_training_start(self.worker_group,
                                        self.backend_config)
         mesh_builder = getattr(self.backend, "mesh_builder", lambda: None)()
+        # Joiners spawned by an elastic resize re-run the same entry
+        # point (their rank/shards come from the reform instructions).
+        self._train_args = (train_fn, config, checkpoint, trial_name,
+                            trial_id, mesh_builder)
         refs = [
             w.start_training.remote(
                 train_fn, config, checkpoint, trial_name, trial_id,
@@ -160,30 +224,316 @@ class BackendExecutor:
             for w in self.worker_group.workers
         ]
         try:
-            ray_tpu.get(refs, timeout=600)
+            ray_tpu.get(refs, timeout=cfg.train_start_timeout_s)
         except Exception as e:
             if _is_worker_death(e):
                 raise TrainingWorkerError(str(e)) from e
             raise
 
+    # ------------------------------------------------------- result pump
+    def _get_refs(self, refs, deadline):
+        """Blocking get.  Elastic mode waits in short slices so a
+        resize request (posted from another thread) interrupts the
+        pump instead of riding out the full round deadline."""
+        if not self._elastic:
+            return ray_tpu.get(refs, timeout=cfg.train_result_timeout_s)
+        while True:
+            if self._resize_target is not None:
+                raise _ResizeRequested()
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise ray_tpu.exceptions.GetTimeoutError(
+                    "train report round timed out")
+            try:
+                return ray_tpu.get(refs, timeout=min(1.0, remain))
+            except ray_tpu.exceptions.GetTimeoutError:
+                continue
+
+    @staticmethod
+    def _is_flush(item) -> bool:
+        from ray_tpu.train import elastic
+        return (isinstance(item, (tuple, list)) and len(item) == 2
+                and item[0] == elastic.FLUSH)
+
+    def _acquire_round(self):
+        """One full round of next_result values, with post-reform flush
+        markers (elastic.FLUSH) skipped: a marker slot re-polls that
+        worker alone, so the real reports stay aligned across ranks."""
+        deadline = time.monotonic() + cfg.train_result_timeout_s
+        raw = list(self._get_refs([r for _, r in self._pending],
+                                  deadline))
+        i = 0
+        while i < len(raw):
+            if self._is_flush(raw[i]):
+                w, _ = self._pending[i]
+                nref = w.next_result.remote()
+                self._pending[i] = (w, nref)
+                raw[i] = self._get_refs([nref], deadline)[0]
+            else:
+                i += 1
+        return raw
+
     def get_next_results(self) -> Optional[List[TrainingResult]]:
         """One report round from every rank; None when the loop finished.
         All ranks must report the same number of times (reference enforces
         the same invariant)."""
-        refs = [w.next_result.remote() for w in self.worker_group.workers]
+        while True:
+            if self._pending is None:
+                self._pending = [(w, w.next_result.remote())
+                                 for w in self.worker_group.workers]
+            try:
+                raw = self._acquire_round()
+            except _ResizeRequested:
+                self._elastic_recover(None)
+                continue
+            except Exception as e:
+                if self._elastic and _is_worker_death(e):
+                    # In-place re-formation: survivors rendezvous the
+                    # new world size; the interrupted round is
+                    # discarded (every rank re-reports from the
+                    # authoritative step after the re-shard).  Raises
+                    # TrainingWorkerError itself when the re-form
+                    # can't complete (quorum, deadline, re-shard
+                    # failure) — the cold-restart path.
+                    self._elastic_recover(e)
+                    continue
+                if _is_worker_death(e):
+                    raise TrainingWorkerError(str(e)) from e
+                raise TrainingFailedError(str(e)) from e
+            self._pending = None
+            finished = [r is None for r in raw]
+            if all(finished):
+                return None
+            if any(finished):
+                raise TrainingFailedError(
+                    "ranks reported unevenly (some finished, some "
+                    "reported)")
+            return [TrainingResult(m, c) for (m, c) in raw]
+
+    # --------------------------------------------------- elastic re-form
+    def request_elastic_resize(self, target_world_size: int):
+        """Grow the gang to ``target_world_size`` in place (an
+        autoscaler grant): spawn joiners into free placement-group
+        bundles, then break the current incarnation so survivors and
+        joiners rendezvous the new world size together.  Joiners
+        receive the authoritative state over the collective plane like
+        any recovering member.  Thread-safe against a pump blocked in
+        get_next_results."""
+        if not self._elastic:
+            raise RuntimeError("elastic resize requires "
+                               "ScalingConfig(elastic=True)")
+        wg = self.worker_group
+        if wg is None or self._train_args is None:
+            raise RuntimeError("no running gang to resize")
+        live = len(wg.workers)
+        if target_world_size <= live:
+            raise ValueError(
+                f"target world size {target_world_size} <= current "
+                f"{live} (scale-down happens by draining members)")
+        free = [i for i in range(wg.capacity)
+                if i not in wg.bundle_indices]
+        need = target_world_size - live
+        if need > len(free):
+            raise ValueError(
+                f"resize to {target_world_size} needs {need} bundles "
+                f"but only {len(free)} are free (gang capacity "
+                f"{wg.capacity})")
+        (train_fn, config, checkpoint, trial_name, trial_id,
+         mesh_builder) = self._train_args
+        for k in range(need):
+            w = wg._spawn(live + k, free[k], target_world_size)
+            token = "j" + os.urandom(3).hex()
+            env = {"RT_TRAIN_ELASTIC_COORD": self._elastic_coord_name,
+                   "RT_TRAIN_ELASTIC_TOKEN": token,
+                   "RT_TRAIN_ELASTIC_GEN": self._gen,
+                   "RT_TRAIN_WORLD_SIZE": target_world_size,
+                   "RT_TRAIN_WORLD_RANK": live + k,
+                   "RT_TRAIN_LOCAL_RANK": live + k}
+            ray_tpu.get(w.set_env.remote(env), timeout=60)  # noqa: RTL001
+            ray_tpu.get(  # noqa: RTL001
+                w.start_training.remote(train_fn, config, checkpoint,
+                                        trial_name, trial_id,
+                                        mesh_builder, True),
+                timeout=cfg.train_start_timeout_s)
+            self._joiners.append((token, w, free[k]))
+        self._resize_target = target_world_size
+        # Break the running incarnation: every survivor's next
+        # collective op (or parked report, via the worker agents) drops
+        # into the rejoin path.
+        if self._collective_group is not None:
+            from ray_tpu.util import collective as col
+            col.abort_collective_group(self._collective_group,
+                                       "elastic resize")
+
+    def _quorum(self) -> int:
+        sc = self.scaling_config
+        q = getattr(sc, "elastic_min_workers", None)
+        if q is None:
+            q = cfg.train_elastic_min_workers
+        return max(1, int(q))
+
+    def _reform_fail(self, msg: str, err):
+        # Release workers parked in wait_reform before falling back.
         try:
-            raw = ray_tpu.get(refs, timeout=3600)
+            ray_tpu.get(self._elastic_coord.post_reform.remote(
+                {"gen": self._gen + 1, "action": "abort",
+                 "reason": msg}), timeout=10)
+        except Exception:
+            pass
+        logger.warning("elastic re-form failed (%s); falling back to "
+                       "cold checkpoint restart", msg)
+        e = TrainingWorkerError(f"elastic re-form failed: {msg}")
+        if err is not None:
+            raise e from err
+        raise e
+
+    def _elastic_recover(self, err):
+        """Driver side of one re-formation (train/elastic.py protocol).
+        On success the pump continues against the re-formed gang; on
+        quorum loss / deadline / re-shard failure raises
+        TrainingWorkerError so the trainer's cold-restart loop takes
+        over."""
+        from ray_tpu.util import collective as col
+        wg = self.worker_group
+        old_workers = list(wg.workers)
+        old_bundles = list(wg.bundle_indices)
+        old_world = len(old_workers)
+        gen = self._gen
+        coord = self._elastic_coord
+        timeout = cfg.train_reform_timeout_s
+        deadline = time.monotonic() + timeout + random.uniform(
+            0.0, max(0.0, cfg.train_reform_jitter_s))
+        self._pending = None  # discard the interrupted round
+        logger.warning(
+            "train gang broke (%s); attempting elastic re-form "
+            "(generation %s)", err, gen + 1)
+
+        # Make sure every survivor breaks: abort the old group
+        # (idempotent when the death watch already killed it) and
+        # announce the recovery so worker agents unwind report-blocked
+        # loops.
+        if self._collective_group is not None:
+            col.abort_collective_group(
+                self._collective_group,
+                "elastic re-form" if err is None else str(err))
+        try:
+            ray_tpu.get(coord.begin_recovery.remote(gen + 1), timeout=30)
         except Exception as e:
-            if _is_worker_death(e):
-                raise TrainingWorkerError(str(e)) from e
-            raise TrainingFailedError(str(e)) from e
-        finished = [r is None for r in raw]
-        if all(finished):
-            return None
-        if any(finished):
-            raise TrainingFailedError(
-                "ranks reported unevenly (some finished, some reported)")
-        return [TrainingResult(m, c) for (m, c) in raw]
+            self._reform_fail(f"elastic coordinator unreachable: {e}",
+                              err)
+
+        # Collect survivor breaks under the bounded deadline; a settle
+        # window separates "everyone who can report has" from "one
+        # straggler is still unwinding".
+        settle = min(2.0, timeout / 5.0)
+        last: dict = {}
+        stable_since = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            try:
+                b = ray_tpu.get(coord.breaks.remote(gen),  # noqa: RTL001
+                                timeout=30)
+            except Exception as e:
+                self._reform_fail(f"break collection failed: {e}", err)
+            if b != last:
+                last, stable_since = b, now
+            elif last and now - stable_since >= settle:
+                break
+            time.sleep(0.2)
+        survivors = sorted(int(r) for r in last)
+        joiners = list(self._joiners)
+        new_world = len(survivors) + len(joiners)
+        if len(survivors) < self._quorum():
+            self._reform_fail(
+                f"{len(survivors)} survivors of {old_world} < quorum "
+                f"{self._quorum()}", err)
+
+        # Compact new ranks: survivors in old-rank order, then joiners.
+        group = f"train_dp_{os.urandom(4).hex()}"
+        gcoord = col.ensure_coordinator(group, new_world)
+        ranks: dict = {}
+        joiner_ranks: dict = {}
+        mapping: dict = {}
+        new_workers, new_bundles = [], []
+        for new_rank, old_rank in enumerate(survivors):
+            w = old_workers[old_rank]
+            ranks[str(old_rank)] = new_rank
+            new_workers.append(w)
+            new_bundles.append(old_bundles[old_rank])
+            aid = getattr(w, "_actor_id", None)
+            if aid is not None:
+                mapping[aid.hex()] = new_rank
+        for k, (token, w, bidx) in enumerate(joiners):
+            rank = len(survivors) + k
+            joiner_ranks[token] = rank
+            new_workers.append(w)
+            new_bundles.append(bidx)
+            aid = getattr(w, "_actor_id", None)
+            if aid is not None:
+                mapping[aid.hex()] = rank
+        # Death watch BEFORE members register: a member dying
+        # mid-re-shard aborts the new group fast (clean fallback, never
+        # a torn state).
+        try:
+            ray_tpu.get(gcoord.watch.remote(mapping), timeout=60)
+        except Exception:
+            logger.warning("could not arm death watch for re-formed "
+                           "group '%s'", group, exc_info=True)
+        instr = {"gen": gen + 1, "group": group,
+                 "world_size": new_world, "ranks": ranks,
+                 "joiners": joiner_ranks,
+                 "dead_ranks": [r for r in range(old_world)
+                                if r not in survivors],
+                 "old_world": old_world}
+        try:
+            ray_tpu.get(coord.post_reform.remote(instr), timeout=30)
+        except Exception as e:
+            self._reform_fail(f"posting reform failed: {e}", err)
+
+        # Await every member's re-shard ack under its own window.
+        done_deadline = time.monotonic() + timeout
+        detail = "re-shard deadline expired"
+        ok = False
+        while time.monotonic() < done_deadline:
+            try:
+                st = ray_tpu.get(  # noqa: RTL001
+                    coord.reform_status.remote(gen + 1), timeout=30)
+            except Exception as e:
+                detail = f"reform status poll failed: {e}"
+                break
+            bad = [f"rank {r}: {v[1]}" for r, v in st.items()
+                   if not v[0]]
+            if bad:
+                detail = "; ".join(bad)
+                break
+            if len(st) == new_world:
+                ok = True
+                break
+            time.sleep(0.2)
+        if not ok:
+            col.abort_collective_group(group, "re-form failed")
+            self._reform_fail(detail, err)
+
+        old_group, self._collective_group = \
+            self._collective_group, group
+        if old_group is not None:
+            # Reap the broken incarnation's coordinator actor (members
+            # already dropped their local halves during rejoin).
+            try:
+                col.destroy_collective_group(old_group)
+            except Exception:
+                pass
+        wg.apply_reform(new_workers, new_bundles)
+        self._joiners = []
+        self._resize_target = None
+        self._gen = gen + 1
+        ELASTIC_RESIZES.inc()
+        logger.warning(
+            "elastic re-form complete: world %s -> %s (generation %s, "
+            "dead ranks %s, %s joiners)", old_world, new_world,
+            gen + 1, instr["dead_ranks"], len(joiner_ranks))
 
     def finish_training(self):
         if self.worker_group is not None:
@@ -203,11 +553,14 @@ class BackendExecutor:
                     pass
 
     def shutdown(self):
+        from ray_tpu.train import elastic as _elastic
         try:
             self.backend.on_shutdown(self.worker_group, self.backend_config)
         except Exception:
             pass
         self._destroy_collective_group()
+        _elastic.kill_elastic_coordinator(self._elastic_coord_name)
+        self._elastic_coord = self._elastic_coord_name = None
         if self.worker_group is not None:
             self.worker_group.shutdown()
             self.worker_group = None

@@ -4,17 +4,28 @@ Reference: python/ray/train/_internal/worker_group.py (WorkerGroup over
 actor handles; execute/execute_single).  Workers live in one placement
 group so the gang is scheduled atomically (reference: backend_executor
 start inside the Tune trial's PG).
+
+Elastic mode (train/elastic.py): the user loop runs inside a rejoin
+wrapper — a CollectiveGroupError on the gang's group (member death
+aborts it via the death watch) or an ElasticReset (resize grant /
+report-blocked unwind) drops into the re-formation protocol instead of
+killing the worker, and the loop re-enters at the re-sharded state.
 """
 
 from __future__ import annotations
 
+import logging
 import socket
 import threading
 from typing import Any, Callable, List, Optional
 
 import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.air import session as air_session
 from ray_tpu.util.collective import CollectiveMixin
+from ray_tpu.util.collective.types import CollectiveGroupError
+
+logger = logging.getLogger(__name__)
 
 
 class _TrainWorker(CollectiveMixin):
@@ -30,6 +41,8 @@ class _TrainWorker(CollectiveMixin):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._env: dict = {}
+        self._dataset_entries: Optional[dict] = None
+        self._elastic_coord: Optional[str] = None
 
     # generic remote execution --------------------------------------------
     def execute(self, fn: Callable, *args, **kwargs):
@@ -42,60 +55,145 @@ class _TrainWorker(CollectiveMixin):
         return True
 
     def node_info(self) -> dict:
+        import os
         return {"hostname": socket.gethostname(),
-                "rank": self.world_rank}
+                "rank": self.world_rank,
+                "pid": os.getpid()}
+
+    # dataset sharding -----------------------------------------------------
+    def _shard_one(self, entry, world: int, rank: int):
+        """Deterministic whole-block split: every rank computes the
+        same split and keeps its own shard (reference:
+        data_parallel_trainer dataset sharding to workers).
+        DatasetConfig(split=False) datasets arrive whole on every rank
+        (the trainer sends (ds, split?, ingest_opts) triples; bare
+        datasets / 2-tuples from older callers default to split, no
+        streaming ingest opts)."""
+        ingest = None
+        if isinstance(entry, tuple):
+            ds, do_split = entry[0], entry[1]
+            if len(entry) > 2:
+                ingest = entry[2]
+        else:
+            ds, do_split = entry, True
+        if do_split and world > 1:
+            shard = ds.split(world)[rank]
+        else:
+            shard = ds
+        return shard, ingest
+
+    def _shard_datasets(self, world: int, rank: int):
+        for name, entry in (self._dataset_entries or {}).items():
+            shard, ingest = self._shard_one(entry, world, rank)
+            if ingest:
+                # Streaming ingest: per-epoch reshuffle through the
+                # streaming executor, next epoch primed while the
+                # step loop drains the current one.
+                from ray_tpu.train.ingest import StreamingDatasetShard
+                shard = StreamingDatasetShard(
+                    shard,
+                    shuffle_each_epoch=ingest.get(
+                        "shuffle_each_epoch", False),
+                    shuffle_seed=ingest.get("shuffle_seed"))
+            self._session.dataset_shards[name] = shard
+
+    def _reshard_datasets(self, world: int, rank: int, epochs: dict):
+        """Elastic resize: re-split every dataset across the NEW world
+        size.  Streaming shards swap their underlying dataset in place
+        (the primed next-epoch pipeline over the OLD shard is closed)
+        and align their epoch counter to the authoritative rank, so
+        every member keeps deriving the same per-epoch shuffle and the
+        next epoch partitions the whole dataset exactly once across
+        the re-formed gang."""
+        for name, entry in (self._dataset_entries or {}).items():
+            shard, ingest = self._shard_one(entry, world, rank)
+            cur = self._session.dataset_shards.get(name)
+            if cur is not None and hasattr(cur, "resplit"):
+                cur.resplit(shard, epoch=epochs.get(name))
+            elif ingest:
+                from ray_tpu.train.ingest import StreamingDatasetShard
+                s = StreamingDatasetShard(
+                    shard,
+                    shuffle_each_epoch=ingest.get(
+                        "shuffle_each_epoch", False),
+                    shuffle_seed=ingest.get("shuffle_seed"))
+                ep = epochs.get(name)
+                if ep is not None:
+                    s._epoch = int(ep)
+                self._session.dataset_shards[name] = s
+            else:
+                self._session.dataset_shards[name] = shard
 
     # training loop --------------------------------------------------------
     def start_training(self, train_fn: Callable, config: dict,
                        checkpoint=None, trial_name: str = "",
-                       trial_id: str = "", mesh_builder: Callable = None):
+                       trial_id: str = "", mesh_builder: Callable = None,
+                       elastic_join: bool = False):
+        import os
+        from ray_tpu.train import elastic
         mesh = mesh_builder() if mesh_builder is not None else None
         self._session = air_session._Session(
             world_rank=self.world_rank, world_size=self.world_size,
             local_rank=self.local_rank, trial_name=trial_name,
             trial_id=trial_id, mesh=mesh, checkpoint=checkpoint)
-        datasets = (config or {}).pop("__datasets__", None)
-        if datasets:
-            # Deterministic whole-block split: every rank computes the
-            # same split and keeps its own shard (reference:
-            # data_parallel_trainer dataset sharding to workers).
-            # DatasetConfig(split=False) datasets arrive whole on every
-            # rank (the trainer sends (ds, split?, ingest_opts)
-            # triples; bare datasets / 2-tuples from older callers
-            # default to split, no streaming ingest opts).
-            for name, entry in datasets.items():
-                ingest = None
-                if isinstance(entry, tuple):
-                    ds, do_split = entry[0], entry[1]
-                    if len(entry) > 2:
-                        ingest = entry[2]
-                else:
-                    ds, do_split = entry, True
-                if do_split and self.world_size > 1:
-                    shards = ds.split(self.world_size)
-                    shard = shards[self.world_rank]
-                else:
-                    shard = ds
-                if ingest:
-                    # Streaming ingest: per-epoch reshuffle through the
-                    # streaming executor, next epoch primed while the
-                    # step loop drains the current one.
-                    from ray_tpu.train.ingest import StreamingDatasetShard
-                    shard = StreamingDatasetShard(
-                        shard,
-                        shuffle_each_epoch=ingest.get(
-                            "shuffle_each_epoch", False),
-                        shuffle_seed=ingest.get("shuffle_seed"))
-                self._session.dataset_shards[name] = shard
+        fps = (config or {}).pop("__failpoints__", None)
+        if fps:
+            # Chaos wiring: arm failpoints in THIS worker process
+            # (train.step / train.reform sites and below).
+            from ray_tpu._private import failpoints
+            failpoints.configure(fps)
+        self._dataset_entries = (config or {}).pop("__datasets__", None)
+        self._elastic_coord = (os.environ.get("RT_TRAIN_ELASTIC_COORD")
+                               or None)
+        if elastic_join:
+            # A joiner's rank/world/shards are assigned by the reform
+            # instructions; its session starts at the driver's current
+            # generation so it long-polls the right reform.
+            self._session.elastic_gen = int(
+                os.environ.get("RT_TRAIN_ELASTIC_GEN", "0"))
+        else:
+            self._shard_datasets(self.world_size, self.world_rank)
         self._error = None
+        if self._elastic_coord:
+            elastic.start_agent(self)
+
+        def _call():
+            train_fn(config) if config is not None else train_fn()
 
         def _run():
             air_session._set_session(self._session)
             try:
-                train_fn(config) if config is not None else train_fn()
+                if elastic_join:
+                    elastic.rejoin(self, None, joining=True)
+                while True:
+                    try:
+                        _call()
+                        break
+                    except StopIteration:
+                        break
+                    except (elastic.ElasticReset,
+                            CollectiveGroupError) as e:
+                        if self._elastic_coord is None:
+                            raise
+                        # getattr: an error re-raised at get() may have
+                        # been wrapped without the cause's attributes;
+                        # only a POSITIVELY different group is a user
+                        # error — unknown means assume the gang broke.
+                        broken = getattr(e, "group", None)
+                        if isinstance(e, CollectiveGroupError) \
+                                and broken is not None \
+                                and broken != os.environ.get(
+                                    "RT_TRAIN_COLLECTIVE_GROUP"):
+                            # A user-managed group broke, not the gang:
+                            # that is a user error, not a resize.
+                            raise
+                        # Re-form in place; rejoin raises on
+                        # abort/deadline and the driver cold-restarts.
+                        elastic.rejoin(self, e)
             except StopIteration:
                 pass
             except BaseException as e:
+                logger.warning("train loop exited with %r", e)
                 self._error = e
             finally:
                 self._session.result_queue.put(None)
@@ -106,12 +204,18 @@ class _TrainWorker(CollectiveMixin):
 
     def next_result(self):
         """Block until the user loop reports (or finishes).  Returns
-        (metrics, checkpoint) or None when the loop ended."""
+        (metrics, checkpoint), an elastic flush marker, or None when
+        the loop ended."""
+        from ray_tpu.train import elastic
         item = self._session.result_queue.get()
         if item is None:
             if self._error is not None:
                 raise self._error
             return None
+        if isinstance(item, tuple) and item and item[0] == elastic.FLUSH:
+            # Stale-round flush (see elastic.FLUSH): not a user report,
+            # so the loop is NOT unblocked here.
+            return item
         self._session.continue_event.set()
         metrics, ckpt = item
         return (metrics, ckpt)
@@ -131,7 +235,7 @@ class _TrainWorker(CollectiveMixin):
                     except Exception:
                         pass
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=cfg.train_worker_join_s)
         return True
 
 
@@ -140,22 +244,40 @@ class WorkerGroup:
                  placement_group=None):
         self.num_workers = num_workers
         self.workers: List[Any] = []
-        cls = ray_tpu.remote(_TrainWorker)
+        self._resources = dict(resources_per_worker)
+        self._pg = placement_group
+        # Which PG bundle each live worker occupies (parallel to
+        # ``workers``); ``capacity`` bounds elastic scale-up — a freed
+        # bundle (dead member) can host a joiner, but the PG cannot
+        # grow.
+        self.bundle_indices: List[int] = []
+        self.capacity = num_workers
         for rank in range(num_workers):
-            opts = dict(
-                num_cpus=resources_per_worker.get("CPU", 0),
-                resources={k: v for k, v in resources_per_worker.items()
-                           if k != "CPU"})
-            if placement_group is not None:
-                opts["placement_group"] = placement_group
-                opts["placement_group_bundle_index"] = rank
-            self.workers.append(
-                cls.options(**opts).remote(rank, num_workers, rank))
+            self.workers.append(self._spawn(rank, rank, num_workers))
+            self.bundle_indices.append(rank)
+
+    def _spawn(self, rank: int, bundle_index: int, world: int):
+        cls = ray_tpu.remote(_TrainWorker)
+        opts = dict(
+            num_cpus=self._resources.get("CPU", 0),
+            resources={k: v for k, v in self._resources.items()
+                       if k != "CPU"})
+        if self._pg is not None:
+            opts["placement_group"] = self._pg
+            opts["placement_group_bundle_index"] = bundle_index
+        return cls.options(**opts).remote(rank, world, rank)
+
+    def apply_reform(self, workers: List[Any], bundles: List[int]):
+        """Adopt the post-reform live set (survivors in new-rank order,
+        joiners appended); dead members' handles drop out here."""
+        self.workers = list(workers)
+        self.bundle_indices = list(bundles)
+        self.num_workers = len(self.workers)
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
         return ray_tpu.get(
             [w.execute.remote(fn, *args, **kwargs) for w in self.workers],
-            timeout=600)
+            timeout=cfg.train_start_timeout_s)
 
     def execute_async(self, fn: Callable, *args, **kwargs):
         return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
@@ -163,7 +285,7 @@ class WorkerGroup:
     def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
         return ray_tpu.get(
             self.workers[rank].execute.remote(fn, *args, **kwargs),
-            timeout=600)
+            timeout=cfg.train_start_timeout_s)
 
     def shutdown(self):
         for w in self.workers:
@@ -172,3 +294,4 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
+        self.bundle_indices = []

@@ -15,9 +15,34 @@ to use an explicitly-managed group instead.
 
 from __future__ import annotations
 
-from typing import Optional
+import os
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ray_tpu._private import failpoints
+
+
+def _step_failpoint():
+    """Chaos hook at the gradient-sync entry (the canonical
+    mid-epoch interruption point: the member is between backward and
+    optimizer update).  ``kill`` SIGKILLs the worker process — the
+    gang's death watch turns that into CollectiveGroupError at every
+    survivor within a round trip."""
+    if not failpoints.ACTIVE:
+        return
+    rank = os.environ.get("RT_TRAIN_WORLD_RANK", "0")
+    act = failpoints.check("train.step", peer=f"r{rank}")
+    if act is None:
+        return
+    if act.kind == "kill":
+        os._exit(int(act.arg or 1))
+    if act.kind == "error":
+        from ray_tpu.train.elastic import ElasticReset
+        raise ElasticReset(f"failpoint: injected step fault at rank {rank}")
+    if act.kind == "delay":
+        import time
+        time.sleep(act.delay_s)
 
 
 def allreduce_gradients(grads, *, group_name: Optional[str] = None,
@@ -33,6 +58,7 @@ def allreduce_gradients(grads, *, group_name: Optional[str] = None,
     from ray_tpu.air import session
     from ray_tpu.util import collective as col
 
+    _step_failpoint()
     if group_name is None:
         try:
             group_name = session.get_collective_group()
@@ -69,3 +95,180 @@ def allreduce_gradients(grads, *, group_name: Optional[str] = None,
     if isinstance(grads, list):
         return reduced
     return reduced[0]
+
+
+class GradientSynchronizer:
+    """Gradient-hook overlap: allreduce buckets WHILE backward still
+    runs, instead of syncing everything after the step.
+
+    ``allreduce_gradients`` needs the full gradient set up front, so
+    the whole exchange serializes behind backward.  This class takes
+    gradients one at a time, as the user's backward produces them
+    (reverse-topological — the order autograd hooks fire), fills fixed
+    buckets, and submits each bucket's fused allreduce the moment it is
+    full.  Communication of early (late-layer) buckets hides under the
+    compute of earlier layers; ``finish()`` only waits for the tail.
+
+        sync = GradientSynchronizer()
+        for step in ...:
+            for name, g in backward_in_reverse(...):   # hook order
+                sync.grad_ready(name, g)
+            grads = sync.finish()                       # averaged
+            apply(grads)
+
+    The bucket plan is fixed from the FIRST step's arrival order and
+    reused verbatim afterwards, so every step submits the identical op
+    sequence (the group contract).  All ranks must therefore feed the
+    same parameters in the same order — true whenever they run the
+    same model graph; a divergent order fails the group's rendezvous
+    signature check with a structured mismatch error rather than
+    corrupting data.  Later steps tolerate out-of-plan-order arrivals:
+    a bucket is submitted only once it AND every earlier bucket are
+    full, preserving launch order.
+
+    Elastic training: the group is re-resolved every step from
+    ``session.get_collective_group()``, so a synchronizer survives a
+    re-form (the re-entered loop sees the new group name).  A
+    re-formation mid-step fails in-flight bucket waits with
+    CollectiveGroupError; the per-step state is reset before the error
+    propagates, so the re-entered loop starts from a clean step."""
+
+    def __init__(self, *, group_name: Optional[str] = None,
+                 average: bool = True,
+                 bucket_bytes: Optional[int] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        self._group_arg = group_name
+        self._average = average
+        self._bucket_bytes = int(bucket_bytes
+                                 or cfg.collective_bucket_bytes)
+        self._plan: Optional[List[List[str]]] = None  # sealed name lists
+        self._slot: Dict[str, Tuple[int, int]] = {}
+        self._reset_step()
+
+    def _reset_step(self):
+        if not self._slot:
+            # The plan never froze (first step aborted): rebuild it
+            # from scratch next step rather than keep a partial one.
+            self._plan = None
+        self._started = False
+        self._group: Optional[str] = None
+        self._step_grads: Dict[str, np.ndarray] = {}
+        self._filled: List[int] = []
+        self._fired = 0
+        self._works: list = []        # (names, CollectiveWork)
+        self._open: List[str] = []    # first step: names in the open bucket
+        self._open_bytes = 0
+        self._open_dtype = None
+
+    # -- internals -----------------------------------------------------
+    def _submit(self, names: List[str]):
+        from ray_tpu.util import collective as col
+        bucket = col.CollectiveBucket(
+            [self._step_grads[n] for n in names])
+        self._works.append(
+            (names, bucket.allreduce_async(group_name=self._group)))
+
+    def _seal_open(self):
+        if not self._open:
+            return
+        names, self._open = self._open, []
+        self._open_bytes, self._open_dtype = 0, None
+        self._plan.append(names)
+        self._submit(names)
+
+    def _fire_ready(self):
+        while self._fired < len(self._plan) and \
+                self._filled[self._fired] == len(self._plan[self._fired]):
+            self._submit(self._plan[self._fired])
+            self._fired += 1
+
+    # -- public API ----------------------------------------------------
+    def grad_ready(self, name: str, grad) -> None:
+        """Hand over one parameter's gradient as backward produces it.
+        May start a fused allreduce; never blocks on one."""
+        if not self._started:
+            self._started = True
+            _step_failpoint()
+            if self._plan is not None:
+                self._filled = [0] * len(self._plan)
+            if self._group_arg is not None:
+                self._group = self._group_arg
+            else:
+                try:
+                    from ray_tpu.air import session
+                    self._group = session.get_collective_group()
+                except Exception:
+                    self._group = None
+        if name in self._step_grads:
+            raise ValueError(f"gradient {name!r} fed twice this step")
+        arr = np.ascontiguousarray(grad)
+        self._step_grads[name] = arr
+        if self._group is None:
+            return  # single-worker / no gang group: passthrough
+        try:
+            if self._slot:
+                slot = self._slot.get(name)
+                if slot is None:
+                    raise ValueError(
+                        f"unknown gradient {name!r}: the bucket plan "
+                        "was fixed on the first step (create a new "
+                        "GradientSynchronizer if the model changed)")
+                self._filled[slot[0]] += 1
+                self._fire_ready()
+            else:
+                # First step: grow the open bucket in arrival order,
+                # seal+submit at the byte threshold or a dtype change
+                # (buckets are dtype-homogeneous).
+                if self._open and (arr.dtype != self._open_dtype
+                                   or self._open_bytes + arr.nbytes
+                                   > self._bucket_bytes):
+                    self._seal_open()
+                if self._plan is None:
+                    self._plan = []
+                if not self._open:
+                    self._open_dtype = arr.dtype
+                self._open.append(name)
+                self._open_bytes += arr.nbytes
+        except BaseException:
+            self._reset_step()
+            raise
+
+    def finish(self) -> Dict[str, np.ndarray]:
+        """Wait for the in-flight buckets (submission order), average,
+        and return {name: reduced gradient} (reduced in place where the
+        input arrays were writable).  Resets for the next step."""
+        from ray_tpu.util import collective as col
+        if not self._started:
+            return {}
+        try:
+            if self._group is None:
+                out = self._step_grads
+                self._reset_step()
+                return out
+            if not self._slot:
+                # Still on the first step: seal the tail bucket and
+                # freeze the plan for every later step.
+                self._seal_open()
+                self._slot = {n: (b, s)
+                              for b, names in enumerate(self._plan or [])
+                              for s, n in enumerate(names)}
+            else:
+                missing = [n for n in self._slot
+                           if n not in self._step_grads]
+                if missing:
+                    raise ValueError(
+                        "finish() before every gradient arrived "
+                        f"(missing: {sorted(missing)[:5]})")
+            out: Dict[str, np.ndarray] = {}
+            for names, work in self._works:
+                for n, t in zip(names, work.wait()):
+                    out[n] = t
+            if self._average:
+                world = col.get_group_handle(self._group).world_size
+                if world > 1:
+                    for t in out.values():
+                        if np.issubdtype(t.dtype, np.inexact):
+                            np.divide(t, world, out=t)
+            return out
+        finally:
+            self._reset_step()

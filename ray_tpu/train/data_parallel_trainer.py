@@ -103,6 +103,11 @@ class DataParallelTrainer(BaseTrainer):
         # set one, else 3 (reference: BackendExecutor default retries).
         # Distinct from Tune trial retries — a gang restart resumes from
         # the last in-trial checkpoint WITHOUT restarting the trial.
+        # With ScalingConfig(elastic=True) this budget counts COLD
+        # restarts only: in-place elastic re-forms are absorbed inside
+        # executor.get_next_results and never raise TrainingWorkerError
+        # unless the re-form itself failed (quorum loss / deadline /
+        # re-shard fault) — only that fallback consumes a unit here.
         budget = fc.max_failures if fc is not None else 3
         executor = BackendExecutor(self._backend_config,
                                    self.scaling_config)

@@ -1,0 +1,351 @@
+"""Elastic gang recovery: re-form the training collective at a new
+world size instead of cold-restarting the trial.
+
+When a gang member dies (the collective plane's death watch aborts the
+group, so every survivor's in-flight op raises CollectiveGroupError
+within a round trip) or the driver grants a resize, survivors
+rendezvous a fresh group incarnation through a per-gang named
+**elastic coordinator** actor:
+
+    worker:  break -> report_break -> wait_reform -> init new group
+             -> state sync (re-shard) -> re-enter train_fn
+    driver:  begin_recovery -> collect breaks (settle window, bounded
+             by RT_TRAIN_REFORM_TIMEOUT_S + jitter) -> quorum check
+             -> assign compact ranks -> arm death watch -> post_reform
+             -> await reform_done from every rank
+
+State sync broadcasts the authoritative survivor's in-memory stash
+(``session.stash_elastic_state``) to every member over the collective
+data plane (one-sided reads / blob frames for large states — no
+checkpoint round trip).  Authoritative = the *lowest committed step*
+among stash holders (lowest rank tiebreak): the least-advanced
+survivor's state is the only one every rank is guaranteed to have
+contributed to, so all ranks roll back to it and the loss curve stays
+continuous.  Adoption is atomic per worker (full deserialize, then one
+reference swap) — a death mid-re-shard aborts the new group, every
+survivor's sync raises, and the driver falls back to the last
+checkpoint; a torn optimizer state is structurally impossible.
+
+The driver coordinates ONLY through the elastic coordinator — never
+through worker-actor RPCs: a worker's actor methods ride a serial
+thread pool that a blocked ``next_result`` would head-of-line block.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.util.collective.types import CollectiveGroupError
+
+logger = logging.getLogger(__name__)
+
+_ELASTIC_PREFIX = "_rt_train_elastic::"
+
+# Flush marker a surviving rejoin enqueues on its report queue.  The
+# driver discards exactly one in-flight next_result per member when it
+# drops the interrupted round, and that call consumes exactly one queue
+# item whenever it runs — the marker is that item, so discarded refs
+# never eat a real post-reform report (which would skew per-rank report
+# counts and trip the driver's even-reporting invariant).  A marker the
+# stale call did NOT eat (it had already consumed a pre-break report)
+# reaches the driver, which skips it and re-polls that worker alone.
+FLUSH = "__rt_elastic_flush__"
+
+
+class ElasticReset(Exception):
+    """The gang broke (member death / resize grant): unwind the user
+    train loop so the worker can rejoin the re-formed group.  Raised
+    out of ``session.report`` and the gradient-sync entry points; user
+    loops should let it propagate."""
+
+
+class _ElasticCoordinator:
+    """Async named actor: the per-gang reform rendezvous.
+
+    One *generation* per successful re-form (gen 0 = the original
+    gang).  Workers report breaks against their current generation;
+    the driver posts instructions for the next one.  A fresh
+    coordinator is created per gang incarnation (cold restarts get a
+    new name), so no cross-incarnation state can leak."""
+
+    def __init__(self):
+        import asyncio
+        self._cond = asyncio.Condition()
+        self._recovery_gen = 0        # highest recovery announced
+        self._breaks: dict = {}       # gen -> {old_rank: info}
+        self._reform: dict | None = None   # latest instruction (or abort)
+        self._done: dict = {}         # gen -> {rank: [ok, err]}
+
+    # -- worker side ---------------------------------------------------
+    async def wait_signal(self, after_gen: int):
+        """Long-poll for a recovery announcement newer than
+        ``after_gen`` (the worker agent thread uses this to wake a
+        loop thread blocked in session.report)."""
+        async with self._cond:
+            while self._recovery_gen <= after_gen:
+                await self._cond.wait()
+            return self._recovery_gen
+
+    async def report_break(self, gen: int, old_rank: int, info: dict):
+        async with self._cond:
+            self._breaks.setdefault(gen, {})[int(old_rank)] = info
+            self._cond.notify_all()
+        return True
+
+    async def wait_reform(self, gen: int):
+        """Block until the driver posts instructions superseding the
+        caller's generation."""
+        async with self._cond:
+            while self._reform is None or self._reform["gen"] <= gen:
+                await self._cond.wait()
+            return dict(self._reform)
+
+    async def report_reform_done(self, gen: int, rank: int, ok: bool,
+                                 err: str | None = None):
+        async with self._cond:
+            self._done.setdefault(gen, {})[int(rank)] = [bool(ok), err]
+            self._cond.notify_all()
+        return True
+
+    # -- driver side ---------------------------------------------------
+    async def begin_recovery(self, gen: int):
+        async with self._cond:
+            if gen > self._recovery_gen:
+                self._recovery_gen = gen
+            self._cond.notify_all()
+        return True
+
+    async def breaks(self, gen: int):
+        async with self._cond:
+            return dict(self._breaks.get(gen, {}))
+
+    async def post_reform(self, instr: dict):
+        async with self._cond:
+            self._reform = dict(instr)
+            self._cond.notify_all()
+        return True
+
+    async def reform_status(self, gen: int):
+        async with self._cond:
+            return dict(self._done.get(gen, {}))
+
+
+def create_elastic_coordinator():
+    """Driver side: spawn a fresh named elastic coordinator for one
+    gang incarnation.  Returns (name, handle)."""
+    name = _ELASTIC_PREFIX + os.urandom(4).hex()
+    coord = ray_tpu.remote(_ElasticCoordinator).options(
+        name=name, num_cpus=0).remote()
+    return name, coord
+
+
+def kill_elastic_coordinator(name: str | None):
+    if not name:
+        return
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(name))
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------- worker
+
+
+def start_agent(worker):
+    """Daemon thread per worker: long-polls the elastic coordinator so
+    a loop thread blocked in ``session.report`` (not in a collective
+    op — the death watch can't reach it there) still learns about a
+    recovery and unwinds into the rejoin path."""
+    sess = worker._session
+    coord_name = worker._elastic_coord
+
+    def _watch():
+        while not sess.stop_requested and sess is worker._session:
+            try:
+                coord = ray_tpu.get_actor(coord_name)
+            except Exception:
+                return  # gang incarnation over
+            try:
+                g = ray_tpu.get(  # noqa: RTL001
+                    coord.wait_signal.remote(sess.elastic_gen),
+                    timeout=30)
+            except ray_tpu.exceptions.GetTimeoutError:
+                continue
+            except Exception:
+                if sess.stop_requested or sess is not worker._session:
+                    return
+                time.sleep(0.5)
+                continue
+            if g > sess.elastic_gen:
+                sess.reform_pending_gen = g
+                sess.continue_event.set()
+                # Wait until the loop thread consumed the signal (its
+                # generation advanced) before long-polling again.
+                while (sess.elastic_gen < g and not sess.stop_requested
+                       and sess is worker._session):
+                    time.sleep(0.2)
+
+    t = threading.Thread(target=_watch, daemon=True,
+                         name="rt-elastic-agent")
+    t.start()
+    return t
+
+
+def rejoin(worker, error, joining: bool = False) -> None:
+    """Worker side of one re-formation, run on the LOOP thread (the
+    one that was executing train_fn).  Raises on abort/deadline — the
+    worker records the error and the driver cold-restarts."""
+    sess = worker._session
+    deadline = (cfg.train_reform_timeout_s + cfg.train_reform_jitter_s
+                + 15.0)
+    coord = ray_tpu.get_actor(worker._elastic_coord)
+
+    if not joining:
+        # 1. Tear down the local member of the broken group.  This
+        # also aborts any in-flight bucket handles: the member's op
+        # executor shuts down and pending waits fail with the group's
+        # CollectiveGroupError.
+        from ray_tpu.util import collective as col
+        old_group = os.environ.get("RT_TRAIN_COLLECTIVE_GROUP") or None
+        if old_group is not None:
+            col.destroy_local_member(old_group)
+        # 2. Drop reports the driver will never consume (it discards
+        # the interrupted round; every rank re-reports from the
+        # authoritative step after the re-shard).
+        while True:
+            try:
+                sess.result_queue.get_nowait()
+            except queue.Empty:
+                break
+        sess.result_queue.put((FLUSH, sess.elastic_gen + 1))
+        st = sess._elastic_state
+        info = {"step": (st or {}).get("step", -1),
+                "has_state": st is not None,
+                "iteration": sess.iteration}
+        ray_tpu.get(coord.report_break.remote(
+            sess.elastic_gen, worker.world_rank, info), timeout=60)
+
+    # 3. Wait for the driver's instructions.
+    instr = ray_tpu.get(coord.wait_reform.remote(sess.elastic_gen),
+                        timeout=deadline)
+    if instr.get("action") == "abort":
+        raise error if error is not None else ElasticReset(
+            "elastic reform aborted: " + str(instr.get("reason", "")))
+
+    if joining:
+        token = os.environ.get("RT_TRAIN_ELASTIC_TOKEN", "")
+        new_rank = instr["joiners"][token]
+    else:
+        new_rank = instr["ranks"][str(worker.world_rank)]
+    world = instr["world_size"]
+    group = instr["group"]
+    gen = instr["gen"]
+    old_rank = worker.world_rank
+
+    try:
+        from ray_tpu.util import collective as col
+        col.init_collective_group(world, new_rank, group_name=group)
+
+        # Chaos hook: kill/err a member between group formation and
+        # state adoption — the canonical mid-re-shard death.  The new
+        # group's death watch (armed by the driver before post_reform)
+        # aborts every survivor's sync, and the driver falls back to
+        # the checkpoint.
+        if failpoints.ACTIVE:
+            act = failpoints.check("train.reform", peer=f"r{old_rank}")
+            if act is not None:
+                if act.kind == "kill":
+                    os._exit(int(act.arg or 1))
+                if act.kind == "error":
+                    raise CollectiveGroupError(
+                        group, "failpoint: injected re-shard fault at "
+                        f"rank {old_rank}")
+                if act.kind == "delay":
+                    time.sleep(act.delay_s)
+
+        auth_meta = _state_sync(group, sess)
+
+        # Re-split datasets across the new world size and align epoch
+        # counters to the authoritative rank so every member derives
+        # the same per-epoch shuffle order.
+        epochs = (auth_meta or {}).get("epochs") or {}
+        worker._reshard_datasets(world, new_rank, epochs)
+
+        # 4. Adopt the new identity (env + session + actor fields).
+        os.environ["RT_TRAIN_WORLD_SIZE"] = str(world)
+        os.environ["RT_TRAIN_WORLD_RANK"] = str(new_rank)
+        os.environ["RT_TRAIN_LOCAL_RANK"] = str(new_rank)
+        os.environ["RT_TRAIN_COLLECTIVE_GROUP"] = group
+        worker.world_rank = new_rank
+        worker.world_size = world
+        worker.local_rank = new_rank
+        sess.world_rank = new_rank
+        sess.world_size = world
+        sess.local_rank = new_rank
+        if auth_meta is not None:
+            sess.iteration = int(auth_meta.get("iteration", 0))
+        sess.elastic_gen = gen
+        sess.elastic_resizes += 1
+        ray_tpu.get(coord.report_reform_done.remote(
+            gen, new_rank, True, None), timeout=60)
+        logger.info("elastic rejoin: rank %s -> %s/%s (gen %s)",
+                    old_rank, new_rank, world, gen)
+    except BaseException as e:
+        try:
+            ray_tpu.get(coord.report_reform_done.remote(
+                gen, new_rank, False, repr(e)), timeout=10)
+        except Exception:
+            pass
+        raise
+
+
+def _state_sync(group_name: str, sess):
+    """One fixed op sequence on the NEW group, every member: gather
+    stash metadata, pick the authoritative holder (min committed step,
+    lowest rank tiebreak), broadcast its pickled stash, adopt
+    atomically.  Returns the authoritative meta (or None when no rank
+    stashed state — the loop re-enters from the last checkpoint)."""
+    from ray_tpu.util import collective as col
+    g = col.get_group_handle(group_name)
+    st = sess._elastic_state
+    meta = {"step": (st or {}).get("step", -1),
+            "has_state": st is not None,
+            "iteration": sess.iteration,
+            "epochs": {n: int(getattr(s, "epoch", 0))
+                       for n, s in sess.dataset_shards.items()}}
+    metas = g.collect("gather", meta)  # rank order
+    holders = [(m["step"], r) for r, m in enumerate(metas)
+               if m["has_state"]]
+    if not holders:
+        sess._elastic_state = None
+        return None
+    _auth_step, auth = min(holders)
+    blob = pickle.dumps(st, protocol=pickle.HIGHEST_PROTOCOL) \
+        if g.rank == auth else b""
+    hdr = g.collect(f"src:{auth}", {"nbytes": len(blob)})
+    n = int(hdr["nbytes"])
+    if g.rank == auth:
+        buf = np.frombuffer(bytearray(blob), dtype=np.uint8)
+    else:
+        buf = np.empty(n, dtype=np.uint8)
+    if n:
+        col.broadcast(buf, src_rank=auth, group_name=group_name)
+    if g.rank != auth:
+        state = pickle.loads(buf.tobytes())
+    else:
+        state = st
+    # Atomic adoption: the fully-deserialized dict swaps in with one
+    # reference assignment — there is no window where a reader can see
+    # half of the old state and half of the new.
+    sess._elastic_state = state
+    return metas[auth]

@@ -186,6 +186,31 @@ class StreamingDatasetShard:
             yield ({k: place(v) for k, v in batch.items()}
                    if isinstance(batch, dict) else place(batch))
 
+    def resplit(self, ds, *, epoch: Optional[int] = None):
+        """Elastic re-shard: swap in this rank's NEW shard of the
+        dataset (split across the re-formed gang's world size) without
+        rebuilding the wrapper.  The primed next-epoch pipeline over
+        the OLD shard is dropped — its rows belong to a partition that
+        no longer exists.  ``epoch`` (the authoritative rank's counter)
+        realigns this member so every rank keeps deriving the same
+        per-epoch shuffle seed, and the next ``iter_batches`` pass
+        partitions the whole dataset exactly once across the new gang:
+        no row is dropped or double-read WITHIN an epoch started after
+        the re-form.  Rows of the interrupted epoch are replayed
+        exactly as far as the step rollback replays steps."""
+        if self._prime_thread is not None:
+            self._prime_thread.join(timeout=30)
+            self._prime_thread = None
+        with self._lock:
+            primed, self._primed = self._primed, None
+        if primed is not None:
+            close = getattr(primed[3], "close", None)
+            if close is not None:
+                close()
+        self._ds = ds
+        if epoch is not None:
+            self._epoch = int(epoch)
+
     def close(self):
         """Drop a primed-but-unconsumed epoch (cancels its window)."""
         with self._lock:

@@ -20,6 +20,7 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     CollectiveBucket,
     CollectiveMixin,
     CollectiveWork,
+    abort_collective_group,
     allgather,
     allreduce,
     allreduce_async,
@@ -29,6 +30,8 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     create_collective_gang,
     create_collective_group,
     destroy_collective_group,
+    destroy_local_member,
+    ensure_coordinator,
     fuse_buckets,
     get_group_handle,
     init_collective_group,

@@ -572,6 +572,62 @@ def destroy_collective_group(group_name: str = "default") -> None:
         pass
 
 
+def destroy_local_member(group_name: str = "default") -> None:
+    """Tear down THIS process's membership of a group without touching
+    the coordinator or the other members: pop the handle, shut down the
+    serial op executor (in-flight bucket handles fail with the group's
+    CollectiveGroupError instead of lingering), and clear the
+    transport's per-group state.  The elastic-training rejoin path uses
+    this — the group as a whole is already dead (death watch / abort),
+    and each survivor only needs to drop its local half before joining
+    the re-formed incarnation under a fresh name."""
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        g.shutdown()
+    try:
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.global_worker
+        if w is not None and w._collective_transport is not None:
+            w._collective_transport.forget_group(group_name)
+    except Exception:
+        pass
+
+
+def ensure_coordinator(group_name: str, world_size: int):
+    """Driver side: get-or-create the named coordinator actor for a
+    group BEFORE its members self-register (init_collective_group on
+    each member get-or-creates too; pre-creating lets the driver arm
+    the death watch first, so a member dying mid-formation still fails
+    the group fast).  Returns the coordinator handle."""
+    name = _COORD_PREFIX + group_name
+    try:
+        return ray_tpu.get_actor(name)
+    except ValueError:
+        try:
+            coord_cls = ray_tpu.remote(_Coordinator)
+            return coord_cls.options(name=name, num_cpus=0).remote(
+                world_size, group_name)
+        except ValueError:
+            return ray_tpu.get_actor(name)
+
+
+def abort_collective_group(group_name: str = "default",
+                           reason: str = "aborted") -> None:
+    """Fail every pending and future op of a group NOW without killing
+    the coordinator (members observe a structured CollectiveGroupError
+    naming ``reason``).  The elastic resize path uses this to break
+    survivors out of the step loop so they rendezvous the new world
+    size."""
+    try:
+        coord = ray_tpu.get_actor(_COORD_PREFIX + group_name)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(coord.abort.remote(reason), timeout=30)
+    except Exception:
+        pass
+
+
 def get_group_handle(group_name: str = "default") -> GroupMember:
     g = _groups.get(group_name)
     if g is None:
@@ -1008,11 +1064,21 @@ class CollectiveBucket:
             else list(range(len(tensors)))
         self._shapes = [t.shape for t in tensors]
         self._sizes = [int(t.size) for t in tensors]
-        self.flat = np.empty(sum(self._sizes), dtype=dt)
-        pos = 0
-        for t, n in zip(tensors, self._sizes):
-            np.copyto(self.flat[pos:pos + n], t.reshape(-1))
-            pos += n
+        if len(tensors) == 1 and tensors[0].flags.c_contiguous:
+            # Single-tensor bucket: skip the pack copy and publish the
+            # caller's buffer itself (same-host peers one-sided-read
+            # straight out of it).  This keeps the SUBMIT side of
+            # hook-ordered gradient overlap O(1) — the memcpy was the
+            # dominant main-thread cost per bucket.  The caller must
+            # not mutate the tensor until the op completes (the same
+            # contract the in-place sync allreduce already has).
+            self.flat = tensors[0].reshape(-1)
+        else:
+            self.flat = np.empty(sum(self._sizes), dtype=dt)
+            pos = 0
+            for t, n in zip(tensors, self._sizes):
+                np.copyto(self.flat[pos:pos + n], t.reshape(-1))
+                pos += n
 
     @property
     def nbytes(self) -> int:

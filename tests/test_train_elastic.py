@@ -1,0 +1,432 @@
+"""Elastic data-parallel training: member death and resize re-form the
+gang IN PLACE (train/elastic.py) — survivors rendezvous a new collective
+incarnation, re-shard in-memory state over the collective plane, and the
+trial resumes without a cold restart; quorum loss or a re-shard fault
+falls back cleanly to the last checkpoint."""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu.cluster_utils import ProcessCluster
+
+
+@pytest.fixture
+def proc_cluster():
+    c = ProcessCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def ray_6cpu():
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+TOTAL_STEPS = 14
+
+
+def _elastic_loop(config):
+    """Per-step: allreduce a gradient, stash resume state, report.
+    Appends one "<pid>:<rank>:<resume step>:<world>" line per (re)entry
+    so the test can prove in-place resumption (same pid, new world)."""
+    import os
+    import time
+
+    import numpy as np
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.train.collective import allreduce_gradients
+
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    st = session.get_elastic_state()
+    ck = session.get_checkpoint()
+    if st is not None:
+        start = int(st["step"]) + 1
+        w = np.asarray(st["w"], dtype=np.float64).copy()
+    elif ck is not None:
+        d = ck.to_dict()
+        start = int(d["step"]) + 1
+        w = np.asarray(d["w"], dtype=np.float64).copy()
+    else:
+        start, w = 0, np.zeros(4)
+    with open(config["log"], "a") as f:
+        f.write(f"{os.getpid()}:{rank}:{start}:{world}\n")
+    for step in range(start, TOTAL_STEPS):
+        g = allreduce_gradients(np.ones(4) * (rank + 1.0))
+        w = w + g
+        session.stash_elastic_state({"step": step, "w": w})
+        time.sleep(float(config.get("sleep", 0.3)))
+        ckpt = None
+        if config.get("checkpoint"):
+            ckpt = Checkpoint.from_dict({"step": step, "w": list(w)})
+        session.report({"step": step, "w0": float(w[0])},
+                       checkpoint=ckpt)
+
+
+def _parse_log(path):
+    out = []
+    for line in open(path).read().splitlines():
+        pid, rank, start, world = line.split(":")
+        out.append((int(pid), int(rank), int(start), int(world)))
+    return out
+
+
+def _wait_for_entries(path, n, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path) and len(_parse_log(path)) >= n:
+            return _parse_log(path)
+        time.sleep(0.3)
+    raise AssertionError(f"{path}: fewer than {n} entries")
+
+
+def _fit_in_thread(trainer):
+    out: dict = {}
+
+    def _fit():
+        try:
+            out["result"] = trainer.fit()
+        except BaseException as e:
+            out["error"] = e
+    t = threading.Thread(target=_fit, daemon=True)
+    t.start()
+    return t, out
+
+
+@pytest.mark.slow
+def test_elastic_sigkill_resumes_in_place(proc_cluster, tmp_path):
+    """Chaos leg 1: SIGKILL a member mid-epoch.  The gang re-forms at
+    W-1 within the reform deadline and resumes from the survivors'
+    in-memory stashes — same worker processes, no checkpoint given, and
+    FailureConfig(max_failures=0) proves the elastic recovery consumed
+    no cold-restart budget."""
+    from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train import DataParallelTrainer, JaxConfig
+
+    c = proc_cluster
+    c.add_node(num_cpus=6)
+    assert c.wait_for_nodes(1)
+    c.connect()
+
+    log = str(tmp_path / "starts")
+    trainer = DataParallelTrainer(
+        _elastic_loop,
+        train_loop_config={"log": log},
+        backend_config=JaxConfig(use_distributed=False),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+        scaling_config=ScalingConfig(num_workers=3, elastic=True,
+                                     resources_per_worker={"CPU": 1}))
+    t, out = _fit_in_thread(trainer)
+
+    entries = _wait_for_entries(log, 3)
+    victim = next(e for e in entries if e[1] == 1 and e[3] == 3)
+    time.sleep(1.5)  # let a few steps stash
+    kill_t = time.monotonic()
+    os.kill(victim[0], signal.SIGKILL)
+
+    t.join(timeout=180)
+    elapsed = time.monotonic() - kill_t
+    assert not t.is_alive(), "fit() hung after elastic member death"
+    assert "error" not in out, f"fit failed: {out.get('error')}"
+    assert out["result"].metrics["step"] == TOTAL_STEPS - 1
+
+    entries = _parse_log(log)
+    first_pids = {e[0] for e in entries if e[3] == 3}
+    reentries = [e for e in entries if e[3] == 2]
+    # Both survivors re-entered at world 2, in the SAME processes,
+    # resuming from stashed state (start > 0) with no checkpoint
+    # configured — the re-shard path, not a cold restart.
+    assert len(reentries) == 2, f"expected 2 re-entries, got {entries}"
+    for pid, _rank, start, _world in reentries:
+        assert pid in first_pids, "re-entry in a NEW process (cold path)"
+        assert pid != victim[0]
+        assert start > 0, "re-entry did not resume from stashed state"
+    # max_failures=0: completion itself proves no budget was consumed.
+    # "within seconds": the whole remaining run (recovery + the
+    # rolled-back steps at ~0.3 s each) fits well under the cold
+    # restart's start_training + full-replay cost.
+    assert elapsed < 90
+
+
+@pytest.mark.slow
+def test_reshard_death_falls_back_to_checkpoint(proc_cluster, tmp_path):
+    """Chaos leg 2: a second member dies DURING the re-shard
+    (train.reform failpoint).  The new group's death watch aborts every
+    survivor's state sync, nobody adopts torn state, and the driver
+    falls back to a clean cold restart from the last checkpoint."""
+    from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train import DataParallelTrainer, JaxConfig
+
+    c = proc_cluster
+    c.add_node(num_cpus=6)
+    assert c.wait_for_nodes(1)
+    c.connect()
+
+    log = str(tmp_path / "starts")
+    trainer = DataParallelTrainer(
+        _elastic_loop,
+        train_loop_config={"log": log, "checkpoint": True,
+                           # Old rank 2 SIGKILLs itself between joining
+                           # the re-formed group and adopting state.
+                           "__failpoints__": "train.reform=kill|peer=r2"},
+        backend_config=JaxConfig(use_distributed=False),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+        scaling_config=ScalingConfig(num_workers=3, elastic=True,
+                                     resources_per_worker={"CPU": 1}))
+    t, out = _fit_in_thread(trainer)
+
+    entries = _wait_for_entries(log, 3)
+    victim = next(e for e in entries if e[1] == 1 and e[3] == 3)
+    time.sleep(1.5)
+    os.kill(victim[0], signal.SIGKILL)
+
+    t.join(timeout=240)
+    assert not t.is_alive(), "fit() hung after re-shard death"
+    assert "error" not in out, f"fit failed: {out.get('error')}"
+    assert out["result"].metrics["step"] == TOTAL_STEPS - 1
+
+    entries = _parse_log(log)
+    initial_pids = {e[0] for e in entries if e[2] == 0}
+    # The elastic path never completed (rank 2 died mid-re-shard), so
+    # every re-entry is the cold restart: fresh processes at world 3
+    # resuming from the checkpoint — never torn state, no world-2 run.
+    cold = [e for e in entries if e[0] not in initial_pids]
+    assert len(cold) == 3, f"expected full cold restart, got {entries}"
+    assert all(e[2] > 0 and e[3] == 3 for e in cold), \
+        f"cold restart lost the checkpoint: {entries}"
+    assert not any(e[3] == 2 for e in entries), \
+        "a torn elastic re-form completed"
+
+
+def _pump(executor, collected, until_none=True, max_rounds=500):
+    """Drive get_next_results, recording rank 0's step per round."""
+    for _ in range(max_rounds):
+        results = executor.get_next_results()
+        if results is None:
+            return True
+        collected.append(results[0].metrics["step"])
+    return False
+
+
+@pytest.mark.slow
+def test_elastic_death_then_scale_up(ray_6cpu, tmp_path):
+    """Driver-level elasticity: kill a member (re-form at W-1), then
+    grant a resize back to W — the joiner adopts broadcast state and the
+    run completes with train_elastic_resizes_total == 2 and an unbroken
+    step stream."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train._internal import backend_executor as be
+    from ray_tpu.util.metrics import registry_snapshot
+
+    def _count(name):
+        for s in registry_snapshot():
+            if s["name"] == name:
+                return sum(s["values"].values())
+        return 0.0
+
+    resizes0 = _count("train_elastic_resizes_total")
+    log = str(tmp_path / "starts")
+    executor = be.BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=3, elastic=True,
+                      resources_per_worker={"CPU": 1}))
+    executor.start()
+    try:
+        executor.start_training(
+            _elastic_loop, {"log": log, "sleep": 0.25},
+            trial_name="t", trial_id="t")
+        steps = []
+        for _ in range(3):
+            res = executor.get_next_results()
+            steps.append(res[0].metrics["step"])
+        ray_tpu.kill(executor.worker_group.workers[1])
+        for _ in range(3):  # recovery happens inside the pump
+            res = executor.get_next_results()
+            steps.append(res[0].metrics["step"])
+        assert len(executor.worker_group.workers) == 2
+        executor.request_elastic_resize(3)
+        assert _pump(executor, steps), "run did not finish"
+        executor.finish_training()
+    finally:
+        executor.shutdown()
+
+    assert len(executor._joiners) == 0
+    assert steps[-1] == TOTAL_STEPS - 1
+    # Continuity: the TRAINING state is continuous (rollback to the
+    # authoritative stash), but the driver's report stream may lose a
+    # few reports per re-form — the interrupted round is discarded.
+    # Forward jumps are therefore bounded and at most one per re-form;
+    # an unbounded jump or a reset to 0 would mean a cold restart.
+    jumps = [(a, b) for a, b in zip(steps, steps[1:]) if b > a + 1]
+    assert len(jumps) <= 2, f"too many report gaps: {steps}"
+    assert all(b - a <= 4 for a, b in jumps), f"unbounded gap: {steps}"
+    assert all(b > 0 for _, b in jumps), f"cold reset detected: {steps}"
+    assert _count("train_elastic_resizes_total") - resizes0 == 2
+    entries = _parse_log(log)
+    assert any(e[3] == 2 for e in entries), "no world-2 re-entry"
+    # The joiner re-formed back to world 3 with start > 0: it adopted
+    # the authoritative stash over the collective plane.
+    rejoined = [e for e in entries if e[3] == 3 and e[2] > 0]
+    assert len(rejoined) == 3, f"scale-up re-form missing: {entries}"
+
+
+@pytest.mark.slow
+def test_elastic_quorum_fallback_and_restart_counter(ray_6cpu, tmp_path):
+    """Below elastic_min_workers the re-form gives up within the
+    bounded deadline and surfaces TrainingWorkerError — the cold path —
+    and restart() counts into train_gang_restarts_total."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train._internal import backend_executor as be
+    from ray_tpu.util.metrics import registry_snapshot
+
+    def _count(name):
+        for s in registry_snapshot():
+            if s["name"] == name:
+                return sum(s["values"].values())
+        return 0.0
+
+    old_timeout = cfg.train_reform_timeout_s
+    cfg.train_reform_timeout_s = 6.0
+    restarts0 = _count("train_gang_restarts_total")
+    executor = be.BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=2, elastic=True, elastic_min_workers=2,
+                      resources_per_worker={"CPU": 1}))
+    try:
+        executor.start()
+        executor.start_training(
+            _elastic_loop, {"log": str(tmp_path / "s"), "sleep": 0.25},
+            trial_name="t", trial_id="t")
+        executor.get_next_results()
+        ray_tpu.kill(executor.worker_group.workers[1])
+        with pytest.raises(be.TrainingWorkerError):
+            while True:
+                executor.get_next_results()
+        executor.restart()
+        assert _count("train_gang_restarts_total") - restarts0 == 1
+    finally:
+        cfg.train_reform_timeout_s = old_timeout
+        executor.shutdown()
+
+
+def test_streaming_shard_resplit(ray_start_regular):
+    """Elastic re-shard of a streaming ingest shard: the primed
+    next-epoch pipeline over the old shard is dropped, the new shard
+    serves the next pass, and the epoch counter realigns."""
+    from ray_tpu import data
+    from ray_tpu.train.ingest import StreamingDatasetShard
+
+    old = data.from_items([{"x": float(i)} for i in range(8)],
+                          parallelism=2)
+    new = data.from_items([{"x": float(i)} for i in range(100, 106)],
+                          parallelism=2)
+    shard = StreamingDatasetShard(old, shuffle_each_epoch=True,
+                                  shuffle_seed=7)
+    first = [r["x"] for b in shard.iter_batches(batch_format="pylist")
+             for r in b]
+    assert sorted(first) == [float(i) for i in range(8)]
+    assert shard.epoch == 1
+
+    shard.resplit(new, epoch=5)
+    assert shard.epoch == 5
+    assert shard._primed is None
+    second = [r["x"] for b in shard.iter_batches(batch_format="pylist")
+              for r in b]
+    assert sorted(second) == [float(i) for i in range(100, 106)]
+    shard.close()
+
+
+def test_gradient_synchronizer_matches_allreduce(ray_start_regular):
+    """Hook-ordered bucketed overlap produces exactly the averaged
+    gradients, across steps (plan reuse) and out-of-plan arrival."""
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote
+    class Member(col.CollectiveMixin):
+        def __init__(self, rank):
+            self.rank = rank
+
+        def run(self):
+            from ray_tpu.train.collective import GradientSynchronizer
+            rng = np.random.RandomState(self.rank)
+            sync = GradientSynchronizer(group_name="gs",
+                                        bucket_bytes=64)
+            outs = []
+            for step in range(3):
+                grads = {f"p{i}": (rng.randn(4).astype(np.float32)
+                                   + step) for i in range(5)}
+                order = [f"p{i}" for i in range(5)]
+                if step == 2:
+                    order = order[::-1]  # out-of-plan arrival order
+                for name in order:
+                    sync.grad_ready(name, grads[name])
+                outs.append({k: v.copy()
+                             for k, v in sync.finish().items()})
+            return outs
+
+    members = [Member.remote(i) for i in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="gs")
+    r0, r1 = ray_tpu.get([m.run.remote() for m in members], timeout=300)
+
+    rngs = [np.random.RandomState(i) for i in range(2)]
+    for step in range(3):
+        raw = [{f"p{i}": rng.randn(4).astype(np.float32) + step
+                for i in range(5)} for rng in rngs]
+        for name in raw[0]:
+            want = (raw[0][name] + raw[1][name]) / 2.0
+            np.testing.assert_allclose(r0[step][name], want, rtol=1e-5)
+            np.testing.assert_allclose(r1[step][name], want, rtol=1e-5)
+
+
+def test_train_timeout_knobs_registered():
+    """Satellite: the hardcoded gang timeouts are now config knobs with
+    RT_TRAIN_* env overrides."""
+    from ray_tpu._private.config import _Config
+
+    assert cfg.train_start_timeout_s == 600.0
+    assert cfg.train_result_timeout_s == 3600.0
+    assert cfg.train_worker_join_s == 5.0
+    assert cfg.train_reform_timeout_s >= 1.0
+    assert cfg.train_reform_jitter_s >= 0.0
+    assert cfg.train_elastic_min_workers == 1
+
+    os.environ["RT_TRAIN_REFORM_TIMEOUT_S"] = "7.5"
+    os.environ["RT_TRAIN_WORKER_JOIN_S"] = "2.0"
+    try:
+        fresh = _Config()
+        assert fresh.train_reform_timeout_s == 7.5
+        assert fresh.train_worker_join_s == 2.0
+    finally:
+        del os.environ["RT_TRAIN_REFORM_TIMEOUT_S"]
+        del os.environ["RT_TRAIN_WORKER_JOIN_S"]
+    assert _Config(
+        {"train_result_timeout_s": 9.0}).train_result_timeout_s == 9.0
+
+
+def test_wrapped_group_error_keeps_attributes():
+    """An error re-raised at get() must keep the cause's structured
+    attributes: a survivor's rejoin wrapper dispatches on ``e.group``
+    to tell the gang's group from a user-managed one, and a wrapper
+    missing it killed the loop (AttributeError) instead of rejoining —
+    the gang then cold-restarted on a plain resize."""
+    from ray_tpu.exceptions import TaskError, _wrap_cause
+    from ray_tpu.util.collective.types import CollectiveGroupError
+
+    e = _wrap_cause(CollectiveGroupError("train_dp_ab", "member died"),
+                    "tb")
+    assert isinstance(e, CollectiveGroupError)
+    assert isinstance(e, TaskError)
+    assert e.group == "train_dp_ab"
+    assert e.reason == "member died"
