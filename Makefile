@@ -17,7 +17,8 @@ PY ?= python
 # verify's recipe uses pipefail, which POSIX sh (dash) rejects.
 SHELL := /bin/bash
 
-.PHONY: store store-tsan store-asan sanitize clean lint verify check \
+.PHONY: store store-tsan store-asan sanitize clean lint \
+	lint-concurrency-strict verify check \
 	bench-quick bench-llm-quick bench-transfer bench-collective \
 	bench-collective-quick bench-control bench-control-quick \
 	bench-serve-scale bench-serve-scale-quick bench-data \
@@ -25,8 +26,10 @@ SHELL := /bin/bash
 	bench-train-quick chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
-# lint: the AST-based distributed-correctness self-check (RTL001-008)
-# over our own tree; fails on any finding NOT in .rtlint-baseline.json.
+# lint: the AST-based distributed-correctness self-check (RTL001-008
+# API misuse + RTC101-104 concurrency: lock discipline, package-wide
+# lock-order cycles, blocking-under-lock, thread escape) over our own
+# tree; fails on any finding NOT in .rtlint-baseline.json.
 # verify: the tier-1 test command from ROADMAP.md.
 # bench-quick: <60 s hot-path probe — ray_perf --quick on the RPC
 # hot-path metrics + the serve overhead probe — so a submission/dispatch
@@ -36,6 +39,17 @@ SHELL := /bin/bash
 lint:
 	$(PY) -m ray_tpu.lint ray_tpu examples tests \
 		--baseline .rtlint-baseline.json
+
+# Nightly strict concurrency leg: RTC baseline entries count ONLY when
+# they carry a justification string in the baseline's "reasons" map
+# (an unjustified count bump fails), and the ThreadSanitizer store
+# stress runs in the same leg — the static analyzer and the dynamic
+# race detector cover each other's blind spots.
+lint-concurrency-strict: $(BUILD)/store_stress_tsan
+	$(PY) -m ray_tpu.lint ray_tpu examples tests --jobs 4 \
+		--select RTC101,RTC102,RTC103,RTC104 \
+		--baseline .rtlint-baseline.json --strict-reasons
+	$(BUILD)/store_stress_tsan
 
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -182,9 +196,13 @@ endif
 # ('not nightly', not 'not slow': the collective member-kill/destroy
 # scenarios are slow-marked to keep tier-1 inside its budget, but they
 # ARE the chaos battery's collective coverage.)
+# RT_LOCK_SANITIZER=1: every locksan-wrapped lock records acquisition
+# order during the battery; tests/conftest.py fails any test that
+# records a lock-order violation (the dynamic half of RTC102).
 chaos:
 	@echo "== chaos battery: RT_CHAOS_SEED=$(CHAOS_SEED) =="
-	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) timeout -k 10 600 \
+	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) \
+		RT_LOCK_SANITIZER=1 timeout -k 10 600 \
 		$(PY) -m pytest -q -m 'not nightly' -p no:cacheprovider \
 		tests/test_failpoints.py \
 		tests/test_rpc_fastpath.py::test_duplicated_actor_task_frames_deduped_by_seq \
@@ -209,7 +227,8 @@ chaos:
 # reconnect).
 chaos-smoke:
 	@echo "== chaos smoke: RT_CHAOS_SEED=$(CHAOS_SEED) =="
-	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) timeout -k 10 300 \
+	env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) \
+		RT_LOCK_SANITIZER=1 timeout -k 10 300 \
 		$(PY) -m pytest -q -p no:cacheprovider \
 		tests/test_failpoints.py::test_same_seed_identical_schedule \
 		tests/test_failpoints.py::test_half_open_detected_by_keepalive \

@@ -13,6 +13,8 @@ import itertools
 import os
 import threading
 
+from ray_tpu._private import locksan
+
 _ID_SIZE = 16
 
 
@@ -83,7 +85,7 @@ class FunctionID(BaseID):
 
 
 class TaskID(BaseID):
-    _counter_lock = threading.Lock()
+    _counter_lock = locksan.make_lock("TaskID._counter_lock")
     _counter = 0
     # Submission fast path: one urandom syscall per PROCESS, not per
     # task (urandom is expensive on syscall-filtered hosts).  The

@@ -16,6 +16,7 @@ import uuid
 from typing import Any, Callable, Dict, Tuple
 
 from ray_tpu import exceptions as rexc
+from ray_tpu._private import locksan
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
 
@@ -70,7 +71,7 @@ class LocalModeWorker:
         self._actors: Dict[ActorID, Any] = {}
         self._named: Dict[Tuple[str, str], ActorID] = {}
         self._actor_meta: Dict[ActorID, str] = {}
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("LocalModeWorker._lock")
         # RuntimeContext surface (api.get_runtime_context reads these).
         self.job_id = JobID.from_random()
         self.worker_id = None

@@ -1,0 +1,238 @@
+"""Runtime lock-order sanitizer: the dynamic half of the RTC pass.
+
+``ray_tpu/lint/concurrency.py`` derives the acquired-while-held graph
+statically (RTC102).  This module is its runtime complement: lock
+hotspots are created through :func:`make_lock` / :func:`make_rlock` /
+:func:`make_condition` with the SAME ``Class.attr`` / ``module.NAME``
+key the analyzer uses, and under the chaos/failpoint battery
+(``RT_LOCK_SANITIZER=1``) every wrapped acquisition is recorded:
+
+* a thread-local held stack tracks what each thread holds;
+* acquiring B while holding A records the edge ``A -> B``;
+* an acquisition whose REVERSE edge was already observed is a
+  lock-order **violation** — the interleaving that deadlocks exists,
+  whether or not this run hit it;
+* :func:`check_against_static` diffs the dynamic edges against the
+  analyzer's graph (``python -m ray_tpu.lint --emit-lock-graph``):
+  dynamic edges the analyzer missed are *analyzer gaps*, worth a bug
+  report against the lint pass itself.
+
+Cost model: when the sanitizer is disabled (the default), the
+factories return the raw ``threading`` primitive — zero wrapper, zero
+overhead, decided once at lock creation.  Enabling it
+(:func:`enable` or the env var) therefore only affects locks created
+AFTER the switch; module-level locks wrap only when the env var is set
+before import, which is how the chaos targets run
+(``RT_LOCK_SANITIZER=1 make chaos``) — child processes inherit the env
+and wrap theirs too.
+
+Reentrant holds of the same key (RLock, or two instances of one class)
+are skipped: per-key identity is the class attribute, matching the
+static graph's nodes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "enabled", "enable", "disable", "make_lock", "make_rlock",
+    "make_condition", "edges", "violations", "reset",
+    "load_static_graph", "check_against_static", "report",
+]
+
+_state_lock = threading.Lock()  # raw on purpose: guards the recorder
+_tls = threading.local()
+
+_enabled = bool(os.environ.get("RT_LOCK_SANITIZER", "")
+                not in ("", "0", "off", "false"))
+# (a, b) -> first-witness provenance
+_edges: Dict[Tuple[str, str], dict] = {}
+_violations: List[dict] = []
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Wrap locks created from now on (idempotent)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _call_site() -> str:
+    """file:line of the first frame outside this module and threading."""
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here and not fn.endswith("threading.py"):
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "?"
+
+
+def _record_acquire(name: str) -> None:
+    stack = _held_stack()
+    if not stack or stack[-1] == name or name in stack:
+        return  # first lock, or reentrancy on the same key
+    held = stack[-1]
+    site = _call_site()
+    with _state_lock:
+        edge = (held, name)
+        if edge not in _edges:
+            _edges[edge] = {"thread": threading.current_thread().name,
+                            "site": site}
+        rev = _edges.get((name, held))
+        if rev is not None:
+            _violations.append({
+                "edge": edge, "site": site,
+                "thread": threading.current_thread().name,
+                "reverse_site": rev["site"],
+                "reverse_thread": rev["thread"],
+                "message": (
+                    f"lock-order violation: {held} -> {name} at {site} "
+                    f"({threading.current_thread().name}) but "
+                    f"{name} -> {held} was taken at {rev['site']} "
+                    f"({rev['thread']}) — the opposite interleaving "
+                    "deadlocks")})
+
+
+class _SanLock:
+    """Order-recording wrapper around a threading lock.  Supports the
+    context-manager and acquire/release protocols, so it drops into
+    ``threading.Condition(lock=...)`` too."""
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            # Record at ATTEMPT time: if this acquisition is the one
+            # that deadlocks, the violation must already be on file.
+            _record_acquire(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if not blocking:
+                _record_acquire(self.name)
+            _held_stack().append(self.name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        stack = _held_stack()
+        # Remove the most recent hold of this key (Condition.wait
+        # releases out of top-of-stack order when other wrapped locks
+        # interleave).
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                break
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<locksan {self.name} {self._inner!r}>"
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` (raw when the sanitizer is off)."""
+    if not _enabled:
+        return threading.Lock()
+    return _SanLock(name, threading.Lock())
+
+
+def make_rlock(name: str):
+    if not _enabled:
+        return threading.RLock()
+    return _SanLock(name, threading.RLock())
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` over a (possibly wrapped) lock: with
+    the sanitizer on, waiting/reacquiring shows up as release/acquire
+    on the condition's key, exactly like the analyzer models it."""
+    return threading.Condition(make_lock(name))
+
+
+# ------------------------------------------------------------ inspection
+
+def edges() -> Dict[Tuple[str, str], dict]:
+    with _state_lock:
+        return dict(_edges)
+
+
+def violations() -> List[dict]:
+    with _state_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    """Clear recorded edges and violations (not the enabled flag)."""
+    with _state_lock:
+        _edges.clear()
+        del _violations[:]
+
+
+def load_static_graph(data) -> set:
+    """``{"edges": [[a, b], ...]}`` (the ``--emit-lock-graph`` shape,
+    or a path to a JSON file of it) -> a set of (a, b) tuples."""
+    if isinstance(data, (str, os.PathLike)):
+        import json
+        with open(data) as f:
+            data = json.load(f)
+    return {tuple(e) for e in data.get("edges", [])}
+
+
+def check_against_static(static_edges: set) -> dict:
+    """Diff dynamic reality against the analyzer's graph.
+
+    ``gaps``  — edges the runtime observed that static analysis missed
+    (report these against ray_tpu/lint/concurrency.py: a manual
+    acquire(), an attribute the ctor-scan didn't see, ...).
+    ``unexercised`` — static edges no test drove; coverage, not bugs.
+    """
+    dyn = set(edges())
+    return {
+        "gaps": sorted(dyn - static_edges),
+        "unexercised": sorted(static_edges - dyn),
+    }
+
+
+def report() -> str:
+    """Human-readable summary (used by the chaos battery on failure)."""
+    vio = violations()
+    eds = edges()
+    lines = [f"locksan: {len(eds)} edge(s), {len(vio)} violation(s)"]
+    for (a, b), prov in sorted(eds.items()):
+        lines.append(f"  edge {a} -> {b}  [{prov['site']} "
+                     f"{prov['thread']}]")
+    for v in vio:
+        lines.append(f"  VIOLATION {v['message']}")
+    return "\n".join(lines)

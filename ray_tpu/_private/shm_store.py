@@ -20,7 +20,9 @@ import os
 import subprocess
 import threading
 
-_LIB_LOCK = threading.Lock()
+from ray_tpu._private import locksan
+
+_LIB_LOCK = locksan.make_lock("shm_store._LIB_LOCK")
 _LIB = None
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "src", "shm_store.cc")

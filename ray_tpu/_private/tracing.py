@@ -55,6 +55,7 @@ import time
 from collections import deque
 from contextlib import contextmanager
 
+from ray_tpu._private import locksan
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 
 # ---------------------------------------------------------------- context
@@ -237,7 +238,7 @@ _ENABLED = bool(cfg.trace_enabled)
 # Drops already surfaced through the prometheus counter (export_metrics
 # incs by the delta so the counter is monotonic across snapshots).
 _exported_drops = 0
-_export_lock = threading.Lock()
+_export_lock = locksan.make_lock("tracing._export_lock")
 _metrics = None  # (drop Counter, depth Gauge) once built
 
 

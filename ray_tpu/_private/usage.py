@@ -26,6 +26,8 @@ import platform
 import threading
 import time
 from dataclasses import asdict, dataclass, field
+
+from ray_tpu._private import locksan
 from enum import Enum, auto
 from typing import Callable, Dict, List, Optional
 
@@ -34,7 +36,7 @@ USAGE_NS = "usage_stats"
 _LIB_PREFIX = b"library_usage:"
 _TAG_PREFIX = b"extra_usage_tag:"
 
-_lock = threading.Lock()
+_lock = locksan.make_lock("usage._lock")
 _pre_init_libraries: set = set()
 _pre_init_tags: Dict[str, str] = {}
 _recorded_libraries: set = set()
@@ -277,6 +279,10 @@ class UsageReporter:
             os.environ.get("RT_USAGE_STATS_REPORT_INTERVAL_S", "3600"))
         self.report_url = os.environ.get("RT_USAGE_STATS_REPORT_URL", "")
         self._start_ms = int(time.time() * 1000)
+        # report_once() is public API AND the loop thread's body: the
+        # counters need a real critical section, not loop confinement.
+        self._counters_lock = locksan.make_lock(
+            "UsageReporter._counters_lock")
         self._counters = {"success": 0, "failed": 0, "seq": 0}
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -290,9 +296,11 @@ class UsageReporter:
         self._stop.set()
 
     def report_once(self) -> UsageStatsToReport:
-        self._counters["seq"] += 1
+        with self._counters_lock:
+            self._counters["seq"] += 1
+            counters = dict(self._counters)
         report = generate_report(self.session_id, self._start_ms,
-                                 self._counters)
+                                 counters)
         error = None
         sent = False
         transport = _transport or (
@@ -301,10 +309,12 @@ class UsageReporter:
             try:
                 transport(self.report_url, asdict(report))
                 sent = True
-                self._counters["success"] += 1
+                with self._counters_lock:
+                    self._counters["success"] += 1
             except Exception as e:
                 error = repr(e)
-                self._counters["failed"] += 1
+                with self._counters_lock:
+                    self._counters["failed"] += 1
         try:
             path = os.path.join(self.session_dir, "usage_stats.json")
             with open(path, "w") as f:

@@ -191,24 +191,30 @@ class Monitor:
                  interval_s: float = 1.0):
         self.autoscaler = autoscaler
         self.interval_s = interval_s
-        self._stop = False
+        import threading
+        # An Event, not a bare bool: stop() must interrupt the sleep
+        # (a bool left the thread parked for a full interval, and a
+        # long interval outlived stop()'s bounded join).
+        self._stop = threading.Event()
         self._thread = None
 
     def start(self):
         import threading
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="autoscaler-monitor")
         self._thread.start()
 
     def _run(self):
-        while not self._stop:
+        while True:
             try:
                 self.autoscaler.update()
             except Exception:
                 logger.exception("autoscaler update failed")
-            time.sleep(self.interval_s)
+            if self._stop.wait(self.interval_s):
+                return
 
     def stop(self):
-        self._stop = True
+        self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -27,6 +27,18 @@ Rule codes (see ray_tpu/lint/rules.py for the implementations):
     RTL006  statically-unserializable capture (locks, files, generators)
     RTL007  jax/jnp compute in a task that requests no TPU resources
     RTL008  wait() misuse (wrong unpack, get(wait(...)), timeout=0 spin)
+
+The RTC1xx family (ray_tpu/lint/concurrency.py) turns the same engine
+on ray_tpu's OWN internals — lock discipline, lock-order deadlock
+cycles, blocking calls under a held lock, and unlocked objects escaping
+into spawned threads.  RTC102 is a *package-scope* rule: it merges a
+per-module summary (PackageRule.summarize) into one whole-tree
+acquired-while-held graph and reports cycles (PackageRule.check_package).
+
+    RTC101  attribute written both under a class lock and bare
+    RTC102  lock-order cycle (potential deadlock) across the package
+    RTC103  blocking call (get/wait/sleep/subprocess/cond-wait) under a lock
+    RTC104  object handed to a thread with no lock but mutated attributes
 """
 
 from __future__ import annotations
@@ -39,9 +51,11 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
 
 __all__ = [
-    "Finding", "Rule", "ModuleContext", "register_rule", "all_rules",
-    "lint_source", "lint_file", "lint_paths", "load_baseline",
-    "write_baseline", "apply_baseline", "baseline_key",
+    "Finding", "Rule", "PackageRule", "ModuleContext", "register_rule",
+    "register_package_rule", "all_rules", "all_package_rules",
+    "lint_source", "lint_file", "lint_paths", "collect_summaries",
+    "load_baseline", "write_baseline", "apply_baseline",
+    "baseline_key",
 ]
 
 # The names ray_tpu exports that the rules care about.  Aliased imports
@@ -90,21 +104,60 @@ class Rule:
                        severity=self.severity)
 
 
+class PackageRule:
+    """A whole-package rule: sees every linted module at once.
+
+    Per-module facts are extracted by ``summarize(ctx)`` into a plain
+    picklable dict (so ``--jobs`` workers can compute them in parallel
+    without shipping ASTs); ``check_package`` then runs ONCE over the
+    merged summary list.  Summaries must carry no AST nodes."""
+
+    code: str = ""
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def summarize(self, ctx: "ModuleContext") -> dict:
+        raise NotImplementedError
+
+    def check_package(self, summaries: List[dict]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 _REGISTRY: Dict[str, Type[Rule]] = {}
+_PACKAGE_REGISTRY: Dict[str, Type[PackageRule]] = {}
 
 
 def register_rule(cls: Type[Rule]) -> Type[Rule]:
     if not cls.code:
         raise ValueError(f"rule {cls.__name__} has no code")
-    if cls.code in _REGISTRY:
+    if cls.code in _REGISTRY or cls.code in _PACKAGE_REGISTRY:
         raise ValueError(f"duplicate rule code {cls.code}")
     _REGISTRY[cls.code] = cls
     return cls
 
 
+def register_package_rule(cls: Type[PackageRule]) -> Type[PackageRule]:
+    if not cls.code:
+        raise ValueError(f"rule {cls.__name__} has no code")
+    if cls.code in _REGISTRY or cls.code in _PACKAGE_REGISTRY:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _PACKAGE_REGISTRY[cls.code] = cls
+    return cls
+
+
+def _load_rules():
+    from ray_tpu.lint import concurrency, rules  # noqa: F401
+
+
 def all_rules() -> Dict[str, Type[Rule]]:
-    from ray_tpu.lint import rules  # noqa: F401  (populates registry)
+    _load_rules()
     return dict(_REGISTRY)
+
+
+def all_package_rules() -> Dict[str, Type[PackageRule]]:
+    _load_rules()
+    return dict(_PACKAGE_REGISTRY)
 
 
 _NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z][A-Z0-9 ,]*))?",
@@ -293,15 +346,26 @@ class ModuleContext:
 
 # ================================================================ engine
 
-def lint_source(source: str, path: str = "<string>",
-                select: Optional[set] = None) -> List[Finding]:
-    """Lint one module's source; returns findings with noqa applied."""
+def _suppressed_by(noqa: Dict[int, Optional[set]], f: Finding) -> bool:
+    if f.line not in noqa:
+        return False
+    codes = noqa[f.line]
+    return codes is None or f.code in codes
+
+
+def _module_pass(source: str, path: str, select: Optional[set]
+                 ) -> Tuple[List[Finding], Optional[dict]]:
+    """Per-module rules + per-module summaries for the package rules.
+
+    Returns (findings with noqa applied, summary-or-None).  The summary
+    is a plain picklable dict: {"path", "noqa", "rules": {code: data}}
+    — what a ``--jobs`` worker ships back instead of an AST."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding(code="RTL000",
                         message=f"syntax error: {e.msg}", path=path,
-                        line=e.lineno or 1, col=e.offset or 0)]
+                        line=e.lineno or 1, col=e.offset or 0)], None
     ctx = ModuleContext(tree, source, path)
     findings: List[Finding] = []
     for code, cls in sorted(all_rules().items()):
@@ -310,17 +374,66 @@ def lint_source(source: str, path: str = "<string>",
         findings.extend(cls().check(ctx))
     findings = [f for f in findings if not ctx.suppressed(f)]
     findings.sort(key=lambda f: (f.line, f.col, f.code))
+    summary = {"path": path, "noqa": ctx.noqa, "rules": {}}
+    for code, cls in sorted(all_package_rules().items()):
+        if select and code not in select:
+            continue
+        summary["rules"][code] = cls().summarize(ctx)
+    return findings, summary
+
+
+def _package_pass(summaries: Sequence[dict],
+                  select: Optional[set] = None) -> List[Finding]:
+    """Run every package rule over the merged summaries; per-file noqa
+    maps (carried in the summaries) are applied to the results."""
+    summaries = [s for s in summaries if s is not None]
+    noqa_by_path = {s["path"]: s["noqa"] for s in summaries}
+    findings: List[Finding] = []
+    for code, cls in sorted(all_package_rules().items()):
+        if select and code not in select:
+            continue
+        per_rule = [s["rules"][code] for s in summaries
+                    if code in s["rules"]]
+        findings.extend(cls().check_package(per_rule))
+    return [f for f in findings
+            if not _suppressed_by(noqa_by_path.get(f.path, {}), f)]
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[set] = None,
+                package: bool = True) -> List[Finding]:
+    """Lint one module's source; returns findings with noqa applied.
+    Package-scope rules run over this single module unless
+    ``package=False`` (lint_paths defers them to one whole-tree pass)."""
+    findings, summary = _module_pass(source, path, select)
+    if package and summary is not None:
+        findings = findings + _package_pass([summary], select)
+        findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
 
 
-def lint_file(path: str, select: Optional[set] = None) -> List[Finding]:
+def lint_file(path: str, select: Optional[set] = None,
+              package: bool = True) -> List[Finding]:
     try:
         with open(path, encoding="utf-8", errors="replace") as f:
             source = f.read()
     except OSError as e:
         return [Finding(code="RTL000", message=f"cannot read: {e}",
                         path=path, line=1, col=0)]
-    return lint_source(source, path, select=select)
+    return lint_source(source, path, select=select, package=package)
+
+
+def _lint_file_job(args: Tuple[str, Optional[set]]
+                   ) -> Tuple[List[Finding], Optional[dict]]:
+    """--jobs worker: one file's module pass (pickle-friendly I/O)."""
+    path, select = args
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+    except OSError as e:
+        return [Finding(code="RTL000", message=f"cannot read: {e}",
+                        path=path, line=1, col=0)], None
+    return _module_pass(source, path, select)
 
 
 _SKIP_DIRS = {".git", "__pycache__", "build", ".eggs", "node_modules"}
@@ -351,14 +464,55 @@ def iter_python_files(paths: Sequence[str]) -> Tuple[List[str],
 
 
 def lint_paths(paths: Sequence[str],
-               select: Optional[set] = None) -> List[Finding]:
+               select: Optional[set] = None,
+               jobs: int = 1) -> List[Finding]:
+    """Lint files/dirs.  Module rules run per file (in ``jobs``
+    parallel processes when jobs > 1); package rules run ONCE over the
+    merged per-module summaries, so the lock-order graph spans every
+    module in the invocation."""
     files, missing = iter_python_files(paths)
     findings: List[Finding] = [
         Finding(code="RTL000", message="path does not exist",
                 path=p, line=1, col=0) for p in missing]
-    for fpath in files:
-        findings.extend(lint_file(fpath, select=select))
+    summaries: List[Optional[dict]] = []
+    if jobs > 1 and len(files) > 1:
+        import concurrent.futures as _cf
+        _load_rules()
+        try:
+            with _cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+                for f_list, summary in pool.map(
+                        _lint_file_job, [(p, select) for p in files],
+                        chunksize=8):
+                    findings.extend(f_list)
+                    summaries.append(summary)
+        except (OSError, PermissionError):
+            # Sandboxed environments may forbid subprocess spawn;
+            # correctness beats parallelism.
+            summaries = []
+            findings = findings[:len(missing)]
+            jobs = 1
+    if jobs <= 1 or not summaries:
+        summaries = []
+        for fpath in files:
+            f_list, summary = _lint_file_job((fpath, select))
+            findings.extend(f_list)
+            summaries.append(summary)
+    findings.extend(_package_pass(summaries, select))
     return findings
+
+
+def collect_summaries(paths: Sequence[str]) -> List[dict]:
+    """Per-module package-rule summaries for every file under `paths`
+    (the raw material of the RTC102 graph — used by
+    ``--emit-lock-graph``)."""
+    files, _missing = iter_python_files(paths)
+    out: List[dict] = []
+    for fpath in files:
+        _f, summary = _lint_file_job((fpath, None))
+        if summary is not None:
+            out.append({"path": summary["path"],
+                        **summary["rules"].get("RTC102", {})})
+    return out
 
 
 # ============================================================== baseline
@@ -386,11 +540,26 @@ def write_baseline(findings: Iterable[Finding], path: str,
     for f in findings:
         k = baseline_key(f, root)
         counts[k] = counts.get(k, 0) + 1
+    # Keep the per-key justification strings ("reasons") for keys that
+    # are still baselined — regeneration must not strip the audit
+    # trail of WHY each finding was accepted.
+    reasons: Dict[str, str] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                old = json.load(fh)
+            reasons = {k: str(v)
+                       for k, v in old.get("reasons", {}).items()
+                       if k in counts}
+        except (OSError, ValueError):
+            pass
     payload = {
         "comment": "ray_tpu.lint baseline: pre-existing finding counts "
                    "per file::code; regenerate with --write-baseline",
         "counts": dict(sorted(counts.items())),
     }
+    if reasons:
+        payload["reasons"] = dict(sorted(reasons.items()))
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=2, sort_keys=True)
         fh.write("\n")

@@ -14,10 +14,50 @@ import json
 import os
 import sys
 
-from ray_tpu.lint import (all_rules, apply_baseline, lint_paths,
-                          load_baseline, write_baseline)
+from ray_tpu.lint import (all_package_rules, all_rules, apply_baseline,
+                          lint_paths, load_baseline, write_baseline)
 
 DEFAULT_BASELINE = ".rtlint-baseline.json"
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def _to_sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 run — enough for CI annotation uploads."""
+    rules_meta = {}
+    for code, cls in {**all_rules(), **all_package_rules()}.items():
+        rules_meta[code] = {
+            "id": code,
+            "name": cls.name,
+            "shortDescription": {"text": cls.description},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(cls.severity, "warning")},
+        }
+    used = sorted({f.code for f in findings})
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ray_tpu.lint",
+                "informationUri": "https://example.invalid/ray_tpu",
+                "rules": [rules_meta[c] for c in used
+                          if c in rules_meta],
+            }},
+            "results": [{
+                "ruleId": f.code,
+                "level": _SARIF_LEVEL.get(f.severity, "warning"),
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                }}],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -32,26 +72,56 @@ def main(argv=None) -> int:
                         "in the current directory, when present)")
     p.add_argument("--no-baseline", action="store_true",
                    help="report all findings, ignoring any baseline")
+    p.add_argument("--strict-reasons", action="store_true",
+                   help="honor baseline entries ONLY for keys that "
+                        "carry a justification string in the "
+                        "baseline's \"reasons\" map (the nightly "
+                        "strict mode: an unjustified count bump "
+                        "fails)")
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings as the baseline "
                         "and exit 0")
     p.add_argument("--select", default=None,
                    help="comma-separated rule codes to run "
                         "(default: all)")
-    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text")
+    p.add_argument("--jobs", "-j", type=int, default=1, metavar="N",
+                   help="parse/lint N files in parallel (package-scope "
+                        "rules still run once over the merged tree)")
+    p.add_argument("--emit-lock-graph", default=None, metavar="PATH",
+                   help="also write the RTC102 acquired-while-held "
+                        "graph as JSON (consumed by the runtime "
+                        "lock-order sanitizer; '-' for stdout)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     args = p.parse_args(argv)
 
     if args.list_rules:
-        for code, cls in sorted(all_rules().items()):
+        module_rules = sorted(all_rules().items())
+        package_rules = sorted(all_package_rules().items())
+        for code, cls in module_rules + package_rules:
+            scope = " [package-scope]" if (code, cls) in package_rules \
+                else ""
             print(f"{code}  {cls.severity:7s} {cls.name}: "
-                  f"{cls.description}")
+                  f"{cls.description}{scope}")
         return 0
 
     select = ({c.strip().upper() for c in args.select.split(",")}
               if args.select else None)
-    findings = lint_paths(args.paths, select=select)
+    findings = lint_paths(args.paths, select=select,
+                          jobs=max(1, args.jobs))
+
+    if args.emit_lock_graph is not None:
+        from ray_tpu.lint import collect_summaries
+        from ray_tpu.lint.concurrency import emit_lock_graph
+        graph = emit_lock_graph(collect_summaries(args.paths))
+        blob = json.dumps(graph, indent=2)
+        if args.emit_lock_graph == "-":
+            print(blob)
+        else:
+            with open(args.emit_lock_graph, "w") as f:
+                f.write(blob + "\n")
 
     baseline_path = args.baseline or (
         DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
@@ -108,12 +178,19 @@ def main(argv=None) -> int:
             print(f"error: cannot read baseline {baseline_path}: {e}",
                   file=sys.stderr)
             return 2
+        if args.strict_reasons:
+            with open(baseline_path, encoding="utf-8") as fh:
+                reasons = json.load(fh).get("reasons", {})
+            baseline = {k: v for k, v in baseline.items()
+                        if k in reasons}
         total = len(findings)
         findings = apply_baseline(findings, baseline)
         baselined = total - len(findings)
 
     if args.format == "json":
         print(json.dumps([f.__dict__ for f in findings], indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(_to_sarif(findings), indent=2))
     else:
         for f in findings:
             print(f.format())

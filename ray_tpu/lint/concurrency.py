@@ -1,0 +1,853 @@
+"""The RTC rule set: concurrency static analysis over ray_tpu's OWN tree.
+
+The reference runtime's C++ planes are watched by TSan/ASan and
+clang-tidy; our Python planes had no equivalent, and each of the last
+few PRs shipped a cross-thread bug that only the chaos battery caught.
+These rules are that missing pass.  Unlike the RTL rules (user-facing
+API misuse), RTC targets the internals: classes holding
+``threading.Lock``s, worker threads, callback registration, and the
+package-wide order in which locks nest.
+
+    RTC101  lock-discipline inference: an attribute written both under
+            ``with self._lock`` and bare, in a class with a thread entry
+    RTC102  lock-order cycle: the whole-package acquired-while-held
+            graph contains a cycle (potential deadlock); the finding
+            carries both witness paths (package-scope rule)
+    RTC103  blocking under a lock: ray_tpu.get/wait, time.sleep,
+            subprocess, Event.wait, Thread.join, or Condition.wait on a
+            *different* lock while a lock is held
+    RTC104  thread escape: a class spawns a thread on one of its own
+            methods, has no lock at all, and mutates ``self`` outside
+            ``__init__``
+
+Static limits (documented, not silent): acquisition is recognized in
+``with`` form only (manual ``.acquire()``/``.release()`` pairs are
+invisible); lock identity is per *class attribute* (two instances of
+one class are one node in the order graph — RLock-style reentrancy on
+the same key is skipped); call-graph resolution covers ``self.m()``,
+same-module ``f()``, and ``mod.f()`` for modules in the linted set.
+The runtime complement (`ray_tpu._private.locksan`) records the REAL
+acquisition order under the chaos battery and reports both order
+violations and edges this analyzer missed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.lint import (Finding, ModuleContext, PackageRule, Rule,
+                          register_package_rule, register_rule)
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# threading.<ctor> / locksan.<factory> spellings that mint a lock.
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock",
+               "Condition": "condition", "Semaphore": "lock",
+               "BoundedSemaphore": "lock"}
+_LOCKSAN_CTORS = {"make_lock": "lock", "make_rlock": "rlock",
+                  "make_condition": "condition"}
+_THREAD_CTORS = {"Thread", "Timer"}
+# with-used self attributes whose NAME alone marks them lock-like (for
+# locks handed in via parameters rather than constructed in the class).
+_LOCKISH_NAMES = ("lock", "mutex", "cond", "cv", "sem")
+
+# self.<attr>.<m>(...) calls that mutate the container bound at <attr>.
+_MUTATORS = {"append", "appendleft", "add", "extend", "insert", "update",
+             "setdefault", "pop", "popleft", "popitem", "remove",
+             "discard", "clear"}
+
+# Callback registrars whose self-method argument marks the class as
+# entered by another thread / event loop.
+_CB_REGISTRARS = {"call_soon_threadsafe", "run_in_executor",
+                  "add_done_callback", "register_at_fork"}
+
+
+def _modbase(path: str) -> str:
+    base = os.path.basename(path)
+    if base == "__init__.py":
+        base = os.path.basename(os.path.dirname(path)) or base
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _is_self_attr(node) -> Optional[str]:
+    """'x' for a `self.x` expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassConc:
+    """Concurrency facts about one class."""
+
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.lock_attrs: Dict[str, str] = {}   # attr -> kind
+        self.event_attrs: set = set()
+        self.thread_attrs: set = set()
+        # (ctor Call node, ("method", name) | ("local", name) | None,
+        #  spawning method name)
+        self.thread_sites: List[Tuple[ast.AST, Optional[tuple], str]] = []
+        self.cb_sites: List[ast.AST] = []
+        self.subclasses_thread = False
+        # (attr, node, held frozenset, method name, in-closure flag).
+        # The closure flag marks writes inside a nested def: those run
+        # on whatever thread CALLS the closure, not on the thread
+        # executing the enclosing method body.
+        self.writes: List[Tuple[str, ast.AST, frozenset, str, bool]] = []
+        # attr -> (node, lock keys held) of one guarded write (evidence
+        # for the RTC101 message)
+        self.guarded_sites: Dict[str, Tuple[ast.AST, frozenset]] = {}
+
+    @property
+    def threaded(self) -> bool:
+        return bool(self.thread_sites or self.cb_sites
+                    or self.subclasses_thread)
+
+
+class _ModuleConc:
+    """One module's concurrency analysis: per-class discipline facts,
+    the local acquired-while-held edges, per-function acquisition
+    summaries, and blocking-under-lock hits."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.path = ctx.path
+        self.modbase = _modbase(ctx.path)
+        # import alias maps
+        self.threading_aliases: set = set()
+        self.time_aliases: set = set()
+        self.subprocess_aliases: set = set()
+        self.select_aliases: set = set()
+        self.locksan_aliases: set = set()
+        self.from_threading: Dict[str, str] = {}   # local -> ctor name
+        self.from_time_sleep: set = set()
+        self.import_mods: Dict[str, str] = {}      # alias -> module base
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.module_locks: Dict[str, str] = {}     # name -> kind
+        self.module_funcs: set = set()
+        self.classes: Dict[str, _ClassConc] = {}
+        # package-rule raw material
+        self.edges: List[list] = []        # [a, b, line, desc]
+        self.acquires: Dict[str, List[list]] = {}   # qual -> [[key, line]]
+        self.calls: Dict[str, List[list]] = {}      # qual -> [ref...]
+        self.held_calls: List[list] = []   # [held key, ref, line]
+        # (node, message) RTC103 hits
+        self.blocking: List[Tuple[ast.AST, str]] = []
+        self._scan_imports()
+        self._scan_module_scope()
+        self._scan_classes()
+        self._walk_all()
+
+    # ------------------------------------------------------------ imports
+    def _scan_imports(self):
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    leaf = alias.name.split(".")[-1]
+                    bound = alias.asname or root
+                    if alias.asname is None and "." in alias.name:
+                        # `import a.b.c` binds `a`; attribute calls on
+                        # the dotted tail are not tracked.
+                        leaf = root
+                    if leaf == "threading" or root == "threading":
+                        self.threading_aliases.add(bound)
+                    elif leaf == "time":
+                        self.time_aliases.add(bound)
+                    elif leaf == "subprocess":
+                        self.subprocess_aliases.add(bound)
+                    elif leaf == "select":
+                        self.select_aliases.add(bound)
+                    elif leaf == "locksan":
+                        self.locksan_aliases.add(bound)
+                    if root == "ray_tpu":
+                        self.import_mods[bound] = alias.name.split(".")[-1]
+                    elif "." not in alias.name:
+                        # Plain `import m` of a sibling module: calls
+                        # through it resolve when m is in the lint
+                        # scope (stdlib modules contribute no acquires
+                        # to the graph, so this is harmless for them).
+                        self.import_mods.setdefault(bound, alias.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module
+                leaf = mod.split(".")[-1]
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if mod == "threading":
+                        self.from_threading[bound] = alias.name
+                    elif mod == "time" and alias.name == "sleep":
+                        self.from_time_sleep.add(bound)
+                    if mod.startswith("ray_tpu"):
+                        # from ray_tpu._private import tracing as _t
+                        # -> _t aliases module "tracing"; from
+                        # ray_tpu.x.y import f -> f is y's function.
+                        self.import_mods.setdefault(bound, alias.name)
+                        self.from_imports[bound] = (leaf, alias.name)
+                        if alias.name == "locksan":
+                            self.locksan_aliases.add(bound)
+
+    # ----------------------------------------------------- ctor detection
+    def _ctor_kind(self, call: ast.Call, table: Dict[str, str],
+                   names: Optional[set] = None) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name):
+            root = fn.value.id
+            if root in self.threading_aliases and fn.attr in table:
+                return table[fn.attr]
+            if root in self.locksan_aliases and \
+                    fn.attr in _LOCKSAN_CTORS and table is _LOCK_CTORS:
+                return _LOCKSAN_CTORS[fn.attr]
+            if names is not None and root in self.threading_aliases \
+                    and fn.attr in names:
+                return fn.attr
+        elif isinstance(fn, ast.Name):
+            tgt = self.from_threading.get(fn.id)
+            if tgt is not None:
+                if tgt in table:
+                    return table[tgt]
+                if names is not None and tgt in names:
+                    return tgt
+            tgt2 = self.from_imports.get(fn.id)
+            if tgt2 is not None and tgt2[1] in _LOCKSAN_CTORS \
+                    and table is _LOCK_CTORS:
+                return _LOCKSAN_CTORS[tgt2[1]]
+        return None
+
+    def _lock_ctor(self, call) -> Optional[str]:
+        return self._ctor_kind(call, _LOCK_CTORS)
+
+    def _event_ctor(self, call) -> bool:
+        return self._ctor_kind(call, {"Event": "event"}) == "event"
+
+    def _thread_ctor(self, call) -> Optional[str]:
+        return self._ctor_kind(call, {}, names=_THREAD_CTORS)
+
+    # -------------------------------------------------------- module scope
+    def _scan_module_scope(self):
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, _DEFS):
+                self.module_funcs.add(stmt.name)
+            elif isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                kind = self._lock_ctor(stmt.value)
+                if kind is not None:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.module_locks[tgt.id] = kind
+
+    # ------------------------------------------------------------- classes
+    def _scan_classes(self):
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _ClassConc(node)
+            self.classes[node.name] = cls
+            for base in node.bases:
+                tail = base
+                while isinstance(tail, ast.Attribute):
+                    if tail.attr == "Thread":
+                        cls.subclasses_thread = True
+                    tail = tail.value
+                if isinstance(tail, ast.Name) and \
+                        self.from_threading.get(tail.id) == "Thread":
+                    cls.subclasses_thread = True
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call):
+                    attr = None
+                    for tgt in sub.targets:
+                        a = _is_self_attr(tgt)
+                        if a is not None:
+                            attr = a
+                    if attr is None:
+                        continue
+                    kind = self._lock_ctor(sub.value)
+                    if kind is not None:
+                        cls.lock_attrs[attr] = kind
+                    elif self._event_ctor(sub.value):
+                        cls.event_attrs.add(attr)
+                    elif self._thread_ctor(sub.value) is not None:
+                        cls.thread_attrs.add(attr)
+            # A with-used lock-named attribute counts as a lock even
+            # when it was handed in (not constructed here).  Sync
+            # `with` only: `async with self._cond` is an asyncio
+            # primitive — the event loop already serializes bare
+            # access, so it stays out of the THREAD-lock analysis.
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        a = _is_self_attr(item.context_expr)
+                        if a is not None and a not in cls.lock_attrs \
+                                and any(t in a.lower()
+                                        for t in _LOCKISH_NAMES):
+                            cls.lock_attrs[a] = "lock"
+
+    # ------------------------------------------------------------ the walk
+    def _walk_all(self):
+        body_defs = []
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, _DEFS):
+                body_defs.append((stmt, None,
+                                  f"{self.modbase}.{stmt.name}"))
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, _DEFS):
+                        body_defs.append(
+                            (sub, self.classes[stmt.name],
+                             f"{self.modbase}.{stmt.name}.{sub.name}"))
+        for fn, cls, qual in body_defs:
+            self._walk_fn(fn, cls, qual, fn.name, closure=False)
+
+    def _walk_fn(self, fn, cls, qual: str, method: str,
+                 closure: bool):
+        self.acquires.setdefault(qual, [])
+        self.calls.setdefault(qual, [])
+        use_cls = cls
+        if cls is not None and not closure and not (
+                fn.args.args and fn.args.args[0].arg == "self"):
+            # No self receiver and not a closure: a static method has
+            # no instance (closures DO — they capture self).
+            use_cls = None
+        held: tuple = ()
+        if use_cls is not None and not closure and \
+                method.endswith("_locked") and use_cls.lock_attrs:
+            # Convention: a `_foo_locked` method documents that its
+            # CALLER holds the class lock — analyze its body as if the
+            # (single or first) class lock were held.
+            held = (f"{use_cls.name}.{min(use_cls.lock_attrs)}",)
+        for stmt in fn.body:
+            self._walk_node(stmt, use_cls, qual, held, method, closure)
+
+    def _lock_key(self, expr, cls) -> Optional[str]:
+        a = _is_self_attr(expr)
+        if a is not None and cls is not None and a in cls.lock_attrs:
+            return f"{cls.name}.{a}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"{self.modbase}.{expr.id}"
+        return None
+
+    def _walk_node(self, node, cls, qual, held, method, closure):
+        if isinstance(node, _DEFS):
+            # A nested def's body does NOT run under the enclosing
+            # with-block — fresh held set; it still belongs to the
+            # method (closures capture self).
+            self._walk_fn(node, cls, f"{qual}.{node.name}", method,
+                          closure=True)
+            return
+        if isinstance(node, (ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                self._walk_node(item.context_expr, cls, qual,
+                                tuple(inner), method, closure)
+                key = self._lock_key(item.context_expr, cls)
+                if key is not None:
+                    for h in inner:
+                        if h != key:
+                            self.edges.append(
+                                [h, key, node.lineno,
+                                 f"{qual} acquires {key} while "
+                                 f"holding {h}"])
+                    self.acquires[qual].append([key, node.lineno])
+                    inner.append(key)
+            for stmt in node.body:
+                self._walk_node(stmt, cls, qual, tuple(inner), method,
+                                closure)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node, cls, qual, held, method, closure)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.Delete)):
+            self._handle_write(node, cls, held, method, closure)
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(child, cls, qual, held, method, closure)
+
+    # ------------------------------------------------------------- writes
+    def _write_attr_of(self, tgt) -> Optional[str]:
+        a = _is_self_attr(tgt)
+        if a is not None:
+            return a
+        if isinstance(tgt, ast.Subscript):
+            return _is_self_attr(tgt.value)
+        return None
+
+    def _handle_write(self, node, cls, held, method, closure):
+        if cls is None:
+            return
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            # `container[key] = self._method` registers a handler some
+            # other thread (RPC dispatch, event loop) will call.
+            if _is_self_attr(node.value) is not None and any(
+                    isinstance(t, ast.Subscript) and
+                    _is_self_attr(t.value) is None
+                    for t in node.targets):
+                cls.cb_sites.append(node)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                sub_targets = tgt.elts
+            else:
+                sub_targets = [tgt]
+            for t in sub_targets:
+                attr = self._write_attr_of(t)
+                if attr is None or attr in cls.lock_attrs:
+                    continue
+                heldset = frozenset(held)
+                cls.writes.append((attr, node, heldset, method, closure))
+                if heldset and attr not in cls.guarded_sites:
+                    cls.guarded_sites[attr] = (node, heldset)
+
+    # -------------------------------------------------------------- calls
+    def _callee_ref(self, call, cls) -> Optional[list]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name):
+            if fn.value.id == "self" and cls is not None:
+                return ["self", self.modbase, cls.name, fn.attr]
+            tgt = self.import_mods.get(fn.value.id)
+            if tgt is not None:
+                return ["mod", tgt, fn.attr]
+        elif isinstance(fn, ast.Name):
+            if fn.id in self.module_funcs:
+                return ["mod", self.modbase, fn.id]
+            tgt = self.from_imports.get(fn.id)
+            if tgt is not None:
+                return ["mod", tgt[0], tgt[1]]
+        return None
+
+    def _thread_target_of(self, call) -> Optional[tuple]:
+        cands = [kw.value for kw in call.keywords if kw.arg == "target"]
+        if not cands and len(call.args) >= 2:
+            cands = [call.args[1]]  # Thread(group, target) / Timer(t, fn)
+        for v in cands:
+            a = _is_self_attr(v)
+            if a is not None:
+                return ("method", a)
+            if isinstance(v, ast.Name):
+                return ("local", v.id)
+        return None
+
+    def _handle_call(self, call, cls, qual, held, method, closure):
+        ref = self._callee_ref(call, cls)
+        if ref is not None:
+            self.calls[qual].append(ref)
+        fn = call.func
+        if cls is not None:
+            if self._thread_ctor(call) is not None:
+                cls.thread_sites.append(
+                    (call, self._thread_target_of(call), method))
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr in _CB_REGISTRARS:
+                for arg in list(call.args) + \
+                        [kw.value for kw in call.keywords]:
+                    if _is_self_attr(arg) is not None:
+                        cls.cb_sites.append(call)
+                        break
+        # Container mutations count as attribute writes.  `.update()`
+        # needs arguments: a no-arg update() is some OTHER protocol's
+        # method (autoscaler.update()), not a dict merge.
+        if cls is not None and isinstance(fn, ast.Attribute) and \
+                fn.attr in _MUTATORS and not (
+                    fn.attr == "update"
+                    and not call.args and not call.keywords):
+            attr = _is_self_attr(fn.value)
+            if attr is not None and attr not in cls.lock_attrs:
+                heldset = frozenset(held)
+                cls.writes.append((attr, call, heldset, method, closure))
+                if heldset and attr not in cls.guarded_sites:
+                    cls.guarded_sites[attr] = (call, heldset)
+        if not held:
+            return
+        if ref is not None:
+            for h in held:
+                self.held_calls.append([h, ref, call.lineno])
+        msg = self._blocking_reason(call, cls, held)
+        if msg is not None:
+            self.blocking.append((call, msg))
+
+    def _blocking_reason(self, call, cls, held) -> Optional[str]:
+        api = self.ctx.api_call_name(call)
+        hnames = ", ".join(sorted(held))
+        if api in ("get", "wait"):
+            return (f"ray_tpu.{api}() blocks on remote work while "
+                    f"holding {hnames}; every other thread needing the "
+                    "lock stalls behind the cluster round-trip")
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name):
+            root = fn.value.id
+            if root in self.time_aliases and fn.attr == "sleep":
+                return (f"time.sleep() parks the thread while holding "
+                        f"{hnames}")
+            if root in self.subprocess_aliases:
+                return (f"subprocess.{fn.attr}() waits on a child "
+                        f"process while holding {hnames}")
+            if root in self.select_aliases and fn.attr == "select":
+                return f"select.select() blocks while holding {hnames}"
+        elif isinstance(fn, ast.Name) and fn.id in self.from_time_sleep:
+            return f"time.sleep() parks the thread while holding {hnames}"
+        # self.<attr>.wait()/join() where <attr> is a known sync object
+        if isinstance(fn, ast.Attribute):
+            owner = _is_self_attr(fn.value)
+            if owner is not None and cls is not None:
+                if fn.attr in ("wait", "wait_for") and \
+                        cls.lock_attrs.get(owner) == "condition":
+                    own = f"{cls.name}.{owner}"
+                    others = [h for h in held if h != own]
+                    if others:
+                        return (f"Condition {owner}.wait() releases "
+                                f"only its own lock; "
+                                f"{', '.join(sorted(others))} stays "
+                                "held for the whole wait")
+                elif fn.attr == "wait" and owner in cls.event_attrs:
+                    return (f"Event {owner}.wait() blocks while "
+                            f"holding {hnames}")
+                elif fn.attr == "join" and owner in cls.thread_attrs:
+                    return (f"Thread {owner}.join() blocks while "
+                            f"holding {hnames}; if that thread needs "
+                            "the lock this deadlocks")
+        return None
+
+
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _analyze(ctx: ModuleContext) -> _ModuleConc:
+    info = getattr(ctx, "_rtc_info", None)
+    if info is None:
+        info = _ModuleConc(ctx)
+        ctx._rtc_info = info
+    return info
+
+
+# ==================================================== per-module rules
+
+@register_rule
+class LockDiscipline(Rule):
+    code = "RTC101"
+    name = "mixed-lock-discipline"
+    severity = "warning"
+    description = ("an attribute is written both under the class lock "
+                   "and bare while the class has a thread entry point "
+                   "— one of the two sides is a race")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        info = _analyze(ctx)
+        for cls in info.classes.values():
+            if not cls.threaded:
+                continue
+            per_attr: Dict[str, Dict[str, list]] = {}
+            for attr, node, heldset, method, closure in cls.writes:
+                if method in _INIT_METHODS and not closure:
+                    continue
+                slot = per_attr.setdefault(attr,
+                                           {"bare": [], "guarded": []})
+                slot["guarded" if heldset else "bare"].append(node)
+            for attr in sorted(per_attr):
+                slot = per_attr[attr]
+                if not slot["bare"] or not slot["guarded"]:
+                    continue
+                bare = min(slot["bare"], key=lambda n: n.lineno)
+                gnode, gheld = cls.guarded_sites.get(
+                    attr, (slot["guarded"][0], frozenset()))
+                locks = ", ".join(sorted(gheld)) or "the class lock"
+                yield self.finding(
+                    ctx, bare,
+                    f"{cls.name}.{attr} is written here WITHOUT the "
+                    f"lock, but under {locks} at line "
+                    f"{gnode.lineno}; {cls.name} has a thread entry "
+                    "point, so the bare write races the locked one — "
+                    "take the lock here, or document single-thread "
+                    "ownership with a noqa")
+
+
+@register_rule
+class BlockingUnderLock(Rule):
+    code = "RTC103"
+    name = "blocking-under-lock"
+    severity = "warning"
+    description = ("a blocking call (ray_tpu.get/wait, time.sleep, "
+                   "subprocess, Event.wait, Thread.join, Condition."
+                   "wait on a different lock) runs while a lock is "
+                   "held — lock hold time becomes unbounded")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        info = _analyze(ctx)
+        for node, msg in info.blocking:
+            yield self.finding(ctx, node, msg)
+
+
+@register_rule
+class ThreadEscape(Rule):
+    code = "RTC104"
+    name = "thread-escape-unlocked"
+    severity = "warning"
+    description = ("a class spawns a thread on one of its own methods, "
+                   "holds no lock at all, and mutates self outside "
+                   "__init__ — the spawned thread and its creator "
+                   "share unsynchronized state")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        info = _analyze(ctx)
+        for cls in info.classes.values():
+            if cls.lock_attrs or not cls.thread_sites:
+                continue
+            # Self-call graph within the class, to chase what the
+            # thread's target method reaches (target -> helpers).
+            calls_in: Dict[str, set] = {}
+            for qual, refs in info.calls.items():
+                parts = qual.split(".")
+                if len(parts) >= 3 and parts[0] == info.modbase and \
+                        parts[1] == cls.name:
+                    slot = calls_in.setdefault(parts[2], set())
+                    for ref in refs:
+                        if ref[0] == "self" and ref[2] == cls.name:
+                            slot.add(ref[3])
+            for site, target, site_method in cls.thread_sites:
+                if target is not None and target[0] == "method":
+                    reach = {target[1]}
+                    frontier = [target[1]]
+                    while frontier:
+                        for n in calls_in.get(frontier.pop(), ()):
+                            if n not in reach:
+                                reach.add(n)
+                                frontier.append(n)
+                    mutated = sorted(
+                        {a for a, _n, _h, m, _c in cls.writes
+                         if m in reach and m not in _INIT_METHODS})
+                    tgt = f"self.{target[1]}"
+                elif target is not None and target[0] == "local":
+                    # A local closure: only its own writes run on the
+                    # spawned thread; the enclosing method body's
+                    # writes happen-before start().
+                    mutated = sorted(
+                        {a for a, _n, _h, m, c in cls.writes
+                         if c and m == site_method})
+                    tgt = f"local function {target[1]}"
+                else:
+                    mutated = sorted(
+                        {a for a, _n, _h, m, c in cls.writes
+                         if (m not in _INIT_METHODS
+                             and m != site_method) or
+                            (c and m == site_method)})
+                    tgt = "a callable"
+                if not mutated:
+                    continue
+                sample = ", ".join(f"self.{a}" for a in mutated[:3])
+                yield self.finding(
+                    ctx, site,
+                    f"{cls.name} hands {tgt} to a new thread but "
+                    f"defines no lock, and mutates {sample} outside "
+                    "__init__ — writes from the spawned thread and "
+                    "the owner interleave unsynchronized")
+                break  # one finding per class
+
+
+# ==================================================== package-scope rule
+
+def _resolve(ref: list) -> str:
+    if ref[0] == "self":
+        return f"{ref[1]}.{ref[2]}.{ref[3]}"
+    return f"{ref[1]}.{ref[2]}"
+
+
+def build_lock_graph(summaries: List[dict]) -> Dict[str, Dict[str, dict]]:
+    """Merge per-module summaries into the package acquired-while-held
+    graph: {a: {b: {"path","line","desc"}}} meaning b was (or may be)
+    acquired while a is held.  Shared with the runtime sanitizer's
+    static-graph comparison (`--emit-lock-graph`)."""
+    acq: Dict[str, Dict[str, dict]] = {}
+    calls: Dict[str, List[list]] = {}
+    adj: Dict[str, Dict[str, dict]] = {}
+
+    def add_edge(a: str, b: str, prov: dict):
+        if a == b:
+            return  # reentrancy on one key (RLock style): not an order
+        adj.setdefault(a, {}).setdefault(b, prov)
+
+    for s in summaries:
+        path = s["path"]
+        for qual, pairs in s.get("acquires", {}).items():
+            slot = acq.setdefault(qual, {})
+            for key, line in pairs:
+                slot.setdefault(key, {"path": path, "line": line,
+                                      "desc": f"{qual} acquires {key}"})
+        for qual, refs in s.get("calls", {}).items():
+            calls.setdefault(qual, []).extend(refs)
+        for a, b, line, desc in s.get("edges", []):
+            add_edge(a, b, {"path": path, "line": line, "desc": desc})
+
+    # Transitive closure of may-acquire over the resolvable call graph.
+    changed = True
+    passes = 0
+    while changed and passes < 50:
+        changed = False
+        passes += 1
+        for qual, refs in calls.items():
+            slot = acq.setdefault(qual, {})
+            for ref in refs:
+                for key, prov in acq.get(_resolve(ref), {}).items():
+                    if key not in slot:
+                        slot[key] = prov
+                        changed = True
+
+    for s in summaries:
+        path = s["path"]
+        for held, ref, line in s.get("held_calls", []):
+            callee = _resolve(ref)
+            for key, prov in acq.get(callee, {}).items():
+                add_edge(held, key, {
+                    "path": path, "line": line,
+                    "desc": (f"call to {callee}() while holding {held} "
+                             f"reaches '{prov['desc']}' at "
+                             f"{prov['path']}:{prov['line']}")})
+    return adj
+
+
+def _find_cycles(adj: Dict[str, Dict[str, dict]]
+                 ) -> List[List[Tuple[str, str, dict]]]:
+    """Cycles in the lock graph as edge lists [(a, b, prov), ...].
+    Two-node cycles are enumerated exactly; longer cycles are found per
+    SCC (one witness cycle per component)."""
+    cycles = []
+    covered = set()
+    for a in sorted(adj):
+        for b in sorted(adj[a]):
+            if a < b and a in adj.get(b, {}):
+                cycles.append([(a, b, adj[a][b]), (b, a, adj[b][a])])
+                covered.update((a, b))
+    # SCCs (iterative Tarjan) for >2-node cycles.
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: set = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs = []
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(adj.get(v0, {}))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, {})))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(set(adj) | {b for m in adj.values() for b in m}):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        if any(n in covered for n in comp) and len(comp) == 2:
+            continue  # already reported as a 2-cycle
+        comp_set = set(comp)
+        # One witness cycle: DFS from the smallest node back to itself.
+        start = comp[0]
+        path: List[Tuple[str, str, dict]] = []
+
+        def dfs(v, seen):
+            for w in sorted(adj.get(v, {})):
+                if w == start and path:
+                    path.append((v, w, adj[v][w]))
+                    return True
+                if w in comp_set and w not in seen:
+                    path.append((v, w, adj[v][w]))
+                    if dfs(w, seen | {w}):
+                        return True
+                    path.pop()
+            return False
+
+        first = sorted(adj.get(start, {}))
+        for w in first:
+            if w in comp_set:
+                path.append((start, w, adj[start][w]))
+                if w == start or dfs(w, {start, w}):
+                    break
+                path.pop()
+        if path and not any(set(e[:2]) <= covered
+                            for e in path if len(set(e[:2])) == 2):
+            cycles.append(path)
+            covered.update(n for e in path for n in e[:2])
+    return cycles
+
+
+@register_package_rule
+class LockOrderCycle(PackageRule):
+    code = "RTC102"
+    name = "lock-order-cycle"
+    severity = "error"
+    description = ("the package-wide acquired-while-held graph has a "
+                   "cycle: two code paths take the same locks in "
+                   "opposite orders, so the right interleaving "
+                   "deadlocks both")
+
+    def summarize(self, ctx: ModuleContext) -> dict:
+        info = _analyze(ctx)
+        return {"path": info.path,
+                "edges": info.edges,
+                "acquires": info.acquires,
+                "calls": info.calls,
+                "held_calls": info.held_calls}
+
+    def check_package(self, summaries: List[dict]) -> Iterable[Finding]:
+        adj = build_lock_graph(summaries)
+        for cycle in _find_cycles(adj):
+            a, b, prov = cycle[0]
+            chain = " -> ".join([e[0] for e in cycle] + [cycle[0][0]])
+            witnesses = "; ".join(
+                f"[{e[0]} -> {e[1]}] {e[2]['desc']} "
+                f"({e[2]['path']}:{e[2]['line']})" for e in cycle)
+            yield Finding(
+                code=self.code, severity=self.severity,
+                path=prov["path"], line=prov["line"], col=0,
+                message=(f"lock-order cycle {chain}: the same locks "
+                         f"are taken in opposite orders — witness "
+                         f"paths: {witnesses}"))
+
+
+def emit_lock_graph(summaries: List[dict]) -> dict:
+    """The statically derived order graph in the shape
+    ``locksan.load_static_graph`` consumes: {"edges": [[a, b], ...]}."""
+    adj = build_lock_graph(summaries)
+    return {"edges": sorted([a, b] for a in adj for b in adj[a]),
+            "comment": "ray_tpu.lint RTC102 acquired-while-held graph; "
+                       "regenerate with --emit-lock-graph"}

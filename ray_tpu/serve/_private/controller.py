@@ -15,6 +15,7 @@ import math
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import locksan
 from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
 from ray_tpu.serve._private.deployment_state import (
     DeploymentStateManager, RUNNING)
@@ -67,7 +68,7 @@ class ServeController:
         self._dsm = DeploymentStateManager(self._long_poll)
         # deploy/update/shutdown all mutate the DSM from executor threads;
         # one lock serializes them (the reconcile tick is cheap).
-        self._dsm_lock = threading.Lock()
+        self._dsm_lock = locksan.make_lock("ServeController._dsm_lock")
         self._autoscale: Dict[str, _AutoscaleState] = {}
         self._http_config = {"host": http_host, "port": http_port}
         self._shutdown = False

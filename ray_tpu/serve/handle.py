@@ -14,10 +14,11 @@ import threading
 import time
 from typing import Any, Optional
 
+from ray_tpu._private import locksan
 from ray_tpu._private import tracing as _tracing
 
 _router_loop: Optional[asyncio.AbstractEventLoop] = None
-_router_loop_lock = threading.Lock()
+_router_loop_lock = locksan.make_lock("handle._router_loop_lock")
 
 
 def _get_router_loop() -> asyncio.AbstractEventLoop:
@@ -204,7 +205,8 @@ class DeploymentHandle:
         self._controller = controller_handle
         self._method_name = method_name
         self._router = None
-        self._router_lock = threading.Lock()
+        self._router_lock = locksan.make_lock(
+            "DeploymentHandle._router_lock")
 
     def _ensure_router(self):
         if self._router is None:

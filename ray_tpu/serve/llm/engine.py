@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private import locksan
 from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu.models import decode
@@ -138,7 +139,7 @@ class TokenStream:
     def __init__(self, request_id: str):
         self.request_id = request_id
         self._buf: collections.deque = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("TokenStream._lock")
         self._wakeups: List = []   # zero-arg callables, fired once each
         self._done = False
         self._error: Optional[BaseException] = None
@@ -512,7 +513,7 @@ class GenerationEngine:
         self._commit_cap = max(1, int(kv_commit_factor * self.kv_pages))
 
         self._scheduler = FCFSScheduler(max_queue_len)
-        self._cond = threading.Condition()
+        self._cond = locksan.make_condition("GenerationEngine._cond")
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._started_t = time.monotonic()
@@ -586,6 +587,17 @@ class GenerationEngine:
             QUEUE_GAUGE.set(0, tags=self._tags)
         for req in leftovers:
             req.stream._finish(err)
+        if t is not None and t.is_alive():
+            # join() timed out: the worker is wedged mid-tick and still
+            # OWNS the slot table, cache, and paging state.  Mutating
+            # them from here would race a live thread (found by
+            # RTC101); it will see _stop and exit on its own — leave
+            # its state alone.
+            logger.warning(
+                "engine %s worker did not exit within %.1fs; leaving "
+                "slot/paging state for it to tear down", self.name,
+                timeout)
+            return
         for s, req in enumerate(self._slots):
             if req is not None:
                 req.stream._finish(err)
@@ -853,7 +865,13 @@ class GenerationEngine:
             pages, matched_tok = reserved
             bt_row = np.zeros((self._max_blocks,), np.int32)
             bt_row[:len(pages)] = pages
-            self._prefill = _PrefillState(req, slot, matched_tok, bt_row)
+            # _prefill writes stay under _cond: stop() tears the field
+            # down under _cond after a join that may have TIMED OUT
+            # with this thread still mid-tick, so the handoff must be
+            # a real critical section, not owner-confinement.
+            with self._cond:
+                self._prefill = _PrefillState(req, slot, matched_tok,
+                                              bt_row)
             # TTFT stage 1 of 3 — queue: submit() to admission (pages
             # reserved, prefill about to start).
             _span_for(req, "engine.queue", req.submit_t,
@@ -864,7 +882,8 @@ class GenerationEngine:
         st = self._prefill
         req = st.req
         if req.stream.cancelled:
-            self._prefill = None
+            with self._cond:
+                self._prefill = None
             self._release_pages(req)
             self._finish_request(req, "cancelled")
             return
@@ -884,7 +903,8 @@ class GenerationEngine:
 
         # Prefill complete: sample the first token from the last REAL
         # column of the final chunk (pad columns carry garbage).
-        self._prefill = None
+        with self._cond:
+            self._prefill = None
         t_fc = time.monotonic()
         # TTFT stage 2 of 3 — prefill: admission to the last chunk's
         # dispatch (chunk count makes chunked-prefill interleaving
@@ -1132,13 +1152,13 @@ class GenerationEngine:
         self._update_kv_gauges()
 
     def _fail_all(self, err: BaseException):
-        if self._prefill is not None:
-            self._prefill.req.stream._finish(err)
-            self._prefill = None
         with self._cond:
+            pf, self._prefill = self._prefill, None
             leftovers = self._scheduler.drain()
             self._committed_blocks = 0
             QUEUE_GAUGE.set(0, tags=self._tags)
+        if pf is not None:
+            pf.req.stream._finish(err)
         for req in leftovers:
             req.stream._finish(err)
         for s in range(self.num_slots):

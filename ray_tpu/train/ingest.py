@@ -31,6 +31,8 @@ from __future__ import annotations
 import threading
 from typing import Iterator, Optional
 
+from ray_tpu._private import locksan
+
 
 class StreamingDatasetShard:
     """One rank's streaming view of a prepared dataset.  Everything a
@@ -47,7 +49,7 @@ class StreamingDatasetShard:
             shuffle_seed = random.randrange(1 << 30)
         self._seed = shuffle_seed
         self._epoch = 0
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("StreamingDatasetShard._lock")
         self._primed = None  # (epoch, kw_key, first_item_or_END, iter)
         self._prime_thread = None
         self._closed = False

@@ -52,7 +52,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from ray_tpu._private import failpoints, protocol, transfer
+from ray_tpu._private import failpoints, locksan, protocol, transfer
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.util.collective.types import CollectiveGroupError
 
@@ -163,7 +163,7 @@ class ScratchArena:
             os.close(fd)
         self._mm[0:_TOKEN_LEN] = self.token
         self._free = [(_HEADER, self.capacity - _HEADER)]
-        self._cond = threading.Condition()
+        self._cond = locksan.make_condition("ScratchArena._cond")
 
     @property
     def token_hex(self) -> str:
@@ -338,7 +338,8 @@ class CollectiveTransport:
         self._peer_maps: dict[str, _PeerScratch] = {}
         self._entries: dict = {}         # key -> recv entry (loop-confined)
         self._aborted: "OrderedDict[str, str]" = OrderedDict()
-        self._scratch_lock = threading.Lock()
+        self._scratch_lock = locksan.make_lock(
+            "CollectiveTransport._scratch_lock")
         # Sticky scratch slots, keyed (group, stream tag): each logical
         # send stream (e.g. "this group's reduce-scatter chunk to rank
         # p") keeps ONE stable arena offset across ops.  Page-fault
@@ -362,7 +363,7 @@ class CollectiveTransport:
 
     # ------------------------------------------------------------ endpoints
     def endpoint_info(self, rank: int) -> dict:
-        self._ensure_scratch()
+        scratch = self._ensure_scratch()
         w = self.w
         nid = getattr(w.node_id, "hex", None)
         aid = getattr(w.actor_id, "hex", None)
@@ -370,8 +371,8 @@ class CollectiveTransport:
             "rank": rank,
             "addr": list(w.addr),
             "node_id": nid() if callable(nid) else None,
-            "scratch_path": self.scratch.path,
-            "scratch_token": self.scratch.token_hex,
+            "scratch_path": scratch.path,
+            "scratch_token": scratch.token_hex,
             "pid": os.getpid(),
             "actor_id": aid() if callable(aid) else None,
             "pvm_addr": int(self._pvm_probe.ctypes.data),
@@ -386,7 +387,10 @@ class CollectiveTransport:
                     f"rt_coll_{self.w.worker_id.hex()[:12]}_{os.getpid()}")
                 self.scratch = ScratchArena(
                     path, max(1 << 20, cfg.collective_scratch_bytes))
-        return self.scratch
+            # Return under the lock: a concurrent close() nulls the
+            # attribute, and callers must get the arena they created,
+            # never None.
+            return self.scratch
 
     def prepare_group(self, group: str, endpoints: dict[int, Endpoint],
                       infos: dict | None = None):
@@ -752,10 +756,12 @@ class CollectiveTransport:
     def forget_group(self, group: str):
         """Clear abort marks/state and release the group's sticky
         scratch slots so a destroyed group's name can be reused."""
+        with self._scratch_lock:
+            scratch = self.scratch
         for key in [k for k in self._sticky if k[0] == group]:
             off, sz = self._sticky.pop(key)
-            if self.scratch is not None:
-                self.scratch.free(off, sz)
+            if scratch is not None:
+                scratch.free(off, sz)
 
         def _clear():
             self._aborted.pop(group, None)
@@ -768,9 +774,13 @@ class CollectiveTransport:
         for ps in self._peer_maps.values():
             ps.close()
         self._peer_maps.clear()
-        if self.scratch is not None:
-            self.scratch.close()
-            self.scratch = None
+        # Detach under the same lock _ensure_scratch publishes under:
+        # a bare write here could hand a concurrent _ensure_scratch an
+        # arena that close() is about to unmap (RTC101).
+        with self._scratch_lock:
+            scratch, self.scratch = self.scratch, None
+        if scratch is not None:
+            scratch.close()
 
 
 def get_transport() -> CollectiveTransport:

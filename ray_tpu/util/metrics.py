@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import threading
 import time
+
+from ray_tpu._private import locksan
 from typing import Dict, List, Optional, Tuple
 
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = locksan.make_lock("metrics._REGISTRY_LOCK")
 _REGISTRY: Dict[str, "Metric"] = {}
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
@@ -33,7 +35,7 @@ class Metric:
         self._default_tags: Dict[str, str] = {}
         # label-values-tuple -> scalar (or bucket-counts for histograms)
         self._values: Dict[tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("Metric._lock")
         with _REGISTRY_LOCK:
             _REGISTRY[name] = self
 

@@ -29,6 +29,24 @@ import ray_tpu  # noqa: E402
 from ray_tpu.cluster_utils import Cluster  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _locksan_no_new_violations():
+    """When the runtime lock-order sanitizer is on (RT_LOCK_SANITIZER=1,
+    e.g. `make chaos`), any test whose execution records a NEW
+    lock-order violation fails with the witness message — the dynamic
+    complement of the static RTC102 cycle detector."""
+    from ray_tpu._private import locksan
+    if not locksan.enabled():
+        yield
+        return
+    before = len(locksan.violations())
+    yield
+    new = locksan.violations()[before:]
+    assert not new, (
+        "lock-order violation(s) recorded during this test:\n"
+        + "\n".join(v["message"] for v in new))
+
+
 @pytest.fixture
 def ray_start_regular():
     """A fresh single-node cluster + connected driver."""

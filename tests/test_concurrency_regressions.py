@@ -1,0 +1,192 @@
+"""Regression tests for the concurrency bugs the RTC lint pass found
+(PR 16 triage).  Each test pins the FIXED behavior:
+
+* GenerationEngine.stop() must not tear down slot/paging state while a
+  wedged worker thread still owns it (serve/llm/engine.py, RTC101).
+* CollectiveTransport._ensure_scratch() vs close() must never hand a
+  caller None or leak an arena (util/collective/transport.py, RTC101).
+* UsageReporter counters are a real critical section — report_once()
+  is public API and the loop thread's body (_private/usage.py, RTC104).
+* autoscaler Monitor.stop() interrupts a long sleep interval instead
+  of outliving its own bounded join (autoscaler/_private/autoscaler.py).
+"""
+
+import threading
+import time
+
+import pytest
+
+
+# ------------------------------------------------ engine wedged worker
+@pytest.mark.slow  # builds a (tiny) jax model
+def test_engine_stop_leaves_state_to_a_wedged_worker():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.llm import GenerationEngine
+
+    cfg = gpt.GPTConfig(vocab_size=97, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32, remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    eng = GenerationEngine(params, cfg, num_slots=2, max_seq=40,
+                           prefill_chunk=4)
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def wedged_run():
+        entered.set()
+        release.wait(30)
+
+    resets = []
+    orig_reset = eng._reset_paging
+    eng._run = wedged_run
+    eng._reset_paging = lambda: (resets.append(1), orig_reset())[1]
+
+    eng.start()
+    assert entered.wait(5)
+    try:
+        eng.stop(timeout=0.2)  # join times out: worker still wedged
+        # The fix: a timed-out join must NOT touch paging/slot state
+        # the live worker still owns.
+        assert resets == []
+        assert eng._thread.is_alive()
+    finally:
+        release.set()
+    eng.stop(timeout=10)  # worker exited: teardown may now proceed
+    assert not eng._thread.is_alive()
+    assert resets == [1]
+
+
+# ------------------------------------- transport scratch publish race
+class _FakeWorker:
+    def __init__(self):
+        from ray_tpu._private.ids import WorkerID
+        self.ext_rpc = {}
+        self.blob_providers = {}
+        self.worker_id = WorkerID.from_random()
+        self.addr = ("127.0.0.1", 0)
+        self.node_id = None
+        self.actor_id = None
+        self.loop = None
+
+
+def test_transport_ensure_scratch_vs_close_race(monkeypatch):
+    from ray_tpu.util.collective import transport as tmod
+
+    created, closed = [], []
+
+    class _FakeArena:
+        def __init__(self, path, capacity):
+            self.path = path
+            self.token_hex = "00" * 16
+            created.append(self)
+
+        def close(self):
+            closed.append(self)
+
+        def free(self, off, sz):
+            pass
+
+    monkeypatch.setattr(tmod, "ScratchArena", _FakeArena)
+    tr = tmod.CollectiveTransport(_FakeWorker())
+
+    stop = threading.Event()
+    errors = []
+
+    def opener():
+        try:
+            while not stop.is_set():
+                info = tr.endpoint_info(0)
+                # The fix: _ensure_scratch returns under the lock, so a
+                # concurrent close() can never hand the caller None.
+                assert info["scratch_path"]
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    def closer():
+        try:
+            while not stop.is_set():
+                tr.close()
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=opener),
+               threading.Thread(target=closer)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join(10)
+        assert not t.is_alive()
+    tr.close()
+    assert not errors, errors
+    # Every arena the race created was eventually closed exactly once:
+    # the swap-under-lock in close() can't double-close or leak one.
+    assert len(created) >= 1
+    assert len(closed) == len(created)
+
+
+# -------------------------------------------- usage counter atomicity
+def test_usage_report_once_counters_are_atomic(tmp_path, monkeypatch):
+    from ray_tpu._private import usage
+
+    sent = []
+    monkeypatch.setattr(usage, "_transport",
+                        lambda url, payload: sent.append(payload))
+    rep = usage.UsageReporter(str(tmp_path), "sess-regress",
+                              interval_s=3600)
+
+    N, K = 8, 20
+    errors = []
+
+    def hammer():
+        try:
+            for _ in range(K):
+                rep.report_once()
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive()
+    assert not errors, errors
+    # seq/success are read-modify-writes from N threads at once: with
+    # the lock, no increment is lost.
+    assert rep._counters["seq"] == N * K
+    assert rep._counters["success"] == N * K
+    assert rep._counters["failed"] == 0
+    assert len(sent) == N * K
+
+
+# ------------------------------------------ monitor responsive stop()
+def test_autoscaler_monitor_stop_interrupts_interval():
+    from ray_tpu.autoscaler._private.autoscaler import Monitor
+
+    class _Scaler:
+        def __init__(self):
+            self.updates = 0
+            self.first = threading.Event()
+
+        def update(self):
+            self.updates += 1
+            self.first.set()
+
+    sc = _Scaler()
+    mon = Monitor(sc, interval_s=30.0)
+    mon.start()
+    assert sc.first.wait(5)
+    t0 = time.monotonic()
+    mon.stop()  # must interrupt the 30s sleep, not wait it out
+    elapsed = time.monotonic() - t0
+    assert not mon._thread.is_alive()
+    assert elapsed < 5.0
+    n = sc.updates
+    time.sleep(0.1)
+    assert sc.updates == n  # no further ticks after stop

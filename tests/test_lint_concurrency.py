@@ -1,0 +1,559 @@
+"""RTC concurrency rules (ray_tpu.lint.concurrency) + the runtime
+lock-order sanitizer (ray_tpu._private.locksan): one flagging and one
+non-flagging fixture per RTC rule, noqa/baseline suppression, the CLI
+surface (--format sarif, --jobs, --emit-lock-graph), and the seeded
+two-lock deadlock fixture caught BOTH statically (RTC102) and
+dynamically (locksan) with a gap-free static/dynamic diff."""
+
+import json
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu._private import locksan
+from ray_tpu.lint import (apply_baseline, collect_summaries, lint_paths,
+                          lint_source, load_baseline, write_baseline)
+from ray_tpu.lint.__main__ import main as lint_main
+from ray_tpu.lint.concurrency import build_lock_graph, emit_lock_graph
+
+
+def codes(src: str):
+    return [f.code for f in lint_source(textwrap.dedent(src), "t.py")]
+
+
+def messages(src: str, code: str):
+    return [f.message for f in lint_source(textwrap.dedent(src), "t.py")
+            if f.code == code]
+
+
+# ------------------------------------------------------------- RTC101
+def test_rtc101_flags_mixed_bare_and_guarded_writes():
+    src = """
+    import threading
+
+    class Buf:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._drain)
+            self._thread.start()
+
+        def _drain(self):
+            with self._lock:
+                self._items = []
+
+        def add(self, x):
+            self._items.append(x)
+    """
+    assert "RTC101" in codes(src)
+    (msg,) = messages(src, "RTC101")
+    assert "Buf._items" in msg and "WITHOUT the lock" in msg
+
+
+def test_rtc101_clean_when_all_writes_guarded_or_no_threads():
+    src = """
+    import threading
+
+    class Guarded:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._drain)
+            self._thread.start()
+
+        def _drain(self):
+            with self._lock:
+                self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+    class SingleThreaded:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def reset(self):
+            with self._lock:
+                self._items = []
+
+        def add(self, x):
+            self._items.append(x)  # no thread entry: loop-confined
+    """
+    assert "RTC101" not in codes(src)
+
+
+def test_rtc101_locked_suffix_means_caller_holds_the_lock():
+    src = """
+    import threading
+
+    class Conv:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._eps = []
+            threading.Thread(target=self._gc).start()
+
+        def _gc(self):
+            with self._lock:
+                self._gc_locked()
+
+        def _gc_locked(self):
+            self._eps = [e for e in self._eps if e]
+    """
+    assert "RTC101" not in codes(src)
+
+
+# ------------------------------------------------------------- RTC102
+_DEADLOCK_SRC = textwrap.dedent("""
+    from ray_tpu._private import locksan
+
+    A = locksan.make_lock("deadlock_fixture.A")
+    B = locksan.make_lock("deadlock_fixture.B")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def ba():
+        with B:
+            with A:
+                pass
+""")
+
+
+def test_rtc102_flags_seeded_two_lock_deadlock(tmp_path):
+    mod = tmp_path / "deadlock_fixture.py"
+    mod.write_text(_DEADLOCK_SRC)
+    findings = lint_paths([str(mod)])
+    rtc102 = [f for f in findings if f.code == "RTC102"]
+    assert len(rtc102) == 1
+    msg = rtc102[0].message
+    assert "lock-order cycle" in msg
+    assert "deadlock_fixture.A" in msg and "deadlock_fixture.B" in msg
+    # The message carries TWO witness paths — one per direction.
+    assert msg.count("deadlock_fixture.py:") >= 2
+
+
+def test_rtc102_clean_when_order_is_consistent():
+    src = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with A:
+            with B:
+                pass
+    """
+    assert "RTC102" not in codes(src)
+
+
+def test_rtc102_sees_cycles_through_the_call_graph():
+    src = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def _inner_b():
+        with B:
+            pass
+
+    def f():
+        with A:
+            _inner_b()
+
+    def g():
+        with B:
+            with A:
+                pass
+    """
+    assert "RTC102" in codes(src)
+
+
+# ------------------------------------------------------------- RTC103
+def test_rtc103_flags_sleep_and_condition_on_other_lock():
+    src = """
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+
+        def tick(self):
+            with self._lock:
+                time.sleep(1)
+
+        def park(self):
+            with self._lock:
+                with self._cond:
+                    self._cond.wait()
+    """
+    out = codes(src)
+    assert out.count("RTC103") == 2
+    msgs = messages(src, "RTC103")
+    assert any("time.sleep()" in m for m in msgs)
+    assert any("releases only its own lock" in m for m in msgs)
+
+
+def test_rtc103_clean_when_blocking_outside_locks():
+    src = """
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+
+        def tick(self):
+            with self._lock:
+                n = 1
+            time.sleep(n)
+
+        def park(self):
+            with self._cond:
+                self._cond.wait()  # waits on its OWN lock: fine
+    """
+    assert "RTC103" not in codes(src)
+
+
+# ------------------------------------------------------------- RTC104
+def test_rtc104_flags_lockless_object_shared_with_thread():
+    src = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.rows = []
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._work)
+            self._t.start()
+
+        def _work(self):
+            self.rows.append(1)
+
+        def add(self, x):
+            self.rows.append(x)
+    """
+    assert "RTC104" in codes(src)
+    (msg,) = messages(src, "RTC104")
+    assert "defines no lock" in msg and "self.rows" in msg
+
+
+def test_rtc104_clean_with_lock_or_writes_before_start():
+    src = """
+    import threading
+
+    class Locked:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.rows = []
+
+        def start(self):
+            threading.Thread(target=self._work).start()
+
+        def _work(self):
+            with self._lock:
+                self.rows.append(1)
+
+    class WriteBeforeStart:
+        def __init__(self):
+            self.rows = []
+
+        def start(self):
+            self.rows = []  # happens-before Thread.start()
+            threading.Thread(target=self._read).start()
+
+        def _read(self):
+            return len(self.rows)
+    """
+    assert "RTC104" not in codes(src)
+
+
+# ------------------------------------------------- noqa and baseline
+def test_noqa_suppresses_rtc_codes():
+    base = """
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self):
+            with self._lock:
+                time.sleep(1){noqa}
+    """
+    assert "RTC103" in codes(base.format(noqa=""))
+    assert "RTC103" not in codes(base.format(noqa="  # noqa: RTC103"))
+    assert "RTC103" not in codes(base.format(noqa="  # noqa"))
+    assert "RTC103" in codes(base.format(noqa="  # noqa: RTC101"))
+
+
+_RTC_FLAGGED = textwrap.dedent("""
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self):
+            with self._lock:
+                time.sleep(1)
+""")
+
+
+def test_baseline_suppresses_rtc_findings(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(_RTC_FLAGGED)
+    findings = lint_paths([str(mod)])
+    assert [f.code for f in findings] == ["RTC103"]
+
+    bl = tmp_path / "bl.json"
+    write_baseline(findings, str(bl), root=str(tmp_path))
+    baseline = load_baseline(str(bl))
+    assert baseline == {"m.py::RTC103": 1}
+    assert apply_baseline(findings, baseline, root=str(tmp_path)) == []
+
+
+def test_write_baseline_preserves_reason_strings(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(_RTC_FLAGGED)
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "counts": {"m.py::RTC103": 1, "gone.py::RTC104": 1},
+        "reasons": {"m.py::RTC103": "deliberate: warmup sleep",
+                    "gone.py::RTC104": "stale entry"},
+    }))
+    findings = lint_paths([str(mod)])
+    write_baseline(findings, str(bl), root=str(tmp_path))
+    out = json.loads(bl.read_text())
+    # Reasons survive regeneration for keys still baselined; reasons
+    # for keys that dropped out of the baseline are pruned with them.
+    assert out["counts"] == {"m.py::RTC103": 1}
+    assert out["reasons"] == {"m.py::RTC103": "deliberate: warmup sleep"}
+
+
+def test_checked_in_baseline_reasons_cover_every_rtc_key():
+    """Satellite contract: every baselined RTC finding carries a
+    justification string."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, ".rtlint-baseline.json")) as f:
+        data = json.load(f)
+    rtc_keys = {k for k in data["counts"] if "::RTC" in k}
+    assert rtc_keys, "expected RTC entries in the checked-in baseline"
+    missing = rtc_keys - set(data.get("reasons", {}))
+    assert not missing, f"RTC baseline keys without a reason: {missing}"
+
+
+def test_cli_strict_reasons_drops_unjustified_entries(tmp_path,
+                                                      monkeypatch,
+                                                      capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text(_RTC_FLAGGED)
+    monkeypatch.chdir(tmp_path)
+    bl = tmp_path / ".rtlint-baseline.json"
+
+    bl.write_text(json.dumps({"counts": {"m.py::RTC103": 1}}))
+    assert lint_main([str(mod)]) == 0  # normal mode: suppressed
+    # Strict mode: the entry has no reason, so the finding fails.
+    assert lint_main([str(mod), "--strict-reasons"]) == 1
+
+    bl.write_text(json.dumps({
+        "counts": {"m.py::RTC103": 1},
+        "reasons": {"m.py::RTC103": "deliberate warmup sleep"}}))
+    assert lint_main([str(mod), "--strict-reasons"]) == 0
+    capsys.readouterr()
+
+
+# ----------------------------------------------------------- CLI flags
+def test_cli_sarif_output_and_jobs(tmp_path, monkeypatch, capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text(_RTC_FLAGGED)
+    monkeypatch.chdir(tmp_path)
+
+    assert lint_main([str(mod), "--no-baseline", "--format", "sarif",
+                      "--jobs", "2"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert [r["ruleId"] for r in run["results"]] == ["RTC103"]
+    assert run["results"][0]["locations"][0]["physicalLocation"][
+        "region"]["startLine"] > 1
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == ["RTC103"]
+
+    assert lint_main([str(mod), "--no-baseline", "--format",
+                      "json"]) == 1
+    blob = json.loads(capsys.readouterr().out)
+    assert blob[0]["code"] == "RTC103"
+
+
+def test_cli_emit_lock_graph(tmp_path, monkeypatch, capsys):
+    mod = tmp_path / "deadlock_fixture.py"
+    mod.write_text(_DEADLOCK_SRC)
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "graph.json"
+    assert lint_main([str(mod), "--no-baseline", "--emit-lock-graph",
+                      str(out)]) == 1  # the RTC102 finding
+    capsys.readouterr()
+    graph = json.loads(out.read_text())
+    edges = {tuple(e) for e in graph["edges"]}
+    assert ("deadlock_fixture.A", "deadlock_fixture.B") in edges
+    assert ("deadlock_fixture.B", "deadlock_fixture.A") in edges
+
+
+# ------------------------------------------------- runtime sanitizer
+@pytest.fixture
+def san():
+    was = locksan.enabled()
+    locksan.reset()
+    locksan.enable()
+    yield locksan
+    locksan.reset()
+    if not was:
+        locksan.disable()
+
+
+def test_locksan_disabled_returns_raw_primitives():
+    if locksan.enabled():  # pragma: no cover - chaos battery runs
+        pytest.skip("sanitizer globally enabled")
+    lk = locksan.make_lock("t.raw")
+    assert type(lk) is type(threading.Lock())
+    assert not isinstance(lk, locksan._SanLock)
+
+
+def test_locksan_records_edges_and_violations(san):
+    a = san.make_lock("t.A")
+    b = san.make_lock("t.B")
+    with a:
+        with b:
+            pass
+    assert ("t.A", "t.B") in san.edges()
+    assert san.violations() == []
+    with b:
+        with a:  # reverse order: the deadlock interleaving exists
+            pass
+    vio = san.violations()
+    assert len(vio) == 1
+    assert vio[0]["edge"] == ("t.B", "t.A")
+    assert "deadlocks" in vio[0]["message"]
+    assert "lock-order violation" in san.report()
+
+
+def test_locksan_reentrant_same_key_is_not_an_edge(san):
+    r = san.make_rlock("t.R")
+    other = san.make_lock("t.O")
+    with r:
+        with r:  # reentrancy on one key: no self-edge
+            with other:
+                pass
+    assert ("t.R", "t.R") not in san.edges()
+    assert ("t.R", "t.O") in san.edges()
+    assert san.violations() == []
+
+
+def test_locksan_condition_wait_releases_its_key(san):
+    cond = san.make_condition("t.C")
+    outer = san.make_lock("t.OUT")
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with outer:
+        with cond:
+            hits.append(1)
+            cond.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+    assert ("t.OUT", "t.C") in san.edges()
+    assert san.violations() == []
+
+
+def test_locksan_static_dynamic_same_fixture(san, tmp_path):
+    """Acceptance gate: the seeded two-lock deadlock is caught by the
+    STATIC cycle detector (test_rtc102_flags_seeded_two_lock_deadlock)
+    and — here — by the runtime sanitizer executing the very same
+    source, with a gap-free diff between the two graphs."""
+    mod = tmp_path / "deadlock_fixture.py"
+    mod.write_text(_DEADLOCK_SRC)
+
+    ns = {}
+    exec(compile(_DEADLOCK_SRC, str(mod), "exec"), ns)
+    ns["ab"]()
+    ns["ba"]()
+    vio = san.violations()
+    assert len(vio) == 1
+    assert set(vio[0]["edge"]) == {"deadlock_fixture.A",
+                                   "deadlock_fixture.B"}
+
+    static = san.load_static_graph(
+        emit_lock_graph(collect_summaries([str(mod)])))
+    diff = san.check_against_static(static)
+    # Both dynamic orderings were predicted statically: no analyzer
+    # gaps.  (Gaps here would be a bug in ray_tpu/lint/concurrency.py.)
+    assert diff["gaps"] == []
+    assert diff["unexercised"] == []
+
+
+def test_locksan_flags_analyzer_gaps(san):
+    a = san.make_lock("gap.A")
+    b = san.make_lock("gap.B")
+    with a:
+        with b:
+            pass
+    diff = san.check_against_static({("gap.A", "gap.B"),
+                                     ("gap.X", "gap.Y")})
+    assert diff["gaps"] == []
+    assert diff["unexercised"] == [("gap.X", "gap.Y")]
+    # An edge the static graph does NOT predict is an analyzer gap.
+    diff = san.check_against_static(set())
+    assert ("gap.A", "gap.B") in diff["gaps"]
+
+
+def test_lock_graph_merges_summaries_across_modules(tmp_path):
+    (tmp_path / "m1.py").write_text(textwrap.dedent("""
+        import threading
+        A = threading.Lock()
+
+        def f():
+            with A:
+                import m2
+                m2.g()
+    """))
+    (tmp_path / "m2.py").write_text(textwrap.dedent("""
+        import threading
+        B = threading.Lock()
+
+        def g():
+            with B:
+                pass
+    """))
+    adj = build_lock_graph(collect_summaries([str(tmp_path)]))
+    assert "m2.B" in adj.get("m1.A", {})

@@ -484,7 +484,13 @@ def test_serve_failover_stream_keeps_one_trace_id(serve_session):
     # The failover annotation rides the trace, naming the dead replica.
     fo = [e for e in mine if e["name"] == "serve.failover"]
     assert fo and fo[0]["args"]["replica_died"] == tag
-    assert fo[0]["args"]["delivered"] == 5
+    # The client consumed 5 items before the kill, but the replica may
+    # have pushed a few more into the router's buffer before dying —
+    # "delivered" counts the router's receipts, so it is >= 5 and is
+    # the exact resume point (len(got) == 24 above proves no token was
+    # lost or duplicated across the failover).
+    delivered = fo[0]["args"]["delivered"]
+    assert delivered >= 5
     # Both assignment attempts live in the driver's ring under the ONE
     # trace id: the original replica and the failover target.  (The
     # dead replica's own ring died with its process — the flight
@@ -494,7 +500,7 @@ def test_serve_failover_stream_keeps_one_trace_id(serve_session):
     assert {a["args"]["replica"] for a in assigns} >= {tag}
     assert len(assigns) >= 2, assigns
     assert any(a["args"]["failover"] == 1
-               and a["args"]["resumed"] == 5 for a in assigns)
+               and a["args"]["resumed"] == delivered for a in assigns)
     # The SURVIVOR's resumed generation carries the original trace id:
     # its engine stage spans are in the tree.
     survivor_engine = [e for e in mine
