@@ -23,7 +23,8 @@ SHELL := /bin/bash
 	bench-collective-quick bench-control bench-control-quick \
 	bench-serve-scale bench-serve-scale-quick bench-data \
 	bench-data-quick bench-trace bench-trace-quick bench-train \
-	bench-train-quick chaos chaos-smoke
+	bench-train-quick bench-autopilot bench-autopilot-quick \
+	chaos chaos-smoke
 
 # --- static + dynamic correctness gates -------------------------------
 # lint: the AST-based distributed-correctness self-check (RTL001-008
@@ -181,6 +182,25 @@ bench-train-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite train_e2e --quick
 
+# Cluster autopilot soak: serve + elastic train gang + data soak share
+# one fixed-capacity cluster under the SLO arbiter while a traffic
+# spike replays.  Asserts the gang shrinks elastically (zero cold
+# restarts, loss series continuous), serve p99 TTFT returns within SLO
+# late in the spike, the data lease revokes within grace and re-soaks
+# only after the gang is whole, and mean utilization stays > 80%.
+# Refreshes the checked-in BENCH_autopilot.json.
+bench-autopilot:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 600 \
+		$(PY) bench.py --suite autopilot \
+		--json-out BENCH_autopilot.json
+
+# <60 s autopilot smoke (shorter phases, same gates): catches an
+# arbitration-policy or lease-backpressure regression before a full
+# soak.  Does NOT touch the checked-in artifact.
+bench-autopilot-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 150 \
+		$(PY) bench.py --suite autopilot --quick
+
 # --- chaos battery ----------------------------------------------------
 # Seeded, deterministic message-level fault injection
 # (tests/test_failpoints.py + the dup-dedup satellites).  Every run
@@ -219,6 +239,8 @@ chaos:
 		tests/test_tracing.py::test_http_sse_trace_header_links_client_proxy_replica \
 		tests/test_train_elastic.py::test_elastic_sigkill_resumes_in_place \
 		tests/test_train_elastic.py::test_reshard_death_falls_back_to_checkpoint \
+		tests/test_autopilot.py::test_chaos_node_sigkill_mid_revocation \
+		tests/test_autopilot.py::test_chaos_gcs_sigkill_mid_arbitration_no_stale_grants \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
@@ -239,7 +261,8 @@ chaos-smoke:
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
 	bench-collective-quick bench-control-quick bench-serve-scale-quick \
-	bench-data-quick bench-trace-quick bench-train-quick
+	bench-data-quick bench-trace-quick bench-train-quick \
+	bench-autopilot-quick
 
 store: ray_tpu/_private/_shm_store.so
 

@@ -45,6 +45,14 @@ Suites (--suite):
              checkpoint-restart baseline, with the metric-series
              continuity record.  Writes BENCH_train_e2e.json; --quick
              is the <60s smoke wired into make check.
+  autopilot  cluster autopilot soak: serve + elastic train + data soak
+             sharing one 8-slot cluster under the GCS arbiter while a
+             traffic spike replays — the sustained TTFT breach shrinks
+             the gang through the elastic re-form path (no restart, no
+             failure budget), revokes the data lease within its grace
+             window, and returns everything when the spike drains.
+             Writes BENCH_autopilot.json; --quick is the <60s smoke
+             wired into make check.
 """
 
 import json
@@ -2854,6 +2862,482 @@ def train_e2e_main(json_out=None, quick=False):
     return detail
 
 
+def _autopilot_soak_batch(batch):
+    """Data soak work unit: a fixed slice of 'idle-capacity' compute
+    per block (one lease unit held for its duration)."""
+    time.sleep(0.3)
+    return batch
+
+
+def autopilot_main(json_out=None, quick=False):
+    """Cluster autopilot soak (--suite autopilot): one 8-slot cluster
+    running all three tenant classes at once under the GCS arbiter —
+
+      * a serve deployment declaring a p99 TTFT SLO (replicas serialize
+        requests, so TTFT is the REAL measured queue wait);
+      * a 4-worker elastic train gang (floor 2, lower priority);
+      * a data job soaking idle slots through a revocable lease gating
+        the streaming executor's admission.
+
+    The driver replays a traffic spike: baseline -> spike -> drain.
+    The spike's queue blowup breaches the SLO; the arbiter reclaims
+    slots from the gang (elastic shrink 4->2 via the re-form path — no
+    checkpoint restart, no failure budget) and revokes the data lease;
+    once the backlog clears the gang grows back and, as traffic drains,
+    serve returns replicas and data re-soaks.  Gates: the gang never
+    dips below its floor and ends back at full size with a continuous
+    step series (zero cold restarts), late-spike TTFT is back within
+    the SLO, the revoked lease drains in-flight work within its grace
+    window then re-soaks, the gang grows before data re-soaks, and
+    mean slot utilization stays above 80%."""
+    import threading
+    from collections import deque
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu import serve
+    from ray_tpu._private import arbiter as arbiter_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG as rcfg
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.data._internal.streaming_executor import StreamingExecutor
+    from ray_tpu.serve.config import AutoscalingConfig
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train._internal import backend_executor as be
+
+    SLO = 0.75            # declared p99 TTFT bound (s)
+    service_s = 0.22      # per-request service time (serialized)
+    deadline_s = 2.5      # requests older than this are shed, not served
+    warm_s, spike_s, drain_s = (5.0, 18.0, 12.0) if quick \
+        else (8.0, 35.0, 25.0)
+    base_rps, spike_rps, drain_rps = 2.0, 12.0, 1.0
+    capacity = 8          # arbitration slots (broker truncates the 0.5)
+
+    def counter_total(counter):
+        return sum(counter.snapshot()["values"].values())
+
+    # 8 whole slots for workloads + 0.5 head-room for the serve
+    # controller's fractional footprint, so a full 6-replica grant is
+    # physically placeable while the broker arbitrates over int(8.5)=8.
+    ray_tpu.init(num_cpus=8.5)
+    total_cpu = float(ray_tpu.cluster_resources().get("CPU", 8.5))
+    old_reform = rcfg.train_reform_timeout_s
+    rcfg.train_reform_timeout_s = 10.0  # bench-sized settle window
+    resizes0 = counter_total(be.ELASTIC_RESIZES)
+    restarts0 = counter_total(be.GANG_RESTARTS)
+
+    # ---- serve: SLO-declaring deployment, measured queue-wait TTFT --
+    serve.start()
+
+    @serve.deployment(name="front", max_concurrent_queries=256,
+                      ray_actor_options={"num_cpus": 1},
+                      autoscaling_config=AutoscalingConfig(
+                          min_replicas=1, max_replicas=6,
+                          target_num_ongoing_requests_per_replica=0.8,
+                          upscale_delay_s=0.3, downscale_delay_s=1.5,
+                          metrics_interval_s=0.2,
+                          decision_cooldown_s=0.5, load_ewma_alpha=0.6,
+                          slo_ttft_p99_s=SLO, priority=100))
+    class Front:
+        """One slot's worth of serving: requests serialize on a lock,
+        so the measured lock wait IS the request's TTFT, and a replica
+        saturates at 1/service_s requests/sec — spike demand genuinely
+        needs more replicas, it cannot hide in thread concurrency."""
+
+        def __init__(self):
+            import collections
+            import threading as _threading
+            self._serial = _threading.Lock()
+            self._waits = collections.deque(maxlen=256)
+
+        def _shed(self, t_enter):
+            # Shed requests record their wait too (a shed IS a TTFT
+            # failure): during a backlog burn-off the signal must keep
+            # showing the breach, not go quiet.
+            waited = time.monotonic() - t_enter
+            self._waits.append((time.monotonic(), waited))
+            return {"shed": True, "wait": waited}
+
+        def __call__(self, t_submit):
+            t_enter = time.monotonic()
+            # Queued requests age out in PARALLEL (they poll rather
+            # than block on the service lock), so a deep backlog sheds
+            # at once when its deadline passes instead of trickling
+            # through the serving replica one lock-hold at a time.
+            while not self._serial.acquire(timeout=0.05):
+                if t_submit is not None and \
+                        time.monotonic() - t_submit > deadline_s:
+                    return self._shed(t_enter)
+            try:
+                if t_submit is not None and \
+                        time.monotonic() - t_submit > deadline_s:
+                    return self._shed(t_enter)
+                waited = time.monotonic() - t_enter
+                self._waits.append((time.monotonic(), waited))
+                time.sleep(service_s)
+                return {"shed": False, "wait": waited}
+            finally:
+                self._serial.release()
+
+        def autoscale_metrics(self):
+            now = time.monotonic()
+            recent = [w for (t, w) in list(self._waits)
+                      if now - t <= 2.0]
+            return {"ttft_p99_s": max(recent) if recent else 0.0}
+
+    handle = Front.deploy()
+    handle.remote(None).result(timeout=60)  # pipeline warm
+
+    # ---- train: elastic gang the broker may shrink to its floor -----
+    executor = be.BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=4, elastic=True,
+                      elastic_min_workers=2, name="bench-gang",
+                      priority=50, resources_per_worker={"CPU": 1}))
+    executor.start()
+    executor.start_training(
+        _e2e_train_loop, {"steps": 1 << 20, "sleep": 0.15},
+        trial_name="autopilot", trial_id="autopilot")
+
+    stop_all = threading.Event()
+    pump_rows = []  # (t, world, step)
+
+    def pump():
+        while not stop_all.is_set():
+            try:
+                res = executor.get_next_results()
+            except Exception:
+                break
+            if res is None:
+                break
+            pump_rows.append((time.monotonic(), len(res),
+                              int(res[0].metrics["step"])))
+
+    threading.Thread(target=pump, daemon=True,
+                     name="bench-pump").start()
+
+    # ---- data: lease-gated streaming soak over tiny blocks ----------
+    prod = ray_tpu.remote(_data_block_producer)
+    block_refs = [prod.remote(i, 4) for i in range(12)]
+    ray_tpu.wait(block_refs, num_returns=len(block_refs), timeout=60,
+                 fetch_local=False)
+    lease = arbiter_mod.DataLease("data:soak", want=8, priority=0)
+    soak_stages = rd.Dataset(list(block_refs)).map_batches(
+        _autopilot_soak_batch)._stages
+    soak_done = [0]
+
+    def soak():
+        while not stop_all.is_set():
+            ex = StreamingExecutor(list(block_refs), soak_stages,
+                                   parallelism=4, lease=lease)
+            try:
+                for _ in ex.iter_handles():
+                    soak_done[0] += 1
+                    if stop_all.is_set():
+                        break
+            except Exception:
+                pass
+            finally:
+                ex.close()
+
+    threading.Thread(target=soak, daemon=True,
+                     name="bench-soak").start()
+
+    # ---- samplers ---------------------------------------------------
+    status_rows, lease_rows, util_rows = [], [], []
+    WIDS = ("serve:front", "train:bench-gang", "data:soak")
+
+    def sample_status():
+        while not stop_all.is_set():
+            try:
+                st = worker_mod.global_worker.gcs_call(
+                    "arbiter_status", {}, timeout=5)
+                row = {"t": time.monotonic(),
+                       "totals": {k: st.get(k) for k in
+                                  ("grants_total", "revocations_total",
+                                   "slo_breach_seconds")}}
+                for w in st.get("workloads", []):
+                    row[w["wid"]] = {
+                        "granted": w["granted"],
+                        "units_now": w["units_now"],
+                        "breached": w["breached"],
+                        "ttft": (w.get("signals") or {}).get(
+                            "ttft_p99_s")}
+                status_rows.append(row)
+            except Exception:
+                pass
+            stop_all.wait(0.25)
+
+    def sample_lease():
+        while not stop_all.is_set():
+            with lease._lock:
+                inflight = lease._in_flight
+            lease_rows.append((time.monotonic(), lease.allowed(),
+                               inflight, soak_done[0]))
+            stop_all.wait(0.2)
+
+    def sample_util():
+        while not stop_all.is_set():
+            try:
+                avail = float(ray_tpu.available_resources().get(
+                    "CPU", 0.0))
+                busy = min(max((total_cpu - avail) / capacity, 0.0),
+                           1.0)
+                util_rows.append((time.monotonic(), busy))
+            except Exception:
+                pass
+            stop_all.wait(0.25)
+
+    for fn in (sample_status, sample_lease, sample_util):
+        threading.Thread(target=fn, daemon=True,
+                         name=f"bench-{fn.__name__}").start()
+
+    # Wait for all three tenants to be registered with the broker.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if status_rows and all(w in status_rows[-1] for w in WIDS):
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError(
+            f"tenants never registered with the broker: "
+            f"{sorted(status_rows[-1]) if status_rows else []}")
+
+    # ---- traffic replay: baseline -> spike -> drain -----------------
+    pending = deque()
+    tallies = {"served": 0, "shed": 0, "error": 0}
+    drain_stop = threading.Event()
+
+    def drain_responses():
+        while not (drain_stop.is_set() and not pending):
+            try:
+                _, resp = pending.popleft()
+            except IndexError:
+                time.sleep(0.02)
+                continue
+            try:
+                out = resp.result(timeout=60)
+                key = "shed" if (isinstance(out, dict)
+                                 and out.get("shed")) else "served"
+                tallies[key] += 1
+            except Exception:
+                tallies["error"] += 1
+
+    drainer = threading.Thread(target=drain_responses, daemon=True,
+                               name="bench-drainer")
+    drainer.start()
+
+    def pace(rate, until):
+        nxt = time.monotonic()
+        while time.monotonic() < until:
+            t_sub = time.monotonic()
+            try:
+                pending.append((t_sub, handle.remote(t_sub)))
+            except Exception:
+                tallies["error"] += 1
+            nxt += 1.0 / rate
+            dt = nxt - time.monotonic()
+            if dt > 0:
+                time.sleep(dt)
+
+    t0 = time.monotonic()
+    pace(base_rps, t0 + warm_s)
+    t_spike = time.monotonic()
+    pace(spike_rps, t_spike + spike_s)
+    t_drain = time.monotonic()
+    pace(drain_rps, t_drain + drain_s)
+    t_end = time.monotonic()
+
+    drain_stop.set()
+    drainer.join(timeout=60)
+    stop_all.set()
+    lease.stop()
+    with lease._lock:
+        lease._granted = 1 << 10  # unblock a soak pass parked on revoke
+    time.sleep(0.5)
+    executor.shutdown()
+    serve.shutdown()
+    ray_tpu.shutdown()
+    rcfg.train_reform_timeout_s = old_reform
+
+    # ---- analysis ---------------------------------------------------
+    def grant_events(wid):
+        ev, last = [], None
+        for r in status_rows:
+            g = (r.get(wid) or {}).get("granted")
+            if g is None or g == last:
+                continue
+            ev.append({"t": round(r["t"] - t0, 2), "granted": g})
+            last = g
+        return ev
+
+    def first_t(rows_t, pred, t_min):
+        for item in rows_t:
+            if item[0] >= t_min and pred(item):
+                return item[0]
+        return None
+
+    worlds = [w for (_, w, _) in pump_rows]
+    steps = [s for (_, _, s) in pump_rows]
+    resizes = int(counter_total(be.ELASTIC_RESIZES) - resizes0)
+    restarts = int(counter_total(be.GANG_RESTARTS) - restarts0)
+
+    spike_rows = [r for r in status_rows
+                  if t_spike <= r["t"] <= t_drain]
+    breach_ts = [r["t"] for r in spike_rows
+                 if (r.get("serve:front") or {}).get("breached")]
+    spike_ttfts = [(r.get("serve:front") or {}).get("ttft")
+                   for r in spike_rows]
+    spike_ttfts = [x for x in spike_ttfts if x is not None]
+    late_ttfts = [x for r in spike_rows for x in
+                  [(r.get("serve:front") or {}).get("ttft")]
+                  if x is not None
+                  and r["t"] >= t_spike + 0.75 * spike_s]
+
+    status_t = [(r["t"], r) for r in status_rows]
+    t_rev = first_t(lease_rows, lambda it: it[1] == 0, t_spike)
+    t_drained = None if t_rev is None else first_t(
+        lease_rows, lambda it: it[2] == 0, t_rev)
+    grace = rcfg.autopilot_data_revoke_grace_s
+    # Anchor the recovery-ordering check on the observed reclaim: the
+    # gang's grow-back and data's re-soak are both measured from the
+    # moment the broker shrank the gang.
+    t_gang_shrunk = first_t(
+        status_t, lambda it: 0 < (it[1].get("train:bench-gang") or {})
+        .get("granted", 4) < 4, t_spike)
+    t_gang_full = None if t_gang_shrunk is None else first_t(
+        status_t, lambda it: (it[1].get("train:bench-gang") or {})
+        .get("granted", 0) >= 4, t_gang_shrunk)
+    t_resoak = None if t_gang_shrunk is None else first_t(
+        status_t, lambda it: (it[1].get("data:soak") or {})
+        .get("granted", 0) >= 1, t_gang_shrunk)
+    soak_at_drain = max((d for (t, _, _, d) in lease_rows
+                         if t <= t_drain), default=0)
+    soak_in_drain = soak_done[0] - soak_at_drain
+
+    utils = [u for (t, u) in util_rows if t0 + 3.0 <= t <= t_end]
+    util_mean = sum(utils) / max(len(utils), 1)
+    totals = status_rows[-1]["totals"] if status_rows else {}
+
+    detail = {
+        "quick": bool(quick), "capacity": capacity, "slo_ttft_s": SLO,
+        "service_s": service_s, "deadline_s": deadline_s,
+        "phases_s": {"warm": warm_s, "spike": spike_s,
+                     "drain": drain_s},
+        "rps": {"base": base_rps, "spike": spike_rps,
+                "drain": drain_rps},
+        "requests": dict(tallies),
+        "serve": {
+            "grant_events": grant_events("serve:front"),
+            "breach_samples": len(breach_ts),
+            "first_breach_t": (round(breach_ts[0] - t0, 2)
+                               if breach_ts else None),
+            "spike_ttft_peak_s": round(max(spike_ttfts), 3)
+            if spike_ttfts else None,
+            "late_spike_ttft_max_s": round(max(late_ttfts), 3)
+            if late_ttfts else None,
+        },
+        "gang": {
+            "grant_events": grant_events("train:bench-gang"),
+            "world_min": min(worlds) if worlds else None,
+            "world_final": worlds[-1] if worlds else None,
+            "steps_final": steps[-1] if steps else None,
+            "elastic_resizes": resizes, "gang_restarts": restarts,
+            "grew_back_t": (round(t_gang_full - t0, 2)
+                            if t_gang_full else None),
+        },
+        "data": {
+            "grant_events": grant_events("data:soak"),
+            "revoked_t": round(t_rev - t0, 2) if t_rev else None,
+            "inflight_drain_s": (round(t_drained - t_rev, 2)
+                                 if t_drained and t_rev else None),
+            "revoke_grace_s": grace,
+            "resoak_t": round(t_resoak - t0, 2) if t_resoak else None,
+            "soak_blocks_total": soak_done[0],
+            "soak_blocks_in_drain_phase": soak_in_drain,
+        },
+        "utilization_mean": round(util_mean, 3),
+        "broker_totals": totals,
+    }
+    line = json.dumps({"suite": "autopilot", "detail": detail})
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+
+    # ---- gates (before the HEADLINE, same order as other suites) ----
+    # The reclaim depth is the arbiter's call: it revokes exactly the
+    # serve shortfall (a mild breach needs one worker, a hard one two),
+    # so require a REAL elastic shrink, not a maximal one.
+    assert worlds and min(worlds) < 4, \
+        f"gang never shrank below its declared size: worlds min " \
+        f"{min(worlds) if worlds else None}"
+    assert all(w >= 2 for w in worlds), \
+        f"gang dipped below its quorum floor: {min(worlds)}"
+    assert worlds[-1] == 4, \
+        f"gang did not grow back to full size: final {worlds[-1]}"
+    assert restarts == 0, \
+        f"{restarts} cold gang restart(s): shrink must ride the " \
+        f"elastic re-form path"
+    assert resizes >= 2, \
+        f"expected >=2 elastic re-formations (shrink+grow), got " \
+        f"{resizes}"
+    assert all(b >= a - 1 for a, b in zip(steps, steps[1:])), \
+        "train step series went backwards (state lost across resize)"
+    assert breach_ts, "spike never registered an SLO breach"
+    assert late_ttfts and max(late_ttfts) <= SLO, \
+        f"late-spike TTFT {max(late_ttfts) if late_ttfts else None} " \
+        f"not back within the {SLO}s SLO"
+    assert t_rev is not None, "data lease was never revoked"
+    assert t_drained is not None and t_drained - t_rev <= grace + 1.5, \
+        f"revoked lease in-flight drain took " \
+        f"{None if t_drained is None else round(t_drained - t_rev, 2)}" \
+        f"s (> grace {grace}s + margin)"
+    assert t_resoak is not None and soak_in_drain >= 3, \
+        f"data never re-soaked after the spike " \
+        f"(resoak_t={t_resoak}, blocks={soak_in_drain})"
+    # Recovery ordering, stated as the phase-5 reservation invariant:
+    # whenever the gang is under-granted, a data grant INCREASE must
+    # still leave enough free pool to cover the gang's whole deficit.
+    # (A wall-clock ordering check is wrong here — data may
+    # legitimately soak slots serve returns while the gang waits out
+    # serve's release cooldowns; what it must never do is eat the
+    # headroom the gang is owed.)
+    prev_d = None
+    for (t, r) in status_t:
+        g = (r.get("train:bench-gang") or {}).get("granted")
+        s = (r.get("serve:front") or {}).get("granted")
+        d = (r.get("data:soak") or {}).get("granted")
+        if d is not None and prev_d is not None and d > prev_d \
+                and g is not None and s is not None and g < 4:
+            free = capacity - s - g - d
+            assert free >= 4 - g, \
+                f"data re-soaked into the gang's deficit at " \
+                f"t={round(t - t0, 2)}: serve={s} gang={g} data={d} " \
+                f"leaves free={free} < gang deficit {4 - g}"
+        if d is not None:
+            prev_d = d
+    assert float(totals.get("revocations_total") or 0) >= 2, totals
+    assert float(totals.get("slo_breach_seconds") or 0) > 0, totals
+    assert util_mean > 0.8, \
+        f"mean slot utilization {util_mean:.2f} <= 0.8"
+
+    print("HEADLINE autopilot gang=4->"
+          + _fmt_headline(min(worlds), 0) + "->"
+          + _fmt_headline(worlds[-1], 0)
+          + " resizes=" + _fmt_headline(resizes, 0)
+          + " restarts=0"
+          + " ttft_peak_s=" + _fmt_headline(
+              detail["serve"]["spike_ttft_peak_s"], 2)
+          + " late_ttft_s=" + _fmt_headline(
+              detail["serve"]["late_spike_ttft_max_s"], 2)
+          + f" slo_s={SLO}"
+          + " lease_drain_s=" + _fmt_headline(
+              detail["data"]["inflight_drain_s"], 2)
+          + " util=" + _fmt_headline(util_mean * 100, 0) + "%")
+    return detail
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
@@ -2861,7 +3345,7 @@ if __name__ == "__main__":
                     choices=["train", "serve_llm", "transfer",
                              "collective", "control_plane",
                              "serve_scale", "data", "trace",
-                             "train_e2e"])
+                             "train_e2e", "autopilot"])
     ap.add_argument("--json-out", default=None,
                     help="also write the JSON line to this path "
                          "(serve_llm/transfer default to their "
@@ -2902,6 +3386,10 @@ if __name__ == "__main__":
     elif cli.suite == "train_e2e":
         train_e2e_main(cli.json_out if cli.quick
                        else (cli.json_out or "BENCH_train_e2e.json"),
+                       quick=cli.quick)
+    elif cli.suite == "autopilot":
+        autopilot_main(cli.json_out if cli.quick
+                       else (cli.json_out or "BENCH_autopilot.json"),
                        quick=cli.quick)
     else:
         main()

@@ -1,0 +1,535 @@
+"""Cluster autopilot: an SLO-driven resource arbiter.
+
+One cluster, three tenant classes that previously raced for nodes:
+
+  * **serve** deployments (PR 10's gauge-driven autoscaler) declare a
+    p99 TTFT SLO and a priority;
+  * **train** gangs (PR 13's elastic re-form path) declare an
+    ``elastic_min_workers`` floor and a priority;
+  * **data** jobs (PR 11's streaming executor) declare a soak class —
+    they want whatever is idle and promise to give it back.
+
+The broker lives inside the GCS (see ``gcs.py``); this module holds the
+*policy* — a pure, deterministic state machine with an injectable clock
+so the arbitration logic is testable in isolation with seeded demand
+traces — plus the client-side helpers (report loop, revocable data
+lease) that workloads embed.
+
+Units are CPU slots: the GCS feeds ``tick()`` the cluster's aggregate
+CPU total, and one unit backs one serve replica / train worker / data
+task slot (the bench provisions 1-CPU nodes so units == nodes).
+
+Decision semantics
+------------------
+``tick(now, capacity)`` returns a list of decision dicts::
+
+    {"wid": str, "action": "grant"|"revoke", "from": int, "to": int,
+     "reason": str, "grace_s": float?}
+
+A *grant* raises a workload's budget, a *revoke* lowers it.  Revokes of
+data leases carry ``grace_s``: new admission stops immediately, in-
+flight tasks get the grace window to drain.  The policy never directs a
+train gang below its declared floor, and two voluntary budget changes
+for the same workload are always >= the cooldown apart; only a capacity
+crunch (node death making the current grants infeasible) bypasses the
+cooldown, and even then trains hold their floor.
+
+Allocation order per tick (which is what makes the recovery ordering
+"grow the gang before data re-soaks" structural rather than tuned):
+
+  1. serve floors, then train floors (min_replicas / quorum);
+  2. trains up to their full declared size;
+  3. serve demand beyond its floor from the remaining free pool;
+  4. if a serve SLO breach has been *sustained* past the breach window,
+     reclaim from trains — lowest priority first, never below floor;
+  5. data soaks whatever is left with revocable leases.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+SERVE = "serve"
+TRAIN = "train"
+DATA = "data"
+_KINDS = (SERVE, TRAIN, DATA)
+
+
+class _Workload:
+    __slots__ = (
+        "wid", "kind", "priority", "min_units", "max_units", "slo",
+        "want", "units_now", "granted", "ewma", "breach_since",
+        "ok_since", "breached", "last_change_t", "last_report_t",
+        "directive", "ever_granted",
+    )
+
+    def __init__(self, wid: str, kind: str):
+        self.wid = wid
+        self.kind = kind
+        self.priority = 100
+        self.min_units = 0
+        self.max_units: Optional[int] = None
+        self.slo: Optional[float] = None
+        self.want = 0
+        self.units_now = 0
+        self.granted = 0
+        self.ewma: Dict[str, float] = {}
+        self.breach_since: Optional[float] = None
+        self.ok_since: Optional[float] = None
+        self.breached = False
+        self.last_change_t = -1e18
+        self.last_report_t = -1e18
+        # One-shot operator directive (rt resize <gang> <n>) delivered
+        # through the next report reply.
+        self.directive: Optional[int] = None
+        self.ever_granted = False
+
+    def desired(self) -> int:
+        d = max(self.want, self.min_units)
+        if self.max_units is not None:
+            d = min(d, self.max_units)
+        return max(d, 0)
+
+    def view(self) -> Dict[str, Any]:
+        return {
+            "wid": self.wid, "kind": self.kind,
+            "priority": self.priority, "min_units": self.min_units,
+            "max_units": self.max_units, "slo": self.slo,
+            "want": self.want, "units_now": self.units_now,
+            "granted": self.granted, "breached": self.breached,
+            "signals": dict(self.ewma),
+        }
+
+
+class ArbiterPolicy:
+    """The pure arbitration state machine.
+
+    No asyncio, no RPC, no global clock: ``clock`` is injectable and
+    every entry point takes/derives an explicit ``now`` so tests drive
+    it with a fake clock and seeded demand traces.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic, *,
+                 period_s: Optional[float] = None,
+                 breach_window_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 ewma_alpha: Optional[float] = None,
+                 revoke_grace_s: Optional[float] = None,
+                 stale_report_s: Optional[float] = None):
+        self._clock = clock
+        self.period_s = (cfg.autopilot_period_s
+                         if period_s is None else period_s)
+        self.breach_window_s = (cfg.autopilot_slo_breach_window_s
+                                if breach_window_s is None
+                                else breach_window_s)
+        self.cooldown_s = (cfg.autopilot_cooldown_s
+                           if cooldown_s is None else cooldown_s)
+        self.ewma_alpha = (cfg.autopilot_ewma_alpha
+                           if ewma_alpha is None else ewma_alpha)
+        self.revoke_grace_s = (cfg.autopilot_data_revoke_grace_s
+                               if revoke_grace_s is None
+                               else revoke_grace_s)
+        self.stale_report_s = (cfg.autopilot_stale_report_s
+                               if stale_report_s is None
+                               else stale_report_s)
+        self._workloads: Dict[str, _Workload] = {}
+        self._last_tick_t: Optional[float] = None
+        # Cumulative counters mirrored into prometheus by the GCS.
+        self.grants_total = 0
+        self.revocations_total = 0
+        self.slo_breach_seconds = 0.0
+
+    # ------------------------------------------------------- registry
+    def register(self, wid: str, kind: str, *, priority: int = 100,
+                 min_units: int = 0, max_units: Optional[int] = None,
+                 slo: Optional[float] = None,
+                 now: Optional[float] = None) -> _Workload:
+        if kind not in _KINDS:
+            raise ValueError(f"unknown workload kind {kind!r} "
+                             f"(expected one of {_KINDS})")
+        wl = self._workloads.get(wid)
+        if wl is None or wl.kind != kind:
+            wl = _Workload(wid, kind)
+            self._workloads[wid] = wl
+        wl.priority = int(priority)
+        wl.min_units = max(int(min_units), 0)
+        wl.max_units = None if max_units is None else int(max_units)
+        wl.slo = slo
+        wl.last_report_t = self._clock() if now is None else now
+        return wl
+
+    def unregister(self, wid: str) -> bool:
+        return self._workloads.pop(wid, None) is not None
+
+    def get(self, wid: str) -> Optional[_Workload]:
+        return self._workloads.get(wid)
+
+    def report(self, wid: str, *, want: int, units_now: int,
+               signals: Optional[Dict[str, float]] = None,
+               now: Optional[float] = None,
+               **decl: Any) -> Dict[str, Any]:
+        """Ingest one workload report; returns the current grant.
+
+        A report doubles as a registration upsert when ``decl`` carries
+        the declaration fields (kind/priority/min_units/max_units/slo).
+        That is what makes a GCS restart safe by construction: broker
+        state is deliberately NOT in the snapshot, so a restarted GCS
+        starts with zero grants and rebuilds the whole table within one
+        report period — stale grants cannot be resurrected.
+        """
+        now = self._clock() if now is None else now
+        wl = self._workloads.get(wid)
+        if wl is None:
+            kind = decl.get("kind")
+            if kind is None:
+                return {"ok": False, "error": {
+                    "code": "UNKNOWN_WORKLOAD",
+                    "message": f"workload {wid!r} is not registered and "
+                               f"the report carries no declaration"}}
+            wl = self.register(
+                wid, kind, priority=decl.get("priority", 100),
+                min_units=decl.get("min_units", 0),
+                max_units=decl.get("max_units"),
+                slo=decl.get("slo"), now=now)
+        elif decl.get("kind"):
+            self.register(
+                wid, decl["kind"],
+                priority=decl.get("priority", wl.priority),
+                min_units=decl.get("min_units", wl.min_units),
+                max_units=decl.get("max_units", wl.max_units),
+                slo=decl.get("slo", wl.slo), now=now)
+            wl = self._workloads[wid]
+        wl.want = max(int(want), 0)
+        wl.units_now = max(int(units_now), 0)
+        wl.last_report_t = now
+        alpha = min(max(self.ewma_alpha, 0.0), 1.0)
+        for key, val in (signals or {}).items():
+            try:
+                val = float(val)
+            except (TypeError, ValueError):
+                continue
+            prev = wl.ewma.get(key)
+            wl.ewma[key] = (val if prev is None or alpha >= 1.0
+                            else alpha * val + (1 - alpha) * prev)
+        directive, wl.directive = wl.directive, None
+        return {"ok": True, "granted": wl.granted,
+                "directive": directive,
+                "revoke_grace_s": self.revoke_grace_s,
+                "report_period_s": cfg.autopilot_report_period_s}
+
+    def set_directive(self, wid: str, target: int) -> None:
+        wl = self._workloads[wid]
+        wl.directive = int(target)
+
+    # ----------------------------------------------------- arbitration
+    def _update_breach(self, wl: _Workload, now: float,
+                       dt: float) -> None:
+        sig = wl.ewma.get("ttft_p99_s")
+        if wl.slo is None or sig is None:
+            wl.breach_since = wl.ok_since = None
+            wl.breached = False
+            return
+        if sig > wl.slo:
+            self.slo_breach_seconds += dt
+            wl.ok_since = None
+            if wl.breach_since is None:
+                wl.breach_since = now
+            if now - wl.breach_since >= self.breach_window_s:
+                wl.breached = True
+        else:
+            wl.breach_since = None
+            if wl.ok_since is None:
+                wl.ok_since = now
+            if now - wl.ok_since >= self.breach_window_s:
+                wl.breached = False
+
+    def tick(self, now: Optional[float] = None,
+             capacity: int = 0) -> List[Dict[str, Any]]:
+        now = self._clock() if now is None else now
+        dt = (0.0 if self._last_tick_t is None
+              else max(now - self._last_tick_t, 0.0))
+        self._last_tick_t = now
+
+        # Drop workloads whose client stopped reporting (driver died
+        # without unregistering) — their budget returns to the pool.
+        for wid in [w.wid for w in self._workloads.values()
+                    if now - w.last_report_t > self.stale_report_s]:
+            del self._workloads[wid]
+
+        by_kind: Dict[str, List[_Workload]] = {k: [] for k in _KINDS}
+        for wl in self._workloads.values():
+            by_kind[wl.kind].append(wl)
+        for k in by_kind:
+            # Priority desc, then wid for determinism.
+            by_kind[k].sort(key=lambda w: (-w.priority, w.wid))
+        serves, trains, datas = (by_kind[SERVE], by_kind[TRAIN],
+                                 by_kind[DATA])
+
+        for wl in serves:
+            self._update_breach(wl, now, dt)
+
+        target: Dict[str, int] = {w: 0 for w in self._workloads}
+        pool = max(int(capacity), 0)
+
+        def _take(wl: _Workload, n: int) -> None:
+            nonlocal pool
+            n = max(min(n, pool), 0)
+            target[wl.wid] += n
+            pool -= n
+
+        # 1. Floors: serve min_replicas, then train quorum floors.
+        # Floors are granted even if the pool runs dry (capacity
+        # accounting is advisory; a gang is never *directed* below its
+        # floor by the arbiter — that is the quorum-safety invariant).
+        for wl in serves + trains:
+            floor = min(wl.min_units, wl.desired())
+            target[wl.wid] = floor
+            pool = max(pool - floor, 0)
+        # 2. Trains up to their full declared size.
+        for wl in trains:
+            _take(wl, wl.desired() - target[wl.wid])
+        # 3. Serve demand beyond floor from the free pool.
+        for wl in serves:
+            _take(wl, wl.desired() - target[wl.wid])
+        # 4. Sustained SLO breach -> reclaim from trains, lowest
+        #    priority first, never below floor.
+        shortfall = sum(wl.desired() - target[wl.wid] for wl in serves
+                        if wl.breached)
+        if shortfall > 0:
+            for victim in sorted(trains,
+                                 key=lambda w: (w.priority, w.wid)):
+                if shortfall <= 0:
+                    break
+                spare = target[victim.wid] - victim.min_units
+                take = max(min(spare, shortfall), 0)
+                if take <= 0:
+                    continue
+                target[victim.wid] -= take
+                shortfall -= take
+                recovered = take
+                for wl in serves:
+                    if not wl.breached or recovered <= 0:
+                        continue
+                    add = min(wl.desired() - target[wl.wid], recovered)
+                    if add > 0:
+                        target[wl.wid] += add
+                        recovered -= add
+        # 5. Data soaks the remainder with revocable leases — but only
+        #    truly IDLE capacity.  Headroom an under-allocated train is
+        #    entitled to stays reserved: after a reclaim, the gang's
+        #    revoke cooldown can expire a tick later than data's, and
+        #    without the reservation a freed slot would re-soak into
+        #    data one tick before the gang is allowed to grow back.
+        #    "Grow before data re-soaks" is a structural invariant, not
+        #    a cooldown race.
+        def _will_pin(wl: _Workload) -> bool:
+            return (wl.ever_granted and target[wl.wid] != wl.granted
+                    and now - wl.last_change_t < self.cooldown_s)
+
+        train_deficit = sum(
+            max(wl.desired() - (wl.granted if _will_pin(wl)
+                                else target[wl.wid]), 0)
+            for wl in trains)
+        pool = max(pool - train_deficit, 0)
+        for wl in datas:
+            _take(wl, wl.desired())
+
+        # Cooldown pinning: a workload inside its cooldown keeps its
+        # current grant — unless the pinned total is infeasible (node
+        # death shrank capacity, or a pin re-inflated a grant past what
+        # the phases allotted), in which case the crunch overrides the
+        # cooldown, data first, trains still never below floor.  The
+        # shave considers EVERY workload, not just pinned ones: a
+        # fresh phase-5 data grant must be the first thing to give
+        # back, or an over-commit caused by someone ELSE's pin would
+        # be taken out of a train's hide while data keeps the slot.
+        for wl in self._workloads.values():
+            t = target[wl.wid]
+            if (wl.ever_granted and t != wl.granted
+                    and now - wl.last_change_t < self.cooldown_s):
+                target[wl.wid] = wl.granted
+        over = sum(target.values()) - max(int(capacity), 0)
+        if over > 0:
+            for wl in sorted(self._workloads.values(),
+                             key=lambda w: (_KINDS.index(w.kind) * -1,
+                                            w.priority, w.wid)):
+                if over <= 0:
+                    break
+                floor = wl.min_units if wl.kind != DATA else 0
+                give = min(max(target[wl.wid] - floor, 0), over)
+                if give > 0:
+                    target[wl.wid] -= give
+                    over -= give
+
+        decisions: List[Dict[str, Any]] = []
+        for wl in self._workloads.values():
+            t = target[wl.wid]
+            if t == wl.granted and wl.ever_granted:
+                continue
+            action = ("grant" if t > wl.granted or not wl.ever_granted
+                      else "revoke")
+            reason = "alloc"
+            if action == "revoke":
+                if wl.kind == TRAIN:
+                    reason = "serve_slo_breach"
+                elif wl.kind == DATA:
+                    reason = "reclaimed"
+                else:
+                    reason = "demand_drop"
+            elif wl.kind == SERVE and wl.breached:
+                reason = "slo_breach_upscale"
+            dec = {"wid": wl.wid, "kind": wl.kind, "action": action,
+                   "from": wl.granted, "to": t, "reason": reason}
+            if action == "revoke" and wl.kind == DATA:
+                dec["grace_s"] = self.revoke_grace_s
+            if action == "grant":
+                self.grants_total += 1
+            else:
+                self.revocations_total += 1
+            wl.granted = t
+            wl.ever_granted = True
+            wl.last_change_t = now
+            decisions.append(dec)
+        return decisions
+
+    # --------------------------------------------------------- export
+    def status(self) -> Dict[str, Any]:
+        return {
+            "workloads": [w.view() for w in self._workloads.values()],
+            "grants_total": self.grants_total,
+            "revocations_total": self.revocations_total,
+            "slo_breach_seconds": self.slo_breach_seconds,
+        }
+
+
+# ---------------------------------------------------------------------
+# Client side: report loop + revocable data lease.
+# ---------------------------------------------------------------------
+
+def gcs_call(method: str, body: Dict[str, Any],
+             timeout: Optional[float] = None) -> Any:
+    """Synchronous GCS RPC usable from any thread (controller executor
+    threads, gang agent threads, the CLI)."""
+    from ray_tpu._private.worker import global_worker
+    return global_worker.gcs_call(method, body, timeout=timeout)
+
+
+class DataLease:
+    """A revocable soak lease for a streaming data job.
+
+    ``allowed()`` is the number of concurrently admitted tasks the
+    broker currently grants.  A background reporter thread refreshes
+    the grant every ``cfg.autopilot_report_period_s``; when the broker
+    revokes units, new admission drops *immediately* (the operator's
+    admission loop consults ``allowed()`` before launching every task)
+    while in-flight tasks get ``revoke_grace_s`` to drain — that is the
+    clean-backpressure contract the arbiter relies on.
+    """
+
+    def __init__(self, wid: str, *, want: int = 1 << 16,
+                 priority: int = 0, start: bool = True):
+        self.wid = wid
+        self.want = want
+        self.priority = priority
+        self._granted = 0
+        self._in_flight = 0
+        self._revoked_t: Optional[float] = None
+        self._grace_s = cfg.autopilot_data_revoke_grace_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- grant side -----------------------------------------------------
+    def allowed(self) -> int:
+        with self._lock:
+            return self._granted
+
+    def note_launched(self, n: int = 1) -> None:
+        with self._lock:
+            self._in_flight += n
+
+    def note_finished(self, n: int = 1) -> None:
+        with self._lock:
+            self._in_flight = max(self._in_flight - n, 0)
+
+    @property
+    def revoked_at(self) -> Optional[float]:
+        with self._lock:
+            return self._revoked_t
+
+    def _apply_reply(self, reply: Dict[str, Any]) -> None:
+        if not isinstance(reply, dict) or not reply.get("ok", False):
+            return
+        granted = int(reply.get("granted", 0))
+        with self._lock:
+            if granted < self._granted:
+                self._revoked_t = time.monotonic()
+            elif granted > self._granted:
+                self._revoked_t = None
+            self._granted = granted
+            self._grace_s = float(
+                reply.get("revoke_grace_s", self._grace_s))
+
+    def report_once(self) -> None:
+        with self._lock:
+            in_flight = self._in_flight
+        reply = gcs_call("arbiter_report", {
+            "wid": self.wid, "want": self.want,
+            "units_now": in_flight,
+            "decl": {"kind": DATA, "priority": self.priority,
+                     "min_units": 0},
+        })
+        self._apply_reply(reply)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        try:
+            self.report_once()
+        except Exception:
+            pass
+        self._thread = threading.Thread(
+            target=self._report_loop, daemon=True,
+            name=f"rt-data-lease-{self.wid}")
+        self._thread.start()
+
+    def _report_loop(self) -> None:
+        while not self._stop.wait(cfg.autopilot_report_period_s):
+            try:
+                self.report_once()
+            except Exception:
+                # GCS unreachable: keep the last grant; the broker will
+                # age us out via the stale-report TTL if we never come
+                # back, so holding the grant here cannot leak budget.
+                continue
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            gcs_call("arbiter_unregister", {"wid": self.wid})
+        except Exception:
+            pass
+
+
+_AMBIENT_LEASE: Optional[DataLease] = None
+
+
+def set_ambient_data_lease(lease: Optional[DataLease]) -> None:
+    """Install a process-wide lease consulted by streaming operators
+    that were not handed one explicitly."""
+    global _AMBIENT_LEASE
+    _AMBIENT_LEASE = lease
+
+
+def ambient_data_lease() -> Optional[DataLease]:
+    return _AMBIENT_LEASE

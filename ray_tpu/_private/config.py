@@ -233,6 +233,53 @@ class _Config:
     actor_restart_wait_s = _def("actor_restart_wait_s", float, 300.0)
     task_queue_warn_len = _def("task_queue_warn_len", int, 100000)
 
+    # --- serve control plane (controller reconcile / autoscale ticks) ---
+    # Reconcile-loop period (was the CONTROL_LOOP_PERIOD_S module
+    # constant in serve/_private/controller.py) and the poll cadence of
+    # the controller's wait loops (deployment-health wait, graceful
+    # shutdown drain) — every controller tick interval now rides the
+    # config table instead of hardcoded literals.
+    serve_control_loop_period_s = _def("serve_control_loop_period_s",
+                                       float, 0.1)
+    serve_health_poll_period_s = _def("serve_health_poll_period_s",
+                                      float, 0.1)
+
+    # --- cluster autopilot (SLO-driven arbiter, _private/arbiter.py) ---
+    # The GCS broker's arbitration tick: how often registered workload
+    # declarations + smoothed signals are re-evaluated into grant /
+    # revoke decisions.
+    autopilot_period_s = _def("autopilot_period_s", float, 0.25)
+    # Client-side report cadence (serve controller SLO attainment,
+    # train gang agent, data soak lease) — each report doubles as the
+    # grant fetch, so one RPC per period per workload.
+    autopilot_report_period_s = _def("autopilot_report_period_s",
+                                     float, 0.25)
+    # A serve SLO breach must be SUSTAINED this long before the arbiter
+    # reclaims capacity from lower-priority workloads (and the
+    # recovery must be sustained equally long before capacity returns)
+    # — the arbiter's half of the flap suppression.
+    autopilot_slo_breach_window_s = _def("autopilot_slo_breach_window_s",
+                                         float, 1.0)
+    # Post-decision cooldown per workload: two budget changes for the
+    # same workload are always at least this far apart.
+    autopilot_cooldown_s = _def("autopilot_cooldown_s", float, 2.0)
+    # EWMA smoothing over reported signals (TTFT p99) — 1.0 disables.
+    autopilot_ewma_alpha = _def("autopilot_ewma_alpha", float, 0.5)
+    # A revoked data soak lease stops admitting new tasks immediately;
+    # in-flight tasks get this grace window to drain before the bench /
+    # chaos harness calls the revocation late.
+    autopilot_data_revoke_grace_s = _def("autopilot_data_revoke_grace_s",
+                                         float, 2.0)
+    # Nodes reserved for a reclaim beneficiary (so revoked capacity
+    # drains instead of accepting new low-priority leases) un-reserve
+    # after this TTL even if the arbiter never clears them.
+    autopilot_reserve_ttl_s = _def("autopilot_reserve_ttl_s", float, 15.0)
+    # A workload whose client stopped reporting (driver died without
+    # unregistering) is dropped from arbitration after this long — its
+    # budget returns to the pool instead of leaking forever.
+    autopilot_stale_report_s = _def("autopilot_stale_report_s",
+                                    float, 15.0)
+
     # --- tracing (the cross-plane span runtime, _private/tracing.py) ---
     # Always-on per-process span ring; set false to hard-disable every
     # record (the fast path is one bool check — measured by

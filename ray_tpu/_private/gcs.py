@@ -54,6 +54,13 @@ class NodeInfo:
         self.alive = True
         self.draining = False  # planned shutdown announced (drain RPC)
         self.drain_deadline = None  # monotonic expiry of the drain flag
+        # Autopilot reservation: while set (to the beneficiary workload
+        # id) the node drains its current leases instead of accepting
+        # new low-priority ones — sched filters treat it like draining,
+        # but GCS actor placement (serve replicas / train workers)
+        # ignores it so the reclaim beneficiary can land there.
+        self.reserved: str | None = None
+        self.reserve_deadline = None
         self.last_heartbeat = time.monotonic()
         self.load = 0  # queued lease count reported by the raylet
         self.pending_shapes: list = []
@@ -72,6 +79,7 @@ class NodeInfo:
             "labels": self.labels,
             "alive": self.alive,
             "draining": self.draining,
+            "reserved": self.reserved,
             "load": self.load,
             # Versioned-sync introspection (beats = all heartbeats,
             # payloads = beats that carried a resource snapshot).
@@ -126,6 +134,10 @@ class PlacementGroupInfo:
         self.job_id = job_id
         self.state = "PENDING"
         self.bundle_nodes: list[NodeID] = []
+        # Bundle indices released back to their node by an elastic
+        # shrink (release_bundles RPC); grow re-reserves them through
+        # the same two-phase prepare/commit before spawning joiners.
+        self.released_bundles: set[int] = set()
 
     def view(self):
         return {
@@ -223,13 +235,27 @@ class GcsServer:
         self._last_snapshot_bytes = 0
         self._snapshot_count = 0
         self._metrics = None
+        # Cluster autopilot: the SLO-driven resource broker.  Policy
+        # state is deliberately NOT persisted (see _snapshot_state) —
+        # a restarted GCS starts with zero grants and rebuilds the
+        # table from client reports within one report period, which is
+        # what makes "no stale grants after snapshot restore" hold by
+        # construction.
+        from ray_tpu._private.arbiter import ArbiterPolicy
+        self.arbiter = ArbiterPolicy()
+        # Gang elasticity registry (wid -> bool) fed by train-gang
+        # reports, so rt resize can answer NOT_ELASTIC structurally.
+        self._gang_elastic: dict[str, bool] = {}
+        self._arbiter_last_counts = {"grants": 0, "revocations": 0,
+                                     "breach_s": 0.0}
         if persist_path:
             self._load_snapshot()
 
     async def start(self, port=0):
         port = await self.server.start(port)
         self._bg_tasks = [
-            asyncio.get_running_loop().create_task(self._liveness_loop())]
+            asyncio.get_running_loop().create_task(self._liveness_loop()),
+            asyncio.get_running_loop().create_task(self._arbiter_loop())]
         if self._persist_path:
             self._bg_tasks.append(
                 asyncio.get_running_loop().create_task(
@@ -293,9 +319,17 @@ class GcsServer:
                 {"pg_id": p.pg_id, "bundles": list(p.bundles),
                  "strategy": p.strategy, "name": p.name,
                  "job_id": p.job_id, "state": p.state,
-                 "bundle_nodes": list(p.bundle_nodes)}
+                 "bundle_nodes": list(p.bundle_nodes),
+                 "released_bundles": list(p.released_bundles)}
                 for p in self.placement_groups.values()
             ],
+            # Autopilot broker state (declarations, grants, breach
+            # timers) is INTENTIONALLY absent: grants are leases over
+            # live capacity, and resurrecting them from a snapshot
+            # could hand out budget against nodes/workloads that died
+            # with the old GCS.  Clients re-report within one
+            # autopilot_report_period_s, rebuilding the table from
+            # scratch — a restart can only under-grant, never leak.
         }
 
     def _write_snapshot(self, state: dict):
@@ -359,6 +393,7 @@ class GcsServer:
                                       p["strategy"], p["name"], p["job_id"])
             info.state = p["state"]
             info.bundle_nodes = p["bundle_nodes"]
+            info.released_bundles = set(p.get("released_bundles", ()))
             self.placement_groups[info.pg_id] = info
         self.restored_from_snapshot = True
         self._record_event(
@@ -689,6 +724,18 @@ class GcsServer:
                     await self._publish("nodes", {
                         "event": "updated", "node_id": node.node_id,
                         "draining": False})
+                if node.alive and node.reserved is not None \
+                        and (node.reserve_deadline is None
+                             or now >= node.reserve_deadline):
+                    # Same shape as the drain-expiry reversal above: a
+                    # reservation permanently excluded the node from
+                    # lease scheduling, so its expiry must be broadcast
+                    # or the node stays fenced forever.
+                    node.reserved = None
+                    node.reserve_deadline = None
+                    await self._publish("nodes", {
+                        "event": "updated", "node_id": node.node_id,
+                        "reserved": None})
                 if node.alive and now - node.last_heartbeat > timeout:
                     # A node that announced its drain and then stalled
                     # during teardown is still an orderly exit, not a
@@ -1407,6 +1454,209 @@ class GcsServer:
     async def rpc_ping(self, conn, body):
         return {"ok": True, "uptime": time.time() - self._start_time}
 
+    # ------------------------------------------------------------ autopilot
+    def _arbiter_capacity(self) -> int:
+        """Arbitration currency: aggregate CPU slots across alive
+        nodes (1 unit backs 1 serve replica / train worker / data
+        task slot; the autopilot bench provisions 1-CPU nodes so a
+        unit is a node)."""
+        return int(self._agg_total.get("CPU", 0))
+
+    async def _arbiter_loop(self):
+        while True:
+            await asyncio.sleep(max(0.02, cfg.autopilot_period_s))
+            try:
+                await self._arbiter_tick()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("arbiter tick failed")
+
+    async def _arbiter_tick(self):
+        t0 = time.time()
+        capacity = self._arbiter_capacity()
+        decisions = self.arbiter.tick(capacity=capacity)
+        if not decisions:
+            return
+        breached = [w for w in
+                    self.arbiter._workloads.values()
+                    if w.kind == "serve" and w.breached]
+        beneficiary = breached[0].wid if breached else None
+        for dec in decisions:
+            reclaim = (dec["action"] == "revoke"
+                       and dec["kind"] in ("train", "data")
+                       and beneficiary is not None)
+            if reclaim:
+                # Fence the reclaimed capacity: the most-idle alive
+                # nodes stop admitting new low-priority leases (sched
+                # filters treat reserved like draining) while the
+                # beneficiary's replicas can still land there.
+                await self._reserve_nodes(dec["from"] - dec["to"],
+                                          beneficiary)
+            sev = "WARNING" if dec["action"] == "revoke" else "INFO"
+            self._record_event(
+                sev, "AUTOPILOT_" + dec["action"].upper(),
+                f"{dec['wid']}: {dec['from']} -> {dec['to']} units "
+                f"({dec['reason']})")
+            await self._publish("arbiter", dict(dec))
+        _tracing.record(
+            "gcs", "gcs.arbitrate", t0, time.time() - t0,
+            args={"capacity": capacity,
+                  "decisions": [
+                      {"wid": d["wid"], "action": d["action"],
+                       "from": d["from"], "to": d["to"],
+                       "reason": d["reason"]} for d in decisions]})
+
+    async def _reserve_nodes(self, count: int, beneficiary: str):
+        if count <= 0:
+            return
+        idle = sorted(
+            (n for n in self.nodes.values()
+             if n.alive and not n.draining and n.reserved is None),
+            key=lambda n: -n.available_resources.get("CPU", 0))
+        now = time.monotonic()
+        for node in idle[:count]:
+            node.reserved = beneficiary
+            node.reserve_deadline = now + cfg.autopilot_reserve_ttl_s
+            await self._publish("nodes", {
+                "event": "updated", "node_id": node.node_id,
+                "reserved": beneficiary})
+
+    async def rpc_arbiter_register(self, conn, body):
+        try:
+            wl = self.arbiter.register(
+                body["wid"], body["kind"],
+                priority=body.get("priority", 100),
+                min_units=body.get("min_units", 0),
+                max_units=body.get("max_units"),
+                slo=body.get("slo"))
+        except ValueError as e:
+            return {"ok": False, "error": {"code": "BAD_DECLARATION",
+                                           "message": str(e)}}
+        if body["kind"] == "train":
+            self._gang_elastic[wl.wid] = bool(body.get("elastic", True))
+        return {"ok": True, "granted": wl.granted}
+
+    async def rpc_arbiter_report(self, conn, body):
+        decl = body.get("decl") or {}
+        if decl.get("kind") == "train" and "elastic" in decl:
+            self._gang_elastic[body["wid"]] = bool(decl["elastic"])
+        return self.arbiter.report(
+            body["wid"], want=body.get("want", 0),
+            units_now=body.get("units_now", 0),
+            signals=body.get("signals"),
+            **{k: v for k, v in decl.items() if k != "elastic"})
+
+    async def rpc_arbiter_unregister(self, conn, body):
+        self._gang_elastic.pop(body["wid"], None)
+        return {"ok": self.arbiter.unregister(body["wid"])}
+
+    async def rpc_arbiter_status(self, conn, body):
+        st = self.arbiter.status()
+        st["capacity"] = self._arbiter_capacity()
+        st["reserved_nodes"] = {
+            n.node_id.hex()[:8]: n.reserved
+            for n in self.nodes.values()
+            if n.alive and n.reserved is not None}
+        return st
+
+    async def rpc_resize_gang(self, conn, body):
+        """Operator/broker entry point for elastic gang resize: the
+        target rides the gang's next report reply as a directive, so
+        `rt resize` and the arbiter's own grants share one path into
+        BackendExecutor.request_elastic_resize."""
+        gang = body["gang"]
+        wid = gang if gang.startswith("train:") else f"train:{gang}"
+        wl = self.arbiter.get(wid)
+        if wl is None or wl.kind != "train":
+            known = sorted(w.wid for w in self.arbiter._workloads.values()
+                           if w.kind == "train")
+            return {"ok": False, "error": {
+                "code": "UNKNOWN_GANG",
+                "message": f"no train gang {gang!r} is registered with "
+                           f"the arbiter (known: {known})"}}
+        if not self._gang_elastic.get(wid, True):
+            return {"ok": False, "error": {
+                "code": "NOT_ELASTIC",
+                "message": f"gang {gang!r} was not started with "
+                           f"ScalingConfig(elastic=True); only elastic "
+                           f"gangs can be resized in place"}}
+        target = int(body["target"])
+        if target < wl.min_units:
+            return {"ok": False, "error": {
+                "code": "BELOW_QUORUM",
+                "message": f"target {target} is below the gang's "
+                           f"elastic_min_workers floor "
+                           f"({wl.min_units})"}}
+        if wl.max_units is not None and target > wl.max_units:
+            return {"ok": False, "error": {
+                "code": "ABOVE_CAPACITY",
+                "message": f"target {target} exceeds the gang's "
+                           f"placement-group capacity "
+                           f"({wl.max_units})"}}
+        self.arbiter.set_directive(wid, target)
+        self._record_event(
+            "INFO", "GANG_RESIZE_REQUESTED",
+            f"{wid}: operator/broker directive -> {target} workers")
+        return {"ok": True, "wid": wid, "target": target}
+
+    async def rpc_release_bundles(self, conn, body):
+        """Elastic shrink support: hand named PG bundle indices back to
+        their nodes so the freed CPU really returns to the cluster pool
+        (a shrunk gang must not keep its old reservation pinned)."""
+        pg = self.placement_groups.get(body["pg_id"])
+        if pg is None:
+            return {"ok": False, "reason": "no such placement group"}
+        released = []
+        for bundle_index in body["indices"]:
+            if bundle_index in pg.released_bundles \
+                    or bundle_index >= len(pg.bundle_nodes):
+                continue
+            node = self.nodes.get(pg.bundle_nodes[bundle_index])
+            if node is not None and node.alive and node.conn is not None:
+                try:
+                    await node.conn.request("return_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": bundle_index})
+                except Exception:
+                    pass
+            pg.released_bundles.add(bundle_index)
+            released.append(bundle_index)
+        return {"ok": True, "released": released}
+
+    async def rpc_reacquire_bundles(self, conn, body):
+        """Elastic grow support: re-reserve previously released bundle
+        indices through the same two-phase prepare/commit used at PG
+        creation.  Failure (capacity taken by another tenant) is a
+        clean refusal — the caller retries on a later grant."""
+        pg = self.placement_groups.get(body["pg_id"])
+        if pg is None:
+            return {"ok": False, "reason": "no such placement group"}
+        reacquired, failed = [], []
+        for bundle_index in body["indices"]:
+            if bundle_index not in pg.released_bundles:
+                continue
+            node = self.nodes.get(pg.bundle_nodes[bundle_index])
+            ok = False
+            if node is not None and node.alive and node.conn is not None:
+                try:
+                    r = await node.conn.request("prepare_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": bundle_index,
+                        "resources": pg.bundles[bundle_index]})
+                    if r.get("ok"):
+                        await node.conn.request("commit_bundle", {
+                            "pg_id": pg.pg_id,
+                            "bundle_index": bundle_index})
+                        ok = True
+                except Exception:
+                    ok = False
+            if ok:
+                pg.released_bundles.discard(bundle_index)
+                reacquired.append(bundle_index)
+            else:
+                failed.append(bundle_index)
+        return {"ok": not failed, "reacquired": reacquired,
+                "failed": failed}
+
     # -------------------------------------------------------------- metrics
     def _ensure_metrics(self):
         """GCS control-plane gauges/counters on the shared
@@ -1439,9 +1689,28 @@ class GcsServer:
                 "seconds since the last durable snapshot write"),
             "snapshot_bytes": Gauge(
                 "gcs_snapshot_bytes", "size of the last snapshot blob"),
+            "autopilot_grants": Counter(
+                "autopilot_grants_total",
+                "arbiter decisions that raised a workload budget"),
+            "autopilot_revocations": Counter(
+                "autopilot_revocations_total",
+                "arbiter decisions that lowered a workload budget"),
+            "autopilot_breach": Counter(
+                "autopilot_slo_breach_seconds",
+                "cumulative seconds any serve workload spent over its "
+                "declared p99 TTFT SLO"),
+            "autopilot_budget": Gauge(
+                "autopilot_budget_units",
+                "current arbiter-granted budget per workload"),
+            "autopilot_workloads": Gauge(
+                "autopilot_workloads",
+                "workloads registered with the arbiter"),
         }
         # Counters exported as monotonic totals: remember last values.
-        self._metric_last = {"dropped": 0, "events_dropped": 0}
+        self._metric_last = {"dropped": 0, "events_dropped": 0,
+                             "autopilot_grants": 0,
+                             "autopilot_revocations": 0,
+                             "autopilot_breach": 0.0}
         return self._metrics
 
     def _update_metrics(self):
@@ -1467,6 +1736,17 @@ class GcsServer:
         export_counter("dropped", m["dropped"], st["dropped"])
         export_counter("events_dropped", m["events_dropped"],
                        self.events_dropped)
+        export_counter("autopilot_grants", m["autopilot_grants"],
+                       self.arbiter.grants_total)
+        export_counter("autopilot_revocations",
+                       m["autopilot_revocations"],
+                       self.arbiter.revocations_total)
+        export_counter("autopilot_breach", m["autopilot_breach"],
+                       self.arbiter.slo_breach_seconds)
+        m["autopilot_workloads"].set(len(self.arbiter._workloads))
+        for wl in self.arbiter._workloads.values():
+            m["autopilot_budget"].set(
+                wl.granted, tags={"workload": wl.wid, "kind": wl.kind})
         m["pending_actors"].set(len(self._pending_actor_creations))
         if self._last_snapshot_ts is not None:
             m["snapshot_age"].set(

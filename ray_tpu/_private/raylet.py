@@ -466,11 +466,18 @@ class Raylet:
                     view["load"] = msg["load"]
                 if "draining" in msg:
                     view["draining"] = msg["draining"]
+                if "reserved" in msg:
+                    view["reserved"] = msg["reserved"]
             if nid != self.node_id:
+                from ray_tpu._private import sched_policy
                 self.sched.index.update(
                     nid, available=msg.get("available"),
                     load=msg.get("load"),
-                    draining=msg.get("draining"))
+                    draining=msg.get("draining"),
+                    # None clears a reservation, so absent-vs-None must
+                    # survive the hop: forward the sentinel when the
+                    # delta didn't carry the field.
+                    reserved=msg.get("reserved", sched_policy._UNSET))
 
     def _respill_pending(self, new_node_view):
         """A node joined: queued requests this node can NEVER satisfy but

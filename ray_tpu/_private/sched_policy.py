@@ -56,6 +56,9 @@ def fits(pool: dict, shape: tuple) -> bool:
     return all(pool.get(k, 0) >= v for k, v in shape)
 
 
+_UNSET = object()  # "field not present in this delta" marker
+
+
 def hybrid_score(entry: "NodeEntry", shape_map: dict) -> float:
     """Critical-resource utilization after placing the request, plus a
     small backlog tiebreak — identical arithmetic to the legacy
@@ -74,7 +77,7 @@ def hybrid_score(entry: "NodeEntry", shape_map: dict) -> float:
 
 class NodeEntry:
     __slots__ = ("node_id", "addr", "total", "avail", "load",
-                 "draining", "seq", "ver")
+                 "draining", "reserved", "seq", "ver")
 
     def __init__(self, node_id, seq):
         self.node_id = node_id
@@ -83,6 +86,10 @@ class NodeEntry:
         self.avail: dict = {}
         self.load = 0
         self.draining = False
+        # Autopilot reservation (beneficiary workload id or None): a
+        # reserved node drains its current leases instead of taking new
+        # ones — same treatment as draining in every filter/index path.
+        self.reserved = None
         self.seq = seq   # registration order (legacy iteration order)
         self.ver = 0     # bumped on every state change
 
@@ -97,6 +104,10 @@ def not_excluded(ctx, e):
 
 def not_draining(ctx, e):
     return not e.draining
+
+
+def not_reserved(ctx, e):
+    return e.reserved is None
 
 
 def fits_total(ctx, e):
@@ -143,15 +154,16 @@ class ScanPolicy:
 
 
 HYBRID_POLICY = ScanPolicy(
-    (not_excluded, not_draining, fits_avail),
+    (not_excluded, not_draining, not_reserved, fits_avail),
     scorer=hybrid_score)
 SPREAD_POLICY = ScanPolicy(
-    (not_excluded, not_draining, fits_avail),
+    (not_excluded, not_draining, not_reserved, fits_avail),
     scorer=lambda e, shape_map: e.load)
 # Legacy spillback admitted any total-fitting node; the chain adds the
 # dead/draining skip (the raylet's index never holds dead nodes) and
 # selection is rotated by SchedulingPolicies.pick_spillback below.
-SPILLBACK_FILTERS = (not_excluded, not_draining, fits_total)
+SPILLBACK_FILTERS = (not_excluded, not_draining, not_reserved,
+                     fits_total)
 
 
 class _ShapeIndex:
@@ -205,11 +217,13 @@ class ClusterIndex:
         e.avail = dict(view.get("available") or e.total)
         e.load = view.get("load", 0)
         e.draining = bool(view.get("draining", False))
+        e.reserved = view.get("reserved")
         self._ver += 1
         e.ver = self._ver
         self._reindex(e, membership=True)
 
-    def update(self, nid, available=None, load=None, draining=None):
+    def update(self, nid, available=None, load=None, draining=None,
+               reserved=_UNSET):
         """Heartbeat-delta update: only what changed travels."""
         e = self.nodes.get(nid)
         if e is None:
@@ -220,6 +234,10 @@ class ClusterIndex:
             e.load = load
         if draining is not None:
             e.draining = bool(draining)
+        if reserved is not _UNSET:
+            # None is a meaningful value here (reservation cleared), so
+            # the no-change default is the module sentinel.
+            e.reserved = reserved
         self._ver += 1
         e.ver = self._ver
         self._reindex(e, membership=False)
@@ -253,7 +271,8 @@ class ClusterIndex:
                     si._order = None
             elif si.total_fits.pop(e.node_id, None) is not None:
                 si._order = None
-        if not e.draining and fits(e.avail, si.shape):
+        if not e.draining and e.reserved is None \
+                and fits(e.avail, si.shape):
             # ver (globally unique) breaks (score, seq) ties so the
             # comparison never reaches the node-id payload.
             heapq.heappush(si.hyb, (hybrid_score(e, si.shape_map),
@@ -269,11 +288,13 @@ class ClusterIndex:
         self.stats["rebuilds"] += 1
         si.hyb = [(hybrid_score(e, si.shape_map), e.seq, e.ver, e.node_id)
                   for e in self.nodes.values()
-                  if not e.draining and fits(e.avail, si.shape)]
+                  if not e.draining and e.reserved is None
+                  and fits(e.avail, si.shape)]
         heapq.heapify(si.hyb)
         si.spr = [(e.load, e.seq, e.ver, e.node_id)
                   for e in self.nodes.values()
-                  if not e.draining and fits(e.avail, si.shape)]
+                  if not e.draining and e.reserved is None
+                  and fits(e.avail, si.shape)]
         heapq.heapify(si.spr)
 
     def shape_index(self, resources) -> _ShapeIndex:
@@ -342,7 +363,8 @@ class ClusterIndex:
             nid = order[(start + i) % n]
             e = self.nodes.get(nid)
             self.stats["scanned"] += 1
-            if e is None or e.node_id == exclude or e.draining:
+            if e is None or e.node_id == exclude or e.draining \
+                    or e.reserved is not None:
                 continue
             if fallback is None:
                 fallback = (e, i)

@@ -547,6 +547,12 @@ class CoreWorker:
         """Run coro on the loop from a non-loop thread and wait."""
         return self._call(coro).result(timeout)
 
+    def gcs_call(self, method, body, timeout=None):
+        """Synchronous GCS RPC from any non-loop thread (serve
+        controller executor threads, train gang agents, the CLI) —
+        the same bounded-reconnect path as _gcs_request."""
+        return self._run(self._gcs_request(method, body), timeout)
+
     def shutdown(self):
         if self._shutdown:
             return

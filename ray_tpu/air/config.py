@@ -38,6 +38,13 @@ class ScalingConfig:
     # FailureConfig.max_failures — that budget counts cold restarts.
     elastic: bool = False
     elastic_min_workers: Optional[int] = None
+    # Cluster-autopilot declaration (_private/arbiter.py): the gang
+    # registers with the GCS broker under ``train:<name>`` (a random
+    # name when unset — set one to target it with `rt resize`), and
+    # ``priority`` orders it against other gangs when a serve SLO
+    # breach forces a reclaim (lowest priority shrinks first).
+    name: Optional[str] = None
+    priority: int = 50
 
     @property
     def _resources(self) -> Dict[str, float]:

@@ -24,6 +24,7 @@ the ordinary scheduling policy chain in the raylet.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Callable, Iterable, Iterator, List, Optional
 
@@ -174,16 +175,24 @@ class MapOperator:
                  budget_bytes: Optional[int] = None,
                  parallelism: Optional[int] = None,
                  locality: bool = True,
-                 n_blocks_hint: Optional[int] = None):
+                 n_blocks_hint: Optional[int] = None,
+                 lease=None):
         self.fused_fn = fused_fn
         self.name = name
         self.budget = budget_bytes or cfg.data_op_budget_bytes
         self.parallelism = parallelism
         self.locality = locality
         self.n_blocks_hint = n_blocks_hint
+        # Revocable autopilot soak lease (arbiter.DataLease): admission
+        # is additionally bounded by lease.allowed() each round, so a
+        # broker revocation stops NEW task launches immediately while
+        # the in-flight window drains within the grace period — the
+        # clean-backpressure half of the revocable-lease contract.
+        self.lease = lease
 
     def iter_outputs(self, upstream: Iterable[BlockHandle]
                      ) -> Iterator[BlockHandle]:
+        from ray_tpu._private import arbiter as _arbiter
         task = ray_tpu.remote(_apply_fused)
         src = iter(upstream)
         in_flight: deque = deque()  # [handle(out_ref), est_bytes]
@@ -194,6 +203,7 @@ class MapOperator:
         window = self.parallelism or auto_parallelism(
             self.n_blocks_hint or 8)
         exhausted = False
+        lease = self.lease or _arbiter.ambient_data_lease()
 
         def _queued():
             return sum(e for _, e in in_flight)
@@ -201,7 +211,10 @@ class MapOperator:
         try:
             while True:
                 budget_blocked = False
-                while not exhausted and len(in_flight) < window:
+                cap = window
+                if lease is not None:
+                    cap = min(window, max(lease.allowed(), 0))
+                while not exhausted and len(in_flight) < cap:
                     if in_flight and _queued() >= self.budget:
                         budget_blocked = True
                         break
@@ -216,7 +229,17 @@ class MapOperator:
                         if opts else task.remote(self.fused_fn, h.ref)
                     est = h.size or est_avg or (1 << 20)
                     in_flight.append([BlockHandle(out), est])
+                    if lease is not None:
+                        lease.note_launched()
                 if not in_flight:
+                    if not exhausted and lease is not None and cap <= 0:
+                        # Lease revoked to zero with nothing in flight:
+                        # hold admission (clean backpressure) and poll
+                        # for a re-grant instead of finishing early.
+                        BP_STALLS.inc(1)
+                        queued_gauge.set(0.0)
+                        time.sleep(0.05)
+                        continue
                     queued_gauge.set(0.0)
                     return
                 if budget_blocked:
@@ -224,6 +247,8 @@ class MapOperator:
                 head, est = in_flight[0]
                 resolve_handle(head)
                 in_flight.popleft()
+                if lease is not None:
+                    lease.note_finished()
                 if head.size:
                     est_avg = (head.size if est_avg is None
                                else 0.5 * (est_avg + head.size))
@@ -262,7 +287,8 @@ class ShuffleOperator:
 
 
 def build_plan(stages, *, budget_bytes=None, parallelism=None,
-               locality: bool = True, n_blocks_hint=None) -> List:
+               locality: bool = True, n_blocks_hint=None,
+               lease=None) -> List:
     """Compile a Dataset stage list into the physical operator chain."""
     from ray_tpu.data.dataset import Dataset
     plan: List = []
@@ -274,7 +300,8 @@ def build_plan(stages, *, budget_bytes=None, parallelism=None,
                                     budget_bytes=budget_bytes,
                                     parallelism=parallelism,
                                     locality=locality,
-                                    n_blocks_hint=n_blocks_hint))
+                                    n_blocks_hint=n_blocks_hint,
+                                    lease=lease))
         else:
             plan.append(ShuffleOperator(seg,
                                         budget_bytes=budget_bytes,

@@ -42,12 +42,17 @@ class StreamingExecutor:
     def __init__(self, block_refs: List, stages, *,
                  parallelism: Optional[int] = None,
                  budget_bytes: Optional[int] = None,
-                 locality: bool = True):
+                 locality: bool = True,
+                 lease=None):
         self._refs = list(block_refs)
+        # ``lease``: an arbiter.DataLease bounding concurrent task
+        # admission (revocable soak capacity).  None falls back to the
+        # process-ambient lease, if one is installed.
         self._plan = build_plan(stages, budget_bytes=budget_bytes,
                                 parallelism=parallelism,
                                 locality=locality,
-                                n_blocks_hint=len(self._refs))
+                                n_blocks_hint=len(self._refs),
+                                lease=lease)
 
     def iter_handles(self) -> Iterator[BlockHandle]:
         """Compose the operator chain; yields final-stage handles."""

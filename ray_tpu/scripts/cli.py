@@ -20,6 +20,11 @@ Commands:
   job submit -- <entrypoint>  supervised job; streams status
   job logs <submission_id>
   job stop <submission_id>
+  resize <gang> <n>           elastic gang resize via the autopilot
+                              broker (structured errors when the gang
+                              is unknown / not elastic / below quorum)
+  autopilot                   broker workload table: grants, SLO
+                              breach state, reserved nodes
   dashboard [--port N]        start the dashboard head, print its URL
   lint <paths>                static distributed-correctness linter
 """
@@ -467,6 +472,48 @@ def cmd_serve(args):
         print(json.dumps(serve_mod.status(), indent=2, default=str))
 
 
+def cmd_resize(args):
+    """Elastic gang resize from the CLI: routes through the GCS broker
+    (rpc_resize_gang), which validates elasticity/quorum/capacity and
+    hands the target to the gang's autopilot agent as a directive.  The
+    driver-side Trainer keeps running — the gang re-forms in place."""
+    from ray_tpu._private.worker import global_worker
+    _connect(args.address)
+    reply = global_worker.gcs_call(
+        "resize_gang", {"gang": args.gang, "target": args.target},
+        timeout=10)
+    if isinstance(reply, dict) and reply.get("ok"):
+        print(f"resize accepted: gang {reply.get('gang', args.gang)!r} "
+              f"-> {args.target} workers (applied by the gang's "
+              "autopilot agent at its next report)")
+        return
+    err = (reply or {}).get("error", {})
+    code = err.get("code", "ERROR")
+    msg = err.get("message", json.dumps(reply, default=str))
+    print(f"resize rejected [{code}]: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def cmd_autopilot(args):
+    """Broker introspection: registered workloads, grants, SLO state,
+    and reserved nodes (rpc_arbiter_status)."""
+    from ray_tpu._private.worker import global_worker
+    _connect(args.address)
+    reply = global_worker.gcs_call("arbiter_status", {}, timeout=10)
+    if args.format == "json":
+        print(json.dumps(reply, indent=2, default=str))
+        return
+    wls = (reply or {}).get("workloads", [])
+    rows = [{"wid": w.get("wid"), "kind": w.get("kind"),
+             "prio": w.get("priority"), "min": w.get("min_units"),
+             "want": w.get("want"), "granted": w.get("granted"),
+             "now": w.get("units_now"),
+             "breached": w.get("breached", False)} for w in wls]
+    print(f"capacity: {reply.get('capacity')} units, "
+          f"reserved nodes: {len(reply.get('reserved_nodes', {}))}")
+    _print_rows(rows)
+
+
 def cmd_dashboard(args):
     import time
 
@@ -576,6 +623,21 @@ def main(argv=None):
     jst.add_argument("submission_id")
     jsub.add_parser("list")
     jp.set_defaults(fn=cmd_job)
+
+    rz = sub.add_parser(
+        "resize", help="resize an elastic train gang via the autopilot "
+        "broker (structured errors: UNKNOWN_GANG, NOT_ELASTIC, "
+        "BELOW_QUORUM, ABOVE_CAPACITY)")
+    rz.add_argument("gang", help="gang name (ScalingConfig.name)")
+    rz.add_argument("target", type=int, help="target worker count")
+    rz.set_defaults(fn=cmd_resize)
+
+    ap = sub.add_parser(
+        "autopilot", help="show the autopilot broker's workload table "
+        "(grants, SLO breach state, reserved nodes)")
+    ap.add_argument("--format", choices=["table", "json"],
+                    default="table")
+    ap.set_defaults(fn=cmd_autopilot)
 
     dp = sub.add_parser("dashboard")
     dp.add_argument("--port", type=int, default=0)

@@ -16,6 +16,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import locksan
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
 from ray_tpu.serve._private.deployment_state import (
     DeploymentStateManager, RUNNING)
@@ -24,7 +25,6 @@ from ray_tpu.serve._private.long_poll import LongPollHost
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
-CONTROL_LOOP_PERIOD_S = 0.1
 
 
 class _AutoscaleState:
@@ -33,6 +33,13 @@ class _AutoscaleState:
         self.under_since: Optional[float] = None
         self.ewma: Optional[float] = None
         self.last_decision_t: float = -1e18
+        # Cluster-autopilot coupling (deployments declaring a TTFT
+        # SLO): last broker-granted replica budget and when we last
+        # reported attainment.  The grant survives a GCS blip — the
+        # controller keeps honoring the last known budget rather than
+        # scaling blind.
+        self.granted: Optional[int] = None
+        self.last_report_t: float = -1e18
 
 
 def _replica_load(metrics: Dict, target_per_replica: float) -> float:
@@ -92,6 +99,15 @@ class ServeController:
         def _do():
             with self._dsm_lock:
                 self._dsm.delete(name)
+            try:
+                from ray_tpu._private.worker import global_worker
+                global_worker.gcs_call(
+                    "arbiter_unregister", {"wid": f"serve:{name}"},
+                    timeout=5)
+            except Exception:
+                # Broker unreachable / never registered: the arbiter's
+                # stale-report TTL reclaims the budget regardless.
+                pass
 
         # The reconcile tick can hold the lock for seconds (blocking gets
         # on hung replicas) — never acquire it on the event loop.
@@ -137,7 +153,7 @@ class ServeController:
             if any(statuses.get(n, {}).get("status") == "DEPLOY_FAILED"
                    for n in names):
                 return False
-            await asyncio.sleep(0.1)
+            await asyncio.sleep(cfg.serve_health_poll_period_s)
         return False
 
     async def get_http_config(self) -> Dict:
@@ -167,7 +183,7 @@ class ServeController:
         while time.monotonic() < deadline:
             if await loop.run_in_executor(None, _tick):
                 break
-            await asyncio.sleep(0.1)
+            await asyncio.sleep(cfg.serve_health_poll_period_s)
         return True
 
     # ----------------------------------------------------- control loop
@@ -191,7 +207,7 @@ class ServeController:
                 await loop.run_in_executor(None, _tick)
             except Exception:
                 logger.exception("control loop tick failed")
-            await asyncio.sleep(CONTROL_LOOP_PERIOD_S)
+            await asyncio.sleep(cfg.serve_control_loop_period_s)
 
     def _autoscale_tick(self):
         """Scale targets from the replicas' REAL saturation gauges
@@ -214,6 +230,7 @@ class ServeController:
                 continue
             total_load = 0.0
             samples = 0
+            ttft_p99 = None
             for r in running:
                 m = r.poll_load(now)  # non-blocking, cached
                 if m is None:
@@ -221,6 +238,11 @@ class ServeController:
                 samples += 1
                 total_load += _replica_load(
                     m, ac.target_num_ongoing_requests_per_replica)
+                t = m.get("ttft_p99_s")
+                if t is not None:
+                    # Worst replica's p99 TTFT is the deployment's SLO
+                    # attainment signal for the autopilot broker.
+                    ttft_p99 = max(ttft_p99 or 0.0, float(t))
             if samples == 0:
                 continue  # no gauge data yet; never scale blind
             st = self._autoscale.setdefault(name, _AutoscaleState())
@@ -231,6 +253,9 @@ class ServeController:
                 st.ewma = alpha * total_load + (1 - alpha) * st.ewma
             desired = math.ceil(st.ewma * ac.smoothing_factor)
             desired = min(max(desired, ac.min_replicas), ac.max_replicas)
+            if getattr(ac, "slo_ttft_p99_s", None) is not None:
+                desired = self._arbiter_cap(name, ac, desired,
+                                            len(running), ttft_p99, now)
             cur = ds.target_num_replicas
             in_cooldown = (now - st.last_decision_t
                            < ac.decision_cooldown_s)
@@ -258,3 +283,37 @@ class ServeController:
                     st.last_decision_t = now
             else:
                 st.over_since = st.under_since = None
+
+    def _arbiter_cap(self, name: str, ac, desired: int, running: int,
+                     ttft_p99: Optional[float], now: float) -> int:
+        """Autopilot coupling for SLO-declaring deployments: report
+        demand + p99 TTFT attainment to the GCS broker (one RPC per
+        autopilot_report_period_s — the report doubles as the grant
+        fetch) and cap the scale target at the granted budget, never
+        below min_replicas.  Runs on the executor tick thread, so the
+        blocking RPC never touches the controller's event loop."""
+        st = self._autoscale.setdefault(name, _AutoscaleState())
+        if now - st.last_report_t >= cfg.autopilot_report_period_s:
+            st.last_report_t = now
+            signals = {}
+            if ttft_p99 is not None:
+                signals["ttft_p99_s"] = ttft_p99
+            try:
+                from ray_tpu._private.worker import global_worker
+                reply = global_worker.gcs_call("arbiter_report", {
+                    "wid": f"serve:{name}", "want": desired,
+                    "units_now": running, "signals": signals,
+                    "decl": {"kind": "serve",
+                             "priority": getattr(ac, "priority", 100),
+                             "min_units": ac.min_replicas,
+                             "max_units": ac.max_replicas,
+                             "slo": ac.slo_ttft_p99_s}}, timeout=5)
+                if isinstance(reply, dict) and reply.get("ok"):
+                    st.granted = int(reply.get("granted", desired))
+            except Exception:
+                # GCS blip: keep honoring the last known grant rather
+                # than scaling blind past the broker's budget.
+                pass
+        if st.granted is not None:
+            desired = max(min(desired, st.granted), ac.min_replicas)
+        return desired

@@ -30,6 +30,15 @@ class AutoscalingConfig:
     smoothing_factor: float = 1.0
     decision_cooldown_s: float = 0.0
     load_ewma_alpha: float = 1.0
+    # Cluster-autopilot declaration (_private/arbiter.py): when
+    # slo_ttft_p99_s is set, the controller registers this deployment
+    # with the GCS broker, reports its p99 TTFT attainment every tick,
+    # and caps scale-ups at the broker's granted budget.  A sustained
+    # breach lets the broker reclaim capacity from lower-priority
+    # workloads (elastic train gangs shrink, data leases revoke) to
+    # honor the SLO.
+    slo_ttft_p99_s: Optional[float] = None
+    priority: int = 100
 
 
 @dataclass

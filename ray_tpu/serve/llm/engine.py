@@ -547,6 +547,10 @@ class GenerationEngine:
         self._spec_accepted = 0
         self._win_t = time.monotonic()
         self._win_tokens = 0
+        # Recent per-request TTFT samples (bounded ring, worker thread
+        # appends) backing the ttft_p99_s gauge in load_info — the SLO
+        # attainment signal the autopilot broker arbitrates on.
+        self._recent_ttft = collections.deque(maxlen=256)
 
         self._tags = {"engine": name}
         QUEUE_GAUGE.set(0, tags=self._tags)
@@ -693,12 +697,19 @@ class GenerationEngine:
         """The autoscaler's saturation gauges, as plain field reads —
         polled every control-loop tick, so no EngineStats construction
         and no rate-window math on this path."""
-        return {"queue_depth": self._scheduler.depth
+        info = {"queue_depth": self._scheduler.depth
                 + (1 if self._prefill is not None else 0),
                 "active_slots": sum(r is not None for r in self._slots),
                 "num_slots": self.num_slots,
                 "kv_blocks_total": self.kv_pages,
                 "kv_blocks_free": self._alloc.free_pages}
+        if self._recent_ttft:
+            # p99 over the recent ring (snapshot first: the worker
+            # thread appends concurrently).
+            samples = sorted(self._recent_ttft)
+            info["ttft_p99_s"] = samples[
+                min(len(samples) - 1, int(len(samples) * 0.99))]
+        return info
 
     def stats(self) -> EngineStats:
         now = time.monotonic()
@@ -1094,6 +1105,7 @@ class GenerationEngine:
         if req.first_token_t is None:
             req.first_token_t = now
             TTFT_HISTOGRAM.observe(now - req.submit_t, tags=self._tags)
+            self._recent_ttft.append(now - req.submit_t)
         else:
             ITL_HISTOGRAM.observe(now - req.last_token_t,
                                   tags=self._tags)

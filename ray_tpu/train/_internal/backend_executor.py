@@ -108,6 +108,30 @@ class BackendExecutor:
         self._joiners: List[Tuple[str, object, int]] = []
         self._resize_target: Optional[int] = None
         self._train_args: Optional[tuple] = None
+        # PG bundle indices handed back to the cluster by an elastic
+        # shrink; a later grow re-reserves them (two-phase, via GCS)
+        # before spawning joiners into them.
+        self._released_bundles: set = set()
+        # Cluster-autopilot registration (one gang == one broker
+        # workload): a daemon agent reports size/demand every
+        # autopilot_report_period_s and applies broker-initiated
+        # resize grants through request_elastic_resize — the same
+        # entry point the driver and `rt resize` use.
+        gname = getattr(scaling_config, "name", None) \
+            or f"gang-{os.urandom(3).hex()}"
+        self._gang_name = gname
+        self._autopilot_wid = f"train:{gname}"
+        self._autopilot_thread = None
+        self._autopilot_stop = None
+        # True while the broker (not a member death) shrank us: only
+        # then does a restored grant auto-grow the gang back — a death
+        # never triggers a surprise self-heal grow.
+        self._broker_shrunk = False
+        # An explicit operator directive (rt resize) pins the reported
+        # demand at its target; otherwise the grow-back logic would
+        # treat the broker's still-full grant as a signal to undo the
+        # operator's shrink on the very next report.
+        self._want_override: Optional[int] = None
 
     _placement_group = None
 
@@ -131,9 +155,30 @@ class BackendExecutor:
             self.worker_group = None
         self._start_workers()
 
+    # ------------------------------------------------------ gcs helpers
+    def _pg_id(self):
+        return getattr(self._placement_group, "id", None)
+
+    @staticmethod
+    def _gcs(method: str, body: dict):
+        from ray_tpu._private.worker import global_worker
+        return global_worker.gcs_call(method, body)
+
     def _start_workers(self):
         from ray_tpu.train import elastic as _elastic
         sc = self.scaling_config
+        if self._released_bundles and self._pg_id() is not None:
+            # Cold restart after a shrink: the full-size gang respawns
+            # into bundles 0..N-1, so released ones must be re-reserved
+            # first (best effort — a failed reacquire surfaces as the
+            # restart's own placement failure).
+            try:
+                self._gcs("reacquire_bundles", {
+                    "pg_id": self._pg_id(),
+                    "indices": sorted(self._released_bundles)})
+            except Exception:
+                pass
+            self._released_bundles.clear()
         self._destroy_collective_group()
         _elastic.kill_elastic_coordinator(self._elastic_coord_name)
         self._elastic_coord = self._elastic_coord_name = None
@@ -141,6 +186,7 @@ class BackendExecutor:
         self._pending = None
         self._joiners = []
         self._resize_target = None
+        self._want_override = None
         self.worker_group = WorkerGroup(
             sc.num_workers, sc._resources, self._placement_group)
         # A gang-wide host collective group for data-parallel gradient
@@ -229,6 +275,98 @@ class BackendExecutor:
             if _is_worker_death(e):
                 raise TrainingWorkerError(str(e)) from e
             raise
+        self._start_autopilot_agent()
+
+    # ------------------------------------------------- autopilot agent
+    def _autopilot_decl(self, live: int) -> dict:
+        sc = self.scaling_config
+        return {"kind": "train",
+                "priority": int(getattr(sc, "priority", 50)),
+                "min_units": self._quorum() if self._elastic else live,
+                "max_units": (self.worker_group.capacity
+                              if self.worker_group is not None
+                              else sc.num_workers),
+                "elastic": self._elastic}
+
+    def _start_autopilot_agent(self):
+        import threading
+        if self._autopilot_thread is not None:
+            return
+        self._autopilot_stop = threading.Event()
+        self._autopilot_thread = threading.Thread(
+            target=self._autopilot_agent_loop, daemon=True,
+            name=f"rt-gang-agent-{self._gang_name}")
+        self._autopilot_thread.start()
+
+    def _autopilot_agent_loop(self):
+        """Report the gang to the GCS broker and apply its resize
+        grants.  Trains always *want* their full declared size back, so
+        a grant moving away from the live size is the broker speaking:
+        below live = reclaim (shrink through the re-form path), back
+        above live = the spike drained (grow, but ONLY when the broker
+        itself did the shrinking — a member death never triggers a
+        surprise self-heal grow from here).  Explicit `rt resize`
+        directives ride the same reply and always apply."""
+        stop = self._autopilot_stop
+        while not stop.wait(cfg.autopilot_report_period_s):
+            try:
+                wg = self.worker_group
+                if wg is None or not wg.workers:
+                    continue
+                live = len(wg.workers)
+                want = (self._want_override
+                        if self._want_override is not None
+                        else wg.capacity)
+                reply = self._gcs("arbiter_report", {
+                    "wid": self._autopilot_wid,
+                    "want": want, "units_now": live,
+                    "decl": self._autopilot_decl(live)})
+                if not isinstance(reply, dict) or not reply.get("ok"):
+                    continue
+                target = reply.get("directive")
+                from_directive = target is not None
+                if target is None and self._elastic:
+                    granted = int(reply.get("granted", live))
+                    if granted < live:
+                        target = granted
+                    elif granted > live and self._broker_shrunk:
+                        target = min(granted, wg.capacity)
+                if target is None:
+                    continue
+                target = int(target)
+                if (not self._elastic or target == live
+                        or self._train_args is None
+                        or self._resize_target is not None
+                        or target < self._quorum()
+                        or target > wg.capacity):
+                    continue
+                self.request_elastic_resize(target)
+                if from_directive:
+                    self._want_override = (target
+                                           if target < wg.capacity
+                                           else None)
+                else:
+                    # Still below full declared size => the broker owns
+                    # the deficit and a later grant may grow us further.
+                    # (`target < live` would clear the flag on a PARTIAL
+                    # grow — e.g. 2 -> 3 of 4 while serve releases nodes
+                    # one cooldown at a time — stranding the gang below
+                    # capacity with no one willing to grow it.)
+                    self._broker_shrunk = target < wg.capacity
+            except Exception:
+                logger.debug("autopilot gang agent iteration failed",
+                             exc_info=True)
+
+    def _stop_autopilot_agent(self):
+        if self._autopilot_stop is not None:
+            self._autopilot_stop.set()
+        if self._autopilot_thread is not None:
+            self._autopilot_thread.join(timeout=2.0)
+            self._autopilot_thread = None
+        try:
+            self._gcs("arbiter_unregister", {"wid": self._autopilot_wid})
+        except Exception:
+            pass
 
     # ------------------------------------------------------- result pump
     def _get_refs(self, refs, deadline):
@@ -312,13 +450,21 @@ class BackendExecutor:
 
     # --------------------------------------------------- elastic re-form
     def request_elastic_resize(self, target_world_size: int):
-        """Grow the gang to ``target_world_size`` in place (an
-        autoscaler grant): spawn joiners into free placement-group
-        bundles, then break the current incarnation so survivors and
-        joiners rendezvous the new world size together.  Joiners
-        receive the authoritative state over the collective plane like
-        any recovering member.  Thread-safe against a pump blocked in
-        get_next_results."""
+        """Resize the gang to ``target_world_size`` in place.  The
+        driver, `rt resize <gang> <n>`, and the autopilot broker all
+        land here.
+
+        Grow: spawn joiners into free placement-group bundles
+        (re-reserving any a previous shrink released), then break the
+        current incarnation so survivors and joiners rendezvous the new
+        world size together; joiners receive the authoritative state
+        over the collective plane like any recovering member.
+
+        Shrink: mark the target and break the incarnation — the re-form
+        path retires the highest ranks (clean StopIteration exit, no
+        failure budget consumed), kills their actors, and releases
+        their bundles so the freed nodes really return to the cluster.
+        Thread-safe against a pump blocked in get_next_results."""
         if not self._elastic:
             raise RuntimeError("elastic resize requires "
                                "ScalingConfig(elastic=True)")
@@ -326,10 +472,20 @@ class BackendExecutor:
         if wg is None or self._train_args is None:
             raise RuntimeError("no running gang to resize")
         live = len(wg.workers)
-        if target_world_size <= live:
-            raise ValueError(
-                f"target world size {target_world_size} <= current "
-                f"{live} (scale-down happens by draining members)")
+        target_world_size = int(target_world_size)
+        if target_world_size == live:
+            raise ValueError(f"gang is already at world size {live}")
+        if target_world_size < live:
+            if target_world_size < self._quorum():
+                raise ValueError(
+                    f"target world size {target_world_size} is below "
+                    f"the elastic quorum floor {self._quorum()}")
+            self._resize_target = target_world_size
+            if self._collective_group is not None:
+                from ray_tpu.util import collective as col
+                col.abort_collective_group(self._collective_group,
+                                           "elastic shrink")
+            return
         free = [i for i in range(wg.capacity)
                 if i not in wg.bundle_indices]
         need = target_world_size - live
@@ -338,24 +494,66 @@ class BackendExecutor:
                 f"resize to {target_world_size} needs {need} bundles "
                 f"but only {len(free)} are free (gang capacity "
                 f"{wg.capacity})")
+        reacquire = [i for i in free[:need]
+                     if i in self._released_bundles]
+        if reacquire and self._pg_id() is not None:
+            try:
+                r = self._gcs("reacquire_bundles", {
+                    "pg_id": self._pg_id(), "indices": reacquire})
+            except Exception as e:
+                raise ValueError(
+                    f"cannot grow to {target_world_size}: bundle "
+                    f"re-reservation RPC failed ({e})") from e
+            got = set(r.get("reacquired", ())) if isinstance(r, dict) \
+                else set()
+            self._released_bundles -= got
+            missing = [i for i in reacquire if i not in got]
+            if missing:
+                raise ValueError(
+                    f"cannot grow to {target_world_size}: released "
+                    f"bundles {missing} could not be re-reserved "
+                    f"(capacity taken by another workload; retry on a "
+                    f"later grant)")
         (train_fn, config, checkpoint, trial_name, trial_id,
          mesh_builder) = self._train_args
-        for k in range(need):
-            w = wg._spawn(live + k, free[k], target_world_size)
-            token = "j" + os.urandom(3).hex()
-            env = {"RT_TRAIN_ELASTIC_COORD": self._elastic_coord_name,
-                   "RT_TRAIN_ELASTIC_TOKEN": token,
-                   "RT_TRAIN_ELASTIC_GEN": self._gen,
-                   "RT_TRAIN_WORLD_SIZE": target_world_size,
-                   "RT_TRAIN_WORLD_RANK": live + k,
-                   "RT_TRAIN_LOCAL_RANK": live + k}
-            ray_tpu.get(w.set_env.remote(env), timeout=60)  # noqa: RTL001
-            ray_tpu.get(  # noqa: RTL001
-                w.start_training.remote(train_fn, config, checkpoint,
-                                        trial_name, trial_id,
-                                        mesh_builder, True),
-                timeout=cfg.train_start_timeout_s)
-            self._joiners.append((token, w, free[k]))
+        # The joiner handshake must stay bounded well below the
+        # broker's stale-report window: this path runs on the autopilot
+        # agent thread, and a wedged joiner that blocks it past the
+        # window gets the gang's registration GC'd out from under a
+        # live gang (its budget returns to the pool and data soaks the
+        # slots).  On any failure kill everything spawned this attempt
+        # so the next grant retries from a clean slate.
+        spawned = []
+        try:
+            for k in range(need):
+                w = wg._spawn(live + k, free[k], target_world_size)
+                spawned.append(("j" + os.urandom(3).hex(), w, free[k]))
+                env = {"RT_TRAIN_ELASTIC_COORD":
+                       self._elastic_coord_name,
+                       "RT_TRAIN_ELASTIC_TOKEN": spawned[-1][0],
+                       "RT_TRAIN_ELASTIC_GEN": self._gen,
+                       "RT_TRAIN_WORLD_SIZE": target_world_size,
+                       "RT_TRAIN_WORLD_RANK": live + k,
+                       "RT_TRAIN_LOCAL_RANK": live + k}
+                ray_tpu.get(w.set_env.remote(env),  # noqa: RTL001
+                            timeout=10)
+                ray_tpu.get(  # noqa: RTL001
+                    w.start_training.remote(train_fn, config,
+                                            checkpoint, trial_name,
+                                            trial_id, mesh_builder,
+                                            True),
+                    timeout=min(10.0, cfg.train_start_timeout_s))
+        except Exception as e:
+            for (_, w, _) in spawned:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            raise ValueError(
+                f"cannot grow to {target_world_size}: joiner "
+                f"handshake failed ({e}); retry on a later "
+                f"grant") from e
+        self._joiners.extend(spawned)
         self._resize_target = target_world_size
         # Break the running incarnation: every survivor's next
         # collective op (or parked report, via the worker agents) drops
@@ -444,11 +642,23 @@ class BackendExecutor:
             time.sleep(0.2)
         survivors = sorted(int(r) for r in last)
         joiners = list(self._joiners)
-        new_world = len(survivors) + len(joiners)
         if len(survivors) < self._quorum():
             self._reform_fail(
                 f"{len(survivors)} survivors of {old_world} < quorum "
                 f"{self._quorum()}", err)
+        # Broker/driver shrink: retire the HIGHEST old ranks down to
+        # the requested size (clamped to quorum — a resize directive
+        # can never push the gang below its floor, even racing a
+        # member death that already shrank the survivor set).
+        retired: List[int] = []
+        resize = self._resize_target
+        if resize is not None:
+            want = max(int(resize), self._quorum())
+            if len(survivors) + len(joiners) > want:
+                keep = max(want - len(joiners), 0)
+                retired = survivors[keep:]
+                survivors = survivors[:keep]
+        new_world = len(survivors) + len(joiners)
 
         # Compact new ranks: survivors in old-rank order, then joiners.
         group = f"train_dp_{os.urandom(4).hex()}"
@@ -484,8 +694,10 @@ class BackendExecutor:
         instr = {"gen": gen + 1, "group": group,
                  "world_size": new_world, "ranks": ranks,
                  "joiners": joiner_ranks,
+                 "retired": retired,
                  "dead_ranks": [r for r in range(old_world)
-                                if r not in survivors],
+                                if r not in survivors
+                                and r not in retired],
                  "old_world": old_world}
         try:
             ray_tpu.get(coord.post_reform.remote(instr), timeout=30)
@@ -530,10 +742,33 @@ class BackendExecutor:
         self._resize_target = None
         self._gen = gen + 1
         ELASTIC_RESIZES.inc()
+        if retired:
+            # Retired members exited their loops cleanly
+            # (StopIteration in rejoin); reap the actors and hand
+            # their bundles back so the freed CPU leaves the gang's
+            # reservation and returns to the cluster pool.
+            rel = []
+            for old_rank in retired:
+                try:
+                    ray_tpu.kill(old_workers[old_rank])
+                except Exception:
+                    pass
+                rel.append(old_bundles[old_rank])
+            if self._pg_id() is not None:
+                try:
+                    r = self._gcs("release_bundles", {
+                        "pg_id": self._pg_id(), "indices": rel})
+                    if isinstance(r, dict):
+                        self._released_bundles.update(
+                            r.get("released", ()))
+                except Exception:
+                    logger.warning("bundle release after elastic "
+                                   "shrink failed", exc_info=True)
         logger.warning(
             "elastic re-form complete: world %s -> %s (generation %s, "
-            "dead ranks %s, %s joiners)", old_world, new_world,
-            gen + 1, instr["dead_ranks"], len(joiner_ranks))
+            "dead ranks %s, %s joiners, %s retired)", old_world,
+            new_world, gen + 1, instr["dead_ranks"],
+            len(joiner_ranks), len(retired))
 
     def finish_training(self):
         if self.worker_group is not None:
@@ -554,6 +789,7 @@ class BackendExecutor:
 
     def shutdown(self):
         from ray_tpu.train import elastic as _elastic
+        self._stop_autopilot_agent()
         try:
             self.backend.on_shutdown(self.worker_group, self.backend_config)
         except Exception:

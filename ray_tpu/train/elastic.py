@@ -242,6 +242,15 @@ def rejoin(worker, error, joining: bool = False) -> None:
         raise error if error is not None else ElasticReset(
             "elastic reform aborted: " + str(instr.get("reason", "")))
 
+    if not joining and worker.world_rank in instr.get("retired", ()):
+        # Broker/driver shrink retired this rank: leave the training
+        # loop cleanly.  StopIteration is caught by the _run() wrapper
+        # as an orderly exit (result_queue gets its terminal None), the
+        # driver reaps the actor and releases the bundle — no failure
+        # budget consumed, no error recorded.
+        raise StopIteration(
+            f"rank {worker.world_rank} retired by elastic shrink "
+            f"(generation {instr['gen']})")
     if joining:
         token = os.environ.get("RT_TRAIN_ELASTIC_TOKEN", "")
         new_rank = instr["joiners"][token]

@@ -1,0 +1,615 @@
+"""Cluster autopilot: the SLO-driven resource arbiter.
+
+Three layers of coverage:
+
+* **Policy isolation** — ArbiterPolicy is a pure state machine with an
+  injectable clock, so decision ordering (serve breach -> shrink the
+  lowest-priority gang first, never below its floor; recovery -> grow
+  the gang back before data re-soaks), flap bounds (two voluntary
+  budget changes >= the cooldown apart), and quorum safety under a
+  capacity crunch are all proven deterministically with a fake clock.
+* **RPC integration** — the broker's GCS surface (register / report /
+  resize_gang structured errors / status) and the revocable DataLease
+  against a live in-process cluster.
+* **Chaos** (slow, wired into `make chaos`) — SIGKILL a node mid-
+  revocation (arbitration must converge, never direct a gang below
+  quorum) and SIGKILL the GCS mid-arbitration (the snapshot must NOT
+  resurrect stale grants; the table rebuilds from reports).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.arbiter import ArbiterPolicy, DataLease
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _policy(clock, **kw):
+    kw.setdefault("breach_window_s", 1.0)
+    kw.setdefault("cooldown_s", 2.0)
+    kw.setdefault("ewma_alpha", 1.0)
+    kw.setdefault("revoke_grace_s", 2.0)
+    kw.setdefault("stale_report_s", 60.0)
+    return ArbiterPolicy(clock, **kw)
+
+
+def _granted(p, wid):
+    return p.get(wid).granted
+
+
+# ---------------------------------------------------------------- policy
+
+
+def test_allocation_order_floors_trains_serve_then_data():
+    """Floors first, trains to full size, serve extra, data soaks the
+    remainder — the order that makes 'grow the gang before data
+    re-soaks' structural."""
+    clk = FakeClock()
+    p = _policy(clk)
+    p.report("serve:s", want=2, units_now=1,
+             kind="serve", priority=100, min_units=1,
+             max_units=4, slo=0.5)
+    p.report("train:g", want=4, units_now=4,
+             kind="train", priority=50, min_units=2, max_units=4)
+    p.report("data:d", want=100, units_now=0, kind="data", priority=0)
+    p.tick(capacity=8)
+    assert _granted(p, "train:g") == 4      # full declared size
+    assert _granted(p, "serve:s") == 2      # its demand
+    assert _granted(p, "data:d") == 2       # 8 - 4 - 2: only the idle
+
+
+def test_serve_breach_shrinks_lowest_priority_gang_first():
+    """A sustained p99 TTFT breach reclaims from the LOWEST-priority
+    gang first; higher-priority gangs are untouched while the victim
+    still has spare above its floor."""
+    clk = FakeClock()
+    p = _policy(clk)
+
+    def _report(ttft, want_serve=3):
+        p.report("serve:s", want=want_serve, units_now=1,
+                 signals={"ttft_p99_s": ttft}, kind="serve",
+                 priority=100, min_units=1, max_units=6, slo=0.5)
+        p.report("train:hi", want=4, units_now=4, kind="train",
+                 priority=60, min_units=2, max_units=4)
+        p.report("train:lo", want=3, units_now=3, kind="train",
+                 priority=40, min_units=1, max_units=3)
+
+    _report(0.1)
+    p.tick(capacity=8)
+    assert _granted(p, "train:hi") == 4
+    assert _granted(p, "train:lo") == 3
+    assert _granted(p, "serve:s") == 1      # pool exhausted by trains
+
+    # Breach must be SUSTAINED past the window before any reclaim.
+    _report(2.0)
+    clk.advance(0.25)
+    p.tick(capacity=8)
+    assert _granted(p, "train:lo") == 3, "reclaimed before the window"
+
+    for _ in range(10):
+        clk.advance(0.25)
+        _report(2.0)
+    decisions = p.tick(capacity=8)
+    # Shortfall is 2 (serve wants 3, has 1): the prio-40 gang gives
+    # both units; the prio-60 gang keeps its full size.
+    assert _granted(p, "train:lo") == 1
+    assert _granted(p, "train:hi") == 4
+    assert _granted(p, "serve:s") == 3
+    revs = [d for d in decisions if d["action"] == "revoke"]
+    assert [d["wid"] for d in revs] == ["train:lo"]
+    assert revs[0]["reason"] == "serve_slo_breach"
+
+
+def test_breach_reclaim_never_directs_gang_below_floor():
+    """Even an unbounded serve shortfall stops reclaiming at every
+    gang's elastic_min_workers floor — the quorum-safety invariant."""
+    clk = FakeClock()
+    p = _policy(clk)
+    for _ in range(12):
+        p.report("serve:s", want=6, units_now=1,
+                 signals={"ttft_p99_s": 9.9}, kind="serve",
+                 priority=100, min_units=1, max_units=6, slo=0.5)
+        p.report("train:hi", want=4, units_now=4, kind="train",
+                 priority=60, min_units=2, max_units=4)
+        p.report("train:lo", want=3, units_now=3, kind="train",
+                 priority=40, min_units=1, max_units=3)
+        clk.advance(0.25)
+        p.tick(capacity=8)
+    assert _granted(p, "train:lo") == 1     # its floor
+    assert _granted(p, "train:hi") == 2     # its floor
+    assert _granted(p, "serve:s") == 5      # 1 + the 4 reclaimed
+
+
+def test_recovery_grows_gang_before_data_resoaks():
+    """When the spike drains, allocation order hands the reclaimed
+    units back to the gang BEFORE data may soak again."""
+    clk = FakeClock()
+    p = _policy(clk)
+
+    def _report(ttft, want_serve):
+        p.report("serve:s", want=want_serve, units_now=1,
+                 signals={"ttft_p99_s": ttft}, kind="serve",
+                 priority=100, min_units=1, max_units=6, slo=0.5)
+        p.report("train:g", want=4, units_now=2, kind="train",
+                 priority=50, min_units=2, max_units=4)
+        p.report("data:d", want=100, units_now=0, kind="data",
+                 priority=0)
+
+    # Drive into breach: gang shrinks to its floor, data to zero.
+    for _ in range(12):
+        _report(9.9, 4)
+        clk.advance(0.25)
+        p.tick(capacity=6)
+    assert _granted(p, "train:g") == 2
+    assert _granted(p, "data:d") == 0
+
+    # Spike drains: sustained-ok window + cooldown, then one tick.
+    for _ in range(12):
+        _report(0.05, 1)
+        clk.advance(0.25)
+        p.tick(capacity=6)
+    assert _granted(p, "train:g") == 4, "gang did not grow back"
+    assert _granted(p, "serve:s") == 1
+    assert _granted(p, "data:d") == 1      # only what the gang left
+
+
+def test_flap_bounds_decisions_at_least_cooldown_apart():
+    """Voluntary budget changes for one workload are >= the cooldown
+    apart no matter how hard demand oscillates."""
+    clk = FakeClock()
+    p = _policy(clk, cooldown_s=2.0)
+    changes = []
+    for i in range(60):
+        p.report("serve:s", want=1 + (i % 2) * 3, units_now=1,
+                 kind="serve", priority=100, min_units=1, max_units=4)
+        for d in p.tick(capacity=8):
+            if d["from"] != d["to"]:
+                changes.append(clk.t)
+        clk.advance(0.25)
+    assert len(changes) >= 2, "demand oscillation never moved the grant"
+    gaps = [b - a for a, b in zip(changes, changes[1:])]
+    assert all(g >= 2.0 - 1e-9 for g in gaps), gaps
+
+
+def test_capacity_crunch_overrides_cooldown_data_first():
+    """Node death making the pinned grants infeasible bypasses the
+    cooldown: data gives back first, the gang follows but NEVER goes
+    below its floor — even if the pool stays short."""
+    clk = FakeClock()
+    p = _policy(clk, cooldown_s=10.0)
+    p.report("train:g", want=4, units_now=4, kind="train",
+             priority=50, min_units=2, max_units=4)
+    p.report("data:d", want=100, units_now=0, kind="data", priority=0)
+    p.tick(capacity=8)
+    assert _granted(p, "train:g") == 4
+    assert _granted(p, "data:d") == 4
+
+    clk.advance(0.25)  # deep inside the cooldown
+    p.report("train:g", want=4, units_now=4, kind="train",
+             priority=50, min_units=2, max_units=4)
+    p.report("data:d", want=100, units_now=4, kind="data", priority=0)
+    decisions = p.tick(capacity=1)          # 7 of 8 nodes died
+    assert _granted(p, "data:d") == 0       # data first, to zero
+    assert _granted(p, "train:g") == 2      # floor, not lower
+    kinds = {d["wid"]: d for d in decisions}
+    assert kinds["data:d"]["action"] == "revoke"
+    assert "grace_s" in kinds["data:d"]
+
+
+def test_data_revoke_carries_grace_window():
+    clk = FakeClock()
+    p = _policy(clk, revoke_grace_s=3.5, cooldown_s=0.0)
+    p.report("data:d", want=100, units_now=0, kind="data", priority=0)
+    p.tick(capacity=4)
+    assert _granted(p, "data:d") == 4
+    clk.advance(0.5)
+    p.report("data:d", want=100, units_now=4, kind="data", priority=0)
+    p.report("train:g", want=4, units_now=0, kind="train",
+             priority=50, min_units=4, max_units=4)
+    (dec,) = [d for d in p.tick(capacity=4) if d["wid"] == "data:d"]
+    assert dec["action"] == "revoke" and dec["grace_s"] == 3.5
+
+
+def test_stale_workloads_garbage_collected():
+    """A client that stops reporting (driver died without unregister)
+    is dropped after the stale TTL and its budget returns."""
+    clk = FakeClock()
+    p = _policy(clk, stale_report_s=5.0)
+    p.report("data:d", want=8, units_now=0, kind="data", priority=0)
+    p.tick(capacity=8)
+    assert _granted(p, "data:d") == 8
+    clk.advance(6.0)
+    p.tick(capacity=8)
+    assert p.get("data:d") is None
+
+
+def test_report_without_declaration_is_structured_error():
+    p = _policy(FakeClock())
+    reply = p.report("serve:ghost", want=1, units_now=0)
+    assert reply["ok"] is False
+    assert reply["error"]["code"] == "UNKNOWN_WORKLOAD"
+
+
+def test_restart_cannot_resurrect_stale_grants():
+    """Broker state is deliberately NOT snapshotted: a fresh policy
+    (restarted GCS) starts with zero grants and rebuilds the table
+    from the next round of reports — the report IS the registration."""
+    clk = FakeClock()
+    p1 = _policy(clk)
+    p1.report("train:g", want=4, units_now=4, kind="train",
+              priority=50, min_units=2, max_units=4)
+    p1.tick(capacity=8)
+    assert _granted(p1, "train:g") == 4
+
+    p2 = _policy(clk)                       # the "restarted" broker
+    assert p2.get("train:g") is None        # nothing resurrected
+    reply = p2.report("train:g", want=4, units_now=4, kind="train",
+                      priority=50, min_units=2, max_units=4)
+    assert reply["ok"] and reply["granted"] == 0  # no stale grant
+    p2.tick(capacity=8)
+    assert _granted(p2, "train:g") == 4     # rebuilt in one period
+
+
+def test_slo_breach_seconds_accumulates():
+    clk = FakeClock()
+    p = _policy(clk)
+    for _ in range(8):
+        p.report("serve:s", want=1, units_now=1,
+                 signals={"ttft_p99_s": 9.0}, kind="serve",
+                 priority=100, min_units=1, max_units=2, slo=0.5)
+        clk.advance(0.25)
+        p.tick(capacity=2)
+    assert p.slo_breach_seconds >= 1.5
+
+
+# ------------------------------------------------------- rpc integration
+
+
+@pytest.fixture
+def ray_4cpu():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _gcs(method, body):
+    from ray_tpu._private.worker import global_worker
+    return global_worker.gcs_call(method, body, timeout=30)
+
+
+def test_resize_gang_structured_errors(ray_4cpu):
+    r = _gcs("resize_gang", {"gang": "nope", "target": 2})
+    assert r["ok"] is False and r["error"]["code"] == "UNKNOWN_GANG"
+
+    _gcs("arbiter_register", {"wid": "train:rigid", "kind": "train",
+                              "min_units": 2, "max_units": 2,
+                              "elastic": False})
+    r = _gcs("resize_gang", {"gang": "rigid", "target": 1})
+    assert r["ok"] is False and r["error"]["code"] == "NOT_ELASTIC"
+
+    _gcs("arbiter_register", {"wid": "train:flex", "kind": "train",
+                              "min_units": 2, "max_units": 4,
+                              "elastic": True})
+    r = _gcs("resize_gang", {"gang": "flex", "target": 1})
+    assert r["ok"] is False and r["error"]["code"] == "BELOW_QUORUM"
+    r = _gcs("resize_gang", {"gang": "flex", "target": 9})
+    assert r["ok"] is False and r["error"]["code"] == "ABOVE_CAPACITY"
+
+    r = _gcs("resize_gang", {"gang": "flex", "target": 3})
+    assert r["ok"] and r["wid"] == "train:flex" and r["target"] == 3
+    # The directive rides the gang's next report reply, exactly once.
+    rep = _gcs("arbiter_report", {"wid": "train:flex", "want": 4,
+                                  "units_now": 4})
+    assert rep["ok"] and rep["directive"] == 3
+    rep = _gcs("arbiter_report", {"wid": "train:flex", "want": 4,
+                                  "units_now": 4})
+    assert rep["directive"] is None
+
+
+def test_arbiter_rpc_register_report_status(ray_4cpu):
+    r = _gcs("arbiter_register", {"wid": "serve:x", "kind": "mystery"})
+    assert r["ok"] is False and r["error"]["code"] == "BAD_DECLARATION"
+
+    assert _gcs("arbiter_register", {
+        "wid": "serve:x", "kind": "serve", "priority": 100,
+        "min_units": 1, "max_units": 3, "slo": 0.5})["ok"]
+    rep = _gcs("arbiter_report", {
+        "wid": "serve:x", "want": 2, "units_now": 1,
+        "signals": {"ttft_p99_s": 0.1}})
+    assert rep["ok"]
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = _gcs("arbiter_status", {})
+        wl = {w["wid"]: w for w in st["workloads"]}.get("serve:x")
+        if wl is not None and wl["granted"] >= 2:
+            break
+        time.sleep(0.2)
+    assert wl is not None and wl["granted"] >= 2, st
+    assert st["capacity"] == 4
+    assert _gcs("arbiter_unregister", {"wid": "serve:x"})["ok"]
+
+
+def test_data_lease_granted_then_revoked_by_gang_floor(ray_4cpu):
+    """End-to-end revocable lease: an idle cluster grants the soak
+    lease real capacity; a gang's floor claim revokes it within a few
+    report periods and admission drops to zero."""
+    lease = DataLease("data:soak", want=64, priority=0)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and lease.allowed() < 4:
+            time.sleep(0.2)
+        assert lease.allowed() == 4, "lease never soaked idle capacity"
+
+        stop = threading.Event()
+
+        def _gang_reports():
+            while not stop.is_set():
+                try:
+                    _gcs("arbiter_report", {
+                        "wid": "train:greedy", "want": 4, "units_now": 4,
+                        "decl": {"kind": "train", "priority": 50,
+                                 "min_units": 4, "max_units": 4,
+                                 "elastic": False}})
+                except Exception:
+                    pass
+                stop.wait(0.2)
+
+        t = threading.Thread(target=_gang_reports, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and lease.allowed() > 0:
+                time.sleep(0.2)
+            assert lease.allowed() == 0, \
+                "lease not revoked when the gang claimed its floor"
+            assert lease.revoked_at is not None
+        finally:
+            stop.set()
+            t.join(5)
+            _gcs("arbiter_unregister", {"wid": "train:greedy"})
+    finally:
+        lease.stop()
+
+
+# --------------------------------------------------- end-to-end elastic
+
+
+def _resize_loop(config):
+    import time as _t
+
+    import numpy as np
+    from ray_tpu.air import session
+    from ray_tpu.train.collective import allreduce_gradients
+
+    rank = session.get_world_rank()
+    st = session.get_elastic_state()
+    start = int(st["step"]) + 1 if st is not None else 0
+    w = (np.asarray(st["w"], dtype=np.float64).copy()
+         if st is not None else np.zeros(2))
+    for step in range(start, int(config["steps"])):
+        g = allreduce_gradients(np.ones(2) * (rank + 1.0))
+        w = w + g
+        session.stash_elastic_state({"step": step, "w": w})
+        _t.sleep(0.25)
+        session.report({"step": step})
+
+
+@pytest.mark.slow
+def test_rt_resize_directive_shrinks_then_grows_gang():
+    """The `rt resize` path end-to-end: a resize_gang RPC's directive
+    rides the gang agent's report reply into request_elastic_resize —
+    shrink retires the highest rank and releases its bundle; a second
+    directive grows back into the released bundle.  No cold restart."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train._internal import backend_executor as be
+
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    executor = be.BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=3, elastic=True, elastic_min_workers=2,
+                      name="rzgang", resources_per_worker={"CPU": 1}))
+    executor.start()
+    try:
+        executor.start_training(_resize_loop, {"steps": 40},
+                                trial_name="t", trial_id="t")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = _gcs("arbiter_status", {})
+            if any(w["wid"] == "train:rzgang"
+                   for w in st["workloads"]):
+                break
+            time.sleep(0.2)
+        r = _gcs("resize_gang", {"gang": "rzgang", "target": 2})
+        assert r["ok"], r
+
+        def _pump_until(world, limit=120):
+            end = time.monotonic() + limit
+            while time.monotonic() < end:
+                res = executor.get_next_results()
+                if res is None:
+                    return False
+                if len(executor.worker_group.workers) == world:
+                    return True
+            return False
+
+        assert _pump_until(2), "gang did not shrink to 2"
+        assert executor._released_bundles, "shrink released no bundle"
+
+        r = _gcs("resize_gang", {"gang": "rzgang", "target": 3})
+        assert r["ok"], r
+        assert _pump_until(3), "gang did not grow back to 3"
+        assert not executor._released_bundles
+    finally:
+        executor.shutdown()
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------------- chaos
+
+
+@pytest.mark.slow
+def test_chaos_node_sigkill_mid_revocation():
+    """`make chaos` leg 1: SIGKILL a node while the arbiter is mid-
+    revocation (serve breach reclaiming from the gang).  Arbitration
+    must converge on the shrunken capacity, keep the gang at or above
+    its floor, and keep answering status RPCs (no deadlock)."""
+    import signal  # noqa: F401  (parity with other chaos tests)
+
+    from ray_tpu.cluster_utils import ProcessCluster
+    pc = ProcessCluster()
+    try:
+        pc.add_node(num_cpus=1)
+        for _ in range(3):
+            pc.add_node(num_cpus=1)
+        assert pc.wait_for_nodes(4)
+        pc.connect()
+
+        stop = threading.Event()
+
+        def _reports():
+            while not stop.is_set():
+                try:
+                    _gcs("arbiter_report", {
+                        "wid": "serve:hot", "want": 3, "units_now": 1,
+                        "signals": {"ttft_p99_s": 9.9},
+                        "decl": {"kind": "serve", "priority": 100,
+                                 "min_units": 1, "max_units": 3,
+                                 "slo": 0.5}})
+                    _gcs("arbiter_report", {
+                        "wid": "train:g", "want": 3, "units_now": 3,
+                        "decl": {"kind": "train", "priority": 50,
+                                 "min_units": 2, "max_units": 3,
+                                 "elastic": True}})
+                except Exception:
+                    pass
+                stop.wait(0.2)
+
+        t = threading.Thread(target=_reports, daemon=True)
+        t.start()
+
+        def _grants():
+            st = _gcs("arbiter_status", {})
+            return {w["wid"]: w["granted"] for w in st["workloads"]}
+
+        # Wait for the revocation to begin (gang below its full size).
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            g = _grants()
+            if g.get("train:g", 3) < 3:
+                break
+            time.sleep(0.2)
+        assert g.get("train:g", 3) < 3, f"no revocation started: {g}"
+
+        # SIGKILL a worker node mid-revocation.
+        pc.remove_node(pc.nodes[-1])
+
+        # Convergence: grants fit the shrunken capacity, the gang
+        # holds quorum, and the grant table goes quiet.
+        deadline = time.monotonic() + 90
+        stable_since = None
+        last = None
+        while time.monotonic() < deadline:
+            st = _gcs("arbiter_status", {})
+            g = {w["wid"]: w["granted"] for w in st["workloads"]}
+            cap = st["capacity"]
+            fits = sum(g.values()) <= cap and g.get("train:g", 0) >= 2
+            if fits and g == last:
+                if stable_since is None:
+                    stable_since = time.monotonic()
+                elif time.monotonic() - stable_since >= 3.0:
+                    break
+            else:
+                stable_since = None
+            last = g
+            time.sleep(0.25)
+        stop.set()
+        t.join(5)
+        assert stable_since is not None and \
+            time.monotonic() - stable_since >= 3.0, \
+            f"arbitration never converged: {last} vs capacity {cap}"
+        assert last.get("train:g", 0) >= 2, \
+            f"gang directed below quorum: {last}"
+    finally:
+        pc.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_gcs_sigkill_mid_arbitration_no_stale_grants():
+    """`make chaos` leg 2: SIGKILL the GCS while grants are live, then
+    restart it from its snapshot.  Broker state is intentionally NOT in
+    the snapshot — the restarted GCS must come back with an EMPTY
+    workload table (stale grants cannot be resurrected) and rebuild it
+    from the clients' next reports."""
+    from ray_tpu.cluster_utils import ProcessCluster
+    pc = ProcessCluster()
+    try:
+        pc.add_node(num_cpus=2)
+        pc.add_node(num_cpus=2)
+        assert pc.wait_for_nodes(2)
+        pc.connect()
+
+        def _report_once():
+            _gcs("arbiter_report", {
+                "wid": "train:g", "want": 4, "units_now": 4,
+                "decl": {"kind": "train", "priority": 50,
+                         "min_units": 2, "max_units": 4,
+                         "elastic": True}})
+
+        deadline = time.monotonic() + 30
+        granted = 0
+        while time.monotonic() < deadline and granted < 4:
+            _report_once()
+            st = _gcs("arbiter_status", {})
+            granted = {w["wid"]: w["granted"]
+                       for w in st["workloads"]}.get("train:g", 0)
+            time.sleep(0.2)
+        assert granted == 4, "gang never granted before the kill"
+        time.sleep(2.0)  # let a snapshot cycle include current state
+
+        pc.kill_gcs()
+        time.sleep(1.0)
+        pc.restart_gcs()
+
+        # Immediately after restart (no reports yet): table is EMPTY.
+        deadline = time.monotonic() + 60
+        st = None
+        while time.monotonic() < deadline:
+            try:
+                st = _gcs("arbiter_status", {})
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert st is not None, "GCS never answered after restart"
+        assert st["workloads"] == [], \
+            f"snapshot resurrected broker state: {st['workloads']}"
+
+        # Reports rebuild the table and the grant returns.
+        deadline = time.monotonic() + 60
+        granted = 0
+        while time.monotonic() < deadline and granted < 4:
+            try:
+                _report_once()
+                st = _gcs("arbiter_status", {})
+                granted = {w["wid"]: w["granted"]
+                           for w in st["workloads"]}.get("train:g", 0)
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert granted == 4, "grants not rebuilt after GCS restart"
+    finally:
+        pc.shutdown()
