@@ -125,10 +125,12 @@ bench-serve-scale:
 		$(PY) bench.py --suite serve_scale \
 		--json-out BENCH_serve_scale.json
 
-# <60 s serve-scale smoke (2 replicas, smaller soak; HEADLINE last):
+# <90 s serve-scale smoke (2 replicas, smaller soak; HEADLINE last):
 # the same hung-stream / failover-parity / shed-accounting assertions
-# as the full soak, so a serving-robustness regression fails make
-# check.  Does NOT touch the checked-in artifact.
+# as the full soak plus the prefix-affinity and KV-migration legs
+# (quick gates on affinity-hit coverage + prefill collapse; the TTFT
+# magnitude gate runs in the full suite).  Does NOT touch the
+# checked-in artifact.
 bench-serve-scale-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite serve_scale --quick
@@ -241,8 +243,18 @@ chaos:
 		tests/test_train_elastic.py::test_reshard_death_falls_back_to_checkpoint \
 		tests/test_autopilot.py::test_chaos_node_sigkill_mid_revocation \
 		tests/test_autopilot.py::test_chaos_gcs_sigkill_mid_arbitration_no_stale_grants \
+		tests/test_serve_kv_affinity.py::test_sse_resume_header_lands_through_proxy \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
+	@echo "== kill-origin-mid-migration x3 (locksan over kv_transfer) =="
+	for i in 1 2 3; do \
+		env JAX_PLATFORMS=cpu RT_CHAOS_SEED=$(CHAOS_SEED) \
+			RT_LOCK_SANITIZER=1 timeout -k 10 300 \
+			$(PY) -m pytest -q -p no:cacheprovider \
+			tests/test_serve_kv_affinity.py::test_kill_origin_mid_migration_reprefills_with_parity \
+		|| { echo "CHAOS kv-migration FAILED (iter $$i) — replay with:" \
+		     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }; \
+	done
 
 # <30 s smoke slice for make check: registry determinism + one fault
 # path per runtime layer (protocol keepalive, transfer partition, GCS

@@ -2392,8 +2392,248 @@ def serve_scale_main(json_out=None, quick=False):
     assert qos_on["cold"]["ttft_p99_s"] < 2.0, \
         "cold-tenant p99 TTFT not bounded under chaos"
 
+    # The soak deployment is done — retire its replicas before the
+    # affinity A/B so idle soak processes don't inflate (and jitter)
+    # the per-stream floor both legs sit on.
     routers[name].stop()
     serve.delete(name)
+
+    # ---- Leg 4: prefix-affinity routing (KV-aware serving) ----------
+    # Prefix-heavy workload whose total page footprint overflows ONE
+    # replica's KV pool but PARTITIONS across two: affinity pins each
+    # prefix's pages to its home replica (prefill collapses to the
+    # tail chunk), random routing re-prefills and thrashes both pools.
+    # Both legs warm identically (round-robin, router bypassed), so
+    # the measured delta is pure routing policy.  Long prompts + a
+    # small prefill chunk make a miss cost ~10 engine dispatches vs 1
+    # for a hit, so the routing policy — not the per-stream RPC floor
+    # — dominates TTFT.
+    n_prefix = 12 if quick else 16
+    aff_rounds = 3
+    aff_max_new = 4
+    aff_prompt_tokens = 40          # 10 pages at page_size=4
+    aff_pages_per_prompt = aff_prompt_tokens // 4
+    # Pool = own partition + slack; the OTHER half of the prefix set
+    # cannot also fit, so random routing evicts continuously.  Tight
+    # slack in quick mode keeps the contrast visible at 36 streams.
+    aff_engine_kw = dict(
+        num_slots=4, max_seq=48, prefill_chunk=4, page_size=4,
+        kv_pages=(n_prefix // 2) * aff_pages_per_prompt
+        + (10 if quick else 20),
+        max_queue_len=256)
+    aff_window = 12
+    aff_prompts = [[int(t) for t in np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1000 + i), (aff_prompt_tokens,), 1,
+        cfg.vocab_size))] for i in range(n_prefix)]
+
+    def prefill_seconds_since(rset, since_us):
+        """Sum of engine.prefill span seconds across the deployment's
+        replicas (each replica's tracing ring, via the trace_spans
+        RPC) — the trace decomposition that attributes a TTFT win to
+        prefill collapse rather than queueing noise."""
+        total, count = 0.0, 0
+        for info in rset._replicas:
+            spans = ray_tpu.get(info["actor"].handle_request.remote(
+                "trace_spans", (), {}), timeout=30)
+            for s in spans:
+                if s.get("name") == "engine.prefill" \
+                        and s.get("ts", 0) >= since_us:
+                    total += s.get("dur", 0.0) / 1e6
+                    count += 1
+        return round(total, 4), count
+
+    def affinity_leg(label, use_hint):
+        dname = f"aff_{label}"
+        # max_concurrent_queries well above the window: replica-side
+        # admission is the engine's job here, and a tight query cap
+        # would trip the hotspot bound and divert affinity picks.
+        llm_deployment(loader, name=dname, num_replicas=2,
+                       engine_config=dict(aff_engine_kw),
+                       max_concurrent_queries=64).deploy()
+        r = make_router(dname)
+        rset = r.replica_set
+
+        async def wait_replicas():
+            for _ in range(300):
+                if len(rset._replicas) == 2:
+                    return
+                await asyncio.sleep(0.1)
+            raise RuntimeError("affinity replicas never came up")
+        on_loop(wait_replicas())
+        # Deterministic warm: prefix i lives on replica i%2.  Also
+        # seeds the digests the affinity leg routes on.
+        infos = sorted(rset._replicas, key=lambda x: x["replica_tag"])
+        warm_refs = [infos[i % 2]["actor"].handle_request.remote(
+            "generate", (p,), {"max_new_tokens": aff_max_new})
+            for i, p in enumerate(aff_prompts)]
+        ray_tpu.get(warm_refs, timeout=300)
+
+        # Measured rounds must route on COMPLETE digests: every warm
+        # prompt's deepest indexed fingerprint advertised by its home
+        # replica (the broadcast is rate-limited, so partial digests
+        # are a real transient).
+        from ray_tpu.serve.llm.paging import prefix_fingerprints
+        want_fp = {}
+        for i, p in enumerate(aff_prompts):
+            want_fp.setdefault(infos[i % 2]["replica_tag"], set()).add(
+                prefix_fingerprints(p, 4, 8)[-1])
+
+        async def wait_digests():
+            for _ in range(150):
+                cur = {x["replica_tag"]:
+                       {e.get("fp") for e in
+                        (x.get("kv_digest") or {}).get("roots", ())}
+                       for x in rset._replicas}
+                if all(fps <= cur.get(tag, set())
+                       for tag, fps in want_fp.items()):
+                    return
+                await asyncio.sleep(0.2)
+            raise RuntimeError("digests never reached the router")
+        if use_hint:
+            on_loop(wait_digests())
+
+        ttfts = []
+
+        async def one(p):
+            t0 = time.monotonic()
+            hint = {"tokens": p} if use_hint else None
+            ait = await rset.assign_replica_stream(
+                "stream", (p,), {"max_new_tokens": aff_max_new},
+                affinity=hint)
+            async for _tok in ait:
+                ttfts.append(time.monotonic() - t0)
+                break
+            async for _tok in ait:
+                pass
+
+        async def rounds():
+            sem = asyncio.Semaphore(aff_window)
+
+            async def gated(p):
+                async with sem:
+                    await one(p)
+            for _ in range(aff_rounds):
+                await asyncio.gather(*[gated(p) for p in aff_prompts])
+
+        t_meas_us = time.time() * 1e6
+        hits0 = counter_total(router_mod.AFFINITY_HITS_COUNTER)
+        t0 = time.monotonic()
+        on_loop(rounds())
+        wall = time.monotonic() - t0
+        prefill_s, prefill_n = prefill_seconds_since(rset, t_meas_us)
+        out = {"streams": len(ttfts),
+               "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4),
+               "ttft_p99_s": round(_pct(ttfts, 0.99) or 0, 4),
+               "prefill_span_s": prefill_s,
+               "prefill_spans": prefill_n,
+               "affinity_hits": int(counter_total(
+                   router_mod.AFFINITY_HITS_COUNTER) - hits0),
+               "wall_s": round(wall, 2)}
+        r.stop()
+        serve.delete(dname)
+        print(f"  affinity[{label}]: ttft mean {out['ttft_mean_s']}s "
+              f"prefill {out['prefill_span_s']}s over "
+              f"{out['prefill_spans']} spans "
+              f"hits={out['affinity_hits']}")
+        return out
+
+    aff_on = affinity_leg("on", True)
+    aff_off = affinity_leg("off", False)
+    ttft_win = aff_off["ttft_mean_s"] / max(aff_on["ttft_mean_s"], 1e-9)
+    prefill_win = (aff_off["prefill_span_s"]
+                   / max(aff_on["prefill_span_s"], 1e-9))
+    detail["affinity"] = {
+        "workload": {"prefixes": n_prefix,
+                     "prompt_tokens": aff_prompt_tokens,
+                     "rounds": aff_rounds, "window": aff_window,
+                     "replicas": 2,
+                     "kv_pages_per_replica":
+                         aff_engine_kw["kv_pages"]},
+        "affinity": aff_on, "random": aff_off,
+        "ttft_mean_win": round(ttft_win, 2),
+        "prefill_span_win": round(prefill_win, 2)}
+    # THE affinity acceptance: >2x mean TTFT at equal load, and the
+    # win is attributable to prefill collapse (the prefill span total
+    # shrinks at least as dramatically as TTFT does).  The quick
+    # smoke's 16 streams are too few for a stable TTFT mean (random
+    # routing lands on the home replica half the time by luck), so
+    # quick gates on the deterministic signals — every request routed
+    # by prefix and the prefill-span collapse — and records TTFT.
+    assert aff_on["affinity_hits"] == aff_on["streams"], \
+        f"affinity leg routed {aff_on['affinity_hits']}/" \
+        f"{aff_on['streams']} requests by prefix"
+    _prefill_bound = 1.5 if quick else 2.0
+    assert prefill_win > _prefill_bound, \
+        f"prefill spans did not collapse ({prefill_win:.2f}x <= " \
+        f"{_prefill_bound}x)"
+    if not quick:
+        assert ttft_win > 2.0, \
+            f"affinity TTFT win {ttft_win:.2f}x <= 2x over random " \
+            f"routing"
+    print(f"  affinity win: ttft {ttft_win:.1f}x "
+          f"prefill {prefill_win:.1f}x")
+
+    # ---- Leg 5: KV migration vs re-prefill crossover ----------------
+    # In-process engine pair (the wire legs are covered by tests): at
+    # how many pages does shipping committed K/V beat recomputing it?
+    from ray_tpu.serve.llm import kv_transfer
+    from ray_tpu.serve.llm.engine import GenerationEngine
+
+    psz = 4
+    mig_kw = dict(num_slots=2, prefill_chunk=8, page_size=psz,
+                  kv_pages=32)
+    src_eng = GenerationEngine(params, cfg, name="xsrc", **mig_kw)
+    dst_eng = GenerationEngine(params, cfg, name="xdst", **mig_kw)
+    src_eng.start()
+    dst_eng.start()
+    mig_table = []
+    crossover = None
+    try:
+        def clear_dst():
+            dst_eng.run_on_worker(lambda: dst_eng._prefix.clear())
+
+        page_counts = [2, 4, 8] if quick else [2, 4, 8, 12]
+        for npages in page_counts:
+            prompt_n = [int(t) for t in np.asarray(jax.random.randint(
+                jax.random.PRNGKey(2000 + npages), (npages * psz,), 1,
+                cfg.vocab_size))]
+            src_eng.submit(prompt_n, max_new_tokens=1).result(60)
+            best_pre = best_mig = float("inf")
+            for _ in range(3):
+                clear_dst()
+                t0 = time.monotonic()
+                dst_eng.submit(prompt_n, max_new_tokens=1).result(60)
+                best_pre = min(best_pre, time.monotonic() - t0)
+                clear_dst()
+                t0 = time.monotonic()
+                moved = kv_transfer.migrate_local(
+                    src_eng, dst_eng, prompt_n)
+                dst_eng.submit(prompt_n, max_new_tokens=1).result(60)
+                best_mig = min(best_mig, time.monotonic() - t0)
+                assert moved == npages, (moved, npages)
+            row = {"pages": npages,
+                   "reprefill_ttft_s": round(best_pre, 5),
+                   "migrate_ttft_s": round(best_mig, 5)}
+            mig_table.append(row)
+            if crossover is None and best_mig < best_pre:
+                crossover = npages
+            print(f"  kv_migrate[{npages}p]: migrate "
+                  f"{row['migrate_ttft_s']}s vs re-prefill "
+                  f"{row['reprefill_ttft_s']}s")
+    finally:
+        src_eng.stop()
+        dst_eng.stop()
+    detail["kv_migration"] = {
+        "page_size": psz, "table": mig_table,
+        "crossover_pages": crossover,
+        "configured_min_migrate_pages": int(
+            __import__("ray_tpu._private.config",
+                       fromlist=["GLOBAL_CONFIG"])
+            .GLOBAL_CONFIG.serve_kv_min_migrate_pages)}
+    big = mig_table[-1]
+    assert big["migrate_ttft_s"] < big["reprefill_ttft_s"], \
+        f"migration not cheaper than re-prefill at {big['pages']} pages"
+
     serve.shutdown()
     ray_tpu.shutdown()
 

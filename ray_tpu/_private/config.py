@@ -244,6 +244,58 @@ class _Config:
     serve_health_poll_period_s = _def("serve_health_poll_period_s",
                                       float, 0.1)
 
+    # --- KV-aware serving (prefix-affinity routing + page migration) ---
+    # Master switch for prefix-affinity routing: replicas publish radix
+    # prefix digests through their autoscale gauges and the router
+    # scores candidates by expected prefix-hit depth.  Off restores the
+    # pure power-of-two-choices pick (kept as the bench baseline).
+    serve_affinity = _def("serve_affinity", bool, True)
+    # Most prefix fingerprints one replica publishes per digest (top-K
+    # by recency) and the deepest page a fingerprint may describe.
+    # Both bound digest size: a digest rides every autoscale poll and
+    # every replica broadcast, so it must stay control-plane-sized.
+    serve_affinity_digest_top_k = _def("serve_affinity_digest_top_k",
+                                       int, 32)
+    serve_affinity_digest_depth = _def("serve_affinity_digest_depth",
+                                       int, 8)
+    # Router score = blend * hit_depth_norm - (1 - blend) * load_norm:
+    # 1.0 routes on affinity alone, 0.0 degenerates to load-only.
+    serve_affinity_blend = _def("serve_affinity_blend", float, 0.7)
+    # Hotspot bound: a replica whose occupancy (in-flight /
+    # max_concurrent_queries) is at or past this fraction loses its
+    # affinity claim — a viral prefix must not starve one replica, so
+    # affinity always loses to overload.
+    serve_affinity_hotspot_bound = _def("serve_affinity_hotspot_bound",
+                                        float, 0.75)
+    # How often a replica's digest may retrigger the controller's
+    # replica broadcast (membership changes still broadcast at once);
+    # bounds long-poll churn under hot caches.
+    serve_affinity_refresh_s = _def("serve_affinity_refresh_s",
+                                    float, 1.0)
+    # --- KV page migration (serve/llm/kv_transfer.py) ---
+    # Sliding window of in-flight page frames per migration pull (the
+    # transfer plane's windowed-pump discipline).
+    serve_kv_migration_window_chunks = _def(
+        "serve_kv_migration_window_chunks", int, 4)
+    # Below this many committed full pages, migration is skipped and
+    # the destination re-prefills.  Crossover rationale: one migrated
+    # page moves page_size * 2 * layers * kv_heads * head_dim * 4 bytes
+    # over a ~GB/s link plus a fixed ~2 RPC rendezvous cost, while
+    # re-prefilling the same page costs one chunked-prefill pass that
+    # is amortized across the whole batch — for 1-page prefixes the
+    # rendezvous alone usually exceeds the prefill FLOPs, so shipping
+    # only wins once a few pages of K/V ride one rendezvous (measured
+    # by bench.py --suite serve_scale's migration-vs-reprefill leg).
+    serve_kv_min_migrate_pages = _def("serve_kv_min_migrate_pages",
+                                      int, 2)
+    # Same-host fast path: the origin stages export pages in a /dev/shm
+    # file the destination mmap-reads (one memcpy, no socket); falls
+    # back to wire frames when the file is not reachable.
+    serve_kv_samehost = _def("serve_kv_samehost", bool, True)
+    # An export a destination never sealed (puller died mid-pull) is
+    # released after this TTL so its page refs cannot leak forever.
+    serve_kv_export_ttl_s = _def("serve_kv_export_ttl_s", float, 60.0)
+
     # --- cluster autopilot (SLO-driven arbiter, _private/arbiter.py) ---
     # The GCS broker's arbitration tick: how often registered workload
     # declarations + smoothed signals are re-evaluated into grant /

@@ -163,6 +163,29 @@ def init_paged_cache(cfg, num_pages: int, page_size: int) -> Dict:
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def paged_write_pages(cache: Dict, page_ids, k_pages, v_pages) -> Dict:
+    """Splice imported K/V pages into the pool: k_pages/v_pages
+    [n, L, page_size, Hkv, Dh] (page-major — each page's bytes travel
+    the wire as one contiguous buffer) land at pool rows `page_ids`
+    [n].  One scatter per cache tensor, cache donated: a KV migration
+    commits between decode ticks as a single dispatch, never a
+    reallocation or a tick stall."""
+    return {"k": cache["k"].at[:, page_ids].set(
+                jnp.moveaxis(k_pages, 0, 1).astype(cache["k"].dtype)),
+            "v": cache["v"].at[:, page_ids].set(
+                jnp.moveaxis(v_pages, 0, 1).astype(cache["v"].dtype))}
+
+
+@jax.jit
+def paged_read_pages(cache: Dict, page_ids) -> Tuple[Any, Any]:
+    """Gather pool rows `page_ids` [n] as page-major
+    [n, L, page_size, Hkv, Dh] K and V stacks — the export half of a KV
+    migration (device_get of the result is the only host copy)."""
+    return (jnp.moveaxis(cache["k"][:, page_ids], 0, 1),
+            jnp.moveaxis(cache["v"][:, page_ids], 0, 1))
+
+
 def paged_chunk_step(params: Dict, tokens, pos, cache: Dict,
                      block_tables, cfg, pad_lo=None
                      ) -> Tuple[Any, Dict]:

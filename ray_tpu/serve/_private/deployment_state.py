@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
 from ray_tpu.util import metrics as _metrics
 
@@ -106,13 +107,23 @@ class ReplicaWrapper:
         return True
 
     def running_info(self) -> Dict:
-        return {
+        info = {
             "replica_tag": self.replica_tag,
             "deployment": self.deployment_name,
             "version": self.version,
             "actor": self._actor,
             "max_concurrent_queries": self._config.max_concurrent_queries,
         }
+        # KV-affinity extras piggyback on the load sample the autoscale
+        # poll already collects: the replica's prefix digest (what it
+        # has cached) and its migration pull address.  Routers receive
+        # them with the membership broadcast — no extra poll plane.
+        load = self.last_load
+        if load:
+            for key in ("kv_digest", "kv_rdv"):
+                if load.get(key):
+                    info[key] = load[key]
+        return info
 
     def num_ongoing(self) -> Optional[int]:
         try:
@@ -172,6 +183,38 @@ class ReplicaWrapper:
         # probe fired pre-drain would repopulate it, so drop that too.
         self.last_load = None
         self._load_ref = None
+
+    def offer_kv_migration(self, dest: "ReplicaWrapper"):
+        """Drain handoff: offer this (DRAINING) replica's hot KV pages
+        to a surviving replica before teardown.  The origin serves a
+        manifest (pull address + hottest cached prefixes, still
+        referenced by its radix tree); the SURVIVOR pulls the pages
+        over the transfer plane.  Copies, not moves — the origin's
+        pages stay intact until its normal teardown, so an un-drain
+        mid-flight cannot double-count anything, and a non-KV
+        deployment simply fails the manifest RPC (swallowed here).
+        The manifest fetch is bounded (2s); the pull itself is
+        fire-and-forget on the survivor."""
+        if self._actor is None or dest._actor is None:
+            return
+        try:
+            manifest = ray_tpu.get(
+                self._actor.handle_request.remote(
+                    "kv_drain_manifest", (), {}), timeout=2)
+        except Exception:
+            return
+        if not manifest:
+            return
+        _tracing.event("serve", "serve.drain_migrate",
+                       args={"origin": self.replica_tag,
+                             "dest": dest.replica_tag,
+                             "prefixes":
+                                 len(manifest.get("prefixes", ()))})
+        logger.info("drain: offering %d hot prefixes of %s to %s",
+                    len(manifest.get("prefixes", ())),
+                    self.replica_tag, dest.replica_tag)
+        dest._actor.handle_request.options(num_returns=0).remote(
+            "kv_pull_from", (manifest,), {})
 
     def confirmed_idle(self, now: float) -> bool:
         """A FRESH post-drain sample confirms zero in-flight work.  The
@@ -236,6 +279,8 @@ class DeploymentState:
         self.replicas: List[ReplicaWrapper] = []
         self._last_health_check = 0.0
         self._last_broadcast: Any = None
+        self._digest_fp: Any = None
+        self._digest_fp_t = 0.0
         self._start_failures = 0
         self.deploy_failed = False
 
@@ -369,8 +414,17 @@ class DeploymentState:
                 def _load_key(r):
                     load = r.poll_load(now)
                     return load.get("ongoing", 0) if load else 0
-                for r in sorted(fresh_running, key=_load_key)[:excess]:
+                victims = sorted(fresh_running, key=_load_key)[:excess]
+                survivors = [r for r in fresh_running
+                             if r not in victims]
+                for r in victims:
                     r.begin_drain(now, cfg.drain_timeout_s)
+                    if survivors and _cfg.serve_affinity:
+                        # Re-home the drained replica's hot KV pages on
+                        # the least-loaded survivor so its cached
+                        # prefixes outlive the scale-down.
+                        r.offer_kv_migration(
+                            min(survivors, key=_load_key))
 
         # 4. Health checks on running replicas (periodic, non-blocking).
         now = time.monotonic()
@@ -389,6 +443,16 @@ class DeploymentState:
                     if r in self.replicas:
                         self.replicas.remove(r)
 
+        # The affinity digest rides the load sample the AUTOSCALER
+        # polls — but a fixed-replica deployment has no autoscaler, so
+        # poll here too or its digests would never leave the replicas.
+        # Non-blocking with at most one outstanding probe per replica,
+        # same cost profile as the autoscale path.
+        if _cfg.serve_affinity:
+            for r in self.replicas:
+                if r.state == RUNNING:
+                    r.poll_load(now)
+
         # 5. Broadcast the running-replica set on change (a DRAINING
         # replica's exclusion here IS the "stop admitting" edge).
         DRAINING_GAUGE.set(
@@ -396,8 +460,25 @@ class DeploymentState:
             tags={"deployment": self.name})
         infos = [r.running_info() for r in self.replicas
                  if r.state == RUNNING]
-        fingerprint = sorted((i["replica_tag"], i["version"])
-                             for i in infos)
+        fingerprint: Any = sorted((i["replica_tag"], i["version"])
+                                  for i in infos)
+        # The affinity digests ride the same broadcast, but re-notifying
+        # every router each time any replica touches any prefix would
+        # turn the long-poll into a firehose: fold the digests into the
+        # fingerprint at most once per serve_affinity_refresh_s —
+        # membership changes still broadcast instantly, digest drift is
+        # batched (stale affinity only costs a suboptimal pick).
+        if _cfg.serve_affinity:
+            now_b = time.monotonic()
+            if now_b - self._digest_fp_t >= _cfg.serve_affinity_refresh_s:
+                self._digest_fp_t = now_b
+                self._digest_fp = sorted(
+                    (i["replica_tag"],
+                     tuple(sorted(
+                         r.get("fp", "") for r in
+                         (i.get("kv_digest") or {}).get("roots", ()))))
+                    for i in infos)
+            fingerprint = (fingerprint, self._digest_fp)
         if fingerprint != self._last_broadcast:
             self._last_broadcast = fingerprint
             self._long_poll.notify_changed(

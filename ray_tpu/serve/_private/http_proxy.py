@@ -113,6 +113,53 @@ class HTTPProxy:
         return str((query or {}).get("stream", "")).lower() \
             in ("1", "true", "yes")
 
+    @staticmethod
+    def affinity_hint(body: bytes,
+                      headers: Dict[str, str]) -> Optional[Dict]:
+        """Routing hint for prefix-affinity: an `x-rt-affinity` header
+        (comma-separated prefix fingerprints from a prior resume
+        cursor) wins; otherwise a JSON body with a token-list prompt
+        ("tokens", or "prompt" for OpenAI-shaped clients) is
+        fingerprinted by the router per replica page size.  None means
+        load-only routing."""
+        hdr = next((v for k, v in (headers or {}).items()
+                    if k.lower() == "x-rt-affinity"), None)
+        if hdr:
+            fps = [f.strip() for f in str(hdr).split(",") if f.strip()]
+            if fps:
+                return {"fps": fps}
+        try:
+            data = _json.loads(body)
+        except Exception:
+            return None
+        if not isinstance(data, dict):
+            return None
+        toks = data.get("tokens", data.get("prompt"))
+        if isinstance(toks, list) and toks \
+                and all(isinstance(t, int) for t in toks):
+            return {"tokens": toks}
+        return None
+
+    @staticmethod
+    def resume_cursor_of(headers: Dict[str, str]) -> Optional[Dict]:
+        """A client-held resume cursor riding the `x-rt-resume` header
+        (the JSON this proxy handed out in a 503 body / SSE error
+        event, plus the delivered items): seeds the router's stream so
+        the resubmitted request continues past the cursor — across
+        proxy death, since nothing about it lives in proxy state."""
+        hdr = next((v for k, v in (headers or {}).items()
+                    if k.lower() == "x-rt-resume"), None)
+        if not hdr:
+            return None
+        try:
+            cur = _json.loads(hdr)
+        except Exception:
+            return None
+        if isinstance(cur, dict) \
+                and (cur.get("items") or cur.get("delivered")):
+            return cur
+        return None
+
     async def handle_stream(self, method: str, path: str,
                             query: Dict[str, str], body: bytes,
                             headers: Dict[str, str]):
@@ -134,7 +181,9 @@ class HTTPProxy:
         try:
             aiter = await rs.assign_replica_stream(
                 "", (req,), {}, unary_fallback=True,
-                tenant=self.tenant_of(query, headers))
+                tenant=self.tenant_of(query, headers),
+                affinity=self.affinity_hint(body, headers),
+                resume=self.resume_cursor_of(headers))
         except TenantThrottled as e:
             return _throttle_response(e)
         except Exception as e:
@@ -186,7 +235,8 @@ class HTTPProxy:
                       query=query, body=body, headers=headers)
         try:
             result = await rs.assign_replica(
-                "", (req,), {}, tenant=self.tenant_of(query, headers))
+                "", (req,), {}, tenant=self.tenant_of(query, headers),
+                affinity=self.affinity_hint(body, headers))
         except TenantThrottled as e:
             return _throttle_response(e)
         except Exception as e:
@@ -346,15 +396,24 @@ class HTTPProxyActor:
                                 headers=hdrs + [tid_hdr])
         except StreamInterrupted as e:
             # Zero items were delivered and failover could not place
-            # the stream: retryable server-side failure.
+            # the stream: retryable server-side failure.  The cursor
+            # also rides RESUBMIT HEADERS: a client (or LB retry hop)
+            # copies x-rt-resume / x-rt-affinity onto the retry and
+            # re-enters with affinity — through ANY proxy, since the
+            # cursor itself is the only state.
             await aiter.aclose()
+            cursor = e.resume_cursor
+            hdrs = [("Retry-After", "1"), tid_hdr,
+                    ("x-rt-resume", _json.dumps(cursor))]
+            if cursor.get("digest"):
+                hdrs.append(("x-rt-affinity",
+                             ",".join(cursor["digest"])))
             return web.Response(
                 status=503,
                 body=_json.dumps({"error": str(e),
-                                  "resume_cursor": e.resume_cursor}
-                                 ).encode(),
+                                  "resume_cursor": cursor}).encode(),
                 content_type="application/json",
-                headers=[("Retry-After", "1"), tid_hdr])
+                headers=hdrs)
         except Exception as e:
             logger.exception("stream failed before first item")
             await aiter.aclose()

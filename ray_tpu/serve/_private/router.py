@@ -45,6 +45,7 @@ from typing import Any, AsyncIterator, Deque, Dict, List, Optional
 
 from ray_tpu._private import failpoints
 from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve._private.qos import DEFAULT_TENANT, TenantQoS
 from ray_tpu.serve.exceptions import StreamInterrupted
@@ -105,6 +106,16 @@ UNARY_RETRY_COUNTER = _metrics.Counter(
     "Unary calls retried on a different replica after actor death "
     "before first response",
     tag_keys=("deployment",))
+AFFINITY_HITS_COUNTER = _metrics.Counter(
+    "serve_kv_affinity_hits_total",
+    "Assignments routed to a replica already holding a prefix of the "
+    "request (prefix-affinity override of the load-based pick)",
+    tag_keys=("deployment",))
+AFFINITY_SCORE_GAUGE = _metrics.Gauge(
+    "serve_router_affinity_score",
+    "Blended affinity score of the last affinity-scored assignment "
+    "(blend * hit-depth - (1-blend) * load; negative = load dominated)",
+    tag_keys=("deployment",))
 
 _QOS_FROM_ENV = "__env__"
 
@@ -125,13 +136,15 @@ class _Waiter:
     """One queued acquisition under QoS: resolved with the chosen
     replica info dict by the WFQ dispatcher."""
 
-    __slots__ = ("fut", "tenant", "exclude", "tag")
+    __slots__ = ("fut", "tenant", "exclude", "tag", "hint")
 
-    def __init__(self, fut, tenant: str, exclude: tuple, tag: float):
+    def __init__(self, fut, tenant: str, exclude: tuple, tag: float,
+                 hint: Optional[Dict] = None):
         self.fut = fut
         self.tenant = tenant
         self.exclude = exclude
         self.tag = tag
+        self.hint = hint
 
 
 class ReplicaSet:
@@ -237,7 +250,8 @@ class ReplicaSet:
 
     # -------------------------------------------------- slot acquisition
     async def _acquire(self, timeout_s: float, tenant: str = None,
-                       exclude: tuple = (), admit: bool = True) -> Dict:
+                       exclude: tuple = (), admit: bool = True,
+                       hint: Optional[Dict] = None) -> Dict:
         """Wait (bounded) for a replica with a free slot; the caller owns
         one in-flight unit on the returned replica and must release it
         via _release(tag).  With a QoS policy installed, admission runs
@@ -249,14 +263,14 @@ class ReplicaSet:
         t0 = time.time()
         if self._qos is not None:
             choice = await self._acquire_qos(timeout_s, tenant, exclude,
-                                             admit)
+                                             admit, hint)
             self._record_wait(t0, time.time(), tenant, choice)
             return choice
         deadline = time.monotonic() + timeout_s
         self._set_queued(+1)
         try:
             while True:
-                choice = self._pick(exclude)
+                choice = self._pick(exclude, hint)
                 if choice is not None:
                     break
                 remain = deadline - time.monotonic()
@@ -288,7 +302,8 @@ class ReplicaSet:
                               "replica": choice["replica_tag"]})
 
     async def _acquire_qos(self, timeout_s: float, tenant: str,
-                           exclude: tuple, admit: bool = True) -> Dict:
+                           exclude: tuple, admit: bool = True,
+                           hint: Optional[Dict] = None) -> Dict:
         tenant = tenant or DEFAULT_TENANT
         dq = self._waiters.get(tenant)
         if dq:
@@ -303,7 +318,7 @@ class ReplicaSet:
             self._qos.admit(self.deployment_name, tenant, queued_now)
         loop = asyncio.get_running_loop()
         w = _Waiter(loop.create_future(), tenant, tuple(exclude or ()),
-                    self._qos.start_tag(tenant))
+                    self._qos.start_tag(tenant), hint)
         self._waiters.setdefault(
             tenant, collections.deque()).append(w)
         self._set_queued(+1)
@@ -372,7 +387,7 @@ class ReplicaSet:
             heads.sort(key=lambda x: x.tag)
             placed = False
             for w in heads:
-                choice = self._pick(w.exclude)
+                choice = self._pick(w.exclude, w.hint)
                 if choice is None:
                     continue  # only excluded replicas free; try others
                 dq = self._waiters.get(w.tenant)
@@ -426,7 +441,8 @@ class ReplicaSet:
     async def assign_replica(self, method_name: str, args: tuple,
                              kwargs: dict,
                              timeout_s: float = 120.0,
-                             tenant: str = None) -> Any:
+                             tenant: str = None,
+                             affinity: Optional[Dict] = None) -> Any:
         """Pick a replica (power-of-two-choices among free ones), send the
         query, and release the slot when it completes.  Bounded: a request
         that can't be assigned within timeout_s (no replicas — deployment
@@ -444,14 +460,17 @@ class ReplicaSet:
         while True:
             choice = await self._acquire(timeout_s, tenant=tenant,
                                          exclude=exclude,
-                                         admit=attempt == 0)
+                                         admit=attempt == 0,
+                                         hint=affinity)
             tag = choice["replica_tag"]
+            span_args = {"deployment": self.deployment_name,
+                         "replica": tag, "attempt": attempt}
+            if choice.get("_affinity"):
+                span_args["affinity"] = choice["_affinity"]
             try:
                 try:
                     with _tracing.span(
-                            "serve", "serve.assign",
-                            args={"deployment": self.deployment_name,
-                                  "replica": tag, "attempt": attempt}):
+                            "serve", "serve.assign", args=span_args):
                         return await self._call_unary(
                             choice, method_name, args, kwargs)
                 except _death_errors() as e:
@@ -497,7 +516,9 @@ class ReplicaSet:
                                     kwargs: dict,
                                     timeout_s: float = 120.0,
                                     unary_fallback: bool = False,
-                                    tenant: str = None
+                                    tenant: str = None,
+                                    affinity: Optional[Dict] = None,
+                                    resume: Optional[Dict] = None
                                     ) -> AsyncIterator:
         """Streaming twin of assign_replica: starts a generator-valued
         call on one replica and returns an async iterator over its
@@ -519,7 +540,14 @@ class ReplicaSet:
         replica; with unary_fallback the iterator yields its value
         wrapped in _UnaryResult (proxy path — degrade to a plain
         response), otherwise it raises TypeError (handle.stream() on a
-        unary method is caller error)."""
+        unary method is caller error).
+
+        `affinity` is the request's routing hint ({"tokens": [...]} or
+        {"fps": [...]}); `resume` seeds the stream from a CLIENT-HELD
+        cursor (x-rt-resume: the items a previous, interrupted stream
+        already delivered, plus the dead origin's kv_origin pull
+        address) — the first replica call then behaves exactly like an
+        internal failover re-submission."""
 
         async def _gen():
             # Everything — INCLUDING slot acquisition — happens inside
@@ -536,12 +564,45 @@ class ReplicaSet:
             exclude: tuple = ()
             failovers = 0
             resumable = False
+            origin_rdv = None
+            last_page = 0
+
+            def _cursor_extras() -> Dict:
+                """KV extras for an outgoing StreamInterrupted cursor:
+                the origin's pull address and the request's prefix
+                fingerprints (at the last-seen replica's page size), so
+                a client resuming through a DIFFERENT proxy re-enters
+                with affinity and can still migrate the pages."""
+                out: Dict[str, Any] = {}
+                if origin_rdv:
+                    out["kv_origin"] = origin_rdv
+                fps = (affinity or {}).get("fps")
+                if not fps and affinity and affinity.get("tokens") \
+                        and last_page:
+                    from ray_tpu.serve.llm.paging import \
+                        prefix_fingerprints
+                    fps = prefix_fingerprints(
+                        affinity["tokens"], last_page,
+                        _cfg.serve_affinity_digest_depth)
+                if fps:
+                    out["digest"] = list(fps)
+                return out
+
+            if resume:
+                # Client-held cursor: only its UNDELIVERED suffix flows
+                # from here on — delivered_n/items count as if this
+                # router had streamed them itself.
+                delivered = list(resume.get("items") or [])
+                delivered_n = int(resume.get("delivered")
+                                  or len(delivered))
+                origin_rdv = resume.get("kv_origin")
             while True:
                 try:
                     choice = await self._acquire(timeout_s,
                                                  tenant=tenant,
                                                  exclude=exclude,
-                                                 admit=failovers == 0)
+                                                 admit=failovers == 0,
+                                                 hint=affinity)
                 except Exception as e:
                     if failovers == 0:
                         raise
@@ -558,9 +619,12 @@ class ReplicaSet:
                         f"not place the stream: {e})",
                         deployment=self.deployment_name,
                         method=method_name, delivered=delivered_n,
-                        resumable=resumable, cause=repr(e)) from e
+                        resumable=resumable, cause=repr(e),
+                        **_cursor_extras()) from e
                 tag = choice["replica_tag"]
                 actor = choice["actor"]
+                last_page = int((choice.get("kv_digest") or {})
+                                .get("page") or 0)
                 finished = False
                 stream_id = None
                 try:
@@ -569,6 +633,13 @@ class ReplicaSet:
                         if delivered_n:
                             resume_state = {"delivered": delivered_n,
                                             "items": list(delivered)}
+                            if origin_rdv \
+                                    and origin_rdv != choice.get("kv_rdv"):
+                                # The dead origin's pull address rides
+                                # the cursor: the resuming replica can
+                                # MIGRATE the committed pages instead of
+                                # re-prefilling the whole prefix.
+                                resume_state["kv_origin"] = origin_rdv
                         t_assign = time.time()
                         started = await self._stream_rpc(
                             actor.handle_request_streaming.remote(
@@ -576,14 +647,24 @@ class ReplicaSet:
                                 resume_state))
                         # serve.assign: replica chosen → stream started
                         # (the replica-side admission RPC round trip).
+                        assign_args = {"deployment":
+                                       self.deployment_name,
+                                       "replica": tag,
+                                       "failover": failovers,
+                                       "resumed": delivered_n}
+                        if choice.get("_affinity"):
+                            assign_args["affinity"] = \
+                                choice["_affinity"]
+                        if resume_state \
+                                and resume_state.get("kv_origin"):
+                            assign_args["kv_origin"] = \
+                                f"{origin_rdv.get('host')}:" \
+                                f"{origin_rdv.get('port')}"
                         _tracing.record(
                             "serve", "serve.assign", t_assign,
                             time.time() - t_assign,
                             trace=_tracing.child_span(),
-                            args={"deployment": self.deployment_name,
-                                  "replica": tag,
-                                  "failover": failovers,
-                                  "resumed": delivered_n})
+                            args=assign_args)
                         if "stream_id" not in started:
                             finished = True
                             if not unary_fallback:
@@ -630,6 +711,13 @@ class ReplicaSet:
                         # stream nobody will poll again (a truly dead
                         # actor just drops the cancel).
                         self._drop_replica(tag)
+                        # Remember where the dead replica's KV pages
+                        # can be pulled from — the HOST may be alive
+                        # even when the replica's actor transport is
+                        # not (injected faults, wedged streams), and a
+                        # dead process just makes the pull fail fast
+                        # into re-prefill.
+                        origin_rdv = choice.get("kv_rdv") or origin_rdv
                         can_failover = (
                             self._stream_failover
                             and failovers < self._max_failovers
@@ -684,7 +772,8 @@ class ReplicaSet:
                             method=method_name,
                             delivered=delivered_n,
                             resumable=resumable,
-                            cause=repr(e)) from e
+                            cause=repr(e),
+                            **_cursor_extras()) from e
                 finally:
                     if stream_id is not None and not finished:
                         # Early close / client gone: free the replica-
@@ -703,7 +792,8 @@ class ReplicaSet:
         gen = _gen()
         return _tracing.bind_agen(gen, ctx) if ctx is not None else gen
 
-    def _pick(self, exclude: tuple = ()) -> Optional[Dict]:
+    def _pick(self, exclude: tuple = (),
+              hint: Optional[Dict] = None) -> Optional[Dict]:
         if self._suppressed:
             now = asyncio.get_event_loop().time()
             for t, dl in list(self._suppressed.items()):
@@ -716,12 +806,90 @@ class ReplicaSet:
                 < r["max_concurrent_queries"]]
         if not free:
             return None
+        if hint and _cfg.serve_affinity \
+                and (hint.get("tokens") or hint.get("fps")):
+            choice = self._pick_affinity(free, hint)
+            if choice is not None:
+                return choice
         if len(free) == 1:
             return free[0]
         # Power of two choices: least-loaded of two random candidates.
         a, b = random.sample(free, 2)
         return a if (self._in_flight.get(a["replica_tag"], 0)
                      <= self._in_flight.get(b["replica_tag"], 0)) else b
+
+    def _load_norm(self, r: Dict) -> float:
+        return (self._in_flight.get(r["replica_tag"], 0)
+                / max(1, r["max_concurrent_queries"]))
+
+    def _hint_fps(self, hint: Dict, page: int,
+                  cache: Dict[int, List[str]]) -> List[str]:
+        """The request's prefix fingerprint chain at a replica's page
+        size.  Token hints are re-fingerprinted per distinct page size
+        seen (cached per pick); a raw-fps hint (x-rt-affinity, resume
+        cursor) only matches replicas with the page size it was minted
+        at — the chained digests simply never collide otherwise."""
+        tokens = hint.get("tokens")
+        if tokens and page > 0:
+            fps = cache.get(page)
+            if fps is None:
+                from ray_tpu.serve.llm.paging import prefix_fingerprints
+                fps = cache[page] = prefix_fingerprints(
+                    tokens, page, _cfg.serve_affinity_digest_depth)
+            return fps
+        return hint.get("fps") or []
+
+    def _pick_affinity(self, free: List[Dict],
+                       hint: Dict) -> Optional[Dict]:
+        """Prefix-affinity scoring: per candidate,
+        ``score = blend * hit_depth/chain_len - (1-blend) * load`` where
+        hit_depth is the DEEPEST request fingerprint in the replica's
+        published digest (fingerprints chain, so depth d present implies
+        the whole d-page prefix is cached).  Returns None — falling back
+        to the load-based power-of-two pick — when no candidate holds
+        any prefix, or when the winner is past the hotspot bound: a
+        viral prefix concentrates hits on one replica, and affinity must
+        lose to overload there rather than starve it."""
+        blend = _cfg.serve_affinity_blend
+        fps_cache: Dict[int, List[str]] = {}
+        best = best_meta = best_key = None
+        for r in free:
+            dig = r.get("kv_digest") or {}
+            fps = self._hint_fps(hint, int(dig.get("page") or 0),
+                                 fps_cache)
+            if not fps:
+                continue
+            have = {x.get("fp") for x in (dig.get("roots") or ())}
+            hits = 0
+            for d, fp in enumerate(fps, 1):
+                if fp in have:
+                    hits = d
+            load = self._load_norm(r)
+            score = blend * (hits / len(fps)) - (1.0 - blend) * load
+            key = (score, -load)
+            if best_key is None or key > best_key:
+                best, best_key = r, key
+                best_meta = {"hits": hits, "chain": len(fps),
+                             "score": round(score, 4),
+                             "load": round(load, 4)}
+        if best is None or not best_meta["hits"]:
+            return None
+        AFFINITY_SCORE_GAUGE.set(best_meta["score"],
+                                 tags={"deployment":
+                                       self.deployment_name})
+        if best_meta["load"] >= _cfg.serve_affinity_hotspot_bound:
+            _tracing.event("serve", "serve.affinity_diverted",
+                           args={"deployment": self.deployment_name,
+                                 "replica": best["replica_tag"],
+                                 **best_meta})
+            return None
+        AFFINITY_HITS_COUNTER.inc(
+            tags={"deployment": self.deployment_name})
+        # A shallow copy so the decision can ride to the serve.assign
+        # span without mutating the shared membership info dict.
+        choice = dict(best)
+        choice["_affinity"] = best_meta
+        return choice
 
     def stats(self) -> Dict:
         return {"queued": self.num_queued,
@@ -745,14 +913,18 @@ class Router:
             loop=loop)
 
     async def assign_request(self, method_name: str, args: tuple,
-                             kwargs: dict, tenant: str = None):
+                             kwargs: dict, tenant: str = None,
+                             affinity: Optional[Dict] = None):
         return await self.replica_set.assign_replica(
-            method_name, args, kwargs, tenant=tenant)
+            method_name, args, kwargs, tenant=tenant, affinity=affinity)
 
     async def assign_request_stream(self, method_name: str, args: tuple,
-                                    kwargs: dict, tenant: str = None):
+                                    kwargs: dict, tenant: str = None,
+                                    affinity: Optional[Dict] = None,
+                                    resume: Optional[Dict] = None):
         return await self.replica_set.assign_replica_stream(
-            method_name, args, kwargs, tenant=tenant)
+            method_name, args, kwargs, tenant=tenant, affinity=affinity,
+            resume=resume)
 
     def stop(self):
         self._long_poll.stop()

@@ -29,33 +29,52 @@ class StreamInterrupted(RuntimeError):
     def __init__(self, message: str, *, deployment: str = "",
                  method: str = "", delivered: int = 0,
                  resumable: bool = False,
-                 cause: Optional[str] = None):
+                 cause: Optional[str] = None,
+                 kv_origin: Optional[Dict] = None,
+                 digest: Optional[list] = None):
         super().__init__(message)
         self.deployment = deployment
         self.method = method
         self.delivered = delivered
         self.resumable = resumable
         self.cause = cause
+        # KV-affinity cursor extras (both optional): where the dead
+        # replica's committed pages can still be pulled from, and the
+        # request's prefix fingerprints — a client resuming through a
+        # DIFFERENT proxy replays these (x-rt-resume / x-rt-affinity)
+        # so the resumed stream lands with affinity and can migrate the
+        # pages instead of re-prefilling.
+        self.kv_origin = kv_origin
+        self.digest = digest
 
     @property
     def resume_cursor(self) -> Dict[str, Any]:
         """Everything a holder of the original (method, args, kwargs)
-        needs to resume: where the stream stopped and whether the
-        deployment supports server-side resumption."""
-        return {"deployment": self.deployment, "method": self.method,
-                "delivered": self.delivered, "resumable": self.resumable}
+        needs to resume: where the stream stopped, whether the
+        deployment supports server-side resumption, and (when known)
+        the KV affinity extras."""
+        cur = {"deployment": self.deployment, "method": self.method,
+               "delivered": self.delivered, "resumable": self.resumable}
+        if self.kv_origin:
+            cur["kv_origin"] = self.kv_origin
+        if self.digest:
+            cur["digest"] = list(self.digest)
+        return cur
 
     def __reduce__(self):
         return (_rebuild_stream_interrupted,
                 (self.args[0] if self.args else "", self.deployment,
-                 self.method, self.delivered, self.resumable, self.cause))
+                 self.method, self.delivered, self.resumable, self.cause,
+                 self.kv_origin, self.digest))
 
 
 def _rebuild_stream_interrupted(msg, deployment, method, delivered,
-                                resumable, cause):
+                                resumable, cause, kv_origin=None,
+                                digest=None):
     return StreamInterrupted(msg, deployment=deployment, method=method,
                              delivered=delivered, resumable=resumable,
-                             cause=cause)
+                             cause=cause, kv_origin=kv_origin,
+                             digest=digest)
 
 
 class TenantThrottled(RuntimeError):

@@ -12,10 +12,11 @@ decodes in.  Admission is free-page-bounded, chunked prefill never
 stalls decoding for more than one chunk, and with temperature=0 every
 request's tokens are bit-identical to decode.generate() run alone.
 
-    engine.py     GenerationEngine + TokenStream (the device loop)
-    paging.py     BlockAllocator + RadixPrefixCache (page bookkeeping)
-    scheduler.py  FCFS admission queue with structured backpressure
-    api.py        LLMServer deployment: generate()/stream()/HTTP+SSE
+    engine.py      GenerationEngine + TokenStream (the device loop)
+    paging.py      BlockAllocator + RadixPrefixCache (page bookkeeping)
+    scheduler.py   FCFS admission queue with structured backpressure
+    api.py         LLMServer deployment: generate()/stream()/HTTP+SSE
+    kv_transfer.py live KV-page migration over the transfer plane
 """
 
 from ray_tpu.serve.llm.engine import (  # noqa: F401
@@ -32,9 +33,10 @@ from ray_tpu.serve.llm.scheduler import (  # noqa: F401
     FCFSScheduler,
 )
 from ray_tpu.serve.llm.api import LLMServer, llm_deployment  # noqa: F401
+from ray_tpu.serve.llm import kv_transfer  # noqa: F401
 
 __all__ = [
     "BlockAllocator", "EngineOverloadedError", "EngineStats",
     "FCFSScheduler", "GenerationEngine", "LLMServer",
-    "RadixPrefixCache", "TokenStream", "llm_deployment",
+    "RadixPrefixCache", "TokenStream", "kv_transfer", "llm_deployment",
 ]

@@ -21,7 +21,9 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
+from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu.serve.exceptions import resumable
+from ray_tpu.serve.llm import kv_transfer
 from ray_tpu.serve.llm.engine import GenerationEngine
 from ray_tpu.serve.llm.scheduler import EngineOverloadedError
 
@@ -109,6 +111,7 @@ class LLMServer:
         tokens, remaining = self._trim_for_resume(tokens, kw, _resume)
         if remaining <= 0:
             return
+        await self._maybe_pull_kv(_resume, tokens)
         stream = self.engine.submit(tokens, **kw)
         try:
             async for tok in stream:
@@ -126,8 +129,76 @@ class LLMServer:
         (picked up via the replica's get_autoscale_metrics): decode
         queue depth, slot occupancy, and KV page headroom — so scaling
         tracks what the ENGINE is actually short of, not just the
-        request count."""
-        return self.engine.load_info()
+        request count.  With affinity on the gauges also carry the
+        engine's prefix digest (kv_digest, set by load_info) and this
+        replica's migration pull address (kv_rdv) — the broadcast that
+        already reaches the router teaches it both WHERE prefixes live
+        and how to ship their pages, with zero extra RPCs."""
+        info = self.engine.load_info()
+        if _cfg.serve_affinity:
+            rdv = kv_transfer.rendezvous(self.engine)
+            if rdv is not None:
+                info["kv_rdv"] = rdv
+        return info
+
+    async def _maybe_pull_kv(self, _resume: Optional[Dict],
+                             tokens: Sequence[int]) -> int:
+        """A failover cursor names the dead stream's origin replica
+        (kv_origin): pull its committed pages for prompt + delivered
+        tokens before submitting, so the resume's prefill collapses to
+        a prefix-cache hit.  Best-effort by design — any failure means
+        re-prefill, never a corrupt cache (pull_kv_pages's contract)."""
+        rdv = (_resume or {}).get("kv_origin")
+        if not rdv or not _cfg.serve_affinity:
+            return 0
+        mine = kv_transfer.rendezvous(self.engine)
+        if mine is not None and mine == rdv:
+            return 0  # resumed onto the origin itself: pages already here
+        return await kv_transfer.pull_kv_pages(rdv, tokens, self.engine)
+
+    # -- KV migration control surface (router / controller RPCs) -------
+
+    def kv_rendezvous(self) -> Optional[Dict]:
+        """Where a peer can pull this replica's KV pages from."""
+        return kv_transfer.rendezvous(self.engine)
+
+    def kv_drain_manifest(self, top_k: int = 8) -> Optional[Dict]:
+        """Drain handoff, origin side: this replica's pull address plus
+        the token paths of its hottest cached prefixes.  The controller
+        fetches this from a DRAINING replica and hands it to the chosen
+        survivor's kv_pull_from — the survivor pulls, so teardown
+        ordering stays trivial (the origin just keeps serving exports
+        until its pages have been copied out)."""
+        rdv = kv_transfer.rendezvous(self.engine)
+        if rdv is None:
+            return None
+        prefixes = self.engine.run_on_worker(
+            lambda: self.engine.kv_hot_prefixes(top_k))
+        prefixes = [p for p in prefixes
+                    if len(p) >= _cfg.serve_kv_min_migrate_pages
+                    * self.engine.page_size]
+        if not prefixes:
+            return None
+        return {"rdv": rdv, "prefixes": prefixes}
+
+    async def kv_pull_from(self, manifest: Dict) -> int:
+        """Drain handoff, survivor side: pull each offered prefix from
+        the draining origin.  Copies, not moves — the origin's pages
+        are untouched, so an un-drain mid-flight cannot double-count
+        anything; its copies simply age out of both caches normally."""
+        total = 0
+        for toks in (manifest or {}).get("prefixes", []):
+            total += await kv_transfer.pull_kv_pages(
+                manifest["rdv"], toks, self.engine)
+        return total
+
+    def trace_spans(self, prefix: str = "engine.") -> List[Dict]:
+        """Spans from THIS replica process's trace ring (the bench's
+        TTFT-attribution probe: engine.queue / engine.prefill /
+        engine.first_tick live here, not in the client process)."""
+        from ray_tpu._private import tracing as _tracing
+        return [e for e in _tracing.ring().snapshot(clear=False)
+                if str(e.get("name", "")).startswith(prefix)]
 
     def check_health(self):
         if not self.engine.running:
@@ -170,6 +241,7 @@ class LLMServer:
                     body["tokens"], kw, _resume)
                 if remaining <= 0:
                     return self._no_events()
+                await self._maybe_pull_kv(_resume, toks)
                 stream = self.engine.submit(toks, **kw)
                 return self._sse_events(stream)
             out = await self.engine.generate(body["tokens"], **kw)

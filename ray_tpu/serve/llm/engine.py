@@ -517,6 +517,12 @@ class GenerationEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._started_t = time.monotonic()
+        # Worker-thread command queue (run_on_worker): KV export/import
+        # and other paging surgery run BETWEEN ticks on the one thread
+        # that owns the device + paging state — the single-owner
+        # discipline stays intact and a migration can never stall a
+        # tick mid-dispatch.
+        self._commands: collections.deque = collections.deque()
 
         # Device + paging state (worker-thread-owned after start).
         # Page 0 is the trash page: every inactive row's block table
@@ -524,8 +530,10 @@ class GenerationEngine:
         self._cache = decode.init_paged_cache(
             cfg, self.kv_pages + 1, self.page_size)
         self._alloc = BlockAllocator(self.kv_pages, first_page=1)
-        self._prefix = (RadixPrefixCache(self.page_size, self._alloc)
-                        if enable_prefix_cache else None)
+        self._prefix = (RadixPrefixCache(
+            self.page_size, self._alloc,
+            digest_depth=_cfg.serve_affinity_digest_depth)
+            if enable_prefix_cache else None)
         self._block_tables = np.zeros((num_slots, self._max_blocks),
                                       np.int32)
         self._pos = np.zeros((num_slots,), np.int32)
@@ -588,7 +596,12 @@ class GenerationEngine:
                 leftovers.append(self._prefill.req)
                 self._prefill = None
             self._committed_blocks = 0
+            commands, self._commands = \
+                list(self._commands), collections.deque()
             QUEUE_GAUGE.set(0, tags=self._tags)
+        for _fn, fut in commands:
+            if not fut.done():
+                fut.set_exception(RuntimeError("engine stopped"))
         for req in leftovers:
             req.stream._finish(err)
         if t is not None and t.is_alive():
@@ -693,6 +706,131 @@ class GenerationEngine:
         """submit() + collect(): the whole generation as a list."""
         return await self.submit(prompt, **kw).collect()
 
+    # ------------------------------------------------------------------
+    # Worker commands (KV migration surface)
+
+    def run_on_worker(self, fn, timeout: float = 30.0):
+        """Run fn() on the engine worker thread between ticks and
+        return its result.  The worker owns the device handle and every
+        paging structure; a command is how any other thread touches
+        them — same single-owner rule the tick itself relies on.
+        Blocks the CALLING thread only (never the tick)."""
+        import concurrent.futures as _cf
+        fut: _cf.Future = _cf.Future()
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("engine stopped")
+            self._commands.append((fn, fut))
+            self._cond.notify_all()
+        self.start()
+        return fut.result(timeout)
+
+    def _drain_commands(self):
+        while True:
+            with self._cond:
+                if not self._commands:
+                    return
+                fn, fut = self._commands.popleft()
+            try:
+                res = fn()
+            except BaseException as e:  # fail THIS command only
+                if not fut.done():
+                    fut.set_exception(e)
+            else:
+                if not fut.done():
+                    fut.set_result(res)
+
+    def kv_export(self, tokens: Sequence[int]) -> Optional[Dict]:
+        """Worker command: snapshot the K/V pages of `tokens`' longest
+        cached full-page prefix, page-major on host.  The matched pages
+        are INCREF'd before anything else — an eviction racing the
+        migration can drop the radix nodes but never recycle the pages
+        under the wire — and stay pinned until kv_export_release().
+        Returns {"pages", "matched_tokens", "k", "v"} or None when
+        nothing is cached (no full page match, or no prefix cache)."""
+        if self._prefix is None:
+            return None
+        tokens = [int(t) for t in tokens]
+        pages, matched = self._prefix.match(tokens)
+        if not pages:
+            return None
+        for p in pages:
+            self._alloc.incref(p)
+        try:
+            k, v = decode.paged_read_pages(
+                self._cache,
+                jnp.asarray(np.asarray(pages, np.int32)))
+            k = np.ascontiguousarray(k)
+            v = np.ascontiguousarray(v)
+        except BaseException:
+            for p in pages:
+                self._alloc.decref(p)
+            raise
+        return {"pages": list(pages), "matched_tokens": matched,
+                "k": k, "v": v}
+
+    def kv_export_release(self, pages: Sequence[int]) -> None:
+        """Worker command: drop the export pins taken by kv_export —
+        called only after the destination sealed (or the migration
+        aborted), so the origin's pages outlive the transfer."""
+        for p in pages:
+            self._alloc.decref(p)
+        self._update_kv_gauges()
+
+    def kv_import(self, tokens: Sequence[int], k: np.ndarray,
+                  v: np.ndarray) -> int:
+        """Worker command: land migrated K/V pages (page-major
+        [n, L, page_size, Hkv, Dh] host arrays for tokens' full pages)
+        into freshly reserved pool pages and publish them in the radix
+        cache.  Pages this replica already holds are skipped; on any
+        failure the reservation is released whole — the cache is never
+        left referencing a partially written page.  Returns the number
+        of pages imported (0 = re-prefill instead)."""
+        if self._prefix is None:
+            return 0
+        tokens = [int(t) for t in tokens]
+        psz = self.page_size
+        usable = min(len(k), len(tokens) // psz)
+        have, _ = self._prefix.match(tokens, max_tokens=usable * psz)
+        start = len(have)
+        if start >= usable:
+            return 0
+        need = usable - start
+        got = self._alloc.alloc(need)
+        if got is None \
+                and self._alloc.free_pages + self._prefix.releasable() \
+                >= need:
+            self._prefix.evict(need)
+            got = self._alloc.alloc(need)
+        if got is None:
+            return 0  # pool too hot to host the import: re-prefill
+        try:
+            self._cache = decode.paged_write_pages(
+                self._cache, jnp.asarray(np.asarray(got, np.int32)),
+                jnp.asarray(k[start:usable]),
+                jnp.asarray(v[start:usable]))
+            self._prefix.insert(tokens[:usable * psz],
+                                list(have) + list(got))
+        except BaseException:
+            for p in got:
+                self._alloc.decref(p)
+            self._update_kv_gauges()
+            raise
+        # insert() increfs each NEW node's page; our allocation ref is
+        # now redundant — the radix tree is the sole owner, exactly as
+        # if these pages had been prefilled and released here.
+        for p in got:
+            self._alloc.decref(p)
+        self._update_kv_gauges()
+        return need
+
+    def kv_hot_prefixes(self, top_k: int) -> List[List[int]]:
+        """Worker command: token sequences of the hottest cached
+        prefixes (drain migration walks these)."""
+        if self._prefix is None:
+            return []
+        return self._prefix.hot_prefixes(top_k)
+
     def load_info(self) -> Dict[str, int]:
         """The autoscaler's saturation gauges, as plain field reads —
         polled every control-loop tick, so no EngineStats construction
@@ -709,6 +847,17 @@ class GenerationEngine:
             samples = sorted(self._recent_ttft)
             info["ttft_p99_s"] = samples[
                 min(len(samples) - 1, int(len(samples) * 0.99))]
+        if self._prefix is not None and _cfg.serve_affinity:
+            try:
+                # Racy-but-safe read of the worker-owned digest index
+                # (best-effort gauge: a poll that loses the race just
+                # publishes the previous digest next tick).
+                info["kv_digest"] = {
+                    "page": self.page_size,
+                    "roots": self._prefix.digest(
+                        _cfg.serve_affinity_digest_top_k)}
+            except RuntimeError:
+                pass  # index mutated mid-iteration; skip this sample
         return info
 
     def stats(self) -> EngineStats:
@@ -750,6 +899,10 @@ class GenerationEngine:
                     self._cond.wait(timeout=0.1)
                 if self._stop:
                     return
+            # Commands (KV export/import) run BETWEEN ticks: they own
+            # the device + paging state for their duration, and their
+            # failures are their caller's, never the batch's.
+            self._drain_commands()
             try:
                 self._admit_one_chunk()
                 self._decode_tick()
@@ -785,6 +938,7 @@ class GenerationEngine:
 
     def _has_work_locked(self) -> bool:
         return (self._scheduler.depth > 0 or self._prefill is not None
+                or bool(self._commands)
                 or any(r is not None for r in self._slots))
 
     def _free_slot(self) -> Optional[int]:
@@ -1159,7 +1313,9 @@ class GenerationEngine:
     def _reset_paging(self):
         self._alloc = BlockAllocator(self.kv_pages, first_page=1)
         if self._prefix is not None:
-            self._prefix = RadixPrefixCache(self.page_size, self._alloc)
+            self._prefix = RadixPrefixCache(
+                self.page_size, self._alloc,
+                digest_depth=_cfg.serve_affinity_digest_depth)
         self._block_tables[:] = 0
         self._update_kv_gauges()
 
@@ -1168,7 +1324,12 @@ class GenerationEngine:
             pf, self._prefill = self._prefill, None
             leftovers = self._scheduler.drain()
             self._committed_blocks = 0
+            commands, self._commands = \
+                list(self._commands), collections.deque()
             QUEUE_GAUGE.set(0, tags=self._tags)
+        for _fn, fut in commands:
+            if not fut.done():
+                fut.set_exception(err)
         if pf is not None:
             pf.req.stream._finish(err)
         for req in leftovers:

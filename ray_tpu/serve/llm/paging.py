@@ -25,7 +25,35 @@ worker thread (admission/eviction), never concurrently.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _chunk_fp(parent_fp: str, key: Sequence[int]) -> str:
+    """Fingerprint of one full page of tokens, chained off the parent
+    page's fingerprint — so one fingerprint names an entire prefix, and
+    two processes that never exchanged state agree on it.  blake2b (not
+    Python hash(): that is salted per process) over little-endian token
+    ids; 8-byte digests keep a whole top-K digest under ~1 KB."""
+    h = hashlib.blake2b(parent_fp.encode("ascii"), digest_size=8)
+    for t in key:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.hexdigest()
+
+
+def prefix_fingerprints(tokens: Sequence[int], page_size: int,
+                        max_depth: int) -> List[str]:
+    """Fingerprints of a prompt's full-page prefixes, shallowest first:
+    out[d-1] names tokens[: d * page_size].  The router computes these
+    for an incoming prompt and intersects them with replicas' published
+    digests; the radix cache computes the same chain incrementally at
+    insert time, so equality means the replica holds that prefix."""
+    out: List[str] = []
+    fp = ""
+    for i in range(min(max_depth, len(tokens) // page_size)):
+        fp = _chunk_fp(fp, tokens[i * page_size:(i + 1) * page_size])
+        out.append(fp)
+    return out
 
 
 class BlockAllocator:
@@ -89,7 +117,8 @@ class BlockAllocator:
 
 
 class _RadixNode:
-    __slots__ = ("children", "page", "parent", "key", "last_used")
+    __slots__ = ("children", "page", "parent", "key", "last_used",
+                 "fp", "depth")
 
     def __init__(self, key, page, parent):
         self.children: Dict[tuple, "_RadixNode"] = {}
@@ -97,6 +126,8 @@ class _RadixNode:
         self.page = page
         self.parent = parent
         self.last_used = 0
+        self.fp = ""      # chained prefix fingerprint (root: "")
+        self.depth = 0    # pages from root (root: 0)
 
 
 class RadixPrefixCache:
@@ -108,7 +139,8 @@ class RadixPrefixCache:
     (decode writes land in it).
     """
 
-    def __init__(self, page_size: int, allocator: BlockAllocator):
+    def __init__(self, page_size: int, allocator: BlockAllocator,
+                 digest_depth: int = 8):
         if page_size < 1:
             raise ValueError("page_size must be >= 1")
         self.page_size = page_size
@@ -116,6 +148,13 @@ class RadixPrefixCache:
         self._root = _RadixNode(None, None, None)
         self._clock = 0
         self.nodes = 0
+        # Affinity digest index: fingerprint -> node, maintained
+        # incrementally for nodes at depth <= digest_depth (fingerprints
+        # chain off the parent, so one entry names a whole prefix).  The
+        # depth cap bounds the index — and the digest the router sees —
+        # independent of how deep the trie grows.
+        self.digest_depth = digest_depth
+        self._fp_index: Dict[str, _RadixNode] = {}
 
     def match(self, tokens: Sequence[int], max_tokens: Optional[int] = None
               ) -> Tuple[List[int], int]:
@@ -160,6 +199,10 @@ class RadixPrefixCache:
             child = node.children.get(key)
             if child is None:
                 child = _RadixNode(key, page, node)
+                child.depth = node.depth + 1
+                if child.depth <= self.digest_depth:
+                    child.fp = _chunk_fp(node.fp, key)
+                    self._fp_index[child.fp] = child
                 node.children[key] = child
                 self._alloc.incref(page)
                 self.nodes += 1
@@ -167,6 +210,72 @@ class RadixPrefixCache:
             child.last_used = self._clock
             node = child
         return added
+
+    def _unindex(self, node: _RadixNode) -> None:
+        if node.fp and self._fp_index.get(node.fp) is node:
+            del self._fp_index[node.fp]
+
+    def digest(self, top_k: int) -> List[Dict]:
+        """The replica's affinity digest: the top_k most recently used
+        MAXIMAL indexed prefixes as [{"fp", "d"}].  The router scores by
+        the deepest request fingerprint present in the digest, and a
+        depth-d entry implies the whole d-page prefix is cached — so an
+        ancestor of an advertised node carries zero information and
+        advertising it would waste a top_k slot (with 8-deep chains,
+        raw-node top-K covers 8x fewer distinct prefixes).  Recency
+        ties break deepest-first for the same reason as hot_prefixes:
+        a path touched as one unit stamps every node the same clock.
+        Bounded by both top_k and digest_depth, so it stays gauge-sized
+        however big the trie is."""
+        out: List[Dict] = []
+        picked: List[_RadixNode] = []
+        for n in sorted(self._fp_index.values(),
+                        key=lambda n: (-n.last_used, -n.depth)):
+            if len(out) >= top_k:
+                break
+            if any(self._is_ancestor(n, p) for p in picked):
+                continue  # implied by a deeper advertised node
+            picked.append(n)
+            out.append({"fp": n.fp, "d": n.depth})
+        return out
+
+    def prefix_tokens(self, node: _RadixNode) -> List[int]:
+        out: List[int] = []
+        while node is not self._root and node is not None:
+            out[:0] = node.key
+            node = node.parent
+        return out
+
+    def hot_prefixes(self, top_k: int) -> List[List[int]]:
+        """Token sequences of the hottest cached prefixes, maximal
+        paths only (a selected node's ancestors are implied — the
+        destination's longest-prefix match recovers them for free).
+        Drain migration walks these to re-home still-referenced pages
+        before teardown."""
+        picked: List[_RadixNode] = []
+        # Depth breaks recency ties deepest-first: a path touched as one
+        # unit stamps every node the same clock, and without the
+        # tiebreak the shallow ancestor would be picked before the deep
+        # node it is implied by.
+        for n in sorted(self._fp_index.values(),
+                        key=lambda n: (-n.last_used, -n.depth)):
+            if len(picked) >= top_k:
+                break
+            if any(self._is_ancestor(n, p) for p in picked):
+                continue
+            picked.append(n)
+        picked = [n for n in picked
+                  if not any(n is not p and self._is_ancestor(n, p)
+                             for p in picked)]
+        return [self.prefix_tokens(n) for n in picked]
+
+    @staticmethod
+    def _is_ancestor(a: _RadixNode, b: _RadixNode) -> bool:
+        while b is not None:
+            if b is a:
+                return True
+            b = b.parent
+        return False
 
     def releasable(self) -> int:
         """Pages the tree could actually FREE by evicting everything:
@@ -224,6 +333,7 @@ class RadixPrefixCache:
                 continue  # touched since snapshot: re-sort by recency
             parent = victim.parent
             del parent.children[victim.key]
+            self._unindex(victim)
             self._alloc.decref(victim.page)
             self.nodes -= 1
             dropped += 1
@@ -239,4 +349,5 @@ class RadixPrefixCache:
             stack.extend(node.children.values())
             self._alloc.decref(node.page)
         self._root.children.clear()
+        self._fp_index.clear()
         self.nodes = 0

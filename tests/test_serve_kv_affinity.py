@@ -1,0 +1,676 @@
+"""KV-aware serving: prefix-affinity routing + live KV-page migration.
+
+The contract under test (PR 18): replicas publish bounded radix-root
+digests through the autoscale gauges; the router scores candidates by
+expected prefix-hit depth blended with load (affinity LOSES to overload
+past the hotspot bound); a resumed stream pulls the dead origin's
+committed pages over the transfer plane instead of re-prefilling —
+verbatim page copies, so greedy output stays bit-identical across a
+mid-stream hop — and any migration failure degrades to re-prefill,
+never to a corrupt cache.  Drain ships still-referenced pages to the
+least-loaded survivor before teardown.
+"""
+
+import asyncio
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+from ray_tpu.models import decode, gpt, llama
+from ray_tpu.serve.exceptions import StreamInterrupted
+from ray_tpu.serve.llm import kv_transfer
+from ray_tpu.serve.llm.engine import GenerationEngine
+from ray_tpu.serve.llm.paging import (BlockAllocator, RadixPrefixCache,
+                                      prefix_fingerprints)
+
+GPT_CFG = gpt.GPTConfig(vocab_size=97, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32, remat=False, use_flash=False)
+LLAMA_CFG = llama.LlamaConfig(vocab_size=97, d_model=32, n_heads=4,
+                              n_kv_heads=2, n_layers=2, d_ff=64,
+                              max_seq=64, dtype=jnp.float32,
+                              remat=False, use_flash=False)
+PAGED_KW = dict(num_slots=3, max_seq=48, prefill_chunk=5, page_size=4,
+                kv_pages=40)
+ENGINE_KW = dict(num_slots=2, max_seq=40, prefill_chunk=4, page_size=4,
+                 kv_pages=40)
+
+
+def _loader():
+    cfg = GPT_CFG
+    return gpt.init_params(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def _prompt(seed, n, vocab=97):
+    return [int(t) for t in np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 1, vocab))]
+
+
+def _oracle(prompt, max_new, cfg=GPT_CFG, model=gpt):
+    params = model.init_params(cfg, jax.random.PRNGKey(0))
+    out = decode.generate(params, jnp.asarray([prompt]), cfg,
+                          max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+def _engine(cfg=GPT_CFG, model=gpt, name="default", **kw):
+    params = model.init_params(cfg, jax.random.PRNGKey(0))
+    return GenerationEngine(params, cfg, name=name,
+                            **{**PAGED_KW, **kw})
+
+
+@pytest.fixture
+def serve_instance():
+    from ray_tpu import serve
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Digest scheme (pure units: fingerprints + radix index)
+
+
+def test_prefix_fingerprints_chain_and_cap():
+    toks = _prompt(0, 20)
+    fps = prefix_fingerprints(toks, 4, 8)
+    assert len(fps) == 5  # 20 tokens / 4-token pages
+    # Chained: a longer prompt sharing the prefix extends the chain
+    # element-for-element — the equality the router's intersection
+    # relies on.
+    fps2 = prefix_fingerprints(toks + [1, 2, 3, 4], 4, 8)
+    assert fps2[:5] == fps
+    # depth cap and page-size sensitivity
+    assert len(prefix_fingerprints(toks, 4, 3)) == 3
+    assert prefix_fingerprints(toks, 8, 8)[0] != fps[0]
+    # deterministic across calls (blake2b, not salted hash())
+    assert prefix_fingerprints(toks, 4, 8) == fps
+
+
+def test_radix_digest_tracks_insert_and_evict():
+    alloc = BlockAllocator(16)
+    cache = RadixPrefixCache(4, alloc, digest_depth=2)
+    toks = _prompt(1, 12)
+    pages = alloc.alloc(3)
+    cache.insert(toks, pages)
+    fps = prefix_fingerprints(toks, 4, 2)
+    dig = {e["fp"]: e["d"] for e in cache.digest(top_k=8)}
+    # depth cap bounds the index (depths 1..2 indexed, depth 3 not)
+    # and ancestors are deduped out of the top_k budget: the depth-2
+    # entry implies its depth-1 parent, which must not spend a slot.
+    assert dig == {fps[1]: 2}
+    # a branch sharing page 1 surfaces its own tip next to a's
+    branch = toks[:4] + _prompt(9, 4)
+    bp = alloc.alloc(1)
+    cache.insert(branch, [pages[0], bp[0]])
+    bfps = prefix_fingerprints(branch, 4, 2)
+    dig = {e["fp"]: e["d"] for e in cache.digest(top_k=8)}
+    assert dig == {fps[1]: 2, bfps[1]: 2}
+    alloc.decref(bp[0])
+    # eviction unindexes as nodes drop
+    for p in pages:
+        alloc.decref(p)  # tree is now sole owner
+    cache.evict(16)
+    assert cache.digest(top_k=8) == []
+    assert alloc.free_pages == 16
+
+
+def test_hot_prefixes_maximal_paths_only():
+    alloc = BlockAllocator(16)
+    cache = RadixPrefixCache(4, alloc, digest_depth=8)
+    a = _prompt(2, 12)           # one 3-page chain
+    b = a[:4] + _prompt(3, 4)    # branches off page 1
+    pa = alloc.alloc(3)
+    cache.insert(a, pa)
+    pb = alloc.alloc(1)
+    cache.insert(b, [pa[0], pb[0]])
+    hot = cache.hot_prefixes(top_k=8)
+    # Maximal paths only: the shared depth-1 ancestor is implied by
+    # both leaves and must not appear as its own entry.
+    assert sorted(map(tuple, hot)) == sorted([tuple(a), tuple(b)])
+    assert cache.hot_prefixes(top_k=1) == [b]  # most recent chain wins
+    cache.match(a)  # touching a makes IT the hottest chain
+    assert cache.hot_prefixes(top_k=1) == [a]
+
+
+# ---------------------------------------------------------------------------
+# Router affinity scoring (unit: fake replica infos)
+
+
+def _rset(infos, in_flight=None):
+    from ray_tpu.serve._private.router import ReplicaSet
+    rs = ReplicaSet("aff", loop=None, qos=None)
+    rs.update_replicas(infos)
+    for tag, n in (in_flight or {}).items():
+        rs._in_flight[tag] = n
+    return rs
+
+
+def _rinfo(tag, fps=None, page=4, maxq=8):
+    info = {"replica_tag": tag, "actor": None,
+            "max_concurrent_queries": maxq}
+    if fps is not None:
+        info["kv_digest"] = {
+            "page": page,
+            "roots": [{"fp": f, "d": d} for d, f in enumerate(fps, 1)]}
+    return info
+
+
+def test_router_prefers_prefix_holder_at_equal_load():
+    toks = _prompt(4, 12)
+    fps = prefix_fingerprints(toks, 4, _cfg.serve_affinity_digest_depth)
+    rs = _rset([_rinfo("cold"), _rinfo("warm", fps=fps)])
+    for _ in range(8):  # power-of-two is random; affinity must not be
+        choice = rs._pick((), {"tokens": toks})
+        assert choice["replica_tag"] == "warm"
+    meta = choice["_affinity"]
+    assert meta["hits"] == 3 and meta["chain"] == 3
+    # deeper hit beats shallower at equal load
+    rs = _rset([_rinfo("deep", fps=fps),
+                _rinfo("shallow", fps=fps[:1])])
+    assert rs._pick((), {"tokens": toks})["replica_tag"] == "deep"
+
+
+def test_router_hotspot_bound_diverts_viral_prefix():
+    from ray_tpu._private import tracing as _tracing
+    toks = _prompt(5, 12)
+    fps = prefix_fingerprints(toks, 4, _cfg.serve_affinity_digest_depth)
+    # holder at 7/8 in-flight (0.875 >= bound 0.75): affinity loses
+    rs = _rset([_rinfo("cold"), _rinfo("viral", fps=fps)],
+               in_flight={"viral": 7})
+    choice = rs._pick((), {"tokens": toks})
+    assert choice["replica_tag"] == "cold"
+    assert "_affinity" not in choice
+    names = [e["name"] for e in _tracing.ring().snapshot(clear=False)]
+    assert "serve.affinity_diverted" in names
+
+
+def test_router_raw_fps_hint_binds_to_mint_page_size():
+    toks = _prompt(6, 16)
+    fps4 = prefix_fingerprints(toks, 4, 8)
+    # A raw-fps hint (x-rt-affinity / resume cursor) only matches the
+    # page size it was minted at; a page-8 replica's chain never
+    # collides, so the pick falls back to load.
+    rs = _rset([_rinfo("p8", fps=prefix_fingerprints(toks, 8, 8),
+                       page=8)])
+    choice = rs._pick((), {"fps": fps4})
+    assert "_affinity" not in choice
+    rs = _rset([_rinfo("p8", fps=prefix_fingerprints(toks, 8, 8),
+                       page=8),
+                _rinfo("p4", fps=fps4, page=4)])
+    assert rs._pick((), {"fps": fps4})["replica_tag"] == "p4"
+
+
+def test_router_no_hit_falls_back_to_load():
+    toks = _prompt(7, 12)
+    other = prefix_fingerprints(_prompt(8, 12), 4, 8)
+    rs = _rset([_rinfo("a", fps=other), _rinfo("b")],
+               in_flight={"a": 5})
+    # no candidate holds any prefix of THIS prompt: pure load pick
+    assert rs._pick((), {"tokens": toks})["replica_tag"] == "b"
+
+
+# ---------------------------------------------------------------------------
+# Cursor plumbing (exceptions + proxy header parsing)
+
+
+def test_stream_interrupted_cursor_carries_kv_origin_and_digest():
+    rdv = {"host": "10.0.0.1", "port": 4242, "engine": "default"}
+    e = StreamInterrupted("died", deployment="llm", method="stream",
+                          delivered=5, resumable=True,
+                          kv_origin=rdv, digest=["aa", "bb"])
+    cur = e.resume_cursor
+    assert cur["kv_origin"] == rdv and cur["digest"] == ["aa", "bb"]
+    e2 = pickle.loads(pickle.dumps(e))  # crosses the RPC boundary
+    assert e2.resume_cursor == cur
+    # extras are optional: absent keys stay absent (cursor is compact)
+    lean = StreamInterrupted("died", delivered=1).resume_cursor
+    assert "kv_origin" not in lean and "digest" not in lean
+
+
+def test_proxy_affinity_hint_and_resume_cursor_parsing():
+    import json
+    from ray_tpu.serve._private.http_proxy import HTTPProxy
+    body = json.dumps({"tokens": [1, 2, 3]}).encode()
+    assert HTTPProxy.affinity_hint(body, {}) == {"tokens": [1, 2, 3]}
+    assert HTTPProxy.affinity_hint(
+        json.dumps({"prompt": [4, 5]}).encode(), {}) == {
+            "tokens": [4, 5]}
+    # header (a replayed resume cursor) wins over the body
+    assert HTTPProxy.affinity_hint(
+        body, {"X-RT-Affinity": "aa, bb"}) == {"fps": ["aa", "bb"]}
+    # text prompts aren't token lists: no hint, never a crash
+    assert HTTPProxy.affinity_hint(
+        json.dumps({"prompt": "hello"}).encode(), {}) is None
+    assert HTTPProxy.affinity_hint(b"not json", {}) is None
+
+    cur = {"delivered": 3, "items": [1, 2, 3], "kv_origin": {"h": 1}}
+    got = HTTPProxy.resume_cursor_of({"x-rt-resume": json.dumps(cur)})
+    assert got == cur
+    assert HTTPProxy.resume_cursor_of({}) is None
+    assert HTTPProxy.resume_cursor_of({"x-rt-resume": "garbage"}) is None
+
+
+# ---------------------------------------------------------------------------
+# Migration data path (in-process engines, no cluster)
+
+
+@pytest.mark.parametrize("cfg,model", [(GPT_CFG, gpt),
+                                       (LLAMA_CFG, llama)],
+                         ids=["gpt", "llama-gqa"])
+def test_migrate_local_parity(cfg, model):
+    """Pages shipped engine-to-engine are verbatim: the destination's
+    greedy output is bit-identical to an unmigrated run, and its
+    prefill actually collapsed (prefix hits cover the shipped pages)."""
+    prompt = _prompt(9, 13, vocab=cfg.vocab_size)
+    want = _oracle(prompt, 8, cfg=cfg, model=model)
+    with _engine(cfg, model, name="src") as src, \
+            _engine(cfg, model, name="dst") as dst:
+        assert src.submit(prompt, max_new_tokens=8).result(60) == want
+        moved = kv_transfer.migrate_local(src, dst, prompt)
+        assert moved == len(prompt) // 4  # all full prompt pages
+        assert dst.submit(prompt, max_new_tokens=8).result(60) == want
+        st = dst.stats()
+        assert st.prefix_hit_tokens >= moved * 4 - 4  # match caps L-1
+        assert st.prefix_cache_hits == 1
+
+
+def test_mid_stream_hop_parity():
+    """THE migration acceptance at engine level: take k tokens on the
+    origin, hop, resume on the destination with the cursor-trimmed
+    prompt — the concatenation is bit-identical to an uninterrupted
+    greedy run and the destination re-prefills only what the shipped
+    pages don't cover."""
+    prompt = _prompt(10, 12)
+    want = _oracle(prompt, 16)
+    with _engine(name="src") as src, _engine(name="dst") as dst:
+        stream = src.submit(prompt, max_new_tokens=16)
+        it = iter(stream)
+        got = [next(it) for _ in range(6)]
+        stream.cancel()
+        assert kv_transfer.migrate_local(src, dst, prompt) == 3
+        # the resume path's trim: prompt + delivered, shrunk budget
+        rest = dst.submit(prompt + got,
+                          max_new_tokens=10).result(60)
+        assert got + rest == want, (got, rest, want)
+        assert dst.stats().prefix_hit_tokens >= 12
+
+
+def test_migrate_below_crossover_is_skipped():
+    """Below serve_kv_min_migrate_pages the rendezvous costs more than
+    the prefill it saves: nothing ships, nothing is left reserved."""
+    prompt = _prompt(11, 5)  # one full page < min_migrate_pages (2)
+    with _engine(name="src") as src, _engine(name="dst") as dst:
+        src.submit(prompt, max_new_tokens=4).result(60)
+        free0 = dst.run_on_worker(lambda: dst._alloc.free_pages)
+        assert kv_transfer.migrate_local(src, dst, prompt) == 0
+        assert dst.run_on_worker(lambda: dst._alloc.free_pages) == free0
+        # and the origin's pins were released despite the skip
+        assert src.run_on_worker(
+            lambda: all(src._alloc.refcount(p) <= 1
+                        for p in range(1, src.kv_pages + 1)))
+
+
+def test_export_pins_survive_origin_eviction():
+    """Refcount safety (PR 4 discipline): an eviction racing an
+    in-flight export drops radix nodes but can never recycle a pinned
+    page — the bytes stay valid until the destination seals."""
+    prompt = _prompt(12, 12)
+    want = _oracle(prompt, 8)
+    with _engine(name="src") as src, _engine(name="dst") as dst:
+        src.submit(prompt, max_new_tokens=8).result(60)
+        exp = src.run_on_worker(lambda: src.kv_export(prompt))
+        assert exp is not None and len(exp["pages"]) == 3
+        # origin evicts EVERYTHING mid-wire
+        src.run_on_worker(lambda: src._prefix.evict(src.kv_pages))
+        refs = src.run_on_worker(
+            lambda: [src._alloc.refcount(p) for p in exp["pages"]])
+        assert all(r >= 1 for r in refs)  # pinned, not recycled
+        # the staged bytes still land a correct import
+        n = dst.run_on_worker(lambda: dst.kv_import(
+            prompt[:exp["matched_tokens"]], exp["k"], exp["v"]))
+        assert n == 3
+        src.run_on_worker(
+            lambda: src.kv_export_release(exp["pages"]))
+        assert src.run_on_worker(
+            lambda: src._alloc.free_pages) == src.kv_pages
+        assert dst.submit(prompt, max_new_tokens=8).result(60) == want
+
+
+def test_kv_import_all_or_nothing_when_pool_hot():
+    """A pool too hot to host the import refuses it WHOLE: no partial
+    commit, no stranded reservation — the caller re-prefills."""
+    prompt = _prompt(13, 12)
+    with _engine(name="src") as src, \
+            _engine(name="tiny", kv_pages=2) as dst:
+        src.submit(prompt, max_new_tokens=4).result(60)
+        exp = src.run_on_worker(lambda: src.kv_export(prompt))
+        try:
+            n = dst.run_on_worker(lambda: dst.kv_import(
+                prompt[:exp["matched_tokens"]], exp["k"], exp["v"]))
+            assert n == 0
+            assert dst.run_on_worker(
+                lambda: dst._alloc.free_pages) == 2
+        finally:
+            src.run_on_worker(
+                lambda: src.kv_export_release(exp["pages"]))
+
+
+# ---------------------------------------------------------------------------
+# Wire path over the real transfer plane (loopback in the driver worker)
+
+
+def _driver_rdv(engine):
+    rdv = kv_transfer.rendezvous(engine)
+    if rdv is None:
+        pytest.skip("driver worker has no RPC server address")
+    return rdv
+
+
+def test_wire_pull_loopback_parity(serve_instance, monkeypatch):
+    """Windowed KIND_BLOB pull through a real socket (samehost staging
+    disabled to force the wire): CRC-checked frames land into fresh
+    pages and the destination's output is bit-identical."""
+    monkeypatch.setattr(_cfg, "serve_kv_samehost", False)
+    prompt = _prompt(14, 13)
+    want = _oracle(prompt, 8)
+    with _engine(name="wsrc") as src, _engine(name="wdst") as dst:
+        src.submit(prompt, max_new_tokens=8).result(60)
+        rdv = _driver_rdv(src)
+        n = asyncio.run(kv_transfer.pull_kv_pages(rdv, prompt, dst))
+        assert n == 3
+        assert not kv_transfer._EXPORTS  # sealed: pins released
+        assert dst.submit(prompt, max_new_tokens=8).result(60) == want
+
+
+def test_wire_pull_samehost_staging(serve_instance):
+    """Same-host fast path: the origin stages the export in /dev/shm
+    and the destination reads it directly — same seal discipline."""
+    prompt = _prompt(15, 13)
+    want = _oracle(prompt, 8)
+    with _engine(name="ssrc") as src, _engine(name="sdst") as dst:
+        src.submit(prompt, max_new_tokens=8).result(60)
+        rdv = _driver_rdv(src)
+        n = asyncio.run(kv_transfer.pull_kv_pages(rdv, prompt, dst))
+        assert n == 3
+        assert not kv_transfer._EXPORTS
+        assert dst.submit(prompt, max_new_tokens=8).result(60) == want
+
+
+def test_wire_pull_failure_degrades_to_reprefill(serve_instance,
+                                                monkeypatch):
+    """A faulted fetch (injected page error) aborts the import WHOLE:
+    pull reports 0, the destination pool is untouched, the origin's
+    pins release at seal — and the request simply re-prefills with
+    output parity intact.  Never a corrupt cache."""
+    monkeypatch.setattr(_cfg, "serve_kv_samehost", False)
+    prompt = _prompt(16, 13)
+    want = _oracle(prompt, 8)
+    with _engine(name="fsrc") as src, _engine(name="fdst") as dst:
+        src.submit(prompt, max_new_tokens=8).result(60)
+        rdv = _driver_rdv(src)
+        free0 = dst.run_on_worker(lambda: dst._alloc.free_pages)
+        failpoints.configure("serve.kv_fetch_page=error")
+        try:
+            n = asyncio.run(
+                kv_transfer.pull_kv_pages(rdv, prompt, dst))
+        finally:
+            failpoints.configure("")
+        assert n == 0
+        assert dst.run_on_worker(
+            lambda: dst._alloc.free_pages) == free0
+        assert not kv_transfer._EXPORTS
+        assert src.run_on_worker(
+            lambda: all(src._alloc.refcount(p) <= 1
+                        for p in range(1, src.kv_pages + 1)))
+        assert dst.submit(prompt, max_new_tokens=8).result(60) == want
+
+
+# ---------------------------------------------------------------------------
+# Cluster: digest propagation, affinity routing, resume-with-migration
+
+
+def _wait(pred, timeout=30.0, interval=0.2, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _digest_fps(info):
+    return {r.get("fp") for r in
+            (info.get("kv_digest") or {}).get("roots", ())}
+
+
+@pytest.mark.slow  # ~12s cluster spin-up; chaos battery covers e2e
+def test_digest_propagates_to_router_and_routes(serve_instance):
+    """End-to-end gauge plumbing: engine.load_info's radix digest rides
+    autoscale_metrics -> controller poll -> membership broadcast into
+    the router's replica view, and a repeat prompt then routes to the
+    replica that holds its prefix."""
+    from ray_tpu.serve.llm.api import llm_deployment
+
+    prompt = _prompt(20, 13)
+    want = _oracle(prompt, 6)
+    handle = llm_deployment(_loader, name="affprop", num_replicas=2,
+                            engine_config=dict(ENGINE_KW)).deploy()
+    sub = handle.options("stream")
+    assert list(sub.stream(prompt, max_new_tokens=6)) == want
+    rs = sub._router.replica_set
+    fps = prefix_fingerprints(prompt, 4,
+                              _cfg.serve_affinity_digest_depth)
+    holder = _wait(
+        lambda: next((r for r in rs._replicas
+                      if fps[-1] in _digest_fps(r)), None),
+        msg="digest broadcast to the router")
+    assert holder["kv_digest"]["page"] == 4
+    # the router's pick follows the digest (warm replica, idle set)
+    choice = rs._pick((), {"tokens": prompt})
+    assert choice["replica_tag"] == holder["replica_tag"]
+    assert choice["_affinity"]["hits"] == len(fps)
+
+
+@pytest.mark.slow  # ~12s cluster spin-up; chaos battery covers e2e
+def test_resume_pull_lands_with_affinity(serve_instance):
+    """A stream resumed on a DIFFERENT replica with the origin's
+    rendezvous in the cursor migrates the origin's committed pages
+    over the wire before submitting: the resumed suffix is
+    bit-identical and the new replica's prefill collapsed (prefix hits
+    cover the shipped pages it never computed itself)."""
+    from ray_tpu.serve.llm.api import llm_deployment
+
+    prompt = _prompt(21, 12)
+    want = _oracle(prompt, 12)
+    handle = llm_deployment(_loader, name="migres", num_replicas=2,
+                            engine_config=dict(ENGINE_KW)).deploy()
+    sub = handle.options("stream")
+    assert list(sub.stream(prompt, max_new_tokens=12)) == want
+    rs = sub._router.replica_set
+    router_loop = rs._loop
+
+    def stats_of(info):
+        return ray_tpu.get(info["actor"].handle_request.remote(
+            "stats", (), {}), timeout=30)
+
+    origin = _wait(
+        lambda: next((r for r in rs._replicas
+                      if stats_of(r)["requests_completed"] > 0), None),
+        msg="origin replica identified")
+    rdv = ray_tpu.get(origin["actor"].handle_request.remote(
+        "kv_rendezvous", (), {}), timeout=30)
+    assert rdv and rdv["host"], "replica published no rendezvous"
+    other = next(r for r in rs._replicas
+                 if r["replica_tag"] != origin["replica_tag"])
+    assert stats_of(other)["prefix_hit_tokens"] == 0
+
+    k = 4
+    resume = {"delivered": k, "items": want[:k], "kv_origin": rdv}
+
+    async def _resumed():
+        # steer the resumed stream away from the origin, as a real
+        # failover would (the origin is dead there)
+        rs._suppressed[origin["replica_tag"]] = \
+            asyncio.get_event_loop().time() + 60.0
+        ait = await rs.assign_replica_stream(
+            "stream", (prompt,), {"max_new_tokens": 12}, resume=resume)
+        return [int(t) async for t in ait]
+
+    rest = asyncio.run_coroutine_threadsafe(
+        _resumed(), router_loop).result(90)
+    assert want[:k] + rest == want, (rest, want)
+    st = stats_of(other)
+    # 3 imported pages cover 12 of the resumed prompt's tokens
+    assert st["prefix_hit_tokens"] >= 12, st
+
+
+@pytest.mark.slow  # ~12s cluster spin-up; chaos battery covers e2e
+def test_drain_offers_pages_to_survivor(serve_instance):
+    """Scale-down drains AND re-homes: the draining replica's hot
+    prefixes are offered to the least-loaded survivor, whose digest
+    then covers both its own and the migrated prefix."""
+    from ray_tpu.serve.llm.api import llm_deployment
+
+    prompts = [_prompt(22, 12), _prompt(23, 12)]
+    dep = llm_deployment(_loader, name="drainmig", num_replicas=2,
+                         engine_config=dict(ENGINE_KW)
+                         ).options(version="v1")  # pin: a replica-count
+    # change must reconcile as a DRAIN, not a version rollout
+    handle = dep.deploy()
+    sub = handle.options("stream")
+    # warm one replica through the router (this also materializes the
+    # router), then warm the OTHER directly — each replica now holds
+    # exactly one of the two prefixes.
+    assert len(list(sub.stream(prompts[0], max_new_tokens=4))) == 4
+    rs = sub._router.replica_set
+    _wait(lambda: len(rs._replicas) == 2, msg="both replicas up")
+
+    def stats_of(info):
+        return ray_tpu.get(info["actor"].handle_request.remote(
+            "stats", (), {}), timeout=30)
+
+    cold = next(r for r in rs._replicas
+                if stats_of(r)["requests_completed"] == 0)
+    ray_tpu.get(cold["actor"].handle_request.remote(
+        "generate", (prompts[1],), {"max_new_tokens": 4}), timeout=120)
+    fps = [prefix_fingerprints(p, 4, 8)[-1] for p in prompts]
+    dep.options(num_replicas=1).deploy(_blocking=False)
+    _wait(lambda: len(rs._replicas) == 1, timeout=60,
+          msg="scale-down to one replica")
+    survivor = rs._replicas[0]
+
+    def survivor_has_both():
+        info = ray_tpu.get(survivor["actor"].handle_request.remote(
+            "autoscale_metrics", (), {}), timeout=30)
+        return all(f in _digest_fps(info) for f in fps)
+
+    _wait(survivor_has_both, timeout=60,
+          msg="survivor holds both prefixes after drain migration")
+
+
+@pytest.mark.slow  # in `make chaos` explicitly; keeps tier-1 lean
+def test_kill_origin_mid_migration_reprefills_with_parity(
+        serve_instance):
+    """Chaos: the migration origin dies between rendezvous and pull.
+    The pull fails (connection refused / stale export), the resumed
+    replica re-prefills from the cursor-trimmed prompt, and the greedy
+    suffix is STILL bit-identical — migration is an optimization, never
+    a correctness dependency."""
+    from ray_tpu.serve.llm.api import llm_deployment
+
+    prompt = _prompt(24, 12)
+    want = _oracle(prompt, 12)
+    handle = llm_deployment(_loader, name="migkill", num_replicas=2,
+                            engine_config=dict(ENGINE_KW)).deploy()
+    sub = handle.options("stream")
+    assert list(sub.stream(prompt, max_new_tokens=12)) == want
+    rs = sub._router.replica_set
+    router_loop = rs._loop
+
+    def stats_of(info):
+        return ray_tpu.get(info["actor"].handle_request.remote(
+            "stats", (), {}), timeout=30)
+
+    origin = _wait(
+        lambda: next((r for r in rs._replicas
+                      if stats_of(r)["requests_completed"] > 0), None),
+        msg="origin replica identified")
+    rdv = ray_tpu.get(origin["actor"].handle_request.remote(
+        "kv_rendezvous", (), {}), timeout=30)
+    assert rdv
+    ray_tpu.kill(origin["actor"])  # mid-migration: rdv now points at a corpse
+
+    k = 4
+    resume = {"delivered": k, "items": want[:k], "kv_origin": rdv}
+
+    async def _resumed():
+        rs._suppressed[origin["replica_tag"]] = \
+            asyncio.get_event_loop().time() + 60.0
+        ait = await rs.assign_replica_stream(
+            "stream", (prompt,), {"max_new_tokens": 12}, resume=resume)
+        return [int(t) async for t in ait]
+
+    rest = asyncio.run_coroutine_threadsafe(
+        _resumed(), router_loop).result(120)
+    assert want[:k] + rest == want, (rest, want)
+
+
+@pytest.mark.slow  # in `make chaos` explicitly; keeps tier-1 lean
+def test_sse_resume_header_lands_through_proxy(serve_instance):
+    """HTTP-level resume: a client that got a resume cursor (from a
+    503 body or SSE error event) replays it in `x-rt-resume` against a
+    FRESH proxy connection and receives exactly the undelivered
+    suffix — nothing about the resume lives in proxy state."""
+    import json as _json
+
+    import requests
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm.api import llm_deployment
+
+    prompt = _prompt(25, 10)
+    want = _oracle(prompt, 10)
+    llm_deployment(_loader, name="httpres", num_replicas=1,
+                   engine_config=dict(ENGINE_KW),
+                   route_prefix="/httpres").deploy()
+    serve.start(_start_proxy=True)
+    addr = serve.get_proxy_address()
+    base = f"http://{addr['host']}:{addr['port']}"
+    k = 4
+    cursor = {"deployment": "httpres", "method": "", "delivered": k,
+              "resumable": True,
+              "items": [{"token": t} for t in want[:k]],
+              "digest": prefix_fingerprints(prompt, 4, 8)}
+    deadline = time.monotonic() + 30
+    while True:
+        r = requests.post(
+            f"{base}/httpres",
+            json={"tokens": prompt, "max_new_tokens": 10},
+            headers={"Accept": "text/event-stream",
+                     "x-rt-resume": _json.dumps(cursor),
+                     "x-rt-affinity": ",".join(cursor["digest"])},
+            stream=True, timeout=120)
+        if r.status_code != 404 or time.monotonic() > deadline:
+            break  # 404 = route table not yet broadcast to the proxy
+        time.sleep(0.2)
+    assert r.status_code == 200
+    got = []
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[len(b"data: "):]
+        if payload == b"[DONE]":
+            break
+        got.append(int(_json.loads(payload)["token"]))
+    assert got == want[k:], (got, want)
