@@ -295,6 +295,14 @@ class _Config:
     # An export a destination never sealed (puller died mid-pull) is
     # released after this TTL so its page refs cannot leak forever.
     serve_kv_export_ttl_s = _def("serve_kv_export_ttl_s", float, 60.0)
+    # How long a router keeps trusting the pull address (kv_rdv) of a
+    # replica that LEFT the membership broadcast.  Client-replayed
+    # resume cursors name a kv_origin to migrate pages from; the router
+    # only honors addresses it has itself observed in the broadcast —
+    # never a client-invented endpoint (SSRF / cache poisoning) — and
+    # the grace window covers the dead-replica resume case, where the
+    # origin is gone from membership by the time the client retries.
+    serve_kv_rdv_grace_s = _def("serve_kv_rdv_grace_s", float, 120.0)
 
     # --- cluster autopilot (SLO-driven arbiter, _private/arbiter.py) ---
     # The GCS broker's arbitration tick: how often registered workload

@@ -146,7 +146,11 @@ class HTTPProxy:
         (the JSON this proxy handed out in a 503 body / SSE error
         event, plus the delivered items): seeds the router's stream so
         the resubmitted request continues past the cursor — across
-        proxy death, since nothing about it lives in proxy state."""
+        proxy death, since nothing about it lives in proxy state.
+        A zero-delivered cursor still counts when it carries kv_origin:
+        an interruption before the first item left the origin's prompt
+        pages worth migrating (the router validates the address against
+        its membership view before anything dials it)."""
         hdr = next((v for k, v in (headers or {}).items()
                     if k.lower() == "x-rt-resume"), None)
         if not hdr:
@@ -156,7 +160,8 @@ class HTTPProxy:
         except Exception:
             return None
         if isinstance(cur, dict) \
-                and (cur.get("items") or cur.get("delivered")):
+                and (cur.get("items") or cur.get("delivered")
+                     or cur.get("kv_origin")):
             return cur
         return None
 

@@ -198,12 +198,15 @@ class RTServeReplica:
                 getattr(target, "__call__", None),
                 "__serve_resumable__", False))
         if resume is not None:
-            if not resumable:
+            if resumable:
+                kwargs = {**kwargs, "_resume": resume}
+            elif resume.get("delivered") or resume.get("items"):
                 raise TypeError(
                     f"{self.deployment_name}.{method_name or '__call__'}"
                     " is not resumable (mark it with @serve.resumable "
                     "to accept a failover cursor)")
-            kwargs = {**kwargs, "_resume": resume}
+            # else: a hint-only cursor (kv_origin at delivered=0) has
+            # nothing to replay — dropped, the stream runs whole.
         if inspect.isasyncgenfunction(target):
             ait = target(*args, **kwargs)
         else:

@@ -185,6 +185,14 @@ class ReplicaSet:
         self._suppress_ttl = float(
             env("RT_SERVE_REPLICA_SUPPRESS_S", "10"))
         self._suppressed: Dict[str, float] = {}
+        # KV pull addresses this router has OBSERVED in the membership
+        # broadcast: current members, plus recently-departed ones kept
+        # for a grace window (a dead replica leaves the broadcast
+        # before its client's resume retry arrives).  Client-replayed
+        # kv_origin cursors are validated against these — see
+        # _trusted_rdv.
+        self._member_rdv: set = set()
+        self._recent_rdv: Dict[tuple, float] = {}
 
     def _replica_series(self, tag: str):
         s = self._g_replica.get(tag)
@@ -193,8 +201,53 @@ class ReplicaSet:
                 {"deployment": self.deployment_name, "replica": tag})
         return s
 
+    @staticmethod
+    def _rdv_key(rdv) -> Optional[tuple]:
+        """Canonical (host, port, engine) key of a kv_rdv dict, or None
+        when it isn't one (missing fields, junk types)."""
+        try:
+            return (str(rdv["host"]), int(rdv["port"]),
+                    str(rdv.get("engine", "default")))
+        except (TypeError, KeyError, ValueError):
+            return None
+
+    def _trusted_rdv(self, rdv) -> Optional[Dict]:
+        """Validate a CLIENT-supplied kv_origin (x-rt-resume rides in
+        from the open HTTP surface): only pull addresses this router has
+        itself seen in the controller's membership broadcast — live now,
+        or departed within serve_kv_rdv_grace_s — are honored, and the
+        returned dict is rebuilt from the canonical key (no smuggled
+        fields).  Anything else is dropped: a forged cursor must not be
+        able to point a replica's migration pull at an attacker-chosen
+        endpoint (SSRF) or seed the shared prefix cache from bytes an
+        attacker serves (cache poisoning).  Dropping is safe — the
+        resume simply re-prefills."""
+        key = self._rdv_key(rdv) if isinstance(rdv, dict) else None
+        if key is None:
+            return None
+        if key in self._member_rdv or \
+                self._recent_rdv.get(key, 0.0) > time.monotonic():
+            return {"host": key[0], "port": key[1], "engine": key[2]}
+        logger.warning(
+            "dropping kv_origin %s:%s from resume cursor: not a pull "
+            "address this router observed in %s's membership",
+            rdv.get("host"), rdv.get("port"), self.deployment_name)
+        return None
+
     def update_replicas(self, infos: List[Dict]):
         self._replicas = list(infos)
+        now = time.monotonic()
+        member = set()
+        for i in infos:
+            key = self._rdv_key(i.get("kv_rdv"))
+            if key is not None:
+                member.add(key)
+        for gone in self._member_rdv - member:
+            self._recent_rdv[gone] = now + _cfg.serve_kv_rdv_grace_s
+        for key, deadline in list(self._recent_rdv.items()):
+            if deadline <= now or key in member:
+                del self._recent_rdv[key]
+        self._member_rdv = member
         tags = {i["replica_tag"] for i in infos}
         for gone in set(self._in_flight) - tags:
             # Zero the departed replica's series: its finally-block
@@ -591,11 +644,15 @@ class ReplicaSet:
             if resume:
                 # Client-held cursor: only its UNDELIVERED suffix flows
                 # from here on — delivered_n/items count as if this
-                # router had streamed them itself.
+                # router had streamed them itself.  The cursor's
+                # kv_origin is honored only when it names a pull
+                # address this router observed in the membership
+                # broadcast (forged origins are SSRF/cache-poisoning
+                # vectors; see _trusted_rdv).
                 delivered = list(resume.get("items") or [])
                 delivered_n = int(resume.get("delivered")
                                   or len(delivered))
-                origin_rdv = resume.get("kv_origin")
+                origin_rdv = self._trusted_rdv(resume.get("kv_origin"))
             while True:
                 try:
                     choice = await self._acquire(timeout_s,
@@ -633,13 +690,18 @@ class ReplicaSet:
                         if delivered_n:
                             resume_state = {"delivered": delivered_n,
                                             "items": list(delivered)}
-                            if origin_rdv \
-                                    and origin_rdv != choice.get("kv_rdv"):
-                                # The dead origin's pull address rides
-                                # the cursor: the resuming replica can
-                                # MIGRATE the committed pages instead of
-                                # re-prefilling the whole prefix.
-                                resume_state["kv_origin"] = origin_rdv
+                        if origin_rdv \
+                                and origin_rdv != choice.get("kv_rdv"):
+                            # The dead origin's pull address rides the
+                            # cursor: the resuming replica can MIGRATE
+                            # the committed pages instead of
+                            # re-prefilling the whole prefix.  Forwarded
+                            # even at delivered=0 — an interruption
+                            # before the first item still left the
+                            # origin's PROMPT pages worth shipping.
+                            resume_state = resume_state or \
+                                {"delivered": 0, "items": []}
+                            resume_state["kv_origin"] = origin_rdv
                         t_assign = time.time()
                         started = await self._stream_rpc(
                             actor.handle_request_streaming.remote(

@@ -147,7 +147,11 @@ class LLMServer:
         (kv_origin): pull its committed pages for prompt + delivered
         tokens before submitting, so the resume's prefill collapses to
         a prefix-cache hit.  Best-effort by design — any failure means
-        re-prefill, never a corrupt cache (pull_kv_pages's contract)."""
+        re-prefill, never a corrupt cache (pull_kv_pages's contract).
+        Trust: kv_origin only ever arrives via the router, which
+        validates client-replayed cursors against its own membership
+        view (ReplicaSet._trusted_rdv) — this replica never dials an
+        address a client invented."""
         rdv = (_resume or {}).get("kv_origin")
         if not rdv or not _cfg.serve_affinity:
             return 0

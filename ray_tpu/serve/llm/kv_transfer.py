@@ -70,6 +70,8 @@ _SERVICES: Dict[str, "GenerationEngineRef"] = {}
 GenerationEngineRef = object  # typing alias; values are engines
 # xid -> export state staged by kv_export_begin.
 _EXPORTS: Dict[str, Dict] = {}
+# TTL sweeper task for _EXPORTS, on the core worker's event loop.
+_SWEEPER: Optional["asyncio.Task"] = None
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else None
 
 
@@ -87,15 +89,36 @@ async def _on_worker(engine, fn, timeout: float = 30.0):
         None, lambda: engine.run_on_worker(fn, timeout=timeout))
 
 
-def _sweep_exports(now: float) -> None:
-    ttl = _cfg.serve_kv_export_ttl_s
-    for xid in [x for x, e in _EXPORTS.items()
-                if now - e["t"] > ttl]:
-        logger.warning("kv export %s never sealed; releasing", xid)
-        _release_export(xid)
+def _ensure_sweeper() -> None:
+    """Start the export-TTL sweeper on the running loop if it is not
+    already alive.  A periodic task (not an inbound-traffic hook): a
+    puller that dies and never triggers another kv_export_begin here
+    must still have its orphaned export reclaimed — pinned pages,
+    frames copy, and /dev/shm staging file all leak otherwise."""
+    global _SWEEPER
+    if _SWEEPER is None or _SWEEPER.done():
+        _SWEEPER = asyncio.get_running_loop().create_task(_sweep_loop())
 
 
-def _release_export(xid: str) -> None:
+async def _sweep_loop() -> None:
+    global _SWEEPER
+    while True:
+        await asyncio.sleep(max(0.5, _cfg.serve_kv_export_ttl_s / 4))
+        now = time.monotonic()
+        ttl = _cfg.serve_kv_export_ttl_s
+        for xid in [x for x, e in _EXPORTS.items()
+                    if now - e["t"] > ttl]:
+            logger.warning("kv export %s never sealed; releasing", xid)
+            await _release_export(xid)
+        if not _EXPORTS:
+            # Idle: retire (no awaits between the check and the reset,
+            # so an export registered after this point sees a done/None
+            # sweeper and starts a fresh one).
+            _SWEEPER = None
+            return
+
+
+async def _release_export(xid: str) -> None:
     exp = _EXPORTS.pop(xid, None)
     if exp is None:
         return
@@ -107,8 +130,12 @@ def _release_export(xid: str) -> None:
             pass
     engine = exp["engine"]
     try:
-        engine.run_on_worker(
-            lambda: engine.kv_export_release(exp["pages"]))
+        # _on_worker, never a bare run_on_worker: this runs on the core
+        # worker's RPC event loop, and the blocking wait for the tick
+        # thread (a long decode tick, a first-time jit) must not stall
+        # every other RPC and heartbeat behind it.
+        await _on_worker(engine,
+                         lambda: engine.kv_export_release(exp["pages"]))
     except Exception:
         logger.exception("kv export %s release failed", xid)
 
@@ -119,7 +146,6 @@ async def _rpc_export_begin(conn, body):
     engine = _SERVICES.get(body.get("engine", ""))
     if engine is None:
         return {"error": f"no kv engine {body.get('engine')!r} here"}
-    _sweep_exports(time.monotonic())
     tokens = body["tokens"]
     try:
         exp = await _on_worker(engine,
@@ -151,6 +177,7 @@ async def _rpc_export_begin(conn, body):
     _EXPORTS[xid] = {"engine": engine, "pages": exp["pages"],
                      "frames": frames, "gen": gen, "path": path,
                      "t": time.monotonic()}
+    _ensure_sweeper()
     return {"xid": xid, "gen": gen, "n": len(frames),
             "matched_tokens": exp["matched_tokens"],
             "page_nbytes": len(frames[0]), "k_nbytes": k[0].nbytes,
@@ -172,6 +199,10 @@ async def _rpc_fetch_page(conn, body):
         # Stale/recycled export: the generation check is what keeps a
         # late frame from sealing garbage into a NEW migration's pages.
         return {"error": "unknown or stale kv export"}
+    # A live pull keeps its export alive: without the refresh a slow
+    # (or failpoint-delayed) window could cross the TTL and get swept
+    # mid-pull, failing a healthy migration into re-prefill.
+    exp["t"] = time.monotonic()
     i = body["i"]
     if not 0 <= i < len(exp["frames"]):
         return {"error": f"page index {i} out of range"}
@@ -181,7 +212,7 @@ async def _rpc_fetch_page(conn, body):
 
 
 async def _rpc_export_end(conn, body):
-    _release_export(body.get("xid"))
+    await _release_export(body.get("xid"))
     return {"ok": True}
 
 

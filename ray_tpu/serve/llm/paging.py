@@ -227,17 +227,29 @@ class RadixPrefixCache:
         a path touched as one unit stamps every node the same clock.
         Bounded by both top_k and digest_depth, so it stays gauge-sized
         however big the trie is."""
-        out: List[Dict] = []
+        return [{"fp": n.fp, "d": n.depth}
+                for n in self._pick_maximal(top_k)]
+
+    def _pick_maximal(self, top_k: int) -> List["_RadixNode"]:
+        """Up to top_k indexed nodes, most recently used first, maximal
+        paths only.  The forward pass skips a candidate implied by an
+        ALREADY-picked descendant; the final pass drops a picked node
+        whose descendant was picked LATER (an ancestor more recently
+        used than its child gets selected first, and nothing in the
+        forward pass revisits it) — without it the output would carry
+        redundant ancestors, breaking the ancestor-deduped contract
+        digest()/hot_prefixes() advertise."""
         picked: List[_RadixNode] = []
         for n in sorted(self._fp_index.values(),
                         key=lambda n: (-n.last_used, -n.depth)):
-            if len(out) >= top_k:
+            if len(picked) >= top_k:
                 break
             if any(self._is_ancestor(n, p) for p in picked):
                 continue  # implied by a deeper advertised node
             picked.append(n)
-            out.append({"fp": n.fp, "d": n.depth})
-        return out
+        return [n for n in picked
+                if not any(n is not p and self._is_ancestor(n, p)
+                           for p in picked)]
 
     def prefix_tokens(self, node: _RadixNode) -> List[int]:
         out: List[int] = []
@@ -252,22 +264,8 @@ class RadixPrefixCache:
         destination's longest-prefix match recovers them for free).
         Drain migration walks these to re-home still-referenced pages
         before teardown."""
-        picked: List[_RadixNode] = []
-        # Depth breaks recency ties deepest-first: a path touched as one
-        # unit stamps every node the same clock, and without the
-        # tiebreak the shallow ancestor would be picked before the deep
-        # node it is implied by.
-        for n in sorted(self._fp_index.values(),
-                        key=lambda n: (-n.last_used, -n.depth)):
-            if len(picked) >= top_k:
-                break
-            if any(self._is_ancestor(n, p) for p in picked):
-                continue
-            picked.append(n)
-        picked = [n for n in picked
-                  if not any(n is not p and self._is_ancestor(n, p)
-                             for p in picked)]
-        return [self.prefix_tokens(n) for n in picked]
+        return [self.prefix_tokens(n)
+                for n in self._pick_maximal(top_k)]
 
     @staticmethod
     def _is_ancestor(a: _RadixNode, b: _RadixNode) -> bool:

@@ -124,6 +124,24 @@ def test_radix_digest_tracks_insert_and_evict():
     assert alloc.free_pages == 16
 
 
+def test_digest_drops_ancestor_touched_after_descendant():
+    """The ancestor-deduped contract holds even when an ancestor is
+    MORE recently used than its descendant (touched alone via a short
+    match): the recency-first pass picks the ancestor before the deep
+    node can shadow it, and the final maximal-path filter must drop it
+    — a redundant ancestor wastes a top_k slot the router scoring
+    assumes carries information."""
+    alloc = BlockAllocator(16)
+    cache = RadixPrefixCache(4, alloc, digest_depth=8)
+    toks = _prompt(17, 12)
+    pages = alloc.alloc(3)
+    cache.insert(toks, pages)
+    cache.match(toks[:4])  # depth-1 node alone becomes the hottest
+    fps = prefix_fingerprints(toks, 4, 8)
+    assert [e["fp"] for e in cache.digest(top_k=8)] == [fps[2]]
+    assert cache.hot_prefixes(top_k=8) == [toks]
+
+
 def test_hot_prefixes_maximal_paths_only():
     alloc = BlockAllocator(16)
     cache = RadixPrefixCache(4, alloc, digest_depth=8)
@@ -256,8 +274,50 @@ def test_proxy_affinity_hint_and_resume_cursor_parsing():
     cur = {"delivered": 3, "items": [1, 2, 3], "kv_origin": {"h": 1}}
     got = HTTPProxy.resume_cursor_of({"x-rt-resume": json.dumps(cur)})
     assert got == cur
+    # zero-delivered cursors still count when they carry a kv_origin:
+    # an interruption before the first item left the origin's PROMPT
+    # pages worth migrating on a retry
+    hint = {"delivered": 0, "kv_origin": {"host": "h", "port": 1}}
+    assert HTTPProxy.resume_cursor_of(
+        {"x-rt-resume": json.dumps(hint)}) == hint
+    assert HTTPProxy.resume_cursor_of(
+        {"x-rt-resume": json.dumps({"delivered": 0})}) is None
     assert HTTPProxy.resume_cursor_of({}) is None
     assert HTTPProxy.resume_cursor_of({"x-rt-resume": "garbage"}) is None
+
+
+def test_router_honors_only_observed_kv_origin():
+    """Trust boundary for client-replayed cursors: the router forwards
+    a kv_origin to the resuming replica only when it names a pull
+    address the router itself observed in the membership broadcast —
+    live now, or departed within the grace window.  A forged origin
+    (SSRF / cache-poisoning vector from the open x-rt-resume header)
+    is dropped and the resume simply re-prefills."""
+    rdv = {"host": "10.0.0.1", "port": 4242, "engine": "default"}
+    holder = _rinfo("a")
+    holder["kv_rdv"] = dict(rdv)
+    rs = _rset([holder, _rinfo("b")])
+    assert rs._trusted_rdv(dict(rdv)) == rdv
+    # the honored dict is rebuilt from the canonical key: extra fields
+    # a client smuggled into the cursor never reach the replica
+    assert rs._trusted_rdv({**rdv, "path": "/evil"}) == rdv
+    # forged / never-observed endpoints are dropped, junk never crashes
+    assert rs._trusted_rdv(
+        {"host": "attacker.example", "port": 80,
+         "engine": "default"}) is None
+    assert rs._trusted_rdv({**rdv, "port": 4243}) is None
+    assert rs._trusted_rdv({"host": "10.0.0.1"}) is None
+    assert rs._trusted_rdv("garbage") is None
+    assert rs._trusted_rdv(None) is None
+    # a departed replica stays trusted for the grace window (dead
+    # replicas leave the broadcast before the client's retry arrives)
+    rs.update_replicas([_rinfo("b")])
+    assert rs._trusted_rdv(dict(rdv)) == rdv
+    # ...and expires after it
+    rs._recent_rdv[("10.0.0.1", 4242, "default")] = \
+        time.monotonic() - 1.0
+    rs.update_replicas([_rinfo("b")])
+    assert rs._trusted_rdv(dict(rdv)) is None
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +495,44 @@ def test_wire_pull_failure_degrades_to_reprefill(serve_instance,
         assert dst.submit(prompt, max_new_tokens=8).result(60) == want
 
 
+def test_orphaned_export_swept_without_inbound_traffic(monkeypatch):
+    """A puller that dies after kv_export_begin and never generates
+    another RPC toward this origin must STILL have its export
+    reclaimed: the TTL sweeper is a periodic task, not an
+    inbound-traffic hook — otherwise the pinned pages, frames copy,
+    and /dev/shm staging file leak until unrelated traffic arrives."""
+    monkeypatch.setattr(_cfg, "serve_kv_export_ttl_s", 0.6)
+    released = []
+
+    class FakeEngine:
+        def run_on_worker(self, fn, timeout=None):
+            return fn()
+
+        def kv_export_release(self, pages):
+            released.append(list(pages))
+
+    async def go():
+        # Detach any sweeper an earlier test left on ANOTHER loop (in
+        # production the handlers all run on the one core-worker loop,
+        # so this aliasing is test-only) and start one here.
+        kv_transfer._SWEEPER = None
+        kv_transfer._EXPORTS["orphan"] = {
+            "engine": FakeEngine(), "pages": [3, 4], "frames": [],
+            "gen": "g", "path": None, "t": time.monotonic()}
+        kv_transfer._ensure_sweeper()
+        deadline = time.monotonic() + 5.0
+        while kv_transfer._EXPORTS and time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+
+    try:
+        asyncio.run(go())
+        assert not kv_transfer._EXPORTS
+        assert released == [[3, 4]]
+    finally:
+        kv_transfer._EXPORTS.clear()
+        kv_transfer._SWEEPER = None
+
+
 # ---------------------------------------------------------------------------
 # Cluster: digest propagation, affinity routing, resume-with-migration
 
@@ -511,6 +609,11 @@ def test_resume_pull_lands_with_affinity(serve_instance):
     rdv = ray_tpu.get(origin["actor"].handle_request.remote(
         "kv_rendezvous", (), {}), timeout=30)
     assert rdv and rdv["host"], "replica published no rendezvous"
+    # the router honors a cursor's kv_origin only once the membership
+    # broadcast has shown it that pull address (the trust gate a
+    # forged cursor cannot pass) — wait for the broadcast to land
+    _wait(lambda: rs._trusted_rdv(dict(rdv)) is not None,
+          msg="origin's kv_rdv observed by the router")
     other = next(r for r in rs._replicas
                  if r["replica_tag"] != origin["replica_tag"])
     assert stats_of(other)["prefix_hit_tokens"] == 0
@@ -609,6 +712,11 @@ def test_kill_origin_mid_migration_reprefills_with_parity(
     rdv = ray_tpu.get(origin["actor"].handle_request.remote(
         "kv_rendezvous", (), {}), timeout=30)
     assert rdv
+    # let the router observe the rdv BEFORE the kill so the cursor
+    # passes the trust gate (grace window covers the departure) and
+    # the test exercises pull-fails -> re-prefill, not trust-drop
+    _wait(lambda: rs._trusted_rdv(dict(rdv)) is not None,
+          msg="origin's kv_rdv observed by the router")
     ray_tpu.kill(origin["actor"])  # mid-migration: rdv now points at a corpse
 
     k = 4
