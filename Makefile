@@ -19,7 +19,8 @@ SHELL := /bin/bash
 
 .PHONY: store store-tsan store-asan sanitize clean lint \
 	lint-concurrency-strict verify check \
-	bench-quick bench-llm-quick bench-transfer bench-collective \
+	bench-quick bench-llm-quick bench-llm-tier-quick bench-transfer \
+	bench-collective \
 	bench-collective-quick bench-control bench-control-quick \
 	bench-serve-scale bench-serve-scale-quick bench-data \
 	bench-data-quick bench-trace bench-trace-quick bench-train \
@@ -73,6 +74,14 @@ bench-quick:
 bench-llm-quick:
 	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
 		$(PY) bench.py --suite serve_llm --quick
+
+# <60 s KV-tiering smoke (smoke sizing; HEADLINE last): sessions held
+# per GB of decode-pool memory with tiering on vs off at equal pool
+# bytes, plus store-resurrect vs re-prefill resume latency with the
+# greedy-parity check in-bench.  Does NOT touch BENCH_serve_llm.json.
+bench-llm-tier-quick:
+	env JAX_PLATFORMS=cpu RT_DISABLE_TPU_DETECTION=1 timeout -k 10 120 \
+		$(PY) bench.py --suite serve_llm_tier --quick
 
 # Object transfer plane GB/s (pull/push, striped, vs stop-and-wait
 # baseline); refreshes the checked-in BENCH_transfer.json artifact.
@@ -244,6 +253,7 @@ chaos:
 		tests/test_autopilot.py::test_chaos_node_sigkill_mid_revocation \
 		tests/test_autopilot.py::test_chaos_gcs_sigkill_mid_arbitration_no_stale_grants \
 		tests/test_serve_kv_affinity.py::test_sse_resume_header_lands_through_proxy \
+		tests/test_serve_llm_tier.py::test_kill_replica_with_demoted_sessions_resurrects_elsewhere \
 	|| { echo "CHAOS BATTERY FAILED — replay with:" \
 	     "make chaos CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 	@echo "== kill-origin-mid-migration x3 (locksan over kv_transfer) =="
@@ -272,7 +282,8 @@ chaos-smoke:
 	     "make chaos-smoke CHAOS_SEED=$(CHAOS_SEED)"; exit 1; }
 
 check: lint verify chaos-smoke bench-quick bench-llm-quick \
-	bench-collective-quick bench-control-quick bench-serve-scale-quick \
+	bench-llm-tier-quick bench-collective-quick bench-control-quick \
+	bench-serve-scale-quick \
 	bench-data-quick bench-trace-quick bench-train-quick \
 	bench-autopilot-quick
 

@@ -17,7 +17,14 @@ Suites (--suite):
              workloads: concurrent capacity, TTFT (incl. prefix-cache
              hits), tokens/sec, speculation acceptance.  Writes
              BENCH_serve_llm.json (the checked-in artifact); --quick
-             is the <60s smoke variant wired into make check.
+             is the <60s smoke variant wired into make check.  Includes
+             the KV-tiering leg: sessions held per GB of decode-pool
+             memory (tiering on/off at equal pool bytes) and
+             store-resurrect vs re-prefill resume latency.
+  serve_llm_tier
+             ONLY the KV-tiering leg above, standalone (the <60s
+             make bench-llm-tier-quick smoke; does not write an
+             artifact unless --json-out is given).
   transfer   node-to-node object plane: same-host multi-raylet pull/push
              GB/s (1 MiB / 64 MiB / 512 MiB; 1-source vs 2-source
              striped) vs the stop-and-wait pickled-chunk baseline, with
@@ -669,6 +676,206 @@ def _llm_run_workload(eng, reqs, stagger_s=0.01, warm_first=False,
     return asyncio.run(run())
 
 
+def _llm_tier_leg(cfg, params, quick):
+    """KV tiering leg: sessions held per GB of DECODE-POOL memory
+    (tiering on vs off at equal pool bytes) plus store-resurrect vs
+    re-prefill resume latency.
+
+    "Held" means the session's full prompt prefix is still resident
+    somewhere in the hierarchy — promotable pool/host/store pages for
+    the tiering engine, pool pages only for the baseline (what the
+    pre-tiering engine could reuse).  The tiering engine spends extra
+    HOST/DISK bytes for the win (recorded honestly in tier_pages);
+    the per-GB figure charges both engines the same decode-pool
+    bytes, which is the scarce resource the hierarchy exists to
+    stretch."""
+    import asyncio
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+    from ray_tpu.serve.llm import GenerationEngine
+
+    page_size = 8 if quick else 16
+    pool_pages = 24 if quick else 32
+    n_sessions = 64 if quick else 96
+    n_timed = 5 if quick else 8
+    plen = 4 * page_size          # 4 full prompt pages per session
+    gen = 4
+    max_seq = plen + gen + 2 * page_size
+    prompts = [_llm_tokens(cfg, 9000 + i, plen)
+               for i in range(n_sessions)]
+    store = tempfile.mkdtemp(prefix="rt_bench_kvstore_")
+
+    def _engine(tiering, prefix=True, name="t"):
+        return GenerationEngine(
+            params, cfg, num_slots=4, max_seq=max_seq,
+            prefill_chunk=32, max_queue_len=256, page_size=page_size,
+            kv_pages=pool_pages, enable_prefix_cache=prefix,
+            kv_tiering=tiering, kv_store_dir=store,
+            name=f"bench-tier-{name}")
+
+    def _sweep(eng):
+        return eng.run_on_worker(
+            lambda: eng._maybe_sweep_tiers(force=True))
+
+    def _held(eng):
+        def count():
+            n = 0
+            for toks in prompts:
+                _, matched = eng._prefix.match_nodes(toks)
+                n += matched >= plen
+            return n
+        return eng.run_on_worker(count)
+
+    async def _drive(eng, tiered):
+        await eng.generate(_llm_tokens(cfg, 8888, 5),
+                           max_new_tokens=4)   # compile warmup
+        for i, p in enumerate(prompts):
+            await eng.generate(p, max_new_tokens=gen,
+                               session_id=f"bench-sess-{i}")
+            if tiered:
+                _sweep(eng)  # cool finished sessions out of the pool
+
+    old_idle = _cfg.serve_kv_demote_idle_s
+    old_t2 = _cfg.serve_kv_t2_idle_s
+    _cfg.serve_kv_demote_idle_s = 0.0
+    _cfg.serve_kv_t2_idle_s = 1e9
+    try:
+        base = _engine(False, name="off")
+        base.start()
+        asyncio.run(_drive(base, tiered=False))
+        held_off = _held(base)
+        base.stop()
+
+        eng = _engine(True, name="on")
+        eng.start()
+        asyncio.run(_drive(eng, tiered=True))
+        held_on = _held(eng)
+        st = eng.stats()
+        pool_bytes = pool_pages * eng._page_nbytes
+
+        # Resume latency: everything demoted to the STORE (the state a
+        # session is in when it resurrects on a different replica),
+        # then resurrect + one continuation token, re-cooling between
+        # samples so each one pays the real import.
+        eng.run_on_worker(eng.kv_flush_to_store)
+        # untimed warmup: compile the resurrect-continuation shapes so
+        # the timed p99 measures the import, not the first jit
+        warm = eng.run_on_worker(
+            lambda: eng.session_resurrect(f"bench-sess-{n_timed}"))
+        asyncio.run(eng.generate([int(t) for t in warm["tokens"]],
+                                 max_new_tokens=1))
+        eng.run_on_worker(eng.kv_flush_to_store)
+        resurrect_s = []
+        ref = None
+        for i in range(n_timed):
+            sid = f"bench-sess-{i}"
+            t0 = _time.perf_counter()
+            res = eng.run_on_worker(
+                lambda s=sid: eng.session_resurrect(s))
+            toks = [int(t) for t in res["tokens"]]
+            out = asyncio.run(eng.generate(toks, max_new_tokens=1))
+            resurrect_s.append(_time.perf_counter() - t0)
+            if i == 0:
+                ref = (toks, out)
+            eng.run_on_worker(eng.kv_flush_to_store)
+        eng.stop()
+
+        # Re-prefill baseline: same continuations, no cache at all —
+        # what resurrect replaces.  Parity: the resurrected
+        # continuation must be bit-identical to the from-scratch one.
+        cold = _engine(False, prefix=False, name="cold")
+        cold.start()
+        asyncio.run(cold.generate(_llm_tokens(cfg, 8888, 5),
+                                  max_new_tokens=4))
+        reprefill_s = []
+        for _ in range(n_timed):
+            t0 = _time.perf_counter()
+            out = asyncio.run(cold.generate(ref[0], max_new_tokens=1))
+            reprefill_s.append(_time.perf_counter() - t0)
+        parity_ok = out == ref[1]
+        cold.stop()
+    finally:
+        _cfg.serve_kv_demote_idle_s = old_idle
+        _cfg.serve_kv_t2_idle_s = old_t2
+        import shutil
+        shutil.rmtree(store, ignore_errors=True)
+
+    gib = pool_bytes / 2**30
+    res_p50 = _pct(resurrect_s, 0.5)
+    pre_p50 = _pct(reprefill_s, 0.5)
+    # Prefill cost grows ~linearly with prefix length; resurrect cost
+    # is dominated by fixed per-page IO.  The crossover estimate
+    # extrapolates from the measured point.
+    crossover = (round(len(ref[0]) * res_p50 / max(1e-9, pre_p50))
+                 if res_p50 > pre_p50 else len(ref[0]))
+    return {
+        "pool_pages": pool_pages,
+        "page_size": page_size,
+        "pool_bytes": pool_bytes,
+        "sessions_submitted": n_sessions,
+        "sessions_held": {"tiering_off": held_off,
+                          "tiering_on": held_on},
+        "sessions_held_per_gb": {
+            "tiering_off": round(held_off / gib, 1),
+            "tiering_on": round(held_on / gib, 1)},
+        "held_ratio": round(held_on / max(1, held_off), 2),
+        "tier_pages": {"t1": st.kv_t1_pages, "t2": st.kv_t2_pages},
+        "kv_demotions": st.kv_demotions,
+        "resume": {
+            "prefix_tokens": len(ref[0]),
+            "resurrect_p50_s": round(res_p50, 4),
+            "resurrect_p99_s": round(_pct(resurrect_s, 0.99), 4),
+            "reprefill_p50_s": round(pre_p50, 4),
+            "reprefill_p99_s": round(_pct(reprefill_s, 0.99), 4),
+            "crossover_prefix_tokens": crossover,
+            "greedy_parity_ok": parity_ok,
+            # Honest-reporting: on CPU the prefill being replaced is
+            # compute-bound and cheap at these model sizes, so the
+            # crossover sits deeper than it would on an accelerator
+            # where prefill FLOPs are the expensive side.
+            "regime": jax.devices()[0].platform,
+        },
+    }
+
+
+def serve_llm_tier_main(json_out=None, quick=False):
+    """Standalone tiering leg (make bench-llm-tier-quick): sessions
+    held per GB + resurrect-vs-reprefill, without the full
+    paged-vs-slot sweep."""
+    import jax
+
+    from ray_tpu.models import gpt
+
+    cfg = _serve_llm_cfg(quick)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    tier = _llm_tier_leg(cfg, params, quick)
+    result = {
+        "metric": "serve_llm_sessions_held_per_gb",
+        "value": tier["sessions_held_per_gb"]["tiering_on"],
+        "unit": "sessions/GiB",
+        "vs_tiering_off": tier["held_ratio"],
+        "detail": tier,
+    }
+    line = json.dumps(result)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+    print("HEADLINE serve_llm_tier sessions/GiB="
+          + _fmt_headline(result["value"])
+          + " vs_off=" + _fmt_headline(tier["held_ratio"], 2) + "x"
+          + " resurrect_p99_s=" + _fmt_headline(
+              tier["resume"]["resurrect_p99_s"], 4)
+          + " reprefill_p99_s=" + _fmt_headline(
+              tier["resume"]["reprefill_p99_s"], 4)
+          + " parity=" + str(tier["resume"]["greedy_parity_ok"]))
+    return result
+
+
 def _llm_engine(params, cfg, mode, *, num_slots, max_seq, kv_tokens,
                 page_size=16, speculate_k=0):
     """mode 'paged': page-table pool + radix prefix cache.  mode
@@ -800,6 +1007,9 @@ def serve_llm_main(json_out=None, quick=False):
         "predictable_text_off": measure(
             "paged", "repetitive", use_params=zero_params)}
 
+    # KV tiering: sessions held per GB of pool + resume latency
+    detail["tiering"] = _llm_tier_leg(cfg, params, quick)
+
     mixed = w["mixed"]
     paged_tps = mixed["paged"]["tokens_per_sec"]
     result = {
@@ -834,7 +1044,11 @@ def serve_llm_main(json_out=None, quick=False):
           + " vs_nospec=" + _fmt_headline(
               spec["predictable_text_off"]["tokens_per_sec"])
           + " spec_random_acceptance=" + _fmt_headline(
-              spec["random_text_on"].get("spec_acceptance"), 3))
+              spec["random_text_on"].get("spec_acceptance"), 3)
+          + " tier_sessions/GiB=" + _fmt_headline(
+              detail["tiering"]["sessions_held_per_gb"]["tiering_on"])
+          + " vs_off=" + _fmt_headline(
+              detail["tiering"]["held_ratio"], 2) + "x")
     return result
 
 
@@ -3582,8 +3796,8 @@ if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="train",
-                    choices=["train", "serve_llm", "transfer",
-                             "collective", "control_plane",
+                    choices=["train", "serve_llm", "serve_llm_tier",
+                             "transfer", "collective", "control_plane",
                              "serve_scale", "data", "trace",
                              "train_e2e", "autopilot"])
     ap.add_argument("--json-out", default=None,
@@ -3599,6 +3813,8 @@ if __name__ == "__main__":
         serve_llm_main(cli.json_out if cli.quick
                        else (cli.json_out or "BENCH_serve_llm.json"),
                        quick=cli.quick)
+    elif cli.suite == "serve_llm_tier":
+        serve_llm_tier_main(cli.json_out, quick=cli.quick)
     elif cli.suite == "transfer":
         transfer_main(cli.json_out or "BENCH_transfer.json")
     elif cli.suite == "collective":

@@ -303,6 +303,49 @@ class _Config:
     # the grace window covers the dead-replica resume case, where the
     # origin is gone from membership by the time the client retries.
     serve_kv_rdv_grace_s = _def("serve_kv_rdv_grace_s", float, 120.0)
+    # --- KV memory hierarchy (cold-page tiering + durable sessions) ---
+    # Master switch for the three-tier hierarchy: T0 decode pool, T1
+    # host shared-memory arena, T2 file-backed page store.  Off keeps
+    # the pure pool-bound behavior (the bench's tiering-off baseline).
+    serve_kv_tiering = _def("serve_kv_tiering", bool, True)
+    # A tree-only T0 page with no decode tick for this long is demoted
+    # to the host arena by the engine's sweeper.  Short enough that an
+    # idle conversation releases its pool pages well before a typical
+    # human reply; long enough that an actively streaming request's
+    # shared prefix never thrashes.
+    serve_kv_demote_idle_s = _def("serve_kv_demote_idle_s", float, 30.0)
+    # A T1 page idle this long past its demotion moves on to the store
+    # tier (T2) — where it survives replica death and is pullable from
+    # any replica on the host.
+    serve_kv_t2_idle_s = _def("serve_kv_t2_idle_s", float, 120.0)
+    # Sweeper cadence.  Also the retry hint submit() sends when the
+    # demotable cold-page headroom could cover a rejected reservation:
+    # one sweep from now the pages will be free.
+    serve_kv_tier_sweep_s = _def("serve_kv_tier_sweep_s", float, 2.0)
+    # Host-arena (T1) byte budget per engine.  Overflow demotes the
+    # arena's coldest pages straight to the store tier, so T1 is a
+    # cache over T2, never a second hard ceiling.
+    serve_kv_t1_budget_bytes = _def("serve_kv_t1_budget_bytes",
+                                    int, 256 * 1024**2)
+    # Store-tier (T2) directory, shared by every replica on the host
+    # (the spill-directory pattern); empty means
+    # <tempdir>/rt_kv_store-<uid>.  Pages are content-addressed by
+    # chained prefix fingerprint, so two replicas that never exchanged
+    # state agree on the key of a shared prefix.
+    serve_kv_store_dir = _def("serve_kv_store_dir", str, "")
+    # Store entries (pages and session manifests) older than this are
+    # garbage-collected by the sweeper; bounds disk growth at the cost
+    # of how long a dormant session stays resurrectable.
+    serve_kv_store_ttl_s = _def("serve_kv_store_ttl_s", float, 3600.0)
+    # Retry-After for kv_exhausted rejections when no demotion headroom
+    # applies (a KV pool drains at generation speed).  Sub-second values
+    # are honored: the HTTP surface sends float seconds on the wire.
+    serve_kv_retry_after_s = _def("serve_kv_retry_after_s", float, 5.0)
+    # Router affinity: a digest hit whose deepest node sits in T1/T2 is
+    # discounted by this factor versus a T0 hit — promoted pages cost a
+    # host->device splice the decode-pool hit does not.
+    serve_affinity_tier_discount = _def("serve_affinity_tier_discount",
+                                        float, 0.5)
 
     # --- cluster autopilot (SLO-driven arbiter, _private/arbiter.py) ---
     # The GCS broker's arbitration tick: how often registered workload

@@ -186,6 +186,19 @@ def paged_read_pages(cache: Dict, page_ids) -> Tuple[Any, Any]:
             jnp.moveaxis(cache["v"][:, page_ids], 0, 1))
 
 
+def paged_read_pages_host(cache: Dict, page_ids) -> Tuple[Any, Any]:
+    """paged_read_pages + the host landing: contiguous page-major numpy
+    K/V stacks, ready to frame byte-for-byte (tier demotion, migration
+    export).  One fused device gather however many pages ride along —
+    the demotion sweeper batches a whole sweep into one call, and the
+    promote/demote paths share this copy discipline so their bytes can
+    never diverge from what the wire path ships."""
+    import numpy as np
+    k, v = paged_read_pages(
+        cache, jnp.asarray(np.asarray(page_ids, np.int32)))
+    return np.ascontiguousarray(k), np.ascontiguousarray(v)
+
+
 def paged_chunk_step(params: Dict, tokens, pos, cache: Dict,
                      block_tables, cfg, pad_lo=None
                      ) -> Tuple[Any, Dict]:

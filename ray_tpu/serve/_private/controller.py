@@ -53,7 +53,12 @@ def _replica_load(metrics: Dict, target_per_replica: float) -> float:
 
       * (active_slots + queue_depth) / num_slots — decode-slot pressure
         including the engine's own waiting line;
-      * 1 - kv_blocks_free/kv_blocks_total — KV page pressure.
+      * 1 - kv_blocks_reclaimable/kv_blocks_total — KV page pressure.
+        Reclaimable counts free pages PLUS cold tree-only pages the
+        tier sweeper can demote to host/store on demand: a replica
+        whose pool is full of idle sessions is not saturated — the
+        pages are a cache, not demand — so counting them as pressure
+        would trigger phantom scale-ups.
     """
     load = metrics.get("ongoing", 0) / max(target_per_replica, 1e-9)
     num_slots = metrics.get("num_slots") or 0
@@ -62,9 +67,10 @@ def _replica_load(metrics: Dict, target_per_replica: float) -> float:
                           + metrics.get("queue_depth", 0)) / num_slots)
     kv_total = metrics.get("kv_blocks_total") or 0
     if kv_total > 0:
-        load = max(load,
-                   1.0 - metrics.get("kv_blocks_free", kv_total)
-                   / kv_total)
+        kv_avail = metrics.get("kv_blocks_reclaimable")
+        if kv_avail is None:
+            kv_avail = metrics.get("kv_blocks_free", kv_total)
+        load = max(load, 1.0 - min(kv_avail, kv_total) / kv_total)
     return load
 
 

@@ -134,11 +134,20 @@ class HTTPProxy:
             return None
         if not isinstance(data, dict):
             return None
+        hint = None
         toks = data.get("tokens", data.get("prompt"))
         if isinstance(toks, list) and toks \
                 and all(isinstance(t, int) for t in toks):
-            return {"tokens": toks}
-        return None
+            hint = {"tokens": toks}
+        session = data.get("session")
+        if isinstance(session, str) and session:
+            # A durable-session id rides the hint so the router can
+            # thread it through resume cursors (any replica can
+            # resurrect the session from the store, so it biases
+            # nothing — it just has to SURVIVE the hop).
+            hint = dict(hint or {})
+            hint["session"] = session
+        return hint
 
     @staticmethod
     def resume_cursor_of(headers: Dict[str, str]) -> Optional[Dict]:
@@ -161,7 +170,10 @@ class HTTPProxy:
             return None
         if isinstance(cur, dict) \
                 and (cur.get("items") or cur.get("delivered")
-                     or cur.get("kv_origin")):
+                     or cur.get("kv_origin") or cur.get("session")):
+            # A session-only cursor is worth keeping too: the replica
+            # resurrects the session's pages from the durable store
+            # even when the origin replica is long gone.
             return cur
         return None
 

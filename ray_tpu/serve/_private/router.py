@@ -619,6 +619,11 @@ class ReplicaSet:
             resumable = False
             origin_rdv = None
             last_page = 0
+            # Durable-session id: survives the whole failover chain in
+            # cursors so a resumed stream can resurrect its KV pages
+            # from the store even when the origin replica is long dead.
+            session = (resume or {}).get("session") \
+                or (affinity or {}).get("session")
 
             def _cursor_extras() -> Dict:
                 """KV extras for an outgoing StreamInterrupted cursor:
@@ -639,6 +644,8 @@ class ReplicaSet:
                         _cfg.serve_affinity_digest_depth)
                 if fps:
                     out["digest"] = list(fps)
+                if session:
+                    out["session"] = session
                 return out
 
             if resume:
@@ -702,6 +709,18 @@ class ReplicaSet:
                             resume_state = resume_state or \
                                 {"delivered": 0, "items": []}
                             resume_state["kv_origin"] = origin_rdv
+                        if session:
+                            # Replica-side api.stream reads the session
+                            # id out of _resume and resurrects the
+                            # conversation's KV pages from the store
+                            # before admission.  Forwarded even at
+                            # delivered=0: a client reconnecting
+                            # minutes later holds a cursor with no
+                            # undelivered items but a session worth
+                            # resurrecting.
+                            resume_state = resume_state or \
+                                {"delivered": 0, "items": []}
+                            resume_state["session"] = session
                         t_assign = time.time()
                         started = await self._stream_rpc(
                             actor.handle_request_streaming.remote(
@@ -921,17 +940,27 @@ class ReplicaSet:
                                  fps_cache)
             if not fps:
                 continue
-            have = {x.get("fp") for x in (dig.get("roots") or ())}
-            hits = 0
+            have = {x.get("fp"): int(x.get("t") or 0)
+                    for x in (dig.get("roots") or ())}
+            hits, hit_tier = 0, 0
             for d, fp in enumerate(fps, 1):
                 if fp in have:
-                    hits = d
+                    hits, hit_tier = d, have[fp]
             load = self._load_norm(r)
-            score = blend * (hits / len(fps)) - (1.0 - blend) * load
+            # A tiered hit (digest entry's worst tier > T0) still saves
+            # the prefill, but the replica must promote the pages back
+            # into the decode pool first — weigh it below an
+            # equally-deep hot hit so T0 holders win ties.
+            weight = 1.0 if hit_tier == 0 else max(
+                0.0, min(1.0,
+                         float(_cfg.serve_affinity_tier_discount)))
+            score = blend * weight * (hits / len(fps)) \
+                - (1.0 - blend) * load
             key = (score, -load)
             if best_key is None or key > best_key:
                 best, best_key = r, key
                 best_meta = {"hits": hits, "chain": len(fps),
+                             "tier": hit_tier,
                              "score": round(score, 4),
                              "load": round(load, 4)}
         if best is None or not best_meta["hits"]:

@@ -107,12 +107,15 @@ class LLMServer:
         with only the undelivered suffix — bit-identical for greedy
         (temperature=0) requests; sampled requests resume on a fresh
         RNG stream past the cursor (documented parity caveat)."""
+        session = overrides.pop("session", None) \
+            or (_resume or {}).get("session")
         kw = self._gen_kwargs(overrides)
         tokens, remaining = self._trim_for_resume(tokens, kw, _resume)
         if remaining <= 0:
             return
-        await self._maybe_pull_kv(_resume, tokens)
-        stream = self.engine.submit(tokens, **kw)
+        rng_state = await self._prepare_kv(_resume, tokens, session)
+        stream = self.engine.submit(tokens, session_id=session,
+                                    rng_state=rng_state, **kw)
         try:
             async for tok in stream:
                 yield int(tok)
@@ -160,6 +163,33 @@ class LLMServer:
             return 0  # resumed onto the origin itself: pages already here
         return await kv_transfer.pull_kv_pages(rdv, tokens, self.engine)
 
+    async def _prepare_kv(self, _resume: Optional[Dict],
+                          tokens: Sequence[int],
+                          session: Optional[str]) -> Optional[Dict]:
+        """Pre-submit KV warm-up, cheapest source first: a live origin
+        pull (failover cursor), then the durable-session store.  The
+        store path is what makes a session resurrect ANYWHERE — the
+        origin can be minutes dead, any replica on the host imports its
+        pages from T2 and the rest re-prefills bit-identically.
+        Returns the session's checkpointed sampler state (None for
+        greedy sessions or when nothing resurrected)."""
+        try:
+            await self._maybe_pull_kv(_resume, tokens)
+        except Exception:
+            pass  # best-effort: re-prefill covers it
+        rng_state = None
+        if session and _cfg.serve_kv_tiering:
+            try:
+                res = await kv_transfer._on_worker(
+                    self.engine,
+                    lambda: self.engine.session_resurrect(session,
+                                                          tokens))
+            except Exception:
+                res = None
+            if res is not None:
+                rng_state = res.get("rng_state")
+        return rng_state
+
     # -- KV migration control surface (router / controller RPCs) -------
 
     def kv_rendezvous(self) -> Optional[Dict]:
@@ -172,7 +202,17 @@ class LLMServer:
         fetches this from a DRAINING replica and hands it to the chosen
         survivor's kv_pull_from — the survivor pulls, so teardown
         ordering stays trivial (the origin just keeps serving exports
-        until its pages have been copied out)."""
+        until its pages have been copied out).
+
+        With tiering on, every demotable page is flushed to the store
+        FIRST: a dying replica demotes instead of dropping, so even if
+        no survivor ever pulls (or this process is killed mid-drain
+        afterwards), its sessions resurrect anywhere from T2."""
+        try:
+            self.engine.run_on_worker(self.engine.kv_flush_to_store,
+                                      timeout=10.0)
+        except Exception:
+            pass  # flush is belt-and-braces; the pull path still runs
         rdv = kv_transfer.rendezvous(self.engine)
         if rdv is None:
             return None
@@ -238,6 +278,7 @@ class LLMServer:
             return _http_error(400, 'body must be {"tokens": [...]}')
         wants_sse = _wants_stream(request)
         overrides = {k: body[k] for k in _GEN_KEYS if k in body}
+        session = body.get("session") or (_resume or {}).get("session")
         try:
             kw = self._gen_kwargs(overrides)
             if wants_sse:
@@ -245,15 +286,24 @@ class LLMServer:
                     body["tokens"], kw, _resume)
                 if remaining <= 0:
                     return self._no_events()
-                await self._maybe_pull_kv(_resume, toks)
-                stream = self.engine.submit(toks, **kw)
+                rng_state = await self._prepare_kv(_resume, toks,
+                                                   session)
+                stream = self.engine.submit(toks, session_id=session,
+                                            rng_state=rng_state, **kw)
                 return self._sse_events(stream)
-            out = await self.engine.generate(body["tokens"], **kw)
+            toks = [int(t) for t in body["tokens"]]
+            rng_state = await self._prepare_kv(None, toks, session)
+            out = await self.engine.generate(
+                toks, session_id=session, rng_state=rng_state, **kw)
         except EngineOverloadedError as e:
             # Retry-After tracks WHAT saturated: a full waiting line
             # drains at admission speed (short), an exhausted KV pool
-            # drains at generation speed (longer).
-            retry = str(max(1, int(getattr(e, "retry_after_s", 1.0))))
+            # drains at generation speed (longer).  Seconds as a FLOAT:
+            # the engine's tier-aware hint can be sub-second — one
+            # demotion sweep away — and the old max(1, int(...))
+            # rounding turned 0.25s of backoff into a full second of
+            # idle client on every retry.
+            retry = f"{max(0.05, float(getattr(e, 'retry_after_s', 1.0))):.3f}"
             return _http_error(503, str(e),
                                headers=[("Retry-After", retry)])
         except (TypeError, ValueError) as e:

@@ -67,7 +67,12 @@ from ray_tpu._private import locksan
 from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu.models import decode
-from ray_tpu.serve.llm.paging import BlockAllocator, RadixPrefixCache
+from ray_tpu.serve.llm.kv_tier import (HostKVArena, KVPageStore,
+                                       frame_crc, page_frame,
+                                       split_frame)
+from ray_tpu.serve.llm.paging import (TIER_HOST, TIER_POOL, TIER_STORE,
+                                      BlockAllocator, RadixPrefixCache,
+                                      prefix_fingerprints)
 from ray_tpu.serve.llm.scheduler import EngineOverloadedError, FCFSScheduler
 from ray_tpu.util import metrics as _metrics
 
@@ -124,6 +129,22 @@ PREFIX_MISSES_COUNTER = _metrics.Counter(
 SPEC_ACCEPTED_COUNTER = _metrics.Counter(
     "serve_llm_spec_accepted_tokens_total",
     "Draft tokens accepted by speculative verification",
+    tag_keys=("engine",))
+KV_TIER_PAGES_GAUGE = _metrics.Gauge(
+    "serve_llm_kv_tier_pages",
+    "Prefix-cache pages by tier (t0=decode pool, t1=host arena, "
+    "t2=store)", tag_keys=("engine", "tier"))
+KV_DEMOTIONS_COUNTER = _metrics.Counter(
+    "serve_llm_kv_demotions_total",
+    "Pages demoted out of the decode pool / host arena, by "
+    "destination tier", tag_keys=("engine", "to"))
+KV_PROMOTIONS_COUNTER = _metrics.Counter(
+    "serve_llm_kv_promotions_total",
+    "Demoted pages promoted back into the decode pool on a prefix "
+    "hit", tag_keys=("engine",))
+RESURRECTIONS_COUNTER = _metrics.Counter(
+    "serve_llm_session_resurrections_total",
+    "Durable sessions restored from the store tier",
     tag_keys=("engine",))
 
 
@@ -277,6 +298,11 @@ class EngineStats:
     prefix_hit_tokens: int = 0
     spec_drafted_tokens: int = 0
     spec_accepted_tokens: int = 0
+    kv_t1_pages: int = 0
+    kv_t2_pages: int = 0
+    kv_demotions: int = 0
+    kv_promotions: int = 0
+    session_resurrections: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -306,17 +332,28 @@ class _Request:
                  "top_k", "eos_token", "rng", "stream", "submit_t",
                  "first_token_t", "last_token_t", "emitted", "n_blocks",
                  "pages", "tokens", "prefix_hit_tokens", "ngram_map",
-                 "ngram_upto", "trace")
+                 "ngram_upto", "trace", "session")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
-                 eos_token, seed, n_blocks):
+                 eos_token, seed, n_blocks, session=None,
+                 rng_state=None):
         self.id = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.top_k = top_k
         self.eos_token = eos_token
+        self.session = session   # durable-session id (None = ephemeral)
         self.rng = np.random.default_rng(seed) if temperature > 0 else None
+        if self.rng is not None and rng_state is not None:
+            # Resurrected sampled session: continue the EXACT random
+            # stream the checkpoint froze, so the continuation draws
+            # what the original replica would have drawn.
+            try:
+                self.rng.bit_generator.state = rng_state
+            except (TypeError, ValueError, KeyError):
+                logger.warning("request %s: stale sampler state "
+                               "ignored; reseeding", rid)
         self.stream = TokenStream(rid)
         self.submit_t = time.monotonic()
         self.first_token_t: Optional[float] = None
@@ -467,7 +504,9 @@ class GenerationEngine:
                  page_size: int = 16, kv_pages: Optional[int] = None,
                  enable_prefix_cache: bool = True,
                  speculate_k: int = 0, speculate_ngram: int = 3,
-                 kv_commit_factor: float = 4.0):
+                 kv_commit_factor: float = 4.0,
+                 kv_tiering: Optional[bool] = None,
+                 kv_store_dir: Optional[str] = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if prefill_chunk < 1:
@@ -534,6 +573,33 @@ class GenerationEngine:
             self.page_size, self._alloc,
             digest_depth=_cfg.serve_affinity_digest_depth)
             if enable_prefix_cache else None)
+        # --- KV tier hierarchy (T0 pool / T1 host arena / T2 store) ---
+        # One page's at-rest frame: K then V bytes of [L, psz, Hkv, Dh].
+        self._page_dtype = np.dtype(cfg.dtype)
+        self._page_kshape = (cfg.n_layers, self.page_size,
+                             decode._kv_heads(cfg), cfg.head_dim)
+        self._page_k_nbytes = (int(np.prod(self._page_kshape))
+                               * self._page_dtype.itemsize)
+        self._page_nbytes = 2 * self._page_k_nbytes
+        self._tiering = bool(_cfg.serve_kv_tiering
+                             if kv_tiering is None else kv_tiering) \
+            and enable_prefix_cache
+        self._kv_store_dir = kv_store_dir
+        self._arena: Optional[HostKVArena] = None   # lazy (worker)
+        self._store: Optional[KVPageStore] = None   # lazy (worker)
+        self._last_sweep = time.monotonic()
+        self._last_store_gc = time.monotonic()
+        self._demotions = 0
+        self._promotions = 0
+        self._resurrections = 0
+        # Racy-read hint for submit()'s Retry-After and load_info's
+        # reclaimable gauge: pool pages a pressure demotion could free
+        # (tree-only T0 pages).  Worker thread refreshes it with the
+        # gauges; readers tolerate staleness.
+        self._demotable_hint = 0
+        if self._prefix is not None:
+            self._prefix.release_payload = self._release_tier_payload
+
         self._block_tables = np.zeros((num_slots, self._max_blocks),
                                       np.int32)
         self._pos = np.zeros((num_slots,), np.int32)
@@ -640,7 +706,9 @@ class GenerationEngine:
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                eos_token: Optional[int] = None, seed: int = 0,
-               request_id: Optional[str] = None) -> TokenStream:
+               request_id: Optional[str] = None,
+               session_id: Optional[str] = None,
+               rng_state: Optional[Dict] = None) -> TokenStream:
         """Queue one prompt; returns its TokenStream immediately.
 
         Raises EngineOverloadedError when admission is saturated —
@@ -676,19 +744,28 @@ class GenerationEngine:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         req = _Request(request_id or uuid.uuid4().hex[:12], prompt,
                        max_new, temperature, top_k, eos_token, seed,
-                       n_blocks)
+                       n_blocks, session=session_id, rng_state=rng_state)
         with self._cond:
             if self._committed_blocks + n_blocks > self._commit_cap:
                 self._rejected += 1
                 REQUESTS_COUNTER.inc(tags={**self._tags,
                                            "status": "rejected"})
+                # Retry hint from config, not a constant — and when the
+                # demotion sweeper could free enough cold pages for
+                # this request by its next pass, say THAT horizon (the
+                # client should come back after one sweep, not after
+                # the generic backoff).
+                retry = max(0.05, float(_cfg.serve_kv_retry_after_s))
+                if self._tiering and self._demotable_hint >= n_blocks:
+                    retry = min(retry, max(
+                        0.05, float(_cfg.serve_kv_tier_sweep_s)))
                 raise EngineOverloadedError(
                     f"KV pool exhausted: {self._committed_blocks} pages "
                     f"of worst-case demand outstanding + {n_blocks} "
                     f"needed exceeds the commit cap "
                     f"({self._commit_cap} = factor * {self.kv_pages} "
                     f"pages); retry later",
-                    reason="kv_exhausted", retry_after_s=5.0)
+                    reason="kv_exhausted", retry_after_s=retry)
             try:
                 self._scheduler.enqueue(req)
             except EngineOverloadedError:
@@ -742,31 +819,57 @@ class GenerationEngine:
 
     def kv_export(self, tokens: Sequence[int]) -> Optional[Dict]:
         """Worker command: snapshot the K/V pages of `tokens`' longest
-        cached full-page prefix, page-major on host.  The matched pages
-        are INCREF'd before anything else — an eviction racing the
-        migration can drop the radix nodes but never recycle the pages
-        under the wire — and stay pinned until kv_export_release().
-        Returns {"pages", "matched_tokens", "k", "v"} or None when
-        nothing is cached (no full page match, or no prefix cache)."""
+        cached full-page prefix, page-major on host — ANY tier.  Pool
+        pages are INCREF'd before the device read — an eviction racing
+        the migration can drop the radix nodes but never recycle the
+        pages under the wire — and stay pinned until
+        kv_export_release().  Demoted pages are CRC-verified host
+        bytes already and are copied synchronously (nothing to pin; an
+        unreadable tier frame truncates the export there).  Returns
+        {"pages" (the pinned pool pages only), "matched_tokens", "k",
+        "v"} or None when nothing is cached."""
         if self._prefix is None:
             return None
         tokens = [int(t) for t in tokens]
-        pages, matched = self._prefix.match(tokens)
-        if not pages:
+        nodes, _ = self._prefix.match_nodes(tokens)
+        usable, frames = [], {}
+        for n in nodes:
+            if n.tier == TIER_POOL:
+                usable.append(n)
+                continue
+            frame = self._tier_frame(n)
+            if frame is None:
+                break
+            frames[id(n)] = frame
+            usable.append(n)
+        if not usable:
             return None
-        for p in pages:
+        pool_pages = [n.page for n in usable if n.tier == TIER_POOL]
+        for p in pool_pages:
             self._alloc.incref(p)
         try:
-            k, v = decode.paged_read_pages(
-                self._cache,
-                jnp.asarray(np.asarray(pages, np.int32)))
-            k = np.ascontiguousarray(k)
-            v = np.ascontiguousarray(v)
+            if pool_pages:
+                k0, v0 = decode.paged_read_pages_host(self._cache,
+                                                      pool_pages)
+            k = np.empty((len(usable),) + self._page_kshape,
+                         self._page_dtype)
+            v = np.empty_like(k)
+            j = 0
+            for i, n in enumerate(usable):
+                if n.tier == TIER_POOL:
+                    k[i], v[i] = k0[j], v0[j]
+                    j += 1
+                else:
+                    k[i], v[i] = split_frame(
+                        frames[id(n)], self._page_k_nbytes,
+                        self._page_kshape, self._page_kshape,
+                        self._page_dtype)
         except BaseException:
-            for p in pages:
+            for p in pool_pages:
                 self._alloc.decref(p)
             raise
-        return {"pages": list(pages), "matched_tokens": matched,
+        return {"pages": pool_pages,
+                "matched_tokens": len(usable) * self.page_size,
                 "k": k, "v": v}
 
     def kv_export_release(self, pages: Sequence[int]) -> None:
@@ -797,6 +900,11 @@ class GenerationEngine:
             return 0
         need = usable - start
         got = self._alloc.alloc(need)
+        if got is None:
+            # Same pressure order as admission: demote cold pages
+            # before evicting shared prefixes.
+            self._demote_for_pressure(need)
+            got = self._alloc.alloc(need)
         if got is None \
                 and self._alloc.free_pages + self._prefix.releasable() \
                 >= need:
@@ -831,6 +939,304 @@ class GenerationEngine:
             return []
         return self._prefix.hot_prefixes(top_k)
 
+    # ------------------------------------------------------------------
+    # KV memory hierarchy (worker thread owns every method here)
+
+    def _tier_arena(self) -> HostKVArena:
+        if self._arena is None:
+            self._arena = HostKVArena(
+                self._page_nbytes,
+                int(_cfg.serve_kv_t1_budget_bytes), name=self.name)
+        return self._arena
+
+    def _tier_store(self) -> KVPageStore:
+        if self._store is None:
+            self._store = KVPageStore(self._kv_store_dir or None)
+        return self._store
+
+    def _release_tier_payload(self, payload) -> None:
+        """RadixPrefixCache.release_payload hook: hand a T1 slot back
+        to the arena when the tree stops owning it.  T2 entries are
+        left in the store on purpose (the TTL sweep owns them — their
+        persistence is what durable sessions resurrect from)."""
+        if payload and payload[0] == "t1" and self._arena is not None:
+            self._arena.free(payload[1])
+
+    def _tier_frame(self, node) -> Optional[bytes]:
+        """CRC-checked at-rest bytes of a demoted node, or None — a
+        MISS: the caller truncates its match there and the chunk is
+        re-prefilled (bit-identical by determinism).  A page is never
+        imported unverified."""
+        payload = node.payload
+        if payload is None:
+            return None
+        kind, key, crc, nbytes = payload
+        if kind == "t1":
+            frame = (self._arena.get(key)
+                     if self._arena is not None else None)
+        else:
+            frame = self._tier_store().get_page(key)
+        if frame is None or len(frame) != nbytes \
+                or frame_crc(frame) != crc:
+            return None
+        return frame
+
+    def _frames_to_arrays(self, frames):
+        n = len(frames)
+        k = np.empty((n,) + self._page_kshape, self._page_dtype)
+        v = np.empty_like(k)
+        for i, fr in enumerate(frames):
+            k[i], v[i] = split_frame(fr, self._page_k_nbytes,
+                                     self._page_kshape,
+                                     self._page_kshape,
+                                     self._page_dtype)
+        return k, v
+
+    def _sweep_due(self) -> bool:
+        return (self._tiering and self._prefix is not None
+                and time.monotonic() - self._last_sweep
+                >= max(0.05, float(_cfg.serve_kv_tier_sweep_s)))
+
+    def _maybe_sweep_tiers(self, force: bool = False) -> int:
+        """The demotion sweeper: pool pages with no decode tick in
+        serve_kv_demote_idle_s move to the host arena (overflow goes
+        straight to the store), arena pages idle serve_kv_t2_idle_s
+        move to the store, and the store's TTL sweep ages dead entries
+        out.  Runs between ticks at serve_kv_tier_sweep_s cadence;
+        `force` is the test hook."""
+        if not self._tiering or self._prefix is None:
+            return 0
+        now = time.monotonic()
+        if not force and now - self._last_sweep \
+                < max(0.05, float(_cfg.serve_kv_tier_sweep_s)):
+            return 0
+        self._last_sweep = now
+        moved = self._demote_t0(self._prefix.demote_candidates(
+            max(0.0, float(_cfg.serve_kv_demote_idle_s))))
+        moved += self._demote_t1(max(0.0,
+                                     float(_cfg.serve_kv_t2_idle_s)))
+        if self._store is not None \
+                and now - self._last_store_gc >= 60.0:
+            self._last_store_gc = now
+            self._store.sweep(float(_cfg.serve_kv_store_ttl_s))
+        self._update_kv_gauges()
+        return moved
+
+    def _demote_t0(self, nodes) -> int:
+        """Move tree-only pool pages (refcount 1, selected by the
+        caller) into the arena — or the store when the arena budget is
+        spent.  One batched device read covers the whole set; each
+        node's demotion commits only after its frame landed, so a
+        failed landing just leaves the page hot."""
+        if not nodes:
+            return 0
+        k, v = decode.paged_read_pages_host(
+            self._cache, [n.page for n in nodes])
+        moved = 0
+        for i, node in enumerate(nodes):
+            frame = page_frame(k[i], v[i])
+            crc = frame_crc(frame)
+            slot = self._tier_arena().put(frame)
+            if slot is not None:
+                self._prefix.apply_demote(
+                    node, TIER_HOST, ("t1", slot, crc, len(frame)))
+                dest = "t1"
+            else:
+                fp = self._prefix.path_fp(node)
+                if not self._tier_store().put_page(fp, frame):
+                    continue   # nowhere to land: the page stays hot
+                self._prefix.apply_demote(
+                    node, TIER_STORE, ("t2", fp, crc, len(frame)))
+                dest = "t2"
+            moved += 1
+            self._demotions += 1
+            KV_DEMOTIONS_COUNTER.inc(tags={**self._tags, "to": dest})
+        return moved
+
+    def _demote_t1(self, min_idle_s: float) -> int:
+        """Arena pages idle past min_idle_s move to the store (CRC
+        re-verified on the way out; an unreadable slot is skipped and
+        the promote path treats it as a miss)."""
+        if self._arena is None:
+            return 0
+        moved = 0
+        for node in self._prefix.demote_candidates(min_idle_s,
+                                                   tier=TIER_HOST):
+            _, slot, crc, nbytes = node.payload
+            frame = self._arena.get(slot)
+            if frame is None or frame_crc(frame) != crc:
+                continue
+            fp = self._prefix.path_fp(node)
+            if not self._tier_store().put_page(fp, frame):
+                continue
+            self._prefix.apply_demote(node, TIER_STORE,
+                                      ("t2", fp, crc, nbytes))
+            moved += 1
+            self._demotions += 1
+            KV_DEMOTIONS_COUNTER.inc(tags={**self._tags, "to": "t2"})
+        return moved
+
+    def _demote_for_pressure(self, need: int) -> int:
+        """Admission under memory pressure prefers DEMOTING cold
+        tree-only pages (their bytes survive in a lower tier and can
+        be promoted back) over EVICTING shared prefixes (their bytes
+        are gone).  min_idle 0: under pressure anything tree-only is
+        fair game, coldest first."""
+        if not self._tiering or self._prefix is None:
+            return 0
+        short = need - self._alloc.free_pages
+        if short <= 0:
+            return 0
+        return self._demote_t0(
+            self._prefix.demote_candidates(0.0, limit=short))
+
+    def kv_flush_to_store(self) -> int:
+        """Worker command: demote EVERY demotable page — tree-only
+        pool pages and all arena slots — straight to the store.  The
+        drain/teardown path: a dying replica demotes instead of
+        dropping, so its sessions resurrect anywhere from T2."""
+        if not self._tiering or self._prefix is None:
+            return 0
+        store = self._tier_store()
+        flushed = 0
+        nodes = self._prefix.demote_candidates(0.0)
+        if nodes:
+            k, v = decode.paged_read_pages_host(
+                self._cache, [n.page for n in nodes])
+            for i, node in enumerate(nodes):
+                frame = page_frame(k[i], v[i])
+                fp = self._prefix.path_fp(node)
+                if not store.put_page(fp, frame):
+                    continue
+                self._prefix.apply_demote(
+                    node, TIER_STORE,
+                    ("t2", fp, frame_crc(frame), len(frame)))
+                flushed += 1
+                self._demotions += 1
+                KV_DEMOTIONS_COUNTER.inc(tags={**self._tags,
+                                               "to": "t2"})
+        flushed += self._demote_t1(0.0)
+        self._update_kv_gauges()
+        return flushed
+
+    # ------------------------------------------------------------------
+    # Durable sessions (store-backed checkpoint / resurrect)
+
+    def _maybe_checkpoint_session(self, req: _Request) -> None:
+        """Worker thread, called BEFORE the request's pages are
+        released: publish the session's full K/V pages into the radix
+        tree (the tiering sweeper then owns their cooling toward the
+        store) and write the session manifest — token history plus
+        sampler RNG state — to the store.  The manifest is what lets
+        ANY replica resurrect the conversation: pages rejoin from the
+        store by fingerprint or by re-prefill, both bit-identical."""
+        if not self._tiering or req.session is None:
+            return
+        psz = self.page_size
+        if req.tokens:
+            toks = list(req.tokens)
+            # The LAST sampled token was never fed back through a tick,
+            # so its K/V was never written — only positions
+            # [0, len(toks)-2] hold state.
+            full = max(0, (len(toks) - 1) // psz)
+        else:
+            toks = [int(t) for t in req.prompt]
+            full = len(toks) // psz   # prefill covered every position
+        full = min(full, len(req.pages))
+        try:
+            if full and self._prefix is not None:
+                self._prefix.insert(toks[:full * psz],
+                                    req.pages[:full])
+            man = {"tokens": [int(t) for t in toks],
+                   "t": time.time(), "engine": self.name}
+            if req.rng is not None:
+                man["rng_state"] = req.rng.bit_generator.state
+            self._tier_store().put_session(req.session, man)
+        except Exception:
+            # A failed checkpoint degrades durability, never the
+            # request (its stream already has every token).
+            logger.exception("engine %s: session %s checkpoint failed",
+                             self.name, req.session)
+
+    def session_resurrect(self, session_id: str,
+                          tokens: Optional[Sequence[int]] = None
+                          ) -> Optional[Dict]:
+        """Worker command: restore a durable session from the store.
+
+        Loads the manifest, then imports whatever store pages the
+        local radix tree does not already cover (per-page CRC gate: an
+        unreadable page stops the import there and the tail
+        re-prefills — deterministic prefill makes the fallback exact,
+        so resurrection never trades parity for durability).  Returns
+        {"tokens", "rng_state", "imported", "cached_pages"} or None
+        when no manifest exists."""
+        if not self._tiering or self._prefix is None:
+            return None
+        man = self._tier_store().get_session(session_id)
+        if man is None:
+            return None
+        toks = [int(t) for t in (tokens if tokens is not None
+                                 else man.get("tokens") or [])]
+        psz = self.page_size
+        usable = len(toks) // psz
+        nodes, _ = self._prefix.match_nodes(toks)
+        depth_lo = len(nodes)
+        imported = 0
+        if depth_lo < usable:
+            fps = prefix_fingerprints(toks, psz, usable)
+            frames = []
+            store = self._tier_store()
+            for d in range(depth_lo, usable):
+                frame = store.get_page(fps[d])
+                if frame is None or len(frame) != self._page_nbytes:
+                    break
+                frames.append(frame)
+            if frames:
+                imported = self._import_store_frames(toks, nodes,
+                                                     frames)
+        self._resurrections += 1
+        RESURRECTIONS_COUNTER.inc(tags=self._tags)
+        self._update_kv_gauges()
+        return {"tokens": man.get("tokens"),
+                "rng_state": man.get("rng_state"),
+                "imported": imported,
+                "cached_pages": depth_lo}
+
+    def _import_store_frames(self, toks, path_nodes, frames) -> int:
+        """Land store frames below an existing (any-tier) matched
+        path: reserve pool pages, splice, publish.  Existing path
+        nodes pass page=None through insert(), so a demoted ancestor
+        keeps its payload instead of adopting garbage."""
+        psz = self.page_size
+        need = len(frames)
+        got = self._alloc.alloc(need)
+        if got is None:
+            self._demote_for_pressure(need)
+            got = self._alloc.alloc(need)
+        if got is None \
+                and self._alloc.free_pages + self._prefix.releasable() \
+                >= need:
+            self._prefix.evict(need)
+            got = self._alloc.alloc(need)
+        if got is None:
+            return 0   # pool too hot: resurrect by re-prefill instead
+        try:
+            k, v = self._frames_to_arrays(frames)
+            self._cache = decode.paged_write_pages(
+                self._cache, jnp.asarray(np.asarray(got, np.int32)),
+                jnp.asarray(k), jnp.asarray(v))
+            depth_hi = len(path_nodes) + need
+            self._prefix.insert(toks[:depth_hi * psz],
+                                [None] * len(path_nodes) + list(got))
+        except BaseException:
+            for p in got:
+                self._alloc.decref(p)
+            self._update_kv_gauges()
+            raise
+        for p in got:
+            self._alloc.decref(p)   # the tree's refs own them now
+        return need
+
     def load_info(self) -> Dict[str, int]:
         """The autoscaler's saturation gauges, as plain field reads —
         polled every control-loop tick, so no EngineStats construction
@@ -841,6 +1247,18 @@ class GenerationEngine:
                 "num_slots": self.num_slots,
                 "kv_blocks_total": self.kv_pages,
                 "kv_blocks_free": self._alloc.free_pages}
+        if self._prefix is not None:
+            tn = self._prefix.tier_nodes
+            info["kv_tier_pages"] = {"t0": tn[0], "t1": tn[1],
+                                     "t2": tn[2]}
+            info["kv_demotable"] = self._demotable_hint
+            # What admission can ACTUALLY claim: the free list plus
+            # everything pressure demotion would surrender.  The
+            # autoscaler reads this instead of kv_blocks_free so idle
+            # sessions parked in the pool never look like saturation
+            # (no phantom scale-ups).
+            info["kv_blocks_reclaimable"] = (self._alloc.free_pages
+                                             + self._demotable_hint)
         if self._recent_ttft:
             # p99 over the recent ring (snapshot first: the worker
             # thread appends concurrently).
@@ -882,7 +1300,14 @@ class GenerationEngine:
             prefix_cache_misses=self._prefix_misses,
             prefix_hit_tokens=self._prefix_hit_tokens,
             spec_drafted_tokens=self._spec_drafted,
-            spec_accepted_tokens=self._spec_accepted)
+            spec_accepted_tokens=self._spec_accepted,
+            kv_t1_pages=(self._prefix.tier_nodes[TIER_HOST]
+                         if self._prefix is not None else 0),
+            kv_t2_pages=(self._prefix.tier_nodes[TIER_STORE]
+                         if self._prefix is not None else 0),
+            kv_demotions=self._demotions,
+            kv_promotions=self._promotions,
+            session_resurrections=self._resurrections)
 
     # ------------------------------------------------------------------
     # Worker thread
@@ -895,7 +1320,12 @@ class GenerationEngine:
             self._fail_all(e)
         while True:
             with self._cond:
-                while not self._stop and not self._has_work_locked():
+                # The idle wait must ALSO break for a due tier sweep:
+                # an engine with no work is exactly the one whose pages
+                # are going cold, and sweeps are what move them out of
+                # the decode pool.
+                while not self._stop and not self._has_work_locked() \
+                        and not self._sweep_due():
                     self._cond.wait(timeout=0.1)
                 if self._stop:
                     return
@@ -904,6 +1334,7 @@ class GenerationEngine:
             # failures are their caller's, never the batch's.
             self._drain_commands()
             try:
+                self._maybe_sweep_tiers()
                 self._admit_one_chunk()
                 self._decode_tick()
             except Exception as e:  # engine-level fault: fail fast,
@@ -958,22 +1389,65 @@ class GenerationEngine:
         """Prefix-match + page reservation for one request.  Returns
         (pages, matched_tokens) or None when the pool can't cover the
         request right now (caller requeues and retries after evictions
-        free pages)."""
+        free pages).
+
+        Tier-aware: the match walks ALL tiers; demoted nodes on the
+        matched path are PROMOTED — their frames are CRC-verified on
+        host FIRST (an unreadable frame truncates the match there and
+        the tail re-prefills, bit-identical by determinism), then
+        spliced into freshly reserved pool pages inside the same
+        all-or-nothing reservation that admits the request."""
         L = len(req.prompt)
-        matched_pages: List[int] = []
-        matched_tok = 0
+        matched_nodes: List = []
+        promote: List = []   # (node, verified frame) in path order
         if self._prefix is not None:
             # Cap at L-1: at least one prompt token must run through
             # tail prefill — logits come from computation, not cache.
-            matched_pages, matched_tok = self._prefix.match(
-                req.prompt, max_tokens=L - 1)
-            # Hold the matched pages BEFORE any eviction can run:
-            # evict() may drop their tree nodes, and only our refs keep
-            # the pages from being recycled under us.
-            for p in matched_pages:
-                self._alloc.incref(p)
-        need = req.n_blocks - len(matched_pages)
+            nodes, _ = self._prefix.match_nodes(req.prompt,
+                                                max_tokens=L - 1)
+            for n in nodes:
+                if n.tier == TIER_POOL:
+                    matched_nodes.append(n)
+                    continue
+                if not self._tiering:
+                    break
+                frame = self._tier_frame(n)
+                if frame is None:
+                    break   # dead payload: re-prefill from here on
+                matched_nodes.append(n)
+                promote.append((n, frame))
+        matched_tok = len(matched_nodes) * self.page_size
+        pool_pages = [n.page for n in matched_nodes
+                      if n.tier == TIER_POOL]
+        # Hold the matched pool pages BEFORE any demotion or eviction
+        # can run: evict() may drop their tree nodes, and only our refs
+        # keep the pages from being recycled under us.  (The extra ref
+        # also makes them ineligible for pressure demotion below.)
+        for p in pool_pages:
+            self._alloc.incref(p)
+        need = req.n_blocks - len(pool_pages)
         got = self._alloc.alloc(need)
+        if got is None:
+            # Pressure order: demote cold tree-only pages first (their
+            # bytes survive in a lower tier), evict shared prefixes
+            # only when that still doesn't cover the reservation.
+            self._demote_for_pressure(need)
+            got = self._alloc.alloc(need)
+        if got is None and promote:
+            # About to fall back to eviction, which may drop the very
+            # tiered leaves queued for promotion (a demoted node holds
+            # no pinnable pool page).  Truncate the match at the first
+            # demoted node — the tail re-prefills — rather than let
+            # promote() run against an orphaned node.
+            cut = matched_nodes.index(promote[0][0])
+            for n in matched_nodes[cut:]:
+                if n.tier == TIER_POOL:
+                    self._alloc.decref(n.page)
+            matched_nodes = matched_nodes[:cut]
+            promote = []
+            matched_tok = len(matched_nodes) * self.page_size
+            pool_pages = [n.page for n in matched_nodes]
+            need = req.n_blocks - len(pool_pages)
         if got is None and self._prefix is not None \
                 and self._alloc.free_pages + self._prefix.releasable() \
                 >= need:
@@ -984,9 +1458,32 @@ class GenerationEngine:
             self._prefix.evict(need)
             got = self._alloc.alloc(need)
         if got is None:
-            for p in matched_pages:
+            for p in pool_pages:
                 self._alloc.decref(p)
             return None
+        if promote:
+            try:
+                k, v = self._frames_to_arrays([f for _, f in promote])
+                landing = got[:len(promote)]
+                self._cache = decode.paged_write_pages(
+                    self._cache,
+                    jnp.asarray(np.asarray(landing, np.int32)),
+                    jnp.asarray(k), jnp.asarray(v))
+            except BaseException:
+                for p in got:
+                    self._alloc.decref(p)
+                for p in pool_pages:
+                    self._alloc.decref(p)
+                self._update_kv_gauges()
+                raise
+            for (node, _), page in zip(promote, landing):
+                # The page's allocation ref becomes the TREE's ref;
+                # the request then takes its own, same as a pool hit.
+                self._prefix.promote(node, page)
+                self._alloc.incref(page)
+            self._promotions += len(promote)
+            KV_PROMOTIONS_COUNTER.inc(len(promote), tags=self._tags)
+            got = got[len(promote):]
         if matched_tok > 0:
             self._prefix_hits += 1
             self._prefix_hit_tokens += matched_tok
@@ -994,7 +1491,7 @@ class GenerationEngine:
         else:
             self._prefix_misses += 1
             PREFIX_MISSES_COUNTER.inc(tags=self._tags)
-        req.pages = matched_pages + got
+        req.pages = [n.page for n in matched_nodes] + got
         req.prefix_hit_tokens = matched_tok
         self._update_kv_gauges()
         return req.pages, matched_tok
@@ -1095,12 +1592,16 @@ class GenerationEngine:
         _span_for(req, "engine.first_tick", t_fc, now - t_fc,
                   args={"request_id": req.id})
         if req.eos_token is not None and first == req.eos_token:
+            req.tokens = list(req.prompt) + [first]
+            self._maybe_checkpoint_session(req)
             self._release_pages(req)
             self._finish_request(req, "completed")
             return
         if req.max_new_tokens == 1:
             # Nothing left to decode: never joins the batch.
             self._emit(req, first, now)
+            req.tokens = list(req.prompt) + [first]
+            self._maybe_checkpoint_session(req)
             self._release_pages(req)
             self._finish_request(req, "completed")
             return
@@ -1287,6 +1788,9 @@ class GenerationEngine:
         self._pos[slot] = 0
         self._tok[slot] = 0
         self._block_tables[slot, :] = 0
+        # Durable sessions checkpoint BEFORE the pages are released —
+        # publishing them into the radix tree needs the refs alive.
+        self._maybe_checkpoint_session(req)
         self._release_pages(req)
         self._update_occupancy()
         self._finish_request(req, status)
@@ -1309,6 +1813,13 @@ class GenerationEngine:
 
     def _update_kv_gauges(self):
         KV_BLOCKS_FREE_GAUGE.set(self._alloc.free_pages, tags=self._tags)
+        if self._prefix is not None:
+            for tier, count in zip(("t0", "t1", "t2"),
+                                   self._prefix.tier_nodes):
+                KV_TIER_PAGES_GAUGE.set(
+                    count, tags={**self._tags, "tier": tier})
+            if self._tiering:
+                self._demotable_hint = self._prefix.releasable()
 
     def _reset_paging(self):
         self._alloc = BlockAllocator(self.kv_pages, first_page=1)
@@ -1316,6 +1827,10 @@ class GenerationEngine:
             self._prefix = RadixPrefixCache(
                 self.page_size, self._alloc,
                 digest_depth=_cfg.serve_affinity_digest_depth)
+            self._prefix.release_payload = self._release_tier_payload
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None
         self._block_tables[:] = 0
         self._update_kv_gauges()
 

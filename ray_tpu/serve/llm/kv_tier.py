@@ -1,0 +1,297 @@
+"""Cold-tier backing for the KV memory hierarchy (T1 arena, T2 store).
+
+The engine's three tiers:
+
+  T0  decode pool — pages live on device, addressed through block
+      tables (paging.py owns the accounting);
+  T1  HostKVArena — one /dev/shm-backed mmap per engine, fixed-size
+      page slots over a byte budget (the same arena-mmap pattern the
+      transfer plane's same-host path uses).  Fast demote/promote, dies
+      with the process;
+  T2  KVPageStore — a host-shared spill directory of content-addressed
+      page files plus session manifests.  Survives replica death; any
+      replica on the host can import from it — which is exactly what
+      makes a durable session resurrect anywhere.
+
+Integrity discipline is kv_transfer's, applied at rest: every page
+travels as one frame (K bytes + V bytes, `page_frame`), every frame
+carries a CRC32 checked before anything touches the device, and a store
+write is temp-file + rename so a reader can never observe a torn page.
+A failed read is a MISS (the caller re-prefills), never a corrupt
+import — the all-or-nothing bar migration set applies to tiers too.
+
+Single-owner discipline: arena and store methods are called from the
+engine's worker thread (the store's files are additionally shared
+across processes, which the atomic-rename write makes safe).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mmap
+import os
+import struct
+import tempfile
+import time
+import uuid
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else None
+# Store page/manifest file header: magic, CRC32 of the body, body length.
+_HDR = struct.Struct("<4sII")
+_MAGIC = b"rtkv"
+
+
+def page_frame(k_page: np.ndarray, v_page: np.ndarray) -> bytes:
+    """One page's wire/at-rest frame: K bytes then V bytes, contiguous.
+    The SAME framing kv_transfer puts on migration frames, so a tier
+    and a peer replica are interchangeable sources for an import."""
+    return k_page.tobytes() + v_page.tobytes()
+
+
+def frame_crc(frame: bytes) -> int:
+    return zlib.crc32(frame)
+
+
+def split_frame(frame: bytes, k_nbytes: int, kshape, vshape,
+                dtype) -> tuple:
+    """Inverse of page_frame: (k, v) arrays of the given shapes."""
+    k = np.frombuffer(frame[:k_nbytes], dtype).reshape(kshape)
+    v = np.frombuffer(frame[k_nbytes:], dtype).reshape(vshape)
+    return k, v
+
+
+class HostKVArena:
+    """Fixed-slot host arena for demoted KV pages (tier T1).
+
+    One mmap of capacity * page_nbytes bytes, /dev/shm-backed when
+    available (anonymous otherwise — same lifetime, no name).  Slots
+    are recycled LIFO; the caller (the radix trie's payload) records
+    which slot holds which page plus its CRC — the arena itself is
+    deliberately dumb storage."""
+
+    def __init__(self, page_nbytes: int, budget_bytes: int,
+                 name: str = "default"):
+        if page_nbytes < 1:
+            raise ValueError("page_nbytes must be >= 1")
+        self.page_nbytes = int(page_nbytes)
+        self.capacity = max(1, int(budget_bytes) // self.page_nbytes)
+        size = self.capacity * self.page_nbytes
+        self._path: Optional[str] = None
+        if _SHM_DIR is not None:
+            self._path = os.path.join(
+                _SHM_DIR, f"rt_kvarena_{name}_{uuid.uuid4().hex[:8]}")
+            try:
+                with open(self._path, "wb") as f:
+                    f.truncate(size)
+                self._file = open(self._path, "r+b")
+                self._mm = mmap.mmap(self._file.fileno(), size)
+            except OSError:
+                self._path = None
+        if self._path is None:
+            self._file = None
+            self._mm = mmap.mmap(-1, size)
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._closed = False
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return self.capacity - len(self._free)
+
+    def put(self, frame: bytes) -> Optional[int]:
+        """Stage one page frame; returns its slot or None when the
+        budget is spent (the sweeper then demotes to the store tier
+        instead — the arena is a cache over T2, never a hard wall)."""
+        if self._closed or not self._free \
+                or len(frame) != self.page_nbytes:
+            return None
+        slot = self._free.pop()
+        base = slot * self.page_nbytes
+        self._mm[base:base + len(frame)] = frame
+        return slot
+
+    def get(self, slot: int) -> Optional[bytes]:
+        if self._closed or not 0 <= slot < self.capacity:
+            return None
+        base = slot * self.page_nbytes
+        return bytes(self._mm[base:base + self.page_nbytes])
+
+    def free(self, slot: int) -> None:
+        if not self._closed and 0 <= slot < self.capacity \
+                and slot not in self._free:
+            self._free.append(slot)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+        except (OSError, ValueError):
+            pass
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+        if self._path:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+def default_store_dir() -> str:
+    """The host-shared spill directory every engine on this host
+    agrees on (uid-scoped, the tempdir convention): config's
+    serve_kv_store_dir when set, else <tempdir>/rt_kv_store-<uid>."""
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+    configured = getattr(_cfg, "serve_kv_store_dir", "") or ""
+    if configured:
+        return configured
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"rt_kv_store-{uid}")
+
+
+def _atomic_write(path: str, payload: bytes) -> bool:
+    """temp + rename so a concurrent reader (another replica pulling a
+    resurrecting session) can never observe a torn file."""
+    tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _checked_read(path: str) -> Optional[bytes]:
+    """Read one header-framed file; any miss — absent, torn, CRC
+    mismatch — is None, and a corrupt file is unlinked so it cannot
+    keep failing future reads."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    if len(data) < _HDR.size:
+        return None
+    magic, crc, n = _HDR.unpack_from(data)
+    body = data[_HDR.size:]
+    if magic != _MAGIC or len(body) != n or zlib.crc32(body) != crc:
+        logger.warning("kv store entry %s failed integrity check; "
+                       "dropping it", os.path.basename(path))
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    return body
+
+
+class KVPageStore:
+    """Durable page + session-manifest store (tier T2).
+
+    Layout under `root`:
+      pages/<fp>.kv        one page frame, content-addressed by the
+                           chained prefix fingerprint of the page's
+                           full prefix (two replicas that never spoke
+                           agree on the key — paging.prefix_fingerprints)
+      sessions/<id>.json   session manifest: token history, sampler RNG
+                           state, page fingerprint chain, timestamp
+
+    Every file is CRC-framed and atomically replaced; reads validate
+    before returning.  sweep() ages both kinds out by mtime."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or default_store_dir()
+        self._pages = os.path.join(self.root, "pages")
+        self._sessions = os.path.join(self.root, "sessions")
+        for d in (self._pages, self._sessions):
+            os.makedirs(d, exist_ok=True)
+
+    # -- pages ---------------------------------------------------------
+
+    def _page_path(self, fp: str) -> str:
+        return os.path.join(self._pages, f"{fp}.kv")
+
+    def put_page(self, fp: str, frame: bytes) -> bool:
+        path = self._page_path(fp)
+        if os.path.exists(path):
+            # Content-addressed: an existing entry is the same bytes
+            # (deterministic prefill), so rewriting buys nothing.
+            return True
+        hdr = _HDR.pack(_MAGIC, zlib.crc32(frame), len(frame))
+        return _atomic_write(path, hdr + frame)
+
+    def get_page(self, fp: str) -> Optional[bytes]:
+        return _checked_read(self._page_path(fp))
+
+    def has_page(self, fp: str) -> bool:
+        return os.path.exists(self._page_path(fp))
+
+    # -- session manifests ---------------------------------------------
+
+    def _session_path(self, session_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in str(session_id))[:128]
+        return os.path.join(self._sessions, f"{safe}.json")
+
+    def put_session(self, session_id: str, manifest: Dict) -> bool:
+        body = json.dumps(manifest).encode()
+        hdr = _HDR.pack(_MAGIC, zlib.crc32(body), len(body))
+        return _atomic_write(self._session_path(session_id), hdr + body)
+
+    def get_session(self, session_id: str) -> Optional[Dict]:
+        body = _checked_read(self._session_path(session_id))
+        if body is None:
+            return None
+        try:
+            return json.loads(body)
+        except ValueError:
+            return None
+
+    # -- hygiene -------------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        try:
+            return {"pages": len(os.listdir(self._pages)),
+                    "sessions": len(os.listdir(self._sessions))}
+        except OSError:
+            return {"pages": 0, "sessions": 0}
+
+    def sweep(self, ttl_s: float) -> int:
+        """Drop entries untouched for ttl_s (mtime); returns how many.
+        Both sweeping engines racing on one shared directory is fine —
+        unlink of an already-gone file is a no-op."""
+        cutoff = time.time() - ttl_s
+        dropped = 0
+        for d in (self._pages, self._sessions):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                path = os.path.join(d, name)
+                try:
+                    if os.path.getmtime(path) < cutoff:
+                        os.unlink(path)
+                        dropped += 1
+                except OSError:
+                    pass
+        return dropped

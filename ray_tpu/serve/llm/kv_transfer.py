@@ -50,6 +50,7 @@ from ray_tpu._private import protocol
 from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 from ray_tpu._private.transfer import run_windowed
+from ray_tpu.serve.llm.kv_tier import frame_crc, page_frame
 from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -152,7 +153,10 @@ async def _rpc_export_begin(conn, body):
                                lambda: engine.kv_export(tokens))
     except Exception as e:
         return {"error": f"export failed: {e!r}"}
-    if exp is None or len(exp["pages"]) < _cfg.serve_kv_min_migrate_pages:
+    # Size the crossover on MATCHED pages (len(k)): with tiering, an
+    # export can cover demoted pages that carry no pool pin, so
+    # exp["pages"] undercounts what the wire would actually save.
+    if exp is None or len(exp["k"]) < _cfg.serve_kv_min_migrate_pages:
         # Below the crossover the rendezvous costs more than the
         # prefill it would save: tell the puller to re-prefill.
         if exp is not None:
@@ -161,7 +165,9 @@ async def _rpc_export_begin(conn, body):
                 lambda: engine.kv_export_release(exp["pages"]))
         return {"n": 0}
     k, v = exp["k"], exp["v"]
-    frames = [k[i].tobytes() + v[i].tobytes() for i in range(len(k))]
+    # Same framing the tier hierarchy stores at rest (kv_tier): K bytes
+    # then V bytes per page, CRC32 over the frame.
+    frames = [page_frame(k[i], v[i]) for i in range(len(k))]
     xid = uuid.uuid4().hex[:12]
     gen = uuid.uuid4().hex[:12]
     path = None
@@ -182,7 +188,7 @@ async def _rpc_export_begin(conn, body):
             "matched_tokens": exp["matched_tokens"],
             "page_nbytes": len(frames[0]), "k_nbytes": k[0].nbytes,
             "shape_k": tuple(k.shape[1:]), "shape_v": tuple(v.shape[1:]),
-            "dtype": str(k.dtype), "crc": [zlib.crc32(f) for f in frames],
+            "dtype": str(k.dtype), "crc": [frame_crc(f) for f in frames],
             "path": path}
 
 
@@ -396,7 +402,7 @@ def migrate_local(src_engine, dst_engine, tokens: Sequence[int],
     if exp is None:
         return 0
     try:
-        if len(exp["pages"]) < _cfg.serve_kv_min_migrate_pages:
+        if len(exp["k"]) < _cfg.serve_kv_min_migrate_pages:
             return 0
         matched = tokens[:exp["matched_tokens"]]
         n = dst_engine.run_on_worker(

@@ -26,7 +26,19 @@ worker thread (admission/eviction), never concurrently.
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Tier ids for _RadixNode.tier: the decode pool (pages live on device,
+# node.page is a pool page id), the host shared-memory arena, and the
+# file-backed page store.  A node's K/V bytes live in EXACTLY one tier;
+# demotion/promotion moves them, never copies them live in two places
+# (the store is the exception by design: a T2 entry persists on disk
+# even after its node is promoted or evicted — that persistence IS the
+# durability the session-resurrect path relies on).
+TIER_POOL = 0
+TIER_HOST = 1
+TIER_STORE = 2
 
 
 def _chunk_fp(parent_fp: str, key: Sequence[int]) -> str:
@@ -118,7 +130,7 @@ class BlockAllocator:
 
 class _RadixNode:
     __slots__ = ("children", "page", "parent", "key", "last_used",
-                 "fp", "depth")
+                 "fp", "depth", "tier", "payload", "last_used_t")
 
     def __init__(self, key, page, parent):
         self.children: Dict[tuple, "_RadixNode"] = {}
@@ -128,6 +140,15 @@ class _RadixNode:
         self.last_used = 0
         self.fp = ""      # chained prefix fingerprint (root: "")
         self.depth = 0    # pages from root (root: 0)
+        # Tier state: TIER_POOL means `page` is a live pool page id;
+        # TIER_HOST/TIER_STORE mean `page` is None and `payload` names
+        # where the bytes went — ("t1", slot, crc, nbytes) for an arena
+        # slot, ("t2", key, crc, nbytes) for a store entry.  last_used_t
+        # is the wall-clock twin of the LRU logical clock; the demotion
+        # sweeper compares it against the idle knobs.
+        self.tier = TIER_POOL
+        self.payload: Optional[tuple] = None
+        self.last_used_t = 0.0
 
 
 class RadixPrefixCache:
@@ -155,32 +176,69 @@ class RadixPrefixCache:
         # independent of how deep the trie grows.
         self.digest_depth = digest_depth
         self._fp_index: Dict[str, _RadixNode] = {}
+        # Nodes per tier, maintained incrementally (load_info polls
+        # this every autoscale tick — never a tree walk on that path).
+        self.tier_nodes: List[int] = [0, 0, 0]
+        # Called with a node's payload whenever the tree stops owning
+        # it (promotion, adoption by insert, eviction, clear).  The
+        # engine points this at the arena's slot-free; T2 payloads are
+        # deliberately NOT deleted from the store here (persistence is
+        # the point — the store's TTL sweep owns their lifetime).
+        self.release_payload: Optional[Callable[[tuple], None]] = None
+
+    def _drop_payload(self, node: _RadixNode) -> None:
+        if node.payload is not None and self.release_payload is not None:
+            try:
+                self.release_payload(node.payload)
+            except Exception:
+                pass  # a leaked arena slot must never poison the trie
+        node.payload = None
 
     def match(self, tokens: Sequence[int], max_tokens: Optional[int] = None
               ) -> Tuple[List[int], int]:
-        """Longest cached prefix of `tokens` in full pages.
+        """Longest cached POOL-TIER prefix of `tokens` in full pages.
 
         Returns (pages, matched_token_count).  `max_tokens` caps the
         match (the engine passes len(prompt)-1: at least one prompt
         token must run through tail prefill to produce the logits the
         first sampled token comes from — a pure cache hit yields K/V,
-        never logits).  Matched nodes are touched for LRU; the CALLER
-        must incref the returned pages before relying on them (a later
-        evict() may drop the nodes)."""
+        never logits).  The walk stops at the first demoted node: a
+        T1/T2 node has no pool page to hand out — callers that can
+        promote use match_nodes() instead.  Matched nodes are touched
+        for LRU; the CALLER must incref the returned pages before
+        relying on them (a later evict() may drop the nodes)."""
+        nodes, _ = self.match_nodes(tokens, max_tokens)
+        pages: List[int] = []
+        for n in nodes:
+            if n.tier != TIER_POOL:
+                break
+            pages.append(n.page)
+        return pages, len(pages) * self.page_size
+
+    def match_nodes(self, tokens: Sequence[int],
+                    max_tokens: Optional[int] = None
+                    ) -> Tuple[List["_RadixNode"], int]:
+        """Longest cached prefix of `tokens` as the NODE path, any
+        tier.  The engine's reservation path walks this to promote
+        demoted nodes back into the pool in the same all-or-nothing
+        reservation that admits the request.  Touches LRU (logical
+        clock and wall time) for every matched node."""
         psz = self.page_size
         limit = len(tokens) if max_tokens is None else min(
             max_tokens, len(tokens))
         self._clock += 1
+        now = time.monotonic()
         node = self._root
-        pages: List[int] = []
+        out: List[_RadixNode] = []
         for i in range(limit // psz):
             child = node.children.get(tuple(tokens[i * psz:(i + 1) * psz]))
             if child is None:
                 break
             child.last_used = self._clock
-            pages.append(child.page)
+            child.last_used_t = now
+            out.append(child)
             node = child
-        return pages, len(pages) * psz
+        return out, len(out) * psz
 
     def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
         """Record that pages[i] holds the K/V of tokens[i*psz:(i+1)*psz].
@@ -189,15 +247,26 @@ class RadixPrefixCache:
         node already exists (another request cached the same chunk
         first) the existing page is kept and the duplicate is ignored —
         the caller keeps its own reference on the duplicate and frees it
-        with the request.  Returns the number of new nodes."""
+        with the request.  A DEMOTED node on the path instead ADOPTS the
+        caller's page (incref'd for the tree, old payload released):
+        the caller just computed or imported bit-identical K/V for that
+        chunk, so this is a free promotion.  Returns the number of new
+        nodes."""
         psz = self.page_size
         self._clock += 1
+        now = time.monotonic()
         node = self._root
         added = 0
         for i, page in enumerate(pages):
             key = tuple(tokens[i * psz:(i + 1) * psz])
             child = node.children.get(key)
             if child is None:
+                if page is None:
+                    # A placeholder for a path node that vanished
+                    # between the caller's match and this insert; a
+                    # node cannot exist without bytes, so the rest of
+                    # the path is unpublishable too.
+                    break
                 child = _RadixNode(key, page, node)
                 child.depth = node.depth + 1
                 if child.depth <= self.digest_depth:
@@ -206,10 +275,92 @@ class RadixPrefixCache:
                 node.children[key] = child
                 self._alloc.incref(page)
                 self.nodes += 1
+                self.tier_nodes[TIER_POOL] += 1
                 added += 1
+            elif child.tier != TIER_POOL and page is not None:
+                # Adoption: deterministic prefill/import reproduced this
+                # chunk's K/V bit-identically in the caller's page.  A
+                # None page means the caller is extending BELOW a
+                # demoted ancestor without re-materializing it (store
+                # import); the ancestor keeps its tier payload.
+                self.tier_nodes[child.tier] -= 1
+                self.tier_nodes[TIER_POOL] += 1
+                child.tier = TIER_POOL
+                child.page = page
+                self._drop_payload(child)
+                self._alloc.incref(page)
             child.last_used = self._clock
+            child.last_used_t = now
             node = child
         return added
+
+    # -- tier transitions (engine worker thread only) -------------------
+
+    def path_fp(self, node: _RadixNode) -> str:
+        """Full-depth chained fingerprint of the prefix this node caps
+        (the digest index only carries fingerprints to digest_depth;
+        store-tier keys need them at ANY depth, so this recomputes the
+        chain from the root — O(depth), demotion-path only)."""
+        keys: List[tuple] = []
+        n = node
+        while n is not self._root and n is not None:
+            keys.append(n.key)
+            n = n.parent
+        fp = ""
+        for key in reversed(keys):
+            fp = _chunk_fp(fp, key)
+        return fp
+
+    def demote_candidates(self, min_idle_s: float,
+                          tier: int = TIER_POOL,
+                          limit: Optional[int] = None
+                          ) -> List["_RadixNode"]:
+        """Nodes eligible to leave `tier`, coldest first.  T0 eligibility
+        is tree-only pages (refcount 1 — a page a live request still
+        gathers through is NEVER demoted) idle at least min_idle_s; T1
+        eligibility is idle time alone.  min_idle_s=0 is the pressure
+        path: anything tree-only is fair game, LRU order."""
+        now = time.monotonic()
+        out: List[_RadixNode] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.tier != tier:
+                continue
+            if tier == TIER_POOL and self._alloc.refcount(n.page) != 1:
+                continue
+            if now - n.last_used_t < min_idle_s:
+                continue
+            out.append(n)
+        out.sort(key=lambda n: (n.last_used, -n.depth))
+        return out if limit is None else out[:limit]
+
+    def apply_demote(self, node: _RadixNode, tier: int,
+                     payload: tuple) -> None:
+        """Commit one node's demotion AFTER its bytes landed in the
+        destination tier: the pool page is freed (T0 source; caller
+        guaranteed refcount 1) or the arena slot released (T1 source),
+        and the node now names `payload` instead."""
+        if node.tier == TIER_POOL:
+            self._alloc.decref(node.page)
+            node.page = None
+        else:
+            self._drop_payload(node)
+        self.tier_nodes[node.tier] -= 1
+        self.tier_nodes[tier] += 1
+        node.tier = tier
+        node.payload = payload
+
+    def promote(self, node: _RadixNode, page: int) -> None:
+        """Commit one node's promotion AFTER its bytes landed in pool
+        page `page` (freshly alloc'd — its allocation ref becomes the
+        tree's ref, mirroring insert()'s accounting)."""
+        self._drop_payload(node)
+        self.tier_nodes[node.tier] -= 1
+        self.tier_nodes[TIER_POOL] += 1
+        node.tier = TIER_POOL
+        node.page = page
 
     def _unindex(self, node: _RadixNode) -> None:
         if node.fp and self._fp_index.get(node.fp) is node:
@@ -226,9 +377,21 @@ class RadixPrefixCache:
         ties break deepest-first for the same reason as hot_prefixes:
         a path touched as one unit stamps every node the same clock.
         Bounded by both top_k and digest_depth, so it stays gauge-sized
-        however big the trie is."""
-        return [{"fp": n.fp, "d": n.depth}
+        however big the trie is.  Each entry carries "t": the WORST
+        tier on its root path — the router discounts T1/T2 hits against
+        T0 hits (a promoted page costs a host->device splice a pool hit
+        does not)."""
+        return [{"fp": n.fp, "d": n.depth, "t": self._path_tier(n)}
                 for n in self._pick_maximal(top_k)]
+
+    def _path_tier(self, node: _RadixNode) -> int:
+        worst = node.tier
+        n = node.parent
+        while n is not None and n.parent is not None:
+            if n.tier > worst:
+                worst = n.tier
+            n = n.parent
+        return worst
 
     def _pick_maximal(self, top_k: int) -> List["_RadixNode"]:
         """Up to top_k indexed nodes, most recently used first, maximal
@@ -276,17 +439,20 @@ class RadixPrefixCache:
         return False
 
     def releasable(self) -> int:
-        """Pages the tree could actually FREE by evicting everything:
-        nodes whose page has no holder besides the tree itself.  The
-        engine checks this before evicting — when even a full wipe
-        cannot cover a reservation, destroying the cache buys nothing
-        (the request waits for residents to finish instead, and future
-        prefix hits survive)."""
+        """POOL pages the tree could actually FREE by evicting
+        everything: T0 nodes whose page has no holder besides the tree
+        itself.  Tier-aware on purpose — a demoted node holds no pool
+        page, so counting it would overstate what eviction can reclaim
+        and let an unsatisfiable reservation wipe the cache for
+        nothing.  The engine checks this before evicting; when even a
+        full wipe cannot cover a reservation, the request waits for
+        residents to finish instead and future prefix hits survive."""
         count, stack = 0, list(self._root.children.values())
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            if self._alloc.refcount(n.page) == 1:
+            if n.tier == TIER_POOL \
+                    and self._alloc.refcount(n.page) == 1:
                 count += 1
         return count
 
@@ -332,7 +498,15 @@ class RadixPrefixCache:
             parent = victim.parent
             del parent.children[victim.key]
             self._unindex(victim)
-            self._alloc.decref(victim.page)
+            if victim.tier == TIER_POOL:
+                self._alloc.decref(victim.page)
+            else:
+                # A demoted leaf frees no pool page, but dropping it
+                # exposes its (warmer, possibly T0) parent to the heap.
+                # Its T2 copy persists in the store; a T1 payload's
+                # arena slot is handed back through the release hook.
+                self._drop_payload(victim)
+            self.tier_nodes[victim.tier] -= 1
             self.nodes -= 1
             dropped += 1
             if parent is not self._root and not parent.children:
@@ -345,7 +519,11 @@ class RadixPrefixCache:
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            self._alloc.decref(node.page)
+            if node.tier == TIER_POOL:
+                self._alloc.decref(node.page)
+            else:
+                self._drop_payload(node)
         self._root.children.clear()
         self._fp_index.clear()
         self.nodes = 0
+        self.tier_nodes = [0, 0, 0]

@@ -400,7 +400,7 @@ def test_llm_server_http_503_when_overloaded():
 
         out = asyncio.run(call())
         assert out["__http__"] is True and out["status"] == 503
-        assert ("Retry-After", "1") in out["headers"]
+        assert ("Retry-After", "1.000") in out["headers"]
     finally:
         srv.engine.stop()
 

@@ -458,7 +458,9 @@ def test_commit_cap_releases_as_requests_finish():
 
 def test_http_retry_after_tracks_overload_reason():
     """api.py maps queue_full -> Retry-After 1 and kv_exhausted -> a
-    longer hint, both as structured 503s."""
+    longer hint, both as structured 503s.  Float seconds on the wire:
+    a tier-aware hint can be sub-second (one demotion sweep away) and
+    integer rounding would turn it into a full second of idle client."""
     import json
 
     from ray_tpu.serve._private.replica import Request
@@ -481,7 +483,7 @@ def test_http_retry_after_tracks_overload_reason():
         srv.engine.submit(_prompt(2, 6), max_new_tokens=6)
         out = _call(srv)
         assert out["__http__"] is True and out["status"] == 503
-        assert ("Retry-After", "5") in out["headers"], out["headers"]
+        assert ("Retry-After", "5.000") in out["headers"], out["headers"]
     finally:
         srv.engine.stop()
 
@@ -494,7 +496,7 @@ def test_http_retry_after_tracks_overload_reason():
         srv2.engine.submit(_prompt(1, 6), max_new_tokens=6)
         out = _call(srv2)
         assert out["__http__"] is True and out["status"] == 503
-        assert ("Retry-After", "1") in out["headers"], out["headers"]
+        assert ("Retry-After", "1.000") in out["headers"], out["headers"]
     finally:
         srv2.engine.stop()
 
